@@ -1,0 +1,163 @@
+"""ray_tpu — a TPU-native distributed ML framework with the capabilities of Ray.
+
+Public core API mirrors the reference's surface
+(ref: python/ray/__init__.py; worker.py:1108 init, :2390 get, :2519 put,
+:2582 wait) while the runtime underneath is single-controller and
+mesh-first — see README.md and SURVEY.md.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+from ._version import __version__
+from . import exceptions
+from .core import runtime as _runtime_mod
+from .core.actor import ActorClass, ActorHandle, get_actor
+from .core.config import Config
+from .core.ids import ActorId, JobId, NodeId, ObjectId, TaskId, WorkerId
+from .core.object_ref import ObjectRef
+from .core.placement_group import (PlacementGroup, placement_group,
+                                   placement_group_table,
+                                   remove_placement_group)
+from .core.remote_function import RemoteFunction
+from .core.runtime import DriverRuntime, RuntimeContext
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "kill", "cancel", "get_actor", "nodes", "cluster_resources",
+    "available_resources", "get_runtime_context", "ObjectRef",
+    "placement_group", "remove_placement_group", "placement_group_table",
+    "PlacementGroup", "exceptions", "method", "__version__",
+]
+
+
+def init(num_cpus: Optional[float] = None,
+         num_tpus: Optional[float] = None,
+         num_nodes: int = 1,
+         resources: Optional[Dict[str, float]] = None,
+         namespace: str = "default",
+         system_config: Optional[Dict[str, Any]] = None,
+         ignore_reinit_error: bool = False,
+         object_store_memory: Optional[int] = None,
+         **_ignored) -> DriverRuntime:
+    """Start (or connect to) the runtime. Inside a worker this is a no-op
+    returning the ambient WorkerRuntime, matching the reference's behavior."""
+    existing = _runtime_mod.maybe_runtime()
+    if existing is not None:
+        if isinstance(existing, DriverRuntime) and not ignore_reinit_error:
+            raise RuntimeError(
+                "ray_tpu.init() called twice; pass ignore_reinit_error=True")
+        return existing
+    res: Dict[str, float] = dict(resources or {})
+    res.setdefault("CPU", float(num_cpus if num_cpus is not None
+                                else (os.cpu_count() or 1)))
+    if num_tpus is not None:
+        res["TPU"] = float(num_tpus)
+    if object_store_memory is not None:
+        res["object_store_memory"] = float(object_store_memory)
+    rt = DriverRuntime(resources=res, num_nodes=num_nodes,
+                       config=Config(system_config), namespace=namespace)
+    _runtime_mod.set_runtime(rt)
+    return rt
+
+
+def shutdown() -> None:
+    rt = _runtime_mod.maybe_runtime()
+    if rt is not None:
+        rt.shutdown()
+        _runtime_mod.set_runtime(None)
+
+
+def is_initialized() -> bool:
+    return _runtime_mod.maybe_runtime() is not None
+
+
+def remote(*args, **options):
+    """Decorator turning a function into a RemoteFunction or a class into an
+    ActorClass. Usable bare (@remote) or with options (@remote(num_cpus=2))."""
+    if len(args) == 1 and not options and (callable(args[0]) or isinstance(args[0], type)):
+        target = args[0]
+        if isinstance(target, type):
+            return ActorClass(target)
+        return RemoteFunction(target)
+    if args:
+        raise TypeError("@remote takes keyword options only")
+
+    def wrap(target):
+        if isinstance(target, type):
+            return ActorClass(target, options)
+        return RemoteFunction(target, options)
+
+    return wrap
+
+
+def method(num_returns: int = 1):
+    """Decorator recording per-method defaults on actor classes (parity shim;
+    options are currently applied at call time via .options())."""
+
+    def wrap(m):
+        m._rtpu_num_returns = num_returns
+        return m
+
+    return wrap
+
+
+def get(refs, timeout: Optional[float] = None):
+    rt = _runtime_mod.get_runtime()
+    if isinstance(refs, ObjectRef):
+        return rt.get(refs, timeout)
+    if isinstance(refs, (list, tuple)):
+        if not all(isinstance(r, ObjectRef) for r in refs):
+            raise TypeError("ray_tpu.get accepts an ObjectRef or a list of them")
+        return rt.get(list(refs), timeout)
+    raise TypeError(f"Cannot get {type(refs)}")
+
+
+def put(value: Any) -> ObjectRef:
+    rt = _runtime_mod.get_runtime()
+    return rt.put(value)
+
+
+def wait(refs: Sequence[ObjectRef], num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    rt = _runtime_mod.get_runtime()
+    return rt.wait(list(refs), num_returns=num_returns, timeout=timeout,
+                   fetch_local=fetch_local)
+
+
+def kill(actor: ActorHandle, no_restart: bool = True) -> None:
+    rt = _runtime_mod.get_runtime()
+    rt.kill_actor(actor._actor_id, no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, force: bool = False, recursive: bool = True) -> None:
+    rt = _runtime_mod.get_runtime()
+    rt.cancel(ref, force=force)
+
+
+def free(refs: Sequence[ObjectRef]) -> None:
+    rt = _runtime_mod.get_runtime()
+    rt.free(list(refs))
+
+
+def nodes() -> List[dict]:
+    rt = _runtime_mod.get_runtime()
+    return [
+        {"NodeID": n.node_id.hex(), "Alive": n.alive,
+         "Resources": dict(n.total_resources.items()),
+         "Labels": dict(n.labels)}
+        for n in rt.gcs.nodes()
+    ]
+
+
+def cluster_resources() -> Dict[str, float]:
+    return _runtime_mod.get_runtime().cluster_resources()
+
+
+def available_resources() -> Dict[str, float]:
+    return _runtime_mod.get_runtime().available_resources()
+
+
+def get_runtime_context() -> RuntimeContext:
+    return _runtime_mod.get_runtime().runtime_context()

@@ -1,0 +1,48 @@
+"""In-process multi-node cluster for tests.
+
+Equivalent of the reference's ray.cluster_utils.Cluster
+(ref: python/ray/cluster_utils.py:99; add_node :165, remove_node :238) — the
+standard way fault-tolerance tests create and kill "nodes" without machines.
+Each added node is a full Node (raylet-equivalent) with its own shared-memory
+store and worker subprocesses.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .core import runtime as runtime_mod
+from .core.config import Config
+from .core.node import Node
+from .core.runtime import DriverRuntime
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True,
+                 head_resources: Optional[Dict[str, float]] = None,
+                 system_config: Optional[dict] = None):
+        if runtime_mod.maybe_runtime() is not None:
+            raise RuntimeError("ray_tpu already initialized")
+        res = head_resources or {"CPU": 2.0}
+        self.runtime = DriverRuntime(resources=res, num_nodes=1 if initialize_head else 0,
+                                     config=Config(system_config))
+        runtime_mod.set_runtime(self.runtime)
+        self.head_node = (next(iter(self.runtime.nodes.values()))
+                          if initialize_head else None)
+
+    def add_node(self, num_cpus: float = 2.0, num_tpus: float = 0.0,
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None) -> Node:
+        res = dict(resources or {})
+        res.setdefault("CPU", num_cpus)
+        if num_tpus:
+            res["TPU"] = num_tpus
+        return self.runtime.add_node(res, labels)
+
+    def remove_node(self, node: Node, kill: bool = True) -> None:
+        """kill=True simulates abrupt node failure (workers SIGKILLed, object
+        store segments destroyed) — the chaos-test path."""
+        self.runtime.remove_node(node.node_id, kill=kill)
+
+    def shutdown(self) -> None:
+        self.runtime.shutdown()
+        runtime_mod.set_runtime(None)

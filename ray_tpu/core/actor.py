@@ -1,0 +1,197 @@
+"""Actor classes, handles, and method invocation.
+
+Equivalent of the reference's actor machinery
+(ref: python/ray/actor.py — ActorClass/_remote, ActorHandle with method
+wrappers; creation registers with the GCS actor manager
+src/ray/gcs/gcs_server/gcs_actor_manager.cc:246; calls go direct to the
+actor's worker with client-side sequencing,
+src/ray/core_worker/transport/direct_actor_task_submitter.h:67)."""
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict, Optional
+
+from . import runtime as runtime_mod
+from .config import DEFAULT as cfg
+from .ids import ActorId
+from .object_ref import ObjectRef
+from .remote_function import (prepare_args, resolve_resources, resolve_strategy)
+from .task_spec import TaskSpec, TaskType
+
+_VALID_ACTOR_OPTIONS = {
+    "num_cpus", "num_tpus", "resources", "max_restarts", "max_task_retries",
+    "max_concurrency", "name", "namespace", "lifetime", "scheduling_strategy",
+    "memory", "placement_group", "placement_group_bundle_index", "runtime_env",
+    "get_if_exists",
+}
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str,
+                 num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        return self._handle._invoke(self._name, args, kwargs, self._num_returns)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method '{self._name}' cannot be called directly; "
+            "use .remote().")
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorId, max_task_retries: int = 0,
+                 description: str = "Actor"):
+        self._actor_id = actor_id
+        self._max_task_retries = max_task_retries
+        self._description = description
+        self._ready_ref: Optional[ObjectRef] = None
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def _invoke(self, method_name: str, args, kwargs, num_returns: int):
+        rt = runtime_mod.get_runtime()
+        sargs, skwargs = prepare_args(rt, args, kwargs)
+        spec = TaskSpec(
+            task_id=rt.new_task_id(),
+            job_id=getattr(rt, "job_id", None) or _nil_job(),
+            task_type=TaskType.ACTOR_TASK,
+            func_id="",
+            description=f"{self._description}.{method_name}",
+            args=sargs,
+            kwargs=skwargs,
+            num_returns=num_returns,
+            resources={},
+            max_retries=self._max_task_retries,
+            actor_id=self._actor_id,
+            method_name=method_name,
+        )
+        refs = rt.submit_spec(spec)
+        if num_returns == 0:
+            return None
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._max_task_retries,
+                              self._description))
+
+    def __repr__(self):
+        return f"ActorHandle({self._description}, {self._actor_id.hex()[:12]})"
+
+
+class ActorClass:
+    def __init__(self, cls, options: Optional[Dict[str, Any]] = None):
+        self._cls = cls
+        self._options = dict(options or {})
+        for k in self._options:
+            if k not in _VALID_ACTOR_OPTIONS:
+                raise ValueError(f"Invalid actor option {k!r}")
+        self._func_ids: Dict[str, str] = {}  # runtime worker_id.hex -> func_id
+
+    def options(self, **overrides) -> "ActorClass":
+        merged = dict(self._options)
+        merged.update(overrides)
+        return ActorClass(self._cls, merged)
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        rt = runtime_mod.get_runtime()
+        opts = self._options
+        name = opts.get("name", "")
+        if name and opts.get("get_if_exists"):
+            existing = _try_get_actor(rt, name, opts.get("namespace"))
+            if existing is not None:
+                return existing
+        rt_key = rt.worker_id.hex()
+        func_id = self._func_ids.get(rt_key)
+        if func_id is None:
+            func_id = rt.export_function(self._cls)
+            self._func_ids[rt_key] = func_id
+        sargs, skwargs = prepare_args(rt, args, kwargs)
+        actor_id = ActorId.from_random()
+        is_async = any(
+            inspect.iscoroutinefunction(m)
+            for _, m in inspect.getmembers(self._cls, inspect.isfunction))
+        spec = TaskSpec(
+            task_id=rt.new_task_id(),
+            job_id=getattr(rt, "job_id", None) or _nil_job(),
+            task_type=TaskType.ACTOR_CREATION_TASK,
+            func_id=func_id,
+            description=f"{self._cls.__name__}.__init__",
+            args=sargs,
+            kwargs=skwargs,
+            num_returns=1,
+            resources=resolve_resources(opts, default_cpus=1.0),
+            max_retries=0,
+            scheduling_strategy=resolve_strategy(opts),
+            actor_id=actor_id,
+            max_restarts=int(opts.get("max_restarts", cfg.actor_max_restarts)),
+            max_concurrency=int(opts.get("max_concurrency", 1)),
+            is_async_actor=is_async,
+            runtime_env=opts.get("runtime_env"),
+        )
+        max_task_retries = int(opts.get("max_task_retries", 0))
+        meta = {"class_name": self._cls.__name__,
+                "max_task_retries": max_task_retries}
+        rt.create_actor(spec, name=name,
+                        detached=(opts.get("lifetime") == "detached"), meta=meta)
+        handle = ActorHandle(actor_id, max_task_retries=max_task_retries,
+                             description=self._cls.__name__)
+        handle._ready_ref = ObjectRef(spec.return_ids()[0])
+        return handle
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class '{self._cls.__name__}' cannot be instantiated "
+            "directly; use .remote().")
+
+
+def _try_get_actor(rt, name: str, namespace: Optional[str]) -> Optional[ActorHandle]:
+    try:
+        return get_actor(name, namespace)
+    except ValueError:
+        return None
+
+
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    rt = runtime_mod.get_runtime()
+    ns = namespace or getattr(rt, "namespace", "default")
+    if hasattr(rt, "gcs"):  # driver
+        info = rt.gcs.get_named_actor(name, ns)
+        from .gcs import ActorState
+
+        if info is None or info.state == ActorState.DEAD:
+            raise ValueError(f"Failed to look up actor {name!r} in namespace {ns!r}")
+        import cloudpickle
+
+        meta_blob = rt.gcs.kv_get("actor_meta:" + info.actor_id.hex(),
+                                  namespace="actor")
+        meta = cloudpickle.loads(meta_blob) if meta_blob else {}
+        return ActorHandle(info.actor_id,
+                           max_task_retries=meta.get("max_task_retries", 0),
+                           description=meta.get("class_name", "Actor"))
+    res = rt.get_named_actor_info(name, ns)
+    if res is None:
+        raise ValueError(f"Failed to look up actor {name!r} in namespace {ns!r}")
+    import cloudpickle
+
+    meta = cloudpickle.loads(res["meta"]) if res.get("meta") else {}
+    return ActorHandle(res["actor_id"],
+                       max_task_retries=meta.get("max_task_retries", 0),
+                       description=meta.get("class_name", "Actor"))
+
+
+def _nil_job():
+    from .ids import JobId
+
+    return JobId.nil()

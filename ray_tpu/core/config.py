@@ -1,0 +1,92 @@
+"""Typed, env-overridable flag registry.
+
+Equivalent of the reference's RAY_CONFIG macro registry
+(ref: src/ray/common/ray_config_def.h — 205 typed flags overridable via
+RAY_<name> env vars and a cluster-wide system-config dict). Here: a plain
+dataclass-like registry; override with RTPU_<NAME> env vars or
+``init(system_config={...})``.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+
+_DEFS: Dict[str, Any] = {}
+
+
+def _define(name: str, default: Any) -> None:
+    _DEFS[name] = default
+
+
+# --- object store / serialization ---
+_define("max_direct_call_object_size", 100 * 1024)  # inline threshold (ref: ray_config_def.h:213)
+_define("task_args_inline_bytes_limit", 10 * 1024 * 1024)  # ref: ray_config_def.h:516
+_define("object_store_memory", 2 * 1024**3)
+_define("object_spilling_dir", "/tmp/ray_tpu_spill")
+_define("min_spilling_size", 1 * 1024 * 1024)
+_define("object_transfer_chunk_bytes", 5 * 1024 * 1024)  # ref: ray_config_def.h:348
+# --- scheduling ---
+_define("scheduler_spread_threshold", 0.5)  # hybrid policy (ref: ray_config_def.h:193)
+_define("scheduler_top_k_fraction", 0.2)  # ref: ray_config_def.h:199-204
+_define("worker_lease_timeout_s", 30.0)
+_define("num_workers_soft_limit", 8)
+_define("worker_prestart_count", 0)
+_define("worker_startup_timeout_s", 60.0)
+_define("worker_idle_timeout_s", 300.0)
+# --- fault tolerance ---
+_define("task_max_retries", 3)
+_define("actor_max_restarts", 0)
+_define("health_check_period_s", 1.0)
+_define("health_check_timeout_s", 10.0)
+_define("lineage_max_bytes", 256 * 1024 * 1024)
+# --- gcs ---
+_define("gcs_storage_path", "")  # non-empty => persist KV/tables to this dir (FT restart)
+_define("task_events_max_buffered", 10000)
+# --- misc ---
+_define("log_dir", "/tmp/ray_tpu/logs")
+_define("metrics_export_port", 0)
+
+
+class Config:
+    """Snapshot of config values; env vars RTPU_<NAME> override defaults,
+    then an explicit system_config dict overrides both."""
+
+    def __init__(self, system_config: Dict[str, Any] | None = None):
+        self._values: Dict[str, Any] = {}
+        for name, default in _DEFS.items():
+            val = default
+            env = os.environ.get("RTPU_" + name.upper())
+            if env is not None:
+                val = _parse(env, default)
+            self._values[name] = val
+        if system_config:
+            for k, v in system_config.items():
+                if k not in _DEFS:
+                    raise ValueError(f"Unknown config key: {k}")
+                self._values[k] = v
+
+    def __getattr__(self, name: str):
+        try:
+            return self._values[name]
+        except KeyError:
+            raise AttributeError(name)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+
+def _parse(env: str, default: Any) -> Any:
+    if isinstance(default, bool):
+        return env.lower() in ("1", "true", "yes")
+    if isinstance(default, int):
+        return int(env)
+    if isinstance(default, float):
+        return float(env)
+    if isinstance(default, (dict, list)):
+        return json.loads(env)
+    return env
+
+
+DEFAULT = Config()

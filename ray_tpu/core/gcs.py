@@ -1,0 +1,323 @@
+"""GCS — global control service (control plane).
+
+Equivalent of the reference's GCS server (ref: src/ray/gcs/gcs_server/
+gcs_server.h:79) with its sub-managers: node table + health
+(gcs_node_manager.cc, gcs_health_check_manager.h:39), actor directory +
+lifecycle FSM (gcs_actor_manager.cc:246,271; src/ray/design_docs/
+actor_states.rst), internal KV (gcs_kv_manager.cc), pubsub
+(src/ray/pubsub/publisher.h:307), job table (gcs_job_manager.cc), placement
+groups with 2-phase bundle commit (gcs_placement_group_manager.cc), and task
+events (gcs_task_manager.h:61).
+
+This runs in-process on the head (driver) — the single-controller model a TPU
+pod already assumes — with optional directory-backed persistence standing in
+for the Redis-backed fault-tolerance store (ref: store_client/
+redis_store_client.h). Remote hosts reach it over the RpcChannel control
+plane.
+"""
+from __future__ import annotations
+
+import enum
+import os
+import pickle
+import threading
+import time
+from collections import defaultdict, deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from .ids import ActorId, JobId, NodeId, PlacementGroupId, TaskId, WorkerId
+from .resources import ResourceSet
+from .task_spec import TaskSpec
+
+
+class ActorState(enum.Enum):
+    # ref: src/ray/design_docs/actor_states.rst
+    DEPENDENCIES_UNREADY = 0
+    PENDING_CREATION = 1
+    ALIVE = 2
+    RESTARTING = 3
+    DEAD = 4
+
+
+@dataclass
+class ActorInfo:
+    actor_id: ActorId
+    name: str  # "" if unnamed
+    namespace: str
+    job_id: JobId
+    state: ActorState
+    creation_spec: TaskSpec
+    max_restarts: int
+    num_restarts: int = 0
+    node_id: Optional[NodeId] = None
+    worker_id: Optional[WorkerId] = None
+    death_cause: str = ""
+    detached: bool = False
+
+
+@dataclass
+class NodeInfo:
+    node_id: NodeId
+    total_resources: ResourceSet
+    labels: Dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+    last_heartbeat: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class JobInfo:
+    job_id: JobId
+    driver_pid: int
+    start_time: float = field(default_factory=time.time)
+    end_time: float = 0.0
+
+
+@dataclass
+class PlacementGroupInfo:
+    pg_id: PlacementGroupId
+    bundles: List[ResourceSet]
+    strategy: str
+    state: str = "PENDING"  # PENDING | CREATED | REMOVED | RESCHEDULING
+    bundle_nodes: List[Optional[NodeId]] = field(default_factory=list)
+    name: str = ""
+
+
+class Pubsub:
+    """In-process pub/sub with per-channel subscriber callbacks.
+    (ref: src/ray/pubsub/publisher.h:307 — long-poll mailboxes; here the
+    subscribers are in-process or bridged over RpcChannel notify)."""
+
+    def __init__(self):
+        self._subs: Dict[str, List[Callable[[Any], None]]] = defaultdict(list)
+        self._lock = threading.Lock()
+
+    def subscribe(self, channel: str, cb: Callable[[Any], None]) -> Callable[[], None]:
+        with self._lock:
+            self._subs[channel].append(cb)
+
+        def _unsub():
+            with self._lock:
+                try:
+                    self._subs[channel].remove(cb)
+                except ValueError:
+                    pass
+
+        return _unsub
+
+    def publish(self, channel: str, msg: Any) -> None:
+        with self._lock:
+            subs = list(self._subs.get(channel, ()))
+        for cb in subs:
+            try:
+                cb(msg)
+            except Exception:
+                pass
+
+
+class Gcs:
+    def __init__(self, storage_path: str = ""):
+        self._lock = threading.RLock()
+        self.pubsub = Pubsub()
+        self._nodes: Dict[NodeId, NodeInfo] = {}
+        self._jobs: Dict[JobId, JobInfo] = {}
+        self._actors: Dict[ActorId, ActorInfo] = {}
+        self._named_actors: Dict[tuple, ActorId] = {}  # (namespace, name) -> id
+        self._kv: Dict[str, Dict[str, bytes]] = defaultdict(dict)  # namespace -> k -> v
+        self._pgs: Dict[PlacementGroupId, PlacementGroupInfo] = {}
+        self._task_events: deque = deque(maxlen=10000)
+        self._storage_path = storage_path
+        # set by the Runtime: asks the scheduler to (re)create an actor
+        self.schedule_actor_cb: Optional[Callable[[ActorInfo], None]] = None
+        if storage_path:
+            os.makedirs(storage_path, exist_ok=True)
+            self._load()
+
+    # ---- node table ----------------------------------------------------------
+
+    def register_node(self, info: NodeInfo) -> None:
+        with self._lock:
+            self._nodes[info.node_id] = info
+        self.pubsub.publish("node", ("ALIVE", info.node_id))
+
+    def mark_node_dead(self, node_id: NodeId, reason: str = "") -> None:
+        with self._lock:
+            info = self._nodes.get(node_id)
+            if info is None or not info.alive:
+                return
+            info.alive = False
+        self.pubsub.publish("node", ("DEAD", node_id))
+        # fail over actors that lived on this node
+        for actor in self.actors_on_node(node_id):
+            self.on_actor_failure(actor.actor_id,
+                                  f"node {node_id.hex()[:8]} died: {reason}")
+
+    def heartbeat(self, node_id: NodeId) -> None:
+        with self._lock:
+            info = self._nodes.get(node_id)
+            if info:
+                info.last_heartbeat = time.monotonic()
+
+    def nodes(self) -> List[NodeInfo]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    def alive_nodes(self) -> List[NodeInfo]:
+        with self._lock:
+            return [n for n in self._nodes.values() if n.alive]
+
+    # ---- job table -----------------------------------------------------------
+
+    def register_job(self, info: JobInfo) -> None:
+        with self._lock:
+            self._jobs[info.job_id] = info
+
+    def finish_job(self, job_id: JobId) -> None:
+        with self._lock:
+            if job_id in self._jobs:
+                self._jobs[job_id].end_time = time.time()
+
+    # ---- actor directory + FSM ----------------------------------------------
+
+    def register_actor(self, info: ActorInfo) -> None:
+        with self._lock:
+            self._actors[info.actor_id] = info
+            if info.name:
+                key = (info.namespace, info.name)
+                if key in self._named_actors:
+                    existing = self._actors.get(self._named_actors[key])
+                    if existing and existing.state != ActorState.DEAD:
+                        raise ValueError(f"Actor name {info.name!r} already taken")
+                self._named_actors[key] = info.actor_id
+        self.pubsub.publish("actor", (info.actor_id, info.state))
+
+    def set_actor_state(self, actor_id: ActorId, state: ActorState,
+                        node_id: Optional[NodeId] = None,
+                        worker_id: Optional[WorkerId] = None,
+                        death_cause: str = "") -> None:
+        with self._lock:
+            info = self._actors.get(actor_id)
+            if info is None:
+                return
+            info.state = state
+            if node_id is not None:
+                info.node_id = node_id
+            if worker_id is not None:
+                info.worker_id = worker_id
+            if death_cause:
+                info.death_cause = death_cause
+        self.pubsub.publish("actor", (actor_id, state))
+
+    def on_actor_failure(self, actor_id: ActorId, cause: str) -> None:
+        """Actor FSM edge: ALIVE/PENDING -> RESTARTING (if budget) or DEAD.
+        (ref: gcs_actor_manager.cc OnActorWorkerDead / restart logic)"""
+        with self._lock:
+            info = self._actors.get(actor_id)
+            if info is None or info.state == ActorState.DEAD:
+                return
+            if info.max_restarts != 0 and (
+                info.max_restarts < 0 or info.num_restarts < info.max_restarts
+            ):
+                info.num_restarts += 1
+                info.state = ActorState.RESTARTING
+                info.death_cause = cause
+                restart = True
+            else:
+                info.state = ActorState.DEAD
+                info.death_cause = cause
+                restart = False
+        self.pubsub.publish("actor", (actor_id, info.state))
+        if restart and self.schedule_actor_cb is not None:
+            self.schedule_actor_cb(info)
+
+    def get_actor(self, actor_id: ActorId) -> Optional[ActorInfo]:
+        with self._lock:
+            return self._actors.get(actor_id)
+
+    def get_named_actor(self, name: str, namespace: str) -> Optional[ActorInfo]:
+        with self._lock:
+            aid = self._named_actors.get((namespace, name))
+            return self._actors.get(aid) if aid else None
+
+    def actors_on_node(self, node_id: NodeId) -> List[ActorInfo]:
+        with self._lock:
+            return [a for a in self._actors.values()
+                    if a.node_id == node_id
+                    and a.state in (ActorState.ALIVE, ActorState.PENDING_CREATION,
+                                    ActorState.RESTARTING)]
+
+    def list_actors(self) -> List[ActorInfo]:
+        with self._lock:
+            return list(self._actors.values())
+
+    # ---- internal KV (function table, cluster metadata) ----------------------
+
+    def kv_put(self, key: str, value: bytes, namespace: str = "default",
+               overwrite: bool = True) -> bool:
+        with self._lock:
+            ns = self._kv[namespace]
+            if not overwrite and key in ns:
+                return False
+            ns[key] = value
+        if self._storage_path:
+            self._persist_kv(namespace, key, value)
+        return True
+
+    def kv_get(self, key: str, namespace: str = "default") -> Optional[bytes]:
+        with self._lock:
+            return self._kv[namespace].get(key)
+
+    def kv_del(self, key: str, namespace: str = "default") -> None:
+        with self._lock:
+            self._kv[namespace].pop(key, None)
+
+    def kv_keys(self, prefix: str = "", namespace: str = "default") -> List[str]:
+        with self._lock:
+            return [k for k in self._kv[namespace] if k.startswith(prefix)]
+
+    # ---- placement groups ----------------------------------------------------
+
+    def register_pg(self, info: PlacementGroupInfo) -> None:
+        with self._lock:
+            self._pgs[info.pg_id] = info
+
+    def get_pg(self, pg_id: PlacementGroupId) -> Optional[PlacementGroupInfo]:
+        with self._lock:
+            return self._pgs.get(pg_id)
+
+    def list_pgs(self) -> List[PlacementGroupInfo]:
+        with self._lock:
+            return list(self._pgs.values())
+
+    # ---- task events (timeline / state API backing store) --------------------
+
+    def add_task_event(self, event: dict) -> None:
+        with self._lock:
+            self._task_events.append(event)
+
+    def task_events(self) -> List[dict]:
+        with self._lock:
+            return list(self._task_events)
+
+    # ---- persistence (GCS fault-tolerance stand-in) --------------------------
+
+    def _persist_kv(self, namespace: str, key: str, value: bytes) -> None:
+        try:
+            fname = os.path.join(self._storage_path, "kv.pkl")
+            with self._lock:
+                snapshot = {ns: dict(kv) for ns, kv in self._kv.items()}
+            with open(fname + ".tmp", "wb") as f:
+                pickle.dump(snapshot, f)
+            os.replace(fname + ".tmp", fname)
+        except Exception:
+            pass
+
+    def _load(self) -> None:
+        fname = os.path.join(self._storage_path, "kv.pkl")
+        if os.path.exists(fname):
+            try:
+                with open(fname, "rb") as f:
+                    data = pickle.load(f)
+                self._kv = defaultdict(dict, data)
+            except Exception:
+                pass

@@ -1,0 +1,429 @@
+"""Node manager — the per-node data/scheduling plane (raylet equivalent).
+
+Equivalent of the reference's raylet (ref: src/ray/raylet/node_manager.h:119;
+worker_pool.h:156 pop-or-start leasing; local_task_manager.cc:57 dispatch;
+placement_group_resource_manager.cc for the 2PC bundle ledger). One Node owns:
+a shared-memory PlasmaStore, a pool of worker subprocesses reached over a
+Unix-socket RpcChannel each, a FIFO lease queue with resource accounting, and
+the placement-group bundle reservations.
+
+Multiple Node objects can live in one driver process — the in-process
+multi-node cluster used by tests, mirroring the reference's
+``ray.cluster_utils.Cluster`` (python/ray/cluster_utils.py:99). A remote host
+would run the same Node served over TCP; the channel protocol is
+transport-agnostic.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..exceptions import WorkerCrashedError
+from .config import Config
+from .gcs import NodeInfo
+from .ids import ActorId, NodeId, PlacementGroupId, TaskId, WorkerId
+from .object_store import PlasmaStore
+from .resources import ResourceSet, normalize, res_add, res_ge, res_sub
+from .rpc import RpcChannel, RpcServer
+from .task_spec import TaskSpec, TaskType
+
+_AUTHKEY = b"ray_tpu"
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: WorkerId
+    proc: subprocess.Popen
+    channel: Optional[RpcChannel] = None
+    state: str = "starting"  # starting | idle | leased | actor | dead
+    pid: int = 0
+    actor_id: Optional[ActorId] = None
+    in_flight: Dict[TaskId, TaskSpec] = field(default_factory=dict)
+    lease_resources: ResourceSet = field(default_factory=dict)
+    lease_pg: Optional[tuple] = None  # (pg_id, bundle_index)
+
+
+@dataclass
+class _LeaseRequest:
+    spec: TaskSpec
+    demand: ResourceSet
+    future: Future  # resolves to WorkerHandle
+    pg: Optional[tuple] = None  # (pg_id, bundle_index)
+
+
+@dataclass
+class _Bundle:
+    reserved: ResourceSet
+    used: ResourceSet = field(default_factory=dict)
+    committed: bool = False
+
+
+class Node:
+    def __init__(self, runtime, node_id: NodeId, resources: ResourceSet,
+                 session_dir: str, config: Config,
+                 labels: Optional[Dict[str, str]] = None):
+        self.runtime = runtime
+        self.node_id = node_id
+        self.config = config
+        self.total_resources = normalize(resources)
+        self.available = dict(self.total_resources)
+        self.labels = labels or {}
+        self.session_dir = session_dir
+        self.store = PlasmaStore(
+            node_id,
+            capacity_bytes=int(resources.get("object_store_memory",
+                                             config.object_store_memory)),
+            spill_dir=os.path.join(config.object_spilling_dir, node_id.hex()[:8]),
+            min_spilling_size=int(config.min_spilling_size),
+        )
+        self.total_resources.pop("object_store_memory", None)
+        self.available.pop("object_store_memory", None)
+        self._lock = threading.RLock()
+        self._workers: Dict[WorkerId, WorkerHandle] = {}
+        self._idle: deque = deque()
+        self._lease_queue: deque = deque()
+        self._bundles: Dict[tuple, _Bundle] = {}  # (pg_id, idx) -> bundle
+        self._starting_count = 0
+        self.alive = True
+        self._sock_path = os.path.join(session_dir, f"node_{node_id.hex()[:12]}.sock")
+        self._server = RpcServer(self._sock_path, self._make_handler,
+                                 family="AF_UNIX", authkey=_AUTHKEY)
+        self._max_workers = max(int(config.num_workers_soft_limit),
+                                int(self.total_resources.get("CPU", 1)))
+        for _ in range(int(config.worker_prestart_count)):
+            self._start_worker()
+
+    def info(self) -> NodeInfo:
+        return NodeInfo(node_id=self.node_id, total_resources=dict(self.total_resources),
+                        labels=dict(self.labels), alive=self.alive)
+
+    # ---- leasing (ref: worker_pool.h PopWorker + local_task_manager.cc) ------
+
+    def request_lease(self, spec: TaskSpec) -> Future:
+        fut: Future = Future()
+        demand = normalize(spec.resources)
+        pg = None
+        strat = spec.scheduling_strategy
+        if strat.kind == "PLACEMENT_GROUP" and strat.placement_group_id is not None:
+            pg = self._pick_bundle(strat.placement_group_id, strat.bundle_index, demand)
+            if pg is None:
+                fut.set_exception(WorkerCrashedError(
+                    f"No bundle with capacity for {demand} in pg "
+                    f"{strat.placement_group_id.hex()[:8]} on this node"))
+                return fut
+        req = _LeaseRequest(spec=spec, demand=demand, future=fut, pg=pg)
+        with self._lock:
+            self._lease_queue.append(req)
+        self._dispatch()
+        return fut
+
+    def _pick_bundle(self, pg_id: PlacementGroupId, index: int,
+                     demand: ResourceSet) -> Optional[tuple]:
+        with self._lock:
+            if index >= 0:
+                key = (pg_id, index)
+                b = self._bundles.get(key)
+                if b is not None and b.committed:
+                    return key
+                return None
+            for key, b in sorted(self._bundles.items(), key=lambda kv: kv[0][1]):
+                if key[0] == pg_id and b.committed and res_ge(
+                        res_sub(b.reserved, b.used), demand):
+                    return key
+        return None
+
+    def _dispatch(self) -> None:
+        """Grant queued leases that fit; start workers on demand."""
+        grants = []
+        with self._lock:
+            if not self.alive:
+                return
+            remaining = deque()
+            while self._lease_queue:
+                req = self._lease_queue.popleft()
+                if req.future.cancelled():
+                    continue
+                if not self._fits(req):
+                    remaining.append(req)
+                    continue
+                worker = self._pop_idle()
+                if worker is None:
+                    remaining.append(req)
+                    if (len(self._workers) + self._starting_count) < self._max_workers \
+                            or not self._workers:
+                        self._start_worker()
+                    continue
+                self._take_resources(req)
+                worker.state = "leased"
+                worker.lease_resources = req.demand
+                worker.lease_pg = req.pg
+                grants.append((req, worker))
+            self._lease_queue = remaining
+        for req, worker in grants:
+            req.future.set_result(worker)
+
+    def _fits(self, req: _LeaseRequest) -> bool:
+        if req.pg is not None:
+            b = self._bundles.get(req.pg)
+            return b is not None and res_ge(res_sub(b.reserved, b.used), req.demand)
+        return res_ge(self.available, req.demand)
+
+    def _take_resources(self, req: _LeaseRequest) -> None:
+        if req.pg is not None:
+            b = self._bundles[req.pg]
+            b.used = res_add(b.used, req.demand)
+        else:
+            self.available = res_sub(self.available, req.demand)
+
+    def release_lease(self, worker: WorkerHandle, terminate: bool = False) -> None:
+        with self._lock:
+            if worker.lease_pg is not None:
+                b = self._bundles.get(worker.lease_pg)
+                if b is not None:
+                    b.used = res_sub(b.used, worker.lease_resources)
+            else:
+                self.available = res_add(self.available, worker.lease_resources)
+            worker.lease_resources = {}
+            worker.lease_pg = None
+            if worker.state in ("leased", "actor") and not terminate:
+                worker.state = "idle"
+                self._idle.append(worker)
+            elif terminate:
+                self._terminate_worker(worker)
+        self._dispatch()
+
+    def _pop_idle(self) -> Optional[WorkerHandle]:
+        while self._idle:
+            w = self._idle.popleft()
+            if w.state == "idle" and w.channel is not None and not w.channel.closed:
+                return w
+        return None
+
+    # ---- worker lifecycle ----------------------------------------------------
+
+    def _start_worker(self) -> WorkerHandle:
+        worker_id = WorkerId.from_random()
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(p for p in sys.path if p)
+        # -S skips site processing (a sitecustomize importing jax costs ~2s
+        # per worker start); the parent's sys.path travels via PYTHONPATH.
+        cmd = [
+            sys.executable, "-S", "-m", "ray_tpu.core.worker_main",
+            "--address", self._sock_path,
+            "--authkey", _AUTHKEY.hex(),
+            "--worker-id", worker_id.hex(),
+            "--node-id", self.node_id.hex(),
+        ]
+        proc = subprocess.Popen(cmd, env=env)
+        handle = WorkerHandle(worker_id=worker_id, proc=proc, pid=proc.pid)
+        self._workers[worker_id] = handle
+        self._starting_count += 1
+        # watchdog: a worker that dies before registering must not strand the
+        # lease queue (ref: worker_pool.cc PopWorker failure callbacks)
+        threading.Thread(target=self._reap_worker, args=(handle,), daemon=True,
+                         name="worker-reaper").start()
+        return handle
+
+    def _reap_worker(self, handle: WorkerHandle) -> None:
+        try:
+            handle.proc.wait()
+        except Exception:
+            return
+        with self._lock:
+            if handle.state == "starting":
+                self._starting_count = max(0, self._starting_count - 1)
+        self._on_worker_exit(handle)
+
+    def _on_register(self, channel: RpcChannel, payload: dict) -> None:
+        worker_id: WorkerId = payload["worker_id"]
+        with self._lock:
+            handle = self._workers.get(worker_id)
+            if handle is None:
+                handle = WorkerHandle(worker_id=worker_id, proc=None,  # type: ignore
+                                      pid=payload.get("pid", 0))
+                self._workers[worker_id] = handle
+            handle.channel = channel
+            handle.pid = payload.get("pid", handle.pid)
+            handle.state = "idle"
+            self._starting_count = max(0, self._starting_count - 1)
+            self._idle.append(handle)
+        channel.on_close(lambda: self._on_worker_exit(handle))
+        self._dispatch()
+
+    def _on_worker_exit(self, worker: WorkerHandle) -> None:
+        with self._lock:
+            if worker.state == "dead":
+                return
+            worker.state = "dead"
+            self._workers.pop(worker.worker_id, None)
+            if worker.lease_resources:
+                if worker.lease_pg is not None:
+                    b = self._bundles.get(worker.lease_pg)
+                    if b is not None:
+                        b.used = res_sub(b.used, worker.lease_resources)
+                else:
+                    self.available = res_add(self.available, worker.lease_resources)
+            in_flight = list(worker.in_flight.values())
+            actor_id = worker.actor_id
+        for spec in in_flight:
+            self.runtime.on_worker_crashed(spec, self.node_id)
+        if actor_id is not None and self.alive:
+            self.runtime.gcs.on_actor_failure(
+                actor_id, f"worker {worker.worker_id.hex()[:8]} died")
+        self._dispatch()
+
+    def _terminate_worker(self, worker: WorkerHandle) -> None:
+        worker.state = "dead"
+        self._workers.pop(worker.worker_id, None)
+        if worker.channel is not None:
+            worker.channel.notify("shutdown")
+            worker.channel.close()
+        if worker.proc is not None:
+            try:
+                worker.proc.terminate()
+            except Exception:
+                pass
+
+    # ---- task push (direct transport) ----------------------------------------
+
+    def push_task(self, worker: WorkerHandle, spec: TaskSpec) -> None:
+        """Push a task to a leased worker (ref: direct_task_transport.h:211
+        PushNormalTask — the raylet is off the data path)."""
+        with self._lock:
+            worker.in_flight[spec.task_id] = spec
+            if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                worker.state = "actor"
+                worker.actor_id = spec.actor_id
+        if worker.channel is None or worker.channel.closed:
+            self._on_worker_exit(worker)
+            return
+        worker.channel.notify("push_task", spec)
+
+    def on_task_done(self, worker: WorkerHandle, payload: dict) -> None:
+        task_id: TaskId = payload["task_id"]
+        with self._lock:
+            spec = worker.in_flight.pop(task_id, None)
+        if spec is None:
+            return
+        self.runtime.on_task_done(spec, payload, self.node_id, worker)
+        if spec.task_type == TaskType.NORMAL_TASK:
+            self.release_lease(worker)
+
+    # ---- placement group bundles: 2PC ----------------------------------------
+    # (ref: node_manager.proto:380-384 PrepareBundleResources/CommitBundleResources)
+
+    def prepare_bundle(self, pg_id: PlacementGroupId, index: int,
+                       resources: ResourceSet) -> bool:
+        with self._lock:
+            demand = normalize(resources)
+            if not res_ge(self.available, demand):
+                return False
+            self.available = res_sub(self.available, demand)
+            self._bundles[(pg_id, index)] = _Bundle(reserved=demand)
+            return True
+
+    def commit_bundle(self, pg_id: PlacementGroupId, index: int) -> None:
+        with self._lock:
+            b = self._bundles.get((pg_id, index))
+            if b is not None:
+                b.committed = True
+        self._dispatch()
+
+    def return_bundle(self, pg_id: PlacementGroupId, index: int) -> None:
+        with self._lock:
+            b = self._bundles.pop((pg_id, index), None)
+            if b is not None:
+                self.available = res_add(self.available, b.reserved)
+        self._dispatch()
+
+    # ---- worker RPC handler --------------------------------------------------
+
+    def _make_handler(self, channel: RpcChannel):
+        state = {"worker": None}
+
+        def handler(method: str, payload):
+            if method == "register":
+                self._on_register(channel, payload)
+                with self._lock:
+                    state["worker"] = self._workers.get(payload["worker_id"])
+                return True
+            worker: Optional[WorkerHandle] = state["worker"]
+            if method == "task_done":
+                if worker is not None:
+                    self.on_task_done(worker, payload)
+                return None
+            if method == "create_object":
+                return self.store.create(payload["object_id"], payload["size"])
+            if method == "seal_object":
+                self.store.seal(payload["object_id"])
+                self.store.pin(payload["object_id"])
+                self.runtime.on_object_sealed(payload["object_id"], self.node_id)
+                return True
+            # everything else is the shared core-worker API, served by the runtime
+            return self.runtime.handle_worker_call(self, worker, method, payload)
+
+        return handler
+
+    # ---- queries & lifecycle -------------------------------------------------
+
+    def get_worker(self, worker_id: WorkerId) -> Optional[WorkerHandle]:
+        with self._lock:
+            return self._workers.get(worker_id)
+
+    def num_workers(self) -> int:
+        with self._lock:
+            return len(self._workers)
+
+    def queue_len(self) -> int:
+        with self._lock:
+            return len(self._lease_queue)
+
+    def kill_worker(self, worker: WorkerHandle, force: bool = True) -> None:
+        try:
+            if force and worker.proc is not None:
+                worker.proc.kill()
+            else:
+                self._terminate_worker(worker)
+        except Exception:
+            pass
+
+    def shutdown(self, kill: bool = False) -> None:
+        """Graceful stop, or simulated node failure when kill=True."""
+        with self._lock:
+            if not self.alive:
+                return
+            self.alive = False
+            workers = list(self._workers.values())
+            queued = list(self._lease_queue)
+            self._lease_queue.clear()
+        for req in queued:
+            if not req.future.done():
+                req.future.set_exception(
+                    WorkerCrashedError(f"node {self.node_id.hex()[:8]} shut down"))
+        for w in workers:
+            try:
+                if kill:
+                    if w.proc is not None:
+                        w.proc.kill()
+                else:
+                    self._terminate_worker(w)
+            except Exception:
+                pass
+        if kill:
+            self.store.destroy()
+        self._server.close()
+        for w in workers:
+            if w.proc is not None:
+                try:
+                    w.proc.wait(timeout=5)
+                except Exception:
+                    pass
+        if not kill:
+            self.store.destroy()

@@ -1,0 +1,57 @@
+"""ObjectRef: a future-like handle to an object in the cluster.
+
+Equivalent of the reference's ObjectRef (ref: python/ray/_raylet.pyx ObjectRef
+cdef class; ownership semantics per src/ray/core_worker/reference_count.h:61 —
+every ref carries its owner's identity so borrowers can locate the value and
+report their references)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from .ids import ObjectId, TaskId, WorkerId
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner", "_call_site", "__weakref__")
+
+    def __init__(self, object_id: ObjectId, owner: Optional[WorkerId] = None,
+                 call_site: str = ""):
+        self.id = object_id
+        self.owner = owner
+        self._call_site = call_site
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def task_id(self) -> bytes:
+        return self.id.task_prefix()
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __reduce__(self):
+        # Serialization of a ref hands out a *borrowed* reference; the runtime
+        # tracks contained refs at serialize() time (serialization.py).
+        return (ObjectRef, (self.id, self.owner, self._call_site))
+
+    # Allow `await ref` inside async actors.
+    def __await__(self):
+        from . import runtime
+
+        result = yield from runtime.get_runtime().get_async(self).__await__()
+        return result
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value."""
+        from . import runtime
+
+        return runtime.get_runtime().as_future(self)

@@ -1,0 +1,297 @@
+"""Per-node shared-memory object store + per-process memory store.
+
+Equivalent of the reference's plasma store + core-worker memory store
+(ref: src/ray/object_manager/plasma/store.h:55 ObjectLifecycleManager,
+eviction_policy.h LRUCache, create_request_queue.h backpressure;
+src/ray/core_worker/store_provider/memory_store/ for small objects).
+
+TPU-host design: one store per node; each sealed object lives in its own
+POSIX shared-memory segment (mmap) so any process on the host maps it
+zero-copy. Creation follows the plasma protocol shape: clients ask the store
+to create (reserving capacity, may trigger LRU eviction or disk spill), write
+into the mapped buffer, then seal. Primary copies are pinned (not evictable)
+until the owner releases them; unpinned copies are LRU-evicted or spilled to
+disk under memory pressure (ref: src/ray/raylet/local_object_manager.h:41).
+
+A faster C++ arena-allocator store (ray_tpu/native/) plugs in behind the same
+interface when built; this Python implementation is the always-available
+fallback and the semantics reference.
+"""
+from __future__ import annotations
+
+import mmap
+import os
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from multiprocessing import shared_memory
+from typing import Dict, Optional
+
+from ..exceptions import ObjectStoreFullError
+from .ids import NodeId, ObjectId
+from .serialization import SerializedObject
+
+
+# Note on resource tracking: only the driver process creates SharedMemory
+# segments (workers attach via /dev/shm mmap — see SegmentReader), so the
+# stock resource_tracker bookkeeping is already balanced: __init__ registers,
+# unlink() unregisters, and a crashed driver leaves the tracker to clean up.
+
+
+@dataclass
+class _Entry:
+    shm: Optional[shared_memory.SharedMemory]
+    size: int
+    sealed: bool = False
+    pinned: bool = False
+    spilled_path: Optional[str] = None
+    created_at: float = field(default_factory=time.monotonic)
+
+
+class PlasmaStore:
+    """Host shared-memory store for one (possibly simulated) node."""
+
+    def __init__(self, node_id: NodeId, capacity_bytes: int, spill_dir: str = "",
+                 min_spilling_size: int = 1024 * 1024):
+        self._node_id = node_id
+        self._prefix = f"rtpu{node_id.hex()[:10]}"
+        self._capacity = capacity_bytes
+        self._min_spilling_size = min_spilling_size
+        self._used = 0
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[ObjectId, _Entry]" = OrderedDict()
+        self._spill_dir = spill_dir
+        self._destroyed = False
+        if spill_dir:
+            os.makedirs(spill_dir, exist_ok=True)
+        self.num_evictions = 0
+        self.num_spills = 0
+
+    # -- plasma protocol: create -> write -> seal ------------------------------
+
+    def segment_name(self, object_id: ObjectId) -> str:
+        return f"{self._prefix}_{object_id.hex()}"
+
+    def create(self, object_id: ObjectId, size: int) -> str:
+        """Reserve capacity and create the segment; returns the shm name the
+        client should attach to and write into. Raises ObjectStoreFullError if
+        space cannot be made (create-queue backpressure is handled by caller)."""
+        with self._lock:
+            if object_id in self._entries:
+                # idempotent re-create: lineage reconstruction may re-run the
+                # producing task while a stale entry lingers
+                self._release_entry(self._entries.pop(object_id))
+            self._ensure_space(size)
+            name = self.segment_name(object_id)
+            try:
+                shm = shared_memory.SharedMemory(name=name, create=True, size=max(size, 1))
+            except FileExistsError:
+                # stale segment from a previous run; reclaim it
+                stale = shared_memory.SharedMemory(name=name)
+                stale.close()
+                stale.unlink()
+                shm = shared_memory.SharedMemory(name=name, create=True, size=max(size, 1))
+            self._entries[object_id] = _Entry(shm=shm, size=size)
+            self._used += size
+            return name
+
+    def seal(self, object_id: ObjectId) -> None:
+        with self._lock:
+            e = self._entries[object_id]
+            e.sealed = True
+            self._entries.move_to_end(object_id)
+
+    def put_serialized(self, object_id: ObjectId, sobj: SerializedObject,
+                       pin: bool = True) -> None:
+        """Create+write+seal in one step (server-local fast path)."""
+        self.create(object_id, sobj.total_bytes)
+        e = self._entries[object_id]
+        sobj.write_into(memoryview(e.shm.buf))
+        e.pinned = pin
+        self.seal(object_id)
+
+    def put_bytes(self, object_id: ObjectId, data: bytes, pin: bool = True) -> None:
+        self.create(object_id, len(data))
+        e = self._entries[object_id]
+        e.shm.buf[: len(data)] = data
+        e.pinned = pin
+        self.seal(object_id)
+
+    # -- reads -----------------------------------------------------------------
+
+    def contains(self, object_id: ObjectId) -> bool:
+        with self._lock:
+            e = self._entries.get(object_id)
+            return e is not None and e.sealed
+
+    def get_bytes(self, object_id: ObjectId) -> Optional[bytes]:
+        """Copy out the object payload (used for inter-node transfer and
+        restore; local readers should attach to the segment instead)."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None:
+                return None
+            if e.shm is None:
+                return self._read_spilled(e)
+            self._entries.move_to_end(object_id)
+            return bytes(e.shm.buf[: e.size])
+
+    def get_segment(self, object_id: ObjectId) -> Optional[tuple[str, int]]:
+        """Return (shm_name, size) for zero-copy local access; restores a
+        spilled object back into shared memory first if needed."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None or not e.sealed:
+                return None
+            if e.shm is None:  # spilled: restore
+                data = self._read_spilled(e)
+                self._ensure_space(e.size)
+                shm = shared_memory.SharedMemory(
+                    name=self.segment_name(object_id), create=True, size=max(e.size, 1))
+                shm.buf[: e.size] = data
+                e.shm = shm
+                self._used += e.size
+            self._entries.move_to_end(object_id)
+            return self.segment_name(object_id), e.size
+
+    # -- lifetime --------------------------------------------------------------
+
+    def pin(self, object_id: ObjectId) -> None:
+        with self._lock:
+            if object_id in self._entries:
+                self._entries[object_id].pinned = True
+
+    def unpin(self, object_id: ObjectId) -> None:
+        with self._lock:
+            if object_id in self._entries:
+                self._entries[object_id].pinned = False
+
+    def delete(self, object_id: ObjectId) -> None:
+        with self._lock:
+            e = self._entries.pop(object_id, None)
+            if e is None:
+                return
+            self._release_entry(e)
+
+    def _release_entry(self, e: _Entry) -> None:
+        if e.shm is not None:
+            self._used -= e.size
+            try:
+                e.shm.close()
+                e.shm.unlink()
+            except FileNotFoundError:
+                pass
+        if e.spilled_path:
+            try:
+                os.unlink(e.spilled_path)
+            except FileNotFoundError:
+                pass
+
+    def _ensure_space(self, size: int) -> None:
+        if size > self._capacity:
+            raise ObjectStoreFullError(
+                f"Object of {size} bytes exceeds store capacity {self._capacity}")
+        while self._used + size > self._capacity:
+            victim = None
+            for oid, e in self._entries.items():  # LRU order
+                if e.sealed and not e.pinned and e.shm is not None:
+                    victim = (oid, e)
+                    break
+            if victim is None:
+                raise ObjectStoreFullError(
+                    f"Store full ({self._used}/{self._capacity} bytes) and no "
+                    f"evictable objects (all pinned)")
+            oid, e = victim
+            # large objects are worth a disk write (restorable later); small
+            # ones are simply evicted — their owner can reconstruct
+            # (ref: min_spilling_size, local_object_manager.h:110)
+            if self._spill_dir and e.size >= self._min_spilling_size:
+                self._spill(oid, e)
+            else:
+                self._evict(oid, e)
+
+    def _spill(self, oid: ObjectId, e: _Entry) -> None:
+        path = os.path.join(self._spill_dir, f"{self._prefix}_{oid.hex()}")
+        with open(path, "wb") as f:
+            f.write(e.shm.buf[: e.size])
+        e.spilled_path = path
+        e.shm.close()
+        e.shm.unlink()
+        e.shm = None
+        self._used -= e.size
+        self.num_spills += 1
+
+    def _evict(self, oid: ObjectId, e: _Entry) -> None:
+        self._entries.pop(oid)
+        self._release_entry(e)
+        self.num_evictions += 1
+
+    def _read_spilled(self, e: _Entry) -> Optional[bytes]:
+        if not e.spilled_path:
+            return None
+        with open(e.spilled_path, "rb") as f:
+            return f.read()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "capacity": self._capacity,
+                "used": self._used,
+                "num_objects": len(self._entries),
+                "num_evictions": self.num_evictions,
+                "num_spills": self.num_spills,
+            }
+
+    def destroy(self) -> None:
+        """Unlink every segment — simulates node loss for chaos tests."""
+        with self._lock:
+            if self._destroyed:
+                return
+            self._destroyed = True
+            for e in self._entries.values():
+                self._release_entry(e)
+            self._entries.clear()
+            self._used = 0
+
+
+class SegmentReader:
+    """Client-side zero-copy attach to sealed segments; caches attachments.
+
+    Attaches via direct /dev/shm mmap rather than
+    multiprocessing.shared_memory, so Python's global resource_tracker (one
+    per cluster, inherited from the driver) never sees attach-side
+    register/unregister pairs — those collide across processes on 3.12.
+    The memoryview handed out references the mmap; the attachment stays open
+    until release() (equivalent of the plasma client's object release)."""
+
+    def __init__(self):
+        self._attached: Dict[str, mmap.mmap] = {}
+        self._lock = threading.Lock()
+
+    def read(self, shm_name: str, size: int) -> memoryview:
+        with self._lock:
+            mm = self._attached.get(shm_name)
+            if mm is None:
+                with open("/dev/shm/" + shm_name, "r+b") as f:
+                    mm = mmap.mmap(f.fileno(), 0)
+                self._attached[shm_name] = mm
+            return memoryview(mm)[:size]
+
+    def release(self, shm_name: str) -> None:
+        with self._lock:
+            mm = self._attached.pop(shm_name, None)
+            if mm is not None:
+                try:
+                    mm.close()
+                except Exception:
+                    pass
+
+    def close(self) -> None:
+        with self._lock:
+            for mm in self._attached.values():
+                try:
+                    mm.close()
+                except Exception:
+                    pass
+            self._attached.clear()

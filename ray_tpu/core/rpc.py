@@ -1,0 +1,225 @@
+"""Bidirectional RPC channel over ``multiprocessing.connection``.
+
+Equivalent of the reference's rpc layer (ref: src/ray/rpc/grpc_server.h,
+client_call.h — callback-based client calls multiplexed on a shared channel).
+Here: one duplex byte pipe (Unix socket or TCP) per peer pair; a reader thread
+demultiplexes responses (resolving futures) and dispatches incoming requests
+to a handler pool, so nested calls never deadlock. The same protocol runs over
+AF_UNIX within a host and AF_INET across hosts (DCN control plane).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import traceback
+from concurrent.futures import Future, ThreadPoolExecutor
+from multiprocessing.connection import Client, Connection, Listener
+from typing import Any, Callable, Dict, Optional
+
+_REQ, _RESP, _ERR, _ONEWAY = 0, 1, 2, 3
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class RpcChannel:
+    """A duplex message channel with request/response correlation.
+
+    handler(method: str, payload: Any) -> Any  serves incoming requests.
+    """
+
+    def __init__(self, conn: Connection,
+                 handler: Optional[Callable[[str, Any], Any]] = None,
+                 num_handler_threads: int = 4,
+                 name: str = ""):
+        self._conn = conn
+        self._handler = handler
+        self._name = name
+        self._seq = itertools.count()
+        self._pending: Dict[int, Future] = {}
+        self._lock = threading.Lock()
+        self._closed = threading.Event()
+        self._on_close_cbs = []
+        self._pool = ThreadPoolExecutor(max_workers=num_handler_threads,
+                                        thread_name_prefix=f"rpc-{name}")
+        self._reader = threading.Thread(target=self._read_loop, daemon=True,
+                                        name=f"rpc-reader-{name}")
+        self._reader.start()
+
+    # -- client side -----------------------------------------------------------
+
+    def call(self, method: str, payload: Any = None, timeout: Optional[float] = None) -> Any:
+        return self.call_async(method, payload).result(timeout)
+
+    def call_async(self, method: str, payload: Any = None) -> Future:
+        fut: Future = Future()
+        msg_id = next(self._seq)
+        with self._lock:
+            if self._closed.is_set():
+                fut.set_exception(ChannelClosed(f"channel {self._name} closed"))
+                return fut
+            self._pending[msg_id] = fut
+        try:
+            self._send((_REQ, msg_id, method, payload))
+        except Exception as e:
+            with self._lock:
+                self._pending.pop(msg_id, None)
+            fut.set_exception(ChannelClosed(str(e)))
+        return fut
+
+    def notify(self, method: str, payload: Any = None) -> None:
+        """Fire-and-forget."""
+        try:
+            self._send((_ONEWAY, 0, method, payload))
+        except Exception:
+            pass
+
+    def _send(self, msg) -> None:
+        with self._lock:
+            self._conn.send(msg)
+
+    # -- server side -----------------------------------------------------------
+
+    def set_handler(self, handler: Callable[[str, Any], Any]) -> None:
+        self._handler = handler
+
+    def _read_loop(self) -> None:
+        try:
+            while not self._closed.is_set():
+                try:
+                    msg = self._conn.recv()
+                except (EOFError, OSError, BrokenPipeError):
+                    break
+                except TypeError:
+                    break  # connection torn down mid-recv at interpreter exit
+                kind, msg_id, a, b = msg
+                if kind == _RESP:
+                    with self._lock:
+                        fut = self._pending.pop(msg_id, None)
+                    if fut is not None:
+                        fut.set_result(b)
+                elif kind == _ERR:
+                    with self._lock:
+                        fut = self._pending.pop(msg_id, None)
+                    if fut is not None:
+                        fut.set_exception(_RemoteCallError(a, b))
+                elif kind == _REQ:
+                    self._pool.submit(self._handle, msg_id, a, b)
+                elif kind == _ONEWAY:
+                    self._pool.submit(self._handle_oneway, a, b)
+        finally:
+            self._teardown()
+
+    def _handle(self, msg_id: int, method: str, payload: Any) -> None:
+        try:
+            result = self._handler(method, payload)
+            self._send((_RESP, msg_id, None, result))
+        except Exception as e:
+            try:
+                self._send((_ERR, msg_id, f"{type(e).__name__}: {e}", traceback.format_exc()))
+            except Exception:
+                pass
+
+    def _handle_oneway(self, method: str, payload: Any) -> None:
+        try:
+            self._handler(method, payload)
+        except Exception:
+            traceback.print_exc()
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def on_close(self, cb: Callable[[], None]) -> None:
+        self._on_close_cbs.append(cb)
+
+    def _teardown(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        with self._lock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for fut in pending:
+            if not fut.done():
+                fut.set_exception(ChannelClosed(f"channel {self._name} closed"))
+        for cb in self._on_close_cbs:
+            try:
+                cb()
+            except Exception:
+                traceback.print_exc()
+        self._pool.shutdown(wait=False)
+
+    def close(self) -> None:
+        try:
+            self._conn.close()
+        except Exception:
+            pass
+        self._teardown()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+
+class _RemoteCallError(Exception):
+    def __init__(self, summary: str, remote_tb: str):
+        super().__init__(f"{summary}\n--- remote traceback ---\n{remote_tb}")
+        self.summary = summary
+        self.remote_tb = remote_tb
+
+
+class RpcServer:
+    """Accepts channel connections on a Unix or TCP socket."""
+
+    def __init__(self, address, handler_factory: Callable[[RpcChannel], Callable],
+                 family: Optional[str] = None, authkey: bytes = b"ray_tpu"):
+        self._listener = Listener(address, family=family, authkey=authkey)
+        self._handler_factory = handler_factory
+        self._channels = []
+        self._stopped = threading.Event()
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True,
+                                               name="rpc-accept")
+        self._accept_thread.start()
+
+    @property
+    def address(self):
+        return self._listener.address
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn = self._listener.accept()
+            except Exception:
+                # A peer dying mid-handshake raises here; keep accepting —
+                # only a closed listener ends the loop.
+                if self._stopped.is_set():
+                    break
+                try:
+                    # closed listener raises immediately again; back off a hair
+                    import time as _t
+
+                    _t.sleep(0.01)
+                    if self._listener._listener is None:  # type: ignore[attr-defined]
+                        break
+                except Exception:
+                    break
+                continue
+            chan = RpcChannel(conn, name="srv", num_handler_threads=16)
+            chan.set_handler(self._handler_factory(chan))
+            self._channels.append(chan)
+
+    def close(self) -> None:
+        self._stopped.set()
+        try:
+            self._listener.close()
+        except Exception:
+            pass
+        for ch in self._channels:
+            ch.close()
+
+
+def connect(address, authkey: bytes = b"ray_tpu",
+            handler: Optional[Callable[[str, Any], Any]] = None,
+            name: str = "") -> RpcChannel:
+    conn = Client(address, authkey=authkey)
+    return RpcChannel(conn, handler=handler, name=name)

@@ -1,0 +1,1067 @@
+"""The per-process runtime: driver (head) and worker variants.
+
+Equivalent of the reference's CoreWorker (ref: src/ray/core_worker/
+core_worker.h:284 — Put :558, Get :665, Wait :704, SubmitTask :828,
+CreateActor :849, SubmitActorTask :895) plus the direct task submitter
+(transport/direct_task_transport.h:75) and the object directory.
+
+Single-controller deviation (TPU-native stance): the head process owns the
+control plane (GCS), the cluster view, and object ownership. Worker processes
+run a thin WorkerRuntime that proxies the same API over their node channel —
+the analog of the Cython binding calling into CoreWorker
+(python/ray/_raylet.pyx:3111 submit_task).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+import weakref
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import cloudpickle
+
+from .. import exceptions as exc
+from . import serialization
+from .config import Config
+from .gcs import ActorInfo, ActorState, Gcs, JobInfo, NodeInfo
+from .ids import ActorId, JobId, NodeId, ObjectId, PlacementGroupId, TaskId, WorkerId
+from .node import Node, WorkerHandle
+from .object_ref import ObjectRef
+from .object_store import SegmentReader
+from .resources import ResourceSet, normalize
+from .scheduling_policy import NodeView, Scheduler
+from .task_manager import ReferenceCounter, TaskManager
+from .task_spec import (ARG_REF, ARG_VALUE, SchedulingStrategy, TaskSpec,
+                        TaskType)
+
+_runtime_lock = threading.Lock()
+_runtime: Optional[object] = None
+
+
+def set_runtime(rt) -> None:
+    global _runtime
+    with _runtime_lock:
+        _runtime = rt
+
+
+def get_runtime():
+    if _runtime is None:
+        raise RuntimeError("ray_tpu is not initialized; call ray_tpu.init() first.")
+    return _runtime
+
+
+def maybe_runtime():
+    return _runtime
+
+
+@dataclass
+class RuntimeContext:
+    job_id: JobId
+    node_id: Optional[NodeId]
+    worker_id: WorkerId
+    task_id: Optional[TaskId] = None
+    actor_id: Optional[ActorId] = None
+    namespace: str = "default"
+
+    def get_job_id(self):
+        return self.job_id.hex()
+
+    def get_node_id(self):
+        return self.node_id.hex() if self.node_id else None
+
+    def get_actor_id(self):
+        return self.actor_id.hex() if self.actor_id else None
+
+
+@dataclass
+class _ActorRecord:
+    info: ActorInfo
+    seq: int = 0
+    worker: Optional[WorkerHandle] = None
+    node_id: Optional[NodeId] = None
+    queued: List[TaskSpec] = field(default_factory=list)
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+
+class DriverRuntime:
+    """Head-process runtime: owns GCS, nodes, objects, and scheduling."""
+
+    def __init__(self, resources: Optional[ResourceSet] = None,
+                 num_nodes: int = 1,
+                 config: Optional[Config] = None,
+                 namespace: str = "default",
+                 session_dir: Optional[str] = None):
+        self.config = config or Config()
+        self.job_id = JobId.from_random()
+        self.worker_id = WorkerId.from_random()
+        self.driver_task_id = TaskId.from_random()
+        self.namespace = namespace
+        self.session_dir = session_dir or os.path.join(
+            "/tmp/ray_tpu", f"session_{int(time.time() * 1000)}_{os.getpid()}")
+        os.makedirs(self.session_dir, exist_ok=True)
+        self.gcs = Gcs(storage_path=self.config.gcs_storage_path)
+        self.gcs.register_job(JobInfo(job_id=self.job_id, driver_pid=os.getpid()))
+        self.gcs.schedule_actor_cb = self._restart_actor
+        self.gcs.pubsub.subscribe("actor", self._on_actor_state)
+        self.gcs.pubsub.subscribe("node", self._on_node_state)
+        self.scheduler = Scheduler(self.config.scheduler_spread_threshold)
+        self.task_manager = TaskManager(self.config.lineage_max_bytes)
+        self.refcount = ReferenceCounter(self._free_object)
+        self.nodes: Dict[NodeId, Node] = {}
+        self._memory_store: Dict[ObjectId, bytes] = {}
+        self._directory: Dict[ObjectId, Set[NodeId]] = {}
+        self._events: Dict[ObjectId, threading.Event] = {}
+        self._recovering: Set[ObjectId] = set()
+        self._reader = SegmentReader()
+        self._actors: Dict[ActorId, _ActorRecord] = {}
+        self._parked: List[TaskSpec] = []
+        self._put_counter = 0
+        self._fn_cache: Dict[int, str] = {}
+        self._lock = threading.RLock()
+        self._pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="rt")
+        self._shutdown = False
+        default_res = resources or {"CPU": float(os.cpu_count() or 1)}
+        for i in range(num_nodes):
+            self.add_node(dict(default_res))
+        self.head_node_id = next(iter(self.nodes), None)
+
+    # ---- cluster membership --------------------------------------------------
+
+    def add_node(self, resources: ResourceSet,
+                 labels: Optional[Dict[str, str]] = None) -> Node:
+        node = Node(self, NodeId.from_random(), resources, self.session_dir,
+                    self.config, labels)
+        with self._lock:
+            self.nodes[node.node_id] = node
+            if getattr(self, "head_node_id", None) is None:
+                self.head_node_id = node.node_id
+        self.gcs.register_node(node.info())
+        self._reschedule_parked()
+        return node
+
+    def remove_node(self, node_id: NodeId, kill: bool = True) -> None:
+        with self._lock:
+            node = self.nodes.get(node_id)
+        if node is None:
+            return
+        node.shutdown(kill=kill)
+        self.gcs.mark_node_dead(node_id, "removed" if not kill else "killed")
+        # objects whose only copies were on this node are now lost
+        with self._lock:
+            for oid, copies in list(self._directory.items()):
+                copies.discard(node_id)
+
+    def _on_node_state(self, msg) -> None:
+        state, node_id = msg
+        if state == "DEAD":
+            self._reschedule_parked()
+
+    def _views(self) -> List[NodeView]:
+        with self._lock:
+            return [
+                NodeView(node_id=n.node_id, total=dict(n.total_resources),
+                         available=dict(n.available), alive=n.alive,
+                         labels=dict(n.labels))
+                for n in self.nodes.values() if n.alive
+            ]
+
+    # ---- function export (ref: python/ray/_private/function_manager.py) -----
+
+    def export_function(self, fn: Any) -> str:
+        # cache holds the referent so a reused id() can't alias a new function
+        key = id(fn)
+        cached = self._fn_cache.get(key)
+        if cached is not None and cached[0] is fn:
+            return cached[1]
+        blob = cloudpickle.dumps(fn)
+        func_id = hashlib.sha1(blob).hexdigest()
+        self.gcs.kv_put("fn:" + func_id, blob, namespace="fn", overwrite=False)
+        self._fn_cache[key] = (fn, func_id)
+        return func_id
+
+    def get_function_blob(self, func_id: str) -> bytes:
+        blob = self.gcs.kv_get("fn:" + func_id, namespace="fn")
+        if blob is None:
+            raise KeyError(f"function {func_id} not found")
+        return blob
+
+    # ---- object API ----------------------------------------------------------
+
+    def _event(self, oid: ObjectId) -> threading.Event:
+        with self._lock:
+            ev = self._events.get(oid)
+            if ev is None:
+                ev = self._events[oid] = threading.Event()
+            return ev
+
+    def _object_available(self, oid: ObjectId) -> bool:
+        with self._lock:
+            if oid in self._memory_store:
+                return True
+            copies = self._directory.get(oid)
+            return bool(copies)
+
+    def make_ref(self, oid: ObjectId, add_ref: bool = True) -> ObjectRef:
+        ref = ObjectRef(oid, owner=self.worker_id)
+        if add_ref:
+            self.refcount.add_local(oid)
+            weakref.finalize(ref, self.refcount.remove_local, oid)
+        return ref
+
+    def next_put_id(self, task_id: Optional[TaskId] = None) -> ObjectId:
+        with self._lock:
+            self._put_counter += 1
+            return ObjectId.for_put(task_id or self.driver_task_id, self._put_counter)
+
+    def put(self, value: Any, _owner=None) -> ObjectRef:
+        oid = self.next_put_id()
+        sobj = serialization.serialize(value)
+        self.store_serialized(oid, sobj)
+        self.refcount.add_owned(oid)
+        return self.make_ref(oid)
+
+    def store_serialized(self, oid: ObjectId, sobj: serialization.SerializedObject,
+                         node_id: Optional[NodeId] = None) -> None:
+        if sobj.total_bytes <= self.config.max_direct_call_object_size:
+            with self._lock:
+                self._memory_store[oid] = sobj.to_bytes()
+        else:
+            node = self.nodes.get(node_id) if node_id else None
+            if node is None:
+                if self.head_node_id is None:
+                    raise RuntimeError(
+                        "Cannot store a large object: cluster has no nodes yet")
+                node = self.nodes[self.head_node_id]
+            node.store.put_serialized(oid, sobj, pin=True)
+            with self._lock:
+                self._directory.setdefault(oid, set()).add(node.node_id)
+        self._event(oid).set()
+
+    def store_inline_bytes(self, oid: ObjectId, data: bytes) -> None:
+        with self._lock:
+            self._memory_store[oid] = data
+        self._event(oid).set()
+
+    def on_object_sealed(self, oid: ObjectId, node_id: NodeId) -> None:
+        with self._lock:
+            self._directory.setdefault(oid, set()).add(node_id)
+        self.refcount.add_owned(oid)
+        self._event(oid).set()
+
+    def _free_object(self, oid: ObjectId) -> None:
+        with self._lock:
+            self._memory_store.pop(oid, None)
+            copies = self._directory.pop(oid, set())
+            self._events.pop(oid, None)
+            nodes = [self.nodes.get(n) for n in copies]
+        for node in nodes:
+            if node is not None:
+                node.store.delete(oid)
+
+    def free(self, refs: Sequence[ObjectRef]) -> None:
+        for r in refs:
+            self._free_object(r.id)
+
+    # fetch: returns ("inline", bytes) or ("shm", name, size)
+    def fetch_one(self, oid: ObjectId, timeout: Optional[float]) -> Tuple:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        attempts = 0
+        while True:
+            ev = self._event(oid)
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            if not ev.wait(remaining):
+                raise exc.GetTimeoutError(
+                    f"Get timed out waiting for object {oid.hex()[:12]}")
+            with self._lock:
+                data = self._memory_store.get(oid)
+                copies = list(self._directory.get(oid, ()))
+            if data is not None:
+                return ("inline", data)
+            transient_failure = False
+            for nid in copies:
+                node = self.nodes.get(nid)
+                if node is not None and node.alive:
+                    try:
+                        seg = node.store.get_segment(oid)
+                    except Exception:
+                        # store momentarily full etc. — the copy still exists
+                        transient_failure = True
+                        continue
+                    if seg is not None:
+                        return ("shm", seg[0], seg[1])
+                # node dead, or store confirms the object is gone
+                with self._lock:
+                    d = self._directory.get(oid)
+                    if d is not None:
+                        d.discard(nid)
+            if transient_failure:
+                time.sleep(0.01)
+                continue
+            # all copies gone -> lineage reconstruction
+            attempts += 1
+            if attempts > 5:
+                raise exc.ObjectLostError(oid.hex())
+            self._recover_object(oid)
+
+    def _recover_object(self, oid: ObjectId) -> None:
+        """Lost-object recovery via lineage re-execution
+        (ref: object_recovery_manager.h:41, task_manager.h:234 ResubmitTask)."""
+        spec = self.task_manager.lineage_for_object(oid)
+        if spec is None:
+            raise exc.ObjectLostError(
+                oid.hex(), f"Object {oid.hex()[:12]} lost and no lineage available "
+                "(put objects and actor-task returns are not reconstructable).")
+        if spec.task_type != TaskType.NORMAL_TASK:
+            raise exc.ObjectLostError(
+                oid.hex(), "Only normal-task outputs can be reconstructed.")
+        with self._lock:
+            ev = self._events.get(oid)
+            if ev is not None:
+                ev.clear()
+            # single reconstruction per task, however many getters noticed
+            if spec.task_id in self._recovering:
+                return
+            if spec.task_id in {s.task_id for s in self._parked}:
+                return
+            already = self.task_manager.get(spec.task_id)
+            if already is not None and already.state in ("PENDING", "RUNNING"):
+                return  # reconstruction already in flight
+            self._recovering.add(spec.task_id)
+        try:
+            self.task_manager.register(spec)
+            self._schedule(spec)
+        finally:
+            with self._lock:
+                self._recovering.discard(spec.task_id)
+
+    def deserialize_fetched(self, result: Tuple) -> Any:
+        kind = result[0]
+        if kind == "inline":
+            value = serialization.loads(result[1])
+        else:
+            _, name, size = result
+            mv = self._reader.read(name, size)
+            value = serialization.loads(mv)
+        if isinstance(value, exc.TaskError):
+            cause = value.cause
+            if isinstance(cause, exc.RayTpuError):
+                raise cause
+            raise value
+        if isinstance(value, exc.RayTpuError):
+            raise value
+        return value
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        out = [self.deserialize_fetched(self.fetch_one(r.id, timeout)) for r in refs]
+        return out[0] if single else out
+
+    def get_many(self, oids: List[ObjectId], timeout: Optional[float] = None):
+        return [self.deserialize_fetched(self.fetch_one(o, timeout)) for o in oids]
+
+    def get_async(self, ref: ObjectRef):
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        return loop.run_in_executor(self._pool, lambda: self.get(ref))
+
+    def as_future(self, ref: ObjectRef) -> Future:
+        return self._pool.submit(self.get, ref)
+
+    def wait(self, refs: List[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None,
+             fetch_local: bool = True) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        if num_returns > len(refs):
+            raise ValueError("num_returns > len(refs)")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pending = list(refs)
+        ready: List[ObjectRef] = []
+        while len(ready) < num_returns:
+            progressed = False
+            for r in list(pending):
+                if self._event(r.id).is_set():
+                    ready.append(r)
+                    pending.remove(r)
+                    progressed = True
+            if len(ready) >= num_returns:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            if not progressed:
+                time.sleep(0.002)
+        return ready, pending
+
+    # ---- task submission -----------------------------------------------------
+
+    def new_task_id(self) -> TaskId:
+        return TaskId.from_random()
+
+    def submit_spec(self, spec: TaskSpec) -> List[ObjectRef]:
+        self.task_manager.register(spec)
+        for ref in spec.arg_refs():
+            self.refcount.pin_for_task(ref.id)
+        for oid in spec.return_ids():
+            self.refcount.add_owned(oid)
+        refs = [self.make_ref(oid) for oid in spec.return_ids()]
+        if spec.task_type == TaskType.ACTOR_TASK:
+            self._submit_actor_spec(spec)
+        else:
+            self._schedule(spec)
+        return refs
+
+    def _schedule(self, spec: TaskSpec) -> None:
+        strat = spec.scheduling_strategy
+        demand = normalize(spec.resources)
+        node: Optional[Node] = None
+        if strat.kind == "PLACEMENT_GROUP" and strat.placement_group_id is not None:
+            pg = self.gcs.get_pg(strat.placement_group_id)
+            if pg is None or pg.state == "REMOVED":
+                self._fail_task(spec, exc.PlacementGroupUnschedulableError(
+                    "placement group removed"))
+                return
+            if pg.state != "CREATED":
+                with self._lock:
+                    self._parked.append(spec)
+                return
+            candidates = (
+                [pg.bundle_nodes[strat.bundle_index]]
+                if strat.bundle_index >= 0 else list(dict.fromkeys(pg.bundle_nodes))
+            )
+            for nid in candidates:
+                n = self.nodes.get(nid)
+                if n is not None and n.alive:
+                    node = n
+                    break
+        else:
+            nid = self.scheduler.pick_node(self._views(), demand, strat,
+                                           local_node_id=self.head_node_id)
+            node = self.nodes.get(nid) if nid is not None else None
+        if node is None:
+            with self._lock:
+                self._parked.append(spec)
+            return
+        self.task_manager.mark_running(spec.task_id)
+        fut = node.request_lease(spec)
+
+        def _granted(f: Future, node=node):
+            try:
+                worker = f.result()
+            except Exception:
+                self.on_worker_crashed(spec, node.node_id)
+                return
+            node.push_task(worker, spec)
+
+        fut.add_done_callback(_granted)
+
+    def _reschedule_parked(self) -> None:
+        with self._lock:
+            parked, self._parked = self._parked, []
+        for spec in parked:
+            self._schedule(spec)
+
+    def _fail_task(self, spec: TaskSpec, error: Exception) -> None:
+        self.task_manager.fail(spec.task_id)
+        blob = serialization.dumps(error)
+        for oid in spec.return_ids():
+            self.store_inline_bytes(oid, blob)
+        for ref in spec.arg_refs():
+            self.refcount.unpin_for_task(ref.id)
+        self.gcs.add_task_event({"task_id": spec.task_id.hex(), "name": spec.description,
+                                 "state": "FAILED", "time": time.time()})
+
+    # called by Node when a worker reports a finished task
+    def on_task_done(self, spec: TaskSpec, payload: dict, node_id: NodeId,
+                     worker: WorkerHandle) -> None:
+        error = payload.get("error")
+        if error is not None:
+            if spec.retry_exceptions:
+                retry = self.task_manager.try_retry(spec.task_id)
+                if retry is not None:
+                    self._schedule(retry)
+                    return
+            self.task_manager.fail(spec.task_id)
+            for oid in spec.return_ids():
+                self.store_inline_bytes(oid, error)
+            if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                self._on_actor_creation_failed(spec, node_id, worker)
+        else:
+            results = payload.get("results") or []
+            for oid, res in zip(spec.return_ids(), results):
+                if res[0] == "inline":
+                    self.store_inline_bytes(oid, res[1])
+                # "stored" results were registered at seal time
+            self.task_manager.complete(spec.task_id)
+            if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                self._on_actor_created(spec, node_id, worker)
+        for ref in spec.arg_refs():
+            self.refcount.unpin_for_task(ref.id)
+        self.gcs.add_task_event({
+            "task_id": spec.task_id.hex(), "name": spec.description,
+            "state": "FAILED" if error is not None else "FINISHED",
+            "node_id": node_id.hex(), "time": time.time(),
+        })
+
+    def on_worker_crashed(self, spec: TaskSpec, node_id: NodeId) -> None:
+        if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+            return  # actor FSM handles restart / death
+        if spec.task_type == TaskType.ACTOR_TASK:
+            rec = self._actors.get(spec.actor_id)
+            info = self.gcs.get_actor(spec.actor_id)
+            if rec is not None and info is not None and spec.max_retries != 0 \
+                    and info.state != ActorState.DEAD:
+                with rec.lock:
+                    rec.queued.insert(0, spec)
+                return
+            err = exc.ActorDiedError(
+                f"Actor {spec.actor_id.hex()[:8]} died while running "
+                f"{spec.description}")
+            self._fail_task(spec, err)
+            return
+        retry = self.task_manager.try_retry(spec.task_id)
+        if retry is not None:
+            self._schedule(retry)
+            return
+        self._fail_task(spec, exc.WorkerCrashedError(
+            f"Worker died while running {spec.description} "
+            f"(node {node_id.hex()[:8]}); retries exhausted"))
+
+    # ---- actors --------------------------------------------------------------
+
+    def create_actor(self, spec: TaskSpec, name: str = "", detached: bool = False,
+                     meta: Optional[dict] = None) -> None:
+        info = ActorInfo(
+            actor_id=spec.actor_id, name=name, namespace=self.namespace,
+            job_id=self.job_id, state=ActorState.PENDING_CREATION,
+            creation_spec=spec, max_restarts=spec.max_restarts, detached=detached)
+        self.gcs.register_actor(info)
+        if meta is not None:
+            self.gcs.kv_put("actor_meta:" + spec.actor_id.hex(),
+                            cloudpickle.dumps(meta), namespace="actor")
+        with self._lock:
+            self._actors[spec.actor_id] = _ActorRecord(info=info)
+        self.submit_spec(spec)
+
+    def _on_actor_created(self, spec: TaskSpec, node_id: NodeId,
+                          worker: WorkerHandle) -> None:
+        rec = self._actors.get(spec.actor_id)
+        info = self.gcs.get_actor(spec.actor_id)
+        if info is not None and info.state == ActorState.DEAD:
+            # killed while the creation task was in flight — don't resurrect
+            node = self.nodes.get(node_id)
+            if node is not None:
+                node.kill_worker(worker, force=True)
+            return
+        if rec is None:
+            return
+        with rec.lock:
+            rec.worker = worker
+            rec.node_id = node_id
+        self.gcs.set_actor_state(spec.actor_id, ActorState.ALIVE,
+                                 node_id=node_id, worker_id=worker.worker_id)
+        self._flush_actor_queue(spec.actor_id)
+
+    def _on_actor_creation_failed(self, spec: TaskSpec, node_id: NodeId,
+                                  worker: WorkerHandle) -> None:
+        self.gcs.set_actor_state(spec.actor_id, ActorState.DEAD,
+                                 death_cause="creation task failed")
+        self._drain_actor_queue_with_error(spec.actor_id,
+                                           "actor creation failed")
+        # the dedicated worker holds a lease; tear it down so resources return
+        node = self.nodes.get(node_id)
+        if node is not None:
+            node.release_lease(worker, terminate=True)
+
+    def _restart_actor(self, info: ActorInfo) -> None:
+        """GCS FSM asked for a restart: resubmit the creation task."""
+        import copy
+
+        spec = copy.copy(info.creation_spec)
+        spec.task_id = self.new_task_id()
+        rec = self._actors.get(info.actor_id)
+        if rec is not None:
+            with rec.lock:
+                rec.worker = None
+        self.task_manager.register(spec)
+        self._schedule(spec)
+
+    def _on_actor_state(self, msg) -> None:
+        actor_id, state = msg
+        if state == ActorState.DEAD:
+            self._drain_actor_queue_with_error(actor_id, "actor is dead")
+
+    def _submit_actor_spec(self, spec: TaskSpec) -> None:
+        rec = self._actors.get(spec.actor_id)
+        info = self.gcs.get_actor(spec.actor_id)
+        if rec is None or info is None or info.state == ActorState.DEAD:
+            cause = info.death_cause if info else "unknown actor"
+            self._fail_task(spec, exc.ActorDiedError(
+                f"Actor {spec.actor_id.hex()[:8]} is dead: {cause}"))
+            return
+        with rec.lock:
+            if info.state == ActorState.ALIVE and rec.worker is not None:
+                spec.seq_no = rec.seq
+                rec.seq += 1
+                node = self.nodes.get(rec.node_id)
+                worker = rec.worker
+            else:
+                rec.queued.append(spec)
+                return
+        if node is None or not node.alive:
+            self.on_worker_crashed(spec, rec.node_id)
+            return
+        node.push_task(worker, spec)
+
+    def _flush_actor_queue(self, actor_id: ActorId) -> None:
+        rec = self._actors.get(actor_id)
+        if rec is None:
+            return
+        with rec.lock:
+            queued, rec.queued = rec.queued, []
+            rec.seq = 0  # fresh worker instance expects sequence from 0
+        for spec in queued:
+            self._submit_actor_spec(spec)
+
+    def _drain_actor_queue_with_error(self, actor_id: ActorId, cause: str) -> None:
+        rec = self._actors.get(actor_id)
+        if rec is None:
+            return
+        with rec.lock:
+            queued, rec.queued = rec.queued, []
+        for spec in queued:
+            self._fail_task(spec, exc.ActorDiedError(
+                f"Actor {actor_id.hex()[:8]}: {cause}"))
+
+    def kill_actor(self, actor_id: ActorId, no_restart: bool = True) -> None:
+        info = self.gcs.get_actor(actor_id)
+        if info is None:
+            return
+        if no_restart:
+            info.max_restarts = 0
+        rec = self._actors.get(actor_id)
+        worker = rec.worker if rec else None
+        node = self.nodes.get(rec.node_id) if rec and rec.node_id else None
+        if worker is not None and node is not None:
+            node.kill_worker(worker, force=True)
+        else:
+            self.gcs.on_actor_failure(actor_id, "killed via ray_tpu.kill")
+
+    def actor_state(self, actor_id: ActorId) -> str:
+        info = self.gcs.get_actor(actor_id)
+        return info.state.name if info else "UNKNOWN"
+
+    def wait_for_actor(self, actor_id: ActorId, timeout: float = 60.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            info = self.gcs.get_actor(actor_id)
+            if info is not None and info.state == ActorState.ALIVE:
+                return
+            if info is not None and info.state == ActorState.DEAD:
+                raise exc.ActorDiedError(info.death_cause)
+            time.sleep(0.01)
+        raise exc.GetTimeoutError(f"actor {actor_id.hex()[:8]} not alive in time")
+
+    # ---- placement groups (ref: gcs_placement_group_manager.cc 2PC) ----------
+
+    def create_placement_group(self, bundles: List[ResourceSet], strategy: str,
+                               name: str = "") -> PlacementGroupId:
+        from .gcs import PlacementGroupInfo
+
+        pg_id = PlacementGroupId.from_random()
+        info = PlacementGroupInfo(pg_id=pg_id, bundles=[normalize(b) for b in bundles],
+                                  strategy=strategy, name=name)
+        self.gcs.register_pg(info)
+        self._pool.submit(self._try_place_pg, pg_id)
+        return pg_id
+
+    def _try_place_pg(self, pg_id: PlacementGroupId) -> None:
+        info = self.gcs.get_pg(pg_id)
+        if info is None or info.state == "REMOVED":
+            return
+        deadline = time.monotonic() + self.config.worker_lease_timeout_s
+        while time.monotonic() < deadline:
+            placement = self.scheduler.pick_bundle_nodes(
+                self._views(), info.bundles, info.strategy)
+            if placement is not None:
+                # phase 1: prepare all bundles
+                prepared = []
+                ok = True
+                for idx, nid in enumerate(placement):
+                    node = self.nodes.get(nid)
+                    if node is None or not node.prepare_bundle(pg_id, idx,
+                                                              info.bundles[idx]):
+                        ok = False
+                        break
+                    prepared.append((node, idx))
+                if ok:
+                    # phase 2: commit
+                    for node, idx in prepared:
+                        node.commit_bundle(pg_id, idx)
+                    info.bundle_nodes = list(placement)
+                    info.state = "CREATED"
+                    self.gcs.pubsub.publish("pg", (pg_id, "CREATED"))
+                    self._reschedule_parked()
+                    return
+                for node, idx in prepared:
+                    node.return_bundle(pg_id, idx)
+            time.sleep(0.05)
+        info.state = "PENDING"  # stays pending; tasks against it park
+
+    def pg_ready(self, pg_id: PlacementGroupId, timeout: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            info = self.gcs.get_pg(pg_id)
+            if info is not None and info.state == "CREATED":
+                return True
+            time.sleep(0.01)
+        return False
+
+    def remove_placement_group(self, pg_id: PlacementGroupId) -> None:
+        info = self.gcs.get_pg(pg_id)
+        if info is None:
+            return
+        info.state = "REMOVED"
+        for idx, nid in enumerate(info.bundle_nodes):
+            node = self.nodes.get(nid)
+            if node is not None:
+                node.return_bundle(pg_id, idx)
+
+    # ---- worker RPC dispatch (the node-side core-worker service) -------------
+
+    def handle_worker_call(self, node: Node, worker: Optional[WorkerHandle],
+                           method: str, payload):
+        if method == "get_objects":
+            ids = payload["ids"]
+            timeout = payload.get("timeout")
+            out = []
+            for oid in ids:
+                out.append(self.fetch_one(oid, timeout))
+            return out
+        if method == "put_inline":
+            oid = payload["object_id"]
+            self.store_inline_bytes(oid, payload["data"])
+            self.refcount.add_owned(oid)
+            return True
+        if method == "export_function":
+            self.gcs.kv_put("fn:" + payload["func_id"], payload["blob"],
+                            namespace="fn", overwrite=False)
+            return True
+        if method == "get_function":
+            return self.get_function_blob(payload)
+        if method == "submit_task":
+            self.submit_spec(payload)
+            return True
+        if method == "create_actor":
+            self.create_actor(payload["spec"], name=payload.get("name", ""),
+                              detached=payload.get("detached", False),
+                              meta=payload.get("meta"))
+            return True
+        if method == "wait":
+            refs = [ObjectRef(o) for o in payload["ids"]]
+            ready, pending = self.wait(refs, payload["num_returns"],
+                                       payload.get("timeout"))
+            return ([r.id for r in ready], [r.id for r in pending])
+        if method == "kill_actor":
+            self.kill_actor(payload["actor_id"], payload.get("no_restart", True))
+            return True
+        if method == "cancel_task":
+            self.cancel(payload["task_id"], payload.get("force", False))
+            return True
+        if method == "actor_state":
+            return self.actor_state(payload)
+        if method == "wait_for_actor":
+            self.wait_for_actor(payload["actor_id"], payload.get("timeout", 60.0))
+            return True
+        if method == "get_named_actor":
+            info = self.gcs.get_named_actor(payload["name"], payload["namespace"])
+            if info is None or info.state == ActorState.DEAD:
+                return None
+            meta = self.gcs.kv_get("actor_meta:" + info.actor_id.hex(),
+                                   namespace="actor")
+            return {"actor_id": info.actor_id, "meta": meta}
+        if method == "kv_put":
+            return self.gcs.kv_put(payload["key"], payload["value"],
+                                   namespace=payload.get("namespace", "user"),
+                                   overwrite=payload.get("overwrite", True))
+        if method == "kv_get":
+            return self.gcs.kv_get(payload["key"],
+                                   namespace=payload.get("namespace", "user"))
+        if method == "kv_del":
+            return self.gcs.kv_del(payload["key"],
+                                   namespace=payload.get("namespace", "user"))
+        if method == "kv_keys":
+            return self.gcs.kv_keys(payload.get("prefix", ""),
+                                    namespace=payload.get("namespace", "user"))
+        if method == "create_pg":
+            return self.create_placement_group(payload["bundles"],
+                                               payload["strategy"],
+                                               payload.get("name", ""))
+        if method == "pg_ready":
+            return self.pg_ready(payload["pg_id"], payload.get("timeout", 30.0))
+        if method == "remove_pg":
+            self.remove_placement_group(payload["pg_id"])
+            return True
+        if method == "add_ref":
+            self.refcount.add_local(payload)
+            return None
+        if method == "remove_ref":
+            self.refcount.remove_local(payload)
+            return None
+        if method == "node_info":
+            return {"node_id": node.node_id, "job_id": self.job_id,
+                    "namespace": self.namespace}
+        if method == "log_event":
+            self.gcs.add_task_event(payload)
+            return None
+        raise ValueError(f"unknown worker call: {method}")
+
+    # ---- cancellation --------------------------------------------------------
+
+    def cancel(self, task_id_or_ref, force: bool = False) -> None:
+        if isinstance(task_id_or_ref, ObjectRef):
+            spec = self.task_manager.lineage_for_object(task_id_or_ref.id)
+        else:
+            pt = self.task_manager.get(task_id_or_ref)
+            spec = pt.spec if pt else None
+        if spec is None:
+            return
+        pt = self.task_manager.get(spec.task_id)
+        if pt is None:
+            return
+        pt.retries_left = 0
+        found_running = False
+        for node in list(self.nodes.values()):
+            for w in list(node._workers.values()):
+                if spec.task_id in w.in_flight:
+                    found_running = True
+                    if force:
+                        node.kill_worker(w, force=True)
+                    elif w.channel is not None:
+                        w.channel.notify("cancel_task", spec.task_id)
+        if not found_running:
+            self._fail_task(spec, exc.TaskCancelledError(
+                f"Task {spec.description} cancelled before execution"))
+
+    # ---- context & lifecycle -------------------------------------------------
+
+    def runtime_context(self) -> RuntimeContext:
+        return RuntimeContext(job_id=self.job_id, node_id=self.head_node_id,
+                              worker_id=self.worker_id, namespace=self.namespace)
+
+    def cluster_resources(self) -> ResourceSet:
+        total: ResourceSet = {}
+        for n in self.nodes.values():
+            if n.alive:
+                for k, v in n.total_resources.items():
+                    total[k] = total.get(k, 0) + v
+        return total
+
+    def available_resources(self) -> ResourceSet:
+        total: ResourceSet = {}
+        for n in self.nodes.values():
+            if n.alive:
+                for k, v in n.available.items():
+                    total[k] = total.get(k, 0) + v
+        return total
+
+    def shutdown(self) -> None:
+        if self._shutdown:
+            return
+        self._shutdown = True
+        for node in list(self.nodes.values()):
+            try:
+                node.shutdown(kill=False)
+            except Exception:
+                pass
+        self.gcs.finish_job(self.job_id)
+        self._reader.close()
+        self._pool.shutdown(wait=False)
+
+
+class WorkerRuntime:
+    """Thin runtime inside worker processes: proxies the core API over the
+    node channel (the analog of _raylet.pyx calling into CoreWorker)."""
+
+    def __init__(self, worker_process):
+        self.worker = worker_process
+        self.channel = worker_process.channel
+        self._tls = threading.local()
+        self._fn_cache: Dict[int, str] = {}
+        self._put_lock = threading.Lock()
+        self._put_counter = 0
+        self.worker_id = worker_process.worker_id
+
+    # task context
+    def set_current_task(self, spec: TaskSpec):
+        prev = getattr(self._tls, "spec", None)
+        self._tls.spec = spec
+        return prev
+
+    def clear_current_task(self, token) -> None:
+        self._tls.spec = token
+
+    def current_task(self) -> Optional[TaskSpec]:
+        return getattr(self._tls, "spec", None)
+
+    # objects
+    def next_put_id(self) -> ObjectId:
+        spec = self.current_task()
+        base = spec.task_id if spec else TaskId.from_random()
+        with self._put_lock:
+            self._put_counter += 1
+            return ObjectId.for_put(base, self._put_counter)
+
+    def put(self, value: Any) -> ObjectRef:
+        from .config import DEFAULT as cfg
+
+        oid = self.next_put_id()
+        sobj = serialization.serialize(value)
+        if sobj.total_bytes <= cfg.max_direct_call_object_size:
+            self.channel.call("put_inline", {"object_id": oid,
+                                             "data": sobj.to_bytes()})
+        else:
+            name = self.channel.call("create_object",
+                                     {"object_id": oid, "size": sobj.total_bytes})
+            mv = self.worker.reader.read(name, sobj.total_bytes)
+            sobj.write_into(mv)
+            del mv  # drop the exported view before unmapping
+            self.worker.reader.release(name)
+            self.channel.call("seal_object", {"object_id": oid})
+        return ObjectRef(oid)
+
+    def get_many(self, oids: List[ObjectId], timeout: Optional[float] = None):
+        results = self.channel.call("get_objects", {"ids": oids, "timeout": timeout},
+                                    timeout=None)
+        out = []
+        for res in results:
+            out.append(self._deserialize(res))
+        return out
+
+    def _deserialize(self, res):
+        if res[0] == "inline":
+            value = serialization.loads(res[1])
+        else:
+            _, name, size = res
+            value = serialization.loads(self.worker.reader.read(name, size))
+        if isinstance(value, exc.TaskError):
+            cause = value.cause
+            if isinstance(cause, exc.RayTpuError):
+                raise cause
+            raise value
+        if isinstance(value, exc.RayTpuError):
+            raise value
+        return value
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        out = self.get_many([r.id for r in refs], timeout)
+        return out[0] if single else out
+
+    def get_async(self, ref: ObjectRef):
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        return loop.run_in_executor(None, lambda: self.get(ref))
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        ready_ids, pending_ids = self.channel.call(
+            "wait", {"ids": [r.id for r in refs], "num_returns": num_returns,
+                     "timeout": timeout}, timeout=None)
+        ready_set = {o for o in ready_ids}
+        ready = [r for r in refs if r.id in ready_set]
+        pending = [r for r in refs if r.id not in ready_set]
+        return ready, pending
+
+    # functions / tasks / actors
+    def export_function(self, fn) -> str:
+        key = id(fn)
+        cached = self._fn_cache.get(key)
+        if cached is not None and cached[0] is fn:
+            return cached[1]
+        blob = cloudpickle.dumps(fn)
+        func_id = hashlib.sha1(blob).hexdigest()
+        self.channel.call("export_function", {"func_id": func_id, "blob": blob})
+        self._fn_cache[key] = (fn, func_id)
+        return func_id
+
+    def new_task_id(self) -> TaskId:
+        return TaskId.from_random()
+
+    def submit_spec(self, spec: TaskSpec) -> List[ObjectRef]:
+        refs = [ObjectRef(oid) for oid in spec.return_ids()]
+        self.channel.call("submit_task", spec)
+        return refs
+
+    def create_actor(self, spec: TaskSpec, name: str = "", detached: bool = False,
+                     meta: Optional[dict] = None) -> None:
+        self.channel.call("create_actor", {"spec": spec, "name": name,
+                                           "detached": detached, "meta": meta})
+
+    def kill_actor(self, actor_id: ActorId, no_restart: bool = True) -> None:
+        self.channel.call("kill_actor", {"actor_id": actor_id,
+                                         "no_restart": no_restart})
+
+    def actor_state(self, actor_id: ActorId) -> str:
+        return self.channel.call("actor_state", actor_id)
+
+    def wait_for_actor(self, actor_id: ActorId, timeout: float = 60.0) -> None:
+        self.channel.call("wait_for_actor", {"actor_id": actor_id,
+                                             "timeout": timeout}, timeout=None)
+
+    def get_named_actor_info(self, name: str, namespace: str):
+        return self.channel.call("get_named_actor", {"name": name,
+                                                     "namespace": namespace})
+
+    def cancel(self, ref, force: bool = False) -> None:
+        self.channel.call("cancel_task", {"task_id": ref, "force": force})
+
+    def free(self, refs) -> None:
+        pass  # centralized GC; workers do not free directly
+
+    # placement groups
+    def create_placement_group(self, bundles, strategy, name=""):
+        return self.channel.call("create_pg", {"bundles": bundles,
+                                               "strategy": strategy, "name": name})
+
+    def pg_ready(self, pg_id, timeout: float = 30.0) -> bool:
+        return self.channel.call("pg_ready", {"pg_id": pg_id, "timeout": timeout},
+                                 timeout=None)
+
+    def remove_placement_group(self, pg_id) -> None:
+        self.channel.call("remove_pg", {"pg_id": pg_id})
+
+    # kv
+    def kv_put(self, key, value, namespace="user", overwrite=True):
+        return self.channel.call("kv_put", {"key": key, "value": value,
+                                            "namespace": namespace,
+                                            "overwrite": overwrite})
+
+    def kv_get(self, key, namespace="user"):
+        return self.channel.call("kv_get", {"key": key, "namespace": namespace})
+
+    def kv_del(self, key, namespace="user"):
+        return self.channel.call("kv_del", {"key": key, "namespace": namespace})
+
+    def kv_keys(self, prefix="", namespace="user"):
+        return self.channel.call("kv_keys", {"prefix": prefix,
+                                             "namespace": namespace})
+
+    def runtime_context(self) -> RuntimeContext:
+        spec = self.current_task()
+        info = self.channel.call("node_info", {})
+        return RuntimeContext(
+            job_id=info["job_id"], node_id=info["node_id"],
+            worker_id=self.worker_id,
+            task_id=spec.task_id if spec else None,
+            actor_id=spec.actor_id if spec else None,
+            namespace=info["namespace"])
+
+    def shutdown(self) -> None:
+        pass

@@ -1,0 +1,121 @@
+"""Serialization: cloudpickle with pickle-5 out-of-band buffers.
+
+Equivalent of the reference's serialization context
+(ref: python/ray/_private/serialization.py — pickle5 + out-of-band buffers so
+large numpy/arrow payloads are written once into the object store without an
+extra copy; ObjectRefs found inside values are tracked for the borrowing
+protocol).
+
+Wire format of a sealed object:
+    [u32 meta_len][meta pickle][u32 nbuf][u64 len_i]*nbuf [buffer bytes...]
+meta is the cloudpickle of the value with PickleBuffer placeholders.
+"""
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+_PROTOCOL = 5
+
+
+class SerializedObject:
+    """A serialized value: a small metadata pickle plus zero-copy buffers."""
+
+    __slots__ = ("meta", "buffers", "contained_refs")
+
+    def __init__(self, meta: bytes, buffers: List[memoryview], contained_refs: list):
+        self.meta = meta
+        self.buffers = buffers
+        self.contained_refs = contained_refs
+
+    @property
+    def total_bytes(self) -> int:
+        return (
+            8
+            + len(self.meta)
+            + 8 * len(self.buffers)
+            + sum(b.nbytes for b in self.buffers)
+        )
+
+    def write_into(self, dest: memoryview) -> int:
+        off = 0
+        struct.pack_into("<I", dest, off, len(self.meta))
+        off += 4
+        dest[off : off + len(self.meta)] = self.meta
+        off += len(self.meta)
+        struct.pack_into("<I", dest, off, len(self.buffers))
+        off += 4
+        for b in self.buffers:
+            struct.pack_into("<Q", dest, off, b.nbytes)
+            off += 8
+        for b in self.buffers:
+            n = b.nbytes
+            dest[off : off + n] = b.cast("B")
+            off += n
+        return off
+
+    def to_bytes(self) -> bytes:
+        out = bytearray(self.total_bytes)
+        self.write_into(memoryview(out))
+        return bytes(out)
+
+
+def serialize(value: Any) -> SerializedObject:
+    buffers: List[pickle.PickleBuffer] = []
+    contained_refs: list = []
+
+    # Track ObjectRefs serialized inside the value (borrowing protocol hook).
+    from .object_ref import ObjectRef  # local import to avoid cycle
+
+    def _reducer_override(obj):
+        return NotImplemented
+
+    class _Pickler(cloudpickle.CloudPickler):
+        def persistent_id(self, obj):  # noqa: N802
+            return None
+
+        def reducer_override(self, obj):
+            if isinstance(obj, ObjectRef):
+                contained_refs.append(obj)
+            return super().reducer_override(obj) if hasattr(super(), "reducer_override") else NotImplemented
+
+    import io
+
+    f = io.BytesIO()
+    p = _Pickler(f, protocol=_PROTOCOL, buffer_callback=buffers.append)
+    p.dump(value)
+    views = [b.raw() for b in buffers]
+    return SerializedObject(f.getvalue(), views, contained_refs)
+
+
+def deserialize(data: memoryview | bytes) -> Any:
+    mv = memoryview(data)
+    off = 0
+    (meta_len,) = struct.unpack_from("<I", mv, off)
+    off += 4
+    meta = mv[off : off + meta_len]
+    off += meta_len
+    (nbuf,) = struct.unpack_from("<I", mv, off)
+    off += 4
+    lens = []
+    for _ in range(nbuf):
+        (n,) = struct.unpack_from("<Q", mv, off)
+        off += 8
+        lens.append(n)
+    bufs = []
+    for n in lens:
+        bufs.append(mv[off : off + n])
+        off += n
+    return pickle.loads(bytes(meta), buffers=bufs)
+
+
+def dumps(value: Any) -> bytes:
+    """Serialize to a single contiguous byte string (inline path)."""
+    return serialize(value).to_bytes()
+
+
+def loads(data: bytes | memoryview) -> Any:
+    return deserialize(data)

@@ -1,0 +1,162 @@
+"""Task manager + reference counter (owner-side bookkeeping).
+
+Equivalent of the reference's core-worker TaskManager
+(ref: src/ray/core_worker/task_manager.h:173 — pending table, retries
+:367 RetryTaskIfPossible, lineage-based resubmission :234 ResubmitTask with a
+byte budget :180) and ReferenceCounter (reference_count.h:61 —
+ownership-based distributed refcounting).
+
+Deviation from the reference: ownership is centralized on the head runtime
+(single-controller), so the borrower protocol reduces to per-process refcount
+reports aggregated here rather than owner-to-borrower long-poll chains.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set
+
+from .ids import ObjectId, TaskId
+from .task_spec import TaskSpec
+
+
+@dataclass
+class PendingTask:
+    spec: TaskSpec
+    retries_left: int
+    reconstructions_left: int = 3
+    submitted_at: float = field(default_factory=time.monotonic)
+    state: str = "PENDING"  # PENDING | RUNNING | FINISHED | FAILED
+
+
+class TaskManager:
+    def __init__(self, lineage_max_bytes: int = 256 * 1024 * 1024):
+        self._lock = threading.RLock()
+        self._pending: Dict[TaskId, PendingTask] = {}
+        # lineage: task prefix (first 12 id bytes) -> spec of the task that
+        # created those objects; bounded by _lineage_bytes budget
+        self._lineage: Dict[bytes, TaskSpec] = {}
+        self._lineage_bytes = 0
+        self._lineage_max_bytes = lineage_max_bytes
+        self._lineage_order: List[bytes] = []
+
+    def register(self, spec: TaskSpec) -> PendingTask:
+        with self._lock:
+            pt = PendingTask(spec=spec, retries_left=spec.max_retries)
+            self._pending[spec.task_id] = pt
+            self._record_lineage(spec)
+            return pt
+
+    def _record_lineage(self, spec: TaskSpec) -> None:
+        prefix = spec.task_id.binary()[:12]
+        if prefix in self._lineage:
+            return
+        approx = 256 + sum(
+            len(a[1]) if a[0] == 0 and isinstance(a[1], bytes) else 64
+            for a in spec.args)
+        self._lineage[prefix] = spec
+        self._lineage_order.append(prefix)
+        self._lineage_bytes += approx
+        while self._lineage_bytes > self._lineage_max_bytes and self._lineage_order:
+            old = self._lineage_order.pop(0)
+            self._lineage.pop(old, None)
+            self._lineage_bytes -= 256  # rough; budget is advisory
+
+    def get(self, task_id: TaskId) -> Optional[PendingTask]:
+        with self._lock:
+            return self._pending.get(task_id)
+
+    def mark_running(self, task_id: TaskId) -> None:
+        with self._lock:
+            pt = self._pending.get(task_id)
+            if pt:
+                pt.state = "RUNNING"
+
+    def complete(self, task_id: TaskId) -> None:
+        with self._lock:
+            pt = self._pending.pop(task_id, None)
+            if pt:
+                pt.state = "FINISHED"
+
+    def fail(self, task_id: TaskId) -> None:
+        with self._lock:
+            pt = self._pending.pop(task_id, None)
+            if pt:
+                pt.state = "FAILED"
+
+    def try_retry(self, task_id: TaskId) -> Optional[TaskSpec]:
+        """Consume one retry; returns the spec to resubmit, or None if
+        exhausted. (ref: task_manager.h:367 RetryTaskIfPossible)"""
+        with self._lock:
+            pt = self._pending.get(task_id)
+            if pt is None or pt.retries_left == 0:
+                return None
+            if pt.retries_left > 0:
+                pt.retries_left -= 1
+            pt.state = "PENDING"
+            return pt.spec
+
+    def lineage_for_object(self, object_id: ObjectId) -> Optional[TaskSpec]:
+        with self._lock:
+            return self._lineage.get(object_id.task_prefix())
+
+    def num_pending(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+
+class ReferenceCounter:
+    """Aggregated reference counts per object.
+
+    Counts: python-local references (driver + each worker process reports),
+    plus pins from pending task arguments. An object is freeable when all
+    counts reach zero. (ref: reference_count.h:61)"""
+
+    def __init__(self, on_free: Callable[[ObjectId], None]):
+        self._lock = threading.Lock()
+        self._local: Dict[ObjectId, int] = {}
+        self._task_pins: Dict[ObjectId, int] = {}
+        self._owned: Set[ObjectId] = set()
+        self._on_free = on_free
+
+    def add_owned(self, object_id: ObjectId) -> None:
+        with self._lock:
+            self._owned.add(object_id)
+
+    def add_local(self, object_id: ObjectId, n: int = 1) -> None:
+        with self._lock:
+            self._local[object_id] = self._local.get(object_id, 0) + n
+
+    def remove_local(self, object_id: ObjectId, n: int = 1) -> None:
+        free = False
+        with self._lock:
+            c = self._local.get(object_id, 0) - n
+            if c <= 0:
+                self._local.pop(object_id, None)
+                free = object_id not in self._task_pins and object_id in self._owned
+            else:
+                self._local[object_id] = c
+        if free:
+            self._on_free(object_id)
+
+    def pin_for_task(self, object_id: ObjectId) -> None:
+        with self._lock:
+            self._task_pins[object_id] = self._task_pins.get(object_id, 0) + 1
+
+    def unpin_for_task(self, object_id: ObjectId) -> None:
+        free = False
+        with self._lock:
+            c = self._task_pins.get(object_id, 0) - 1
+            if c <= 0:
+                self._task_pins.pop(object_id, None)
+                free = (object_id not in self._local
+                        and object_id in self._owned)
+            else:
+                self._task_pins[object_id] = c
+        if free:
+            self._on_free(object_id)
+
+    def counts(self, object_id: ObjectId) -> tuple:
+        with self._lock:
+            return (self._local.get(object_id, 0), self._task_pins.get(object_id, 0))

@@ -1,0 +1,295 @@
+"""Worker process entrypoint.
+
+Equivalent of the reference's worker side of CoreWorker
+(ref: src/ray/core_worker/core_worker.cc:2523 ExecuteTask;
+python/ray/_raylet.pyx:1253 execute_task;
+transport/actor_scheduling_queue.cc for ordered actor execution;
+concurrency_group_manager.cc for threaded/async actors).
+
+A worker connects back to its node over a Unix socket RpcChannel, registers,
+then serves pushed tasks. Normal tasks run one-at-a-time on the main executor
+thread; actor tasks run on the actor's scheduling queue (FIFO by client
+sequence number, with max_concurrency threads, or an asyncio loop for async
+actors). Blocking runtime calls (get/put/submit) are proxied back over the
+channel to the node — the worker never blocks its RPC reader.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import inspect
+import os
+import queue
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from . import serialization
+from .ids import ActorId, WorkerId
+from .object_ref import ObjectRef
+from .object_store import SegmentReader
+from .rpc import RpcChannel, connect
+from .task_spec import ARG_REF, ARG_VALUE, TaskSpec, TaskType
+
+
+class ActorQueue:
+    """Ordered execution queue for one actor instance.
+    (ref: transport/actor_scheduling_queue.cc — enforce seq order;
+    out_of_order_actor_submit_queue.cc for max_concurrency > 1)."""
+
+    def __init__(self, worker: "WorkerProcess", instance: Any, spec: TaskSpec):
+        self.worker = worker
+        self.instance = instance
+        self.max_concurrency = max(1, spec.max_concurrency)
+        self.is_async = spec.is_async_actor
+        self._expected_seq = 0
+        self._buffer: Dict[int, TaskSpec] = {}
+        self._lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(max_workers=self.max_concurrency,
+                                        thread_name_prefix="actor")
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        if self.is_async:
+            self._loop = asyncio.new_event_loop()
+            threading.Thread(target=self._loop.run_forever, daemon=True,
+                             name="actor-asyncio").start()
+
+    def push(self, spec: TaskSpec) -> None:
+        # Dispatch under the lock: push_task messages are handled by a pool
+        # of RPC threads, so releasing the lock before pool.submit would let
+        # two threads invert the sequence order.
+        with self._lock:
+            self._buffer[spec.seq_no] = spec
+            while self._expected_seq in self._buffer:
+                s = self._buffer.pop(self._expected_seq)
+                self._expected_seq += 1
+                if self.is_async:
+                    asyncio.run_coroutine_threadsafe(self._run_async(s), self._loop)
+                else:
+                    self._pool.submit(self.worker.execute_task, s, self.instance)
+
+    async def _run_async(self, spec: TaskSpec) -> None:
+        if self._is_coroutine(spec):
+            await self.worker.execute_task_async(spec, self.instance)
+        else:
+            loop = asyncio.get_event_loop()
+            await loop.run_in_executor(None, self.worker.execute_task, spec,
+                                       self.instance)
+
+    def _is_coroutine(self, spec: TaskSpec) -> bool:
+        try:
+            method = getattr(self.instance, spec.method_name)
+            return inspect.iscoroutinefunction(method)
+        except Exception:
+            return False
+
+
+class WorkerProcess:
+    def __init__(self, channel: RpcChannel, worker_id: WorkerId, node_id_hex: str):
+        self.channel = channel
+        self.worker_id = worker_id
+        self.node_id_hex = node_id_hex
+        self.reader = SegmentReader()
+        self._fn_cache: Dict[str, Any] = {}
+        self._task_queue: "queue.Queue[Optional[TaskSpec]]" = queue.Queue()
+        self._actor: Optional[ActorQueue] = None
+        self._actor_id: Optional[ActorId] = None
+        self._cancelled: set = set()
+        self._stop = threading.Event()
+        # register the worker-mode runtime so `ray_tpu.get/put/remote` work in tasks
+        from . import runtime as runtime_mod
+
+        self.runtime = runtime_mod.WorkerRuntime(self)
+        runtime_mod.set_runtime(self.runtime)
+
+    # -- incoming RPC ----------------------------------------------------------
+
+    def handle(self, method: str, payload: Any) -> Any:
+        if method == "push_task":
+            spec: TaskSpec = payload
+            if spec.task_type == TaskType.ACTOR_TASK and self._actor is not None:
+                self._actor.push(spec)
+            else:
+                self._task_queue.put(spec)
+            return None
+        if method == "ping":
+            return "pong"
+        if method == "cancel_task":
+            self._cancelled.add(payload)
+            return None
+        if method == "kill_actor":
+            os._exit(0)
+        if method == "shutdown":
+            self._stop.set()
+            self._task_queue.put(None)
+            return None
+        raise ValueError(f"unknown method {method}")
+
+    # -- task execution --------------------------------------------------------
+
+    def run(self) -> None:
+        while not self._stop.is_set() and not self.channel.closed:
+            try:
+                spec = self._task_queue.get(timeout=0.25)
+            except queue.Empty:
+                continue
+            if spec is None:
+                break
+            self.execute_task(spec, self._actor.instance if self._actor else None)
+
+    def _get_function(self, func_id: str):
+        fn = self._fn_cache.get(func_id)
+        if fn is None:
+            blob = self.channel.call("get_function", func_id, timeout=60)
+            fn = cloudpickle.loads(blob)
+            self._fn_cache[func_id] = fn
+        return fn
+
+    def resolve_args(self, spec: TaskSpec):
+        ref_ids = [a[1].id for a in spec.args if a[0] == ARG_REF]
+        ref_ids += [a[1].id for a in spec.kwargs.values() if a[0] == ARG_REF]
+        values = {}
+        if ref_ids:
+            fetched = self.runtime.get_many(ref_ids)
+            values = dict(zip([r.hex() for r in ref_ids], fetched))
+        args = [
+            values[a[1].id.hex()] if a[0] == ARG_REF else serialization.loads(a[1])
+            for a in spec.args
+        ]
+        kwargs = {
+            k: (values[a[1].id.hex()] if a[0] == ARG_REF else serialization.loads(a[1]))
+            for k, a in spec.kwargs.items()
+        }
+        return args, kwargs
+
+    def execute_task(self, spec: TaskSpec, instance: Any = None) -> None:
+        if spec.task_id in self._cancelled:
+            self._report_error(spec, _make_cancelled_error(spec))
+            return
+        token = self.runtime.set_current_task(spec)
+        try:
+            args, kwargs = self.resolve_args(spec)
+            if spec.task_type == TaskType.NORMAL_TASK:
+                fn = self._get_function(spec.func_id)
+                result = fn(*args, **kwargs)
+            elif spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                cls = self._get_function(spec.func_id)
+                inst = cls(*args, **kwargs)
+                self._actor = ActorQueue(self, inst, spec)
+                self._actor_id = spec.actor_id
+                result = None
+            else:  # ACTOR_TASK
+                method = getattr(instance, spec.method_name)
+                if inspect.iscoroutinefunction(method):
+                    result = asyncio.run(method(*args, **kwargs))
+                else:
+                    result = method(*args, **kwargs)
+            self._report_success(spec, result)
+        except BaseException as e:  # noqa: BLE001 — remote errors must be shipped back
+            self._report_error(spec, e)
+        finally:
+            self.runtime.clear_current_task(token)
+
+    async def execute_task_async(self, spec: TaskSpec, instance: Any) -> None:
+        token = self.runtime.set_current_task(spec)
+        try:
+            args, kwargs = self.resolve_args(spec)
+            method = getattr(instance, spec.method_name)
+            result = await method(*args, **kwargs)
+            self._report_success(spec, result)
+        except BaseException as e:  # noqa: BLE001
+            self._report_error(spec, e)
+        finally:
+            self.runtime.clear_current_task(token)
+
+    # -- result reporting ------------------------------------------------------
+
+    def _report_success(self, spec: TaskSpec, result: Any) -> None:
+        from .config import DEFAULT as cfg
+
+        if spec.num_returns == 0:
+            outs = []
+        elif spec.num_returns == 1:
+            outs = [result]
+        else:
+            outs = list(result)
+            if len(outs) != spec.num_returns:
+                self._report_error(
+                    spec,
+                    ValueError(
+                        f"Task returned {len(outs)} values, expected {spec.num_returns}"),
+                )
+                return
+        results = []
+        return_ids = spec.return_ids()
+        for oid, value in zip(return_ids, outs):
+            sobj = serialization.serialize(value)
+            if sobj.total_bytes <= cfg.max_direct_call_object_size:
+                results.append(("inline", sobj.to_bytes()))
+            else:
+                name = self.channel.call("create_object",
+                                         {"object_id": oid, "size": sobj.total_bytes})
+                mv = self.reader.read(name, sobj.total_bytes)
+                sobj.write_into(mv)
+                del mv  # drop the exported view before unmapping
+                self.reader.release(name)
+                self.channel.call("seal_object", {"object_id": oid})
+                results.append(("stored", None))
+        self.channel.notify("task_done", {
+            "task_id": spec.task_id,
+            "results": results,
+            "error": None,
+        })
+
+    def _report_error(self, spec: TaskSpec, exc: BaseException) -> None:
+        from ..exceptions import TaskError
+
+        if isinstance(exc, TaskError):
+            err = exc
+        else:
+            err = TaskError(cause=exc, remote_traceback=traceback.format_exc(),
+                            task_desc=spec.description)
+        try:
+            blob = serialization.dumps(err)
+        except Exception:
+            blob = serialization.dumps(
+                TaskError(remote_traceback=traceback.format_exc(),
+                          task_desc=spec.description))
+        self.channel.notify("task_done", {
+            "task_id": spec.task_id,
+            "results": None,
+            "error": blob,
+        })
+
+
+def _make_cancelled_error(spec: TaskSpec):
+    from ..exceptions import TaskCancelledError
+
+    return TaskCancelledError(f"Task {spec.description} was cancelled")
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--address", required=True)
+    parser.add_argument("--authkey", required=True)
+    parser.add_argument("--worker-id", required=True)
+    parser.add_argument("--node-id", required=True)
+    args = parser.parse_args()
+
+    worker_id = WorkerId.from_hex(args.worker_id)
+    channel = connect(args.address, authkey=bytes.fromhex(args.authkey),
+                      name=f"worker-{args.worker_id[:8]}")
+    wp = WorkerProcess(channel, worker_id, args.node_id)
+    channel.set_handler(wp.handle)
+    channel.on_close(lambda: os._exit(0))
+    channel.call("register", {"worker_id": worker_id, "pid": os.getpid()}, timeout=30)
+    try:
+        wp.run()
+    finally:
+        channel.close()
+
+
+if __name__ == "__main__":
+    main()
