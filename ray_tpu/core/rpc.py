@@ -32,7 +32,8 @@ class RpcChannel:
     def __init__(self, conn: Connection,
                  handler: Optional[Callable[[str, Any], Any]] = None,
                  num_handler_threads: int = 4,
-                 name: str = ""):
+                 name: str = "",
+                 autostart: bool = True):
         self._conn = conn
         self._handler = handler
         self._name = name
@@ -40,12 +41,21 @@ class RpcChannel:
         self._pending: Dict[int, Future] = {}
         self._lock = threading.Lock()
         self._closed = threading.Event()
+        self._started = False
         self._on_close_cbs = []
         self._pool = ThreadPoolExecutor(max_workers=num_handler_threads,
                                         thread_name_prefix=f"rpc-{name}")
         self._reader = threading.Thread(target=self._read_loop, daemon=True,
                                         name=f"rpc-reader-{name}")
-        self._reader.start()
+        if autostart:
+            self.start()
+
+    def start(self) -> None:
+        """Begin reading. Callers that must install a handler first pass
+        autostart=False — otherwise a message can race the handler install."""
+        if not self._started:
+            self._started = True
+            self._reader.start()
 
     # -- client side -----------------------------------------------------------
 
@@ -204,8 +214,10 @@ class RpcServer:
                 except Exception:
                     break
                 continue
-            chan = RpcChannel(conn, name="srv", num_handler_threads=16)
+            chan = RpcChannel(conn, name="srv", num_handler_threads=16,
+                              autostart=False)
             chan.set_handler(self._handler_factory(chan))
+            chan.start()
             self._channels.append(chan)
 
     def close(self) -> None:

@@ -13,6 +13,7 @@ the analog of the Cython binding calling into CoreWorker
 """
 from __future__ import annotations
 
+import contextvars
 import hashlib
 import os
 import threading
@@ -202,8 +203,10 @@ class DriverRuntime:
         with self._lock:
             if oid in self._memory_store:
                 return True
-            copies = self._directory.get(oid)
-            return bool(copies)
+            copies = self._directory.get(oid) or ()
+            return any(
+                (n := self.nodes.get(nid)) is not None and n.alive
+                for nid in copies)
 
     def make_ref(self, oid: ObjectId, add_ref: bool = True) -> ObjectRef:
         ref = ObjectRef(oid, owner=self.worker_id)
@@ -439,6 +442,13 @@ class DriverRuntime:
                     node = n
                     break
         else:
+            if strat.kind == "NODE_AFFINITY" and not strat.soft:
+                target = self.nodes.get(strat.node_id)
+                if target is None or not target.alive:
+                    self._fail_task(spec, exc.RayTpuError(
+                        f"Task {spec.description}: hard node affinity to "
+                        f"dead/unknown node {strat.node_id.hex()[:8]}"))
+                    return
             nid = self.scheduler.pick_node(self._views(), demand, strat,
                                            local_node_id=self.head_node_id)
             node = self.nodes.get(nid) if nid is not None else None
@@ -469,7 +479,10 @@ class DriverRuntime:
         self.task_manager.fail(spec.task_id)
         blob = serialization.dumps(error)
         for oid in spec.return_ids():
-            self.store_inline_bytes(oid, blob)
+            # results sealed before the failure was noticed stay valid (the
+            # task_done message races the store seal on deliberate kills)
+            if not self._object_available(oid):
+                self.store_inline_bytes(oid, blob)
         for ref in spec.arg_refs():
             self.refcount.unpin_for_task(ref.id)
         self.gcs.add_task_event({"task_id": spec.task_id.hex(), "name": spec.description,
@@ -510,11 +523,21 @@ class DriverRuntime:
     def on_worker_crashed(self, spec: TaskSpec, node_id: NodeId) -> None:
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
             return  # actor FSM handles restart / death
+        if spec.num_returns > 0 and all(
+                self._object_available(oid) for oid in spec.return_ids()):
+            # results were sealed (on a live node) before the crash: the task
+            # finished, only its task_done message was lost
+            self.task_manager.complete(spec.task_id)
+            for ref in spec.arg_refs():
+                self.refcount.unpin_for_task(ref.id)
+            return
         if spec.task_type == TaskType.ACTOR_TASK:
             rec = self._actors.get(spec.actor_id)
             info = self.gcs.get_actor(spec.actor_id)
             if rec is not None and info is not None and spec.max_retries != 0 \
                     and info.state != ActorState.DEAD:
+                if spec.max_retries > 0:
+                    spec.max_retries -= 1  # consume one retry per requeue
                 with rec.lock:
                     rec.queued.insert(0, spec)
                 return
@@ -562,6 +585,9 @@ class DriverRuntime:
         with rec.lock:
             rec.worker = worker
             rec.node_id = node_id
+            rec.seq = 0  # fresh worker instance expects sequence from 0;
+            # must happen BEFORE ALIVE is visible so no direct submission can
+            # grab a sequence number that the flush below will reuse
         self.gcs.set_actor_state(spec.actor_id, ActorState.ALIVE,
                                  node_id=node_id, worker_id=worker.worker_id)
         self._flush_actor_queue(spec.actor_id)
@@ -597,21 +623,33 @@ class DriverRuntime:
 
     def _submit_actor_spec(self, spec: TaskSpec) -> None:
         rec = self._actors.get(spec.actor_id)
-        info = self.gcs.get_actor(spec.actor_id)
-        if rec is None or info is None or info.state == ActorState.DEAD:
-            cause = info.death_cause if info else "unknown actor"
+        if rec is None:
             self._fail_task(spec, exc.ActorDiedError(
-                f"Actor {spec.actor_id.hex()[:8]} is dead: {cause}"))
+                f"Actor {spec.actor_id.hex()[:8]}: unknown actor"))
             return
         with rec.lock:
-            if info.state == ActorState.ALIVE and rec.worker is not None:
+            # state read and enqueue are atomic w.r.t. _on_actor_created's
+            # seq reset + flush, so no submission can straddle a restart
+            info = self.gcs.get_actor(spec.actor_id)
+            if info is None or info.state == ActorState.DEAD:
+                cause = info.death_cause if info else "unknown actor"
+                dead_cause = cause
+            elif info.state == ActorState.ALIVE and rec.worker is not None \
+                    and not rec.queued:
+                # direct path only when no earlier tasks are still queued —
+                # otherwise this call would overtake them in sequence order
                 spec.seq_no = rec.seq
                 rec.seq += 1
                 node = self.nodes.get(rec.node_id)
                 worker = rec.worker
+                dead_cause = None
             else:
                 rec.queued.append(spec)
                 return
+        if dead_cause is not None:
+            self._fail_task(spec, exc.ActorDiedError(
+                f"Actor {spec.actor_id.hex()[:8]} is dead: {dead_cause}"))
+            return
         if node is None or not node.alive:
             self.on_worker_crashed(spec, rec.node_id)
             return
@@ -621,11 +659,32 @@ class DriverRuntime:
         rec = self._actors.get(actor_id)
         if rec is None:
             return
+        # drain one at a time, assigning sequence numbers under the lock, so
+        # concurrent direct submissions (which defer while the queue is
+        # non-empty) can never overtake queued tasks
+        while True:
+            with rec.lock:
+                info = self.gcs.get_actor(actor_id)
+                if info is None or info.state != ActorState.ALIVE \
+                        or rec.worker is None or not rec.queued:
+                    break
+                spec = rec.queued.pop(0)
+                spec.seq_no = rec.seq
+                rec.seq += 1
+                node = self.nodes.get(rec.node_id)
+                worker = rec.worker
+            if node is None or not node.alive:
+                self.on_worker_crashed(spec, rec.node_id)
+                continue
+            node.push_task(worker, spec)
+        # a task may have been appended after the final lock release — if the
+        # queue is non-empty and the actor is alive, a new flush is required
         with rec.lock:
-            queued, rec.queued = rec.queued, []
-            rec.seq = 0  # fresh worker instance expects sequence from 0
-        for spec in queued:
-            self._submit_actor_spec(spec)
+            again = bool(rec.queued) and rec.worker is not None
+        if again:
+            info = self.gcs.get_actor(actor_id)
+            if info is not None and info.state == ActorState.ALIVE:
+                self._flush_actor_queue(actor_id)
 
     def _drain_actor_queue_with_error(self, actor_id: ActorId, cause: str) -> None:
         rec = self._actors.get(actor_id)
@@ -883,6 +942,14 @@ class DriverRuntime:
         self._pool.shutdown(wait=False)
 
 
+class _TaskCtx:
+    __slots__ = ("spec", "put_index")
+
+    def __init__(self, spec: TaskSpec):
+        self.spec = spec
+        self.put_index = 0
+
+
 class WorkerRuntime:
     """Thin runtime inside worker processes: proxies the core API over the
     node channel (the analog of _raylet.pyx calling into CoreWorker)."""
@@ -890,31 +957,40 @@ class WorkerRuntime:
     def __init__(self, worker_process):
         self.worker = worker_process
         self.channel = worker_process.channel
-        self._tls = threading.local()
-        self._fn_cache: Dict[int, str] = {}
+        # contextvars, not thread-locals: async-actor coroutines interleave
+        # on one event-loop thread, but each asyncio.Task carries its own
+        # Context, so per-task state stays isolated
+        self._current: "contextvars.ContextVar[Optional[_TaskCtx]]" = \
+            contextvars.ContextVar("rtpu_current_task", default=None)
+        self._fn_cache: Dict[int, tuple] = {}
         self._put_lock = threading.Lock()
         self._put_counter = 0
         self.worker_id = worker_process.worker_id
 
     # task context
     def set_current_task(self, spec: TaskSpec):
-        prev = getattr(self._tls, "spec", None)
-        self._tls.spec = spec
-        return prev
+        return self._current.set(_TaskCtx(spec))
 
     def clear_current_task(self, token) -> None:
-        self._tls.spec = token
+        self._current.reset(token)
 
     def current_task(self) -> Optional[TaskSpec]:
-        return getattr(self._tls, "spec", None)
+        ctx = self._current.get()
+        return ctx.spec if ctx is not None else None
 
     # objects
     def next_put_id(self) -> ObjectId:
-        spec = self.current_task()
-        base = spec.task_id if spec else TaskId.from_random()
+        # Per-task deterministic put indices: a re-executed task (lineage
+        # reconstruction) recreates byte-identical put ObjectIds, making
+        # objects put inside tasks reconstructable — stronger than the
+        # reference, where ray.put objects are unrecoverable.
+        ctx = self._current.get()
+        if ctx is not None:
+            ctx.put_index += 1
+            return ObjectId.for_put(ctx.spec.task_id, ctx.put_index)
         with self._put_lock:
             self._put_counter += 1
-            return ObjectId.for_put(base, self._put_counter)
+            return ObjectId.for_put(TaskId.from_random(), self._put_counter)
 
     def put(self, value: Any) -> ObjectRef:
         from .config import DEFAULT as cfg

@@ -279,8 +279,11 @@ def main() -> None:
     args = parser.parse_args()
 
     worker_id = WorkerId.from_hex(args.worker_id)
-    channel = connect(args.address, authkey=bytes.fromhex(args.authkey),
-                      name=f"worker-{args.worker_id[:8]}")
+    try:
+        channel = connect(args.address, authkey=bytes.fromhex(args.authkey),
+                          name=f"worker-{args.worker_id[:8]}")
+    except OSError:
+        return  # node shut down while we were starting; exit quietly
     wp = WorkerProcess(channel, worker_id, args.node_id)
     channel.set_handler(wp.handle)
     channel.on_close(lambda: os._exit(0))

@@ -1,0 +1,133 @@
+"""Actor semantics (ref: python/ray/tests/test_actor*.py)."""
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def incr(self, k=1):
+        self.n += k
+        return self.n
+
+    def read(self):
+        return self.n
+
+    def fail(self):
+        raise RuntimeError("actor method failure")
+
+
+def test_actor_basic(ray_start_regular):
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.incr.remote(5)) == 15
+    assert ray_tpu.get(c.read.remote()) == 15
+
+
+def test_actor_ordering(ray_start_regular):
+    c = Counter.remote(0)
+    refs = [c.incr.remote() for _ in range(30)]
+    assert ray_tpu.get(refs) == list(range(1, 31))
+
+
+def test_actor_method_error(ray_start_regular):
+    c = Counter.remote(0)
+    with pytest.raises(exceptions.TaskError):
+        ray_tpu.get(c.fail.remote())
+    # actor survives method errors
+    assert ray_tpu.get(c.read.remote()) == 0
+
+
+def test_actor_init_error(ray_start_regular):
+    @ray_tpu.remote
+    class Broken:
+        def __init__(self):
+            raise ValueError("bad init")
+
+        def f(self):
+            return 1
+
+    b = Broken.remote()
+    with pytest.raises((exceptions.TaskError, exceptions.ActorDiedError)):
+        ray_tpu.get(b.f.remote(), timeout=30)
+
+
+def test_named_actor(ray_start_regular):
+    Counter.options(name="counter1").remote(7)
+    h = ray_tpu.get_actor("counter1")
+    assert ray_tpu.get(h.read.remote()) == 7
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("no_such_actor")
+
+
+def test_kill_actor(ray_start_regular):
+    c = Counter.remote(0)
+    ray_tpu.get(c.read.remote())
+    ray_tpu.kill(c)
+    with pytest.raises(exceptions.ActorDiedError):
+        ray_tpu.get(c.read.remote(), timeout=30)
+
+
+def test_actor_handle_in_task(ray_start_regular):
+    c = Counter.remote(0)
+
+    @ray_tpu.remote
+    def bump(h, k):
+        return ray_tpu.get(h.incr.remote(k))
+
+    assert ray_tpu.get(bump.remote(c, 42)) == 42
+
+
+def test_actor_creates_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Parent:
+        def spawn(self):
+            child = Counter.remote(99)
+            return ray_tpu.get(child.read.remote())
+
+    p = Parent.remote()
+    assert ray_tpu.get(p.spawn.remote()) == 99
+
+
+def test_threaded_actor(ray_start_regular):
+    @ray_tpu.remote(max_concurrency=4)
+    class Slow:
+        def work(self, t):
+            time.sleep(t)
+            return t
+
+    s = Slow.remote()
+    t0 = time.monotonic()
+    refs = [s.work.remote(0.5) for _ in range(4)]
+    ray_tpu.get(refs)
+    # 4 x 0.5s overlapped should be well under 2s serial time
+    assert time.monotonic() - t0 < 1.9
+
+
+def test_async_actor(ray_start_regular):
+    @ray_tpu.remote(max_concurrency=8)
+    class Async:
+        async def aget(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.2)
+            return x * 2
+
+    a = Async.remote()
+    t0 = time.monotonic()
+    out = ray_tpu.get([a.aget.remote(i) for i in range(5)])
+    assert out == [0, 2, 4, 6, 8]
+    assert time.monotonic() - t0 < 1.5
+
+
+def test_get_if_exists(ray_start_regular):
+    a = Counter.options(name="singleton", get_if_exists=True).remote(3)
+    b = Counter.options(name="singleton", get_if_exists=True).remote(1000)
+    ray_tpu.get(a.incr.remote())
+    # b is the same actor
+    assert ray_tpu.get(b.read.remote()) == 4

@@ -1,0 +1,134 @@
+"""Multi-node scheduling, resources, placement groups
+(ref: python/ray/tests/test_scheduling.py, test_placement_group.py)."""
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy, PlacementGroupSchedulingStrategy)
+
+
+def test_multi_node_spread(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote(scheduling_strategy="SPREAD", num_cpus=1)
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    nodes = set(ray_tpu.get([where.remote() for _ in range(12)]))
+    assert len(nodes) >= 2
+
+
+def test_node_affinity(ray_start_cluster):
+    cluster = ray_start_cluster
+    n2 = cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    strat = NodeAffinitySchedulingStrategy(n2.node_id)
+    out = ray_tpu.get(where.options(scheduling_strategy=strat).remote())
+    assert out == n2.node_id.hex()
+
+
+def test_custom_resource(ray_start_cluster):
+    cluster = ray_start_cluster
+    special = cluster.add_node(num_cpus=1, resources={"accel": 2})
+
+    @ray_tpu.remote(resources={"accel": 1}, num_cpus=0)
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    assert ray_tpu.get(where.remote()) == special.node_id.hex()
+
+
+def test_resource_gating(ray_start_regular):
+    # 4 CPUs; 2-cpu tasks -> at most 2 concurrent
+    @ray_tpu.remote(num_cpus=2)
+    def hold():
+        time.sleep(0.6)
+        return time.monotonic()
+
+    t0 = time.monotonic()
+    refs = [hold.remote() for _ in range(4)]
+    ray_tpu.get(refs)
+    elapsed = time.monotonic() - t0
+    assert elapsed >= 1.0  # two waves of 0.6s
+
+
+def test_placement_group_strict_spread(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+
+    pg = ray_tpu.placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=15)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    outs = ray_tpu.get([
+        where.options(scheduling_strategy=PlacementGroupSchedulingStrategy(
+            pg, placement_group_bundle_index=i)).remote()
+        for i in range(3)
+    ])
+    assert len(set(outs)) == 3
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_placement_group_strict_pack(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=4)
+    pg = ray_tpu.placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_PACK")
+    assert pg.ready(timeout=15)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    outs = ray_tpu.get([
+        where.options(scheduling_strategy=PlacementGroupSchedulingStrategy(pg)).remote()
+        for _ in range(2)
+    ])
+    assert len(set(outs)) == 1
+
+
+def test_placement_group_unsatisfiable_waits(ray_start_cluster):
+    pg = ray_tpu.placement_group([{"CPU": 100}], strategy="PACK")
+    assert not pg.ready(timeout=1.0)
+
+
+def test_pg_capacity_reserved(ray_start_cluster):
+    cluster = ray_start_cluster  # head has 2 CPUs
+    pg = ray_tpu.placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.ready(timeout=15)
+    # all CPU reserved by the PG: a non-PG task cannot run...
+    @ray_tpu.remote(num_cpus=1)
+    def f():
+        return 1
+
+    _, pending = ray_tpu.wait([f.remote()], timeout=1.5)
+    assert pending  # blocked
+    # ...until the PG is removed
+    ray_tpu.remove_placement_group(pg)
+    ready, _ = ray_tpu.wait(pending, timeout=30)
+    assert ready
+
+
+def test_add_node_unparks_tasks(ray_start_cluster):
+    cluster = ray_start_cluster
+
+    @ray_tpu.remote(resources={"special": 1})
+    def f():
+        return "ran"
+
+    ref = f.remote()
+    _, pending = ray_tpu.wait([ref], timeout=1.0)
+    assert pending
+    cluster.add_node(num_cpus=1, resources={"special": 1})
+    assert ray_tpu.get(ref, timeout=30) == "ran"

@@ -1,0 +1,194 @@
+"""Fault tolerance: worker crashes, actor restarts, node death, lineage
+reconstruction (ref: python/ray/tests/test_failure*.py, chaos suite
+release/nightly_tests/chaos_test/)."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+
+
+def test_task_retry_on_worker_crash(ray_start_regular):
+    @ray_tpu.remote(max_retries=2)
+    def flaky(marker_path):
+        # die the first time, succeed on retry
+        if not os.path.exists(marker_path):
+            open(marker_path, "w").close()
+            os._exit(1)
+        return "recovered"
+
+    marker = f"/tmp/rtpu_flaky_{os.getpid()}_{time.time_ns()}"
+    try:
+        assert ray_tpu.get(flaky.remote(marker), timeout=60) == "recovered"
+    finally:
+        if os.path.exists(marker):
+            os.unlink(marker)
+
+
+def test_task_no_retry_exhausted(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        os._exit(1)
+
+    with pytest.raises(exceptions.WorkerCrashedError):
+        ray_tpu.get(die.remote(), timeout=60)
+
+
+def test_actor_restart(ray_start_regular):
+    @ray_tpu.remote(max_restarts=2)
+    class Phoenix:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def crash(self):
+            os._exit(1)
+
+    p = Phoenix.remote()
+    assert ray_tpu.get(p.incr.remote()) == 1
+    crash_ref = p.crash.remote()
+    # the crash call itself dies with the worker (max_task_retries=0)
+    with pytest.raises(exceptions.ActorDiedError):
+        ray_tpu.get(crash_ref, timeout=60)
+    # restarted: state reset, still serving
+    out = ray_tpu.get(p.incr.remote(), timeout=60)
+    assert out == 1
+
+
+def test_actor_no_restart_dies(ray_start_regular):
+    @ray_tpu.remote(max_restarts=0)
+    class Mortal:
+        def crash(self):
+            os._exit(1)
+
+        def ping(self):
+            return "pong"
+
+    m = Mortal.remote()
+    assert ray_tpu.get(m.ping.remote()) == "pong"
+    m.crash.remote()
+    time.sleep(0.5)
+    with pytest.raises(exceptions.ActorDiedError):
+        ray_tpu.get(m.ping.remote(), timeout=30)
+
+
+def test_lineage_reconstruction_on_node_death(ray_start_cluster):
+    cluster = ray_start_cluster
+    n2 = cluster.add_node(num_cpus=2)
+
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    @ray_tpu.remote(max_retries=3,
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        n2.node_id, soft=True))
+    def big_array(seed):
+        return np.full((512, 1024), seed, dtype=np.float32)
+
+    ref = big_array.remote(7)
+    first = ray_tpu.get(ref, timeout=60)
+    assert first[0, 0] == 7
+    # kill the node holding the only copy
+    cluster.remove_node(n2, kill=True)
+    # re-resolves via lineage re-execution on the surviving node
+    again = ray_tpu.get(ref, timeout=90)
+    assert again.shape == (512, 1024) and again[0, 0] == 7
+
+
+def test_task_put_object_reconstructed(ray_start_cluster):
+    """Objects ray_tpu.put() inside a task carry deterministic per-task put
+    ids, so lineage re-execution recreates them — stronger than the
+    reference, where put objects are unrecoverable."""
+    cluster = ray_start_cluster
+    n2 = cluster.add_node(num_cpus=1)
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    @ray_tpu.remote(max_retries=2,
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        n2.node_id, soft=True))
+    def put_big():
+        return ray_tpu.put(np.ones((512, 1024), dtype=np.float32))
+
+    inner_ref = ray_tpu.get(put_big.remote(), timeout=60)
+    assert ray_tpu.get(inner_ref, timeout=60).shape == (512, 1024)
+    cluster.remove_node(n2, kill=True)
+    again = ray_tpu.get(inner_ref, timeout=90)
+    assert again.shape == (512, 1024)
+
+
+def test_actor_output_lost_is_fatal(ray_start_cluster):
+    """Actor-task outputs are not reconstructable (no deterministic replay);
+    losing the only copy raises ObjectLostError."""
+    cluster = ray_start_cluster
+    n2 = cluster.add_node(num_cpus=1)
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        n2.node_id, soft=True))
+    class Maker:
+        def make(self):
+            return np.ones((512, 1024), dtype=np.float32)
+
+    m = Maker.remote()
+    ref = m.make.remote()
+    assert ray_tpu.get(ref, timeout=60).shape == (512, 1024)
+    cluster.remove_node(n2, kill=True)
+    # ObjectLostError if the loss is noticed at fetch time, ActorDiedError if
+    # the crash handler reported the in-flight task first — both are correct
+    with pytest.raises((exceptions.ObjectLostError, exceptions.ActorDiedError)):
+        ray_tpu.get(ref, timeout=30)
+
+
+def test_node_death_actor_failover(ray_start_cluster):
+    cluster = ray_start_cluster  # head: 2 cpus
+    n2 = cluster.add_node(num_cpus=2)
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    @ray_tpu.remote(max_restarts=3, max_task_retries=3,
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(n2.node_id, soft=True))
+    class Svc:
+        def where(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    s = Svc.remote()
+    first = ray_tpu.get(s.where.remote(), timeout=60)
+    assert first == n2.node_id.hex()
+    cluster.remove_node(n2, kill=True)
+    time.sleep(1.0)
+    second = ray_tpu.get(s.where.remote(), timeout=60)
+    assert second != first  # restarted elsewhere
+
+
+def test_chaos_random_worker_kills(ray_start_cluster):
+    """Mini chaos rig: keep killing random workers while tasks flow
+    (ref: test_utils.py:1390 get_and_run_node_killer)."""
+    import random
+
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    rt = cluster.runtime
+
+    @ray_tpu.remote(max_retries=5)
+    def work(i):
+        time.sleep(0.05)
+        return i
+
+    refs = [work.remote(i) for i in range(40)]
+    rng = random.Random(0)
+    deadline = time.monotonic() + 20
+    killed = 0
+    while time.monotonic() < deadline and killed < 5:
+        time.sleep(0.3)
+        nodes = [n for n in rt.nodes.values() if n.alive]
+        node = rng.choice(nodes)
+        workers = [w for w in node._workers.values() if w.state in ("leased",)]
+        if workers:
+            node.kill_worker(rng.choice(workers), force=True)
+            killed += 1
+    out = ray_tpu.get(refs, timeout=120)
+    assert out == list(range(40))
