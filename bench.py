@@ -1,0 +1,117 @@
+"""Benchmark entry point — run by the driver on real TPU hardware.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Headline: GPT-2-small training-step throughput on one chip (tokens/s) with
+MFU. vs_baseline = achieved MFU / 0.50, the BASELINE.md north-star target
+(the reference publishes no absolute tokens/s for this — BASELINE.json
+published:{} — so the MFU target is the comparison line).
+
+RTPU_BENCH_SMOKE=1 runs a tiny config on CPU (CI smoke).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+SMOKE = os.environ.get("RTPU_BENCH_SMOKE", "") == "1"
+
+if SMOKE:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+if SMOKE:
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+
+_PEAK_BF16 = {
+    # chip kind substring -> peak bf16 FLOP/s per chip
+    "v5 lite": 197e12, "v5e": 197e12,
+    "v5p": 459e12, "v5": 459e12,
+    "v4": 275e12,
+    "v6 lite": 918e12, "v6e": 918e12,
+    "v3": 123e12, "v2": 45e12,
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in _PEAK_BF16.items():
+        if key in kind:
+            return val
+    return 197e12  # assume v5e
+
+
+def main() -> None:
+    from ray_tpu.models import GPT, GPTConfig
+
+    on_tpu = jax.default_backend() == "tpu"
+    if SMOKE or not on_tpu:
+        cfg = GPTConfig.tiny(dtype=jnp.float32, use_flash=False)
+        batch, seq, steps, warmup = 2, 128, 3, 1
+    else:
+        cfg = GPTConfig.small(dtype=jnp.bfloat16, use_flash=True)
+        batch, seq, steps, warmup = 8, 1024, 30, 3
+
+    model = GPT(cfg)
+    import optax
+
+    tx = optax.adamw(3e-4, weight_decay=0.1)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0))
+    opt_state = jax.jit(tx.init)(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
+                                cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    @jax.jit
+    def train_step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(model.loss)(params, tokens, targets)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return loss, optax.apply_updates(params, updates), opt_state
+
+    for _ in range(warmup):
+        loss, params, opt_state = train_step(params, opt_state, tokens, targets)
+    # sync via host transfer: on the tunneled TPU backend block_until_ready
+    # does not actually block, but a device->host read does
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, params, opt_state = train_step(params, opt_state, tokens, targets)
+    loss_val = float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * steps / dt
+
+    n = model.num_params()
+    # fwd+bwd matmul FLOPs/token: 6N + causal attention 6·L·S·D
+    flops_per_token = 6 * n + 6 * cfg.n_layer * seq * cfg.d_model
+    achieved = flops_per_token * tokens_per_sec
+    peak = _peak_flops(jax.devices()[0])
+    mfu = achieved / peak
+
+    print(json.dumps({
+        "metric": "gpt2_small_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.50, 4),
+        "detail": {
+            "mfu": round(mfu, 4),
+            "loss": loss_val,
+            "params": n,
+            "batch": batch, "seq": seq,
+            "device": getattr(jax.devices()[0], "device_kind", "cpu"),
+            "steps_timed": steps,
+            "sec_per_step": round(dt / steps, 4),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -143,8 +143,29 @@ class ActorClass:
         max_task_retries = int(opts.get("max_task_retries", 0))
         meta = {"class_name": self._cls.__name__,
                 "max_task_retries": max_task_retries}
-        rt.create_actor(spec, name=name,
-                        detached=(opts.get("lifetime") == "detached"), meta=meta)
+        import time as _time
+
+        deadline = _time.monotonic() + 30.0
+        while True:
+            try:
+                rt.create_actor(spec, name=name,
+                                detached=(opts.get("lifetime") == "detached"),
+                                meta=meta)
+                break
+            except Exception as e:
+                # get_if_exists creation race: another process registered the
+                # name between our lookup and create (the GCS rejects
+                # duplicates, ref: gcs_actor_manager.cc name registry). Adopt
+                # the winner — or, if the winner died, retry the create (the
+                # GCS frees names held by DEAD actors).
+                if not (name and opts.get("get_if_exists")
+                        and "already taken" in str(e)
+                        and _time.monotonic() < deadline):
+                    raise
+                existing = _try_get_actor(rt, name, opts.get("namespace"))
+                if existing is not None:
+                    return existing
+                _time.sleep(0.01)
         handle = ActorHandle(actor_id, max_task_retries=max_task_retries,
                              description=self._cls.__name__)
         handle._ready_ref = ObjectRef(spec.return_ids()[0])

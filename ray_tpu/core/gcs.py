@@ -181,14 +181,16 @@ class Gcs:
 
     def register_actor(self, info: ActorInfo) -> None:
         with self._lock:
-            self._actors[info.actor_id] = info
             if info.name:
                 key = (info.namespace, info.name)
                 if key in self._named_actors:
                     existing = self._actors.get(self._named_actors[key])
                     if existing and existing.state != ActorState.DEAD:
+                        # checked before inserting the record so a rejected
+                        # registration leaves no orphan actor entry
                         raise ValueError(f"Actor name {info.name!r} already taken")
                 self._named_actors[key] = info.actor_id
+            self._actors[info.actor_id] = info
         self.pubsub.publish("actor", (info.actor_id, info.state))
 
     def set_actor_state(self, actor_id: ActorId, state: ActorState,

@@ -534,13 +534,15 @@ class DriverRuntime:
         if spec.task_type == TaskType.ACTOR_TASK:
             rec = self._actors.get(spec.actor_id)
             info = self.gcs.get_actor(spec.actor_id)
-            if rec is not None and info is not None and spec.max_retries != 0 \
+            if rec is not None and info is not None \
                     and info.state != ActorState.DEAD:
-                if spec.max_retries > 0:
-                    spec.max_retries -= 1  # consume one retry per requeue
-                with rec.lock:
-                    rec.queued.insert(0, spec)
-                return
+                # single retry budget: TaskManager's retries_left (registered
+                # from max_task_retries) — not a second in-spec counter
+                retry = self.task_manager.try_retry(spec.task_id)
+                if retry is not None:
+                    with rec.lock:
+                        rec.queued.insert(0, retry)
+                    return
             err = exc.ActorDiedError(
                 f"Actor {spec.actor_id.hex()[:8]} died while running "
                 f"{spec.description}")
@@ -651,7 +653,12 @@ class DriverRuntime:
                 f"Actor {spec.actor_id.hex()[:8]} is dead: {dead_cause}"))
             return
         if node is None or not node.alive:
-            self.on_worker_crashed(spec, rec.node_id)
+            # same node-death window as in _flush_actor_queue: park, don't
+            # burn a retry — the actor FSM decides restart vs DEAD.
+            with rec.lock:
+                rec.seq -= 1
+                rec.queued.insert(0, spec)
+                rec.worker = None
             return
         node.push_task(worker, spec)
 
@@ -674,8 +681,14 @@ class DriverRuntime:
                 node = self.nodes.get(rec.node_id)
                 worker = rec.worker
             if node is None or not node.alive:
-                self.on_worker_crashed(spec, rec.node_id)
-                continue
+                # node-death window (node dead, actor FSM not yet notified):
+                # park the task and stop — no retry consumed, no busy-spin.
+                # The restart (or DEAD transition) re-drives this queue.
+                with rec.lock:
+                    rec.seq -= 1
+                    rec.queued.insert(0, spec)
+                    rec.worker = None
+                break
             node.push_task(worker, spec)
         # a task may have been appended after the final lock release — if the
         # queue is non-empty and the actor is alive, a new flush is required

@@ -278,6 +278,13 @@ def main() -> None:
     parser.add_argument("--node-id", required=True)
     args = parser.parse_args()
 
+    # SIGUSR1 dumps all thread stacks to stderr — the debugging hook for
+    # "worker looks wedged" (ref: the reference's ray stack CLI).
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+
     worker_id = WorkerId.from_hex(args.worker_id)
     try:
         channel = connect(args.address, authkey=bytes.fromhex(args.authkey),

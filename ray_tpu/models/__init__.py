@@ -1,0 +1,20 @@
+"""ray_tpu.models — model families used by the Train/Serve/RLlib layers
+and the benchmark configs (BASELINE.md north stars: GPT-2, ResNet-18/CIFAR,
+ViT-B/16, Llama-2-7B, PPO nets).
+
+Design: plain-pytree functional models (init/apply pairs), parameters
+stacked over layers and iterated with lax.scan (one compiled block instead
+of L unrolled ones), logical-axis annotations consumed by
+ray_tpu.parallel.mesh.AxisRules for dp/fsdp/tp/sp sharding, bf16 compute
+with f32 master dtypes chosen per-config.
+"""
+from .gpt import GPT, GPTConfig
+from .llama import Llama, LlamaConfig
+from .resnet import ResNet, ResNetConfig
+from .vit import ViT, ViTConfig
+from .mlp import MLP, MLPConfig
+
+__all__ = [
+    "GPT", "GPTConfig", "Llama", "LlamaConfig", "ResNet", "ResNetConfig",
+    "ViT", "ViTConfig", "MLP", "MLPConfig",
+]

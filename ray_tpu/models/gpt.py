@@ -1,0 +1,212 @@
+"""GPT-2 family — the flagship model (BASELINE.md north star: >50% MFU).
+
+TPU-first choices:
+- layer params stacked on a leading axis and driven by lax.scan: one
+  compiled transformer block regardless of depth (fast compile, XLA
+  pipelines the scan).
+- vocab padded to a multiple of 128 so the embedding/LM-head matmuls tile
+  the MXU exactly.
+- flash-attention Pallas kernel on the hot path; jax.checkpoint around the
+  block for rematerialisation.
+- every parameter carries a logical-axis tuple (see `logical_axes`) that
+  AxisRules maps to the dp/fsdp/tp/sp mesh — pure data parallel, ZeRO-3
+  style fsdp, megatron tp, and sequence parallel all fall out of the same
+  annotations.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import cross_entropy_loss, flash_attention, gelu, layernorm
+from ..ops.ring_attention import ring_attention
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    max_seq: int = 1024
+    dropout: float = 0.0          # inference/bench default; train sets >0
+    dtype: Any = jnp.bfloat16     # activation/compute dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    use_flash: bool = True
+    seq_axis: Optional[str] = None  # set to "sp" to use ring attention
+
+    @property
+    def padded_vocab(self) -> int:
+        return _round_up(self.vocab_size, 128)
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_head == 0
+        return self.d_model // self.n_head
+
+    # ---- presets ----------------------------------------------------------
+    @staticmethod
+    def tiny(**kw) -> "GPTConfig":
+        return GPTConfig(vocab_size=512, n_layer=2, n_head=2, d_model=64,
+                         d_ff=256, max_seq=128, **kw)
+
+    @staticmethod
+    def small(**kw) -> "GPTConfig":      # GPT-2 124M
+        return GPTConfig(**kw)
+
+    @staticmethod
+    def medium(**kw) -> "GPTConfig":     # 350M
+        return GPTConfig(n_layer=24, n_head=16, d_model=1024, d_ff=4096, **kw)
+
+    @staticmethod
+    def large(**kw) -> "GPTConfig":      # 774M
+        return GPTConfig(n_layer=36, n_head=20, d_model=1280, d_ff=5120, **kw)
+
+    @staticmethod
+    def xl(**kw) -> "GPTConfig":         # 1.5B
+        return GPTConfig(n_layer=48, n_head=25, d_model=1600, d_ff=6400, **kw)
+
+
+class GPT:
+    """init/apply pair. Params are a flat dict of stacked arrays."""
+
+    def __init__(self, config: GPTConfig):
+        self.config = config
+
+    # ---- parameters --------------------------------------------------------
+
+    def init(self, rng: jax.Array) -> Dict[str, jax.Array]:
+        c = self.config
+        pd = c.param_dtype
+        L, D, F, V, S = c.n_layer, c.d_model, c.d_ff, c.padded_vocab, c.max_seq
+        k = jax.random.split(rng, 8)
+        std = 0.02
+        # residual-path projections scaled per GPT-2 (1/sqrt(2L))
+        res_std = std / math.sqrt(2 * L)
+        return {
+            "wte": jax.random.normal(k[0], (V, D), pd) * std,
+            "wpe": jax.random.normal(k[1], (S, D), pd) * std,
+            "ln1_g": jnp.ones((L, D), pd), "ln1_b": jnp.zeros((L, D), pd),
+            "w_qkv": jax.random.normal(k[2], (L, D, 3 * D), pd) * std,
+            "b_qkv": jnp.zeros((L, 3 * D), pd),
+            "w_proj": jax.random.normal(k[3], (L, D, D), pd) * res_std,
+            "b_proj": jnp.zeros((L, D), pd),
+            "ln2_g": jnp.ones((L, D), pd), "ln2_b": jnp.zeros((L, D), pd),
+            "w_fc": jax.random.normal(k[4], (L, D, F), pd) * std,
+            "b_fc": jnp.zeros((L, F), pd),
+            "w_out": jax.random.normal(k[5], (L, F, D), pd) * res_std,
+            "b_out": jnp.zeros((L, D), pd),
+            "lnf_g": jnp.ones((D,), pd), "lnf_b": jnp.zeros((D,), pd),
+        }
+
+    @staticmethod
+    def logical_axes() -> Dict[str, Tuple[Optional[str], ...]]:
+        """Per-param logical axes; leading layer-stack axis is unsharded
+        (scan carries it). Mapped to mesh axes by AxisRules."""
+        return {
+            "wte": ("vocab", "embed"),
+            "wpe": (None, "embed"),
+            "ln1_g": (None, None), "ln1_b": (None, None),
+            "w_qkv": (None, "embed", "heads"),
+            "b_qkv": (None, "heads"),
+            "w_proj": (None, "heads", "embed"),
+            "b_proj": (None, "embed"),
+            "ln2_g": (None, None), "ln2_b": (None, None),
+            "w_fc": (None, "embed", "mlp"),
+            "b_fc": (None, "mlp"),
+            "w_out": (None, "mlp", "embed"),
+            "b_out": (None, "embed"),
+            "lnf_g": (None,), "lnf_b": (None,),
+        }
+
+    def param_shardings(self, mesh, rules=None):
+        from ..parallel.mesh import AxisRules
+        from jax.sharding import NamedSharding
+
+        rules = rules or AxisRules()
+        return {
+            name: NamedSharding(mesh, rules.mesh_axes(axes))
+            for name, axes in self.logical_axes().items()
+        }
+
+    def num_params(self) -> int:
+        shapes = jax.eval_shape(self.init, jax.random.PRNGKey(0))
+        return sum(int(math.prod(s.shape)) for s in jax.tree.leaves(shapes))
+
+    def flops_per_token(self) -> int:
+        """Forward+backward matmul FLOPs per token (6N rule + attention)."""
+        c = self.config
+        n = self.num_params()
+        attn = 12 * c.n_layer * c.d_model * c.max_seq  # 6 * 2 * L * D * S (causal half)
+        return 6 * n + attn
+
+    # ---- forward -----------------------------------------------------------
+
+    def _block(self, x: jax.Array, lp: Dict[str, jax.Array],
+               rng: Optional[jax.Array]) -> jax.Array:
+        c = self.config
+        B, S, D = x.shape
+        H, hd = c.n_head, c.head_dim
+        h = layernorm(x, lp["ln1_g"], lp["ln1_b"])
+        qkv = (h @ lp["w_qkv"].astype(c.dtype)) + lp["b_qkv"].astype(c.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, S, H, hd)
+        k = k.reshape(B, S, H, hd)
+        v = v.reshape(B, S, H, hd)
+        if c.seq_axis is not None:
+            attn = ring_attention(q, k, v, axis_name=c.seq_axis, causal=True)
+        elif c.use_flash:
+            attn = flash_attention(q, k, v, causal=True)
+        else:
+            from ..ops import mha_reference
+
+            attn = mha_reference(q, k, v, causal=True)
+        attn = attn.reshape(B, S, D)
+        x = x + (attn @ lp["w_proj"].astype(c.dtype)) + lp["b_proj"].astype(c.dtype)
+        h = layernorm(x, lp["ln2_g"], lp["ln2_b"])
+        h = gelu((h @ lp["w_fc"].astype(c.dtype)) + lp["b_fc"].astype(c.dtype))
+        x = x + (h @ lp["w_out"].astype(c.dtype)) + lp["b_out"].astype(c.dtype)
+        return x
+
+    def apply(self, params: Dict[str, jax.Array], tokens: jax.Array,
+              positions: Optional[jax.Array] = None,
+              rng: Optional[jax.Array] = None) -> jax.Array:
+        """tokens [B, S] int32 -> logits [B, S, padded_vocab] (f32)."""
+        c = self.config
+        B, S = tokens.shape
+        if positions is None:
+            positions = jnp.arange(S)[None, :]
+        x = params["wte"].astype(c.dtype)[tokens] \
+            + params["wpe"].astype(c.dtype)[positions]
+
+        layer_params = {k: v for k, v in params.items()
+                        if v.ndim >= 1 and k not in ("wte", "wpe", "lnf_g", "lnf_b")}
+
+        def block_fn(x, lp):
+            return self._block(x, lp, rng), None
+
+        if c.remat:
+            block_fn = jax.checkpoint(block_fn)  # remat: HBM for FLOPs
+
+        x, _ = jax.lax.scan(block_fn, x, layer_params)
+        x = layernorm(x, params["lnf_g"], params["lnf_b"])
+        # tied LM head; logits in f32 for a stable softmax/loss
+        logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
+                            params["wte"].astype(jnp.float32))
+        return logits
+
+    def loss(self, params: Dict[str, jax.Array], tokens: jax.Array,
+             targets: jax.Array, rng: Optional[jax.Array] = None) -> jax.Array:
+        logits = self.apply(params, tokens, rng=rng)
+        return cross_entropy_loss(logits, targets)

@@ -1,0 +1,50 @@
+"""Small MLP — RL policy/value nets and test fixtures."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int
+    hidden: Tuple[int, ...] = (256, 256)
+    out_dim: int = 1
+    activation: str = "tanh"
+    dtype: Any = jnp.float32
+
+
+_ACTS = {"tanh": jnp.tanh, "relu": jax.nn.relu, "gelu": jax.nn.gelu,
+         "silu": jax.nn.silu}
+
+
+class MLP:
+    def __init__(self, config: MLPConfig):
+        self.config = config
+
+    def init(self, rng: jax.Array) -> Dict[str, jax.Array]:
+        c = self.config
+        dims = (c.in_dim,) + tuple(c.hidden) + (c.out_dim,)
+        params = {}
+        keys = jax.random.split(rng, len(dims))
+        for i, (a, b) in enumerate(zip(dims[:-1], dims[1:])):
+            # orthogonal init — the PPO-stable choice
+            w = jax.random.orthogonal(keys[i], max(a, b))[:a, :b]
+            scale = 0.01 if i == len(dims) - 2 else (2.0 ** 0.5)
+            params[f"w{i}"] = (w * scale).astype(c.dtype)
+            params[f"b{i}"] = jnp.zeros((b,), c.dtype)
+        return params
+
+    def apply(self, params: Dict[str, jax.Array], x: jax.Array) -> jax.Array:
+        c = self.config
+        act = _ACTS[c.activation]
+        n = len(c.hidden) + 1
+        h = x.astype(c.dtype)
+        for i in range(n):
+            h = h @ params[f"w{i}"] + params[f"b{i}"]
+            if i < n - 1:
+                h = act(h)
+        return h

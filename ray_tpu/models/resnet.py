@@ -1,0 +1,139 @@
+"""ResNet for CIFAR/ImageNet (BASELINE.md: ResNet-18/CIFAR-10 2-worker ref).
+
+Convs map straight onto the MXU via lax.conv_general_dilated (XLA tiles
+them like matmuls); batch-norm statistics in f32. Functional init/apply
+with explicit batch-stat state (train step threads it through)."""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 10
+    stage_sizes: Tuple[int, ...] = (2, 2, 2, 2)   # resnet-18
+    width: int = 64
+    small_inputs: bool = True   # CIFAR stem (3x3, no maxpool)
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @staticmethod
+    def resnet18_cifar(**kw) -> "ResNetConfig":
+        return ResNetConfig(**kw)
+
+    @staticmethod
+    def resnet50_imagenet(**kw) -> "ResNetConfig":
+        return ResNetConfig(stage_sizes=(3, 4, 6, 3), small_inputs=False,
+                            num_classes=1000, **kw)
+
+
+def _conv_init(key, shape, dtype):
+    fan_in = shape[0] * shape[1] * shape[2]
+    return jax.random.normal(key, shape, dtype) * math.sqrt(2.0 / fan_in)
+
+
+class ResNet:
+    """Basic-block ResNet. Params/state: nested dicts keyed by layer path."""
+
+    def __init__(self, config: ResNetConfig):
+        self.config = config
+
+    def init(self, rng: jax.Array) -> Tuple[Dict, Dict]:
+        c = self.config
+        pd = c.param_dtype
+        params: Dict[str, Any] = {}
+        state: Dict[str, Any] = {}
+        keys = iter(jax.random.split(rng, 256))
+
+        def bn(path, ch):
+            params[path + "/g"] = jnp.ones((ch,), pd)
+            params[path + "/b"] = jnp.zeros((ch,), pd)
+            state[path + "/mean"] = jnp.zeros((ch,), jnp.float32)
+            state[path + "/var"] = jnp.ones((ch,), jnp.float32)
+
+        stem = 3 if c.small_inputs else 7
+        params["stem/w"] = _conv_init(next(keys), (stem, stem, 3, c.width), pd)
+        bn("stem/bn", c.width)
+        ch_in = c.width
+        for si, blocks in enumerate(c.stage_sizes):
+            ch = c.width * (2 ** si)
+            for bi in range(blocks):
+                p = f"s{si}b{bi}"
+                stride = 2 if (bi == 0 and si > 0) else 1
+                params[p + "/c1"] = _conv_init(next(keys), (3, 3, ch_in, ch), pd)
+                bn(p + "/bn1", ch)
+                params[p + "/c2"] = _conv_init(next(keys), (3, 3, ch, ch), pd)
+                bn(p + "/bn2", ch)
+                if stride != 1 or ch_in != ch:
+                    params[p + "/proj"] = _conv_init(next(keys), (1, 1, ch_in, ch), pd)
+                    bn(p + "/bnp", ch)
+                ch_in = ch
+        params["head/w"] = jax.random.normal(
+            next(keys), (ch_in, c.num_classes), pd) * 0.01
+        params["head/b"] = jnp.zeros((c.num_classes,), pd)
+        return params, state
+
+    def _bn(self, x, params, state, path, train: bool, updates):
+        g = params[path + "/g"].astype(jnp.float32)
+        b = params[path + "/b"].astype(jnp.float32)
+        xf = x.astype(jnp.float32)
+        if train:
+            mu = jnp.mean(xf, axis=(0, 1, 2))
+            var = jnp.var(xf, axis=(0, 1, 2))
+            m = 0.9
+            updates[path + "/mean"] = m * state[path + "/mean"] + (1 - m) * mu
+            updates[path + "/var"] = m * state[path + "/var"] + (1 - m) * var
+        else:
+            mu = state[path + "/mean"]
+            var = state[path + "/var"]
+        y = (xf - mu) * jax.lax.rsqrt(var + 1e-5) * g + b
+        return y.astype(x.dtype)
+
+    def _conv(self, x, w, stride=1):
+        return jax.lax.conv_general_dilated(
+            x, w.astype(x.dtype), (stride, stride), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def apply(self, params: Dict, state: Dict, images: jax.Array,
+              train: bool = False) -> Tuple[jax.Array, Dict]:
+        """images [B, H, W, 3] -> (logits [B, classes], new_state)."""
+        c = self.config
+        x = images.astype(c.dtype)
+        updates = dict(state)
+        x = self._conv(x, params["stem/w"], 1 if c.small_inputs else 2)
+        x = self._bn(x, params, state, "stem/bn", train, updates)
+        x = jax.nn.relu(x)
+        if not c.small_inputs:
+            x = jax.lax.reduce_window(
+                x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
+        ch_in = c.width
+        for si, blocks in enumerate(c.stage_sizes):
+            ch = c.width * (2 ** si)
+            for bi in range(blocks):
+                p = f"s{si}b{bi}"
+                stride = 2 if (bi == 0 and si > 0) else 1
+                res = x
+                y = self._conv(x, params[p + "/c1"], stride)
+                y = jax.nn.relu(self._bn(y, params, state, p + "/bn1", train, updates))
+                y = self._conv(y, params[p + "/c2"], 1)
+                y = self._bn(y, params, state, p + "/bn2", train, updates)
+                if p + "/proj" in params:
+                    res = self._conv(res, params[p + "/proj"], stride)
+                    res = self._bn(res, params, state, p + "/bnp", train, updates)
+                x = jax.nn.relu(y + res)
+                ch_in = ch
+        x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+        logits = x @ params["head/w"].astype(jnp.float32) \
+            + params["head/b"].astype(jnp.float32)
+        return logits, updates
+
+    def loss(self, params, state, images, labels, train: bool = True):
+        logits, new_state = self.apply(params, state, images, train=train)
+        onehot = jax.nn.one_hot(labels, self.config.num_classes)
+        loss = -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+        return loss, new_state

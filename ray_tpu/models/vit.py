@@ -1,0 +1,157 @@
+"""ViT (BASELINE.md: ViT-B/16 PBT sweep config).
+
+Patchify = one big reshaped matmul (MXU-friendly); encoder reuses the
+scan-over-layers transformer pattern from gpt.py with bidirectional flash
+attention."""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import flash_attention, gelu, layernorm, mha_reference
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    use_flash: bool = True
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_head
+
+    @staticmethod
+    def tiny(**kw) -> "ViTConfig":
+        return ViTConfig(image_size=32, patch_size=8, num_classes=10,
+                         n_layer=2, n_head=2, d_model=64, d_ff=128, **kw)
+
+    @staticmethod
+    def b16(**kw) -> "ViTConfig":
+        return ViTConfig(**kw)
+
+
+class ViT:
+    def __init__(self, config: ViTConfig):
+        self.config = config
+
+    def init(self, rng: jax.Array) -> Dict[str, jax.Array]:
+        c = self.config
+        pd = c.param_dtype
+        L, D, F = c.n_layer, c.d_model, c.d_ff
+        P = c.patch_size * c.patch_size * 3
+        k = jax.random.split(rng, 8)
+        std = 0.02
+        return {
+            "patch/w": jax.random.normal(k[0], (P, D), pd) * math.sqrt(1.0 / P),
+            "patch/b": jnp.zeros((D,), pd),
+            "cls": jnp.zeros((1, 1, D), pd),
+            "pos": jax.random.normal(k[1], (1, c.num_patches + 1, D), pd) * std,
+            "ln1_g": jnp.ones((L, D), pd), "ln1_b": jnp.zeros((L, D), pd),
+            "w_qkv": jax.random.normal(k[2], (L, D, 3 * D), pd) * std,
+            "b_qkv": jnp.zeros((L, 3 * D), pd),
+            "w_proj": jax.random.normal(k[3], (L, D, D), pd) * std / math.sqrt(2 * L),
+            "b_proj": jnp.zeros((L, D), pd),
+            "ln2_g": jnp.ones((L, D), pd), "ln2_b": jnp.zeros((L, D), pd),
+            "w_fc": jax.random.normal(k[4], (L, D, F), pd) * std,
+            "b_fc": jnp.zeros((L, F), pd),
+            "w_out": jax.random.normal(k[5], (L, F, D), pd) * std / math.sqrt(2 * L),
+            "b_out": jnp.zeros((L, D), pd),
+            "lnf_g": jnp.ones((D,), pd), "lnf_b": jnp.zeros((D,), pd),
+            "head/w": jnp.zeros((D, c.num_classes), pd),
+            "head/b": jnp.zeros((c.num_classes,), pd),
+        }
+
+    @staticmethod
+    def logical_axes() -> Dict[str, Tuple[Optional[str], ...]]:
+        return {
+            "patch/w": (None, "embed"), "patch/b": ("embed",),
+            "cls": (None, None, "embed"), "pos": (None, None, "embed"),
+            "ln1_g": (None, None), "ln1_b": (None, None),
+            "w_qkv": (None, "embed", "heads"), "b_qkv": (None, "heads"),
+            "w_proj": (None, "heads", "embed"), "b_proj": (None, "embed"),
+            "ln2_g": (None, None), "ln2_b": (None, None),
+            "w_fc": (None, "embed", "mlp"), "b_fc": (None, "mlp"),
+            "w_out": (None, "mlp", "embed"), "b_out": (None, "embed"),
+            "lnf_g": (None,), "lnf_b": (None,),
+            "head/w": ("embed", None), "head/b": (None,),
+        }
+
+    def param_shardings(self, mesh, rules=None):
+        from jax.sharding import NamedSharding
+        from ..parallel.mesh import AxisRules
+
+        rules = rules or AxisRules()
+        return {n: NamedSharding(mesh, rules.mesh_axes(a))
+                for n, a in self.logical_axes().items()}
+
+    def _patchify(self, images: jax.Array) -> jax.Array:
+        c = self.config
+        B, H, W, C = images.shape
+        p = c.patch_size
+        x = images.reshape(B, H // p, p, W // p, p, C)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(B, (H // p) * (W // p), p * p * C)
+        return x
+
+    def _block(self, x, lp):
+        c = self.config
+        B, S, D = x.shape
+        H, hd = c.n_head, c.head_dim
+        h = layernorm(x, lp["ln1_g"], lp["ln1_b"])
+        qkv = (h @ lp["w_qkv"].astype(c.dtype)) + lp["b_qkv"].astype(c.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (t.reshape(B, S, H, hd) for t in (q, k, v))
+        if c.use_flash and S % 8 == 0:
+            attn = flash_attention(q, k, v, causal=False,
+                                   block_q=min(128, S), block_k=min(128, S))
+        else:
+            attn = mha_reference(q, k, v, causal=False)
+        x = x + (attn.reshape(B, S, D) @ lp["w_proj"].astype(c.dtype)) \
+            + lp["b_proj"].astype(c.dtype)
+        h = layernorm(x, lp["ln2_g"], lp["ln2_b"])
+        h = gelu((h @ lp["w_fc"].astype(c.dtype)) + lp["b_fc"].astype(c.dtype))
+        return x + (h @ lp["w_out"].astype(c.dtype)) + lp["b_out"].astype(c.dtype)
+
+    def apply(self, params: Dict, images: jax.Array) -> jax.Array:
+        c = self.config
+        x = self._patchify(images.astype(c.dtype))
+        x = x @ params["patch/w"].astype(c.dtype) + params["patch/b"].astype(c.dtype)
+        B = x.shape[0]
+        cls = jnp.broadcast_to(params["cls"].astype(c.dtype), (B, 1, c.d_model))
+        x = jnp.concatenate([cls, x], axis=1) + params["pos"].astype(c.dtype)
+        stacked = {n: params[n] for n, a in self.logical_axes().items()
+                   if len(a) > 1 and a[0] is None and n in
+                   ("ln1_g", "ln1_b", "w_qkv", "b_qkv", "w_proj", "b_proj",
+                    "ln2_g", "ln2_b", "w_fc", "b_fc", "w_out", "b_out")}
+
+        def block_fn(x, lp):
+            return self._block(x, lp), None
+
+        if c.remat:
+            block_fn = jax.checkpoint(block_fn)
+        x, _ = jax.lax.scan(block_fn, x, stacked)
+        x = layernorm(x, params["lnf_g"], params["lnf_b"])
+        cls_tok = x[:, 0].astype(jnp.float32)
+        return cls_tok @ params["head/w"].astype(jnp.float32) \
+            + params["head/b"].astype(jnp.float32)
+
+    def loss(self, params, images, labels):
+        logits = self.apply(params, images)
+        onehot = jax.nn.one_hot(labels, self.config.num_classes)
+        return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))

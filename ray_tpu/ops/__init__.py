@@ -1,0 +1,26 @@
+"""ray_tpu.ops — TPU kernels (Pallas) and fused numerics.
+
+The compute-hot path of the framework. The reference has no first-party
+kernels (its CUDA appears only through torch/NCCL deps — SURVEY.md §2
+legend); for a TPU-native framework the hot ops are first-party:
+
+- flash_attention: tiled online-softmax attention on the MXU (Pallas).
+- ring_attention: context-parallel attention over the `sp` mesh axis —
+  K/V blocks rotate the ring via ppermute while compute overlaps.
+- fused layers: rmsnorm/layernorm/rope/cross-entropy shaped so XLA fuses
+  them into adjacent matmuls.
+
+Everything here runs in Pallas interpret mode on CPU (tests) and compiled
+on TPU.
+"""
+from .attention import mha_reference
+from .flash_attention import flash_attention
+from .ring_attention import ring_attention
+from .layers import (cross_entropy_loss, gelu, layernorm, rmsnorm,
+                     rope_cache, apply_rope)
+
+__all__ = [
+    "flash_attention", "ring_attention", "mha_reference",
+    "rmsnorm", "layernorm", "gelu", "rope_cache", "apply_rope",
+    "cross_entropy_loss",
+]

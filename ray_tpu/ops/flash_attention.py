@@ -1,0 +1,150 @@
+"""Flash attention for TPU (Pallas).
+
+Tiled online-softmax attention: Q blocks stream over the grid; for each Q
+block the kernel walks K/V blocks with a fori_loop keeping running max and
+normalizer in f32 (VPU) and accumulating PV on the MXU. bf16 in, f32
+accumulate — the standard TPU recipe (pallas_guide.md: MXU matmuls with
+preferred_element_type; min tile (16,128) for bf16).
+
+Forward is a Pallas kernel; backward is a custom VJP that recomputes
+attention blockwise with jnp (XLA fuses the recompute into the dq/dk/dv
+matmuls — rematerialisation trades FLOPs for HBM, the right default on
+TPU). Causal masking skips fully-masked K blocks via the loop upper bound,
+halving FLOPs for autoregressive models.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .attention import mha_reference
+
+_NEG_INF = -1e30
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float, causal: bool,
+                block_q: int, block_k: int, seq_k: int):
+    """Grid: (batch*heads, num_q_blocks). Per call: q_ref (block_q, d);
+    k_ref/v_ref (seq_k, d) — whole K/V for this (batch, head) in VMEM."""
+    qi = pl.program_id(1)
+    q = q_ref[...].astype(jnp.float32) * sm_scale
+
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+
+    if causal:
+        # K blocks strictly beyond this Q block's diagonal contribute nothing.
+        num_kb = (qi + 1) * block_q // block_k + ((qi + 1) * block_q % block_k != 0)
+    else:
+        num_kb = seq_k // block_k
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+    o_ref[...] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k):
+    batch, seq_q, heads, d = q.shape
+    seq_k = k.shape[1]
+    block_q = min(block_q, seq_q)
+    block_k = min(block_k, seq_k)
+    assert seq_q % block_q == 0 and seq_k % block_k == 0, (
+        f"seq ({seq_q},{seq_k}) must divide blocks ({block_q},{block_k})")
+    # fold batch and heads into one grid axis; move heads out of the way:
+    # [B,S,H,D] -> [B*H, S, D]
+    qr = q.transpose(0, 2, 1, 3).reshape(batch * heads, seq_q, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(batch * heads, seq_k, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(batch * heads, seq_k, d)
+
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, seq_k=seq_k)
+    grid = (batch * heads, seq_q // block_q)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((batch * heads, seq_q, d), q.dtype),
+        interpret=_use_interpret(),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * batch * heads * seq_q * seq_k * d // (2 if causal else 1),
+            bytes_accessed=(qr.size + kr.size + vr.size) * q.dtype.itemsize,
+            transcendentals=batch * heads * seq_q * seq_k,
+        ),
+    )(qr, kr, vr)
+    return out.reshape(batch, heads, seq_q, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k):
+    return _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k)
+
+
+def _flash_vjp_fwd(q, k, v, sm_scale, causal, block_q, block_k):
+    out = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, res, g):
+    # Rematerialised backward: recompute probabilities with the reference
+    # formulation and let XLA fuse. O(S^2) memory is avoided by checkpointing
+    # at the layer level (jax.checkpoint in the model); for very long S the
+    # ring_attention path tiles the backward too.
+    q, k, v = res
+
+    def f(q, k, v):
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+
+    _, vjp = jax.vjp(f, q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128) -> jax.Array:
+    """Flash attention. q/k/v: [batch, seq, heads, head_dim] -> same shape.
+
+    head_dim should be a multiple of 128 for MXU efficiency (pads are the
+    caller's job — model dims are chosen MXU-friendly instead)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if q.shape[1] < 8:  # tiny decode steps: kernel launch not worth it
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    return _flash(q, k, v, sm_scale, causal, block_q, block_k)

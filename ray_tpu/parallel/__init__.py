@@ -1,0 +1,38 @@
+"""ray_tpu.parallel — the mesh/collective layer.
+
+This package replaces the reference's NCCL/Gloo collective stack
+(ref: python/ray/util/collective/collective.py:40 GroupManager,
+collective_group/nccl_collective_group.py) and torch process-group
+bootstrap (ref: python/ray/train/torch/config.py:69
+_setup_torch_process_group) with TPU-native equivalents:
+
+- `MeshSpec` / `build_mesh`: declarative device-mesh construction over
+  dp/fsdp/tp/sp/ep/pp axes (jax.sharding.Mesh), on real TPU slices or
+  virtual CPU devices for tests.
+- logical-axis sharding rules (`AxisRules`, `logical_to_mesh`,
+  `shard_constraint`): annotate pytrees once, let pjit/XLA insert the
+  ICI collectives.
+- `collective`: an explicit actor-to-actor collective API with the same
+  verbs as the reference (allreduce/allgather/reducescatter/broadcast/
+  send/recv), implemented over the object store for host tensors and
+  over XLA collectives (psum/all_gather/ppermute) inside jit.
+- `MeshGroup`: gang formation — hands each Train worker its mesh slice
+  (the analog of TorchConfig handing each worker a process group).
+"""
+from .mesh import (AxisRules, MeshSpec, build_mesh, default_axis_rules,
+                   local_mesh, mesh_shape_for, named_sharding,
+                   shard_constraint, logical_to_mesh, virtual_mesh)
+from .collective import (allgather, allreduce, barrier, broadcast,
+                         create_collective_group, destroy_collective_group,
+                         get_group, recv, reduce, reducescatter, send)
+from .mesh_group import MeshGroup, MeshWorkerMixin
+
+__all__ = [
+    "MeshSpec", "build_mesh", "virtual_mesh", "local_mesh", "named_sharding",
+    "shard_constraint", "logical_to_mesh", "AxisRules", "default_axis_rules",
+    "mesh_shape_for",
+    "create_collective_group", "destroy_collective_group", "get_group",
+    "allreduce", "allgather", "reducescatter", "broadcast", "reduce",
+    "send", "recv", "barrier",
+    "MeshGroup", "MeshWorkerMixin",
+]

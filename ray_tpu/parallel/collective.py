@@ -1,0 +1,208 @@
+"""Explicit collectives between actors/tasks.
+
+Host-side equivalent of the reference's `ray.util.collective`
+(ref: python/ray/util/collective/collective.py:258-615 — allreduce/reduce/
+broadcast/allgather/reducescatter/send/recv; GroupManager :40; rendezvous
+via a named store actor, collective_group/nccl_util + gloo).
+
+TPU-native stance: *device* collectives belong to XLA (psum/all_gather/
+ppermute over ICI inside jit — see ray_tpu.parallel.mesh); this module is
+the host/DCN plane used for control tensors, rollout-weight broadcast, and
+CPU-side aggregation, implemented over the object store with a named
+rendezvous actor instead of NCCL rings.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+_REDUCE_OPS = {
+    "sum": lambda xs: _tree_reduce(xs, np.add),
+    "product": lambda xs: _tree_reduce(xs, np.multiply),
+    "max": lambda xs: _tree_reduce(xs, np.maximum),
+    "min": lambda xs: _tree_reduce(xs, np.minimum),
+}
+
+
+def _tree_reduce(xs: List[Any], op) -> Any:
+    out = xs[0]
+    for x in xs[1:]:
+        out = op(out, x)
+    return out
+
+
+class _CollectiveStore:
+    """Named rendezvous actor: one per group. Ranks deposit contributions
+    keyed by (op sequence number, rank); readers block-poll until the op's
+    row is complete. Mirrors the reference's NamedActor store rendezvous
+    (ref: util/collective/collective_group/base_collective_group.py)."""
+
+    def __init__(self, world_size: int):
+        self._world = world_size
+        self._slots: Dict[int, Dict[int, Any]] = {}
+        self._p2p: Dict[tuple, Any] = {}
+
+    def put(self, seq: int, rank: int, value):
+        self._slots.setdefault(seq, {})[rank] = value
+        return True
+
+    def gather(self, seq: int) -> Optional[List[Any]]:
+        row = self._slots.get(seq)
+        if row is None or len(row) < self._world:
+            return None
+        return [row[r] for r in range(self._world)]
+
+    def done(self, seq: int, rank: int):
+        """Each rank acks after consuming; last ack frees the row."""
+        row = self._slots.get(seq)
+        if row is not None:
+            acks = self._slots.setdefault(-seq - 1, {})
+            acks[rank] = True
+            if len(acks) >= self._world:
+                self._slots.pop(seq, None)
+                self._slots.pop(-seq - 1, None)
+        return True
+
+    def p2p_put(self, seq: int, src: int, dst: int, value):
+        self._p2p[(seq, src, dst)] = value
+        return True
+
+    def p2p_take(self, seq: int, src: int, dst: int):
+        return self._p2p.pop((seq, src, dst), _MISSING)
+
+
+_MISSING = "__rtpu_missing__"
+# Process-global registry: a worker process holds one rank per group, but
+# actor tasks may execute on different threads (executor pool), so the
+# registry must not be thread-local.
+_GROUPS: Dict[str, "CollectiveGroup"] = {}
+
+
+class CollectiveGroup:
+    """Per-process view of a collective group (rank-local state)."""
+
+    def __init__(self, group_name: str, world_size: int, rank: int):
+        self.group_name = group_name
+        self.world_size = world_size
+        self.rank = rank
+        self._seq = 0
+        store_cls = ray_tpu.remote(_CollectiveStore)
+        # num_cpus=0: the store is a pure rendezvous point and must schedule
+        # even on a fully-subscribed cluster (ranks hold all the CPUs while
+        # they block in _exchange).
+        self._store = store_cls.options(
+            name=f"rtpu_collective:{group_name}",
+            get_if_exists=True, lifetime="detached", num_cpus=0,
+            max_concurrency=max(8, world_size * 2),
+        ).remote(world_size)
+
+    def _next_seq(self) -> int:
+        self._seq += 1
+        return self._seq
+
+    def _exchange(self, value, timeout: float = 120.0) -> List[Any]:
+        seq = self._next_seq()
+        ray_tpu.get(self._store.put.remote(seq, self.rank, value))
+        deadline = time.monotonic() + timeout
+        delay = 0.0005
+        while True:
+            row = ray_tpu.get(self._store.gather.remote(seq))
+            if row is not None:
+                self._store.done.remote(seq, self.rank)
+                return row
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"collective {self.group_name} seq={seq} rank={self.rank} "
+                    f"timed out after {timeout}s")
+            time.sleep(delay)
+            delay = min(delay * 2, 0.05)
+
+
+def _groups() -> Dict[str, CollectiveGroup]:
+    return _GROUPS
+
+
+def create_collective_group(world_size: int, rank: int,
+                            group_name: str = "default",
+                            backend: str = "object_store") -> CollectiveGroup:
+    """Called by every participant (ref: collective.py:90 init_collective_group).
+    backend is accepted for API parity; only object_store exists (device
+    collectives are XLA's job)."""
+    g = CollectiveGroup(group_name, world_size, rank)
+    _groups()[group_name] = g
+    return g
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    _groups().pop(group_name, None)
+
+
+def get_group(group_name: str = "default") -> CollectiveGroup:
+    try:
+        return _groups()[group_name]
+    except KeyError:
+        raise ValueError(
+            f"Collective group {group_name!r} not initialized in this "
+            "process; call create_collective_group first") from None
+
+
+def allreduce(tensor, group_name: str = "default", op: str = "sum"):
+    g = get_group(group_name)
+    row = g._exchange(tensor)
+    return _REDUCE_OPS[op](row)
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
+           op: str = "sum"):
+    g = get_group(group_name)
+    row = g._exchange(tensor)
+    if g.rank == dst_rank:
+        return _REDUCE_OPS[op](row)
+    return tensor
+
+
+def allgather(tensor, group_name: str = "default") -> List[Any]:
+    return get_group(group_name)._exchange(tensor)
+
+
+def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
+    g = get_group(group_name)
+    row = g._exchange(tensor)
+    total = _REDUCE_OPS[op](row)
+    return np.array_split(np.asarray(total), g.world_size)[g.rank]
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    g = get_group(group_name)
+    row = g._exchange(tensor if g.rank == src_rank else None)
+    return row[src_rank]
+
+
+def barrier(group_name: str = "default") -> None:
+    get_group(group_name)._exchange(0)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default",
+         tag: int = 0) -> None:
+    g = get_group(group_name)
+    ray_tpu.get(g._store.p2p_put.remote(tag, g.rank, dst_rank, tensor))
+
+
+def recv(src_rank: int, group_name: str = "default", tag: int = 0,
+         timeout: float = 120.0):
+    g = get_group(group_name)
+    deadline = time.monotonic() + timeout
+    delay = 0.0005
+    while True:
+        v = ray_tpu.get(g._store.p2p_take.remote(tag, src_rank, g.rank))
+        if not (isinstance(v, str) and v == _MISSING):
+            return v
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"recv from rank {src_rank} timed out")
+        time.sleep(delay)
+        delay = min(delay * 2, 0.05)

@@ -1,0 +1,184 @@
+"""Device-mesh construction and sharding rules.
+
+TPU-native replacement for the reference's process-group bootstrap
+(ref: python/ray/train/torch/config.py:69 _setup_torch_process_group,
+python/ray/util/collective/collective.py:258-615). On TPU there is no
+per-tensor NCCL group: the unit of parallelism is a `jax.sharding.Mesh`
+over which pjit/shard_map place XLA collectives on ICI. This module owns:
+
+- `MeshSpec`: declarative parallelism degrees (dp/fsdp/tp/sp/ep/pp).
+- `build_mesh`: devices -> Mesh, preferring ICI-contiguous axis order.
+- logical axis rules: model code annotates pytrees with *logical* axes
+  ("batch", "embed", "heads", ...) which map to mesh axes here — the
+  flax `logical_axis_rules` idea, reimplemented standalone.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical mesh axis names, outermost (slowest/DCN-most) first.  Ordering
+# matters: jax lays devices out so the *last* axes are ICI-nearest, so we put
+# tensor/seq (latency-sensitive, every-layer collectives) last and dp/pp
+# (per-step collectives, DCN-tolerant) first.  This mirrors the scaling-book
+# recipe: data outermost, model innermost.
+MESH_AXES = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Parallelism degrees. -1 on exactly one axis means "fill with all
+    remaining devices" (like torch DeviceMesh / t5x partitioning)."""
+    dp: int = -1      # pure data parallel (replicated params)
+    fsdp: int = 1     # data parallel with sharded params (zero-3 style)
+    tp: int = 1       # tensor (megatron) parallel
+    sp: int = 1       # sequence/context parallel (ring attention axis)
+    ep: int = 1       # expert parallel (MoE)
+    pp: int = 1       # pipeline parallel
+
+    def degrees(self) -> Dict[str, int]:
+        return {"pp": self.pp, "dp": self.dp, "fsdp": self.fsdp,
+                "ep": self.ep, "sp": self.sp, "tp": self.tp}
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        """Fill the single -1 axis so the product equals n_devices."""
+        d = self.degrees()
+        wild = [k for k, v in d.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError(f"At most one mesh axis may be -1, got {wild}")
+        fixed = math.prod(v for v in d.values() if v != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}")
+            d[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"Mesh {d} wants {fixed} devices but {n_devices} are available")
+        return d
+
+
+def build_mesh(spec: Union[MeshSpec, Dict[str, int], None] = None,
+               devices: Optional[Sequence[jax.Device]] = None,
+               axis_names: Sequence[str] = MESH_AXES) -> Mesh:
+    """Build a Mesh from a spec over the given (default: all) devices.
+
+    Uses `mesh_utils.create_device_mesh` when possible so the physical ICI
+    topology lines up with the logical axes; falls back to a plain reshape
+    on virtual/CPU devices.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if spec is None:
+        spec = MeshSpec()
+    degrees = spec.resolve(len(devices)) if isinstance(spec, MeshSpec) else dict(spec)
+    shape = tuple(degrees[a] for a in axis_names)
+    try:
+        from jax.experimental import mesh_utils
+        if devices[0].platform == "tpu":
+            dev_array = mesh_utils.create_device_mesh(
+                shape, devices=devices, allow_split_physical_axes=True)
+        else:
+            raise ValueError  # virtual devices: plain reshape is fine
+    except Exception:
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names)
+
+
+def virtual_mesh(n_devices: int,
+                 spec: Union[MeshSpec, Dict[str, int], None] = None) -> Mesh:
+    """Mesh over the first n host/virtual devices — the test path
+    (conftest sets xla_force_host_platform_device_count)."""
+    return build_mesh(spec, devices=jax.devices()[:n_devices])
+
+
+def local_mesh() -> Mesh:
+    """Single-process mesh over all local devices, dp-major."""
+    return build_mesh(MeshSpec(dp=-1), devices=jax.local_devices())
+
+
+def mesh_shape_for(n_devices: int, prefer_tp: int = 1) -> MeshSpec:
+    """Heuristic spec: cap tp at prefer_tp (and at n), rest goes to dp."""
+    tp = math.gcd(prefer_tp, n_devices)
+    return MeshSpec(dp=-1, tp=tp)
+
+
+# ---------------------------------------------------------------------------
+# Logical axis rules
+# ---------------------------------------------------------------------------
+
+#: rule list: logical axis name -> mesh axis (or tuple of mesh axes, or None)
+Rules = Sequence[Tuple[str, Union[str, Tuple[str, ...], None]]]
+
+
+@dataclass
+class AxisRules:
+    """Maps logical axis names used by model code to physical mesh axes.
+
+    Equivalent in spirit to flax.linen.logical_axis_rules; standalone so
+    models can be plain pytrees. First matching rule wins; unknown logical
+    axes are unsharded (None).
+    """
+    rules: Rules = field(default_factory=lambda: default_axis_rules())
+
+    def mesh_axes(self, logical: Sequence[Optional[str]]) -> P:
+        out: List[Union[str, Tuple[str, ...], None]] = []
+        for name in logical:
+            if name is None:
+                out.append(None)
+                continue
+            for key, axes in self.rules:
+                if key == name:
+                    out.append(axes)
+                    break
+            else:
+                out.append(None)
+        # Trim trailing Nones (canonical PartitionSpec form).
+        while out and out[-1] is None:
+            out.pop()
+        return P(*out)
+
+
+def default_axis_rules(fsdp_enabled: bool = True) -> Rules:
+    """The standard decoder-LM mapping (scaling-book style):
+    batch -> dp(+fsdp), sequence -> sp, embed -> fsdp (param sharding),
+    heads/mlp -> tp, experts -> ep, pipeline stage handled outside."""
+    return (
+        ("batch", ("dp", "fsdp") if fsdp_enabled else "dp"),
+        ("seq", "sp"),
+        ("embed", "fsdp" if fsdp_enabled else None),
+        ("heads", "tp"),
+        ("kv", None),
+        ("mlp", "tp"),
+        ("vocab", "tp"),
+        ("expert", "ep"),
+        ("stage", "pp"),
+    )
+
+
+def logical_to_mesh(tree: Any, logical_tree: Any, mesh: Mesh,
+                    rules: Optional[AxisRules] = None) -> Any:
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
+    rules = rules or AxisRules()
+    return jax.tree.map(
+        lambda logical: NamedSharding(mesh, rules.mesh_axes(logical)),
+        logical_tree, is_leaf=lambda x: isinstance(x, tuple))
+
+
+def named_sharding(mesh: Mesh, *axes: Union[str, Tuple[str, ...], None]) -> NamedSharding:
+    return NamedSharding(mesh, P(*axes))
+
+
+def shard_constraint(x: Any, mesh: Mesh,
+                     *logical: Optional[str],
+                     rules: Optional[AxisRules] = None) -> Any:
+    """with_sharding_constraint via logical axis names. Safe to call outside
+    jit (no-op annotation will still place the array)."""
+    rules = rules or AxisRules()
+    spec = rules.mesh_axes(logical)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

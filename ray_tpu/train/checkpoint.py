@@ -1,0 +1,112 @@
+"""Checkpoint — dict/directory morphing container.
+
+Parity with the reference's AIR Checkpoint (ref: python/ray/air/
+checkpoint.py:66 — dict <-> dir <-> URI forms). Pytrees of jax/numpy
+arrays are stored with numpy .npz + cloudpickle for the structure, which
+keeps checkpoints framework-neutral and mmap-able; orbax integration for
+large sharded arrays lives in the trainer's save path."""
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+import time
+from typing import Any, Dict, Optional
+
+import cloudpickle
+import numpy as np
+
+
+class Checkpoint:
+    def __init__(self, data: Optional[Dict[str, Any]] = None,
+                 directory: Optional[str] = None):
+        if (data is None) == (directory is None):
+            raise ValueError("Provide exactly one of data / directory")
+        self._data = data
+        self._dir = directory
+
+    # ---- constructors ------------------------------------------------------
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        return cls(data=dict(data))
+
+    @classmethod
+    def from_directory(cls, directory: str) -> "Checkpoint":
+        return cls(directory=directory)
+
+    # ---- accessors ---------------------------------------------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        if self._data is not None:
+            return dict(self._data)
+        path = os.path.join(self._dir, "checkpoint.pkl")
+        with open(path, "rb") as f:
+            data = cloudpickle.load(f)
+        arrays_path = os.path.join(self._dir, "arrays.npz")
+        if os.path.exists(arrays_path):
+            arrs = np.load(arrays_path, allow_pickle=False)
+            data = _restore_arrays(data, arrs)
+        return data
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        if self._dir is not None and path is None:
+            return self._dir
+        path = path or tempfile.mkdtemp(prefix="rtpu_ckpt_")
+        os.makedirs(path, exist_ok=True)
+        data, arrays = _extract_arrays(self._data if self._data is not None
+                                       else self.to_dict())
+        if arrays:
+            np.savez(os.path.join(path, "arrays.npz"), **arrays)
+        with open(os.path.join(path, "checkpoint.pkl"), "wb") as f:
+            cloudpickle.dump(data, f, protocol=pickle.HIGHEST_PROTOCOL)
+        with open(os.path.join(path, ".metadata"), "w") as f:
+            f.write(f"ray_tpu checkpoint {time.time()}\n")
+        return path
+
+    def __repr__(self):
+        kind = "dict" if self._data is not None else f"dir:{self._dir}"
+        return f"Checkpoint({kind})"
+
+
+def _extract_arrays(data: Any, prefix: str = "", out: Optional[dict] = None):
+    """Pull numpy/jax arrays out of a nested dict into a flat npz-able map,
+    leaving placeholders. Keeps the pickle tiny and arrays zero-copy."""
+    out = {} if out is None else out
+    if isinstance(data, dict):
+        new = {}
+        for k, v in data.items():
+            sub, out = _extract_arrays(v, f"{prefix}{k}/", out)
+            new[k] = sub
+        return new, out
+    if hasattr(data, "__array__") and not np.isscalar(data):
+        arr = np.asarray(data)
+        key = prefix.rstrip("/")
+        out[key] = arr
+        return _ArrayRef(key), out
+    return data, out
+
+
+def _restore_arrays(data: Any, arrs) -> Any:
+    if isinstance(data, dict):
+        return {k: _restore_arrays(v, arrs) for k, v in data.items()}
+    if isinstance(data, _ArrayRef):
+        return arrs[data.key]
+    return data
+
+
+class _ArrayRef:
+    __slots__ = ("key",)
+
+    def __init__(self, key: str):
+        self.key = key
+
+
+def prune_checkpoints(base_dir: str, num_to_keep: Optional[int]) -> None:
+    if not num_to_keep or not os.path.isdir(base_dir):
+        return
+    ckpts = sorted(d for d in os.listdir(base_dir)
+                   if d.startswith("checkpoint_"))
+    for stale in ckpts[:-num_to_keep]:
+        shutil.rmtree(os.path.join(base_dir, stale), ignore_errors=True)

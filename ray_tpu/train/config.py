@@ -1,0 +1,66 @@
+"""Train configuration dataclasses.
+
+Parity with the reference's AIR configs (ref: python/ray/air/config.py —
+ScalingConfig/RunConfig/FailureConfig/CheckpointConfig), with the TPU
+twist: ScalingConfig carries a MeshSpec instead of GPU counts — the
+backend hands each worker a mesh slice rather than a torch process group
+(ref: train/torch/config.py:69)."""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ..parallel.mesh import MeshSpec
+
+
+@dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Dict[str, float] = field(default_factory=dict)
+    mesh: Optional[MeshSpec] = None          # parallelism layout per worker gang
+    devices_per_worker: Optional[int] = None  # CI: partition the host devices
+    placement_strategy: str = "SPREAD"
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker)
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu:
+            res.setdefault("TPU", 1.0)
+        return res
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0    # 0 = fail fast; -1 = unlimited restarts
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_frequency: int = 0
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    verbose: int = 0
+
+    def resolved_storage_path(self) -> str:
+        base = self.storage_path or os.path.expanduser("~/ray_tpu_results")
+        name = self.name or "train_run"
+        return os.path.join(base, name)
+
+
+@dataclass
+class Result:
+    """What fit() returns (ref: python/ray/air/result.py)."""
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Any]            # train.Checkpoint
+    path: str
+    error: Optional[BaseException] = None
+    metrics_history: list = field(default_factory=list)

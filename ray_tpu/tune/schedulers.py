@@ -1,0 +1,182 @@
+"""Trial schedulers: FIFO, ASHA, HyperBand (ASHA-based), Median stopping,
+PBT.
+
+Parity with the reference's tune.schedulers (ref: python/ray/tune/
+schedulers/ — async_hyperband.py ASHA rung logic, median_stopping_rule.py,
+pbt.py exploit/explore via checkpoint swap)."""
+from __future__ import annotations
+
+import math
+import random
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def set_metric(self, metric: str, mode: str) -> None:
+        self.metric = metric
+        self.mode = mode
+
+    def _score(self, result: dict) -> float:
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_result(self, trial, result: dict) -> str:
+        return CONTINUE
+
+    def on_complete(self, trial, result: Optional[dict]) -> None:
+        pass
+
+    def choose_action(self, controller) -> None:
+        """Hook for schedulers that mutate trials (PBT)."""
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (ref: schedulers/async_hyperband.py). Rungs at
+    grace_period * reduction_factor^k; a trial reaching a rung stops unless
+    in the top 1/reduction_factor of completed scores at that rung."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 grace_period: int = 1, reduction_factor: float = 3,
+                 max_t: int = 100):
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.max_t = max_t
+        self._rungs: Dict[int, List[float]] = defaultdict(list)
+
+    def _rung_levels(self) -> List[int]:
+        levels = []
+        t = self.grace
+        while t < self.max_t:
+            levels.append(int(t))
+            t *= self.rf
+        return levels
+
+    def on_result(self, trial, result: dict) -> str:
+        t = int(result.get(self.time_attr, 0))
+        if t >= self.max_t:
+            return STOP
+        score = self._score(result)
+        for level in self._rung_levels():
+            if t == level:
+                rung = self._rungs[level]
+                rung.append(score)
+                k = max(1, int(len(rung) / self.rf))
+                cutoff = sorted(rung, reverse=True)[k - 1]
+                if score < cutoff:
+                    return STOP
+        return CONTINUE
+
+
+ASHAScheduler = AsyncHyperBandScheduler
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best score is below the median of running averages
+    (ref: schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self._avgs: Dict[str, List[float]] = defaultdict(list)
+
+    def on_result(self, trial, result: dict) -> str:
+        t = int(result.get(self.time_attr, 0))
+        score = self._score(result)
+        self._avgs[trial.trial_id].append(score)
+        if t <= self.grace or len(self._avgs) < self.min_samples:
+            return CONTINUE
+        running = [sum(v) / len(v) for v in self._avgs.values()]
+        running.sort()
+        median = running[len(running) // 2]
+        mine = self._avgs[trial.trial_id]
+        if sum(mine) / len(mine) < median and max(mine) < median:
+            return STOP
+        return CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (ref: schedulers/pbt.py): at each perturbation interval, bottom-
+    quartile trials copy the checkpoint of a top-quartile trial (exploit)
+    and perturb hyperparameters (explore). The controller performs the
+    restart; we record the decision on the trial."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = dict(hyperparam_mutations or {})
+        self.quantile = quantile_fraction
+        self.resample_prob = resample_probability
+        self._rng = random.Random(seed)
+        self._latest: Dict[str, dict] = {}
+
+    def on_result(self, trial, result: dict) -> str:
+        self._latest[trial.trial_id] = result
+        t = int(result.get(self.time_attr, 0))
+        if t > 0 and t % self.interval == 0:
+            trial.pbt_ready = True
+        return CONTINUE
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        new = dict(config)
+        for key, spec in self.mutations.items():
+            if key not in new:
+                continue
+            if callable(spec):
+                new[key] = spec()
+            elif isinstance(spec, list):
+                if self._rng.random() < self.resample_prob:
+                    new[key] = self._rng.choice(spec)
+                else:
+                    cur = new[key]
+                    idx = spec.index(cur) if cur in spec else 0
+                    idx = max(0, min(len(spec) - 1,
+                                     idx + self._rng.choice([-1, 1])))
+                    new[key] = spec[idx]
+            else:  # Domain
+                if self._rng.random() < self.resample_prob:
+                    new[key] = spec.sample(self._rng)
+                else:
+                    new[key] = new[key] * self._rng.choice([0.8, 1.2])
+        return new
+
+    def choose_action(self, controller) -> None:
+        ready = [t for t in controller.running_trials()
+                 if getattr(t, "pbt_ready", False)]
+        if not ready:
+            return
+        scored = [(self._score(self._latest[t.trial_id]), t)
+                  for t in controller.all_trials()
+                  if t.trial_id in self._latest and t.status in ("RUNNING", "PAUSED")]
+        if len(scored) < 2:
+            for t in ready:
+                t.pbt_ready = False
+            return
+        scored.sort(key=lambda x: x[0])
+        n = len(scored)
+        k = max(1, int(n * self.quantile))
+        bottom = {t.trial_id for _, t in scored[:k]}
+        top = [t for _, t in scored[-k:]]
+        for t in ready:
+            t.pbt_ready = False
+            if t.trial_id in bottom:
+                donor = self._rng.choice(top)
+                if donor.trial_id == t.trial_id or donor.latest_checkpoint is None:
+                    continue
+                new_config = self._explore(donor.config)
+                controller.exploit_trial(t, donor, new_config)

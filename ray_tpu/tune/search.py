@@ -1,0 +1,140 @@
+"""Search spaces and suggestion generation.
+
+Parity with the reference's tune.search (ref: python/ray/tune/search/ —
+grid/random via basic_variant.py; sample.py domains: uniform/loguniform/
+choice/randint; external searchers Optuna/HyperOpt/... are optional deps
+there and are represented here by the Searcher plug-in base)."""
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+
+# ---- sampling domains (ref: tune/search/sample.py) -------------------------
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+@dataclass
+class Uniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+@dataclass
+class LogUniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+@dataclass
+class Randint(Domain):
+    low: int
+    high: int
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+@dataclass
+class Choice(Domain):
+    values: List[Any]
+
+    def sample(self, rng):
+        return rng.choice(self.values)
+
+
+@dataclass
+class GridSearch:
+    values: List[Any]
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> Randint:
+    return Randint(low, high)
+
+
+def choice(values: List[Any]) -> Choice:
+    return Choice(list(values))
+
+
+def grid_search(values: List[Any]) -> Dict[str, Any]:
+    return {"grid_search": list(values)}
+
+
+def _is_grid(v) -> bool:
+    return isinstance(v, dict) and set(v.keys()) == {"grid_search"}
+
+
+# ---- searchers -------------------------------------------------------------
+
+class Searcher:
+    """Suggestion plug-in (ref: tune/search/searcher.py). suggest() returns a
+    config dict or None when exhausted; on_trial_complete feeds results back
+    (used by adaptive searchers)."""
+
+    def set_space(self, param_space: Dict[str, Any], metric: str, mode: str):
+        self.param_space = param_space
+        self.metric = metric
+        self.mode = mode
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict]) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid x random sampling (ref: tune/search/basic_variant.py). Grid keys
+    expand combinatorially; Domain keys sample per trial; num_samples
+    multiplies the whole expansion."""
+
+    def __init__(self, num_samples: int = 1, seed: Optional[int] = None):
+        self.num_samples = num_samples
+        self._rng = random.Random(seed)
+        self._iter: Optional[Iterator[Dict[str, Any]]] = None
+
+    def _expand(self) -> Iterator[Dict[str, Any]]:
+        space = self.param_space
+        grid_keys = [k for k, v in space.items() if _is_grid(v)]
+        grids = [space[k]["grid_search"] for k in grid_keys]
+        for _ in range(self.num_samples):
+            for combo in itertools.product(*grids) if grids else [()]:
+                cfg = {}
+                for k, v in space.items():
+                    if _is_grid(v):
+                        continue
+                    cfg[k] = v.sample(self._rng) if isinstance(v, Domain) else v
+                cfg.update(dict(zip(grid_keys, combo)))
+                yield cfg
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._iter is None:
+            self._iter = self._expand()
+        try:
+            return next(self._iter)
+        except StopIteration:
+            return None
+
+
+class RandomSearch(BasicVariantGenerator):
+    """Alias emphasizing pure sampling (no grid keys)."""

@@ -1,0 +1,98 @@
+"""Distributed FIFO queue backed by an actor.
+
+Parity with the reference's `ray.util.queue.Queue`
+(ref: python/ray/util/queue.py — actor-backed asyncio queue with
+put/get/qsize/empty/full and *_nowait* variants)."""
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self.maxsize = maxsize
+        self._items: List[Any] = []
+
+    def put(self, item) -> bool:
+        if self.maxsize > 0 and len(self._items) >= self.maxsize:
+            return False
+        self._items.append(item)
+        return True
+
+    def get(self) -> tuple:
+        if not self._items:
+            return (False, None)
+        return (True, self._items.pop(0))
+
+    def get_batch(self, max_items: int) -> List[Any]:
+        out, self._items = (self._items[:max_items],
+                            self._items[max_items:])
+        return out
+
+    def qsize(self) -> int:
+        return len(self._items)
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
+        opts = dict(actor_options or {})
+        opts.setdefault("num_cpus", 0)
+        cls = ray_tpu.remote(_QueueActor)
+        self.actor = cls.options(**opts).remote(maxsize)
+
+    def put(self, item, block: bool = True, timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if ray_tpu.get(self.actor.put.remote(item)):
+                return
+            if not block:
+                raise Full("Queue is full")
+            if deadline is not None and time.monotonic() > deadline:
+                raise Full("Queue put timed out")
+            time.sleep(0.005)
+
+    def put_nowait(self, item) -> None:
+        self.put(item, block=False)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = 0.001
+        while True:
+            ok, item = ray_tpu.get(self.actor.get.remote())
+            if ok:
+                return item
+            if not block:
+                raise Empty("Queue is empty")
+            if deadline is not None and time.monotonic() > deadline:
+                raise Empty("Queue get timed out")
+            time.sleep(delay)
+            delay = min(delay * 2, 0.05)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def get_batch(self, max_items: int = 64) -> List[Any]:
+        return ray_tpu.get(self.actor.get_batch.remote(max_items))
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self.actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def shutdown(self) -> None:
+        try:
+            ray_tpu.kill(self.actor)
+        except Exception:
+            pass

@@ -9,14 +9,23 @@ exercise 256-chip sharding logic in CI.
 import os
 import sys
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault(
-    "XLA_FLAGS",
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8",
-)
+# Must be set before jax is imported anywhere in the test process. Forced
+# (not setdefault): the ambient environment points JAX_PLATFORMS at the real
+# TPU tunnel, but tests run on the virtual 8-device CPU mesh per SURVEY.md §7.
+os.environ["JAX_PLATFORMS"] = "cpu"
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# A sitecustomize in this image pins jax_platforms to the TPU tunnel even
+# when the env var says cpu; override at the config level before any backend
+# is initialized.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

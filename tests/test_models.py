@@ -1,0 +1,155 @@
+"""Model-family tests: shape/grad sanity on tiny configs, sharded GPT train
+step on the virtual mesh (the single-controller SPMD path the Train layer
+drives)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import (GPT, GPTConfig, Llama, LlamaConfig, MLP,
+                            MLPConfig, ResNet, ResNetConfig, ViT, ViTConfig)
+from ray_tpu.parallel import MeshSpec, virtual_mesh
+
+
+class TestGPT:
+    def test_forward_shapes(self):
+        cfg = GPTConfig.tiny(dtype=jnp.float32)
+        model = GPT(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        logits = model.apply(params, tokens)
+        assert logits.shape == (2, 16, cfg.padded_vocab)
+        assert logits.dtype == jnp.float32
+
+    def test_loss_decreases(self):
+        cfg = GPTConfig.tiny(dtype=jnp.float32, remat=False, use_flash=False)
+        model = GPT(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 512)
+        targets = jnp.roll(tokens, -1, axis=1)
+
+        @jax.jit
+        def step(params):
+            loss, grads = jax.value_and_grad(model.loss)(params, tokens, targets)
+            return loss, jax.tree.map(lambda p, g: p - 0.1 * g, params, grads)
+
+        l0, params = step(params)
+        for _ in range(5):
+            l1, params = step(params)
+        assert float(l1) < float(l0)
+
+    def test_causality(self):
+        cfg = GPTConfig.tiny(dtype=jnp.float32, use_flash=False, remat=False)
+        model = GPT(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        t1 = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0, 512)
+        t2 = t1.at[0, -1].set((t1[0, -1] + 1) % 512)
+        l1 = model.apply(params, t1)
+        l2 = model.apply(params, t2)
+        # changing the last token must not affect earlier positions
+        np.testing.assert_allclose(np.asarray(l1[:, :-1]),
+                                   np.asarray(l2[:, :-1]), atol=1e-5)
+
+    def test_sharded_train_step(self):
+        mesh = virtual_mesh(8, MeshSpec(dp=2, fsdp=2, tp=2))
+        cfg = GPTConfig.tiny(dtype=jnp.float32, use_flash=False)
+        model = GPT(cfg)
+        shardings = model.param_shardings(mesh)
+        init = jax.jit(model.init, out_shardings=shardings)
+        params = init(jax.random.PRNGKey(0))
+        # verify a tp-sharded param actually is sharded
+        assert not params["w_fc"].sharding.is_fully_replicated
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 512)
+        targets = jnp.roll(tokens, -1, axis=1)
+
+        @jax.jit
+        def step(params, tokens, targets):
+            loss, grads = jax.value_and_grad(model.loss)(params, tokens, targets)
+            return loss, jax.tree.map(lambda p, g: p - 0.01 * g, params, grads)
+
+        loss, new_params = step(params, tokens, targets)
+        assert np.isfinite(float(loss))
+        assert new_params["w_fc"].sharding == params["w_fc"].sharding
+
+    def test_num_params_small(self):
+        n = GPT(GPTConfig.small()).num_params()
+        assert 120e6 < n < 165e6  # 124M + vocab padding
+
+
+class TestLlama:
+    def test_forward_and_gqa(self):
+        cfg = LlamaConfig.tiny(dtype=jnp.float32, use_flash=False, remat=False)
+        model = Llama(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        logits = model.apply(params, tokens)
+        assert logits.shape == (2, 16, cfg.padded_vocab)
+
+    def test_decode_matches_forward(self):
+        cfg = LlamaConfig.tiny(dtype=jnp.float32, use_flash=False, remat=False)
+        model = Llama(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, 512)
+        full = model.apply(params, tokens)  # [1, 8, V]
+        cache = model.init_cache(batch=1)
+        outs = []
+        for i in range(8):
+            logits, cache = model.decode_step(params, cache, tokens[:, i:i+1])
+            outs.append(logits)
+        dec = jnp.stack(outs, axis=1)
+        np.testing.assert_allclose(np.asarray(dec), np.asarray(full),
+                                   atol=2e-3, rtol=2e-3)
+
+
+class TestResNet:
+    def test_train_step(self):
+        cfg = ResNetConfig.resnet18_cifar(dtype=jnp.float32)
+        model = ResNet(cfg)
+        params, state = model.init(jax.random.PRNGKey(0))
+        images = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+        labels = jnp.array([0, 1, 2, 3])
+
+        (loss, new_state), grads = jax.value_and_grad(
+            model.loss, has_aux=True)(params, state, images, labels)
+        assert np.isfinite(float(loss))
+        # batch stats updated
+        assert not np.allclose(np.asarray(new_state["stem/bn/mean"]), 0.0)
+
+    def test_eval_mode(self):
+        cfg = ResNetConfig.resnet18_cifar(dtype=jnp.float32)
+        model = ResNet(cfg)
+        params, state = model.init(jax.random.PRNGKey(0))
+        images = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+        logits, new_state = model.apply(params, state, images, train=False)
+        assert logits.shape == (2, 10)
+        for k in state:
+            np.testing.assert_array_equal(np.asarray(new_state[k]),
+                                          np.asarray(state[k]))
+
+
+class TestViT:
+    def test_forward(self):
+        cfg = ViTConfig.tiny(dtype=jnp.float32, remat=False)
+        model = ViT(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        images = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+        logits = model.apply(params, images)
+        assert logits.shape == (2, 10)
+
+    def test_grad(self):
+        cfg = ViTConfig.tiny(dtype=jnp.float32, remat=False, use_flash=False)
+        model = ViT(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        images = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+        labels = jnp.array([1, 2])
+        g = jax.grad(model.loss)(params, images, labels)
+        assert np.isfinite(float(jnp.abs(g["w_qkv"]).sum()))
+
+
+class TestMLP:
+    def test_apply(self):
+        model = MLP(MLPConfig(in_dim=8, hidden=(16,), out_dim=4))
+        params = model.init(jax.random.PRNGKey(0))
+        y = model.apply(params, jnp.ones((3, 8)))
+        assert y.shape == (3, 4)

@@ -1,0 +1,129 @@
+"""Train layer tests: JaxTrainer end-to-end on the virtual mesh, reporting,
+checkpointing, failure restart (mirrors ref: python/ray/train/tests/
+test_backend.py, test_data_parallel_trainer.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import (Checkpoint, FailureConfig, JaxTrainer, Result,
+                           RunConfig, ScalingConfig)
+
+
+@pytest.fixture
+def rt(tmp_path):
+    runtime = ray_tpu.init(num_cpus=8)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+def test_basic_fit_reports_and_checkpoints(rt, tmp_path):
+    def loop(config):
+        ctx = train.get_context()
+        for i in range(3):
+            ckpt = None
+            if ctx.get_world_rank() == 0:
+                ckpt = Checkpoint.from_dict({"step": i, "w": np.ones(4) * i})
+            train.report({"loss": 1.0 / (i + 1), "rank": ctx.get_world_rank()},
+                         checkpoint=ckpt)
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2, devices_per_worker=4),
+        run_config=RunConfig(name="t1", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert len(result.metrics_history) == 3
+    assert result.metrics["loss"] == pytest.approx(1.0 / 3)
+    data = result.checkpoint.to_dict()
+    assert data["step"] == 2
+    np.testing.assert_allclose(data["w"], 2.0)
+    assert os.path.isdir(os.path.join(str(tmp_path), "t1"))
+
+
+def test_mesh_available_in_loop(rt, tmp_path):
+    def loop(config):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = train.get_mesh()
+        x = jnp.arange(8.0)
+        y = jax.jit(lambda x: (x * 2).sum(),
+                    in_shardings=NamedSharding(mesh, P("dp")))(x)
+        train.report({"total": float(y), "devices": len(mesh.devices.flat)})
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2, devices_per_worker=4),
+        run_config=RunConfig(name="t2", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["total"] == 56.0
+    assert result.metrics["devices"] == 4
+
+
+def test_dataset_shards(rt, tmp_path):
+    def loop(config):
+        shard = train.get_dataset_shard("train")
+        train.report({"n": len(shard), "first": shard[0]})
+
+    trainer = JaxTrainer(
+        loop,
+        datasets={"train": list(range(10))},
+        scaling_config=ScalingConfig(num_workers=2, devices_per_worker=4),
+        run_config=RunConfig(name="t3", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["n"] == 5
+
+
+def test_failure_restart_from_checkpoint(rt, tmp_path):
+    marker = str(tmp_path / "fail_once")
+
+    def loop(config):
+        ctx = train.get_context()
+        ckpt = train.get_checkpoint()
+        start = ckpt.to_dict()["step"] + 1 if ckpt is not None else 0
+        for i in range(start, 4):
+            if i == 2 and not os.path.exists(config["marker"]) \
+                    and ctx.get_world_rank() == 0:
+                open(config["marker"], "w").close()
+                os._exit(1)  # hard-kill this worker process
+            c = None
+            if ctx.get_world_rank() == 0:
+                c = Checkpoint.from_dict({"step": i})
+            train.report({"step": i}, checkpoint=c)
+
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={"marker": marker},
+        scaling_config=ScalingConfig(num_workers=2, devices_per_worker=4),
+        run_config=RunConfig(name="t4", storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=2)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 3
+    # restart resumed from step-1 checkpoint, not from scratch
+    steps = [m["step"] for m in result.metrics_history]
+    assert steps.count(0) == 1
+
+
+def test_failure_exhausts_budget(rt, tmp_path):
+    def loop(config):
+        os._exit(1)
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1, devices_per_worker=4),
+        run_config=RunConfig(name="t5", storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=0)),
+    )
+    result = trainer.fit()
+    assert result.error is not None
