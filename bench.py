@@ -11,6 +11,7 @@ RTPU_BENCH_SMOKE=1 runs a tiny config on CPU (CI smoke).
 """
 from __future__ import annotations
 
+import functools
 import json
 import os
 import sys
@@ -56,7 +57,8 @@ def main() -> None:
         batch, seq, steps, warmup = 2, 128, 3, 1
     else:
         cfg = GPTConfig.small(dtype=jnp.bfloat16, use_flash=True)
-        batch, seq, steps, warmup = 8, 1024, 30, 3
+        batch = int(os.environ.get("RTPU_BENCH_BATCH", "16"))
+        seq, steps, warmup = 1024, 30, 3
 
     model = GPT(cfg)
     import optax
@@ -68,7 +70,8 @@ def main() -> None:
                                 cfg.vocab_size)
     targets = jnp.roll(tokens, -1, axis=1)
 
-    @jax.jit
+    # donate params/opt_state: in-place update, no per-step HBM copy
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, tokens, targets):
         loss, grads = jax.value_and_grad(model.loss)(params, tokens, targets)
         updates, opt_state = tx.update(grads, opt_state, params)
@@ -90,9 +93,7 @@ def main() -> None:
     tokens_per_sec = tokens_per_step * steps / dt
 
     n = model.num_params()
-    # fwd+bwd matmul FLOPs/token: 6N + causal attention 6·L·S·D
-    flops_per_token = 6 * n + 6 * cfg.n_layer * seq * cfg.d_model
-    achieved = flops_per_token * tokens_per_sec
+    achieved = model.flops_per_token(seq) * tokens_per_sec
     peak = _peak_flops(jax.devices()[0])
     mfu = achieved / peak
 

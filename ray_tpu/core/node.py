@@ -274,6 +274,8 @@ class Node:
             actor_id = worker.actor_id
         for spec in in_flight:
             self.runtime.on_worker_crashed(spec, self.node_id)
+        # drop every object reference the dead worker held
+        self.runtime.refcount.release_holder(worker.worker_id)
         if actor_id is not None and self.alive:
             self.runtime.gcs.on_actor_failure(
                 actor_id, f"worker {worker.worker_id.hex()[:8]} died")
@@ -282,6 +284,7 @@ class Node:
     def _terminate_worker(self, worker: WorkerHandle) -> None:
         worker.state = "dead"
         self._workers.pop(worker.worker_id, None)
+        self.runtime.refcount.release_holder(worker.worker_id)
         if worker.channel is not None:
             worker.channel.notify("shutdown")
             worker.channel.close()
@@ -365,6 +368,13 @@ class Node:
                 self.store.seal(payload["object_id"])
                 self.store.pin(payload["object_id"])
                 self.runtime.on_object_sealed(payload["object_id"], self.node_id)
+                if worker is not None and payload.get("is_put"):
+                    # a worker ray_tpu.put: the worker holds the only ref
+                    # (its adopt_owned_ref finalizer sends the balancing
+                    # remove). Task returns sealed via _report_success get
+                    # their lifetime from the caller's returned refs.
+                    self.runtime.refcount.add_holder_ref(
+                        payload["object_id"], worker.worker_id)
                 return True
             # everything else is the shared core-worker API, served by the runtime
             return self.runtime.handle_worker_call(self, worker, method, payload)

@@ -11,6 +11,30 @@ from typing import Optional
 from .ids import ObjectId, TaskId, WorkerId
 
 
+# Per-process hook invoked for every ObjectRef materialized by
+# DESERIALIZATION (not plain construction). Workers install it to report
+# borrowed references to the head; the driver installs it to count refs it
+# receives inside fetched values (ref: _private/serialization.py in-band
+# ObjectRef tracking for the borrowing protocol).
+_borrow_hook = None
+
+
+def _set_borrow_hook(hook) -> None:
+    global _borrow_hook
+    _borrow_hook = hook
+
+
+def _reconstruct_ref(object_id, owner, call_site):
+    ref = ObjectRef(object_id, owner, call_site)
+    hook = _borrow_hook
+    if hook is not None:
+        try:
+            hook(ref)
+        except Exception:
+            pass
+    return ref
+
+
 class ObjectRef:
     __slots__ = ("id", "owner", "_call_site", "__weakref__")
 
@@ -39,9 +63,10 @@ class ObjectRef:
         return f"ObjectRef({self.id.hex()})"
 
     def __reduce__(self):
-        # Serialization of a ref hands out a *borrowed* reference; the runtime
-        # tracks contained refs at serialize() time (serialization.py).
-        return (ObjectRef, (self.id, self.owner, self._call_site))
+        # Serialization of a ref hands out a *borrowed* reference; the
+        # deserializing process's _borrow_hook reports the borrow so the
+        # head's per-holder counts keep the object alive.
+        return (_reconstruct_ref, (self.id, self.owner, self._call_site))
 
     # Allow `await ref` inside async actors.
     def __await__(self):

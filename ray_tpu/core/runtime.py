@@ -129,6 +129,15 @@ class DriverRuntime:
         for i in range(num_nodes):
             self.add_node(dict(default_res))
         self.head_node_id = next(iter(self.nodes), None)
+        # refs the driver receives INSIDE fetched values (borrows) must be
+        # counted like refs it created via make_ref
+        from .object_ref import _set_borrow_hook
+
+        def _driver_borrow(ref: ObjectRef) -> None:
+            self.refcount.add_local(ref.id)
+            weakref.finalize(ref, self.refcount.remove_local, ref.id)
+
+        _set_borrow_hook(_driver_borrow)
 
     # ---- cluster membership --------------------------------------------------
 
@@ -655,10 +664,20 @@ class DriverRuntime:
         if node is None or not node.alive:
             # same node-death window as in _flush_actor_queue: park, don't
             # burn a retry — the actor FSM decides restart vs DEAD.
+            restarted = False
             with rec.lock:
-                rec.seq -= 1
-                rec.queued.insert(0, spec)
-                rec.worker = None
+                if rec.worker is worker:
+                    rec.seq -= 1
+                    rec.queued.insert(0, spec)
+                    rec.worker = None
+                else:
+                    # restart completed in the window: rec.seq/worker belong
+                    # to the new epoch — don't clobber them, requeue for a
+                    # fresh seq assignment on the new worker
+                    rec.queued.insert(0, spec)
+                    restarted = rec.worker is not None
+            if restarted:
+                self._flush_actor_queue(spec.actor_id)
             return
         node.push_task(worker, spec)
 
@@ -685,10 +704,15 @@ class DriverRuntime:
                 # park the task and stop — no retry consumed, no busy-spin.
                 # The restart (or DEAD transition) re-drives this queue.
                 with rec.lock:
-                    rec.seq -= 1
+                    if rec.worker is worker:
+                        rec.seq -= 1
+                        rec.queued.insert(0, spec)
+                        rec.worker = None
+                        break
+                    # restart won the race — requeue and retry on the new
+                    # worker epoch (loop re-pops with a fresh seq)
                     rec.queued.insert(0, spec)
-                    rec.worker = None
-                break
+                continue
             node.push_task(worker, spec)
         # a task may have been appended after the final lock release — if the
         # queue is non-empty and the actor is alive, a new flush is required
@@ -818,6 +842,11 @@ class DriverRuntime:
             oid = payload["object_id"]
             self.store_inline_bytes(oid, payload["data"])
             self.refcount.add_owned(oid)
+            if worker is not None:
+                # the putting worker holds the ref; without this the object
+                # has zero counted references and a later unpin frees it
+                # out from under the worker (round-1 weak #4)
+                self.refcount.add_holder_ref(oid, worker.worker_id)
             return True
         if method == "export_function":
             self.gcs.kv_put("fn:" + payload["func_id"], payload["blob"],
@@ -826,7 +855,14 @@ class DriverRuntime:
         if method == "get_function":
             return self.get_function_blob(payload)
         if method == "submit_task":
-            self.submit_spec(payload)
+            refs = self.submit_spec(payload)
+            if worker is not None:
+                # count the submitting worker as holder of the return refs;
+                # the transient driver-side refs created by submit_spec are
+                # balanced (add_local now, remove_local at GC) and must not
+                # be the only thing keeping the results alive
+                for r in refs:
+                    self.refcount.add_holder_ref(r.id, worker.worker_id)
             return True
         if method == "create_actor":
             self.create_actor(payload["spec"], name=payload.get("name", ""),
@@ -879,10 +915,16 @@ class DriverRuntime:
             self.remove_placement_group(payload["pg_id"])
             return True
         if method == "add_ref":
-            self.refcount.add_local(payload)
+            if worker is not None:
+                self.refcount.add_holder_ref(payload, worker.worker_id)
+            else:
+                self.refcount.add_local(payload)
             return None
         if method == "remove_ref":
-            self.refcount.remove_local(payload)
+            if worker is not None:
+                self.refcount.remove_holder_ref(payload, worker.worker_id)
+            else:
+                self.refcount.remove_local(payload)
             return None
         if method == "node_info":
             return {"node_id": node.node_id, "job_id": self.job_id,
@@ -979,6 +1021,42 @@ class WorkerRuntime:
         self._put_lock = threading.Lock()
         self._put_counter = 0
         self.worker_id = worker_process.worker_id
+        self._held_lock = threading.Lock()
+        self._held: Dict[ObjectId, int] = {}
+
+    # -- worker-held reference accounting (ref: reference_count.h:61 borrower
+    # reports; the head aggregates per-holder counts and frees only when all
+    # holders have dropped theirs) ------------------------------------------
+
+    def adopt_owned_ref(self, ref: ObjectRef) -> None:
+        """A ref whose holder-count the head already established (task
+        submission returns, puts): only attach the decrement finalizer."""
+        with self._held_lock:
+            self._held[ref.id] = self._held.get(ref.id, 0) + 1
+        weakref.finalize(ref, self._deref, ref.id)
+
+    def register_borrowed_ref(self, ref: ObjectRef) -> None:
+        """A ref deserialized in this worker (task arg or inside a fetched
+        value): report the borrow to the head, then track like any ref."""
+        with self._held_lock:
+            self._held[ref.id] = self._held.get(ref.id, 0) + 1
+        try:
+            self.channel.notify("add_ref", ref.id)
+        except Exception:
+            pass
+        weakref.finalize(ref, self._deref, ref.id)
+
+    def _deref(self, oid: ObjectId) -> None:
+        with self._held_lock:
+            c = self._held.get(oid, 0) - 1
+            if c <= 0:
+                self._held.pop(oid, None)
+            else:
+                self._held[oid] = c
+        try:
+            self.channel.notify("remove_ref", oid)
+        except Exception:
+            pass
 
     # task context
     def set_current_task(self, spec: TaskSpec):
@@ -1020,8 +1098,14 @@ class WorkerRuntime:
             sobj.write_into(mv)
             del mv  # drop the exported view before unmapping
             self.worker.reader.release(name)
-            self.channel.call("seal_object", {"object_id": oid})
-        return ObjectRef(oid)
+            # is_put: the worker holds the only reference (balanced by
+            # adopt_owned_ref below); task RETURNS also seal but their
+            # lifetime is owned by the caller's returned refs instead.
+            self.channel.call("seal_object", {"object_id": oid,
+                                              "is_put": True})
+        ref = ObjectRef(oid)
+        self.adopt_owned_ref(ref)
+        return ref
 
     def get_many(self, oids: List[ObjectId], timeout: Optional[float] = None):
         results = self.channel.call("get_objects", {"ids": oids, "timeout": timeout},
@@ -1086,6 +1170,10 @@ class WorkerRuntime:
     def submit_spec(self, spec: TaskSpec) -> List[ObjectRef]:
         refs = [ObjectRef(oid) for oid in spec.return_ids()]
         self.channel.call("submit_task", spec)
+        # the head counted this worker as holder of each return ref during
+        # the submit call; pair each with a GC-driven decrement
+        for r in refs:
+            self.adopt_owned_ref(r)
         return refs
 
     def create_actor(self, spec: TaskSpec, name: str = "", detached: bool = False,

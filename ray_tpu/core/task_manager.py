@@ -109,20 +109,30 @@ class TaskManager:
 class ReferenceCounter:
     """Aggregated reference counts per object.
 
-    Counts: python-local references (driver + each worker process reports),
-    plus pins from pending task arguments. An object is freeable when all
-    counts reach zero. (ref: reference_count.h:61)"""
+    Counts: python-local references in the driver, per-HOLDER references
+    reported by worker processes (a holder is a WorkerId; all of a dead
+    worker's refs are dropped in one sweep — the single-controller
+    reduction of the reference's borrower protocol), plus pins from
+    pending task arguments. An object is freeable only when all three
+    reach zero. (ref: reference_count.h:61)"""
 
     def __init__(self, on_free: Callable[[ObjectId], None]):
         self._lock = threading.Lock()
         self._local: Dict[ObjectId, int] = {}
         self._task_pins: Dict[ObjectId, int] = {}
+        self._holders: Dict[ObjectId, Dict[object, int]] = {}
         self._owned: Set[ObjectId] = set()
         self._on_free = on_free
 
     def add_owned(self, object_id: ObjectId) -> None:
         with self._lock:
             self._owned.add(object_id)
+
+    def _freeable_locked(self, object_id: ObjectId) -> bool:
+        return (object_id not in self._local
+                and object_id not in self._task_pins
+                and object_id not in self._holders
+                and object_id in self._owned)
 
     def add_local(self, object_id: ObjectId, n: int = 1) -> None:
         with self._lock:
@@ -134,11 +144,50 @@ class ReferenceCounter:
             c = self._local.get(object_id, 0) - n
             if c <= 0:
                 self._local.pop(object_id, None)
-                free = object_id not in self._task_pins and object_id in self._owned
+                free = self._freeable_locked(object_id)
             else:
                 self._local[object_id] = c
         if free:
             self._on_free(object_id)
+
+    def add_holder_ref(self, object_id: ObjectId, holder, n: int = 1) -> None:
+        """A worker process holds (another) reference to the object."""
+        with self._lock:
+            h = self._holders.setdefault(object_id, {})
+            h[holder] = h.get(holder, 0) + n
+
+    def remove_holder_ref(self, object_id: ObjectId, holder,
+                          n: int = 1) -> None:
+        free = False
+        with self._lock:
+            h = self._holders.get(object_id)
+            if h is None:
+                return
+            c = h.get(holder, 0) - n
+            if c <= 0:
+                h.pop(holder, None)
+            else:
+                h[holder] = c
+            if not h:
+                self._holders.pop(object_id, None)
+                free = self._freeable_locked(object_id)
+        if free:
+            self._on_free(object_id)
+
+    def release_holder(self, holder) -> None:
+        """Drop every reference a (dead) worker held."""
+        to_free = []
+        with self._lock:
+            for oid in list(self._holders):
+                h = self._holders[oid]
+                if holder in h:
+                    h.pop(holder, None)
+                    if not h:
+                        self._holders.pop(oid, None)
+                        if self._freeable_locked(oid):
+                            to_free.append(oid)
+        for oid in to_free:
+            self._on_free(oid)
 
     def pin_for_task(self, object_id: ObjectId) -> None:
         with self._lock:
@@ -150,8 +199,7 @@ class ReferenceCounter:
             c = self._task_pins.get(object_id, 0) - 1
             if c <= 0:
                 self._task_pins.pop(object_id, None)
-                free = (object_id not in self._local
-                        and object_id in self._owned)
+                free = self._freeable_locked(object_id)
             else:
                 self._task_pins[object_id] = c
         if free:
@@ -159,4 +207,6 @@ class ReferenceCounter:
 
     def counts(self, object_id: ObjectId) -> tuple:
         with self._lock:
-            return (self._local.get(object_id, 0), self._task_pins.get(object_id, 0))
+            return (self._local.get(object_id, 0),
+                    self._task_pins.get(object_id, 0),
+                    sum(self._holders.get(object_id, {}).values()))

@@ -103,6 +103,11 @@ class WorkerProcess:
 
         self.runtime = runtime_mod.WorkerRuntime(self)
         runtime_mod.set_runtime(self.runtime)
+        # every ObjectRef deserialized in this process is a borrow the head
+        # must count (ref: reference_count.h:61 borrower protocol)
+        from .object_ref import _set_borrow_hook
+
+        _set_borrow_hook(self.runtime.register_borrowed_ref)
 
     # -- incoming RPC ----------------------------------------------------------
 

@@ -16,6 +16,7 @@ TPU-first choices:
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple
@@ -144,11 +145,17 @@ class GPT:
         shapes = jax.eval_shape(self.init, jax.random.PRNGKey(0))
         return sum(int(math.prod(s.shape)) for s in jax.tree.leaves(shapes))
 
-    def flops_per_token(self) -> int:
-        """Forward+backward matmul FLOPs per token (6N rule + attention)."""
+    def flops_per_token(self, seq: Optional[int] = None) -> int:
+        """Forward+backward matmul FLOPs per token (6N rule + attention).
+
+        Attention term: QK^T + PV are each 2·S·D MAC-FLOPs per token per
+        layer forward (4·S·D), ×3 for fwd+bwd = 12·S·D, halved for causal
+        masking → 6·L·S·D. This is the single source of truth; bench.py
+        calls it rather than duplicating the formula."""
         c = self.config
+        s = c.max_seq if seq is None else seq
         n = self.num_params()
-        attn = 12 * c.n_layer * c.d_model * c.max_seq  # 6 * 2 * L * D * S (causal half)
+        attn = 6 * c.n_layer * c.d_model * s
         return 6 * n + attn
 
     # ---- forward -----------------------------------------------------------
@@ -179,34 +186,91 @@ class GPT:
         x = x + (h @ lp["w_out"].astype(c.dtype)) + lp["b_out"].astype(c.dtype)
         return x
 
+    @staticmethod
+    def _remat_policy():
+        """Save matmul outputs + flash-attention kernel outputs, recompute
+        only the cheap elementwise chain in the backward — full-block remat
+        costs +1/3 step FLOPs, which this policy avoids while still
+        bounding activation memory."""
+        cp = jax.checkpoint_policies
+        policy = getattr(cp, "dots_with_no_batch_dims_saveable", None)
+        names = getattr(cp, "save_only_these_names", None)
+        both = getattr(cp, "save_from_both_policies", None)
+        if policy and names and both:
+            # see flash_attention._flash_vjp_fwd: saving these means the
+            # backward never re-runs the forward kernel
+            policy = both(policy, names("flash_out", "flash_lse"))
+        return policy
+
     def apply(self, params: Dict[str, jax.Array], tokens: jax.Array,
               positions: Optional[jax.Array] = None,
               rng: Optional[jax.Array] = None) -> jax.Array:
         """tokens [B, S] int32 -> logits [B, S, padded_vocab] (f32)."""
         c = self.config
-        B, S = tokens.shape
-        if positions is None:
-            positions = jnp.arange(S)[None, :]
-        x = params["wte"].astype(c.dtype)[tokens] \
-            + params["wpe"].astype(c.dtype)[positions]
-
-        layer_params = {k: v for k, v in params.items()
-                        if v.ndim >= 1 and k not in ("wte", "wpe", "lnf_g", "lnf_b")}
-
-        def block_fn(x, lp):
-            return self._block(x, lp, rng), None
-
-        if c.remat:
-            block_fn = jax.checkpoint(block_fn)  # remat: HBM for FLOPs
-
-        x, _ = jax.lax.scan(block_fn, x, layer_params)
-        x = layernorm(x, params["lnf_g"], params["lnf_b"])
-        # tied LM head; logits in f32 for a stable softmax/loss
-        logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
-                            params["wte"].astype(jnp.float32))
+        x = self._backbone(params, tokens, rng, positions=positions)
+        # tied LM head in bf16 on the MXU fast path, f32 accumulation —
+        # a f32xf32 matmul here runs at 1/4 MXU rate and doubles HBM
+        # traffic on the [B,S,V] logits
+        logits = jnp.einsum("bsd,vd->bsv", x,
+                            params["wte"].astype(c.dtype),
+                            preferred_element_type=jnp.float32)
         return logits
 
     def loss(self, params: Dict[str, jax.Array], tokens: jax.Array,
              targets: jax.Array, rng: Optional[jax.Array] = None) -> jax.Array:
         logits = self.apply(params, tokens, rng=rng)
         return cross_entropy_loss(logits, targets)
+
+    def loss_chunked(self, params: Dict[str, jax.Array], tokens: jax.Array,
+                     targets: jax.Array, rng: Optional[jax.Array] = None,
+                     num_chunks: int = 8) -> jax.Array:
+        """Cross-entropy without materializing the full [B,S,V] f32 logits:
+        the LM head + logsumexp run per token-chunk under jax.checkpoint,
+        so only per-chunk logits ever exist (fwd and bwd) — e.g. 3.3 GB of
+        GPT-2-small logits at B=16,S=1024 become 8 × 412 MB transients.
+        Measured a wash on speed at that size (bench uses plain `loss`);
+        use it when vocab*batch*seq logits don't fit HBM."""
+        c = self.config
+        B, S = tokens.shape
+        x = self._backbone(params, tokens, rng)         # [B,S,D] bf16
+        wte = params["wte"].astype(c.dtype)
+        xt = x.reshape(B * S, -1)
+        tg = targets.reshape(B * S)
+        assert (B * S) % num_chunks == 0
+        xt = xt.reshape(num_chunks, (B * S) // num_chunks, -1)
+        tg = tg.reshape(num_chunks, (B * S) // num_chunks)
+
+        @functools.partial(jax.checkpoint,
+                           policy=jax.checkpoint_policies.nothing_saveable)
+        def chunk_nll(carry, xt_tg):
+            xc, tc = xt_tg
+            logits = jnp.einsum("td,vd->tv", xc, wte,
+                                preferred_element_type=jnp.float32)
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(
+                logits, tc[:, None], axis=-1)[:, 0]
+            return carry + jnp.sum(lse - gold), None
+
+        total, _ = jax.lax.scan(chunk_nll, jnp.float32(0.0), (xt, tg))
+        return total / (B * S)
+
+    def _backbone(self, params: Dict[str, jax.Array], tokens: jax.Array,
+                  rng: Optional[jax.Array] = None,
+                  positions: Optional[jax.Array] = None) -> jax.Array:
+        """Transformer stack up to the final layernorm ([B,S,D], no head)."""
+        c = self.config
+        B, S = tokens.shape
+        if positions is None:
+            positions = jnp.arange(S)[None, :]
+        x = params["wte"].astype(c.dtype)[tokens] \
+            + params["wpe"].astype(c.dtype)[positions]
+        layer_params = {k: v for k, v in params.items()
+                        if k not in ("wte", "wpe", "lnf_g", "lnf_b")}
+
+        def block_fn(x, lp):
+            return self._block(x, lp, rng), None
+
+        if c.remat:
+            block_fn = jax.checkpoint(block_fn, policy=self._remat_policy())
+        x, _ = jax.lax.scan(block_fn, x, layer_params)
+        return layernorm(x, params["lnf_g"], params["lnf_b"])

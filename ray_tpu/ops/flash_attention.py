@@ -1,16 +1,22 @@
-"""Flash attention for TPU (Pallas).
+"""Flash attention for TPU (Pallas) — forward AND backward kernels.
 
-Tiled online-softmax attention: Q blocks stream over the grid; for each Q
-block the kernel walks K/V blocks with a fori_loop keeping running max and
-normalizer in f32 (VPU) and accumulating PV on the MXU. bf16 in, f32
-accumulate — the standard TPU recipe (pallas_guide.md: MXU matmuls with
-preferred_element_type; min tile (16,128) for bf16).
+Tiled online-softmax attention. Layout [B,S,H,D] -> [B*H, S, D]; the grid
+streams Q and K/V blocks so nothing larger than a block is VMEM-resident
+(the round-1 kernel kept whole K/V per head in VMEM, capping sequence
+length). bf16 inputs feed the MXU directly (preferred_element_type=f32
+accumulate); all softmax state is f32 on the VPU — the standard TPU recipe
+(pallas_guide.md: MXU matmuls with preferred_element_type; min tile
+(16,128) for bf16).
 
-Forward is a Pallas kernel; backward is a custom VJP that recomputes
-attention blockwise with jnp (XLA fuses the recompute into the dq/dk/dv
-matmuls — rematerialisation trades FLOPs for HBM, the right default on
-TPU). Causal masking skips fully-masked K blocks via the loop upper bound,
-halving FLOPs for autoregressive models.
+Forward saves the logsumexp per row; backward is two Pallas kernels that
+recompute probabilities from (q, k, lse) inside the kernel — dq in one
+pass over K blocks, dk/dv in one pass over Q blocks — with f32 scratch
+accumulators. Causal masking skips fully-masked blocks via a predicate on
+the grid position, halving FLOPs for autoregressive models.
+
+Reference capability (not design): the reference has no first-party
+attention kernels at all (torch/NCCL stack); this is new TPU-native work
+per SURVEY.md §5.
 """
 from __future__ import annotations
 
@@ -30,106 +36,328 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float, causal: bool,
-                block_q: int, block_k: int, seq_k: int):
-    """Grid: (batch*heads, num_q_blocks). Per call: q_ref (block_q, d);
-    k_ref/v_ref (seq_k, d) — whole K/V for this (batch, head) in VMEM."""
+def _fit_block(block: int, seq: int) -> int:
+    """Largest size <= block that divides seq (stays a multiple of 128
+    when possible so tiles keep MXU-friendly shapes)."""
+    block = min(block, seq)
+    if seq % block == 0:
+        return block
+    for b in range(block - block % 128, 127, -128):
+        if seq % b == 0:
+            return b
+    for b in range(min(block, seq), 0, -1):
+        if seq % b == 0:
+            return b
+    return seq
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *,
+                sm_scale: float, causal: bool,
+                block_q: int, block_k: int, num_kb: int):
+    """Grid: (B*H, num_q_blocks, num_k_blocks); K innermost so the f32
+    scratch (m, l, acc) carries across K iterations for one Q block."""
     qi = pl.program_id(1)
-    q = q_ref[...].astype(jnp.float32) * sm_scale
+    kb = pl.program_id(2)
 
-    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    if causal:
-        # K blocks strictly beyond this Q block's diagonal contribute nothing.
-        num_kb = (qi + 1) * block_q // block_k + ((qi + 1) * block_q % block_k != 0)
-    else:
-        num_kb = seq_k // block_k
+    # Causal: the block [qi*bq, qi*bq+bq) x [kb*bk, kb*bk+bk) intersects the
+    # lower triangle iff its last row can see its first column.
+    run = (qi * block_q + block_q - 1 >= kb * block_k) if causal else True
 
-    def body(kb, carry):
-        m, l, acc = carry
-        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+    @pl.when(run)
+    def _compute():
+        q = q_ref[...]  # (block_q, d) input dtype — MXU fast path
+        k = k_ref[...]
+        v = v_ref[...]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = m_new
 
-    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
-    o_ref[...] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[...], 1e-30)
+        o_ref[...] = (acc_scr[...] / l).astype(o_ref.dtype)
+        lse_ref[...] = m_scr[...] + jnp.log(l)
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k):
-    batch, seq_q, heads, d = q.shape
+    """[B*H, S, D] in -> (out [B*H, S, D], lse [B*H, S])."""
+    bh, seq_q, d = q.shape
     seq_k = k.shape[1]
-    block_q = min(block_q, seq_q)
-    block_k = min(block_k, seq_k)
-    assert seq_q % block_q == 0 and seq_k % block_k == 0, (
-        f"seq ({seq_q},{seq_k}) must divide blocks ({block_q},{block_k})")
-    # fold batch and heads into one grid axis; move heads out of the way:
-    # [B,S,H,D] -> [B*H, S, D]
-    qr = q.transpose(0, 2, 1, 3).reshape(batch * heads, seq_q, d)
-    kr = k.transpose(0, 2, 1, 3).reshape(batch * heads, seq_k, d)
-    vr = v.transpose(0, 2, 1, 3).reshape(batch * heads, seq_k, d)
+    block_q = _fit_block(block_q, seq_q)
+    block_k = _fit_block(block_k, seq_k)
+    num_kb = seq_k // block_k
+    from jax.experimental.pallas import tpu as pltpu
 
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, seq_k=seq_k)
-    grid = (batch * heads, seq_q // block_q)
-    out = pl.pallas_call(
+        block_q=block_q, block_k=block_k, num_kb=num_kb)
+    grid = (bh, seq_q // block_q, num_kb)
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((batch * heads, seq_q, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+            # trailing singleton keeps the block a legal (8k, 128m)-free
+            # tile: (block_q, 1) with 1 == overall dim
+            jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
         interpret=_use_interpret(),
         cost_estimate=pl.CostEstimate(
-            flops=4 * batch * heads * seq_q * seq_k * d // (2 if causal else 1),
-            bytes_accessed=(qr.size + kr.size + vr.size) * q.dtype.itemsize,
-            transcendentals=batch * heads * seq_q * seq_k,
+            flops=4 * bh * seq_q * seq_k * d // (2 if causal else 1),
+            bytes_accessed=(q.size + k.size + v.size) * q.dtype.itemsize,
+            transcendentals=bh * seq_q * seq_k,
         ),
-    )(qr, kr, vr)
-    return out.reshape(batch, heads, seq_q, d).transpose(0, 2, 1, 3)
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, sm_scale: float, causal: bool,
+                   block_q: int, block_k: int, num_kb: int):
+    """Grid: (B*H, num_q_blocks, num_k_blocks); accumulates dq over K."""
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    run = (qi * block_q + block_q - 1 >= kb * block_k) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
+        do = do_ref[...]
+        lse = lse_ref[...]
+        delta = delta_ref[...]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)  # (bq, bk) f32, exactly softmax(s)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_scr[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        dq_ref[...] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *,
+                    sm_scale: float, causal: bool,
+                    block_q: int, block_k: int, num_qb: int):
+    """Grid: (B*H, num_k_blocks, num_q_blocks); accumulates dk/dv over Q."""
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    run = (qi * block_q + block_q - 1 >= kb * block_k) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
+        do = do_ref[...]
+        lse = lse_ref[...]
+        delta = delta_ref[...]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        pt = p.astype(do.dtype)
+        dv_scr[...] += jax.lax.dot_general(
+            pt, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk_scr[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_qb - 1)
+    def _finalize():
+        dk_ref[...] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, g, sm_scale, causal, block_q, block_k):
+    bh, seq_q, d = q.shape
+    seq_k = k.shape[1]
+    block_q = _fit_block(block_q, seq_q)
+    block_k = _fit_block(block_k, seq_k)
+    num_qb = seq_q // block_q
+    num_kb = seq_k // block_k
+    # delta_i = rowsum(dO_i * O_i): cheap elementwise reduce — jnp/XLA.
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+                    keepdims=True)
+
+    interp = _use_interpret()
+    from jax.experimental.pallas import tpu as pltpu
+
+    q_spec = pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0))
+    row_spec = pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0))
+    k_spec = pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_kb=num_kb),
+        grid=(bh, num_qb, num_kb),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interp,
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bh * seq_q * seq_k * d // (2 if causal else 1),
+            bytes_accessed=(q.size * 2 + k.size + v.size) * q.dtype.itemsize,
+            transcendentals=bh * seq_q * seq_k,
+        ),
+    )(q, k, v, g, lse, delta)
+
+    # dk/dv: Q streams in the minor grid dim.
+    qb_spec = pl.BlockSpec((None, block_q, d), lambda b, j, i: (b, i, 0))
+    rowb_spec = pl.BlockSpec((None, block_q, 1), lambda b, j, i: (b, i, 0))
+    kb_spec = pl.BlockSpec((None, block_k, d), lambda b, j, i: (b, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_qb=num_qb),
+        grid=(bh, num_kb, num_qb),
+        in_specs=[qb_spec, kb_spec, kb_spec, qb_spec, rowb_spec, rowb_spec],
+        out_specs=[kb_spec, kb_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_k, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, seq_k, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interp,
+        cost_estimate=pl.CostEstimate(
+            flops=8 * bh * seq_q * seq_k * d // (2 if causal else 1),
+            bytes_accessed=(q.size * 2 + k.size * 2 + v.size * 2)
+            * q.dtype.itemsize,
+            transcendentals=bh * seq_q * seq_k,
+        ),
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom VJP over [B, S, H, D]
+# ---------------------------------------------------------------------------
+
+
+def _to_bhsd(x):
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _from_bhsd(x, b, h):
+    bh, s, d = x.shape
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, sm_scale, causal, block_q, block_k):
-    return _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k)
+    out, _ = _flash_fwd(_to_bhsd(q), _to_bhsd(k), _to_bhsd(v),
+                        sm_scale, causal, block_q, block_k)
+    return _from_bhsd(out, q.shape[0], q.shape[2])
 
 
 def _flash_vjp_fwd(q, k, v, sm_scale, causal, block_q, block_k):
-    out = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k)
-    return out, (q, k, v)
+    from jax.ad_checkpoint import checkpoint_name
+
+    qr, kr, vr = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
+    out, lse = _flash_fwd(qr, kr, vr, sm_scale, causal, block_q, block_k)
+    # Named so a remat policy can choose to SAVE these residuals: pallas
+    # outputs are not dots, so a dots-saveable policy would otherwise
+    # re-run the forward kernel inside the backward pass.
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
+    return (_from_bhsd(out, q.shape[0], q.shape[2]),
+            (qr, kr, vr, out, lse, q.shape[0], q.shape[2]))
 
 
 def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, res, g):
-    # Rematerialised backward: recompute probabilities with the reference
-    # formulation and let XLA fuse. O(S^2) memory is avoided by checkpointing
-    # at the layer level (jax.checkpoint in the model); for very long S the
-    # ring_attention path tiles the backward too.
-    q, k, v = res
-
-    def f(q, k, v):
-        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
-
-    _, vjp = jax.vjp(f, q, k, v)
-    return vjp(g)
+    qr, kr, vr, out, lse, b, h = res
+    dq, dk, dv = _flash_bwd(qr, kr, vr, out, lse, _to_bhsd(g),
+                            sm_scale, causal, block_q, block_k)
+    return (_from_bhsd(dq, b, h), _from_bhsd(dk, b, h), _from_bhsd(dv, b, h))
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -138,13 +366,20 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True,
                     sm_scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128) -> jax.Array:
+                    block_q: int = 1024, block_k: int = 1024) -> jax.Array:
     """Flash attention. q/k/v: [batch, seq, heads, head_dim] -> same shape.
 
     head_dim should be a multiple of 128 for MXU efficiency (pads are the
     caller's job — model dims are chosen MXU-friendly instead)."""
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if causal and q.shape[1] != k.shape[1]:
+        # The kernels' diagonal masks assume square attention; the reference
+        # formulation applies a (seq_k - seq_q) offset this path does not.
+        raise ValueError(
+            f"causal flash_attention requires seq_q == seq_k, got "
+            f"{q.shape[1]} != {k.shape[1]}; use mha_reference for "
+            "offset-causal decode")
     if q.shape[1] < 8:  # tiny decode steps: kernel launch not worth it
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
     return _flash(q, k, v, sm_scale, causal, block_q, block_k)

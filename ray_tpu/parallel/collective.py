@@ -95,8 +95,11 @@ class CollectiveGroup:
         # num_cpus=0: the store is a pure rendezvous point and must schedule
         # even on a fully-subscribed cluster (ranks hold all the CPUs while
         # they block in _exchange).
+        # Name scoped by world_size so re-creating a group with a different
+        # size can never attach to a stale store left by the old group.
+        self._store_name = f"rtpu_collective:{group_name}:{world_size}"
         self._store = store_cls.options(
-            name=f"rtpu_collective:{group_name}",
+            name=self._store_name,
             get_if_exists=True, lifetime="detached", num_cpus=0,
             max_concurrency=max(8, world_size * 2),
         ).remote(world_size)
@@ -139,7 +142,16 @@ def create_collective_group(world_size: int, rank: int,
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
-    _groups().pop(group_name, None)
+    """Tear down the local view AND the detached rendezvous store, so a
+    future group with the same name starts from a clean slate (a leaked
+    detached store would otherwise survive across jobs with stale slot
+    rows from any timed-out collective)."""
+    g = _groups().pop(group_name, None)
+    if g is not None:
+        try:
+            ray_tpu.kill(g._store)
+        except Exception:
+            pass
 
 
 def get_group(group_name: str = "default") -> CollectiveGroup:

@@ -80,6 +80,7 @@ class BackendExecutor:
               dataset_shards: Optional[List[dict]] = None,
               checkpoint=None) -> None:
         s = self.scaling
+        self._pending = {}
         n = s.num_workers
         res = s.worker_resources()
         bundles = [dict(res) for _ in range(n)]
@@ -117,19 +118,32 @@ class BackendExecutor:
 
     # ---- result streaming --------------------------------------------------
 
+    def _drain_queue(self) -> None:
+        """Pull every queued report into the persistent per-iteration buffer.
+
+        The buffer must live on `self`: a single drain can dequeue partial
+        rows for several iterations at once, and any rows not returned by
+        this call must survive until their iteration completes (round-1 bug:
+        a call-local buffer silently dropped them)."""
+        for p in self.queue.get_batch(256):
+            self._pending.setdefault(p["iteration"], {})[p["rank"]] = p
+
+    def _pop_complete(self) -> Optional[List[dict]]:
+        for it in sorted(self._pending):
+            if len(self._pending[it]) == len(self.workers):
+                row = self._pending.pop(it)
+                return [row[r] for r in sorted(row)]
+        return None
+
     def next_results(self, timeout: float = 600.0) -> Optional[List[dict]]:
         """One result per rank for the next finished iteration, or None when
         training completed. Raises TrainWorkerError on a dead worker."""
         deadline = time.monotonic() + timeout
-        iter_buf: Dict[int, Dict[int, dict]] = {}
         while True:
-            for p in self.queue.get_batch(256):
-                iter_buf.setdefault(p["iteration"], {})[p["rank"]] = p
-                self._pending.setdefault(p["iteration"], {})
-            for it in sorted(iter_buf):
-                if len(iter_buf[it]) == len(self.workers):
-                    row = iter_buf.pop(it)
-                    return [row[r] for r in sorted(row)]
+            self._drain_queue()
+            row = self._pop_complete()
+            if row is not None:
+                return row
             done, _ = ray_tpu.wait(self._run_refs,
                                    num_returns=len(self._run_refs), timeout=0.0)
             if len(done) == len(self._run_refs):
@@ -138,13 +152,8 @@ class BackendExecutor:
                     ray_tpu.get(self._run_refs)
                 except ray_tpu.exceptions.RayTpuError as e:
                     raise TrainWorkerError(str(e)) from e
-                for p in self.queue.get_batch(256):
-                    iter_buf.setdefault(p["iteration"], {})[p["rank"]] = p
-                for it in sorted(iter_buf):
-                    if len(iter_buf[it]) == len(self.workers):
-                        row = iter_buf.pop(it)
-                        return [row[r] for r in sorted(row)]
-                return None
+                self._drain_queue()
+                return self._pop_complete()
             if time.monotonic() > deadline:
                 raise TrainWorkerError(
                     f"timed out waiting for training results ({timeout}s)")

@@ -49,6 +49,10 @@ class RunConfig:
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
     verbose: int = 0
+    # Tune stop criteria, e.g. {"training_iteration": 10} — a trial stops
+    # when any key's reported value reaches the threshold (ref: air.RunConfig
+    # stop / tune/stopper.py)
+    stop: Optional[Dict[str, Any]] = None
 
     def resolved_storage_path(self) -> str:
         base = self.storage_path or os.path.expanduser("~/ray_tpu_results")

@@ -1,0 +1,29 @@
+"""ray_tpu.tune — hyperparameter sweep engine.
+
+Parity with the reference's Ray Tune (ref: python/ray/tune/__init__.py):
+Tuner/TuneConfig/ResultGrid, function + class Trainables with
+tune.report(), search spaces (grid/random/domains), trial schedulers
+(FIFO/ASHA/MedianStopping/PBT). Trials run as actors in per-trial
+placement groups under a single-threaded controller event loop.
+"""
+from ..train.config import RunConfig
+from .schedulers import (ASHAScheduler, AsyncHyperBandScheduler,
+                         FIFOScheduler, MedianStoppingRule,
+                         PopulationBasedTraining, TrialScheduler)
+from .search import (BasicVariantGenerator, Choice, Domain, GridSearch,
+                     LogUniform, Randint, RandomSearch, Searcher, Uniform,
+                     choice, grid_search, loguniform, randint, uniform)
+from .session import get_checkpoint, report
+from .trainable import Trainable
+from .tuner import (ResultGrid, Trial, TuneConfig, TuneController, Tuner,
+                    run)
+
+__all__ = [
+    "Tuner", "TuneConfig", "TuneController", "ResultGrid", "Trial", "run",
+    "Trainable", "report", "get_checkpoint", "RunConfig",
+    "TrialScheduler", "FIFOScheduler", "AsyncHyperBandScheduler",
+    "ASHAScheduler", "MedianStoppingRule", "PopulationBasedTraining",
+    "Searcher", "BasicVariantGenerator", "RandomSearch",
+    "Domain", "Uniform", "LogUniform", "Randint", "Choice", "GridSearch",
+    "uniform", "loguniform", "randint", "choice", "grid_search",
+]

@@ -1,0 +1,61 @@
+"""Function-trainable session: `tune.report` / `tune.get_checkpoint`.
+
+Parity with the reference's session bridge (ref: python/ray/tune/
+trainable/function_trainable.py — function trainables report through
+`session.report`, results are consumed by the controller one iteration at
+a time). Each trial actor owns a dedicated worker process, so the session
+is a module global guarded by a lock.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Optional
+
+
+class _Session:
+    def __init__(self, checkpoint=None):
+        self.results: "queue.Queue[Any]" = queue.Queue()
+        self.checkpoint = checkpoint
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+
+
+_lock = threading.Lock()
+_session: Optional[_Session] = None
+
+
+def _init_session(checkpoint=None) -> _Session:
+    global _session
+    with _lock:
+        _session = _Session(checkpoint)
+        return _session
+
+
+def _get_session() -> Optional[_Session]:
+    return _session
+
+
+def _shutdown_session() -> None:
+    global _session
+    with _lock:
+        _session = None
+
+
+def report(metrics: Optional[dict] = None, checkpoint=None, **kw) -> None:
+    """Report one iteration's metrics (and optionally a checkpoint) from a
+    function trainable (ref: tune's session.report)."""
+    s = _get_session()
+    if s is None:
+        raise RuntimeError(
+            "tune.report() called outside a Tune trial; run this function "
+            "via Tuner(...).fit()")
+    m = dict(metrics or {})
+    m.update(kw)
+    s.results.put({"metrics": m, "checkpoint": checkpoint})
+
+
+def get_checkpoint():
+    """The checkpoint this trial should resume from (or None)."""
+    s = _get_session()
+    return s.checkpoint if s is not None else None

@@ -1,0 +1,154 @@
+"""Trainable — the unit of execution for one trial.
+
+Parity with the reference (ref: python/ray/tune/trainable/trainable.py —
+class API setup/step/save_checkpoint/load_checkpoint; trainable.py:1398
+save/restore; function_trainable.py runs the user function on a thread and
+streams reports). The controller talks to a `_TrialRunner` actor hosting
+either form behind one interface: step() -> result dict, save() -> dict,
+restore(dict).
+"""
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+from . import session as _session
+
+
+class Trainable:
+    """Class-API base. Subclass and override setup/step/save_checkpoint/
+    load_checkpoint."""
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        self.config = config
+
+    def step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def save_checkpoint(self) -> Dict[str, Any]:
+        return {}
+
+    def load_checkpoint(self, checkpoint: Dict[str, Any]) -> None:
+        pass
+
+    def cleanup(self) -> None:
+        pass
+
+    def reset_config(self, new_config: Dict[str, Any]) -> bool:
+        """Return True if the trainable can adopt new_config in place
+        (PBT fast path; ref: trainable.py reset_config)."""
+        return False
+
+
+class FunctionRunner:
+    """Adapts a function trainable to the step() interface: the function
+    runs on a daemon thread, `tune.report` enqueues results, step() pops
+    one per call."""
+
+    def __init__(self, fn: Callable, config: Dict[str, Any], checkpoint):
+        self._sess = _session._init_session(checkpoint)
+        self._config = config
+
+        def runner():
+            try:
+                fn(config)
+            except BaseException as e:  # noqa: BLE001 — surfaced via step()
+                self._sess.error = e
+                traceback.print_exc()
+            finally:
+                self._sess.done.set()
+
+        self._thread = threading.Thread(target=runner, daemon=True)
+        self._thread.start()
+        self._last_checkpoint: Optional[Dict[str, Any]] = None
+
+    def step(self, timeout: float = 600.0) -> Optional[Dict[str, Any]]:
+        import queue
+
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                item = self._sess.results.get(timeout=0.05)
+            except queue.Empty:
+                item = None
+            if item is not None:
+                if item.get("checkpoint") is not None:
+                    ck = item["checkpoint"]
+                    self._last_checkpoint = (
+                        ck.to_dict() if hasattr(ck, "to_dict") else dict(ck))
+                return item["metrics"]
+            if self._sess.done.is_set() and self._sess.results.empty():
+                if self._sess.error is not None:
+                    raise self._sess.error
+                return None  # function returned: trial complete
+            if time.monotonic() > deadline:
+                raise TimeoutError("function trainable produced no report")
+
+    def save_checkpoint(self) -> Dict[str, Any]:
+        return dict(self._last_checkpoint or {})
+
+    def cleanup(self) -> None:
+        _session._shutdown_session()
+
+
+class _TrialRunner:
+    """Actor hosting one trial (function or class trainable)."""
+
+    def __init__(self, trainable: Any, config: Dict[str, Any],
+                 checkpoint: Optional[Dict[str, Any]] = None):
+        import cloudpickle
+
+        if isinstance(trainable, bytes):
+            trainable = cloudpickle.loads(trainable)
+        self._config = dict(config)
+        ck = dict(checkpoint or {})
+        self._iteration = int(ck.pop("__iteration__", 0))
+        if isinstance(trainable, type) and issubclass(trainable, Trainable):
+            self._kind = "class"
+            self._obj = trainable()
+            self._obj.setup(dict(config))
+            if ck:
+                self._obj.load_checkpoint(ck)
+        else:
+            self._kind = "function"
+            self._obj = FunctionRunner(trainable, dict(config), ck or None)
+
+    def step(self) -> Optional[Dict[str, Any]]:
+        """One training iteration; None when the trainable is finished."""
+        result = self._obj.step()
+        if result is None:
+            return None
+        self._iteration += 1
+        result = dict(result)
+        result.setdefault("training_iteration", self._iteration)
+        result.setdefault("trial_iteration", self._iteration)
+        return result
+
+    def save(self) -> Dict[str, Any]:
+        ck = self._obj.save_checkpoint()
+        return {"__iteration__": self._iteration, **(ck or {})}
+
+    def restore(self, checkpoint: Dict[str, Any]) -> bool:
+        ck = dict(checkpoint)
+        self._iteration = int(ck.pop("__iteration__", self._iteration))
+        if self._kind == "class":
+            self._obj.load_checkpoint(ck)
+            return True
+        return False  # function trainables restart via a fresh actor
+
+    def reset(self, new_config: Dict[str, Any]) -> bool:
+        if self._kind == "class":
+            ok = self._obj.reset_config(dict(new_config))
+            if ok:
+                self._config = dict(new_config)
+            return bool(ok)
+        return False
+
+    def stop(self) -> bool:
+        try:
+            self._obj.cleanup()
+        except Exception:
+            pass
+        return True

@@ -1,0 +1,375 @@
+"""Tuner + TuneController — the experiment engine.
+
+Parity with the reference (ref: python/ray/tune/tuner.py:320 Tuner.fit;
+tune/execution/tune_controller.py:49, event loop `step`:267 — trials run
+as actors inside per-trial placement groups, results stream back one
+iteration at a time, schedulers stop/perturb trials, searchers generate
+configs). PBT exploit/explore swaps checkpoints through the object store
+(ref: tune/schedulers/pbt.py).
+"""
+from __future__ import annotations
+
+import os
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.core.placement_group import placement_group, remove_placement_group
+
+from ..train.config import Result, RunConfig
+from .schedulers import CONTINUE, STOP, FIFOScheduler, PopulationBasedTraining, TrialScheduler
+from .search import BasicVariantGenerator, Searcher
+from .trainable import _TrialRunner
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+@dataclass
+class TuneConfig:
+    """ref: python/ray/tune/tune_config.py"""
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    search_alg: Optional[Searcher] = None
+    scheduler: Optional[TrialScheduler] = None
+    trial_resources: Dict[str, float] = field(default_factory=lambda: {"CPU": 1.0})
+    reuse_actors: bool = False
+    seed: Optional[int] = None
+
+
+class Trial:
+    _next = [0]
+
+    def __init__(self, config: Dict[str, Any]):
+        Trial._next[0] += 1
+        self.trial_id = f"trial_{Trial._next[0]:05d}"
+        self.config = dict(config)
+        self.status = PENDING
+        self.runner = None
+        self.pg = None
+        self.future = None
+        self.last_result: Optional[dict] = None
+        self.metrics_history: List[dict] = []
+        self.latest_checkpoint: Optional[dict] = None
+        self.error: Optional[BaseException] = None
+        self.pbt_ready = False
+
+    def __repr__(self):
+        return f"Trial({self.trial_id}, {self.status})"
+
+
+class ResultGrid:
+    """ref: python/ray/tune/result_grid.py"""
+
+    def __init__(self, results: List[Result], metric: Optional[str],
+                 mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def errors(self) -> List[BaseException]:
+        return [r.error for r in self._results if r.error is not None]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("No metric to rank results by")
+        ok = [r for r in self._results
+              if r.error is None and metric in (r.metrics or {})]
+        if not ok:
+            raise RuntimeError("No successful trial reported the metric")
+        key = lambda r: float(r.metrics[metric])  # noqa: E731
+        return (max if mode == "max" else min)(ok, key=key)
+
+    def get_dataframe(self):
+        rows = [dict(r.metrics or {}) for r in self._results]
+        try:
+            import pandas as pd
+
+            return pd.DataFrame(rows)
+        except Exception:
+            return rows
+
+
+class TuneController:
+    """Single-threaded event loop driving all trials
+    (ref: tune_controller.py:49; step:267)."""
+
+    def __init__(self, trainable: Any, param_space: Dict[str, Any],
+                 tune_config: TuneConfig, run_config: RunConfig):
+        self.tc = tune_config
+        self.rc = run_config
+        self._trainable_blob = cloudpickle.dumps(trainable)
+        self.searcher = tune_config.search_alg or BasicVariantGenerator(
+            num_samples=tune_config.num_samples, seed=tune_config.seed)
+        self.searcher.set_space(dict(param_space or {}),
+                                tune_config.metric, tune_config.mode)
+        self.scheduler = tune_config.scheduler or FIFOScheduler()
+        if tune_config.metric:
+            self.scheduler.set_metric(tune_config.metric, tune_config.mode)
+        self.trials: List[Trial] = []
+        self._exhausted = False
+        # checkpoint cadence: PBT needs one per perturbation interval
+        freq = run_config.checkpoint_config.checkpoint_frequency
+        if not freq and isinstance(self.scheduler, PopulationBasedTraining):
+            freq = 1
+        self._ckpt_freq = freq
+
+    # -- scheduler-facing API (ref: pbt.py uses these) -----------------------
+
+    def running_trials(self) -> List[Trial]:
+        return [t for t in self.trials if t.status == RUNNING]
+
+    def all_trials(self) -> List[Trial]:
+        return list(self.trials)
+
+    def exploit_trial(self, trial: Trial, donor: Trial,
+                      new_config: Dict[str, Any]) -> None:
+        """PBT exploit+explore: trial adopts donor's checkpoint and a
+        mutated config — implemented as an actor swap (ref: pbt.py
+        _exploit; trial restore via checkpoint)."""
+        try:
+            donor_ckpt = ray_tpu.get(donor.runner.save.remote(), timeout=60)
+        except Exception:
+            return
+        self._stop_runner(trial)
+        trial.config = dict(new_config)
+        trial.latest_checkpoint = donor_ckpt
+        self._start_runner(trial, checkpoint=donor_ckpt)
+
+    # -- trial lifecycle -----------------------------------------------------
+
+    def _start_runner(self, trial: Trial, checkpoint: Optional[dict] = None):
+        res = dict(self.tc.trial_resources)
+        if trial.pg is None:
+            trial.pg = placement_group([dict(res)], strategy="PACK")
+            if not trial.pg.ready(timeout=60.0):
+                raise RuntimeError(f"{trial.trial_id}: placement group not ready")
+        cls = ray_tpu.remote(_TrialRunner)
+        trial.runner = cls.options(
+            num_cpus=res.get("CPU", 1.0),
+            resources={k: v for k, v in res.items() if k != "CPU"},
+            placement_group=trial.pg,
+            placement_group_bundle_index=0,
+        ).remote(self._trainable_blob, trial.config, checkpoint)
+        trial.status = RUNNING
+        trial.future = trial.runner.step.remote()
+
+    def _stop_runner(self, trial: Trial) -> None:
+        if trial.runner is not None:
+            try:
+                ray_tpu.kill(trial.runner)
+            except Exception:
+                pass
+        trial.runner = None
+        trial.future = None
+
+    def _finish(self, trial: Trial, status: str,
+                error: Optional[BaseException] = None) -> None:
+        self._stop_runner(trial)
+        if trial.pg is not None:
+            try:
+                remove_placement_group(trial.pg)
+            except Exception:
+                pass
+            trial.pg = None
+        trial.status = status
+        trial.error = error
+        self.scheduler.on_complete(trial, trial.last_result)
+        self.searcher.on_trial_complete(trial.trial_id, trial.last_result)
+
+    def _should_stop(self, result: dict) -> bool:
+        stop = getattr(self.rc, "stop", None) or {}
+        for k, v in stop.items():
+            if k in result and float(result[k]) >= float(v):
+                return True
+        return False
+
+    def _maybe_checkpoint(self, trial: Trial, result: dict) -> None:
+        it = int(result.get("training_iteration", 0))
+        if self._ckpt_freq and it % self._ckpt_freq == 0:
+            try:
+                trial.latest_checkpoint = ray_tpu.get(
+                    trial.runner.save.remote(), timeout=60)
+            except Exception:
+                pass
+
+    # -- the loop ------------------------------------------------------------
+
+    def _capacity(self) -> int:
+        if self.tc.max_concurrent_trials:
+            return self.tc.max_concurrent_trials
+        cpus = ray_tpu.cluster_resources().get("CPU", 1.0)
+        per = self.tc.trial_resources.get("CPU", 1.0) or 1.0
+        return max(1, int(cpus / per))
+
+    def _fill(self) -> None:
+        cap = self._capacity()
+        while len(self.running_trials()) < cap:
+            pending = [t for t in self.trials if t.status == PENDING]
+            if pending:
+                t = pending[0]
+            elif not self._exhausted:
+                cfg = self.searcher.suggest(f"trial_{len(self.trials)}")
+                if cfg is None:
+                    self._exhausted = True
+                    return
+                t = Trial(cfg)
+                self.trials.append(t)
+            else:
+                return
+            try:
+                self._start_runner(t)
+            except Exception as e:  # noqa: BLE001
+                traceback.print_exc()
+                self._finish(t, ERROR, e)
+
+    def run(self) -> List[Trial]:
+        while True:
+            self._fill()
+            active = {t.future: t for t in self.running_trials()
+                      if t.future is not None}
+            if not active:
+                pending = [t for t in self.trials if t.status == PENDING]
+                if not pending and self._exhausted:
+                    break
+                if not pending and not self.trials:
+                    self._exhausted = True  # empty space: nothing to do
+                    break
+                continue
+            # Drain EVERY completed future this pass, so trials advance one
+            # iteration per loop in round-robin rather than one trial
+            # running to completion first — ASHA's rung cutoffs need
+            # interleaved arrivals to have a comparison population (ref:
+            # tune_controller.py step:267 processes events fairly).
+            done, _ = ray_tpu.wait(list(active), num_returns=len(active),
+                                   timeout=0.2)
+            if not done:
+                done, _ = ray_tpu.wait(list(active), num_returns=1,
+                                       timeout=5.0)
+            for fut in done:
+                trial = active[fut]
+                try:
+                    result = ray_tpu.get(fut)
+                except Exception as e:  # noqa: BLE001 — trial failure
+                    self._finish(trial, ERROR, e)
+                    continue
+                if result is None:
+                    self._finish(trial, TERMINATED)
+                    continue
+                trial.last_result = result
+                trial.metrics_history.append(result)
+                self._maybe_checkpoint(trial, result)
+                decision = self.scheduler.on_result(trial, result)
+                if decision == STOP or self._should_stop(result):
+                    self._finish(trial, TERMINATED)
+                else:
+                    # PBT may swap the runner (and queue a fresh step)
+                    # underneath us — only re-issue if the consumed future
+                    # is still the trial's current one.
+                    self.scheduler.choose_action(self)
+                    if (trial.status == RUNNING and trial.runner is not None
+                            and trial.future is fut):
+                        trial.future = trial.runner.step.remote()
+            self.scheduler.choose_action(self)
+        return self.trials
+
+
+class Tuner:
+    """ref: python/ray/tune/tuner.py:320. Also accepts a Train trainer
+    instance (ref: train/base_trainer.py:829 — a Trainer becomes a
+    Trainable): param_space keys override the trainer's train_loop_config.
+    """
+
+    def __init__(self, trainable: Any = None, *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        from ..train.trainer import DataParallelTrainer
+
+        if isinstance(trainable, DataParallelTrainer):
+            trainable = _trainer_to_trainable(trainable)
+        self.trainable = trainable
+        self.param_space = dict(param_space or {})
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> ResultGrid:
+        controller = TuneController(self.trainable, self.param_space,
+                                    self.tune_config, self.run_config)
+        trials = controller.run()
+        base = self.run_config.resolved_storage_path()
+        os.makedirs(base, exist_ok=True)
+        results = []
+        for t in trials:
+            ck = None
+            if t.latest_checkpoint:
+                from ..train.checkpoint import Checkpoint
+
+                ck = Checkpoint.from_dict(t.latest_checkpoint)
+            results.append(Result(
+                metrics=dict(t.last_result or {}),
+                checkpoint=ck,
+                path=os.path.join(base, t.trial_id),
+                error=t.error,
+                metrics_history=list(t.metrics_history)))
+        return ResultGrid(results, self.tune_config.metric,
+                          self.tune_config.mode)
+
+
+def _trainer_to_trainable(trainer) -> Callable:
+    """Wrap a DataParallelTrainer so each trial re-fits it with the trial
+    config merged into train_loop_config, streaming history entries as
+    reports (ref: base_trainer.py:829 as_trainable)."""
+    import copy
+
+    from . import session as _sess
+
+    base = trainer
+
+    def train_fn(config: Dict[str, Any]) -> None:
+        t = copy.copy(base)
+        t.train_config = {**base.train_config, **config}
+        result = t.fit()
+        if result.error is not None:
+            raise result.error
+        for entry in result.metrics_history or [result.metrics or {}]:
+            _sess.report(dict(entry))
+
+    return train_fn
+
+
+def run(trainable, config: Optional[Dict[str, Any]] = None,
+        num_samples: int = 1, metric: Optional[str] = None,
+        mode: str = "max", scheduler: Optional[TrialScheduler] = None,
+        stop: Optional[Dict[str, Any]] = None,
+        **kw) -> ResultGrid:
+    """Legacy-style entry point (ref: tune/tune.py:292 tune.run)."""
+    rc = RunConfig()
+    if stop:
+        rc.stop = stop  # type: ignore[attr-defined]
+    return Tuner(
+        trainable, param_space=config,
+        tune_config=TuneConfig(metric=metric, mode=mode,
+                               num_samples=num_samples, scheduler=scheduler),
+        run_config=rc).fit()

@@ -39,6 +39,21 @@ class TestGPT:
             l1, params = step(params)
         assert float(l1) < float(l0)
 
+    def test_loss_chunked_matches_loss(self):
+        cfg = GPTConfig.tiny(dtype=jnp.float32, remat=False, use_flash=False)
+        model = GPT(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 512)
+        targets = jnp.roll(tokens, -1, axis=1)
+        full = model.loss(params, tokens, targets)
+        chunked = model.loss_chunked(params, tokens, targets, num_chunks=4)
+        np.testing.assert_allclose(float(full), float(chunked), rtol=1e-5)
+        g1 = jax.grad(model.loss)(params, tokens, targets)
+        g2 = jax.grad(lambda p: model.loss_chunked(p, tokens, targets,
+                                                   num_chunks=4))(params)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            a, b, atol=1e-4, rtol=1e-4), g1, g2)
+
     def test_causality(self):
         cfg = GPTConfig.tiny(dtype=jnp.float32, use_flash=False, remat=False)
         model = GPT(cfg)

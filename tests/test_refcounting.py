@@ -1,0 +1,119 @@
+"""Worker-held reference counting (ref: reference_count.h:61 borrower
+protocol; round-1 weak #4 — results of worker-submitted tasks were freed
+out from under the workers holding them)."""
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def rt():
+    rt = ray_tpu.init(num_cpus=4)
+    yield rt
+    ray_tpu.shutdown()
+
+
+def test_worker_submitted_results_survive_driver_gc(rt):
+    """A worker submits tasks and gets their results while the driver holds
+    no refs at all; head GC must not free them (round-1 hang)."""
+
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer():
+        import gc
+
+        total = 0
+        for i in range(30):
+            ref = inner.remote(i)
+            gc.collect()  # churn the head's transient refs
+            total += ray_tpu.get(ref, timeout=30)
+        return total
+
+    assert ray_tpu.get(outer.remote(), timeout=120) == 2 * sum(range(30))
+
+
+def test_worker_put_survives_task_arg_unpin(rt):
+    """A worker puts an object, passes it as an arg to a task (pin+unpin),
+    and can still get it afterwards — the unpin must not free it while the
+    worker still holds the ref."""
+
+    @ray_tpu.remote
+    def reader(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def owner():
+        import gc
+
+        ref = ray_tpu.put(41)
+        out = ray_tpu.get(reader.remote(ref), timeout=30)
+        gc.collect()
+        time.sleep(0.2)
+        # the put object must still be alive for the holder
+        again = ray_tpu.get(ref, timeout=30)
+        return (out, again)
+
+    assert ray_tpu.get(owner.remote(), timeout=60) == (42, 41)
+
+
+def test_borrowed_ref_outlives_owner_task(rt):
+    """An actor stores a ref it received as an argument; the object must
+    stay alive after the submitting task's pins are gone."""
+
+    @ray_tpu.remote
+    class Keeper:
+        def __init__(self):
+            self.ref = None
+
+        def keep(self, refs):
+            # nested in a list so the runtime passes the ref itself rather
+            # than resolving it to its value (reference arg semantics)
+            self.ref = refs[0]
+            return True
+
+        def read(self):
+            return ray_tpu.get(self.ref, timeout=30)
+
+    @ray_tpu.remote
+    def producer(keeper):
+        ref = ray_tpu.put({"v": 7})
+        ray_tpu.get(keeper.keep.remote([ref]), timeout=30)
+        return True
+
+    k = Keeper.remote()
+    assert ray_tpu.get(producer.remote(k), timeout=60)
+    import gc
+
+    gc.collect()
+    time.sleep(0.3)
+    assert ray_tpu.get(k.read.remote(), timeout=30) == {"v": 7}
+
+
+def test_dead_worker_refs_released(rt):
+    """Refs held by a killed actor are swept so objects don't leak."""
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.refs = []
+
+        def hold(self, n):
+            self.refs = [ray_tpu.put(b"x" * 10) for _ in range(n)]
+            return [r.id for r in self.refs]
+
+    h = Holder.remote()
+    oids = ray_tpu.get(h.hold.remote(5), timeout=30)
+    # holder refs registered on the head
+    assert any(rt.refcount.counts(o)[2] > 0 for o in oids)
+    ray_tpu.kill(h)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if all(rt.refcount.counts(o)[2] == 0 for o in oids):
+            break
+        time.sleep(0.1)
+    assert all(rt.refcount.counts(o)[2] == 0 for o in oids)
