@@ -1,0 +1,18 @@
+"""ray_tpu.data — lazy, streaming, distributed datasets.
+
+Equivalent of Ray Data (ref: python/ray/data/): logical plan + streaming
+executor over ray_tpu tasks/actor pools, columnar numpy blocks in the
+object store, sharded ingest for ray_tpu.train workers.
+"""
+from .block import Block
+from .context import DataContext
+from .dataset import (ActorPoolStrategy, Dataset, from_blocks, from_items,
+                      from_numpy, range, read_csv, read_json, read_numpy,
+                      read_parquet)
+from .iterator import DataShard
+
+__all__ = [
+    "ActorPoolStrategy", "Block", "DataContext", "DataShard", "Dataset",
+    "from_blocks", "from_items", "from_numpy", "range", "read_csv",
+    "read_json", "read_numpy", "read_parquet",
+]

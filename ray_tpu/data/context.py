@@ -1,0 +1,24 @@
+"""Execution context / tunables (ref: python/ray/data/context.py DataContext)."""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass
+class DataContext:
+    # backpressure: max blocks in flight per streaming stage
+    # (ref: streaming_executor_state.py resource limits)
+    max_in_flight_blocks: int = 16
+    default_parallelism: int = 8
+    target_min_rows_per_block: int = 1000
+
+    _current = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        with cls._lock:
+            if cls._current is None:
+                cls._current = cls()
+            return cls._current

@@ -1,0 +1,374 @@
+"""Dataset — the lazy, distributed data API.
+
+Parity with the reference's Dataset (ref: python/ray/data/dataset.py;
+read_api.py; plan execution via _internal/plan.py:544 → streaming
+executor). Transforms are lazy logical ops; execution streams blocks
+through tasks/actor pools with bounded in-flight blocks. Blocks are
+columnar numpy dicts (see block.py) — the natural feed format for jax.
+"""
+from __future__ import annotations
+
+import builtins
+import math
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+
+import cloudpickle
+import numpy as np
+
+import ray_tpu
+
+from .block import (Block, block_concat, block_from_batch, block_from_items,
+                    block_num_rows, block_size_bytes, block_to_batch,
+                    block_to_rows)
+from .context import DataContext
+from .executor import StreamingExecutor
+from .iterator import DataShard, _iter_batches_from_blocks
+from .plan import AllToAllOp, MapOp, SourceOp, build_segments
+
+
+@dataclass
+class ActorPoolStrategy:
+    """compute= strategy running the UDF on a pool of actors (ref:
+    python/ray/data/_internal/compute.py ActorPoolStrategy)."""
+    size: int = 2
+    resources: Optional[Dict[str, float]] = None
+
+
+class Dataset:
+    def __init__(self, ops: List[Any], context: Optional[DataContext] = None):
+        self._ops = ops
+        self._ctx = context or DataContext.get_current()
+        self._last_stats: Optional[dict] = None
+
+    # -- transforms (lazy) ---------------------------------------------------
+
+    def _with(self, op) -> "Dataset":
+        return Dataset(self._ops + [op], self._ctx)
+
+    def map_batches(self, fn: Union[Callable, type], *,
+                    batch_format: str = "numpy",
+                    fn_constructor_args: tuple = (),
+                    compute: Optional[ActorPoolStrategy] = None,
+                    **_ignored) -> "Dataset":
+        """Apply fn to whole blocks. A class UDF runs on an actor pool
+        (constructed once per actor)."""
+        if isinstance(fn, type):
+            ctor_args = fn_constructor_args
+
+            class _Bound:
+                def __init__(self, cls=fn, args=ctor_args):
+                    self._inst = cls(*args)
+
+                def __call__(self, batch):
+                    return self._inst(batch)
+
+            inst_holder: list = []
+
+            def block_fn(block: Block) -> Block:
+                if not inst_holder:
+                    inst_holder.append(_Bound())
+                return block_from_batch(
+                    inst_holder[0](block_to_batch(block, batch_format)))
+
+            if compute is None:
+                compute = ActorPoolStrategy()
+        else:
+            def block_fn(block: Block) -> Block:
+                return block_from_batch(fn(block_to_batch(block, batch_format)))
+
+        c = (compute.size, compute.resources) if compute is not None else None
+        return self._with(MapOp(block_fn, name="map_batches", compute=c))
+
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        def block_fn(block: Block) -> Block:
+            return block_from_items([fn(r) for r in block_to_rows(block)])
+
+        return self._with(MapOp(block_fn, name="map"))
+
+    def flat_map(self, fn: Callable[[Any], List[Any]]) -> "Dataset":
+        def block_fn(block: Block) -> Block:
+            out: List[Any] = []
+            for r in block_to_rows(block):
+                out.extend(fn(r))
+            return block_from_items(out)
+
+        return self._with(MapOp(block_fn, name="flat_map"))
+
+    def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
+        def block_fn(block: Block) -> Block:
+            rows = [r for r in block_to_rows(block) if fn(r)]
+            return block_from_items(rows)
+
+        return self._with(MapOp(block_fn, name="filter"))
+
+    def add_column(self, name: str, fn: Callable[[Dict[str, np.ndarray]], Any]
+                   ) -> "Dataset":
+        def block_fn(block: Block) -> Block:
+            out = dict(block)
+            out[name] = np.asarray(fn(block))
+            return out
+
+        return self._with(MapOp(block_fn, name=f"add_column[{name}]"))
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        def block_fn(block: Block) -> Block:
+            return {k: v for k, v in block.items() if k not in cols}
+
+        return self._with(MapOp(block_fn, name="drop_columns"))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._with(AllToAllOp("repartition", num_blocks))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return self._with(AllToAllOp("random_shuffle", seed))
+
+    def limit(self, n: int) -> "Dataset":
+        """Applied exactly at iteration time (truncates the block stream)."""
+        ds = Dataset(self._ops, self._ctx)
+        ds._limit = n  # type: ignore[attr-defined]
+        return ds
+
+    # -- execution -----------------------------------------------------------
+
+    def _execute_refs(self) -> List[Any]:
+        ex = StreamingExecutor(self._ctx)
+        refs = list(ex.execute(build_segments(self._ops)))
+        self._last_stats = ex.stats.summary()
+        return refs
+
+    def _stream_blocks(self) -> Iterator[Block]:
+        ex = StreamingExecutor(self._ctx)
+        limit = getattr(self, "_limit", None)
+        seen = 0
+        for ref in ex.execute(build_segments(self._ops)):
+            block = ray_tpu.get(ref)
+            if limit is not None:
+                take = min(block_num_rows(block), limit - seen)
+                if take <= 0:
+                    break
+                from .block import block_slice
+
+                block = block_slice(block, 0, take)
+                seen += take
+                yield block
+                if seen >= limit:
+                    break
+            else:
+                yield block
+        self._last_stats = ex.stats.summary()
+
+    def materialize(self) -> "Dataset":
+        refs = self._execute_refs()
+        return Dataset([SourceOp(refs=refs, name="materialized")], self._ctx)
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy", drop_last: bool = False,
+                     local_shuffle_seed: Optional[int] = None) -> Iterator[Any]:
+        return _iter_batches_from_blocks(self._stream_blocks(), batch_size,
+                                         batch_format, drop_last,
+                                         local_shuffle_seed)
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self._stream_blocks():
+            for row in block_to_rows(block):
+                yield row
+
+    def take(self, n: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Any]:
+        return list(self.iter_rows())
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    def count(self) -> int:
+        return sum(block_num_rows(b) for b in self._stream_blocks())
+
+    def sum(self, column: str = "item") -> float:
+        total = 0.0
+        for b in self._stream_blocks():
+            if column in b and block_num_rows(b):
+                total += float(np.sum(b[column]))
+        return total
+
+    def schema(self) -> Optional[Dict[str, str]]:
+        for b in self._stream_blocks():
+            return {k: str(v.dtype) for k, v in b.items()}
+        return None
+
+    def num_blocks(self) -> int:
+        src = self._ops[0]
+        n = len(src.read_fns) if src.read_fns is not None else len(src.refs or [])
+        for op in self._ops[1:]:
+            if isinstance(op, AllToAllOp) and op.kind == "repartition":
+                n = op.arg
+        return n
+
+    def size_bytes(self) -> int:
+        return sum(block_size_bytes(b) for b in self._stream_blocks())
+
+    def stats(self) -> dict:
+        return dict(self._last_stats or {})
+
+    # -- splitting (Train ingest) --------------------------------------------
+
+    def split_shards(self, n: int, *, equal: bool = True,
+                     locality_hints=None) -> List[DataShard]:
+        """Materialize and split into n shards for n Train workers (ref:
+        python/ray/data/dataset.py split / streaming_split feeding
+        train/_internal/data_config.py)."""
+        refs = self._execute_refs()
+        if equal and refs and len(refs) % n != 0 or (refs and len(refs) < n):
+            ex = StreamingExecutor(self._ctx)
+            per = max(1, math.ceil(len(refs) / n)) if refs else 1
+            refs = ex.execute(build_segments(
+                [SourceOp(refs=refs), AllToAllOp("repartition", n * per)]))
+            refs = list(refs)
+        return [DataShard(refs[i::n], name=f"shard_{i}") for i in builtins.range(n)]
+
+    def split(self, n: int, **kw) -> List[DataShard]:
+        return self.split_shards(n, **kw)
+
+    def __repr__(self):
+        names = [getattr(op, "name", op.__class__.__name__)
+                 for op in self._ops]
+        return f"Dataset({' -> '.join(names)})"
+
+
+# ---------------------------------------------------------------------------
+# read API (ref: python/ray/data/read_api.py)
+# ---------------------------------------------------------------------------
+
+
+def _make_dataset(read_fns: List[Callable[[], Block]], name: str) -> Dataset:
+    blobs = [cloudpickle.dumps(fn) for fn in read_fns]
+    return Dataset([SourceOp(read_fns=blobs, name=name)])
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
+    n = len(items)
+    ctx = DataContext.get_current()
+    if parallelism <= 0:
+        parallelism = max(1, min(ctx.default_parallelism,
+                                 math.ceil(n / ctx.target_min_rows_per_block)))
+    parallelism = max(1, min(parallelism, n or 1))
+    fns = []
+    for i in builtins.range(parallelism):
+        chunk = items[n * i // parallelism: n * (i + 1) // parallelism]
+        fns.append(lambda c=chunk: block_from_items(c))
+    return _make_dataset(fns, "from_items")
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    ctx = DataContext.get_current()
+    if parallelism <= 0:
+        parallelism = max(1, min(ctx.default_parallelism,
+                                 math.ceil(n / ctx.target_min_rows_per_block)))
+    parallelism = max(1, min(parallelism, n or 1))
+    fns = []
+    for i in builtins.range(parallelism):
+        lo, hi = n * i // parallelism, n * (i + 1) // parallelism
+        fns.append(lambda a=lo, b=hi: {"id": np.arange(a, b)})
+    return _make_dataset(fns, "range")
+
+
+def from_numpy(arrays: Union[np.ndarray, Dict[str, np.ndarray]], *,
+               parallelism: int = -1) -> Dataset:
+    block = block_from_batch(arrays)
+    n = block_num_rows(block)
+    ctx = DataContext.get_current()
+    if parallelism <= 0:
+        parallelism = max(1, min(ctx.default_parallelism,
+                                 math.ceil(n / ctx.target_min_rows_per_block)))
+    parallelism = max(1, min(parallelism, n or 1))
+    fns = []
+    for i in builtins.range(parallelism):
+        lo, hi = n * i // parallelism, n * (i + 1) // parallelism
+        sub = {k: v[lo:hi] for k, v in block.items()}
+        fns.append(lambda s=sub: s)
+    return _make_dataset(fns, "from_numpy")
+
+
+def from_blocks(blocks: List[Block]) -> Dataset:
+    return _make_dataset([lambda b=b: block_from_batch(b) for b in blocks],
+                         "from_blocks")
+
+
+def _file_read_fns(paths: Union[str, List[str]], reader: Callable[[str], Block],
+                   suffixes: tuple) -> List[Callable[[], Block]]:
+    import os
+
+    if isinstance(paths, str):
+        paths = [paths]
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, names in os.walk(p):
+                files.extend(os.path.join(root, f) for f in sorted(names)
+                             if f.endswith(suffixes))
+        else:
+            files.append(p)
+    if not files:
+        raise FileNotFoundError(f"No input files under {paths}")
+    return [lambda f=f: reader(f) for f in files]
+
+
+def read_parquet(paths: Union[str, List[str]], **kw) -> Dataset:
+    def reader(path: str) -> Block:
+        import pyarrow.parquet as pq
+
+        table = pq.read_table(path)
+        return {name: table.column(name).to_numpy(zero_copy_only=False)
+                for name in table.column_names}
+
+    return _make_dataset(_file_read_fns(paths, reader, (".parquet",)),
+                         "read_parquet")
+
+
+def read_csv(paths: Union[str, List[str]], **kw) -> Dataset:
+    def reader(path: str) -> Block:
+        import csv
+
+        with open(path, newline="") as f:
+            rows = list(csv.DictReader(f))
+        block = block_from_items(rows)
+        out: Block = {}
+        for k, v in block.items():
+            try:
+                out[k] = v.astype(np.float64)
+            except (ValueError, TypeError):
+                out[k] = v
+        return out
+
+    return _make_dataset(_file_read_fns(paths, reader, (".csv",)), "read_csv")
+
+
+def read_json(paths: Union[str, List[str]], **kw) -> Dataset:
+    def reader(path: str) -> Block:
+        import json
+
+        rows = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rows.append(json.loads(line))
+        return block_from_items(rows)
+
+    return _make_dataset(_file_read_fns(paths, reader, (".json", ".jsonl")),
+                         "read_json")
+
+
+def read_numpy(paths: Union[str, List[str]], **kw) -> Dataset:
+    def reader(path: str) -> Block:
+        return {"data": np.load(path)}
+
+    return _make_dataset(_file_read_fns(paths, reader, (".npy",)), "read_numpy")

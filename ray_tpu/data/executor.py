@@ -1,0 +1,284 @@
+"""Streaming executor for ray_tpu.data.
+
+Equivalent of the reference's StreamingExecutor driving a PhysicalOperator
+DAG over tasks/actor pools with bounded in-flight blocks (ref:
+python/ray/data/_internal/execution/streaming_executor.py:49, loop in
+streaming_executor_state.py; actor pools:
+_internal/execution/operators/actor_pool_map_operator.py:34).
+
+Design here: the logical plan is fused into *segments* — a source (read
+tasks or materialized block refs) followed by a chain of block→block
+transforms — separated by all-to-all barriers (repartition / shuffle).
+Each segment streams: inputs are submitted as remote tasks with a bounded
+in-flight window (backpressure), outputs yield in completion order and
+flow into the next segment without a barrier.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Iterator, List, Optional
+
+import cloudpickle
+import numpy as np
+
+import ray_tpu
+
+from .block import (Block, block_concat, block_num_rows, block_select,
+                    block_slice)
+
+# ---------------------------------------------------------------------------
+# remote helpers (module-level so the function blob is exported once)
+# ---------------------------------------------------------------------------
+
+
+def _apply_chain(chain_blob: bytes, block: Block) -> Block:
+    fns: List[Callable[[Block], Block]] = cloudpickle.loads(chain_blob)
+    for fn in fns:
+        block = fn(block)
+    return block
+
+
+def _read_and_apply(read_blob: bytes, chain_blob: bytes) -> Block:
+    read_fn = cloudpickle.loads(read_blob)
+    return _apply_chain(chain_blob, read_fn())
+
+
+def _count_rows(block: Block) -> int:
+    return block_num_rows(block)
+
+
+def _slice_concat(plan: List[tuple], *blocks: Block) -> Block:
+    """plan: [(input_index, start, stop), ...] into *blocks."""
+    parts = [block_slice(blocks[i], a, b) for (i, a, b) in plan]
+    return block_concat(parts)
+
+
+def _shuffle_map(block: Block, n: int, seed: int):
+    rng = np.random.default_rng(seed)
+    n_rows = block_num_rows(block)
+    assign = rng.integers(0, n, size=n_rows)
+    outs = [block_select(block, np.nonzero(assign == j)[0]) for j in range(n)]
+    return tuple(outs) if n > 1 else outs[0]
+
+
+def _shuffle_reduce(seed: int, *parts: Block) -> Block:
+    merged = block_concat(parts)
+    n_rows = block_num_rows(merged)
+    perm = np.random.default_rng(seed).permutation(n_rows)
+    return block_select(merged, perm)
+
+
+class _BlockWorker:
+    """Actor-pool worker for map_batches(compute=ActorPoolStrategy(...)).
+    Holds the deserialized chain so per-block calls skip unpickling; a
+    class-based UDF's constructor runs once here (ref:
+    actor_pool_map_operator.py _MapWorker)."""
+
+    def __init__(self, chain_blob: bytes):
+        self._fns = cloudpickle.loads(chain_blob)
+
+    def apply(self, block: Block) -> Block:
+        for fn in self._fns:
+            block = fn(block)
+        return block
+
+    def ping(self) -> bool:
+        return True
+
+
+# ---------------------------------------------------------------------------
+# execution
+# ---------------------------------------------------------------------------
+
+
+class ExecStats:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.tasks_submitted = 0
+        self.blocks_produced = 0
+        self.peak_in_flight = 0
+
+    def on_submit(self, in_flight: int) -> None:
+        with self.lock:
+            self.tasks_submitted += 1
+            self.peak_in_flight = max(self.peak_in_flight, in_flight)
+
+    def summary(self) -> dict:
+        return {"tasks_submitted": self.tasks_submitted,
+                "blocks_produced": self.blocks_produced,
+                "peak_in_flight": self.peak_in_flight}
+
+
+class StreamingExecutor:
+    """Drives one dataset execution; yields output block refs."""
+
+    def __init__(self, context):
+        self.ctx = context
+        self.stats = ExecStats()
+        self._apply_remote = ray_tpu.remote(_apply_chain)
+        self._read_remote = ray_tpu.remote(_read_and_apply)
+
+    # -- segment drivers -----------------------------------------------------
+
+    def _stream_tasks(self, inputs: Iterator[Any], chain_blob: bytes,
+                      reads: bool) -> Iterator[Any]:
+        """Submit one task per input with a bounded in-flight window."""
+        cap = max(1, int(self.ctx.max_in_flight_blocks))
+        in_flight: dict = {}
+        inputs = iter(inputs)
+        exhausted = False
+        while True:
+            while not exhausted and len(in_flight) < cap:
+                try:
+                    item = next(inputs)
+                except StopIteration:
+                    exhausted = True
+                    break
+                if reads:
+                    ref = self._read_remote.remote(item, chain_blob)
+                else:
+                    ref = self._apply_remote.remote(chain_blob, item)
+                in_flight[ref] = True
+                self.stats.on_submit(len(in_flight))
+            if not in_flight:
+                if exhausted:
+                    return
+                continue
+            done, _ = ray_tpu.wait(list(in_flight), num_returns=1,
+                                   timeout=None, fetch_local=False)
+            for ref in done:
+                in_flight.pop(ref, None)
+                self.stats.blocks_produced += 1
+                yield ref
+
+    def _stream_actor_pool(self, inputs: Iterator[Any], chain_blob: bytes,
+                           pool_size: int,
+                           resources: Optional[dict]) -> Iterator[Any]:
+        cls = ray_tpu.remote(_BlockWorker)
+        opts = {}
+        if resources:
+            opts["num_cpus"] = resources.get("CPU", 1.0)
+            extra = {k: v for k, v in resources.items() if k != "CPU"}
+            if extra:
+                opts["resources"] = extra
+        actors = [cls.options(**opts).remote(chain_blob) if opts
+                  else cls.remote(chain_blob) for _ in range(pool_size)]
+        try:
+            ray_tpu.get([a.ping.remote() for a in actors], timeout=60)
+            per_actor_cap = max(
+                1, int(self.ctx.max_in_flight_blocks) // pool_size) + 1
+            in_flight: dict = {}
+            load = {i: 0 for i in range(pool_size)}
+            inputs = iter(inputs)
+            exhausted = False
+            while True:
+                while not exhausted:
+                    i = min(load, key=lambda k: load[k])
+                    if load[i] >= per_actor_cap:
+                        break
+                    try:
+                        item = next(inputs)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    ref = actors[i].apply.remote(item)
+                    in_flight[ref] = i
+                    load[i] += 1
+                    self.stats.on_submit(len(in_flight))
+                if not in_flight:
+                    if exhausted:
+                        return
+                    continue
+                done, _ = ray_tpu.wait(list(in_flight), num_returns=1,
+                                       timeout=None, fetch_local=False)
+                for ref in done:
+                    load[in_flight.pop(ref)] -= 1
+                    self.stats.blocks_produced += 1
+                    yield ref
+        finally:
+            for a in actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+
+    # -- barriers ------------------------------------------------------------
+
+    def _repartition(self, refs: List[Any], n: int) -> List[Any]:
+        counts = ray_tpu.get(
+            [ray_tpu.remote(_count_rows).remote(r) for r in refs], timeout=300)
+        total = sum(counts)
+        slice_remote = ray_tpu.remote(_slice_concat)
+        offsets = [0]
+        for c in counts:
+            offsets.append(offsets[-1] + c)
+        outs = []
+        for j in range(n):
+            lo = total * j // n
+            hi = total * (j + 1) // n
+            plan, args = [], []
+            for i, c in enumerate(counts):
+                a, b = max(lo, offsets[i]), min(hi, offsets[i + 1])
+                if a < b:
+                    plan.append((len(args), a - offsets[i], b - offsets[i]))
+                    args.append(refs[i])
+            outs.append(slice_remote.remote(plan, *args))
+        return outs
+
+    def _random_shuffle(self, refs: List[Any], seed: Optional[int]) -> List[Any]:
+        n = len(refs)
+        if n == 0:
+            return refs
+        base = seed if seed is not None else 0x5EED
+        map_remote = ray_tpu.remote(_shuffle_map)
+        reduce_remote = ray_tpu.remote(_shuffle_reduce)
+        parts = [map_remote.options(num_returns=n).remote(r, n, base + i)
+                 for i, r in enumerate(refs)]
+        if n == 1:
+            cols = [[p] for p in parts]
+        else:
+            cols = [[parts[i][j] for i in range(n)] for j in range(n)]
+        return [reduce_remote.remote(base ^ (j * 2654435761), *col)
+                for j, col in enumerate(cols)]
+
+    # -- plan driver ---------------------------------------------------------
+
+    def execute(self, segments: List[dict]) -> Iterator[Any]:
+        """segments: produced by plan.build_segments(). Each is a dict:
+        {source: ('reads', [blobs]) | ('refs', [refs]) | ('barrier', op),
+         chain: bytes, compute: None | (pool_size, resources)}"""
+        stream: Optional[Iterator[Any]] = None
+        for seg in segments:
+            kind, payload = seg["source"]
+            if kind == "reads":
+                # the map chain is fused into the read task itself
+                stream = self._stream_tasks(iter(payload), seg["chain"],
+                                            reads=True)
+                continue
+            if kind == "refs":
+                inputs: Iterator[Any] = iter(payload)
+            elif kind == "chained":
+                assert stream is not None
+                inputs = stream
+            elif kind == "barrier":
+                op, arg = payload
+                upstream = list(stream) if stream is not None else []
+                if op == "repartition":
+                    refs = self._repartition(upstream, arg)
+                elif op == "random_shuffle":
+                    refs = self._random_shuffle(upstream, arg)
+                else:
+                    raise ValueError(f"unknown barrier {op}")
+                inputs = iter(refs)
+            else:  # pragma: no cover
+                raise ValueError(kind)
+            if seg["identity"]:
+                stream = inputs
+            elif seg["compute"] is not None:
+                size, res = seg["compute"]
+                stream = self._stream_actor_pool(inputs, seg["chain"],
+                                                 size, res)
+            else:
+                stream = self._stream_tasks(inputs, seg["chain"], reads=False)
+        assert stream is not None
+        return stream

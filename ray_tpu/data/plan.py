@@ -1,0 +1,90 @@
+"""Logical plan + fusion for ray_tpu.data.
+
+Mirrors the reference's logical-plan → physical-plan split (ref:
+python/ray/data/_internal/logical/, planner/plan_udf_map_op.py fusion):
+consecutive block→block transforms fuse into one task per block; all-to-all
+ops (repartition / random_shuffle) are barriers; an actor-pool compute
+strategy cuts the fusion so the chain runs on the pool.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Tuple
+
+import cloudpickle
+
+
+@dataclass
+class SourceOp:
+    """Produces blocks: read-task callables, or already-materialized refs."""
+    read_fns: Optional[List[bytes]] = None   # cloudpickled () -> Block
+    refs: Optional[List[Any]] = None
+    name: str = "source"
+
+
+@dataclass
+class MapOp:
+    """A block -> block transform, optionally on an actor pool."""
+    fn: Callable  # Block -> Block
+    name: str = "map"
+    compute: Optional[Tuple[int, Optional[dict]]] = None  # (pool, resources)
+
+
+@dataclass
+class AllToAllOp:
+    kind: str  # "repartition" | "random_shuffle"
+    arg: Any = None
+    name: str = "all_to_all"
+
+
+def build_segments(ops: List[Any]) -> List[dict]:
+    """Fuse the op list into executor segments (see StreamingExecutor.execute)."""
+    if not ops or not isinstance(ops[0], SourceOp):
+        raise ValueError("plan must start with a SourceOp")
+    segments: List[dict] = []
+    src = ops[0]
+    if src.read_fns is not None:
+        pending_source = ("reads", list(src.read_fns))
+    else:
+        pending_source = ("refs", list(src.refs or []))
+    chain: List[Callable] = []
+    compute: Optional[Tuple[int, Optional[dict]]] = None
+
+    def flush():
+        nonlocal pending_source, chain, compute
+        segments.append({
+            "source": pending_source,
+            "chain": cloudpickle.dumps(list(chain)),
+            "identity": not chain,
+            "compute": compute,
+        })
+        chain = []
+        compute = None
+
+    for op in ops[1:]:
+        if isinstance(op, MapOp):
+            if op.compute is not None:
+                # actor-pool op: cut fusion before and run the pool segment
+                if chain or pending_source[0] == "reads":
+                    flush()
+                    pending_source = ("chained", None)
+                chain.append(op.fn)
+                compute = op.compute
+                flush()
+                pending_source = ("chained", None)
+            else:
+                if compute is not None:
+                    flush()
+                    pending_source = ("chained", None)
+                chain.append(op.fn)
+        elif isinstance(op, AllToAllOp):
+            flush()
+            pending_source = ("barrier", (op.kind, op.arg))
+        else:
+            raise TypeError(f"unknown op {op!r}")
+    flush()
+
+    # resolve "chained" placeholders: those segments consume the previous
+    # segment's stream — the executor handles this by treating them as
+    # ("refs", <upstream stream>) at run time.
+    return segments
