@@ -1,0 +1,91 @@
+"""Command-line interface (ref: python/ray/scripts/scripts.py:71
+`ray start/stop/status`).
+
+`ray_tpu start --head --port P`    — standalone head: hosts GCS + the head
+                                     node and listens for joining agents.
+`ray_tpu start --address H:P`      — node agent joining a head (the remote
+                                     half of the multi-host runtime).
+`ray_tpu status --address H:P`     — print cluster nodes/resources.
+
+Usage: python -m ray_tpu <command> [options]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _cmd_start(args) -> int:
+    if args.head:
+        from .core import runtime as runtime_mod
+        from .core.runtime import DriverRuntime
+
+        resources = {"CPU": args.num_cpus, **json.loads(args.resources)}
+        rt = DriverRuntime(resources=resources)
+        runtime_mod.set_runtime(rt)
+        addr = rt.enable_remote_nodes(host=args.host, port=args.port)
+        print(f"ray_tpu head listening on {addr[0]}:{addr[1]}")
+        print(f"Join more nodes with:\n  python -m ray_tpu start "
+              f"--address {addr[0]}:{addr[1]}")
+        try:
+            while True:
+                time.sleep(1.0)
+        except KeyboardInterrupt:
+            rt.shutdown()
+        return 0
+    if not args.address:
+        print("start needs --head or --address HOST:PORT", file=sys.stderr)
+        return 2
+    from .core.node_agent import main as agent_main
+
+    agent_args = ["--address", args.address,
+                  "--num-cpus", str(args.num_cpus),
+                  "--resources", args.resources,
+                  "--labels", args.labels]
+    return agent_main(agent_args)
+
+
+def _cmd_status(args) -> int:
+    from .core import runtime as runtime_mod
+
+    rt = runtime_mod.maybe_runtime()
+    if rt is None:
+        print("No ray_tpu runtime in this process. `status` reports on the "
+              "in-process cluster; run it from the driver, or see the head "
+              "process logs for cluster membership.", file=sys.stderr)
+        return 1
+    for info in rt.gcs.nodes():
+        state = "ALIVE" if info.alive else "DEAD"
+        print(f"{info.node_id.hex()[:12]}  {state:5s}  {info.total_resources}")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ray_tpu", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("start", help="start a head or join as a node agent")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", default="",
+                    help="head HOST:PORT to join as an agent")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=6380)
+    sp.add_argument("--num-cpus", type=float,
+                    default=float(os.cpu_count() or 1))
+    sp.add_argument("--resources", default="{}")
+    sp.add_argument("--labels", default="{}")
+    sp.set_defaults(fn=_cmd_start)
+
+    st = sub.add_parser("status", help="show cluster nodes")
+    st.add_argument("--address", default="")
+    st.set_defaults(fn=_cmd_status)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,323 @@
+"""Node agent — joins a head process over TCP and hosts workers + a store.
+
+The remote half of RemoteNode (see remote_node.py). Equivalent of running
+the reference's raylet on a joining machine (`ray start --address=...`,
+ref: python/ray/scripts/scripts.py:71; python/ray/_private/node.py:1220
+start_ray_processes). The agent owns: worker subprocesses (reached over a
+local AF_UNIX socket exactly like the in-process Node's), the node's
+shared-memory PlasmaStore, and the object-chunk server. All scheduling
+stays on the head; the agent executes worker lifecycle commands and relays
+workers' core-API calls up the TCP channel.
+
+Object locality: a worker `get` of a non-local object pulls it from the
+head in 5 MiB chunks into the LOCAL store first (creating a tracked copy,
+ref: object_manager.h:117), then hands the worker a zero-copy local
+/dev/shm segment.
+
+Run: python -m ray_tpu.core.node_agent --address HOST:PORT [--num-cpus N]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+from .config import Config
+from .ids import NodeId, ObjectId, WorkerId
+from .object_store import (PlasmaStore, SegmentReader, pull_chunks,
+                           read_store_chunk)
+from .rpc import RpcChannel, RpcServer, connect
+
+_AUTHKEY = b"ray_tpu"
+
+
+class NodeAgent:
+    def __init__(self, head_address, resources: Dict[str, float],
+                 labels: Optional[Dict[str, str]] = None,
+                 session_dir: Optional[str] = None,
+                 node_id: Optional[NodeId] = None):
+        self.config = Config()
+        self.node_id = node_id or NodeId.from_random()
+        self.session_dir = session_dir or os.path.join(
+            "/tmp/ray_tpu", f"agent_{self.node_id.hex()[:8]}_{os.getpid()}")
+        os.makedirs(self.session_dir, exist_ok=True)
+        self.store = PlasmaStore(
+            self.node_id,
+            capacity_bytes=int(resources.pop("object_store_memory",
+                                             self.config.object_store_memory)),
+            spill_dir=os.path.join(self.config.object_spilling_dir,
+                                   self.node_id.hex()[:8]),
+            min_spilling_size=int(self.config.min_spilling_size),
+        )
+        self.reader = SegmentReader()
+        self._lock = threading.RLock()
+        self._procs: Dict[WorkerId, subprocess.Popen] = {}
+        self._channels: Dict[WorkerId, RpcChannel] = {}
+        self._stopped = threading.Event()
+        self._sock_path = os.path.join(
+            self.session_dir, f"agent_{self.node_id.hex()[:12]}.sock")
+        self._server = RpcServer(self._sock_path, self._make_worker_handler,
+                                 family="AF_UNIX", authkey=_AUTHKEY)
+        # one duplex channel to the head: requests out, commands in
+        conn_addr = (tuple(head_address) if isinstance(head_address, list)
+                     else head_address)
+        self.head = connect(conn_addr, authkey=_AUTHKEY, name="agent",
+                            handler=self._handle_head_command,
+                            num_handler_threads=8)
+        self.head.on_close(self._on_head_lost)
+        self.head.call("register_node", {
+            "node_id": self.node_id,
+            "resources": dict(resources),
+            "labels": dict(labels or {}),
+            "pid": os.getpid(),
+        }, timeout=30)
+
+    # ---- commands from the head ---------------------------------------------
+
+    def _handle_head_command(self, method: str, payload):
+        if method == "start_worker":
+            self._start_worker(payload["worker_id"])
+            return True
+        if method == "push_task":
+            ch = self._channels.get(payload["worker_id"])
+            if ch is None or ch.closed:
+                self.head.notify("worker_exit",
+                                 {"worker_id": payload["worker_id"]})
+                return False
+            ch.notify("push_task", payload["spec"])
+            return True
+        if method == "kill_worker":
+            self._kill_worker(payload["worker_id"], payload.get("force", True))
+            return True
+        if method == "store_delete":
+            self.store.delete(payload["object_id"])
+            return True
+        if method == "store_stats":
+            return self.store.stats()
+        if method == "object_info":
+            seg = self.store.get_segment(payload["object_id"])
+            return None if seg is None else seg[1]
+        if method == "read_chunk":
+            return self._read_chunk(payload["object_id"], payload["offset"],
+                                    payload["length"])
+        if method == "shutdown":
+            threading.Thread(target=self.shutdown,
+                             kwargs={"kill": payload.get("kill", False)},
+                             daemon=True).start()
+            return True
+        raise ValueError(f"unknown head command {method}")
+
+    def _read_chunk(self, oid: ObjectId, offset: int, length: int):
+        return read_store_chunk(self.store, self.reader, oid, offset, length)
+
+    # ---- worker lifecycle ----------------------------------------------------
+
+    def _start_worker(self, worker_id: WorkerId) -> None:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(p for p in sys.path if p)
+        cmd = [
+            sys.executable, "-S", "-m", "ray_tpu.core.worker_main",
+            "--address", self._sock_path,
+            "--authkey", _AUTHKEY.hex(),
+            "--worker-id", worker_id.hex(),
+            "--node-id", self.node_id.hex(),
+        ]
+        proc = subprocess.Popen(cmd, env=env)
+        with self._lock:
+            self._procs[worker_id] = proc
+        threading.Thread(target=self._reap, args=(worker_id, proc),
+                         daemon=True).start()
+
+    def _reap(self, worker_id: WorkerId, proc: subprocess.Popen) -> None:
+        try:
+            proc.wait()
+        except Exception:
+            return
+        with self._lock:
+            self._procs.pop(worker_id, None)
+            self._channels.pop(worker_id, None)
+        if not self._stopped.is_set() and not self.head.closed:
+            self.head.notify("worker_exit", {"worker_id": worker_id})
+
+    def _kill_worker(self, worker_id: WorkerId, force: bool) -> None:
+        with self._lock:
+            proc = self._procs.get(worker_id)
+            ch = self._channels.get(worker_id)
+        if not force and ch is not None:
+            ch.notify("shutdown")
+            ch.close()
+        if proc is not None:
+            try:
+                (proc.kill if force else proc.terminate)()
+            except Exception:
+                pass
+
+    # ---- worker-facing handler (relay) --------------------------------------
+
+    def _make_worker_handler(self, channel: RpcChannel):
+        state = {"worker_id": None}
+
+        def handler(method: str, payload):
+            if method == "register":
+                wid: WorkerId = payload["worker_id"]
+                state["worker_id"] = wid
+                with self._lock:
+                    self._channels[wid] = channel
+                channel.on_close(lambda: self._on_worker_channel_close(wid))
+                self.head.call("worker_register",
+                               {"worker_id": wid,
+                                "pid": payload.get("pid", 0)}, timeout=30)
+                return True
+            wid = state["worker_id"]
+            if method == "create_object":
+                return self.store.create(payload["object_id"], payload["size"])
+            if method == "seal_object":
+                self.store.seal(payload["object_id"])
+                self.store.pin(payload["object_id"])
+                self.head.notify("object_sealed", {
+                    "object_id": payload["object_id"],
+                    "worker_id": wid,
+                    "is_put": bool(payload.get("is_put")),
+                })
+                return True
+            if method == "task_done":
+                self.head.notify("task_done", {"worker_id": wid,
+                                               "payload": payload})
+                return None
+            if method == "get_objects":
+                return self._get_objects(payload["ids"],
+                                         payload.get("timeout"))
+            if method == "log_event":
+                self.head.notify("worker_call", {"worker_id": wid,
+                                                 "method": method,
+                                                 "payload": payload})
+                return None
+            # everything else: relay to the head's core-worker API
+            from .rpc import ChannelClosed
+
+            try:
+                return self.head.call("worker_call", {"worker_id": wid,
+                                                      "method": method,
+                                                      "payload": payload})
+            except ChannelClosed:
+                if self._stopped.is_set() or self.head.closed:
+                    return None  # agent shutting down; drop the relay
+                raise
+
+        return handler
+
+    def _on_worker_channel_close(self, worker_id: WorkerId) -> None:
+        with self._lock:
+            self._channels.pop(worker_id, None)
+        if not self._stopped.is_set() and not self.head.closed:
+            self.head.notify("worker_exit", {"worker_id": worker_id})
+
+    # ---- object pulls --------------------------------------------------------
+
+    def _get_objects(self, ids, timeout):
+        out = []
+        for oid in ids:
+            seg = self.store.get_segment(oid)
+            if seg is not None:
+                out.append(("shm", seg[0], seg[1]))
+                continue
+            res = self.head.call("fetch_for_agent",
+                                 {"object_id": oid, "timeout": timeout},
+                                 timeout=None if timeout is None
+                                 else timeout + 30)
+            kind = res[0]
+            if kind == "inline":
+                out.append(res)
+                continue
+            # ("sized", total): pull chunks from the head into the local
+            # store, then serve the local segment zero-copy
+            data = pull_chunks(
+                lambda off, n: self.head.call(
+                    "head_read_chunk",
+                    {"object_id": oid, "offset": off, "length": n},
+                    timeout=120),
+                res[1])
+            if data is None:
+                raise RuntimeError(
+                    f"object {oid.hex()[:12]} vanished mid-transfer")
+            self.store.put_bytes(oid, data, pin=True)
+            self.head.notify("object_copy", {"object_id": oid})
+            seg = self.store.get_segment(oid)
+            out.append(("shm", seg[0], seg[1]))
+        return out
+
+    # ---- lifecycle -----------------------------------------------------------
+
+    def _on_head_lost(self) -> None:
+        if not self._stopped.is_set():
+            self.shutdown(kill=True)
+
+    def shutdown(self, kill: bool = False) -> None:
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        with self._lock:
+            procs = dict(self._procs)
+            channels = dict(self._channels)
+        for ch in channels.values():
+            try:
+                ch.notify("shutdown")
+                ch.close()
+            except Exception:
+                pass
+        for proc in procs.values():
+            try:
+                (proc.kill if kill else proc.terminate)()
+            except Exception:
+                pass
+        for proc in procs.values():
+            try:
+                proc.wait(timeout=5)
+            except Exception:
+                pass
+        self._server.close()
+        try:
+            self.head.close()
+        except Exception:
+            pass
+        self.store.destroy()
+
+    def wait(self) -> None:
+        """Block until shut down (the agent main loop)."""
+        while not self._stopped.is_set():
+            time.sleep(0.2)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="ray_tpu node agent")
+    p.add_argument("--address", required=True,
+                   help="head host:port to join")
+    p.add_argument("--num-cpus", type=float, default=float(os.cpu_count() or 1))
+    p.add_argument("--resources", default="{}",
+                   help="extra resources as JSON, e.g. '{\"TPU\": 4}'")
+    p.add_argument("--labels", default="{}")
+    p.add_argument("--node-id", default="",
+                   help="hex node id assigned by the launcher (optional)")
+    args = p.parse_args(argv)
+    host, _, port = args.address.rpartition(":")
+    resources = {"CPU": args.num_cpus, **json.loads(args.resources)}
+    agent = NodeAgent((host, int(port)), resources,
+                      labels=json.loads(args.labels),
+                      node_id=NodeId(bytes.fromhex(args.node_id))
+                      if args.node_id else None)
+    print(f"ray_tpu node agent {agent.node_id.hex()[:12]} joined "
+          f"{args.address}", flush=True)
+    try:
+        agent.wait()
+    except KeyboardInterrupt:
+        agent.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

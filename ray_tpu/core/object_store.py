@@ -255,6 +255,45 @@ class PlasmaStore:
             self._used = 0
 
 
+# ---------------------------------------------------------------------------
+# chunked object transfer (ref: object_manager.h:117; 5 MiB chunks per
+# ray_config_def.h:348). Shared by both transfer directions: the head
+# pulling from an agent and an agent pulling from the head.
+# ---------------------------------------------------------------------------
+
+TRANSFER_CHUNK = 5 * 1024 * 1024
+
+
+def read_store_chunk(store: "PlasmaStore", reader: "SegmentReader",
+                     object_id: ObjectId, offset: int, length: int):
+    """Serve one chunk of a sealed object's bytes, or None if gone."""
+    seg = store.get_segment(object_id)
+    if seg is None:
+        return None
+    name, size = seg
+    mv = reader.read(name, size)
+    try:
+        return bytes(mv[offset:offset + length])
+    finally:
+        del mv
+        reader.release(name)
+
+
+def pull_chunks(fetch_chunk, total: int) -> Optional[bytes]:
+    """Assemble an object from sequential fetch_chunk(offset, length) calls;
+    None if the source loses the object mid-transfer."""
+    buf = bytearray(total)
+    off = 0
+    while off < total:
+        n = min(TRANSFER_CHUNK, total - off)
+        chunk = fetch_chunk(off, n)
+        if chunk is None:
+            return None
+        buf[off:off + len(chunk)] = chunk
+        off += len(chunk)
+    return bytes(buf)
+
+
 class SegmentReader:
     """Client-side zero-copy attach to sealed segments; caches attachments.
 

@@ -1,0 +1,221 @@
+"""Head-side handle for a node living in another OS process / host.
+
+The reference splits this across the raylet daemon plus the head's
+gcs_node_manager and object_manager (ref: src/ray/raylet/node_manager.h:119;
+src/ray/object_manager/object_manager.h:117 — chunked pulls;
+python/ray/_private/node.py:1183,1220 process bring-up). The TPU-native
+reduction keeps the single-controller design: ALL scheduling state (lease
+queue, resource ledger, PG bundles) stays on the head in this class, which
+reuses Node's logic wholesale; the remote agent process hosts only the
+worker subprocesses and the shared-memory store. Control flows over one
+duplex TCP channel; bulk object bytes move as chunked reads
+(ref: ray_config_def.h:348 — 5 MiB chunks).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from .ids import NodeId, ObjectId, WorkerId
+from .node import Node, WorkerHandle
+from .object_store import SegmentReader, pull_chunks
+from .resources import ResourceSet
+from .rpc import RpcChannel
+
+
+class RemoteStoreProxy:
+    """The slice of the PlasmaStore interface the head calls on a node.
+    Bytes never move through here except via explicit chunk reads."""
+
+    def __init__(self, node: "RemoteNode"):
+        self._node = node
+
+    def delete(self, object_id: ObjectId) -> None:
+        ch = self._node.channel
+        if ch is not None and not ch.closed:
+            ch.notify("store_delete", {"object_id": object_id})
+
+    def get_segment(self, object_id: ObjectId):
+        # head cannot mmap a remote /dev/shm segment; fetch_one special-
+        # cases remote nodes through pull_object_bytes instead
+        return None
+
+    def put_serialized(self, object_id, sobj, pin=True):
+        raise NotImplementedError(
+            "driver puts are stored on the head node; remote placement "
+            "happens by task execution locality")
+
+    def stats(self) -> dict:
+        try:
+            return self._node.channel.call("store_stats", None, timeout=10)
+        except Exception:
+            return {}
+
+    def destroy(self) -> None:
+        pass  # owned by the agent process
+
+
+class RemoteNode(Node):
+    """A Node whose workers and store live behind a TCP channel.
+
+    Scheduling (leases, resources, bundles) is inherited from Node and runs
+    head-side; worker lifecycle operations are forwarded to the agent.
+    """
+
+    is_remote = True
+
+    def __init__(self, runtime, node_id: NodeId, resources: ResourceSet,
+                 config, channel: RpcChannel,
+                 labels: Optional[Dict[str, str]] = None):
+        # deliberately NOT calling Node.__init__ — no local store, no local
+        # RpcServer, no prestarted subprocesses. Mirror its ledger state.
+        from collections import deque
+
+        from .resources import normalize
+
+        self.runtime = runtime
+        self.node_id = node_id
+        self.config = config
+        self.total_resources = normalize(resources)
+        self.available = dict(self.total_resources)
+        self.labels = labels or {}
+        self.session_dir = runtime.session_dir
+        self.store = RemoteStoreProxy(self)
+        self.total_resources.pop("object_store_memory", None)
+        self.available.pop("object_store_memory", None)
+        self._lock = threading.RLock()
+        self._workers: Dict[WorkerId, WorkerHandle] = {}
+        self._idle = deque()
+        self._lease_queue = deque()
+        self._bundles = {}
+        self._starting_count = 0
+        self.alive = True
+        self.channel = channel
+        self._server = None
+        self._reader = SegmentReader()
+        self._max_workers = max(int(config.num_workers_soft_limit),
+                                int(self.total_resources.get("CPU", 1)))
+        channel.on_close(self._on_channel_close)
+
+    # ---- worker lifecycle (forwarded) ---------------------------------------
+
+    def _start_worker(self) -> WorkerHandle:
+        worker_id = WorkerId.from_random()
+        handle = WorkerHandle(worker_id=worker_id, proc=None)  # type: ignore
+        self._workers[worker_id] = handle
+        self._starting_count += 1
+        try:
+            self.channel.notify("start_worker", {"worker_id": worker_id})
+        except Exception:
+            self._on_worker_exit(handle)
+        return handle
+
+    def on_remote_worker_register(self, worker_id: WorkerId, pid: int) -> None:
+        with self._lock:
+            handle = self._workers.get(worker_id)
+            if handle is None:
+                handle = WorkerHandle(worker_id=worker_id, proc=None,  # type: ignore
+                                      pid=pid)
+                self._workers[worker_id] = handle
+            handle.pid = pid
+            handle.state = "idle"
+            self._starting_count = max(0, self._starting_count - 1)
+            self._idle.append(handle)
+        self._dispatch()
+
+    def on_remote_worker_exit(self, worker_id: WorkerId) -> None:
+        with self._lock:
+            handle = self._workers.get(worker_id)
+            if handle is None:
+                return
+            if handle.state == "starting":
+                self._starting_count = max(0, self._starting_count - 1)
+        self._on_worker_exit(handle)
+
+    def _pop_idle(self) -> Optional[WorkerHandle]:
+        # remote workers have no head-side channel object; liveness is
+        # tracked by agent exit notifications
+        while self._idle:
+            w = self._idle.popleft()
+            if w.state == "idle":
+                return w
+        return None
+
+    def push_task(self, worker: WorkerHandle, spec) -> None:
+        from .task_spec import TaskType
+
+        with self._lock:
+            worker.in_flight[spec.task_id] = spec
+            if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                worker.state = "actor"
+                worker.actor_id = spec.actor_id
+        if not self.alive or self.channel.closed:
+            self._on_worker_exit(worker)
+            return
+        self.channel.notify("push_task", {"worker_id": worker.worker_id,
+                                          "spec": spec})
+
+    def _terminate_worker(self, worker: WorkerHandle) -> None:
+        worker.state = "dead"
+        self._workers.pop(worker.worker_id, None)
+        self.runtime.refcount.release_holder(worker.worker_id)
+        try:
+            self.channel.notify("kill_worker", {"worker_id": worker.worker_id,
+                                                "force": False})
+        except Exception:
+            pass
+
+    def kill_worker(self, worker: WorkerHandle, force: bool = True) -> None:
+        try:
+            self.channel.notify("kill_worker", {"worker_id": worker.worker_id,
+                                                "force": force})
+        except Exception:
+            pass
+
+    # ---- object transfer -----------------------------------------------------
+
+    def pull_object_bytes(self, oid: ObjectId) -> Optional[bytes]:
+        """Chunked pull of a remote object's serialized bytes
+        (ref: object_manager.h:117 PullManager; 5 MiB chunks)."""
+        try:
+            size = self.channel.call("object_info", {"object_id": oid},
+                                     timeout=30)
+            if size is None:
+                return None
+            return pull_chunks(
+                lambda off, n: self.channel.call(
+                    "read_chunk",
+                    {"object_id": oid, "offset": off, "length": n},
+                    timeout=60),
+                size)
+        except Exception:
+            return None
+
+    # ---- lifecycle -----------------------------------------------------------
+
+    def _on_channel_close(self) -> None:
+        if not self.alive:
+            return
+        self.runtime.on_remote_node_lost(self.node_id)
+
+    def shutdown(self, kill: bool = False) -> None:
+        from ..exceptions import WorkerCrashedError
+
+        with self._lock:
+            if not self.alive:
+                return
+            self.alive = False
+            queued = list(self._lease_queue)
+            self._lease_queue.clear()
+        for req in queued:
+            if not req.future.done():
+                req.future.set_exception(
+                    WorkerCrashedError(f"node {self.node_id.hex()[:8]} shut down"))
+        try:
+            self.channel.notify("shutdown", {"kill": kill})
+        except Exception:
+            pass
+        try:
+            self.channel.close()
+        except Exception:
+            pass

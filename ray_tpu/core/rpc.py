@@ -45,6 +45,13 @@ class RpcChannel:
         self._on_close_cbs = []
         self._pool = ThreadPoolExecutor(max_workers=num_handler_threads,
                                         thread_name_prefix=f"rpc-{name}")
+        # Notifications get their own single-thread lane: they stay FIFO
+        # and can never be starved by blocking request handlers (e.g. a
+        # fetch waiting on an object whose seal NOTIFICATION would satisfy
+        # it — the reference keeps these planes separate too: pubsub
+        # long-poll vs request RPCs).
+        self._oneway_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"rpc-ow-{name}")
         self._reader = threading.Thread(target=self._read_loop, daemon=True,
                                         name=f"rpc-reader-{name}")
         if autostart:
@@ -117,7 +124,7 @@ class RpcChannel:
                 elif kind == _REQ:
                     self._pool.submit(self._handle, msg_id, a, b)
                 elif kind == _ONEWAY:
-                    self._pool.submit(self._handle_oneway, a, b)
+                    self._oneway_pool.submit(self._handle_oneway, a, b)
         finally:
             self._teardown()
 
@@ -140,13 +147,23 @@ class RpcChannel:
     # -- lifecycle -------------------------------------------------------------
 
     def on_close(self, cb: Callable[[], None]) -> None:
-        self._on_close_cbs.append(cb)
+        with self._lock:
+            if not self._closed.is_set():
+                self._on_close_cbs.append(cb)
+                return
+        # teardown already ran: fire immediately so late registrants (e.g.
+        # a node handle built while the peer was dying) still observe the
+        # death
+        try:
+            cb()
+        except Exception:
+            traceback.print_exc()
 
     def _teardown(self) -> None:
-        if self._closed.is_set():
-            return
-        self._closed.set()
         with self._lock:
+            if self._closed.is_set():
+                return
+            self._closed.set()
             pending = list(self._pending.values())
             self._pending.clear()
         for fut in pending:
@@ -158,6 +175,7 @@ class RpcChannel:
             except Exception:
                 traceback.print_exc()
         self._pool.shutdown(wait=False)
+        self._oneway_pool.shutdown(wait=False)
 
     def close(self) -> None:
         try:
@@ -182,9 +200,11 @@ class RpcServer:
     """Accepts channel connections on a Unix or TCP socket."""
 
     def __init__(self, address, handler_factory: Callable[[RpcChannel], Callable],
-                 family: Optional[str] = None, authkey: bytes = b"ray_tpu"):
+                 family: Optional[str] = None, authkey: bytes = b"ray_tpu",
+                 num_handler_threads: int = 16):
         self._listener = Listener(address, family=family, authkey=authkey)
         self._handler_factory = handler_factory
+        self._num_handler_threads = num_handler_threads
         self._channels = []
         self._stopped = threading.Event()
         self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True,
@@ -214,7 +234,8 @@ class RpcServer:
                 except Exception:
                     break
                 continue
-            chan = RpcChannel(conn, name="srv", num_handler_threads=16,
+            chan = RpcChannel(conn, name="srv",
+                              num_handler_threads=self._num_handler_threads,
                               autostart=False)
             chan.set_handler(self._handler_factory(chan))
             chan.start()
@@ -232,6 +253,7 @@ class RpcServer:
 
 def connect(address, authkey: bytes = b"ray_tpu",
             handler: Optional[Callable[[str, Any], Any]] = None,
-            name: str = "") -> RpcChannel:
+            name: str = "", num_handler_threads: int = 4) -> RpcChannel:
     conn = Client(address, authkey=authkey)
-    return RpcChannel(conn, handler=handler, name=name)
+    return RpcChannel(conn, handler=handler, name=name,
+                      num_handler_threads=num_handler_threads)

@@ -117,6 +117,7 @@ class DriverRuntime:
         self._directory: Dict[ObjectId, Set[NodeId]] = {}
         self._events: Dict[ObjectId, threading.Event] = {}
         self._recovering: Set[ObjectId] = set()
+        self._pull_futures: Dict[ObjectId, Future] = {}
         self._reader = SegmentReader()
         self._actors: Dict[ActorId, _ActorRecord] = {}
         self._parked: List[TaskSpec] = []
@@ -140,6 +141,134 @@ class DriverRuntime:
         _set_borrow_hook(_driver_borrow)
 
     # ---- cluster membership --------------------------------------------------
+
+    def enable_remote_nodes(self, host: str = "127.0.0.1", port: int = 0):
+        """Start the TCP listener node agents join (the head half of the
+        multi-host runtime; ref: gcs_server.h:79 node registration +
+        node_manager.proto lease/transfer RPCs collapsed onto one duplex
+        channel per agent). Returns the (host, port) address agents pass
+        as --address."""
+        from .rpc import RpcServer
+
+        if getattr(self, "_remote_server", None) is not None:
+            return self._remote_server.address
+        # one agent channel multiplexes every worker on that host; size the
+        # pool so blocking fetches can't starve the worker_call relay
+        self._remote_server = RpcServer((host, port),
+                                        self._make_agent_handler,
+                                        family="AF_INET",
+                                        num_handler_threads=32)
+        return self._remote_server.address
+
+    def _make_agent_handler(self, channel):
+        from .node import WorkerHandle
+        from .remote_node import RemoteNode
+
+        state = {"node": None}
+
+        def handler(method: str, payload):
+            node: Optional[RemoteNode] = state["node"]
+            if method == "register_node":
+                node = RemoteNode(self, payload["node_id"],
+                                  payload["resources"], self.config, channel,
+                                  labels=payload.get("labels"))
+                state["node"] = node
+                with self._lock:
+                    self.nodes[node.node_id] = node
+                self.gcs.register_node(node.info())
+                self._reschedule_parked()
+                return True
+            if node is None:
+                raise RuntimeError("agent sent a message before register_node")
+            if method == "worker_register":
+                node.on_remote_worker_register(payload["worker_id"],
+                                               payload.get("pid", 0))
+                return True
+            if method == "worker_exit":
+                node.on_remote_worker_exit(payload["worker_id"])
+                return None
+            if method == "task_done":
+                worker = node.get_worker(payload["worker_id"])
+                if worker is not None:
+                    node.on_task_done(worker, payload["payload"])
+                return None
+            if method == "object_sealed":
+                self.on_object_sealed(payload["object_id"], node.node_id)
+                if payload.get("is_put") and payload.get("worker_id"):
+                    self.refcount.add_holder_ref(payload["object_id"],
+                                                 payload["worker_id"])
+                return None
+            if method == "object_copy":
+                with self._lock:
+                    self._directory.setdefault(
+                        payload["object_id"], set()).add(node.node_id)
+                return None
+            if method == "fetch_for_agent":
+                res = self.fetch_one(payload["object_id"],
+                                     payload.get("timeout"))
+                if res[0] == "inline":
+                    return res
+                return ("sized", res[2])  # agent pulls via head_read_chunk
+            if method == "head_read_chunk":
+                return self._read_local_chunk(payload["object_id"],
+                                              payload["offset"],
+                                              payload["length"])
+            if method == "worker_call":
+                worker = node.get_worker(payload["worker_id"])
+                if worker is None:
+                    # raced an exit notification; holder accounting still
+                    # needs the id, nothing else does
+                    worker = WorkerHandle(worker_id=payload["worker_id"],
+                                          proc=None)  # type: ignore
+                return self.handle_worker_call(node, worker,
+                                               payload["method"],
+                                               payload["payload"])
+            raise ValueError(f"unknown agent message {method}")
+
+        return handler
+
+    def _read_local_chunk(self, oid: ObjectId, offset: int, length: int):
+        """Serve a chunk of a locally-stored object (transfer source side)."""
+        from .object_store import read_store_chunk
+
+        with self._lock:
+            copies = list(self._directory.get(oid, ()))
+        for nid in copies:
+            n = self.nodes.get(nid)
+            if n is None or not n.alive or getattr(n, "is_remote", False):
+                continue
+            chunk = read_store_chunk(n.store, self._reader, oid, offset,
+                                     length)
+            if chunk is not None:
+                return chunk
+        return None
+
+    def on_remote_node_lost(self, node_id: NodeId) -> None:
+        """Agent channel dropped: fail in-flight work, restart actors
+        (ref: gcs_node_manager.cc death broadcast)."""
+        node = self.nodes.get(node_id)
+        if node is None:
+            return
+        with node._lock:
+            if not node.alive:
+                return
+            node.alive = False
+            workers = list(node._workers.values())
+            queued = list(node._lease_queue)
+            node._lease_queue.clear()
+        from ..exceptions import WorkerCrashedError
+
+        for req in queued:
+            if not req.future.done():
+                req.future.set_exception(WorkerCrashedError(
+                    f"node {node_id.hex()[:8]} disconnected"))
+        for w in workers:
+            node._on_worker_exit(w)
+        self.gcs.mark_node_dead(node_id, "agent disconnected")
+        with self._lock:
+            for oid, copies in list(self._directory.items()):
+                copies.discard(node_id)
+        self._reschedule_parked()
 
     def add_node(self, resources: ResourceSet,
                  labels: Optional[Dict[str, str]] = None) -> Node:
@@ -297,14 +426,28 @@ class DriverRuntime:
             for nid in copies:
                 node = self.nodes.get(nid)
                 if node is not None and node.alive:
-                    try:
-                        seg = node.store.get_segment(oid)
-                    except Exception:
-                        # store momentarily full etc. — the copy still exists
-                        transient_failure = True
-                        continue
-                    if seg is not None:
-                        return ("shm", seg[0], seg[1])
+                    if getattr(node, "is_remote", False):
+                        # chunked pull from the agent, promoted into the
+                        # head node's store so later readers are zero-copy.
+                        # Concurrent getters share one transfer via the
+                        # in-flight pull table (ref: object_manager.h:117
+                        # PullManager dedup).
+                        res = self._pull_once(oid, node)
+                        if res is not None:
+                            return res
+                        transient_failure = not node.channel.closed
+                        if transient_failure:
+                            continue
+                    else:
+                        try:
+                            seg = node.store.get_segment(oid)
+                        except Exception:
+                            # store momentarily full etc. — the copy still
+                            # exists
+                            transient_failure = True
+                            continue
+                        if seg is not None:
+                            return ("shm", seg[0], seg[1])
                 # node dead, or store confirms the object is gone
                 with self._lock:
                     d = self._directory.get(oid)
@@ -318,6 +461,49 @@ class DriverRuntime:
             if attempts > 5:
                 raise exc.ObjectLostError(oid.hex())
             self._recover_object(oid)
+
+    def _pull_once(self, oid: ObjectId, node) -> Optional[Tuple]:
+        """One chunked transfer per object however many getters: the first
+        caller pulls, the rest wait on its Future."""
+        with self._lock:
+            fut = self._pull_futures.get(oid)
+            owner = fut is None
+            if owner:
+                fut = self._pull_futures[oid] = Future()
+        if not owner:
+            try:
+                return fut.result(timeout=300)
+            except Exception:
+                return None
+        try:
+            data = node.pull_object_bytes(oid)
+            res = None if data is None else self._promote_pulled(oid, data)
+            fut.set_result(res)
+            return res
+        except BaseException as e:
+            fut.set_exception(e)
+            raise
+        finally:
+            with self._lock:
+                self._pull_futures.pop(oid, None)
+
+    def _promote_pulled(self, oid: ObjectId, data: bytes) -> Tuple:
+        """Store bytes pulled from a remote node into the head-local store
+        and return a fetch result for them."""
+        head = self.nodes.get(self.head_node_id)
+        if head is not None and head.alive and not getattr(head, "is_remote",
+                                                           False):
+            try:
+                if not head.store.contains(oid):
+                    head.store.put_bytes(oid, data, pin=True)
+                with self._lock:
+                    self._directory.setdefault(oid, set()).add(head.node_id)
+                seg = head.store.get_segment(oid)
+                if seg is not None:
+                    return ("shm", seg[0], seg[1])
+            except Exception:
+                pass
+        return ("inline", data)
 
     def _recover_object(self, oid: ObjectId) -> None:
         """Lost-object recovery via lineage re-execution
@@ -990,6 +1176,11 @@ class DriverRuntime:
         for node in list(self.nodes.values()):
             try:
                 node.shutdown(kill=False)
+            except Exception:
+                pass
+        if getattr(self, "_remote_server", None) is not None:
+            try:
+                self._remote_server.close()
             except Exception:
                 pass
         self.gcs.finish_job(self.job_id)

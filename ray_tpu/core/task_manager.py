@@ -121,6 +121,11 @@ class ReferenceCounter:
         self._local: Dict[ObjectId, int] = {}
         self._task_pins: Dict[ObjectId, int] = {}
         self._holders: Dict[ObjectId, Dict[object, int]] = {}
+        # holders whose process has died: a late add_holder_ref (a relayed
+        # call racing the exit notification) must not resurrect a count
+        # nothing will ever decrement. WorkerIds are never reused, so the
+        # set only grows by one entry per worker lifetime.
+        self._dead_holders: Set[object] = set()
         self._owned: Set[ObjectId] = set()
         self._on_free = on_free
 
@@ -153,6 +158,8 @@ class ReferenceCounter:
     def add_holder_ref(self, object_id: ObjectId, holder, n: int = 1) -> None:
         """A worker process holds (another) reference to the object."""
         with self._lock:
+            if holder in self._dead_holders:
+                return
             h = self._holders.setdefault(object_id, {})
             h[holder] = h.get(holder, 0) + n
 
@@ -178,6 +185,7 @@ class ReferenceCounter:
         """Drop every reference a (dead) worker held."""
         to_free = []
         with self._lock:
+            self._dead_holders.add(holder)
             for oid in list(self._holders):
                 h = self._holders[oid]
                 if holder in h:
