@@ -8,6 +8,7 @@ mesh-first — see README.md and SURVEY.md.
 from __future__ import annotations
 
 import os
+import time as _time
 from typing import Any, Dict, List, Optional, Sequence
 
 from ._version import __version__
@@ -104,10 +105,20 @@ def method(num_returns: int = 1):
 
 
 def get(refs, timeout: Optional[float] = None):
+    # future-like objects (e.g. serve.DeploymentResponse) resolve through
+    # the __rtpu_result__ protocol
+    if hasattr(refs, "__rtpu_result__"):
+        return refs.__rtpu_result__(timeout)
     rt = _runtime_mod.get_runtime()
     if isinstance(refs, ObjectRef):
         return rt.get(refs, timeout)
     if isinstance(refs, (list, tuple)):
+        if all(hasattr(r, "__rtpu_result__") for r in refs) and refs:
+            deadline = None if timeout is None else _time.monotonic() + timeout
+            return [r.__rtpu_result__(
+                None if deadline is None
+                else max(0.0, deadline - _time.monotonic()))
+                for r in refs]
         if not all(isinstance(r, ObjectRef) for r in refs):
             raise TypeError("ray_tpu.get accepts an ObjectRef or a list of them")
         return rt.get(list(refs), timeout)

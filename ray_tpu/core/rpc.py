@@ -10,6 +10,7 @@ AF_UNIX within a host and AF_INET across hosts (DCN control plane).
 from __future__ import annotations
 
 import itertools
+import queue as queue_mod
 import threading
 import traceback
 from concurrent.futures import Future, ThreadPoolExecutor
@@ -17,6 +18,7 @@ from multiprocessing.connection import Client, Connection, Listener
 from typing import Any, Callable, Dict, Optional
 
 _REQ, _RESP, _ERR, _ONEWAY = 0, 1, 2, 3
+_CLOSE = object()  # writer-thread sentinel
 
 
 class ChannelClosed(Exception):
@@ -54,6 +56,14 @@ class RpcChannel:
             max_workers=1, thread_name_prefix=f"rpc-ow-{name}")
         self._reader = threading.Thread(target=self._read_loop, daemon=True,
                                         name=f"rpc-reader-{name}")
+        # Single writer thread owns conn.send. Senders only enqueue, so a
+        # full socket buffer can never block the reader thread, a handler,
+        # or a GC finalizer (an ObjectRef finalizer notifying remove_ref
+        # from inside the reader's read loop deadlocked both pipe
+        # directions before this).
+        self._out_q: queue_mod.SimpleQueue = queue_mod.SimpleQueue()
+        self._writer = threading.Thread(target=self._write_loop, daemon=True,
+                                        name=f"rpc-writer-{name}")
         if autostart:
             self.start()
 
@@ -62,6 +72,7 @@ class RpcChannel:
         autostart=False — otherwise a message can race the handler install."""
         if not self._started:
             self._started = True
+            self._writer.start()
             self._reader.start()
 
     # -- client side -----------------------------------------------------------
@@ -82,7 +93,8 @@ class RpcChannel:
         except Exception as e:
             with self._lock:
                 self._pending.pop(msg_id, None)
-            fut.set_exception(ChannelClosed(str(e)))
+            if not fut.done():  # teardown may have failed it already
+                fut.set_exception(ChannelClosed(str(e)))
         return fut
 
     def notify(self, method: str, payload: Any = None) -> None:
@@ -93,8 +105,20 @@ class RpcChannel:
             pass
 
     def _send(self, msg) -> None:
-        with self._lock:
-            self._conn.send(msg)
+        if self._closed.is_set():
+            raise ChannelClosed(f"channel {self._name} closed")
+        self._out_q.put(msg)
+
+    def _write_loop(self) -> None:
+        while True:
+            msg = self._out_q.get()
+            if msg is _CLOSE:
+                return
+            try:
+                self._conn.send(msg)
+            except Exception:
+                self._teardown()
+                return
 
     # -- server side -----------------------------------------------------------
 
@@ -166,6 +190,7 @@ class RpcChannel:
             self._closed.set()
             pending = list(self._pending.values())
             self._pending.clear()
+        self._out_q.put(_CLOSE)  # let the writer drain queued sends, then exit
         for fut in pending:
             if not fut.done():
                 fut.set_exception(ChannelClosed(f"channel {self._name} closed"))
@@ -178,11 +203,15 @@ class RpcChannel:
         self._oneway_pool.shutdown(wait=False)
 
     def close(self) -> None:
+        self._teardown()
+        # give the writer a moment to flush already-queued messages (e.g. a
+        # final "shutdown" notify) before the connection drops
+        if self._started and threading.current_thread() is not self._writer:
+            self._writer.join(timeout=2.0)
         try:
             self._conn.close()
         except Exception:
             pass
-        self._teardown()
 
     @property
     def closed(self) -> bool:

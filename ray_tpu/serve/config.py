@@ -1,0 +1,41 @@
+"""Deployment configuration (ref: python/ray/serve/config.py
+DeploymentConfig/AutoscalingConfig)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class AutoscalingConfig:
+    """Request-based autoscaling (ref: serve/_private/autoscaling_policy.py:12
+    — desired = ongoing_requests / target, clamped and smoothed)."""
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.0
+    downscale_delay_s: float = 5.0
+
+
+@dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_concurrent_queries: int = 8
+    health_check_period_s: float = 2.0
+    health_check_timeout_s: float = 10.0
+    graceful_shutdown_timeout_s: float = 5.0
+    user_config: Optional[Any] = None
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    autoscaling: Optional[AutoscalingConfig] = None
+
+    def version_fields(self) -> tuple:
+        """Changes to these require replacing replicas (rolling update);
+        num_replicas alone only rescales (ref: deployment_state.py
+        lightweight-update split)."""
+        return (repr(self.user_config), repr(self.ray_actor_options))
+
+
+# deployment statuses (ref: serve/schema.py DeploymentStatus)
+UPDATING = "UPDATING"
+HEALTHY = "HEALTHY"
+UNHEALTHY = "UNHEALTHY"

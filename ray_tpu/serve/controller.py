@@ -1,0 +1,298 @@
+"""ServeController — the reconcile loop.
+
+Equivalent of the reference's controller actor (ref:
+python/ray/serve/_private/controller.py:74; run_control_loop :298) with
+DeploymentState semantics (ref: deployment_state.py — target vs running
+replicas, health checks, rolling updates, scale up/down) collapsed into
+one actor. Replicas are actors the controller owns; handles discover them
+via get_replicas (the long-poll analog is version-stamped polling,
+ref: long_poll.py:187).
+"""
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+from .config import HEALTHY, UNHEALTHY, UPDATING, DeploymentConfig
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class _ReplicaState:
+    def __init__(self, handle, version: int, tag: str):
+        self.handle = handle
+        self.version = version
+        self.tag = tag
+        self.starting = True           # until first successful ping
+        self.started_at = time.monotonic()
+        self.last_ongoing = 0
+
+
+class _DeploymentState:
+    def __init__(self, name: str, blob: bytes, init_args, init_kwargs,
+                 config: DeploymentConfig):
+        self.name = name
+        self.blob = blob
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+        self.config = config
+        self.version = 0
+        self.replicas: List[_ReplicaState] = []
+        self.status = UPDATING
+        self.target = (config.autoscaling.min_replicas
+                       if config.autoscaling else config.num_replicas)
+        self._last_scale = 0.0
+        self.deleted = False
+
+
+class ServeController:
+    def __init__(self, control_period_s: float = 0.5):
+        self._period = control_period_s
+        self._deployments: Dict[str, _DeploymentState] = {}
+        # deleted-then-redeployed states drain here until their replicas die
+        self._graveyard: list = []
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._control_loop,
+                                        daemon=True, name="serve-reconcile")
+        self._thread.start()
+
+    # -- API ------------------------------------------------------------------
+
+    def deploy(self, name: str, blob: bytes, init_args, init_kwargs,
+               config: DeploymentConfig) -> bool:
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is not None and st.deleted:
+                self._graveyard.append(st)  # loop still owns its replicas
+                st = None
+            if st is None:
+                st = _DeploymentState(name, blob, init_args, init_kwargs,
+                                      config)
+                self._deployments[name] = st
+                return True
+            code_changed = (blob != st.blob
+                            or init_args != st.init_args
+                            or init_kwargs != st.init_kwargs
+                            or config.version_fields()
+                            != st.config.version_fields())
+            st.blob, st.init_args, st.init_kwargs = blob, init_args, init_kwargs
+            st.config = config
+            if not config.autoscaling:
+                st.target = config.num_replicas
+            if code_changed:
+                st.version += 1         # triggers rolling replacement
+                st.status = UPDATING
+            return True
+
+    def delete(self, name: str) -> bool:
+        # mark-and-reconcile rather than pop: an in-flight _reconcile
+        # holding this state must not restart replicas for a deployment
+        # that no longer exists — the loop drains it and removes the entry
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None:
+                return False
+            st.deleted = True
+            st.target = 0
+        return True
+
+    def get_replicas(self, name: str):
+        """-> (version, max_concurrent_queries, [actor handles]) for routing."""
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None:
+                return (0, 0, [])
+            handles = [r.handle for r in st.replicas
+                       if not r.starting and r.version == st.version]
+            return (st.version, st.config.max_concurrent_queries, handles)
+
+    def status(self) -> Dict[str, dict]:
+        with self._lock:
+            return {
+                name: {"status": st.status, "version": st.version,
+                       "target": st.target,
+                       "running": sum(1 for r in st.replicas
+                                      if not r.starting)}
+                for name, st in self._deployments.items() if not st.deleted
+            }
+
+    def list_deployments(self) -> List[str]:
+        with self._lock:
+            return list(self._deployments)
+
+    def ping(self) -> str:
+        return "ok"
+
+    def shutdown(self) -> bool:
+        self._stop.set()
+        with self._lock:
+            states = list(self._deployments.values())
+            self._deployments.clear()
+        for st in states:
+            for r in st.replicas:
+                self._kill(r)
+        return True
+
+    # -- reconciliation -------------------------------------------------------
+
+    def _control_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                with self._lock:
+                    states = (list(self._deployments.values())
+                              + list(self._graveyard))
+                for st in states:
+                    self._reconcile(st)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+            self._stop.wait(self._period)
+
+    def _reconcile(self, st: _DeploymentState) -> None:
+        if st.deleted:
+            with self._lock:
+                victims = list(st.replicas)
+                st.replicas.clear()
+            for r in victims:
+                self._kill(r, st.config.graceful_shutdown_timeout_s)
+            with self._lock:
+                if self._deployments.get(st.name) is st:
+                    del self._deployments[st.name]
+                if st in self._graveyard:
+                    self._graveyard.remove(st)
+            return
+        self._health_check(st)
+        self._autoscale(st)
+        with self._lock:
+            current = list(st.replicas)
+            target = st.target
+            version = st.version
+        running = [r for r in current if not r.starting]
+        # rolling update: at most one old replica replaced per cycle, and
+        # only while the deployment is at healthy strength (ref:
+        # deployment_state.py rolling update semantics)
+        old = [r for r in running if r.version != version]
+        if old and len(running) >= target:
+            victim = old[0]
+            with self._lock:
+                if victim in st.replicas:
+                    st.replicas.remove(victim)
+            self._kill(victim, st.config.graceful_shutdown_timeout_s)
+            current = [r for r in current if r is not victim]
+        # scale up
+        while len(current) < target:
+            r = self._start_replica(st, version)
+            if r is None:
+                break
+            current.append(r)
+        # scale down (newest starting first, then newest running)
+        while len(current) > target:
+            victim = sorted(current, key=lambda r: (not r.starting,
+                                                    -r.started_at))[0]
+            with self._lock:
+                if victim in st.replicas:
+                    st.replicas.remove(victim)
+            self._kill(victim, st.config.graceful_shutdown_timeout_s)
+            current.remove(victim)
+        with self._lock:
+            healthy = sum(1 for r in st.replicas
+                          if not r.starting and r.version == version)
+            if healthy >= st.target and not old:
+                st.status = HEALTHY
+            elif not st.replicas:
+                st.status = UNHEALTHY
+            else:
+                st.status = UPDATING
+
+    def _health_check(self, st: _DeploymentState) -> None:
+        with self._lock:
+            replicas = list(st.replicas)
+        if not replicas:
+            return
+        probes = [(r, r.handle.ping.remote()) for r in replicas]
+        deadline = time.monotonic() + st.config.health_check_timeout_s
+        for r, ref in probes:
+            timeout = max(0.1, deadline - time.monotonic())
+            try:
+                info = ray_tpu.get(ref, timeout=timeout)
+                r.starting = False
+                r.last_ongoing = int(info.get("ongoing", 0))
+            except Exception:
+                grace = st.config.health_check_timeout_s * 3
+                if r.starting and time.monotonic() - r.started_at < grace:
+                    continue  # still constructing
+                with self._lock:
+                    if r in st.replicas:
+                        st.replicas.remove(r)
+                self._kill(r, st.config.graceful_shutdown_timeout_s)
+
+    def _autoscale(self, st: _DeploymentState) -> None:
+        cfg = st.config.autoscaling
+        if cfg is None:
+            return
+        with self._lock:
+            running = [r for r in st.replicas if not r.starting]
+            ongoing = sum(r.last_ongoing for r in running)
+        if not running:
+            return
+        import math
+
+        desired = max(cfg.min_replicas,
+                      min(cfg.max_replicas,
+                          math.ceil(ongoing / cfg.target_ongoing_requests)))
+        now = time.monotonic()
+        if desired > st.target and now - st._last_scale >= cfg.upscale_delay_s:
+            st.target = desired
+            st._last_scale = now
+        elif (desired < st.target
+              and now - st._last_scale >= cfg.downscale_delay_s):
+            st.target = desired
+            st._last_scale = now
+
+    # -- replica ops ----------------------------------------------------------
+
+    def _start_replica(self, st: _DeploymentState,
+                       version: int) -> Optional[_ReplicaState]:
+        from .replica import Replica
+
+        tag = f"{st.name}#{uuid.uuid4().hex[:6]}"
+        opts = dict(st.config.ray_actor_options)
+        opts.setdefault("num_cpus", 1.0)
+        try:
+            cls = ray_tpu.remote(Replica)
+            handle = cls.options(**opts).remote(
+                st.blob, st.init_args, st.init_kwargs,
+                st.config.user_config, st.name, tag, version)
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+            return None
+        r = _ReplicaState(handle, version, tag)
+        with self._lock:
+            st.replicas.append(r)
+        return r
+
+    def _kill(self, r: _ReplicaState, grace_s: float = 5.0) -> None:
+        try:
+            ray_tpu.get(r.handle.shutdown.remote(), timeout=grace_s)
+        except Exception:
+            pass
+        try:
+            ray_tpu.kill(r.handle)
+        except Exception:
+            pass
+
+
+def get_or_create_controller():
+    """The controller is a named detached actor shared by all drivers in
+    the session (ref: serve/_private/client.py get_controller)."""
+    cls = ray_tpu.remote(ServeController)
+    return cls.options(name=CONTROLLER_NAME, lifetime="detached",
+                       get_if_exists=True, max_restarts=1).remote()

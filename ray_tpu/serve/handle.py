@@ -1,0 +1,181 @@
+"""DeploymentHandle — the routing client.
+
+Equivalent of the reference's handle + router (ref:
+python/ray/serve/handle.py DeploymentHandle/DeploymentResponse;
+_private/router.py:263 PowerOfTwoChoicesReplicaScheduler, choose_two
+:411). remote() returns a DeploymentResponse backed by a router worker
+that owns the request until a replica finishes it: power-of-two-choices
+over handle-local in-flight counts, backoff when every replica is at
+max_concurrent_queries, and transparent re-routing when a replica dies
+mid-request (the reference router reassigns exactly the same way).
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.exceptions import ActorDiedError, WorkerCrashedError
+
+from .controller import CONTROLLER_NAME
+
+
+class DeploymentResponse:
+    """Future-like result of handle.remote(). `ray_tpu.get` accepts it
+    (via the __rtpu_result__ protocol), or call .result(timeout)."""
+
+    def __init__(self, fut: Future):
+        self._fut = fut
+
+    def result(self, timeout: Optional[float] = None):
+        return self._fut.result(timeout)
+
+    def __rtpu_result__(self, timeout: Optional[float] = None):
+        return self._fut.result(timeout)
+
+    def done(self) -> bool:
+        return self._fut.done()
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str):
+        self._name = deployment_name
+        self._init_local()
+
+    def _init_local(self) -> None:
+        self._controller = None
+        self._version = -1
+        self._replicas: list = []
+        self._max_q = 8
+        self._refreshed = 0.0
+        self._inflight: Dict[Any, int] = {}  # replica actor_id -> count
+        self._lock = threading.Lock()
+        self._router: Optional[ThreadPoolExecutor] = None
+
+    # handles travel into other deployments' constructors
+    def __reduce__(self):
+        return (DeploymentHandle, (self._name,))
+
+    # -- replica discovery ----------------------------------------------------
+
+    def _refresh(self, force: bool = False) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if not force and self._replicas and now - self._refreshed < 2.0:
+                return
+        if self._controller is None:
+            self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        version, max_q, replicas = ray_tpu.get(
+            self._controller.get_replicas.remote(self._name), timeout=30)
+        with self._lock:
+            self._refreshed = time.monotonic()
+            if replicas:
+                self._replicas = replicas
+                self._max_q = max_q or 8
+                self._version = version
+                live = {r._actor_id for r in replicas}
+                self._inflight = {a: c for a, c in self._inflight.items()
+                                  if a in live}
+
+    def _drop(self, replica) -> None:
+        with self._lock:
+            self._replicas = [r for r in self._replicas if r is not replica]
+            self._inflight.pop(replica._actor_id, None)
+
+    # -- power-of-two-choices -------------------------------------------------
+
+    def _pick(self):
+        """-> replica handle, or None when all replicas are saturated or
+        unknown (caller backs off / refreshes)."""
+        with self._lock:
+            n = len(self._replicas)
+            if n == 0:
+                return None
+            if n == 1:
+                cand = self._replicas[0]
+            else:
+                a, b = random.sample(range(n), 2)
+                ca = self._inflight.get(self._replicas[a]._actor_id, 0)
+                cb = self._inflight.get(self._replicas[b]._actor_id, 0)
+                cand = self._replicas[a] if ca <= cb else self._replicas[b]
+            if self._inflight.get(cand._actor_id, 0) >= self._max_q:
+                return None
+            aid = cand._actor_id
+            self._inflight[aid] = self._inflight.get(aid, 0) + 1
+            return cand
+
+    # -- the router worker ----------------------------------------------------
+
+    def _route_blocking(self, method: str, args, kwargs, deadline: float):
+        import ray_tpu.core.runtime as runtime_mod
+
+        rt = runtime_mod.get_runtime()
+        backoff = 0.005
+        while True:
+            self._refresh()
+            replica = self._pick()
+            if replica is None:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"{self._name}: no replica accepted the request "
+                        f"(all dead or saturated)")
+                time.sleep(backoff + random.random() * backoff)
+                backoff = min(backoff * 2, 0.25)
+                self._refresh(force=True)
+                continue
+            aid = replica._actor_id
+            try:
+                if rt.actor_state(aid) in ("DEAD", "RESTARTING"):
+                    raise ActorDiedError("replica not alive")
+                ref = replica.handle_request.remote(method, args, kwargs)
+                remaining = max(0.1, deadline - time.monotonic())
+                return ray_tpu.get(ref, timeout=remaining)
+            except (ActorDiedError, WorkerCrashedError):
+                # replica died before/while running the request: the router
+                # still owns it — drop the corpse and reassign (ref:
+                # router.py replica-death reassignment)
+                self._drop(replica)
+                continue
+            finally:
+                with self._lock:
+                    c = self._inflight.get(aid, 0) - 1
+                    if c <= 0:
+                        self._inflight.pop(aid, None)
+                    else:
+                        self._inflight[aid] = c
+
+    def _submit(self, method: str, args, kwargs) -> DeploymentResponse:
+        with self._lock:
+            if self._router is None:
+                self._router = ThreadPoolExecutor(
+                    max_workers=16, thread_name_prefix=f"router-{self._name}")
+            router = self._router
+        deadline = time.monotonic() + 300.0
+        fut = router.submit(self._route_blocking, method, args, kwargs,
+                            deadline)
+        return DeploymentResponse(fut)
+
+    # -- public API ------------------------------------------------------------
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._submit("__call__", args, kwargs)
+
+    def __getattr__(self, item: str):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return _MethodCaller(self, item)
+
+    def __repr__(self):
+        return f"DeploymentHandle({self._name!r})"
+
+
+class _MethodCaller:
+    def __init__(self, handle: DeploymentHandle, method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._handle._submit(self._method, args, kwargs)

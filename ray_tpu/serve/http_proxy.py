@@ -1,0 +1,113 @@
+"""HTTP ingress.
+
+Equivalent of the reference's HTTPProxyActor (ref:
+python/ray/serve/_private/http_proxy.py:873 — uvicorn/ASGI). Here a
+stdlib ThreadingHTTPServer inside an actor: no external web framework in
+the image, and the proxy is off the TPU hot path by design. Requests:
+
+    POST /<deployment>       body = JSON  -> result as JSON
+    GET  /<deployment>?q=... -> calls with the query dict
+    GET  /-/routes           -> deployment listing
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict
+from urllib.parse import parse_qs, urlparse
+
+import ray_tpu
+
+
+class HTTPProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        from .handle import DeploymentHandle
+
+        self._handles: Dict[str, DeploymentHandle] = {}
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _reply(self, code: int, payload) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _dispatch(self, data) -> None:
+                path = urlparse(self.path)
+                name = path.path.strip("/")
+                if name == "-/routes":
+                    self._reply(200, proxy._routes())
+                    return
+                if not name:
+                    self._reply(404, {"error": "no deployment in path"})
+                    return
+                try:
+                    h = proxy._get_handle(name)
+                    ref = h.remote(data)
+                    result = ray_tpu.get(ref, timeout=60)
+                    self._reply(200, proxy._jsonable(result))
+                except Exception as e:  # noqa: BLE001 — surfaced as 500
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(n) if n else b""
+                try:
+                    data = json.loads(raw) if raw else None
+                except json.JSONDecodeError:
+                    self._reply(400, {"error": "body must be JSON"})
+                    return
+                self._dispatch(data)
+
+            def do_GET(self):  # noqa: N802
+                q = parse_qs(urlparse(self.path).query)
+                data = {k: v[0] if len(v) == 1 else v for k, v in q.items()}
+                self._dispatch(data or None)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="serve-http")
+        self._thread.start()
+
+    def _get_handle(self, name: str):
+        from .handle import DeploymentHandle
+
+        h = self._handles.get(name)
+        if h is None:
+            h = self._handles[name] = DeploymentHandle(name)
+        return h
+
+    def _routes(self) -> dict:
+        from .controller import CONTROLLER_NAME
+
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        return {"deployments":
+                ray_tpu.get(controller.list_deployments.remote(), timeout=10)}
+
+    @staticmethod
+    def _jsonable(value):
+        import numpy as np
+
+        if isinstance(value, np.ndarray):
+            return value.tolist()
+        if isinstance(value, (np.integer, np.floating)):
+            return value.item()
+        return value
+
+    def address(self) -> tuple:
+        return ("127.0.0.1", self._port)
+
+    def ping(self) -> str:
+        return "ok"
+
+    def shutdown(self) -> bool:
+        self._server.shutdown()
+        return True

@@ -1,0 +1,92 @@
+"""MeshDeployment — a replica whose compute is a gang of mesh workers.
+
+The TPU-native twist on Serve (SURVEY.md §7: "a replica spans multiple
+hosts, unlike Ray, so the router must target mesh groups"): one replica =
+one MeshGroup of host actors that each own a slice of the device mesh and
+enter the same pjit-compiled program. The router still sees a single
+replica actor (this class); fan-out to the gang happens inside
+handle_request via MeshGroup.run, so power-of-two-choices and
+max_concurrent_queries compose unchanged.
+
+Subclass and implement:
+    build(config)          -> (params, apply_fn) built ONCE per worker
+    preprocess(request)    -> batch (host side, optional)
+    postprocess(outputs)   -> response (optional)
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..parallel import MeshGroup, MeshSpec
+from ..parallel.mesh_group import MeshWorkerMixin
+
+
+class _MeshInferenceWorker(MeshWorkerMixin):
+    """One host of the replica's gang: builds the model and jits the
+    sharded forward on its mesh slice."""
+
+    def build_model(self, build_blob: bytes, config: Optional[dict]) -> bool:
+        import cloudpickle
+
+        build = cloudpickle.loads(build_blob)
+        self._params, self._apply = build(self.mesh, config or {})
+        return True
+
+    def infer(self, batch):
+        return self._apply(self._params, batch)
+
+
+class MeshDeployment:
+    """User-facing base: a deployment class hosting a sharded model.
+
+    build_fn(mesh, config) -> (params, apply_fn) runs on every gang
+    worker; apply_fn(params, batch) is the pjit-compiled forward.
+    """
+
+    def __init__(self, build_fn, *, num_workers: int = 1,
+                 spec: Optional[MeshSpec] = None,
+                 devices_per_worker: Optional[int] = None,
+                 coordinator: Optional[str] = None,
+                 config: Optional[dict] = None):
+        import cloudpickle
+
+        self._group = MeshGroup(num_workers=num_workers, spec=spec,
+                                worker_cls=_MeshInferenceWorker,
+                                devices_per_process=devices_per_worker,
+                                coordinator=coordinator)
+        blob = cloudpickle.dumps(build_fn)
+        self._group.run(lambda w: w.build_model(blob, config))
+        self._config = config
+
+    def __call__(self, request: Any):
+        batch = self.preprocess(request)
+        # SPMD gang entry: every worker runs the same program on its mesh
+        # slice; worker 0's (fully-addressable on single-host meshes)
+        # output is the reply
+        outs = self._group.run(lambda w, b=batch: w.infer(b))
+        return self.postprocess(outs[0])
+
+    def preprocess(self, request: Any):
+        return request
+
+    def postprocess(self, output: Any):
+        return output
+
+    def check_health(self) -> None:
+        # a dead gang worker fails the next ping -> replica replaced
+        import ray_tpu
+
+        ray_tpu.get([w.mesh_run.remote(_noop_blob())
+                     for w in self._group.workers], timeout=30)
+
+    def __del__(self):
+        try:
+            self._group.shutdown()
+        except Exception:
+            pass
+
+
+def _noop_blob() -> bytes:
+    import cloudpickle
+
+    return cloudpickle.dumps(lambda w: True)

@@ -1,0 +1,79 @@
+"""Replica actor — hosts one copy of the user's callable.
+
+Equivalent of the reference's RayServeReplica (ref:
+python/ray/serve/_private/replica.py — user callable wrapper, ongoing-
+query counting, health checks, reconfigure). The TPU twist lives in
+MeshDeployment (mesh_replica.py): a replica whose compute spans a gang of
+mesh workers.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+
+class Replica:
+    def __init__(self, serialized_cls: bytes, init_args: tuple,
+                 init_kwargs: dict, user_config: Any, deployment: str,
+                 replica_tag: str, version: int):
+        target = cloudpickle.loads(serialized_cls)
+        self._deployment = deployment
+        self._replica_tag = replica_tag
+        self._version = version
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+        if isinstance(target, type):
+            self._callable = target(*init_args, **init_kwargs)
+        else:
+            # function deployment: args bind at call time
+            self._callable = target
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    # -- request path ----------------------------------------------------------
+
+    def handle_request(self, method: str, args: tuple, kwargs: dict):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if method == "__call__":
+                fn = self._callable
+            else:
+                fn = getattr(self._callable, method)
+            return fn(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    # -- control plane ---------------------------------------------------------
+
+    def ping(self) -> dict:
+        """Health check; user classes may define check_health() that raises
+        when unhealthy (ref: replica.py check_health)."""
+        check = getattr(self._callable, "check_health", None)
+        if callable(check):
+            check()
+        return {"ok": True, "version": self._version,
+                "ongoing": self._ongoing, "total": self._total}
+
+    def queue_len(self) -> int:
+        return self._ongoing
+
+    def reconfigure(self, user_config: Any) -> bool:
+        fn = getattr(self._callable, "reconfigure", None)
+        if callable(fn):
+            fn(user_config)
+            return True
+        return False
+
+    def shutdown(self) -> bool:
+        """Graceful cleanup before the controller hard-kills this actor —
+        a MeshDeployment tears down its gang of mesh workers here."""
+        fn = getattr(self._callable, "__del__", None)
+        if callable(fn):
+            fn()
+        return True

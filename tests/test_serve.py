@@ -1,0 +1,188 @@
+"""ray_tpu.serve: deploy/scale/route/recover + sharded mesh inference
+(ref test model: python/ray/serve/tests/ controller/replica/handle e2e)."""
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    rt = ray_tpu.init(num_cpus=8)
+    yield rt
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _teardown_deployments(cluster):
+    yield
+    try:
+        for name in serve.status():
+            serve.delete(name)
+    except Exception:
+        pass
+
+
+def test_deploy_and_route(cluster):
+    @serve.deployment(num_replicas=2)
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+        def triple(self, x):
+            return x * 3
+
+    h = serve.run(Doubler.bind())
+    assert ray_tpu.get(h.remote(21), timeout=30) == 42
+    assert ray_tpu.get(h.triple.remote(10), timeout=30) == 30
+    st = serve.status()["Doubler"]
+    assert st["status"] == "HEALTHY" and st["running"] == 2
+
+
+def test_function_deployment_and_composition(cluster):
+    @serve.deployment
+    def embed(x):
+        return x + 100
+
+    @serve.deployment
+    class Pipeline:
+        def __init__(self, embedder):
+            self.embedder = embedder
+
+        def __call__(self, x):
+            return ray_tpu.get(self.embedder.remote(x), timeout=30) + 1
+
+    h = serve.run(Pipeline.bind(embed.bind()))
+    assert ray_tpu.get(h.remote(5), timeout=60) == 106
+
+
+def test_scale_up_down(cluster):
+    @serve.deployment(num_replicas=1)
+    class S:
+        def __call__(self, x):
+            return x
+
+    serve.run(S.bind())
+    assert serve.status()["S"]["running"] == 1
+    serve.run(S.options(num_replicas=3).bind())
+    deadline = time.monotonic() + 60
+    while serve.status()["S"]["running"] != 3:
+        assert time.monotonic() < deadline
+        time.sleep(0.2)
+    serve.run(S.options(num_replicas=1).bind())
+    deadline = time.monotonic() + 60
+    while serve.status()["S"]["running"] != 1:
+        assert time.monotonic() < deadline
+        time.sleep(0.2)
+
+
+def test_replica_recovery_after_kill(cluster):
+    @serve.deployment(num_replicas=2, health_check_period_s=0.5,
+                      health_check_timeout_s=2.0)
+    class R:
+        def __call__(self, x):
+            return x + 1
+
+    h = serve.run(R.bind())
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+    _, _, replicas = ray_tpu.get(controller.get_replicas.remote("R"),
+                                 timeout=30)
+    ray_tpu.kill(replicas[0])  # hard kill one replica
+    # service keeps answering throughout recovery
+    for i in range(20):
+        assert ray_tpu.get(h.remote(i), timeout=60) == i + 1
+        time.sleep(0.05)
+    deadline = time.monotonic() + 60
+    while serve.status()["R"]["running"] != 2:
+        assert time.monotonic() < deadline
+        time.sleep(0.2)
+
+
+def test_rolling_update_changes_code(cluster):
+    @serve.deployment(num_replicas=2, user_config={"bias": 1})
+    class V:
+        def __init__(self):
+            self.bias = 0
+
+        def reconfigure(self, cfg):
+            self.bias = cfg["bias"]
+
+        def __call__(self, x):
+            return x + self.bias
+
+    h = serve.run(V.bind())
+    assert ray_tpu.get(h.remote(0), timeout=30) == 1
+    serve.run(V.options(user_config={"bias": 7}).bind())
+    deadline = time.monotonic() + 90
+    while True:
+        vals = {ray_tpu.get(h.remote(0), timeout=30) for _ in range(4)}
+        if vals == {7}:
+            break
+        assert time.monotonic() < deadline
+        time.sleep(0.3)
+
+
+def test_http_proxy(cluster):
+    @serve.deployment
+    class Echo:
+        def __call__(self, body):
+            return {"got": body}
+
+    serve.run(Echo.bind())
+    host, port = serve.start_http_proxy()
+    req = urllib.request.Request(
+        f"http://{host}:{port}/Echo", data=json.dumps({"a": 1}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert json.load(resp) == {"got": {"a": 1}}
+    with urllib.request.urlopen(f"http://{host}:{port}/-/routes",
+                                timeout=30) as resp:
+        assert "Echo" in json.load(resp)["deployments"]
+
+
+def test_mesh_deployment_sharded_inference(cluster):
+    """A replica spanning a gang of mesh workers serving a pjit-sharded
+    GPT-tiny forward (the Llama-2-7B north-star shape, tiny config)."""
+
+    def build(mesh, config):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ray_tpu.models import GPT, GPTConfig
+
+        cfg = GPTConfig.tiny(dtype=jnp.float32, use_flash=False, remat=False)
+        model = GPT(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+
+        @jax.jit
+        def forward(params, tokens):
+            return model.apply(params, tokens).argmax(-1)
+
+        def apply(params, tokens):
+            out = forward(params, jnp.asarray(tokens, jnp.int32))
+            return np.asarray(jax.device_get(out))
+
+        return params, apply
+
+    @serve.deployment(num_replicas=1, health_check_timeout_s=60)
+    class GptServer(serve.MeshDeployment):
+        def __init__(self):
+            super().__init__(build, num_workers=2, devices_per_worker=2)
+
+        def preprocess(self, request):
+            return np.asarray(request, dtype=np.int32)
+
+        def postprocess(self, out):
+            return np.asarray(out).tolist()
+
+    h = serve.run(GptServer.bind(), timeout=240)
+    tokens = [[1, 2, 3, 4]]
+    out = ray_tpu.get(h.remote(tokens), timeout=120)
+    assert np.asarray(out).shape == (1, 4)
