@@ -97,6 +97,8 @@ def main() -> None:
     peak = _peak_flops(jax.devices()[0])
     mfu = achieved / peak
 
+    rl_steps_per_sec = _bench_ppo_steps()
+
     print(json.dumps({
         "metric": "gpt2_small_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
@@ -110,8 +112,37 @@ def main() -> None:
             "device": getattr(jax.devices()[0], "device_kind", "cpu"),
             "steps_timed": steps,
             "sec_per_step": round(dt / steps, 4),
+            "ppo_env_steps_per_sec": rl_steps_per_sec,
         },
     }))
+
+
+def _bench_ppo_steps() -> float:
+    """Secondary metric: PPO env-steps/s, single-process rollout+learner
+    (the >100k steps/s north star is multi-worker; this tracks the
+    per-core envelope without burning bench budget)."""
+    try:
+        from ray_tpu.rllib.learner import PPOLearner
+        from ray_tpu.rllib.rollout_worker import RolloutWorker
+
+        n_envs, T = (8, 64) if SMOKE else (32, 256)
+        w = RolloutWorker("CartPole-v1", num_envs=n_envs, rollout_len=T,
+                          gamma=0.99, lam=0.95, seed=0)
+        info = w.env_info()
+        learner = PPOLearner(info["obs_dim"], info["num_actions"],
+                             minibatch_size=512, num_epochs=2, seed=0)
+        learner.update(w.sample(learner.get_params()))  # warmup/compile
+        t0 = time.perf_counter()
+        iters = 1 if SMOKE else 3
+        for _ in range(iters):
+            learner.update(w.sample(learner.get_params()))
+        dt = time.perf_counter() - t0
+        return round(n_envs * T * iters / dt, 1)
+    except Exception:
+        import traceback
+
+        traceback.print_exc()  # a broken RL stack must not look like 0 perf
+        return 0.0
 
 
 if __name__ == "__main__":

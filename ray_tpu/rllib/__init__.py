@@ -1,0 +1,16 @@
+"""ray_tpu.rllib — reinforcement learning on the distributed runtime.
+
+Equivalent of RLlib's core loop (ref: rllib/algorithms/): rollout-worker
+actors sampling vectorized envs, a jitted JAX PPO learner (pmean-ready
+for data-parallel meshes), synchronous Algorithm.train() with object-
+store weight broadcast, and a Tune-compatible trainable surface.
+"""
+from .algorithm import PPO, PPOConfig
+from .env import CartPoleVecEnv, VectorEnv, make_env, register_env
+from .learner import PPOLearner, ppo_loss
+from .rollout_worker import RolloutWorker
+
+__all__ = [
+    "CartPoleVecEnv", "PPO", "PPOConfig", "PPOLearner", "RolloutWorker",
+    "VectorEnv", "make_env", "ppo_loss", "register_env",
+]

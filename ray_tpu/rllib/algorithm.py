@@ -1,0 +1,175 @@
+"""Algorithm — the train() driver.
+
+ref: rllib/algorithms/algorithm.py (step :813, training_step :1400);
+ppo/ppo.py:420 training_step = synchronous_parallel_sample over the
+WorkerSet → learner update → weight broadcast. Here: N rollout-worker
+actors sample in parallel, batches meet at the JAX learner, new params
+broadcast through ONE object-store put per iteration.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+import numpy as np
+
+import ray_tpu
+
+from . import sample_batch as sb
+from .learner import PPOLearner
+from .rollout_worker import RolloutWorker
+
+
+@dataclass
+class PPOConfig:
+    """ref: ppo/ppo.py PPOConfig + algorithm_config.py builder pattern."""
+    env: str = "CartPole-v1"
+    env_creator: Optional[Callable] = None
+    num_rollout_workers: int = 2
+    num_envs_per_worker: int = 8
+    rollout_fragment_length: int = 128
+    gamma: float = 0.99
+    lam: float = 0.95
+    lr: float = 3e-4
+    clip_param: float = 0.2
+    vf_loss_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    sgd_minibatch_size: int = 256
+    num_sgd_epochs: int = 4
+    hidden: tuple = (64, 64)
+    seed: int = 0
+    worker_resources: Dict[str, float] = field(default_factory=dict)
+
+    def environment(self, env: str = None, *, env_creator=None) -> "PPOConfig":
+        if env is not None:
+            self.env = env
+        if env_creator is not None:
+            self.env_creator = env_creator
+        return self
+
+    def rollouts(self, *, num_rollout_workers: int = None,
+                 num_envs_per_worker: int = None,
+                 rollout_fragment_length: int = None) -> "PPOConfig":
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, *, lr: float = None, gamma: float = None,
+                 clip_param: float = None, entropy_coeff: float = None,
+                 sgd_minibatch_size: int = None,
+                 num_sgd_epochs: int = None) -> "PPOConfig":
+        for k, v in [("lr", lr), ("gamma", gamma), ("clip_param", clip_param),
+                     ("entropy_coeff", entropy_coeff),
+                     ("sgd_minibatch_size", sgd_minibatch_size),
+                     ("num_sgd_epochs", num_sgd_epochs)]:
+            if v is not None:
+                setattr(self, k, v)
+        return self
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+class PPO:
+    """Synchronous-PPO algorithm instance (Tune-trainable shaped: train()
+    returns a result dict, save/restore round-trip the learner state)."""
+
+    def __init__(self, config: PPOConfig):
+        self.config = config
+        c = config
+        creator_blob = (cloudpickle.dumps(c.env_creator)
+                        if c.env_creator else None)
+        worker_cls = ray_tpu.remote(RolloutWorker)
+        opts = {"num_cpus": c.worker_resources.get("CPU", 1.0)}
+        extra = {k: v for k, v in c.worker_resources.items() if k != "CPU"}
+        if extra:
+            opts["resources"] = extra
+        self.workers: List = [
+            worker_cls.options(**opts).remote(
+                c.env, c.num_envs_per_worker, c.rollout_fragment_length,
+                c.gamma, c.lam, seed=c.seed + 1000 * i,
+                env_creator=creator_blob)
+            for i in range(c.num_rollout_workers)
+        ]
+        info = ray_tpu.get(self.workers[0].env_info.remote(), timeout=60)
+        self.learner = PPOLearner(
+            info["obs_dim"], info["num_actions"], lr=c.lr,
+            clip=c.clip_param, vf_coeff=c.vf_loss_coeff,
+            ent_coeff=c.entropy_coeff, minibatch_size=c.sgd_minibatch_size,
+            num_epochs=c.num_sgd_epochs, hidden=c.hidden, seed=c.seed)
+        self._iteration = 0
+        self._total_steps = 0
+        self._total_episodes = 0
+        self._recent_returns: List[float] = []
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration: parallel sample -> learner SGD -> broadcast."""
+        t0 = time.monotonic()
+        params_ref = ray_tpu.put(self.learner.get_params())
+        batches = ray_tpu.get(
+            [w.sample.remote(params_ref) for w in self.workers], timeout=300)
+        sample_time = time.monotonic() - t0
+        batch = sb.concat(batches)
+        t1 = time.monotonic()
+        stats = self.learner.update(batch)
+        learn_time = time.monotonic() - t1
+        for rets in ray_tpu.get(
+                [w.episode_returns.remote() for w in self.workers],
+                timeout=60):
+            self._recent_returns.extend(rets)
+            self._total_episodes += len(rets)
+        self._recent_returns = self._recent_returns[-100:]
+        self._iteration += 1
+        steps = sb.num_steps(batch)
+        self._total_steps += steps
+        mean_ret = (float(np.mean(self._recent_returns))
+                    if self._recent_returns else float("nan"))
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_total": self._total_steps,
+            "timesteps_this_iter": steps,
+            "episode_reward_mean": mean_ret,
+            "episodes_total": self._total_episodes,
+            "env_steps_per_sec": steps / max(1e-9, sample_time + learn_time),
+            "sample_time_s": sample_time,
+            "learn_time_s": learn_time,
+            **stats,
+        }
+
+    # -- Tune-trainable surface ----------------------------------------------
+
+    def save(self) -> Dict:
+        import jax
+
+        return {"params": jax.device_get(self.learner.params),
+                "opt_state": jax.device_get(self.learner.opt_state),
+                "iteration": self._iteration,
+                "total_steps": self._total_steps}
+
+    def restore(self, ckpt: Dict) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.learner.params = {k: jnp.asarray(v)
+                               for k, v in ckpt["params"].items()}
+        if "opt_state" in ckpt:  # Adam moments survive the round-trip
+            self.learner.opt_state = jax.tree.map(jnp.asarray,
+                                                  ckpt["opt_state"])
+        else:
+            self.learner.opt_state = self.learner.optimizer.init(
+                self.learner.params)
+        self._iteration = int(ckpt.get("iteration", 0))
+        self._total_steps = int(ckpt.get("total_steps", 0))
+
+    def stop(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

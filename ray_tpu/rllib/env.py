@@ -1,0 +1,151 @@
+"""Environment API + built-in vectorized envs.
+
+The reference samples gym envs through vector wrappers (ref:
+rllib/env/vector_env.py; env_runner_v2.py). This image ships no gym, so
+the API here IS the gymnasium step/reset contract, a numpy-vectorized
+CartPole implements it natively (vector math, no per-env Python loop —
+the >100k steps/s north star needs that), and `make_env` wraps a real
+gymnasium env when one is installed.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+
+class VectorEnv:
+    """n independent env copies stepped as one batch."""
+
+    num_envs: int
+    obs_dim: int
+    num_actions: int
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, actions: np.ndarray
+             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, Dict[str, Any]]:
+        """-> (obs [n, obs_dim], reward [n], done [n], info). Sub-envs
+        auto-reset on done (the obs returned is the NEW episode's)."""
+        raise NotImplementedError
+
+
+class CartPoleVecEnv(VectorEnv):
+    """Classic cart-pole control, vectorized over n envs in numpy
+    (dynamics per the standard formulation; episode caps at 500 steps)."""
+
+    GRAVITY = 9.8
+    CART_MASS = 1.0
+    POLE_MASS = 0.1
+    POLE_HALF_LEN = 0.5
+    FORCE = 10.0
+    DT = 0.02
+    X_LIMIT = 2.4
+    THETA_LIMIT = 12 * 2 * np.pi / 360
+    MAX_STEPS = 500
+
+    def __init__(self, num_envs: int = 8, seed: int = 0):
+        self.num_envs = num_envs
+        self.obs_dim = 4
+        self.num_actions = 2
+        self._rng = np.random.default_rng(seed)
+        self._state = np.zeros((num_envs, 4), np.float64)
+        self._steps = np.zeros(num_envs, np.int64)
+
+    def _sample_state(self, n: int) -> np.ndarray:
+        return self._rng.uniform(-0.05, 0.05, size=(n, 4))
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._state = self._sample_state(self.num_envs)
+        self._steps[:] = 0
+        return self._state.astype(np.float32)
+
+    def step(self, actions: np.ndarray):
+        x, x_dot, theta, theta_dot = self._state.T
+        force = np.where(actions == 1, self.FORCE, -self.FORCE)
+        cos_t, sin_t = np.cos(theta), np.sin(theta)
+        total_mass = self.CART_MASS + self.POLE_MASS
+        pole_ml = self.POLE_MASS * self.POLE_HALF_LEN
+        temp = (force + pole_ml * theta_dot ** 2 * sin_t) / total_mass
+        theta_acc = (self.GRAVITY * sin_t - cos_t * temp) / (
+            self.POLE_HALF_LEN
+            * (4.0 / 3.0 - self.POLE_MASS * cos_t ** 2 / total_mass))
+        x_acc = temp - pole_ml * theta_acc * cos_t / total_mass
+        x = x + self.DT * x_dot
+        x_dot = x_dot + self.DT * x_acc
+        theta = theta + self.DT * theta_dot
+        theta_dot = theta_dot + self.DT * theta_acc
+        self._state = np.stack([x, x_dot, theta, theta_dot], axis=1)
+        self._steps += 1
+        failed = ((np.abs(x) > self.X_LIMIT)
+                  | (np.abs(theta) > self.THETA_LIMIT))
+        truncated = (self._steps >= self.MAX_STEPS) & ~failed
+        done = failed | truncated
+        reward = np.ones(self.num_envs, np.float32)
+        info = {}
+        if done.any():
+            idx = np.nonzero(done)[0]
+            # hand the pre-reset states out so the sampler can bootstrap
+            # time-limit truncations with V(s_final) instead of zero
+            info["truncated"] = truncated
+            info["final_obs"] = self._state.astype(np.float32)
+            self._state[idx] = self._sample_state(len(idx))
+            self._steps[idx] = 0
+        return (self._state.astype(np.float32), reward,
+                done.astype(np.bool_), info)
+
+
+_REGISTRY: Dict[str, Callable[..., VectorEnv]] = {
+    "CartPole-v1": CartPoleVecEnv,
+}
+
+
+def register_env(name: str, creator: Callable[..., VectorEnv]) -> None:
+    """ref: ray.tune.registry.register_env"""
+    _REGISTRY[name] = creator
+
+
+def make_env(name: str, num_envs: int = 8, seed: int = 0) -> VectorEnv:
+    if name in _REGISTRY:
+        return _REGISTRY[name](num_envs=num_envs, seed=seed)
+    try:  # fall back to gymnasium when installed
+        import gymnasium
+
+        return _GymnasiumVecEnv(name, num_envs, seed)
+    except ImportError:
+        raise ValueError(
+            f"Unknown env {name!r}; register it with "
+            f"ray_tpu.rllib.register_env") from None
+
+
+class _GymnasiumVecEnv(VectorEnv):
+    """Adapter over gymnasium.vector when the library is present."""
+
+    def __init__(self, name: str, num_envs: int, seed: int):
+        import gymnasium
+
+        try:
+            # gymnasium >= 1.0 defaults to NEXT_STEP autoreset, which would
+            # break the same-step contract this adapter promises
+            self._env = gymnasium.make_vec(
+                name, num_envs=num_envs,
+                autoreset_mode=gymnasium.vector.AutoresetMode.SAME_STEP)
+        except TypeError:
+            self._env = gymnasium.make_vec(name, num_envs=num_envs)
+        self.num_envs = num_envs
+        self.obs_dim = int(np.prod(self._env.single_observation_space.shape))
+        self.num_actions = int(self._env.single_action_space.n)
+        self._seed = seed
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        obs, _ = self._env.reset(seed=seed if seed is not None else self._seed)
+        return np.asarray(obs, np.float32).reshape(self.num_envs, -1)
+
+    def step(self, actions: np.ndarray):
+        obs, reward, term, trunc, info = self._env.step(actions)
+        done = np.asarray(term) | np.asarray(trunc)
+        return (np.asarray(obs, np.float32).reshape(self.num_envs, -1),
+                np.asarray(reward, np.float32), done, info)

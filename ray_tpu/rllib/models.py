@@ -1,0 +1,63 @@
+"""Policy/value networks in plain jax (param-dict style, matching
+ray_tpu.models). ref: rllib/models/catalog.py fcnet defaults
+(two hidden layers, tanh); the experimental jax net the reference never
+finished (rllib/models/jax/fcnet.py) is the shape this completes.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_policy_params(rng: jax.Array, obs_dim: int, num_actions: int,
+                       hidden: Tuple[int, ...] = (64, 64)) -> Dict:
+    keys = jax.random.split(rng, len(hidden) + 2)
+    params = {}
+    last = obs_dim
+    for i, h in enumerate(hidden):
+        params[f"w{i}"] = jax.random.normal(
+            keys[i], (last, h), jnp.float32) * np.sqrt(2.0 / last)
+        params[f"b{i}"] = jnp.zeros((h,), jnp.float32)
+        last = h
+    # separate small-init heads: policy logits + value
+    params["w_pi"] = jax.random.normal(
+        keys[-2], (last, num_actions), jnp.float32) * 0.01
+    params["b_pi"] = jnp.zeros((num_actions,), jnp.float32)
+    params["w_v"] = jax.random.normal(keys[-1], (last, 1), jnp.float32) * 1.0
+    params["b_v"] = jnp.zeros((1,), jnp.float32)
+    return params
+
+
+def forward(params: Dict, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """obs [B, obs_dim] -> (logits [B, A], value [B])."""
+    x = obs
+    i = 0
+    while f"w{i}" in params:
+        x = jnp.tanh(x @ params[f"w{i}"] + params[f"b{i}"])
+        i += 1
+    logits = x @ params["w_pi"] + params["b_pi"]
+    value = (x @ params["w_v"] + params["b_v"])[:, 0]
+    return logits, value
+
+
+def sample_actions(params: Dict, obs: np.ndarray, rng: np.random.Generator
+                   ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Rollout-side inference (numpy sampling from jitted logits):
+    -> (actions, logp, values)."""
+    logits, values = _forward_jit(params, jnp.asarray(obs))
+    logits = np.asarray(logits)
+    values = np.asarray(values)
+    z = logits - logits.max(axis=1, keepdims=True)
+    p = np.exp(z)
+    p /= p.sum(axis=1, keepdims=True)
+    u = rng.random((len(p), 1))
+    actions = (p.cumsum(axis=1) < u).sum(axis=1).astype(np.int64)
+    actions = np.clip(actions, 0, p.shape[1] - 1)
+    logp = np.log(p[np.arange(len(p)), actions] + 1e-8)
+    return actions, logp.astype(np.float32), values.astype(np.float32)
+
+
+_forward_jit = jax.jit(forward)

@@ -1,0 +1,91 @@
+"""RolloutWorker — an actor sampling a vector env with the current policy.
+
+ref: rllib/evaluation/rollout_worker.py (sample :660) + env_runner_v2.py.
+The whole T×n rollout is vector math: one jitted policy forward per step
+over all n envs, numpy env stepping, GAE computed worker-side so the
+learner receives train-ready batches through the object store.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from . import sample_batch as sb
+from .env import make_env
+from .models import sample_actions
+
+
+class RolloutWorker:
+    def __init__(self, env_name: str, num_envs: int, rollout_len: int,
+                 gamma: float, lam: float, seed: int = 0,
+                 env_creator=None):
+        import cloudpickle
+
+        if env_creator is not None:
+            creator = cloudpickle.loads(env_creator)
+            self.env = creator(num_envs=num_envs, seed=seed)
+        else:
+            self.env = make_env(env_name, num_envs=num_envs, seed=seed)
+        self.rollout_len = rollout_len
+        self.gamma = gamma
+        self.lam = lam
+        self._rng = np.random.default_rng(seed + 1)
+        self._obs = self.env.reset(seed=seed)
+        # episode-return bookkeeping (survives across sample() calls)
+        self._ep_return = np.zeros(self.env.num_envs, np.float64)
+        self._finished_returns: list = []
+
+    def sample(self, params: Dict) -> sb.Batch:
+        T, n = self.rollout_len, self.env.num_envs
+        obs_buf = np.empty((T, n, self.env.obs_dim), np.float32)
+        act_buf = np.empty((T, n), np.int64)
+        logp_buf = np.empty((T, n), np.float32)
+        val_buf = np.empty((T, n), np.float32)
+        rew_buf = np.empty((T, n), np.float32)
+        done_buf = np.empty((T, n), np.bool_)
+        obs = self._obs
+        for t in range(T):
+            actions, logp, values = sample_actions(params, obs, self._rng)
+            obs_buf[t], act_buf[t] = obs, actions
+            logp_buf[t], val_buf[t] = logp, values
+            obs, reward, done, info = self.env.step(actions)
+            rew_buf[t], done_buf[t] = reward, done
+            if done.any() and "truncated" in info:
+                # time-limit truncation is not termination: fold
+                # gamma*V(s_final) into the reward so GAE's done-cut
+                # doesn't zero a bootstrap that should exist (ref:
+                # postprocessing.py time-limit handling)
+                trunc = info["truncated"]
+                if trunc.any():
+                    idx = np.nonzero(trunc)[0]
+                    _, _, v_final = sample_actions(
+                        params, info["final_obs"][idx], self._rng)
+                    rew_buf[t, idx] += self.gamma * v_final
+            self._ep_return += reward
+            if done.any():
+                idx = np.nonzero(done)[0]
+                self._finished_returns.extend(self._ep_return[idx].tolist())
+                self._ep_return[idx] = 0.0
+        self._obs = obs
+        _, _, last_values = sample_actions(params, obs, self._rng)
+        adv, ret = sb.compute_gae(rew_buf, val_buf, done_buf, last_values,
+                                  self.gamma, self.lam)
+        flat = lambda a: a.reshape(T * n, *a.shape[2:])  # noqa: E731
+        return {
+            sb.OBS: flat(obs_buf), sb.ACTIONS: flat(act_buf),
+            sb.LOGP: flat(logp_buf), sb.VALUES: flat(val_buf),
+            sb.REWARDS: flat(rew_buf), sb.DONES: flat(done_buf),
+            sb.ADVANTAGES: flat(adv), sb.RETURNS: flat(ret),
+        }
+
+    def episode_returns(self, clear: bool = True) -> list:
+        out = list(self._finished_returns)
+        if clear:
+            self._finished_returns.clear()
+        return out
+
+    def env_info(self) -> dict:
+        return {"obs_dim": self.env.obs_dim,
+                "num_actions": self.env.num_actions,
+                "num_envs": self.env.num_envs}

@@ -1,0 +1,66 @@
+"""Sample batches + advantage estimation.
+
+ref: rllib/policy/sample_batch.py (column dict container);
+rllib/evaluation/postprocessing.py compute_gae_for_sample_batch.
+Batches are plain dicts of numpy arrays — they travel through the object
+store and concatenate cheaply on the learner.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterator, List
+
+import numpy as np
+
+Batch = Dict[str, np.ndarray]
+
+OBS = "obs"
+ACTIONS = "actions"
+REWARDS = "rewards"
+DONES = "dones"
+LOGP = "logp"
+VALUES = "values"
+ADVANTAGES = "advantages"
+RETURNS = "returns"
+
+
+def concat(batches: List[Batch]) -> Batch:
+    keys = batches[0].keys()
+    return {k: np.concatenate([b[k] for b in batches]) for k in keys}
+
+
+def num_steps(batch: Batch) -> int:
+    return len(batch[REWARDS])
+
+
+def compute_gae(rewards: np.ndarray, values: np.ndarray, dones: np.ndarray,
+                last_values: np.ndarray, gamma: float,
+                lam: float) -> tuple:
+    """GAE over a [T, n_envs] rollout (ref: postprocessing.py:compute_advantages).
+    dones cut the bootstrap at auto-reset boundaries."""
+    T, n = rewards.shape
+    adv = np.zeros((T, n), np.float32)
+    last_gae = np.zeros(n, np.float32)
+    next_value = last_values
+    for t in range(T - 1, -1, -1):
+        not_done = 1.0 - dones[t].astype(np.float32)
+        delta = rewards[t] + gamma * next_value * not_done - values[t]
+        last_gae = delta + gamma * lam * not_done * last_gae
+        adv[t] = last_gae
+        next_value = values[t]
+    returns = adv + values
+    return adv, returns
+
+
+def minibatches(batch: Batch, minibatch_size: int, num_epochs: int,
+                seed: int = 0) -> Iterator[Batch]:
+    """Shuffled minibatch stream for SGD (ref: ppo learner minibatching).
+    A batch smaller than minibatch_size still yields one (whole-batch)
+    minibatch per epoch — never silently zero SGD steps."""
+    n = num_steps(batch)
+    mb = min(minibatch_size, n)
+    rng = np.random.default_rng(seed)
+    for _ in range(num_epochs):
+        perm = rng.permutation(n)
+        for lo in range(0, n - mb + 1, mb):
+            idx = perm[lo:lo + mb]
+            yield {k: v[idx] for k, v in batch.items()}
