@@ -1,0 +1,135 @@
+"""Core-runtime microbenchmarks (scheduler / object store / actor plane).
+
+Mirrors the reference's microbenchmark harness (ref:
+python/ray/_private/ray_perf.py:93-241 — tasks/s, actor calls/s, put
+throughput, many-args/many-returns) so regressions in the task/actor/
+object planes show up as numbers per round, tracked next to the model
+bench in bench.py.
+
+Run: python bench_core.py            (full)
+     RTPU_BENCH_SMOKE=1 ...          (CI smoke: tiny counts)
+Prints one JSON line per metric, then a summary JSON line.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+SMOKE = os.environ.get("RTPU_BENCH_SMOKE", "") == "1"
+
+
+def _rate(name: str, count: float, dt: float, unit: str) -> dict:
+    rec = {"metric": name, "value": round(count / dt, 1), "unit": unit}
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def main() -> int:
+    import ray_tpu
+
+    rt = ray_tpu.init(num_cpus=max(4, os.cpu_count() or 4))
+    results = []
+    n_small = 100 if SMOKE else 2000
+    n_calls = 100 if SMOKE else 3000
+    n_puts = 20 if SMOKE else 200
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    @ray_tpu.remote
+    def many_returns():
+        return tuple(range(64))
+
+    @ray_tpu.remote
+    def sink(*args):
+        return len(args)
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    # warmup: spin up workers + export functions
+    ray_tpu.get([nop.remote() for _ in range(8)], timeout=120)
+
+    # -- tasks/s (single submitter, pipelined) ------------------------------
+    t0 = time.perf_counter()
+    ray_tpu.get([nop.remote() for _ in range(n_small)], timeout=600)
+    results.append(_rate("tasks_per_second", n_small,
+                         time.perf_counter() - t0, "tasks/s"))
+
+    # -- actor calls/s (pipelined on one actor) -----------------------------
+    c = Counter.remote()
+    ray_tpu.get(c.inc.remote(), timeout=60)
+    t0 = time.perf_counter()
+    out = ray_tpu.get([c.inc.remote() for _ in range(n_calls)], timeout=600)
+    assert out[-1] == n_calls + 1
+    results.append(_rate("actor_calls_per_second", n_calls,
+                         time.perf_counter() - t0, "calls/s"))
+
+    # -- sync actor call latency (round-trip) -------------------------------
+    t0 = time.perf_counter()
+    for _ in range(n_small // 10):
+        ray_tpu.get(c.inc.remote(), timeout=60)
+    dt = time.perf_counter() - t0
+    rec = {"metric": "actor_call_round_trip_ms",
+           "value": round(1000 * dt / (n_small // 10), 3), "unit": "ms"}
+    print(json.dumps(rec), flush=True)
+    results.append(rec)
+
+    # -- put throughput (1 MiB objects) -------------------------------------
+    blob = np.random.default_rng(0).integers(
+        0, 255, size=1024 * 1024, dtype=np.uint8)
+    t0 = time.perf_counter()
+    refs = [ray_tpu.put(blob) for _ in range(n_puts)]
+    dt = time.perf_counter() - t0
+    results.append(_rate("put_gigabytes_per_second",
+                         n_puts * blob.nbytes / 1e9, dt, "GB/s"))
+
+    # -- get throughput (zero-copy reads of those puts) ---------------------
+    t0 = time.perf_counter()
+    vals = ray_tpu.get(refs, timeout=300)
+    dt = time.perf_counter() - t0
+    assert len(vals) == n_puts
+    results.append(_rate("get_gigabytes_per_second",
+                         n_puts * blob.nbytes / 1e9, dt, "GB/s"))
+    del vals, refs
+
+    # -- many args to one task (ref envelope: 10k+) -------------------------
+    n_args = 100 if SMOKE else 1000
+    arg_refs = [ray_tpu.put(i) for i in range(n_args)]
+    t0 = time.perf_counter()
+    assert ray_tpu.get(sink.remote(*arg_refs), timeout=300) == n_args
+    rec = {"metric": "args_per_task", "value": n_args,
+           "unit": f"args in {round(time.perf_counter() - t0, 2)}s"}
+    print(json.dumps(rec), flush=True)
+    results.append(rec)
+
+    # -- many returns -------------------------------------------------------
+    t0 = time.perf_counter()
+    refs = many_returns.options(num_returns=64).remote()
+    vals = ray_tpu.get(list(refs), timeout=120)
+    assert vals == list(range(64))
+    rec = {"metric": "returns_per_task", "value": 64,
+           "unit": f"returns in {round(time.perf_counter() - t0, 2)}s"}
+    print(json.dumps(rec), flush=True)
+    results.append(rec)
+
+    ray_tpu.shutdown()
+    print(json.dumps({"metric": "core_microbench_summary",
+                      "value": {r["metric"]: r["value"] for r in results},
+                      "smoke": SMOKE}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

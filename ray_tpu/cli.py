@@ -48,6 +48,41 @@ def _cmd_start(args) -> int:
     return agent_main(agent_args)
 
 
+def _no_runtime_help() -> int:
+    print("No ray_tpu runtime in this process. `list`/`timeline` read the "
+          "in-process head state — call them from the driver (e.g. "
+          "ray_tpu.cli.main(['list', 'summary'])) or use the state API "
+          "(ray_tpu.util.state) directly.", file=sys.stderr)
+    return 1
+
+
+def _cmd_list(args) -> int:
+    from .core import runtime as runtime_mod
+    from .util import state
+
+    if runtime_mod.maybe_runtime() is None:
+        return _no_runtime_help()
+    fn = {"nodes": state.list_nodes, "actors": state.list_actors,
+          "tasks": state.list_tasks, "objects": state.list_objects,
+          "pgs": state.list_placement_groups,
+          "summary": state.summary}[args.what]
+    rows = fn()
+    print(json.dumps(rows, indent=2, default=str))
+    return 0
+
+
+def _cmd_timeline(args) -> int:
+    from .core import runtime as runtime_mod
+    from .util.state import timeline
+
+    if runtime_mod.maybe_runtime() is None:
+        return _no_runtime_help()
+    events = timeline(output_path=args.output)
+    print(f"wrote {len(events)} trace events to {args.output} "
+          f"(open in chrome://tracing or https://ui.perfetto.dev)")
+    return 0
+
+
 def _cmd_status(args) -> int:
     from .core import runtime as runtime_mod
 
@@ -82,6 +117,17 @@ def main(argv=None) -> int:
     st = sub.add_parser("status", help="show cluster nodes")
     st.add_argument("--address", default="")
     st.set_defaults(fn=_cmd_status)
+
+    ls = sub.add_parser(
+        "list", help="list tasks/actors/objects/nodes/pgs/summary "
+                     "(run from the driver process)")
+    ls.add_argument("what", choices=["tasks", "actors", "objects", "nodes",
+                                     "pgs", "summary"])
+    ls.set_defaults(fn=_cmd_list)
+
+    tl = sub.add_parser("timeline", help="export Chrome-trace of task events")
+    tl.add_argument("--output", default="/tmp/ray_tpu_timeline.json")
+    tl.set_defaults(fn=_cmd_timeline)
 
     args = p.parse_args(argv)
     return args.fn(args)

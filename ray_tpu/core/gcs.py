@@ -129,13 +129,63 @@ class Gcs:
         self._storage_path = storage_path
         # set by the Runtime: asks the scheduler to (re)create an actor
         self.schedule_actor_cb: Optional[Callable[[ActorInfo], None]] = None
+        self._dirty = threading.Event()
+        self._stop_flusher = threading.Event()
+        self._flush_file_lock = threading.Lock()
+        self._event_counts: Dict[str, int] = {}  # monotonic, for /metrics
         if storage_path:
             os.makedirs(storage_path, exist_ok=True)
             self._load()
+            # debounced table snapshots (the Redis-write analog, ref:
+            # redis_store_client.h; gcs_table_storage.cc)
+            threading.Thread(target=self._flush_loop, daemon=True,
+                             name="gcs-flusher").start()
+
+    def _mark_dirty(self) -> None:
+        if self._storage_path:
+            self._dirty.set()
+
+    def _flush_loop(self) -> None:
+        while not self._stop_flusher.is_set():
+            if self._dirty.wait(timeout=0.5):
+                self._dirty.clear()
+                self.flush()
+            else:
+                continue
+
+    def flush(self) -> None:
+        """Write the actor/job/pg tables + named index to disk atomically.
+        The pickle happens under the table lock (records are mutated in
+        place by the FSM — a copy of the dict alone would tear) and the
+        file write is serialized so stop() can't interleave with the
+        flusher thread."""
+        if not self._storage_path:
+            return
+        try:
+            with self._lock:
+                blob = pickle.dumps({
+                    "actors": dict(self._actors),
+                    "named_actors": dict(self._named_actors),
+                    "jobs": dict(self._jobs),
+                    "pgs": dict(self._pgs),
+                })
+            with self._flush_file_lock:
+                fname = os.path.join(self._storage_path, "tables.pkl")
+                with open(fname + ".tmp", "wb") as f:
+                    f.write(blob)
+                os.replace(fname + ".tmp", fname)
+        except Exception:
+            self._dirty.set()  # retry on the next flusher tick
+
+    def stop(self) -> None:
+        self._stop_flusher.set()
+        self.flush()
 
     # ---- node table ----------------------------------------------------------
 
     def register_node(self, info: NodeInfo) -> None:
+        # node membership is NOT persisted (rebuilt by re-registration on
+        # restart), so no dirty mark
         with self._lock:
             self._nodes[info.node_id] = info
         self.pubsub.publish("node", ("ALIVE", info.node_id))
@@ -171,11 +221,13 @@ class Gcs:
     def register_job(self, info: JobInfo) -> None:
         with self._lock:
             self._jobs[info.job_id] = info
+        self._mark_dirty()
 
     def finish_job(self, job_id: JobId) -> None:
         with self._lock:
             if job_id in self._jobs:
                 self._jobs[job_id].end_time = time.time()
+        self._mark_dirty()
 
     # ---- actor directory + FSM ----------------------------------------------
 
@@ -191,6 +243,7 @@ class Gcs:
                         raise ValueError(f"Actor name {info.name!r} already taken")
                 self._named_actors[key] = info.actor_id
             self._actors[info.actor_id] = info
+        self._mark_dirty()
         self.pubsub.publish("actor", (info.actor_id, info.state))
 
     def set_actor_state(self, actor_id: ActorId, state: ActorState,
@@ -208,6 +261,7 @@ class Gcs:
                 info.worker_id = worker_id
             if death_cause:
                 info.death_cause = death_cause
+        self._mark_dirty()
         self.pubsub.publish("actor", (actor_id, state))
 
     def on_actor_failure(self, actor_id: ActorId, cause: str) -> None:
@@ -228,6 +282,7 @@ class Gcs:
                 info.state = ActorState.DEAD
                 info.death_cause = cause
                 restart = False
+        self._mark_dirty()
         self.pubsub.publish("actor", (actor_id, info.state))
         if restart and self.schedule_actor_cb is not None:
             self.schedule_actor_cb(info)
@@ -282,6 +337,7 @@ class Gcs:
     def register_pg(self, info: PlacementGroupInfo) -> None:
         with self._lock:
             self._pgs[info.pg_id] = info
+        self._mark_dirty()
 
     def get_pg(self, pg_id: PlacementGroupId) -> Optional[PlacementGroupInfo]:
         with self._lock:
@@ -296,6 +352,14 @@ class Gcs:
     def add_task_event(self, event: dict) -> None:
         with self._lock:
             self._task_events.append(event)
+            st = event.get("state", "?")
+            self._event_counts[st] = self._event_counts.get(st, 0) + 1
+
+    def task_event_counts(self) -> Dict[str, int]:
+        """Monotonic per-state totals (unlike the bounded ring buffer,
+        these never decrease — safe to export as Prometheus counters)."""
+        with self._lock:
+            return dict(self._event_counts)
 
     def task_events(self) -> List[dict]:
         with self._lock:
@@ -323,3 +387,41 @@ class Gcs:
                 self._kv = defaultdict(dict, data)
             except Exception:
                 pass
+        tname = os.path.join(self._storage_path, "tables.pkl")
+        if os.path.exists(tname):
+            try:
+                with open(tname, "rb") as f:
+                    tables = pickle.load(f)
+            except Exception:
+                return
+            self._jobs = tables.get("jobs", {})
+            self._pgs = tables.get("pgs", {})
+            self._actors = tables.get("actors", {})
+            self._named_actors = tables.get("named_actors", {})
+            # workers died with the old head: every actor that was running
+            # is gone. Detached actors keep their creation spec and go to
+            # RESTARTING so the new runtime can revive them (ref:
+            # gcs_server.cc:521 restart path + actor_states.rst); normal
+            # actors die with their job.
+            for info in self._actors.values():
+                if info.state == ActorState.DEAD:
+                    continue
+                if info.detached:
+                    info.state = ActorState.RESTARTING
+                    info.num_restarts = 0
+                    info.node_id = None
+                    info.worker_id = None
+                    info.death_cause = "head restarted"
+                else:
+                    info.state = ActorState.DEAD
+                    info.death_cause = "lost in head restart"
+            for pg in self._pgs.values():
+                if pg.state not in ("REMOVED",):
+                    pg.state = "RESCHEDULING"
+                    pg.bundle_nodes = [None] * len(pg.bundles)
+
+    def detached_actors_to_revive(self) -> List[ActorInfo]:
+        with self._lock:
+            return [a for a in self._actors.values()
+                    if a.detached and a.state == ActorState.RESTARTING
+                    and a.node_id is None]

@@ -70,12 +70,28 @@ class NodeAgent:
                             handler=self._handle_head_command,
                             num_handler_threads=8)
         self.head.on_close(self._on_head_lost)
-        self.head.call("register_node", {
+        reply = self.head.call("register_node", {
             "node_id": self.node_id,
             "resources": dict(resources),
             "labels": dict(labels or {}),
             "pid": os.getpid(),
         }, timeout=30)
+        head_period = (reply or {}).get(
+            "health_check_period_s", self.config.health_check_period_s)
+        # periodic liveness signal; a hung/partitioned agent (channel still
+        # open, nothing flowing) is declared dead by the head's health
+        # monitor when these stop (ref: gcs_health_check_manager.h:39)
+        threading.Thread(target=self._heartbeat_loop, args=(head_period,),
+                         daemon=True, name="agent-heartbeat").start()
+
+    def _heartbeat_loop(self, period_s: float) -> None:
+        period = max(0.05, float(period_s) / 2)
+        while not self._stopped.is_set() and not self.head.closed:
+            try:
+                self.head.notify("heartbeat", None)
+            except Exception:
+                break  # channel closed mid-send; head loss handler runs
+            self._stopped.wait(period)
 
     # ---- commands from the head ---------------------------------------------
 

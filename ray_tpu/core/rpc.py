@@ -146,9 +146,15 @@ class RpcChannel:
                     if fut is not None:
                         fut.set_exception(_RemoteCallError(a, b))
                 elif kind == _REQ:
-                    self._pool.submit(self._handle, msg_id, a, b)
+                    try:
+                        self._pool.submit(self._handle, msg_id, a, b)
+                    except RuntimeError:
+                        break  # pool shut down: channel is closing
                 elif kind == _ONEWAY:
-                    self._oneway_pool.submit(self._handle_oneway, a, b)
+                    try:
+                        self._oneway_pool.submit(self._handle_oneway, a, b)
+                    except RuntimeError:
+                        break
         finally:
             self._teardown()
 

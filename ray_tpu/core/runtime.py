@@ -139,6 +139,20 @@ class DriverRuntime:
             weakref.finalize(ref, self.refcount.remove_local, ref.id)
 
         _set_borrow_hook(_driver_borrow)
+        self._revive_detached_actors()
+
+    def _revive_detached_actors(self) -> None:
+        """Head restart: re-create detached actors whose metadata survived
+        in the persisted GCS tables (ref: gcs_server.cc:521 restart path;
+        detached lifetime semantics)."""
+        for info in self.gcs.detached_actors_to_revive():
+            with self._lock:
+                self._actors[info.actor_id] = _ActorRecord(info=info)
+            try:
+                self._restart_actor(info)
+            except Exception:
+                self.gcs.set_actor_state(info.actor_id, ActorState.DEAD,
+                                         death_cause="revival failed")
 
     # ---- cluster membership --------------------------------------------------
 
@@ -158,7 +172,29 @@ class DriverRuntime:
                                         self._make_agent_handler,
                                         family="AF_INET",
                                         num_handler_threads=32)
+        # health monitor: remote nodes must keep heartbeating or be
+        # declared dead even with the TCP channel still open (hung agent,
+        # network partition) — ref: gcs_health_check_manager.h:39
+        self._health_thread = threading.Thread(
+            target=self._health_check_loop, daemon=True, name="health-check")
+        self._health_thread.start()
         return self._remote_server.address
+
+    def _health_check_loop(self) -> None:
+        period = float(self.config.health_check_period_s)
+        timeout = float(self.config.health_check_timeout_s)
+        while not self._shutdown:
+            time.sleep(period)
+            now = time.monotonic()
+            with self._lock:
+                remote_ids = [nid for nid, n in self.nodes.items()
+                              if getattr(n, "is_remote", False) and n.alive]
+            for nid in remote_ids:
+                info = next((i for i in self.gcs.nodes()
+                             if i.node_id == nid), None)
+                if info is not None and info.alive \
+                        and now - info.last_heartbeat > timeout:
+                    self.on_remote_node_lost(nid)
 
     def _make_agent_handler(self, channel):
         from .node import WorkerHandle
@@ -177,9 +213,19 @@ class DriverRuntime:
                     self.nodes[node.node_id] = node
                 self.gcs.register_node(node.info())
                 self._reschedule_parked()
-                return True
+                # the head's health cadence governs the agent's heartbeat
+                # period — local agent config must not race a stricter head
+                return {"health_check_period_s":
+                        float(self.config.health_check_period_s)}
             if node is None:
                 raise RuntimeError("agent sent a message before register_node")
+            if not node.alive:
+                # fenced-off node (declared dead by heartbeat timeout):
+                # drop everything — its tasks were already rescheduled
+                return None
+            if method == "heartbeat":
+                self.gcs.heartbeat(node.node_id)
+                return None
             if method == "worker_register":
                 node.on_remote_worker_register(payload["worker_id"],
                                                payload.get("pid", 0))
@@ -264,6 +310,13 @@ class DriverRuntime:
                     f"node {node_id.hex()[:8]} disconnected"))
         for w in workers:
             node._on_worker_exit(w)
+        # fence the evicted agent: close its channel so a merely-stalled
+        # (not dead) agent can't keep executing and report stale results —
+        # the agent shuts itself down on head-channel loss
+        try:
+            node.channel.close()
+        except Exception:
+            pass
         self.gcs.mark_node_dead(node_id, "agent disconnected")
         with self._lock:
             for oid, copies in list(self._directory.items()):
@@ -660,6 +713,7 @@ class DriverRuntime:
             except Exception:
                 self.on_worker_crashed(spec, node.node_id)
                 return
+            self._event_running(spec, node.node_id)
             node.push_task(worker, spec)
 
         fut.add_done_callback(_granted)
@@ -669,6 +723,15 @@ class DriverRuntime:
             parked, self._parked = self._parked, []
         for spec in parked:
             self._schedule(spec)
+
+    def _event_running(self, spec: TaskSpec, node_id: NodeId) -> None:
+        """Start-of-execution event: pairs with the FINISHED/FAILED event
+        to give the timeline durations (ref: task_event_buffer.h:199 state
+        transitions feeding GcsTaskManager)."""
+        self.gcs.add_task_event({
+            "task_id": spec.task_id.hex(), "name": spec.description,
+            "state": "RUNNING", "node_id": node_id.hex(),
+            "time": time.time()})
 
     def _fail_task(self, spec: TaskSpec, error: Exception) -> None:
         self.task_manager.fail(spec.task_id)
@@ -865,6 +928,7 @@ class DriverRuntime:
             if restarted:
                 self._flush_actor_queue(spec.actor_id)
             return
+        self._event_running(spec, node.node_id)
         node.push_task(worker, spec)
 
     def _flush_actor_queue(self, actor_id: ActorId) -> None:
@@ -899,6 +963,7 @@ class DriverRuntime:
                     # worker epoch (loop re-pops with a fresh seq)
                     rec.queued.insert(0, spec)
                 continue
+            self._event_running(spec, node.node_id)
             node.push_task(worker, spec)
         # a task may have been appended after the final lock release — if the
         # queue is non-empty and the actor is alive, a new flush is required
@@ -1184,6 +1249,7 @@ class DriverRuntime:
             except Exception:
                 pass
         self.gcs.finish_job(self.job_id)
+        self.gcs.stop()
         self._reader.close()
         self._pool.shutdown(wait=False)
 

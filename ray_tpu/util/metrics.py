@@ -1,0 +1,180 @@
+"""Prometheus metrics exposition.
+
+Equivalent of the reference's metrics pipeline (ref:
+src/ray/stats/metric_defs.cc:44 native metric definitions;
+python/ray/_private/metrics_agent.py Prometheus exposition). Gauges are
+computed from live runtime state at scrape time — no sampling loop to
+drift — and exposed on a stdlib HTTP endpoint at /metrics.
+
+Also the app-metric API: Counter/Gauge/Histogram
+(ref: python/ray/util/metrics.py) registered into the same exposition.
+"""
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from ..core import runtime as runtime_mod
+
+_user_metrics_lock = threading.Lock()
+_user_metrics: List["Metric"] = []
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Tuple[str, ...] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._values: Dict[tuple, float] = {}
+        self._lock = threading.Lock()
+        with _user_metrics_lock:
+            _user_metrics.append(self)
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> tuple:
+        tags = tags or {}
+        return tuple(tags.get(k, "") for k in self.tag_keys)
+
+    kind = "gauge"
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        k = self._key(tags)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+
+class Gauge(Metric):
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[self._key(tags)] = float(value)
+
+
+class Histogram(Metric):
+    """Exposed as _sum/_count (enough for rate/mean panels)."""
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[List[float]] = None,
+                 tag_keys: Tuple[str, ...] = ()):
+        super().__init__(name, description, tag_keys)
+        self._counts: Dict[tuple, int] = {}
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        k = self._key(tags)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+            self._counts[k] = self._counts.get(k, 0) + 1
+
+
+def _fmt_tags(tags: Dict[str, str]) -> str:
+    if not tags:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in tags.items())
+    return "{" + inner + "}"
+
+
+def _render() -> str:
+    lines: List[str] = []
+
+    def emit(name: str, value, tags: Optional[Dict[str, str]] = None,
+             help_: str = "", kind: str = "gauge") -> None:
+        if help_:
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} {kind}")
+        lines.append(f"{name}{_fmt_tags(tags or {})} {value}")
+
+    rt = runtime_mod.maybe_runtime()
+    if rt is not None and hasattr(rt, "gcs"):
+        nodes = rt.gcs.nodes()
+        emit("ray_tpu_nodes_total", len(nodes), help_="cluster nodes")
+        emit("ray_tpu_nodes_alive", sum(1 for n in nodes if n.alive))
+        by_state: Dict[str, int] = {}
+        for a in rt.gcs.list_actors():
+            by_state[a.state.name] = by_state.get(a.state.name, 0) + 1
+        lines.append("# HELP ray_tpu_actors actors by state")
+        lines.append("# TYPE ray_tpu_actors gauge")
+        for state, n in sorted(by_state.items()):
+            emit("ray_tpu_actors", n, {"state": state})
+        lines.append("# HELP ray_tpu_task_events_total task state "
+                     "transitions since head start")
+        lines.append("# TYPE ray_tpu_task_events_total counter")
+        for state, n in sorted(rt.gcs.task_event_counts().items()):
+            emit("ray_tpu_task_events_total", n, {"state": state})
+        for nid, node in list(rt.nodes.items()):
+            try:
+                st = node.store.stats()
+            except Exception:
+                continue
+            tags = {"node": nid.hex()[:12]}
+            emit("ray_tpu_object_store_bytes_used", st.get("used", 0), tags)
+            emit("ray_tpu_object_store_capacity_bytes",
+                 st.get("capacity", 0), tags)
+            emit("ray_tpu_object_store_objects", st.get("num_objects", 0),
+                 tags)
+            emit("ray_tpu_object_store_evictions_total",
+                 st.get("num_evictions", 0), tags, kind="counter")
+            emit("ray_tpu_object_store_spills_total",
+                 st.get("num_spills", 0), tags, kind="counter")
+    with _user_metrics_lock:
+        metrics = list(_user_metrics)
+    for m in metrics:
+        lines.append(f"# HELP {m.name} {m.description}")
+        lines.append(f"# TYPE {m.name} {m.kind}")
+        with m._lock:
+            items = list(m._values.items())
+            counts = dict(getattr(m, "_counts", {}))
+        for k, value in items:
+            tags = dict(zip(m.tag_keys, k))
+            if isinstance(m, Histogram):
+                emit(m.name + "_sum", value, tags)
+                emit(m.name + "_count", counts.get(k, 0), tags)
+            else:
+                emit(m.name, value, tags)
+    return "\n".join(lines) + "\n"
+
+
+_server: Optional[ThreadingHTTPServer] = None
+
+
+def start_metrics_server(host: str = "127.0.0.1",
+                         port: int = 0) -> Tuple[str, int]:
+    """Start (or return) the /metrics endpoint; -> (host, port)."""
+    global _server
+    if _server is not None:
+        return _server.server_address[:2]
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):  # noqa: N802
+            if self.path.rstrip("/") in ("", "/metrics", "/-/healthy"):
+                body = _render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+    _server = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=_server.serve_forever, daemon=True,
+                     name="metrics-http").start()
+    return _server.server_address[:2]
+
+
+def stop_metrics_server() -> None:
+    global _server
+    if _server is not None:
+        _server.shutdown()
+        _server = None

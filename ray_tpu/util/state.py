@@ -1,0 +1,165 @@
+"""State API — programmatic cluster introspection.
+
+Equivalent of the reference's `ray.util.state` (ref:
+python/ray/util/state/api.py list_tasks/list_actors/list_objects/
+list_nodes; dashboard/state_aggregator.py). Backed by the head's GCS
+tables, the task-event log, the reference counter, and per-node store
+stats. Chrome-trace export mirrors `ray timeline`
+(ref: scripts.py timeline command; task_event_buffer.h state events).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from ..core import runtime as runtime_mod
+
+
+def _rt():
+    rt = runtime_mod.get_runtime()
+    if not hasattr(rt, "gcs"):
+        raise RuntimeError("state API must run on the driver (head) process")
+    return rt
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    rt = _rt()
+    out = []
+    for info in rt.gcs.nodes():
+        node = rt.nodes.get(info.node_id)
+        out.append({
+            "node_id": info.node_id.hex(),
+            "alive": info.alive,
+            "resources_total": dict(info.total_resources),
+            "resources_available": (dict(node.available)
+                                    if node is not None else {}),
+            "labels": dict(info.labels),
+            "is_remote": bool(getattr(node, "is_remote", False)),
+            "num_workers": node.num_workers() if node is not None else 0,
+            "lease_queue_len": node.queue_len() if node is not None else 0,
+        })
+    return out
+
+
+def list_actors(state: Optional[str] = None) -> List[Dict[str, Any]]:
+    rt = _rt()
+    out = []
+    for a in rt.gcs.list_actors():
+        row = {
+            "actor_id": a.actor_id.hex(),
+            "name": a.name,
+            "namespace": a.namespace,
+            "state": a.state.name,
+            "node_id": a.node_id.hex() if a.node_id else None,
+            "num_restarts": a.num_restarts,
+            "detached": a.detached,
+            "death_cause": a.death_cause,
+            "class_name": a.creation_spec.description.split(".")[0],
+        }
+        if state is None or row["state"] == state:
+            out.append(row)
+    return out
+
+
+def list_tasks(limit: int = 1000) -> List[Dict[str, Any]]:
+    """Most-recent task state transitions (RUNNING/FINISHED/FAILED)."""
+    rt = _rt()
+    return rt.gcs.task_events()[-limit:]
+
+
+def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
+    rt = _rt()
+    out = []
+    with rt._lock:
+        directory = {oid: set(nids) for oid, nids in rt._directory.items()}
+        inline = set(rt._memory_store)
+    for oid in list(inline)[:limit]:
+        local, pins, holders = rt.refcount.counts(oid)
+        out.append({"object_id": oid.hex(), "where": "inline",
+                    "local_refs": local, "task_pins": pins,
+                    "worker_refs": holders})
+    for oid, nids in list(directory.items())[:max(0, limit - len(out))]:
+        local, pins, holders = rt.refcount.counts(oid)
+        out.append({"object_id": oid.hex(),
+                    "where": [n.hex()[:12] for n in nids],
+                    "local_refs": local, "task_pins": pins,
+                    "worker_refs": holders})
+    return out
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    rt = _rt()
+    return [{
+        "pg_id": pg.pg_id.hex(),
+        "state": pg.state,
+        "strategy": pg.strategy,
+        "bundles": [dict(b) for b in pg.bundles],
+        "nodes": [n.hex()[:12] if n else None for n in pg.bundle_nodes],
+        "name": pg.name,
+    } for pg in rt.gcs.list_pgs()]
+
+
+def object_store_stats() -> Dict[str, Dict[str, Any]]:
+    rt = _rt()
+    out = {}
+    for nid, node in rt.nodes.items():
+        try:
+            out[nid.hex()[:12]] = node.store.stats()
+        except Exception:
+            out[nid.hex()[:12]] = {}
+    return out
+
+
+def summary() -> Dict[str, Any]:
+    rt = _rt()
+    events = rt.gcs.task_events()
+    by_state: Dict[str, int] = {}
+    for e in events:
+        by_state[e.get("state", "?")] = by_state.get(e.get("state", "?"), 0) + 1
+    return {
+        "nodes_alive": sum(1 for n in rt.gcs.nodes() if n.alive),
+        "nodes_total": len(rt.gcs.nodes()),
+        "actors_by_state": _count_by(list_actors(), "state"),
+        "task_events_by_state": by_state,
+        "placement_groups": _count_by(list_placement_groups(), "state"),
+        "object_store": object_store_stats(),
+    }
+
+
+def _count_by(rows: List[dict], key: str) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for r in rows:
+        out[r[key]] = out.get(r[key], 0) + 1
+    return out
+
+
+def timeline(output_path: Optional[str] = None) -> List[dict]:
+    """Chrome-trace (catapult) events from the task log; load the result
+    in chrome://tracing or Perfetto (ref: `ray timeline`)."""
+    rt = _rt()
+    events = rt.gcs.task_events()
+    starts: Dict[str, dict] = {}
+    trace: List[dict] = []
+    for e in events:
+        tid = e.get("task_id", "")
+        state = e.get("state")
+        if state == "RUNNING":
+            starts[tid] = e
+        elif state in ("FINISHED", "FAILED"):
+            begin = starts.pop(tid, None)
+            t_end = e.get("time", 0.0)
+            t_begin = begin.get("time", t_end) if begin else t_end
+            trace.append({
+                "name": e.get("name", tid[:8]),
+                "cat": "task",
+                "ph": "X",  # complete event
+                "ts": t_begin * 1e6,
+                "dur": max(1.0, (t_end - t_begin) * 1e6),
+                "pid": e.get("node_id", "head")[:12],
+                "tid": tid[:12],
+                "args": {"state": state},
+            })
+    if output_path:
+        with open(output_path, "w") as f:
+            json.dump(trace, f)
+    return trace

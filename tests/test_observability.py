@@ -1,0 +1,109 @@
+"""State API, timeline export, Prometheus metrics (ref test model:
+python/ray/tests/test_state_api.py; test_metrics_agent.py)."""
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics as metrics_mod
+from ray_tpu.util import state
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    rt = ray_tpu.init(num_cpus=4)
+
+    @ray_tpu.remote
+    def work(x):
+        return x * 2
+
+    @ray_tpu.remote
+    class Keeper:
+        def ping(self):
+            return "ok"
+
+    keeper = Keeper.options(name="keeper").remote()
+    ray_tpu.get([work.remote(i) for i in range(5)], timeout=60)
+    ray_tpu.get(keeper.ping.remote(), timeout=60)
+    yield rt
+    metrics_mod.stop_metrics_server()
+    ray_tpu.shutdown()
+
+
+def test_list_nodes(cluster):
+    nodes = state.list_nodes()
+    assert len(nodes) == 1
+    assert nodes[0]["alive"]
+    assert nodes[0]["resources_total"].get("CPU") == 4.0
+
+
+def test_list_actors_and_filter(cluster):
+    actors = state.list_actors()
+    assert any(a["name"] == "keeper" for a in actors)
+    alive = state.list_actors(state="ALIVE")
+    assert all(a["state"] == "ALIVE" for a in alive)
+
+
+def test_list_tasks_has_running_and_finished(cluster):
+    events = state.list_tasks()
+    states = {e["state"] for e in events}
+    assert "RUNNING" in states and "FINISHED" in states
+    named = [e for e in events if e["name"].startswith("work")]
+    assert named
+
+
+def test_list_objects_counts_refs(cluster):
+    ref = ray_tpu.put([1, 2, 3])
+    rows = state.list_objects()
+    mine = [r for r in rows if r["object_id"] == ref.id.hex()]
+    assert mine and mine[0]["local_refs"] >= 1
+    del ref
+
+
+def test_summary(cluster):
+    s = state.summary()
+    assert s["nodes_alive"] == 1
+    assert s["task_events_by_state"].get("FINISHED", 0) >= 5
+    assert "ALIVE" in s["actors_by_state"]
+
+
+def test_timeline_export(cluster, tmp_path):
+    out = str(tmp_path / "trace.json")
+    events = state.timeline(output_path=out)
+    assert events, "no trace events"
+    ev = events[0]
+    assert ev["ph"] == "X" and ev["dur"] >= 1.0
+    with open(out) as f:
+        assert json.load(f) == events
+
+
+def test_prometheus_scrape(cluster):
+    host, port = metrics_mod.start_metrics_server()
+    # user metrics
+    counter = metrics_mod.Counter("test_requests_total", "reqs",
+                                  tag_keys=("route",))
+    counter.inc(3, tags={"route": "/a"})
+    gauge = metrics_mod.Gauge("test_queue_depth")
+    gauge.set(7)
+    with urllib.request.urlopen(f"http://{host}:{port}/metrics",
+                                timeout=10) as resp:
+        body = resp.read().decode()
+    assert "ray_tpu_nodes_alive 1" in body
+    assert "ray_tpu_task_events_total" in body
+    assert "ray_tpu_object_store_capacity_bytes" in body
+    assert 'test_requests_total{route="/a"} 3' in body
+    assert "test_queue_depth 7" in body
+
+
+def test_cli_list_and_timeline(cluster, tmp_path, capsys):
+    from ray_tpu.cli import main as cli_main
+
+    assert cli_main(["list", "summary"]) == 0
+    out = capsys.readouterr().out
+    assert json.loads(out)["nodes_alive"] == 1
+    trace = str(tmp_path / "t.json")
+    assert cli_main(["timeline", "--output", trace]) == 0
+    with open(trace) as f:
+        assert isinstance(json.load(f), list)
