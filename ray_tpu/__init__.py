@@ -17,7 +17,7 @@ from .core import runtime as _runtime_mod
 from .core.actor import ActorClass, ActorHandle, get_actor
 from .core.config import Config
 from .core.ids import ActorId, JobId, NodeId, ObjectId, TaskId, WorkerId
-from .core.object_ref import ObjectRef
+from .core.object_ref import ObjectRef, ObjectRefGenerator
 from .core.placement_group import (PlacementGroup, placement_group,
                                    placement_group_table,
                                    remove_placement_group)
@@ -28,6 +28,7 @@ __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "kill", "cancel", "get_actor", "nodes", "cluster_resources",
     "available_resources", "get_runtime_context", "ObjectRef",
+    "ObjectRefGenerator",
     "placement_group", "remove_placement_group", "placement_group_table",
     "PlacementGroup", "exceptions", "method", "__version__",
 ]
@@ -93,12 +94,17 @@ def remote(*args, **options):
     return wrap
 
 
-def method(num_returns: int = 1):
-    """Decorator recording per-method defaults on actor classes (parity shim;
-    options are currently applied at call time via .options())."""
+def method(num_returns=None, concurrency_group: Optional[str] = None):
+    """Per-method defaults on actor classes: num_returns (int or
+    "streaming") and concurrency_group (ref: python/ray/actor.py method
+    decorator; concurrency groups per
+    transport/concurrency_group_manager.cc)."""
 
     def wrap(m):
-        m._rtpu_num_returns = num_returns
+        if num_returns is not None:
+            m._rtpu_num_returns = num_returns
+        if concurrency_group is not None:
+            m._rtpu_concurrency_group = concurrency_group
         return m
 
     return wrap

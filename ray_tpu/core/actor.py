@@ -16,28 +16,48 @@ from .config import DEFAULT as cfg
 from .ids import ActorId
 from .object_ref import ObjectRef
 from .remote_function import (prepare_args, resolve_resources, resolve_strategy)
-from .task_spec import TaskSpec, TaskType
+from .task_spec import STREAMING_RETURNS, TaskSpec, TaskType
 
 _VALID_ACTOR_OPTIONS = {
     "num_cpus", "num_tpus", "resources", "max_restarts", "max_task_retries",
-    "max_concurrency", "name", "namespace", "lifetime", "scheduling_strategy",
-    "memory", "placement_group", "placement_group_bundle_index", "runtime_env",
-    "get_if_exists",
+    "max_concurrency", "concurrency_groups", "name", "namespace", "lifetime",
+    "scheduling_strategy", "memory", "placement_group",
+    "placement_group_bundle_index", "runtime_env", "get_if_exists",
 }
+
+
+def _method_meta_of(cls) -> Dict[str, dict]:
+    """Per-method defaults set by the @ray_tpu.method decorator."""
+    meta: Dict[str, dict] = {}
+    for name, m in inspect.getmembers(cls, callable):
+        nr = getattr(m, "_rtpu_num_returns", None)
+        cg = getattr(m, "_rtpu_concurrency_group", None)
+        if nr is not None or cg is not None:
+            meta[name] = {"num_returns": nr if nr is not None else 1,
+                          "concurrency_group": cg or ""}
+    return meta
 
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", name: str,
-                 num_returns: int = 1):
+                 num_returns=1, concurrency_group: str = ""):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
-    def options(self, num_returns: int = 1) -> "ActorMethod":
-        return ActorMethod(self._handle, self._name, num_returns)
+    def options(self, num_returns=None,
+                concurrency_group: Optional[str] = None) -> "ActorMethod":
+        return ActorMethod(
+            self._handle, self._name,
+            self._num_returns if num_returns is None else num_returns,
+            self._concurrency_group if concurrency_group is None
+            else concurrency_group)
 
     def remote(self, *args, **kwargs):
-        return self._handle._invoke(self._name, args, kwargs, self._num_returns)
+        return self._handle._invoke(self._name, args, kwargs,
+                                    self._num_returns,
+                                    self._concurrency_group)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -47,19 +67,29 @@ class ActorMethod:
 
 class ActorHandle:
     def __init__(self, actor_id: ActorId, max_task_retries: int = 0,
-                 description: str = "Actor"):
+                 description: str = "Actor",
+                 method_meta: Optional[Dict[str, dict]] = None):
         self._actor_id = actor_id
         self._max_task_retries = max_task_retries
         self._description = description
+        self._method_meta = method_meta or {}
         self._ready_ref: Optional[ObjectRef] = None
 
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
             raise AttributeError(name)
-        return ActorMethod(self, name)
+        meta = self.__dict__.get("_method_meta", {}).get(name, {})
+        return ActorMethod(self, name,
+                           num_returns=meta.get("num_returns", 1),
+                           concurrency_group=meta.get("concurrency_group",
+                                                      ""))
 
-    def _invoke(self, method_name: str, args, kwargs, num_returns: int):
+    def _invoke(self, method_name: str, args, kwargs, num_returns,
+                concurrency_group: str = ""):
         rt = runtime_mod.get_runtime()
+        if num_returns == "streaming":
+            num_returns = STREAMING_RETURNS
+        num_returns = int(num_returns)
         sargs, skwargs = prepare_args(rt, args, kwargs)
         spec = TaskSpec(
             task_id=rt.new_task_id(),
@@ -74,8 +104,13 @@ class ActorHandle:
             max_retries=self._max_task_retries,
             actor_id=self._actor_id,
             method_name=method_name,
+            concurrency_group=concurrency_group,
         )
         refs = rt.submit_spec(spec)
+        if num_returns == STREAMING_RETURNS:
+            from .object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(spec.task_id, rt)
         if num_returns == 0:
             return None
         if num_returns == 1:
@@ -84,7 +119,7 @@ class ActorHandle:
 
     def __reduce__(self):
         return (ActorHandle, (self._actor_id, self._max_task_retries,
-                              self._description))
+                              self._description, self._method_meta))
 
     def __repr__(self):
         return f"ActorHandle({self._description}, {self._actor_id.hex()[:12]})"
@@ -122,6 +157,10 @@ class ActorClass:
         is_async = any(
             inspect.iscoroutinefunction(m)
             for _, m in inspect.getmembers(self._cls, inspect.isfunction))
+        if is_async and opts.get("concurrency_groups"):
+            raise ValueError(
+                "concurrency_groups are not supported on async actors yet; "
+                "use max_concurrency for asyncio concurrency")
         spec = TaskSpec(
             task_id=rt.new_task_id(),
             job_id=getattr(rt, "job_id", None) or _nil_job(),
@@ -137,12 +176,15 @@ class ActorClass:
             actor_id=actor_id,
             max_restarts=int(opts.get("max_restarts", cfg.actor_max_restarts)),
             max_concurrency=int(opts.get("max_concurrency", 1)),
+            concurrency_groups=opts.get("concurrency_groups"),
             is_async_actor=is_async,
             runtime_env=opts.get("runtime_env"),
         )
         max_task_retries = int(opts.get("max_task_retries", 0))
+        method_meta = _method_meta_of(self._cls)
         meta = {"class_name": self._cls.__name__,
-                "max_task_retries": max_task_retries}
+                "max_task_retries": max_task_retries,
+                "method_meta": method_meta}
         import time as _time
 
         deadline = _time.monotonic() + 30.0
@@ -167,7 +209,8 @@ class ActorClass:
                     return existing
                 _time.sleep(0.01)
         handle = ActorHandle(actor_id, max_task_retries=max_task_retries,
-                             description=self._cls.__name__)
+                             description=self._cls.__name__,
+                             method_meta=method_meta)
         handle._ready_ref = ObjectRef(spec.return_ids()[0])
         return handle
 
@@ -200,7 +243,8 @@ def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
         meta = cloudpickle.loads(meta_blob) if meta_blob else {}
         return ActorHandle(info.actor_id,
                            max_task_retries=meta.get("max_task_retries", 0),
-                           description=meta.get("class_name", "Actor"))
+                           description=meta.get("class_name", "Actor"),
+                           method_meta=meta.get("method_meta"))
     res = rt.get_named_actor_info(name, ns)
     if res is None:
         raise ValueError(f"Failed to look up actor {name!r} in namespace {ns!r}")
@@ -209,7 +253,8 @@ def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
     meta = cloudpickle.loads(res["meta"]) if res.get("meta") else {}
     return ActorHandle(res["actor_id"],
                        max_task_retries=meta.get("max_task_retries", 0),
-                       description=meta.get("class_name", "Actor"))
+                       description=meta.get("class_name", "Actor"),
+                       method_meta=meta.get("method_meta"))
 
 
 def _nil_job():

@@ -35,6 +35,40 @@ def _reconstruct_ref(object_id, owner, call_site):
     return ref
 
 
+class ObjectRefGenerator:
+    """Iterator over a streaming task's yielded items
+    (ref: python/ray/_raylet.pyx ObjectRefGenerator /
+    core_worker.proto:436). Each __next__ blocks until the worker reports
+    the next item, then returns an ObjectRef to it. Usable in the process
+    that submitted the task."""
+
+    def __init__(self, task_id, runtime):
+        import weakref
+
+        self._task_id = task_id
+        self._rt = runtime
+        self._index = 0
+        # free never-consumed items when the generator is dropped
+        weakref.finalize(self, runtime.release_generator, task_id)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        ref = self._rt.next_generator_item(self._task_id, self._index)
+        if ref is None:
+            raise StopIteration
+        self._index += 1
+        return ref
+
+    def completed(self) -> int:
+        """Items consumed so far."""
+        return self._index
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({self._task_id.hex()[:12]}, i={self._index})"
+
+
 class ObjectRef:
     __slots__ = ("id", "owner", "_call_site", "__weakref__")
 

@@ -13,7 +13,8 @@ from . import runtime as runtime_mod
 from . import serialization
 from .config import DEFAULT as cfg
 from .object_ref import ObjectRef
-from .task_spec import (ARG_REF, ARG_VALUE, SchedulingStrategy, TaskSpec,
+from .task_spec import (ARG_REF, ARG_VALUE, STREAMING_RETURNS,
+                        SchedulingStrategy, TaskSpec,
                         TaskType)
 
 _VALID_OPTIONS = {
@@ -97,7 +98,10 @@ class RemoteFunction:
             func_id = rt.export_function(self._fn)
             self._func_ids[rt_key] = func_id
         sargs, skwargs = prepare_args(rt, args, kwargs)
-        num_returns = int(self._options.get("num_returns", 1))
+        num_returns = self._options.get("num_returns", 1)
+        if num_returns == "streaming":
+            num_returns = STREAMING_RETURNS
+        num_returns = int(num_returns)
         spec = TaskSpec(
             task_id=rt.new_task_id(),
             job_id=getattr(rt, "job_id", None) or _job_of(rt),
@@ -114,6 +118,10 @@ class RemoteFunction:
             runtime_env=self._options.get("runtime_env"),
         )
         refs = rt.submit_spec(spec)
+        if num_returns == STREAMING_RETURNS:
+            from .object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(spec.task_id, rt)
         if num_returns == 0:
             return None
         if num_returns == 1:

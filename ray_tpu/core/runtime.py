@@ -36,8 +36,8 @@ from .object_store import SegmentReader
 from .resources import ResourceSet, normalize
 from .scheduling_policy import NodeView, Scheduler
 from .task_manager import ReferenceCounter, TaskManager
-from .task_spec import (ARG_REF, ARG_VALUE, SchedulingStrategy, TaskSpec,
-                        TaskType)
+from .task_spec import (ARG_REF, ARG_VALUE, STREAMING_RETURNS,
+                        SchedulingStrategy, TaskSpec, TaskType)
 
 _runtime_lock = threading.Lock()
 _runtime: Optional[object] = None
@@ -118,6 +118,8 @@ class DriverRuntime:
         self._events: Dict[ObjectId, threading.Event] = {}
         self._recovering: Set[ObjectId] = set()
         self._pull_futures: Dict[ObjectId, Future] = {}
+        self._generators: Dict[TaskId, dict] = {}
+        self._released_generators: Set[TaskId] = set()
         self._reader = SegmentReader()
         self._actors: Dict[ActorId, _ActorRecord] = {}
         self._parked: List[TaskSpec] = []
@@ -455,6 +457,7 @@ class DriverRuntime:
         for node in nodes:
             if node is not None:
                 node.store.delete(oid)
+        self.refcount.forget(oid)
 
     def free(self, refs: Sequence[ObjectRef]) -> None:
         for r in refs:
@@ -724,6 +727,95 @@ class DriverRuntime:
         for spec in parked:
             self._schedule(spec)
 
+    # ---- streaming generators (ref: core_worker.proto:436) -------------------
+
+    def _gen_state(self, task_id: TaskId) -> dict:
+        with self._lock:
+            g = self._generators.get(task_id)
+            if g is None:
+                g = self._generators[task_id] = {
+                    "items": {}, "done": False, "error": None,
+                    "event": threading.Event()}
+            return g
+
+    def on_generator_item(self, task_id: TaskId, index: int, oid: ObjectId,
+                          data: Optional[bytes] = None) -> bool:
+        """A worker reported one yielded item (inline bytes, or already
+        sealed into a store). Returns False when the consumer dropped the
+        generator — the worker stops producing (the cancellation half of
+        the streaming protocol)."""
+        with self._lock:
+            if task_id in self._released_generators:
+                released = True
+            else:
+                released = False
+        if released:
+            if data is None:
+                self._free_object(oid)  # already sealed into a store
+            return False
+        if data is not None:
+            self.store_inline_bytes(oid, data)
+        self.refcount.add_owned(oid)
+        g = self._gen_state(task_id)
+        with self._lock:
+            g["items"][index] = oid
+        g["event"].set()
+        return True
+
+    def _generator_finish(self, task_id: TaskId,
+                          error: Optional[bytes] = None) -> None:
+        with self._lock:
+            if task_id in self._released_generators:
+                # stream ended after the consumer dropped it: tombstone done
+                self._released_generators.discard(task_id)
+                return
+        g = self._gen_state(task_id)
+        with self._lock:
+            g["done"] = True
+            if error is not None:
+                g["error"] = error
+        g["event"].set()
+
+    def next_generator_item(self, task_id: TaskId, index: int,
+                            timeout: Optional[float] = None
+                            ) -> Optional[ObjectRef]:
+        """Blocks until item `index` exists; None = generator exhausted."""
+        g = self._gen_state(task_id)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                oid = g["items"].get(index)
+                if oid is not None:
+                    return self.make_ref(oid)
+                if g["error"] is not None:
+                    err = serialization.loads(g["error"])
+                    raise err if isinstance(err, BaseException) \
+                        else exc.TaskError(cause=RuntimeError(str(err)))
+                if g["done"]:
+                    return None
+                g["event"].clear()
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            if not g["event"].wait(remaining):
+                raise exc.GetTimeoutError(
+                    f"generator item {index} of {task_id.hex()[:12]}")
+
+    def release_generator(self, task_id: TaskId) -> None:
+        """Generator GC'd: free yielded items nothing ever referenced and
+        tombstone the task so late items are rejected (which tells the
+        producing worker to stop)."""
+        with self._lock:
+            g = self._generators.pop(task_id, None)
+            spec = self.task_manager.get(task_id)
+            if spec is not None and spec.state in ("PENDING", "RUNNING"):
+                self._released_generators.add(task_id)
+        if g is None:
+            return
+        for oid in g["items"].values():
+            # atomic check-and-free through the refcounter (a zero-count
+            # decrement frees only when truly unreferenced)
+            self.refcount.remove_local(oid, 0)
+
     def _event_running(self, spec: TaskSpec, node_id: NodeId) -> None:
         """Start-of-execution event: pairs with the FINISHED/FAILED event
         to give the timeline durations (ref: task_event_buffer.h:199 state
@@ -741,6 +833,8 @@ class DriverRuntime:
             # task_done message races the store seal on deliberate kills)
             if not self._object_available(oid):
                 self.store_inline_bytes(oid, blob)
+        if spec.num_returns == STREAMING_RETURNS:
+            self._generator_finish(spec.task_id, error=blob)
         for ref in spec.arg_refs():
             self.refcount.unpin_for_task(ref.id)
         self.gcs.add_task_event({"task_id": spec.task_id.hex(), "name": spec.description,
@@ -751,7 +845,10 @@ class DriverRuntime:
                      worker: WorkerHandle) -> None:
         error = payload.get("error")
         if error is not None:
-            if spec.retry_exceptions:
+            # streaming tasks never retry transparently: a rerun would
+            # re-mint the same item ids under refs already consumed
+            if spec.retry_exceptions \
+                    and spec.num_returns != STREAMING_RETURNS:
                 retry = self.task_manager.try_retry(spec.task_id)
                 if retry is not None:
                     self._schedule(retry)
@@ -759,6 +856,8 @@ class DriverRuntime:
             self.task_manager.fail(spec.task_id)
             for oid in spec.return_ids():
                 self.store_inline_bytes(oid, error)
+            if spec.num_returns == STREAMING_RETURNS:
+                self._generator_finish(spec.task_id, error=error)
             if spec.task_type == TaskType.ACTOR_CREATION_TASK:
                 self._on_actor_creation_failed(spec, node_id, worker)
         else:
@@ -767,6 +866,8 @@ class DriverRuntime:
                 if res[0] == "inline":
                     self.store_inline_bytes(oid, res[1])
                 # "stored" results were registered at seal time
+            if spec.num_returns == STREAMING_RETURNS:
+                self._generator_finish(spec.task_id)
             self.task_manager.complete(spec.task_id)
             if spec.task_type == TaskType.ACTOR_CREATION_TASK:
                 self._on_actor_created(spec, node_id, worker)
@@ -781,6 +882,11 @@ class DriverRuntime:
     def on_worker_crashed(self, spec: TaskSpec, node_id: NodeId) -> None:
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
             return  # actor FSM handles restart / death
+        if spec.num_returns == STREAMING_RETURNS:
+            # no transparent re-run: items already delivered would repeat
+            self._fail_task(spec, exc.WorkerCrashedError(
+                f"Worker died while streaming {spec.description}"))
+            return
         if spec.num_returns > 0 and all(
                 self._object_available(oid) for oid in spec.return_ids()):
             # results were sealed (on a live node) before the crash: the task
@@ -1165,6 +1271,28 @@ class DriverRuntime:
         if method == "remove_pg":
             self.remove_placement_group(payload["pg_id"])
             return True
+        if method == "generator_item":
+            self.on_generator_item(payload["task_id"], payload["index"],
+                                   payload["object_id"],
+                                   payload.get("data"))
+            return True
+        if method == "generator_next":
+            try:
+                ref = self.next_generator_item(payload["task_id"],
+                                               payload["index"],
+                                               payload.get("timeout"))
+            except exc.GetTimeoutError:
+                raise
+            except BaseException as e:  # generator failed: typed error back
+                return ("error", serialization.dumps(e))
+            if ref is None:
+                return ("done", None)
+            if worker is not None:
+                self.refcount.add_holder_ref(ref.id, worker.worker_id)
+            return ("ref", ref.id)
+        if method == "release_generator":
+            self.release_generator(payload)
+            return None
         if method == "add_ref":
             if worker is not None:
                 self.refcount.add_holder_ref(payload, worker.worker_id)
@@ -1472,6 +1600,24 @@ class WorkerRuntime:
         self.channel.call("remove_pg", {"pg_id": pg_id})
 
     # kv
+    def next_generator_item(self, task_id, index: int,
+                            timeout: Optional[float] = None):
+        kind, val = self.channel.call(
+            "generator_next",
+            {"task_id": task_id, "index": index, "timeout": timeout})
+        if kind == "done":
+            return None
+        if kind == "error":
+            err = serialization.loads(val)
+            raise err if isinstance(err, BaseException) else \
+                exc.TaskError(cause=RuntimeError(str(err)))
+        ref = ObjectRef(val)
+        self.adopt_owned_ref(ref)  # head counted this worker as holder
+        return ref
+
+    def release_generator(self, task_id) -> None:
+        self.channel.notify("release_generator", task_id)
+
     def kv_put(self, key, value, namespace="user", overwrite=True):
         return self.channel.call("kv_put", {"key": key, "value": value,
                                             "namespace": namespace,

@@ -213,6 +213,15 @@ class ReferenceCounter:
         if free:
             self._on_free(object_id)
 
+    def forget(self, object_id: ObjectId) -> None:
+        """Freed object: drop residual bookkeeping (the _owned marker and
+        any stale per-holder rows) so long sessions don't accumulate ids."""
+        with self._lock:
+            self._owned.discard(object_id)
+            self._holders.pop(object_id, None)
+            self._local.pop(object_id, None)
+            self._task_pins.pop(object_id, None)
+
     def counts(self, object_id: ObjectId) -> tuple:
         with self._lock:
             return (self._local.get(object_id, 0),

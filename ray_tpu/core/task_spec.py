@@ -27,6 +27,11 @@ ARG_VALUE = 0
 ARG_REF = 1
 Arg = Tuple[int, Any]  # (ARG_VALUE, bytes) | (ARG_REF, ObjectRef)
 
+# num_returns sentinel: the task is a generator streaming items back one
+# at a time (ref: src/ray/protobuf/core_worker.proto:436
+# ReportGeneratorItemReturns; num_returns="streaming")
+STREAMING_RETURNS = -1
+
 
 @dataclass
 class SchedulingStrategy:
@@ -62,10 +67,14 @@ class TaskSpec:
     max_restarts: int = 0
     max_concurrency: int = 1
     concurrency_group: str = ""
+    # creation-task only: named group -> max concurrent calls
+    # (ref: src/ray/core_worker/transport/concurrency_group_manager.cc)
+    concurrency_groups: Optional[Dict[str, int]] = None
     is_async_actor: bool = False
     runtime_env: Optional[dict] = None
 
     def return_ids(self) -> List[ObjectId]:
+        # STREAMING_RETURNS (-1): ids are minted per yielded item instead
         return [ObjectId.for_task_return(self.task_id, i) for i in range(self.num_returns)]
 
     def arg_refs(self) -> List[ObjectRef]:

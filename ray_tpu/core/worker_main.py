@@ -32,7 +32,22 @@ from .ids import ActorId, WorkerId
 from .object_ref import ObjectRef
 from .object_store import SegmentReader
 from .rpc import RpcChannel, connect
-from .task_spec import ARG_REF, ARG_VALUE, TaskSpec, TaskType
+from .task_spec import (ARG_REF, ARG_VALUE, STREAMING_RETURNS, TaskSpec,
+                        TaskType)
+
+
+def _aiter_to_iter(agen):
+    """Drain an async generator synchronously (streaming async-actor
+    methods; the channel call between items blocks anyway)."""
+    loop = asyncio.new_event_loop()
+    try:
+        while True:
+            try:
+                yield loop.run_until_complete(agen.__anext__())
+            except StopAsyncIteration:
+                break
+    finally:
+        loop.close()
 
 
 class ActorQueue:
@@ -50,11 +65,24 @@ class ActorQueue:
         self._lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=self.max_concurrency,
                                         thread_name_prefix="actor")
+        # named concurrency groups: each an independent execution lane with
+        # its own parallelism cap; calls within a group keep submission
+        # order relative to each other (FIFO into a bounded pool) while
+        # groups never block one another (ref:
+        # transport/concurrency_group_manager.cc)
+        self._group_pools: Dict[str, ThreadPoolExecutor] = {}
+        for gname, size in (spec.concurrency_groups or {}).items():
+            self._group_pools[gname] = ThreadPoolExecutor(
+                max_workers=max(1, int(size)),
+                thread_name_prefix=f"actor-{gname}")
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         if self.is_async:
             self._loop = asyncio.new_event_loop()
             threading.Thread(target=self._loop.run_forever, daemon=True,
                              name="actor-asyncio").start()
+
+    def _pool_for(self, spec: TaskSpec) -> ThreadPoolExecutor:
+        return self._group_pools.get(spec.concurrency_group, self._pool)
 
     def push(self, spec: TaskSpec) -> None:
         # Dispatch under the lock: push_task messages are handled by a pool
@@ -65,10 +93,20 @@ class ActorQueue:
             while self._expected_seq in self._buffer:
                 s = self._buffer.pop(self._expected_seq)
                 self._expected_seq += 1
+                if s.concurrency_group \
+                        and s.concurrency_group not in self._group_pools:
+                    self._pool.submit(
+                        self.worker._report_error, s,
+                        ValueError(
+                            f"concurrency group {s.concurrency_group!r} was "
+                            f"not declared in concurrency_groups="
+                            f"{sorted(self._group_pools)}"))
+                    continue
                 if self.is_async:
                     asyncio.run_coroutine_threadsafe(self._run_async(s), self._loop)
                 else:
-                    self._pool.submit(self.worker.execute_task, s, self.instance)
+                    self._pool_for(s).submit(self.worker.execute_task, s,
+                                             self.instance)
 
     async def _run_async(self, spec: TaskSpec) -> None:
         if self._is_coroutine(spec):
@@ -214,6 +252,9 @@ class WorkerProcess:
     def _report_success(self, spec: TaskSpec, result: Any) -> None:
         from .config import DEFAULT as cfg
 
+        if spec.num_returns == STREAMING_RETURNS:
+            self._stream_generator(spec, result)
+            return
         if spec.num_returns == 0:
             outs = []
         elif spec.num_returns == 1:
@@ -245,6 +286,59 @@ class WorkerProcess:
         self.channel.notify("task_done", {
             "task_id": spec.task_id,
             "results": results,
+            "error": None,
+        })
+
+    def _stream_generator(self, spec: TaskSpec, result: Any) -> None:
+        """Iterate the task's generator, reporting each item as it is
+        produced (ref: _raylet.pyx execute_streaming_generator:868;
+        ReportGeneratorItemReturns). The per-item call doubles as
+        backpressure: the worker can't run ahead of the head's intake."""
+        from .config import DEFAULT as cfg
+        from .ids import ObjectId
+
+        if hasattr(result, "__aiter__") and not hasattr(result, "__iter__"):
+            result = _aiter_to_iter(result)  # async-generator methods
+        n = 0
+        try:
+            for item in result:
+                oid = ObjectId.for_task_return(spec.task_id, n)
+                sobj = serialization.serialize(item)
+                if sobj.total_bytes <= cfg.max_direct_call_object_size:
+                    ok = self.channel.call("generator_item", {
+                        "task_id": spec.task_id, "index": n,
+                        "object_id": oid, "data": sobj.to_bytes()})
+                    if ok is False:
+                        break  # consumer dropped the generator
+                else:
+                    name = self.channel.call(
+                        "create_object", {"object_id": oid,
+                                          "size": sobj.total_bytes})
+                    mv = self.reader.read(name, sobj.total_bytes)
+                    sobj.write_into(mv)
+                    del mv
+                    self.reader.release(name)
+                    self.channel.call("seal_object", {"object_id": oid})
+                    ok = self.channel.call("generator_item", {
+                        "task_id": spec.task_id, "index": n,
+                        "object_id": oid})
+                    if ok is False:
+                        break  # consumer dropped the generator
+                n += 1
+        except BaseException as e:  # noqa: BLE001 — mid-stream failure
+            self._report_error(spec, e)
+            return
+        finally:
+            close = getattr(result, "close", None)
+            if callable(close):
+                try:
+                    close()  # run the generator's finally blocks
+                except Exception:
+                    pass
+        self.channel.notify("task_done", {
+            "task_id": spec.task_id,
+            "results": [],
+            "streaming_count": n,
             "error": None,
         })
 

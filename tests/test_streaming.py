@@ -1,0 +1,186 @@
+"""Streaming generator returns + actor concurrency groups
+(ref test model: python/ray/tests/test_streaming_generator.py,
+test_concurrency_group.py)."""
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    rt = ray_tpu.init(num_cpus=8)
+    yield rt
+    ray_tpu.shutdown()
+
+
+class TestStreamingGenerators:
+    def test_basic_stream(self, cluster):
+        @ray_tpu.remote(num_returns="streaming")
+        def gen(n):
+            for i in range(n):
+                yield i * 10
+
+        out = [ray_tpu.get(ref, timeout=30) for ref in gen.remote(5)]
+        assert out == [0, 10, 20, 30, 40]
+
+    def test_stream_is_incremental(self, cluster):
+        """Items are consumable before the generator finishes."""
+        @ray_tpu.remote(num_returns="streaming")
+        def slow_gen():
+            for i in range(3):
+                yield i
+                time.sleep(1.0)
+
+        t0 = time.monotonic()
+        it = iter(slow_gen.remote())
+        first = ray_tpu.get(next(it), timeout=30)
+        first_latency = time.monotonic() - t0
+        assert first == 0
+        assert first_latency < 2.5, f"first item took {first_latency}s"
+        rest = [ray_tpu.get(r, timeout=30) for r in it]
+        assert rest == [1, 2]
+
+    def test_large_items_via_store(self, cluster):
+        @ray_tpu.remote(num_returns="streaming")
+        def big_gen():
+            for i in range(3):
+                yield np.full(300_000, i, dtype=np.int64)  # 2.4 MB each
+
+        arrays = [ray_tpu.get(r, timeout=60) for r in big_gen.remote()]
+        assert [int(a[0]) for a in arrays] == [0, 1, 2]
+
+    def test_generator_error_surfaces(self, cluster):
+        @ray_tpu.remote(num_returns="streaming")
+        def bad_gen():
+            yield 1
+            raise ValueError("boom")
+
+        it = iter(bad_gen.remote())
+        assert ray_tpu.get(next(it), timeout=30) == 1
+        with pytest.raises(Exception, match="boom"):
+            next(it)
+
+    def test_dropped_generator_stops_producer(self, cluster):
+        """Dropping the generator mid-stream tells the worker to stop
+        (the cancellation half of the streaming protocol)."""
+        @ray_tpu.remote
+        class Probe:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+            def count(self):
+                return self.n
+
+        probe = Probe.remote()
+        ray_tpu.get(probe.bump.remote(), timeout=30)
+
+        @ray_tpu.remote(num_returns="streaming")
+        def endless(p):
+            i = 0
+            while True:
+                ray_tpu.get(p.bump.remote(), timeout=30)
+                yield i
+                i += 1
+
+        it = iter(endless.remote(probe))
+        assert ray_tpu.get(next(it), timeout=30) == 0
+        del it  # consumer walks away
+        import gc
+
+        gc.collect()
+        time.sleep(1.0)
+        a = ray_tpu.get(probe.count.remote(), timeout=30)
+        time.sleep(1.5)
+        b = ray_tpu.get(probe.count.remote(), timeout=30)
+        assert b - a <= 2, f"producer still running: {a} -> {b}"
+
+    def test_actor_streaming_method(self, cluster):
+        @ray_tpu.remote
+        class Producer:
+            @ray_tpu.method(num_returns="streaming")
+            def stream(self, n):
+                for i in range(n):
+                    yield i + 100
+
+        p = Producer.remote()
+        out = [ray_tpu.get(r, timeout=30) for r in p.stream.remote(4)]
+        assert out == [100, 101, 102, 103]
+
+    def test_stream_consumed_in_worker(self, cluster):
+        """A task can consume its own submitted stream (relay path)."""
+        @ray_tpu.remote(num_returns="streaming")
+        def gen(n):
+            for i in range(n):
+                yield i
+
+        @ray_tpu.remote
+        def consume():
+            return sum(ray_tpu.get(r, timeout=30) for r in gen.remote(4))
+
+        assert ray_tpu.get(consume.remote(), timeout=60) == 6
+
+
+class TestConcurrencyGroups:
+    def test_groups_run_concurrently(self, cluster):
+        """A long call in one group must not block another group
+        (ref: concurrency_group_manager.cc)."""
+        @ray_tpu.remote(concurrency_groups={"io": 1, "compute": 1})
+        class Split:
+            def __init__(self):
+                self.events = []
+
+            @ray_tpu.method(concurrency_group="io")
+            def slow_io(self):
+                time.sleep(2.0)
+                return "io-done"
+
+            @ray_tpu.method(concurrency_group="compute")
+            def quick(self):
+                return time.monotonic()
+
+        s = Split.remote()
+        t0 = time.monotonic()
+        slow = s.slow_io.remote()
+        time.sleep(0.2)  # let slow_io start
+        quick_t = ray_tpu.get(s.quick.remote(), timeout=30)
+        quick_latency = quick_t - t0
+        assert quick_latency < 1.5, \
+            f"quick call waited {quick_latency}s behind slow_io"
+        assert ray_tpu.get(slow, timeout=30) == "io-done"
+
+    def test_default_group_still_ordered(self, cluster):
+        @ray_tpu.remote(concurrency_groups={"side": 2})
+        class Mixed:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+            @ray_tpu.method(concurrency_group="side")
+            def side_call(self):
+                return "side"
+
+        m = Mixed.remote()
+        vals = ray_tpu.get([m.bump.remote() for _ in range(10)], timeout=30)
+        assert vals == list(range(1, 11))
+        assert ray_tpu.get(m.side_call.remote(), timeout=30) == "side"
+
+    def test_method_options_override(self, cluster):
+        @ray_tpu.remote(concurrency_groups={"g": 1})
+        class A:
+            def work(self):
+                return "default"
+
+        a = A.remote()
+        assert ray_tpu.get(
+            a.work.options(concurrency_group="g").remote(), timeout=30) \
+            == "default"
