@@ -48,6 +48,12 @@ class WorkerHandle:
     in_flight: Dict[TaskId, TaskSpec] = field(default_factory=dict)
     lease_resources: ResourceSet = field(default_factory=dict)
     lease_pg: Optional[tuple] = None  # (pg_id, bundle_index)
+    # >0 while the worker sits in blocking get/wait calls: its lease
+    # resources are returned to the pool so dependent tasks can run (ref:
+    # local_task_manager.cc blocked-worker accounting via
+    # NotifyDirectCallTaskBlocked/Unblocked). A depth counter, not a bool:
+    # threaded actors (max_concurrency>1) can block on several calls at once.
+    blocked_depth: int = 0
 
 
 @dataclass
@@ -156,8 +162,13 @@ class Node:
                 worker = self._pop_idle()
                 if worker is None:
                     remaining.append(req)
-                    if (len(self._workers) + self._starting_count) < self._max_workers \
-                            or not self._workers:
+                    # blocked workers don't count toward the cap: each one
+                    # freed its resources and is waiting on work that may
+                    # only be runnable by a new worker (deep nested graphs)
+                    active = (len(self._workers) + self._starting_count
+                              - sum(1 for w in self._workers.values()
+                                    if w.blocked_depth > 0))
+                    if active < self._max_workers or not self._workers:
                         self._start_worker()
                     continue
                 self._take_resources(req)
@@ -184,7 +195,9 @@ class Node:
 
     def release_lease(self, worker: WorkerHandle, terminate: bool = False) -> None:
         with self._lock:
-            if worker.lease_pg is not None:
+            if worker.blocked_depth > 0:
+                worker.blocked_depth = 0  # resources already back in the pool
+            elif worker.lease_pg is not None:
                 b = self._bundles.get(worker.lease_pg)
                 if b is not None:
                     b.used = res_sub(b.used, worker.lease_resources)
@@ -198,6 +211,43 @@ class Node:
             elif terminate:
                 self._terminate_worker(worker)
         self._dispatch()
+
+    def notify_worker_blocked(self, worker: WorkerHandle) -> None:
+        """The worker entered a blocking get/wait: return its lease resources
+        to the pool so tasks it depends on can be dispatched here. Without
+        this, nested task graphs deadlock once every CPU is held by a blocked
+        parent (ref: local_task_manager.cc:57 blocked-worker accounting)."""
+        with self._lock:
+            if not worker.lease_resources \
+                    or worker.state not in ("leased", "actor"):
+                return
+            worker.blocked_depth += 1
+            if worker.blocked_depth > 1:
+                return  # resources already released by the first blocker
+            if worker.lease_pg is not None:
+                b = self._bundles.get(worker.lease_pg)
+                if b is not None:
+                    b.used = res_sub(b.used, worker.lease_resources)
+            else:
+                self.available = res_add(self.available, worker.lease_resources)
+        self._dispatch()
+
+    def notify_worker_unblocked(self, worker: WorkerHandle) -> None:
+        """The blocking call returned: re-take the lease resources. May drive
+        availability negative (temporary oversubscription) — progress beats
+        strictness here, exactly as the reference behaves on unblock."""
+        with self._lock:
+            if worker.blocked_depth == 0:
+                return
+            worker.blocked_depth -= 1
+            if worker.blocked_depth > 0:
+                return  # other calls from this worker still blocked
+            if worker.lease_pg is not None:
+                b = self._bundles.get(worker.lease_pg)
+                if b is not None:
+                    b.used = res_add(b.used, worker.lease_resources)
+            else:
+                self.available = res_sub(self.available, worker.lease_resources)
 
     def _pop_idle(self) -> Optional[WorkerHandle]:
         while self._idle:
@@ -263,7 +313,9 @@ class Node:
                 return
             worker.state = "dead"
             self._workers.pop(worker.worker_id, None)
-            if worker.lease_resources:
+            if worker.blocked_depth > 0:
+                worker.blocked_depth = 0  # resources already back in the pool
+            elif worker.lease_resources:
                 if worker.lease_pg is not None:
                     b = self._bundles.get(worker.lease_pg)
                     if b is not None:

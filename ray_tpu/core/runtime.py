@@ -464,11 +464,15 @@ class DriverRuntime:
             self._free_object(r.id)
 
     # fetch: returns ("inline", bytes) or ("shm", name, size)
-    def fetch_one(self, oid: ObjectId, timeout: Optional[float]) -> Tuple:
+    def fetch_one(self, oid: ObjectId, timeout: Optional[float],
+                  on_block=None) -> Tuple:
         deadline = None if timeout is None else time.monotonic() + timeout
         attempts = 0
         while True:
             ev = self._event(oid)
+            if on_block is not None and not ev.is_set():
+                on_block()  # about to actually wait: release caller's lease
+                on_block = None
             remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
             if not ev.wait(remaining):
                 raise exc.GetTimeoutError(
@@ -630,7 +634,8 @@ class DriverRuntime:
 
     def wait(self, refs: List[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None,
-             fetch_local: bool = True) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+             fetch_local: bool = True, on_block=None
+             ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
         if num_returns > len(refs):
             raise ValueError("num_returns > len(refs)")
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -648,6 +653,9 @@ class DriverRuntime:
             if deadline is not None and time.monotonic() >= deadline:
                 break
             if not progressed:
+                if on_block is not None:
+                    on_block()
+                    on_block = None
                 time.sleep(0.002)
         return ready, pending
 
@@ -744,21 +752,22 @@ class DriverRuntime:
         sealed into a store). Returns False when the consumer dropped the
         generator — the worker stops producing (the cancellation half of
         the streaming protocol)."""
-        with self._lock:
-            if task_id in self._released_generators:
-                released = True
-            else:
-                released = False
-        if released:
-            if data is None:
-                self._free_object(oid)  # already sealed into a store
-            return False
         if data is not None:
             self.store_inline_bytes(oid, data)
-        self.refcount.add_owned(oid)
-        g = self._gen_state(task_id)
+        # Tombstone check, item insertion, AND the ownership count must share
+        # one lock acquisition: a release interleaved between them would
+        # either resurrect the popped generator dict or free-check the item
+        # before it is owned, leaking it permanently. (_lock is an RLock, so
+        # the nested _gen_state/add_owned calls are safe.)
         with self._lock:
-            g["items"][index] = oid
+            released = task_id in self._released_generators
+            if not released:
+                g = self._gen_state(task_id)
+                g["items"][index] = oid
+                self.refcount.add_owned(oid)
+        if released:
+            self._free_object(oid)
+            return False
         g["event"].set()
         return True
 
@@ -777,8 +786,8 @@ class DriverRuntime:
         g["event"].set()
 
     def next_generator_item(self, task_id: TaskId, index: int,
-                            timeout: Optional[float] = None
-                            ) -> Optional[ObjectRef]:
+                            timeout: Optional[float] = None,
+                            on_block=None) -> Optional[ObjectRef]:
         """Blocks until item `index` exists; None = generator exhausted."""
         g = self._gen_state(task_id)
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -794,6 +803,9 @@ class DriverRuntime:
                 if g["done"]:
                     return None
                 g["event"].clear()
+            if on_block is not None:
+                on_block()
+                on_block = None
             remaining = (None if deadline is None
                          else max(0.0, deadline - time.monotonic()))
             if not g["event"].wait(remaining):
@@ -1186,15 +1198,35 @@ class DriverRuntime:
 
     # ---- worker RPC dispatch (the node-side core-worker service) -------------
 
+    def _block_guard(self, node: Node, worker: Optional[WorkerHandle]):
+        """Blocked-worker accounting for worker-originated blocking calls:
+        `on_block` (invoked lazily, only if the call actually waits) returns
+        the worker's lease resources to its node's pool; `unblock` re-takes
+        them on the way out (ref: local_task_manager.cc:57)."""
+        state = {"blocked": False}
+
+        def on_block():
+            if worker is not None and not state["blocked"]:
+                state["blocked"] = True
+                node.notify_worker_blocked(worker)
+
+        def unblock():
+            if state["blocked"]:
+                node.notify_worker_unblocked(worker)
+
+        return on_block, unblock
+
     def handle_worker_call(self, node: Node, worker: Optional[WorkerHandle],
                            method: str, payload):
         if method == "get_objects":
             ids = payload["ids"]
             timeout = payload.get("timeout")
-            out = []
-            for oid in ids:
-                out.append(self.fetch_one(oid, timeout))
-            return out
+            on_block, unblock = self._block_guard(node, worker)
+            try:
+                return [self.fetch_one(oid, timeout, on_block=on_block)
+                        for oid in ids]
+            finally:
+                unblock()
         if method == "put_inline":
             oid = payload["object_id"]
             self.store_inline_bytes(oid, payload["data"])
@@ -1228,8 +1260,13 @@ class DriverRuntime:
             return True
         if method == "wait":
             refs = [ObjectRef(o) for o in payload["ids"]]
-            ready, pending = self.wait(refs, payload["num_returns"],
-                                       payload.get("timeout"))
+            on_block, unblock = self._block_guard(node, worker)
+            try:
+                ready, pending = self.wait(refs, payload["num_returns"],
+                                           payload.get("timeout"),
+                                           on_block=on_block)
+            finally:
+                unblock()
             return ([r.id for r in ready], [r.id for r in pending])
         if method == "kill_actor":
             self.kill_actor(payload["actor_id"], payload.get("no_restart", True))
@@ -1240,7 +1277,13 @@ class DriverRuntime:
         if method == "actor_state":
             return self.actor_state(payload)
         if method == "wait_for_actor":
-            self.wait_for_actor(payload["actor_id"], payload.get("timeout", 60.0))
+            on_block, unblock = self._block_guard(node, worker)
+            on_block()  # not a hot path: treat the whole call as blocked
+            try:
+                self.wait_for_actor(payload["actor_id"],
+                                    payload.get("timeout", 60.0))
+            finally:
+                unblock()
             return True
         if method == "get_named_actor":
             info = self.gcs.get_named_actor(payload["name"], payload["namespace"])
@@ -1267,24 +1310,35 @@ class DriverRuntime:
                                                payload["strategy"],
                                                payload.get("name", ""))
         if method == "pg_ready":
-            return self.pg_ready(payload["pg_id"], payload.get("timeout", 30.0))
+            on_block, unblock = self._block_guard(node, worker)
+            on_block()  # not a hot path: treat the whole call as blocked
+            try:
+                return self.pg_ready(payload["pg_id"],
+                                     payload.get("timeout", 30.0))
+            finally:
+                unblock()
         if method == "remove_pg":
             self.remove_placement_group(payload["pg_id"])
             return True
         if method == "generator_item":
-            self.on_generator_item(payload["task_id"], payload["index"],
-                                   payload["object_id"],
-                                   payload.get("data"))
-            return True
+            # The boolean is the cancellation half of the protocol: False
+            # tells the producing worker the consumer dropped the generator.
+            return self.on_generator_item(payload["task_id"], payload["index"],
+                                          payload["object_id"],
+                                          payload.get("data"))
         if method == "generator_next":
+            on_block, unblock = self._block_guard(node, worker)
             try:
                 ref = self.next_generator_item(payload["task_id"],
                                                payload["index"],
-                                               payload.get("timeout"))
+                                               payload.get("timeout"),
+                                               on_block=on_block)
             except exc.GetTimeoutError:
                 raise
             except BaseException as e:  # generator failed: typed error back
                 return ("error", serialization.dumps(e))
+            finally:
+                unblock()
             if ref is None:
                 return ("done", None)
             if worker is not None:

@@ -129,3 +129,45 @@ def test_cluster_resources(ray_start_regular):
     res = ray_tpu.cluster_resources()
     assert res["CPU"] == 4.0
     assert len(ray_tpu.nodes()) == 1
+
+
+def test_nested_tasks_deeper_than_cpus():
+    """Blocked workers release their lease: a recursive chain deeper than
+    the CPU count must not deadlock (ref: local_task_manager.cc:57
+    blocked-worker accounting; round-2 VERDICT weak #2 repro)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        def parent(depth):
+            if depth == 0:
+                return 0
+            return ray_tpu.get(parent.remote(depth - 1)) + 1
+
+        # depth 10 > the worker soft limit (8): blocked workers must be
+        # excluded from the start-worker cap, not just release their CPUs
+        assert ray_tpu.get(parent.remote(10), timeout=120) == 10
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_nested_wait_releases_lease():
+    """A worker blocked in ray_tpu.wait must also release its CPU."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=1)
+    try:
+        @ray_tpu.remote
+        def leaf():
+            return 7
+
+        @ray_tpu.remote
+        def parent():
+            ref = leaf.remote()
+            ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=30)
+            return ray_tpu.get(ready[0])
+
+        assert ray_tpu.get(parent.remote(), timeout=60) == 7
+    finally:
+        ray_tpu.shutdown()
