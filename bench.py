@@ -118,26 +118,45 @@ def main() -> None:
 
 
 def _bench_ppo_steps() -> float:
-    """Secondary metric: PPO env-steps/s, single-process rollout+learner
-    (the >100k steps/s north star is multi-worker; this tracks the
-    per-core envelope without burning bench budget)."""
+    """PPO env-steps/s through the real multi-worker actor path: N rollout
+    actors (numpy policy, no jax in workers) -> JAX learner on the default
+    backend -> one object-store weight broadcast per iteration (the
+    BASELINE.md configuration; north star >100k steps/s). Worker count
+    scales with the bench host's cores (override RTPU_BENCH_PPO_WORKERS)."""
     try:
-        from ray_tpu.rllib.learner import PPOLearner
-        from ray_tpu.rllib.rollout_worker import RolloutWorker
+        import ray_tpu
+        from ray_tpu.rllib.algorithm import PPOConfig
 
-        n_envs, T = (8, 64) if SMOKE else (32, 256)
-        w = RolloutWorker("CartPole-v1", num_envs=n_envs, rollout_len=T,
-                          gamma=0.99, lam=0.95, seed=0)
-        info = w.env_info()
-        learner = PPOLearner(info["obs_dim"], info["num_actions"],
-                             minibatch_size=512, num_epochs=2, seed=0)
-        learner.update(w.sample(learner.get_params()))  # warmup/compile
-        t0 = time.perf_counter()
-        iters = 1 if SMOKE else 3
-        for _ in range(iters):
-            learner.update(w.sample(learner.get_params()))
-        dt = time.perf_counter() - t0
-        return round(n_envs * T * iters / dt, 1)
+        cores = os.cpu_count() or 1
+        if SMOKE:
+            n_workers, n_envs, T, iters = 2, 8, 64, 1
+            mb, epochs = 512, 2
+        else:
+            n_workers = int(os.environ.get(
+                "RTPU_BENCH_PPO_WORKERS", max(2, min(32, cores))))
+            # large rollouts + few big minibatches amortize learner-device
+            # round-trip latency (each jit call over the TPU tunnel pays one)
+            n_envs, T, iters = 64, 512, 3
+            mb, epochs = 8192, 2
+        ray_tpu.init(num_cpus=float(max(4, n_workers + 1)))
+        try:
+            algo = (PPOConfig()
+                    .environment("CartPole-v1")
+                    .rollouts(num_rollout_workers=n_workers,
+                              num_envs_per_worker=n_envs,
+                              rollout_fragment_length=T)
+                    .training(sgd_minibatch_size=mb, num_sgd_epochs=epochs)
+                    .build())
+            algo.train()  # warmup: spawn workers, first jit compile
+            t0 = time.perf_counter()
+            total = 0
+            for _ in range(iters):
+                total += algo.train()["timesteps_this_iter"]
+            dt = time.perf_counter() - t0
+            algo.stop()
+            return round(total / dt, 1)
+        finally:
+            ray_tpu.shutdown()
     except Exception:
         import traceback
 

@@ -4,13 +4,37 @@ Equivalent of RLlib's core loop (ref: rllib/algorithms/): rollout-worker
 actors sampling vectorized envs, a jitted JAX PPO learner (pmean-ready
 for data-parallel meshes), synchronous Algorithm.train() with object-
 store weight broadcast, and a Tune-compatible trainable surface.
-"""
-from .algorithm import PPO, PPOConfig
-from .env import CartPoleVecEnv, VectorEnv, make_env, register_env
-from .learner import PPOLearner, ppo_loss
-from .rollout_worker import RolloutWorker
 
-__all__ = [
-    "CartPoleVecEnv", "PPO", "PPOConfig", "PPOLearner", "RolloutWorker",
-    "VectorEnv", "make_env", "ppo_loss", "register_env",
-]
+Lazy exports (PEP 562): rollout-worker processes unpickle their actor
+class by module reference, and an eager `from .learner import ...` here
+would drag jax+optax into every rollout actor — the exact cost
+np_policy.py exists to avoid. Only the submodule actually touched gets
+imported.
+"""
+from typing import TYPE_CHECKING
+
+_EXPORTS = {
+    "PPO": "algorithm", "PPOConfig": "algorithm",
+    "CartPoleVecEnv": "env", "VectorEnv": "env",
+    "make_env": "env", "register_env": "env",
+    "PPOLearner": "learner", "ppo_loss": "learner",
+    "RolloutWorker": "rollout_worker",
+}
+
+__all__ = sorted(_EXPORTS)
+
+if TYPE_CHECKING:  # static analyzers see the eager imports
+    from .algorithm import PPO, PPOConfig  # noqa: F401
+    from .env import (CartPoleVecEnv, VectorEnv, make_env,  # noqa: F401
+                      register_env)
+    from .learner import PPOLearner, ppo_loss  # noqa: F401
+    from .rollout_worker import RolloutWorker  # noqa: F401
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

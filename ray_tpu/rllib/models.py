@@ -43,21 +43,6 @@ def forward(params: Dict, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return logits, value
 
 
-def sample_actions(params: Dict, obs: np.ndarray, rng: np.random.Generator
-                   ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Rollout-side inference (numpy sampling from jitted logits):
-    -> (actions, logp, values)."""
-    logits, values = _forward_jit(params, jnp.asarray(obs))
-    logits = np.asarray(logits)
-    values = np.asarray(values)
-    z = logits - logits.max(axis=1, keepdims=True)
-    p = np.exp(z)
-    p /= p.sum(axis=1, keepdims=True)
-    u = rng.random((len(p), 1))
-    actions = (p.cumsum(axis=1) < u).sum(axis=1).astype(np.int64)
-    actions = np.clip(actions, 0, p.shape[1] - 1)
-    logp = np.log(p[np.arange(len(p)), actions] + 1e-8)
-    return actions, logp.astype(np.float32), values.astype(np.float32)
-
-
-_forward_jit = jax.jit(forward)
+# Rollout inference is pure numpy (no jax, no device, no jit dispatch) —
+# see np_policy.py. Re-exported here for API continuity.
+from .np_policy import sample_actions  # noqa: E402,F401

@@ -1,0 +1,50 @@
+"""Numpy rollout-side policy inference.
+
+Rollout actors deliberately never import jax: on a TPU host every extra
+process initializing the backend pays seconds of startup and contends for
+the chip, and for a (64, 64) fcnet a numpy forward is microseconds —
+far below jit dispatch overhead, let alone a device round-trip per env
+step. The learner (ray_tpu.rllib.learner) is the only RL component that
+touches jax/TPU, mirroring the reference's rollout-on-CPU / learn-on-GPU
+split (ref: rllib/evaluation/rollout_worker.py:660 sample loop;
+rllib/core/learner/learner.py update on device).
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+
+def ensure_numpy(params: Dict) -> Dict:
+    """Normalize a param dict (possibly jax arrays off the object store)
+    to float32 numpy once per rollout, so the per-step loop never pays a
+    conversion."""
+    return {k: np.asarray(v, dtype=np.float32) for k, v in params.items()}
+
+
+def forward_np(params: Dict, obs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """obs [B, obs_dim] -> (logits [B, A], value [B]). Mirrors
+    models.forward exactly (two tanh hidden layers + separate heads)."""
+    x = obs
+    i = 0
+    while f"w{i}" in params:
+        x = np.tanh(x @ params[f"w{i}"] + params[f"b{i}"])
+        i += 1
+    logits = x @ params["w_pi"] + params["b_pi"]
+    value = (x @ params["w_v"] + params["b_v"])[:, 0]
+    return logits, value
+
+
+def sample_actions(params: Dict, obs: np.ndarray, rng: np.random.Generator
+                   ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Rollout-side inference -> (actions, logp, values)."""
+    logits, values = forward_np(params, obs)
+    z = logits - logits.max(axis=1, keepdims=True)
+    p = np.exp(z)
+    p /= p.sum(axis=1, keepdims=True)
+    u = rng.random((len(p), 1))
+    actions = (p.cumsum(axis=1) < u).sum(axis=1).astype(np.int64)
+    np.clip(actions, 0, p.shape[1] - 1, out=actions)
+    logp = np.log(p[np.arange(len(p)), actions] + 1e-8)
+    return actions, logp.astype(np.float32), values.astype(np.float32)

@@ -13,7 +13,7 @@ import numpy as np
 
 from . import sample_batch as sb
 from .env import make_env
-from .models import sample_actions
+from .np_policy import ensure_numpy, sample_actions
 
 
 class RolloutWorker:
@@ -37,6 +37,7 @@ class RolloutWorker:
         self._finished_returns: list = []
 
     def sample(self, params: Dict) -> sb.Batch:
+        params = ensure_numpy(params)  # one conversion, not one per step
         T, n = self.rollout_len, self.env.num_envs
         obs_buf = np.empty((T, n, self.env.obs_dim), np.float32)
         act_buf = np.empty((T, n), np.int64)

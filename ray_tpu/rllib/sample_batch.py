@@ -7,7 +7,7 @@ store and concatenate cheaply on the learner.
 """
 from __future__ import annotations
 
-from typing import Dict, Iterator, List
+from typing import Dict, List
 
 import numpy as np
 
@@ -49,18 +49,3 @@ def compute_gae(rewards: np.ndarray, values: np.ndarray, dones: np.ndarray,
         next_value = values[t]
     returns = adv + values
     return adv, returns
-
-
-def minibatches(batch: Batch, minibatch_size: int, num_epochs: int,
-                seed: int = 0) -> Iterator[Batch]:
-    """Shuffled minibatch stream for SGD (ref: ppo learner minibatching).
-    A batch smaller than minibatch_size still yields one (whole-batch)
-    minibatch per epoch — never silently zero SGD steps."""
-    n = num_steps(batch)
-    mb = min(minibatch_size, n)
-    rng = np.random.default_rng(seed)
-    for _ in range(num_epochs):
-        perm = rng.permutation(n)
-        for lo in range(0, n - mb + 1, mb):
-            idx = perm[lo:lo + mb]
-            yield {k: v[idx] for k, v in batch.items()}
