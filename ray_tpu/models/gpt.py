@@ -45,6 +45,13 @@ class GPTConfig:
     param_dtype: Any = jnp.float32
     remat: bool = True
     use_flash: bool = True
+    flash_block_q: int = 1024     # flash kernel tile sizes (clamped to seq)
+    flash_block_k: int = 1024
+    # scan_layers=True compiles one block body (fast compile, the right
+    # default for deep models); False unrolls the layer loop — slower to
+    # compile but removes the scan's per-layer residual-stacking
+    # dynamic-update-slices, worth ~6% MFU on the training bench
+    scan_layers: bool = True
     seq_axis: Optional[str] = None  # set to "sp" to use ring attention
 
     @property
@@ -174,7 +181,9 @@ class GPT:
         if c.seq_axis is not None:
             attn = ring_attention(q, k, v, axis_name=c.seq_axis, causal=True)
         elif c.use_flash:
-            attn = flash_attention(q, k, v, causal=True)
+            attn = flash_attention(q, k, v, causal=True,
+                                   block_q=c.flash_block_q,
+                                   block_k=c.flash_block_k)
         else:
             from ..ops import mha_reference
 
@@ -228,8 +237,9 @@ class GPT:
         the LM head + logsumexp run per token-chunk under jax.checkpoint,
         so only per-chunk logits ever exist (fwd and bwd) — e.g. 3.3 GB of
         GPT-2-small logits at B=16,S=1024 become 8 × 412 MB transients.
-        Measured a wash on speed at that size (bench uses plain `loss`);
-        use it when vocab*batch*seq logits don't fit HBM."""
+        This is the bench configuration (bench.py): marginally faster than
+        plain `loss` at B=32+ and the only option once vocab*batch*seq
+        logits stop fitting HBM."""
         c = self.config
         B, S = tokens.shape
         x = self._backbone(params, tokens, rng)         # [B,S,D] bf16
@@ -267,10 +277,19 @@ class GPT:
         layer_params = {k: v for k, v in params.items()
                         if k not in ("wte", "wpe", "lnf_g", "lnf_b")}
 
-        def block_fn(x, lp):
-            return self._block(x, lp, rng), None
+        if c.scan_layers:
+            def block_fn(x, lp):
+                return self._block(x, lp, rng), None
 
-        if c.remat:
-            block_fn = jax.checkpoint(block_fn, policy=self._remat_policy())
-        x, _ = jax.lax.scan(block_fn, x, layer_params)
+            if c.remat:
+                block_fn = jax.checkpoint(block_fn,
+                                          policy=self._remat_policy())
+            x, _ = jax.lax.scan(block_fn, x, layer_params)
+        else:
+            blk = self._block
+            if c.remat:
+                blk = jax.checkpoint(blk, policy=self._remat_policy())
+            for i in range(c.n_layer):
+                lp = {k: v[i] for k, v in layer_params.items()}
+                x = blk(x, lp, rng)
         return layernorm(x, params["lnf_g"], params["lnf_b"])
