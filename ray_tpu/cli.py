@@ -26,10 +26,13 @@ def _cmd_start(args) -> int:
         resources = {"CPU": args.num_cpus, **json.loads(args.resources)}
         rt = DriverRuntime(resources=resources)
         runtime_mod.set_runtime(rt)
+        from .core.rpc import cluster_token
+
         addr = rt.enable_remote_nodes(host=args.host, port=args.port)
         print(f"ray_tpu head listening on {addr[0]}:{addr[1]}")
         print(f"Join more nodes with:\n  python -m ray_tpu start "
-              f"--address {addr[0]}:{addr[1]}")
+              f"--address {addr[0]}:{addr[1]} "
+              f"--authkey {cluster_token().hex()}")
         try:
             while True:
                 time.sleep(1.0)
@@ -45,6 +48,8 @@ def _cmd_start(args) -> int:
                   "--num-cpus", str(args.num_cpus),
                   "--resources", args.resources,
                   "--labels", args.labels]
+    if args.authkey:
+        agent_args += ["--authkey", args.authkey]
     return agent_main(agent_args)
 
 
@@ -112,6 +117,8 @@ def main(argv=None) -> int:
                     default=float(os.cpu_count() or 1))
     sp.add_argument("--resources", default="{}")
     sp.add_argument("--labels", default="{}")
+    sp.add_argument("--authkey", default="",
+                    help="cluster auth token (hex) printed by the head")
     sp.set_defaults(fn=_cmd_start)
 
     st = sub.add_parser("status", help="show cluster nodes")

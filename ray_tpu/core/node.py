@@ -31,10 +31,8 @@ from .gcs import NodeInfo
 from .ids import ActorId, NodeId, PlacementGroupId, TaskId, WorkerId
 from .object_store import PlasmaStore
 from .resources import ResourceSet, normalize, res_add, res_ge, res_sub
-from .rpc import RpcChannel, RpcServer
+from .rpc import RpcChannel, RpcServer, cluster_token
 from .task_spec import TaskSpec, TaskType
-
-_AUTHKEY = b"ray_tpu"
 
 
 @dataclass
@@ -54,6 +52,7 @@ class WorkerHandle:
     # NotifyDirectCallTaskBlocked/Unblocked). A depth counter, not a bool:
     # threaded actors (max_concurrency>1) can block on several calls at once.
     blocked_depth: int = 0
+    idle_since: float = 0.0  # monotonic timestamp of the last idle entry
 
 
 @dataclass
@@ -100,11 +99,38 @@ class Node:
         self.alive = True
         self._sock_path = os.path.join(session_dir, f"node_{node_id.hex()[:12]}.sock")
         self._server = RpcServer(self._sock_path, self._make_handler,
-                                 family="AF_UNIX", authkey=_AUTHKEY)
+                                 family="AF_UNIX")
         self._max_workers = max(int(config.num_workers_soft_limit),
                                 int(self.total_resources.get("CPU", 1)))
         for _ in range(int(config.worker_prestart_count)):
             self._start_worker()
+        # idle-worker reclamation (ref: worker_pool.cc idle worker killing;
+        # config.worker_idle_timeout_s existed but was unenforced until r3)
+        threading.Thread(target=self._idle_reaper_loop, daemon=True,
+                         name="idle-reaper").start()
+
+    def _idle_reaper_loop(self) -> None:
+        timeout = float(self.config.worker_idle_timeout_s)
+        keep = int(self.config.worker_prestart_count)
+        while self.alive:
+            time.sleep(min(30.0, max(1.0, timeout / 4)))
+            now = time.monotonic()
+            victims = []
+            with self._lock:
+                if not self.alive:
+                    return
+                idle = [w for w in self._workers.values()
+                        if w.state == "idle"]
+                reclaimable = sorted(idle, key=lambda w: w.idle_since)
+                # oldest first, but always keep the prestart floor warm
+                for w in reclaimable[:max(0, len(idle) - keep)]:
+                    if now - w.idle_since > timeout:
+                        victims.append(w)
+                for w in victims:
+                    self._terminate_worker(w)
+                if victims:
+                    self._idle = deque(x for x in self._idle
+                                       if x.state == "idle")
 
     def info(self) -> NodeInfo:
         return NodeInfo(node_id=self.node_id, total_resources=dict(self.total_resources),
@@ -207,6 +233,7 @@ class Node:
             worker.lease_pg = None
             if worker.state in ("leased", "actor") and not terminate:
                 worker.state = "idle"
+                worker.idle_since = time.monotonic()
                 self._idle.append(worker)
             elif terminate:
                 self._terminate_worker(worker)
@@ -262,12 +289,14 @@ class Node:
         worker_id = WorkerId.from_random()
         env = dict(os.environ)
         env["PYTHONPATH"] = os.pathsep.join(p for p in sys.path if p)
+        # auth token travels via env (RTPU_AUTHKEY), never argv — argv is
+        # world-readable through /proc/<pid>/cmdline
+        env["RTPU_AUTHKEY"] = cluster_token().hex()
         # -S skips site processing (a sitecustomize importing jax costs ~2s
         # per worker start); the parent's sys.path travels via PYTHONPATH.
         cmd = [
             sys.executable, "-S", "-m", "ray_tpu.core.worker_main",
             "--address", self._sock_path,
-            "--authkey", _AUTHKEY.hex(),
             "--worker-id", worker_id.hex(),
             "--node-id", self.node_id.hex(),
         ]
@@ -302,6 +331,7 @@ class Node:
             handle.channel = channel
             handle.pid = payload.get("pid", handle.pid)
             handle.state = "idle"
+            handle.idle_since = time.monotonic()
             self._starting_count = max(0, self._starting_count - 1)
             self._idle.append(handle)
         channel.on_close(lambda: self._on_worker_exit(handle))

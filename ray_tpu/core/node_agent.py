@@ -31,9 +31,23 @@ from .config import Config
 from .ids import NodeId, ObjectId, WorkerId
 from .object_store import (PlasmaStore, SegmentReader, pull_chunks,
                            read_store_chunk)
-from .rpc import RpcChannel, RpcServer, connect
+from .rpc import RpcChannel, RpcServer, cluster_token, connect
 
-_AUTHKEY = b"ray_tpu"
+
+def _outbound_ip_toward(addr) -> str:
+    """The local interface address this host would use to reach `addr` —
+    the right P2P advertisement when --node-ip isn't given (a UDP connect
+    performs routing without sending a packet)."""
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect((addr[0], int(addr[1]) or 80))
+        return s.getsockname()[0]
+    except Exception:
+        return "127.0.0.1"
+    finally:
+        s.close()
 
 
 class NodeAgent:
@@ -62,11 +76,27 @@ class NodeAgent:
         self._sock_path = os.path.join(
             self.session_dir, f"agent_{self.node_id.hex()[:12]}.sock")
         self._server = RpcServer(self._sock_path, self._make_worker_handler,
-                                 family="AF_UNIX", authkey=_AUTHKEY)
-        # one duplex channel to the head: requests out, commands in
+                                 family="AF_UNIX")
         conn_addr = (tuple(head_address) if isinstance(head_address, list)
                      else head_address)
-        self.head = connect(conn_addr, authkey=_AUTHKEY, name="agent",
+        # peer-facing object server: other agents pull chunks DIRECTLY from
+        # here instead of relaying through the head (ref: object_manager.h:117
+        # — raylets push chunks peer-to-peer; head DCN bandwidth must not be
+        # the cluster ceiling). Authenticated with the same cluster token.
+        # Binds all interfaces; ADVERTISES --node-ip / RTPU_NODE_IP, or the
+        # interface this host uses to reach the head (loopback advertisement
+        # would silently defeat cross-machine P2P).
+        peer_host = (os.environ.get("RTPU_NODE_IP")
+                     or _outbound_ip_toward(conn_addr))
+        self._peer_server = RpcServer(("0.0.0.0", 0),
+                                      self._make_peer_handler,
+                                      family="AF_INET",
+                                      num_handler_threads=8)
+        self._peer_addr = (peer_host, self._peer_server.address[1])
+        self._peer_channels: Dict[tuple, RpcChannel] = {}
+        # one duplex channel to the head: requests out, commands in.
+        # authkey = the cluster token (from --authkey / RTPU_AUTHKEY).
+        self.head = connect(conn_addr, name="agent",
                             handler=self._handle_head_command,
                             num_handler_threads=8)
         self.head.on_close(self._on_head_lost)
@@ -75,6 +105,7 @@ class NodeAgent:
             "resources": dict(resources),
             "labels": dict(labels or {}),
             "pid": os.getpid(),
+            "object_server_addr": tuple(self._peer_addr),
         }, timeout=30)
         head_period = (reply or {}).get(
             "health_check_period_s", self.config.health_check_period_s)
@@ -131,15 +162,65 @@ class NodeAgent:
     def _read_chunk(self, oid: ObjectId, offset: int, length: int):
         return read_store_chunk(self.store, self.reader, oid, offset, length)
 
+    # ---- peer-to-peer object serving ----------------------------------------
+
+    def _make_peer_handler(self, channel: RpcChannel):
+        def handler(method: str, payload):
+            if method == "object_info":
+                seg = self.store.get_segment(payload["object_id"])
+                return None if seg is None else seg[1]
+            if method == "read_chunk":
+                return self._read_chunk(payload["object_id"],
+                                        payload["offset"], payload["length"])
+            raise ValueError(f"unknown peer message {method}")
+
+        return handler
+
+    def _peer_channel(self, addr: tuple) -> RpcChannel:
+        with self._lock:
+            ch = self._peer_channels.get(addr)
+            if ch is not None and not ch.closed:
+                return ch
+        ch = connect(addr, name="peer",
+                     num_handler_threads=2)
+        with self._lock:
+            old = self._peer_channels.get(addr)
+            if old is not None and not old.closed:
+                ch.close()
+                return old
+            self._peer_channels[addr] = ch
+        return ch
+
+    def _pull_from_peers(self, oid: ObjectId, peers) -> Optional[bytes]:
+        """Try each holder's object server in turn; None = no peer could
+        serve it (caller falls back to the head relay)."""
+        for addr in peers:
+            try:
+                ch = self._peer_channel(tuple(addr))
+                size = ch.call("object_info", {"object_id": oid}, timeout=30)
+                if size is None:
+                    continue  # holder evicted it since the head looked
+                data = pull_chunks(
+                    lambda off, n, ch=ch: ch.call(
+                        "read_chunk",
+                        {"object_id": oid, "offset": off, "length": n},
+                        timeout=120),
+                    size)
+                if data is not None:
+                    return data
+            except Exception:
+                continue  # peer unreachable/dying: next copy or fallback
+        return None
+
     # ---- worker lifecycle ----------------------------------------------------
 
     def _start_worker(self, worker_id: WorkerId) -> None:
         env = dict(os.environ)
         env["PYTHONPATH"] = os.pathsep.join(p for p in sys.path if p)
+        env["RTPU_AUTHKEY"] = cluster_token().hex()  # env, never argv
         cmd = [
             sys.executable, "-S", "-m", "ray_tpu.core.worker_main",
             "--address", self._sock_path,
-            "--authkey", _AUTHKEY.hex(),
             "--worker-id", worker_id.hex(),
             "--node-id", self.node_id.hex(),
         ]
@@ -250,14 +331,31 @@ class NodeAgent:
             if kind == "inline":
                 out.append(res)
                 continue
-            # ("sized", total): pull chunks from the head into the local
-            # store, then serve the local segment zero-copy
-            data = pull_chunks(
-                lambda off, n: self.head.call(
-                    "head_read_chunk",
-                    {"object_id": oid, "offset": off, "length": n},
-                    timeout=120),
-                res[1])
+            data = None
+            if kind == "remote":
+                # the head answered with LOCATIONS: pull chunks directly
+                # from a holding agent (P2P); the head never touches the
+                # bytes (ref: object_manager.h:117)
+                data = self._pull_from_peers(oid, res[1])
+                if data is None:
+                    # every peer failed: ask the head to relay (it pulls
+                    # the object into its own store and serves chunks)
+                    res = self.head.call(
+                        "fetch_for_agent",
+                        {"object_id": oid, "timeout": timeout,
+                         "relay": True},
+                        timeout=None if timeout is None else timeout + 30)
+                    if res[0] == "inline":
+                        out.append(res)
+                        continue
+            if data is None:
+                # ("sized", total): pull chunks from the head's store
+                data = pull_chunks(
+                    lambda off, n: self.head.call(
+                        "head_read_chunk",
+                        {"object_id": oid, "offset": off, "length": n},
+                        timeout=120),
+                    res[1])
             if data is None:
                 raise RuntimeError(
                     f"object {oid.hex()[:12]} vanished mid-transfer")
@@ -280,6 +378,16 @@ class NodeAgent:
         with self._lock:
             procs = dict(self._procs)
             channels = dict(self._channels)
+            peer_channels = dict(self._peer_channels)
+        for ch in peer_channels.values():
+            try:
+                ch.close()
+            except Exception:
+                pass
+        try:
+            self._peer_server.close()
+        except Exception:
+            pass
         for ch in channels.values():
             try:
                 ch.notify("shutdown")
@@ -319,7 +427,18 @@ def main(argv=None) -> int:
     p.add_argument("--labels", default="{}")
     p.add_argument("--node-id", default="",
                    help="hex node id assigned by the launcher (optional)")
+    p.add_argument("--authkey", default="",
+                   help="cluster auth token (hex) from the head's join "
+                        "command; RTPU_AUTHKEY env is the alternative")
+    p.add_argument("--node-ip", default="",
+                   help="address other agents use to reach this node's "
+                        "object server (default: auto-detect the interface "
+                        "facing the head)")
     args = p.parse_args(argv)
+    if args.authkey:
+        os.environ["RTPU_AUTHKEY"] = args.authkey
+    if args.node_ip:
+        os.environ["RTPU_NODE_IP"] = args.node_ip
     host, _, port = args.address.rpartition(":")
     resources = {"CPU": args.num_cpus, **json.loads(args.resources)}
     agent = NodeAgent((host, int(port)), resources,

@@ -91,11 +91,16 @@ class RemoteNode(Node):
         self._starting_count = 0
         self.alive = True
         self.channel = channel
+        self.peer_addr = None  # agent's P2P object-server (host, port)
         self._server = None
         self._reader = SegmentReader()
         self._max_workers = max(int(config.num_workers_soft_limit),
                                 int(self.total_resources.get("CPU", 1)))
         channel.on_close(self._on_channel_close)
+        # same idle-worker reclamation as the in-process Node: remote
+        # workers are terminated over the channel when idle past the limit
+        threading.Thread(target=self._idle_reaper_loop, daemon=True,
+                         name="idle-reaper").start()
 
     # ---- worker lifecycle (forwarded) ---------------------------------------
 
@@ -176,20 +181,24 @@ class RemoteNode(Node):
 
     def pull_object_bytes(self, oid: ObjectId) -> Optional[bytes]:
         """Chunked pull of a remote object's serialized bytes
-        (ref: object_manager.h:117 PullManager; 5 MiB chunks)."""
-        try:
-            size = self.channel.call("object_info", {"object_id": oid},
-                                     timeout=30)
-            if size is None:
-                return None
-            return pull_chunks(
-                lambda off, n: self.channel.call(
-                    "read_chunk",
-                    {"object_id": oid, "offset": off, "length": n},
-                    timeout=60),
-                size)
-        except Exception:
+        (ref: object_manager.h:117 PullManager; 5 MiB chunks).
+
+        Returns None ONLY when the agent definitively reports the object
+        absent from its store (copy gone -> caller drops the directory
+        entry and lineage recovery can run). Transient RPC failures RAISE
+        so the caller retries instead of wrongly declaring the copy lost
+        — conflating the two made a get() on an evicted remote copy hang
+        forever (advisor r2)."""
+        size = self.channel.call("object_info", {"object_id": oid},
+                                 timeout=30)
+        if size is None:
             return None
+        return pull_chunks(
+            lambda off, n: self.channel.call(
+                "read_chunk",
+                {"object_id": oid, "offset": off, "length": n},
+                timeout=60),
+            size)
 
     # ---- lifecycle -----------------------------------------------------------
 

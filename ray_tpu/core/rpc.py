@@ -10,6 +10,7 @@ AF_UNIX within a host and AF_INET across hosts (DCN control plane).
 from __future__ import annotations
 
 import itertools
+import os
 import queue as queue_mod
 import threading
 import traceback
@@ -231,13 +232,44 @@ class _RemoteCallError(Exception):
         self.remote_tb = remote_tb
 
 
+_CLUSTER_TOKEN: Optional[bytes] = None
+
+
+def cluster_token() -> bytes:
+    """Per-cluster RPC auth token.
+
+    multiprocessing.connection unpickles peer payloads, so a guessable
+    authkey means anyone who can reach the head port gets code execution
+    on every node (the reference's cross-host plane is gRPC/protobuf and
+    has no such amplification). The token is generated fresh per head
+    process, inherited by worker/agent subprocesses through the
+    RTPU_AUTHKEY env var, and handed to remote machines via the join
+    command `ray_tpu start --head` prints. The port must still only be
+    exposed on a trusted network — the token authenticates, it does not
+    encrypt."""
+    global _CLUSTER_TOKEN
+    if _CLUSTER_TOKEN is None:
+        env = os.environ.get("RTPU_AUTHKEY", "")
+        if env:
+            _CLUSTER_TOKEN = bytes.fromhex(env)
+        else:
+            import secrets
+
+            _CLUSTER_TOKEN = secrets.token_bytes(32)
+            # exported so child processes (workers, agents started from
+            # this process) authenticate without the key appearing in argv
+            os.environ["RTPU_AUTHKEY"] = _CLUSTER_TOKEN.hex()
+    return _CLUSTER_TOKEN
+
+
 class RpcServer:
     """Accepts channel connections on a Unix or TCP socket."""
 
     def __init__(self, address, handler_factory: Callable[[RpcChannel], Callable],
-                 family: Optional[str] = None, authkey: bytes = b"ray_tpu",
+                 family: Optional[str] = None, authkey: Optional[bytes] = None,
                  num_handler_threads: int = 16):
-        self._listener = Listener(address, family=family, authkey=authkey)
+        self._listener = Listener(address, family=family,
+                                  authkey=authkey or cluster_token())
         self._handler_factory = handler_factory
         self._num_handler_threads = num_handler_threads
         self._channels = []
@@ -286,9 +318,9 @@ class RpcServer:
             ch.close()
 
 
-def connect(address, authkey: bytes = b"ray_tpu",
+def connect(address, authkey: Optional[bytes] = None,
             handler: Optional[Callable[[str, Any], Any]] = None,
             name: str = "", num_handler_threads: int = 4) -> RpcChannel:
-    conn = Client(address, authkey=authkey)
+    conn = Client(address, authkey=authkey or cluster_token())
     return RpcChannel(conn, handler=handler, name=name,
                       num_handler_threads=num_handler_threads)

@@ -210,6 +210,7 @@ class DriverRuntime:
                 node = RemoteNode(self, payload["node_id"],
                                   payload["resources"], self.config, channel,
                                   labels=payload.get("labels"))
+                node.peer_addr = payload.get("object_server_addr")
                 state["node"] = node
                 with self._lock:
                     self.nodes[node.node_id] = node
@@ -252,11 +253,10 @@ class DriverRuntime:
                         payload["object_id"], set()).add(node.node_id)
                 return None
             if method == "fetch_for_agent":
-                res = self.fetch_one(payload["object_id"],
-                                     payload.get("timeout"))
-                if res[0] == "inline":
-                    return res
-                return ("sized", res[2])  # agent pulls via head_read_chunk
+                return self._fetch_for_agent(node, payload["object_id"],
+                                             payload.get("timeout"),
+                                             relay=payload.get("relay",
+                                                               False))
             if method == "head_read_chunk":
                 return self._read_local_chunk(payload["object_id"],
                                               payload["offset"],
@@ -274,6 +274,49 @@ class DriverRuntime:
             raise ValueError(f"unknown agent message {method}")
 
         return handler
+
+    def _fetch_for_agent(self, node, oid: ObjectId,
+                         timeout: Optional[float], relay: bool = False):
+        """Answer an agent's fetch: ("inline", bytes) for small objects,
+        ("remote", [peer_addrs]) when other agents hold the only copies —
+        the requester pulls chunks from them DIRECTLY (P2P, the head never
+        touches the bytes; ref: object_manager.h:117) — or ("sized", n)
+        when the head's own store has (or, with relay=True, pulls) a copy
+        to serve via head_read_chunk."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not relay:
+            ev = self._event(oid)
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            if not ev.wait(remaining):
+                raise exc.GetTimeoutError(
+                    f"Get timed out waiting for object {oid.hex()[:12]}")
+            with self._lock:
+                data = self._memory_store.get(oid)
+                copies = list(self._directory.get(oid, ()))
+            if data is not None:
+                return ("inline", data)
+            peers = []
+            head_local = False
+            for nid in copies:
+                n = self.nodes.get(nid)
+                if n is None or not n.alive:
+                    continue
+                if not getattr(n, "is_remote", False):
+                    head_local = True
+                elif nid != node.node_id and getattr(n, "peer_addr", None):
+                    peers.append(tuple(n.peer_addr))
+            if head_local:
+                break  # serve from the head's own store below
+            if peers:
+                return ("remote", peers)
+            break  # copies lost or only on the requester: relay path
+        res = self.fetch_one(oid, (None if deadline is None
+                                   else max(0.0,
+                                            deadline - time.monotonic())))
+        if res[0] == "inline":
+            return res
+        return ("sized", res[2])  # agent pulls via head_read_chunk
 
     def _read_local_chunk(self, oid: ObjectId, offset: int, length: int):
         """Serve a chunk of a locally-stored object (transfer source side)."""
@@ -492,12 +535,20 @@ class DriverRuntime:
                         # Concurrent getters share one transfer via the
                         # in-flight pull table (ref: object_manager.h:117
                         # PullManager dedup).
-                        res = self._pull_once(oid, node)
+                        try:
+                            res = self._pull_once(oid, node)
+                        except Exception:
+                            # transient RPC failure: the copy may still
+                            # exist — retry while the channel stays open
+                            if not node.channel.closed:
+                                transient_failure = True
+                                continue
+                            res = None
                         if res is not None:
                             return res
-                        transient_failure = not node.channel.closed
-                        if transient_failure:
-                            continue
+                        # res None = the agent definitively reported the
+                        # object gone: fall through to drop the directory
+                        # entry so lineage recovery can run
                     else:
                         try:
                             seg = node.store.get_segment(oid)
@@ -531,10 +582,9 @@ class DriverRuntime:
             if owner:
                 fut = self._pull_futures[oid] = Future()
         if not owner:
-            try:
-                return fut.result(timeout=300)
-            except Exception:
-                return None
+            # propagate the owner's outcome: None = definitively absent,
+            # exception = transient failure (caller retries)
+            return fut.result(timeout=300)
         try:
             data = node.pull_object_bytes(oid)
             res = None if data is None else self._promote_pulled(oid, data)

@@ -372,7 +372,6 @@ def _make_cancelled_error(spec: TaskSpec):
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--address", required=True)
-    parser.add_argument("--authkey", required=True)
     parser.add_argument("--worker-id", required=True)
     parser.add_argument("--node-id", required=True)
     args = parser.parse_args()
@@ -386,8 +385,9 @@ def main() -> None:
 
     worker_id = WorkerId.from_hex(args.worker_id)
     try:
-        channel = connect(args.address, authkey=bytes.fromhex(args.authkey),
-                          name=f"worker-{args.worker_id[:8]}")
+        # auth token arrives via RTPU_AUTHKEY in the environment (connect's
+        # default cluster_token() reads it), never on the command line
+        channel = connect(args.address, name=f"worker-{args.worker_id[:8]}")
     except OSError:
         return  # node shut down while we were starting; exit quietly
     wp = WorkerProcess(channel, worker_id, args.node_id)

@@ -10,6 +10,11 @@ class DataContext:
     # backpressure: max blocks in flight per streaming stage
     # (ref: streaming_executor_state.py resource limits)
     max_in_flight_blocks: int = 16
+    # emit blocks in plan order rather than completion order (ref:
+    # execution_options.preserve_order — the reference defaults False for
+    # throughput; here determinism wins by default; buffered out-of-order
+    # refs count against max_in_flight_blocks so the stream stays bounded)
+    preserve_order: bool = True
     default_parallelism: int = 8
     target_min_rows_per_block: int = 1000
 

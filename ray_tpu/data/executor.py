@@ -10,8 +10,9 @@ Design here: the logical plan is fused into *segments* — a source (read
 tasks or materialized block refs) followed by a chain of block→block
 transforms — separated by all-to-all barriers (repartition / shuffle).
 Each segment streams: inputs are submitted as remote tasks with a bounded
-in-flight window (backpressure), outputs yield in completion order and
-flow into the next segment without a barrier.
+in-flight window (backpressure); outputs yield in plan order by default
+(DataContext.preserve_order) or completion order, and flow into the next
+segment without a barrier.
 """
 from __future__ import annotations
 
@@ -122,13 +123,22 @@ class StreamingExecutor:
 
     def _stream_tasks(self, inputs: Iterator[Any], chain_blob: bytes,
                       reads: bool) -> Iterator[Any]:
-        """Submit one task per input with a bounded in-flight window."""
+        """Submit one task per input with a bounded in-flight window.
+        With ctx.preserve_order (default), blocks emit in PLAN order —
+        completed-out-of-order refs buffer until their turn."""
         cap = max(1, int(self.ctx.max_in_flight_blocks))
-        in_flight: dict = {}
+        ordered = bool(self.ctx.preserve_order)
+        in_flight: dict = {}   # ref -> submission index
+        ready: dict = {}       # submission index -> ref (ordered mode)
+        submitted = 0
+        next_emit = 0
         inputs = iter(inputs)
         exhausted = False
         while True:
-            while not exhausted and len(in_flight) < cap:
+            # buffered-but-unemitted refs count against the window: one
+            # stalled head-of-line block must throttle submission, not let
+            # the whole dataset materialize behind it
+            while not exhausted and len(in_flight) + len(ready) < cap:
                 try:
                     item = next(inputs)
                 except StopIteration:
@@ -138,18 +148,27 @@ class StreamingExecutor:
                     ref = self._read_remote.remote(item, chain_blob)
                 else:
                     ref = self._apply_remote.remote(chain_blob, item)
-                in_flight[ref] = True
+                in_flight[ref] = submitted
+                submitted += 1
                 self.stats.on_submit(len(in_flight))
             if not in_flight:
                 if exhausted:
+                    for idx in sorted(ready):
+                        yield ready.pop(idx)
                     return
                 continue
             done, _ = ray_tpu.wait(list(in_flight), num_returns=1,
                                    timeout=None, fetch_local=False)
             for ref in done:
-                in_flight.pop(ref, None)
+                idx = in_flight.pop(ref)
                 self.stats.blocks_produced += 1
-                yield ref
+                if not ordered:
+                    yield ref
+                    continue
+                ready[idx] = ref
+                while next_emit in ready:
+                    yield ready.pop(next_emit)
+                    next_emit += 1
 
     def _stream_actor_pool(self, inputs: Iterator[Any], chain_blob: bytes,
                            pool_size: int,
@@ -167,34 +186,49 @@ class StreamingExecutor:
             ray_tpu.get([a.ping.remote() for a in actors], timeout=60)
             per_actor_cap = max(
                 1, int(self.ctx.max_in_flight_blocks) // pool_size) + 1
-            in_flight: dict = {}
+            ordered = bool(self.ctx.preserve_order)
+            in_flight: dict = {}   # ref -> (actor index, submission index)
+            ready: dict = {}
+            submitted = 0
+            next_emit = 0
             load = {i: 0 for i in range(pool_size)}
             inputs = iter(inputs)
             exhausted = False
             while True:
                 while not exhausted:
                     i = min(load, key=lambda k: load[k])
-                    if load[i] >= per_actor_cap:
-                        break
+                    if load[i] >= per_actor_cap or len(ready) >= len(actors) \
+                            * per_actor_cap:
+                        break  # window full (incl. head-of-line buffer)
                     try:
                         item = next(inputs)
                     except StopIteration:
                         exhausted = True
                         break
                     ref = actors[i].apply.remote(item)
-                    in_flight[ref] = i
+                    in_flight[ref] = (i, submitted)
+                    submitted += 1
                     load[i] += 1
                     self.stats.on_submit(len(in_flight))
                 if not in_flight:
                     if exhausted:
+                        for idx in sorted(ready):
+                            yield ready.pop(idx)
                         return
                     continue
                 done, _ = ray_tpu.wait(list(in_flight), num_returns=1,
                                        timeout=None, fetch_local=False)
                 for ref in done:
-                    load[in_flight.pop(ref)] -= 1
+                    i, idx = in_flight.pop(ref)
+                    load[i] -= 1
                     self.stats.blocks_produced += 1
-                    yield ref
+                    if not ordered:
+                        yield ref
+                        continue
+                    ready[idx] = ref
+                    while next_emit in ready:
+                        yield ready.pop(next_emit)
+                        next_emit += 1
         finally:
             for a in actors:
                 try:

@@ -167,11 +167,23 @@ class GPT:
 
     # ---- forward -----------------------------------------------------------
 
+    def _dropout(self, x: jax.Array, key: jax.Array) -> jax.Array:
+        rate = self.config.dropout
+        keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+        return jnp.where(keep, x / jnp.asarray(1.0 - rate, x.dtype),
+                         jnp.zeros_like(x))
+
     def _block(self, x: jax.Array, lp: Dict[str, jax.Array],
                rng: Optional[jax.Array]) -> jax.Array:
         c = self.config
         B, S, D = x.shape
         H, hd = c.n_head, c.head_dim
+        # per-layer dropout key rides in the (stacked) layer params so one
+        # scanned block body serves every layer
+        key = lp.get("_dropout_key")
+        drop = c.dropout > 0.0 and key is not None
+        if drop:
+            k_attn, k_mlp = jax.random.split(key)
         h = layernorm(x, lp["ln1_g"], lp["ln1_b"])
         qkv = (h @ lp["w_qkv"].astype(c.dtype)) + lp["b_qkv"].astype(c.dtype)
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -189,10 +201,16 @@ class GPT:
 
             attn = mha_reference(q, k, v, causal=True)
         attn = attn.reshape(B, S, D)
-        x = x + (attn @ lp["w_proj"].astype(c.dtype)) + lp["b_proj"].astype(c.dtype)
+        proj = (attn @ lp["w_proj"].astype(c.dtype)) + lp["b_proj"].astype(c.dtype)
+        if drop:
+            proj = self._dropout(proj, k_attn)
+        x = x + proj
         h = layernorm(x, lp["ln2_g"], lp["ln2_b"])
         h = gelu((h @ lp["w_fc"].astype(c.dtype)) + lp["b_fc"].astype(c.dtype))
-        x = x + (h @ lp["w_out"].astype(c.dtype)) + lp["b_out"].astype(c.dtype)
+        out = (h @ lp["w_out"].astype(c.dtype)) + lp["b_out"].astype(c.dtype)
+        if drop:
+            out = self._dropout(out, k_mlp)
+        x = x + out
         return x
 
     @staticmethod
@@ -276,6 +294,15 @@ class GPT:
             + params["wpe"].astype(c.dtype)[positions]
         layer_params = {k: v for k, v in params.items()
                         if k not in ("wte", "wpe", "lnf_g", "lnf_b")}
+        if c.dropout > 0.0 and rng is not None:
+            # GPT-2 drops embeddings + each residual-branch output; the
+            # per-layer keys stack onto the layer params so the scanned
+            # body stays a single compiled block
+            emb_key, layers_key = jax.random.split(rng)
+            x = self._dropout(x, emb_key)
+            layer_params["_dropout_key"] = jax.random.split(
+                layers_key, c.n_layer)
+        rng = None  # keys travel inside layer_params from here
 
         if c.scan_layers:
             def block_fn(x, lp):

@@ -37,18 +37,18 @@ def _use_interpret() -> bool:
 
 
 def _fit_block(block: int, seq: int) -> int:
-    """Largest size <= block that divides seq (stays a multiple of 128
-    when possible so tiles keep MXU-friendly shapes)."""
+    """Largest multiple of 128 that is <= block and divides seq. The
+    kernel path requires seq % 128 == 0 (flash_attention routes anything
+    else to mha_reference), so a 128-multiple divisor always exists —
+    sub-128 blocks would lower to illegal / silently padded Mosaic tiles
+    on real TPU."""
     block = min(block, seq)
     if seq % block == 0:
         return block
     for b in range(block - block % 128, 127, -128):
         if seq % b == 0:
             return b
-    for b in range(min(block, seq), 0, -1):
-        if seq % b == 0:
-            return b
-    return seq
+    return 128
 
 
 # ---------------------------------------------------------------------------
@@ -566,7 +566,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             f"causal flash_attention requires seq_q == seq_k, got "
             f"{q.shape[1]} != {k.shape[1]}; use mha_reference for "
             "offset-causal decode")
-    if q.shape[1] < 8:  # tiny decode steps: kernel launch not worth it
+    if q.shape[1] % 128 != 0 or k.shape[1] % 128 != 0:
+        # Mosaic's minimum tile is (8, 128): sub-128 sequence blocks lower
+        # to illegal or silently padded tiles on real TPU. Pads are the
+        # caller's job; unpadded odd shapes go to the XLA reference.
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
     b, s, h, d = q.shape
     merge = lambda x: x.reshape(x.shape[0], x.shape[1], h * d)  # noqa: E731

@@ -23,6 +23,7 @@ import cloudpickle
 
 import ray_tpu
 
+from .batching import batch  # noqa: F401 — serve.batch decorator
 from .config import AutoscalingConfig, DeploymentConfig
 from .controller import CONTROLLER_NAME, get_or_create_controller
 from .handle import DeploymentHandle

@@ -1,0 +1,134 @@
+"""@serve.batch — dynamic request batching.
+
+Equivalent of the reference's serve.batching (ref:
+python/ray/serve/batching.py _BatchQueue: requests accumulate until
+max_batch_size or batch_wait_timeout_s, then one call runs the whole
+batch). On TPU this is the single most valuable Serve feature: a
+pjit-compiled model step costs the same for 1 or 32 rows, so batching
+multiplies throughput by the batch size.
+
+The reference's implementation is asyncio-native; replicas here execute
+requests on an actor thread pool (max_concurrency > 1), so this is the
+threaded equivalent: callers block on a per-item Future while a flusher
+thread drains the queue. Batching therefore requires
+max_concurrent_queries > 1 on the deployment — same constraint as the
+reference (no concurrency, nothing to batch).
+
+    @serve.deployment(max_concurrent_queries=64)
+    class Model:
+        @serve.batch(max_batch_size=32, batch_wait_timeout_s=0.01)
+        def __call__(self, inputs: list) -> list:
+            return model_step(np.stack(inputs)).tolist()
+"""
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable[[List[Any]], List[Any]],
+                 max_batch_size: int, batch_wait_timeout_s: float):
+        self._fn = fn
+        self._max = max(1, int(max_batch_size))
+        self._timeout = float(batch_wait_timeout_s)
+        self._lock = threading.Lock()
+        self._items: List[tuple] = []  # (arg, Future)
+        self._wake = threading.Condition(self._lock)
+        self._thread: Optional[threading.Thread] = None
+
+    def submit(self, arg: Any) -> Future:
+        fut: Future = Future()
+        with self._lock:
+            # lazy flusher start: a queue that loses a creation race is
+            # never submitted to and must not leak a parked thread
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._flusher, daemon=True, name="serve-batch")
+                self._thread.start()
+            self._items.append((arg, fut))
+            self._wake.notify()
+        return fut
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def _flusher(self) -> None:
+        while True:
+            with self._lock:
+                while not self._items:
+                    self._wake.wait()
+                # first item arrived: linger up to the timeout for more
+                deadline = time.monotonic() + self._timeout
+                while (len(self._items) < self._max
+                       and time.monotonic() < deadline):
+                    self._wake.wait(timeout=max(
+                        0.0, deadline - time.monotonic()))
+                batch, self._items = (self._items[:self._max],
+                                      self._items[self._max:])
+            args = [a for a, _ in batch]
+            futs = [f for _, f in batch]
+            try:
+                results = self._fn(args)
+                if results is None or len(results) != len(args):
+                    raise ValueError(
+                        f"@serve.batch function returned "
+                        f"{0 if results is None else len(results)} results "
+                        f"for a batch of {len(args)}")
+            except BaseException as e:  # noqa: BLE001 — ship to every caller
+                for f in futs:
+                    f.set_exception(e)
+                continue
+            for f, r in zip(futs, results):
+                f.set_result(r)
+
+
+def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator: the wrapped function receives a LIST of the individual
+    call arguments and must return a same-length list of results. Each
+    caller passes one item and gets its own result back.
+    (ref: python/ray/serve/batching.py serve.batch)
+
+    NOTE: this closure must stay free of locks/threads — deployment
+    classes travel through cloudpickle, which serializes these inner
+    functions by value. Queue state is created lazily AFTER unpickling
+    and attached to the replica instance; the GIL-atomic
+    __dict__.setdefault resolves creation races."""
+
+    def deco(fn: Callable) -> Callable:
+        qattr = f"__rtpu_batch_queue_{fn.__name__}"
+
+        def queue_for(instance, wrapper) -> _BatchQueue:
+            holder = instance if instance is not None else wrapper
+            q = holder.__dict__.get(qattr)
+            if q is None:
+                target = (functools.partial(fn, instance)
+                          if instance is not None else fn)
+                q = holder.__dict__.setdefault(
+                    qattr, _BatchQueue(target, max_batch_size,
+                                       batch_wait_timeout_s))
+            return q
+
+        @functools.wraps(fn)
+        def wrapper(*args):
+            if len(args) == 2:        # bound method: (self, item)
+                instance, item = args
+            elif len(args) == 1:      # free function: (item,)
+                instance, item = None, args[0]
+            else:
+                raise TypeError(
+                    "@serve.batch functions take exactly one request "
+                    "argument (plus self for methods)")
+            return queue_for(instance, wrapper).submit(item).result()
+
+        wrapper._rtpu_serve_batch = True  # noqa: SLF001 — introspection tag
+        return wrapper
+
+    if _fn is not None:
+        return deco(_fn)
+    return deco

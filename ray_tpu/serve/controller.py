@@ -215,7 +215,8 @@ class ServeController:
             replicas = list(st.replicas)
         if not replicas:
             return
-        probes = [(r, r.handle.ping.remote()) for r in replicas]
+        probes = [(r, r.handle.ping.options(
+            concurrency_group="control").remote()) for r in replicas]
         deadline = time.monotonic() + st.config.health_check_timeout_s
         for r, ref in probes:
             timeout = max(0.1, deadline - time.monotonic())
@@ -264,6 +265,18 @@ class ServeController:
         tag = f"{st.name}#{uuid.uuid4().hex[:6]}"
         opts = dict(st.config.ray_actor_options)
         opts.setdefault("num_cpus", 1.0)
+        # real request parallelism must match the router's admission cap —
+        # and batching only happens when requests overlap. The "control"
+        # lane keeps health pings and queue-depth probes off the request
+        # threads, so a saturated replica still answers its router
+        # (ref: replica.py max_concurrent_queries + concurrency groups)
+        opts.setdefault("max_concurrency",
+                        int(st.config.max_concurrent_queries))
+        # MERGE (not setdefault): user-supplied groups must not evict the
+        # control lane, or every health ping / depth probe errors out
+        cg = dict(opts.get("concurrency_groups") or {})
+        cg.setdefault("control", 2)
+        opts["concurrency_groups"] = cg
         try:
             cls = ray_tpu.remote(Replica)
             handle = cls.options(**opts).remote(

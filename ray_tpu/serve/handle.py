@@ -52,6 +52,7 @@ class DeploymentHandle:
         self._max_q = 8
         self._refreshed = 0.0
         self._inflight: Dict[Any, int] = {}  # replica actor_id -> count
+        self._depth_cache: Dict[Any, tuple] = {}  # actor_id -> (ts, depth)
         self._lock = threading.Lock()
         self._router: Optional[ThreadPoolExecutor] = None
 
@@ -87,6 +88,49 @@ class DeploymentHandle:
 
     # -- power-of-two-choices -------------------------------------------------
 
+    _PROBE_TTL = 0.05  # seconds a probed depth stays fresh
+
+    def _probe_depths(self, replicas) -> list:
+        """REPLICA-REPORTED queue depths (ref: router.py:411 choose_two —
+        the reference probes candidates rather than trusting router-local
+        counts, which are wrong by construction once several handles or
+        proxies route to the same replicas). Probes run through the
+        replicas' control lane CONCURRENTLY, with a short-TTL cache so
+        request bursts don't pay a round trip each; probe failure falls
+        back to the handle-local in-flight count."""
+        now = time.monotonic()
+        out: list = [None] * len(replicas)
+        pending = []
+        with self._lock:
+            for i, r in enumerate(replicas):
+                hit = self._depth_cache.get(r._actor_id)
+                if hit is not None and now - hit[0] < self._PROBE_TTL:
+                    out[i] = hit[1]
+                else:
+                    pending.append(i)
+        refs = []
+        for i in pending:
+            try:
+                refs.append((i, replicas[i].queue_len.options(
+                    concurrency_group="control").remote()))
+            except Exception:
+                refs.append((i, None))
+        for i, ref in refs:
+            depth = None
+            if ref is not None:
+                try:
+                    depth = int(ray_tpu.get(ref, timeout=1.0))
+                except Exception:
+                    depth = None
+            with self._lock:
+                if depth is None:
+                    depth = self._inflight.get(replicas[i]._actor_id, 0)
+                else:
+                    self._depth_cache[replicas[i]._actor_id] = (
+                        time.monotonic(), depth)
+            out[i] = depth
+        return out
+
     def _pick(self):
         """-> replica handle, or None when all replicas are saturated or
         unknown (caller backs off / refreshes)."""
@@ -95,16 +139,19 @@ class DeploymentHandle:
             if n == 0:
                 return None
             if n == 1:
-                cand = self._replicas[0]
+                cands = [self._replicas[0]]
             else:
                 a, b = random.sample(range(n), 2)
-                ca = self._inflight.get(self._replicas[a]._actor_id, 0)
-                cb = self._inflight.get(self._replicas[b]._actor_id, 0)
-                cand = self._replicas[a] if ca <= cb else self._replicas[b]
-            if self._inflight.get(cand._actor_id, 0) >= self._max_q:
+                cands = [self._replicas[a], self._replicas[b]]
+        depths = self._probe_depths(cands)
+        j = min(range(len(cands)), key=lambda i: depths[i])
+        cand, depth = cands[j], depths[j]
+        with self._lock:
+            local = self._inflight.get(cand._actor_id, 0)
+            if max(depth, local) >= self._max_q:
                 return None
             aid = cand._actor_id
-            self._inflight[aid] = self._inflight.get(aid, 0) + 1
+            self._inflight[aid] = local + 1
             return cand
 
     # -- the router worker ----------------------------------------------------

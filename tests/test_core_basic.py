@@ -171,3 +171,35 @@ def test_nested_wait_releases_lease():
         assert ray_tpu.get(parent.remote(), timeout=60) == 7
     finally:
         ray_tpu.shutdown()
+
+
+def test_idle_workers_reclaimed():
+    """Idle workers beyond worker_idle_timeout_s are terminated down to
+    the prestart floor (ref: worker_pool.cc idle killing; r2 weak #8)."""
+    import os
+    import time
+
+    import ray_tpu
+
+    os.environ["RTPU_WORKER_IDLE_TIMEOUT_S"] = "1.0"
+    try:
+        ray_tpu.init(num_cpus=4)
+
+        @ray_tpu.remote
+        def f(x):
+            return x
+
+        assert ray_tpu.get([f.remote(i) for i in range(8)]) == list(range(8))
+        from ray_tpu.core import runtime as runtime_mod
+
+        rt = runtime_mod.maybe_runtime()
+        node = rt.nodes[rt.head_node_id]
+        assert node.num_workers() >= 1
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and node.num_workers() > 0:
+            time.sleep(0.25)
+        assert node.num_workers() == 0, \
+            f"{node.num_workers()} idle workers still alive"
+    finally:
+        os.environ.pop("RTPU_WORKER_IDLE_TIMEOUT_S", None)
+        ray_tpu.shutdown()

@@ -168,3 +168,27 @@ class TestMLP:
         params = model.init(jax.random.PRNGKey(0))
         y = model.apply(params, jnp.ones((3, 8)))
         assert y.shape == (3, 4)
+
+
+def test_gpt_dropout_applied():
+    """dropout>0 + rng must change the output vs no-rng (it was silently
+    ignored until r3) and stay deterministic for a fixed key."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import GPT, GPTConfig
+
+    cfg = GPTConfig.tiny(dtype=jnp.float32, use_flash=False, dropout=0.5)
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg.vocab_size)
+    eval_logits = model.apply(params, tokens)
+    k = jax.random.PRNGKey(2)
+    train_logits = model.apply(params, tokens, rng=k)
+    train_logits2 = model.apply(params, tokens, rng=k)
+    assert not jnp.allclose(eval_logits, train_logits)
+    assert jnp.allclose(train_logits, train_logits2)
+    # different key -> different mask
+    other = model.apply(params, tokens, rng=jax.random.PRNGKey(3))
+    assert not jnp.allclose(train_logits, other)

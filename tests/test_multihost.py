@@ -193,3 +193,34 @@ def test_mesh_group_across_processes(cluster):
         assert all(r[2] == out[0][3] * 2 for r in out)
     finally:
         group.shutdown()
+
+
+def test_p2p_transfer_bypasses_head(cluster):
+    """remote A -> remote B object movement goes agent-to-agent: the head
+    answers with LOCATIONS and never stores the bytes
+    (ref: object_manager.h:117 — P2P chunk transfer; r2 VERDICT missing #3)."""
+    a = cluster.add_remote_node(num_cpus=1.0)
+    b = cluster.add_remote_node(num_cpus=1.0)
+
+    @ray_tpu.remote
+    def big():
+        return np.arange(2_000_000, dtype=np.int64)  # 16 MB: chunked path
+
+    @ray_tpu.remote
+    def total(x):
+        return int(x.sum())
+
+    expect = int(np.arange(2_000_000, dtype=np.int64).sum())
+    r = big.options(scheduling_strategy=_pin(a)).remote()
+    got = ray_tpu.get(
+        total.options(scheduling_strategy=_pin(b)).remote(r), timeout=90)
+    assert got == expect
+
+    rt = cluster.runtime
+    oid = r.id
+    # directory: copies on A and B only — never promoted into the head
+    copies = set(rt._directory.get(oid, ()))
+    assert a.node_id in copies and b.node_id in copies
+    head_node = rt.nodes[rt.head_node_id]
+    assert not head_node.store.contains(oid), \
+        "P2P transfer must not create a head-store copy"

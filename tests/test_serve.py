@@ -186,3 +186,60 @@ def test_mesh_deployment_sharded_inference(cluster):
     tokens = [[1, 2, 3, 4]]
     out = ray_tpu.get(h.remote(tokens), timeout=120)
     assert np.asarray(out).shape == (1, 4)
+
+
+def test_serve_batch_throughput(cluster):
+    """@serve.batch: one fixed-cost model step serves a whole batch.
+    Done-bar from r2 VERDICT #6: batched >= 5x unbatched throughput when
+    the model is a serialized fixed-cost step (ref: serve/batching.py)."""
+    import threading
+
+    STEP = 0.02  # simulated compiled-model step cost per LAUNCH
+    N = 64
+
+    @serve.deployment(max_concurrent_queries=N)
+    class Batched:
+        @serve.batch(max_batch_size=32, batch_wait_timeout_s=0.005)
+        def __call__(self, items):
+            time.sleep(STEP)
+            return [x * 2 for x in items]
+
+    @serve.deployment(max_concurrent_queries=N)
+    class Unbatched:
+        def __init__(self):
+            self._device = threading.Lock()  # one model, one device
+
+        def __call__(self, x):
+            with self._device:
+                time.sleep(STEP)
+            return x * 2
+
+    hb = serve.run(Batched.bind())
+    t0 = time.monotonic()
+    futs = [hb.remote(i) for i in range(N)]
+    assert [f.result(timeout=60) for f in futs] == [2 * i for i in range(N)]
+    batched_s = time.monotonic() - t0
+    serve.delete("Batched")
+
+    hu = serve.run(Unbatched.bind())
+    t0 = time.monotonic()
+    futs = [hu.remote(i) for i in range(N)]
+    assert [f.result(timeout=60) for f in futs] == [2 * i for i in range(N)]
+    unbatched_s = time.monotonic() - t0
+    serve.delete("Unbatched")
+
+    assert unbatched_s / batched_s >= 5.0, \
+        f"batched={batched_s:.2f}s unbatched={unbatched_s:.2f}s"
+
+
+def test_serve_batch_error_propagates(cluster):
+    @serve.deployment(max_concurrent_queries=8)
+    class Bad:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.005)
+        def __call__(self, items):
+            raise RuntimeError("batch exploded")
+
+    h = serve.run(Bad.bind())
+    fut = h.remote(1)
+    with pytest.raises(Exception, match="batch exploded"):
+        fut.result(timeout=30)
