@@ -1,0 +1,18 @@
+"""ray_tpu.autoscaler — demand-driven cluster scaling.
+
+Equivalent of the reference's autoscaler v1 (ref:
+python/ray/autoscaler/_private/autoscaler.py:166 StandardAutoscaler,
+update loop :368, driven by monitor.py:126; testable fake provider:
+autoscaler/_private/fake_multi_node/node_provider.py). The TPU-native
+unit of scaling is a SLICE (a whole node_agent joining with its chips),
+not a VM: providers launch/terminate agents, the reconcile loop reads
+demand straight off the head's single-controller state — parked tasks,
+queued leases, and pending placement groups.
+"""
+from .autoscaler import AutoscalerConfig, StandardAutoscaler
+from .provider import FakeSliceProvider, NodeProvider, TPUSliceProvider
+
+__all__ = [
+    "AutoscalerConfig", "FakeSliceProvider", "NodeProvider",
+    "StandardAutoscaler", "TPUSliceProvider",
+]

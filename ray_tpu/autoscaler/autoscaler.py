@@ -1,0 +1,174 @@
+"""StandardAutoscaler — the reconcile loop.
+
+ref: python/ray/autoscaler/_private/autoscaler.py:166 StandardAutoscaler
+(update :368: read load -> bin-pack unmet demand -> launch; terminate
+idle), resource_demand_scheduler.py for the packing. Single-controller
+reduction: demand is read directly off the head runtime — parked task
+specs, per-node lease queues, pending placement groups — no gossip hop.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ..core.ids import NodeId
+from ..core.resources import ResourceSet, normalize, res_ge, res_sub
+from .provider import NodeProvider
+
+
+@dataclass
+class AutoscalerConfig:
+    min_workers: int = 0
+    max_workers: int = 4
+    idle_timeout_s: float = 30.0
+    update_interval_s: float = 1.0
+    # launch at most this many nodes per update pass (ref: upscaling_speed)
+    max_launch_batch: int = 2
+
+
+class StandardAutoscaler:
+    def __init__(self, runtime, provider: NodeProvider,
+                 config: Optional[AutoscalerConfig] = None):
+        self.runtime = runtime
+        self.provider = provider
+        self.config = config or AutoscalerConfig()
+        self._last_busy: Dict[NodeId, float] = {}
+        self._requested: ResourceSet = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> "StandardAutoscaler":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="autoscaler")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.update()
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+            self._stop.wait(self.config.update_interval_s)
+
+    # -- explicit demand (ref: ray.autoscaler.sdk.request_resources) ----------
+
+    def request_resources(self, bundles: List[ResourceSet]) -> None:
+        """Pin a demand floor independent of queued work."""
+        total: ResourceSet = {}
+        for b in bundles:
+            for k, v in normalize(b).items():
+                total[k] = total.get(k, 0.0) + v
+        with self._lock:
+            self._requested = total
+
+    # -- demand / supply -------------------------------------------------------
+
+    def _pending_demands(self) -> List[ResourceSet]:
+        """One entry per schedulable unit that cannot run right now."""
+        rt = self.runtime
+        demands: List[ResourceSet] = []
+        with rt._lock:
+            parked = list(rt._parked)
+        for spec in parked:
+            demands.append(normalize(spec.resources))
+        for node in list(rt.nodes.values()):
+            if not node.alive:
+                continue
+            with node._lock:
+                for req in list(node._lease_queue):
+                    demands.append(dict(req.demand))
+        for pg in rt.gcs.list_pgs():
+            if pg.state == "PENDING":
+                demands.extend(normalize(b) for b in pg.bundles)
+        with self._lock:
+            if self._requested:
+                demands.append(dict(self._requested))
+        return [d for d in demands if d]
+
+    def _unmet_after_packing(self, demands: List[ResourceSet]) -> int:
+        """Greedy first-fit of demands onto current availability; returns
+        how many demands no node can absorb (ref:
+        resource_demand_scheduler.py bin packing)."""
+        rt = self.runtime
+        avail = []
+        for node in rt.nodes.values():
+            if node.alive:
+                with node._lock:
+                    avail.append(dict(node.available))
+        unmet = 0
+        for d in demands:
+            for a in avail:
+                if res_ge(a, d):
+                    a.update(res_sub(a, d))
+                    break
+            else:
+                unmet += 1
+        return unmet
+
+    # -- one reconcile pass ----------------------------------------------------
+
+    def update(self) -> dict:
+        cfg = self.config
+        provider_nodes = set(self.provider.non_terminated_nodes())
+        demands = self._pending_demands()
+        unmet = self._unmet_after_packing(demands)
+
+        launched = 0
+        per_node = self.provider.node_resources()
+        while (unmet > 0 and launched < cfg.max_launch_batch
+               and len(provider_nodes) + launched < cfg.max_workers):
+            # each new node absorbs however many unmet demands fit on it
+            cap = dict(per_node)
+            absorbed = 0
+            for d in demands:
+                if res_ge(cap, d):
+                    cap.update(res_sub(cap, d))
+                    absorbed += 1
+            if absorbed == 0:
+                break  # demand shaped wrong for this node type: stop
+            self.provider.create_node()
+            launched += 1
+            unmet = max(0, unmet - absorbed)
+        while len(provider_nodes) + launched < cfg.min_workers:
+            self.provider.create_node()
+            launched += 1
+
+        # idle reclamation: a provider node with no lease activity and no
+        # queue for idle_timeout_s gets terminated (never below min_workers)
+        now = time.monotonic()
+        terminated = []
+        provider_nodes = set(self.provider.non_terminated_nodes())
+        for nid in list(provider_nodes):
+            node = self.runtime.nodes.get(nid)
+            if node is None or not node.alive:
+                self._last_busy.pop(nid, None)
+                continue
+            with node._lock:
+                busy = (bool(node._lease_queue)
+                        or any(w.state in ("leased", "actor")
+                               for w in node._workers.values()))
+            if busy:
+                self._last_busy[nid] = now
+                continue
+            if now - self._last_busy.setdefault(nid, now) \
+                    > cfg.idle_timeout_s \
+                    and len(provider_nodes) - len(terminated) \
+                    > cfg.min_workers:
+                self.provider.terminate_node(nid)
+                terminated.append(nid)
+                self._last_busy.pop(nid, None)
+        return {"pending_demands": len(demands), "unmet": unmet,
+                "launched": launched, "terminated": len(terminated),
+                "provider_nodes": len(self.provider.non_terminated_nodes())}

@@ -103,6 +103,75 @@ def _cmd_status(args) -> int:
     return 0
 
 
+def _head_channel(args):
+    from .core.rpc import connect
+
+    if args.authkey:
+        os.environ["RTPU_AUTHKEY"] = args.authkey
+    host, _, port = args.address.rpartition(":")
+    return connect((host, int(port)), name="job-client")
+
+
+def _cmd_submit(args) -> int:
+    entry = [a for a in args.entrypoint if a != "--"]
+    if not entry:
+        print("submit needs an entrypoint after --", file=sys.stderr)
+        return 2
+    import shlex
+
+    ch = _head_channel(args)
+    try:
+        job_id = ch.call("submit_job", {
+            "entrypoint": shlex.join(entry),
+            "env": json.loads(args.env),
+            "working_dir": args.working_dir}, timeout=60)
+        print(f"submitted {job_id}")
+        if args.no_wait:
+            return 0
+        deadline = time.monotonic() + args.timeout
+        while time.monotonic() < deadline:
+            rec = ch.call("job_info", job_id, timeout=30) or {}
+            if rec.get("status") in ("SUCCEEDED", "FAILED", "STOPPED"):
+                logs = rec.get("logs", "")
+                if logs:
+                    sys.stdout.write(logs)
+                print(f"job {job_id}: {rec['status']} "
+                      f"(exit_code={rec.get('exit_code')})")
+                return int(rec.get("exit_code") or 0) \
+                    if rec["status"] != "SUCCEEDED" else 0
+            time.sleep(0.5)
+        print(f"timed out waiting for {job_id}", file=sys.stderr)
+        return 1
+    finally:
+        ch.close()
+
+
+def _cmd_job(args) -> int:
+    ch = _head_channel(args)
+    try:
+        if args.what == "list":
+            for rec in ch.call("list_jobs", None, timeout=30):
+                print(f"{rec['job_id']}  {rec.get('status'):10s}  "
+                      f"{rec.get('entrypoint', '')}")
+            return 0
+        if not args.job_id:
+            print("job {status,logs,stop} needs a job id", file=sys.stderr)
+            return 2
+        if args.what == "status":
+            rec = ch.call("job_info", args.job_id, timeout=30)
+            print("NOT_FOUND" if rec is None else rec.get("status"))
+            return 0 if rec else 1
+        if args.what == "logs":
+            rec = ch.call("job_info", args.job_id, timeout=30) or {}
+            sys.stdout.write(rec.get("logs", ""))
+            return 0
+        ok = ch.call("stop_job", args.job_id, timeout=30)
+        print("stopped" if ok else "not running")
+        return 0
+    finally:
+        ch.close()
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ray_tpu", description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
@@ -135,6 +204,29 @@ def main(argv=None) -> int:
     tl = sub.add_parser("timeline", help="export Chrome-trace of task events")
     tl.add_argument("--output", default="/tmp/ray_tpu_timeline.json")
     tl.set_defaults(fn=_cmd_timeline)
+
+    sj = sub.add_parser(
+        "submit", help="run an entrypoint command as a job on a running "
+                       "head (ref: job_manager.py submit_job)")
+    sj.add_argument("--address", required=True, help="head HOST:PORT")
+    sj.add_argument("--authkey", default="",
+                    help="cluster auth token (hex) printed by the head")
+    sj.add_argument("--working-dir", default=None)
+    sj.add_argument("--env", default="{}",
+                    help="extra env vars for the entrypoint, as JSON")
+    sj.add_argument("--no-wait", action="store_true",
+                    help="print the job id and return immediately")
+    sj.add_argument("--timeout", type=float, default=3600.0)
+    sj.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                    help="command to run (prefix with -- )")
+    sj.set_defaults(fn=_cmd_submit)
+
+    jb = sub.add_parser("job", help="status/logs/stop/list for jobs")
+    jb.add_argument("what", choices=["status", "logs", "stop", "list"])
+    jb.add_argument("job_id", nargs="?", default="")
+    jb.add_argument("--address", required=True)
+    jb.add_argument("--authkey", default="")
+    jb.set_defaults(fn=_cmd_job)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -269,7 +269,9 @@ class NodeAgent:
                 self.head.call("worker_register",
                                {"worker_id": wid,
                                 "pid": payload.get("pid", 0)}, timeout=30)
-                return True
+                # prints from workers on this host can't reach the driver's
+                # console — have them tee lines up the channel
+                return {"forward_logs": True}
             wid = state["worker_id"]
             if method == "create_object":
                 return self.store.create(payload["object_id"], payload["size"])
@@ -289,7 +291,7 @@ class NodeAgent:
             if method == "get_objects":
                 return self._get_objects(payload["ids"],
                                          payload.get("timeout"))
-            if method == "log_event":
+            if method in ("log_event", "worker_log"):
                 self.head.notify("worker_call", {"worker_id": wid,
                                                  "method": method,
                                                  "payload": payload})

@@ -206,6 +206,27 @@ class DriverRuntime:
 
         def handler(method: str, payload):
             node: Optional[RemoteNode] = state["node"]
+            # job-submission plane: served to UNREGISTERED client channels
+            # (a second process submitting work to this running head; ref:
+            # dashboard/modules/job/job_manager.py REST surface)
+            if method == "submit_job":
+                from .. import jobs
+
+                return jobs.submit_job(payload["entrypoint"],
+                                       env=payload.get("env"),
+                                       working_dir=payload.get("working_dir"))
+            if method == "job_info":
+                from .. import jobs
+
+                return jobs.get_job_info(payload)
+            if method == "list_jobs":
+                from .. import jobs
+
+                return jobs.list_jobs()
+            if method == "stop_job":
+                from .. import jobs
+
+                return jobs.stop_job(payload)
             if method == "register_node":
                 node = RemoteNode(self, payload["node_id"],
                                   payload["resources"], self.config, channel,
@@ -1414,6 +1435,20 @@ class DriverRuntime:
                     "namespace": self.namespace}
         if method == "log_event":
             self.gcs.add_task_event(payload)
+            return None
+        if method == "worker_log":
+            # remote workers' stdout/stderr surface on the driver console
+            # with a provenance prefix (ref: log_monitor.py -> driver
+            # stdout with the (name pid=..., ip=...) prefix)
+            if getattr(node, "is_remote", False):
+                import sys as _sys
+
+                out = (_sys.stderr if payload.get("stream") == "stderr"
+                       else _sys.stdout)
+                prefix = (f"(worker pid={payload.get('pid')}, "
+                          f"node={node.node_id.hex()[:8]}) ")
+                for line in payload.get("lines", ()):
+                    print(prefix + line, file=out)
             return None
         raise ValueError(f"unknown worker call: {method}")
 

@@ -20,6 +20,7 @@ import asyncio
 import inspect
 import os
 import queue
+import sys
 import threading
 import traceback
 from concurrent.futures import ThreadPoolExecutor
@@ -34,6 +35,46 @@ from .object_store import SegmentReader
 from .rpc import RpcChannel, connect
 from .task_spec import (ARG_REF, ARG_VALUE, STREAMING_RETURNS, TaskSpec,
                         TaskType)
+
+
+class _StreamTee:
+    """Line-buffered tee of a worker's stdout/stderr to the node channel —
+    the log plane (ref: python/ray/_private/log_monitor.py tails worker
+    log files to the driver; here lines ride the existing RPC channel).
+    Local writes still reach the original stream (the agent's console)."""
+
+    def __init__(self, channel: RpcChannel, stream: str, orig):
+        self._ch = channel
+        self._stream = stream
+        self._orig = orig
+        self._buf = ""
+        self._lock = threading.Lock()
+
+    def write(self, s: str) -> int:
+        self._orig.write(s)
+        lines = None
+        with self._lock:
+            self._buf += s
+            if "\n" in self._buf:
+                done, self._buf = self._buf.rsplit("\n", 1)
+                lines = done.split("\n")
+        if lines:
+            try:
+                self._ch.notify("worker_log", {
+                    "stream": self._stream, "lines": lines,
+                    "pid": os.getpid()})
+            except Exception:
+                pass  # channel down: the local stream still has the line
+        return len(s)
+
+    def flush(self) -> None:
+        self._orig.flush()
+
+    def isatty(self) -> bool:
+        return False
+
+    def fileno(self):
+        return self._orig.fileno()
 
 
 def _aiter_to_iter(agen):
@@ -393,7 +434,12 @@ def main() -> None:
     wp = WorkerProcess(channel, worker_id, args.node_id)
     channel.set_handler(wp.handle)
     channel.on_close(lambda: os._exit(0))
-    channel.call("register", {"worker_id": worker_id, "pid": os.getpid()}, timeout=30)
+    resp = channel.call("register", {"worker_id": worker_id,
+                                     "pid": os.getpid()}, timeout=30)
+    if isinstance(resp, dict) and resp.get("forward_logs"):
+        # remote node: the driver can't see this console — tee prints back
+        sys.stdout = _StreamTee(channel, "stdout", sys.stdout)
+        sys.stderr = _StreamTee(channel, "stderr", sys.stderr)
     try:
         wp.run()
     finally:

@@ -117,6 +117,17 @@ class Dataset:
 
         return self._with(MapOp(block_fn, name="drop_columns"))
 
+    def sort(self, key: str = "id", *, descending: bool = False) -> "Dataset":
+        """Distributed sort by a column: sample -> range partition ->
+        per-partition sort (ref: dataset.py sort;
+        planner/exchange/sort_task_spec.py)."""
+        return self._with(AllToAllOp("sort", (key, descending), name="sort"))
+
+    def groupby(self, key: str) -> "GroupedDataset":
+        """-> GroupedDataset with count/sum/mean/min/max aggregations
+        (ref: dataset.py groupby; grouped_data.py)."""
+        return GroupedDataset(self, key)
+
     def repartition(self, num_blocks: int) -> "Dataset":
         return self._with(AllToAllOp("repartition", num_blocks))
 
@@ -246,6 +257,39 @@ class Dataset:
 # ---------------------------------------------------------------------------
 # read API (ref: python/ray/data/read_api.py)
 # ---------------------------------------------------------------------------
+
+
+class GroupedDataset:
+    """Aggregations over groups of a key column. Two-stage: per-block
+    partial aggregate states hash-partition by key, then merge — the
+    classic map-side combine (ref: python/ray/data/grouped_data.py)."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, specs: List[tuple]) -> Dataset:
+        return self._ds._with(
+            AllToAllOp("groupby", (self._key, specs), name="groupby"))
+
+    def count(self) -> Dataset:
+        return self._agg([("count", None)])
+
+    def sum(self, on: str) -> Dataset:
+        return self._agg([("sum", on)])
+
+    def mean(self, on: str) -> Dataset:
+        return self._agg([("mean", on)])
+
+    def min(self, on: str) -> Dataset:
+        return self._agg([("min", on)])
+
+    def max(self, on: str) -> Dataset:
+        return self._agg([("max", on)])
+
+    def aggregate(self, *specs: tuple) -> Dataset:
+        """specs: ("count", None) / ("sum"|"mean"|"min"|"max", column)."""
+        return self._agg(list(specs))
 
 
 def _make_dataset(read_fns: List[Callable[[], Block]], name: str) -> Dataset:

@@ -69,6 +69,120 @@ def _shuffle_reduce(seed: int, *parts: Block) -> Block:
     return block_select(merged, perm)
 
 
+# -- sort (range partition; ref: planner/exchange/sort_task_spec.py) --------
+
+
+def _sort_sample(block: Block, key: str, k: int) -> np.ndarray:
+    col = block[key]
+    if len(col) <= k:
+        return np.asarray(col)
+    idx = np.linspace(0, len(col) - 1, k).astype(np.int64)
+    return np.asarray(col)[idx]
+
+
+def _sort_map(block: Block, key: str, boundaries: np.ndarray):
+    """Range-partition one block by the sampled boundaries."""
+    col = np.asarray(block[key])
+    assign = np.searchsorted(boundaries, col, side="right")
+    n = len(boundaries) + 1
+    outs = [block_select(block, np.nonzero(assign == j)[0])
+            for j in range(n)]
+    return tuple(outs) if n > 1 else outs[0]
+
+
+def _sort_reduce(key: str, descending: bool, *parts: Block) -> Block:
+    merged = block_concat(parts)
+    order = np.argsort(np.asarray(merged[key]), kind="stable")
+    if descending:
+        order = order[::-1]
+    return block_select(merged, order)
+
+
+# -- groupby/aggregate (hash partition of per-block partial states;
+#    ref: _internal/planner/exchange + push_based_shuffle reduce stage) ----
+
+
+def _bucket_of(values: np.ndarray, n: int) -> np.ndarray:
+    """Deterministic cross-process bucket assignment per key value."""
+    import zlib
+
+    v = np.asarray(values)
+    if v.dtype.kind in "iub":
+        # Fibonacci multiplicative hash spreads adjacent ints
+        return ((v.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15))
+                >> np.uint64(40)).astype(np.int64) % n
+    if v.dtype.kind == "f":
+        v = v.astype(np.float64) + 0.0  # -0.0 -> 0.0: equal keys, one bucket
+        return _bucket_of(v.view(np.uint64), n)
+    return np.asarray([zlib.crc32(repr(x).encode()) % n for x in v],
+                      np.int64)
+
+
+def _partial_agg(block: Block, key: str, specs: List[tuple]) -> Block:
+    """-> partial-state block: unique keys + accumulator columns."""
+    keys = np.asarray(block[key])
+    uniq, inv = np.unique(keys, return_inverse=True)
+    out: Block = {key: uniq}
+    for i, (op, col) in enumerate(specs):
+        if op == "count":
+            out[f"__a{i}_c"] = np.bincount(inv, minlength=len(uniq))
+            continue
+        vals = np.asarray(block[col], np.float64)
+        if op in ("sum", "mean"):
+            out[f"__a{i}_s"] = np.bincount(inv, weights=vals,
+                                           minlength=len(uniq))
+            if op == "mean":
+                out[f"__a{i}_c"] = np.bincount(inv, minlength=len(uniq))
+        elif op in ("min", "max"):
+            fill = np.inf if op == "min" else -np.inf
+            acc = np.full(len(uniq), fill)
+            fn = np.minimum if op == "min" else np.maximum
+            fn.at(acc, inv, vals)
+            out[f"__a{i}_m"] = acc
+        else:
+            raise ValueError(f"unknown aggregate {op!r}")
+    return out
+
+
+def _groupby_map(block: Block, key: str, specs: List[tuple], n: int):
+    partial = _partial_agg(block, key, specs)
+    assign = _bucket_of(partial[key], n)
+    outs = [block_select(partial, np.nonzero(assign == j)[0])
+            for j in range(n)]
+    return tuple(outs) if n > 1 else outs[0]
+
+
+def _groupby_reduce(key: str, specs: List[tuple], *parts: Block) -> Block:
+    merged = block_concat([p for p in parts if block_num_rows(p)])
+    if not merged:
+        return {key: np.asarray([])}
+    keys = np.asarray(merged[key])
+    uniq, inv = np.unique(keys, return_inverse=True)
+    out: Block = {key: uniq}
+    for i, (op, col) in enumerate(specs):
+        name = f"{op}()" if col is None else f"{op}({col})"
+        if op == "count":
+            out[name] = np.bincount(
+                inv, weights=merged[f"__a{i}_c"],
+                minlength=len(uniq)).astype(np.int64)
+        elif op == "sum":
+            out[name] = np.bincount(inv, weights=merged[f"__a{i}_s"],
+                                    minlength=len(uniq))
+        elif op == "mean":
+            s = np.bincount(inv, weights=merged[f"__a{i}_s"],
+                            minlength=len(uniq))
+            c = np.bincount(inv, weights=merged[f"__a{i}_c"],
+                            minlength=len(uniq))
+            out[name] = s / np.maximum(c, 1)
+        else:  # min / max
+            fill = np.inf if op == "min" else -np.inf
+            acc = np.full(len(uniq), fill)
+            fn = np.minimum if op == "min" else np.maximum
+            fn.at(acc, inv, np.asarray(merged[f"__a{i}_m"]))
+            out[name] = acc
+    return out
+
+
 class _BlockWorker:
     """Actor-pool worker for map_batches(compute=ActorPoolStrategy(...)).
     Holds the deserialized chain so per-block calls skip unpickling; a
@@ -275,6 +389,48 @@ class StreamingExecutor:
         return [reduce_remote.remote(base ^ (j * 2654435761), *col)
                 for j, col in enumerate(cols)]
 
+    def _sort(self, refs: List[Any], key: str, descending: bool) -> List[Any]:
+        """Distributed sort: sample -> range partition -> per-partition
+        sort (ref: planner/exchange/sort_task_spec.py SortTaskSpec)."""
+        n = len(refs)
+        if n == 0:
+            return refs
+        sample_remote = ray_tpu.remote(_sort_sample)
+        samples = ray_tpu.get(
+            [sample_remote.remote(r, key, 64) for r in refs], timeout=300)
+        vals = np.concatenate([s for s in samples if len(s)]) \
+            if any(len(s) for s in samples) else np.asarray([])
+        if len(vals) == 0 or n == 1:
+            reduce_remote = ray_tpu.remote(_sort_reduce)
+            return [reduce_remote.remote(key, descending, r) for r in refs]
+        boundaries = np.quantile(np.sort(vals),
+                                 [j / n for j in range(1, n)]) \
+            if vals.dtype.kind == "f" else np.sort(vals)[
+                [min(len(vals) - 1, len(vals) * j // n)
+                 for j in range(1, n)]]
+        map_remote = ray_tpu.remote(_sort_map)
+        reduce_remote = ray_tpu.remote(_sort_reduce)
+        parts = [map_remote.options(num_returns=n).remote(r, key, boundaries)
+                 for r in refs]
+        # n > 1 here: the single-partition case early-returned above
+        cols = [[parts[i][j] for i in range(n)] for j in range(n)]
+        out = [reduce_remote.remote(key, descending, *col) for col in cols]
+        # ascending partitions ordered low->high; descending reverses
+        return out[::-1] if descending else out
+
+    def _groupby(self, refs: List[Any], key: str,
+                 specs: List[tuple]) -> List[Any]:
+        n = len(refs)
+        if n == 0:
+            return refs
+        map_remote = ray_tpu.remote(_groupby_map)
+        reduce_remote = ray_tpu.remote(_groupby_reduce)
+        parts = [map_remote.options(num_returns=n).remote(r, key, specs, n)
+                 for r in refs]
+        cols = ([[p] for p in parts] if n == 1
+                else [[parts[i][j] for i in range(n)] for j in range(n)])
+        return [reduce_remote.remote(key, specs, *col) for col in cols]
+
     # -- plan driver ---------------------------------------------------------
 
     def execute(self, segments: List[dict]) -> Iterator[Any]:
@@ -301,6 +457,10 @@ class StreamingExecutor:
                     refs = self._repartition(upstream, arg)
                 elif op == "random_shuffle":
                     refs = self._random_shuffle(upstream, arg)
+                elif op == "sort":
+                    refs = self._sort(upstream, arg[0], arg[1])
+                elif op == "groupby":
+                    refs = self._groupby(upstream, arg[0], arg[1])
                 else:
                     raise ValueError(f"unknown barrier {op}")
                 inputs = iter(refs)

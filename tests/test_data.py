@@ -168,3 +168,51 @@ def test_train_ingest_e2e(cluster):
     result = trainer.fit()
     assert result.error is None
     assert result.metrics["seen"] > 0
+
+
+def test_sort(cluster):
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    vals = rng.permutation(200).astype(np.int64)
+    ds = rd.from_numpy({"v": vals}, parallelism=4).sort("v")
+    out = np.asarray([r["v"] for r in ds.take_all()])
+    np.testing.assert_array_equal(out, np.sort(vals))
+
+    ds = rd.from_numpy({"v": vals}, parallelism=4).sort("v", descending=True)
+    out = np.asarray([r["v"] for r in ds.take_all()])
+    np.testing.assert_array_equal(out, np.sort(vals)[::-1])
+
+
+def test_groupby_aggregates(cluster):
+    import numpy as np
+
+    n = 300
+    keys = np.arange(n) % 7
+    vals = np.arange(n, dtype=np.float64)
+    ds = rd.from_numpy({"k": keys, "v": vals}, parallelism=5)
+
+    counts = {r["k"]: r["count()"] for r in ds.groupby("k").count().take_all()}
+    sums = {r["k"]: r["sum(v)"] for r in ds.groupby("k").sum("v").take_all()}
+    means = {r["k"]: r["mean(v)"] for r in ds.groupby("k").mean("v").take_all()}
+    mins = {r["k"]: r["min(v)"] for r in ds.groupby("k").min("v").take_all()}
+    maxs = {r["k"]: r["max(v)"] for r in ds.groupby("k").max("v").take_all()}
+    for k in range(7):
+        mask = keys == k
+        assert counts[k] == mask.sum()
+        assert sums[k] == pytest.approx(vals[mask].sum())
+        assert means[k] == pytest.approx(vals[mask].mean())
+        assert mins[k] == vals[mask].min()
+        assert maxs[k] == vals[mask].max()
+
+
+def test_groupby_multi_aggregate_and_chain(cluster):
+    import numpy as np
+
+    keys = np.asarray([0, 1, 0, 1, 2])
+    vals = np.asarray([1.0, 2.0, 3.0, 4.0, 5.0])
+    ds = rd.from_numpy({"k": keys, "v": vals}, parallelism=2)
+    rows = (ds.groupby("k").aggregate(("count", None), ("sum", "v"))
+            .sort("k").take_all())
+    assert [(r["k"], r["count()"], r["sum(v)"]) for r in rows] == [
+        (0, 2, 4.0), (1, 2, 6.0), (2, 1, 5.0)]

@@ -224,3 +224,22 @@ def test_p2p_transfer_bypasses_head(cluster):
     head_node = rt.nodes[rt.head_node_id]
     assert not head_node.store.contains(oid), \
         "P2P transfer must not create a head-store copy"
+
+
+def test_remote_worker_logs_reach_driver(cluster, capfd):
+    """Prints from workers on remote nodes surface on the driver console
+    with a provenance prefix (ref: _private/log_monitor.py; r2 missing #10)."""
+    remote = cluster.add_remote_node(num_cpus=1.0)
+
+    @ray_tpu.remote
+    def chatty():
+        print("hello from the other side")
+        return 1
+
+    assert ray_tpu.get(
+        chatty.options(scheduling_strategy=_pin(remote)).remote(),
+        timeout=60) == 1
+    time.sleep(0.5)  # notify is async: give the relay a beat
+    out = capfd.readouterr().out
+    assert "hello from the other side" in out
+    assert "(worker pid=" in out
