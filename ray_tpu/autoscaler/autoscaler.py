@@ -35,7 +35,7 @@ class StandardAutoscaler:
         self.provider = provider
         self.config = config or AutoscalerConfig()
         self._last_busy: Dict[NodeId, float] = {}
-        self._requested: ResourceSet = {}
+        self._requested: List[ResourceSet] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
@@ -65,13 +65,13 @@ class StandardAutoscaler:
     # -- explicit demand (ref: ray.autoscaler.sdk.request_resources) ----------
 
     def request_resources(self, bundles: List[ResourceSet]) -> None:
-        """Pin a demand floor independent of queued work."""
-        total: ResourceSet = {}
-        for b in bundles:
-            for k, v in normalize(b).items():
-                total[k] = total.get(k, 0.0) + v
+        """Pin a demand floor independent of queued work. Bundles stay
+        separate demands — aggregating them would turn N node-sized
+        requests into one unsatisfiable super-node request and the
+        launch loop would never fire (ref: sdk.request_resources treats
+        each bundle as independently placeable)."""
         with self._lock:
-            self._requested = total
+            self._requested = [normalize(b) for b in bundles]
 
     # -- demand / supply -------------------------------------------------------
 
@@ -93,8 +93,7 @@ class StandardAutoscaler:
             if pg.state == "PENDING":
                 demands.extend(normalize(b) for b in pg.bundles)
         with self._lock:
-            if self._requested:
-                demands.append(dict(self._requested))
+            demands.extend(dict(b) for b in self._requested)
         return [d for d in demands if d]
 
     def _unmet_after_packing(self, demands: List[ResourceSet]) -> int:

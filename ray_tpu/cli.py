@@ -108,12 +108,20 @@ def _head_channel(args):
 
     if args.authkey:
         os.environ["RTPU_AUTHKEY"] = args.authkey
-    host, _, port = args.address.rpartition(":")
+    host, sep, port = args.address.rpartition(":")
+    if not sep or not host or not port.isdigit():
+        print(f"--address must be HOST:PORT, got {args.address!r}",
+              file=sys.stderr)
+        raise SystemExit(2)
     return connect((host, int(port)), name="job-client")
 
 
 def _cmd_submit(args) -> int:
-    entry = [a for a in args.entrypoint if a != "--"]
+    # strip only the LEADING '--' separator; later '--' tokens belong to
+    # the entrypoint itself (e.g. `pytest tests -- -k foo`)
+    entry = list(args.entrypoint)
+    if entry and entry[0] == "--":
+        entry = entry[1:]
     if not entry:
         print("submit needs an entrypoint after --", file=sys.stderr)
         return 2

@@ -49,6 +49,17 @@ class _StreamTee:
         self._orig = orig
         self._buf = ""
         self._lock = threading.Lock()
+        # file-object surface libraries probe before writing
+        self.encoding = getattr(orig, "encoding", "utf-8")
+        self.errors = getattr(orig, "errors", "strict")
+
+    def writelines(self, lines) -> None:
+        for line in lines:
+            self.write(line)
+
+    @property
+    def buffer(self):
+        return getattr(self._orig, "buffer", self._orig)
 
     def write(self, s: str) -> int:
         self._orig.write(s)

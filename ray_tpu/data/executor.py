@@ -92,6 +92,10 @@ def _sort_map(block: Block, key: str, boundaries: np.ndarray):
 
 def _sort_reduce(key: str, descending: bool, *parts: Block) -> Block:
     merged = block_concat(parts)
+    if not merged:
+        # every map task routed zero rows into this range partition
+        # (skewed/constant keys): an empty block sorts to itself
+        return merged
     order = np.argsort(np.asarray(merged[key]), kind="stable")
     if descending:
         order = order[::-1]

@@ -109,6 +109,16 @@ class JobSupervisor:
                 self._entrypoint, shell=True, env=env, cwd=self._cwd,
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 text=True, start_new_session=True)
+            if self._stop_requested:
+                # stop() ran in the other concurrency lane between the
+                # PENDING check above and the Popen assignment — it saw
+                # _proc None and could only set the flag; honor it now
+                import signal
+
+                try:
+                    os.killpg(os.getpgid(self._proc.pid), signal.SIGTERM)
+                except Exception:
+                    self._proc.terminate()
             out, _ = self._proc.communicate()
             rc = self._proc.returncode
         except Exception as e:  # spawn failure is a FAILED job, not a crash

@@ -15,6 +15,10 @@ from typing import TYPE_CHECKING
 
 _EXPORTS = {
     "PPO": "algorithm", "PPOConfig": "algorithm",
+    "DQN": "dqn", "DQNConfig": "dqn", "DQNLearner": "dqn",
+    "DQNRolloutWorker": "dqn",
+    "ReplayBuffer": "replay_buffer",
+    "PrioritizedReplayBuffer": "replay_buffer",
     "CartPoleVecEnv": "env", "VectorEnv": "env",
     "make_env": "env", "register_env": "env",
     "PPOLearner": "learner", "ppo_loss": "learner",
@@ -25,6 +29,10 @@ __all__ = sorted(_EXPORTS)
 
 if TYPE_CHECKING:  # static analyzers see the eager imports
     from .algorithm import PPO, PPOConfig  # noqa: F401
+    from .dqn import (DQN, DQNConfig, DQNLearner,  # noqa: F401
+                      DQNRolloutWorker)
+    from .replay_buffer import (PrioritizedReplayBuffer,  # noqa: F401
+                                ReplayBuffer)
     from .env import (CartPoleVecEnv, VectorEnv, make_env,  # noqa: F401
                       register_env)
     from .learner import PPOLearner, ppo_loss  # noqa: F401

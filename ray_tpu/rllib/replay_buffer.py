@@ -1,0 +1,150 @@
+"""Replay buffers for off-policy algorithms.
+
+ref: rllib/utils/replay_buffers/replay_buffer.py (ring storage, add/sample)
+and prioritized_replay_buffer.py (sum-tree proportional prioritization per
+Schaul et al. 2015). Storage is column-major preallocated numpy — the same
+dict-of-arrays shape sample batches already use, so buffers concatenate
+rollout-worker output with zero copies beyond the ring write.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+Batch = Dict[str, np.ndarray]
+
+
+class ReplayBuffer:
+    """Uniform-sampling ring buffer (ref: replay_buffer.py:71 add,
+    :132 sample). Columns are allocated lazily from the first batch so the
+    buffer is schema-agnostic (DQN transitions, SAC tuples, ...)."""
+
+    def __init__(self, capacity: int = 100_000, seed: int = 0):
+        self.capacity = int(capacity)
+        self._cols: Dict[str, np.ndarray] = {}
+        self._size = 0
+        self._next = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return self._size
+
+    def _ensure_storage(self, batch: Batch) -> None:
+        for k, v in batch.items():
+            if k not in self._cols:
+                self._cols[k] = np.empty((self.capacity, *v.shape[1:]),
+                                         v.dtype)
+
+    def add(self, batch: Batch) -> np.ndarray:
+        """Append a batch of rows; oldest rows are overwritten when full.
+        Returns the ring indices written (prioritized subclass uses them)."""
+        n = len(next(iter(batch.values())))
+        self._ensure_storage(batch)
+        idx = (self._next + np.arange(n)) % self.capacity
+        for k, v in batch.items():
+            self._cols[k][idx] = v
+        self._next = int((self._next + n) % self.capacity)
+        self._size = min(self._size + n, self.capacity)
+        return idx
+
+    def sample(self, batch_size: int) -> Batch:
+        idx = self._rng.integers(0, self._size, size=batch_size)
+        return {k: v[idx] for k, v in self._cols.items()}
+
+    def state(self) -> Dict:
+        """Checkpointable state (ref: replay_buffer.py get_state)."""
+        return {"cols": {k: v[:self._size].copy()
+                         for k, v in self._cols.items()},
+                "next": self._next, "size": self._size}
+
+    def restore(self, state: Dict) -> None:
+        for k, v in state["cols"].items():
+            self._cols[k] = np.empty((self.capacity, *v.shape[1:]), v.dtype)
+            self._cols[k][:len(v)] = v
+        self._size = int(state["size"])
+        self._next = int(state["next"])
+
+
+class SumTree:
+    """Binary-indexed sum tree over `capacity` leaves: O(log n) update and
+    prefix-sum sampling (ref: the segment tree in
+    rllib/execution/segment_tree.py)."""
+
+    def __init__(self, capacity: int):
+        # round up to a power of two so every leaf sits at the same depth —
+        # the vectorized bottom-up propagation assumes level-aligned parents
+        self.capacity = 1 << (capacity - 1).bit_length()
+        self._tree = np.zeros(2 * self.capacity, np.float64)
+
+    def update(self, idx: np.ndarray, values: np.ndarray) -> None:
+        leaf = np.asarray(idx) + self.capacity
+        self._tree[leaf] = values  # duplicate idx: last write wins
+        # propagate bottom-up; each parent is recomputed from BOTH children,
+        # so recomputing a parent twice (duplicate indices) is harmless
+        pos = np.unique(leaf // 2)
+        while True:
+            self._tree[pos] = self._tree[2 * pos] + self._tree[2 * pos + 1]
+            if pos[0] <= 1:
+                break
+            pos = np.unique(pos // 2)
+
+    @property
+    def total(self) -> float:
+        return float(self._tree[1])
+
+    def sample_prefix(self, prefix: np.ndarray) -> np.ndarray:
+        """Vectorized descent: for each prefix sum p in [0, total), find the
+        leaf whose cumulative range contains p."""
+        pos = np.ones(len(prefix), np.int64)
+        p = prefix.astype(np.float64).copy()
+        while pos[0] < self.capacity:
+            left = self._tree[2 * pos]
+            go_right = p >= left
+            p -= np.where(go_right, left, 0.0)
+            pos = 2 * pos + go_right
+        return pos - self.capacity
+
+
+class PrioritizedReplayBuffer(ReplayBuffer):
+    """Proportional prioritized replay (ref:
+    prioritized_replay_buffer.py:26; Schaul et al.).  P(i) ∝ p_i^alpha;
+    importance weights w_i = (N * P(i))^-beta normalized by max."""
+
+    def __init__(self, capacity: int = 100_000, *, alpha: float = 0.6,
+                 beta: float = 0.4, eps: float = 1e-6, seed: int = 0):
+        super().__init__(capacity, seed)
+        self.alpha = alpha
+        self.beta = beta
+        self.eps = eps
+        self._tree = SumTree(capacity)
+        self._max_priority = 1.0
+
+    def add(self, batch: Batch) -> np.ndarray:
+        idx = super().add(batch)
+        # new transitions get max priority so they are seen at least once
+        self._tree.update(idx, np.full(len(idx),
+                                       self._max_priority ** self.alpha))
+        return idx
+
+    def sample(self, batch_size: int
+               ) -> Tuple[Batch, np.ndarray, np.ndarray]:
+        """-> (batch, ring_indices, importance_weights)."""
+        total = self._tree.total
+        # stratified prefixes: one uniform draw per equal segment
+        seg = total / batch_size
+        prefix = (np.arange(batch_size)
+                  + self._rng.random(batch_size)) * seg
+        idx = self._tree.sample_prefix(np.minimum(prefix, total * (1 - 1e-9)))
+        idx = np.minimum(idx, self._size - 1)
+        probs = self._tree._tree[idx + self._tree.capacity] / total
+        weights = (self._size * np.maximum(probs, 1e-12)) ** (-self.beta)
+        weights /= weights.max()
+        return ({k: v[idx] for k, v in self._cols.items()}, idx,
+                weights.astype(np.float32))
+
+    def update_priorities(self, idx: np.ndarray,
+                          td_errors: np.ndarray) -> None:
+        p = np.abs(td_errors) + self.eps
+        self._max_priority = max(self._max_priority, float(p.max()))
+        self._tree.update(np.asarray(idx), p ** self.alpha)

@@ -75,6 +75,23 @@ def test_request_resources_floor(head):
         provider.shutdown()
 
 
+def test_request_resources_multi_bundle(head):
+    """N node-sized bundles must launch N nodes, not collapse into one
+    unsatisfiable aggregate demand (regression)."""
+    provider = FakeSliceProvider(head, resources_per_node={"CPU": 2.0})
+    sc = StandardAutoscaler(head, provider, AutoscalerConfig(
+        min_workers=0, max_workers=4, idle_timeout_s=60.0,
+        max_launch_batch=3))
+    try:
+        sc.request_resources([{"CPU": 2.0}] * 3)
+        stats = sc.update()
+        assert stats["launched"] == 3, stats
+        assert len(provider.non_terminated_nodes()) == 3
+    finally:
+        sc.stop()
+        provider.shutdown()
+
+
 def test_tpu_slice_provider_discovery(head, monkeypatch):
     monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "t1k-w0,t1k-w1,t1k-w2")
     launched = []

@@ -184,6 +184,17 @@ def test_sort(cluster):
     np.testing.assert_array_equal(out, np.sort(vals)[::-1])
 
 
+def test_sort_constant_keys(cluster):
+    """Skewed/constant sort keys leave range partitions empty — the
+    reduce must hand back empty blocks, not crash (regression)."""
+    import numpy as np
+
+    vals = np.full(100, 5, np.int64)
+    ds = rd.from_numpy({"v": vals}, parallelism=4).sort("v")
+    out = np.asarray([r["v"] for r in ds.take_all()])
+    np.testing.assert_array_equal(out, vals)
+
+
 def test_groupby_aggregates(cluster):
     import numpy as np
 
