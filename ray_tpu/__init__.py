@@ -42,6 +42,7 @@ def init(num_cpus: Optional[float] = None,
          system_config: Optional[Dict[str, Any]] = None,
          ignore_reinit_error: bool = False,
          object_store_memory: Optional[int] = None,
+         runtime_env: Optional[Dict[str, Any]] = None,
          **_ignored) -> DriverRuntime:
     """Start (or connect to) the runtime. Inside a worker this is a no-op
     returning the ambient WorkerRuntime, matching the reference's behavior."""
@@ -60,6 +61,12 @@ def init(num_cpus: Optional[float] = None,
         res["object_store_memory"] = float(object_store_memory)
     rt = DriverRuntime(resources=res, num_nodes=num_nodes,
                        config=Config(system_config), namespace=namespace)
+    if runtime_env:
+        # job-level default: merged under every task/actor env (ref:
+        # job_config.py runtime_env; validated now so errors hit at init)
+        from .core import runtime_env as _renv_mod
+
+        rt.default_runtime_env = _renv_mod.validate(runtime_env)
     _runtime_mod.set_runtime(rt)
     return rt
 

@@ -178,7 +178,7 @@ class ActorClass:
             max_concurrency=int(opts.get("max_concurrency", 1)),
             concurrency_groups=opts.get("concurrency_groups"),
             is_async_actor=is_async,
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=rt.prepare_runtime_env(opts.get("runtime_env")),
         )
         max_task_retries = int(opts.get("max_task_retries", 0))
         method_meta = _method_meta_of(self._cls)

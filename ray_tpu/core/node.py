@@ -52,6 +52,10 @@ class WorkerHandle:
     # NotifyDirectCallTaskBlocked/Unblocked). A depth counter, not a bool:
     # threaded actors (max_concurrency>1) can block on several calls at once.
     blocked_depth: int = 0
+    # runtime_env dedication (ref: worker_pool.cc keys PopWorker by the
+    # env hash): None = fresh/unbound; "" = bound to the plain env;
+    # other = bound to that packaged runtime_env for life
+    env_hash: Optional[str] = None
     idle_since: float = 0.0  # monotonic timestamp of the last idle entry
 
 
@@ -61,6 +65,7 @@ class _LeaseRequest:
     demand: ResourceSet
     future: Future  # resolves to WorkerHandle
     pg: Optional[tuple] = None  # (pg_id, bundle_index)
+    env_hash: str = ""  # runtime_env dedication key ("" = plain)
 
 
 @dataclass
@@ -150,7 +155,10 @@ class Node:
                     f"No bundle with capacity for {demand} in pg "
                     f"{strat.placement_group_id.hex()[:8]} on this node"))
                 return fut
-        req = _LeaseRequest(spec=spec, demand=demand, future=fut, pg=pg)
+        from .runtime_env import env_hash as _env_hash
+
+        req = _LeaseRequest(spec=spec, demand=demand, future=fut, pg=pg,
+                            env_hash=_env_hash(spec.runtime_env))
         with self._lock:
             self._lease_queue.append(req)
         self._dispatch()
@@ -185,7 +193,7 @@ class Node:
                 if not self._fits(req):
                     remaining.append(req)
                     continue
-                worker = self._pop_idle()
+                worker = self._pop_idle(req.env_hash)
                 if worker is None:
                     remaining.append(req)
                     # blocked workers don't count toward the cap: each one
@@ -194,10 +202,25 @@ class Node:
                     active = (len(self._workers) + self._starting_count
                               - sum(1 for w in self._workers.values()
                                     if w.blocked_depth > 0))
+                    if active >= self._max_workers:
+                        # cap reached but an idle worker bound to a
+                        # DIFFERENT runtime_env may be the blocker: evict
+                        # one to make room (ref: worker_pool.cc kills
+                        # idle workers of other envs under pressure)
+                        victim = next(
+                            (w for w in self._idle
+                             if w.state == "idle" and w.env_hash
+                             not in (None, req.env_hash)), None)
+                        if victim is not None:
+                            self._terminate_worker(victim)
+                            self._idle = deque(
+                                x for x in self._idle if x is not victim)
+                            active -= 1
                     if active < self._max_workers or not self._workers:
                         self._start_worker()
                     continue
                 self._take_resources(req)
+                worker.env_hash = req.env_hash  # dedicate on first grant
                 worker.state = "leased"
                 worker.lease_resources = req.demand
                 worker.lease_pg = req.pg
@@ -276,12 +299,24 @@ class Node:
             else:
                 self.available = res_sub(self.available, worker.lease_resources)
 
-    def _pop_idle(self) -> Optional[WorkerHandle]:
+    def _pop_idle(self, env_hash: str = "") -> Optional[WorkerHandle]:
+        """Pop an idle worker compatible with the request's runtime_env:
+        one already dedicated to the same env, or a fresh unbound one (it
+        gets dedicated on grant). A worker bound to a DIFFERENT env is
+        never reused — its process state (env vars, sys.path, cwd) is that
+        environment's (ref: worker_pool.cc runtime-env-keyed pop)."""
+        kept = []
+        found = None
         while self._idle:
             w = self._idle.popleft()
-            if w.state == "idle" and w.channel is not None and not w.channel.closed:
-                return w
-        return None
+            if w.state != "idle" or w.channel is None or w.channel.closed:
+                continue
+            if w.env_hash is None or w.env_hash == env_hash:
+                found = w
+                break
+            kept.append(w)
+        self._idle.extendleft(reversed(kept))
+        return found
 
     # ---- worker lifecycle ----------------------------------------------------
 

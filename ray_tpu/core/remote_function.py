@@ -115,7 +115,8 @@ class RemoteFunction:
             max_retries=int(self._options.get("max_retries", cfg.task_max_retries)),
             retry_exceptions=bool(self._options.get("retry_exceptions", False)),
             scheduling_strategy=resolve_strategy(self._options),
-            runtime_env=self._options.get("runtime_env"),
+            runtime_env=rt.prepare_runtime_env(
+                self._options.get("runtime_env")),
         )
         refs = rt.submit_spec(spec)
         if num_returns == STREAMING_RETURNS:

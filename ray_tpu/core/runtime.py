@@ -125,6 +125,8 @@ class DriverRuntime:
         self._parked: List[TaskSpec] = []
         self._put_counter = 0
         self._fn_cache: Dict[int, str] = {}
+        self._renv_cache: Dict[str, dict] = {}
+        self.default_runtime_env: Optional[dict] = None  # job-level env
         self._lock = threading.RLock()
         self._pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="rt")
         self._shutdown = False
@@ -734,6 +736,27 @@ class DriverRuntime:
 
     def new_task_id(self) -> TaskId:
         return TaskId.from_random()
+
+    def prepare_runtime_env(self, renv: Optional[dict]) -> Optional[dict]:
+        """Merge with the job-level default, zip+upload local dirs into the
+        GCS KV, and stamp the dedication hash — once per distinct env
+        (content-addressed cache). ref: runtime_env_agent.py:161, here run
+        submitter-side because the KV is the package store."""
+        from . import runtime_env as renv_mod
+
+        merged = renv_mod.merge(self.default_runtime_env,
+                                renv_mod.validate(renv))
+        if not merged:
+            return None
+        key = renv_mod.cache_key(merged)
+        cached = self._renv_cache.get(key)
+        if cached is None:
+            cached = renv_mod.package(
+                merged,
+                lambda k, b: self.gcs.kv_put(
+                    k, b, namespace=renv_mod.KV_NAMESPACE, overwrite=False))
+            self._renv_cache[key] = cached
+        return cached
 
     def submit_spec(self, spec: TaskSpec) -> List[ObjectRef]:
         self.task_manager.register(spec)
@@ -1756,6 +1779,21 @@ class WorkerRuntime:
 
     def release_generator(self, task_id) -> None:
         self.channel.notify("release_generator", task_id)
+
+    def prepare_runtime_env(self, renv: Optional[dict]) -> Optional[dict]:
+        """Nested submission: no env specified inherits the parent task's
+        (already-packaged) env — the worker IS that environment; an explicit
+        env is packaged fresh (reference semantics: a task-level env
+        replaces, not composes)."""
+        if not renv:
+            cur = self.current_task()
+            return cur.runtime_env if cur is not None else None
+        from . import runtime_env as renv_mod
+
+        return renv_mod.package(
+            renv_mod.validate(renv),
+            lambda k, b: self.kv_put(k, b, namespace=renv_mod.KV_NAMESPACE,
+                                     overwrite=False))
 
     def kv_put(self, key, value, namespace="user", overwrite=True):
         return self.channel.call("kv_put", {"key": key, "value": value,

@@ -1,0 +1,243 @@
+"""Per-task/actor runtime environments.
+
+Equivalent of the reference's runtime_env machinery (ref:
+dashboard/modules/runtime_env/runtime_env_agent.py:161 CreateRuntimeEnv;
+python/ray/_private/runtime_env/working_dir.py + py_modules.py packaging;
+runtime_env/packaging.py zip-and-upload protocol).
+
+Design: the submitting process validates the env, zips any local
+directories, and uploads them as content-addressed blobs in the GCS KV
+("renv" namespace) — the same channel function exports already ride.
+Workers are DEDICATED to one environment (reference semantics:
+worker_pool.cc keys PopWorker by runtime_env hash): the node's lease
+dispatch only hands a task to a worker bound to the same env hash, and a
+fresh worker applies the env exactly once before its first task —
+env_vars into os.environ, extracted working_dir as cwd + sys.path head,
+py_modules onto sys.path.
+
+pip/conda/container are deliberately gated (no package installation on an
+air-gapped TPU host); a clear error beats a silent ignore.
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import sys
+import tempfile
+import zipfile
+from typing import Callable, Dict, List, Optional
+
+ALLOWED_KEYS = {"env_vars", "working_dir", "py_modules", "config"}
+GATED_KEYS = {"pip", "conda", "container", "image_uri", "uv"}
+# ref: runtime_env/packaging.py GCS_STORAGE_MAX_SIZE guard
+MAX_PACKAGE_BYTES = 500 * 1024 * 1024
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+KV_NAMESPACE = "renv"
+
+
+def validate(renv: Optional[dict]) -> Optional[dict]:
+    """Normalize and reject unknown/gated keys early, in the submitter."""
+    if not renv:
+        return None
+    gated = GATED_KEYS & renv.keys()
+    if gated:
+        raise ValueError(
+            f"runtime_env keys {sorted(gated)} are not supported on this "
+            f"runtime: TPU hosts run hermetic images; ship code via "
+            f"working_dir/py_modules and configuration via env_vars")
+    unknown = renv.keys() - ALLOWED_KEYS
+    if unknown:
+        raise ValueError(f"unknown runtime_env keys {sorted(unknown)}; "
+                         f"supported: {sorted(ALLOWED_KEYS)}")
+    out: dict = {}
+    env_vars = renv.get("env_vars") or {}
+    if env_vars:
+        if not isinstance(env_vars, dict):
+            raise TypeError("env_vars must be a dict")
+        out["env_vars"] = {str(k): str(v) for k, v in env_vars.items()}
+    if renv.get("working_dir"):
+        out["working_dir"] = str(renv["working_dir"])
+    mods = renv.get("py_modules") or []
+    if mods:
+        out["py_modules"] = [str(m) for m in mods]
+    if renv.get("config"):
+        out["config"] = dict(renv["config"])
+    return out or None
+
+
+def _zip_dir(path: str) -> bytes:
+    """Deterministic zip of a directory tree (sorted walk, zeroed
+    timestamps) so identical trees hash identically across submitters."""
+    buf = io.BytesIO()
+    total = 0
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
+            for name in sorted(files):
+                full = os.path.join(root, name)
+                rel = os.path.relpath(full, path)
+                try:
+                    data = open(full, "rb").read()
+                except OSError:
+                    continue  # sockets, vanished tmpfiles
+                total += len(data)
+                if total > MAX_PACKAGE_BYTES:
+                    raise ValueError(
+                        f"runtime_env package {path!r} exceeds "
+                        f"{MAX_PACKAGE_BYTES >> 20} MiB")
+                info = zipfile.ZipInfo(rel, date_time=(1980, 1, 1, 0, 0, 0))
+                info.compress_type = zipfile.ZIP_DEFLATED
+                zf.writestr(info, data)
+    return buf.getvalue()
+
+
+def _upload_dir(path: str, kv_put: Callable[[str, bytes], None]) -> dict:
+    path = os.path.abspath(os.path.expanduser(path))
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"runtime_env directory {path!r} not found")
+    blob = _zip_dir(path)
+    sha = hashlib.sha1(blob).hexdigest()
+    kv_put(f"pkg:{sha}", blob)
+    return {"pkg": sha, "name": os.path.basename(path.rstrip(os.sep))}
+
+
+def package(renv: Optional[dict],
+            kv_put: Callable[[str, bytes], None]) -> Optional[dict]:
+    """Submitter side: replace local paths with content-addressed KV
+    references, then stamp the whole env with its hash (the worker-pool
+    dedication key)."""
+    renv = validate(renv)
+    if renv is None:
+        return None
+    out = dict(renv)
+    if "working_dir" in out:
+        out["working_dir"] = _upload_dir(out["working_dir"], kv_put)
+    if "py_modules" in out:
+        out["py_modules"] = [_upload_dir(m, kv_put)
+                             for m in out["py_modules"]]
+    out["_hash"] = hashlib.sha1(
+        json.dumps(out, sort_keys=True).encode()).hexdigest()[:16]
+    return out
+
+
+def dir_fingerprint(path: str) -> str:
+    """Cheap content fingerprint (relpath, size, mtime_ns of every file)
+    so submitter-side caches notice edited working_dirs without paying a
+    full re-zip per submission."""
+    path = os.path.abspath(os.path.expanduser(path))
+    h = hashlib.sha1()
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
+        for name in sorted(files):
+            full = os.path.join(root, name)
+            try:
+                st = os.stat(full)
+            except OSError:
+                continue
+            h.update(f"{os.path.relpath(full, path)}|{st.st_size}|"
+                     f"{st.st_mtime_ns}\n".encode())
+    return h.hexdigest()[:16]
+
+
+def cache_key(renv: dict) -> str:
+    """Cache key for a VALIDATED (pre-packaging) env: the env dict plus
+    fingerprints of every referenced local directory — a path alone would
+    serve stale packages after the user edits the tree."""
+    fps = {}
+    wd = renv.get("working_dir")
+    if wd:
+        fps["working_dir"] = dir_fingerprint(wd)
+    for i, m in enumerate(renv.get("py_modules") or []):
+        fps[f"py_modules.{i}"] = dir_fingerprint(m)
+    return json.dumps({"env": renv, "fp": fps}, sort_keys=True)
+
+
+def env_hash(packaged: Optional[dict]) -> str:
+    """'' = the plain environment (no runtime_env)."""
+    return packaged.get("_hash", "") if packaged else ""
+
+
+def merge(base: Optional[dict], override: Optional[dict]) -> Optional[dict]:
+    """Job-level default + per-task override (ref:
+    runtime_env.py:merge_runtime_env): env_vars union (task wins),
+    other keys replaced wholesale."""
+    if not base:
+        return override
+    if not override:
+        return base
+    out = dict(base)
+    out.update({k: v for k, v in override.items() if k != "env_vars"})
+    ev = dict(base.get("env_vars") or {})
+    ev.update(override.get("env_vars") or {})
+    if ev:
+        out["env_vars"] = ev
+    out.pop("_hash", None)
+    return out
+
+
+# -- worker side --------------------------------------------------------------
+
+def _cache_root() -> str:
+    return os.path.join(tempfile.gettempdir(), "ray_tpu_runtime_env")
+
+
+def _extract(ref: dict, kv_get: Callable[[str], bytes]) -> str:
+    """Fetch+extract a packaged dir into the shared content-addressed
+    cache. Concurrent workers race benignly: extraction goes to a
+    process-private temp dir, then one atomic rename wins."""
+    sha = ref["pkg"]
+    dest = os.path.join(_cache_root(), sha)
+    if os.path.isdir(dest):
+        return dest
+    blob = kv_get(f"pkg:{sha}")
+    if blob is None:
+        raise RuntimeError(f"runtime_env package {sha} missing from KV")
+    os.makedirs(_cache_root(), exist_ok=True)
+    tmp = tempfile.mkdtemp(dir=_cache_root(), prefix=f".{sha}.")
+    with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+        zf.extractall(tmp)
+    try:
+        os.rename(tmp, dest)
+    except OSError:
+        if not os.path.isdir(dest):  # lost the race is fine; else real error
+            raise
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return dest
+
+
+def apply(packaged: Optional[dict],
+          kv_get: Callable[[str], bytes]) -> None:
+    """Apply an environment to THIS process (called once, before the
+    worker's first task — the worker is dedicated from then on)."""
+    if not packaged:
+        return
+    for k, v in (packaged.get("env_vars") or {}).items():
+        os.environ[k] = v
+    paths: List[str] = []
+    wd = packaged.get("working_dir")
+    if wd:
+        dest = _extract(wd, kv_get)
+        paths.append(dest)
+        os.chdir(dest)
+    for ref in packaged.get("py_modules") or []:
+        dest = _extract(ref, kv_get)
+        # a py_modules entry IS the importable package: expose it under
+        # its original name via an aliasing dir on sys.path (the zip is
+        # rooted inside the package; ref: py_modules.py upload contract)
+        alias_root = dest + "_pkg"
+        os.makedirs(alias_root, exist_ok=True)
+        link = os.path.join(alias_root, ref["name"])
+        if not os.path.lexists(link):
+            try:
+                os.symlink(dest, link)
+            except FileExistsError:
+                pass  # concurrent worker won the race
+        paths.append(alias_root)
+    for p in reversed(paths):
+        if p not in sys.path:
+            sys.path.insert(0, p)

@@ -187,6 +187,7 @@ class WorkerProcess:
         self._actor: Optional[ActorQueue] = None
         self._actor_id: Optional[ActorId] = None
         self._cancelled: set = set()
+        self._renv_applied = False  # runtime_env applied once, first task
         self._stop = threading.Event()
         # register the worker-mode runtime so `ray_tpu.get/put/remote` work in tasks
         from . import runtime as runtime_mod
@@ -263,6 +264,26 @@ class WorkerProcess:
         if spec.task_id in self._cancelled:
             self._report_error(spec, _make_cancelled_error(spec))
             return
+        if spec.runtime_env and not self._renv_applied:
+            # the node's lease dispatch guarantees this worker is either
+            # fresh or already dedicated to exactly this env, so a single
+            # application covers the worker's whole life
+            from . import runtime_env as renv_mod
+
+            try:
+                renv_mod.apply(
+                    spec.runtime_env,
+                    lambda key: self.channel.call(
+                        "kv_get",
+                        {"key": key, "namespace": renv_mod.KV_NAMESPACE},
+                        timeout=120))
+            except BaseException as e:
+                from ..exceptions import RuntimeEnvSetupError
+
+                self._report_error(spec, RuntimeEnvSetupError(
+                    f"runtime_env setup failed: {e!r}"))
+                return
+            self._renv_applied = True
         token = self.runtime.set_current_task(spec)
         try:
             args, kwargs = self.resolve_args(spec)

@@ -148,3 +148,22 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         p = np.abs(td_errors) + self.eps
         self._max_priority = max(self._max_priority, float(p.max()))
         self._tree.update(np.asarray(idx), p ** self.alpha)
+
+    def state(self) -> Dict:
+        s = super().state()
+        # leaf priorities must round-trip or a restored buffer samples
+        # from a zeroed tree (NaN weights, single-row minibatches)
+        leaves = self._tree._tree[self._tree.capacity:
+                                  self._tree.capacity + self.capacity]
+        s["priorities"] = leaves[:self._size].copy()
+        s["max_priority"] = self._max_priority
+        return s
+
+    def restore(self, state: Dict) -> None:
+        super().restore(state)
+        self._max_priority = float(state.get("max_priority", 1.0))
+        prios = state.get("priorities")
+        if prios is None:  # plain-buffer snapshot: everything max priority
+            prios = np.full(self._size, self._max_priority ** self.alpha)
+        if self._size:
+            self._tree.update(np.arange(self._size), np.asarray(prios))

@@ -28,11 +28,14 @@ from .config import AutoscalingConfig, DeploymentConfig
 from .controller import CONTROLLER_NAME, get_or_create_controller
 from .handle import DeploymentHandle
 from .mesh_replica import MeshDeployment
+from .multiplex import (get_multiplexed_model_id,  # noqa: F401
+                        multiplexed)
 
 __all__ = [
     "AutoscalingConfig", "Application", "Deployment", "DeploymentHandle",
     "MeshDeployment", "delete", "deployment", "get_deployment_handle",
-    "run", "shutdown", "start_http_proxy", "status",
+    "get_multiplexed_model_id", "multiplexed", "run", "shutdown",
+    "start_http_proxy", "status",
 ]
 
 

@@ -40,10 +40,33 @@ class DeploymentResponse:
         return self._fut.done()
 
 
+class DeploymentResponseGenerator:
+    """Iterator over a streaming deployment response (ref: handle.py
+    DeploymentResponseGenerator). Wraps the core ObjectRefGenerator:
+    chunks arrive with backpressure; dropping the iterator cancels the
+    producer through the streaming-returns protocol."""
+
+    def __init__(self, ref_gen):
+        self._gen = ref_gen
+
+    def __iter__(self):
+        for ref in self._gen:
+            yield ray_tpu.get(ref)
+
+    def __next__(self):
+        return ray_tpu.get(next(self._gen))
+
+
 class DeploymentHandle:
     def __init__(self, deployment_name: str):
         self._name = deployment_name
         self._init_local()
+
+    def options(self, *, stream: bool = False,
+                multiplexed_model_id: str = "") -> "_OptionsHandle":
+        """ref: handle.py DeploymentHandle.options(stream=...,
+        multiplexed_model_id=...)."""
+        return _OptionsHandle(self, stream, multiplexed_model_id)
 
     def _init_local(self) -> None:
         self._controller = None
@@ -131,9 +154,62 @@ class DeploymentHandle:
             out[i] = depth
         return out
 
-    def _pick(self):
+    _MUX_TTL = 1.0  # seconds the resident-model map stays fresh
+
+    def _mux_candidates(self, mux_id: str) -> list:
+        """Replicas already hosting mux_id (ref: router.py
+        multiplexed_model_ids-aware ranking). The resident-model map is
+        probed through the control lane with its own TTL cache."""
+        now = time.monotonic()
+        with self._lock:
+            replicas = list(self._replicas)
+            cache = getattr(self, "_mux_cache", None)
+            if cache is None:
+                cache = self._mux_cache = {}
+        # fan the probes out BEFORE collecting: R sequential 1s-timeout
+        # gets would stall routing by up to R seconds on hung replicas
+        stale = []
+        for r in replicas:
+            hit = cache.get(r._actor_id)
+            if hit is None or now - hit[0] >= self._MUX_TTL:
+                try:
+                    ref = r.multiplexed_model_ids.options(
+                        concurrency_group="control").remote()
+                except Exception:
+                    ref = None
+                stale.append((r, ref))
+        for r, ref in stale:
+            ids = []
+            if ref is not None:
+                try:
+                    ids = ray_tpu.get(ref, timeout=1.0)
+                except Exception:
+                    ids = []
+            with self._lock:
+                cache[r._actor_id] = (time.monotonic(), set(ids))
+        hosts = []
+        with self._lock:
+            for r in replicas:
+                hit = cache.get(r._actor_id)
+                if hit is not None and mux_id in hit[1]:
+                    hosts.append(r)
+        return hosts
+
+    def _pick(self, mux_id: str = ""):
         """-> replica handle, or None when all replicas are saturated or
         unknown (caller backs off / refreshes)."""
+        if mux_id:
+            hosts = self._mux_candidates(mux_id)
+            if hosts:
+                depths = self._probe_depths(hosts)
+                j = min(range(len(hosts)), key=lambda i: depths[i])
+                if depths[j] < self._max_q:
+                    with self._lock:
+                        aid = hosts[j]._actor_id
+                        self._inflight[aid] = self._inflight.get(aid, 0) + 1
+                    return hosts[j]
+            # no replica hosts the model (or all saturated): fall through
+            # to plain p2c — the chosen replica will load it
         with self._lock:
             n = len(self._replicas)
             if n == 0:
@@ -156,14 +232,19 @@ class DeploymentHandle:
 
     # -- the router worker ----------------------------------------------------
 
-    def _route_blocking(self, method: str, args, kwargs, deadline: float):
+    def _route_blocking(self, method: str, args, kwargs, deadline: float,
+                        mux_id: str = ""):
         import ray_tpu.core.runtime as runtime_mod
 
+        if mux_id:
+            from .multiplex import MUX_KWARG
+
+            kwargs = {**kwargs, MUX_KWARG: mux_id}
         rt = runtime_mod.get_runtime()
         backoff = 0.005
         while True:
             self._refresh()
-            replica = self._pick()
+            replica = self._pick(mux_id)
             if replica is None:
                 if time.monotonic() > deadline:
                     raise TimeoutError(
@@ -194,7 +275,8 @@ class DeploymentHandle:
                     else:
                         self._inflight[aid] = c
 
-    def _submit(self, method: str, args, kwargs) -> DeploymentResponse:
+    def _submit(self, method: str, args, kwargs,
+                mux_id: str = "") -> DeploymentResponse:
         with self._lock:
             if self._router is None:
                 self._router = ThreadPoolExecutor(
@@ -202,8 +284,43 @@ class DeploymentHandle:
             router = self._router
         deadline = time.monotonic() + 300.0
         fut = router.submit(self._route_blocking, method, args, kwargs,
-                            deadline)
+                            deadline, mux_id)
         return DeploymentResponse(fut)
+
+    def _submit_streaming(self, method: str, args, kwargs,
+                          mux_id: str = "") -> DeploymentResponseGenerator:
+        """Streaming requests route synchronously (picking a replica is
+        cheap; the chunks themselves are pull-driven) and do NOT re-route
+        mid-stream — a replica death surfaces to the consumer, matching
+        the reference's streaming semantics (http_proxy.py:775)."""
+        if mux_id:
+            from .multiplex import MUX_KWARG
+
+            kwargs = {**kwargs, MUX_KWARG: mux_id}
+        deadline = time.monotonic() + 300.0
+        backoff = 0.005
+        while True:
+            self._refresh()
+            replica = self._pick(mux_id)
+            if replica is not None:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"{self._name}: no replica available")
+            time.sleep(backoff + random.random() * backoff)
+            backoff = min(backoff * 2, 0.25)
+            self._refresh(force=True)
+        aid = replica._actor_id
+        try:
+            ref_gen = replica.handle_request_streaming.options(
+                num_returns="streaming").remote(method, args, kwargs)
+        finally:
+            with self._lock:
+                c = self._inflight.get(aid, 0) - 1
+                if c <= 0:
+                    self._inflight.pop(aid, None)
+                else:
+                    self._inflight[aid] = c
+        return DeploymentResponseGenerator(ref_gen)
 
     # -- public API ------------------------------------------------------------
 
@@ -226,3 +343,42 @@ class _MethodCaller:
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         return self._handle._submit(self._method, args, kwargs)
+
+
+class _OptionsHandle:
+    """handle.options(stream=..., multiplexed_model_id=...) view — same
+    underlying routing state, different submission mode."""
+
+    def __init__(self, handle: DeploymentHandle, stream: bool,
+                 mux_id: str):
+        self._handle = handle
+        self._stream = stream
+        self._mux_id = mux_id
+
+    def options(self, *, stream: Optional[bool] = None,
+                multiplexed_model_id: Optional[str] = None
+                ) -> "_OptionsHandle":
+        return _OptionsHandle(
+            self._handle,
+            self._stream if stream is None else stream,
+            self._mux_id if multiplexed_model_id is None
+            else multiplexed_model_id)
+
+    def remote(self, *args, **kwargs):
+        if self._stream:
+            return self._handle._submit_streaming("__call__", args, kwargs,
+                                                  self._mux_id)
+        return self._handle._submit("__call__", args, kwargs, self._mux_id)
+
+    def __getattr__(self, item: str):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        h, stream, mux = self._handle, self._stream, self._mux_id
+
+        class _Caller:
+            def remote(self, *args, **kwargs):
+                if stream:
+                    return h._submit_streaming(item, args, kwargs, mux)
+                return h._submit(item, args, kwargs, mux)
+
+        return _Caller()

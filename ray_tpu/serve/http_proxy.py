@@ -28,6 +28,11 @@ class HTTPProxy:
         proxy = self
 
         class Handler(BaseHTTPRequestHandler):
+            # chunked transfer-encoding is an HTTP/1.1 construct; the
+            # stdlib default of HTTP/1.0 would make streamed replies
+            # invalid for spec-compliant clients
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *a):  # quiet
                 pass
 
@@ -39,9 +44,36 @@ class HTTPProxy:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _stream_reply(self, gen) -> None:
+                """Chunked transfer of a streaming deployment: one JSON
+                line per yielded chunk (ref: http_proxy.py:775 streaming
+                via ASGI; NDJSON is the framework-free equivalent)."""
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(b: bytes) -> None:
+                    self.wfile.write(f"{len(b):X}\r\n".encode())
+                    self.wfile.write(b + b"\r\n")
+
+                try:
+                    for item in gen:
+                        chunk(json.dumps(proxy._jsonable(item)).encode()
+                              + b"\n")
+                except Exception:  # noqa: BLE001
+                    # headers are already on the wire: a clean terminator
+                    # would present the truncated stream as success, and a
+                    # second _reply would corrupt the connection — drop
+                    # the connection so the client sees a framing error
+                    self.close_connection = True
+                    return
+                self.wfile.write(b"0\r\n\r\n")
+
             def _dispatch(self, data) -> None:
                 path = urlparse(self.path)
                 name = path.path.strip("/")
+                q = parse_qs(path.query)
                 if name == "-/routes":
                     self._reply(200, proxy._routes())
                     return
@@ -50,6 +82,15 @@ class HTTPProxy:
                     return
                 try:
                     h = proxy._get_handle(name)
+                    mux = (q.get("model_id") or [""])[0]
+                    if (q.get("stream") or ["0"])[0] in ("1", "true"):
+                        gen = h.options(stream=True,
+                                        multiplexed_model_id=mux
+                                        ).remote(data)
+                        self._stream_reply(gen)
+                        return
+                    if mux:
+                        h = h.options(multiplexed_model_id=mux)
                     ref = h.remote(data)
                     result = ray_tpu.get(ref, timeout=60)
                     self._reply(200, proxy._jsonable(result))
@@ -68,7 +109,8 @@ class HTTPProxy:
 
             def do_GET(self):  # noqa: N802
                 q = parse_qs(urlparse(self.path).query)
-                data = {k: v[0] if len(v) == 1 else v for k, v in q.items()}
+                data = {k: v[0] if len(v) == 1 else v for k, v in q.items()
+                        if k not in ("stream", "model_id")}  # control params
                 self._dispatch(data or None)
 
         self._server = ThreadingHTTPServer((host, port), Handler)

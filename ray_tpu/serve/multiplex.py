@@ -1,0 +1,115 @@
+"""Model multiplexing — many models per replica with LRU residency.
+
+Equivalent of the reference's serve.multiplexed (ref:
+python/ray/serve/multiplex.py _ModelMultiplexWrapper;
+api.py:multiplexed). A deployment decorates its loader with
+@serve.multiplexed(max_num_models_per_replica=N); requests carry a
+model id via handle.options(multiplexed_model_id=...), the router
+prefers replicas that already host that model (routing map from
+replica-reported ids), and the replica's wrapper loads/evicts models
+LRU. On TPU this is the many-LoRA/many-finetune serving pattern: N
+adapter sets resident per mesh replica, routed by id.
+"""
+from __future__ import annotations
+
+import contextvars
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, List
+
+# set by the replica around each request (ref: serve/context.py
+# _serve_request_context.multiplexed_model_id)
+_current_model_id: "contextvars.ContextVar[str]" = contextvars.ContextVar(
+    "serve_multiplexed_model_id", default="")
+
+MUX_KWARG = "__multiplexed_model_id__"  # internal request annotation
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a request: the model id the caller asked for (ref:
+    serve.get_multiplexed_model_id)."""
+    return _current_model_id.get()
+
+
+class _BoundMultiplex:
+    """Per-replica-instance LRU of loaded models."""
+
+    def __init__(self, obj: Any, fn: Callable, max_models: int):
+        self._obj = obj
+        self._fn = fn
+        self._max = max_models
+        self._models: "OrderedDict[str, Any]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def __call__(self, model_id: str) -> Any:
+        with self._lock:
+            if model_id in self._models:
+                self._models.move_to_end(model_id)
+                return self._models[model_id]
+        # load OUTSIDE the lock: loads can be slow (checkpoint reads) and
+        # other requests may be serving resident models meanwhile
+        model = self._fn(self._obj, model_id)
+        with self._lock:
+            self._models[model_id] = model
+            self._models.move_to_end(model_id)
+            while len(self._models) > self._max:
+                _, evicted = self._models.popitem(last=False)
+                del_fn = getattr(evicted, "__del__", None)
+                if callable(del_fn):
+                    try:
+                        del_fn()
+                    except Exception:
+                        pass
+        return model
+
+    def model_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._models)
+
+
+class _MultiplexedMethod:
+    """Descriptor form of the decorator: binds one LRU wrapper per
+    replica instance and registers it so the replica can report its
+    resident model ids to the router."""
+
+    REGISTRY_ATTR = "__serve_multiplex_wrappers__"
+
+    def __init__(self, fn: Callable, max_models: int):
+        self._fn = fn
+        self._max = max_models
+        self._attr = f"__serve_mux_{fn.__name__}__"
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        bound = obj.__dict__.get(self._attr)
+        if bound is None:
+            bound = _BoundMultiplex(obj, self._fn, self._max)
+            obj.__dict__[self._attr] = bound
+            registry = obj.__dict__.setdefault(self.REGISTRY_ATTR, [])
+            registry.append(bound)
+        return bound
+
+
+def multiplexed(max_num_models_per_replica: int = 3):
+    """Decorator for a deployment's model-loader method:
+
+        @serve.multiplexed(max_num_models_per_replica=4)
+        def get_model(self, model_id: str): ...
+
+        def __call__(self, req):
+            model = self.get_model(serve.get_multiplexed_model_id())
+    """
+    def deco(fn: Callable) -> _MultiplexedMethod:
+        return _MultiplexedMethod(fn, max_num_models_per_replica)
+
+    return deco
+
+
+def resident_model_ids(callable_obj: Any) -> List[str]:
+    """All model ids currently loaded across a replica's multiplex
+    wrappers (reported to the router for locality-aware picks)."""
+    out: List[str] = []
+    for w in getattr(callable_obj, _MultiplexedMethod.REGISTRY_ATTR, []):
+        out.extend(w.model_ids())
+    return out

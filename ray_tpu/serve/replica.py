@@ -35,19 +35,52 @@ class Replica:
 
     # -- request path ----------------------------------------------------------
 
+    def _resolve(self, method: str):
+        return (self._callable if method == "__call__"
+                else getattr(self._callable, method))
+
     def handle_request(self, method: str, args: tuple, kwargs: dict):
+        from .multiplex import MUX_KWARG, _current_model_id
+
+        mux_id = kwargs.pop(MUX_KWARG, "")
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        token = _current_model_id.set(mux_id)
         try:
-            if method == "__call__":
-                fn = self._callable
-            else:
-                fn = getattr(self._callable, method)
-            return fn(*args, **kwargs)
+            return self._resolve(method)(*args, **kwargs)
         finally:
+            _current_model_id.reset(token)
             with self._lock:
                 self._ongoing -= 1
+
+    def handle_request_streaming(self, method: str, args: tuple,
+                                 kwargs: dict):
+        """Generator variant: chunks ride the core streaming-returns
+        protocol (ref: _private/replica.py handle_request_streaming;
+        here num_returns='streaming' on this actor method does the
+        backpressure + cancellation)."""
+        from .multiplex import MUX_KWARG, _current_model_id
+
+        mux_id = kwargs.pop(MUX_KWARG, "")
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        token = _current_model_id.set(mux_id)
+        try:
+            result = self._resolve(method)(*args, **kwargs)
+            yield from result
+        finally:
+            _current_model_id.reset(token)
+            with self._lock:
+                self._ongoing -= 1
+
+    def multiplexed_model_ids(self) -> list:
+        """Resident multiplexed models (router locality hints; ref:
+        multiplex.py push of model ids through replica info)."""
+        from .multiplex import resident_model_ids
+
+        return resident_model_ids(self._callable)
 
     # -- control plane ---------------------------------------------------------
 

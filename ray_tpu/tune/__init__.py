@@ -8,11 +8,13 @@ placement groups under a single-threaded controller event loop.
 """
 from ..train.config import RunConfig
 from .schedulers import (ASHAScheduler, AsyncHyperBandScheduler,
-                         FIFOScheduler, MedianStoppingRule,
+                         FIFOScheduler, HyperBandForBOHB,
+                         HyperBandScheduler, MedianStoppingRule,
                          PopulationBasedTraining, TrialScheduler)
 from .search import (BasicVariantGenerator, Choice, Domain, GridSearch,
-                     LogUniform, Randint, RandomSearch, Searcher, Uniform,
-                     choice, grid_search, loguniform, randint, uniform)
+                     LogUniform, Randint, RandomSearch, Searcher,
+                     TPESearcher, TuneBOHB, Uniform, choice, grid_search,
+                     loguniform, randint, uniform)
 from .session import get_checkpoint, report
 from .trainable import Trainable
 from .tuner import (ResultGrid, Trial, TuneConfig, TuneController, Tuner,
@@ -22,8 +24,10 @@ __all__ = [
     "Tuner", "TuneConfig", "TuneController", "ResultGrid", "Trial", "run",
     "Trainable", "report", "get_checkpoint", "RunConfig",
     "TrialScheduler", "FIFOScheduler", "AsyncHyperBandScheduler",
-    "ASHAScheduler", "MedianStoppingRule", "PopulationBasedTraining",
-    "Searcher", "BasicVariantGenerator", "RandomSearch",
+    "ASHAScheduler", "HyperBandScheduler", "HyperBandForBOHB",
+    "MedianStoppingRule", "PopulationBasedTraining",
+    "Searcher", "BasicVariantGenerator", "RandomSearch", "TPESearcher",
+    "TuneBOHB",
     "Domain", "Uniform", "LogUniform", "Randint", "Choice", "GridSearch",
     "uniform", "loguniform", "randint", "choice", "grid_search",
 ]

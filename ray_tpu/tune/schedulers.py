@@ -13,6 +13,9 @@ from typing import Any, Dict, List, Optional
 
 CONTINUE = "CONTINUE"
 STOP = "STOP"
+# checkpoint + release resources; a scheduler resumes it later via
+# controller.resume_trial (ref: trial_scheduler.py PAUSE)
+PAUSE = "PAUSE"
 
 
 class TrialScheduler:
@@ -31,7 +34,15 @@ class TrialScheduler:
         pass
 
     def choose_action(self, controller) -> None:
-        """Hook for schedulers that mutate trials (PBT)."""
+        """Hook for schedulers that mutate trials (PBT) or resume paused
+        ones (HyperBand promotions)."""
+
+    def on_deadlock(self, controller) -> None:
+        """Every live trial is paused and nothing is pending: the
+        scheduler MUST make progress (resume or stop someone). Default:
+        resume everything — safe for schedulers that never pause."""
+        for t in controller.paused_trials():
+            controller.resume_trial(t)
 
 
 class FIFOScheduler(TrialScheduler):
@@ -77,6 +88,150 @@ class AsyncHyperBandScheduler(TrialScheduler):
 
 
 ASHAScheduler = AsyncHyperBandScheduler
+
+
+class _Bracket:
+    """One HyperBand bracket: `target` trials entering at budget r0, then
+    successive halving at rungs r0*eta^k (ref: hyperband.py Bracket)."""
+
+    def __init__(self, s: int, target: int, r0: int, eta: float,
+                 max_t: int):
+        self.s = s
+        self.target = target
+        self.members: set = set()
+        self.live: set = set()
+        self.closed = False  # no further members will join
+        levels = []
+        r = float(r0)
+        while r < max_t:
+            levels.append(max(1, int(round(r))))
+            r *= eta
+        self.levels = levels
+        self.rungs: Dict[int, Dict[str, float]] = {lv: {} for lv in levels}
+        self.waiting: Dict[int, set] = {lv: set() for lv in levels}
+
+    def full(self) -> bool:
+        return len(self.members) >= self.target
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Synchronous HyperBand (ref: schedulers/hyperband.py; Li et al.
+    2018). Brackets are created with their canonical population
+    n_s = ceil((s_max+1) * eta^s / (s+1)) and filled sequentially,
+    exploration-heaviest first (s = s_max down to 0, then repeat). A
+    trial reaching a rung PAUSES (checkpoint + release resources); when
+    every live member of a CLOSED bracket has reported at the rung, the
+    top ceil(n/eta) resume and the rest stop. Brackets close when full,
+    or when the searcher is exhausted (on_deadlock / choose_action with
+    no unassigned trials left). The async variant is
+    AsyncHyperBandScheduler; this one gives the bracket-diversity
+    guarantee BOHB builds on (hb_bohb.py)."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 max_t: int = 81, reduction_factor: float = 3):
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.eta = reduction_factor
+        self.s_max = max(0, int(
+            math.log(max_t) / math.log(reduction_factor) + 1e-9))
+        self._brackets: List[_Bracket] = []
+        self._bracket_of: Dict[str, _Bracket] = {}
+        self._next_s = self.s_max
+
+    def _new_bracket(self) -> _Bracket:
+        s = self._next_s
+        self._next_s = self._next_s - 1 if self._next_s > 0 else self.s_max
+        n = int(math.ceil((self.s_max + 1) * (self.eta ** s) / (s + 1)))
+        r0 = max(1, int(round(self.max_t * (self.eta ** -s))))
+        b = _Bracket(s, n, r0, self.eta, self.max_t)
+        self._brackets.append(b)
+        return b
+
+    def _assign(self, trial) -> _Bracket:
+        b = self._bracket_of.get(trial.trial_id)
+        if b is None:
+            b = next((x for x in self._brackets
+                      if not x.full() and not x.closed), None)
+            if b is None:
+                b = self._new_bracket()
+            b.members.add(trial.trial_id)
+            b.live.add(trial.trial_id)
+            if b.full():
+                b.closed = True
+            self._bracket_of[trial.trial_id] = b
+        return b
+
+    def on_result(self, trial, result: dict) -> str:
+        b = self._assign(trial)
+        t = int(result.get(self.time_attr, 0))
+        if t >= self.max_t:
+            return STOP
+        for level in b.levels:
+            if t >= level and trial.trial_id not in b.rungs[level]:
+                b.rungs[level][trial.trial_id] = self._score(result)
+                b.waiting[level].add(trial.trial_id)
+                return PAUSE
+        return CONTINUE
+
+    def on_complete(self, trial, result: Optional[dict]) -> None:
+        b = self._bracket_of.get(trial.trial_id)
+        if b is not None:
+            b.live.discard(trial.trial_id)
+
+    def _decide_rung(self, b: _Bracket, level: int, controller,
+                     force: bool = False) -> None:
+        waiting = b.waiting[level]
+        if not waiting:
+            return
+        rung = b.rungs[level]
+        if not force and (not b.closed
+                          or any(tid not in rung for tid in b.live)):
+            return  # population incomplete or stragglers still climbing
+        scored = sorted(((s, tid) for tid, s in rung.items()
+                         if tid in b.live), reverse=True)
+        keep = max(1, int(math.ceil(len(scored) / self.eta)))
+        promoted = {tid for _, tid in scored[:keep]}
+        trials = {t.trial_id: t for t in controller.all_trials()}
+        for tid in list(waiting):
+            waiting.discard(tid)
+            t = trials.get(tid)
+            if t is None:
+                continue
+            if tid in promoted:
+                controller.resume_trial(t)
+            else:
+                controller.stop_trial(t)
+
+    def _maybe_close_brackets(self, controller) -> None:
+        """The searcher is exhausted and every trial has a bracket: no
+        bracket will ever gain members — close them all."""
+        if not getattr(controller, "_exhausted", False):
+            return
+        if any(t.trial_id not in self._bracket_of
+               for t in controller.all_trials()
+               if t.status in ("PENDING", "RUNNING", "PAUSED")):
+            return
+        for b in self._brackets:
+            b.closed = True
+
+    def choose_action(self, controller) -> None:
+        self._maybe_close_brackets(controller)
+        for b in self._brackets:
+            for level in b.levels:
+                self._decide_rung(b, level, controller)
+
+    def on_deadlock(self, controller) -> None:
+        # nothing can run and nothing is pending: rung populations will
+        # never complete — force decisions from whatever has reported
+        for b in self._brackets:
+            b.closed = True
+            for level in b.levels:
+                self._decide_rung(b, level, controller, force=True)
+
+
+# BOHB = HyperBand brackets + the TPE model-based searcher
+# (ref: schedulers/hb_bohb.py pairs HyperBandForBOHB with TuneBOHB)
+HyperBandForBOHB = HyperBandScheduler
 
 
 class MedianStoppingRule(TrialScheduler):

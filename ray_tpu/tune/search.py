@@ -111,7 +111,11 @@ class BasicVariantGenerator(Searcher):
     def __init__(self, num_samples: int = 1, seed: Optional[int] = None):
         self.num_samples = num_samples
         self._rng = random.Random(seed)
-        self._iter: Optional[Iterator[Dict[str, Any]]] = None
+        # materialized at first suggest (not a lazy generator) so the
+        # searcher pickles cleanly into experiment-state snapshots and
+        # resumes exactly where it left off
+        self._configs: Optional[List[Dict[str, Any]]] = None
+        self._pos = 0
 
     def _expand(self) -> Iterator[Dict[str, Any]]:
         space = self.param_space
@@ -128,13 +132,158 @@ class BasicVariantGenerator(Searcher):
                 yield cfg
 
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
-        if self._iter is None:
-            self._iter = self._expand()
-        try:
-            return next(self._iter)
-        except StopIteration:
+        if self._configs is None:
+            self._configs = list(self._expand())
+        if self._pos >= len(self._configs):
             return None
+        cfg = self._configs[self._pos]
+        self._pos += 1
+        return cfg
 
 
 class RandomSearch(BasicVariantGenerator):
     """Alias emphasizing pure sampling (no grid keys)."""
+
+
+class TPESearcher(Searcher):
+    """Native Tree-structured Parzen Estimator (the model-based searcher
+    the reference gets from Optuna/BOHB external deps — ref:
+    tune/search/optuna/optuna_search.py, bohb/bohb_search.py TuneBOHB;
+    Bergstra et al. 2011). No external dependency: per-dimension KDEs.
+
+    Observations split into good (top `gamma` quantile) and bad; each
+    candidate is scored by sum_k log(l_k(x)/g_k(x)) where l/g are
+    Gaussian KDEs (continuous dims, log-space for LogUniform) or
+    Laplace-smoothed frequencies (categorical dims) over the good/bad
+    sets; the best of `n_candidates` samples drawn from l() wins."""
+
+    def __init__(self, n_initial_points: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None):
+        self.n_initial = n_initial_points
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self._rng = random.Random(seed)
+        self._obs: List[tuple] = []  # (config, score) — score: higher=better
+        self._suggested: Dict[str, Dict[str, Any]] = {}
+
+    # -- domain helpers ----------------------------------------------------
+
+    def _dims(self):
+        out = {}
+        for k, v in self.param_space.items():
+            if _is_grid(v):
+                out[k] = Choice(list(v["grid_search"]))
+            elif isinstance(v, Domain):
+                out[k] = v
+        return out
+
+    @staticmethod
+    def _to_real(dom, x):
+        return math.log(x) if isinstance(dom, LogUniform) else float(x)
+
+    @staticmethod
+    def _from_real(dom, z):
+        if isinstance(dom, LogUniform):
+            z = math.exp(z)
+            return min(max(z, dom.low), dom.high)
+        if isinstance(dom, Randint):
+            return min(max(int(round(z)), dom.low), dom.high - 1)
+        return min(max(z, dom.low), dom.high)
+
+    def _kde_sample(self, dom, values: List[float]):
+        """Draw from a KDE mixture over observed (real-space) values."""
+        lo = self._to_real(dom, dom.low)
+        hi = self._to_real(dom, dom.high if not isinstance(dom, Randint)
+                           else dom.high - 1)
+        if not values:
+            return self._rng.uniform(lo, hi)
+        bw = max((hi - lo) / max(1.0, math.sqrt(len(values))), 1e-12)
+        center = self._rng.choice(values)
+        return min(max(self._rng.gauss(center, bw), lo), hi)
+
+    @staticmethod
+    def _kde_logpdf(values: List[float], bw: float, x: float) -> float:
+        if not values:
+            return 0.0
+        acc = 0.0
+        for c in values:
+            acc += math.exp(-0.5 * ((x - c) / bw) ** 2)
+        return math.log(max(acc / (len(values) * bw), 1e-300))
+
+    # -- Searcher API ------------------------------------------------------
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        dims = self._dims()
+        fixed = {k: v for k, v in self.param_space.items() if k not in dims}
+        if len(self._obs) < self.n_initial:
+            cfg = {k: d.sample(self._rng) for k, d in dims.items()}
+        else:
+            ranked = sorted(self._obs, key=lambda o: -o[1])
+            n_good = max(1, int(math.ceil(len(ranked) * self.gamma)))
+            good = [c for c, _ in ranked[:n_good]]
+            bad = [c for c, _ in ranked[n_good:]] or good
+            best_cfg, best_score = None, -math.inf
+            for _ in range(self.n_candidates):
+                cand = {}
+                logratio = 0.0
+                for k, dom in dims.items():
+                    if isinstance(dom, Choice):
+                        counts_g = {v: 1.0 for v in map(repr, dom.values)}
+                        counts_b = dict(counts_g)
+                        for c in good:
+                            counts_g[repr(c.get(k))] = counts_g.get(
+                                repr(c.get(k)), 1.0) + 1.0
+                        for c in bad:
+                            counts_b[repr(c.get(k))] = counts_b.get(
+                                repr(c.get(k)), 1.0) + 1.0
+                        zg = sum(counts_g.values())
+                        zb = sum(counts_b.values())
+                        # sample categorical from the good distribution
+                        r = self._rng.random() * zg
+                        pick = dom.values[-1]
+                        for v in dom.values:
+                            r -= counts_g[repr(v)]
+                            if r <= 0:
+                                pick = v
+                                break
+                        cand[k] = pick
+                        logratio += math.log(
+                            (counts_g[repr(pick)] / zg)
+                            / (counts_b[repr(pick)] / zb))
+                    else:
+                        gv = [self._to_real(dom, c[k]) for c in good
+                              if k in c]
+                        bv = [self._to_real(dom, c[k]) for c in bad
+                              if k in c]
+                        lo = self._to_real(dom, dom.low)
+                        hi = self._to_real(
+                            dom, dom.high if not isinstance(dom, Randint)
+                            else dom.high - 1)
+                        bw_g = max((hi - lo) / max(1.0, math.sqrt(
+                            max(1, len(gv)))), 1e-12)
+                        bw_b = max((hi - lo) / max(1.0, math.sqrt(
+                            max(1, len(bv)))), 1e-12)
+                        z = self._kde_sample(dom, gv)
+                        cand[k] = self._from_real(dom, z)
+                        logratio += (self._kde_logpdf(gv, bw_g, z)
+                                     - self._kde_logpdf(bv, bw_b, z))
+                if logratio > best_score:
+                    best_score, best_cfg = logratio, cand
+            cfg = best_cfg or {}
+        cfg.update(fixed)
+        self._suggested[trial_id] = dict(cfg)
+        return cfg
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[dict]) -> None:
+        cfg = self._suggested.pop(trial_id, None)
+        if cfg is None or not result or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if self.mode == "min":
+            score = -score
+        self._obs.append((cfg, score))
+
+
+# the BOHB pairing name (model-based half; pair with HyperBandForBOHB)
+TuneBOHB = TPESearcher

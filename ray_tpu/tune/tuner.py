@@ -10,6 +10,7 @@ configs). PBT exploit/explore swaps checkpoints through the object store
 from __future__ import annotations
 
 import os
+import re
 import time
 import traceback
 from dataclasses import dataclass, field
@@ -21,12 +22,14 @@ import ray_tpu
 from ray_tpu.core.placement_group import placement_group, remove_placement_group
 
 from ..train.config import Result, RunConfig
-from .schedulers import CONTINUE, STOP, FIFOScheduler, PopulationBasedTraining, TrialScheduler
+from .schedulers import (CONTINUE, PAUSE, STOP, FIFOScheduler,
+                         PopulationBasedTraining, TrialScheduler)
 from .search import BasicVariantGenerator, Searcher
 from .trainable import _TrialRunner
 
 PENDING = "PENDING"
 RUNNING = "RUNNING"
+PAUSED = "PAUSED"
 TERMINATED = "TERMINATED"
 ERROR = "ERROR"
 
@@ -48,9 +51,14 @@ class TuneConfig:
 class Trial:
     _next = [0]
 
-    def __init__(self, config: Dict[str, Any]):
-        Trial._next[0] += 1
-        self.trial_id = f"trial_{Trial._next[0]:05d}"
+    @classmethod
+    def next_id(cls) -> str:
+        cls._next[0] += 1
+        return f"trial_{cls._next[0]:05d}"
+
+    def __init__(self, config: Dict[str, Any],
+                 trial_id: Optional[str] = None):
+        self.trial_id = trial_id or Trial.next_id()
         self.config = dict(config)
         self.status = PENDING
         self.runner = None
@@ -134,14 +142,111 @@ class TuneController:
         if not freq and isinstance(self.scheduler, PopulationBasedTraining):
             freq = 1
         self._ckpt_freq = freq
+        self._exp_path: Optional[str] = None
+        self._last_snapshot = 0.0
+
+    # -- experiment state (ref: tune/execution/experiment_state.py
+    # _ExperimentCheckpointManager: periodic driver-side snapshots that
+    # Tuner.restore() resumes from) -----------------------------------------
+
+    def snapshot_state(self) -> dict:
+        trials = []
+        for t in self.trials:
+            trials.append({
+                "trial_id": t.trial_id, "config": dict(t.config),
+                # in-flight trials restart from their latest checkpoint
+                "status": (PENDING if t.status in (RUNNING, PAUSED)
+                           else t.status),
+                "last_result": t.last_result,
+                "metrics_history": list(t.metrics_history),
+                "latest_checkpoint": t.latest_checkpoint,
+            })
+        return {"trials": trials, "searcher": self.searcher,
+                "scheduler": self.scheduler, "exhausted": self._exhausted,
+                "trainable_blob": self._trainable_blob,
+                "metric": self.tc.metric, "mode": self.tc.mode}
+
+    def load_state(self, state: dict) -> None:
+        self.searcher = state["searcher"]
+        self.scheduler = state["scheduler"]
+        self._exhausted = bool(state["exhausted"])
+        self.trials = []
+        max_seq = 0
+        for s in state["trials"]:
+            t = Trial(s["config"], trial_id=s["trial_id"])
+            t.status = s["status"]
+            t.last_result = s["last_result"]
+            t.metrics_history = list(s["metrics_history"])
+            t.latest_checkpoint = s["latest_checkpoint"]
+            self.trials.append(t)
+            m = re.match(r"trial_(\d+)$", s["trial_id"])
+            if m:
+                max_seq = max(max_seq, int(m.group(1)))
+        # new suggestions must not collide with restored trial ids
+        Trial._next[0] = max(Trial._next[0], max_seq)
+
+    def _maybe_snapshot(self, force: bool = False) -> None:
+        if not self._exp_path:
+            return
+        now = time.monotonic()
+        if not force and now - self._last_snapshot < 5.0:
+            return
+        self._last_snapshot = now
+        os.makedirs(self._exp_path, exist_ok=True)
+        path = os.path.join(self._exp_path, "experiment_state.pkl")
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                cloudpickle.dump(self.snapshot_state(), f)
+            os.replace(tmp, path)  # atomic: a crash never truncates
+        except Exception:  # noqa: BLE001 — snapshots are best-effort
+            traceback.print_exc()
 
     # -- scheduler-facing API (ref: pbt.py uses these) -----------------------
 
     def running_trials(self) -> List[Trial]:
         return [t for t in self.trials if t.status == RUNNING]
 
+    def paused_trials(self) -> List[Trial]:
+        return [t for t in self.trials if t.status == PAUSED]
+
     def all_trials(self) -> List[Trial]:
         return list(self.trials)
+
+    def resume_trial(self, trial: Trial) -> None:
+        """Un-pause: restart the runner from the pause checkpoint (ref:
+        tune_controller.py _schedule_trial_resume)."""
+        if trial.status != PAUSED:
+            return
+        try:
+            self._start_runner(trial, checkpoint=trial.latest_checkpoint)
+        except Exception as e:  # noqa: BLE001
+            traceback.print_exc()
+            self._finish(trial, ERROR, e)
+
+    def stop_trial(self, trial: Trial) -> None:
+        """Scheduler-initiated stop of a paused/running trial."""
+        if trial.status in (RUNNING, PAUSED, PENDING):
+            self._finish(trial, TERMINATED)
+
+    def _pause_trial(self, trial: Trial) -> None:
+        """Checkpoint, then RELEASE the actor + placement group — a paused
+        trial must not hold resources or bracket-synchronized schedulers
+        (HyperBand) deadlock the cluster (ref: the reference pauses via
+        save+stop, trial_runner.py)."""
+        try:
+            trial.latest_checkpoint = ray_tpu.get(
+                trial.runner.save.remote(), timeout=60)
+        except Exception:
+            pass
+        self._stop_runner(trial)
+        if trial.pg is not None:
+            try:
+                remove_placement_group(trial.pg)
+            except Exception:
+                pass
+            trial.pg = None
+        trial.status = PAUSED
 
     def exploit_trial(self, trial: Trial, donor: Trial,
                       new_config: Dict[str, Any]) -> None:
@@ -230,16 +335,28 @@ class TuneController:
             if pending:
                 t = pending[0]
             elif not self._exhausted:
-                cfg = self.searcher.suggest(f"trial_{len(self.trials)}")
+                # custom searchers (TPE, ...) suggest indefinitely;
+                # num_samples is the experiment's total-trial budget
+                # (ref: tune_config.py num_samples applies to searchers)
+                if self.tc.search_alg is not None \
+                        and len(self.trials) >= self.tc.num_samples:
+                    self._exhausted = True
+                    return
+                # the id handed to suggest() IS the trial's id — adaptive
+                # searchers key their pending suggestions by it and match
+                # it again in on_trial_complete
+                tid = Trial.next_id()
+                cfg = self.searcher.suggest(tid)
                 if cfg is None:
                     self._exhausted = True
                     return
-                t = Trial(cfg)
+                t = Trial(cfg, trial_id=tid)
                 self.trials.append(t)
             else:
                 return
             try:
-                self._start_runner(t)
+                # restored trials resume from their snapshot checkpoint
+                self._start_runner(t, checkpoint=t.latest_checkpoint)
             except Exception as e:  # noqa: BLE001
                 traceback.print_exc()
                 self._finish(t, ERROR, e)
@@ -247,10 +364,23 @@ class TuneController:
     def run(self) -> List[Trial]:
         while True:
             self._fill()
+            self._maybe_snapshot()
             active = {t.future: t for t in self.running_trials()
                       if t.future is not None}
             if not active:
                 pending = [t for t in self.trials if t.status == PENDING]
+                paused = self.paused_trials()
+                if not pending and paused:
+                    # nothing running, nothing to start: rung populations
+                    # can never complete — the scheduler must force
+                    # progress (promote/stop from incomplete rungs)
+                    self.scheduler.choose_action(self)
+                    if not self.running_trials():
+                        self.scheduler.on_deadlock(self)
+                    if self.running_trials() or \
+                            [t for t in self.trials if t.status == PENDING]:
+                        continue
+                    break  # scheduler refused to act: avoid spinning
                 if not pending and self._exhausted:
                     break
                 if not pending and not self.trials:
@@ -283,6 +413,9 @@ class TuneController:
                 decision = self.scheduler.on_result(trial, result)
                 if decision == STOP or self._should_stop(result):
                     self._finish(trial, TERMINATED)
+                elif decision == PAUSE:
+                    self._pause_trial(trial)
+                    self.scheduler.choose_action(self)
                 else:
                     # PBT may swap the runner (and queue a fresh step)
                     # underneath us — only re-issue if the consumed future
@@ -314,11 +447,44 @@ class Tuner:
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
 
+    _restore_state: Optional[dict] = None
+
+    @classmethod
+    def restore(cls, path: str, trainable: Any = None, *,
+                param_space: Optional[Dict[str, Any]] = None,
+                tune_config: Optional[TuneConfig] = None,
+                run_config: Optional[RunConfig] = None) -> "Tuner":
+        """Resume an interrupted experiment from its state snapshot (ref:
+        tuner.py:200 Tuner.restore + experiment_state.py). Finished trials
+        keep their results; in-flight trials restart from their latest
+        checkpoint; the searcher/scheduler continue with their state."""
+        state_file = os.path.join(path, "experiment_state.pkl")
+        with open(state_file, "rb") as f:
+            state = cloudpickle.load(f)
+        if trainable is None:
+            trainable = cloudpickle.loads(state["trainable_blob"])
+        if tune_config is None:
+            tune_config = TuneConfig(metric=state.get("metric"),
+                                     mode=state.get("mode") or "max")
+        rc = run_config or RunConfig()
+        if os.path.isdir(path):
+            # pin storage back to the restored experiment directory
+            rc.storage_path = os.path.dirname(path.rstrip(os.sep)) or path
+            rc.name = os.path.basename(path.rstrip(os.sep))
+        tuner = cls(trainable, param_space=param_space,
+                    tune_config=tune_config, run_config=rc)
+        tuner._restore_state = state
+        return tuner
+
     def fit(self) -> ResultGrid:
         controller = TuneController(self.trainable, self.param_space,
                                     self.tune_config, self.run_config)
-        trials = controller.run()
         base = self.run_config.resolved_storage_path()
+        controller._exp_path = base
+        if self._restore_state is not None:
+            controller.load_state(self._restore_state)
+        trials = controller.run()
+        controller._maybe_snapshot(force=True)
         os.makedirs(base, exist_ok=True)
         results = []
         for t in trials:
@@ -327,8 +493,12 @@ class Tuner:
                 from ..train.checkpoint import Checkpoint
 
                 ck = Checkpoint.from_dict(t.latest_checkpoint)
+            metrics = dict(t.last_result or {})
+            # the trial's config rides along (ref: ResultGrid results carry
+            # .config; experiment_analysis.py merges config into dataframes)
+            metrics.setdefault("config", dict(t.config))
             results.append(Result(
-                metrics=dict(t.last_result or {}),
+                metrics=metrics,
                 checkpoint=ck,
                 path=os.path.join(base, t.trial_id),
                 error=t.error,

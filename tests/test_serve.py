@@ -243,3 +243,103 @@ def test_serve_batch_error_propagates(cluster):
     fut = h.remote(1)
     with pytest.raises(Exception, match="batch exploded"):
         fut.result(timeout=30)
+
+
+def test_streaming_response_through_handle(cluster):
+    """Generator deployments stream chunks through the core
+    streaming-returns protocol via handle.options(stream=True)."""
+    @serve.deployment
+    class Tokens:
+        def __call__(self, prompt):
+            for i in range(5):
+                yield f"{prompt}-{i}"
+
+    handle = serve.run(Tokens.bind())
+    chunks = list(handle.options(stream=True).remote("tok"))
+    assert chunks == [f"tok-{i}" for i in range(5)]
+
+
+def test_streaming_through_http_proxy(cluster):
+    @serve.deployment
+    class Counter:
+        def __call__(self, body):
+            n = int((body or {}).get("n", 3))
+            for i in range(n):
+                yield {"i": i}
+
+    serve.run(Counter.bind())
+    host, port = serve.start_http_proxy()
+    req = urllib.request.Request(
+        f"http://{host}:{port}/Counter?stream=1",
+        data=json.dumps({"n": 4}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.headers.get("Content-Type") == "application/x-ndjson"
+        lines = [json.loads(ln) for ln in resp.read().splitlines() if ln]
+    assert lines == [{"i": i} for i in range(4)]
+
+
+def test_multiplexed_model_loading_and_lru(cluster):
+    """serve.multiplexed loads per-model state lazily, serves by id and
+    evicts LRU beyond max_num_models_per_replica."""
+    @serve.deployment
+    class MuxModel:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return {"id": model_id}
+
+        def __call__(self, body):
+            model = self.get_model(serve.get_multiplexed_model_id())
+            return {"served_by": model["id"], "loads": list(self.loads)}
+
+    handle = serve.run(MuxModel.bind())
+    r1 = ray_tpu.get(
+        handle.options(multiplexed_model_id="a").remote({}), timeout=30)
+    assert r1["served_by"] == "a" and r1["loads"] == ["a"]
+    # same id again: cache hit, no reload
+    r2 = ray_tpu.get(
+        handle.options(multiplexed_model_id="a").remote({}), timeout=30)
+    assert r2["loads"] == ["a"]
+    # two more ids: LRU capacity 2 evicts "a"
+    ray_tpu.get(handle.options(multiplexed_model_id="b").remote({}),
+                timeout=30)
+    ray_tpu.get(handle.options(multiplexed_model_id="c").remote({}),
+                timeout=30)
+    r3 = ray_tpu.get(
+        handle.options(multiplexed_model_id="a").remote({}), timeout=30)
+    assert r3["loads"] == ["a", "b", "c", "a"]  # "a" reloaded post-evict
+
+
+def test_multiplexed_routing_prefers_resident_replica(cluster):
+    """With several replicas, requests for a model id should keep landing
+    on the replica that already loaded it."""
+    @serve.deployment(num_replicas=2)
+    class Tagged:
+        def __init__(self):
+            import os
+
+            self.pid = os.getpid()
+
+        @serve.multiplexed(max_num_models_per_replica=4)
+        def get_model(self, model_id: str):
+            return model_id
+
+        def __call__(self, body):
+            self.get_model(serve.get_multiplexed_model_id())
+            return self.pid
+
+    handle = serve.run(Tagged.bind())
+    pids = {ray_tpu.get(
+        handle.options(multiplexed_model_id="m1").remote({}), timeout=30)
+        for _ in range(8)}
+    # warm-up may land anywhere; after residency is visible (1s TTL),
+    # routing must stick to one replica
+    time.sleep(1.2)
+    sticky = {ray_tpu.get(
+        handle.options(multiplexed_model_id="m1").remote({}), timeout=30)
+        for _ in range(8)}
+    assert len(sticky) == 1

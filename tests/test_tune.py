@@ -163,3 +163,145 @@ def test_trainer_under_tune(rt):
     # last reported entry per trial: lr * 2
     best = results.get_best_result()
     assert best.metrics["loss"] == pytest.approx(1.0)
+
+
+def test_hyperband_brackets_promote_and_stop(rt):
+    """Synchronous HyperBand: trials pause at rung boundaries, rungs
+    promote the top 1/eta when full, losers stop early."""
+    from ray_tpu.tune import HyperBandScheduler
+
+    iters_run = {}
+
+    def train_fn(config):
+        ck = tune.get_checkpoint()
+        start = (ck or {}).get("it", 0)
+        for i in range(start, 100):
+            tune.report(score=config["q"] * (i + 1),
+                        training_iteration=i + 1,
+                        checkpoint={"it": i + 1})
+
+    results = Tuner(
+        train_fn,
+        param_space={"q": tune.grid_search([1, 2, 3, 4, 5, 6])},
+        tune_config=TuneConfig(
+            metric="score", mode="max", max_concurrent_trials=3,
+            scheduler=HyperBandScheduler(max_t=9, reduction_factor=3)),
+    ).fit()
+    assert len(results) == 6
+    assert not results.errors
+    iters = sorted(len(r.metrics_history) for r in results)
+    # early-stopped losers ran fewer iterations than max_t survivors
+    assert iters[0] < 9
+    assert iters[-1] <= 9
+    best = results.get_best_result()
+    assert best.metrics["config"]["q"] == 6  # highest slope survives
+
+
+def test_tpe_searcher_beats_random_on_quadratic(rt):
+    """TPE concentrates samples near the optimum of a smooth objective."""
+    from ray_tpu.tune import TPESearcher
+
+    def objective(config):
+        x = config["x"]
+        tune.report(loss=(x - 3.0) ** 2)
+
+    searcher = TPESearcher(n_initial_points=6, seed=0)
+    results = Tuner(
+        objective,
+        param_space={"x": tune.uniform(-10.0, 10.0)},
+        tune_config=TuneConfig(metric="loss", mode="min", num_samples=30,
+                               max_concurrent_trials=4,
+                               search_alg=searcher),
+    ).fit()
+    assert len(results) == 30
+    best = results.get_best_result()
+    assert abs(best.metrics["config"]["x"] - 3.0) < 1.5
+    # the second half of suggestions should cluster nearer the optimum
+    xs = [r.metrics["config"]["x"] for r in results]
+    early = sum(abs(x - 3.0) for x in xs[:10]) / 10
+    late = sum(abs(x - 3.0) for x in xs[-10:]) / 10
+    assert late < early
+
+
+def test_tpe_with_choice_and_loguniform(rt):
+    from ray_tpu.tune import TPESearcher
+
+    def objective(config):
+        bonus = 1.0 if config["act"] == "gelu" else 0.0
+        tune.report(score=bonus - abs(config["lr"] - 1e-3) / 1e-3)
+
+    results = Tuner(
+        objective,
+        param_space={"lr": tune.loguniform(1e-5, 1e-1),
+                     "act": tune.choice(["relu", "gelu", "tanh"])},
+        tune_config=TuneConfig(metric="score", mode="max", num_samples=25,
+                               search_alg=TPESearcher(n_initial_points=8,
+                                                      seed=1)),
+    ).fit()
+    assert len(results) == 25
+    assert not results.errors
+
+
+def test_experiment_snapshot_and_restore(rt, tmp_path):
+    """fit() writes experiment_state.pkl; Tuner.restore resumes finished
+    trials without re-running them and completes pending work."""
+    calls = []
+
+    def train_fn(config):
+        for i in range(3):
+            tune.report(score=config["x"] * (i + 1))
+
+    rc = RunConfig(name="exp1", storage_path=str(tmp_path))
+    results = Tuner(
+        train_fn,
+        param_space={"x": tune.grid_search([1, 2, 3])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=rc).fit()
+    assert len(results) == 3
+    state_file = tmp_path / "exp1" / "experiment_state.pkl"
+    assert state_file.exists()
+
+    # restore the finished experiment: results preserved, nothing re-runs
+    restored = Tuner.restore(str(tmp_path / "exp1"), train_fn).fit()
+    assert len(restored) == 3
+    assert restored.get_best_result().metrics["score"] == 9
+
+
+def test_restore_resumes_inflight_trial_from_checkpoint(rt, tmp_path):
+    """A snapshot taken mid-run marks running trials PENDING with their
+    checkpoint; restore must continue from the checkpoint, not iter 0."""
+    import cloudpickle
+
+    from ray_tpu.tune.tuner import TuneController
+
+    def train_fn(config):
+        ck = tune.get_checkpoint()
+        start = (ck or {}).get("it", 0)
+        for i in range(start, 4):
+            tune.report(score=i + 1, it_seen=start,
+                        checkpoint={"it": i + 1})
+
+    rc = RunConfig(name="exp2", storage_path=str(tmp_path))
+    ctrl = TuneController(train_fn, {"x": tune.grid_search([1])},
+                          TuneConfig(metric="score", mode="max"), rc)
+    # hand-build the interrupted state: one trial mid-flight at iter 2
+    state = ctrl.snapshot_state()
+    state["trials"] = [{
+        "trial_id": "trial_mid", "config": {"x": 1}, "status": "PENDING",
+        "last_result": {"score": 2}, "metrics_history": [{"score": 1},
+                                                         {"score": 2}],
+        "latest_checkpoint": {"it": 2},
+    }]
+    state["exhausted"] = True
+    exp_dir = tmp_path / "exp2"
+    exp_dir.mkdir(parents=True)
+    with open(exp_dir / "experiment_state.pkl", "wb") as f:
+        cloudpickle.dump(state, f)
+
+    results = Tuner.restore(str(exp_dir), train_fn).fit()
+    assert len(results) == 1
+    r = results[0]
+    assert r.error is None
+    # resumed from it=2: first report carries it_seen=2, final score 4
+    assert r.metrics["score"] == 4
+    assert r.metrics["it_seen"] == 2
