@@ -56,11 +56,17 @@ def main() -> None:
         cfg = GPTConfig.tiny(dtype=jnp.float32, use_flash=False)
         batch, seq, steps, warmup = 2, 128, 3, 1
     else:
-        # unrolled layers (no scan residual-stacking DUS) + chunked LM head
-        # (no [B,S,V] f32 logits): the measured-best single-chip config
+        # measured-best single-chip config (scripts/mfu_sweep.py r3/r3b):
+        # unrolled layers (no scan residual-stacking DUS), chunked LM head
+        # (no [B,S,V] f32 logits), and remat OFF — everything fits HBM at
+        # B=48, so rematerialising the elementwise chains was pure
+        # overhead (0.409 -> 0.460 MFU). Remaining gap to 0.50 is
+        # per-program overhead in the flash kernel (in-model attention
+        # ~3.2 ms/layer vs ~0.5 ms roofline at d=64; faster than both
+        # jax's official flash and splash kernels at this shape).
         cfg = GPTConfig.small(dtype=jnp.bfloat16, use_flash=True,
-                              scan_layers=False)
-        batch = int(os.environ.get("RTPU_BENCH_BATCH", "64"))
+                              scan_layers=False, remat=False)
+        batch = int(os.environ.get("RTPU_BENCH_BATCH", "40"))
         seq, steps, warmup = 1024, 30, 3
 
     model = GPT(cfg)

@@ -51,6 +51,12 @@ def init(num_cpus: Optional[float] = None,
         if isinstance(existing, DriverRuntime) and not ignore_reinit_error:
             raise RuntimeError(
                 "ray_tpu.init() called twice; pass ignore_reinit_error=True")
+        if runtime_env and isinstance(existing, DriverRuntime):
+            # re-init with a job env must not silently drop it: it becomes
+            # the new job-level default for subsequent submissions
+            from .core import runtime_env as _renv_mod
+
+            existing.default_runtime_env = _renv_mod.validate(runtime_env)
         return existing
     res: Dict[str, float] = dict(resources or {})
     res.setdefault("CPU", float(num_cpus if num_cpus is not None

@@ -29,7 +29,7 @@ from ..exceptions import WorkerCrashedError
 from .config import Config
 from .gcs import NodeInfo
 from .ids import ActorId, NodeId, PlacementGroupId, TaskId, WorkerId
-from .object_store import PlasmaStore
+from .object_store import make_store
 from .resources import ResourceSet, normalize, res_add, res_ge, res_sub
 from .rpc import RpcChannel, RpcServer, cluster_token
 from .task_spec import TaskSpec, TaskType
@@ -86,7 +86,7 @@ class Node:
         self.available = dict(self.total_resources)
         self.labels = labels or {}
         self.session_dir = session_dir
-        self.store = PlasmaStore(
+        self.store = make_store(
             node_id,
             capacity_bytes=int(resources.get("object_store_memory",
                                              config.object_store_memory)),
@@ -299,17 +299,22 @@ class Node:
             else:
                 self.available = res_sub(self.available, worker.lease_resources)
 
+    def _worker_alive(self, w: WorkerHandle) -> bool:
+        return w.channel is not None and not w.channel.closed
+
     def _pop_idle(self, env_hash: str = "") -> Optional[WorkerHandle]:
         """Pop an idle worker compatible with the request's runtime_env:
         one already dedicated to the same env, or a fresh unbound one (it
         gets dedicated on grant). A worker bound to a DIFFERENT env is
         never reused — its process state (env vars, sys.path, cwd) is that
-        environment's (ref: worker_pool.cc runtime-env-keyed pop)."""
+        environment's (ref: worker_pool.cc runtime-env-keyed pop).
+        RemoteNode shares this loop and overrides only _worker_alive
+        (remote workers have no head-side channel object)."""
         kept = []
         found = None
         while self._idle:
             w = self._idle.popleft()
-            if w.state != "idle" or w.channel is None or w.channel.closed:
+            if w.state != "idle" or not self._worker_alive(w):
                 continue
             if w.env_hash is None or w.env_hash == env_hash:
                 found = w

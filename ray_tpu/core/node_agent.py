@@ -29,7 +29,7 @@ from typing import Dict, Optional
 
 from .config import Config
 from .ids import NodeId, ObjectId, WorkerId
-from .object_store import (PlasmaStore, SegmentReader, pull_chunks,
+from .object_store import (make_store, SegmentReader, pull_chunks,
                            read_store_chunk)
 from .rpc import RpcChannel, RpcServer, cluster_token, connect
 
@@ -60,7 +60,7 @@ class NodeAgent:
         self.session_dir = session_dir or os.path.join(
             "/tmp/ray_tpu", f"agent_{self.node_id.hex()[:8]}_{os.getpid()}")
         os.makedirs(self.session_dir, exist_ok=True)
-        self.store = PlasmaStore(
+        self.store = make_store(
             self.node_id,
             capacity_bytes=int(resources.pop("object_store_memory",
                                              self.config.object_store_memory)),

@@ -13,9 +13,10 @@ into the mapped buffer, then seal. Primary copies are pinned (not evictable)
 until the owner releases them; unpinned copies are LRU-evicted or spilled to
 disk under memory pressure (ref: src/ray/raylet/local_object_manager.h:41).
 
-A faster C++ arena-allocator store (ray_tpu/native/) plugs in behind the same
-interface when built; this Python implementation is the always-available
-fallback and the semantics reference.
+The C++ store (ray_tpu/native/store.cpp, wrapped by NativePlasmaStore
+below) plugs in behind the same interface when the toolchain can build
+it — `make_store` picks it by default; this Python implementation is the
+always-available fallback and the semantics reference.
 """
 from __future__ import annotations
 
@@ -253,6 +254,171 @@ class PlasmaStore:
                 self._release_entry(e)
             self._entries.clear()
             self._used = 0
+
+
+class NativePlasmaStore:
+    """PlasmaStore surface over the C++ core (ray_tpu/native/store.cpp):
+    segment lifecycle, LRU/spill/evict decisions, capacity accounting and
+    crc32c seal checksums run native; Python only moves payload bytes
+    through zero-copy memoryviews of the C++-owned mappings. Same
+    file-per-object /dev/shm layout, so SegmentReader and the transfer
+    protocol are untouched."""
+
+    def __init__(self, lib, node_id: NodeId, capacity_bytes: int,
+                 spill_dir: str = "", min_spilling_size: int = 1024 * 1024):
+        self._lib = lib
+        self._node_id = node_id
+        self._prefix = f"rtpu{node_id.hex()[:10]}"
+        if spill_dir:
+            os.makedirs(spill_dir, exist_ok=True)
+        self._h = lib.rtpu_store_open(self._prefix.encode(),
+                                      capacity_bytes,
+                                      spill_dir.encode() or None,
+                                      min_spilling_size)
+        self._destroyed = False
+        self._lock = threading.RLock()
+
+    def segment_name(self, object_id: ObjectId) -> str:
+        return f"{self._prefix}_{object_id.hex()}"
+
+    def _view(self, object_id: ObjectId):
+        import ctypes
+
+        if self._h is None:  # destroyed (simulated node death)
+            return None, 0, False
+        ptr = ctypes.c_void_p()
+        size = ctypes.c_uint64()
+        sealed = ctypes.c_int()
+        rc = self._lib.rtpu_store_get(self._h, object_id.hex().encode(),
+                                      ctypes.byref(ptr), ctypes.byref(size),
+                                      ctypes.byref(sealed))
+        if rc != 0:
+            return None, 0, False
+        n = size.value
+        buf = (ctypes.c_char * max(n, 1)).from_address(ptr.value)
+        return memoryview(buf).cast("B")[:n], n, bool(sealed.value)
+
+    # -- plasma protocol ---------------------------------------------------
+
+    def create(self, object_id: ObjectId, size: int) -> str:
+        with self._lock:
+            if self._h is None:
+                raise ObjectStoreFullError("store destroyed")
+            rc = self._lib.rtpu_store_create(self._h,
+                                             object_id.hex().encode(), size)
+        if rc == -1:
+            raise ObjectStoreFullError(
+                f"Object of {size} bytes exceeds store capacity")
+        if rc != 0:
+            raise ObjectStoreFullError(
+                "Store full and no evictable objects (all pinned)")
+        return self.segment_name(object_id)
+
+    def _call(self, fn, *args) -> int:
+        with self._lock:
+            if self._h is None:
+                return -1
+            return fn(self._h, *args)
+
+    def seal(self, object_id: ObjectId) -> None:
+        self._call(self._lib.rtpu_store_seal, object_id.hex().encode(), 1)
+
+    def put_serialized(self, object_id: ObjectId, sobj: SerializedObject,
+                       pin: bool = True) -> None:
+        self.create(object_id, sobj.total_bytes)
+        mv, _, _ = self._view(object_id)
+        sobj.write_into(mv)
+        del mv
+        if pin:
+            self.pin(object_id)
+        self.seal(object_id)
+
+    def put_bytes(self, object_id: ObjectId, data: bytes,
+                  pin: bool = True) -> None:
+        self.create(object_id, len(data))
+        mv, _, _ = self._view(object_id)
+        mv[:len(data)] = data
+        del mv
+        if pin:
+            self.pin(object_id)
+        self.seal(object_id)
+
+    # -- reads -------------------------------------------------------------
+
+    def contains(self, object_id: ObjectId) -> bool:
+        return self._call(self._lib.rtpu_store_contains,
+                          object_id.hex().encode()) == 1
+
+    def get_bytes(self, object_id: ObjectId) -> Optional[bytes]:
+        with self._lock:
+            mv, n, _ = self._view(object_id)
+            if mv is None:
+                return None
+            out = bytes(mv[:n])
+            del mv
+            return out
+
+    def get_segment(self, object_id: ObjectId) -> Optional[tuple]:
+        with self._lock:
+            mv, n, sealed = self._view(object_id)  # restores spilled
+            if mv is None or not sealed:
+                return None
+            del mv
+            return self.segment_name(object_id), n
+
+    def verify(self, object_id: ObjectId) -> Optional[bool]:
+        """crc32c integrity check of a sealed in-memory object: True ok,
+        False CORRUPTED, None unknown/spilled."""
+        rc = self._call(self._lib.rtpu_store_verify,
+                        object_id.hex().encode())
+        return None if rc < 0 else bool(rc)
+
+    # -- lifetime ----------------------------------------------------------
+
+    def pin(self, object_id: ObjectId) -> None:
+        self._call(self._lib.rtpu_store_pin, object_id.hex().encode(), 1)
+
+    def unpin(self, object_id: ObjectId) -> None:
+        self._call(self._lib.rtpu_store_pin, object_id.hex().encode(), 0)
+
+    def delete(self, object_id: ObjectId) -> None:
+        self._call(self._lib.rtpu_store_delete, object_id.hex().encode())
+
+    def stats(self) -> dict:
+        import ctypes
+
+        vals = [ctypes.c_uint64() for _ in range(5)]
+        with self._lock:
+            if self._h is None:
+                return {"native": True, "destroyed": True}
+            self._lib.rtpu_store_stats(self._h,
+                                       *[ctypes.byref(v) for v in vals])
+        return {"capacity": vals[1].value, "used": vals[0].value,
+                "num_objects": vals[2].value,
+                "num_evictions": vals[3].value,
+                "num_spills": vals[4].value, "native": True}
+
+    def destroy(self) -> None:
+        with self._lock:
+            if self._destroyed:
+                return
+            self._destroyed = True
+            self._lib.rtpu_store_destroy(self._h)
+            self._h = None
+
+
+def make_store(node_id: NodeId, capacity_bytes: int, spill_dir: str = "",
+               min_spilling_size: int = 1024 * 1024):
+    """Native store when the C++ layer builds (default), else the Python
+    reference implementation. RTPU_NATIVE_STORE=0 forces Python."""
+    from ..native import load_store_lib
+
+    lib = load_store_lib()
+    if lib is not None:
+        return NativePlasmaStore(lib, node_id, capacity_bytes, spill_dir,
+                                 min_spilling_size)
+    return PlasmaStore(node_id, capacity_bytes, spill_dir,
+                       min_spilling_size)
 
 
 # ---------------------------------------------------------------------------

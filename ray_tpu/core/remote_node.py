@@ -137,22 +137,10 @@ class RemoteNode(Node):
                 self._starting_count = max(0, self._starting_count - 1)
         self._on_worker_exit(handle)
 
-    def _pop_idle(self, env_hash: str = "") -> Optional[WorkerHandle]:
-        # remote workers have no head-side channel object; liveness is
-        # tracked by agent exit notifications. runtime_env dedication
-        # matches Node._pop_idle: same-env or fresh workers only.
-        kept = []
-        found = None
-        while self._idle:
-            w = self._idle.popleft()
-            if w.state != "idle":
-                continue
-            if w.env_hash is None or w.env_hash == env_hash:
-                found = w
-                break
-            kept.append(w)
-        self._idle.extendleft(reversed(kept))
-        return found
+    def _worker_alive(self, w: WorkerHandle) -> bool:
+        # no head-side channel object; liveness is tracked by agent exit
+        # notifications (the dedication loop lives in Node._pop_idle)
+        return True
 
     def push_task(self, worker: WorkerHandle, spec) -> None:
         from .task_spec import TaskType

@@ -116,6 +116,8 @@ class DriverRuntime:
         self._memory_store: Dict[ObjectId, bytes] = {}
         self._directory: Dict[ObjectId, Set[NodeId]] = {}
         self._events: Dict[ObjectId, threading.Event] = {}
+        self._obj_waiters: Dict[ObjectId, list] = {}
+        self._placement_wake = threading.Event()
         self._recovering: Set[ObjectId] = set()
         self._pull_futures: Dict[ObjectId, Future] = {}
         self._generators: Dict[TaskId, dict] = {}
@@ -458,6 +460,19 @@ class DriverRuntime:
                 ev = self._events[oid] = threading.Event()
             return ev
 
+    def _notify_object(self, oid: ObjectId) -> None:
+        """Object became available: fire its event AND wake any wait()
+        callers multi-waiting on it (threading.Event has no select(); the
+        waiter list is the event-driven replacement for wait()'s old 2 ms
+        polling loop — SURVEY §6's 10k-concurrent-task envelope dies on
+        N_waiters × 500 wakeups/s)."""
+        self._event(oid).set()
+        with self._lock:
+            waiters = self._obj_waiters.pop(oid, None)
+        if waiters:
+            for w in waiters:
+                w.set()
+
     def _object_available(self, oid: ObjectId) -> bool:
         with self._lock:
             if oid in self._memory_store:
@@ -501,18 +516,18 @@ class DriverRuntime:
             node.store.put_serialized(oid, sobj, pin=True)
             with self._lock:
                 self._directory.setdefault(oid, set()).add(node.node_id)
-        self._event(oid).set()
+        self._notify_object(oid)
 
     def store_inline_bytes(self, oid: ObjectId, data: bytes) -> None:
         with self._lock:
             self._memory_store[oid] = data
-        self._event(oid).set()
+        self._notify_object(oid)
 
     def on_object_sealed(self, oid: ObjectId, node_id: NodeId) -> None:
         with self._lock:
             self._directory.setdefault(oid, set()).add(node_id)
         self.refcount.add_owned(oid)
-        self._event(oid).set()
+        self._notify_object(oid)
 
     def _free_object(self, oid: ObjectId) -> None:
         with self._lock:
@@ -714,22 +729,49 @@ class DriverRuntime:
         deadline = None if timeout is None else time.monotonic() + timeout
         pending = list(refs)
         ready: List[ObjectRef] = []
-        while len(ready) < num_returns:
-            progressed = False
+        while True:
             for r in list(pending):
+                if len(ready) >= num_returns:
+                    break  # contract: ready has AT MOST num_returns
+                    # entries (ref: ray.wait docs) — extras stay pending
                 if self._event(r.id).is_set():
                     ready.append(r)
                     pending.remove(r)
-                    progressed = True
-            if len(ready) >= num_returns:
+            if len(ready) >= num_returns or not pending:
                 break
             if deadline is not None and time.monotonic() >= deadline:
                 break
-            if not progressed:
+            # event-driven sleep: one waiter event registered on every
+            # pending object; _notify_object wakes us on the first arrival
+            # (no polling — the old 2 ms loop burned a core per waiter)
+            wake = threading.Event()
+            registered: List[ObjectId] = []
+            fired = False
+            with self._lock:
+                for r in pending:
+                    ev = self._events.get(r.id)
+                    if ev is not None and ev.is_set():
+                        fired = True  # raced a completion: re-scan now
+                        break
+                    self._obj_waiters.setdefault(r.id, []).append(wake)
+                    registered.append(r.id)
+            if not fired:
                 if on_block is not None:
                     on_block()
                     on_block = None
-                time.sleep(0.002)
+                remaining = (None if deadline is None
+                             else max(0.0, deadline - time.monotonic()))
+                wake.wait(remaining)
+            with self._lock:
+                for oid in registered:
+                    ws = self._obj_waiters.get(oid)
+                    if ws is not None:
+                        try:
+                            ws.remove(wake)
+                        except ValueError:
+                            pass
+                        if not ws:
+                            self._obj_waiters.pop(oid, None)
         return ready, pending
 
     # ---- task submission -----------------------------------------------------
@@ -803,7 +845,8 @@ class DriverRuntime:
                         f"dead/unknown node {strat.node_id.hex()[:8]}"))
                     return
             nid = self.scheduler.pick_node(self._views(), demand, strat,
-                                           local_node_id=self.head_node_id)
+                                           local_node_id=self.head_node_id,
+                                           locality=self._arg_locality(spec))
             node = self.nodes.get(nid) if nid is not None else None
         if node is None:
             with self._lock:
@@ -823,11 +866,42 @@ class DriverRuntime:
 
         fut.add_done_callback(_granted)
 
+    def _arg_locality(self, spec: TaskSpec) -> Dict[NodeId, int]:
+        """Bytes of the task's arguments resident per node (the input to
+        the locality-aware lease policy; ref: lease_policy.cc:22 builds
+        the same map from the ownership/locality data). Inline args are
+        location-free and contribute nothing."""
+        weights: Dict[NodeId, int] = {}
+        with self._lock:
+            for ref in spec.arg_refs():
+                oid = ref.id
+                nodes = self._directory.get(oid)
+                if not nodes:
+                    continue
+                blob = self._memory_store.get(oid)
+                # unknown remote sizes weigh 1 MiB: big enough to beat
+                # emptiness, small enough not to drown real size info
+                size = len(blob) if blob is not None else (1 << 20)
+                for nid in nodes:
+                    weights[nid] = weights.get(nid, 0) + size
+        return weights
+
     def _reschedule_parked(self) -> None:
         with self._lock:
             parked, self._parked = self._parked, []
         for spec in parked:
             self._schedule(spec)
+        # wake in-flight PG placers and retry PGs whose placement window
+        # expired before the cluster grew (ref: gcs_placement_group_
+        # scheduler retries pending PGs on node add)
+        self._placement_wake.set()
+        try:
+            pending = [p.pg_id for p in self.gcs.list_pgs()
+                       if p.state == "PENDING"]
+        except Exception:
+            pending = []
+        for pid in pending:
+            self._pool.submit(self._try_place_pg, pid, True)
 
     # ---- streaming generators (ref: core_worker.proto:436) -------------------
 
@@ -1238,12 +1312,36 @@ class DriverRuntime:
         self._pool.submit(self._try_place_pg, pg_id)
         return pg_id
 
-    def _try_place_pg(self, pg_id: PlacementGroupId) -> None:
+    def _try_place_pg(self, pg_id: PlacementGroupId,
+                      single_attempt: bool = False) -> None:
+        """single_attempt=True (retry path) makes ONE placement pass and
+        returns: retries run on the shared _pool, and a blocking
+        wait-for-capacity loop per pending PG would starve the pool's
+        other users (await-ref futures, new PG creations) for up to the
+        whole lease timeout."""
+        with self._lock:
+            placing = getattr(self, "_placing_pgs", None)
+            if placing is None:
+                placing = self._placing_pgs = set()
+            if pg_id in placing:
+                return  # another placer thread already owns this PG
+            placing.add(pg_id)
+        try:
+            self._try_place_pg_locked(pg_id, single_attempt)
+        finally:
+            with self._lock:
+                placing.discard(pg_id)
+
+    def _try_place_pg_locked(self, pg_id: PlacementGroupId,
+                             single_attempt: bool = False) -> None:
         info = self.gcs.get_pg(pg_id)
         if info is None or info.state == "REMOVED":
             return
         deadline = time.monotonic() + self.config.worker_lease_timeout_s
-        while time.monotonic() < deadline:
+        first = True
+        while first or (not single_attempt
+                        and time.monotonic() < deadline):
+            first = False
             placement = self.scheduler.pick_bundle_nodes(
                 self._views(), info.bundles, info.strategy)
             if placement is not None:
@@ -1268,17 +1366,35 @@ class DriverRuntime:
                     return
                 for node, idx in prepared:
                     node.return_bundle(pg_id, idx)
-            time.sleep(0.05)
-        info.state = "PENDING"  # stays pending; tasks against it park
+            # event-with-fallback instead of a 50 ms poll: woken by any
+            # cluster change (_reschedule_parked), 500 ms safety tick
+            self._placement_wake.clear()
+            self._placement_wake.wait(0.5)
+        # stays pending; tasks against it park, and _reschedule_parked
+        # re-submits placement when the cluster changes (node joins)
+        info.state = "PENDING"
 
     def pg_ready(self, pg_id: PlacementGroupId, timeout: float = 30.0) -> bool:
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        """Event-driven: parks on the GCS 'pg' pubsub channel rather than
+        polling get_pg (1k concurrent PGs × 100 polls/s was the first
+        casualty of SURVEY §6's envelope)."""
+        ev = threading.Event()
+
+        def _on_pg(msg) -> None:
+            pid, state = msg
+            if pid == pg_id and state == "CREATED":
+                ev.set()
+
+        unsub = self.gcs.pubsub.subscribe("pg", _on_pg)
+        try:
+            # check AFTER subscribing: a publish between check and
+            # subscribe would otherwise be missed forever
             info = self.gcs.get_pg(pg_id)
             if info is not None and info.state == "CREATED":
                 return True
-            time.sleep(0.01)
-        return False
+            return ev.wait(timeout)
+        finally:
+            unsub()
 
     def remove_placement_group(self, pg_id: PlacementGroupId) -> None:
         info = self.gcs.get_pg(pg_id)
@@ -1790,10 +1906,19 @@ class WorkerRuntime:
             return cur.runtime_env if cur is not None else None
         from . import runtime_env as renv_mod
 
-        return renv_mod.package(
-            renv_mod.validate(renv),
-            lambda k, b: self.kv_put(k, b, namespace=renv_mod.KV_NAMESPACE,
-                                     overwrite=False))
+        validated = renv_mod.validate(renv)
+        key = renv_mod.cache_key(validated)
+        cache = getattr(self, "_renv_cache", None)
+        if cache is None:
+            cache = self._renv_cache = {}
+        cached = cache.get(key)
+        if cached is None:
+            cached = cache[key] = renv_mod.package(
+                validated,
+                lambda k, b: self.kv_put(k, b,
+                                         namespace=renv_mod.KV_NAMESPACE,
+                                         overwrite=False))
+        return cached
 
     def kv_put(self, key, value, namespace="user", overwrite=True):
         return self.channel.call("kv_put", {"key": key, "value": value,

@@ -123,11 +123,29 @@ def package(renv: Optional[dict],
     return out
 
 
+_FP_TTL = 5.0  # seconds a directory fingerprint stays cached
+_fp_cache: Dict[str, tuple] = {}  # path -> (monotonic_ts, fingerprint)
+
+
 def dir_fingerprint(path: str) -> str:
     """Cheap content fingerprint (relpath, size, mtime_ns of every file)
     so submitter-side caches notice edited working_dirs without paying a
-    full re-zip per submission."""
+    full re-zip per submission. The walk itself is memoized for a few
+    seconds — task-submission hot loops must not pay one stat() per
+    tracked file per .remote() call."""
+    import time
+
     path = os.path.abspath(os.path.expanduser(path))
+    hit = _fp_cache.get(path)
+    now = time.monotonic()
+    if hit is not None and now - hit[0] < _FP_TTL:
+        return hit[1]
+    fp = _dir_fingerprint_uncached(path)
+    _fp_cache[path] = (now, fp)
+    return fp
+
+
+def _dir_fingerprint_uncached(path: str) -> str:
     h = hashlib.sha1()
     for root, dirs, files in os.walk(path):
         dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)

@@ -61,6 +61,7 @@ class Scheduler:
         demand: ResourceSet,
         strategy: SchedulingStrategy,
         local_node_id: Optional[NodeId] = None,
+        locality: Optional[Dict[NodeId, int]] = None,
     ) -> Optional[NodeId]:
         if strategy.kind == "NODE_AFFINITY":
             target = next((v for v in views if v.node_id == strategy.node_id), None)
@@ -73,6 +74,19 @@ class Scheduler:
             return None
         if strategy.kind == "SPREAD":
             return self._spread(views, demand)
+        # Locality-aware default policy (ref: core_worker/lease_policy.cc
+        # LocalityAwareLeasePolicy::GetBestNodeForTask — request the lease
+        # from the node holding the most argument bytes): a node already
+        # holding the args skips one or two DCN hops per argument. Only a
+        # node that can run the task NOW wins on locality; otherwise fall
+        # through to hybrid packing.
+        if locality:
+            ranked = sorted(
+                (v for v in views
+                 if locality.get(v.node_id) and _has_available(v, demand)),
+                key=lambda v: -locality[v.node_id])
+            if ranked:
+                return ranked[0].node_id
         return self._hybrid(views, demand, local_node_id)
 
     # -- hybrid: pack onto low-utilization nodes (local first) until the

@@ -1,0 +1,145 @@
+"""Minimal cluster dashboard.
+
+Equivalent of the reference's dashboard backend (ref: dashboard/
+dashboard.py + datacenter.py aggregation; the React frontend is out of
+scope — the reference ships ~1MB of compiled JS). One stdlib HTTP server
+over the existing state API: `/` renders a self-refreshing HTML overview
+(nodes, actors, tasks, placement groups, jobs, object stores) and
+`/api/*` serves the same data as JSON for tooling.
+"""
+from __future__ import annotations
+
+import html
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .util import state as state_api
+
+
+def _jobs_rows():
+    try:
+        from . import jobs
+
+        return jobs.list_jobs()
+    except Exception:
+        return []
+
+
+_API = {
+    "nodes": state_api.list_nodes,
+    "actors": state_api.list_actors,
+    "tasks": lambda: state_api.list_tasks(limit=200),
+    "objects": lambda: state_api.list_objects(limit=200),
+    "placement_groups": state_api.list_placement_groups,
+    "object_store": state_api.object_store_stats,
+    "summary": state_api.summary,
+    "jobs": _jobs_rows,
+}
+
+
+def _table(title: str, rows) -> str:
+    if isinstance(rows, dict):
+        rows = [{"key": k, **v} if isinstance(v, dict) else
+                {"key": k, "value": v} for k, v in rows.items()]
+    if not rows:
+        return f"<h2>{title}</h2><p class='empty'>none</p>"
+    cols = list(rows[0].keys())
+    head = "".join(f"<th>{html.escape(str(c))}</th>" for c in cols)
+    body = "".join(
+        "<tr>" + "".join(
+            f"<td>{html.escape(str(r.get(c, '')))[:64]}</td>"
+            for c in cols) + "</tr>"
+        for r in rows[:100])
+    return (f"<h2>{title} ({len(rows)})</h2>"
+            f"<table><tr>{head}</tr>{body}</table>")
+
+
+_STYLE = """<style>
+body{font-family:monospace;margin:1.5em;background:#fafafa;color:#222}
+table{border-collapse:collapse;margin-bottom:1em;font-size:12px}
+td,th{border:1px solid #ccc;padding:2px 8px;text-align:left}
+th{background:#eee}h1{font-size:18px}h2{font-size:14px;margin:0.6em 0 0.2em}
+.empty{color:#999;font-size:12px}</style>"""
+
+
+class Dashboard:
+    """Serves the overview; run on the head (in-process thread, off the
+    scheduling hot path)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        dash = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                path = self.path.split("?")[0].strip("/")
+                if path.startswith("api/"):
+                    fn = _API.get(path[4:])
+                    if fn is None:
+                        self._send(404, b'{"error": "unknown endpoint"}',
+                                   "application/json")
+                        return
+                    try:
+                        body = json.dumps(fn(), default=str).encode()
+                        self._send(200, body, "application/json")
+                    except Exception as e:  # noqa: BLE001
+                        self._send(500, json.dumps(
+                            {"error": repr(e)}).encode(),
+                            "application/json")
+                    return
+                self._send(200, dash._render().encode(), "text/html")
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="dashboard")
+        self._thread.start()
+
+    def _render(self) -> str:
+        parts = ["<html><head><title>ray_tpu dashboard</title>",
+                 "<meta http-equiv='refresh' content='5'>", _STYLE,
+                 "</head><body><h1>ray_tpu cluster</h1>"]
+        try:
+            parts.append(_table("Summary", [state_api.summary()]))
+            parts.append(_table("Nodes", state_api.list_nodes()))
+            parts.append(_table("Actors", state_api.list_actors()))
+            parts.append(_table("Jobs", _jobs_rows()))
+            parts.append(_table("Placement groups",
+                                state_api.list_placement_groups()))
+            parts.append(_table("Object stores",
+                                state_api.object_store_stats()))
+            parts.append(_table("Recent tasks",
+                                state_api.list_tasks(limit=50)))
+        except Exception as e:  # noqa: BLE001 — render what we can
+            parts.append(f"<p class='empty'>error: {html.escape(repr(e))}"
+                         f"</p>")
+        parts.append("</body></html>")
+        return "".join(parts)
+
+    def address(self) -> tuple:
+        return ("127.0.0.1", self._port)
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+
+
+_dashboard: Optional[Dashboard] = None
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> tuple:
+    """Start (or return) the head's dashboard; -> (host, port)."""
+    global _dashboard
+    if _dashboard is None:
+        _dashboard = Dashboard(host, port)
+    return _dashboard.address()

@@ -7,12 +7,12 @@ object store, sharded ingest for ray_tpu.train workers.
 from .block import Block
 from .context import DataContext
 from .dataset import (ActorPoolStrategy, Dataset, GroupedDataset,
-                      from_blocks, from_items, from_numpy, range, read_csv,
+                      from_arrow, from_blocks, from_items, from_numpy, range, read_csv,
                       read_json, read_numpy, read_parquet)
 from .iterator import DataShard
 
 __all__ = [
     "ActorPoolStrategy", "Block", "DataContext", "DataShard", "Dataset",
-    "GroupedDataset", "from_blocks", "from_items", "from_numpy", "range",
+    "GroupedDataset", "from_arrow", "from_blocks", "from_items", "from_numpy", "range",
     "read_csv", "read_json", "read_numpy", "read_parquet",
 ]

@@ -42,19 +42,80 @@ def block_from_items(items: Sequence[Any]) -> Block:
 
 
 def block_from_batch(batch: Any) -> Block:
-    """Accept a columnar dict, a pandas DataFrame, or a list of rows."""
+    """Accept a columnar dict, a pandas DataFrame, a pyarrow Table, torch
+    tensors, or a list of rows."""
     if batch is None:
         return {}
     if isinstance(batch, dict):
-        return {k: np.asarray(v) if not isinstance(v, np.ndarray) else v
-                for k, v in batch.items()}
+        return {k: _any_to_numpy(v) for k, v in batch.items()}
     if isinstance(batch, (list, tuple)):
         return block_from_items(list(batch))
+    if type(batch).__module__.startswith("pyarrow"):  # Arrow Table
+        return arrow_to_block(batch)
     if hasattr(batch, "to_dict") and hasattr(batch, "columns"):  # DataFrame
         return {c: batch[c].to_numpy() for c in batch.columns}
     if isinstance(batch, np.ndarray):
         return {"item": batch}
     raise TypeError(f"Cannot convert {type(batch).__name__} to a block")
+
+
+def _any_to_numpy(v: Any) -> np.ndarray:
+    if isinstance(v, np.ndarray):
+        return v
+    if type(v).__module__.startswith("torch"):
+        return v.detach().cpu().numpy()
+    if type(v).__module__.startswith("pyarrow"):
+        return v.to_numpy(zero_copy_only=False)
+    return np.asarray(v)
+
+
+# -- Arrow interop (ref: python/ray/data/block.py BlockAccessor.to_arrow /
+# _internal/arrow_block.py). Numeric columns cross zero-copy; strings and
+# nested values go through Arrow's own conversion. --------------------------
+
+def arrow_to_block(table) -> Block:
+    return {name: table.column(name).to_numpy(zero_copy_only=False)
+            for name in table.column_names}
+
+
+def block_to_arrow(block: Block):
+    import pyarrow as pa
+
+    cols = {}
+    for k, v in block.items():
+        if v.dtype == object:
+            cols[k] = pa.array(list(v))
+        elif v.ndim > 1:
+            # tensors become fixed-size lists (ArrowTensorArray analog)
+            flat = pa.array(v.reshape(len(v), -1).tolist())
+            cols[k] = flat
+        else:
+            cols[k] = pa.array(v)  # zero-copy for numeric dtypes
+    return pa.table(cols)
+
+
+def block_to_torch(block: Block, dtypes=None, device: str = "cpu"):
+    """dict of torch tensors; torch.from_numpy is zero-copy on cpu (ref:
+    python/ray/data/iterator.py iter_torch_batches; air/_internal/
+    torch_utils.py convert_ndarray_batch_to_torch_tensor_batch)."""
+    import torch
+
+    out = {}
+    for k, v in block.items():
+        if v.dtype == object:
+            raise TypeError(f"column {k!r} has object dtype; cast it "
+                            f"before iter_torch_batches")
+        arr = np.ascontiguousarray(v)
+        if not arr.flags.writeable:
+            arr = arr.copy()  # torch rejects non-writable zero-copy views
+        t = torch.from_numpy(arr)
+        dt = dtypes.get(k) if isinstance(dtypes, dict) else dtypes
+        if dt is not None:
+            t = t.to(dt)
+        if device not in ("cpu", None):
+            t = t.to(device)
+        out[k] = t
+    return out
 
 
 def block_num_rows(block: Block) -> int:
@@ -97,6 +158,10 @@ def block_to_batch(block: Block, batch_format: str = "numpy") -> Any:
 
         return pd.DataFrame({k: list(v) if v.dtype == object else v
                              for k, v in block.items()})
+    if batch_format in ("pyarrow", "arrow"):
+        return block_to_arrow(block)
+    if batch_format == "torch":
+        return block_to_torch(block)
     if batch_format == "rows":
         return block_to_rows(block)
     raise ValueError(f"Unknown batch_format {batch_format!r}")

@@ -180,6 +180,32 @@ class Dataset:
                                          batch_format, drop_last,
                                          local_shuffle_seed)
 
+    def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
+                           dtypes=None, device: str = "cpu",
+                           drop_last: bool = False,
+                           local_shuffle_seed: Optional[int] = None
+                           ) -> Iterator[Any]:
+        """Batches as dicts of torch tensors (zero-copy from the block's
+        numpy columns on cpu; ref: data/iterator.py iter_torch_batches)."""
+        from .block import block_to_torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last,
+                                       local_shuffle_seed=local_shuffle_seed):
+            yield block_to_torch(batch, dtypes=dtypes, device=device)
+
+    def to_arrow_refs(self) -> List[Any]:
+        """Blocks as pyarrow.Table object refs (ref:
+        dataset.py to_arrow_refs)."""
+        from .block import block_to_arrow
+
+        @ray_tpu.remote
+        def conv(block):
+            return block_to_arrow(block)
+
+        return [conv.remote(ref) for ref in self._execute_refs()]
+
     def iter_rows(self) -> Iterator[Any]:
         for block in self._stream_blocks():
             for row in block_to_rows(block):
@@ -322,6 +348,18 @@ def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
         lo, hi = n * i // parallelism, n * (i + 1) // parallelism
         fns.append(lambda a=lo, b=hi: {"id": np.arange(a, b)})
     return _make_dataset(fns, "range")
+
+
+def from_arrow(tables, *, parallelism: int = -1) -> Dataset:
+    """One or more pyarrow Tables -> Dataset (ref: data/read_api.py
+    from_arrow). A single table splits by row range; a list keeps one
+    block per table."""
+    from .block import arrow_to_block
+
+    if not isinstance(tables, (list, tuple)):
+        return from_numpy(arrow_to_block(tables), parallelism=parallelism)
+    fns = [lambda t=t: arrow_to_block(t) for t in tables]
+    return _make_dataset(fns, "from_arrow")
 
 
 def from_numpy(arrays: Union[np.ndarray, Dict[str, np.ndarray]], *,

@@ -68,6 +68,21 @@ class DataShard:
                                          batch_format, drop_last,
                                          local_shuffle_seed)
 
+    def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
+                           dtypes=None, device: str = "cpu",
+                           drop_last: bool = False,
+                           local_shuffle_seed: Optional[int] = None
+                           ) -> Iterator[Any]:
+        """Train-loop sugar: batches as dicts of torch tensors (ref:
+        iterator.py iter_torch_batches)."""
+        from .block import block_to_torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last,
+                                       local_shuffle_seed=local_shuffle_seed):
+            yield block_to_torch(batch, dtypes=dtypes, device=device)
+
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         for b in self._blocks():
             for row in block_to_rows(b):

@@ -178,11 +178,15 @@ class DeploymentHandle:
                 except Exception:
                     ref = None
                 stale.append((r, ref))
+        # one SHARED deadline for the collection: per-ref 1 s timeouts
+        # would serialize into an R-second stall when replicas hang
+        probe_deadline = time.monotonic() + 1.0
         for r, ref in stale:
             ids = []
             if ref is not None:
                 try:
-                    ids = ray_tpu.get(ref, timeout=1.0)
+                    ids = ray_tpu.get(ref, timeout=max(
+                        0.05, probe_deadline - time.monotonic()))
                 except Exception:
                     ids = []
             with self._lock:

@@ -1,0 +1,10 @@
+"""ray_tpu.util — utility layer over the core API.
+
+Parity with `ray.util` (ref: python/ray/util/__init__.py): ActorPool,
+Queue, the multiprocessing.Pool shim, scheduling strategies, state API,
+and metrics.
+"""
+from .actor_pool import ActorPool  # noqa: F401
+from .queue import Queue  # noqa: F401
+
+__all__ = ["ActorPool", "Queue"]

@@ -1,0 +1,97 @@
+"""ActorPool — fan work over a fixed set of actors.
+
+Parity with the reference's `ray.util.ActorPool`
+(ref: python/ray/util/actor_pool.py — submit/get_next/get_next_unordered,
+map/map_unordered over idle actors, push/pop for membership)."""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, List
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: Iterable[Any]):
+        self._idle: List[Any] = list(actors)
+        self._future_to_actor: dict = {}
+        self._pending_submits: List[tuple] = []
+        self._next_task_index = 0
+        self._index_to_future: dict = {}
+        self._next_return_index = 0
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        """fn(actor, value) -> ObjectRef; queued if no actor is idle
+        (ref: actor_pool.py:81)."""
+        if self._idle:
+            actor = self._idle.pop()
+            ref = fn(actor, value)
+            self._future_to_actor[ref] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = ref
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def _return_actor(self, actor: Any) -> None:
+        self._idle.append(actor)
+        if self._pending_submits:
+            self.submit(*self._pending_submits.pop(0))
+
+    # -- retrieval ---------------------------------------------------------
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor)
+
+    def get_next(self, timeout: float = None) -> Any:
+        """Next result in SUBMISSION order (ref: actor_pool.py:150)."""
+        if not self.has_next():
+            raise StopIteration("No more results to get")
+        ref = self._index_to_future[self._next_return_index]
+        result = ray_tpu.get(ref, timeout=timeout)
+        del self._index_to_future[self._next_return_index]
+        self._next_return_index += 1
+        _, actor = self._future_to_actor.pop(ref)
+        self._return_actor(actor)
+        return result
+
+    def get_next_unordered(self, timeout: float = None) -> Any:
+        """Next result in COMPLETION order (ref: actor_pool.py:188)."""
+        if not self.has_next():
+            raise StopIteration("No more results to get")
+        ready, _ = ray_tpu.wait(list(self._future_to_actor), num_returns=1,
+                                timeout=timeout)
+        if not ready:
+            raise TimeoutError("Timed out waiting for result")
+        ref = ready[0]
+        idx, actor = self._future_to_actor.pop(ref)
+        self._index_to_future.pop(idx, None)
+        self._return_actor(actor)
+        return ray_tpu.get(ref)
+
+    # -- bulk --------------------------------------------------------------
+
+    def map(self, fn: Callable[[Any, Any], Any],
+            values: Iterable[Any]) -> Iterator[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable[[Any, Any], Any],
+                      values: Iterable[Any]) -> Iterator[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    # -- membership --------------------------------------------------------
+
+    def push(self, actor: Any) -> None:
+        self._return_actor(actor)
+
+    def pop_idle(self) -> Any:
+        return self._idle.pop() if self._idle else None
+
+    def has_free(self) -> bool:
+        return bool(self._idle) and not self._pending_submits

@@ -1,0 +1,162 @@
+"""multiprocessing.Pool shim over tasks.
+
+Parity with the reference's `ray.util.multiprocessing.Pool`
+(ref: python/ray/util/multiprocessing/pool.py — drop-in Pool whose
+workers are actors, so existing `from multiprocessing import Pool` code
+scales past one host by changing the import)."""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+class AsyncResult:
+    """ref: pool.py AsyncResult — get/wait/ready/successful."""
+
+    def __init__(self, refs: List[Any], single: bool = False):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        out = ray_tpu.get(self._refs, timeout=timeout)
+        return out[0] if self._single else out
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                                timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            self.get(timeout=0.001)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    """Task-backed process pool. `processes` bounds in-flight tasks (the
+    cluster's CPUs bound real parallelism); initializer runs inside each
+    task via a lazily-applied wrapper since tasks are stateless here."""
+
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: tuple = ()):
+        self._processes = processes or int(
+            ray_tpu.cluster_resources().get("CPU", 1))
+        self._initializer = initializer
+        self._initargs = initargs
+        self._closed = False
+
+    def _wrap(self, func: Callable) -> Callable:
+        init, initargs = self._initializer, self._initargs
+        if init is None:
+            return func
+
+        def wrapped(*a, **kw):
+            init(*initargs)
+            return func(*a, **kw)
+
+        wrapped.__name__ = getattr(func, "__name__", "pool_task")
+        return wrapped
+
+    def _submit_all(self, func: Callable, iterables,
+                    chunksize: Optional[int] = None) -> List[Any]:
+        if self._closed:
+            raise ValueError("Pool not running")
+        remote_fn = ray_tpu.remote(self._wrap(func))
+        items = list(zip(*iterables)) if len(iterables) > 1 \
+            else [(x,) for x in iterables[0]]
+        if chunksize and chunksize > 1:
+            chunks = [items[i:i + chunksize]
+                      for i in range(0, len(items), chunksize)]
+
+            def run_chunk(chunk, _fn=func, _init=self._initializer,
+                          _initargs=self._initargs):
+                if _init is not None:
+                    _init(*_initargs)
+                return [_fn(*args) for args in chunk]
+
+            chunk_fn = ray_tpu.remote(run_chunk)
+            return [chunk_fn.remote(c) for c in chunks], True
+        return [remote_fn.remote(*args) for args in items], False
+
+    @staticmethod
+    def _flatten(results, chunked: bool):
+        if not chunked:
+            return results
+        return list(itertools.chain.from_iterable(results))
+
+    # -- the multiprocessing.Pool surface ---------------------------------
+
+    def apply(self, func: Callable, args: tuple = (), kwds: dict = None):
+        return self.apply_async(func, args, kwds).get()
+
+    def apply_async(self, func: Callable, args: tuple = (),
+                    kwds: dict = None) -> AsyncResult:
+        if self._closed:
+            raise ValueError("Pool not running")
+        remote_fn = ray_tpu.remote(self._wrap(func))
+        return AsyncResult([remote_fn.remote(*args, **(kwds or {}))],
+                           single=True)
+
+    def map(self, func: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        refs, chunked = self._submit_all(func, [iterable], chunksize)
+        return self._flatten(ray_tpu.get(refs), chunked)
+
+    def map_async(self, func: Callable, iterable: Iterable,
+                  chunksize: Optional[int] = None) -> AsyncResult:
+        refs, chunked = self._submit_all(func, [iterable], chunksize)
+        if chunked:
+            raise NotImplementedError("map_async with chunksize")
+        return AsyncResult(refs)
+
+    def starmap(self, func: Callable, iterable: Iterable) -> List[Any]:
+        refs = [ray_tpu.remote(self._wrap(func)).remote(*args)
+                for args in iterable]
+        return ray_tpu.get(refs)
+
+    def imap(self, func: Callable, iterable: Iterable,
+             chunksize: Optional[int] = None):
+        refs, chunked = self._submit_all(func, [iterable], chunksize)
+        for r in refs:
+            v = ray_tpu.get(r)
+            if chunked:
+                yield from v
+            else:
+                yield v
+
+    def imap_unordered(self, func: Callable, iterable: Iterable,
+                       chunksize: Optional[int] = None):
+        refs, chunked = self._submit_all(func, [iterable], chunksize)
+        pending = list(refs)
+        while pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1)
+            v = ray_tpu.get(ready[0])
+            if chunked:
+                yield from v
+            else:
+                yield v
+
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+
+    def join(self) -> None:
+        if not self._closed:
+            raise ValueError("Pool is still running")
+
+    def __enter__(self) -> "Pool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.terminate()

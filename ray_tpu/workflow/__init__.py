@@ -1,0 +1,220 @@
+"""ray_tpu.workflow — durable workflows (checkpointed task DAGs).
+
+Equivalent of the reference's ray.workflow (ref: python/ray/workflow/ —
+api.py run/resume, workflow_storage.py step-result persistence,
+workflow_state_from_storage.py resume). A workflow is a DAG of steps;
+each step runs as a regular task and its result is checkpointed to
+durable storage before dependents see it, so a crashed driver resumes
+from the last completed step instead of re-running the graph.
+
+    @workflow.step
+    def fetch(url): ...
+
+    @workflow.step
+    def merge(a, b): ...
+
+    dag = merge.step(fetch.step("u1"), fetch.step("u2"))
+    result = workflow.run(dag, workflow_id="ingest-2026-07-30")
+    # crash anywhere -> workflow.resume("ingest-2026-07-30")
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+
+# statuses (ref: workflow/common.py WorkflowStatus)
+RUNNING = "RUNNING"
+SUCCESSFUL = "SUCCESSFUL"
+FAILED = "FAILED"
+RESUMABLE = "RESUMABLE"
+
+
+def _storage_root() -> str:
+    return os.environ.get("RTPU_WORKFLOW_STORAGE",
+                          os.path.expanduser("~/ray_tpu_workflows"))
+
+
+@dataclass
+class StepNode:
+    """One node of the DAG; args may contain other StepNodes."""
+    fn_blob: bytes
+    name: str
+    args: tuple = ()
+    kwargs: Dict[str, Any] = field(default_factory=dict)
+    num_cpus: float = 1.0
+    max_retries: int = 3
+
+    def step_key(self, position: str) -> str:
+        """Stable identity: DAG position + code identity — a changed
+        function invalidates its old checkpoint (content addressing the
+        reference gets from step ids)."""
+        h = hashlib.sha1(self.fn_blob).hexdigest()[:8]
+        return f"{position}_{self.name}_{h}"
+
+
+class _StepFunction:
+    def __init__(self, fn: Callable, num_cpus: float = 1.0,
+                 max_retries: int = 3):
+        self._fn = fn
+        self._blob = cloudpickle.dumps(fn)
+        self._name = getattr(fn, "__name__", "step")
+        self._num_cpus = num_cpus
+        self._max_retries = max_retries
+
+    def step(self, *args, **kwargs) -> StepNode:
+        return StepNode(self._blob, self._name, args, kwargs,
+                        self._num_cpus, self._max_retries)
+
+    def options(self, *, num_cpus: float = None,
+                max_retries: int = None) -> "_StepFunction":
+        return _StepFunction(
+            self._fn,
+            self._num_cpus if num_cpus is None else num_cpus,
+            self._max_retries if max_retries is None else max_retries)
+
+    def __call__(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)  # direct local call still works
+
+
+def step(fn: Callable = None, **opts) -> _StepFunction:
+    """Decorator marking a function as a workflow step."""
+    if fn is None:
+        return lambda f: _StepFunction(f, **opts)
+    return _StepFunction(fn)
+
+
+class _Storage:
+    """Filesystem-backed step-result store (ref: workflow_storage.py;
+    any shared filesystem gives cross-host durability)."""
+
+    def __init__(self, workflow_id: str):
+        self.dir = os.path.join(_storage_root(), workflow_id)
+        os.makedirs(os.path.join(self.dir, "steps"), exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.dir, "steps", key + ".pkl")
+
+    def has(self, key: str) -> bool:
+        return os.path.exists(self._path(key))
+
+    def load(self, key: str) -> Any:
+        with open(self._path(key), "rb") as f:
+            return cloudpickle.load(f)
+
+    def save(self, key: str, value: Any) -> None:
+        tmp = self._path(key) + ".tmp"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(value, f)
+        os.replace(tmp, self._path(key))  # atomic: no torn checkpoints
+
+    def meta(self) -> dict:
+        p = os.path.join(self.dir, "workflow.json")
+        if os.path.exists(p):
+            with open(p) as f:
+                return json.load(f)
+        return {}
+
+    def set_meta(self, **kw) -> None:
+        m = self.meta()
+        m.update(kw)
+        tmp = os.path.join(self.dir, "workflow.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(m, f)
+        os.replace(tmp, os.path.join(self.dir, "workflow.json"))
+
+
+def _execute(node: StepNode, storage: _Storage, position: str) -> Any:
+    key = node.step_key(position)
+    if storage.has(key):
+        return storage.load(key)  # completed in a previous run
+    # resolve child steps first (post-order); each child is itself
+    # checkpointed, so a crash mid-graph loses at most one step
+    args = [(_execute(a, storage, f"{position}.{i}")
+             if isinstance(a, StepNode) else a)
+            for i, a in enumerate(node.args)]
+    kwargs = {k: (_execute(v, storage, f"{position}.{k}")
+                  if isinstance(v, StepNode) else v)
+              for k, v in node.kwargs.items()}
+    fn = cloudpickle.loads(node.fn_blob)
+    remote_fn = ray_tpu.remote(fn)
+    ref = remote_fn.options(num_cpus=node.num_cpus,
+                            max_retries=node.max_retries).remote(
+        *args, **kwargs)
+    result = ray_tpu.get(ref)
+    storage.save(key, result)
+    return result
+
+
+def run(dag: StepNode, *, workflow_id: Optional[str] = None) -> Any:
+    """Execute the DAG durably; returns the root step's result.
+    Re-running with the same workflow_id resumes (completed steps are
+    read from storage, not re-executed)."""
+    if not isinstance(dag, StepNode):
+        raise TypeError("workflow.run expects a StepNode "
+                        "(build one with @workflow.step + .step(...))")
+    workflow_id = workflow_id or f"wf_{int(time.time() * 1000)}"
+    storage = _Storage(workflow_id)
+    storage.set_meta(status=RUNNING, started_at=time.time(),
+                     dag_blob_sha=hashlib.sha1(dag.fn_blob).hexdigest())
+    # persist the DAG itself so resume() works without the user's code
+    with open(os.path.join(storage.dir, "dag.pkl"), "wb") as f:
+        cloudpickle.dump(dag, f)
+    try:
+        result = _execute(dag, storage, "root")
+    except BaseException as e:
+        storage.set_meta(status=RESUMABLE, error=repr(e),
+                         failed_at=time.time())
+        raise
+    storage.set_meta(status=SUCCESSFUL, finished_at=time.time())
+    return result
+
+
+def resume(workflow_id: str) -> Any:
+    """Continue an interrupted workflow from its checkpoints (ref:
+    api.py resume)."""
+    storage = _Storage(workflow_id)
+    dag_path = os.path.join(storage.dir, "dag.pkl")
+    if not os.path.exists(dag_path):
+        raise ValueError(f"no workflow {workflow_id!r} in storage")
+    with open(dag_path, "rb") as f:
+        dag = cloudpickle.load(f)
+    storage.set_meta(status=RUNNING, resumed_at=time.time())
+    try:
+        result = _execute(dag, storage, "root")
+    except BaseException as e:
+        storage.set_meta(status=RESUMABLE, error=repr(e),
+                         failed_at=time.time())
+        raise
+    storage.set_meta(status=SUCCESSFUL, finished_at=time.time())
+    return result
+
+
+def get_status(workflow_id: str) -> Optional[str]:
+    return _Storage(workflow_id).meta().get("status")
+
+
+def list_all(status_filter: Optional[str] = None) -> List[tuple]:
+    root = _storage_root()
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for wid in sorted(os.listdir(root)):
+        st = _Storage(wid).meta().get("status")
+        if st and (status_filter is None or st == status_filter):
+            out.append((wid, st))
+    return out
+
+
+def delete(workflow_id: str) -> None:
+    import shutil
+
+    shutil.rmtree(os.path.join(_storage_root(), workflow_id),
+                  ignore_errors=True)

@@ -18,7 +18,8 @@ from ray_tpu.models import GPT, GPTConfig  # noqa: E402
 PEAK = 197e12  # v5e bf16
 
 
-def time_config(name, cfg, batch, loss_kind, steps=6, warmup=2):
+def time_config(name, cfg, batch, loss_kind, steps=6, warmup=2,
+                num_chunks=None):
     model = GPT(cfg)
     tx = optax.adamw(3e-4, weight_decay=0.1)
     params = jax.jit(model.init)(jax.random.PRNGKey(0))
@@ -26,7 +27,14 @@ def time_config(name, cfg, batch, loss_kind, steps=6, warmup=2):
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, 1024), 0,
                                 cfg.vocab_size)
     targets = jnp.roll(tokens, -1, axis=1)
-    loss_fn = model.loss if loss_kind == "plain" else model.loss_chunked
+    if loss_kind == "plain":
+        loss_fn = model.loss
+    elif num_chunks is None:
+        loss_fn = model.loss_chunked
+    else:
+        import functools as _ft
+
+        loss_fn = _ft.partial(model.loss_chunked, num_chunks=num_chunks)
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, tokens, targets):
@@ -84,6 +92,74 @@ def main():
             ("B16 flash plain noremat",
              GPTConfig.small(remat=False, **base), 16, "plain"),
         ]
+    if mode == "r3b":
+        un = dict(scan_layers=False, **base)
+        nc = lambda b, rows: (b * 1024) // rows  # noqa: E731
+        runs = [
+            ("b32 noremat c4096 (r3 best)",
+             GPTConfig.small(remat=False, **un), 32, "chunked", nc(32, 4096)),
+            ("b16 noremat c4096",
+             GPTConfig.small(remat=False, **un), 16, "chunked", nc(16, 4096)),
+            ("b24 noremat c4096",
+             GPTConfig.small(remat=False, **un), 24, "chunked", nc(24, 4096)),
+            ("b32 noremat 512x1024",
+             GPTConfig.small(remat=False, flash_block_q=512, **un),
+             32, "chunked", nc(32, 4096)),
+            ("b32 noremat plain-loss",
+             GPTConfig.small(remat=False, **un), 32, "plain", None),
+            ("b16 noremat plain-loss",
+             GPTConfig.small(remat=False, **un), 16, "plain", None),
+            ("b48 noremat c4096",
+             GPTConfig.small(remat=False, **un), 48, "chunked", nc(48, 4096)),
+            ("b32 noremat scan",
+             GPTConfig.small(remat=False, scan_layers=True, **base),
+             32, "chunked", nc(32, 4096)),
+        ]
+        for name, cfg, b, kind, chunks in runs:
+            try:
+                time_config(name, cfg, b, kind, num_chunks=chunks)
+            except Exception as e:
+                print(f"{name:44s} FAILED: {type(e).__name__}: "
+                      f"{str(e)[:140]}", flush=True)
+        return
+    if mode == "r3":
+        runs = []
+        un = dict(scan_layers=False, **base)
+        nc = lambda b, rows: (b * 1024) // rows  # noqa: E731
+        runs = [
+            ("b64 1024x1024 c4096 (bench now)",
+             GPTConfig.small(**un), 64, "chunked", nc(64, 4096)),
+            ("b64 512x512 c4096",
+             GPTConfig.small(flash_block_q=512, flash_block_k=512, **un),
+             64, "chunked", nc(64, 4096)),
+            ("b64 512x1024 c4096",
+             GPTConfig.small(flash_block_q=512, **un),
+             64, "chunked", nc(64, 4096)),
+            ("b64 256x512 c4096",
+             GPTConfig.small(flash_block_q=256, flash_block_k=512, **un),
+             64, "chunked", nc(64, 4096)),
+            ("b96 1024x1024 c4096",
+             GPTConfig.small(**un), 96, "chunked", nc(96, 4096)),
+            ("b64 1024x1024 c8192",
+             GPTConfig.small(**un), 64, "chunked", nc(64, 8192)),
+            ("b64 1024x1024 c16384",
+             GPTConfig.small(**un), 64, "chunked", nc(64, 16384)),
+            ("b64 1024x1024 c2048",
+             GPTConfig.small(**un), 64, "chunked", nc(64, 2048)),
+            ("b32 noremat c4096",
+             GPTConfig.small(remat=False, **un), 32, "chunked", nc(32, 4096)),
+            ("b64 noremat c4096",
+             GPTConfig.small(remat=False, **un), 64, "chunked", nc(64, 4096)),
+            ("b48 1024x1024 c4096",
+             GPTConfig.small(**un), 48, "chunked", nc(48, 4096)),
+        ]
+        for name, cfg, b, kind, chunks in runs:
+            try:
+                time_config(name, cfg, b, kind, num_chunks=chunks)
+            except Exception as e:
+                print(f"{name:44s} FAILED: {type(e).__name__}: "
+                      f"{str(e)[:140]}", flush=True)
+        return
     for name, cfg, b, kind in runs:
         try:
             time_config(name, cfg, b, kind)

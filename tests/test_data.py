@@ -227,3 +227,54 @@ def test_groupby_multi_aggregate_and_chain(cluster):
             .sort("k").take_all())
     assert [(r["k"], r["count()"], r["sum(v)"]) for r in rows] == [
         (0, 2, 4.0), (1, 2, 6.0), (2, 1, 5.0)]
+
+
+def test_arrow_block_interop(cluster):
+    import numpy as np
+    import pyarrow as pa
+
+    table = pa.table({"x": np.arange(50, dtype=np.int64),
+                      "name": [f"row{i}" for i in range(50)]})
+    ds = rd.from_arrow(table, parallelism=4)
+    assert ds.count() == 50
+    # numpy -> arrow roundtrip via batch_format
+    batches = list(ds.iter_batches(batch_size=None, batch_format="pyarrow"))
+    assert all(isinstance(b, pa.Table) for b in batches)
+    assert sum(b.num_rows for b in batches) == 50
+    refs = rd.from_numpy({"v": np.arange(10)}).to_arrow_refs()
+    tabs = ray_tpu.get(refs, timeout=60)
+    assert sum(t.num_rows for t in tabs) == 10
+
+
+def test_iter_torch_batches(cluster):
+    import numpy as np
+    import torch
+
+    ds = rd.from_numpy({"x": np.arange(32, dtype=np.float32),
+                        "y": np.arange(32, dtype=np.int64)})
+    total = 0
+    for batch in ds.iter_torch_batches(batch_size=8):
+        assert isinstance(batch["x"], torch.Tensor)
+        assert batch["x"].dtype == torch.float32
+        assert batch["y"].dtype == torch.int64
+        total += len(batch["x"])
+    assert total == 32
+    # dtype override
+    b = next(ds.iter_torch_batches(batch_size=4,
+                                   dtypes={"x": torch.float64,
+                                           "y": torch.int32}))
+    assert b["x"].dtype == torch.float64 and b["y"].dtype == torch.int32
+
+
+def test_shard_iter_torch_batches(cluster):
+    import numpy as np
+    import torch
+
+    ds = rd.from_numpy({"x": np.arange(20, dtype=np.float32)})
+    shards = ds.split_shards(2)
+    seen = 0
+    for shard in shards:
+        for batch in shard.iter_torch_batches(batch_size=5):
+            assert isinstance(batch["x"], torch.Tensor)
+            seen += len(batch["x"])
+    assert seen == 20

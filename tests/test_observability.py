@@ -107,3 +107,34 @@ def test_cli_list_and_timeline(cluster, tmp_path, capsys):
     assert cli_main(["timeline", "--output", trace]) == 0
     with open(trace) as f:
         assert isinstance(json.load(f), list)
+
+
+def test_dashboard_serves_overview_and_api(cluster):
+    import json as _json
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu.dashboard import Dashboard
+
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    ray_tpu.get([noop.remote() for _ in range(3)], timeout=60)
+    dash = Dashboard(port=0)  # ephemeral port
+    try:
+        host, port = dash.address()
+        with urllib.request.urlopen(f"http://{host}:{port}/",
+                                    timeout=10) as r:
+            page = r.read().decode()
+        assert "ray_tpu cluster" in page and "Nodes" in page
+        with urllib.request.urlopen(f"http://{host}:{port}/api/summary",
+                                    timeout=10) as r:
+            s = _json.load(r)
+        assert s["nodes_alive"] >= 1
+        with urllib.request.urlopen(f"http://{host}:{port}/api/nodes",
+                                    timeout=10) as r:
+            nodes = _json.load(r)
+        assert len(nodes) >= 1
+    finally:
+        dash.shutdown()

@@ -159,7 +159,8 @@ def test_packaging_roundtrip_deterministic(tmp_path):
 def test_edited_working_dir_ships_fresh_package(cluster, tmp_path):
     """The submitter cache must notice content edits, not just paths."""
     import os as _os
-    import time as _time
+
+    from ray_tpu.core import runtime_env as renv_mod
 
     proj = tmp_path / "editproj"
     proj.mkdir()
@@ -177,5 +178,8 @@ def test_edited_working_dir_ships_fresh_package(cluster, tmp_path):
     st = _os.stat(proj / "version.txt")
     _os.utime(proj / "version.txt", ns=(st.st_atime_ns,
                                         st.st_mtime_ns + 1_000_000))
+    # the fingerprint walk is TTL-memoized (edits surface within ~5s);
+    # tests drop the memo instead of sleeping
+    renv_mod._fp_cache.clear()
     assert ray_tpu.get(read_version.options(runtime_env=env).remote(),
                        timeout=60) == "v2"

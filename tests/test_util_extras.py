@@ -1,0 +1,91 @@
+"""ray_tpu.util.ActorPool + multiprocessing.Pool shim (ref test models:
+python/ray/tests/test_actor_pool.py, test_multiprocessing.py)."""
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool
+from ray_tpu.util.multiprocessing import Pool
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    rt = ray_tpu.init(num_cpus=4)
+    yield rt
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote(num_cpus=0.25)
+class Doubler:
+    def double(self, x):
+        return 2 * x
+
+    def slow_double(self, x):
+        import time
+
+        time.sleep(0.05 * (3 - x))  # later submissions finish first
+        return 2 * x
+
+
+def _cleanup(pool):
+    while True:
+        a = pool.pop_idle()
+        if a is None:
+            break
+        ray_tpu.kill(a)
+
+
+def test_actor_pool_map_ordered(cluster):
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(6)))
+    assert out == [0, 2, 4, 6, 8, 10]
+    _cleanup(pool)
+
+
+def test_actor_pool_map_unordered_completion_order(cluster):
+    pool = ActorPool([Doubler.remote() for _ in range(3)])
+    out = list(pool.map_unordered(
+        lambda a, v: a.slow_double.remote(v), [0, 1, 2]))
+    assert sorted(out) == [0, 2, 4]
+    assert out == [4, 2, 0]  # reverse sleep order == completion order
+    _cleanup(pool)
+
+
+def test_actor_pool_submit_get_next(cluster):
+    pool = ActorPool([Doubler.remote()])
+    pool.submit(lambda a, v: a.double.remote(v), 10)
+    pool.submit(lambda a, v: a.double.remote(v), 20)  # queued: one actor
+    assert pool.has_next()
+    assert pool.get_next(timeout=30) == 20
+    assert pool.get_next(timeout=30) == 40
+    assert not pool.has_next()
+    with pytest.raises(StopIteration):
+        pool.get_next()
+    _cleanup(pool)
+
+
+def _sq(x):
+    return x * x
+
+
+def test_mp_pool_map_and_starmap(cluster):
+    with Pool(processes=2) as pool:
+        assert pool.map(_sq, range(6)) == [0, 1, 4, 9, 16, 25]
+        assert pool.starmap(pow, [(2, 3), (3, 2)]) == [8, 9]
+
+
+def test_mp_pool_apply_async_and_imap(cluster):
+    pool = Pool()
+    r = pool.apply_async(_sq, (7,))
+    assert r.get(timeout=30) == 49
+    assert pool.apply(_sq, (8,)) == 64
+    assert list(pool.imap(_sq, [1, 2, 3])) == [1, 4, 9]
+    assert sorted(pool.imap_unordered(_sq, [1, 2, 3])) == [1, 4, 9]
+    pool.close()
+    with pytest.raises(ValueError):
+        pool.map(_sq, [1])
+
+
+def test_mp_pool_chunksize(cluster):
+    with Pool() as pool:
+        assert pool.map(_sq, range(10), chunksize=3) == [
+            x * x for x in range(10)]

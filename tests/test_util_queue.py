@@ -1,0 +1,104 @@
+"""ray_tpu.util.queue — actor-backed distributed FIFO (ref test model:
+python/ray/tests/test_queue.py)."""
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    rt = ray_tpu.init(num_cpus=4)
+    yield rt
+    ray_tpu.shutdown()
+
+
+def test_fifo_roundtrip(cluster):
+    q = Queue()
+    for i in range(5):
+        q.put(i)
+    assert q.qsize() == 5
+    assert [q.get() for _ in range(5)] == [0, 1, 2, 3, 4]
+    assert q.empty()
+    q.shutdown()
+
+
+def test_get_timeout_and_nowait(cluster):
+    q = Queue()
+    with pytest.raises(Empty):
+        q.get_nowait()
+    t0 = time.monotonic()
+    with pytest.raises(Empty):
+        q.get(timeout=0.3)
+    assert 0.2 < time.monotonic() - t0 < 5.0
+    q.shutdown()
+
+
+def test_blocked_get_woken_by_put(cluster):
+    """A parked consumer wakes on produce — no client-side polling."""
+    q = Queue()
+    out = []
+
+    def consumer():
+        out.append(q.get(timeout=15))
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.3)  # consumer is parked inside the actor
+    q.put("payload")
+    t.join(timeout=15)
+    assert out == ["payload"]
+    q.shutdown()
+
+
+def test_maxsize_full_and_unblock(cluster):
+    q = Queue(maxsize=1)
+    q.put("a")
+    with pytest.raises(Full):
+        q.put("b", block=False)
+    with pytest.raises(Full):
+        q.put("b", timeout=0.2)
+
+    def drain():
+        time.sleep(0.3)
+        q.get()
+
+    t = threading.Thread(target=drain)
+    t.start()
+    q.put("b", timeout=10)  # unblocks when the drain frees a slot
+    t.join(timeout=10)
+    assert q.get() == "b"
+    q.shutdown()
+
+
+def test_get_batch(cluster):
+    q = Queue()
+    for i in range(10):
+        q.put(i)
+    assert q.get_batch(4) == [0, 1, 2, 3]
+    assert q.get_batch(100) == [4, 5, 6, 7, 8, 9]
+    assert q.get_batch(2) == []
+    q.shutdown()
+
+
+def test_queue_between_tasks(cluster):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return "done"
+
+    @ray_tpu.remote
+    def consumer(q, n):
+        return [q.get(timeout=30) for _ in range(n)]
+
+    p = producer.remote(q, 8)
+    c = consumer.remote(q, 8)
+    assert ray_tpu.get(c, timeout=60) == list(range(8))
+    assert ray_tpu.get(p, timeout=60) == "done"
+    q.shutdown()
