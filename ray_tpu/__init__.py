@@ -38,6 +38,8 @@ def init(num_cpus: Optional[float] = None,
          num_tpus: Optional[float] = None,
          num_nodes: int = 1,
          resources: Optional[Dict[str, float]] = None,
+         address: Optional[str] = None,
+         authkey: Optional[str] = None,
          namespace: str = "default",
          system_config: Optional[Dict[str, Any]] = None,
          ignore_reinit_error: bool = False,
@@ -46,6 +48,26 @@ def init(num_cpus: Optional[float] = None,
          **_ignored) -> DriverRuntime:
     """Start (or connect to) the runtime. Inside a worker this is a no-op
     returning the ambient WorkerRuntime, matching the reference's behavior."""
+    if address:
+        # remote-driver mode (the Ray Client equivalent): attach to a
+        # running head instead of starting a local cluster
+        from .client import ClientRuntime
+
+        existing = _runtime_mod.maybe_runtime()
+        if existing is not None:
+            # silently handing back a DIFFERENT cluster's runtime would
+            # run the caller's work on the wrong cluster
+            if getattr(existing, "_address", None) == address:
+                return existing
+            raise RuntimeError(
+                f"ray_tpu.init(address={address!r}) called but this "
+                f"process already has a runtime "
+                f"({type(existing).__name__}); call ray_tpu.shutdown() "
+                f"first")
+        client = ClientRuntime(address, authkey=authkey)
+        client._address = address
+        _runtime_mod.set_runtime(client)
+        return client
     existing = _runtime_mod.maybe_runtime()
     if existing is not None:
         if isinstance(existing, DriverRuntime) and not ignore_reinit_error:

@@ -489,7 +489,9 @@ class Node:
             if method == "seal_object":
                 self.store.seal(payload["object_id"])
                 self.store.pin(payload["object_id"])
-                self.runtime.on_object_sealed(payload["object_id"], self.node_id)
+                self.runtime.on_object_sealed(
+                    payload["object_id"], self.node_id,
+                    size=self.store.object_size(payload["object_id"]))
                 if worker is not None and payload.get("is_put"):
                     # a worker ray_tpu.put: the worker holds the only ref
                     # (its adopt_owned_ref finalizer sends the balancing

@@ -282,6 +282,7 @@ class NodeAgent:
                     "object_id": payload["object_id"],
                     "worker_id": wid,
                     "is_put": bool(payload.get("is_put")),
+                    "size": self.store.object_size(payload["object_id"]),
                 })
                 return True
             if method == "task_done":

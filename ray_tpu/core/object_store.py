@@ -138,6 +138,11 @@ class PlasmaStore:
             self._entries.move_to_end(object_id)
             return bytes(e.shm.buf[: e.size])
 
+    def object_size(self, object_id: ObjectId) -> Optional[int]:
+        with self._lock:
+            e = self._entries.get(object_id)
+            return None if e is None else e.size
+
     def get_segment(self, object_id: ObjectId) -> Optional[tuple[str, int]]:
         """Return (shm_name, size) for zero-copy local access; restores a
         spilled object back into shared memory first if needed."""
@@ -325,23 +330,30 @@ class NativePlasmaStore:
 
     def put_serialized(self, object_id: ObjectId, sobj: SerializedObject,
                        pin: bool = True) -> None:
-        self.create(object_id, sobj.total_bytes)
-        mv, _, _ = self._view(object_id)
-        sobj.write_into(mv)
-        del mv
-        if pin:
-            self.pin(object_id)
-        self.seal(object_id)
+        # the whole create->write->seal sequence runs under the store
+        # lock: a concurrent delete/destroy/re-create of the same oid
+        # would munmap the segment mid-write and the ctypes view write
+        # would SIGSEGV (the Python store fails safe via BufferError;
+        # the native mapping has no such guard)
+        with self._lock:
+            self.create(object_id, sobj.total_bytes)
+            mv, _, _ = self._view(object_id)
+            sobj.write_into(mv)
+            del mv
+            if pin:
+                self.pin(object_id)
+            self.seal(object_id)
 
     def put_bytes(self, object_id: ObjectId, data: bytes,
                   pin: bool = True) -> None:
-        self.create(object_id, len(data))
-        mv, _, _ = self._view(object_id)
-        mv[:len(data)] = data
-        del mv
-        if pin:
-            self.pin(object_id)
-        self.seal(object_id)
+        with self._lock:  # see put_serialized: write under the lock
+            self.create(object_id, len(data))
+            mv, _, _ = self._view(object_id)
+            mv[:len(data)] = data
+            del mv
+            if pin:
+                self.pin(object_id)
+            self.seal(object_id)
 
     # -- reads -------------------------------------------------------------
 
@@ -365,6 +377,14 @@ class NativePlasmaStore:
                 return None
             del mv
             return self.segment_name(object_id), n
+
+    def object_size(self, object_id: ObjectId) -> Optional[int]:
+        with self._lock:
+            mv, n, _ = self._view(object_id)
+            if mv is None:
+                return None
+            del mv
+            return n
 
     def verify(self, object_id: ObjectId) -> Optional[bool]:
         """crc32c integrity check of a sealed in-memory object: True ok,

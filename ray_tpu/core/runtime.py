@@ -117,6 +117,7 @@ class DriverRuntime:
         self._directory: Dict[ObjectId, Set[NodeId]] = {}
         self._events: Dict[ObjectId, threading.Event] = {}
         self._obj_waiters: Dict[ObjectId, list] = {}
+        self._obj_sizes: Dict[ObjectId, int] = {}  # locality weights
         self._placement_wake = threading.Event()
         self._recovering: Set[ObjectId] = set()
         self._pull_futures: Dict[ObjectId, Future] = {}
@@ -231,6 +232,24 @@ class DriverRuntime:
                 from .. import jobs
 
                 return jobs.stop_job(payload)
+            if method == "register_client":
+                # Ray-Client plane (ref: python/ray/util/client/ server/
+                # proxier.py): a REMOTE DRIVER attaches to this running
+                # head; its channel speaks the same worker-call protocol
+                # with byte-valued object transfer (no shared /dev/shm
+                # across hosts). Holder refs key off the client id and are
+                # dropped wholesale on disconnect.
+                shell = _ClientShell(WorkerId.from_random())
+                state["client"] = shell
+                channel.on_close(
+                    lambda cid=shell.worker_id:
+                    self.refcount.release_holder(cid))
+                return {"client_id": shell.worker_id.hex(),
+                        "job_id": self.job_id.hex(),
+                        "namespace": self.namespace}
+            client = state.get("client")
+            if client is not None:
+                return self._handle_client_call(client, method, payload)
             if method == "register_node":
                 node = RemoteNode(self, payload["node_id"],
                                   payload["resources"], self.config, channel,
@@ -267,7 +286,8 @@ class DriverRuntime:
                     node.on_task_done(worker, payload["payload"])
                 return None
             if method == "object_sealed":
-                self.on_object_sealed(payload["object_id"], node.node_id)
+                self.on_object_sealed(payload["object_id"], node.node_id,
+                                      size=payload.get("size"))
                 if payload.get("is_put") and payload.get("worker_id"):
                     self.refcount.add_holder_ref(payload["object_id"],
                                                  payload["worker_id"])
@@ -516,6 +536,7 @@ class DriverRuntime:
             node.store.put_serialized(oid, sobj, pin=True)
             with self._lock:
                 self._directory.setdefault(oid, set()).add(node.node_id)
+                self._obj_sizes[oid] = sobj.total_bytes
         self._notify_object(oid)
 
     def store_inline_bytes(self, oid: ObjectId, data: bytes) -> None:
@@ -523,9 +544,12 @@ class DriverRuntime:
             self._memory_store[oid] = data
         self._notify_object(oid)
 
-    def on_object_sealed(self, oid: ObjectId, node_id: NodeId) -> None:
+    def on_object_sealed(self, oid: ObjectId, node_id: NodeId,
+                         size: Optional[int] = None) -> None:
         with self._lock:
             self._directory.setdefault(oid, set()).add(node_id)
+            if size:
+                self._obj_sizes[oid] = int(size)
         self.refcount.add_owned(oid)
         self._notify_object(oid)
 
@@ -534,6 +558,7 @@ class DriverRuntime:
             self._memory_store.pop(oid, None)
             copies = self._directory.pop(oid, set())
             self._events.pop(oid, None)
+            self._obj_sizes.pop(oid, None)
             nodes = [self.nodes.get(n) for n in copies]
         for node in nodes:
             if node is not None:
@@ -878,10 +903,10 @@ class DriverRuntime:
                 nodes = self._directory.get(oid)
                 if not nodes:
                     continue
-                blob = self._memory_store.get(oid)
-                # unknown remote sizes weigh 1 MiB: big enough to beat
-                # emptiness, small enough not to drown real size info
-                size = len(blob) if blob is not None else (1 << 20)
+                # real sealed sizes tracked at seal/put time; unknown
+                # sizes weigh 1 MiB (big enough to beat emptiness, small
+                # enough not to drown real size info)
+                size = self._obj_sizes.get(oid) or (1 << 20)
                 for nid in nodes:
                     weights[nid] = weights.get(nid, 0) + size
         return weights
@@ -1408,6 +1433,44 @@ class DriverRuntime:
 
     # ---- worker RPC dispatch (the node-side core-worker service) -------------
 
+    def _handle_client_call(self, client: "_ClientShell", method: str,
+                            payload):
+        """Remote-driver calls: object payloads travel as bytes (the
+        client cannot mmap the head's segments); everything else reuses
+        the worker-call surface with the client as the holder identity."""
+        head = self.nodes.get(self.head_node_id)
+        if method == "client_get_objects":
+            out = []
+            for oid in payload["ids"]:
+                res = self.fetch_one(oid, payload.get("timeout"))
+                if res[0] == "inline":
+                    out.append(("inline", res[1]))
+                else:
+                    _, name, size = res
+                    mv = self._reader.read(name, size)
+                    try:
+                        out.append(("inline", bytes(mv[:size])))
+                    finally:
+                        del mv
+                        self._reader.release(name)
+            return out
+        if method == "client_put":
+            oid = payload["object_id"]
+            data = payload["data"]
+            # the HEAD's config (system_config overrides included), not
+            # the module default — DEFAULT doesn't see init() overrides
+            if len(data) <= self.config.max_direct_call_object_size:
+                self.store_inline_bytes(oid, data)
+            else:
+                head.store.put_bytes(oid, data, pin=True)
+                with self._lock:
+                    self._directory.setdefault(oid, set()).add(head.node_id)
+                self._notify_object(oid)
+            self.refcount.add_owned(oid)
+            self.refcount.add_holder_ref(oid, client.worker_id)
+            return True
+        return self.handle_worker_call(head, client, method, payload)
+
     def _block_guard(self, node: Node, worker: Optional[WorkerHandle]):
         """Blocked-worker accounting for worker-originated blocking calls:
         `on_block` (invoked lazily, only if the call actually waits) returns
@@ -1666,6 +1729,20 @@ class _TaskCtx:
     def __init__(self, spec: TaskSpec):
         self.spec = spec
         self.put_index = 0
+
+
+class _ClientShell:
+    """Holder identity + no-op lease surface for a remote-driver client
+    (quacks enough like a WorkerHandle for handle_worker_call and
+    _block_guard; clients hold no lease, so blocking accounting no-ops)."""
+
+    __slots__ = ("worker_id", "lease_resources", "state", "blocked_depth")
+
+    def __init__(self, worker_id: WorkerId):
+        self.worker_id = worker_id
+        self.lease_resources: dict = {}
+        self.state = "client"
+        self.blocked_depth = 0
 
 
 class WorkerRuntime:

@@ -13,8 +13,9 @@ from .llama import Llama, LlamaConfig
 from .resnet import ResNet, ResNetConfig
 from .vit import ViT, ViTConfig
 from .mlp import MLP, MLPConfig
+from .moe import MoE, MoEConfig
 
 __all__ = [
     "GPT", "GPTConfig", "Llama", "LlamaConfig", "ResNet", "ResNetConfig",
-    "ViT", "ViTConfig", "MLP", "MLPConfig",
+    "ViT", "ViTConfig", "MLP", "MLPConfig", "MoE", "MoEConfig",
 ]

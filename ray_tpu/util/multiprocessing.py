@@ -70,7 +70,6 @@ class Pool:
                     chunksize: Optional[int] = None) -> List[Any]:
         if self._closed:
             raise ValueError("Pool not running")
-        remote_fn = ray_tpu.remote(self._wrap(func))
         items = list(zip(*iterables)) if len(iterables) > 1 \
             else [(x,) for x in iterables[0]]
         if chunksize and chunksize > 1:
@@ -85,6 +84,7 @@ class Pool:
 
             chunk_fn = ray_tpu.remote(run_chunk)
             return [chunk_fn.remote(c) for c in chunks], True
+        remote_fn = ray_tpu.remote(self._wrap(func))
         return [remote_fn.remote(*args) for args in items], False
 
     @staticmethod
@@ -119,8 +119,10 @@ class Pool:
         return AsyncResult(refs)
 
     def starmap(self, func: Callable, iterable: Iterable) -> List[Any]:
-        refs = [ray_tpu.remote(self._wrap(func)).remote(*args)
-                for args in iterable]
+        # one wrapper for the whole batch: a fresh remote fn per item
+        # would defeat the export cache (re-pickle + re-export per call)
+        remote_fn = ray_tpu.remote(self._wrap(func))
+        refs = [remote_fn.remote(*args) for args in iterable]
         return ray_tpu.get(refs)
 
     def imap(self, func: Callable, iterable: Iterable,

@@ -53,11 +53,26 @@ class StepNode:
     max_retries: int = 3
 
     def step_key(self, position: str) -> str:
-        """Stable identity: DAG position + code identity — a changed
-        function invalidates its old checkpoint (content addressing the
-        reference gets from step ids)."""
-        h = hashlib.sha1(self.fn_blob).hexdigest()[:8]
-        return f"{position}_{self.name}_{h}"
+        """Stable identity: DAG position + code identity + literal-input
+        identity — a changed function OR changed inputs invalidates the
+        old checkpoint (content addressing the reference gets from step
+        ids). Child StepNodes are replaced by position markers: their own
+        keys already capture their content."""
+        def enc(v):
+            if isinstance(v, StepNode):
+                return b"<step>"
+            try:
+                return cloudpickle.dumps(v)
+            except Exception:
+                return repr(v).encode()
+
+        h = hashlib.sha1(self.fn_blob)
+        for a in self.args:
+            h.update(enc(a))
+        for k in sorted(self.kwargs):
+            h.update(k.encode())
+            h.update(enc(self.kwargs[k]))
+        return f"{position}_{self.name}_{h.hexdigest()[:12]}"
 
 
 class _StepFunction:
@@ -131,25 +146,45 @@ class _Storage:
         os.replace(tmp, os.path.join(self.dir, "workflow.json"))
 
 
-def _execute(node: StepNode, storage: _Storage, position: str) -> Any:
+def _submit(node: StepNode, storage: _Storage, position: str,
+            pending: List[tuple]):
+    """Submit the whole subtree WITHOUT blocking: child results travel as
+    ObjectRefs straight into the parent's arguments, so independent
+    branches run concurrently across the cluster (a serial tree walk
+    would strand an N-way fan-out at 1x parallelism). Returns the ref of
+    this node's result; `pending` collects (key, ref, cached) post-order
+    for the checkpointing pass."""
     key = node.step_key(position)
     if storage.has(key):
-        return storage.load(key)  # completed in a previous run
-    # resolve child steps first (post-order); each child is itself
-    # checkpointed, so a crash mid-graph loses at most one step
-    args = [(_execute(a, storage, f"{position}.{i}")
+        ref = ray_tpu.put(storage.load(key))  # replay from checkpoint
+        pending.append((key, ref, True))
+        return ref
+    args = [(_submit(a, storage, f"{position}.{i}", pending)
              if isinstance(a, StepNode) else a)
             for i, a in enumerate(node.args)]
-    kwargs = {k: (_execute(v, storage, f"{position}.{k}")
+    kwargs = {k: (_submit(v, storage, f"{position}.{k}", pending)
                   if isinstance(v, StepNode) else v)
               for k, v in node.kwargs.items()}
     fn = cloudpickle.loads(node.fn_blob)
-    remote_fn = ray_tpu.remote(fn)
-    ref = remote_fn.options(num_cpus=node.num_cpus,
-                            max_retries=node.max_retries).remote(
-        *args, **kwargs)
-    result = ray_tpu.get(ref)
-    storage.save(key, result)
+    ref = ray_tpu.remote(fn).options(
+        num_cpus=node.num_cpus,
+        max_retries=node.max_retries).remote(*args, **kwargs)
+    pending.append((key, ref, False))
+    return ref
+
+
+def _execute(node: StepNode, storage: _Storage, position: str) -> Any:
+    pending: List[tuple] = []
+    root_ref = _submit(node, storage, position, pending)
+    # checkpoint in post-order (children land before parents); a crash
+    # mid-graph loses only steps whose results hadn't arrived yet
+    result = None
+    for key, ref, cached in pending:
+        result = ray_tpu.get(ref)
+        if not cached:
+            storage.save(key, result)
+    # the root is the last post-order entry
+    assert pending[-1][1] is root_ref
     return result
 
 

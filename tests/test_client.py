@@ -1,0 +1,129 @@
+"""Remote-driver client: a second process drives a running head over TCP
+(ref test model: python/ray/tests/test_client.py)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.rpc import cluster_token
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def head_address():
+    rt = ray_tpu.init(num_cpus=4)
+    addr = rt.enable_remote_nodes(host="127.0.0.1", port=0)
+    yield f"{addr[0]}:{addr[1]}", cluster_token().hex()
+    ray_tpu.shutdown()
+
+
+def _run_client(script: str, address: str, token: str) -> str:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["RTPU_ADDR"] = address
+    env["RTPU_TOKEN"] = token
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run([sys.executable, "-c", script], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, f"stdout={out.stdout}\nstderr={out.stderr}"
+    return out.stdout
+
+
+PREAMBLE = """
+import os
+import ray_tpu
+
+rt = ray_tpu.init(address=os.environ["RTPU_ADDR"],
+                  authkey=os.environ["RTPU_TOKEN"])
+assert getattr(rt, "is_client", False)
+"""
+
+
+def test_client_tasks_and_objects(head_address):
+    addr, token = head_address
+    out = _run_client(PREAMBLE + textwrap.dedent("""
+        import numpy as np
+
+        @ray_tpu.remote
+        def add(a, b):
+            return a + b
+
+        assert ray_tpu.get(add.remote(2, 3), timeout=60) == 5
+        # large object: bytes travel the wire both ways
+        big = ray_tpu.put(np.arange(200_000, dtype=np.int64))
+        doubled = ray_tpu.get(add.remote(big, big), timeout=60)
+        assert doubled[1234] == 2468
+        ready, pending = ray_tpu.wait([add.remote(1, 1)], timeout=30)
+        assert len(ready) == 1 and not pending
+        print("CLIENT-OK")
+    """), addr, token)
+    assert "CLIENT-OK" in out
+
+
+def test_client_actors(head_address):
+    addr, token = head_address
+    out = _run_client(PREAMBLE + textwrap.dedent("""
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.remote()
+        vals = ray_tpu.get([c.incr.remote() for _ in range(5)], timeout=60)
+        assert vals == [1, 2, 3, 4, 5], vals
+        ray_tpu.kill(c)
+        print("ACTOR-OK")
+    """), addr, token)
+    assert "ACTOR-OK" in out
+
+
+def test_cluster_outlives_client(head_address):
+    """A named detached actor created by one client is visible to the
+    next client — the single-controller 'cluster outlives driver' story."""
+    addr, token = head_address
+    _run_client(PREAMBLE + textwrap.dedent("""
+        @ray_tpu.remote
+        class Registry:
+            def __init__(self):
+                self.items = []
+
+            def add(self, x):
+                self.items.append(x)
+                return len(self.items)
+
+        r = Registry.options(name="shared-registry",
+                             lifetime="detached").remote()
+        assert ray_tpu.get(r.add.remote("from-client-1"), timeout=60) == 1
+        print("C1-OK")
+    """), addr, token)
+    out = _run_client(PREAMBLE + textwrap.dedent("""
+        r = ray_tpu.get_actor("shared-registry")
+        assert ray_tpu.get(r.add.remote("from-client-2"), timeout=60) == 2
+        print("C2-OK")
+    """), addr, token)
+    assert "C2-OK" in out
+
+
+def test_client_task_error_propagates(head_address):
+    addr, token = head_address
+    out = _run_client(PREAMBLE + textwrap.dedent("""
+        @ray_tpu.remote
+        def boom():
+            raise ValueError("kapow")
+
+        try:
+            ray_tpu.get(boom.remote(), timeout=60)
+            raise SystemExit("no error raised")
+        except Exception as e:
+            assert "kapow" in str(e), e
+        print("ERR-OK")
+    """), addr, token)
+    assert "ERR-OK" in out
