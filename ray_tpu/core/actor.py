@@ -16,6 +16,7 @@ from .config import DEFAULT as cfg
 from .ids import ActorId
 from .object_ref import ObjectRef
 from .remote_function import (prepare_args, resolve_resources, resolve_strategy)
+from ..util.tracing import current_context as _trace_ctx
 from .task_spec import STREAMING_RETURNS, TaskSpec, TaskType
 
 _VALID_ACTOR_OPTIONS = {
@@ -105,6 +106,7 @@ class ActorHandle:
             actor_id=self._actor_id,
             method_name=method_name,
             concurrency_group=concurrency_group,
+            trace_ctx=_trace_ctx(),
         )
         refs = rt.submit_spec(spec)
         if num_returns == STREAMING_RETURNS:
@@ -179,6 +181,7 @@ class ActorClass:
             concurrency_groups=opts.get("concurrency_groups"),
             is_async_actor=is_async,
             runtime_env=rt.prepare_runtime_env(opts.get("runtime_env")),
+            trace_ctx=_trace_ctx(),
         )
         max_task_retries = int(opts.get("max_task_retries", 0))
         method_meta = _method_meta_of(self._cls)

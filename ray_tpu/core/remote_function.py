@@ -13,6 +13,7 @@ from . import runtime as runtime_mod
 from . import serialization
 from .config import DEFAULT as cfg
 from .object_ref import ObjectRef
+from ..util.tracing import current_context as _trace_ctx
 from .task_spec import (ARG_REF, ARG_VALUE, STREAMING_RETURNS,
                         SchedulingStrategy, TaskSpec,
                         TaskType)
@@ -117,6 +118,7 @@ class RemoteFunction:
             scheduling_strategy=resolve_strategy(self._options),
             runtime_env=rt.prepare_runtime_env(
                 self._options.get("runtime_env")),
+            trace_ctx=_trace_ctx(),
         )
         refs = rt.submit_spec(spec)
         if num_returns == STREAMING_RETURNS:

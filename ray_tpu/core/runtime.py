@@ -1638,6 +1638,8 @@ class DriverRuntime:
         if method == "log_event":
             self.gcs.add_task_event(payload)
             return None
+        if method == "task_events":
+            return list(self.gcs.task_events())
         if method == "worker_log":
             # remote workers' stdout/stderr surface on the driver console
             # with a provenance prefix (ref: log_monitor.py -> driver

@@ -72,6 +72,11 @@ class TaskSpec:
     concurrency_groups: Optional[Dict[str, int]] = None
     is_async_actor: bool = False
     runtime_env: Optional[dict] = None
+    # distributed tracing: (trace_id, parent_span_id) propagated from the
+    # submitting context (ref: python/ray/util/tracing/ — the OTel
+    # context-injection hooks; here spans ride the spec and land in the
+    # GCS task-event stream)
+    trace_ctx: Optional[tuple] = None
 
     def return_ids(self) -> List[ObjectId]:
         # STREAMING_RETURNS (-1): ids are minted per yielded item instead

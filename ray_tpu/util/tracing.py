@@ -1,0 +1,127 @@
+"""Distributed tracing — trace/span propagation across tasks and actors.
+
+Equivalent of the reference's tracing hooks (ref: python/ray/util/
+tracing/tracing_helper.py — OTel context injected into task metadata and
+re-activated in the worker). Framework-free implementation: a trace
+context (trace_id, span_id) lives in a contextvar, rides every TaskSpec
+submitted under it, and is re-activated around remote execution; each
+task execution emits a span into the GCS task-event stream, so
+`timeline()` and the state API can reconstruct cross-process call trees
+without an OTel dependency (plug a real exporter in via `span_export`).
+
+    with tracing.trace("ingest") as span:
+        ray_tpu.get(process.remote(x))   # child spans link to `span`
+
+    tree = tracing.get_trace(span.trace_id)
+"""
+from __future__ import annotations
+
+import contextvars
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+_current: "contextvars.ContextVar[Optional[tuple]]" = contextvars.ContextVar(
+    "rtpu_trace_ctx", default=None)
+
+# optional exporter hook: called with each finished span dict
+span_export: Optional[Callable[[dict], None]] = None
+
+
+def _new_id() -> str:
+    return os.urandom(8).hex()
+
+
+@dataclass
+class Span:
+    trace_id: str
+    span_id: str
+    parent_span_id: Optional[str]
+    name: str
+    start: float = field(default_factory=time.time)
+    end: Optional[float] = None
+    attributes: Dict[str, Any] = field(default_factory=dict)
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[str(key)] = value
+
+
+class trace:
+    """Context manager opening a (root or child) span in this process."""
+
+    def __init__(self, name: str, **attributes):
+        self._name = name
+        self._attrs = attributes
+        self.span: Optional[Span] = None
+        self._token = None
+
+    def __enter__(self) -> Span:
+        parent = _current.get()
+        trace_id = parent[0] if parent else _new_id()
+        self.span = Span(trace_id=trace_id, span_id=_new_id(),
+                         parent_span_id=parent[1] if parent else None,
+                         name=self._name, attributes=dict(self._attrs))
+        self._token = _current.set((trace_id, self.span.span_id))
+        return self.span
+
+    def __exit__(self, *exc) -> None:
+        _current.reset(self._token)
+        self.span.end = time.time()
+        _record(self.span)
+
+
+def current_context() -> Optional[tuple]:
+    """(trace_id, span_id) to stamp onto outgoing TaskSpecs, or None."""
+    return _current.get()
+
+
+def activate(ctx: Optional[tuple]):
+    """Worker-side: re-activate the submitter's context around a task
+    (returns the reset token)."""
+    return _current.set(tuple(ctx) if ctx else None)
+
+
+def deactivate(token) -> None:
+    _current.reset(token)
+
+
+def _record(span: Span) -> None:
+    """Spans land in the GCS task-event stream (local or via channel)."""
+    event = {
+        "task_id": "", "name": span.name, "state": "SPAN",
+        "trace_id": span.trace_id, "span_id": span.span_id,
+        "parent_span_id": span.parent_span_id,
+        "time": span.start, "end_time": span.end,
+        "attributes": span.attributes,
+    }
+    if span_export is not None:
+        try:
+            span_export(event)
+        except Exception:
+            pass
+    try:
+        from ..core import runtime as runtime_mod
+
+        rt = runtime_mod.maybe_runtime()
+        if rt is None:
+            return
+        if hasattr(rt, "gcs"):
+            rt.gcs.add_task_event(event)
+        else:  # worker/client: ship to the head
+            rt.channel.notify("log_event", event)
+    except Exception:
+        pass
+
+
+def get_trace(trace_id: str) -> List[dict]:
+    """All recorded spans (and traced task events) of one trace, ordered
+    by start time — the call tree via parent_span_id links."""
+    from ..core import runtime as runtime_mod
+
+    rt = runtime_mod.get_runtime()
+    events = (rt.gcs.task_events() if hasattr(rt, "gcs")
+              else rt.channel.call("task_events", {}))
+    out = [dict(e) for e in events if e.get("trace_id") == trace_id]
+    out.sort(key=lambda e: e.get("time", 0.0))
+    return out

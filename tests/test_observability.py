@@ -138,3 +138,56 @@ def test_dashboard_serves_overview_and_api(cluster):
         assert len(nodes) >= 1
     finally:
         dash.shutdown()
+
+
+def test_trace_spans_propagate_across_tasks(cluster):
+    """A trace opened in the driver links spans from remote tasks (and
+    their nested submissions) into one call tree."""
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    @ray_tpu.remote
+    def child(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def parent(x):
+        return ray_tpu.get(child.remote(x), timeout=60)
+
+    with tracing.trace("root-op", user="tester") as root:
+        assert ray_tpu.get(parent.remote(1), timeout=60) == 2
+    trace_id = root.trace_id
+
+    # spans arrive via worker notify: allow a beat for the channel
+    deadline = _time.monotonic() + 10
+    spans = []
+    while _time.monotonic() < deadline:
+        spans = tracing.get_trace(trace_id)
+        if len(spans) >= 3:
+            break
+        _time.sleep(0.1)
+    names = [s["name"] for s in spans]
+    assert "root-op" in names and "parent" in names and "child" in names, \
+        names
+    by_id = {s["span_id"]: s for s in spans}
+    child_span = next(s for s in spans if s["name"] == "child")
+    parent_span = next(s for s in spans if s["name"] == "parent")
+    # the tree: child's parent is the parent task's span, whose parent
+    # is the driver's root span
+    assert child_span["parent_span_id"] == parent_span["span_id"]
+    assert by_id[parent_span["parent_span_id"]]["name"] == "root-op"
+    root_span = next(s for s in spans if s["name"] == "root-op")
+    assert root_span["attributes"]["user"] == "tester"
+
+
+def test_untraced_tasks_emit_no_spans(cluster):
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    @ray_tpu.remote
+    def plain():
+        return tracing.current_context()
+
+    assert ray_tpu.get(plain.remote(), timeout=60) is None
