@@ -285,17 +285,16 @@ class WorkerProcess:
                 return
             self._renv_applied = True
         token = self.runtime.set_current_task(spec)
-        # tracing: re-activate the submitter's span context so nested
-        # submissions continue the trace, and emit one span per traced
-        # task (ref: tracing_helper.py inject/extract around execution)
-        span_cm = None
-        if spec.trace_ctx:
-            from ..util import tracing
+        # tracing: the submitter's span context re-activates around the
+        # execution and resets afterwards (tracing.task_span handles the
+        # token; a leak would misattribute later tasks on this thread)
+        from ..util.tracing import task_span
 
-            tracing.activate(spec.trace_ctx)
-            span_cm = tracing.trace(spec.description,
-                                    task_id=spec.task_id.hex())
-            span_cm.__enter__()
+        with task_span(spec):
+            self._execute_task_inner(spec, instance, token)
+
+    def _execute_task_inner(self, spec: TaskSpec, instance: Any,
+                            token) -> None:
         try:
             args, kwargs = self.resolve_args(spec)
             if spec.task_type == TaskType.NORMAL_TASK:
@@ -317,31 +316,22 @@ class WorkerProcess:
         except BaseException as e:  # noqa: BLE001 — remote errors must be shipped back
             self._report_error(spec, e)
         finally:
-            if span_cm is not None:
-                span_cm.__exit__(None, None, None)
             self.runtime.clear_current_task(token)
 
     async def execute_task_async(self, spec: TaskSpec, instance: Any) -> None:
-        token = self.runtime.set_current_task(spec)
-        span_cm = None
-        if spec.trace_ctx:
-            from ..util import tracing
+        from ..util.tracing import task_span
 
-            tracing.activate(spec.trace_ctx)
-            span_cm = tracing.trace(spec.description,
-                                    task_id=spec.task_id.hex())
-            span_cm.__enter__()
-        try:
-            args, kwargs = self.resolve_args(spec)
-            method = getattr(instance, spec.method_name)
-            result = await method(*args, **kwargs)
-            self._report_success(spec, result)
-        except BaseException as e:  # noqa: BLE001
-            self._report_error(spec, e)
-        finally:
-            if span_cm is not None:
-                span_cm.__exit__(None, None, None)
-            self.runtime.clear_current_task(token)
+        token = self.runtime.set_current_task(spec)
+        with task_span(spec):
+            try:
+                args, kwargs = self.resolve_args(spec)
+                method = getattr(instance, spec.method_name)
+                result = await method(*args, **kwargs)
+                self._report_success(spec, result)
+            except BaseException as e:  # noqa: BLE001
+                self._report_error(spec, e)
+            finally:
+                self.runtime.clear_current_task(token)
 
     # -- result reporting ------------------------------------------------------
 

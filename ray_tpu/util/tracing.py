@@ -86,10 +86,34 @@ def deactivate(token) -> None:
     _current.reset(token)
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def task_span(spec):
+    """Worker-side wrapper for one task execution: re-activate the
+    submitter's context, open the task's span, and ALWAYS reset the
+    thread's context afterwards — worker threads are long-lived, and a
+    leaked contextvar would stamp every later (even untraced) task on
+    this thread into the wrong trace."""
+    if not getattr(spec, "trace_ctx", None):
+        yield None
+        return
+    token = activate(spec.trace_ctx)
+    try:
+        with trace(spec.description, task_id=spec.task_id.hex()) as span:
+            yield span
+    finally:
+        deactivate(token)
+
+
 def _record(span: Span) -> None:
     """Spans land in the GCS task-event stream (local or via channel)."""
     event = {
-        "task_id": "", "name": span.name, "state": "SPAN",
+        # the task id (when this span wraps a task) joins span events to
+        # the task's RUNNING/FINISHED events in the same stream
+        "task_id": span.attributes.get("task_id", ""),
+        "name": span.name, "state": "SPAN",
         "trace_id": span.trace_id, "span_id": span.span_id,
         "parent_span_id": span.parent_span_id,
         "time": span.start, "end_time": span.end,

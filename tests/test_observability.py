@@ -183,6 +183,8 @@ def test_trace_spans_propagate_across_tasks(cluster):
 
 
 def test_untraced_tasks_emit_no_spans(cluster):
+    """A traced task must not leak its context into later untraced tasks
+    on the same long-lived worker (regression: activate-token reset)."""
     import ray_tpu
     from ray_tpu.util import tracing
 
@@ -190,4 +192,12 @@ def test_untraced_tasks_emit_no_spans(cluster):
     def plain():
         return tracing.current_context()
 
-    assert ray_tpu.get(plain.remote(), timeout=60) is None
+    @ray_tpu.remote
+    def traced_noop():
+        return 1
+
+    with tracing.trace("leak-check"):
+        ray_tpu.get([traced_noop.remote() for _ in range(8)], timeout=60)
+    # every worker that just ran a traced task must come back clean
+    out = ray_tpu.get([plain.remote() for _ in range(8)], timeout=60)
+    assert all(ctx is None for ctx in out), out
