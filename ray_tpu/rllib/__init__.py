@@ -17,6 +17,8 @@ _EXPORTS = {
     "PPO": "algorithm", "PPOConfig": "algorithm",
     "DQN": "dqn", "DQNConfig": "dqn", "DQNLearner": "dqn",
     "DQNRolloutWorker": "dqn",
+    "Impala": "impala", "ImpalaConfig": "impala",
+    "ImpalaLearner": "impala",
     "ReplayBuffer": "replay_buffer",
     "PrioritizedReplayBuffer": "replay_buffer",
     "CartPoleVecEnv": "env", "VectorEnv": "env",
@@ -31,6 +33,8 @@ if TYPE_CHECKING:  # static analyzers see the eager imports
     from .algorithm import PPO, PPOConfig  # noqa: F401
     from .dqn import (DQN, DQNConfig, DQNLearner,  # noqa: F401
                       DQNRolloutWorker)
+    from .impala import (Impala, ImpalaConfig,  # noqa: F401
+                         ImpalaLearner)
     from .replay_buffer import (PrioritizedReplayBuffer,  # noqa: F401
                                 ReplayBuffer)
     from .env import (CartPoleVecEnv, VectorEnv, make_env,  # noqa: F401

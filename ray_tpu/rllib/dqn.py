@@ -22,30 +22,17 @@ import numpy as np
 import ray_tpu
 
 from . import sample_batch as sb
-from .env import make_env
 from .np_policy import ensure_numpy, forward_np
+from .rollout_worker import EnvWorkerBase
 from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
 
 NEXT_OBS = "next_obs"
 
 
-class DQNRolloutWorker:
+class DQNRolloutWorker(EnvWorkerBase):
     """Actor collecting epsilon-greedy transitions (ref:
     rollout_worker.py sample + dqn's EpsilonGreedy exploration). The Q-net
     reuses the fcnet param layout; the policy head IS the Q head."""
-
-    def __init__(self, env_name: str, num_envs: int, rollout_len: int,
-                 seed: int = 0, env_creator=None):
-        if env_creator is not None:
-            creator = cloudpickle.loads(env_creator)
-            self.env = creator(num_envs=num_envs, seed=seed)
-        else:
-            self.env = make_env(env_name, num_envs=num_envs, seed=seed)
-        self.rollout_len = rollout_len
-        self._rng = np.random.default_rng(seed + 1)
-        self._obs = self.env.reset(seed=seed)
-        self._ep_return = np.zeros(self.env.num_envs, np.float64)
-        self._finished_returns: list = []
 
     def sample(self, params: Dict, epsilon: float) -> sb.Batch:
         params = ensure_numpy(params)
@@ -67,7 +54,7 @@ class DQNRolloutWorker:
             obs, reward, done, info = self.env.step(actions)
             rew_buf[t], done_buf[t] = reward, done
             next_buf[t] = obs
-            self._ep_return += reward
+            self._track_returns(reward, done)
             if done.any():
                 idx = np.nonzero(done)[0]
                 if "final_obs" in info:
@@ -78,24 +65,12 @@ class DQNRolloutWorker:
                     # time-limit truncation still bootstraps: don't cut
                     # the target at a non-terminal state
                     done_buf[t] &= ~info["truncated"]
-                self._finished_returns.extend(self._ep_return[idx].tolist())
-                self._ep_return[idx] = 0.0
         self._obs = obs
         flat = lambda a: a.reshape(T * n, *a.shape[2:])  # noqa: E731
         return {sb.OBS: flat(obs_buf), sb.ACTIONS: flat(act_buf),
                 sb.REWARDS: flat(rew_buf), sb.DONES: flat(done_buf),
                 NEXT_OBS: flat(next_buf)}
 
-    def episode_returns(self, clear: bool = True) -> list:
-        out = list(self._finished_returns)
-        if clear:
-            self._finished_returns.clear()
-        return out
-
-    def env_info(self) -> dict:
-        return {"obs_dim": self.env.obs_dim,
-                "num_actions": self.env.num_actions,
-                "num_envs": self.env.num_envs}
 
 
 class DQNLearner:

@@ -1,0 +1,372 @@
+"""IMPALA — asynchronous distributed on-policy RL with V-trace.
+
+ref: rllib/algorithms/impala/impala.py (async sample pipeline,
+training_step :760) and vtrace off-policy correction (Espeholt et al.
+2018). The architectural point vs PPO: rollout actors sample
+CONTINUOUSLY against whatever weights they last saw and ship batches
+into a queue; the learner consumes without barriers, so slow actors
+never stall the device. The resulting policy lag is corrected by
+V-trace importance weighting (rho/c clipping) inside the jitted
+learner update.
+
+TPU-native shape mirrors the house style: numpy behavior policies in
+the actors (np_policy rationale), ONE jitted donated-buffer update per
+consumed batch on the device, weights broadcast through the object
+store every `broadcast_interval` updates.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+import numpy as np
+
+import ray_tpu
+
+from . import sample_batch as sb
+from .np_policy import ensure_numpy, sample_actions
+from .rollout_worker import EnvWorkerBase
+
+
+class ImpalaRolloutWorker(EnvWorkerBase):
+    """Actor producing fixed-length trajectory fragments [T, n] with the
+    behavior policy's log-probs (needed for the V-trace ratios). Unlike
+    PPO's worker, NO advantage computation happens here — V-trace needs
+    the learner's CURRENT values, not the behavior policy's."""
+
+    def __init__(self, env_name: str, num_envs: int, rollout_len: int,
+                 gamma: float = 0.99, seed: int = 0, env_creator=None):
+        super().__init__(env_name, num_envs, rollout_len, seed, env_creator)
+        self.gamma = gamma
+
+    def sample(self, params: Dict) -> Dict[str, np.ndarray]:
+        params = ensure_numpy(params)
+        T, n = self.rollout_len, self.env.num_envs
+        obs = np.empty((T + 1, n, self.env.obs_dim), np.float32)
+        act = np.empty((T, n), np.int64)
+        logp = np.empty((T, n), np.float32)
+        rew = np.empty((T, n), np.float32)
+        done = np.empty((T, n), np.bool_)
+        cur = self._obs
+        for t in range(T):
+            a, lp, _ = sample_actions(params, cur, self._rng)
+            obs[t], act[t], logp[t] = cur, a, lp
+            cur, r, d, info = self.env.step(a)
+            rew[t], done[t] = r, d
+            if d.any() and "truncated" in info:
+                # Time-limit truncation is not termination, but the env
+                # auto-reset already replaced cur with the NEXT episode's
+                # obs — clearing done would make V-trace bootstrap from
+                # the unrelated fresh episode. Keep done=True (cut the
+                # chain) and fold gamma*V_behavior(s_final) into the
+                # reward instead (the rollout_worker.py:73 recipe).
+                trunc = info["truncated"]
+                if trunc.any():
+                    idx = np.nonzero(trunc)[0]
+                    _, _, v_final = sample_actions(
+                        params, info["final_obs"][idx], self._rng)
+                    rew[t, idx] += self.gamma * v_final
+            self._track_returns(r, d)
+        obs[T] = cur
+        self._obs = cur
+        return {"obs": obs, "actions": act, "behavior_logp": logp,
+                "rewards": rew, "dones": done}
+
+
+class ImpalaLearner:
+    """Jitted V-trace actor-critic update (Espeholt et al. eq. 1)."""
+
+    def __init__(self, obs_dim: int, num_actions: int, *, lr: float = 5e-4,
+                 gamma: float = 0.99, rho_clip: float = 1.0,
+                 c_clip: float = 1.0, vf_coeff: float = 0.5,
+                 ent_coeff: float = 0.01, hidden=(64, 64), seed: int = 0,
+                 max_grad_norm: float = 10.0):
+        import jax
+        import optax
+
+        from .models import init_policy_params
+
+        self.params = init_policy_params(jax.random.PRNGKey(seed), obs_dim,
+                                         num_actions, tuple(hidden))
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(max_grad_norm), optax.adam(lr))
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = jax.jit(
+            self._make_update(gamma, rho_clip, c_clip, vf_coeff, ent_coeff),
+            donate_argnums=(0, 1))
+        self.num_updates = 0
+
+    @staticmethod
+    def _vtrace(values, bootstrap, rewards, dones, rhos, gamma,
+                rho_clip, c_clip):
+        """V-trace targets via a reverse lax.scan over [T, n] fragments;
+        dones cut the bootstrap at (true) episode ends."""
+        import jax
+        import jax.numpy as jnp
+
+        not_done = 1.0 - dones.astype(jnp.float32)
+        clipped_rho = jnp.minimum(rhos, rho_clip)
+        cs = jnp.minimum(rhos, c_clip)
+        next_values = jnp.concatenate([values[1:], bootstrap[None]], axis=0)
+        deltas = clipped_rho * (rewards + gamma * next_values * not_done
+                                - values)
+
+        def body(acc, xs):
+            delta, c, nd = xs
+            acc = delta + gamma * nd * c * acc
+            return acc, acc
+
+        _, adv = jax.lax.scan(body, jnp.zeros_like(bootstrap),
+                              (deltas, cs, not_done), reverse=True)
+        vs = values + adv  # v_s targets
+        vs_next = jnp.concatenate([vs[1:], bootstrap[None]], axis=0)
+        # policy-gradient advantages use one-step targets (paper eq. 1)
+        pg_adv = clipped_rho * (rewards + gamma * vs_next * not_done
+                                - values)
+        return vs, pg_adv
+
+    def _make_update(self, gamma, rho_clip, c_clip, vf_coeff, ent_coeff):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from .models import forward
+
+        def loss_fn(params, batch):
+            T, n = batch["actions"].shape
+            obs_all = batch["obs"].reshape((T + 1) * n, -1)
+            logits_all, values_all = forward(params, obs_all)
+            logits = logits_all.reshape(T + 1, n, -1)[:T]
+            values = values_all.reshape(T + 1, n)
+            bootstrap = values[T]
+            values = values[:T]
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+            rhos = jnp.exp(logp - batch["behavior_logp"])
+            vs, pg_adv = self._vtrace(
+                jax.lax.stop_gradient(values),
+                jax.lax.stop_gradient(bootstrap), batch["rewards"],
+                batch["dones"], jax.lax.stop_gradient(rhos), gamma,
+                rho_clip, c_clip)
+            pg_loss = -jnp.mean(logp * jax.lax.stop_gradient(pg_adv))
+            vf_loss = jnp.mean((values - jax.lax.stop_gradient(vs)) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            loss = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy
+            stats = {"pg_loss": pg_loss, "vf_loss": vf_loss,
+                     "entropy": entropy, "mean_rho": jnp.mean(rhos)}
+            return loss, stats
+
+        def update(params, opt_state, batch):
+            (loss, stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            return (optax.apply_updates(params, updates), opt_state, loss,
+                    stats)
+
+        return update
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax
+        import jax.numpy as jnp
+
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.opt_state, loss, stats = self._update(
+            self.params, self.opt_state, jb)
+        self.num_updates += 1
+        out = jax.device_get(stats)
+        return {"loss": float(loss), **{k: float(v) for k, v in out.items()}}
+
+    def get_params(self) -> Dict:
+        import jax
+
+        return jax.device_get(self.params)
+
+
+@dataclass
+class ImpalaConfig:
+    """ref: impala.py IMPALAConfig defaults (rollout 50, broadcast every
+    update, queue-fed learner)."""
+    env: str = "CartPole-v1"
+    env_creator: Optional[Callable] = None
+    num_rollout_workers: int = 2
+    num_envs_per_worker: int = 8
+    rollout_fragment_length: int = 32
+    gamma: float = 0.99
+    lr: float = 5e-4
+    rho_clip: float = 1.0
+    c_clip: float = 1.0
+    vf_coeff: float = 0.5
+    ent_coeff: float = 0.01
+    batches_per_iter: int = 8
+    broadcast_interval: int = 1  # updates between weight publications
+    max_queue: int = 8
+    hidden: tuple = (64, 64)
+    seed: int = 0
+    worker_resources: Dict[str, float] = field(default_factory=dict)
+
+    def environment(self, env: str = None, *,
+                    env_creator=None) -> "ImpalaConfig":
+        if env is not None:
+            self.env = env
+        if env_creator is not None:
+            self.env_creator = env_creator
+        return self
+
+    def rollouts(self, *, num_rollout_workers: int = None,
+                 num_envs_per_worker: int = None,
+                 rollout_fragment_length: int = None) -> "ImpalaConfig":
+        for k, v in [("num_rollout_workers", num_rollout_workers),
+                     ("num_envs_per_worker", num_envs_per_worker),
+                     ("rollout_fragment_length", rollout_fragment_length)]:
+            if v is not None:
+                setattr(self, k, v)
+        return self
+
+    def training(self, *, lr: float = None, gamma: float = None,
+                 ent_coeff: float = None, batches_per_iter: int = None,
+                 broadcast_interval: int = None) -> "ImpalaConfig":
+        for k, v in [("lr", lr), ("gamma", gamma), ("ent_coeff", ent_coeff),
+                     ("batches_per_iter", batches_per_iter),
+                     ("broadcast_interval", broadcast_interval)]:
+            if v is not None:
+                setattr(self, k, v)
+        return self
+
+    def build(self) -> "Impala":
+        return Impala(self)
+
+
+class Impala:
+    """Async pipeline: per-worker feeder threads keep one sample() in
+    flight each and push results into a bounded queue (backpressure);
+    train() consumes `batches_per_iter` batches, updating per batch and
+    publishing fresh weights every `broadcast_interval` updates. Workers
+    pick up the newest weights at their next fragment — bounded policy
+    lag, corrected by V-trace."""
+
+    def __init__(self, config: ImpalaConfig):
+        self.config = c = config
+        creator_blob = (cloudpickle.dumps(c.env_creator)
+                        if c.env_creator else None)
+        worker_cls = ray_tpu.remote(ImpalaRolloutWorker)
+        opts = {"num_cpus": c.worker_resources.get("CPU", 1.0)}
+        self.workers = [
+            worker_cls.options(**opts).remote(
+                c.env, c.num_envs_per_worker, c.rollout_fragment_length,
+                gamma=c.gamma, seed=c.seed + 1000 * i,
+                env_creator=creator_blob)
+            for i in range(c.num_rollout_workers)]
+        info = ray_tpu.get(self.workers[0].env_info.remote(), timeout=60)
+        self.learner = ImpalaLearner(
+            info["obs_dim"], info["num_actions"], lr=c.lr, gamma=c.gamma,
+            rho_clip=c.rho_clip, c_clip=c.c_clip, vf_coeff=c.vf_coeff,
+            ent_coeff=c.ent_coeff, hidden=c.hidden, seed=c.seed)
+        self._params_ref = ray_tpu.put(self.learner.get_params())
+        self._params_lock = threading.Lock()
+        import queue as _q
+
+        self._queue: "_q.Queue" = _q.Queue(maxsize=c.max_queue)
+        self._stop = threading.Event()
+        self._feeders = [
+            threading.Thread(target=self._feed, args=(w,), daemon=True,
+                             name=f"impala-feeder-{i}")
+            for i, w in enumerate(self.workers)]
+        for t in self._feeders:
+            t.start()
+        self._iteration = 0
+        self._total_steps = 0
+        self._total_episodes = 0
+        self._recent: List[float] = []
+
+    def _feed(self, worker) -> None:
+        """One in-flight sample per worker, forever (the async half)."""
+        import queue as _q
+
+        while not self._stop.is_set():
+            try:
+                with self._params_lock:
+                    ref = self._params_ref
+                batch = ray_tpu.get(worker.sample.remote(ref), timeout=300)
+            except Exception:
+                if not self._stop.is_set():
+                    time.sleep(0.2)  # worker error: actor restart covers it
+                continue
+            # backpressure: NEVER drop a sampled batch — re-offer until a
+            # slot frees or shutdown (a full queue just means the learner
+            # is momentarily behind, not that the work is worthless)
+            while not self._stop.is_set():
+                try:
+                    self._queue.put(batch, timeout=0.5)
+                    break
+                except _q.Full:
+                    continue
+
+    def train(self) -> Dict[str, Any]:
+        c = self.config
+        t0 = time.monotonic()
+        stats: Dict[str, float] = {}
+        steps = 0
+        for _ in range(c.batches_per_iter):
+            batch = self._queue.get(timeout=300)
+            steps += int(np.prod(batch["actions"].shape))
+            stats = self.learner.update(batch)
+            if self.learner.num_updates % c.broadcast_interval == 0:
+                new_ref = ray_tpu.put(self.learner.get_params())
+                with self._params_lock:
+                    self._params_ref = new_ref
+        for rets in ray_tpu.get(
+                [w.episode_returns.remote() for w in self.workers],
+                timeout=60):
+            self._recent.extend(rets)
+            self._total_episodes += len(rets)
+        self._recent = self._recent[-100:]
+        self._iteration += 1
+        self._total_steps += steps
+        dt = time.monotonic() - t0
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_total": self._total_steps,
+            "timesteps_this_iter": steps,
+            "episode_reward_mean": (float(np.mean(self._recent))
+                                    if self._recent else float("nan")),
+            "episodes_total": self._total_episodes,
+            "env_steps_per_sec": steps / max(1e-9, dt),
+            **stats,
+        }
+
+    # -- Tune-trainable surface ------------------------------------------
+
+    def save(self) -> Dict:
+        import jax
+
+        return {"params": jax.device_get(self.learner.params),
+                "opt_state": jax.device_get(self.learner.opt_state),
+                "iteration": self._iteration,
+                "total_steps": self._total_steps}
+
+    def restore(self, ckpt: Dict) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.learner.params = jax.tree.map(jnp.asarray, ckpt["params"])
+        if "opt_state" in ckpt:
+            self.learner.opt_state = jax.tree.map(jnp.asarray,
+                                                  ckpt["opt_state"])
+        self._iteration = int(ckpt.get("iteration", 0))
+        self._total_steps = int(ckpt.get("total_steps", 0))
+        with self._params_lock:
+            self._params_ref = ray_tpu.put(self.learner.get_params())
+
+    def stop(self) -> None:
+        self._stop.set()
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

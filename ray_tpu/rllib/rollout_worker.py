@@ -16,10 +16,13 @@ from .env import make_env
 from .np_policy import ensure_numpy, sample_actions
 
 
-class RolloutWorker:
+class EnvWorkerBase:
+    """Shared rollout-actor plumbing: env construction (by name or
+    pickled creator), the persistent obs, the RNG, and episode-return
+    bookkeeping. PPO / DQN / IMPALA workers differ only in sample()."""
+
     def __init__(self, env_name: str, num_envs: int, rollout_len: int,
-                 gamma: float, lam: float, seed: int = 0,
-                 env_creator=None):
+                 seed: int = 0, env_creator=None):
         import cloudpickle
 
         if env_creator is not None:
@@ -28,13 +31,38 @@ class RolloutWorker:
         else:
             self.env = make_env(env_name, num_envs=num_envs, seed=seed)
         self.rollout_len = rollout_len
-        self.gamma = gamma
-        self.lam = lam
         self._rng = np.random.default_rng(seed + 1)
         self._obs = self.env.reset(seed=seed)
         # episode-return bookkeeping (survives across sample() calls)
         self._ep_return = np.zeros(self.env.num_envs, np.float64)
         self._finished_returns: list = []
+
+    def _track_returns(self, reward: np.ndarray, done: np.ndarray) -> None:
+        self._ep_return += reward
+        if done.any():
+            idx = np.nonzero(done)[0]
+            self._finished_returns.extend(self._ep_return[idx].tolist())
+            self._ep_return[idx] = 0.0
+
+    def episode_returns(self, clear: bool = True) -> list:
+        out = list(self._finished_returns)
+        if clear:
+            self._finished_returns.clear()
+        return out
+
+    def env_info(self) -> dict:
+        return {"obs_dim": self.env.obs_dim,
+                "num_actions": self.env.num_actions,
+                "num_envs": self.env.num_envs}
+
+
+class RolloutWorker(EnvWorkerBase):
+    def __init__(self, env_name: str, num_envs: int, rollout_len: int,
+                 gamma: float, lam: float, seed: int = 0,
+                 env_creator=None):
+        super().__init__(env_name, num_envs, rollout_len, seed, env_creator)
+        self.gamma = gamma
+        self.lam = lam
 
     def sample(self, params: Dict) -> sb.Batch:
         params = ensure_numpy(params)  # one conversion, not one per step
@@ -63,11 +91,7 @@ class RolloutWorker:
                     _, _, v_final = sample_actions(
                         params, info["final_obs"][idx], self._rng)
                     rew_buf[t, idx] += self.gamma * v_final
-            self._ep_return += reward
-            if done.any():
-                idx = np.nonzero(done)[0]
-                self._finished_returns.extend(self._ep_return[idx].tolist())
-                self._ep_return[idx] = 0.0
+            self._track_returns(reward, done)
         self._obs = obs
         _, _, last_values = sample_actions(params, obs, self._rng)
         adv, ret = sb.compute_gae(rew_buf, val_buf, done_buf, last_values,
@@ -80,13 +104,3 @@ class RolloutWorker:
             sb.ADVANTAGES: flat(adv), sb.RETURNS: flat(ret),
         }
 
-    def episode_returns(self, clear: bool = True) -> list:
-        out = list(self._finished_returns)
-        if clear:
-            self._finished_returns.clear()
-        return out
-
-    def env_info(self) -> dict:
-        return {"obs_dim": self.env.obs_dim,
-                "num_actions": self.env.num_actions,
-                "num_envs": self.env.num_envs}

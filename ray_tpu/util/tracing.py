@@ -16,6 +16,7 @@ without an OTel dependency (plug a real exporter in via `span_export`).
 """
 from __future__ import annotations
 
+import contextlib
 import contextvars
 import os
 import time
@@ -84,9 +85,6 @@ def activate(ctx: Optional[tuple]):
 
 def deactivate(token) -> None:
     _current.reset(token)
-
-
-import contextlib
 
 
 @contextlib.contextmanager

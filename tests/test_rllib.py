@@ -324,3 +324,100 @@ class TestReplayBufferState:
         _, idx, w = buf2.sample(64)
         assert (idx == 7).mean() > 0.9  # priorities survived the roundtrip
         assert np.isfinite(w).all()
+
+
+class TestImpala:
+    def test_vtrace_matches_onpolicy_gae_lambda1(self):
+        """With rho == c == 1 (on-policy, no clipping) V-trace targets
+        reduce to n-step TD(lambda=1) returns — cross-check vs numpy."""
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.impala import ImpalaLearner
+
+        T, n = 5, 3
+        rng = np.random.default_rng(0)
+        values = rng.normal(size=(T, n)).astype(np.float32)
+        bootstrap = rng.normal(size=n).astype(np.float32)
+        rewards = rng.normal(size=(T, n)).astype(np.float32)
+        dones = np.zeros((T, n), np.bool_)
+        rhos = np.ones((T, n), np.float32)
+        gamma = 0.9
+        vs, pg_adv = ImpalaLearner._vtrace(
+            jnp.asarray(values), jnp.asarray(bootstrap),
+            jnp.asarray(rewards), jnp.asarray(dones), jnp.asarray(rhos),
+            gamma, 1.0, 1.0)
+        # numpy reference: vs_t = discounted return bootstrapped at V(T)
+        expect = np.zeros((T, n), np.float32)
+        acc = bootstrap.copy()
+        for t in range(T - 1, -1, -1):
+            acc = rewards[t] + gamma * acc
+            expect[t] = acc
+        np.testing.assert_allclose(np.asarray(vs), expect, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_vtrace_dones_cut_bootstrap(self):
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.impala import ImpalaLearner
+
+        values = np.zeros((2, 1), np.float32)
+        bootstrap = np.array([100.0], np.float32)
+        rewards = np.ones((2, 1), np.float32)
+        dones = np.array([[True], [False]])
+        rhos = np.ones((2, 1), np.float32)
+        vs, _ = ImpalaLearner._vtrace(
+            jnp.asarray(values), jnp.asarray(bootstrap),
+            jnp.asarray(rewards), jnp.asarray(dones), jnp.asarray(rhos),
+            0.99, 1.0, 1.0)
+        # t=0 ends an episode: its target must not see t=1 or the
+        # bootstrap value
+        assert abs(float(vs[0, 0]) - 1.0) < 1e-5
+
+    def test_impala_solves_cartpole(self, cluster):
+        """Async e2e: continuously-sampling actors -> queue -> V-trace
+        learner reaches reward>=150 on CartPole."""
+        from ray_tpu.rllib import ImpalaConfig
+
+        algo = (ImpalaConfig()
+                .environment("CartPole-v1")
+                .rollouts(num_rollout_workers=2, num_envs_per_worker=8,
+                          rollout_fragment_length=32)
+                .training(lr=5e-4, ent_coeff=0.01, batches_per_iter=8)
+                .build())
+        try:
+            best = 0.0
+            result = {}
+            for _ in range(150):
+                result = algo.train()
+                if np.isfinite(result["episode_reward_mean"]):
+                    best = max(best, result["episode_reward_mean"])
+                if best >= 150:
+                    break
+            assert best >= 150, f"best={best}, last={result}"
+            assert result["env_steps_per_sec"] > 0
+        finally:
+            algo.stop()
+
+    def test_impala_save_restore(self, cluster):
+        from ray_tpu.rllib import ImpalaConfig
+
+        algo = (ImpalaConfig()
+                .rollouts(num_rollout_workers=1, num_envs_per_worker=4,
+                          rollout_fragment_length=16)
+                .training(batches_per_iter=2).build())
+        try:
+            algo.train()
+            ckpt = algo.save()
+            algo2 = (ImpalaConfig()
+                     .rollouts(num_rollout_workers=1, num_envs_per_worker=4,
+                               rollout_fragment_length=16)
+                     .training(batches_per_iter=2).build())
+            try:
+                algo2.restore(ckpt)
+                p1, p2 = algo.learner.get_params(), algo2.learner.get_params()
+                for k in p1:
+                    np.testing.assert_allclose(p1[k], p2[k])
+            finally:
+                algo2.stop()
+        finally:
+            algo.stop()
