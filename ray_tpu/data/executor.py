@@ -69,6 +69,12 @@ def _shuffle_reduce(seed: int, *parts: Block) -> Block:
     return block_select(merged, perm)
 
 
+def _merge_parts(*parts: Block) -> Block:
+    """Intermediate merge of one reducer's parts from one map wave
+    (push-based shuffle's merge stage)."""
+    return block_concat(parts)
+
+
 # -- sort (range partition; ref: planner/exchange/sort_task_spec.py) --------
 
 
@@ -377,6 +383,10 @@ class StreamingExecutor:
             outs.append(slice_remote.remote(plan, *args))
         return outs
 
+    # maps per merge wave for the push-based path (ref:
+    # push_based_shuffle.py _MergeTaskScheduler merge_factor)
+    _SHUFFLE_MERGE_FACTOR = 8
+
     def _random_shuffle(self, refs: List[Any], seed: Optional[int]) -> List[Any]:
         n = len(refs)
         if n == 0:
@@ -384,14 +394,37 @@ class StreamingExecutor:
         base = seed if seed is not None else 0x5EED
         map_remote = ray_tpu.remote(_shuffle_map)
         reduce_remote = ray_tpu.remote(_shuffle_reduce)
-        parts = [map_remote.options(num_returns=n).remote(r, n, base + i)
-                 for i, r in enumerate(refs)]
-        if n == 1:
-            cols = [[p] for p in parts]
-        else:
-            cols = [[parts[i][j] for i in range(n)] for j in range(n)]
-        return [reduce_remote.remote(base ^ (j * 2654435761), *col)
-                for j, col in enumerate(cols)]
+        M = self._SHUFFLE_MERGE_FACTOR
+        if n <= M:
+            # small: simple pull shuffle, every reducer takes N parts
+            parts = [map_remote.options(num_returns=n).remote(r, n, base + i)
+                     for i, r in enumerate(refs)]
+            if n == 1:
+                cols = [[p] for p in parts]
+            else:
+                cols = [[parts[i][j] for i in range(n)] for j in range(n)]
+            return [reduce_remote.remote(base ^ (j * 2654435761), *col)
+                    for j, col in enumerate(cols)]
+        # Push-based two-stage shuffle (ref: _internal/push_based_shuffle.py):
+        # maps run in waves of M; each wave's per-reducer parts merge
+        # IMMEDIATELY into one block per (wave, reducer), so the N x N
+        # intermediate object matrix never exists at once — per-wave parts
+        # become garbage as soon as their merge lands, in-flight objects
+        # stay O(M*N), and wave w+1's maps overlap wave w's merges through
+        # ordinary async scheduling.
+        merge_remote = ray_tpu.remote(_merge_parts)
+        merged_cols: List[List[Any]] = [[] for _ in range(n)]
+        for w0 in range(0, n, M):
+            wave = refs[w0:w0 + M]
+            parts = [map_remote.options(num_returns=n).remote(
+                r, n, base + w0 + i) for i, r in enumerate(wave)]
+            for j in range(n):
+                # n > M >= 8 here, so num_returns is always a list
+                col = [parts[i][j] for i in range(len(wave))]
+                merged_cols[j].append(merge_remote.remote(*col))
+        return [reduce_remote.remote(base ^ (j * 2654435761),
+                                     *merged_cols[j])
+                for j in range(n)]
 
     def _sort(self, refs: List[Any], key: str, descending: bool) -> List[Any]:
         """Distributed sort: sample -> range partition -> per-partition

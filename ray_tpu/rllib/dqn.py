@@ -72,7 +72,6 @@ class DQNRolloutWorker(EnvWorkerBase):
                 NEXT_OBS: flat(next_buf)}
 
 
-
 class DQNLearner:
     """Jitted double-DQN update with a periodically synced target net
     (ref: dqn_torch_policy.py build_q_losses; learner.py donation

@@ -26,7 +26,6 @@ import numpy as np
 
 import ray_tpu
 
-from . import sample_batch as sb
 from .np_policy import ensure_numpy, sample_actions
 from .rollout_worker import EnvWorkerBase
 
@@ -256,6 +255,9 @@ class Impala:
                         if c.env_creator else None)
         worker_cls = ray_tpu.remote(ImpalaRolloutWorker)
         opts = {"num_cpus": c.worker_resources.get("CPU", 1.0)}
+        extra = {k: v for k, v in c.worker_resources.items() if k != "CPU"}
+        if extra:
+            opts["resources"] = extra
         self.workers = [
             worker_cls.options(**opts).remote(
                 c.env, c.num_envs_per_worker, c.rollout_fragment_length,
