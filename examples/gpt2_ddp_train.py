@@ -1,0 +1,69 @@
+"""GPT-2 DDP training through ray_tpu.train (BASELINE: 'GPT-2-small DDP,
+NCCL->ICI allreduce path'). Gang workers share a jax mesh; gradients
+allreduce over ICI inside jit — no NCCL, no process groups."""
+import argparse
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+
+def train_loop(config):
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.models import GPT, GPTConfig
+
+    mesh = train.get_mesh()
+    cfg = (GPTConfig.small(dtype=jnp.bfloat16, use_flash=True)
+           if config.get("full") else
+           GPTConfig.tiny(dtype=jnp.float32, use_flash=False))
+    model = GPT(cfg)
+    params = jax.jit(model.init,
+                     out_shardings=model.param_shardings(mesh))(
+        jax.random.PRNGKey(0))
+    tx = optax.adamw(3e-4, weight_decay=0.1)
+    opt_state = jax.jit(tx.init)(params)
+    B, S = config.get("batch", 8), config.get("seq", 64)
+    data_sharding = NamedSharding(mesh, P(("dp", "fsdp"), None))
+
+    @jax.jit
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(model.loss)(params, tokens, targets)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return loss, optax.apply_updates(params, updates), opt_state
+
+    rng = np.random.default_rng(0)
+    for i in range(config.get("steps", 3)):
+        tokens = jax.device_put(
+            rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32),
+            data_sharding)
+        targets = jnp.roll(tokens, -1, axis=1)
+        loss, params, opt_state = step(params, opt_state, tokens, targets)
+        train.report({"loss": float(loss), "step": i})
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--full", action="store_true")
+    ap.add_argument("--num-workers", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=3)
+    args = ap.parse_args()
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    trainer = JaxTrainer(
+        train_loop,
+        train_loop_config={"full": args.full, "steps": args.steps},
+        scaling_config=ScalingConfig(num_workers=args.num_workers,
+                                     devices_per_worker=4),
+        run_config=RunConfig(name="gpt2_ddp"))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    print("final:", result.metrics)
+
+
+if __name__ == "__main__":
+    main()

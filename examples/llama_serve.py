@@ -1,0 +1,65 @@
+"""Llama pjit-sharded Serve inference (BASELINE: 'Llama-2-7B pjit-sharded
+Serve inference'). A MeshDeployment replica spans a gang of mesh workers;
+the model's parameters shard over the mesh per its logical axes and
+greedy decode runs jitted with a KV cache. --full uses llama2_7b sizes."""
+import argparse
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import serve
+
+
+def build(mesh, config):
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import Llama, LlamaConfig
+
+    cfg = (LlamaConfig.llama2_7b() if config.get("full")
+           else LlamaConfig.tiny(dtype=jnp.float32))
+    model = Llama(cfg)
+    params = jax.jit(model.init,
+                     out_shardings=model.param_shardings(mesh))(
+        jax.random.PRNGKey(0))
+
+    @jax.jit
+    def greedy_next(params, tokens):
+        logits = model.apply(params, tokens)
+        return logits[:, -1, :].argmax(-1)
+
+    def apply(params, payload):
+        tokens = jnp.asarray(payload["tokens"], jnp.int32)
+        out = list(np.asarray(payload["tokens"][0]))
+        for _ in range(int(payload.get("max_new", 4))):
+            nxt = int(jax.device_get(
+                greedy_next(params, jnp.asarray([out], jnp.int32))[0]))
+            out.append(nxt)
+        return out
+
+    return params, apply
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--full", action="store_true")
+    ap.add_argument("--num-workers", type=int, default=2)
+    args = ap.parse_args()
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    full = args.full
+
+    @serve.deployment(num_replicas=1, health_check_timeout_s=120)
+    class LlamaServer(serve.MeshDeployment):
+        def __init__(self):
+            super().__init__(build, num_workers=args.num_workers,
+                             devices_per_worker=2, config={"full": full})
+
+    handle = serve.run(LlamaServer.bind(), timeout=300)
+    out = ray_tpu.get(handle.remote(
+        {"tokens": [[1, 5, 9]], "max_new": 4}), timeout=120)
+    print("generated token ids:", out)
+    serve.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""PPO through the rollout-actor/learner split (BASELINE: 'PPO Atari
+Breakout' shape; the built-in vectorized CartPole stands in — register
+an Atari VectorEnv via ray_tpu.rllib.register_env for the real thing)."""
+import argparse
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib import PPOConfig
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--target", type=float, default=150.0)
+    args = ap.parse_args()
+    ray_tpu.init(num_cpus=max(4, args.workers + 2),
+                 ignore_reinit_error=True)
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=args.workers,
+                      num_envs_per_worker=8,
+                      rollout_fragment_length=128)
+            .training(lr=1e-3, entropy_coeff=0.005)
+            .build())
+    try:
+        best = 0.0
+        for i in range(args.iters):
+            r = algo.train()
+            if np.isfinite(r["episode_reward_mean"]):
+                best = max(best, r["episode_reward_mean"])
+            print(f"iter {r['training_iteration']:3d} "
+                  f"reward={r['episode_reward_mean']:7.1f} "
+                  f"steps/s={r['env_steps_per_sec']:,.0f}")
+            if best >= args.target:
+                break
+        print("best reward:", best)
+    finally:
+        algo.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""ViT PBT hyperparameter sweep through Tune (BASELINE: 'ViT-B/16 PBT
+sweep on a multi-host v5e slice'). Population-based training: bottom
+trials clone top trials' checkpoints and perturb the learning rate."""
+import argparse
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import PopulationBasedTraining, TuneConfig, Tuner
+
+
+def train_vit(config):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import ViT, ViTConfig
+
+    cfg = (ViTConfig.b16(num_classes=10, dtype=jnp.float32)
+           if config.get("full")
+           else ViTConfig.tiny(dtype=jnp.float32))
+    model = ViT(cfg)
+    ck = tune.get_checkpoint()
+    if ck and "params" in ck:
+        params = jax.tree.map(jnp.asarray, ck["params"])
+        start = int(ck.get("it", 0))
+    else:
+        params = model.init(jax.random.PRNGKey(0))
+        start = 0
+    tx = optax.adam(config["lr"])
+    opt_state = tx.init(params)
+    rng = np.random.default_rng(start)
+    B, side = 4, cfg.image_size
+
+    def loss_fn(params, images, labels):
+        logits = model.apply(params, images)
+        onehot = jax.nn.one_hot(labels, cfg.num_classes)
+        return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), -1))
+
+    step = jax.jit(jax.value_and_grad(loss_fn))
+    for i in range(start, start + config.get("iters", 4)):
+        images = rng.normal(size=(B, side, side, 3)).astype(np.float32)
+        labels = rng.integers(0, 10, B)
+        loss, grads = step(params, jnp.asarray(images), jnp.asarray(labels))
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        tune.report(loss=float(loss), training_iteration=i + 1,
+                    checkpoint={"params": jax.device_get(params),
+                                "it": i + 1})
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--full", action="store_true")
+    ap.add_argument("--population", type=int, default=4)
+    args = ap.parse_args()
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    pbt = PopulationBasedTraining(
+        perturbation_interval=2,
+        hyperparam_mutations={"lr": [1e-4, 3e-4, 1e-3, 3e-3]})
+    results = Tuner(
+        train_vit,
+        param_space={"lr": tune.choice([1e-4, 3e-4, 1e-3, 3e-3]),
+                     "full": args.full},
+        tune_config=TuneConfig(metric="loss", mode="min",
+                               num_samples=args.population,
+                               scheduler=pbt)).fit()
+    best = results.get_best_result()
+    print("best lr:", best.metrics["config"]["lr"],
+          "loss:", best.metrics["loss"])
+
+
+if __name__ == "__main__":
+    main()

@@ -19,7 +19,7 @@ import ray_tpu
 
 from . import sample_batch as sb
 from .learner import PPOLearner
-from .rollout_worker import RolloutWorker
+from .rollout_worker import RolloutWorker, worker_opts
 
 
 @dataclass
@@ -86,10 +86,7 @@ class PPO:
         creator_blob = (cloudpickle.dumps(c.env_creator)
                         if c.env_creator else None)
         worker_cls = ray_tpu.remote(RolloutWorker)
-        opts = {"num_cpus": c.worker_resources.get("CPU", 1.0)}
-        extra = {k: v for k, v in c.worker_resources.items() if k != "CPU"}
-        if extra:
-            opts["resources"] = extra
+        opts = worker_opts(c.worker_resources)
         self.workers: List = [
             worker_cls.options(**opts).remote(
                 c.env, c.num_envs_per_worker, c.rollout_fragment_length,

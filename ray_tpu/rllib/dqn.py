@@ -23,7 +23,7 @@ import ray_tpu
 
 from . import sample_batch as sb
 from .np_policy import ensure_numpy, forward_np
-from .rollout_worker import EnvWorkerBase
+from .rollout_worker import EnvWorkerBase, worker_opts
 from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
 
 NEXT_OBS = "next_obs"
@@ -287,10 +287,7 @@ class DQN:
         creator_blob = (cloudpickle.dumps(c.env_creator)
                         if c.env_creator else None)
         worker_cls = ray_tpu.remote(DQNRolloutWorker)
-        opts = {"num_cpus": c.worker_resources.get("CPU", 1.0)}
-        extra = {k: v for k, v in c.worker_resources.items() if k != "CPU"}
-        if extra:
-            opts["resources"] = extra
+        opts = worker_opts(c.worker_resources)
         self.workers: List = [
             worker_cls.options(**opts).remote(
                 c.env, c.num_envs_per_worker, c.rollout_fragment_length,

@@ -27,7 +27,7 @@ import numpy as np
 import ray_tpu
 
 from .np_policy import ensure_numpy, sample_actions
-from .rollout_worker import EnvWorkerBase
+from .rollout_worker import EnvWorkerBase, worker_opts
 
 
 class ImpalaRolloutWorker(EnvWorkerBase):
@@ -254,10 +254,7 @@ class Impala:
         creator_blob = (cloudpickle.dumps(c.env_creator)
                         if c.env_creator else None)
         worker_cls = ray_tpu.remote(ImpalaRolloutWorker)
-        opts = {"num_cpus": c.worker_resources.get("CPU", 1.0)}
-        extra = {k: v for k, v in c.worker_resources.items() if k != "CPU"}
-        if extra:
-            opts["resources"] = extra
+        opts = worker_opts(c.worker_resources)
         self.workers = [
             worker_cls.options(**opts).remote(
                 c.env, c.num_envs_per_worker, c.rollout_fragment_length,

@@ -16,6 +16,16 @@ from .env import make_env
 from .np_policy import ensure_numpy, sample_actions
 
 
+def worker_opts(worker_resources):
+    """Resource dict for a rollout actor: CPU becomes num_cpus, everything
+    else rides through as custom resources (shared by PPO/DQN/IMPALA)."""
+    opts = {"num_cpus": worker_resources.get("CPU", 1.0)}
+    extra = {k: v for k, v in worker_resources.items() if k != "CPU"}
+    if extra:
+        opts["resources"] = extra
+    return opts
+
+
 class EnvWorkerBase:
     """Shared rollout-actor plumbing: env construction (by name or
     pickled creator), the persistent obs, the RNG, and episode-return

@@ -1,0 +1,48 @@
+"""The examples/ scripts run end-to-end in smoke mode (subprocess, CPU
+mesh) — the BASELINE.md configurations stay executable."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script: str, *args, timeout=240) -> str:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script), *args],
+        env=env, capture_output=True, text=True, timeout=timeout,
+        cwd=REPO)
+    assert out.returncode == 0, f"stdout={out.stdout}\nstderr={out.stderr}"
+    return out.stdout
+
+
+def test_gpt2_ddp_example():
+    out = _run("gpt2_ddp_train.py", "--steps", "2")
+    assert "final:" in out and "loss" in out
+
+
+def test_resnet_cifar_example():
+    out = _run("resnet_cifar_train.py", "--steps", "2")
+    assert "final:" in out
+
+
+def test_ppo_example():
+    out = _run("ppo_cartpole.py", "--iters", "2", "--target", "1")
+    assert "best reward:" in out
+
+
+def test_llama_serve_example():
+    out = _run("llama_serve.py", timeout=300)
+    assert "generated token ids:" in out
+
+
+def test_vit_pbt_example():
+    out = _run("vit_pbt_sweep.py", "--population", "2", timeout=300)
+    assert "best lr:" in out
