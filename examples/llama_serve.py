@@ -22,19 +22,25 @@ def build(mesh, config):
     params = jax.jit(model.init,
                      out_shardings=model.param_shardings(mesh))(
         jax.random.PRNGKey(0))
-
-    @jax.jit
-    def greedy_next(params, tokens):
-        logits = model.apply(params, tokens)
-        return logits[:, -1, :].argmax(-1)
+    # KV-cache decode: ONE compiled step with static [B, 1] shapes —
+    # no per-token retrace, no prefix recompute
+    decode = jax.jit(model.decode_step)
 
     def apply(params, payload):
-        tokens = jnp.asarray(payload["tokens"], jnp.int32)
-        out = list(np.asarray(payload["tokens"][0]))
+        prompt = list(np.asarray(payload["tokens"][0]).tolist())
+        cache = model.init_cache(batch=1)
+        # prefill the cache one token at a time (static shapes; a batched
+        # prefill kernel is the production upgrade)
+        logits = None
+        for tok in prompt:
+            logits, cache = decode(params, cache,
+                                   jnp.asarray([[tok]], jnp.int32))
+        out = list(prompt)
         for _ in range(int(payload.get("max_new", 4))):
-            nxt = int(jax.device_get(
-                greedy_next(params, jnp.asarray([out], jnp.int32))[0]))
+            nxt = int(jax.device_get(logits[0].argmax(-1)))
             out.append(nxt)
+            logits, cache = decode(params, cache,
+                                   jnp.asarray([[nxt]], jnp.int32))
         return out
 
     return params, apply

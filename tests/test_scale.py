@@ -1,0 +1,105 @@
+"""Scale-envelope smoke tests (SURVEY §6: 10k+ concurrent tasks, 1k+
+PGs, 1M queued — scaled to CI size). These exist to catch the envelope's
+first casualties: polling loops, per-waiter wakeup storms, O(N^2) queue
+scans (ref test model: release/benchmarks/ many_tasks / many_pgs)."""
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    rt = ray_tpu.init(num_cpus=4)
+    yield rt
+    ray_tpu.shutdown()
+
+
+def test_thousand_tasks_complete(cluster):
+    @ray_tpu.remote(num_cpus=0.01)
+    def tiny(i):
+        return i
+
+    t0 = time.monotonic()
+    refs = [tiny.remote(i) for i in range(1000)]
+    out = ray_tpu.get(refs, timeout=120)
+    dt = time.monotonic() - t0
+    assert out == list(range(1000))
+    assert dt < 60, f"1000 tasks took {dt:.1f}s"
+
+
+def test_many_concurrent_waiters_wake_evently(cluster):
+    """200 threads each parked in wait() on a distinct object: every one
+    must wake when its object (and only then) completes — the
+    event-driven wait path under fan-out (the old 2 ms polling loop
+    burned a core per waiter here)."""
+    @ray_tpu.remote(num_cpus=0.01)
+    def produce(i):
+        time.sleep(0.05)
+        return i
+
+    refs = [produce.remote(i) for i in range(200)]
+    results = {}
+    lock = threading.Lock()
+
+    def waiter(i, ref):
+        ready, pending = ray_tpu.wait([ref], timeout=120)
+        with lock:
+            results[i] = (len(ready), len(pending))
+
+    threads = [threading.Thread(target=waiter, args=(i, r))
+               for i, r in enumerate(refs)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert all(results.get(i) == (1, 0) for i in range(200)), \
+        {i: results.get(i) for i in range(200)
+         if results.get(i) != (1, 0)}
+    assert time.monotonic() - t0 < 90
+
+
+def test_many_placement_groups_lifecycle(cluster):
+    from ray_tpu.core.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    pgs = [placement_group([{"CPU": 0.01}]) for _ in range(100)]
+    ready = sum(1 for pg in pgs if pg.ready(timeout=60))
+    assert ready == 100
+    for pg in pgs:
+        remove_placement_group(pg)
+
+
+def test_deep_queue_drains_in_order_per_actor(cluster):
+    """One actor, 500 queued calls: seq-ordered execution survives a
+    deep backlog."""
+    @ray_tpu.remote
+    class Seq:
+        def __init__(self):
+            self.n = 0
+
+        def next(self):
+            self.n += 1
+            return self.n
+
+    a = Seq.remote()
+    refs = [a.next.remote() for _ in range(500)]
+    out = ray_tpu.get(refs, timeout=120)
+    assert out == list(range(1, 501))
+    ray_tpu.kill(a)
+
+
+def test_wait_num_returns_contract_at_scale(cluster):
+    """wait() returns AT MOST num_returns ready entries even when many
+    more are already complete (the ray.wait contract)."""
+    @ray_tpu.remote(num_cpus=0.01)
+    def now(i):
+        return i
+
+    refs = [now.remote(i) for i in range(64)]
+    ray_tpu.get(refs, timeout=60)  # all complete
+    ready, pending = ray_tpu.wait(refs, num_returns=5, timeout=10)
+    assert len(ready) == 5 and len(pending) == 59
