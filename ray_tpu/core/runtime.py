@@ -13,6 +13,7 @@ the analog of the Cython binding calling into CoreWorker
 """
 from __future__ import annotations
 
+import collections
 import contextvars
 import hashlib
 import os
@@ -118,7 +119,13 @@ class DriverRuntime:
         self._events: Dict[ObjectId, threading.Event] = {}
         self._obj_waiters: Dict[ObjectId, list] = {}
         self._obj_sizes: Dict[ObjectId, int] = {}  # locality weights
-        self._placement_wake = threading.Event()
+        # PG placement: one dedicated placer thread drains a FIFO of
+        # pending groups (ref: gcs_placement_group_scheduler.cc — the GCS
+        # schedules PGs from a single queue). A per-PG thread-pool task per
+        # cluster event flooded the shared pool O(N^2) at 1k PGs.
+        self._pg_cv = threading.Condition()
+        self._pg_pending: "collections.deque[PlacementGroupId]" = collections.deque()
+        self._pg_parked: Set[PlacementGroupId] = set()
         self._recovering: Set[ObjectId] = set()
         self._pull_futures: Dict[ObjectId, Future] = {}
         self._generators: Dict[TaskId, dict] = {}
@@ -133,6 +140,8 @@ class DriverRuntime:
         self._lock = threading.RLock()
         self._pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="rt")
         self._shutdown = False
+        threading.Thread(target=self._pg_placer_loop, daemon=True,
+                         name="pg-placer").start()
         default_res = resources or {"CPU": float(os.cpu_count() or 1)}
         for i in range(num_nodes):
             self.add_node(dict(default_res))
@@ -147,6 +156,13 @@ class DriverRuntime:
 
         _set_borrow_hook(_driver_borrow)
         self._revive_detached_actors()
+        # head restart: PGs restored as RESCHEDULING (gcs restore path)
+        # need a placement pass once nodes re-register
+        with self._pg_cv:
+            for pg in self.gcs.list_pgs():
+                if pg.state in ("PENDING", "RESCHEDULING"):
+                    self._pg_pending.append(pg.pg_id)
+            self._pg_cv.notify()
 
     def _revive_detached_actors(self) -> None:
         """Head restart: re-create detached actors whose metadata survived
@@ -851,6 +867,12 @@ class DriverRuntime:
             if pg.state != "CREATED":
                 with self._lock:
                     self._parked.append(spec)
+                # the placer may have committed (or a remove landed)
+                # between the state read and the append — its
+                # _reschedule_parked_tasks would then have missed this
+                # spec; re-check so no task parks forever
+                if pg.state in ("CREATED", "REMOVED"):
+                    self._reschedule_parked_tasks()
                 return
             candidates = (
                 [pg.bundle_nodes[strat.bundle_index]]
@@ -911,22 +933,26 @@ class DriverRuntime:
                     weights[nid] = weights.get(nid, 0) + size
         return weights
 
-    def _reschedule_parked(self) -> None:
+    def _reschedule_parked_tasks(self) -> None:
         with self._lock:
             parked, self._parked = self._parked, []
         for spec in parked:
-            self._schedule(spec)
-        # wake in-flight PG placers and retry PGs whose placement window
-        # expired before the cluster grew (ref: gcs_placement_group_
-        # scheduler retries pending PGs on node add)
-        self._placement_wake.set()
-        try:
-            pending = [p.pg_id for p in self.gcs.list_pgs()
-                       if p.state == "PENDING"]
-        except Exception:
-            pending = []
-        for pid in pending:
-            self._pool.submit(self._try_place_pg, pid, True)
+            try:
+                self._schedule(spec)
+            except Exception as e:
+                # one bad spec (e.g. a node channel dying mid-lease) must
+                # not drop the rest of the swapped-out parked list
+                try:
+                    self._fail_task(spec, exc.RayTpuError(
+                        f"reschedule failed: {e!r}"))
+                except Exception:
+                    pass
+
+    def _reschedule_parked(self) -> None:
+        self._reschedule_parked_tasks()
+        # cluster membership/capacity changed: parked pending PGs get
+        # another placement pass through the single placer thread
+        self._wake_pg_placer(recheck_parked=True)
 
     # ---- streaming generators (ref: core_worker.proto:436) -------------------
 
@@ -1334,70 +1360,114 @@ class DriverRuntime:
         info = PlacementGroupInfo(pg_id=pg_id, bundles=[normalize(b) for b in bundles],
                                   strategy=strategy, name=name)
         self.gcs.register_pg(info)
-        self._pool.submit(self._try_place_pg, pg_id)
+        with self._pg_cv:
+            self._pg_pending.append(pg_id)
+            self._pg_cv.notify()
         return pg_id
 
-    def _try_place_pg(self, pg_id: PlacementGroupId,
-                      single_attempt: bool = False) -> None:
-        """single_attempt=True (retry path) makes ONE placement pass and
-        returns: retries run on the shared _pool, and a blocking
-        wait-for-capacity loop per pending PG would starve the pool's
-        other users (await-ref futures, new PG creations) for up to the
-        whole lease timeout."""
-        with self._lock:
-            placing = getattr(self, "_placing_pgs", None)
-            if placing is None:
-                placing = self._placing_pgs = set()
-            if pg_id in placing:
-                return  # another placer thread already owns this PG
-            placing.add(pg_id)
-        try:
-            self._try_place_pg_locked(pg_id, single_attempt)
-        finally:
-            with self._lock:
-                placing.discard(pg_id)
+    def _wake_pg_placer(self, recheck_parked: bool = False) -> None:
+        """Capacity or membership changed: move parked (unplaceable) PGs
+        back into the placer's queue and wake it."""
+        with self._pg_cv:
+            if recheck_parked and self._pg_parked:
+                self._pg_pending.extend(self._pg_parked)
+                self._pg_parked.clear()
+            self._pg_cv.notify()
 
-    def _try_place_pg_locked(self, pg_id: PlacementGroupId,
-                             single_attempt: bool = False) -> None:
-        info = self.gcs.get_pg(pg_id)
-        if info is None or info.state == "REMOVED":
-            return
-        deadline = time.monotonic() + self.config.worker_lease_timeout_s
-        first = True
-        while first or (not single_attempt
-                        and time.monotonic() < deadline):
-            first = False
-            placement = self.scheduler.pick_bundle_nodes(
-                self._views(), info.bundles, info.strategy)
-            if placement is not None:
-                # phase 1: prepare all bundles
-                prepared = []
-                ok = True
-                for idx, nid in enumerate(placement):
-                    node = self.nodes.get(nid)
-                    if node is None or not node.prepare_bundle(pg_id, idx,
-                                                              info.bundles[idx]):
-                        ok = False
-                        break
-                    prepared.append((node, idx))
-                if ok:
-                    # phase 2: commit
-                    for node, idx in prepared:
-                        node.commit_bundle(pg_id, idx)
-                    info.bundle_nodes = list(placement)
-                    info.state = "CREATED"
-                    self.gcs.pubsub.publish("pg", (pg_id, "CREATED"))
-                    self._reschedule_parked()
+    def _pg_placer_loop(self) -> None:
+        """Single placer thread. Placement decisions are serialized, so
+        two groups can never race prepare_bundle into mutual abort, and a
+        burst of N creations costs N placement passes — not N^2 pool
+        submissions. Parked groups (no capacity) retry on cluster events
+        and on a 500 ms tick (lease releases free capacity without an
+        event)."""
+        while True:
+            with self._pg_cv:
+                while not self._pg_pending and not self._shutdown:
+                    if self._pg_parked:
+                        if not self._pg_cv.wait(0.5) and not self._pg_pending:
+                            self._pg_pending.extend(self._pg_parked)
+                            self._pg_parked.clear()
+                    else:
+                        self._pg_cv.wait()
+                if self._shutdown:
                     return
-                for node, idx in prepared:
-                    node.return_bundle(pg_id, idx)
-            # event-with-fallback instead of a 50 ms poll: woken by any
-            # cluster change (_reschedule_parked), 500 ms safety tick
-            self._placement_wake.clear()
-            self._placement_wake.wait(0.5)
-        # stays pending; tasks against it park, and _reschedule_parked
-        # re-submits placement when the cluster changes (node joins)
-        info.state = "PENDING"
+                pg_id = self._pg_pending.popleft()
+            try:
+                placed = self._place_pg_once(pg_id)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+                placed = False  # park, never drop: a transient error (node
+                # channel death mid-prepare) must not strand the PG forever
+            if not placed:
+                with self._pg_cv:
+                    self._pg_parked.add(pg_id)
+
+    def _place_pg_once(self, pg_id: PlacementGroupId) -> bool:
+        """One 2PC placement pass. True = done (created, removed, or
+        gone); False = no capacity, park for retry."""
+        info = self.gcs.get_pg(pg_id)
+        if info is None or info.state in ("REMOVED", "CREATED"):
+            return True
+        placement = self.scheduler.pick_bundle_nodes(
+            self._views(), info.bundles, info.strategy)
+        if placement is None:
+            return self._mark_pg_pending(info)
+        # phase 1: prepare all bundles
+        prepared = []
+        ok = True
+        try:
+            for idx, nid in enumerate(placement):
+                node = self.nodes.get(nid)
+                if node is None or not node.prepare_bundle(
+                        pg_id, idx, info.bundles[idx]):
+                    ok = False
+                    break
+                prepared.append((node, idx))
+        except Exception:
+            ok = False
+        if not ok:
+            for node, idx in prepared:
+                node.return_bundle(pg_id, idx)
+            return self._mark_pg_pending(info)
+        # phase 2: commit. The CREATED transition is serialized with
+        # remove_placement_group's REMOVED transition under _pg_cv — an
+        # unsynchronized write here could overwrite REMOVED and resurrect
+        # a removed group with its bundles reserved forever.
+        for node, idx in prepared:
+            node.commit_bundle(pg_id, idx)
+        info.bundle_nodes = list(placement)
+        with self._pg_cv:
+            if info.state == "REMOVED":
+                removed = True
+            else:
+                removed = False
+                info.state = "CREATED"
+        if removed:
+            # the remover may have run mid-prepare and seen no
+            # bundle_nodes to return — return them here (return_bundle
+            # pops its entry, so a double return no-ops)
+            for node, idx in prepared:
+                node.return_bundle(pg_id, idx)
+            return True
+        self.gcs.pubsub.publish("pg", (pg_id, "CREATED"))
+        try:
+            self._reschedule_parked_tasks()
+        except Exception:
+            pass  # placement bookkeeping is done; scheduling errors
+            # surface on the affected tasks, not the placer
+        return True
+
+    def _mark_pg_pending(self, info) -> bool:
+        """Transition to PENDING unless a concurrent remove won. Returns
+        True when the group was removed (caller must NOT park it)."""
+        with self._pg_cv:
+            if info.state == "REMOVED":
+                return True
+            info.state = "PENDING"
+            return False
 
     def pg_ready(self, pg_id: PlacementGroupId, timeout: float = 30.0) -> bool:
         """Event-driven: parks on the GCS 'pg' pubsub channel rather than
@@ -1425,11 +1495,22 @@ class DriverRuntime:
         info = self.gcs.get_pg(pg_id)
         if info is None:
             return
-        info.state = "REMOVED"
+        with self._pg_cv:
+            info.state = "REMOVED"
+            try:
+                self._pg_pending.remove(pg_id)
+            except ValueError:
+                pass
+            self._pg_parked.discard(pg_id)
         for idx, nid in enumerate(info.bundle_nodes):
             node = self.nodes.get(nid)
             if node is not None:
                 node.return_bundle(pg_id, idx)
+        # returned bundles free capacity parked PGs may be waiting on
+        self._wake_pg_placer(recheck_parked=True)
+        # tasks parked against this group must fail (via _schedule's
+        # REMOVED check) rather than stay parked forever
+        self._reschedule_parked_tasks()
 
     # ---- worker RPC dispatch (the node-side core-worker service) -------------
 
@@ -1709,6 +1790,8 @@ class DriverRuntime:
         if self._shutdown:
             return
         self._shutdown = True
+        with self._pg_cv:
+            self._pg_cv.notify()
         for node in list(self.nodes.values()):
             try:
                 node.shutdown(kill=False)

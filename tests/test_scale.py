@@ -17,17 +17,17 @@ def cluster():
     ray_tpu.shutdown()
 
 
-def test_thousand_tasks_complete(cluster):
-    @ray_tpu.remote(num_cpus=0.01)
+def test_ten_thousand_tasks_complete(cluster):
+    @ray_tpu.remote(num_cpus=0.001)
     def tiny(i):
         return i
 
     t0 = time.monotonic()
-    refs = [tiny.remote(i) for i in range(1000)]
-    out = ray_tpu.get(refs, timeout=120)
+    refs = [tiny.remote(i) for i in range(10000)]
+    out = ray_tpu.get(refs, timeout=240)
     dt = time.monotonic() - t0
-    assert out == list(range(1000))
-    assert dt < 60, f"1000 tasks took {dt:.1f}s"
+    assert out == list(range(10000))
+    assert dt < 120, f"10000 tasks took {dt:.1f}s"
 
 
 def test_many_concurrent_waiters_wake_evently(cluster):
@@ -66,15 +66,20 @@ def test_many_placement_groups_lifecycle(cluster):
     from ray_tpu.core.placement_group import (placement_group,
                                               remove_placement_group)
 
-    pgs = [placement_group([{"CPU": 0.01}]) for _ in range(100)]
-    ready = sum(1 for pg in pgs if pg.ready(timeout=60))
-    assert ready == 100
+    t0 = time.monotonic()
+    pgs = [placement_group([{"CPU": 0.001}]) for _ in range(1000)]
+    ready = sum(1 for pg in pgs if pg.ready(timeout=120))
+    dt = time.monotonic() - t0
+    assert ready == 1000
+    # the single-placer design places a 1k burst in well under a second;
+    # anything superlinear (per-commit rescan storms) blows this budget
+    assert dt < 60, f"1000 PGs took {dt:.1f}s"
     for pg in pgs:
         remove_placement_group(pg)
 
 
 def test_deep_queue_drains_in_order_per_actor(cluster):
-    """One actor, 500 queued calls: seq-ordered execution survives a
+    """One actor, 5000 queued calls: seq-ordered execution survives a
     deep backlog."""
     @ray_tpu.remote
     class Seq:
@@ -86,9 +91,9 @@ def test_deep_queue_drains_in_order_per_actor(cluster):
             return self.n
 
     a = Seq.remote()
-    refs = [a.next.remote() for _ in range(500)]
-    out = ray_tpu.get(refs, timeout=120)
-    assert out == list(range(1, 501))
+    refs = [a.next.remote() for _ in range(5000)]
+    out = ray_tpu.get(refs, timeout=240)
+    assert out == list(range(1, 5001))
     ray_tpu.kill(a)
 
 
