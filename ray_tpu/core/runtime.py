@@ -1372,7 +1372,18 @@ class DriverRuntime:
             if recheck_parked and self._pg_parked:
                 self._pg_pending.extend(self._pg_parked)
                 self._pg_parked.clear()
+                self._pg_last_fp = None  # explicit event: force a real pass
             self._pg_cv.notify()
+
+    def _capacity_fingerprint(self):
+        """Cheap O(nodes) digest of per-node available resources — the
+        placer's 500 ms tick skips re-placing parked PGs when nothing has
+        changed since their last failed pass (permanently-unplaceable
+        groups must not churn pick_bundle_nodes forever)."""
+        with self._lock:
+            return tuple(sorted(
+                (n.node_id, tuple(sorted(n.available.items())))
+                for n in self.nodes.values() if n.alive))
 
     def _pg_placer_loop(self) -> None:
         """Single placer thread. Placement decisions are serialized, so
@@ -1381,13 +1392,17 @@ class DriverRuntime:
         submissions. Parked groups (no capacity) retry on cluster events
         and on a 500 ms tick (lease releases free capacity without an
         event)."""
+        self._pg_last_fp = None
         while True:
             with self._pg_cv:
                 while not self._pg_pending and not self._shutdown:
                     if self._pg_parked:
                         if not self._pg_cv.wait(0.5) and not self._pg_pending:
-                            self._pg_pending.extend(self._pg_parked)
-                            self._pg_parked.clear()
+                            fp = self._capacity_fingerprint()
+                            if fp != self._pg_last_fp:
+                                self._pg_pending.extend(self._pg_parked)
+                                self._pg_parked.clear()
+                                self._pg_last_fp = fp
                     else:
                         self._pg_cv.wait()
                 if self._shutdown:

@@ -30,18 +30,23 @@ namespace {
 
 // software crc32c (Castagnoli), slice-by-1; ~1 GB/s — run at seal time
 // on the already-written buffer, far from the memcpy hot path.
-uint32_t crc32c(const uint8_t* data, size_t n) {
-  static uint32_t table[256];
-  static bool init = false;
-  if (!init) {
+struct Crc32cTable {
+  uint32_t t[256];
+  Crc32cTable() {
     for (uint32_t i = 0; i < 256; i++) {
       uint32_t c = i;
       for (int k = 0; k < 8; k++)
         c = (c & 1) ? (0x82F63B78u ^ (c >> 1)) : (c >> 1);
-      table[i] = c;
+      t[i] = c;
     }
-    init = true;
   }
+};
+
+uint32_t crc32c(const uint8_t* data, size_t n) {
+  // magic-static: thread-safe one-time init (two Store instances sealing
+  // concurrently raced the old lazy bool-guarded fill)
+  static const Crc32cTable tbl;
+  const uint32_t* table = tbl.t;
   uint32_t c = 0xFFFFFFFFu;
   for (size_t i = 0; i < n; i++) c = table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
   return c ^ 0xFFFFFFFFu;
@@ -116,13 +121,19 @@ bool free_one(Store* s, uint64_t needed) {
       std::string path = s->spill_dir + "/" + seg_name(s, oid);
       FILE* f = fopen(path.c_str(), "wb");
       if (f) {
-        fwrite(e.base, 1, e.size, f);
-        fclose(f);
-        e.spill_path = path;
-        unmap_unlink(s, oid, e, true);
-        s->used -= e.size;
-        s->num_spills++;
-        return true;
+        // a short write (disk full/quota) recorded as a successful spill
+        // would silently lose the object at restore time — verify both
+        // the write and the flush-on-close before unmapping memory
+        size_t wrote = fwrite(e.base, 1, e.size, f);
+        int closed = fclose(f);
+        if (wrote == e.size && closed == 0) {
+          e.spill_path = path;
+          unmap_unlink(s, oid, e, true);
+          s->used -= e.size;
+          s->num_spills++;
+          return true;
+        }
+        unlink(path.c_str());  // drop the partial file
       }
       // spill failed: fall through to plain eviction
     }

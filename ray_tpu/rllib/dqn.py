@@ -236,6 +236,11 @@ class DQNConfig:
     hidden: tuple = (64, 64)
     seed: int = 0
     worker_resources: Dict[str, float] = field(default_factory=dict)
+    # include the replay buffer in save() so a restored trial (Tune PBT
+    # exploit, pause/resume) resumes warm; disable for image/large buffers
+    # where checkpoints would be GB-sized (ref: algorithm_config
+    # store_buffer_in_checkpoints)
+    checkpoint_replay_buffer: bool = True
 
     def environment(self, env: str = None, *, env_creator=None) -> "DQNConfig":
         if env is not None:
@@ -384,12 +389,18 @@ class DQN:
     def save(self) -> Dict:
         import jax
 
-        return {"params": jax.device_get(self.learner.params),
+        ckpt = {"params": jax.device_get(self.learner.params),
                 "target_params": jax.device_get(self.learner.target_params),
                 "opt_state": jax.device_get(self.learner.opt_state),
                 "iteration": self._iteration,
                 "total_steps": self._total_steps,
                 "num_updates": self.learner.num_updates}
+        if self.config.checkpoint_replay_buffer:
+            # a restored trial (Tune PBT exploit, pause/resume) must not
+            # restart cold: without the buffer it stalls until
+            # learning_starts refills and all PER priorities are lost
+            ckpt["buffer"] = self.buffer.state()
+        return ckpt
 
     def restore(self, ckpt: Dict) -> None:
         import jax
@@ -403,6 +414,8 @@ class DQN:
         self.learner.num_updates = int(ckpt.get("num_updates", 0))
         self._iteration = int(ckpt.get("iteration", 0))
         self._total_steps = int(ckpt.get("total_steps", 0))
+        if "buffer" in ckpt:
+            self.buffer.restore(ckpt["buffer"])
 
     def stop(self) -> None:
         for w in self.workers:

@@ -309,12 +309,13 @@ class Impala:
     def train(self) -> Dict[str, Any]:
         c = self.config
         t0 = time.monotonic()
-        stats: Dict[str, float] = {}
+        stat_sums: Dict[str, float] = {}
         steps = 0
         for _ in range(c.batches_per_iter):
             batch = self._queue.get(timeout=300)
             steps += int(np.prod(batch["actions"].shape))
-            stats = self.learner.update(batch)
+            for k, v in self.learner.update(batch).items():
+                stat_sums[k] = stat_sums.get(k, 0.0) + float(v)
             if self.learner.num_updates % c.broadcast_interval == 0:
                 new_ref = ray_tpu.put(self.learner.get_params())
                 with self._params_lock:
@@ -336,7 +337,9 @@ class Impala:
                                     if self._recent else float("nan")),
             "episodes_total": self._total_episodes,
             "env_steps_per_sec": steps / max(1e-9, dt),
-            **stats,
+            # means over the iteration's updates, not the last batch's
+            **{k: v / max(1, c.batches_per_iter)
+               for k, v in stat_sums.items()},
         }
 
     # -- Tune-trainable surface ------------------------------------------

@@ -58,12 +58,26 @@ class ReplayBuffer:
                          for k, v in self._cols.items()},
                 "next": self._next, "size": self._size}
 
-    def restore(self, state: Dict) -> None:
+    def restore(self, state: Dict) -> np.ndarray:
+        """Restore a snapshot, possibly across a capacity change (PBT
+        explore can hand a donor checkpoint from a differently-sized
+        trial). On shrink the NEWEST rows win. Returns the source-row
+        order of the kept rows (the prioritized subclass re-maps its
+        leaf priorities with it)."""
+        size = int(state["size"])
+        nxt = int(state["next"])
+        keep = min(size, self.capacity)
+        if nxt < size:  # ring had wrapped: oldest row sits at `next`
+            order = np.concatenate([np.arange(nxt, size), np.arange(0, nxt)])
+        else:
+            order = np.arange(size)
+        order = order[len(order) - keep:]
         for k, v in state["cols"].items():
             self._cols[k] = np.empty((self.capacity, *v.shape[1:]), v.dtype)
-            self._cols[k][:len(v)] = v
-        self._size = int(state["size"])
-        self._next = int(state["next"])
+            self._cols[k][:keep] = v[order]
+        self._size = keep
+        self._next = keep % self.capacity if self.capacity else 0
+        return order
 
 
 class SumTree:
@@ -159,11 +173,17 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         s["max_priority"] = self._max_priority
         return s
 
-    def restore(self, state: Dict) -> None:
-        super().restore(state)
+    def restore(self, state: Dict) -> np.ndarray:
+        order = super().restore(state)
         self._max_priority = float(state.get("max_priority", 1.0))
         prios = state.get("priorities")
         if prios is None:  # plain-buffer snapshot: everything max priority
             prios = np.full(self._size, self._max_priority ** self.alpha)
+        else:
+            prios = np.asarray(prios)[order]  # same keep/reorder as rows
+        # fresh tree: leaves beyond the restored size would otherwise keep
+        # stale priorities and skew every subsequent sample toward them
+        self._tree = SumTree(self.capacity)
         if self._size:
-            self._tree.update(np.arange(self._size), np.asarray(prios))
+            self._tree.update(np.arange(self._size), prios[:self._size])
+        return order

@@ -54,12 +54,17 @@ class _BoundMultiplex:
             self._models.move_to_end(model_id)
             while len(self._models) > self._max:
                 _, evicted = self._models.popitem(last=False)
-                del_fn = getattr(evicted, "__del__", None)
-                if callable(del_fn):
-                    try:
-                        del_fn()
-                    except Exception:
-                        pass
+                # release via a conventional hook, never __del__ directly —
+                # the interpreter calls __del__ again at GC, and models
+                # freeing device memory/files there would double-release
+                for hook in ("unload", "close"):
+                    fn = getattr(evicted, hook, None)
+                    if callable(fn):
+                        try:
+                            fn()
+                        except Exception:
+                            pass
+                        break
         return model
 
     def model_ids(self) -> List[str]:

@@ -325,6 +325,39 @@ class TestReplayBufferState:
         assert (idx == 7).mean() > 0.9  # priorities survived the roundtrip
         assert np.isfinite(w).all()
 
+    def test_restore_into_smaller_capacity_keeps_newest(self):
+        """PBT explore can hand a donor checkpoint from a bigger trial:
+        restore must clamp to capacity, newest rows first."""
+        from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer
+
+        big = PrioritizedReplayBuffer(capacity=32, alpha=1.0, seed=0)
+        big.add({"x": np.arange(40, dtype=np.int64)})  # wraps: keeps 8..39
+        small = PrioritizedReplayBuffer(capacity=8, alpha=1.0, seed=0)
+        small.restore(big.state())
+        assert len(small) == 8
+        assert sorted(small._cols["x"][:8].tolist()) == list(range(32, 40))
+        _, idx, w = small.sample(32)
+        assert (idx < 8).all() and np.isfinite(w).all()
+
+    def test_restore_into_live_buffer_clears_stale_priorities(self):
+        """Restoring a small snapshot over a grown buffer must zero the
+        sum-tree leaves beyond the restored size."""
+        from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer
+
+        snap = PrioritizedReplayBuffer(capacity=64, alpha=1.0, seed=0)
+        snap.add({"x": np.arange(4, dtype=np.int64)})
+        state = snap.state()
+
+        live = PrioritizedReplayBuffer(capacity=64, alpha=1.0, seed=1)
+        live.add({"x": np.arange(64, dtype=np.int64)})
+        live.update_priorities(np.arange(64), np.full(64, 100.0))
+        live.restore(state)
+        assert len(live) == 4
+        # total must reflect only the 4 restored leaves, not 64 stale ones
+        assert live._tree.total <= 4 * live._max_priority + 1e-6
+        _, idx, _ = live.sample(32)
+        assert (idx < 4).all()
+
 
 class TestImpala:
     def test_vtrace_matches_onpolicy_gae_lambda1(self):
