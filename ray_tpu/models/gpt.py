@@ -229,19 +229,29 @@ class GPT:
             policy = both(policy, names("flash_out", "flash_lse"))
         return policy
 
+    def _embed(self, wte: jax.Array, wpe: jax.Array, tokens: jax.Array,
+               positions: Optional[jax.Array] = None) -> jax.Array:
+        """Token + position embedding — the single definition all paths
+        (apply/loss, loss_pp, actor-pipeline stage 0) share."""
+        c = self.config
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])[None, :]
+        return wte.astype(c.dtype)[tokens] + wpe.astype(c.dtype)[positions]
+
+    def _lm_head(self, head_w: jax.Array, x: jax.Array) -> jax.Array:
+        """Tied LM head in bf16 on the MXU fast path, f32 accumulation —
+        a f32xf32 matmul here runs at 1/4 MXU rate and doubles HBM
+        traffic on the [B,S,V] logits. Single definition for all paths."""
+        return jnp.einsum("bsd,vd->bsv", x,
+                          head_w.astype(self.config.dtype),
+                          preferred_element_type=jnp.float32)
+
     def apply(self, params: Dict[str, jax.Array], tokens: jax.Array,
               positions: Optional[jax.Array] = None,
               rng: Optional[jax.Array] = None) -> jax.Array:
         """tokens [B, S] int32 -> logits [B, S, padded_vocab] (f32)."""
-        c = self.config
         x = self._backbone(params, tokens, rng, positions=positions)
-        # tied LM head in bf16 on the MXU fast path, f32 accumulation —
-        # a f32xf32 matmul here runs at 1/4 MXU rate and doubles HBM
-        # traffic on the [B,S,V] logits
-        logits = jnp.einsum("bsd,vd->bsv", x,
-                            params["wte"].astype(c.dtype),
-                            preferred_element_type=jnp.float32)
-        return logits
+        return self._lm_head(params["wte"], x)
 
     def loss(self, params: Dict[str, jax.Array], tokens: jax.Array,
              targets: jax.Array, rng: Optional[jax.Array] = None) -> jax.Array:
@@ -258,15 +268,21 @@ class GPT:
         This is the bench configuration (bench.py): marginally faster than
         plain `loss` at B=32+ and the only option once vocab*batch*seq
         logits stop fitting HBM."""
-        c = self.config
-        B, S = tokens.shape
         x = self._backbone(params, tokens, rng)         # [B,S,D] bf16
-        wte = params["wte"].astype(c.dtype)
-        xt = x.reshape(B * S, -1)
-        tg = targets.reshape(B * S)
-        assert (B * S) % num_chunks == 0
-        xt = xt.reshape(num_chunks, (B * S) // num_chunks, -1)
-        tg = tg.reshape(num_chunks, (B * S) // num_chunks)
+        return self._chunked_head_nll(params["wte"], x, targets, num_chunks)
+
+    def _chunked_head_nll(self, wte: jax.Array, x: jax.Array,
+                          targets: jax.Array, num_chunks: int) -> jax.Array:
+        """Head + token-mean NLL per chunk under jax.checkpoint — shared by
+        loss_chunked and loss_pp so the no-full-logits property holds on
+        every path."""
+        wte = wte.astype(self.config.dtype)
+        T = targets.size
+        xt = x.reshape(T, -1)
+        tg = targets.reshape(T)
+        assert T % num_chunks == 0
+        xt = xt.reshape(num_chunks, T // num_chunks, -1)
+        tg = tg.reshape(num_chunks, T // num_chunks)
 
         @functools.partial(jax.checkpoint,
                            policy=jax.checkpoint_policies.nothing_saveable)
@@ -280,7 +296,60 @@ class GPT:
             return carry + jnp.sum(lse - gold), None
 
         total, _ = jax.lax.scan(chunk_nll, jnp.float32(0.0), (xt, tg))
-        return total / (B * S)
+        return total / T
+
+    def loss_pp(self, params: Dict[str, jax.Array], tokens: jax.Array,
+                targets: jax.Array, mesh, num_microbatches: int = 0,
+                pp_axis: str = "pp", rng: Optional[jax.Array] = None,
+                num_chunks: int = 0) -> jax.Array:
+        """Pipeline-parallel loss: the layer stack runs as a collective
+        microbatch pipeline over the mesh's `pp` axis (see
+        parallel/pipeline.py), embedding and LM head replicated across pp
+        (their FLOPs are small next to the body; this is the standard
+        praxis-style split). Differentiable — jax.grad through this gives
+        the reverse pipeline automatically.
+
+        The reference has no pipeline engine to cite; capability-new per
+        SURVEY.md §5."""
+        from ..parallel.pipeline import pipeline_spmd, stack_stages
+
+        c = self.config
+        P_ = mesh.shape[pp_axis]
+        M = num_microbatches or max(P_, 2)
+        B, S = tokens.shape
+        if B % M:
+            raise ValueError(f"batch {B} not divisible into {M} microbatches")
+        x = self._embed(params["wte"], params["wpe"], tokens)
+        D = x.shape[-1]
+        layer_params = {k: v for k, v in params.items()
+                        if k not in ("wte", "wpe", "lnf_g", "lnf_b")}
+        if c.dropout > 0.0 and rng is not None:
+            # same regularization as the non-pp path: embedding dropout +
+            # per-layer residual-branch dropout keys stacked onto the
+            # layer params (they stage-split with everything else)
+            emb_key, layers_key = jax.random.split(rng)
+            x = self._dropout(x, emb_key)
+            layer_params["_dropout_key"] = jax.random.split(
+                layers_key, c.n_layer)
+        stages = stack_stages(layer_params, P_)
+        x_mb = x.reshape(M, B // M, S, D)
+
+        def stage_fn(lp, xs):
+            def blk(h, lpp):
+                return self._block(h, lpp, None), None
+            body = jax.checkpoint(blk, policy=self._remat_policy()) \
+                if c.remat else blk
+            h, _ = jax.lax.scan(body, xs, lp)
+            return h
+
+        y_mb = pipeline_spmd(stage_fn, stages, x_mb, mesh, pp_axis=pp_axis)
+        x = y_mb.reshape(B, S, D)
+        x = layernorm(x, params["lnf_g"], params["lnf_b"])
+        # chunked head: pipeline parallelism exists for the large-model
+        # regime where full [B,S,V] f32 logits can't live in HBM.
+        # M divides B, so it always divides B*S — a safe default chunking.
+        return self._chunked_head_nll(params["wte"], x, targets,
+                                      num_chunks or M)
 
     def _backbone(self, params: Dict[str, jax.Array], tokens: jax.Array,
                   rng: Optional[jax.Array] = None,
@@ -288,10 +357,7 @@ class GPT:
         """Transformer stack up to the final layernorm ([B,S,D], no head)."""
         c = self.config
         B, S = tokens.shape
-        if positions is None:
-            positions = jnp.arange(S)[None, :]
-        x = params["wte"].astype(c.dtype)[tokens] \
-            + params["wpe"].astype(c.dtype)[positions]
+        x = self._embed(params["wte"], params["wpe"], tokens, positions)
         layer_params = {k: v for k, v in params.items()
                         if k not in ("wte", "wpe", "lnf_g", "lnf_b")}
         if c.dropout > 0.0 and rng is not None:

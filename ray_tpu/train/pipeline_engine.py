@@ -1,0 +1,308 @@
+"""Actor-hosted pipeline parallelism: 1F1B microbatch schedule over stage
+actors.
+
+Complements the in-XLA collective pipeline (ray_tpu/parallel/pipeline.py):
+that one runs the whole pipeline inside a single jitted program over the
+`pp` mesh axis (the right shape for one pod slice); THIS one hosts each
+stage in its own actor — its own process, host, and (on real hardware) its
+own mesh — with activations flowing through the object store. That is the
+shape pipeline parallelism takes ACROSS slices or hosts where one XLA
+program can't span the gap.
+
+The reference has no pipeline engine at all (SURVEY.md §5); its nearest
+machinery is the DDP WorkerGroup (ref: python/ray/train/_internal/
+worker_group.py:100), which this reuses in spirit: stage actors in a
+placement group, driven by an explicit 1F1B schedule (schedule_1f1b in
+parallel/pipeline.py).
+
+Scheduling note: the runtime's actor queues execute strictly in submission
+order (core/worker_main.py ActorQueue), so submitting each stage's ops in
+1F1B order pins the schedule, while ObjectRef arguments give exact
+cross-stage dataflow sync — fwd(i, mb) waits on fwd(i-1, mb), bwd(i, mb)
+waits on bwd(i+1, mb). No barriers, no polling.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.core.placement_group import placement_group, remove_placement_group
+
+from ..parallel.pipeline import schedule_1f1b
+
+
+class _StageActor:
+    """One pipeline stage: holds its parameter shard, runs jitted
+    per-microbatch forward (saving the vjp closure — the 1F1B in-flight
+    activation memory), backward (popping it), and the optimizer step on
+    locally-accumulated grads."""
+
+    def setup(self, stage_idx: int, num_stages: int, fn_blob: bytes,
+              params: Any, tx_blob: Optional[bytes]) -> bool:
+        import jax
+
+        self.idx = stage_idx
+        self.num_stages = num_stages
+        self.is_last = stage_idx == num_stages - 1
+        self.fn = cloudpickle.loads(fn_blob)
+        self.params = params
+        self.tx = cloudpickle.loads(tx_blob) if tx_blob else None
+        self.opt_state = self.tx.init(params) if self.tx else None
+        self._vjps = {}
+        self._grad_acc = None
+        self._jax = jax
+        return True
+
+    def forward(self, mb: int, x, targets=None):
+        """Returns the stage output (activation for the next stage; the
+        scalar loss on the last stage). Residuals stay here in the vjp."""
+        jax = self._jax
+        if self.is_last:
+            out, vjp = jax.vjp(
+                lambda p, h: self.fn(p, h, targets), self.params, x)
+        else:
+            out, vjp = jax.vjp(self.fn, self.params, x)
+        self._vjps[mb] = vjp
+        self.peak_in_flight = max(getattr(self, "peak_in_flight", 0),
+                                  len(self._vjps))
+        return out
+
+    def backward(self, mb: int, g=None):
+        """g: cotangent from the next stage (None on the last stage — the
+        loss seeds with 1.0). Returns the cotangent for the previous
+        stage and accumulates this stage's param grads."""
+        import jax.numpy as jnp
+
+        vjp = self._vjps.pop(mb)
+        if g is None:
+            g = jnp.float32(1.0)
+        gp, gx = vjp(g)
+        if self._grad_acc is None:
+            self._grad_acc = gp
+        else:
+            self._grad_acc = self._jax.tree.map(
+                lambda a, b: a + b, self._grad_acc, gp)
+        return gx
+
+    def apply_grads(self, scale: float = 1.0) -> bool:
+        import optax
+
+        grads = self._jax.tree.map(lambda g: g * scale, self._grad_acc)
+        updates, self.opt_state = self.tx.update(grads, self.opt_state,
+                                                 self.params)
+        self.params = optax.apply_updates(self.params, updates)
+        self._grad_acc = None
+        return True
+
+    def in_flight(self) -> int:
+        """Number of saved fwd residuals (0 after a drained step)."""
+        return len(self._vjps)
+
+    def max_in_flight(self) -> int:
+        """Peak saved residual count across the run — tests assert the
+        1F1B memory bound (<= num_stages - idx) against this; a GPipe
+        regression (all fwds before any bwd) would blow it to M."""
+        return getattr(self, "peak_in_flight", 0)
+
+    def get_grad(self, key: str):
+        return self._grad_acc[key]
+
+    def add_grad(self, key: str, g) -> bool:
+        self._grad_acc[key] = self._grad_acc[key] + g
+        return True
+
+    def get_params(self):
+        return self.params
+
+
+class PipelineEngine:
+    """Drives P stage actors through the 1F1B schedule.
+
+    stage_fns: P callables. Stages 0..P-2: fn(params, x) -> activation.
+        The last stage: fn(params, x, targets) -> scalar loss (mean over
+        the microbatch).
+    stage_params: P parameter pytrees (one per stage).
+    tx: an optax optimizer applied per-stage to local grads.
+    """
+
+    def __init__(self, stage_fns: Sequence[Callable],
+                 stage_params: Sequence[Any],
+                 tx=None,
+                 resources_per_stage: Optional[dict] = None,
+                 tied: Sequence[tuple] = ()):
+        # tied: [(stage_i, key_i, stage_j, key_j), ...] — parameter pairs
+        # that are copies of one weight (e.g. tied embedding/LM head split
+        # across first/last stage). Their grads are exchanged and summed
+        # before each optimizer step, so the copies evolve identically —
+        # the Megatron-style tied-embedding all-reduce.
+        self.tied = list(tied)
+        self.num_stages = len(stage_fns)
+        res = dict(resources_per_stage or {"CPU": 1.0})
+        self._pg = placement_group([dict(res) for _ in range(self.num_stages)],
+                                   strategy="SPREAD")
+        if not self._pg.ready(timeout=60):
+            raise TimeoutError("pipeline placement group not ready")
+        actor_cls = ray_tpu.remote(_StageActor)
+        tx_blob = cloudpickle.dumps(tx) if tx is not None else None
+        self.stages = []
+        setups = []
+        for i, (fn, params) in enumerate(zip(stage_fns, stage_params)):
+            a = actor_cls.options(
+                num_cpus=res.get("CPU", 1.0),
+                placement_group=self._pg,
+                placement_group_bundle_index=i).remote()
+            self.stages.append(a)
+            setups.append(a.setup.remote(i, self.num_stages,
+                                         cloudpickle.dumps(fn), params,
+                                         tx_blob))
+        ray_tpu.get(setups, timeout=120)
+
+    def step(self, microbatches: Sequence[Any], targets: Sequence[Any],
+             apply: bool = True, timeout: float = 300.0) -> float:
+        """One 1F1B training step over M microbatches. Returns mean loss."""
+        P_, M = self.num_stages, len(microbatches)
+        sizes = {len(mb) for mb in microbatches}
+        if len(sizes) > 1:
+            # per-microbatch mean losses are averaged and grads scaled by
+            # 1/M — ragged sizes would silently mis-weight tokens
+            raise ValueError(f"microbatches must be equal-sized, got {sizes}")
+        sched = schedule_1f1b(P_, M)
+        fwd_ref: List[List[Any]] = [[None] * M for _ in range(P_)]
+        bwd_ref: List[List[Any]] = [[None] * M for _ in range(P_)]
+        # submit ops per stage IN SCHEDULE ORDER (actor queues preserve
+        # it); an op whose upstream ref isn't created yet is deferred to a
+        # later sweep — the worklist drains in <= P sweeps
+        pending = [list(ops) for ops in sched]
+        while any(pending):
+            progressed = False
+            for i in range(P_):
+                while pending[i]:
+                    kind, mb = pending[i][0]
+                    if kind == "fwd":
+                        src = microbatches[mb] if i == 0 else fwd_ref[i - 1][mb]
+                        if src is None:
+                            break
+                        if i == P_ - 1:
+                            fwd_ref[i][mb] = self.stages[i].forward.remote(
+                                mb, src, targets[mb])
+                        else:
+                            fwd_ref[i][mb] = self.stages[i].forward.remote(
+                                mb, src)
+                    else:
+                        if fwd_ref[i][mb] is None:
+                            break
+                        g = None if i == P_ - 1 else bwd_ref[i + 1][mb]
+                        if i != P_ - 1 and g is None:
+                            break
+                        bwd_ref[i][mb] = self.stages[i].backward.remote(mb, g)
+                    pending[i].pop(0)
+                    progressed = True
+            if not progressed:
+                raise RuntimeError("1F1B schedule deadlocked (bug)")
+        losses = ray_tpu.get([fwd_ref[P_ - 1][mb] for mb in range(M)],
+                             timeout=timeout)
+        # wait for every stage's final backward so the step is fully
+        # drained when this returns (per-actor FIFO ordering would already
+        # sequence get_grad/apply_grads correctly, but callers of
+        # step(apply=False) may read params/timings immediately after)
+        ray_tpu.get([bwd_ref[i][M - 1] for i in range(P_)], timeout=timeout)
+        if apply:
+            # tied copies exchange grads ONCE per optimizer step, over the
+            # full accumulation — doing it per step() would double-count
+            # the partner's contribution under apply=False accumulation
+            for (i, ki, j, kj) in self.tied:
+                gi = self.stages[i].get_grad.remote(ki)
+                gj = self.stages[j].get_grad.remote(kj)
+                ray_tpu.get([self.stages[i].add_grad.remote(ki, gj),
+                             self.stages[j].add_grad.remote(kj, gi)],
+                            timeout=timeout)
+            ray_tpu.get([s.apply_grads.remote(1.0 / M) for s in self.stages],
+                        timeout=timeout)
+        return float(sum(float(l) for l in losses) / M)
+
+    def get_params(self) -> List[Any]:
+        return ray_tpu.get([s.get_params.remote() for s in self.stages],
+                           timeout=120)
+
+    def shutdown(self) -> None:
+        for s in self.stages:
+            try:
+                ray_tpu.kill(s)
+            except Exception:
+                pass
+        try:
+            remove_placement_group(self._pg)
+        except Exception:
+            pass
+
+
+def gpt_pipeline_stages(model, params, num_stages: int):
+    """Split a GPT (models/gpt.py) into pipeline stages: stage 0 carries
+    the embedding, the last stage carries the final LN + tied LM head +
+    loss; layer blocks divide evenly. Returns (stage_fns, stage_params)
+    for PipelineEngine."""
+    import jax.numpy as jnp
+
+    c = model.config
+    L = c.n_layer
+    if L % num_stages:
+        raise ValueError(f"{L} layers not divisible by {num_stages} stages")
+    per = L // num_stages
+    layer_keys = [k for k in params
+                  if k not in ("wte", "wpe", "lnf_g", "lnf_b")]
+
+    def slice_layers(lo, hi):
+        return {k: params[k][lo:hi] for k in layer_keys}
+
+    stage_params = []
+    for i in range(num_stages):
+        sp = {"layers": slice_layers(i * per, (i + 1) * per)}
+        if i == 0:
+            sp["wte"] = params["wte"]
+            sp["wpe"] = params["wpe"]
+        if i == num_stages - 1:
+            sp["lnf_g"] = params["lnf_g"]
+            sp["lnf_b"] = params["lnf_b"]
+            if "wte" not in sp:
+                sp["head"] = params["wte"]  # tied head needs its own copy
+        stage_params.append(sp)
+
+    def run_layers(model, sp, x):
+        import jax
+
+        def blk(h, lp):
+            return model._block(h, lp, None), None
+        h, _ = jax.lax.scan(blk, x, sp["layers"])
+        return h
+
+    def make_first(model):
+        def fn(sp, tokens):
+            x = model._embed(sp["wte"], sp["wpe"], tokens)
+            return run_layers(model, sp, x)
+        return fn
+
+    def make_mid(model):
+        def fn(sp, x):
+            return run_layers(model, sp, x)
+        return fn
+
+    def make_last(model):
+        def fn(sp, x, targets):
+            from ..ops import cross_entropy_loss, layernorm
+            h = run_layers(model, sp, x)
+            h = layernorm(h, sp["lnf_g"], sp["lnf_b"])
+            head = sp.get("head", sp.get("wte"))
+            return cross_entropy_loss(model._lm_head(head, h), targets)
+        return fn
+
+    if num_stages < 2:
+        raise ValueError("pipeline needs >= 2 stages")
+    stage_fns: List[Callable] = [make_first(model)]
+    for _ in range(num_stages - 2):
+        stage_fns.append(make_mid(model))
+    stage_fns.append(make_last(model))
+    # the tied embedding/head copies must exchange grads every step
+    tied = [(0, "wte", num_stages - 1, "head")]
+    return stage_fns, stage_params, tied
