@@ -131,6 +131,7 @@ def main() -> None:
             "steps_timed": steps,
             "sec_per_step": round(dt / steps, 4),
             "ppo_env_steps_per_sec": rl_steps_per_sec,
+            "ppo_atari_env_steps_per_sec": _bench_ppo_atari_steps(),
         },
     }))
 
@@ -179,6 +180,50 @@ def _bench_ppo_steps() -> float:
         import traceback
 
         traceback.print_exc()  # a broken RL stack must not look like 0 perf
+        return 0.0
+
+
+def _bench_ppo_atari_steps() -> float:
+    """PPO env-steps/s on the Atari-shaped pipeline (84x84x4 uint8 obs
+    through WarpFrame+FrameStack, NatureCNN policy) — the BASELINE PPO
+    config is Atari Breakout; this measures the pixels path, not the
+    4-float CartPole shortcut."""
+    try:
+        import ray_tpu
+        from ray_tpu.rllib.algorithm import PPOConfig
+
+        cores = os.cpu_count() or 1
+        if SMOKE:
+            n_workers, n_envs, T, iters = 1, 4, 16, 1
+            mb, epochs = 64, 1
+        else:
+            n_workers = int(os.environ.get(
+                "RTPU_BENCH_ATARI_WORKERS", max(2, min(16, cores))))
+            n_envs, T, iters = 8, 64, 2
+            mb, epochs = 1024, 1
+        ray_tpu.init(num_cpus=float(max(4, n_workers + 1)))
+        try:
+            algo = (PPOConfig(hidden=(512,))
+                    .environment("BreakoutShaped-v0")
+                    .rollouts(num_rollout_workers=n_workers,
+                              num_envs_per_worker=n_envs,
+                              rollout_fragment_length=T)
+                    .training(sgd_minibatch_size=mb, num_sgd_epochs=epochs)
+                    .build())
+            algo.train()  # warmup: spawn workers, first jit compile
+            t0 = time.perf_counter()
+            total = 0
+            for _ in range(iters):
+                total += algo.train()["timesteps_this_iter"]
+            dt = time.perf_counter() - t0
+            algo.stop()
+            return round(total / dt, 1)
+        finally:
+            ray_tpu.shutdown()
+    except Exception:
+        import traceback
+
+        traceback.print_exc()
         return 0.0
 
 

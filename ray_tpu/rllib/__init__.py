@@ -19,10 +19,14 @@ _EXPORTS = {
     "DQNRolloutWorker": "dqn",
     "Impala": "impala", "ImpalaConfig": "impala",
     "ImpalaLearner": "impala",
+    "SAC": "sac", "SACConfig": "sac", "SACLearner": "sac",
     "ReplayBuffer": "replay_buffer",
     "PrioritizedReplayBuffer": "replay_buffer",
-    "CartPoleVecEnv": "env", "VectorEnv": "env",
+    "CartPoleVecEnv": "env", "PendulumVecEnv": "env", "VectorEnv": "env",
     "make_env": "env", "register_env": "env",
+    "BreakoutShapedVecEnv": "preprocessors", "wrap_atari": "preprocessors",
+    "WarpFrameVec": "preprocessors", "FrameStackVec": "preprocessors",
+    "MaxAndSkipVec": "preprocessors",
     "PPOLearner": "learner", "ppo_loss": "learner",
     "RolloutWorker": "rollout_worker",
 }

@@ -96,7 +96,8 @@ class PPO:
         ]
         info = ray_tpu.get(self.workers[0].env_info.remote(), timeout=60)
         self.learner = PPOLearner(
-            info["obs_dim"], info["num_actions"], lr=c.lr,
+            info.get("obs_shape", info["obs_dim"]), info["num_actions"],
+            lr=c.lr,
             clip=c.clip_param, vf_coeff=c.vf_loss_coeff,
             ent_coeff=c.entropy_coeff, minibatch_size=c.sgd_minibatch_size,
             num_epochs=c.num_sgd_epochs, hidden=c.hidden, seed=c.seed)

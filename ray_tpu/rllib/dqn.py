@@ -38,8 +38,8 @@ class DQNRolloutWorker(EnvWorkerBase):
         params = ensure_numpy(params)
         T, n = self.rollout_len, self.env.num_envs
         A = self.env.num_actions
-        obs_buf = np.empty((T, n, self.env.obs_dim), np.float32)
-        next_buf = np.empty((T, n, self.env.obs_dim), np.float32)
+        obs_buf = np.empty((T, n, *self.env.obs_shape), self.env.obs_dtype)
+        next_buf = np.empty((T, n, *self.env.obs_shape), self.env.obs_dtype)
         act_buf = np.empty((T, n), np.int64)
         rew_buf = np.empty((T, n), np.float32)
         done_buf = np.empty((T, n), np.bool_)
@@ -78,7 +78,7 @@ class DQNLearner:
     rationale). Returns |TD| so prioritized replay can refresh
     priorities without a second device pass."""
 
-    def __init__(self, obs_dim: int, num_actions: int, *, lr: float = 5e-4,
+    def __init__(self, obs_dim, num_actions: int, *, lr: float = 5e-4,
                  gamma: float = 0.99, double_q: bool = True,
                  hidden=(64, 64), seed: int = 0,
                  max_grad_norm: float = 10.0):
@@ -300,7 +300,7 @@ class DQN:
             for i in range(c.num_rollout_workers)]
         info = ray_tpu.get(self.workers[0].env_info.remote(), timeout=60)
         self.learner = DQNLearner(
-            info["obs_dim"], info["num_actions"], lr=c.lr, gamma=c.gamma,
+            info.get("obs_shape", info["obs_dim"]), info["num_actions"], lr=c.lr, gamma=c.gamma,
             double_q=c.double_q, hidden=c.hidden, seed=c.seed)
         if c.prioritized_replay:
             self.buffer = PrioritizedReplayBuffer(

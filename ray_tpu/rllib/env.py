@@ -20,13 +20,19 @@ class VectorEnv:
     num_envs: int
     obs_dim: int
     num_actions: int
+    obs_dtype = np.float32
+
+    @property
+    def obs_shape(self) -> Tuple[int, ...]:
+        """Per-env observation shape; image envs override with (H, W, C)."""
+        return (self.obs_dim,)
 
     def reset(self, seed: Optional[int] = None) -> np.ndarray:
         raise NotImplementedError
 
     def step(self, actions: np.ndarray
              ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, Dict[str, Any]]:
-        """-> (obs [n, obs_dim], reward [n], done [n], info). Sub-envs
+        """-> (obs [n, *obs_shape], reward [n], done [n], info). Sub-envs
         auto-reset on done (the obs returned is the NEW episode's)."""
         raise NotImplementedError
 
@@ -98,8 +104,78 @@ class CartPoleVecEnv(VectorEnv):
                 done.astype(np.bool_), info)
 
 
+class PendulumVecEnv(VectorEnv):
+    """Classic inverted pendulum swing-up, vectorized — the repo's
+    continuous-action reference task (gymnasium Pendulum-v1 dynamics:
+    obs (cos th, sin th, th_dot), torque in [-2, 2], 200-step episodes).
+    Continuous envs expose `action_dim`/bounds instead of num_actions."""
+
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+    DT = 0.05
+    G = 10.0
+    M = 1.0
+    L = 1.0
+    MAX_STEPS = 200
+
+    continuous = True
+    action_dim = 1
+    action_low = -2.0
+    action_high = 2.0
+
+    def __init__(self, num_envs: int = 8, seed: int = 0):
+        self.num_envs = num_envs
+        self.obs_dim = 3
+        self.num_actions = 0  # discrete interface N/A
+        self._rng = np.random.default_rng(seed)
+        self._th = np.zeros(num_envs)
+        self._thdot = np.zeros(num_envs)
+        self._steps = np.zeros(num_envs, np.int64)
+
+    def _obs(self) -> np.ndarray:
+        return np.stack([np.cos(self._th), np.sin(self._th),
+                         self._thdot], axis=1).astype(np.float32)
+
+    def _sample(self, n):
+        return (self._rng.uniform(-np.pi, np.pi, n),
+                self._rng.uniform(-1.0, 1.0, n))
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._th, self._thdot = self._sample(self.num_envs)
+        self._steps[:] = 0
+        return self._obs()
+
+    def step(self, actions: np.ndarray):
+        u = np.clip(np.asarray(actions, np.float64).reshape(self.num_envs),
+                    -self.MAX_TORQUE, self.MAX_TORQUE)
+        th = ((self._th + np.pi) % (2 * np.pi)) - np.pi
+        cost = th ** 2 + 0.1 * self._thdot ** 2 + 0.001 * u ** 2
+        thdot = self._thdot + (
+            3 * self.G / (2 * self.L) * np.sin(self._th)
+            + 3.0 / (self.M * self.L ** 2) * u) * self.DT
+        thdot = np.clip(thdot, -self.MAX_SPEED, self.MAX_SPEED)
+        self._th = self._th + thdot * self.DT
+        self._thdot = thdot
+        self._steps += 1
+        truncated = self._steps >= self.MAX_STEPS
+        done = truncated.copy()
+        info: Dict[str, Any] = {}
+        if done.any():
+            idx = np.nonzero(done)[0]
+            info["truncated"] = truncated
+            info["final_obs"] = self._obs()
+            th_new, thdot_new = self._sample(len(idx))
+            self._th[idx] = th_new
+            self._thdot[idx] = thdot_new
+            self._steps[idx] = 0
+        return self._obs(), (-cost).astype(np.float32), done, info
+
+
 _REGISTRY: Dict[str, Callable[..., VectorEnv]] = {
     "CartPole-v1": CartPoleVecEnv,
+    "Pendulum-v1": PendulumVecEnv,
 }
 
 
@@ -109,6 +185,8 @@ def register_env(name: str, creator: Callable[..., VectorEnv]) -> None:
 
 
 def make_env(name: str, num_envs: int = 8, seed: int = 0) -> VectorEnv:
+    if name not in _REGISTRY:
+        from . import preprocessors  # noqa: F401 — registers image envs
     if name in _REGISTRY:
         return _REGISTRY[name](num_envs=num_envs, seed=seed)
     try:  # fall back to gymnasium when installed

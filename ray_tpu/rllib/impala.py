@@ -44,7 +44,7 @@ class ImpalaRolloutWorker(EnvWorkerBase):
     def sample(self, params: Dict) -> Dict[str, np.ndarray]:
         params = ensure_numpy(params)
         T, n = self.rollout_len, self.env.num_envs
-        obs = np.empty((T + 1, n, self.env.obs_dim), np.float32)
+        obs = np.empty((T + 1, n, *self.env.obs_shape), self.env.obs_dtype)
         act = np.empty((T, n), np.int64)
         logp = np.empty((T, n), np.float32)
         rew = np.empty((T, n), np.float32)
@@ -78,7 +78,7 @@ class ImpalaRolloutWorker(EnvWorkerBase):
 class ImpalaLearner:
     """Jitted V-trace actor-critic update (Espeholt et al. eq. 1)."""
 
-    def __init__(self, obs_dim: int, num_actions: int, *, lr: float = 5e-4,
+    def __init__(self, obs_dim, num_actions: int, *, lr: float = 5e-4,
                  gamma: float = 0.99, rho_clip: float = 1.0,
                  c_clip: float = 1.0, vf_coeff: float = 0.5,
                  ent_coeff: float = 0.01, hidden=(64, 64), seed: int = 0,
@@ -136,7 +136,8 @@ class ImpalaLearner:
 
         def loss_fn(params, batch):
             T, n = batch["actions"].shape
-            obs_all = batch["obs"].reshape((T + 1) * n, -1)
+            obs_all = batch["obs"].reshape((T + 1) * n,
+                                           *batch["obs"].shape[2:])
             logits_all, values_all = forward(params, obs_all)
             logits = logits_all.reshape(T + 1, n, -1)[:T]
             values = values_all.reshape(T + 1, n)
@@ -263,7 +264,7 @@ class Impala:
             for i in range(c.num_rollout_workers)]
         info = ray_tpu.get(self.workers[0].env_info.remote(), timeout=60)
         self.learner = ImpalaLearner(
-            info["obs_dim"], info["num_actions"], lr=c.lr, gamma=c.gamma,
+            info.get("obs_shape", info["obs_dim"]), info["num_actions"], lr=c.lr, gamma=c.gamma,
             rho_clip=c.rho_clip, c_clip=c.c_clip, vf_coeff=c.vf_coeff,
             ent_coeff=c.ent_coeff, hidden=c.hidden, seed=c.seed)
         self._params_ref = ray_tpu.put(self.learner.get_params())

@@ -91,7 +91,7 @@ class PPOLearner:
     """Single-process learner; LearnerGroup-style scale-out runs this under
     shard_map on a MeshGroup with mesh_axis="dp"."""
 
-    def __init__(self, obs_dim: int, num_actions: int, *,
+    def __init__(self, obs_dim, num_actions: int, *,
                  lr: float = 3e-4, clip: float = 0.2, vf_coeff: float = 0.5,
                  ent_coeff: float = 0.01, minibatch_size: int = 256,
                  num_epochs: int = 4, hidden=(64, 64), seed: int = 0,

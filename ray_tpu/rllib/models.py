@@ -12,11 +12,45 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def init_policy_params(rng: jax.Array, obs_dim: int, num_actions: int,
-                       hidden: Tuple[int, ...] = (64, 64)) -> Dict:
-    keys = jax.random.split(rng, len(hidden) + 2)
+# NatureCNN (Mnih et al. 2015) conv stack: (out_channels, kernel, stride).
+# ref: rllib/models/catalog.py conv defaults for 84x84 Atari frames.
+NATURE_CONV: Tuple[Tuple[int, int, int], ...] = ((32, 8, 4), (64, 4, 2),
+                                                 (64, 3, 1))
+
+
+def _conv_out_hw(h: int, w: int, conv) -> Tuple[int, int]:
+    for (_, k, s) in conv:
+        h = (h - k) // s + 1
+        w = (w - k) // s + 1
+    return h, w
+
+
+def init_policy_params(rng: jax.Array, obs_shape, num_actions: int,
+                       hidden: Tuple[int, ...] = (64, 64),
+                       conv: Tuple = NATURE_CONV) -> Dict:
+    """obs_shape: int (flat vector) or (H, W, C) image — image obs get a
+    NatureCNN front end before the fc trunk."""
     params = {}
-    last = obs_dim
+    if isinstance(obs_shape, int):
+        last = obs_shape
+    elif len(obs_shape) == 1:
+        last = int(obs_shape[0])
+    else:
+        H, W, C = obs_shape
+        ckeys = jax.random.split(jax.random.fold_in(rng, 17), len(conv))
+        cin = C
+        for i, (cout, k, s) in enumerate(conv):
+            fan_in = k * k * cin
+            # stride rides in the key so params stay a pure array pytree
+            # (an int leaf would hit the optimizer and grad maps)
+            params[f"conv{i}s{s}_w"] = jax.random.normal(
+                ckeys[i], (k, k, cin, cout), jnp.float32) \
+                * np.sqrt(2.0 / fan_in)
+            params[f"conv{i}s{s}_b"] = jnp.zeros((cout,), jnp.float32)
+            cin = cout
+        oh, ow = _conv_out_hw(H, W, conv)
+        last = oh * ow * cin
+    keys = jax.random.split(rng, len(hidden) + 2)
     for i, h in enumerate(hidden):
         params[f"w{i}"] = jax.random.normal(
             keys[i], (last, h), jnp.float32) * np.sqrt(2.0 / last)
@@ -31,9 +65,29 @@ def init_policy_params(rng: jax.Array, obs_dim: int, num_actions: int,
     return params
 
 
+from .np_policy import conv_layer_keys  # noqa: E402 — single parser
+
+
+def has_conv(params: Dict) -> bool:
+    return any(k.startswith("conv0s") for k in params)
+
+
+def _conv_trunk(params: Dict, x: jax.Array) -> jax.Array:
+    """NatureCNN forward: uint8 [B,H,W,C] -> flat [B, F]. Normalization
+    (x/255) lives here so rollout and learner can both feed raw frames."""
+    x = x.astype(jnp.float32) / 255.0 if x.dtype == jnp.uint8 \
+        else x.astype(jnp.float32)
+    for wk, bk, s in conv_layer_keys(params):
+        x = jax.lax.conv_general_dilated(
+            x, params[wk], window_strides=(s, s), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        x = jax.nn.relu(x + params[bk])
+    return x.reshape(x.shape[0], -1)
+
+
 def forward(params: Dict, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """obs [B, obs_dim] -> (logits [B, A], value [B])."""
-    x = obs
+    """obs [B, obs_dim] or [B,H,W,C] -> (logits [B, A], value [B])."""
+    x = _conv_trunk(params, obs) if has_conv(params) else obs
     i = 0
     while f"w{i}" in params:
         x = jnp.tanh(x @ params[f"w{i}"] + params[f"b{i}"])

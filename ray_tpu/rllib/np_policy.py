@@ -23,10 +23,49 @@ def ensure_numpy(params: Dict) -> Dict:
     return {k: np.asarray(v, dtype=np.float32) for k, v in params.items()}
 
 
+def _conv2d_np(x: np.ndarray, w: np.ndarray, stride: int) -> np.ndarray:
+    """VALID conv via stride-tricks im2col + one BLAS matmul (the numpy
+    analog of lax.conv NHWC/HWIO). x [B,H,W,C] f32, w [kh,kw,cin,cout]."""
+    B, H, W, C = x.shape
+    kh, kw, ci, co = w.shape
+    oh = (H - kh) // stride + 1
+    ow = (W - kw) // stride + 1
+    s0, s1, s2, s3 = x.strides
+    patches = np.lib.stride_tricks.as_strided(
+        x, (B, oh, ow, kh, kw, C),
+        (s0, s1 * stride, s2 * stride, s1, s2, s3))
+    out = patches.reshape(B * oh * ow, kh * kw * C) @ w.reshape(-1, co)
+    return out.reshape(B, oh, ow, co)
+
+
+def conv_layer_keys(params: Dict):
+    """Ordered [(w_key, b_key, stride), ...] parsed from the conv{i}s{s}_w
+    key grammar. THE single implementation — models.py (jax side) imports
+    it from here, since this module deliberately has no jax dependency."""
+    out = []
+    i = 0
+    while True:
+        match = [k for k in params if k.startswith(f"conv{i}s")
+                 and k.endswith("_w")]
+        if not match:
+            return out
+        wk = match[0]
+        out.append((wk, wk[:-2] + "_b", int(wk[len(f"conv{i}s"):-2])))
+        i += 1
+
+
 def forward_np(params: Dict, obs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-    """obs [B, obs_dim] -> (logits [B, A], value [B]). Mirrors
-    models.forward exactly (two tanh hidden layers + separate heads)."""
+    """obs [B, obs_dim] or [B,H,W,C] -> (logits [B, A], value [B]).
+    Mirrors models.forward exactly (NatureCNN trunk for image obs, tanh
+    hidden layers, separate heads)."""
     x = obs
+    conv_keys = conv_layer_keys(params)
+    if conv_keys:
+        x = x.astype(np.float32) / 255.0 if x.dtype == np.uint8 \
+            else x.astype(np.float32)
+        for wk, bk, s in conv_keys:
+            x = np.maximum(_conv2d_np(x, params[wk], s) + params[bk], 0.0)
+        x = x.reshape(len(x), -1)
     i = 0
     while f"w{i}" in params:
         x = np.tanh(x @ params[f"w{i}"] + params[f"b{i}"])

@@ -1,0 +1,240 @@
+"""Image-observation preprocessing wrappers + a Breakout-shaped env.
+
+Vectorized ports of the reference's Atari pipeline (ref:
+rllib/env/wrappers/atari_wrappers.py — MaxAndSkipEnv :71, WarpFrame :148,
+FrameStack :206): grayscale + 84x84 resize + 4-frame stack over a
+VectorEnv, operating on whole [n, H, W, C] batches.
+
+This image ships no ALE/ROMs, so `BreakoutShapedVecEnv` stands in for the
+BASELINE PPO config (Atari Breakout): native 210x160x3 uint8 frames, the
+Breakout action set (NOOP/FIRE/RIGHT/LEFT), a paddle that must intercept a
+falling ball — pixels-to-policy learnable, exercising the full conv +
+wrapper pipeline at the real observation scale.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from .env import VectorEnv, register_env
+
+
+class VecEnvWrapper(VectorEnv):
+    def __init__(self, env: VectorEnv):
+        self.env = env
+        self.num_envs = env.num_envs
+        self.num_actions = env.num_actions
+        self.obs_dtype = env.obs_dtype
+
+    @property
+    def obs_shape(self):
+        return self.env.obs_shape
+
+    @property
+    def obs_dim(self):
+        # derived, so shape-changing wrappers (warp/stack) stay consistent
+        return int(np.prod(self.obs_shape))
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        return self.env.reset(seed)
+
+    def step(self, actions: np.ndarray):
+        return self.env.step(actions)
+
+
+class MaxAndSkipVec(VecEnvWrapper):
+    """Repeat each action `skip` times; reward is the sum over the window
+    (stopping at the first done per env so a new episode's rewards don't
+    leak in); obs is the elementwise max of the last two frames (ALE
+    flicker removal). ref: atari_wrappers.py:71."""
+
+    def __init__(self, env: VectorEnv, skip: int = 4):
+        super().__init__(env)
+        self.skip = skip
+
+    def step(self, actions: np.ndarray):
+        n = self.num_envs
+        total = np.zeros(n, np.float32)
+        done_seen = np.zeros(n, np.bool_)
+        prev = obs = None
+        info: Dict[str, Any] = {}
+        for _ in range(self.skip):
+            prev = obs
+            obs, reward, done, info = self.env.step(actions)
+            total += reward * (~done_seen)
+            done_seen |= done
+        if prev is not None:
+            obs = np.maximum(obs, prev)
+        return obs, total, done_seen, info
+
+
+class WarpFrameVec(VecEnvWrapper):
+    """RGB [n,H,W,3] uint8 -> grayscale 84x84x1 uint8 (luma weights +
+    nearest-neighbor resize; no cv2 in this image). ref:
+    atari_wrappers.py:148 WarpFrame."""
+
+    SIZE = 84
+
+    def __init__(self, env: VectorEnv):
+        super().__init__(env)
+        h, w = env.obs_shape[0], env.obs_shape[1]
+        self._rows = np.linspace(0, h - 1, self.SIZE).round().astype(np.intp)
+        self._cols = np.linspace(0, w - 1, self.SIZE).round().astype(np.intp)
+        self.obs_dtype = np.uint8
+
+    @property
+    def obs_shape(self):
+        return (self.SIZE, self.SIZE, 1)
+
+    def _warp(self, obs: np.ndarray) -> np.ndarray:
+        gray = (obs[..., 0] * 0.299 + obs[..., 1] * 0.587
+                + obs[..., 2] * 0.114)
+        small = gray[:, self._rows[:, None], self._cols[None, :]]
+        return small.astype(np.uint8)[..., None]
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        return self._warp(self.env.reset(seed))
+
+    def step(self, actions: np.ndarray):
+        obs, reward, done, info = self.env.step(actions)
+        return self._warp(obs), reward, done, info
+
+
+class FrameStackVec(VecEnvWrapper):
+    """Stack the last k frames along the channel axis; a done env's stack
+    refills with its new episode's first frame. ref:
+    atari_wrappers.py:206 FrameStack."""
+
+    def __init__(self, env: VectorEnv, k: int = 4):
+        super().__init__(env)
+        self.k = k
+        h, w, c = env.obs_shape
+        assert c == 1, "stack grayscale frames (WarpFrameVec first)"
+        self._buf = np.zeros((env.num_envs, h, w, k), env.obs_dtype)
+
+    @property
+    def obs_shape(self):
+        h, w, _ = self.env.obs_shape
+        return (h, w, self.k)
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        frame = self.env.reset(seed)[..., 0]
+        self._buf[:] = frame[..., None]
+        return self._buf.copy()
+
+    def step(self, actions: np.ndarray):
+        obs, reward, done, info = self.env.step(actions)
+        self._buf = np.roll(self._buf, -1, axis=-1)
+        self._buf[..., -1] = obs[..., 0]
+        if done.any():
+            idx = np.nonzero(done)[0]
+            # post-done obs is the new episode's first frame: refill
+            self._buf[idx] = obs[idx]
+        return self._buf.copy(), reward, done, info
+
+
+class BreakoutShapedVecEnv(VectorEnv):
+    """Falling-ball catch game at Atari Breakout's native observation and
+    action interface: 210x160x3 uint8 frames, actions (NOOP, FIRE, RIGHT,
+    LEFT). A ball drops from the top with horizontal drift (bouncing off
+    walls); the paddle at the bottom must intercept it: +1 per catch, 0
+    per miss, 5 drops per episode."""
+
+    H, W = 210, 160
+    PADDLE_Y = 190
+    PADDLE_HALF = 8
+    BALL_HALF = 2
+    PADDLE_SPEED = 6
+    BALL_VY = 5
+    DROPS = 5
+
+    def __init__(self, num_envs: int = 8, seed: int = 0):
+        self.num_envs = num_envs
+        self.obs_dim = self.H * self.W * 3
+        self.num_actions = 4
+        self.obs_dtype = np.uint8
+        self._rng = np.random.default_rng(seed)
+        n = num_envs
+        self._bx = np.zeros(n, np.float64)
+        self._by = np.zeros(n, np.float64)
+        self._bvx = np.zeros(n, np.float64)
+        self._px = np.zeros(n, np.float64)
+        self._drops = np.zeros(n, np.int64)
+
+    @property
+    def obs_shape(self):
+        return (self.H, self.W, 3)
+
+    def _spawn(self, idx: np.ndarray) -> None:
+        m = len(idx)
+        self._bx[idx] = self._rng.uniform(10, self.W - 10, m)
+        self._by[idx] = 10.0
+        self._bvx[idx] = self._rng.uniform(-3, 3, m)
+
+    def _render(self) -> np.ndarray:
+        n = self.num_envs
+        frames = np.zeros((n, self.H, self.W, 3), np.uint8)
+        bh = self.BALL_HALF
+        ph = self.PADDLE_HALF
+        for i in range(n):
+            bx, by = int(self._bx[i]), int(self._by[i])
+            frames[i, max(0, by - bh):by + bh,
+                   max(0, bx - bh):bx + bh] = (200, 72, 72)
+            px = int(self._px[i])
+            frames[i, self.PADDLE_Y:self.PADDLE_Y + 4,
+                   max(0, px - ph):px + ph] = (200, 72, 72)
+        return frames
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        all_idx = np.arange(self.num_envs)
+        self._spawn(all_idx)
+        self._px[:] = self.W / 2
+        self._drops[:] = self.DROPS
+        return self._render()
+
+    def step(self, actions: np.ndarray):
+        # Breakout action semantics: 0 NOOP, 1 FIRE (noop here), 2 RIGHT,
+        # 3 LEFT
+        dx = np.where(actions == 2, self.PADDLE_SPEED,
+                      np.where(actions == 3, -self.PADDLE_SPEED, 0))
+        self._px = np.clip(self._px + dx, self.PADDLE_HALF,
+                           self.W - self.PADDLE_HALF)
+        self._bx += self._bvx
+        bounce = (self._bx < self.BALL_HALF) | (self._bx > self.W - self.BALL_HALF)
+        self._bvx = np.where(bounce, -self._bvx, self._bvx)
+        self._bx = np.clip(self._bx, self.BALL_HALF, self.W - self.BALL_HALF)
+        self._by += self.BALL_VY
+        landed = self._by >= self.PADDLE_Y
+        caught = landed & (np.abs(self._bx - self._px)
+                           <= self.PADDLE_HALF + self.BALL_HALF)
+        reward = caught.astype(np.float32)
+        done = np.zeros(self.num_envs, np.bool_)
+        if landed.any():
+            idx = np.nonzero(landed)[0]
+            self._drops[idx] -= 1
+            finished = idx[self._drops[idx] <= 0]
+            done[finished] = True
+            self._drops[finished] = self.DROPS
+            self._spawn(idx)
+            if len(finished):
+                self._px[finished] = self.W / 2
+        return self._render(), reward, done, {}
+
+
+def wrap_atari(env: VectorEnv, frame_stack: int = 4,
+               max_and_skip: int = 0) -> VectorEnv:
+    """The reference's wrap_deepmind composition for VectorEnvs."""
+    if max_and_skip:
+        env = MaxAndSkipVec(env, skip=max_and_skip)
+    env = WarpFrameVec(env)
+    return FrameStackVec(env, k=frame_stack)
+
+
+def _make_breakout_shaped(num_envs: int = 8, seed: int = 0) -> VectorEnv:
+    return wrap_atari(BreakoutShapedVecEnv(num_envs=num_envs, seed=seed))
+
+
+register_env("BreakoutShaped-v0", _make_breakout_shaped)

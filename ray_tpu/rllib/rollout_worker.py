@@ -62,6 +62,7 @@ class EnvWorkerBase:
 
     def env_info(self) -> dict:
         return {"obs_dim": self.env.obs_dim,
+                "obs_shape": tuple(self.env.obs_shape),
                 "num_actions": self.env.num_actions,
                 "num_envs": self.env.num_envs}
 
@@ -77,7 +78,7 @@ class RolloutWorker(EnvWorkerBase):
     def sample(self, params: Dict) -> sb.Batch:
         params = ensure_numpy(params)  # one conversion, not one per step
         T, n = self.rollout_len, self.env.num_envs
-        obs_buf = np.empty((T, n, self.env.obs_dim), np.float32)
+        obs_buf = np.empty((T, n, *self.env.obs_shape), self.env.obs_dtype)
         act_buf = np.empty((T, n), np.int64)
         logp_buf = np.empty((T, n), np.float32)
         val_buf = np.empty((T, n), np.float32)

@@ -454,3 +454,190 @@ class TestImpala:
                 algo2.stop()
         finally:
             algo.stop()
+
+
+class TestImageObs:
+    def test_np_conv_forward_matches_jax(self):
+        import jax
+        from ray_tpu.rllib.models import init_policy_params, forward
+        from ray_tpu.rllib.np_policy import forward_np, ensure_numpy
+        import jax.numpy as jnp
+
+        params = init_policy_params(jax.random.PRNGKey(0), (84, 84, 4), 4,
+                                    hidden=(64,))
+        obs = (np.random.default_rng(0).random((5, 84, 84, 4)) * 255
+               ).astype(np.uint8)
+        lj, vj = forward(params, jnp.asarray(obs))
+        ln, vn = forward_np(ensure_numpy(params), obs)
+        np.testing.assert_allclose(np.asarray(lj), ln, atol=1e-3, rtol=1e-3)
+        np.testing.assert_allclose(np.asarray(vj), vn, atol=1e-3, rtol=1e-3)
+
+    def test_warp_and_stack_shapes(self):
+        from ray_tpu.rllib.preprocessors import (BreakoutShapedVecEnv,
+                                                 wrap_atari)
+
+        env = wrap_atari(BreakoutShapedVecEnv(num_envs=3, seed=0))
+        obs = env.reset()
+        assert obs.shape == (3, 84, 84, 4) and obs.dtype == np.uint8
+        assert env.obs_shape == (84, 84, 4)
+        obs, r, d, _ = env.step(np.zeros(3, np.int64))
+        assert obs.shape == (3, 84, 84, 4)
+
+    def test_frame_stack_rolls_and_refills_on_done(self):
+        from ray_tpu.rllib.env import VectorEnv
+        from ray_tpu.rllib.preprocessors import FrameStackVec
+
+        class Counter(VectorEnv):
+            """Emits frame k = constant k; env 0 'dies' at step 3."""
+            num_envs = 2
+            obs_dim = 4
+            num_actions = 2
+            obs_dtype = np.uint8
+
+            def __init__(self):
+                self.k = 0
+
+            @property
+            def obs_shape(self):
+                return (2, 2, 1)
+
+            def reset(self, seed=None):
+                self.k = 0
+                return np.zeros((2, 2, 2, 1), np.uint8)
+
+            def step(self, actions):
+                self.k += 1
+                obs = np.full((2, 2, 2, 1), self.k, np.uint8)
+                done = np.array([self.k == 3, False])
+                return obs, np.zeros(2, np.float32), done, {}
+
+        env = FrameStackVec(Counter(), k=4)
+        env.reset()
+        for _ in range(3):
+            obs, _, done, _ = env.step(np.zeros(2, np.int64))
+        # env 0 done at k=3: its whole stack refills with frame 3
+        assert (obs[0, ..., :] == 3).all()
+        # env 1 keeps the rolling history (0,1,2,3)
+        assert list(obs[1, 0, 0, :]) == [0, 1, 2, 3]
+
+    def test_max_and_skip_masks_post_done_rewards(self):
+        from ray_tpu.rllib.env import VectorEnv
+        from ray_tpu.rllib.preprocessors import MaxAndSkipVec
+
+        class RewardEach(VectorEnv):
+            num_envs = 1
+            obs_dim = 1
+            num_actions = 2
+
+            def __init__(self):
+                self.t = 0
+
+            @property
+            def obs_shape(self):
+                return (1,)
+
+            def reset(self, seed=None):
+                self.t = 0
+                return np.zeros((1, 1), np.float32)
+
+            def step(self, actions):
+                self.t += 1
+                done = np.array([self.t == 2])  # dies on 2nd inner step
+                return (np.zeros((1, 1), np.float32),
+                        np.ones(1, np.float32), done, {})
+
+        env = MaxAndSkipVec(RewardEach(), skip=4)
+        env.reset()
+        _, reward, done, _ = env.step(np.zeros(1, np.int64))
+        # rewards after the first done must not leak into the old episode
+        assert reward[0] == 2.0 and done[0]
+
+    def test_breakout_shaped_tracker_beats_random(self):
+        from ray_tpu.rllib.preprocessors import BreakoutShapedVecEnv
+
+        env = BreakoutShapedVecEnv(num_envs=8, seed=3)
+        env.reset()
+        tracked = 0.0
+        for _ in range(300):
+            act = np.where(env._bx > env._px + 2, 2,
+                           np.where(env._bx < env._px - 2, 3, 0))
+            _, r, _, _ = env.step(act)
+            tracked += r.sum()
+        env2 = BreakoutShapedVecEnv(num_envs=8, seed=3)
+        env2.reset()
+        rng = np.random.default_rng(0)
+        rand = 0.0
+        for _ in range(300):
+            _, r, _, _ = env2.step(rng.integers(0, 4, 8))
+            rand += r.sum()
+        assert tracked > 3 * max(rand, 1.0), (tracked, rand)
+
+    def test_ppo_trains_on_image_obs(self, cluster):
+        from ray_tpu.rllib import PPO, PPOConfig
+
+        cfg = PPOConfig(env="BreakoutShaped-v0", num_rollout_workers=1,
+                        num_envs_per_worker=4, rollout_fragment_length=16,
+                        hidden=(128,), sgd_minibatch_size=32,
+                        num_sgd_epochs=1)
+        algo = PPO(cfg)
+        try:
+            res = algo.train()
+            assert res["timesteps_this_iter"] == 64
+            assert np.isfinite(res["policy_loss"])
+            assert np.isfinite(res["entropy"])
+        finally:
+            algo.stop()
+
+
+class TestSAC:
+    def test_sac_learns_pendulum(self, cluster):
+        from ray_tpu.rllib import SAC, SACConfig
+
+        cfg = SACConfig(num_rollout_workers=1, num_envs_per_worker=8,
+                        rollout_fragment_length=50, learning_starts=1000,
+                        train_batch_size=256, num_updates_per_iter=400,
+                        alpha_lr=1e-3, hidden=(128, 128), seed=1)
+        algo = SAC(cfg)
+        try:
+            rews = []
+            for _ in range(25):
+                res = algo.train()
+                r = res["episode_reward_mean"]
+                if r == r:
+                    rews.append(r)
+            # Pendulum random play sits near -1300; SAC reaches ~ -600
+            # within 10k steps with the 1:1 update ratio
+            assert rews and rews[-1] > -900, rews[-3:]
+            assert rews[-1] > rews[0] + 200, (rews[0], rews[-1])
+        finally:
+            algo.stop()
+
+    def test_sac_rejects_discrete_env(self, cluster):
+        from ray_tpu.rllib import SAC, SACConfig
+
+        with pytest.raises(ValueError):
+            SAC(SACConfig(env="CartPole-v1"))
+
+    def test_sac_checkpoint_roundtrip(self, cluster):
+        from ray_tpu.rllib import SAC, SACConfig
+
+        cfg = SACConfig(num_rollout_workers=1, num_envs_per_worker=4,
+                        rollout_fragment_length=25, learning_starts=100,
+                        train_batch_size=64, num_updates_per_iter=8)
+        a = SAC(cfg)
+        try:
+            a.train()
+            a.train()
+            ckpt = a.save()
+            b = SAC(cfg)
+            try:
+                b.restore(ckpt)
+                assert b._total_steps == a._total_steps
+                ap = a.learner.params["actor"]["w0"]
+                bp = b.learner.params["actor"]["w0"]
+                np.testing.assert_allclose(np.asarray(ap), np.asarray(bp))
+                assert float(b.learner.log_alpha) == float(a.learner.log_alpha)
+            finally:
+                b.stop()
+        finally:
+            a.stop()
