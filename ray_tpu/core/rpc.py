@@ -111,12 +111,35 @@ class RpcChannel:
         self._out_q.put(msg)
 
     def _write_loop(self) -> None:
+        from . import wire
+
         while True:
             msg = self._out_q.get()
             if msg is _CLOSE:
                 return
             try:
-                self._conn.send(msg)
+                # typed frames, never pickle: see wire.py (the reference's
+                # control plane is protobuf/gRPC; pickle framing here was
+                # an RCE amplifier behind one shared token)
+                self._conn.send_bytes(wire.encode(msg))
+            except wire.WireEncodeError as e:
+                traceback.print_exc()
+                # one bad payload must not kill the channel — but it must
+                # not strand its correlated future either: fail a _REQ's
+                # future locally; answer a _RESP's caller with an _ERR
+                kind, msg_id = msg[0], msg[1]
+                if kind == _REQ:
+                    with self._lock:
+                        fut = self._pending.pop(msg_id, None)
+                    if fut is not None and not fut.done():
+                        fut.set_exception(e)
+                elif kind == _RESP:
+                    try:
+                        self._conn.send_bytes(wire.encode(
+                            (_ERR, msg_id, f"WireEncodeError: {e}", "")))
+                    except Exception:
+                        pass
+                continue
             except Exception:
                 self._teardown()
                 return
@@ -127,15 +150,27 @@ class RpcChannel:
         self._handler = handler
 
     def _read_loop(self) -> None:
+        from . import wire
+
         try:
             while not self._closed.is_set():
                 try:
-                    msg = self._conn.recv()
+                    data = self._conn.recv_bytes()
                 except (EOFError, OSError, BrokenPipeError):
                     break
                 except TypeError:
                     break  # connection torn down mid-recv at interpreter exit
-                kind, msg_id, a, b = msg
+                try:
+                    msg = wire.decode(data)
+                    kind, msg_id, a, b = msg
+                    if not isinstance(kind, int) or not isinstance(msg_id, int):
+                        raise wire.WireDecodeError("bad frame header")
+                except (wire.WireDecodeError, ValueError, TypeError):
+                    # malformed/malicious frame: it was never evaluated —
+                    # drop it and keep serving (a pickle-framing channel
+                    # would have executed it on recv)
+                    traceback.print_exc()
+                    continue
                 if kind == _RESP:
                     with self._lock:
                         fut = self._pending.pop(msg_id, None)

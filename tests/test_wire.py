@@ -1,0 +1,164 @@
+"""Typed wire codec (core/wire.py): control frames are structural data,
+never pickle — a forged frame must not execute code (the reference's
+equivalent guarantee comes from protobuf/gRPC framing,
+ref: src/ray/protobuf/common.proto)."""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from ray_tpu.core import wire
+
+
+class TestCodec:
+    def test_primitive_roundtrip(self):
+        vals = [None, True, False, 0, -1, 2 ** 40, -(2 ** 70), 1.5,
+                float("inf"), "héllo", b"\x00\xff", [1, [2, "x"]],
+                (1, 2), {"a": {"b": [1]}}, {1, 2}, frozenset({3})]
+        for v in vals:
+            assert wire.decode(wire.encode(v)) == v
+
+    def test_ids_and_taskspec_roundtrip(self):
+        from ray_tpu.core.ids import (ActorId, JobId, NodeId, ObjectId,
+                                      PlacementGroupId, TaskId, WorkerId)
+        from ray_tpu.core.task_spec import (ARG_VALUE, SchedulingStrategy,
+                                            TaskSpec, TaskType)
+
+        for cls in (ActorId, JobId, NodeId, ObjectId, PlacementGroupId,
+                    TaskId, WorkerId):
+            i = cls.from_random()
+            assert wire.decode(wire.encode(i)) == i
+        spec = TaskSpec(
+            task_id=TaskId.from_random(), job_id=JobId.from_random(),
+            task_type=TaskType.ACTOR_TASK, func_id="fid", description="d",
+            args=[(ARG_VALUE, b"abc")], kwargs={"k": (ARG_VALUE, b"v")},
+            scheduling_strategy=SchedulingStrategy(kind="SPREAD"),
+            seq_no=7)
+        out = wire.decode(wire.encode(spec))
+        assert out.task_id == spec.task_id
+        assert out.task_type is TaskType.ACTOR_TASK
+        assert out.args == spec.args and out.seq_no == 7
+        assert out.scheduling_strategy.kind == "SPREAD"
+
+    def test_numpy_scalars_coerce(self):
+        assert wire.decode(wire.encode({"r": np.float32(1.5)})) == {"r": 1.5}
+        assert wire.decode(wire.encode(np.int64(7))) == 7
+
+    def test_unregistered_type_raises_at_send(self):
+        class Evil:
+            pass
+
+        with pytest.raises(wire.WireEncodeError):
+            wire.encode(Evil())
+
+    def test_pickle_frame_rejected(self):
+        evil = pickle.dumps({"x": 1})
+        with pytest.raises(wire.WireDecodeError):
+            wire.decode(evil)
+
+    def test_truncated_and_forged_frames_rejected(self):
+        good = wire.encode([1, 2, 3])
+        with pytest.raises(wire.WireDecodeError):
+            wire.decode(good[:-2])
+        # forge an absurd container count: tag list + count 2^31
+        import struct
+        forged = wire.MAGIC + bytes([wire.VERSION, 8]) \
+            + struct.pack("<I", 2 ** 31)
+        with pytest.raises(wire.WireDecodeError):
+            wire.decode(forged)
+        with pytest.raises(wire.WireDecodeError):
+            wire.decode(good + b"trailing")
+
+    def test_unknown_struct_id_rejected(self):
+        import struct
+        frame = wire.MAGIC + bytes([wire.VERSION, 12]) \
+            + struct.pack("<H", 9999) + wire.encode(())[3:]
+        with pytest.raises(wire.WireDecodeError):
+            wire.decode(frame)
+
+
+class TestMaliciousFrameOverRpc:
+    def test_pickle_bomb_cannot_execute_and_channel_survives(self, tmp_path):
+        """An attacker with the cluster token sends a raw pickle that would
+        create a file on unpickling. The server must neither execute it nor
+        die: a legitimate request on another connection still works."""
+        from multiprocessing.connection import Client
+
+        from ray_tpu.core.rpc import RpcServer, cluster_token, connect
+
+        canary = tmp_path / "pwned"
+
+        class Bomb:
+            def __reduce__(self):
+                return (os.system, (f"touch {canary}",))
+
+        srv = RpcServer(("127.0.0.1", 0), lambda ch: (lambda m, p: "ok"))
+        try:
+            # raw connection, correct token, malicious payload
+            conn = Client(srv.address, authkey=cluster_token())
+            conn.send_bytes(pickle.dumps((0, 1, "m", Bomb())))
+            # also a frame with valid magic but garbage body
+            conn.send_bytes(wire.MAGIC + bytes([wire.VERSION, 250]))
+            import time
+
+            time.sleep(0.5)
+            assert not canary.exists(), "pickle executed on the server!"
+            # the server is still alive and serving typed frames
+            ch = connect(srv.address, name="legit")
+            assert ch.call("ping", {"n": 1}, timeout=10) == "ok"
+            ch.close()
+            conn.close()
+        finally:
+            srv.close()
+
+    def test_worker_payloads_still_flow(self):
+        """Sanity: the full task path (specs, refs, results) works over the
+        typed frames — covered more broadly by the core suites."""
+        import ray_tpu
+
+        rt = ray_tpu.init(num_cpus=2)
+        try:
+            @ray_tpu.remote
+            def f(x):
+                return {"v": x * 2, "arr_bytes": bytes(3)}
+
+            out = ray_tpu.get(f.remote(21), timeout=60)
+            assert out["v"] == 42
+        finally:
+            ray_tpu.shutdown()
+
+    def test_deep_nesting_frame_rejected(self):
+        # 5000 nested single-element lists: must raise WireDecodeError,
+        # not RecursionError (which would bypass the read loop's
+        # drop-and-continue and kill the channel)
+        import struct
+        body = b""
+        for _ in range(5000):
+            body += bytes([8]) + struct.pack("<I", 1)
+        body += bytes([0])
+        frame = wire.MAGIC + bytes([wire.VERSION]) + body
+        with pytest.raises(wire.WireDecodeError):
+            wire.decode(frame)
+
+    def test_ndarray_raises_encode_error(self):
+        with pytest.raises(wire.WireEncodeError):
+            wire.encode({"m": np.arange(3)})
+
+    def test_unencodable_request_fails_future_not_channel(self):
+        from ray_tpu.core.rpc import RpcServer, connect
+
+        srv = RpcServer(("127.0.0.1", 0), lambda ch: (lambda m, p: "ok"))
+        try:
+            ch = connect(srv.address, name="cli")
+
+            class Unregistered:
+                pass
+
+            with pytest.raises(wire.WireEncodeError):
+                ch.call("m", Unregistered(), timeout=10)
+            # the channel survived and still serves
+            assert ch.call("ping", 1, timeout=10) == "ok"
+            ch.close()
+        finally:
+            srv.close()
