@@ -180,6 +180,47 @@ def _cmd_job(args) -> int:
         ch.close()
 
 
+def _cmd_serve(args) -> int:
+    """serve deploy/status/shutdown as a remote driver against a running
+    head (client.py). A head is required: an in-process cluster would die
+    with the CLI, taking the deployments with it."""
+    if not args.address:
+        print("serve commands need --address HOST:PORT of a running head\n"
+              "(an in-process cluster would vanish when this CLI exits; "
+              "for local experiments use serve.run/serve.deploy_config "
+              "from a driver script)", file=sys.stderr)
+        return 2
+    from .client import connect_client
+
+    if args.authkey:
+        os.environ["RTPU_AUTHKEY"] = args.authkey
+    connect_client(args.address)
+    from ray_tpu import serve
+
+    if args.what == "deploy":
+        if not args.config:
+            print("serve deploy needs a config file", file=sys.stderr)
+            return 2
+        out = serve.deploy_config(args.config)
+        for n in out["deployments"]:
+            print(f"deployed {n}")
+        if out["http"]:
+            print(f"http ingress on {out['http'][0]}:{out['http'][1]}")
+        return 0
+    try:
+        if args.what == "status":
+            for name, st in serve.status().items():
+                print(f"{name:30s} {st['status']:10s} "
+                      f"replicas={st.get('replicas')}")
+            return 0
+        serve.shutdown()
+        print("serve shut down")
+        return 0
+    except Exception:
+        print("no serve instance running on this cluster", file=sys.stderr)
+        return 1
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ray_tpu", description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
@@ -235,6 +276,17 @@ def main(argv=None) -> int:
     jb.add_argument("--address", required=True)
     jb.add_argument("--authkey", default="")
     jb.set_defaults(fn=_cmd_job)
+
+    sv = sub.add_parser(
+        "serve", help="deploy/status/shutdown serve applications "
+                      "(ref: `serve deploy` + serve/schema.py config)")
+    sv.add_argument("what", choices=["deploy", "status", "shutdown"])
+    sv.add_argument("config", nargs="?", default="",
+                    help="YAML/JSON application config (deploy)")
+    sv.add_argument("--address", default="",
+                    help="head HOST:PORT (default: in-process cluster)")
+    sv.add_argument("--authkey", default="")
+    sv.set_defaults(fn=_cmd_serve)
 
     args = p.parse_args(argv)
     return args.fn(args)

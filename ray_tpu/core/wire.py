@@ -247,7 +247,16 @@ def _decode_value(r: _Reader, depth: int = 0) -> Any:
 def encode(obj: Any) -> bytes:
     buf = bytearray(MAGIC)
     buf.append(VERSION)
-    _encode_value(buf, obj)
+    try:
+        _encode_value(buf, obj)
+    except WireEncodeError:
+        raise
+    except Exception as e:
+        # UnicodeEncodeError (surrogate strings), RecursionError (deep
+        # payloads), etc. must surface as WireEncodeError: rpc.py's write
+        # loop drops the frame for that type but tears the channel down
+        # for anything else
+        raise WireEncodeError(f"unencodable payload: {e!r}") from e
     return bytes(buf)
 
 

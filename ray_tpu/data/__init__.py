@@ -8,11 +8,13 @@ from .block import Block
 from .context import DataContext
 from .dataset import (ActorPoolStrategy, Dataset, GroupedDataset,
                       from_arrow, from_blocks, from_items, from_numpy, range, read_csv,
-                      read_json, read_numpy, read_parquet)
+                      read_images, read_json, read_numpy,
+                      read_parquet, read_tfrecords)
 from .iterator import DataShard
 
 __all__ = [
     "ActorPoolStrategy", "Block", "DataContext", "DataShard", "Dataset",
     "GroupedDataset", "from_arrow", "from_blocks", "from_items", "from_numpy", "range",
-    "read_csv", "read_json", "read_numpy", "read_parquet",
+    "read_csv", "read_images", "read_json", "read_numpy",
+    "read_parquet", "read_tfrecords",
 ]

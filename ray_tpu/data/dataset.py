@@ -454,3 +454,41 @@ def read_numpy(paths: Union[str, List[str]], **kw) -> Dataset:
         return {"data": np.load(path)}
 
     return _make_dataset(_file_read_fns(paths, reader, (".npy",)), "read_numpy")
+
+
+def read_images(paths: Union[str, List[str]], *,
+                size: Optional[tuple] = None,
+                mode: str = "RGB", include_paths: bool = False,
+                **kw) -> Dataset:
+    """Image files -> blocks with an 'image' column ([H,W,C] uint8 per
+    row; uniform sizes stack into one [N,H,W,C] array). PIL decodes
+    (ref: python/ray/data/read_api.py read_images /
+    _internal/datasource/image_datasource.py)."""
+    def reader(path: str) -> Block:
+        from PIL import Image
+
+        img = Image.open(path).convert(mode)
+        if size is not None:
+            img = img.resize((size[1], size[0]))  # PIL takes (W, H)
+        arr = np.asarray(img, np.uint8)
+        block: Block = {"image": arr[None]}
+        if include_paths:
+            block["path"] = np.asarray([path], object)
+        return block
+
+    return _make_dataset(
+        _file_read_fns(paths, reader,
+                       (".png", ".jpg", ".jpeg", ".bmp", ".gif")),
+        "read_images")
+
+
+def read_tfrecords(paths: Union[str, List[str]], **kw) -> Dataset:
+    """TFRecord files of tf.train.Example -> columnar blocks. No
+    TensorFlow needed: framing + the Example protobuf subset are decoded
+    natively with CRC verification (data/tfrecords.py; ref:
+    python/ray/data/read_api.py read_tfrecords)."""
+    from .tfrecords import tfrecords_to_block
+
+    return _make_dataset(
+        _file_read_fns(paths, tfrecords_to_block, (".tfrecord", ".tfrecords")),
+        "read_tfrecords")

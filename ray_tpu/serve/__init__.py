@@ -142,14 +142,67 @@ def delete(name: str) -> None:
     ray_tpu.get(controller.delete.remote(name), timeout=60)
 
 
-def start_http_proxy(host: str = "127.0.0.1", port: int = 0) -> tuple:
-    """Start the HTTP ingress actor; returns (host, port)."""
-    from .http_proxy import HTTPProxy
+def start_http_proxy(host: str = "127.0.0.1", port: int = 0,
+                     asyncio_server: bool = True) -> tuple:
+    """Start the HTTP ingress actor; returns (host, port). The default is
+    the asyncio proxy (http_asyncio.py — the reference's uvicorn/ASGI
+    analog); asyncio_server=False keeps the stdlib thread-per-request
+    fallback."""
+    if asyncio_server:
+        from .http_asyncio import AsyncHTTPProxy as ProxyCls
+    else:
+        from .http_proxy import HTTPProxy as ProxyCls
 
-    cls = ray_tpu.remote(HTTPProxy)
+    cls = ray_tpu.remote(ProxyCls)
     proxy = cls.options(name="SERVE_PROXY", lifetime="detached",
                         get_if_exists=True).remote(host, port)
     return tuple(ray_tpu.get(proxy.address.remote(), timeout=30))
+
+
+def deploy_config(path: str) -> list:
+    """`serve deploy <config>`: declarative YAML/JSON application config
+    (ref: python/ray/serve/schema.py ServeDeploySchema + `serve deploy`).
+
+    Schema:
+        http: {host: ..., port: ...}            # optional ingress
+        applications:
+          - name: my_app                        # optional
+            import_path: pkg.module:app         # Application or builder
+            args: {...}                         # builder kwargs
+            num_replicas: 2                     # per-deployment override
+
+    Returns {"deployments": [names], "http": (host, port) | None}."""
+    import importlib
+
+    import yaml
+
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    apps = cfg.get("applications") or []
+    if not apps:
+        raise ValueError(f"{path}: no applications in config")
+    deployed = []
+    for app_cfg in apps:
+        import_path = app_cfg["import_path"]
+        mod_name, _, attr = import_path.partition(":")
+        if not attr:
+            raise ValueError(
+                f"import_path must be 'module:attr', got {import_path!r}")
+        target = getattr(importlib.import_module(mod_name), attr)
+        if callable(target) and not isinstance(target, Application):
+            target = target(**(app_cfg.get("args") or {}))
+        if not isinstance(target, Application):
+            raise TypeError(f"{import_path} is not a serve Application")
+        if app_cfg.get("num_replicas"):
+            target.deployment.config.num_replicas = int(
+                app_cfg["num_replicas"])
+        deployed.append(run(target))
+    http = cfg.get("http")
+    addr = None
+    if http is not None:
+        addr = start_http_proxy(http.get("host", "127.0.0.1"),
+                                int(http.get("port", 8000)))
+    return {"deployments": [d._name for d in deployed], "http": addr}
 
 
 def shutdown() -> None:

@@ -1,0 +1,266 @@
+"""Asyncio HTTP ingress — the production proxy.
+
+Equivalent of the reference's uvicorn/ASGI HTTPProxyActor (ref:
+python/ray/serve/_private/http_proxy.py:873). No ASGI framework ships in
+this image, so this is a native asyncio HTTP/1.1 server: one event loop
+owns all connections (keep-alive, pipel­ined clients, slow readers cost a
+task each, not a thread each), and deployment calls run on a bounded
+thread pool so a slow replica can never stall the accept/IO path. The
+stdlib-http.server proxy (http_proxy.py) remains as the zero-dependency
+fallback; serve.start_http_proxy picks this one by default.
+
+Routes (same surface as http_proxy.py):
+    POST /<deployment>            body = JSON  -> result as JSON
+    GET  /<deployment>?q=...      query dict -> result as JSON
+    ...?stream=1                  chunked NDJSON streaming response
+    ...?model_id=<id>             multiplexed model routing
+    GET  /-/routes                deployment listing
+    GET  /-/healthz               proxy liveness
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+import ray_tpu
+
+_MAX_BODY = 64 << 20  # 64 MiB request cap
+_MAX_HEADER = 64 << 10
+
+
+class AsyncHTTPProxy:
+    """Actor hosting the asyncio server; the loop runs on its own thread
+    (actor method calls return immediately)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000,
+                 num_handler_threads: int = 64):
+        self._host = host
+        self._handles: Dict[str, object] = {}
+        self._pool = ThreadPoolExecutor(num_handler_threads,
+                                        thread_name_prefix="serve-call")
+        self._loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._port = 0
+        self._requests = 0
+        self._errors = 0
+
+        def runner():
+            asyncio.set_event_loop(self._loop)
+            self._loop.run_until_complete(self._start(host, port))
+            self._started.set()
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=runner, daemon=True,
+                                        name="serve-asyncio")
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("asyncio proxy failed to start")
+
+    async def _start(self, host: str, port: int) -> None:
+        self._server = await asyncio.start_server(self._serve_conn, host,
+                                                  port)
+        self._port = self._server.sockets[0].getsockname()[1]
+
+    # -- connection handling -----------------------------------------------
+
+    async def _serve_conn(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                try:
+                    req = await self._read_request(reader)
+                except (ValueError, UnicodeDecodeError,
+                        asyncio.LimitOverrunError):
+                    # malformed request (bad Content-Length, non-UTF8
+                    # headers, oversized request line): answer 400, don't
+                    # leak an unhandled-task exception per port-scan probe
+                    self._write_json(writer, 400,
+                                     {"error": "malformed request"}, False)
+                    await writer.drain()
+                    break
+                if req is None:
+                    break
+                keep = await self._handle_request(writer, *req)
+                await writer.drain()
+                if not keep:
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _read_request(self, reader):
+        line = await reader.readline()
+        if not line or line in (b"\r\n", b"\n"):
+            return None
+        try:
+            method, target, _version = line.decode().split(None, 2)
+        except ValueError:
+            return None
+        headers: Dict[str, str] = {}
+        total = 0
+        while True:
+            h = await reader.readline()
+            total += len(h)
+            if total > _MAX_HEADER:
+                return None
+            if h in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = h.decode().partition(":")
+            headers[k.strip().lower()] = v.strip()
+        n = int(headers.get("content-length") or 0)
+        if n < 0 or n > _MAX_BODY:
+            raise ValueError(f"bad content-length {n}")
+        body = await reader.readexactly(n) if n else b""
+        return method, target, headers, body
+
+    async def _handle_request(self, writer, method, target, headers,
+                              body) -> bool:
+        self._requests += 1
+        keep = headers.get("connection", "keep-alive").lower() != "close"
+        url = urlparse(target)
+        name = url.path.strip("/")
+        q = parse_qs(url.query)
+        if name == "-/healthz":
+            self._write_json(writer, 200, {"status": "ok"}, keep)
+            return keep
+        if name == "-/routes":
+            try:
+                routes = await self._in_pool(self._routes)
+                self._write_json(writer, 200, routes, keep)
+            except Exception as e:  # noqa: BLE001
+                self._write_json(writer, 500, {"error": str(e)}, keep)
+            return keep
+        if not name:
+            self._write_json(writer, 404, {"error": "no deployment in path"},
+                             keep)
+            return keep
+        if method == "POST":
+            try:
+                data = json.loads(body) if body else None
+            except json.JSONDecodeError:
+                self._write_json(writer, 400, {"error": "body must be JSON"},
+                                 keep)
+                return keep
+        else:
+            data = {k: v[0] if len(v) == 1 else v for k, v in q.items()
+                    if k not in ("stream", "model_id")} or None
+        mux = (q.get("model_id") or [""])[0]
+        stream = (q.get("stream") or ["0"])[0] in ("1", "true")
+        try:
+            if stream:
+                await self._stream_response(writer, name, data, mux)
+                return keep
+            result = await self._in_pool(self._call_blocking, name, data,
+                                         mux)
+            self._write_json(writer, 200, _jsonable(result), keep)
+        except Exception as e:  # noqa: BLE001
+            self._errors += 1
+            self._write_json(writer, 500,
+                             {"error": f"{type(e).__name__}: {e}"}, keep)
+        return keep
+
+    async def _stream_response(self, writer, name, data, mux) -> None:
+        """Chunked NDJSON: generator items are pulled on the pool (each
+        next() blocks on the replica) and written as they arrive."""
+        gen = self._get_handle(name).options(
+            stream=True, multiplexed_model_id=mux).remote(data)
+        it = iter(gen)
+        writer.write(b"HTTP/1.1 200 OK\r\n"
+                     b"Content-Type: application/x-ndjson\r\n"
+                     b"Transfer-Encoding: chunked\r\n\r\n")
+        _SENTINEL = object()
+
+        def pull():
+            try:
+                return next(it)
+            except StopIteration:
+                return _SENTINEL
+        try:
+            while True:
+                item = await self._in_pool(pull)
+                if item is _SENTINEL:
+                    break
+                payload = json.dumps(_jsonable(item)).encode() + b"\n"
+                writer.write(f"{len(payload):X}\r\n".encode())
+                writer.write(payload + b"\r\n")
+                await writer.drain()
+        except Exception:  # noqa: BLE001
+            # headers are on the wire: drop the connection so the client
+            # sees a framing error, not a truncated-but-"complete" stream
+            writer.close()
+            raise
+        writer.write(b"0\r\n\r\n")
+
+    # -- helpers ------------------------------------------------------------
+
+    def _in_pool(self, fn, *args):
+        return self._loop.run_in_executor(self._pool, fn, *args)
+
+    def _call_blocking(self, name: str, data, mux: str):
+        h = self._get_handle(name)
+        if mux:
+            h = h.options(multiplexed_model_id=mux)
+        return ray_tpu.get(h.remote(data), timeout=60)
+
+    def _get_handle(self, name: str):
+        from .handle import DeploymentHandle
+
+        h = self._handles.get(name)
+        if h is None:
+            h = self._handles[name] = DeploymentHandle(name)
+        return h
+
+    def _routes(self) -> dict:
+        from .controller import CONTROLLER_NAME
+
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        return {"deployments":
+                ray_tpu.get(controller.list_deployments.remote(),
+                            timeout=10)}
+
+    @staticmethod
+    def _write_json(writer, code: int, payload, keep: bool) -> None:
+        body = json.dumps(payload).encode()
+        reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  500: "Internal Server Error"}.get(code, "")
+        conn = "keep-alive" if keep else "close"
+        writer.write((f"HTTP/1.1 {code} {reason}\r\n"
+                      f"Content-Type: application/json\r\n"
+                      f"Content-Length: {len(body)}\r\n"
+                      f"Connection: {conn}\r\n\r\n").encode())
+        writer.write(body)
+
+    # -- actor surface -------------------------------------------------------
+
+    def address(self) -> tuple:
+        return (self._host, self._port)
+
+    def stats(self) -> dict:
+        return {"requests": self._requests, "errors": self._errors}
+
+    def ping(self) -> str:
+        return "ok"
+
+    def shutdown(self) -> bool:
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._pool.shutdown(wait=False)
+        return True
+
+
+def _jsonable(value):
+    import numpy as np
+
+    if isinstance(value, np.ndarray):
+        return value.tolist()
+    if isinstance(value, (np.integer, np.floating)):
+        return value.item()
+    return value

@@ -294,3 +294,100 @@ def test_push_based_shuffle_large_parallelism(cluster):
     np.testing.assert_array_equal(np.sort(out), vals)  # nothing lost/duped
     assert not np.array_equal(out, vals)  # actually shuffled
     assert ds.num_blocks() == 20
+
+
+def test_read_images(tmp_path, cluster):
+    from PIL import Image
+
+    import ray_tpu.data as rd
+
+    for i in range(6):
+        arr = np.full((8, 10, 3), i * 30, np.uint8)
+        Image.fromarray(arr).save(tmp_path / f"img_{i}.png")
+    ds = rd.read_images(str(tmp_path), size=(16, 12), include_paths=True)
+    batches = list(ds.iter_batches(batch_size=None))
+    block = {k: np.concatenate([b[k] for b in batches])
+             for k in batches[0]}
+    assert block["image"].shape == (6, 16, 12, 3)
+    assert block["image"].dtype == np.uint8
+    assert len(block["path"]) == 6
+    # pixel values survive decode+resize (constant images stay constant)
+    means = sorted(block["image"].reshape(6, -1).mean(axis=1).tolist())
+    assert abs(means[0] - 0) < 1 and abs(means[-1] - 150) < 1
+
+
+def test_read_tfrecords_roundtrip(tmp_path, cluster):
+    import ray_tpu.data as rd
+    from ray_tpu.data.tfrecords import (decode_example, encode_example,
+                                        write_tfrecord_file)
+
+    # build two files of Examples with all three feature kinds
+    for fi in range(2):
+        recs = []
+        for i in range(5):
+            recs.append(encode_example({
+                "idx": fi * 5 + i,
+                "score": float(i) * 0.5,
+                "name": f"row{fi}_{i}".encode(),
+                "vec": [1.0, 2.0, float(i)],
+            }))
+        write_tfrecord_file(str(tmp_path / f"part{fi}.tfrecord"), recs)
+
+    # low-level codec roundtrip
+    ex = decode_example(encode_example({"a": 7, "b": 1.5, "c": b"xyz"}))
+    assert ex["a"] == [7] and abs(ex["b"][0] - 1.5) < 1e-6
+    assert ex["c"] == [b"xyz"]
+
+    ds = rd.read_tfrecords(str(tmp_path))
+    batches = list(ds.iter_batches(batch_size=None))
+    block = {k: np.concatenate([b[k] for b in batches])
+             for k in batches[0]}
+    assert sorted(block["idx"].tolist()) == list(range(10))
+    assert abs(float(block["score"].max()) - 2.0) < 1e-6
+    assert set(len(v) for v in block["vec"]) == {3}
+
+
+def test_read_tfrecords_detects_corruption(tmp_path, cluster):
+    import pytest as _pytest
+
+    from ray_tpu.data.tfrecords import (encode_example, read_tfrecord_file,
+                                        write_tfrecord_file)
+
+    p = str(tmp_path / "c.tfrecord")
+    write_tfrecord_file(p, [encode_example({"x": 1})])
+    raw = bytearray(open(p, "rb").read())
+    raw[14] ^= 0xFF  # flip a data byte
+    open(p, "wb").write(bytes(raw))
+    with _pytest.raises(ValueError):
+        list(read_tfrecord_file(p))
+
+
+def test_tfrecords_into_train_ingest(tmp_path, cluster):
+    """TFRecords -> Dataset -> 2-worker gang via DataConfig-style
+    datasets= (the ingest path the BASELINE bulk-ingest test models)."""
+    import ray_tpu.data as rd
+    from ray_tpu import train
+    from ray_tpu.data.tfrecords import encode_example, write_tfrecord_file
+    from ray_tpu.train import DataParallelTrainer, ScalingConfig
+
+    for fi in range(4):
+        recs = [encode_example({"v": fi * 10 + i}) for i in range(10)]
+        write_tfrecord_file(str(tmp_path / f"p{fi}.tfrecord"), recs)
+    ds = rd.read_tfrecords(str(tmp_path))
+
+    def loop(config):
+        ctx = train.get_context()
+        shard = train.get_dataset_shard("train")
+        seen = []
+        for batch in shard.iter_batches(batch_size=8):
+            seen.extend(int(v) for v in batch["v"])
+        train.report({"n": len(seen),
+                      "sum": int(sum(seen)) if seen else 0})
+
+    res = DataParallelTrainer(
+        loop, datasets={"train": ds},
+        scaling_config=ScalingConfig(num_workers=2)).fit()
+    assert res.error is None
+    # rank 0's shard is exactly half the 40 rows; an equal split with
+    # no duplication is the sharding contract under test
+    assert res.metrics_history[-1]["n"] == 20

@@ -343,3 +343,121 @@ def test_multiplexed_routing_prefers_resident_replica(cluster):
         handle.options(multiplexed_model_id="m1").remote({}), timeout=30)
         for _ in range(8)}
     assert len(sticky) == 1
+
+
+class TestAsyncioProxy:
+    def test_asyncio_proxy_basic_and_keepalive(self, cluster):
+        import http.client
+
+        @serve.deployment(num_replicas=2)
+        class Echo:
+            def __call__(self, body):
+                return {"got": body}
+
+        serve.run(Echo.bind())
+        host, port = serve.start_http_proxy(port=0)
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        # two requests on ONE connection (keep-alive)
+        for i in range(2):
+            conn.request("POST", "/Echo", body=json.dumps({"i": i}),
+                         headers={"Content-Type": "application/json"})
+            r = conn.getresponse()
+            assert r.status == 200
+            assert json.loads(r.read())["got"] == {"i": i}
+        conn.request("GET", "/-/healthz")
+        assert json.loads(conn.getresponse().read())["status"] == "ok"
+        conn.request("GET", "/-/routes")
+        assert "Echo" in str(json.loads(conn.getresponse().read()))
+        conn.close()
+
+    def test_asyncio_proxy_streaming(self, cluster):
+        import http.client
+
+        @serve.deployment
+        class Gen:
+            def __call__(self, body):
+                for i in range(4):
+                    yield {"i": i}
+
+        serve.run(Gen.bind())
+        host, port = serve.start_http_proxy(port=0)
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        conn.request("POST", "/Gen?stream=1", body="null")
+        r = conn.getresponse()
+        assert r.status == 200
+        lines = [json.loads(l) for l in r.read().decode().strip().split("\n")]
+        assert lines == [{"i": i} for i in range(4)]
+        conn.close()
+
+    def test_load_100_in_flight_4_replicas(self, cluster):
+        """100 concurrent requests through the asyncio proxy against 4
+        replicas: all succeed, the load spreads across replicas
+        (power-of-two-choices routing), p2c stats exposed."""
+        import http.client
+        from concurrent.futures import ThreadPoolExecutor
+
+        @serve.deployment(num_replicas=4, max_concurrent_queries=8)
+        class Slow:
+            def __init__(self):
+                import os as _os
+                self.pid = _os.getpid()
+
+            def __call__(self, body):
+                time.sleep(0.05)
+                return {"pid": self.pid}
+
+        serve.run(Slow.bind())
+        host, port = serve.start_http_proxy(port=0)
+
+        def one(i):
+            conn = http.client.HTTPConnection(host, port, timeout=120)
+            try:
+                conn.request("POST", "/Slow", body=json.dumps({"i": i}))
+                r = conn.getresponse()
+                return r.status, json.loads(r.read())
+            finally:
+                conn.close()
+
+        with ThreadPoolExecutor(100) as pool:
+            results = list(pool.map(one, range(100)))
+        assert all(code == 200 for code, _ in results)
+        pids = {body["pid"] for _, body in results}
+        assert len(pids) >= 3, f"load not spread: {pids}"
+        proxy = ray_tpu.get_actor("SERVE_PROXY")
+        stats = ray_tpu.get(proxy.stats.remote(), timeout=30)
+        assert stats["requests"] >= 100
+        assert stats["errors"] == 0
+
+
+class TestServeDeployConfig:
+    def test_deploy_from_yaml(self, cluster, tmp_path):
+        import http.client
+
+        mod = tmp_path / "my_serve_app.py"
+        mod.write_text(
+            "from ray_tpu import serve\n"
+            "@serve.deployment\n"
+            "class Hello:\n"
+            "    def __init__(self, greeting='hi'):\n"
+            "        self.g = greeting\n"
+            "    def __call__(self, body):\n"
+            "        return {'msg': self.g}\n"
+            "def app(greeting='hello'):\n"
+            "    return Hello.bind(greeting)\n")
+        cfg = tmp_path / "serve.yaml"
+        cfg.write_text(
+            "http:\n  host: 127.0.0.1\n  port: 0\n"
+            "applications:\n"
+            "  - import_path: my_serve_app:app\n"
+            "    args: {greeting: bonjour}\n"
+            "    num_replicas: 2\n")
+        import sys as _sys
+        _sys.path.insert(0, str(tmp_path))
+        try:
+            out = serve.deploy_config(str(cfg))
+            assert out["deployments"] == ["Hello"]
+            h = serve.get_deployment_handle("Hello")
+            out = ray_tpu.get(h.remote({}), timeout=30)
+            assert out == {"msg": "bonjour"}
+        finally:
+            _sys.path.remove(str(tmp_path))

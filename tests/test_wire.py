@@ -162,3 +162,10 @@ class TestMaliciousFrameOverRpc:
             ch.close()
         finally:
             srv.close()
+
+    def test_surrogate_string_raises_wire_encode_error(self):
+        # os.fsdecode of non-UTF8 paths yields surrogates; the encode
+        # failure must be WireEncodeError (frame dropped) not
+        # UnicodeEncodeError (channel torn down)
+        with pytest.raises(wire.WireEncodeError):
+            wire.encode("bad\udce9name")
