@@ -216,7 +216,9 @@ def _cmd_serve(args) -> int:
         serve.shutdown()
         print("serve shut down")
         return 0
-    except Exception:
+    except ValueError:
+        # get_actor raises ValueError when the controller doesn't exist;
+        # anything else (auth, network) should surface as a traceback
         print("no serve instance running on this cluster", file=sys.stderr)
         return 1
 
@@ -284,7 +286,7 @@ def main(argv=None) -> int:
     sv.add_argument("config", nargs="?", default="",
                     help="YAML/JSON application config (deploy)")
     sv.add_argument("--address", default="",
-                    help="head HOST:PORT (default: in-process cluster)")
+                    help="head HOST:PORT of a running cluster (required)")
     sv.add_argument("--authkey", default="")
     sv.set_defaults(fn=_cmd_serve)
 

@@ -152,6 +152,12 @@ class NodeAgent:
         if method == "read_chunk":
             return self._read_chunk(payload["object_id"], payload["offset"],
                                     payload["length"])
+        if method == "store_put_chunk":
+            # head -> agent object push (the inverse of read_chunk; lets
+            # the head place a driver put on this node's store)
+            return self.store.put_chunk(
+                payload["object_id"], payload["offset"], payload["total"],
+                payload["data"])
         if method == "shutdown":
             threading.Thread(target=self.shutdown,
                              kwargs={"kill": payload.get("kill", False)},

@@ -50,6 +50,30 @@ class _Entry:
     created_at: float = field(default_factory=time.monotonic)
 
 
+
+def _assemble_chunk(partial, object_id, offset, total, data,
+                    create, write, finish) -> bool:
+    """Shared chunked-push state machine for both store classes. Chunks
+    must arrive in order; offset 0 RESTARTS the object (a caller retrying
+    a failed push from scratch must not inherit a stale byte counter and
+    seal with an unwritten tail). Returns True when the object seals."""
+    if offset == 0:
+        create()
+        partial[object_id] = 0
+    expect = partial.get(object_id)
+    if expect is None or offset != expect:
+        raise ValueError(
+            f"out-of-order chunk for {object_id.hex()[:12]}: "
+            f"offset {offset}, expected {expect}")
+    write(offset, data)
+    partial[object_id] = offset + len(data)
+    if partial[object_id] >= total:
+        del partial[object_id]
+        finish()
+        return True
+    return False
+
+
 class PlasmaStore:
     """Host shared-memory store for one (possibly simulated) node."""
 
@@ -61,6 +85,7 @@ class PlasmaStore:
         self._min_spilling_size = min_spilling_size
         self._used = 0
         self._lock = threading.RLock()
+        self._partial: Dict[ObjectId, int] = {}  # chunked-push progress
         self._entries: "OrderedDict[ObjectId, _Entry]" = OrderedDict()
         self._spill_dir = spill_dir
         self._destroyed = False
@@ -118,6 +143,24 @@ class PlasmaStore:
         e.shm.buf[: len(data)] = data
         e.pinned = pin
         self.seal(object_id)
+
+    def put_chunk(self, object_id: ObjectId, offset: int, total: int,
+                  data: bytes, pin: bool = True) -> bool:
+        """Incremental create->write->seal for chunked pushes (the head's
+        remote-put path; mirror of read_store_chunk on the pull side).
+        Returns True when the final chunk seals the object."""
+        with self._lock:
+            def finish():
+                e = self._entries[object_id]
+                e.pinned = pin
+                self.seal(object_id)
+
+            return _assemble_chunk(
+                self._partial, object_id, offset, total, data,
+                create=lambda: self.create(object_id, total),
+                write=lambda off, d: self._entries[object_id].shm.buf
+                .__setitem__(slice(off, off + len(d)), d),
+                finish=finish)
 
     # -- reads -----------------------------------------------------------------
 
@@ -282,6 +325,7 @@ class NativePlasmaStore:
                                       min_spilling_size)
         self._destroyed = False
         self._lock = threading.RLock()
+        self._partial: Dict[ObjectId, int] = {}  # chunked-push progress
 
     def segment_name(self, object_id: ObjectId) -> str:
         return f"{self._prefix}_{object_id.hex()}"
@@ -343,6 +387,26 @@ class NativePlasmaStore:
             if pin:
                 self.pin(object_id)
             self.seal(object_id)
+
+    def put_chunk(self, object_id: ObjectId, offset: int, total: int,
+                  data: bytes, pin: bool = True) -> bool:
+        """Chunked create->write->seal (native-store mirror of the Python
+        store's put_chunk; the RLock makes nested create/pin/seal safe)."""
+        with self._lock:
+            def write(off, d):
+                mv, _n, _sealed = self._view(object_id)
+                mv[off:off + len(d)] = d
+                del mv
+
+            def finish():
+                if pin:
+                    self.pin(object_id)
+                self.seal(object_id)
+
+            return _assemble_chunk(
+                self._partial, object_id, offset, total, data,
+                create=lambda: self.create(object_id, total),
+                write=write, finish=finish)
 
     def put_bytes(self, object_id: ObjectId, data: bytes,
                   pin: bool = True) -> None:

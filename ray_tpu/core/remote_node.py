@@ -41,9 +41,32 @@ class RemoteStoreProxy:
         return None
 
     def put_serialized(self, object_id, sobj, pin=True):
-        raise NotImplementedError(
-            "driver puts are stored on the head node; remote placement "
-            "happens by task execution locality")
+        """Push a serialized object into the remote agent's store in
+        chunks (the inverse of the chunked pull path; ref:
+        object_manager.h:117 Push). Unused by the default placement
+        policy (driver puts land on the head; remote copies appear via
+        execution locality) but fully functional for explicit remote
+        placement."""
+        data = sobj.to_bytes()
+        total = len(data)
+        chunk = 5 << 20  # mirror the pull path's 5 MiB chunks
+        ch = self._node.channel
+        if ch is None or ch.closed:
+            raise ConnectionError(
+                f"node {self._node.node_id.hex()[:8]} channel closed")
+        off = 0
+        while True:
+            end = min(off + chunk, total)
+            sealed = ch.call("store_put_chunk",
+                             {"object_id": object_id, "offset": off,
+                              "total": total, "data": data[off:end]},
+                             timeout=60)
+            off = end
+            if off >= total:
+                break
+        if not sealed:
+            raise RuntimeError(
+                f"remote put of {object_id.hex()[:12]} did not seal")
 
     def stats(self) -> dict:
         try:

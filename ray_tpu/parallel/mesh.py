@@ -34,33 +34,49 @@ MESH_AXES = ("pp", "dp", "fsdp", "ep", "sp", "tp")
 @dataclass(frozen=True)
 class MeshSpec:
     """Parallelism degrees. -1 on exactly one axis means "fill with all
-    remaining devices" (like torch DeviceMesh / t5x partitioning)."""
+    remaining devices" (like torch DeviceMesh / t5x partitioning).
+
+    `slices` > 1 declares a MULTI-SLICE job: devices span that many TPU
+    slices joined by DCN (no ICI between slices). The mesh gains an
+    outermost "slice" axis; per-slice ICI meshes compose under it, so
+    collectives over "slice" ride DCN and everything else stays on ICI —
+    the megascale recipe (dp over DCN, model axes within a slice)."""
     dp: int = -1      # pure data parallel (replicated params)
     fsdp: int = 1     # data parallel with sharded params (zero-3 style)
     tp: int = 1       # tensor (megatron) parallel
     sp: int = 1       # sequence/context parallel (ring attention axis)
     ep: int = 1       # expert parallel (MoE)
     pp: int = 1       # pipeline parallel
+    slices: int = 1   # DCN-connected slices (outermost axis when > 1)
 
     def degrees(self) -> Dict[str, int]:
         return {"pp": self.pp, "dp": self.dp, "fsdp": self.fsdp,
                 "ep": self.ep, "sp": self.sp, "tp": self.tp}
 
     def resolve(self, n_devices: int) -> Dict[str, int]:
-        """Fill the single -1 axis so the product equals n_devices."""
+        """Fill the single -1 axis so the per-slice product equals
+        n_devices / slices."""
+        if self.slices < 1:
+            raise ValueError(f"slices must be >= 1, got {self.slices}")
+        if n_devices % self.slices:
+            raise ValueError(
+                f"{n_devices} devices not divisible into {self.slices} slices")
+        per_slice = n_devices // self.slices
         d = self.degrees()
         wild = [k for k, v in d.items() if v == -1]
         if len(wild) > 1:
             raise ValueError(f"At most one mesh axis may be -1, got {wild}")
         fixed = math.prod(v for v in d.values() if v != -1)
         if wild:
-            if n_devices % fixed:
+            if per_slice % fixed:
                 raise ValueError(
-                    f"{n_devices} devices not divisible by fixed axes product {fixed}")
-            d[wild[0]] = n_devices // fixed
-        elif fixed != n_devices:
+                    f"{per_slice} per-slice devices not divisible by fixed "
+                    f"axes product {fixed}")
+            d[wild[0]] = per_slice // fixed
+        elif fixed != per_slice:
             raise ValueError(
-                f"Mesh {d} wants {fixed} devices but {n_devices} are available")
+                f"Mesh {d} wants {fixed} devices/slice but {per_slice} "
+                f"are available")
         return d
 
 
@@ -71,11 +87,14 @@ def build_mesh(spec: Union[MeshSpec, Dict[str, int], None] = None,
 
     Uses `mesh_utils.create_device_mesh` when possible so the physical ICI
     topology lines up with the logical axes; falls back to a plain reshape
-    on virtual/CPU devices.
+    on virtual/CPU devices. A MeshSpec with slices > 1 produces a
+    DCN-aware mesh: outermost "slice" axis over per-slice ICI meshes.
     """
     devices = list(devices if devices is not None else jax.devices())
     if spec is None:
         spec = MeshSpec()
+    if isinstance(spec, MeshSpec) and spec.slices > 1:
+        return build_multislice_mesh(spec, devices, axis_names)
     degrees = spec.resolve(len(devices)) if isinstance(spec, MeshSpec) else dict(spec)
     shape = tuple(degrees[a] for a in axis_names)
     try:
@@ -88,6 +107,52 @@ def build_mesh(spec: Union[MeshSpec, Dict[str, int], None] = None,
     except Exception:
         dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, axis_names)
+
+
+def group_devices_by_slice(devices: Sequence[jax.Device],
+                           num_slices: int) -> List[List[jax.Device]]:
+    """Partition devices into their physical slices. Real multi-slice TPU
+    devices carry `slice_index`; virtual/CPU devices (tests) split into
+    contiguous equal groups."""
+    by_idx: Dict[int, List[jax.Device]] = {}
+    if all(getattr(d, "slice_index", None) is not None for d in devices):
+        for d in devices:
+            by_idx.setdefault(d.slice_index, []).append(d)
+        if len(by_idx) == num_slices:
+            return [by_idx[i] for i in sorted(by_idx)]
+        # topology disagrees with the spec: fall through to error
+        raise ValueError(
+            f"spec wants {num_slices} slices but devices report "
+            f"{len(by_idx)} distinct slice_index values")
+    per = len(devices) // num_slices
+    return [list(devices[i * per:(i + 1) * per]) for i in range(num_slices)]
+
+
+def build_multislice_mesh(spec: MeshSpec,
+                          devices: Optional[Sequence[jax.Device]] = None,
+                          axis_names: Sequence[str] = MESH_AXES) -> Mesh:
+    """Compose per-slice ICI meshes under an outermost "slice" DCN axis
+    (SURVEY §5 comm-backend: DCN-aware multi-slice meshes; the analog of
+    mesh_utils.create_hybrid_device_mesh). Collectives that name "slice"
+    lower to DCN transfers; all other axes stay within a slice's ICI."""
+    devices = list(devices if devices is not None else jax.devices())
+    degrees = spec.resolve(len(devices))
+    inner_shape = tuple(degrees[a] for a in axis_names)
+    groups = group_devices_by_slice(devices, spec.slices)
+    per_slice = []
+    for g in groups:
+        try:
+            from jax.experimental import mesh_utils
+            if g[0].platform == "tpu":
+                arr = mesh_utils.create_device_mesh(
+                    inner_shape, devices=g, allow_split_physical_axes=True)
+            else:
+                raise ValueError
+        except Exception:
+            arr = np.asarray(g).reshape(inner_shape)
+        per_slice.append(arr)
+    dev_array = np.stack(per_slice, axis=0)
+    return Mesh(dev_array, ("slice", *axis_names))
 
 
 def virtual_mesh(n_devices: int,
@@ -144,10 +209,18 @@ class AxisRules:
         return P(*out)
 
 
-def default_axis_rules(fsdp_enabled: bool = True) -> Rules:
+def default_axis_rules(fsdp_enabled: bool = True,
+                       multislice: bool = False) -> Rules:
     """The standard decoder-LM mapping (scaling-book style):
     batch -> dp(+fsdp), sequence -> sp, embed -> fsdp (param sharding),
-    heads/mlp -> tp, experts -> ep, pipeline stage handled outside."""
+    heads/mlp -> tp, experts -> ep, pipeline stage handled outside.
+    multislice=True prepends the DCN "slice" axis to the batch mapping —
+    data parallel across slices, model axes within a slice."""
+    if multislice:
+        batch_axes = (("slice", "dp", "fsdp") if fsdp_enabled
+                      else ("slice", "dp"))
+        return (("batch", batch_axes),) + tuple(
+            r for r in default_axis_rules(fsdp_enabled) if r[0] != "batch")
     return (
         ("batch", ("dp", "fsdp") if fsdp_enabled else "dp"),
         ("seq", "sp"),

@@ -159,7 +159,7 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 0,
     return tuple(ray_tpu.get(proxy.address.remote(), timeout=30))
 
 
-def deploy_config(path: str) -> list:
+def deploy_config(path: str) -> dict:
     """`serve deploy <config>`: declarative YAML/JSON application config
     (ref: python/ray/serve/schema.py ServeDeploySchema + `serve deploy`).
 

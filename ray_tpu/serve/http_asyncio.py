@@ -155,10 +155,17 @@ class AsyncHTTPProxy:
                     if k not in ("stream", "model_id")} or None
         mux = (q.get("model_id") or [""])[0]
         stream = (q.get("stream") or ["0"])[0] in ("1", "true")
-        try:
-            if stream:
+        if stream:
+            try:
                 await self._stream_response(writer, name, data, mux)
-                return keep
+            except Exception:  # noqa: BLE001
+                # mid-stream failure: headers are already on the wire and
+                # _stream_response closed the connection — writing a 500
+                # here would corrupt the chunk framing of a dead socket
+                self._errors += 1
+                return False
+            return keep
+        try:
             result = await self._in_pool(self._call_blocking, name, data,
                                          mux)
             self._write_json(writer, 200, _jsonable(result), keep)
@@ -251,7 +258,15 @@ class AsyncHTTPProxy:
         return "ok"
 
     def shutdown(self) -> bool:
-        self._loop.call_soon_threadsafe(self._loop.stop)
+        def _close():
+            # close the listening socket first: a stopped loop with an
+            # open server would keep accepting connections that nothing
+            # ever services (clients hang instead of connection-refused)
+            if self._server is not None:
+                self._server.close()
+            self._loop.stop()
+
+        self._loop.call_soon_threadsafe(_close)
         self._pool.shutdown(wait=False)
         return True
 

@@ -1,75 +1,88 @@
-"""ActorPool — fan work over a fixed set of actors.
+"""ActorPool — fan a stream of work over a fixed set of actors.
 
-Parity with the reference's `ray.util.ActorPool`
-(ref: python/ray/util/actor_pool.py — submit/get_next/get_next_unordered,
-map/map_unordered over idle actors, push/pop for membership)."""
+API parity with `ray.util.ActorPool` (ref: python/ray/util/actor_pool.py
+public surface: submit/get_next/get_next_unordered/map/map_unordered/
+has_next/has_free/push/pop_idle). The implementation is this repo's own:
+work is ticketed in submission order, a FIFO backlog feeds freed actors,
+and ordered retrieval walks the ticket sequence while unordered
+retrieval leans on `ray_tpu.wait`.
+"""
 from __future__ import annotations
 
-from typing import Any, Callable, Iterable, Iterator, List
+import collections
+import itertools
+from typing import Any, Callable, Iterable, Iterator, Optional
 
 import ray_tpu
 
 
 class ActorPool:
     def __init__(self, actors: Iterable[Any]):
-        self._idle: List[Any] = list(actors)
-        self._future_to_actor: dict = {}
-        self._pending_submits: List[tuple] = []
-        self._next_task_index = 0
-        self._index_to_future: dict = {}
-        self._next_return_index = 0
+        self._free = collections.deque(actors)
+        self._backlog: collections.deque = collections.deque()
+        self._inflight: dict = {}       # ref -> (ticket, actor)
+        self._by_ticket: dict = {}      # ticket -> ref
+        self._tickets = itertools.count()
+        self._head = 0                  # oldest ticket not yet returned
 
-    # -- submission --------------------------------------------------------
+    # -- submission ----------------------------------------------------------
 
     def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
-        """fn(actor, value) -> ObjectRef; queued if no actor is idle
-        (ref: actor_pool.py:81)."""
-        if self._idle:
-            actor = self._idle.pop()
-            ref = fn(actor, value)
-            self._future_to_actor[ref] = (self._next_task_index, actor)
-            self._index_to_future[self._next_task_index] = ref
-            self._next_task_index += 1
+        """fn(actor, value) -> ObjectRef; parks in the backlog when every
+        actor is busy."""
+        if self._free:
+            self._launch(fn, value)
         else:
-            self._pending_submits.append((fn, value))
+            self._backlog.append((fn, value))
 
-    def _return_actor(self, actor: Any) -> None:
-        self._idle.append(actor)
-        if self._pending_submits:
-            self.submit(*self._pending_submits.pop(0))
+    def _launch(self, fn: Callable, value: Any) -> None:
+        actor = self._free.popleft()
+        ref = fn(actor, value)
+        ticket = next(self._tickets)
+        self._inflight[ref] = (ticket, actor)
+        self._by_ticket[ticket] = ref
 
-    # -- retrieval ---------------------------------------------------------
+    def _recycle(self, actor: Any) -> None:
+        self._free.append(actor)
+        while self._backlog and self._free:
+            self._launch(*self._backlog.popleft())
+
+    # -- retrieval -----------------------------------------------------------
 
     def has_next(self) -> bool:
-        return bool(self._future_to_actor)
+        return bool(self._inflight)
 
-    def get_next(self, timeout: float = None) -> Any:
-        """Next result in SUBMISSION order (ref: actor_pool.py:150)."""
-        if not self.has_next():
+    def get_next(self, timeout: Optional[float] = None) -> Any:
+        """Next result in SUBMISSION order. A timeout leaves the pool
+        state untouched so the call can simply be retried."""
+        if not self._inflight:
             raise StopIteration("No more results to get")
-        ref = self._index_to_future[self._next_return_index]
-        result = ray_tpu.get(ref, timeout=timeout)
-        del self._index_to_future[self._next_return_index]
-        self._next_return_index += 1
-        _, actor = self._future_to_actor.pop(ref)
-        self._return_actor(actor)
-        return result
+        head = self._head
+        while head not in self._by_ticket:
+            head += 1  # that ticket was consumed unordered; skip
+        ref = self._by_ticket[head]
+        value = ray_tpu.get(ref, timeout=timeout)  # may raise: state intact
+        del self._by_ticket[head]
+        self._head = head + 1
+        _, actor = self._inflight.pop(ref)
+        self._recycle(actor)
+        return value
 
-    def get_next_unordered(self, timeout: float = None) -> Any:
-        """Next result in COMPLETION order (ref: actor_pool.py:188)."""
-        if not self.has_next():
+    def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
+        """Next result in COMPLETION order."""
+        if not self._inflight:
             raise StopIteration("No more results to get")
-        ready, _ = ray_tpu.wait(list(self._future_to_actor), num_returns=1,
+        ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
                                 timeout=timeout)
         if not ready:
             raise TimeoutError("Timed out waiting for result")
         ref = ready[0]
-        idx, actor = self._future_to_actor.pop(ref)
-        self._index_to_future.pop(idx, None)
-        self._return_actor(actor)
+        ticket, actor = self._inflight.pop(ref)
+        self._by_ticket.pop(ticket, None)
+        self._recycle(actor)
         return ray_tpu.get(ref)
 
-    # -- bulk --------------------------------------------------------------
+    # -- bulk ----------------------------------------------------------------
 
     def map(self, fn: Callable[[Any, Any], Any],
             values: Iterable[Any]) -> Iterator[Any]:
@@ -85,13 +98,13 @@ class ActorPool:
         while self.has_next():
             yield self.get_next_unordered()
 
-    # -- membership --------------------------------------------------------
+    # -- membership ----------------------------------------------------------
 
     def push(self, actor: Any) -> None:
-        self._return_actor(actor)
+        self._recycle(actor)
 
-    def pop_idle(self) -> Any:
-        return self._idle.pop() if self._idle else None
+    def pop_idle(self) -> Optional[Any]:
+        return self._free.pop() if self._free else None
 
     def has_free(self) -> bool:
-        return bool(self._idle) and not self._pending_submits
+        return bool(self._free) and not self._backlog

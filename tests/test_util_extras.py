@@ -42,7 +42,11 @@ def test_actor_pool_map_ordered(cluster):
 
 
 def test_actor_pool_map_unordered_completion_order(cluster):
-    pool = ActorPool([Doubler.remote() for _ in range(3)])
+    actors = [Doubler.remote() for _ in range(3)]
+    # warm every actor first: worker cold-start (~0.3s, staggered) would
+    # otherwise dominate the 50ms sleep deltas the ordering relies on
+    ray_tpu.get([a.double.remote(0) for a in actors], timeout=60)
+    pool = ActorPool(actors)
     out = list(pool.map_unordered(
         lambda a, v: a.slow_double.remote(v), [0, 1, 2]))
     assert sorted(out) == [0, 2, 4]
