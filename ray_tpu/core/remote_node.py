@@ -55,15 +55,24 @@ class RemoteStoreProxy:
             raise ConnectionError(
                 f"node {self._node.node_id.hex()[:8]} channel closed")
         off = 0
-        while True:
-            end = min(off + chunk, total)
-            sealed = ch.call("store_put_chunk",
-                             {"object_id": object_id, "offset": off,
-                              "total": total, "data": data[off:end]},
-                             timeout=60)
-            off = end
-            if off >= total:
-                break
+        try:
+            while True:
+                end = min(off + chunk, total)
+                sealed = ch.call("store_put_chunk",
+                                 {"object_id": object_id, "offset": off,
+                                  "total": total, "data": data[off:end]},
+                                 timeout=60)
+                off = end
+                if off >= total:
+                    break
+        except Exception:
+            # a half-pushed object is an unsealed, unevictable reservation
+            # of `total` bytes in the agent's store — release it
+            try:
+                ch.notify("store_delete", {"object_id": object_id})
+            except Exception:
+                pass
+            raise
         if not sealed:
             raise RuntimeError(
                 f"remote put of {object_id.hex()[:12]} did not seal")

@@ -157,11 +157,19 @@ class AsyncHTTPProxy:
         stream = (q.get("stream") or ["0"])[0] in ("1", "true")
         if stream:
             try:
-                await self._stream_response(writer, name, data, mux)
-            except Exception:  # noqa: BLE001
-                # mid-stream failure: headers are already on the wire and
-                # _stream_response closed the connection — writing a 500
-                # here would corrupt the chunk framing of a dead socket
+                ok = await self._stream_response(writer, name, data, mux)
+            except Exception as e:  # noqa: BLE001 — pre-header failure
+                # nothing on the wire yet (submission/iterator setup
+                # failed): a normal 500 is still possible
+                self._errors += 1
+                self._write_json(writer, 500,
+                                 {"error": f"{type(e).__name__}: {e}"},
+                                 keep)
+                return keep
+            if not ok:
+                # mid-stream failure: headers were already sent and the
+                # connection was closed — a late 500 would corrupt the
+                # chunk framing
                 self._errors += 1
                 return False
             return keep
@@ -175,9 +183,12 @@ class AsyncHTTPProxy:
                              {"error": f"{type(e).__name__}: {e}"}, keep)
         return keep
 
-    async def _stream_response(self, writer, name, data, mux) -> None:
+    async def _stream_response(self, writer, name, data, mux) -> bool:
         """Chunked NDJSON: generator items are pulled on the pool (each
-        next() blocks on the replica) and written as they arrive."""
+        next() blocks on the replica) and written as they arrive.
+        Exceptions BEFORE the headers go out propagate (caller sends a
+        500); a mid-stream failure closes the connection and returns
+        False."""
         gen = self._get_handle(name).options(
             stream=True, multiplexed_model_id=mux).remote(data)
         it = iter(gen)
@@ -204,8 +215,9 @@ class AsyncHTTPProxy:
             # headers are on the wire: drop the connection so the client
             # sees a framing error, not a truncated-but-"complete" stream
             writer.close()
-            raise
+            return False
         writer.write(b"0\r\n\r\n")
+        return True
 
     # -- helpers ------------------------------------------------------------
 

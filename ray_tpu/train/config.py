@@ -53,6 +53,12 @@ class RunConfig:
     # when any key's reported value reaches the threshold (ref: air.RunConfig
     # stop / tune/stopper.py)
     stop: Optional[Dict[str, Any]] = None
+    # remote-storage mirror of the experiment dir (ref: tune/syncer.py
+    # SyncConfig(upload_dir)): any fsspec URI (gs://, s3://, file://,
+    # memory://) or a plain path; experiment snapshots + checkpoints are
+    # pushed there and Tuner.restore can resume from the mirror
+    upload_dir: Optional[str] = None
+    sync_period_s: float = 5.0
 
     def resolved_storage_path(self) -> str:
         base = self.storage_path or os.path.expanduser("~/ray_tpu_results")

@@ -7,6 +7,8 @@ tune.report(), search spaces (grid/random/domains), trial schedulers
 placement groups under a single-threaded controller event loop.
 """
 from ..train.config import RunConfig
+from .pb2 import PB2
+from .syncer import Syncer, pull_experiment
 from .schedulers import (ASHAScheduler, AsyncHyperBandScheduler,
                          FIFOScheduler, HyperBandForBOHB,
                          HyperBandScheduler, MedianStoppingRule,
@@ -25,7 +27,8 @@ __all__ = [
     "Trainable", "report", "get_checkpoint", "RunConfig",
     "TrialScheduler", "FIFOScheduler", "AsyncHyperBandScheduler",
     "ASHAScheduler", "HyperBandScheduler", "HyperBandForBOHB",
-    "MedianStoppingRule", "PopulationBasedTraining",
+    "MedianStoppingRule", "PB2", "PopulationBasedTraining",
+    "Syncer", "pull_experiment",
     "Searcher", "BasicVariantGenerator", "RandomSearch", "TPESearcher",
     "TuneBOHB",
     "Domain", "Uniform", "LogUniform", "Randint", "Choice", "GridSearch",

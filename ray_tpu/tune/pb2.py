@@ -1,0 +1,149 @@
+"""PB2 — Population Based Bandits.
+
+ref: python/ray/tune/schedulers/pb2.py (Parker-Holder et al. 2020,
+"Provably Efficient Online Hyperparameter Optimization with
+Population-Based Bandits"). Same exploit step as PBT (bottom-quantile
+trials adopt a top trial's checkpoint), but the EXPLORE step replaces
+random perturbation with a GP-UCB acquisition: a Gaussian process is fit
+on (time, hyperparams) -> reward improvement observations collected from
+the whole population, and the new config maximizes UCB over the bounded
+search box. Numpy GP (RBF kernel, Cholesky) — no sklearn/GPy dependency,
+matching the repo's no-new-deps rule.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .schedulers import PopulationBasedTraining
+
+
+class _GP:
+    """Minimal RBF-kernel GP regressor with a white-noise term."""
+
+    def __init__(self, lengthscale: float = 0.3, noise: float = 1e-2):
+        self.ls = lengthscale
+        self.noise = noise
+        self._X: Optional[np.ndarray] = None
+        self._alpha: Optional[np.ndarray] = None
+        self._L: Optional[np.ndarray] = None
+
+    def _k(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / (self.ls ** 2))
+
+    def fit(self, X: np.ndarray, y: np.ndarray) -> None:
+        K = self._k(X, X) + self.noise * np.eye(len(X))
+        self._L = np.linalg.cholesky(K)
+        self._alpha = np.linalg.solve(
+            self._L.T, np.linalg.solve(self._L, y))
+        self._X = X
+
+    def predict(self, Xs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        Ks = self._k(Xs, self._X)
+        mu = Ks @ self._alpha
+        v = np.linalg.solve(self._L, Ks.T)
+        var = np.clip(1.0 - (v ** 2).sum(0), 1e-9, None)
+        return mu, np.sqrt(var)
+
+
+class PB2(PopulationBasedTraining):
+    """Drop-in beside PopulationBasedTraining: pass continuous
+    `hyperparam_bounds` ({key: (low, high)}) instead of mutation specs.
+    Controller interaction (exploit_trial) is inherited unchanged."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 perturbation_interval: int = 4,
+                 hyperparam_bounds: Optional[Dict[str, Sequence[float]]] = None,
+                 quantile_fraction: float = 0.25,
+                 ucb_kappa: float = 2.0,
+                 num_candidates: int = 256,
+                 log_scale: bool = True,
+                 seed: Optional[int] = None):
+        if not hyperparam_bounds:
+            raise ValueError("PB2 needs hyperparam_bounds={key: (lo, hi)}")
+        super().__init__(time_attr=time_attr,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations={},
+                         quantile_fraction=quantile_fraction,
+                         seed=seed)
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in hyperparam_bounds.items()}
+        self.kappa = ucb_kappa
+        self.num_candidates = num_candidates
+        self.log_scale = log_scale
+        self._np_rng = np.random.default_rng(seed)
+        # observations: (t, config-vector) -> score improvement since the
+        # trial's previous perturbation window
+        self._obs_X: List[List[float]] = []
+        self._obs_y: List[float] = []
+        self._prev_score: Dict[str, float] = {}
+
+    # -- observation collection ---------------------------------------------
+
+    def _vec(self, t: float, config: Dict[str, Any]) -> List[float]:
+        out = [t]
+        for k in sorted(self.bounds):
+            lo, hi = self.bounds[k]
+            v = float(config.get(k, lo))
+            if self.log_scale and lo > 0:
+                import math
+
+                v = (math.log(v) - math.log(lo)) / max(
+                    math.log(hi) - math.log(lo), 1e-12)
+            else:
+                v = (v - lo) / max(hi - lo, 1e-12)
+            out.append(min(max(v, 0.0), 1.0))
+        return out
+
+    def on_result(self, trial, result: dict) -> str:
+        action = super().on_result(trial, result)
+        if getattr(trial, "pbt_ready", False):
+            score = self._score(result)
+            prev = self._prev_score.get(trial.trial_id)
+            if prev is not None and np.isfinite(score) and np.isfinite(prev):
+                t = float(result.get(self.time_attr, 0))
+                self._obs_X.append(self._vec(t, trial.config))
+                self._obs_y.append(score - prev)
+            self._prev_score[trial.trial_id] = score
+        return action
+
+    # -- GP-UCB explore -------------------------------------------------------
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        keys = sorted(self.bounds)
+        cands = self._np_rng.random((self.num_candidates, len(keys)))
+        if len(self._obs_y) >= 4:
+            X = np.asarray(self._obs_X)
+            y = np.asarray(self._obs_y)
+            t_now = X[:, 0].max()
+            # normalize: time to [0,1] over the window, y standardized
+            tden = max(t_now, 1.0)
+            Xn = X.copy()
+            Xn[:, 0] = X[:, 0] / tden
+            ystd = y.std() or 1.0
+            yn = (y - y.mean()) / ystd
+            gp = _GP()
+            try:
+                gp.fit(Xn, yn)
+                Xc = np.concatenate(
+                    [np.full((len(cands), 1), t_now / tden), cands], axis=1)
+                mu, sd = gp.predict(Xc)
+                best = int(np.argmax(mu + self.kappa * sd))
+            except np.linalg.LinAlgError:
+                best = int(self._np_rng.integers(len(cands)))
+        else:
+            best = int(self._np_rng.integers(len(cands)))
+        new = dict(config)
+        for i, k in enumerate(keys):
+            lo, hi = self.bounds[k]
+            u = float(cands[best, i])
+            if self.log_scale and lo > 0:
+                import math
+
+                new[k] = math.exp(
+                    math.log(lo) + u * (math.log(hi) - math.log(lo)))
+            else:
+                new[k] = lo + u * (hi - lo)
+        return new

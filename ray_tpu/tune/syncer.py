@@ -1,0 +1,132 @@
+"""Experiment/checkpoint syncing to remote storage.
+
+ref: python/ray/tune/syncer.py:345 (Syncer/_ BackgroundSyncer uploading
+trial + experiment state to cloud storage via pyarrow/fsspec
+filesystems). Here: an fsspec-backed Syncer pushes the experiment
+directory (experiment_state.pkl + per-trial checkpoints) to an
+`upload_dir` URI after every driver snapshot, and `pull_experiment`
+restores it onto a local path so `Tuner.restore` resumes a sweep on a
+fresh machine. Any fsspec protocol works (file://, gs://, s3://,
+memory:// in tests); plain local paths sync with stdlib copy.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import time
+import traceback
+from typing import Optional
+
+
+def _split(uri: str):
+    """-> (fsspec filesystem or None for plain-local, root path)."""
+    if "://" not in uri:
+        return None, uri
+    import fsspec
+
+    fs, _, paths = fsspec.get_fs_token_paths(uri)
+    return fs, paths[0] if paths else uri.split("://", 1)[1]
+
+
+class Syncer:
+    """Push a local experiment dir to remote storage (and pull it back).
+
+    Incremental: files are re-uploaded only when size or mtime-tracked
+    content changed since the last push (driver-side cache)."""
+
+    def __init__(self, upload_dir: str, sync_period_s: float = 5.0):
+        self.upload_dir = upload_dir.rstrip("/")
+        self.period = sync_period_s
+        self._fs, self._root = _split(self.upload_dir)
+        self._last_sync = 0.0
+        self._pushed: dict = {}  # relpath -> (size, mtime)
+        # uploads run off-thread: the tune controller calls sync_up from
+        # its single-threaded event loop, and a slow cloud push must not
+        # stall trial scheduling (the reference's _BackgroundSyncer)
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._executor = ThreadPoolExecutor(1, thread_name_prefix="syncer")
+        self._inflight = None
+
+    # -- push ----------------------------------------------------------------
+
+    def sync_up(self, local_dir: str, force: bool = False) -> bool:
+        now = time.monotonic()
+        if not force and now - self._last_sync < self.period:
+            return False
+        if force:
+            # final sync: wait out any background push, then run inline
+            # so callers observe a complete mirror on return
+            if self._inflight is not None:
+                try:
+                    self._inflight.result(timeout=300)
+                except Exception:  # noqa: BLE001
+                    traceback.print_exc()
+                self._inflight = None
+            self._last_sync = now
+            try:
+                self._push_dir(local_dir)
+                return True
+            except Exception:  # noqa: BLE001 — syncing is best-effort
+                traceback.print_exc()
+                return False
+        if self._inflight is not None and not self._inflight.done():
+            return False  # previous push still draining
+        self._last_sync = now
+
+        def push():
+            try:
+                self._push_dir(local_dir)
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+
+        self._inflight = self._executor.submit(push)
+        return True
+
+    def _push_dir(self, local_dir: str) -> None:
+        base = os.path.abspath(local_dir)
+        for root, _dirs, files in os.walk(base):
+            for f in files:
+                if f.endswith(".tmp"):
+                    continue
+                src = os.path.join(root, f)
+                rel = os.path.relpath(src, base)
+                st = os.stat(src)
+                sig = (st.st_size, st.st_mtime_ns)
+                if self._pushed.get(rel) == sig:
+                    continue
+                dst = f"{self._root}/{rel}"
+                if self._fs is None:
+                    os.makedirs(os.path.dirname(dst), exist_ok=True)
+                    shutil.copy2(src, dst)
+                else:
+                    self._fs.makedirs(os.path.dirname(dst), exist_ok=True)
+                    self._fs.put_file(src, dst)
+                self._pushed[rel] = sig
+
+    # -- pull ----------------------------------------------------------------
+
+    def sync_down(self, local_dir: str) -> None:
+        """Mirror the remote experiment dir onto local_dir."""
+        os.makedirs(local_dir, exist_ok=True)
+        if self._fs is None:
+            for root, _dirs, files in os.walk(self._root):
+                for f in files:
+                    src = os.path.join(root, f)
+                    rel = os.path.relpath(src, self._root)
+                    dst = os.path.join(local_dir, rel)
+                    os.makedirs(os.path.dirname(dst), exist_ok=True)
+                    shutil.copy2(src, dst)
+            return
+        for src in self._fs.find(self._root):
+            rel = os.path.relpath(src, self._root)
+            dst = os.path.join(local_dir, rel)
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            self._fs.get_file(src, dst)
+
+
+def pull_experiment(upload_dir: str, local_dir: str) -> str:
+    """Restore a synced experiment onto local_dir; returns the local
+    experiment path to hand to Tuner.restore."""
+    Syncer(upload_dir).sync_down(local_dir)
+    return local_dir

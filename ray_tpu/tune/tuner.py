@@ -144,6 +144,12 @@ class TuneController:
         self._ckpt_freq = freq
         self._exp_path: Optional[str] = None
         self._last_snapshot = 0.0
+        self._syncer = None
+        if run_config.upload_dir:
+            from .syncer import Syncer
+
+            self._syncer = Syncer(run_config.upload_dir,
+                                  run_config.sync_period_s)
 
     # -- experiment state (ref: tune/execution/experiment_state.py
     # _ExperimentCheckpointManager: periodic driver-side snapshots that
@@ -201,6 +207,8 @@ class TuneController:
             os.replace(tmp, path)  # atomic: a crash never truncates
         except Exception:  # noqa: BLE001 — snapshots are best-effort
             traceback.print_exc()
+        if self._syncer is not None:
+            self._syncer.sync_up(self._exp_path, force=force)
 
     # -- scheduler-facing API (ref: pbt.py uses these) -----------------------
 

@@ -305,3 +305,90 @@ def test_restore_resumes_inflight_trial_from_checkpoint(rt, tmp_path):
     # resumed from it=2: first report carries it_seen=2, final score 4
     assert r.metrics["score"] == 4
     assert r.metrics["it_seen"] == 2
+
+
+def test_pb2_gp_explore_and_exploit(rt):
+    """PB2: same exploit machinery as PBT, GP-UCB explore within bounds —
+    configs must change mid-history AND stay inside the bounds."""
+    from ray_tpu.tune import PB2
+
+    def train_fn(config):
+        ck = tune.get_checkpoint() or {}
+        step = int(ck.get("step", 0))
+        for _ in range(12 - step):
+            step += 1
+            tune.report({"score": config["lr"] * step, "lr": config["lr"]},
+                        checkpoint={"step": step})
+
+    pb2 = PB2(perturbation_interval=2,
+              hyperparam_bounds={"lr": (0.1, 10.0)}, seed=7)
+    results = Tuner(
+        train_fn,
+        param_space={"lr": tune.uniform(0.1, 10.0)},
+        tune_config=TuneConfig(metric="score", mode="max", num_samples=8,
+                               max_concurrent_trials=8, scheduler=pb2,
+                               seed=3),
+        run_config=RunConfig(stop={"training_iteration": 12}),
+    ).fit()
+    assert len(results) == 8
+    assert not results.errors
+    perturbed = 0
+    for r in results:
+        lrs = {round(m["lr"], 6) for m in (r.metrics_history or [])
+               if "lr" in m}
+        if len(lrs) > 1:
+            perturbed += 1
+        assert all(0.1 <= lr <= 10.0 for lr in lrs), lrs
+    assert perturbed >= 1, "PB2 never exploited/explored any trial"
+
+
+def test_pb2_gp_prefers_better_region():
+    """Unit-level: after observations showing high-x improves more, the
+    GP-UCB explore proposes configs in the better half."""
+    from ray_tpu.tune.pb2 import PB2
+
+    pb2 = PB2(hyperparam_bounds={"x": (0.0, 1.0)}, log_scale=False, seed=0)
+    # improvement grows with x
+    for i in range(20):
+        x = i / 19.0
+        pb2._obs_X.append([1.0, x])
+        pb2._obs_y.append(x * 2.0 + 0.01 * (i % 3))
+    picks = [pb2._explore({"x": 0.5})["x"] for _ in range(5)]
+    assert sum(p > 0.6 for p in picks) >= 4, picks
+
+
+def test_tune_syncer_roundtrip_and_restore(rt, tmp_path):
+    """Experiment syncs to an fsspec remote (memory://) during the run;
+    pulling it onto a fresh path restores the sweep with all results
+    (ref: tune/syncer.py:345 + Tuner.restore)."""
+    from ray_tpu.tune import Tuner, pull_experiment
+
+    def train_fn(config):
+        for i in range(3):
+            tune.report({"score": config["a"] * (i + 1)},
+                        checkpoint={"i": i})
+
+    remote = "memory://synced_exp"
+    results = Tuner(
+        train_fn,
+        param_space={"a": tune.grid_search([1.0, 2.0])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="sync_exp",
+                             storage_path=str(tmp_path / "local"),
+                             upload_dir=remote, sync_period_s=0.0),
+    ).fit()
+    assert len(results) == 2 and not results.errors
+
+    # the remote mirror has the experiment state
+    import fsspec
+
+    fs = fsspec.filesystem("memory")
+    assert any(p.endswith("experiment_state.pkl")
+               for p in fs.find("/synced_exp"))
+
+    # restore on a "fresh machine": pull the mirror, Tuner.restore
+    fresh = str(tmp_path / "pulled")
+    local_exp = pull_experiment(remote, fresh)
+    restored = Tuner.restore(local_exp, train_fn).fit()
+    assert len(restored) == 2 and not restored.errors
+    assert restored.get_best_result().metrics["score"] == 6.0
