@@ -1,0 +1,405 @@
+"""Cluster launcher — `ray_tpu up / down / attach / exec` from a YAML
+cluster config.
+
+ref: python/ray/autoscaler/_private/commands.py (create_or_update_cluster
+:690, teardown_cluster, attach_cluster, exec_cluster) and updater.py (the
+SSH NodeUpdater: file mounts -> setup commands -> start command). The
+structure here is the same three layers:
+
+- `CommandRunner`: how to reach a node. `LocalCommandRunner` (subprocess
+  on this host — the testable path, like the reference's fake_multi_node)
+  and `SSHCommandRunner` (ssh/scp subprocess; BatchMode, connection
+  timeouts, no external deps).
+- `NodeUpdater`: bootstrap one node — push file mounts, run setup
+  commands, run the start command.
+- `cluster_up/down/attach/exec`: orchestration + a state file under
+  ~/.ray_tpu/clusters/<name>.json recording the head address, auth key,
+  and launched nodes so later commands can find the cluster.
+
+Config schema (YAML):
+
+    cluster_name: demo
+    provider:
+      type: local            # or: ssh
+      worker_ips: [a, b]     # ssh only
+      ssh_user: ubuntu       # ssh only
+      ssh_key: ~/.ssh/id     # ssh only
+      head_ip: 10.0.0.1      # ssh only (where the head runs)
+    head:
+      port: 6380
+      num_cpus: 4
+      resources: {}
+    workers:
+      count: 2
+      num_cpus: 2
+      resources: {}
+    file_mounts: {/remote/path: /local/path}   # ssh only
+    setup_commands: ["pip list"]                # run before start
+"""
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import shlex
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+STATE_DIR = os.path.expanduser("~/.ray_tpu/clusters")
+
+
+# ---------------------------------------------------------------------------
+# command runners (ref: autoscaler/_private/command_runner.py)
+# ---------------------------------------------------------------------------
+
+
+class CommandRunner:
+    def run(self, cmd: str, env: Optional[Dict[str, str]] = None,
+            background: bool = False) -> subprocess.Popen:
+        raise NotImplementedError
+
+    def check(self, cmd: str, env: Optional[Dict[str, str]] = None,
+              timeout: float = 120.0) -> str:
+        raise NotImplementedError
+
+    def put(self, local: str, remote: str) -> None:
+        raise NotImplementedError
+
+
+class LocalCommandRunner(CommandRunner):
+    """Nodes are processes on this host (the fake_multi_node analog)."""
+
+    def run(self, cmd, env=None, background=False):
+        full_env = {**os.environ, **(env or {})}
+        return subprocess.Popen(cmd, shell=True, env=full_env,
+                                start_new_session=background)
+
+    def check(self, cmd, env=None, timeout=120.0):
+        full_env = {**os.environ, **(env or {})}
+        out = subprocess.run(cmd, shell=True, env=full_env, timeout=timeout,
+                             capture_output=True, text=True)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"command failed rc={out.returncode}: {cmd}\n{out.stderr}")
+        return out.stdout
+
+    def put(self, local, remote):
+        import shutil
+
+        if os.path.abspath(local) == os.path.abspath(remote):
+            return
+        os.makedirs(os.path.dirname(remote), exist_ok=True)
+        if os.path.isdir(local):
+            shutil.copytree(local, remote, dirs_exist_ok=True)
+        else:
+            shutil.copy2(local, remote)
+
+
+class SSHCommandRunner(CommandRunner):
+    """Reach a node over ssh/scp subprocesses (ref: command_runner.py
+    SSHCommandRunner; BatchMode so a missing key fails fast instead of
+    prompting)."""
+
+    def __init__(self, host: str, user: str = "", key: str = ""):
+        self.host = host
+        self.user = user
+        self.key = key
+
+    def _ssh_base(self) -> List[str]:
+        base = ["ssh", "-o", "BatchMode=yes", "-o", "ConnectTimeout=15",
+                "-o", "StrictHostKeyChecking=accept-new"]
+        if self.key:
+            base += ["-i", os.path.expanduser(self.key)]
+        target = f"{self.user}@{self.host}" if self.user else self.host
+        return base + [target]
+
+    def run(self, cmd, env=None, background=False):
+        envs = " ".join(f"{k}={shlex.quote(v)}" for k, v in (env or {}).items())
+        remote = f"{envs} nohup {cmd} >/tmp/ray_tpu_launch.log 2>&1 &" \
+            if background else f"{envs} {cmd}"
+        return subprocess.Popen(self._ssh_base() + [remote])
+
+    def check(self, cmd, env=None, timeout=120.0):
+        envs = " ".join(f"{k}={shlex.quote(v)}" for k, v in (env or {}).items())
+        out = subprocess.run(self._ssh_base() + [f"{envs} {cmd}"],
+                             timeout=timeout, capture_output=True, text=True)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"ssh {self.host} failed rc={out.returncode}: {cmd}\n"
+                f"{out.stderr}")
+        return out.stdout
+
+    def put(self, local, remote):
+        target = f"{self.user}@{self.host}" if self.user else self.host
+        scp = ["scp", "-o", "BatchMode=yes", "-r"]
+        if self.key:
+            scp += ["-i", os.path.expanduser(self.key)]
+        subprocess.run(scp + [local, f"{target}:{remote}"], check=True,
+                       timeout=300)
+
+
+# ---------------------------------------------------------------------------
+# node bootstrap (ref: autoscaler/_private/updater.py NodeUpdater.run)
+# ---------------------------------------------------------------------------
+
+
+class NodeUpdater:
+    def __init__(self, runner: CommandRunner, config: dict,
+                 env: Dict[str, str]):
+        self.runner = runner
+        self.config = config
+        self.env = env
+
+    def bootstrap(self, start_cmd: str) -> subprocess.Popen:
+        for remote, local in (self.config.get("file_mounts") or {}).items():
+            self.runner.put(os.path.expanduser(local),
+                            os.path.expanduser(remote))
+        for cmd in self.config.get("setup_commands") or []:
+            self.runner.check(cmd, env=self.env)
+        return self.runner.run(start_cmd, env=self.env, background=True)
+
+
+# ---------------------------------------------------------------------------
+# orchestration
+# ---------------------------------------------------------------------------
+
+
+def _load_config(path: str) -> dict:
+    import yaml
+
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    cfg.setdefault("provider", {"type": "local"})
+    cfg.setdefault("head", {})
+    cfg.setdefault("workers", {})
+    if not cfg.get("cluster_name"):
+        raise ValueError(f"{path}: cluster_name is required")
+    return cfg
+
+
+def _state_path(name: str) -> str:
+    os.makedirs(STATE_DIR, exist_ok=True)
+    return os.path.join(STATE_DIR, f"{name}.json")
+
+
+def _save_state(name: str, state: dict) -> None:
+    with open(_state_path(name), "w") as f:
+        json.dump(state, f, indent=2)
+
+
+def load_state(name: str) -> dict:
+    with open(_state_path(name)) as f:
+        return json.load(f)
+
+
+def _runner_for(provider: dict, host: Optional[str]) -> CommandRunner:
+    if provider.get("type", "local") == "local":
+        return LocalCommandRunner()
+    return SSHCommandRunner(host, provider.get("ssh_user", ""),
+                            provider.get("ssh_key", ""))
+
+
+def _python() -> str:
+    return shlex.quote(sys.executable)
+
+
+def cluster_up(config_path: str, wait_workers_s: float = 60.0) -> dict:
+    """Start the head, then bootstrap every worker node with the join
+    command. Returns the cluster state dict (also persisted)."""
+    cfg = _load_config(config_path)
+    name = cfg["cluster_name"]
+    provider = cfg["provider"]
+    head_cfg = cfg["head"]
+    authkey = secrets.token_bytes(32).hex()
+    host = head_cfg.get("host", "127.0.0.1"
+                        if provider.get("type", "local") == "local"
+                        else "0.0.0.0")
+    port = int(head_cfg.get("port", 6380))
+    env = {"RTPU_AUTHKEY": authkey,
+           "PYTHONPATH": os.pathsep.join(p for p in sys.path if p)}
+
+    workers_cfg = cfg["workers"]
+    count = int(workers_cfg.get("count", 0))
+    worker_ips = provider.get("worker_ips") or []
+    if provider.get("type", "local") != "local" and count > len(worker_ips):
+        raise ValueError(
+            f"workers.count={count} but provider.worker_ips has only "
+            f"{len(worker_ips)} hosts")
+
+    head_runner = _runner_for(provider, provider.get("head_ip"))
+    head_cmd = (f"{_python()} -m ray_tpu start --head --host {host} "
+                f"--port {port} --num-cpus {head_cfg.get('num_cpus', 4)} "
+                f"--resources {shlex.quote(json.dumps(head_cfg.get('resources') or {}))} "
+                f"--authkey {authkey}")
+    head_proc = NodeUpdater(head_runner, cfg, env).bootstrap(head_cmd)
+    join_host = provider.get("head_ip", "127.0.0.1")
+    address = f"{join_host}:{port}"
+    # state is persisted as soon as anything is running: a failure later
+    # in bring-up must still leave `ray_tpu down <name>` able to find and
+    # kill what was launched
+    state = {"cluster_name": name, "address": address, "authkey": authkey,
+             "head_pid": getattr(head_proc, "pid", None),
+             "worker_pids": [], "provider": provider,
+             "config_path": os.path.abspath(config_path),
+             "started_at": time.time()}
+    _save_state(name, state)
+    try:
+        _wait_port(join_host if join_host != "0.0.0.0" else "127.0.0.1",
+                   port, timeout=30)
+        for i in range(count):
+            w_host = worker_ips[i] if i < len(worker_ips) else None
+            runner = _runner_for(provider, w_host)
+            join_cmd = (
+                f"{_python()} -m ray_tpu start --address {address} "
+                f"--num-cpus {workers_cfg.get('num_cpus', 2)} "
+                f"--resources {shlex.quote(json.dumps(workers_cfg.get('resources') or {}))} "
+                f"--authkey {authkey}")
+            proc = NodeUpdater(runner, cfg, env).bootstrap(join_cmd)
+            state["worker_pids"].append(getattr(proc, "pid", None))
+            _save_state(name, state)
+        if count:
+            _wait_workers(address, authkey, count, wait_workers_s)
+    except BaseException:
+        _save_state(name, state)  # whatever launched is on record
+        raise
+    return state
+
+
+def _wait_port(host: str, port: int, timeout: float) -> None:
+    import socket
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection((host, port), timeout=1):
+                return
+        except OSError:
+            time.sleep(0.2)
+    raise TimeoutError(f"head {host}:{port} did not come up in {timeout}s")
+
+
+def _wait_workers(address: str, authkey: str, count: int,
+                  timeout: float) -> None:
+    """Poll the head's node table until all workers joined."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if len(_alive_nodes(address, authkey)) >= count + 1:
+                return
+        except Exception:
+            pass
+        time.sleep(0.5)
+    raise TimeoutError(f"{count} workers did not join within {timeout}s")
+
+
+def _alive_nodes(address: str, authkey: str) -> list:
+    from ..core.rpc import connect
+
+    host, _, port = address.rpartition(":")
+    # authkey passed explicitly: cluster_token() caches per-process, and
+    # a launcher driving a brand-new cluster from a process that already
+    # belonged to another one must not reuse the stale token
+    ch = connect((host, int(port)), authkey=bytes.fromhex(authkey),
+                 name="launcher")
+    try:
+        return [n for n in ch.call("list_nodes", None, timeout=15)
+                if n.get("alive")]
+    finally:
+        ch.close()
+
+
+def cluster_down(name_or_config: str) -> None:
+    """Terminate every node of the cluster (ref: commands.py
+    teardown_cluster)."""
+    name = name_or_config
+    if name.endswith((".yaml", ".yml", ".json")):
+        name = _load_config(name_or_config)["cluster_name"]
+    state = load_state(name)
+    provider = state.get("provider") or {"type": "local"}
+    if provider.get("type", "local") == "local":
+        for pid in [*state.get("worker_pids", []), state.get("head_pid")]:
+            if pid:
+                _kill_tree(int(pid))
+    else:
+        # scope the kill to THIS cluster: every launched process carries
+        # the cluster's authkey in argv, so matching it cannot touch other
+        # clusters (or hand-started nodes) sharing the host
+        pat = shlex.quote(state["authkey"])
+        for ip in (provider.get("worker_ips") or []) + \
+                [provider.get("head_ip")]:
+            if not ip:
+                continue
+            try:
+                SSHCommandRunner(ip, provider.get("ssh_user", ""),
+                                 provider.get("ssh_key", "")).check(
+                    f"pkill -f {pat} || true", timeout=30)
+            except Exception:
+                pass
+    try:
+        os.remove(_state_path(name))
+    except FileNotFoundError:
+        pass
+
+
+def _kill_tree(pid: int) -> None:
+    """The launcher started nodes with start_new_session=True, so the
+    process group id is the child's pid — signal the whole group (worker
+    subprocesses included), then reap if it was our own child (a killed
+    but unreaped child is a zombie that still answers os.kill(pid, 0))."""
+    for sig in (signal.SIGTERM, signal.SIGKILL):
+        try:
+            os.killpg(pid, sig)
+        except ProcessLookupError:
+            break
+        except PermissionError:
+            os.kill(pid, sig)
+        time.sleep(0.3)
+    try:
+        for _ in range(20):
+            done, _status = os.waitpid(pid, os.WNOHANG)
+            if done:
+                break
+            time.sleep(0.05)
+    except (ChildProcessError, OSError):
+        pass  # not our child: init reaps it
+
+
+def exec_on_head(name_or_config: str, cmd: str, timeout: float = 300.0) -> str:
+    """Run a shell command on the head node with the cluster's auth env
+    (ref: commands.py exec_cluster)."""
+    name = name_or_config
+    if name.endswith((".yaml", ".yml", ".json")):
+        name = _load_config(name_or_config)["cluster_name"]
+    state = load_state(name)
+    provider = state.get("provider") or {"type": "local"}
+    runner = _runner_for(provider, provider.get("head_ip"))
+    env = {"RTPU_AUTHKEY": state["authkey"],
+           "RTPU_ADDRESS": state["address"]}
+    return runner.check(cmd, env=env, timeout=timeout)
+
+
+def attach_cmd(name_or_config: str) -> tuple:
+    """-> (argv, extra_env) opening an interactive shell on the head with
+    the cluster's RTPU_ADDRESS/RTPU_AUTHKEY set, so driver scripts and
+    `ray_tpu ... --address $RTPU_ADDRESS` work out of the box (`ray_tpu
+    attach` executes it; returned for testability)."""
+    name = name_or_config
+    if name.endswith((".yaml", ".yml", ".json")):
+        name = _load_config(name_or_config)["cluster_name"]
+    state = load_state(name)
+    provider = state.get("provider") or {"type": "local"}
+    env = {"RTPU_ADDRESS": state["address"],
+           "RTPU_AUTHKEY": state["authkey"]}
+    if provider.get("type", "local") == "local":
+        return [os.environ.get("SHELL", "/bin/sh")], env
+    host = provider.get("head_ip")
+    user = provider.get("ssh_user", "")
+    target = f"{user}@{host}" if user else host
+    base = ["ssh", "-t"]
+    if provider.get("ssh_key"):
+        base += ["-i", os.path.expanduser(provider["ssh_key"])]
+    exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+    return base + [target, f"env {exports} $SHELL -l"], env

@@ -223,6 +223,48 @@ def _cmd_serve(args) -> int:
         return 1
 
 
+def _cmd_up(args) -> int:
+    from .autoscaler.launcher import cluster_up
+
+    state = cluster_up(args.config)
+    print(f"cluster {state['cluster_name']} is up")
+    print(f"  head: {state['address']}")
+    print(f"  workers: {len(state['worker_pids'])}")
+    print(f"  connect: ray_tpu serve/submit --address {state['address']} "
+          f"--authkey {state['authkey']}")
+    return 0
+
+
+def _cmd_down(args) -> int:
+    from .autoscaler.launcher import cluster_down
+
+    cluster_down(args.cluster)
+    print(f"cluster {args.cluster} torn down")
+    return 0
+
+
+def _cmd_attach(args) -> int:
+    from .autoscaler.launcher import attach_cmd
+
+    argv, env = attach_cmd(args.cluster)
+    os.execvpe(argv[0], argv, {**os.environ, **env})
+
+
+def _cmd_exec(args) -> int:
+    from .autoscaler.launcher import exec_on_head
+
+    cmd = list(args.cmd)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        print("exec needs a command after --", file=sys.stderr)
+        return 2
+    import shlex
+
+    sys.stdout.write(exec_on_head(args.cluster, shlex.join(cmd)))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ray_tpu", description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
@@ -278,6 +320,25 @@ def main(argv=None) -> int:
     jb.add_argument("--address", required=True)
     jb.add_argument("--authkey", default="")
     jb.set_defaults(fn=_cmd_job)
+
+    up = sub.add_parser("up", help="launch a cluster from a YAML config "
+                                   "(ref: autoscaler commands.py "
+                                   "create_or_update_cluster)")
+    up.add_argument("config")
+    up.set_defaults(fn=_cmd_up)
+
+    dn = sub.add_parser("down", help="tear a launched cluster down")
+    dn.add_argument("cluster", help="cluster name or config path")
+    dn.set_defaults(fn=_cmd_down)
+
+    at = sub.add_parser("attach", help="open a shell on the head node")
+    at.add_argument("cluster", help="cluster name or config path")
+    at.set_defaults(fn=_cmd_attach)
+
+    ex = sub.add_parser("exec", help="run a command on the head node")
+    ex.add_argument("cluster", help="cluster name or config path")
+    ex.add_argument("cmd", nargs=argparse.REMAINDER)
+    ex.set_defaults(fn=_cmd_exec)
 
     sv = sub.add_parser(
         "serve", help="deploy/status/shutdown serve applications "

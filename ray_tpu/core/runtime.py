@@ -244,6 +244,11 @@ class DriverRuntime:
                 from .. import jobs
 
                 return jobs.list_jobs()
+            if method == "list_nodes":
+                # launcher/status plane (ref: state API list_nodes)
+                return [{"node_id": n.node_id.hex(), "alive": n.alive,
+                         "resources": dict(n.total_resources)}
+                        for n in self.gcs.nodes()]
             if method == "stop_job":
                 from .. import jobs
 

@@ -79,6 +79,7 @@ class PB2(PopulationBasedTraining):
         self._obs_X: List[List[float]] = []
         self._obs_y: List[float] = []
         self._prev_score: Dict[str, float] = {}
+        self._prev_cfg: Dict[str, tuple] = {}
 
     # -- observation collection ---------------------------------------------
 
@@ -101,12 +102,20 @@ class PB2(PopulationBasedTraining):
         action = super().on_result(trial, result)
         if getattr(trial, "pbt_ready", False):
             score = self._score(result)
+            cfg_sig = tuple(sorted(
+                (k, float(trial.config.get(k, 0.0))) for k in self.bounds))
             prev = self._prev_score.get(trial.trial_id)
-            if prev is not None and np.isfinite(score) and np.isfinite(prev):
+            # an exploit swaps in the donor's checkpoint AND a new config:
+            # the resulting score jump is NOT improvement attributable to
+            # the config — start a fresh window instead of recording it
+            same_cfg = self._prev_cfg.get(trial.trial_id) == cfg_sig
+            if prev is not None and same_cfg \
+                    and np.isfinite(score) and np.isfinite(prev):
                 t = float(result.get(self.time_attr, 0))
                 self._obs_X.append(self._vec(t, trial.config))
                 self._obs_y.append(score - prev)
             self._prev_score[trial.trial_id] = score
+            self._prev_cfg[trial.trial_id] = cfg_sig
         return action
 
     # -- GP-UCB explore -------------------------------------------------------

@@ -104,6 +104,17 @@ class Syncer:
                     self._fs.put_file(src, dst)
                 self._pushed[rel] = sig
 
+    def close(self) -> None:
+        """Release the background upload thread (the controller calls
+        this after the final force-sync)."""
+        if self._inflight is not None:
+            try:
+                self._inflight.result(timeout=60)
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+            self._inflight = None
+        self._executor.shutdown(wait=False)
+
     # -- pull ----------------------------------------------------------------
 
     def sync_down(self, local_dir: str) -> None:

@@ -493,6 +493,8 @@ class Tuner:
             controller.load_state(self._restore_state)
         trials = controller.run()
         controller._maybe_snapshot(force=True)
+        if controller._syncer is not None:
+            controller._syncer.close()
         os.makedirs(base, exist_ok=True)
         results = []
         for t in trials:
