@@ -116,10 +116,13 @@ class SSHCommandRunner(CommandRunner):
         target = f"{self.user}@{self.host}" if self.user else self.host
         return base + [target]
 
+    log_path = "~/.ray_tpu/launch.log"  # set per node by the launcher
+
     def run(self, cmd, env=None, background=False):
         envs = " ".join(f"{k}={shlex.quote(v)}" for k, v in (env or {}).items())
-        remote = f"{envs} nohup {cmd} >/tmp/ray_tpu_launch.log 2>&1 &" \
-            if background else f"{envs} {cmd}"
+        log = shlex.quote(self.log_path)
+        remote = (f"mkdir -p ~/.ray_tpu && {envs} nohup {cmd} "
+                  f">{log} 2>&1 &") if background else f"{envs} {cmd}"
         return subprocess.Popen(self._ssh_base() + [remote])
 
     def check(self, cmd, env=None, timeout=120.0):
@@ -172,9 +175,10 @@ def _load_config(path: str) -> dict:
 
     with open(path) as f:
         cfg = yaml.safe_load(f)
-    cfg.setdefault("provider", {"type": "local"})
-    cfg.setdefault("head", {})
-    cfg.setdefault("workers", {})
+    # `head:` with no children parses as None — normalize falsy sections
+    cfg["provider"] = cfg.get("provider") or {"type": "local"}
+    cfg["head"] = cfg.get("head") or {}
+    cfg["workers"] = cfg.get("workers") or {}
     if not cfg.get("cluster_name"):
         raise ValueError(f"{path}: cluster_name is required")
     return cfg
@@ -186,8 +190,10 @@ def _state_path(name: str) -> str:
 
 
 def _save_state(name: str, state: dict) -> None:
-    with open(_state_path(name), "w") as f:
+    path = _state_path(name)
+    with open(path, "w") as f:
         json.dump(state, f, indent=2)
+    os.chmod(path, 0o600)  # it holds the cluster auth token
 
 
 def load_state(name: str) -> dict:
@@ -214,26 +220,40 @@ def cluster_up(config_path: str, wait_workers_s: float = 60.0) -> dict:
     provider = cfg["provider"]
     head_cfg = cfg["head"]
     authkey = secrets.token_bytes(32).hex()
+    # a NON-secret nonce rides in every node's argv so teardown can
+    # pkill by it; the authkey itself travels env-only (argv is visible
+    # to every local user via /proc)
+    nonce = f"rtpu-{name}-{secrets.token_hex(8)}"
     host = head_cfg.get("host", "127.0.0.1"
                         if provider.get("type", "local") == "local"
                         else "0.0.0.0")
     port = int(head_cfg.get("port", 6380))
-    env = {"RTPU_AUTHKEY": authkey,
-           "PYTHONPATH": os.pathsep.join(p for p in sys.path if p)}
+    env = {"RTPU_AUTHKEY": authkey}
+    if provider.get("type", "local") == "local":
+        # local nodes resolve ray_tpu from this checkout; remote hosts
+        # have their own install — exporting our sys.path there would
+        # shadow theirs with wrong-or-stale paths
+        env["PYTHONPATH"] = os.pathsep.join(p for p in sys.path if p)
 
     workers_cfg = cfg["workers"]
     count = int(workers_cfg.get("count", 0))
     worker_ips = provider.get("worker_ips") or []
-    if provider.get("type", "local") != "local" and count > len(worker_ips):
-        raise ValueError(
-            f"workers.count={count} but provider.worker_ips has only "
-            f"{len(worker_ips)} hosts")
+    if provider.get("type", "local") != "local":
+        if count > len(worker_ips):
+            raise ValueError(
+                f"workers.count={count} but provider.worker_ips has only "
+                f"{len(worker_ips)} hosts")
+        if not provider.get("head_ip"):
+            raise ValueError(
+                "ssh provider needs head_ip (where the head process runs)")
 
     head_runner = _runner_for(provider, provider.get("head_ip"))
+    if isinstance(head_runner, SSHCommandRunner):
+        head_runner.log_path = f"~/.ray_tpu/launch_{name}_head.log"
     head_cmd = (f"{_python()} -m ray_tpu start --head --host {host} "
                 f"--port {port} --num-cpus {head_cfg.get('num_cpus', 4)} "
                 f"--resources {shlex.quote(json.dumps(head_cfg.get('resources') or {}))} "
-                f"--authkey {authkey}")
+                f"--cluster-name {nonce}")
     head_proc = NodeUpdater(head_runner, cfg, env).bootstrap(head_cmd)
     join_host = provider.get("head_ip", "127.0.0.1")
     address = f"{join_host}:{port}"
@@ -241,6 +261,7 @@ def cluster_up(config_path: str, wait_workers_s: float = 60.0) -> dict:
     # in bring-up must still leave `ray_tpu down <name>` able to find and
     # kill what was launched
     state = {"cluster_name": name, "address": address, "authkey": authkey,
+             "nonce": nonce,
              "head_pid": getattr(head_proc, "pid", None),
              "worker_pids": [], "provider": provider,
              "config_path": os.path.abspath(config_path),
@@ -252,11 +273,13 @@ def cluster_up(config_path: str, wait_workers_s: float = 60.0) -> dict:
         for i in range(count):
             w_host = worker_ips[i] if i < len(worker_ips) else None
             runner = _runner_for(provider, w_host)
+            if isinstance(runner, SSHCommandRunner):
+                runner.log_path = f"~/.ray_tpu/launch_{name}_worker{i}.log"
             join_cmd = (
                 f"{_python()} -m ray_tpu start --address {address} "
                 f"--num-cpus {workers_cfg.get('num_cpus', 2)} "
                 f"--resources {shlex.quote(json.dumps(workers_cfg.get('resources') or {}))} "
-                f"--authkey {authkey}")
+                f"--cluster-name {nonce}")
             proc = NodeUpdater(runner, cfg, env).bootstrap(join_cmd)
             state["worker_pids"].append(getattr(proc, "pid", None))
             _save_state(name, state)
@@ -320,14 +343,15 @@ def cluster_down(name_or_config: str) -> None:
     state = load_state(name)
     provider = state.get("provider") or {"type": "local"}
     if provider.get("type", "local") == "local":
+        needle = state.get("nonce") or "ray_tpu"
         for pid in [*state.get("worker_pids", []), state.get("head_pid")]:
-            if pid:
+            if pid and _pid_matches(int(pid), needle):
                 _kill_tree(int(pid))
     else:
         # scope the kill to THIS cluster: every launched process carries
-        # the cluster's authkey in argv, so matching it cannot touch other
-        # clusters (or hand-started nodes) sharing the host
-        pat = shlex.quote(state["authkey"])
+        # the cluster's non-secret nonce in argv, so matching it cannot
+        # touch other clusters (or hand-started nodes) sharing the host
+        pat = shlex.quote(state.get("nonce") or state["authkey"])
         for ip in (provider.get("worker_ips") or []) + \
                 [provider.get("head_ip")]:
             if not ip:
@@ -342,6 +366,18 @@ def cluster_down(name_or_config: str) -> None:
         os.remove(_state_path(name))
     except FileNotFoundError:
         pass
+
+
+def _pid_matches(pid: int, needle: str) -> bool:
+    """Stale state files survive reboots and pid recycling: only signal a
+    process whose cmdline still carries this cluster's nonce."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            return needle.encode() in f.read()
+    except FileNotFoundError:
+        return False
+    except OSError:
+        return True  # no /proc (non-Linux): keep the old behavior
 
 
 def _kill_tree(pid: int) -> None:

@@ -281,6 +281,10 @@ def main(argv=None) -> int:
     sp.add_argument("--labels", default="{}")
     sp.add_argument("--authkey", default="",
                     help="cluster auth token (hex) printed by the head")
+    sp.add_argument("--cluster-name", default="",
+                    help="label only: lets the launcher find this "
+                         "cluster's processes without putting the "
+                         "authkey in argv")
     sp.set_defaults(fn=_cmd_start)
 
     st = sub.add_parser("status", help="show cluster nodes")

@@ -20,6 +20,11 @@ _EXPORTS = {
     "Impala": "impala", "ImpalaConfig": "impala",
     "ImpalaLearner": "impala",
     "SAC": "sac", "SACConfig": "sac", "SACLearner": "sac",
+    "APPO": "impala", "APPOConfig": "impala",
+    "MARWIL": "offline", "MARWILConfig": "offline",
+    "BC": "offline", "BCConfig": "offline",
+    "collect_experiences": "offline", "read_experiences": "offline",
+    "write_experiences": "offline",
     "ReplayBuffer": "replay_buffer",
     "PrioritizedReplayBuffer": "replay_buffer",
     "CartPoleVecEnv": "env", "PendulumVecEnv": "env", "VectorEnv": "env",

@@ -82,7 +82,13 @@ class ImpalaLearner:
                  gamma: float = 0.99, rho_clip: float = 1.0,
                  c_clip: float = 1.0, vf_coeff: float = 0.5,
                  ent_coeff: float = 0.01, hidden=(64, 64), seed: int = 0,
-                 max_grad_norm: float = 10.0):
+                 max_grad_norm: float = 10.0,
+                 clip_param: Optional[float] = None):
+        # clip_param set = APPO: the PPO clipped surrogate on V-trace
+        # advantages instead of the plain importance-weighted PG loss
+        # (ref: rllib/algorithms/appo/appo.py - APPO is IMPALA's async
+        # pipeline with PPO's loss)
+        self.clip_param = clip_param
         import jax
         import optax
 
@@ -94,7 +100,8 @@ class ImpalaLearner:
             optax.clip_by_global_norm(max_grad_norm), optax.adam(lr))
         self.opt_state = self.optimizer.init(self.params)
         self._update = jax.jit(
-            self._make_update(gamma, rho_clip, c_clip, vf_coeff, ent_coeff),
+            self._make_update(gamma, rho_clip, c_clip, vf_coeff, ent_coeff,
+                              clip_param),
             donate_argnums=(0, 1))
         self.num_updates = 0
 
@@ -127,7 +134,8 @@ class ImpalaLearner:
                                 - values)
         return vs, pg_adv
 
-    def _make_update(self, gamma, rho_clip, c_clip, vf_coeff, ent_coeff):
+    def _make_update(self, gamma, rho_clip, c_clip, vf_coeff, ent_coeff,
+                     clip_param=None):
         import jax
         import jax.numpy as jnp
         import optax
@@ -152,7 +160,20 @@ class ImpalaLearner:
                 jax.lax.stop_gradient(bootstrap), batch["rewards"],
                 batch["dones"], jax.lax.stop_gradient(rhos), gamma,
                 rho_clip, c_clip)
-            pg_loss = -jnp.mean(logp * jax.lax.stop_gradient(pg_adv))
+            if clip_param is None:
+                pg_loss = -jnp.mean(logp * jax.lax.stop_gradient(pg_adv))
+            else:
+                adv = jax.lax.stop_gradient(pg_adv)
+                # per-batch advantage normalization (the standard PPO
+                # recipe; raw V-trace advantages carry return scale)
+                adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+                ratio = rhos  # same importance ratio V-trace used;
+                # gradient flows through it (only the _vtrace arg was
+                # stop_gradient'ed)
+                surr = jnp.minimum(
+                    ratio * adv,
+                    jnp.clip(ratio, 1 - clip_param, 1 + clip_param) * adv)
+                pg_loss = -jnp.mean(surr)
             vf_loss = jnp.mean((values - jax.lax.stop_gradient(vs)) ** 2)
             entropy = -jnp.mean(
                 jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
@@ -204,6 +225,7 @@ class ImpalaConfig:
     vf_coeff: float = 0.5
     ent_coeff: float = 0.01
     batches_per_iter: int = 8
+    clip_param: Optional[float] = None  # set = APPO (PPO clip on V-trace)
     broadcast_interval: int = 1  # updates between weight publications
     max_queue: int = 8
     hidden: tuple = (64, 64)
@@ -266,7 +288,8 @@ class Impala:
         self.learner = ImpalaLearner(
             info.get("obs_shape", info["obs_dim"]), info["num_actions"], lr=c.lr, gamma=c.gamma,
             rho_clip=c.rho_clip, c_clip=c.c_clip, vf_coeff=c.vf_coeff,
-            ent_coeff=c.ent_coeff, hidden=c.hidden, seed=c.seed)
+            ent_coeff=c.ent_coeff, hidden=c.hidden, seed=c.seed,
+            clip_param=c.clip_param)
         self._params_ref = ray_tpu.put(self.learner.get_params())
         self._params_lock = threading.Lock()
         import queue as _q
@@ -373,3 +396,18 @@ class Impala:
                 ray_tpu.kill(w)
             except Exception:
                 pass
+
+
+@dataclass
+class APPOConfig(ImpalaConfig):
+    """APPO = IMPALA's async sample pipeline + PPO's clipped surrogate on
+    V-trace advantages (ref: rllib/algorithms/appo/appo.py)."""
+    clip_param: Optional[float] = 0.2
+
+    def build(self) -> "APPO":
+        return APPO(self)
+
+
+class APPO(Impala):
+    """Asynchronous PPO (ref: appo.py). Everything but the loss is
+    IMPALA: feeder threads, bounded queue, V-trace correction."""

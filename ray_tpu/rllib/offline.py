@@ -1,0 +1,286 @@
+"""Offline RL — experience IO + MARWIL/BC.
+
+ref: rllib/offline/ (json_writer.py/json_reader.py SampleBatch files,
+dataset_reader.py) and rllib/algorithms/marwil/ (MARWIL: Monotonic
+Advantage Re-Weighted Imitation Learning; BC is MARWIL with beta=0 —
+the same subclassing the reference uses, bc.py:24).
+
+Experience files are JSONL of per-episode records (obs/actions/rewards/
+dones lists) — readable with stdlib, diffable, and loadable through
+`ray_tpu.data.read_json` as well. `collect_experiences` runs any
+callable policy over a VectorEnv to produce them (the analog of
+rollout-workers writing through a JsonWriter output config).
+
+The MARWIL learner computes discounted returns per episode, fits a value
+baseline, and weights the imitation log-likelihood by
+exp(beta * advantage) — plain behavior cloning when beta == 0.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .env import VectorEnv, make_env
+from .models import forward, init_policy_params
+
+
+# ---------------------------------------------------------------------------
+# experience IO (ref: offline/json_writer.py / json_reader.py)
+# ---------------------------------------------------------------------------
+
+
+def write_experiences(path: str, episodes: List[Dict[str, Any]]) -> None:
+    """episodes: [{obs: [T,...], actions: [T], rewards: [T]}] -> JSONL."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        for ep in episodes:
+            rec = {k: np.asarray(v).tolist() for k, v in ep.items()}
+            f.write(json.dumps(rec) + "\n")
+
+
+def read_experiences(paths) -> List[Dict[str, np.ndarray]]:
+    if isinstance(paths, str):
+        paths = [paths]
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _d, names in os.walk(p):
+                files.extend(os.path.join(root, n) for n in sorted(names)
+                             if n.endswith((".json", ".jsonl")))
+        else:
+            files.append(p)
+    episodes = []
+    for fp in files:
+        with open(fp) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                episodes.append({
+                    "obs": np.asarray(rec["obs"], np.float32),
+                    "actions": np.asarray(rec["actions"], np.int64),
+                    "rewards": np.asarray(rec["rewards"], np.float32),
+                })
+    if not episodes:
+        raise FileNotFoundError(f"no experience files under {paths}")
+    return episodes
+
+
+def collect_experiences(env: VectorEnv, policy: Callable[[np.ndarray],
+                                                         np.ndarray],
+                        num_episodes: int, path: Optional[str] = None,
+                        seed: int = 0) -> List[Dict[str, Any]]:
+    """Run `policy(obs_batch) -> actions` until num_episodes complete;
+    optionally write the JSONL file. Episodes are tracked per sub-env so
+    vectorized auto-resets don't splice episodes together."""
+    n = env.num_envs
+    obs = env.reset(seed=seed)
+    cur: List[Dict[str, list]] = [
+        {"obs": [], "actions": [], "rewards": []} for _ in range(n)]
+    done_eps: List[Dict[str, Any]] = []
+    while len(done_eps) < num_episodes:
+        actions = np.asarray(policy(obs))
+        for i in range(n):
+            cur[i]["obs"].append(obs[i])
+            cur[i]["actions"].append(actions[i])
+        obs, reward, done, info = env.step(actions)
+        for i in range(n):
+            cur[i]["rewards"].append(reward[i])
+            if done[i]:
+                done_eps.append({k: np.asarray(v)
+                                 for k, v in cur[i].items()})
+                cur[i] = {"obs": [], "actions": [], "rewards": []}
+    done_eps = done_eps[:num_episodes]
+    if path:
+        write_experiences(path, done_eps)
+    return done_eps
+
+
+# ---------------------------------------------------------------------------
+# MARWIL / BC
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class MARWILConfig:
+    """ref: marwil.py MARWILConfig (beta, vf_coeff); bc.py sets beta=0."""
+    env: str = "CartPole-v1"          # for evaluation only
+    env_creator: Optional[Callable] = None
+    input_paths: Any = None           # file/dir of JSONL experiences
+    episodes: Optional[List[Dict[str, np.ndarray]]] = None  # or in-memory
+    beta: float = 1.0                 # 0 = plain behavior cloning
+    gamma: float = 0.99
+    lr: float = 5e-4
+    vf_coeff: float = 1.0
+    train_batch_size: int = 512
+    num_updates_per_iter: int = 32
+    hidden: tuple = (64, 64)
+    seed: int = 0
+    evaluation_num_episodes: int = 8
+
+    def build(self) -> "MARWIL":
+        return MARWIL(self)
+
+
+@dataclass
+class BCConfig(MARWILConfig):
+    """Behavior cloning = MARWIL with beta=0 (ref: bc.py:24)."""
+    beta: float = 0.0
+
+    def build(self) -> "BC":
+        return BC(self)
+
+
+class MARWIL:
+    """Offline trainer: no rollout workers — train() consumes the fixed
+    dataset; evaluation runs the learned policy in the env."""
+
+    def __init__(self, config: MARWILConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.config = c = config
+        episodes = c.episodes or read_experiences(c.input_paths)
+        # flatten episodes into transitions with discounted returns
+        obs, acts, rets = [], [], []
+        for ep in episodes:
+            r = np.asarray(ep["rewards"], np.float32)
+            g = np.zeros_like(r)
+            acc = 0.0
+            for t in range(len(r) - 1, -1, -1):
+                acc = r[t] + c.gamma * acc
+                g[t] = acc
+            obs.append(np.asarray(ep["obs"], np.float32))
+            acts.append(np.asarray(ep["actions"], np.int64))
+            rets.append(g)
+        self._obs = np.concatenate(obs)
+        self._acts = np.concatenate(acts)
+        rets_all = np.concatenate(rets)
+        # standardize returns: raw discounted returns (O(1/(1-gamma)))
+        # would make the shared-trunk value loss dwarf the imitation
+        # gradient and degrade the policy head
+        self._ret_mean = float(rets_all.mean())
+        self._ret_std = float(rets_all.std() + 1e-8)
+        self._rets = (rets_all - self._ret_mean) / self._ret_std
+        self._num_actions = int(self._acts.max()) + 1
+        obs_shape = self._obs.shape[1:]
+        self.params = init_policy_params(
+            jax.random.PRNGKey(c.seed),
+            obs_shape if len(obs_shape) > 1 else int(obs_shape[0]),
+            self._num_actions, tuple(c.hidden))
+        self.optimizer = optax.adam(c.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._rng = np.random.default_rng(c.seed)
+        self._iteration = 0
+
+        beta, vf_coeff = c.beta, c.vf_coeff
+
+        def loss_fn(params, ob, ac, ret):
+            logits, values = forward(params, ob)
+            logp = jax.nn.log_softmax(logits)
+            logp_a = jnp.take_along_axis(logp, ac[:, None], axis=1)[:, 0]
+            adv = ret - values
+            if beta == 0.0:
+                # plain BC needs no baseline at all (ref: bc.py — BC
+                # drops the value head from the loss)
+                vf_loss = jnp.float32(0.0)
+                pol_loss = -jnp.mean(logp_a)
+            else:
+                vf_loss = jnp.mean(adv ** 2)
+                # exp(beta * normalized advantage), gradient only through
+                # the log-likelihood (ref: marwil_torch_policy.py loss)
+                w = jnp.exp(beta * jax.lax.stop_gradient(
+                    adv / (jnp.std(adv) + 1e-8)))
+                w = jnp.minimum(w, 20.0)           # weight clip
+                pol_loss = -jnp.mean(w * logp_a)
+            return pol_loss + vf_coeff * vf_loss, (pol_loss, vf_loss)
+
+        def update_many(params, opt_state, ob, ac, ret):
+            def body(carry, xs):
+                params, opt_state = carry
+                o, a, r = xs
+                (loss, aux), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, o, a, r)
+                updates, opt_state = self.optimizer.update(grads, opt_state)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), (loss, *aux)
+
+            (params, opt_state), stats = jax.lax.scan(
+                body, (params, opt_state), (ob, ac, ret))
+            return params, opt_state, jax.tree.map(jnp.mean, stats)
+
+        self._update_many = jax.jit(update_many, donate_argnums=(0, 1))
+
+    def train(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        c = self.config
+        t0 = time.monotonic()
+        K, B = c.num_updates_per_iter, min(c.train_batch_size,
+                                           len(self._obs))
+        idx = self._rng.integers(0, len(self._obs), size=(K, B))
+        ob = jnp.asarray(self._obs[idx])
+        ac = jnp.asarray(self._acts[idx])
+        ret = jnp.asarray(self._rets[idx])
+        self.params, self.opt_state, (loss, pol, vf) = self._update_many(
+            self.params, self.opt_state, ob, ac, ret)
+        self._iteration += 1
+        out = {"training_iteration": self._iteration,
+               "loss": float(loss), "policy_loss": float(pol),
+               "vf_loss": float(vf),
+               "num_transitions": len(self._obs),
+               "train_time_s": time.monotonic() - t0}
+        return out
+
+    def evaluate(self, num_episodes: Optional[int] = None,
+                 seed: int = 123) -> Dict[str, float]:
+        """Greedy rollouts of the learned policy in the config env."""
+        import jax
+
+        c = self.config
+        n_eps = num_episodes or c.evaluation_num_episodes
+        env = (c.env_creator(num_envs=4, seed=seed) if c.env_creator
+               else make_env(c.env, num_envs=4, seed=seed))
+        params = jax.device_get(self.params)
+        from .np_policy import forward_np
+
+        obs = env.reset(seed=seed)
+        ep_ret = np.zeros(env.num_envs)
+        done_rets: List[float] = []
+        while len(done_rets) < n_eps:
+            logits, _ = forward_np(params, obs.astype(np.float32))
+            actions = logits.argmax(axis=1)
+            obs, r, done, _ = env.step(actions)
+            ep_ret += r
+            for i in np.nonzero(done)[0]:
+                done_rets.append(float(ep_ret[i]))
+                ep_ret[i] = 0.0
+        return {"episode_reward_mean": float(np.mean(done_rets[:n_eps])),
+                "episodes": n_eps}
+
+    # Tune-trainable surface
+    def save(self) -> Dict:
+        import jax
+
+        return {"params": jax.device_get(self.params),
+                "iteration": self._iteration}
+
+    def restore(self, ckpt: Dict) -> None:
+        import jax.numpy as jnp
+
+        self.params = {k: jnp.asarray(v) for k, v in ckpt["params"].items()}
+        self._iteration = int(ckpt.get("iteration", 0))
+
+    def stop(self) -> None:
+        pass  # no workers
+
+
+class BC(MARWIL):
+    """Behavior cloning (ref: bc.py — MARWIL with beta=0)."""
