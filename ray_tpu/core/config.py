@@ -14,39 +14,92 @@ from typing import Any, Dict
 
 
 _DEFS: Dict[str, Any] = {}
+_DESCRIPTIONS: Dict[str, str] = {}
 
 
-def _define(name: str, default: Any) -> None:
+def _define(name: str, default: Any, description: str = "") -> None:
     _DEFS[name] = default
+    _DESCRIPTIONS[name] = description
+
+
+def describe() -> Dict[str, Dict[str, Any]]:
+    """Flag catalog: {name: {default, type, description, env}} — the
+    analog of reading ray_config_def.h."""
+    return {
+        name: {"default": default,
+               "type": type(default).__name__,
+               "description": _DESCRIPTIONS.get(name, ""),
+               "env": "RTPU_" + name.upper()}
+        for name, default in _DEFS.items()
+    }
 
 
 # --- object store / serialization ---
-_define("max_direct_call_object_size", 100 * 1024)  # inline threshold (ref: ray_config_def.h:213)
-_define("task_args_inline_bytes_limit", 10 * 1024 * 1024)  # ref: ray_config_def.h:516
-_define("object_store_memory", 2 * 1024**3)
-_define("object_spilling_dir", "/tmp/ray_tpu_spill")
-_define("min_spilling_size", 1 * 1024 * 1024)
-_define("object_transfer_chunk_bytes", 5 * 1024 * 1024)  # ref: ray_config_def.h:348
+_define("max_direct_call_object_size", 100 * 1024,
+        "values at or under this inline into specs/results instead of the "
+        "shared-memory store (ref: ray_config_def.h:213)")
+_define("task_args_inline_bytes_limit", 10 * 1024 * 1024,
+        "total inline-arg budget per task (ref: ray_config_def.h:516)")
+_define("object_store_memory", 2 * 1024**3,
+        "per-node shared-memory store capacity in bytes")
+_define("object_spilling_dir", "/tmp/ray_tpu_spill",
+        "disk spill directory; empty disables spilling")
+_define("min_spilling_size", 1 * 1024 * 1024,
+        "objects smaller than this are evicted rather than spilled")
+_define("object_transfer_chunk_bytes", 5 * 1024 * 1024,
+        "chunk size for inter-node object pulls/pushes "
+        "(ref: ray_config_def.h:348)")
 # --- scheduling ---
-_define("scheduler_spread_threshold", 0.5)  # hybrid policy (ref: ray_config_def.h:193)
-_define("scheduler_top_k_fraction", 0.2)  # ref: ray_config_def.h:199-204
-_define("worker_lease_timeout_s", 30.0)
-_define("num_workers_soft_limit", 8)
-_define("worker_prestart_count", 0)
-_define("worker_startup_timeout_s", 60.0)
-_define("worker_idle_timeout_s", 300.0)
+_define("scheduler_spread_threshold", 0.5,
+        "hybrid policy: pack onto a node until this utilization, then "
+        "spread (ref: ray_config_def.h:193)")
+_define("scheduler_top_k_fraction", 0.2,
+        "fraction of best-scoring nodes randomized over per decision "
+        "(ref: ray_config_def.h:199)")
+_define("worker_lease_timeout_s", 30.0,
+        "how long a lease request waits for capacity before erroring")
+_define("num_workers_soft_limit", 8,
+        "per-node worker-pool size target; the idle reaper trims to it")
+_define("worker_prestart_count", 0,
+        "workers started eagerly at node bring-up")
+_define("worker_startup_timeout_s", 60.0,
+        "a worker that hasn't registered by then is declared failed")
+_define("worker_idle_timeout_s", 300.0,
+        "idle workers above the soft limit are reaped after this")
+# --- runtime / rpc ---
+_define("driver_pool_threads", 8,
+        "DriverRuntime's shared thread pool (lease grants, await-ref "
+        "futures, function export)")
+_define("rpc_handler_threads", 4,
+        "request-handler threads per RpcChannel (worker/agent channels)")
+_define("agent_server_threads", 32,
+        "handler threads for the head's agent-facing TCP server (blocking "
+        "fetches must not starve worker_call relays)")
+_define("pg_placer_tick_s", 0.5,
+        "parked placement groups re-check capacity at this cadence when "
+        "no cluster event fires")
 # --- fault tolerance ---
-_define("task_max_retries", 3)
-_define("actor_max_restarts", 0)
-_define("health_check_period_s", 1.0)
-_define("health_check_timeout_s", 10.0)
-_define("lineage_max_bytes", 256 * 1024 * 1024)
+_define("task_max_retries", 3,
+        "default automatic retries for worker-crash task failures")
+_define("actor_max_restarts", 0,
+        "default actor restart budget (0 = actors die with their worker)")
+_define("health_check_period_s", 1.0,
+        "head -> remote-agent heartbeat check cadence "
+        "(ref: gcs_health_check_manager)")
+_define("health_check_timeout_s", 10.0,
+        "an agent silent for this long is declared dead and fenced")
+_define("lineage_max_bytes", 256 * 1024 * 1024,
+        "lineage (resubmittable task specs) memory budget")
 # --- gcs ---
-_define("gcs_storage_path", "")  # non-empty => persist KV/tables to this dir (FT restart)
-_define("task_events_max_buffered", 10000)
+_define("gcs_storage_path", "",
+        "non-empty => persist KV/tables to this dir (head restart FT)")
+_define("task_events_max_buffered", 10000,
+        "task-event ring size backing the state API / timeline")
 # --- misc ---
-_define("log_dir", "/tmp/ray_tpu/logs")
-_define("metrics_export_port", 0)
+_define("log_dir", "/tmp/ray_tpu/logs",
+        "worker/agent log directory")
+_define("metrics_export_port", 0,
+        "non-zero => Prometheus exposition server on this port")
 
 
 class Config:

@@ -34,9 +34,13 @@ class RpcChannel:
 
     def __init__(self, conn: Connection,
                  handler: Optional[Callable[[str, Any], Any]] = None,
-                 num_handler_threads: int = 4,
+                 num_handler_threads: Optional[int] = None,
                  name: str = "",
                  autostart: bool = True):
+        if num_handler_threads is None:
+            from .config import DEFAULT
+
+            num_handler_threads = int(DEFAULT.rpc_handler_threads)
         self._conn = conn
         self._handler = handler
         self._name = name
@@ -355,7 +359,8 @@ class RpcServer:
 
 def connect(address, authkey: Optional[bytes] = None,
             handler: Optional[Callable[[str, Any], Any]] = None,
-            name: str = "", num_handler_threads: int = 4) -> RpcChannel:
+            name: str = "",
+            num_handler_threads: Optional[int] = None) -> RpcChannel:
     conn = Client(address, authkey=authkey or cluster_token())
     return RpcChannel(conn, handler=handler, name=name,
                       num_handler_threads=num_handler_threads)

@@ -138,7 +138,9 @@ class DriverRuntime:
         self._renv_cache: Dict[str, dict] = {}
         self.default_runtime_env: Optional[dict] = None  # job-level env
         self._lock = threading.RLock()
-        self._pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="rt")
+        self._pool = ThreadPoolExecutor(
+            max_workers=int(self.config.driver_pool_threads),
+            thread_name_prefix="rt")
         self._shutdown = False
         threading.Thread(target=self._pg_placer_loop, daemon=True,
                          name="pg-placer").start()
@@ -191,10 +193,9 @@ class DriverRuntime:
             return self._remote_server.address
         # one agent channel multiplexes every worker on that host; size the
         # pool so blocking fetches can't starve the worker_call relay
-        self._remote_server = RpcServer((host, port),
-                                        self._make_agent_handler,
-                                        family="AF_INET",
-                                        num_handler_threads=32)
+        self._remote_server = RpcServer(
+            (host, port), self._make_agent_handler, family="AF_INET",
+            num_handler_threads=int(self.config.agent_server_threads))
         # health monitor: remote nodes must keep heartbeating or be
         # declared dead even with the TCP channel still open (hung agent,
         # network partition) — ref: gcs_health_check_manager.h:39
@@ -1402,7 +1403,9 @@ class DriverRuntime:
             with self._pg_cv:
                 while not self._pg_pending and not self._shutdown:
                     if self._pg_parked:
-                        if not self._pg_cv.wait(0.5) and not self._pg_pending:
+                        tick = float(self.config.pg_placer_tick_s)
+                        if not self._pg_cv.wait(tick) \
+                                and not self._pg_pending:
                             fp = self._capacity_fingerprint()
                             if fp != self._pg_last_fp:
                                 self._pg_pending.extend(self._pg_parked)

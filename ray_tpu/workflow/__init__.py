@@ -79,12 +79,18 @@ class _StepFunction:
     def __init__(self, fn: Callable, num_cpus: float = 1.0,
                  max_retries: int = 3):
         self._fn = fn
-        self._blob = cloudpickle.dumps(fn)
+        # serialization is DEFERRED to the first .step() call: pickling at
+        # decoration time would capture an empty closure cell for
+        # recursive steps (`fact` isn't bound until the decorator
+        # returns), breaking dynamic-continuation recursion
+        self._blob: Optional[bytes] = None
         self._name = getattr(fn, "__name__", "step")
         self._num_cpus = num_cpus
         self._max_retries = max_retries
 
     def step(self, *args, **kwargs) -> StepNode:
+        if self._blob is None:
+            self._blob = cloudpickle.dumps(self._fn)
         return StepNode(self._blob, self._name, args, kwargs,
                         self._num_cpus, self._max_retries)
 
@@ -146,46 +152,195 @@ class _Storage:
         os.replace(tmp, os.path.join(self.dir, "workflow.json"))
 
 
-def _submit(node: StepNode, storage: _Storage, position: str,
-            pending: List[tuple]):
-    """Submit the whole subtree WITHOUT blocking: child results travel as
-    ObjectRefs straight into the parent's arguments, so independent
-    branches run concurrently across the cluster (a serial tree walk
-    would strand an N-way fan-out at 1x parallelism). Returns the ref of
-    this node's result; `pending` collects (key, ref, cached) post-order
-    for the checkpointing pass."""
-    key = node.step_key(position)
-    if storage.has(key):
-        ref = ray_tpu.put(storage.load(key))  # replay from checkpoint
-        pending.append((key, ref, True))
-        return ref
-    args = [(_submit(a, storage, f"{position}.{i}", pending)
-             if isinstance(a, StepNode) else a)
-            for i, a in enumerate(node.args)]
-    kwargs = {k: (_submit(v, storage, f"{position}.{k}", pending)
-                  if isinstance(v, StepNode) else v)
-              for k, v in node.kwargs.items()}
-    fn = cloudpickle.loads(node.fn_blob)
-    ref = ray_tpu.remote(fn).options(
-        num_cpus=node.num_cpus,
-        max_retries=node.max_retries).remote(*args, **kwargs)
-    pending.append((key, ref, False))
-    return ref
+@dataclass
+class EventNode:
+    """A durable wait point (ref: workflow/api.py wait_for_event +
+    workflow/event_listener.py). Execution blocks until the named event
+    is delivered — via `workflow.deliver_event` (the built-in
+    storage-backed listener) or a custom `listener()` callable returning
+    the payload (or None to keep waiting). The received payload
+    checkpoints like any step result, so a resumed workflow does NOT
+    re-wait for an event it already saw."""
+    name: str
+    timeout_s: Optional[float] = None
+    listener_blob: Optional[bytes] = None
+    poll_interval_s: float = 0.2
+
+    def step_key(self, position: str) -> str:
+        return f"{position}_event_{self.name}"
+
+
+def wait_for_event(name: str, *, timeout_s: Optional[float] = None,
+                   listener: Optional[Callable[[], Any]] = None,
+                   poll_interval_s: float = 0.2) -> EventNode:
+    """A DAG node that resolves when the event arrives; use it as an
+    argument to any step."""
+    return EventNode(name, timeout_s,
+                     cloudpickle.dumps(listener) if listener else None,
+                     poll_interval_s)
+
+
+def deliver_event(workflow_id: str, name: str, payload: Any = None) -> None:
+    """Deliver an event to a (possibly currently waiting) workflow."""
+    storage = _Storage(workflow_id)
+    os.makedirs(os.path.join(storage.dir, "events"), exist_ok=True)
+    path = os.path.join(storage.dir, "events", name + ".pkl")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        cloudpickle.dump(payload, f)
+    os.replace(tmp, path)
+
+
+@dataclass
+class _Continuation:
+    """Durable marker persisted under a hop's key when the step RETURNED
+    another StepNode: resume loads it and re-enters the chain at that
+    hop instead of re-running everything before it."""
+    node: Any
+
+
+class _Executor:
+    """Driver-side scheduler: every child subtree resolves on its own
+    thread (parallel fan-out), each step's value checkpoints before
+    parents consume it, and a step that RETURNS a StepNode is a dynamic
+    continuation (ref: workflow continuation semantics). Continuations
+    run as an ITERATIVE trampoline — each hop persists a _Continuation
+    marker, so arbitrarily long chains neither blow the Python stack nor
+    lose progress on a crash."""
+
+    MAX_CONTINUATIONS = 100_000  # runaway-loop backstop
+
+    def __init__(self, storage: _Storage):
+        import threading
+
+        self.storage = storage
+        # a failed sibling aborts event waits so a co-scheduled
+        # wait_for_event with no timeout can't hang the whole run
+        self._abort = threading.Event()
+
+    def execute(self, node, position: str) -> Any:
+        value, _ref = self._resolve(node, position)
+        return value
+
+    def _resolve(self, node, position: str):
+        """-> (value, task_ref_or_None). The ref, when present, lets a
+        parent pass the result WITHOUT re-uploading it (the child task's
+        store copy is reused)."""
+        if isinstance(node, EventNode):
+            return self._await_event(node, position), None
+        root_key = node.step_key(position)
+        cur, curpos, hops = node, position, 0
+        ref = None
+        while True:
+            if isinstance(cur, EventNode):
+                value = self._await_event(cur, curpos)
+                ref = None
+            else:
+                key = cur.step_key(curpos)
+                if self.storage.has(key):
+                    value = self.storage.load(key)
+                    ref = None
+                else:
+                    value, ref = self._run_step(cur, curpos)
+                    self.storage.save(
+                        key, _Continuation(value)
+                        if isinstance(value, (StepNode, EventNode))
+                        else value)
+            if isinstance(value, _Continuation):
+                value = value.node  # loaded marker: re-enter the chain
+            if not isinstance(value, (StepNode, EventNode)):
+                break
+            hops += 1
+            if hops > self.MAX_CONTINUATIONS:
+                raise RuntimeError(
+                    f"step {root_key} exceeded {self.MAX_CONTINUATIONS} "
+                    "continuations (infinite loop?)")
+            cur, curpos = value, f"{position}.c{hops}"
+        if hops:
+            # the chain's final value also lands under the ROOT key so a
+            # completed chain replays in one load
+            self.storage.save(root_key, value)
+        return value, ref
+
+    def _run_step(self, node: StepNode, position: str):
+        import threading
+
+        results: Dict[Any, Any] = {}
+        errors: List[BaseException] = []
+
+        def resolve(slot, child, child_pos):
+            try:
+                value, child_ref = self._resolve(child, child_pos)
+                # hand the parent the child task's existing store copy
+                # when there is one — re-inlining a multi-GB value would
+                # round-trip it through driver memory a second time
+                results[slot] = child_ref if child_ref is not None else value
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors.append(e)
+                self._abort.set()
+
+        threads = []
+        for i, a in enumerate(node.args):
+            if isinstance(a, (StepNode, EventNode)):
+                threads.append(threading.Thread(
+                    target=resolve, args=(i, a, f"{position}.{i}"),
+                    daemon=True))
+            else:
+                results[i] = a
+        for k, v in node.kwargs.items():
+            if isinstance(v, (StepNode, EventNode)):
+                threads.append(threading.Thread(
+                    target=resolve, args=(k, v, f"{position}.{k}"),
+                    daemon=True))
+            else:
+                results[k] = v
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        args = [results[i] for i in range(len(node.args))]
+        kwargs = {k: results[k] for k in node.kwargs}
+        fn = cloudpickle.loads(node.fn_blob)
+        ref = ray_tpu.remote(fn).options(
+            num_cpus=node.num_cpus,
+            max_retries=node.max_retries).remote(*args, **kwargs)
+        return ray_tpu.get(ref), ref
+
+    def _await_event(self, node: EventNode, position: str) -> Any:
+        key = node.step_key(position)
+        if self.storage.has(key):
+            return self.storage.load(key)  # already received pre-crash
+        listener = (cloudpickle.loads(node.listener_blob)
+                    if node.listener_blob else None)
+        path = os.path.join(self.storage.dir, "events", node.name + ".pkl")
+        deadline = (time.monotonic() + node.timeout_s
+                    if node.timeout_s is not None else None)
+        while True:
+            if self._abort.is_set():
+                raise RuntimeError(
+                    f"event wait {node.name!r} aborted: a sibling step "
+                    "failed")
+            if listener is not None:
+                payload = listener()
+                if payload is not None:
+                    break
+            elif os.path.exists(path):
+                with open(path, "rb") as f:
+                    payload = cloudpickle.load(f)
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"event {node.name!r} not delivered within "
+                    f"{node.timeout_s}s")
+            time.sleep(node.poll_interval_s)
+        self.storage.save(key, payload)
+        return payload
 
 
 def _execute(node: StepNode, storage: _Storage, position: str) -> Any:
-    pending: List[tuple] = []
-    root_ref = _submit(node, storage, position, pending)
-    # checkpoint in post-order (children land before parents); a crash
-    # mid-graph loses only steps whose results hadn't arrived yet
-    result = None
-    for key, ref, cached in pending:
-        result = ray_tpu.get(ref)
-        if not cached:
-            storage.save(key, result)
-    # the root is the last post-order entry
-    assert pending[-1][1] is root_ref
-    return result
+    return _Executor(storage).execute(node, position)
 
 
 def run(dag: StepNode, *, workflow_id: Optional[str] = None) -> Any:

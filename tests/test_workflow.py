@@ -87,3 +87,133 @@ def test_delete_and_status(cluster):
     assert workflow.get_status("wf_del") == workflow.SUCCESSFUL
     workflow.delete("wf_del")
     assert workflow.get_status("wf_del") is None
+
+
+class TestDynamicWorkflows:
+    def test_continuation_recursion(self, cluster, tmp_path, monkeypatch):
+        """A step returning a StepNode is a durable continuation —
+        factorial via recursion, every hop checkpointed (ref: workflow
+        continuation semantics)."""
+        monkeypatch.setenv("RTPU_WORKFLOW_STORAGE", str(tmp_path))
+
+        @workflow.step
+        def fact(n, acc=1):
+            if n <= 1:
+                return acc
+            return fact.step(n - 1, acc * n)  # continuation
+
+        assert workflow.run(fact.step(6), workflow_id="fact6") == 720
+        # checkpoints exist for the continuation chain
+        steps = os.listdir(tmp_path / "fact6" / "steps")
+        assert len(steps) >= 6
+
+    def test_continuation_resumes_mid_chain(self, cluster, tmp_path,
+                                            monkeypatch):
+        monkeypatch.setenv("RTPU_WORKFLOW_STORAGE", str(tmp_path))
+        boom = tmp_path / "boom_flag"
+        boom_path = str(boom)
+
+        @workflow.step
+        def counting(n):
+            if n == 0:
+                return "done"
+            if n == 2 and os.path.exists(boom_path):
+                raise RuntimeError("boom")
+            return counting.step(n - 1)
+
+        boom.write_text("1")
+        with pytest.raises(Exception):
+            workflow.run(counting.step(4), workflow_id="chain")
+        assert workflow.get_status("chain") == workflow.RESUMABLE
+        os.remove(boom)
+        assert workflow.resume("chain") == "done"
+        assert workflow.get_status("chain") == workflow.SUCCESSFUL
+
+
+class TestWorkflowEvents:
+    def test_wait_for_event_delivery(self, cluster, tmp_path, monkeypatch):
+        """A workflow blocks on an external event; deliver_event from
+        another thread unblocks it (ref: workflow/event_listener.py)."""
+        import threading
+        import time as _t
+
+        monkeypatch.setenv("RTPU_WORKFLOW_STORAGE", str(tmp_path))
+
+        @workflow.step
+        def handle(order):
+            return {"processed": order["id"]}
+
+        dag = handle.step(workflow.wait_for_event("order", timeout_s=30))
+
+        def deliver():
+            _t.sleep(0.5)
+            workflow.deliver_event("evwf", "order", {"id": 7})
+
+        threading.Thread(target=deliver, daemon=True).start()
+        out = workflow.run(dag, workflow_id="evwf")
+        assert out == {"processed": 7}
+
+    def test_event_survives_resume(self, cluster, tmp_path, monkeypatch):
+        """An event received before a crash is NOT re-awaited on resume
+        (its payload checkpointed)."""
+        monkeypatch.setenv("RTPU_WORKFLOW_STORAGE", str(tmp_path))
+
+        boom2 = tmp_path / "boom2_flag"
+        boom2_path = str(boom2)
+
+        @workflow.step
+        def explode(payload):
+            if os.path.exists(boom2_path):
+                raise RuntimeError("late failure")
+            return payload * 2
+
+        dag = explode.step(workflow.wait_for_event("tick", timeout_s=30))
+        workflow.deliver_event("evres", "tick", 21)
+        boom2.write_text("1")
+        with pytest.raises(Exception):
+            workflow.run(dag, workflow_id="evres")
+        # remove the delivered-event file: resume must replay from the
+        # CHECKPOINT, not the delivery
+        ev = tmp_path / "evres" / "events" / "tick.pkl"
+        os.remove(ev)
+        os.remove(boom2)
+        assert workflow.resume("evres") == 42
+
+    def test_event_timeout(self, cluster, tmp_path, monkeypatch):
+        monkeypatch.setenv("RTPU_WORKFLOW_STORAGE", str(tmp_path))
+
+        @workflow.step
+        def never(x):
+            return x
+
+        with pytest.raises(Exception):
+            workflow.run(never.step(
+                workflow.wait_for_event("ghost", timeout_s=0.5,
+                                        poll_interval_s=0.05)),
+                workflow_id="late")
+
+    def test_custom_listener(self, cluster, tmp_path, monkeypatch):
+        monkeypatch.setenv("RTPU_WORKFLOW_STORAGE", str(tmp_path))
+        box = tmp_path / "mailbox.txt"
+
+        def listener():
+            return box.read_text() if box.exists() else None
+
+        @workflow.step
+        def echo(msg):
+            return msg.upper()
+
+        import threading
+        import time as _t
+
+        def write():
+            _t.sleep(0.4)
+            box.write_text("hello")
+
+        threading.Thread(target=write, daemon=True).start()
+        out = workflow.run(
+            echo.step(workflow.wait_for_event(
+                "mb", listener=listener, timeout_s=30,
+                poll_interval_s=0.05)),
+            workflow_id="cust")
+        assert out == "HELLO"
