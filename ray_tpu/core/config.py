@@ -72,6 +72,8 @@ _define("driver_pool_threads", 8,
         "futures, function export)")
 _define("rpc_handler_threads", 4,
         "request-handler threads per RpcChannel (worker/agent channels)")
+_define("node_server_threads", 16,
+        "handler threads for a node's worker-facing RPC server")
 _define("agent_server_threads", 32,
         "handler threads for the head's agent-facing TCP server (blocking "
         "fetches must not starve worker_call relays)")

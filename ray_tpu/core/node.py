@@ -105,7 +105,7 @@ class Node:
         self._sock_path = os.path.join(session_dir, f"node_{node_id.hex()[:12]}.sock")
         self._server = RpcServer(self._sock_path, self._make_handler,
                                  num_handler_threads=int(
-                                     self.config.rpc_handler_threads) * 4,
+                                     self.config.node_server_threads),
                                  family="AF_UNIX")
         self._max_workers = max(int(config.num_workers_soft_limit),
                                 int(self.total_resources.get("CPU", 1)))

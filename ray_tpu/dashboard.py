@@ -1,17 +1,22 @@
-"""Minimal cluster dashboard.
+"""Cluster dashboard — single-page app over the state API.
 
-Equivalent of the reference's dashboard backend (ref: dashboard/
-dashboard.py + datacenter.py aggregation; the React frontend is out of
-scope — the reference ships ~1MB of compiled JS). One stdlib HTTP server
-over the existing state API: `/` renders a self-refreshing HTML overview
-(nodes, actors, tasks, placement groups, jobs, object stores) and
-`/api/*` serves the same data as JSON for tooling.
+Equivalent of the reference's dashboard (ref: dashboard/dashboard.py +
+datacenter.py aggregation + the React SPA in dashboard/client; the
+reference ships ~1MB of compiled JS — here the SPA is ~150 lines of
+vanilla JS embedded below, served by a stdlib HTTP server). Views: live
+overview with utilization bars and sparklines, nodes, actors, tasks
+(filterable), placement groups, objects, jobs, and serve deployments.
+`/api/*` serves every view's data as JSON for tooling; a background
+sampler keeps a short metrics history for the sparklines (the analog of
+the reference's metrics dashboard integration, scoped to in-process
+history instead of Prometheus/Grafana).
 """
 from __future__ import annotations
 
-import html
 import json
 import threading
+import time
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -27,49 +32,165 @@ def _jobs_rows():
         return []
 
 
+def _serve_rows():
+    try:
+        import ray_tpu
+        from .serve.controller import CONTROLLER_NAME
+
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        status = ray_tpu.get(controller.status.remote(), timeout=5)
+        return [{"deployment": name, **st} for name, st in status.items()]
+    except Exception:
+        return []
+
+
 _API = {
     "nodes": state_api.list_nodes,
     "actors": state_api.list_actors,
-    "tasks": lambda: state_api.list_tasks(limit=200),
-    "objects": lambda: state_api.list_objects(limit=200),
+    "tasks": lambda: state_api.list_tasks(limit=300),
+    "objects": lambda: state_api.list_objects(limit=300),
     "placement_groups": state_api.list_placement_groups,
     "object_store": state_api.object_store_stats,
     "summary": state_api.summary,
     "jobs": _jobs_rows,
+    "serve": _serve_rows,
 }
 
-
-def _table(title: str, rows) -> str:
-    if isinstance(rows, dict):
-        rows = [{"key": k, **v} if isinstance(v, dict) else
-                {"key": k, "value": v} for k, v in rows.items()]
-    if not rows:
-        return f"<h2>{title}</h2><p class='empty'>none</p>"
-    cols = list(rows[0].keys())
-    head = "".join(f"<th>{html.escape(str(c))}</th>" for c in cols)
-    body = "".join(
-        "<tr>" + "".join(
-            f"<td>{html.escape(str(r.get(c, '')))[:64]}</td>"
-            for c in cols) + "</tr>"
-        for r in rows[:100])
-    return (f"<h2>{title} ({len(rows)})</h2>"
-            f"<table><tr>{head}</tr>{body}</table>")
+_HISTORY_LEN = 120  # 2s cadence -> 4 minutes of sparkline
 
 
-_STYLE = """<style>
-body{font-family:monospace;margin:1.5em;background:#fafafa;color:#222}
-table{border-collapse:collapse;margin-bottom:1em;font-size:12px}
-td,th{border:1px solid #ccc;padding:2px 8px;text-align:left}
-th{background:#eee}h1{font-size:18px}h2{font-size:14px;margin:0.6em 0 0.2em}
-.empty{color:#999;font-size:12px}</style>"""
+class _MetricsSampler:
+    """Background thread appending one overview sample every 2s — feeds
+    the sparklines without a Prometheus round-trip."""
+
+    def __init__(self):
+        self.history: deque = deque(maxlen=_HISTORY_LEN)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        threading.Thread(target=self._loop, daemon=True,
+                         name="dash-sampler").start()
+
+    def snapshot(self) -> list:
+        with self._lock:
+            return list(self.history)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(2.0):
+            try:
+                s = state_api.summary()
+                stores = state_api.object_store_stats()
+                if isinstance(stores, dict):
+                    stores = list(stores.values())
+                used = sum(st.get("used_bytes", st.get("used", 0))
+                           for st in stores if isinstance(st, dict))
+                tasks = s.get("task_events_by_state", {})
+                with self._lock:
+                    self.history.append({
+                        "t": time.time(),
+                        "alive_nodes": s.get("nodes_alive", 0),
+                        "actors": sum(s.get("actors_by_state",
+                                            {}).values()),
+                        "finished_tasks": int(tasks.get("FINISHED", 0)),
+                        "store_used_bytes": used,
+                    })
+            except Exception:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+_PAGE = """<!doctype html><html><head><title>ray_tpu dashboard</title>
+<style>
+body{font-family:-apple-system,'Segoe UI',sans-serif;margin:0;background:#f6f7f9;color:#1a1d21}
+header{background:#1a1d21;color:#fff;padding:10px 20px;display:flex;align-items:center;gap:16px}
+header h1{font-size:16px;margin:0}
+nav button{background:none;border:none;color:#aab;padding:6px 10px;cursor:pointer;font-size:13px;border-bottom:2px solid transparent}
+nav button.active{color:#fff;border-color:#4c8dff}
+main{padding:16px 20px;max-width:1200px}
+table{border-collapse:collapse;width:100%;font-size:12px;font-family:ui-monospace,monospace;background:#fff}
+td,th{border:1px solid #e2e5e9;padding:4px 8px;text-align:left;white-space:nowrap;overflow:hidden;max-width:260px;text-overflow:ellipsis}
+th{background:#eef0f3;position:sticky;top:0}
+.cards{display:flex;gap:12px;flex-wrap:wrap;margin-bottom:16px}
+.card{background:#fff;border:1px solid #e2e5e9;border-radius:6px;padding:10px 14px;min-width:130px}
+.card .v{font-size:22px;font-weight:600}.card .k{font-size:11px;color:#667}
+.bar{background:#e8eaee;border-radius:3px;height:8px;width:120px;display:inline-block;vertical-align:middle}
+.bar i{display:block;height:8px;border-radius:3px;background:#4c8dff}
+input#q{padding:4px 8px;font-size:12px;margin-bottom:8px;width:240px}
+svg.spark{vertical-align:middle}
+.empty{color:#99a;font-size:12px;padding:12px}
+</style></head><body>
+<header><h1>ray_tpu</h1><nav id=nav></nav>
+<span id=updated style="margin-left:auto;font-size:11px;color:#889"></span></header>
+<main id=main></main>
+<script>
+const TABS=["overview","nodes","actors","tasks","placement_groups","objects","jobs","serve"];
+let tab="overview", filter="";
+const nav=document.getElementById("nav");
+TABS.forEach(t=>{const b=document.createElement("button");b.textContent=t.replace("_"," ");
+ b.onclick=()=>{tab=t;render()};b.id="tab_"+t;nav.appendChild(b)});
+function esc(s){return String(s??"").replace(/[&<>"']/g,c=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]))}
+async function api(p){const r=await fetch("/api/"+p);return r.json()}
+function spark(vals,w=140,h=28){if(!vals.length)return "";
+ const mn=Math.min(...vals),mx=Math.max(...vals),rg=(mx-mn)||1;
+ const pts=vals.map((v,i)=>`${(i/(vals.length-1||1)*w).toFixed(1)},${(h-2-(v-mn)/rg*(h-4)).toFixed(1)}`).join(" ");
+ return `<svg class=spark width=${w} height=${h}><polyline points="${pts}" fill=none stroke=#4c8dff stroke-width=1.5/></svg>`}
+function table(rows){if(!rows||!rows.length)return "<div class=empty>none</div>";
+ const cols=Object.keys(rows[0]);
+ let html="<table><tr>"+cols.map(c=>`<th>${esc(c)}</th>`).join("")+"</tr>";
+ for(const r of rows.slice(0,200)){html+="<tr>"+cols.map(c=>{
+  let v=r[c];if(v&&typeof v==="object")v=JSON.stringify(v);
+  return `<td title="${esc(v)}">${esc(v)}</td>`}).join("")+"</tr>"}
+ return html+"</table>"}
+function card(k,v,extra=""){return `<div class=card><div class=v>${esc(v)}</div><div class=k>${esc(k)}</div>${extra}</div>`}
+async function render(){
+ TABS.forEach(t=>document.getElementById("tab_"+t).classList.toggle("active",t===tab));
+ const main=document.getElementById("main");
+ try{
+  if(tab==="overview"){
+   const [s,nodes,hist]=await Promise.all([api("summary"),api("nodes"),api("metrics_history")]);
+   let cards="";
+   const nact=Object.values(s.actors_by_state||{}).reduce((a,b)=>a+b,0);
+   const nfin=(s.task_events_by_state||{}).FINISHED||0;
+   cards+=card("alive nodes",s.nodes_alive??"-",spark(hist.map(h=>h.alive_nodes)));
+   cards+=card("actors",nact,spark(hist.map(h=>h.actors)));
+   cards+=card("finished tasks",nfin,spark(hist.map(h=>h.finished_tasks)));
+   cards+=card("store used",fmtB(hist.length?hist[hist.length-1].store_used_bytes:0),
+               spark(hist.map(h=>h.store_used_bytes)));
+   let bars="<h3 style='font-size:13px'>Per-node CPU utilization</h3>";
+   for(const n of nodes){const tot=(n.resources_total&&n.resources_total.CPU)||(n.total&&n.total.CPU)||0;
+    const av=(n.resources_available&&n.resources_available.CPU)??(n.available&&n.available.CPU)??tot;
+    const used=tot-av,pct=tot?Math.round(used/tot*100):0;
+    bars+=`<div style="font-size:12px;margin:3px 0">${esc((n.node_id||"").slice(0,12))}
+      <span class=bar><i style="width:${pct}%"></i></span> ${used.toFixed(1)}/${tot} CPU</div>`}
+   main.innerHTML=`<div class=cards>${cards}</div>${bars}`;
+  } else if(tab==="tasks"){
+   const rows=await api("tasks");
+   const f=filter.toLowerCase();
+   const shown=f?rows.filter(r=>JSON.stringify(r).toLowerCase().includes(f)):rows;
+   main.innerHTML=`<input id=q placeholder="filter tasks..." value="${esc(filter)}">`+table(shown);
+   const q=document.getElementById("q");
+   q.oninput=()=>{filter=q.value;render()};q.focus();q.setSelectionRange(filter.length,filter.length);
+  } else {
+   main.innerHTML=table(await api(tab));
+  }
+  document.getElementById("updated").textContent="updated "+new Date().toLocaleTimeString();
+ }catch(e){main.innerHTML=`<div class=empty>error: ${e}</div>`}
+}
+function fmtB(b){if(!b)return "0";const u=["B","KB","MB","GB"];let i=0;
+ while(b>=1024&&i<u.length-1){b/=1024;i++}return b.toFixed(1)+u[i]}
+render();
+setInterval(()=>{if(tab!=="tasks"||!filter)render()},2000);
+</script></body></html>"""
 
 
 class Dashboard:
-    """Serves the overview; run on the head (in-process thread, off the
-    scheduling hot path)."""
+    """Serves the SPA + JSON API; runs on the head (in-process thread,
+    off the scheduling hot path)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 8265):
         dash = self
+        self._sampler: Optional[_MetricsSampler] = None
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):
@@ -84,6 +205,12 @@ class Dashboard:
 
             def do_GET(self):  # noqa: N802
                 path = self.path.split("?")[0].strip("/")
+                if path == "api/metrics_history":
+                    samples = (dash._sampler.snapshot()
+                               if dash._sampler is not None else [])
+                    body = json.dumps(samples, default=str).encode()
+                    self._send(200, body, "application/json")
+                    return
                 if path.startswith("api/"):
                     fn = _API.get(path[4:])
                     if fn is None:
@@ -98,39 +225,23 @@ class Dashboard:
                             {"error": repr(e)}).encode(),
                             "application/json")
                     return
-                self._send(200, dash._render().encode(), "text/html")
+                self._send(200, _PAGE.encode(), "text/html")
 
+        # bind FIRST: a port-in-use failure must not leak a forever-
+        # polling sampler thread
         self._server = ThreadingHTTPServer((host, port), Handler)
+        self._sampler = _MetricsSampler()
         self._port = self._server.server_address[1]
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True, name="dashboard")
         self._thread.start()
 
-    def _render(self) -> str:
-        parts = ["<html><head><title>ray_tpu dashboard</title>",
-                 "<meta http-equiv='refresh' content='5'>", _STYLE,
-                 "</head><body><h1>ray_tpu cluster</h1>"]
-        try:
-            parts.append(_table("Summary", [state_api.summary()]))
-            parts.append(_table("Nodes", state_api.list_nodes()))
-            parts.append(_table("Actors", state_api.list_actors()))
-            parts.append(_table("Jobs", _jobs_rows()))
-            parts.append(_table("Placement groups",
-                                state_api.list_placement_groups()))
-            parts.append(_table("Object stores",
-                                state_api.object_store_stats()))
-            parts.append(_table("Recent tasks",
-                                state_api.list_tasks(limit=50)))
-        except Exception as e:  # noqa: BLE001 — render what we can
-            parts.append(f"<p class='empty'>error: {html.escape(repr(e))}"
-                         f"</p>")
-        parts.append("</body></html>")
-        return "".join(parts)
-
     def address(self) -> tuple:
         return ("127.0.0.1", self._port)
 
     def shutdown(self) -> None:
+        if self._sampler is not None:
+            self._sampler.stop()
         self._server.shutdown()
 
 

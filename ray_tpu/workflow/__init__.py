@@ -210,6 +210,8 @@ class _Executor:
 
     MAX_CONTINUATIONS = 100_000  # runaway-loop backstop
 
+    MAX_RESOLVER_THREADS = 64
+
     def __init__(self, storage: _Storage):
         import threading
 
@@ -217,6 +219,13 @@ class _Executor:
         # a failed sibling aborts event waits so a co-scheduled
         # wait_for_event with no timeout can't hang the whole run
         self._abort = threading.Event()
+        # bounds concurrent resolver threads across the whole run. A
+        # child that can't get a permit resolves INLINE on its parent's
+        # thread (never blocks on the semaphore), so wide/deep DAGs
+        # degrade to partial serialization instead of thread exhaustion
+        # or a nested-pool deadlock.
+        self._thread_permits = threading.Semaphore(
+            self.MAX_RESOLVER_THREADS)
 
     def execute(self, node, position: str) -> Any:
         value, _ref = self._resolve(node, position)
@@ -279,23 +288,38 @@ class _Executor:
                 errors.append(e)
                 self._abort.set()
 
-        threads = []
+        def resolve_permitted(slot, child, child_pos):
+            try:
+                resolve(slot, child, child_pos)
+            finally:
+                self._thread_permits.release()
+
+        pending = []
         for i, a in enumerate(node.args):
             if isinstance(a, (StepNode, EventNode)):
-                threads.append(threading.Thread(
-                    target=resolve, args=(i, a, f"{position}.{i}"),
-                    daemon=True))
+                pending.append((i, a, f"{position}.{i}"))
             else:
                 results[i] = a
         for k, v in node.kwargs.items():
             if isinstance(v, (StepNode, EventNode)):
-                threads.append(threading.Thread(
-                    target=resolve, args=(k, v, f"{position}.{k}"),
-                    daemon=True))
+                pending.append((k, v, f"{position}.{k}"))
             else:
                 results[k] = v
-        for t in threads:
-            t.start()
+        threads = []
+        inline = []
+        for idx, item in enumerate(pending):
+            if idx < len(pending) - 1 \
+                    and self._thread_permits.acquire(blocking=False):
+                t = threading.Thread(target=resolve_permitted, args=item,
+                                     daemon=True)
+                threads.append(t)
+                t.start()
+            else:
+                # no permit (or last child): run on this thread — always
+                # at least one child makes progress without a new thread
+                inline.append(item)
+        for item in inline:
+            resolve(*item)
         for t in threads:
             t.join()
         if errors:

@@ -127,7 +127,9 @@ def test_dashboard_serves_overview_and_api(cluster):
         with urllib.request.urlopen(f"http://{host}:{port}/",
                                     timeout=10) as r:
             page = r.read().decode()
-        assert "ray_tpu cluster" in page and "Nodes" in page
+        # SPA shell: tab nav + client-side fetch of the JSON API
+        assert "ray_tpu dashboard" in page and "api/" in page
+        assert "placement_groups" in page and "serve" in page
         with urllib.request.urlopen(f"http://{host}:{port}/api/summary",
                                     timeout=10) as r:
             s = _json.load(r)
@@ -136,6 +138,14 @@ def test_dashboard_serves_overview_and_api(cluster):
                                     timeout=10) as r:
             nodes = _json.load(r)
         assert len(nodes) >= 1
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/api/metrics_history",
+                timeout=10) as r:
+            hist = _json.load(r)
+        assert isinstance(hist, list)  # fills as the sampler ticks
+        with urllib.request.urlopen(f"http://{host}:{port}/api/serve",
+                                    timeout=10) as r:
+            assert isinstance(_json.load(r), list)
     finally:
         dash.shutdown()
 
