@@ -15,6 +15,8 @@ _ID_SIZE = 16
 
 _NIL = b"\x00" * _ID_SIZE
 
+_rand_local = threading.local()
+
 
 class BaseId:
     __slots__ = ("_bytes",)
@@ -27,7 +29,17 @@ class BaseId:
 
     @classmethod
     def from_random(cls):
-        return cls(os.urandom(_ID_SIZE))
+        # os.urandom is a syscall (~100us under load): batch a page of
+        # entropy per thread and slice ids from it (task-heavy drivers
+        # mint thousands of ids per second)
+        local = _rand_local
+        buf = getattr(local, "buf", b"")
+        pos = getattr(local, "pos", 0)
+        if pos + _ID_SIZE > len(buf):
+            buf = local.buf = os.urandom(_ID_SIZE * 256)
+            pos = local.pos = 0
+        local.pos = pos + _ID_SIZE
+        return cls(buf[pos:pos + _ID_SIZE])
 
     @classmethod
     def nil(cls):

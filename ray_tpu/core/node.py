@@ -220,6 +220,16 @@ class Node:
                             active -= 1
                     if active < self._max_workers or not self._workers:
                         self._start_worker()
+                    elif not self._idle:
+                        # no idle worker of ANY env and no room to start
+                        # one: nothing later in the queue is grantable
+                        # either — stop scanning. Without this, every
+                        # lease/release event walked the whole backlog
+                        # (O(queue^2) across a burst; the first casualty
+                        # of the 10k-task envelope).
+                        remaining.extend(self._lease_queue)
+                        self._lease_queue.clear()
+                        break
                     continue
                 self._take_resources(req)
                 worker.env_hash = req.env_hash  # dedicate on first grant

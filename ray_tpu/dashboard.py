@@ -78,7 +78,9 @@ class _MetricsSampler:
         while not self._stop.wait(2.0):
             try:
                 s = state_api.summary()
-                stores = state_api.object_store_stats()
+                stores = s.get("object_store", {})  # summary() already
+                # computed this; a second call would double the per-node
+                # RPC load on remote clusters
                 if isinstance(stores, dict):
                     stores = list(stores.values())
                 used = sum(st.get("used_bytes", st.get("used", 0))
@@ -243,6 +245,7 @@ class Dashboard:
         if self._sampler is not None:
             self._sampler.stop()
         self._server.shutdown()
+        self._server.server_close()  # release the listening fd
 
 
 _dashboard: Optional[Dashboard] = None

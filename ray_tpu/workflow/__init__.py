@@ -307,15 +307,25 @@ class _Executor:
                 results[k] = v
         threads = []
         inline = []
+        step_children = [p for p in pending
+                         if not isinstance(p[1], EventNode)]
         for idx, item in enumerate(pending):
-            if idx < len(pending) - 1 \
+            if isinstance(item[1], EventNode):
+                # event waits ALWAYS get their own (unpermitted) thread: a
+                # wait parked inline or holding a permit for its whole
+                # (possibly unbounded) duration would serialize against —
+                # or starve — the sibling steps that trigger the event
+                t = threading.Thread(target=resolve, args=item, daemon=True)
+                threads.append(t)
+                t.start()
+            elif item is not step_children[-1] \
                     and self._thread_permits.acquire(blocking=False):
                 t = threading.Thread(target=resolve_permitted, args=item,
                                      daemon=True)
                 threads.append(t)
                 t.start()
             else:
-                # no permit (or last child): run on this thread — always
+                # no permit (or last step child): run on this thread —
                 # at least one child makes progress without a new thread
                 inline.append(item)
         for item in inline:
