@@ -1,0 +1,50 @@
+"""PPO on the Breakout-shaped pixels pipeline — the BASELINE 'PPO Atari
+Breakout' configuration: 84x84x4 uint8 observations through the Atari
+wrappers (WarpFrame grayscale+resize, FrameStack), a NatureCNN policy on
+the learner, numpy conv inference in the rollout actors. This image
+ships no ALE/ROMs, so BreakoutShapedVecEnv (native 210x160x3 frames,
+Breakout's NOOP/FIRE/RIGHT/LEFT action set, paddle-intercepts-ball
+dynamics) stands in; swap the env name for a registered ALE VectorEnv to
+run the real ROMs."""
+import argparse
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib import PPOConfig
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--target", type=float, default=3.0)  # catches/episode
+    args = ap.parse_args()
+    ray_tpu.init(num_cpus=max(4, args.workers + 2),
+                 ignore_reinit_error=True)
+    algo = (PPOConfig(hidden=(512,))
+            .environment("BreakoutShaped-v0")
+            .rollouts(num_rollout_workers=args.workers,
+                      num_envs_per_worker=4,
+                      rollout_fragment_length=64)
+            .training(lr=2.5e-4, entropy_coeff=0.01,
+                      sgd_minibatch_size=128, num_sgd_epochs=2)
+            .build())
+    try:
+        best = float("-inf")
+        for _ in range(args.iters):
+            r = algo.train()
+            if np.isfinite(r["episode_reward_mean"]):
+                best = max(best, r["episode_reward_mean"])
+            print(f"iter {r['training_iteration']:3d} "
+                  f"reward={r['episode_reward_mean']:6.2f} "
+                  f"steps/s={r['env_steps_per_sec']:,.0f}")
+            if best >= args.target:
+                break
+        print("best reward:", best)
+    finally:
+        algo.stop()
+
+
+if __name__ == "__main__":
+    main()

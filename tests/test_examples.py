@@ -46,3 +46,9 @@ def test_llama_serve_example():
 def test_vit_pbt_example():
     out = _run("vit_pbt_sweep.py", "--population", "2", timeout=300)
     assert "best lr:" in out
+
+
+def test_ppo_breakout_example():
+    out = _run("ppo_breakout.py", "--workers", "1", "--iters", "1",
+               "--target", "-1")
+    assert "best reward:" in out
