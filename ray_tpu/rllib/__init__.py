@@ -25,6 +25,10 @@ _EXPORTS = {
     "BC": "offline", "BCConfig": "offline",
     "collect_experiences": "offline", "read_experiences": "offline",
     "write_experiences": "offline",
+    "MultiAgentPPO": "multi_agent", "MultiAgentPPOConfig": "multi_agent",
+    "MultiAgentVecEnv": "multi_agent", "CoordinationVecEnv": "multi_agent",
+    "make_multi_agent_env": "multi_agent",
+    "register_multi_agent_env": "multi_agent",
     "ReplayBuffer": "replay_buffer",
     "PrioritizedReplayBuffer": "replay_buffer",
     "CartPoleVecEnv": "env", "PendulumVecEnv": "env", "VectorEnv": "env",

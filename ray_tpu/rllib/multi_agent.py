@@ -1,0 +1,373 @@
+"""Multi-agent RL — per-agent policies over a shared environment.
+
+ref: rllib/env/multi_agent_env.py (dict-keyed obs/rewards per agent),
+rllib/policy/policy_map.py + algorithm_config.multi_agent(policies=...,
+policy_mapping_fn=...) — the reference's core multi-agent contract:
+each agent id maps to a policy id; trajectories route to the mapped
+policy's learner; policies train independently on their own batches.
+
+Vectorized natively like the rest of this rllib: a MultiAgentVecEnv
+steps n env copies at once with {agent_id: [n, obs_dim]} observation
+dicts, rollout workers collect per-agent fragments with numpy policy
+inference, and each policy's learner is the SAME fused-scan PPO learner
+single-agent training uses (learner.py) — multi-agent is a routing
+layer, not a new optimizer.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+import numpy as np
+
+import ray_tpu
+
+from . import sample_batch as sb
+from .learner import PPOLearner
+from .np_policy import ensure_numpy, sample_actions
+from .rollout_worker import EnvWorkerBase, worker_opts
+
+
+class MultiAgentVecEnv:
+    """n copies of a multi-agent env stepped as one batch.
+
+    Contract (the vectorized form of ref multi_agent_env.py):
+      agent_ids: fixed tuple of agent ids (all active every step)
+      reset()  -> {agent_id: [n, obs_dim]}
+      step({agent_id: [n] actions})
+               -> (obs_dict, {agent_id: [n] rewards}, [n] dones, info)
+    Sub-envs auto-reset on done.
+    """
+
+    num_envs: int
+    obs_dim: int
+    num_actions: int
+    agent_ids: tuple = ()
+
+    def reset(self, seed: Optional[int] = None) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def step(self, actions: Dict[str, np.ndarray]):
+        raise NotImplementedError
+
+
+class CoordinationVecEnv(MultiAgentVecEnv):
+    """Two-agent repeated coordination game with observations: each agent
+    sees both agents' previous actions (one-hot) and must learn to pick
+    the SAME arm as its partner (+1 each when matched, 0 otherwise);
+    episodes last 25 rounds. A pure-conflict-free game both independent
+    learners solve quickly — the multi-agent analog of CartPole for
+    tests (ref test model: rllib's rock_paper_scissors / two-step-game
+    examples)."""
+
+    EPISODE_LEN = 25
+    ARMS = 3
+
+    agent_ids = ("a0", "a1")
+
+    def __init__(self, num_envs: int = 8, seed: int = 0):
+        self.num_envs = num_envs
+        self.num_actions = self.ARMS
+        self.obs_dim = 2 * self.ARMS  # one-hot prev action of both agents
+        self._rng = np.random.default_rng(seed)
+        self._prev = np.zeros((num_envs, 2), np.int64)
+        self._t = np.zeros(num_envs, np.int64)
+
+    def _obs(self) -> Dict[str, np.ndarray]:
+        eye = np.eye(self.ARMS, dtype=np.float32)
+        both = np.concatenate([eye[self._prev[:, 0]],
+                               eye[self._prev[:, 1]]], axis=1)
+        # each agent sees (own prev, partner prev) in its own order
+        own_first = np.concatenate([eye[self._prev[:, 1]],
+                                    eye[self._prev[:, 0]]], axis=1)
+        return {"a0": both, "a1": own_first}
+
+    def reset(self, seed: Optional[int] = None) -> Dict[str, np.ndarray]:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._prev = self._rng.integers(0, self.ARMS, (self.num_envs, 2))
+        self._t[:] = 0
+        return self._obs()
+
+    def step(self, actions: Dict[str, np.ndarray]):
+        a0 = np.asarray(actions["a0"])
+        a1 = np.asarray(actions["a1"])
+        match = (a0 == a1).astype(np.float32)
+        rewards = {"a0": match.copy(), "a1": match.copy()}
+        self._prev = np.stack([a0, a1], axis=1)
+        self._t += 1
+        done = self._t >= self.EPISODE_LEN
+        info = {}
+        if done.any():
+            # the 25-round cap is a TIME LIMIT, not termination: hand the
+            # pre-reset obs out so samplers bootstrap V(s_final)
+            info["truncated"] = done.copy()
+            info["final_obs"] = self._obs()
+            idx = np.nonzero(done)[0]
+            self._prev[idx] = self._rng.integers(0, self.ARMS,
+                                                 (len(idx), 2))
+            self._t[idx] = 0
+        return self._obs(), rewards, done, info
+
+
+_MA_REGISTRY: Dict[str, Callable[..., MultiAgentVecEnv]] = {
+    "Coordination-v0": CoordinationVecEnv,
+}
+
+
+def register_multi_agent_env(name: str, creator) -> None:
+    _MA_REGISTRY[name] = creator
+
+
+def make_multi_agent_env(name: str, num_envs: int = 8,
+                         seed: int = 0) -> MultiAgentVecEnv:
+    if name not in _MA_REGISTRY:
+        raise ValueError(f"Unknown multi-agent env {name!r}")
+    return _MA_REGISTRY[name](num_envs=num_envs, seed=seed)
+
+
+class MultiAgentRolloutWorker(EnvWorkerBase):
+    """Samples all agents in lockstep; emits one train batch PER POLICY
+    (trajectories of every agent mapped to it, concatenated), with GAE
+    computed per agent so advantages never mix across policies."""
+
+    def __init__(self, env_name: str, num_envs: int, rollout_len: int,
+                 gamma: float, lam: float, mapping_blob: bytes,
+                 seed: int = 0, env_creator=None):
+        # EnvWorkerBase builds single-agent envs; construct ours here but
+        # reuse its episode-return bookkeeping fields/methods
+        self.env = (cloudpickle.loads(env_creator)(num_envs=num_envs,
+                                                   seed=seed)
+                    if env_creator else
+                    make_multi_agent_env(env_name, num_envs, seed))
+        self.rollout_len = rollout_len
+        self._rng = np.random.default_rng(seed + 1)
+        self._obs = self.env.reset(seed=seed)
+        self.gamma = gamma
+        self.lam = lam
+        self.mapping = cloudpickle.loads(mapping_blob)
+        self._ep_return = np.zeros(self.env.num_envs, np.float64)
+        self._finished_returns: list = []
+
+    def env_info(self) -> dict:
+        return {"obs_dim": self.env.obs_dim,
+                "obs_shape": (self.env.obs_dim,),
+                "num_actions": self.env.num_actions,
+                "num_envs": self.env.num_envs,
+                "agent_ids": tuple(self.env.agent_ids)}
+
+    def sample(self, policy_params: Dict[str, Dict]
+               ) -> Dict[str, sb.Batch]:
+        params = {pid: ensure_numpy(p) for pid, p in policy_params.items()}
+        T, n = self.rollout_len, self.env.num_envs
+        agents = list(self.env.agent_ids)
+        buf = {a: {"obs": np.empty((T, n, self.env.obs_dim), np.float32),
+                   "act": np.empty((T, n), np.int64),
+                   "logp": np.empty((T, n), np.float32),
+                   "val": np.empty((T, n), np.float32),
+                   "rew": np.empty((T, n), np.float32)}
+               for a in agents}
+        done_buf = np.empty((T, n), np.bool_)
+        obs = self._obs
+        for t in range(T):
+            acts: Dict[str, np.ndarray] = {}
+            for a in agents:
+                p = params[self.mapping(a)]
+                actions, logp, values = sample_actions(p, obs[a], self._rng)
+                b = buf[a]
+                b["obs"][t], b["act"][t] = obs[a], actions
+                b["logp"][t], b["val"][t] = logp, values
+                acts[a] = actions
+            obs, rewards, done, info = self.env.step(acts)
+            for a in agents:
+                buf[a]["rew"][t] = rewards[a]
+            if done.any() and "truncated" in info:
+                # time-limit truncation is not termination: fold
+                # gamma*V(s_final) into each agent's reward so GAE's
+                # done-cut doesn't zero a bootstrap that should exist
+                # (the rollout_worker.py:94 recipe, per agent)
+                trunc = np.asarray(info["truncated"])
+                if trunc.any():
+                    idx = np.nonzero(trunc)[0]
+                    for a in agents:
+                        p = params[self.mapping(a)]
+                        fo = info["final_obs"][a][idx]
+                        _, _, v_final = sample_actions(p, fo, self._rng)
+                        buf[a]["rew"][t, idx] += self.gamma * v_final
+            done_buf[t] = done
+            # per-env sum over agents is the tracked episode return
+            step_rew = sum(np.asarray(rewards[a], np.float64)
+                           for a in agents)
+            self._track_returns(step_rew.astype(np.float32), done)
+        self._obs = obs
+        # per-agent GAE with each agent's own value stream
+        out: Dict[str, List[sb.Batch]] = {}
+        for a in agents:
+            p = params[self.mapping(a)]
+            _, _, last_values = sample_actions(p, obs[a], self._rng)
+            b = buf[a]
+            adv, ret = sb.compute_gae(b["rew"], b["val"], done_buf,
+                                      last_values, self.gamma, self.lam)
+            flat = lambda x: x.reshape(T * n, *x.shape[2:])  # noqa: E731
+            batch = {sb.OBS: flat(b["obs"]), sb.ACTIONS: flat(b["act"]),
+                     sb.LOGP: flat(b["logp"]), sb.VALUES: flat(b["val"]),
+                     sb.REWARDS: flat(b["rew"]),
+                     sb.DONES: flat(done_buf.copy()),
+                     sb.ADVANTAGES: flat(adv), sb.RETURNS: flat(ret)}
+            out.setdefault(self.mapping(a), []).append(batch)
+        return {pid: sb.concat(batches) for pid, batches in out.items()}
+
+
+@dataclass
+class MultiAgentPPOConfig:
+    """ref: algorithm_config.multi_agent(policies, policy_mapping_fn).
+    policies: policy ids (params/learner per id); None -> one shared
+    policy ("default") for every agent."""
+    env: str = "Coordination-v0"
+    env_creator: Optional[Callable] = None
+    policies: Optional[List[str]] = None
+    policy_mapping_fn: Optional[Callable[[str], str]] = None
+    num_rollout_workers: int = 2
+    num_envs_per_worker: int = 8
+    rollout_fragment_length: int = 64
+    gamma: float = 0.99
+    lam: float = 0.95
+    lr: float = 3e-4
+    clip_param: float = 0.2
+    vf_loss_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    sgd_minibatch_size: int = 256
+    num_sgd_epochs: int = 4
+    hidden: tuple = (64, 64)
+    seed: int = 0
+    worker_resources: Dict[str, float] = field(default_factory=dict)
+
+    def build(self) -> "MultiAgentPPO":
+        return MultiAgentPPO(self)
+
+
+class MultiAgentPPO:
+    """One PPOLearner per policy id; rollout workers route each agent's
+    trajectories to its mapped policy. Tune-trainable shaped."""
+
+    def __init__(self, config: MultiAgentPPOConfig):
+        self.config = c = config
+        probe = (c.env_creator(num_envs=1, seed=c.seed) if c.env_creator
+                 else make_multi_agent_env(c.env, 1, c.seed))
+        agent_ids = tuple(probe.agent_ids)
+        if c.policies is None:
+            policies = ["default"]
+            mapping = (lambda agent_id: "default")
+        else:
+            policies = list(c.policies)
+            mapping = c.policy_mapping_fn
+            if mapping is None:
+                raise ValueError(
+                    "policies given without policy_mapping_fn")
+        for a in agent_ids:
+            pid = mapping(a)
+            if pid not in policies:
+                raise ValueError(
+                    f"policy_mapping_fn({a!r}) -> {pid!r} not in "
+                    f"policies {policies}")
+        self.policy_ids = policies
+        self.mapping = mapping
+        mapping_blob = cloudpickle.dumps(mapping)
+        creator_blob = (cloudpickle.dumps(c.env_creator)
+                        if c.env_creator else None)
+        worker_cls = ray_tpu.remote(MultiAgentRolloutWorker)
+        opts = worker_opts(c.worker_resources)
+        self.workers = [
+            worker_cls.options(**opts).remote(
+                c.env, c.num_envs_per_worker, c.rollout_fragment_length,
+                c.gamma, c.lam, mapping_blob, seed=c.seed + 1000 * i,
+                env_creator=creator_blob)
+            for i in range(c.num_rollout_workers)]
+        info = ray_tpu.get(self.workers[0].env_info.remote(), timeout=60)
+        self._num_agents = max(1, len(info.get("agent_ids", ())))
+        self.learners: Dict[str, PPOLearner] = {
+            pid: PPOLearner(
+                info["obs_dim"], info["num_actions"], lr=c.lr,
+                clip=c.clip_param, vf_coeff=c.vf_loss_coeff,
+                ent_coeff=c.entropy_coeff,
+                minibatch_size=c.sgd_minibatch_size,
+                num_epochs=c.num_sgd_epochs, hidden=c.hidden,
+                seed=c.seed + 31 * i)
+            for i, pid in enumerate(policies)}
+        self._iteration = 0
+        self._total_steps = 0
+        self._recent: List[float] = []
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.monotonic()
+        params_ref = ray_tpu.put(
+            {pid: ln.get_params() for pid, ln in self.learners.items()})
+        results = ray_tpu.get(
+            [w.sample.remote(params_ref) for w in self.workers],
+            timeout=300)
+        sample_time = time.monotonic() - t0
+        t1 = time.monotonic()
+        stats: Dict[str, Any] = {}
+        steps = 0
+        for pid in self.policy_ids:
+            batches = [r[pid] for r in results if pid in r]
+            if not batches:
+                continue
+            batch = sb.concat(batches)
+            steps += sb.num_steps(batch)
+            for k, v in self.learners[pid].update(batch).items():
+                stats[f"{pid}/{k}"] = v
+        learn_time = time.monotonic() - t1
+        for rets in ray_tpu.get(
+                [w.episode_returns.remote() for w in self.workers],
+                timeout=60):
+            self._recent.extend(rets)
+        self._recent = self._recent[-100:]
+        self._iteration += 1
+        # `steps` summed per-policy batch rows = AGENT steps; report env
+        # steps under the shared field names so budgets/throughput stay
+        # comparable with the single-agent algorithms
+        env_steps = steps // self._num_agents
+        self._total_steps += env_steps
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_total": self._total_steps,
+            "timesteps_this_iter": env_steps,
+            "agent_steps_this_iter": steps,
+            "episode_reward_mean": (float(np.mean(self._recent))
+                                    if self._recent else float("nan")),
+            "env_steps_per_sec": env_steps / max(
+                1e-9, sample_time + learn_time),
+            **stats,
+        }
+
+    def save(self) -> Dict:
+        import jax
+
+        return {"policies": {pid: {
+                    "params": jax.device_get(ln.params),
+                    "opt_state": jax.device_get(ln.opt_state)}
+                for pid, ln in self.learners.items()},
+                "iteration": self._iteration,
+                "total_steps": self._total_steps}
+
+    def restore(self, ckpt: Dict) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        for pid, st in ckpt["policies"].items():
+            ln = self.learners[pid]
+            ln.params = {k: jnp.asarray(v)
+                         for k, v in st["params"].items()}
+            ln.opt_state = jax.tree.map(jnp.asarray, st["opt_state"])
+        self._iteration = int(ckpt.get("iteration", 0))
+        self._total_steps = int(ckpt.get("total_steps", 0))
+
+    def stop(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
