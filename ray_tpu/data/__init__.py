@@ -9,12 +9,14 @@ from .context import DataContext
 from .dataset import (ActorPoolStrategy, Dataset, GroupedDataset,
                       from_arrow, from_blocks, from_items, from_numpy, range, read_csv,
                       read_images, read_json, read_numpy,
-                      read_parquet, read_tfrecords)
+                      read_parquet, read_sql, read_tfrecords)
+from .pipeline import DatasetPipeline
 from .iterator import DataShard
 
 __all__ = [
     "ActorPoolStrategy", "Block", "DataContext", "DataShard", "Dataset",
     "GroupedDataset", "from_arrow", "from_blocks", "from_items", "from_numpy", "range",
+    "DatasetPipeline",
     "read_csv", "read_images", "read_json", "read_numpy",
-    "read_parquet", "read_tfrecords",
+    "read_parquet", "read_sql", "read_tfrecords",
 ]

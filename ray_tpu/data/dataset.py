@@ -274,6 +274,21 @@ class Dataset:
     def split(self, n: int, **kw) -> List[DataShard]:
         return self.split_shards(n, **kw)
 
+    def window(self, *, blocks_per_window: int = 10):
+        """-> DatasetPipeline of windows over the source read tasks
+        (ref: dataset.py window / dataset_pipeline.py): one window's
+        blocks live at a time."""
+        from .pipeline import window_dataset
+
+        return window_dataset(self, blocks_per_window=blocks_per_window)
+
+    def repeat(self, times: Optional[int] = None):
+        """-> DatasetPipeline cycling this dataset (epochs; re-reads
+        from source each pass)."""
+        from .pipeline import repeat_dataset
+
+        return repeat_dataset(self, times)
+
     def __repr__(self):
         names = [getattr(op, "name", op.__class__.__name__)
                  for op in self._ops]
@@ -480,6 +495,43 @@ def read_images(paths: Union[str, List[str]], *,
         _file_read_fns(paths, reader,
                        (".png", ".jpg", ".jpeg", ".bmp", ".gif")),
         "read_images")
+
+
+def read_sql(sql: str, connection_factory: Union[str, Callable], *,
+             parallelism: int = 1, **kw) -> Dataset:
+    """SQL query -> Dataset (ref: python/ray/data/read_api.py read_sql).
+    connection_factory: a zero-arg callable returning a DB-API 2.0
+    connection, or a string path treated as a sqlite3 database file.
+    parallelism > 1 shards the query rows round-robin into that many
+    blocks (each read task re-runs the query and keeps its slice — the
+    portable strategy when the dialect lacks OFFSET pushdown)."""
+    if isinstance(connection_factory, str):
+        db_path = connection_factory
+
+        def connection_factory():  # noqa: F811 — intentional rebind
+            import sqlite3
+
+            return sqlite3.connect(db_path)
+
+    conn_blob = cloudpickle.dumps(connection_factory)
+
+    def read_shard(shard: int, nshards: int) -> Block:
+        factory = cloudpickle.loads(conn_blob)
+        conn = factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(sql)
+            cols = [d[0] for d in cur.description]
+            # iterate the cursor: fetchall() would hold the FULL result
+            # in every shard task simultaneously (nshards x table memory)
+            rows = [r for i, r in enumerate(cur) if i % nshards == shard]
+        finally:
+            conn.close()
+        return block_from_items([dict(zip(cols, r)) for r in rows])
+
+    n = max(1, int(parallelism))
+    fns = [lambda s=s: read_shard(s, n) for s in builtins.range(n)]
+    return _make_dataset(fns, "read_sql")
 
 
 def read_tfrecords(paths: Union[str, List[str]], **kw) -> Dataset:

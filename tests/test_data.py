@@ -391,3 +391,95 @@ def test_tfrecords_into_train_ingest(tmp_path, cluster):
     # rank 0's shard is exactly half the 40 rows; an equal split with
     # no duplication is the sharding contract under test
     assert res.metrics_history[-1]["n"] == 20
+
+
+class TestDatasetPipeline:
+    def test_window_streams_one_window_at_a_time(self, cluster):
+        import ray_tpu.data as rd
+
+        ds = rd.range(100, parallelism=10)
+        pipe = ds.window(blocks_per_window=2)
+        assert pipe.length == 5
+        total = sorted(v for b in pipe.iter_batches(batch_size=None)
+                       for v in b["id"])
+        assert total == list(range(100))
+
+    def test_window_with_transforms_and_count(self, cluster):
+        import ray_tpu.data as rd
+
+        pipe = (rd.range(60, parallelism=6)
+                .map_batches(lambda b: {"id": b["id"] * 2})
+                .window(blocks_per_window=2)
+                .filter(lambda r: r["id"] % 4 == 0))
+        vals = sorted(r["id"] for r in pipe.iter_rows())
+        assert vals == [v for v in range(0, 120, 2) if v % 4 == 0]
+
+    def test_repeat_epochs(self, cluster):
+        import ray_tpu.data as rd
+
+        pipe = rd.range(10, parallelism=2).repeat(3)
+        assert pipe.length == 3
+        rows = [r["id"] for r in pipe.iter_rows()]
+        assert len(rows) == 30 and sorted(set(rows)) == list(range(10))
+
+    def test_infinite_repeat_take(self, cluster):
+        import ray_tpu.data as rd
+
+        pipe = rd.range(4, parallelism=1).repeat()
+        assert pipe.length is None
+        rows = pipe.take(11)
+        assert len(rows) == 11
+
+    def test_split_for_workers(self, cluster):
+        import ray_tpu.data as rd
+
+        pipe = rd.range(40, parallelism=8).window(blocks_per_window=2)
+        parts = pipe.split(2)
+        a = sorted(r["id"] for r in parts[0].iter_rows())
+        b = sorted(r["id"] for r in parts[1].iter_rows())
+        assert not (set(a) & set(b))
+        assert sorted(a + b) == list(range(40))
+
+    def test_windowed_shuffle_then_repeat(self, cluster):
+        import ray_tpu.data as rd
+
+        pipe = (rd.range(20, parallelism=4).window(blocks_per_window=2)
+                .random_shuffle(seed=0).repeat(2))
+        rows = [r["id"] for r in pipe.iter_rows()]
+        assert len(rows) == 40
+
+
+def test_read_sql_sqlite(tmp_path, cluster):
+    import sqlite3
+
+    import ray_tpu.data as rd
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE metrics (step INTEGER, loss REAL)")
+    conn.executemany("INSERT INTO metrics VALUES (?, ?)",
+                     [(i, 10.0 / (i + 1)) for i in range(50)])
+    conn.commit()
+    conn.close()
+
+    ds = rd.read_sql("SELECT step, loss FROM metrics WHERE step < 30",
+                     db, parallelism=3)
+    batches = list(ds.iter_batches(batch_size=None))
+    steps = sorted(int(s) for b in batches for s in b["step"])
+    assert steps == list(range(30))
+    assert len(batches) == 3  # sharded into `parallelism` blocks
+
+
+def test_window_rejects_global_ops_and_limit(cluster):
+    import pytest as _pytest
+
+    import ray_tpu.data as rd
+
+    with _pytest.raises(ValueError):
+        rd.range(10, parallelism=5).sort("id").window(blocks_per_window=2)
+    with _pytest.raises(ValueError):
+        rd.range(10, parallelism=5).limit(5).window(blocks_per_window=2)
+    # per-window shuffle AFTER windowing is the supported spelling
+    pipe = rd.range(10, parallelism=5).window(
+        blocks_per_window=2).random_shuffle(seed=0)
+    assert sorted(r["id"] for r in pipe.iter_rows()) == list(range(10))
