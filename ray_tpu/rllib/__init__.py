@@ -25,6 +25,8 @@ _EXPORTS = {
     "BC": "offline", "BCConfig": "offline",
     "collect_experiences": "offline", "read_experiences": "offline",
     "write_experiences": "offline",
+    "MeanStdFilter": "connectors", "RunningStat": "connectors",
+    "make_connector": "connectors",
     "MultiAgentPPO": "multi_agent", "MultiAgentPPOConfig": "multi_agent",
     "MultiAgentVecEnv": "multi_agent", "CoordinationVecEnv": "multi_agent",
     "make_multi_agent_env": "multi_agent",

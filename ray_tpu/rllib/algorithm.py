@@ -18,6 +18,7 @@ import numpy as np
 import ray_tpu
 
 from . import sample_batch as sb
+from .connectors import NoFilter, make_connector, merge_deltas
 from .learner import PPOLearner
 from .rollout_worker import RolloutWorker, worker_opts
 
@@ -39,6 +40,10 @@ class PPOConfig:
     sgd_minibatch_size: int = 256
     num_sgd_epochs: int = 4
     hidden: tuple = (64, 64)
+    # "NoFilter" | "MeanStd": running obs normalization applied in the
+    # rollout workers, stats merged across workers each iteration
+    # (ref: rllib/utils/filter.py + filter_manager.py via connectors)
+    observation_filter: str = "NoFilter"
     seed: int = 0
     worker_resources: Dict[str, float] = field(default_factory=dict)
 
@@ -91,10 +96,14 @@ class PPO:
             worker_cls.options(**opts).remote(
                 c.env, c.num_envs_per_worker, c.rollout_fragment_length,
                 c.gamma, c.lam, seed=c.seed + 1000 * i,
-                env_creator=creator_blob)
+                env_creator=creator_blob,
+                observation_filter=c.observation_filter)
             for i in range(c.num_rollout_workers)
         ]
         info = ray_tpu.get(self.workers[0].env_info.remote(), timeout=60)
+        self.obs_filter = make_connector(
+            c.observation_filter,
+            info.get("obs_shape", (info["obs_dim"],)))
         self.learner = PPOLearner(
             info.get("obs_shape", info["obs_dim"]), info["num_actions"],
             lr=c.lr,
@@ -117,6 +126,17 @@ class PPO:
         t1 = time.monotonic()
         stats = self.learner.update(batch)
         learn_time = time.monotonic() - t1
+        # merge worker filter deltas AFTER the update (the batch already
+        # holds filtered obs, so nothing here depends on the merge) and
+        # broadcast without blocking: per-actor ordering guarantees
+        # sync_filter lands before the next sample.remote
+        if not isinstance(self.obs_filter, NoFilter):
+            deltas = ray_tpu.get(
+                [w.filter_delta.remote() for w in self.workers],
+                timeout=60)
+            state = merge_deltas(self.obs_filter, deltas)
+            for w in self.workers:
+                w.sync_filter.remote(state)
         for rets in ray_tpu.get(
                 [w.episode_returns.remote() for w in self.workers],
                 timeout=60):
@@ -145,10 +165,15 @@ class PPO:
     def save(self) -> Dict:
         import jax
 
-        return {"params": jax.device_get(self.learner.params),
+        ckpt = {"params": jax.device_get(self.learner.params),
                 "opt_state": jax.device_get(self.learner.opt_state),
                 "iteration": self._iteration,
                 "total_steps": self._total_steps}
+        if not isinstance(self.obs_filter, NoFilter):
+            # without the filter stats a restored policy would see raw
+            # (unnormalized) obs until the filter re-converged
+            ckpt["obs_filter"] = self.obs_filter.state()
+        return ckpt
 
     def restore(self, ckpt: Dict) -> None:
         import jax
@@ -164,6 +189,11 @@ class PPO:
                 self.learner.params)
         self._iteration = int(ckpt.get("iteration", 0))
         self._total_steps = int(ckpt.get("total_steps", 0))
+        if "obs_filter" in ckpt and not isinstance(self.obs_filter,
+                                                   NoFilter):
+            self.obs_filter.set_state(ckpt["obs_filter"])
+            ray_tpu.get([w.sync_filter.remote(ckpt["obs_filter"])
+                         for w in self.workers], timeout=60)
 
     def stop(self) -> None:
         for w in self.workers:

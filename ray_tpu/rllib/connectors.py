@@ -1,0 +1,151 @@
+"""Connectors — observation/action transformation attached to policies.
+
+ref: rllib/connectors/ (agent/obs pipelines synced rollout<->learner) and
+rllib/utils/filter.py MeanStdFilter + filter_manager.py (the running
+observation normalizer whose statistics merge across rollout workers
+every iteration). The protocol here mirrors the reference's:
+
+- workers apply the connector to observations AT COLLECTION TIME, so
+  train batches already hold transformed obs and the learner needs no
+  separate path;
+- each worker accumulates statistics locally during sampling;
+- the algorithm merges worker deltas after each iteration and broadcasts
+  the merged state back, so all workers (and evaluation) share one
+  filter.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class RunningStat:
+    """Mergeable running mean/variance (Chan et al. parallel variance —
+    ref: rllib/utils/filter.py RunningStat)."""
+
+    def __init__(self, shape):
+        self.n = 0
+        self.mean = np.zeros(shape, np.float64)
+        self.m2 = np.zeros(shape, np.float64)
+
+    def push_batch(self, x: np.ndarray) -> None:
+        x = np.asarray(x, np.float64).reshape(-1, *self.mean.shape)
+        k = len(x)
+        if k == 0:
+            return
+        bmean = x.mean(axis=0)
+        bm2 = ((x - bmean) ** 2).sum(axis=0)
+        self._merge(k, bmean, bm2)
+
+    def _merge(self, n2, mean2, m22) -> None:
+        n1 = self.n
+        if n2 == 0:
+            return
+        delta = mean2 - self.mean
+        n = n1 + n2
+        self.mean = self.mean + delta * (n2 / n)
+        self.m2 = self.m2 + m22 + delta ** 2 * (n1 * n2 / n)
+        self.n = n
+
+    def merge(self, other: "RunningStat") -> None:
+        self._merge(other.n, other.mean, other.m2)
+
+    @property
+    def std(self) -> np.ndarray:
+        var = self.m2 / self.n if self.n > 1 else np.ones_like(self.m2)
+        return np.sqrt(np.maximum(var, 1e-8))
+
+    def state(self) -> Dict[str, Any]:
+        return {"n": self.n, "mean": self.mean.copy(),
+                "m2": self.m2.copy()}
+
+    def set_state(self, s: Dict[str, Any]) -> None:
+        self.n = int(s["n"])
+        self.mean = np.asarray(s["mean"], np.float64).copy()
+        self.m2 = np.asarray(s["m2"], np.float64).copy()
+
+
+class Connector:
+    """Base: __call__ transforms an obs batch; stats sync via
+    state/set_state/delta/apply_delta."""
+
+    def __call__(self, obs: np.ndarray, update: bool = True) -> np.ndarray:
+        return obs
+
+    def state(self) -> Dict[str, Any]:
+        return {}
+
+    def set_state(self, s: Dict[str, Any]) -> None:
+        pass
+
+    def delta(self) -> Dict[str, Any]:
+        """State accumulated since the last set_state (for merging)."""
+        return {}
+
+
+class NoFilter(Connector):
+    pass
+
+
+class MeanStdFilter(Connector):
+    """Normalize observations by running mean/std (ref: filter.py
+    MeanStdFilter). `update=False` (evaluation) transforms without
+    accumulating."""
+
+    def __init__(self, shape):
+        self.rs = RunningStat(shape)
+        self._base = RunningStat(shape)  # snapshot at last sync
+
+    def __call__(self, obs: np.ndarray, update: bool = True) -> np.ndarray:
+        if update:
+            self.rs.push_batch(obs)
+        if self.rs.n < 2:
+            return np.asarray(obs, np.float32)
+        return ((obs - self.rs.mean) / self.rs.std).astype(np.float32)
+
+    def state(self) -> Dict[str, Any]:
+        return self.rs.state()
+
+    def set_state(self, s: Dict[str, Any]) -> None:
+        self.rs.set_state(s)
+        self._base.set_state(s)
+
+    def delta(self) -> Dict[str, Any]:
+        """The observations THIS worker saw since the last broadcast:
+        subtract the base snapshot by merging counts."""
+        # n_delta = n - n_base; mean/m2 deltas via reverse merge
+        n_b, n_t = self._base.n, self.rs.n
+        n_d = n_t - n_b
+        if n_d <= 0:
+            return {"n": 0, "mean": np.zeros_like(self.rs.mean),
+                    "m2": np.zeros_like(self.rs.m2)}
+        mean_d = (self.rs.mean * n_t - self._base.mean * n_b) / n_d
+        delta = mean_d - self._base.mean
+        m2_d = (self.rs.m2 - self._base.m2
+                - delta ** 2 * (n_b * n_d / max(n_t, 1)))
+        return {"n": n_d, "mean": mean_d, "m2": np.maximum(m2_d, 0.0)}
+
+
+def make_connector(kind: str, shape) -> Connector:
+    if kind in (None, "NoFilter", "no_filter", ""):
+        return NoFilter()
+    if kind in ("MeanStd", "MeanStdFilter"):
+        return MeanStdFilter(shape)
+    raise ValueError(f"unknown observation_filter {kind!r}")
+
+
+def merge_deltas(central: Connector, deltas: List[Dict[str, Any]]
+                 ) -> Dict[str, Any]:
+    """Fold worker deltas into the central connector; returns the new
+    broadcastable state (ref: filter_manager.py synchronize)."""
+    if isinstance(central, MeanStdFilter):
+        for d in deltas:
+            if d and d.get("n", 0) > 0:
+                rs = RunningStat(central.rs.mean.shape)
+                rs.set_state(d)
+                central.rs.merge(rs)
+        state = central.rs.state()
+        central._base.set_state(state)
+        return state
+    return {}

@@ -12,6 +12,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from . import sample_batch as sb
+from .connectors import NoFilter, make_connector
 from .env import make_env
 from .np_policy import ensure_numpy, sample_actions
 
@@ -70,15 +71,29 @@ class EnvWorkerBase:
 class RolloutWorker(EnvWorkerBase):
     def __init__(self, env_name: str, num_envs: int, rollout_len: int,
                  gamma: float, lam: float, seed: int = 0,
-                 env_creator=None):
+                 env_creator=None, observation_filter: str = "NoFilter"):
         super().__init__(env_name, num_envs, rollout_len, seed, env_creator)
         self.gamma = gamma
         self.lam = lam
+        self.filter = make_connector(observation_filter,
+                                     self.env.obs_shape)
+
+    def filter_delta(self):
+        """Stats accumulated since the last sync (merged centrally)."""
+        return self.filter.delta()
+
+    def sync_filter(self, state) -> bool:
+        self.filter.set_state(state)
+        return True
 
     def sample(self, params: Dict) -> sb.Batch:
         params = ensure_numpy(params)  # one conversion, not one per step
         T, n = self.rollout_len, self.env.num_envs
-        obs_buf = np.empty((T, n, *self.env.obs_shape), self.env.obs_dtype)
+        # a filter emits float32; only the pass-through keeps the env's
+        # native dtype (uint8 image obs must not silently truncate)
+        obs_dtype = (self.env.obs_dtype if isinstance(self.filter, NoFilter)
+                     else np.float32)
+        obs_buf = np.empty((T, n, *self.env.obs_shape), obs_dtype)
         act_buf = np.empty((T, n), np.int64)
         logp_buf = np.empty((T, n), np.float32)
         val_buf = np.empty((T, n), np.float32)
@@ -86,8 +101,9 @@ class RolloutWorker(EnvWorkerBase):
         done_buf = np.empty((T, n), np.bool_)
         obs = self._obs
         for t in range(T):
-            actions, logp, values = sample_actions(params, obs, self._rng)
-            obs_buf[t], act_buf[t] = obs, actions
+            fobs = self.filter(obs)  # connector: batches hold FILTERED obs
+            actions, logp, values = sample_actions(params, fobs, self._rng)
+            obs_buf[t], act_buf[t] = fobs, actions
             logp_buf[t], val_buf[t] = logp, values
             obs, reward, done, info = self.env.step(actions)
             rew_buf[t], done_buf[t] = reward, done
@@ -100,11 +116,14 @@ class RolloutWorker(EnvWorkerBase):
                 if trunc.any():
                     idx = np.nonzero(trunc)[0]
                     _, _, v_final = sample_actions(
-                        params, info["final_obs"][idx], self._rng)
+                        params,
+                        self.filter(info["final_obs"][idx], update=False),
+                        self._rng)
                     rew_buf[t, idx] += self.gamma * v_final
             self._track_returns(reward, done)
         self._obs = obs
-        _, _, last_values = sample_actions(params, obs, self._rng)
+        _, _, last_values = sample_actions(
+            params, self.filter(obs, update=False), self._rng)
         adv, ret = sb.compute_gae(rew_buf, val_buf, done_buf, last_values,
                                   self.gamma, self.lam)
         flat = lambda a: a.reshape(T * n, *a.shape[2:])  # noqa: E731

@@ -834,3 +834,100 @@ class TestMultiAgent:
         acts = {"a0": np.zeros(4, np.int64), "a1": np.ones(4, np.int64)}
         _, rew, _, _ = env.step(acts)
         assert (rew["a0"] == 0.0).all()
+
+
+class TestConnectors:
+    def test_running_stat_merge_matches_numpy(self):
+        from ray_tpu.rllib.connectors import RunningStat
+
+        rng = np.random.default_rng(0)
+        a = rng.normal(3.0, 2.0, (500, 4))
+        b = rng.normal(-1.0, 0.5, (300, 4))
+        s1 = RunningStat((4,))
+        s1.push_batch(a)
+        s2 = RunningStat((4,))
+        s2.push_batch(b)
+        s1.merge(s2)
+        allx = np.concatenate([a, b])
+        np.testing.assert_allclose(s1.mean, allx.mean(0), rtol=1e-9)
+        np.testing.assert_allclose(s1.std, allx.std(0), rtol=1e-6)
+
+    def test_meanstd_delta_excludes_synced_base(self):
+        from ray_tpu.rllib.connectors import MeanStdFilter, RunningStat
+
+        f = MeanStdFilter((2,))
+        rng = np.random.default_rng(1)
+        f(rng.normal(size=(100, 2)))
+        f.set_state(f.state())  # sync point
+        fresh = rng.normal(5.0, 1.0, (50, 2))
+        f(fresh)
+        d = f.delta()
+        assert d["n"] == 50
+        np.testing.assert_allclose(d["mean"], fresh.mean(0), atol=1e-6)
+
+    def test_ppo_meanstd_solves_badly_scaled_env(self, cluster):
+        """CartPole with obs scaled x100: unfiltered PPO struggles; the
+        MeanStd connector restores the learnable scale (ref:
+        rllib/utils/filter.py rationale)."""
+        from ray_tpu.rllib import CartPoleVecEnv
+
+        class ScaledCartPole(CartPoleVecEnv):
+            SCALE = np.array([100.0, 1000.0, 100.0, 1000.0],
+                             np.float32)
+
+            def reset(self, seed=None):
+                return super().reset(seed) * self.SCALE
+
+            def step(self, actions):
+                obs, r, d, info = super().step(actions)
+                if "final_obs" in info:
+                    info["final_obs"] = info["final_obs"] * self.SCALE
+                return obs * self.SCALE, r, d, info
+
+        algo = (PPOConfig(observation_filter="MeanStd")
+                .environment("scaled", env_creator=lambda num_envs, seed:
+                             ScaledCartPole(num_envs=num_envs, seed=seed))
+                .rollouts(num_rollout_workers=2, num_envs_per_worker=8,
+                          rollout_fragment_length=128)
+                .training(lr=1e-3, entropy_coeff=0.005)
+                .build())
+        try:
+            best = 0.0
+            for _ in range(40):
+                r = algo.train()
+                if np.isfinite(r["episode_reward_mean"]):
+                    best = max(best, r["episode_reward_mean"])
+                if best >= 150:
+                    break
+            assert best >= 150, best
+            # the central filter really merged worker stats
+            assert algo.obs_filter.rs.n > 1000
+        finally:
+            algo.stop()
+
+    def test_filter_state_survives_checkpoint(self, cluster):
+        algo = (PPOConfig(observation_filter="MeanStd")
+                .rollouts(num_rollout_workers=1, num_envs_per_worker=4,
+                          rollout_fragment_length=32).build())
+        try:
+            algo.train()
+            n_before = algo.obs_filter.rs.n
+            assert n_before > 0
+            ck = algo.save()
+            assert "obs_filter" in ck
+            algo2 = (PPOConfig(observation_filter="MeanStd")
+                     .rollouts(num_rollout_workers=1, num_envs_per_worker=4,
+                               rollout_fragment_length=32).build())
+            try:
+                algo2.restore(ck)
+                assert algo2.obs_filter.rs.n == n_before
+                np.testing.assert_allclose(algo2.obs_filter.rs.mean,
+                                           algo.obs_filter.rs.mean)
+                # the restored workers got the state too
+                d = ray_tpu.get(
+                    algo2.workers[0].filter_delta.remote(), timeout=30)
+                assert d["n"] == 0  # fresh sync point, no drift
+            finally:
+                algo2.stop()
+        finally:
+            algo.stop()
