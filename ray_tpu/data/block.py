@@ -162,6 +162,8 @@ def block_to_batch(block: Block, batch_format: str = "numpy") -> Any:
         return block_to_arrow(block)
     if batch_format == "torch":
         return block_to_torch(block)
+    if batch_format in ("tf", "tensorflow"):
+        return block_to_tf(block)
     if batch_format == "rows":
         return block_to_rows(block)
     raise ValueError(f"Unknown batch_format {batch_format!r}")
@@ -175,3 +177,18 @@ def block_size_bytes(block: Block) -> int:
         else:
             total += v.nbytes
     return total
+
+def block_to_tf(block, dtypes=None):
+    """Columns -> dict of tf.Tensors (TF shares the numpy buffer where
+    dtypes allow; ref: data/iterator.py iter_tf_batches)."""
+    import tensorflow as tf
+
+    out = {}
+    for k, v in block.items():
+        t = tf.convert_to_tensor(v)
+        if dtypes:
+            want = dtypes.get(k) if isinstance(dtypes, dict) else dtypes
+            if want is not None:
+                t = tf.cast(t, want)
+        out[k] = t
+    return out

@@ -195,6 +195,20 @@ class Dataset:
                                        local_shuffle_seed=local_shuffle_seed):
             yield block_to_torch(batch, dtypes=dtypes, device=device)
 
+    def iter_tf_batches(self, *, batch_size: Optional[int] = 256,
+                        dtypes=None, drop_last: bool = False,
+                        local_shuffle_seed: Optional[int] = None
+                        ) -> Iterator[Any]:
+        """Batches as dicts of tf.Tensors (ref: dataset.py
+        iter_tf_batches)."""
+        from .block import block_to_tf
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last,
+                                       local_shuffle_seed=local_shuffle_seed):
+            yield block_to_tf(batch, dtypes=dtypes)
+
     def to_arrow_refs(self) -> List[Any]:
         """Blocks as pyarrow.Table object refs (ref:
         dataset.py to_arrow_refs)."""
