@@ -1,0 +1,11 @@
+"""ray_tpu.train.torch — the reference's `ray.train.torch` surface
+(ref: python/ray/train/torch/__init__.py): prepare_model wraps in DDP
+when the gang's gloo process group is up; prepare_data_loader shards
+with a DistributedSampler. TorchTrainer sets the process group up before
+the user loop runs."""
+from .torch_backend import (prepare_data_loader, prepare_model,
+                            setup_torch_process_group,
+                            teardown_torch_process_group)
+
+__all__ = ["prepare_data_loader", "prepare_model",
+           "setup_torch_process_group", "teardown_torch_process_group"]

@@ -1,0 +1,135 @@
+"""Torch DDP backend for TorchTrainer.
+
+ref: python/ray/train/torch/config.py:69 _setup_torch_process_group
+(rank-0 rendezvous address, dist.init_process_group :113) and
+train_loop_utils.py prepare_model (DDP wrap). On this framework the gang
+is a set of worker processes on the cluster's hosts; the process group
+runs gloo over TCP (torch-cpu — CUDA/NCCL has no place in a TPU-first
+stack, and the jax path is JaxTrainer; TorchTrainer exists so reference
+users can port data/CPU-torch workloads incrementally with REAL
+allreduce semantics behind the familiar API).
+"""
+from __future__ import annotations
+
+import datetime
+from typing import Any, Optional
+
+
+def setup_torch_process_group(init_method: str, rank: int,
+                              world_size: int,
+                              timeout_s: float = 120.0) -> None:
+    """Called in every gang worker before the user loop (ref:
+    config.py:113)."""
+    import torch.distributed as dist
+
+    if dist.is_initialized():
+        return
+    dist.init_process_group(
+        backend="gloo", init_method=init_method, rank=rank,
+        world_size=world_size,
+        timeout=datetime.timedelta(seconds=timeout_s))
+
+
+def teardown_torch_process_group() -> None:
+    import torch.distributed as dist
+
+    if dist.is_initialized():
+        dist.destroy_process_group()
+
+
+def prepare_model(model: Any) -> Any:
+    """Wrap for data-parallel training (ref: train_loop_utils.py:329
+    prepare_model): DDP when a multi-worker process group is up,
+    pass-through otherwise."""
+    import torch.distributed as dist
+    from torch.nn.parallel import DistributedDataParallel
+
+    if dist.is_initialized() and dist.get_world_size() > 1:
+        return DistributedDataParallel(model)
+    return model
+
+
+def prepare_data_loader(loader: Any) -> Any:
+    """Shard a DataLoader across the gang with a DistributedSampler
+    (ref: train_loop_utils.py prepare_data_loader)."""
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader
+    from torch.utils.data.distributed import DistributedSampler
+
+    if not (dist.is_initialized() and dist.get_world_size() > 1):
+        return loader
+    sampler = DistributedSampler(loader.dataset,
+                                 num_replicas=dist.get_world_size(),
+                                 rank=dist.get_rank(),
+                                 shuffle=True)
+    return DataLoader(loader.dataset, batch_size=loader.batch_size,
+                      sampler=sampler, num_workers=0,
+                      collate_fn=loader.collate_fn,
+                      drop_last=loader.drop_last)
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class RendezvousBroker:
+    """Named actor through which rank 0 advertises the TCPStore address
+    it actually bound (torch's tcp:// store lives in the RANK-0 WORKER
+    process — which may sit on any node — so the driver cannot pick the
+    address; ref: torch/config.py's master_addr = rank-0 node ip)."""
+
+    def __init__(self):
+        self._addr = None
+
+    def set(self, addr: str) -> bool:
+        self._addr = addr
+        return True
+
+    def get(self):
+        return self._addr
+
+
+def rendezvous(rdzv_name: str, route_host: str, rank: int,
+               world_size: int, timeout_s: float = 60.0) -> str:
+    """Rank 0 binds locally and advertises via the broker; other ranks
+    poll the broker. Returns the init_method URL."""
+    import time as _time
+
+    import ray_tpu
+
+    if rank == 0:
+        host = "127.0.0.1"
+        if route_host not in ("127.0.0.1", "localhost", ""):
+            # the interface THIS worker's host uses toward the cluster
+            import socket
+
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                s.connect((route_host, 80))
+                host = s.getsockname()[0]
+            finally:
+                s.close()
+        addr = f"tcp://{host}:{free_port(host)}"
+        broker = ray_tpu.remote(RendezvousBroker).options(
+            name=rdzv_name, get_if_exists=True).remote()
+        ray_tpu.get(broker.set.remote(addr), timeout=timeout_s)
+        return addr
+    deadline = _time.monotonic() + timeout_s
+    while _time.monotonic() < deadline:
+        try:
+            broker = ray_tpu.get_actor(rdzv_name)
+            addr = ray_tpu.get(broker.get.remote(), timeout=10)
+            if addr:
+                return addr
+        except Exception:
+            pass
+        _time.sleep(0.1)
+    raise TimeoutError(
+        f"torch rendezvous {rdzv_name!r}: rank 0 never advertised "
+        f"an address within {timeout_s}s")

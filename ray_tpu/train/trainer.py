@@ -120,6 +120,58 @@ class JaxTrainer(DataParallelTrainer):
 
 
 class TorchTrainer(DataParallelTrainer):
-    """API-parity alias (ref: torch/torch_trainer.py:16). torch-cpu works in
-    workers, but the TPU path is JaxTrainer; kept so reference users can
-    port incrementally."""
+    """Torch data-parallel trainer with a REAL gloo process group (ref:
+    torch/torch_trainer.py:16 + torch/config.py _setup_torch_process_group).
+    Every gang worker joins `dist.init_process_group("gloo")` over a
+    rank-0 TCP rendezvous before the user loop runs, so
+    `train.torch.prepare_model(model)` returns a genuine
+    DistributedDataParallel whose gradients allreduce across workers.
+    torch-cpu only by design — the TPU compute path is JaxTrainer."""
+
+    def fit(self) -> Result:
+        import uuid
+
+        # route_host: where the cluster's control plane listens — rank 0
+        # derives ITS OWN reachable interface toward it, then advertises
+        # the TCPStore address through a named broker actor (the store
+        # lives in the rank-0 worker process, not on the driver)
+        route_host = "127.0.0.1"
+        from ..core import runtime as runtime_mod
+
+        rt = runtime_mod.maybe_runtime()
+        srv = getattr(rt, "_remote_server", None)
+        if srv is not None:
+            route_host = srv.address[0]
+        user_loop = self.train_loop
+        user_config = self.train_config
+
+        def wrapped(config):
+            from . import get_context
+            from .torch_backend import (rendezvous,
+                                        setup_torch_process_group,
+                                        teardown_torch_process_group)
+
+            config = dict(config)
+            rdzv_name = config.pop("_torch_rdzv_name")
+            rhost = config.pop("_torch_route_host")
+            ctx = get_context()
+            init_method = rendezvous(rdzv_name, rhost,
+                                     ctx.get_world_rank(),
+                                     ctx.get_world_size())
+            setup_torch_process_group(init_method, ctx.get_world_rank(),
+                                      ctx.get_world_size())
+            try:
+                return user_loop(config)
+            finally:
+                teardown_torch_process_group()
+
+        self.train_loop = wrapped
+        self.train_config = {**self.train_config,
+                             "_torch_rdzv_name":
+                                 f"_torch_rdzv_{uuid.uuid4().hex[:12]}",
+                             "_torch_route_host": route_host}
+        try:
+            return super().fit()
+        finally:
+            self.train_loop = user_loop
+            self.train_config = user_config
