@@ -35,7 +35,7 @@ __all__ = [
     "AutoscalingConfig", "Application", "Deployment", "DeploymentHandle",
     "MeshDeployment", "delete", "deployment", "get_deployment_handle",
     "get_multiplexed_model_id", "multiplexed", "run", "shutdown",
-    "start_http_proxy", "status",
+    "start_grpc_proxy", "start_http_proxy", "status",
 ]
 
 
@@ -142,6 +142,18 @@ def delete(name: str) -> None:
     ray_tpu.get(controller.delete.remote(name), timeout=60)
 
 
+def start_grpc_proxy(host: str = "127.0.0.1", port: int = 0) -> tuple:
+    """Start the gRPC ingress actor (ref: serve gRPC proxy path);
+    returns (host, port). Generic-handler service — see
+    serve/grpc_proxy.py for the wire contract."""
+    from .grpc_proxy import GrpcProxy
+
+    cls = ray_tpu.remote(GrpcProxy)
+    proxy = cls.options(name="SERVE_GRPC_PROXY", lifetime="detached",
+                        get_if_exists=True).remote(host, port)
+    return tuple(ray_tpu.get(proxy.address.remote(), timeout=30))
+
+
 def start_http_proxy(host: str = "127.0.0.1", port: int = 0,
                      asyncio_server: bool = True) -> tuple:
     """Start the HTTP ingress actor; returns (host, port). The default is
@@ -219,9 +231,10 @@ def shutdown() -> None:
         ray_tpu.kill(controller)
     except Exception:
         pass
-    try:
-        proxy = ray_tpu.get_actor("SERVE_PROXY")
-        ray_tpu.get(proxy.shutdown.remote(), timeout=10)
-        ray_tpu.kill(proxy)
-    except Exception:
-        pass
+    for proxy_name in ("SERVE_PROXY", "SERVE_GRPC_PROXY"):
+        try:
+            proxy = ray_tpu.get_actor(proxy_name)
+            ray_tpu.get(proxy.shutdown.remote(), timeout=10)
+            ray_tpu.kill(proxy)
+        except Exception:
+            pass

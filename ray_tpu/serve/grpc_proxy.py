@@ -1,0 +1,188 @@
+"""gRPC ingress for Serve.
+
+ref: python/ray/serve/_private/grpc_util.py + proxy.py gRPC path (the
+reference's gRPC ingress registers user-supplied servicer functions).
+Here the service is schema-generic — a GenericRpcHandler routes by
+method path, so no protoc codegen is required on either side:
+
+    method  /ray_tpu.serve/<deployment>          unary JSON -> JSON
+    method  /ray_tpu.serve/<deployment>/stream   unary JSON -> stream of
+                                                 JSON messages
+    method  /ray_tpu.serve/_routes               deployment listing
+
+Request/response bodies are UTF-8 JSON bytes (the wire contract the
+HTTP ingress exposes, over gRPC framing — HTTP/2 multiplexing,
+deadlines, and streaming flow control come from gRPC itself). Multiplex
+routing rides gRPC metadata: ("model_id", ...).
+"""
+from __future__ import annotations
+
+import json
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict
+
+import ray_tpu
+
+_PREFIX = "/ray_tpu.serve/"
+
+
+class GrpcProxy:
+    """Actor hosting the gRPC server (thread-pool execution model: each
+    RPC runs a blocking DeploymentHandle call off the event loop)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 max_workers: int = 32):
+        import grpc
+
+        self._host = host
+        self._handles: Dict[str, object] = {}
+        proxy = self
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, call_details):
+                method = call_details.method
+                if not method.startswith(_PREFIX):
+                    return None
+                target = method[len(_PREFIX):]
+                if target == "_routes":
+                    return grpc.unary_unary_rpc_method_handler(
+                        proxy._routes_rpc)
+                if target.endswith("/stream"):
+                    name = target[:-len("/stream")]
+                    return grpc.unary_stream_rpc_method_handler(
+                        proxy._make_stream_rpc(name))
+                return grpc.unary_unary_rpc_method_handler(
+                    proxy._make_unary_rpc(target))
+
+        self._server = grpc.server(
+            ThreadPoolExecutor(max_workers, thread_name_prefix="serve-grpc"))
+        self._server.add_generic_rpc_handlers((Handler(),))
+        self._port = self._server.add_insecure_port(f"{host}:{port}")
+        if self._port == 0:
+            raise RuntimeError(f"could not bind gRPC ingress on "
+                               f"{host}:{port}")
+        self._server.start()
+
+    # -- RPC implementations -------------------------------------------------
+
+    def _get_handle(self, name: str):
+        from .handle import DeploymentHandle
+
+        h = self._handles.get(name)
+        if h is None:
+            h = self._handles[name] = DeploymentHandle(name)
+        return h
+
+    @staticmethod
+    def _payload(request: bytes):
+        return json.loads(request) if request else None
+
+    @staticmethod
+    def _mux_id(context) -> str:
+        for k, v in context.invocation_metadata():
+            if k == "model_id":
+                return v
+        return ""
+
+    def _make_unary_rpc(self, name: str):
+        import grpc
+
+        def rpc(request: bytes, context) -> bytes:
+            try:
+                h = self._get_handle(name)
+                mux = self._mux_id(context)
+                if mux:
+                    h = h.options(multiplexed_model_id=mux)
+                # honor the CLIENT's gRPC deadline (capped so an
+                # abandoned no-deadline call can't pin a pool thread
+                # forever)
+                remaining = context.time_remaining()
+                timeout = min(remaining, 600.0) if remaining else 60.0
+                result = ray_tpu.get(h.remote(self._payload(request)),
+                                     timeout=timeout)
+                return json.dumps(_jsonable(result)).encode()
+            except Exception as e:  # noqa: BLE001 — surfaced as INTERNAL
+                context.abort(grpc.StatusCode.INTERNAL,
+                              f"{type(e).__name__}: {e}")
+
+        return rpc
+
+    def _make_stream_rpc(self, name: str):
+        import grpc
+
+        def rpc(request: bytes, context):
+            try:
+                gen = self._get_handle(name).options(
+                    stream=True,
+                    multiplexed_model_id=self._mux_id(context)
+                ).remote(self._payload(request))
+                for item in gen:
+                    yield json.dumps(_jsonable(item)).encode()
+            except Exception as e:  # noqa: BLE001
+                context.abort(grpc.StatusCode.INTERNAL,
+                              f"{type(e).__name__}: {e}")
+
+        return rpc
+
+    def _routes_rpc(self, request: bytes, context) -> bytes:
+        import grpc
+
+        try:
+            from .controller import CONTROLLER_NAME
+
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            routes = ray_tpu.get(controller.list_deployments.remote(),
+                                 timeout=10)
+            return json.dumps({"deployments": routes}).encode()
+        except Exception as e:  # noqa: BLE001 — same mapping as the
+            # unary/stream handlers: INTERNAL + "TypeName: msg"
+            context.abort(grpc.StatusCode.INTERNAL,
+                          f"{type(e).__name__}: {e}")
+
+    # -- actor surface -------------------------------------------------------
+
+    def address(self) -> tuple:
+        return (self._host, self._port)
+
+    def ping(self) -> str:
+        return "ok"
+
+    def shutdown(self) -> bool:
+        # stop() returns an event; wait it out so in-flight RPCs drain
+        # before the caller kills this actor
+        self._server.stop(grace=1.0).wait()
+        return True
+
+
+from .http_asyncio import _jsonable  # noqa: E402 — single shared coercion
+
+
+def grpc_call(address: tuple, deployment: str, payload=None,
+              model_id: str = "", timeout: float = 60.0):
+    """Client helper (also shows the wire contract for non-Python
+    clients): unary JSON call to a deployment."""
+    import grpc
+
+    with grpc.insecure_channel(f"{address[0]}:{address[1]}") as chan:
+        fn = chan.unary_unary(
+            f"{_PREFIX}{deployment}",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
+        md = (("model_id", model_id),) if model_id else None
+        out = fn(json.dumps(payload).encode(), metadata=md,
+                 timeout=timeout)
+        return json.loads(out)
+
+
+def grpc_stream(address: tuple, deployment: str, payload=None,
+                timeout: float = 60.0):
+    """Client helper: streaming call yielding parsed JSON messages."""
+    import grpc
+
+    with grpc.insecure_channel(f"{address[0]}:{address[1]}") as chan:
+        fn = chan.unary_stream(
+            f"{_PREFIX}{deployment}/stream",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
+        for msg in fn(json.dumps(payload).encode(), timeout=timeout):
+            yield json.loads(msg)

@@ -461,3 +461,57 @@ class TestServeDeployConfig:
             assert out == {"msg": "bonjour"}
         finally:
             _sys.path.remove(str(tmp_path))
+
+
+class TestGrpcIngress:
+    def test_grpc_unary_and_routes(self, cluster):
+        from ray_tpu.serve.grpc_proxy import grpc_call
+
+        @serve.deployment(num_replicas=2)
+        class Adder:
+            def __call__(self, body):
+                return {"sum": body["a"] + body["b"]}
+
+        serve.run(Adder.bind())
+        addr = serve.start_grpc_proxy(port=0)
+        out = grpc_call(addr, "Adder", {"a": 2, "b": 40})
+        assert out == {"sum": 42}
+        # concurrent unary calls through the thread-pool server
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(16) as pool:
+            outs = list(pool.map(
+                lambda i: grpc_call(addr, "Adder", {"a": i, "b": 1})["sum"],
+                range(30)))
+        assert outs == [i + 1 for i in range(30)]
+
+    def test_grpc_streaming(self, cluster):
+        from ray_tpu.serve.grpc_proxy import grpc_stream
+
+        @serve.deployment
+        class Counter:
+            def __call__(self, body):
+                for i in range(body["n"]):
+                    yield {"i": i}
+
+        serve.run(Counter.bind())
+        addr = serve.start_grpc_proxy(port=0)
+        msgs = list(grpc_stream(addr, "Counter", {"n": 5}))
+        assert msgs == [{"i": i} for i in range(5)]
+
+    def test_grpc_error_status(self, cluster):
+        import grpc
+        import pytest as _pytest
+
+        from ray_tpu.serve.grpc_proxy import grpc_call
+
+        @serve.deployment
+        class Boom:
+            def __call__(self, body):
+                raise ValueError("nope")
+
+        serve.run(Boom.bind())
+        addr = serve.start_grpc_proxy(port=0)
+        with _pytest.raises(grpc.RpcError) as ei:
+            grpc_call(addr, "Boom", {})
+        assert ei.value.code() == grpc.StatusCode.INTERNAL
