@@ -9,7 +9,8 @@ from .context import DataContext
 from .dataset import (ActorPoolStrategy, Dataset, GroupedDataset,
                       from_arrow, from_blocks, from_items, from_numpy, range, read_csv,
                       read_images, read_json, read_numpy,
-                      read_parquet, read_sql, read_tfrecords)
+                      read_parquet, read_sql, read_tfrecords,
+                      read_webdataset)
 from .pipeline import DatasetPipeline
 from .iterator import DataShard
 
@@ -18,5 +19,5 @@ __all__ = [
     "GroupedDataset", "from_arrow", "from_blocks", "from_items", "from_numpy", "range",
     "DatasetPipeline",
     "read_csv", "read_images", "read_json", "read_numpy",
-    "read_parquet", "read_sql", "read_tfrecords",
+    "read_parquet", "read_sql", "read_tfrecords", "read_webdataset",
 ]

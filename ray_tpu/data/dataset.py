@@ -548,6 +548,52 @@ def read_sql(sql: str, connection_factory: Union[str, Callable], *,
     return _make_dataset(fns, "read_sql")
 
 
+def read_webdataset(paths: Union[str, List[str]], *,
+                    decode: bool = True, **kw) -> Dataset:
+    """WebDataset tar shards -> Dataset (ref: python/ray/data/read_api.py
+    read_webdataset). Each tar member group sharing a basename prefix
+    (before the first dot) is one sample; columns are named by member
+    extension. With decode=True: jpg/png/bmp decode via PIL to uint8
+    arrays, txt/cls to str, json to parsed objects, everything else
+    stays bytes. One block per tar shard — the format's unit of
+    streaming."""
+    def reader(path: str) -> Block:
+        import io
+        import json as _json
+        import os
+        import tarfile
+
+        samples: Dict[str, Dict[str, Any]] = {}
+        order: List[str] = []
+        with tarfile.open(path) as tar:
+            for m in tar:
+                if not m.isfile():
+                    continue
+                base = os.path.basename(m.name)
+                key, _, ext = base.partition(".")
+                data = tar.extractfile(m).read()
+                if decode:
+                    lext = ext.lower()
+                    if lext in ("jpg", "jpeg", "png", "bmp"):
+                        from PIL import Image
+
+                        data = np.asarray(
+                            Image.open(io.BytesIO(data)).convert("RGB"),
+                            np.uint8)
+                    elif lext in ("txt", "cls"):
+                        data = data.decode()
+                    elif lext == "json":
+                        data = _json.loads(data)
+                if key not in samples:
+                    samples[key] = {"__key__": key}
+                    order.append(key)
+                samples[key][ext] = data
+        return block_from_items([samples[k] for k in order])
+
+    return _make_dataset(
+        _file_read_fns(paths, reader, (".tar",)), "read_webdataset")
+
+
 def read_tfrecords(paths: Union[str, List[str]], **kw) -> Dataset:
     """TFRecord files of tf.train.Example -> columnar blocks. No
     TensorFlow needed: framing + the Example protobuf subset are decoded

@@ -501,3 +501,44 @@ def test_iter_tf_batches(cluster):
     shard = ds.split_shards(2)[0]
     tb = list(shard.iter_tf_batches(batch_size=None))
     assert tb and isinstance(tb[0]["id"], tf.Tensor)
+
+
+def test_read_webdataset(tmp_path, cluster):
+    import io
+    import json
+    import tarfile
+
+    import ray_tpu.data as rd
+    from PIL import Image
+
+    # build two tar shards in webdataset layout
+    for shard in range(2):
+        with tarfile.open(tmp_path / f"shard{shard}.tar", "w") as tar:
+            for i in range(3):
+                key = f"{shard}{i:03d}"
+                img = Image.fromarray(
+                    np.full((4, 5, 3), shard * 10 + i, np.uint8))
+                buf = io.BytesIO()
+                img.save(buf, format="PNG")
+
+                def add(name, data):
+                    info = tarfile.TarInfo(name)
+                    info.size = len(data)
+                    tar.addfile(info, io.BytesIO(data))
+
+                add(f"{key}.png", buf.getvalue())
+                add(f"{key}.cls", str(i).encode())
+                add(f"{key}.json", json.dumps({"k": key}).encode())
+
+    ds = rd.read_webdataset(str(tmp_path))
+    rows = sorted(ds.iter_rows(), key=lambda r: r["__key__"])
+    assert len(rows) == 6
+    assert rows[0]["png"].shape == (4, 5, 3)
+    assert rows[0]["png"].dtype == np.uint8
+    assert int(rows[0]["png"][0, 0, 0]) == 0
+    assert rows[4]["cls"] == "1"
+    assert rows[3]["json"]["k"] == "1000"
+    # decode=False keeps raw bytes
+    raw = next(iter(rd.read_webdataset(
+        str(tmp_path / "shard0.tar"), decode=False).iter_rows()))
+    assert isinstance(raw["png"], bytes) and isinstance(raw["cls"], bytes)
