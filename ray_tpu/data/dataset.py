@@ -569,11 +569,17 @@ def read_webdataset(paths: Union[str, List[str]], *,
             for m in tar:
                 if not m.isfile():
                     continue
-                base = os.path.basename(m.name)
-                key, _, ext = base.partition(".")
+                # key = FULL path up to the basename's first dot (the
+                # webdataset base_plus_ext rule: train/a/0001.jpg and
+                # train/b/0001.jpg are DIFFERENT samples)
+                dirname, base = os.path.split(m.name)
+                stem, _, ext = base.partition(".")
+                key = os.path.join(dirname, stem) if dirname else stem
                 data = tar.extractfile(m).read()
                 if decode:
-                    lext = ext.lower()
+                    # decode dispatches on the LAST extension segment so
+                    # 0001.seg.png decodes like 0001.png
+                    lext = ext.lower().rsplit(".", 1)[-1]
                     if lext in ("jpg", "jpeg", "png", "bmp"):
                         from PIL import Image
 
@@ -588,7 +594,16 @@ def read_webdataset(paths: Union[str, List[str]], *,
                     samples[key] = {"__key__": key}
                     order.append(key)
                 samples[key][ext] = data
-        return block_from_items([samples[k] for k in order])
+        # union of extensions across samples: optional members (.cls on
+        # some samples only) must not vanish because the shard's FIRST
+        # sample lacked them (block_from_items seeds columns from row 0)
+        all_keys: Dict[str, None] = {}
+        for k in order:
+            for col in samples[k]:
+                all_keys.setdefault(col)
+        rows = [{col: samples[k].get(col) for col in all_keys}
+                for k in order]
+        return block_from_items(rows)
 
     return _make_dataset(
         _file_read_fns(paths, reader, (".tar",)), "read_webdataset")

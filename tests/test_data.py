@@ -542,3 +542,41 @@ def test_read_webdataset(tmp_path, cluster):
     raw = next(iter(rd.read_webdataset(
         str(tmp_path / "shard0.tar"), decode=False).iter_rows()))
     assert isinstance(raw["png"], bytes) and isinstance(raw["cls"], bytes)
+
+
+def test_read_webdataset_nested_heterogeneous(tmp_path, cluster):
+    """Nested paths are distinct samples; optional members survive a
+    first sample that lacks them; multi-extension members decode by the
+    LAST segment (the webdataset base_plus_ext rules)."""
+    import io
+    import tarfile
+
+    import ray_tpu.data as rd
+    from PIL import Image
+
+    def png_bytes(v):
+        b = io.BytesIO()
+        Image.fromarray(np.full((2, 2, 3), v, np.uint8)).save(b, "PNG")
+        return b.getvalue()
+
+    with tarfile.open(tmp_path / "n.tar", "w") as tar:
+        def add(name, data):
+            ti = tarfile.TarInfo(name)
+            ti.size = len(data)
+            tar.addfile(ti, io.BytesIO(data))
+
+        # same basename in two dirs = two samples
+        add("a/0001.png", png_bytes(10))
+        add("b/0001.png", png_bytes(20))
+        add("b/0001.cls", b"7")         # optional member, absent from a/
+        add("b/0001.seg.png", png_bytes(99))  # multi-extension
+
+    rows = sorted(rd.read_webdataset(str(tmp_path / "n.tar")).iter_rows(),
+                  key=lambda r: r["__key__"])
+    assert [r["__key__"] for r in rows] == ["a/0001", "b/0001"]
+    assert rows[0]["cls"] is None and rows[1]["cls"] == "7"
+    assert int(rows[0]["png"][0, 0, 0]) == 10
+    assert int(rows[1]["png"][0, 0, 0]) == 20
+    # seg.png decoded as an image via its last extension segment
+    assert rows[1]["seg.png"].shape == (2, 2, 3)
+    assert int(rows[1]["seg.png"][0, 0, 0]) == 99
