@@ -13,6 +13,7 @@ import itertools
 import os
 import queue as queue_mod
 import threading
+import time
 import traceback
 from concurrent.futures import Future, ThreadPoolExecutor
 from multiprocessing.connection import Client, Connection, Listener
@@ -20,6 +21,32 @@ from typing import Any, Callable, Dict, Optional
 
 _REQ, _RESP, _ERR, _ONEWAY = 0, 1, 2, 3
 _CLOSE = object()  # writer-thread sentinel
+
+# per-handler instrumentation (ref: the reference's per-RPC gRPC stats,
+# src/ray/stats/metric_defs.cc grpc_server_req_* counters): method ->
+# [calls, errors, total_seconds]. Process-wide; read via rpc_stats().
+_RPC_STATS: Dict[str, list] = {}
+_RPC_STATS_LOCK = threading.Lock()
+
+
+def _record_rpc(method: str, seconds: float, error: bool) -> None:
+    with _RPC_STATS_LOCK:
+        row = _RPC_STATS.get(method)
+        if row is None:
+            row = _RPC_STATS[method] = [0, 0, 0.0]
+        row[0] += 1
+        if error:
+            row[1] += 1
+        row[2] += seconds
+
+
+def rpc_stats() -> Dict[str, dict]:
+    """{method: {calls, errors, total_s, avg_ms}} for every RPC method
+    this process has served."""
+    with _RPC_STATS_LOCK:
+        return {m: {"calls": c, "errors": e, "total_s": round(t, 4),
+                    "avg_ms": round(t / c * 1e3, 3) if c else 0.0}
+                for m, (c, e, t) in _RPC_STATS.items()}
 
 
 class ChannelClosed(Exception):
@@ -199,20 +226,31 @@ class RpcChannel:
             self._teardown()
 
     def _handle(self, msg_id: int, method: str, payload: Any) -> None:
+        t0 = time.perf_counter()
+        ok = False
         try:
             result = self._handler(method, payload)
             self._send((_RESP, msg_id, None, result))
+            ok = True  # only after the reply went out: a failed _RESP
+            # send IS a client-visible error and must count as one
         except Exception as e:
             try:
                 self._send((_ERR, msg_id, f"{type(e).__name__}: {e}", traceback.format_exc()))
             except Exception:
                 pass
+        finally:
+            _record_rpc(method, time.perf_counter() - t0, not ok)
 
     def _handle_oneway(self, method: str, payload: Any) -> None:
+        t0 = time.perf_counter()
+        ok = False
         try:
             self._handler(method, payload)
+            ok = True
         except Exception:
             traceback.print_exc()
+        finally:
+            _record_rpc(method, time.perf_counter() - t0, not ok)
 
     # -- lifecycle -------------------------------------------------------------
 

@@ -15,8 +15,11 @@ fresh worker applies the env exactly once before its first task —
 env_vars into os.environ, extracted working_dir as cwd + sys.path head,
 py_modules onto sys.path.
 
-pip/conda/container are deliberately gated (no package installation on an
-air-gapped TPU host); a clear error beats a silent ignore.
+pip envs install into per-requirement-set venvs on the worker host
+(--system-site-packages so the base stack stays importable); pip's
+standard source controls (PIP_INDEX_URL / --no-index / --find-links)
+point at a mirror or wheelhouse on air-gapped pods. conda/container
+remain gated: a clear error beats a silent ignore.
 """
 from __future__ import annotations
 
@@ -29,8 +32,8 @@ import tempfile
 import zipfile
 from typing import Callable, Dict, List, Optional
 
-ALLOWED_KEYS = {"env_vars", "working_dir", "py_modules", "config"}
-GATED_KEYS = {"pip", "conda", "container", "image_uri", "uv"}
+ALLOWED_KEYS = {"env_vars", "working_dir", "py_modules", "pip", "config"}
+GATED_KEYS = {"conda", "container", "image_uri", "uv"}
 # ref: runtime_env/packaging.py GCS_STORAGE_MAX_SIZE guard
 MAX_PACKAGE_BYTES = 500 * 1024 * 1024
 _EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
@@ -47,7 +50,7 @@ def validate(renv: Optional[dict]) -> Optional[dict]:
         raise ValueError(
             f"runtime_env keys {sorted(gated)} are not supported on this "
             f"runtime: TPU hosts run hermetic images; ship code via "
-            f"working_dir/py_modules and configuration via env_vars")
+            f"working_dir/py_modules/pip and configuration via env_vars")
     unknown = renv.keys() - ALLOWED_KEYS
     if unknown:
         raise ValueError(f"unknown runtime_env keys {sorted(unknown)}; "
@@ -63,6 +66,30 @@ def validate(renv: Optional[dict]) -> Optional[dict]:
     mods = renv.get("py_modules") or []
     if mods:
         out["py_modules"] = [str(m) for m in mods]
+    if "pip" in renv and renv["pip"] is not None:
+        pip = renv["pip"]
+        # ref: runtime_env/pip.py — list of requirement strings, or
+        # {"packages": [...], "pip_install_options": [...]}. Installs go
+        # into a per-requirement-set venv on the worker host; standard
+        # pip env (PIP_INDEX_URL / PIP_NO_INDEX / PIP_FIND_LINKS) and
+        # the explicit options control where packages come from — on an
+        # air-gapped pod that is a local mirror or wheelhouse.
+        if isinstance(pip, (list, tuple)):
+            if not pip:
+                raise ValueError("runtime_env pip list must be non-empty")
+            out["pip"] = {"packages": [str(p) for p in pip],
+                          "pip_install_options": []}
+        elif isinstance(pip, dict):
+            pkgs = pip.get("packages")
+            if not pkgs:
+                raise ValueError("runtime_env pip dict needs 'packages'")
+            out["pip"] = {
+                "packages": [str(p) for p in pkgs],
+                "pip_install_options": [
+                    str(o) for o in pip.get("pip_install_options") or []]}
+        else:
+            raise TypeError("pip must be a list of requirements or a "
+                            "{'packages': [...]} dict")
     if renv.get("config"):
         out["config"] = dict(renv["config"])
     return out or None
@@ -228,6 +255,56 @@ def _extract(ref: dict, kv_get: Callable[[str], bytes]) -> str:
     return dest
 
 
+def _venv_site_packages(venv_dir: str) -> str:
+    vpy = f"python{sys.version_info.major}.{sys.version_info.minor}"
+    return os.path.join(venv_dir, "lib", vpy, "site-packages")
+
+
+def _ensure_pip_env(pip_spec: dict) -> str:
+    """Create (or reuse) the venv for this requirement set; returns its
+    site-packages path. Cache key = packages + options + interpreter
+    version; builds are atomic-rename like _extract so concurrent
+    workers race benignly (ref: runtime_env/pip.py PipProcessor)."""
+    import shutil
+    import subprocess
+
+    key = hashlib.sha1(json.dumps(
+        {"pkgs": sorted(pip_spec["packages"]),
+         "opts": pip_spec.get("pip_install_options") or [],
+         "py": sys.version_info[:2]},
+        sort_keys=True).encode()).hexdigest()[:16]
+    dest = os.path.join(_cache_root(), f"venv_{key}")
+    if os.path.isdir(dest):
+        return _venv_site_packages(dest)
+    os.makedirs(_cache_root(), exist_ok=True)
+    tmp = tempfile.mkdtemp(dir=_cache_root(), prefix=f".venv_{key}.")
+    try:
+        # --system-site-packages: the worker's own stack (ray_tpu, jax,
+        # numpy) must stay importable alongside the extra packages
+        subprocess.run([sys.executable, "-m", "venv",
+                        "--system-site-packages", tmp],
+                       check=True, capture_output=True, timeout=120)
+        vpip = os.path.join(tmp, "bin", "python")
+        out = subprocess.run(
+            [vpip, "-m", "pip", "install", "--no-input",
+             *pip_spec.get("pip_install_options", []),
+             *pip_spec["packages"]],
+            capture_output=True, text=True, timeout=600)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"runtime_env pip install failed "
+                f"(packages={pip_spec['packages']}):\n{out.stderr[-2000:]}")
+        os.rename(tmp, dest)
+    except KeyboardInterrupt:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise  # never swallow interrupts, winner or not
+    except Exception:
+        shutil.rmtree(tmp, ignore_errors=True)
+        if not os.path.isdir(dest):  # a concurrent builder may have won
+            raise
+    return _venv_site_packages(dest)
+
+
 def apply(packaged: Optional[dict],
           kv_get: Callable[[str], bytes]) -> None:
     """Apply an environment to THIS process (called once, before the
@@ -236,6 +313,11 @@ def apply(packaged: Optional[dict],
         return
     for k, v in (packaged.get("env_vars") or {}).items():
         os.environ[k] = v
+    pip_spec = packaged.get("pip")
+    if pip_spec:
+        site = _ensure_pip_env(pip_spec)
+        if site not in sys.path:
+            sys.path.insert(0, site)
     paths: List[str] = []
     wd = packaged.get("working_dir")
     if wd:

@@ -52,6 +52,7 @@ _API = {
     "placement_groups": state_api.list_placement_groups,
     "object_store": state_api.object_store_stats,
     "summary": state_api.summary,
+    "rpc": state_api.rpc_method_stats,
     "jobs": _jobs_rows,
     "serve": _serve_rows,
 }

@@ -110,6 +110,14 @@ def object_store_stats() -> Dict[str, Dict[str, Any]]:
     return out
 
 
+def rpc_method_stats() -> Dict[str, dict]:
+    """Per-RPC-method call/error/latency stats served by THIS process
+    (ref: the reference's grpc_server_req_* metrics)."""
+    from ..core.rpc import rpc_stats
+
+    return rpc_stats()
+
+
 def summary() -> Dict[str, Any]:
     rt = _rt()
     events = rt.gcs.task_events()

@@ -96,8 +96,8 @@ def test_gated_and_unknown_keys_raise(cluster):
     def f():
         return 1
 
-    with pytest.raises(ValueError, match="pip"):
-        f.options(runtime_env={"pip": ["requests"]}).remote()
+    with pytest.raises(ValueError, match="conda"):
+        f.options(runtime_env={"conda": {"deps": []}}).remote()
     with pytest.raises(ValueError, match="unknown"):
         f.options(runtime_env={"bogus_key": 1}).remote()
 
@@ -183,3 +183,70 @@ def test_edited_working_dir_ships_fresh_package(cluster, tmp_path):
     renv_mod._fp_cache.clear()
     assert ray_tpu.get(read_version.options(runtime_env=env).remote(),
                        timeout=60) == "v2"
+
+
+def _build_test_wheel(tmp_path, name="rtpu_testpkg", value=41):
+    """Build a trivial wheel into a local wheelhouse (the air-gapped
+    install source for the pip runtime_env)."""
+    import subprocess
+    import sys
+
+    src = tmp_path / "pkgsrc"
+    (src / name).mkdir(parents=True)
+    (src / name / "__init__.py").write_text(f"ANSWER = {value}\n")
+    (src / "pyproject.toml").write_text(
+        "[build-system]\n"
+        "requires = ['setuptools']\n"
+        "build-backend = 'setuptools.build_meta'\n"
+        "[project]\n"
+        f"name = '{name}'\n"
+        "version = '1.0'\n")
+    wheelhouse = tmp_path / "wheels"
+    wheelhouse.mkdir()
+    out = subprocess.run(
+        [sys.executable, "-m", "pip", "wheel", "--no-deps", "--no-index",
+         "--no-build-isolation", "-w", str(wheelhouse), str(src)],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    return str(wheelhouse)
+
+
+def test_pip_runtime_env_installs_into_venv(cluster, tmp_path):
+    """A task with runtime_env pip imports a package that exists only in
+    the env's venv (installed from a local wheelhouse — the air-gapped
+    source pip's standard options select)."""
+    wheelhouse = _build_test_wheel(tmp_path, value=41)
+
+    @ray_tpu.remote(runtime_env={
+        "pip": {"packages": ["rtpu_testpkg"],
+                "pip_install_options": ["--no-index", "--find-links",
+                                        wheelhouse]}})
+    def use_pkg():
+        import rtpu_testpkg
+
+        return rtpu_testpkg.ANSWER + 1
+
+    assert ray_tpu.get(use_pkg.remote(), timeout=300) == 42
+
+    # plain-env workers must NOT see the package
+    @ray_tpu.remote
+    def plain():
+        try:
+            import rtpu_testpkg  # noqa: F401
+
+            return "leaked"
+        except ImportError:
+            return "isolated"
+
+    assert ray_tpu.get(plain.remote(), timeout=60) == "isolated"
+
+
+def test_pip_env_validation():
+    from ray_tpu.core.runtime_env import validate
+
+    v = validate({"pip": ["a", "b==1.0"]})
+    assert v["pip"]["packages"] == ["a", "b==1.0"]
+    with pytest.raises(ValueError):
+        validate({"pip": {}})
+    with pytest.raises(ValueError):
+        validate({"conda": {"deps": []}})
