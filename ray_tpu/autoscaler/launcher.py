@@ -350,8 +350,19 @@ def cluster_down(name_or_config: str) -> None:
     else:
         # scope the kill to THIS cluster: every launched process carries
         # the cluster's non-secret nonce in argv, so matching it cannot
-        # touch other clusters (or hand-started nodes) sharing the host
-        pat = shlex.quote(state.get("nonce") or state["authkey"])
+        # touch other clusters (or hand-started nodes) sharing the host.
+        # NEVER fall back to the authkey — pkill -f would place the
+        # secret in remote argv (/proc, shell history on shared hosts)
+        nonce = state.get("nonce")
+        if not nonce:
+            raise RuntimeError(
+                f"cluster state for {name!r} predates nonce tracking; "
+                "refusing a pattern kill that would expose the authkey. "
+                "Kill the recorded pids by hand "
+                f"(head={state.get('head_pid')} "
+                f"workers={state.get('worker_pids', [])}), then delete "
+                f"{_state_path(name)} to finish the teardown.")
+        pat = shlex.quote(nonce)
         for ip in (provider.get("worker_ips") or []) + \
                 [provider.get("head_ip")]:
             if not ip:

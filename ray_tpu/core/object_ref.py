@@ -55,7 +55,15 @@ class ObjectRefGenerator:
         return self
 
     def __next__(self):
-        ref = self._rt.next_generator_item(self._task_id, self._index)
+        return self.next()
+
+    def next(self, timeout: Optional[float] = None):
+        """`__next__` with a deadline: raises GetTimeoutError if the
+        producer yields nothing within `timeout` seconds. Lets blocking
+        consumers (Serve proxies) bound how long a hung replica can pin
+        their thread."""
+        ref = self._rt.next_generator_item(self._task_id, self._index,
+                                           timeout=timeout)
         if ref is None:
             raise StopIteration
         self._index += 1

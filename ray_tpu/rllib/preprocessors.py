@@ -54,6 +54,12 @@ class MaxAndSkipVec(VecEnvWrapper):
         self.skip = skip
 
     def step(self, actions: np.ndarray):
+        # Vectorized divergence from the reference wrapper: envs that
+        # finish mid-window keep being stepped (the batch moves in
+        # lockstep), so the auto-reset episode consumes up to skip-1
+        # stale repeats of the old action. What must NOT leak is pixels:
+        # a done env returns its latest post-reset frame unmaxed rather
+        # than np.maximum'd with a pre-reset frame.
         n = self.num_envs
         total = np.zeros(n, np.float32)
         done_seen = np.zeros(n, np.bool_)
@@ -65,7 +71,9 @@ class MaxAndSkipVec(VecEnvWrapper):
             total += reward * (~done_seen)
             done_seen |= done
         if prev is not None:
-            obs = np.maximum(obs, prev)
+            maxed = np.maximum(obs, prev)
+            keep = done_seen.reshape((n,) + (1,) * (obs.ndim - 1))
+            obs = np.where(keep, obs, maxed)
         return obs, total, done_seen, info
 
 

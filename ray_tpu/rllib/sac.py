@@ -191,6 +191,7 @@ class SACConfig:
     learning_starts: int = 1_000
     hidden: tuple = (256, 256)
     seed: int = 0
+    checkpoint_replay_buffer: bool = True
     worker_resources: Dict[str, float] = field(default_factory=dict)
 
     def build(self) -> "SAC":
@@ -406,7 +407,7 @@ class SAC:
         import jax
 
         L = self.learner
-        return {"params": jax.device_get(L.params),
+        ckpt = {"params": jax.device_get(L.params),
                 "target_q": jax.device_get(L.target_q),
                 "log_alpha": float(L.log_alpha),
                 # Adam moments + the sampling key survive the round-trip
@@ -417,6 +418,12 @@ class SAC:
                 "rng_key": jax.device_get(L._key),
                 "iteration": self._iteration,
                 "total_steps": self._total_steps}
+        if self.config.checkpoint_replay_buffer:
+            # same contract as DQN: a restored trial (PBT exploit,
+            # pause/resume) resumes warm instead of stalling until
+            # learning_starts refills
+            ckpt["buffer"] = self.buffer.state()
+        return ckpt
 
     def restore(self, ckpt: Dict) -> None:
         import jax.numpy as jnp
@@ -434,6 +441,8 @@ class SAC:
             L._key = jnp.asarray(ckpt["rng_key"])
         self._iteration = int(ckpt.get("iteration", 0))
         self._total_steps = int(ckpt.get("total_steps", 0))
+        if "buffer" in ckpt:
+            self.buffer.restore(ckpt["buffer"])
 
     def stop(self) -> None:
         for w in self.workers:

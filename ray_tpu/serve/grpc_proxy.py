@@ -116,7 +116,13 @@ class GrpcProxy:
                     stream=True,
                     multiplexed_model_id=self._mux_id(context)
                 ).remote(self._payload(request))
-                for item in gen:
+                while True:
+                    # per-item cap, same rationale as the unary path: a
+                    # hung replica must not pin this thread forever
+                    try:
+                        item = gen.next(timeout=600.0)
+                    except StopIteration:
+                        break
                     yield json.dumps(_jsonable(item)).encode()
             except Exception as e:  # noqa: BLE001
                 context.abort(grpc.StatusCode.INTERNAL,

@@ -56,6 +56,12 @@ class DeploymentResponseGenerator:
     def __next__(self):
         return ray_tpu.get(next(self._gen))
 
+    def next(self, timeout=None):
+        """`__next__` with a per-item deadline (GetTimeoutError on
+        expiry) so proxy threads can't be pinned by a hung replica."""
+        ref = self._gen.next(timeout=timeout)
+        return ray_tpu.get(ref, timeout=timeout)
+
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str):

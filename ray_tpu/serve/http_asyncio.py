@@ -191,15 +191,18 @@ class AsyncHTTPProxy:
         False."""
         gen = self._get_handle(name).options(
             stream=True, multiplexed_model_id=mux).remote(data)
-        it = iter(gen)
         writer.write(b"HTTP/1.1 200 OK\r\n"
                      b"Content-Type: application/x-ndjson\r\n"
                      b"Transfer-Encoding: chunked\r\n\r\n")
         _SENTINEL = object()
 
         def pull():
+            # the timeout lives INSIDE the blocking call: a hung replica
+            # releases the pool thread after 600s (GetTimeoutError) —
+            # an outer asyncio.wait_for would free only the coroutine
+            # while the thread stayed pinned in next() forever
             try:
-                return next(it)
+                return gen.next(timeout=600.0)
             except StopIteration:
                 return _SENTINEL
         try:

@@ -552,6 +552,45 @@ class TestImageObs:
         # rewards after the first done must not leak into the old episode
         assert reward[0] == 2.0 and done[0]
 
+    def test_max_and_skip_no_pixel_leak_across_reset(self):
+        """An env done mid-window must return its post-reset frame
+        unmaxed — old-episode pixels must not bleed into the new
+        episode's first observation."""
+        from ray_tpu.rllib.env import VectorEnv
+        from ray_tpu.rllib.preprocessors import MaxAndSkipVec
+
+        class BrightThenDark(VectorEnv):
+            num_envs = 1
+            obs_dim = 4
+            num_actions = 2
+
+            def __init__(self):
+                self.t = 0
+
+            @property
+            def obs_shape(self):
+                return (2, 2, 1)
+
+            def reset(self, seed=None):
+                self.t = 0
+                return np.zeros((1, 2, 2, 1), np.uint8)
+
+            def step(self, actions):
+                self.t += 1
+                # bright frames until done at t==3 (the skip window's
+                # penultimate step), then the auto-reset episode is dark
+                done = np.array([self.t == 3])
+                val = 255 if self.t <= 3 else 7
+                return (np.full((1, 2, 2, 1), val, np.uint8),
+                        np.zeros(1, np.float32), done, {})
+
+        env = MaxAndSkipVec(BrightThenDark(), skip=4)
+        env.reset()
+        obs, _, done, _ = env.step(np.zeros(1, np.int64))
+        assert done[0]
+        # a max with the pre-reset frame would read 255 here
+        assert (obs[0] == 7).all()
+
     def test_breakout_shaped_tracker_beats_random(self):
         from ray_tpu.rllib.preprocessors import BreakoutShapedVecEnv
 
@@ -637,6 +676,9 @@ class TestSAC:
                 bp = b.learner.params["actor"]["w0"]
                 np.testing.assert_allclose(np.asarray(ap), np.asarray(bp))
                 assert float(b.learner.log_alpha) == float(a.learner.log_alpha)
+                # off-policy data rides along (same contract as DQN):
+                # a restored trial resumes warm, not from learning_starts
+                assert len(b.buffer) == len(a.buffer) > 0
             finally:
                 b.stop()
         finally:
