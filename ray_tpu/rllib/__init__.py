@@ -40,6 +40,10 @@ _EXPORTS = {
     "MaxAndSkipVec": "preprocessors",
     "PPOLearner": "learner", "ppo_loss": "learner",
     "RolloutWorker": "rollout_worker",
+    "PPOJax": "ppo_jax", "PPOJaxConfig": "ppo_jax",
+    "JaxVectorEnv": "jax_env", "CartPoleJax": "jax_env",
+    "BreakoutShapedJax": "jax_env", "make_jax_env": "jax_env",
+    "register_jax_env": "jax_env",
 }
 
 __all__ = sorted(_EXPORTS)

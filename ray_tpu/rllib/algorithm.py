@@ -137,9 +137,12 @@ class PPO:
             state = merge_deltas(self.obs_filter, deltas)
             for w in self.workers:
                 w.sync_filter.remote(state)
-        for rets in ray_tpu.get(
-                [w.episode_returns.remote() for w in self.workers],
-                timeout=60):
+        # one blocking round for both independent per-worker fetches
+        perf_refs = [w.perf_stats.remote() for w in self.workers]
+        ret_refs = [w.episode_returns.remote() for w in self.workers]
+        both = ray_tpu.get(perf_refs + ret_refs, timeout=60)
+        perf = both[:len(self.workers)]
+        for rets in both[len(self.workers):]:
             self._recent_returns.extend(rets)
             self._total_episodes += len(rets)
         self._recent_returns = self._recent_returns[-100:]
@@ -157,6 +160,10 @@ class PPO:
             "env_steps_per_sec": steps / max(1e-9, sample_time + learn_time),
             "sample_time_s": sample_time,
             "learn_time_s": learn_time,
+            # per-stage rollout breakdown (summed across workers): the
+            # remainder of sample_time is serialization + actor RPC
+            "rollout_env_time_s": sum(p["env_s"] for p in perf),
+            "rollout_infer_time_s": sum(p["infer_s"] for p in perf),
             **stats,
         }
 

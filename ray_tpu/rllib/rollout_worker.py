@@ -77,6 +77,7 @@ class RolloutWorker(EnvWorkerBase):
         self.lam = lam
         self.filter = make_connector(observation_filter,
                                      self.env.obs_shape)
+        self._perf = {"env_s": 0.0, "infer_s": 0.0}
 
     def filter_delta(self):
         """Stats accumulated since the last sync (merged centrally)."""
@@ -86,7 +87,18 @@ class RolloutWorker(EnvWorkerBase):
         self.filter.set_state(state)
         return True
 
+    def perf_stats(self, clear: bool = True) -> Dict[str, float]:
+        """Cumulative seconds spent in env.step vs policy inference since
+        the last call — the per-stage breakdown for locating the rollout
+        bottleneck (ref: rllib sampler perf_stats, metrics.py)."""
+        out = dict(self._perf)
+        if clear:
+            self._perf = {"env_s": 0.0, "infer_s": 0.0}
+        return out
+
     def sample(self, params: Dict) -> sb.Batch:
+        import time as _time
+
         params = ensure_numpy(params)  # one conversion, not one per step
         T, n = self.rollout_len, self.env.num_envs
         # a filter emits float32; only the pass-through keeps the env's
@@ -102,10 +114,14 @@ class RolloutWorker(EnvWorkerBase):
         obs = self._obs
         for t in range(T):
             fobs = self.filter(obs)  # connector: batches hold FILTERED obs
+            t0 = _time.perf_counter()
             actions, logp, values = sample_actions(params, fobs, self._rng)
+            self._perf["infer_s"] += _time.perf_counter() - t0
             obs_buf[t], act_buf[t] = fobs, actions
             logp_buf[t], val_buf[t] = logp, values
+            t1 = _time.perf_counter()  # buffer copies stay OUT of env_s
             obs, reward, done, info = self.env.step(actions)
+            self._perf["env_s"] += _time.perf_counter() - t1
             rew_buf[t], done_buf[t] = reward, done
             if done.any() and "truncated" in info:
                 # time-limit truncation is not termination: fold

@@ -22,6 +22,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Dict
 
 import ray_tpu
+from ray_tpu.exceptions import GetTimeoutError
 
 _PREFIX = "/ray_tpu.serve/"
 
@@ -124,6 +125,9 @@ class GrpcProxy:
                     except StopIteration:
                         break
                     yield json.dumps(_jsonable(item)).encode()
+            except GetTimeoutError as e:
+                context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                              f"stream item timed out: {e}")
             except Exception as e:  # noqa: BLE001
                 context.abort(grpc.StatusCode.INTERNAL,
                               f"{type(e).__name__}: {e}")

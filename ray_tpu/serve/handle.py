@@ -58,9 +58,15 @@ class DeploymentResponseGenerator:
 
     def next(self, timeout=None):
         """`__next__` with a per-item deadline (GetTimeoutError on
-        expiry) so proxy threads can't be pinned by a hung replica."""
+        expiry) so proxy threads can't be pinned by a hung replica. One
+        deadline spans both waits (ref arrival AND payload fetch) — two
+        full timeouts would double the documented cap."""
+        if timeout is None:
+            return ray_tpu.get(next(self._gen))
+        deadline = time.monotonic() + timeout
         ref = self._gen.next(timeout=timeout)
-        return ray_tpu.get(ref, timeout=timeout)
+        return ray_tpu.get(ref, timeout=max(0.0,
+                                            deadline - time.monotonic()))
 
 
 class DeploymentHandle:
