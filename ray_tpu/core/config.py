@@ -74,6 +74,18 @@ _define("rpc_handler_threads", 4,
         "request-handler threads per RpcChannel (worker/agent channels)")
 _define("node_server_threads", 16,
         "handler threads for a node's worker-facing RPC server")
+_define("capture_worker_logs", 1,
+        "tee every worker's stdout/stderr over its node channel into the "
+        "head's bounded log store (dashboard log view / state API); "
+        "0 = only remote workers forward, for console display")
+_define("worker_log_history", 4000,
+        "lines of worker stdout/stderr retained in the head's in-memory "
+        "log store (ring buffer)")
+_define("worker_task_prefetch", 16,
+        "max same-signature tasks pushed onto one leased worker's queue "
+        "(executed sequentially; only the lease's resources are held). "
+        "Keeps workers fed under burst and lets RPC frames coalesce — "
+        "set 1 to restore strict one-task-per-lease dispatch")
 _define("agent_server_threads", 32,
         "handler threads for the head's agent-facing TCP server (blocking "
         "fetches must not starve worker_call relays)")

@@ -19,7 +19,7 @@ _rand_local = threading.local()
 
 
 class BaseId:
-    __slots__ = ("_bytes",)
+    __slots__ = ("_bytes", "_hash")
     _kind = "Id"
 
     def __init__(self, id_bytes: bytes):
@@ -59,7 +59,18 @@ class BaseId:
         return self._bytes.hex()
 
     def __hash__(self):
-        return hash((self._kind, self._bytes))
+        # ids key every hot table; cache the hash (immutable value).
+        try:
+            return self._hash
+        except AttributeError:
+            h = self._hash = hash((self._kind, self._bytes))
+            return h
+
+    def __reduce__(self):
+        # NEVER pickle the cached hash: bytes hashing is salted per
+        # process (PYTHONHASHSEED), so a hash computed in a worker is
+        # wrong in the driver — equal ids would miss every dict lookup
+        return (type(self), (self._bytes,))
 
     def __eq__(self, other):
         return type(other) is type(self) and other._bytes == self._bytes

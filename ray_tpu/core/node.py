@@ -98,7 +98,12 @@ class Node:
         self._lock = threading.RLock()
         self._workers: Dict[WorkerId, WorkerHandle] = {}
         self._idle: deque = deque()
-        self._lease_queue: deque = deque()
+        # lease backlog bucketed by (demand, pg, env) signature: a burst
+        # of identical tasks is ONE bucket, so dispatch is O(#buckets)
+        # per event instead of O(backlog) — the 10k-queued envelope's
+        # second O(queue^2) cliff after the round-4 early-exit fix
+        # (ref: local_task_manager.cc tasks_to_dispatch_ per-class map)
+        self._lease_queue: Dict[tuple, deque] = {}
         self._bundles: Dict[tuple, _Bundle] = {}  # (pg_id, idx) -> bundle
         self._starting_count = 0
         self.alive = True
@@ -109,6 +114,7 @@ class Node:
                                  family="AF_UNIX")
         self._max_workers = max(int(config.num_workers_soft_limit),
                                 int(self.total_resources.get("CPU", 1)))
+        self._prefetch_depth = max(1, int(config.worker_task_prefetch))
         for _ in range(int(config.worker_prestart_count)):
             self._start_worker()
         # idle-worker reclamation (ref: worker_pool.cc idle worker killing;
@@ -147,7 +153,11 @@ class Node:
 
     def request_lease(self, spec: TaskSpec) -> Future:
         fut: Future = Future()
-        demand = normalize(spec.resources)
+        # submitters on the hot path pre-normalize (remote_function);
+        # decoded/foreign specs fall through to normalize here
+        demand = spec.__dict__.get("_demand")
+        if demand is None:
+            demand = normalize(spec.resources)
         pg = None
         strat = spec.scheduling_strategy
         if strat.kind == "PLACEMENT_GROUP" and strat.placement_group_id is not None:
@@ -161,8 +171,15 @@ class Node:
 
         req = _LeaseRequest(spec=spec, demand=demand, future=fut, pg=pg,
                             env_hash=_env_hash(spec.runtime_env))
+        dkey = spec.__dict__.get("_demand_key")
+        if dkey is None:
+            dkey = tuple(sorted(demand.items()))
+        # task type is part of the signature: lease reuse must never hand
+        # a busy task worker to an actor-creation request (push_task
+        # would flip it to state="actor" mid-stream)
+        sig = (dkey, req.pg, req.env_hash, spec.task_type)
         with self._lock:
-            self._lease_queue.append(req)
+            self._lease_queue.setdefault(sig, deque()).append(req)
         self._dispatch()
         return fut
 
@@ -182,62 +199,61 @@ class Node:
         return None
 
     def _dispatch(self) -> None:
-        """Grant queued leases that fit; start workers on demand."""
+        """Grant queued leases that fit; start workers on demand.
+
+        Per-bucket scan: every request in a bucket shares one (demand,
+        pg, env) signature, so the first head that can't be granted ends
+        that bucket — no per-request walk of the backlog."""
         grants = []
         with self._lock:
             if not self.alive:
                 return
-            remaining = deque()
-            while self._lease_queue:
-                req = self._lease_queue.popleft()
-                if req.future.cancelled():
-                    continue
-                if not self._fits(req):
-                    remaining.append(req)
-                    continue
-                worker = self._pop_idle(req.env_hash)
-                if worker is None:
-                    remaining.append(req)
-                    # blocked workers don't count toward the cap: each one
-                    # freed its resources and is waiting on work that may
-                    # only be runnable by a new worker (deep nested graphs)
-                    active = (len(self._workers) + self._starting_count
-                              - sum(1 for w in self._workers.values()
-                                    if w.blocked_depth > 0))
-                    if active >= self._max_workers:
-                        # cap reached but an idle worker bound to a
-                        # DIFFERENT runtime_env may be the blocker: evict
-                        # one to make room (ref: worker_pool.cc kills
-                        # idle workers of other envs under pressure)
-                        victim = next(
-                            (w for w in self._idle
-                             if w.state == "idle" and w.env_hash
-                             not in (None, req.env_hash)), None)
-                        if victim is not None:
-                            self._terminate_worker(victim)
-                            self._idle = deque(
-                                x for x in self._idle if x is not victim)
-                            active -= 1
-                    if active < self._max_workers or not self._workers:
-                        self._start_worker()
-                    elif not self._idle:
-                        # no idle worker of ANY env and no room to start
-                        # one: nothing later in the queue is grantable
-                        # either — stop scanning. Without this, every
-                        # lease/release event walked the whole backlog
-                        # (O(queue^2) across a burst; the first casualty
-                        # of the 10k-task envelope).
-                        remaining.extend(self._lease_queue)
-                        self._lease_queue.clear()
-                        break
-                    continue
-                self._take_resources(req)
-                worker.env_hash = req.env_hash  # dedicate on first grant
-                worker.state = "leased"
-                worker.lease_resources = req.demand
-                worker.lease_pg = req.pg
-                grants.append((req, worker))
-            self._lease_queue = remaining
+            for sig in list(self._lease_queue.keys()):
+                bucket = self._lease_queue[sig]
+                while bucket:
+                    req = bucket[0]
+                    if req.future.cancelled():
+                        bucket.popleft()
+                        continue
+                    if not self._fits(req):
+                        break  # same demand behind it: none of it fits
+                    worker = self._pop_idle(req.env_hash)
+                    if worker is None:
+                        # blocked workers don't count toward the cap:
+                        # each freed its resources and waits on work that
+                        # may only be runnable by a new worker
+                        active = (len(self._workers) + self._starting_count
+                                  - sum(1 for w in self._workers.values()
+                                        if w.blocked_depth > 0))
+                        if active >= self._max_workers:
+                            # cap reached but an idle worker bound to a
+                            # DIFFERENT runtime_env may be the blocker:
+                            # evict one to make room (ref: worker_pool.cc
+                            # idle-worker kill under pressure)
+                            victim = next(
+                                (w for w in self._idle
+                                 if w.state == "idle" and w.env_hash
+                                 not in (None, req.env_hash)), None)
+                            if victim is not None:
+                                self._terminate_worker(victim)
+                                self._idle = deque(
+                                    x for x in self._idle
+                                    if x is not victim)
+                                active -= 1
+                        if active < self._max_workers or not self._workers:
+                            self._start_worker()
+                        break  # this bucket needs a worker that isn't
+                        # here yet; other buckets (different env) may
+                        # still have one
+                    bucket.popleft()
+                    self._take_resources(req)
+                    worker.env_hash = req.env_hash  # dedicate on grant
+                    worker.state = "leased"
+                    worker.lease_resources = req.demand
+                    worker.lease_pg = req.pg
+                    grants.append((req, worker))
+                if not bucket:
+                    del self._lease_queue[sig]
         for req, worker in grants:
             req.future.set_result(worker)
 
@@ -451,7 +467,43 @@ class Node:
             return
         self.runtime.on_task_done(spec, payload, self.node_id, worker)
         if spec.task_type == TaskType.NORMAL_TASK:
-            self.release_lease(worker)
+            nxt = self._reuse_lease(worker)
+            if nxt:
+                # lease reuse (ref: direct_task_transport lease caching /
+                # local_task_manager same-scheduling-class dispatch): the
+                # next queued requests have the identical (demand, pg,
+                # env) signature, so the worker flows straight to them —
+                # no resource return, no dispatch scan, no new grant.
+                # Up to `prefetch` tasks ride one lease (executed
+                # sequentially by the worker; only the lease's own
+                # resources are held), which keeps the worker fed and
+                # lets both channel directions coalesce frames.
+                for req in nxt:
+                    req.future.set_result(worker)
+            elif not worker.in_flight:
+                self.release_lease(worker)
+
+    def _reuse_lease(self, worker: WorkerHandle) -> list:
+        out: list = []
+        with self._lock:
+            if not self.alive or worker.state != "leased" \
+                    or worker.channel is None or worker.channel.closed:
+                return out
+            want = self._prefetch_depth - len(worker.in_flight)
+            if want <= 0:
+                return out
+            sig = (tuple(sorted(worker.lease_resources.items())),
+                   worker.lease_pg, worker.env_hash or "",
+                   TaskType.NORMAL_TASK)  # reuse serves normal tasks only
+            bucket = self._lease_queue.get(sig)
+            while bucket and len(out) < want:
+                req = bucket.popleft()
+                if not bucket:
+                    del self._lease_queue[sig]
+                    bucket = None
+                if not req.future.cancelled():
+                    out.append(req)
+        return out
 
     # ---- placement group bundles: 2PC ----------------------------------------
     # (ref: node_manager.proto:380-384 PrepareBundleResources/CommitBundleResources)
@@ -490,7 +542,11 @@ class Node:
                 self._on_register(channel, payload)
                 with self._lock:
                     state["worker"] = self._workers.get(payload["worker_id"])
-                return True
+                # local workers tee stdout/stderr too when the head keeps
+                # a log store (dashboard log view); lines still reach the
+                # console through the tee's original stream
+                return {"forward_logs":
+                        bool(int(self.config.capture_worker_logs))}
             worker: Optional[WorkerHandle] = state["worker"]
             if method == "task_done":
                 if worker is not None:
@@ -529,7 +585,7 @@ class Node:
 
     def queue_len(self) -> int:
         with self._lock:
-            return len(self._lease_queue)
+            return sum(len(b) for b in self._lease_queue.values())
 
     def kill_worker(self, worker: WorkerHandle, force: bool = True) -> None:
         try:
@@ -547,7 +603,7 @@ class Node:
                 return
             self.alive = False
             workers = list(self._workers.values())
-            queued = list(self._lease_queue)
+            queued = [r for b in self._lease_queue.values() for r in b]
             self._lease_queue.clear()
         for req in queued:
             if not req.future.done():

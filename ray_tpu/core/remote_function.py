@@ -81,6 +81,12 @@ class RemoteFunction:
             if k not in _VALID_OPTIONS:
                 raise ValueError(f"Invalid @remote option {k!r}")
         self._func_ids: Dict[str, str] = {}  # runtime worker_id.hex -> func_id
+        # per-runtime wire template + normalized demand: every spec this
+        # function submits shares its constant fields, so they encode once
+        self._wire_tmpls: Dict[str, tuple] = {}
+        self._consts: Dict[str, dict] = {}
+        self._norm_demand: Optional[Dict[str, float]] = None
+        self._demand_key: Optional[tuple] = None
         functools.update_wrapper(self, fn)
 
     def options(self, **overrides) -> "RemoteFunction":
@@ -99,28 +105,57 @@ class RemoteFunction:
             func_id = rt.export_function(self._fn)
             self._func_ids[rt_key] = func_id
         sargs, skwargs = prepare_args(rt, args, kwargs)
-        num_returns = self._options.get("num_returns", 1)
-        if num_returns == "streaming":
-            num_returns = STREAMING_RETURNS
-        num_returns = int(num_returns)
+        # constants of this (function, options, runtime) resolved once —
+        # the submit loop is the head-throughput envelope's hot path
+        consts = self._consts.get(rt_key)
+        if consts is None:
+            num_returns = self._options.get("num_returns", 1)
+            if num_returns == "streaming":
+                num_returns = STREAMING_RETURNS
+            consts = {
+                "job_id": getattr(rt, "job_id", None) or _job_of(rt),
+                "description": (self._options.get("name")
+                                or getattr(self._fn, "__name__", "fn")),
+                "num_returns": int(num_returns),
+                "resources": resolve_resources(self._options),
+                "max_retries": int(self._options.get(
+                    "max_retries", cfg.task_max_retries)),
+                "retry_exceptions": bool(self._options.get(
+                    "retry_exceptions", False)),
+                "scheduling_strategy": resolve_strategy(self._options),
+                "runtime_env": rt.prepare_runtime_env(
+                    self._options.get("runtime_env")),
+            }
+            self._consts[rt_key] = consts
         spec = TaskSpec(
             task_id=rt.new_task_id(),
-            job_id=getattr(rt, "job_id", None) or _job_of(rt),
             task_type=TaskType.NORMAL_TASK,
             func_id=func_id,
-            description=self._options.get("name") or getattr(self._fn, "__name__", "fn"),
             args=sargs,
             kwargs=skwargs,
-            num_returns=num_returns,
-            resources=resolve_resources(self._options),
-            max_retries=int(self._options.get("max_retries", cfg.task_max_retries)),
-            retry_exceptions=bool(self._options.get("retry_exceptions", False)),
-            scheduling_strategy=resolve_strategy(self._options),
-            runtime_env=rt.prepare_runtime_env(
-                self._options.get("runtime_env")),
             trace_ctx=_trace_ctx(),
+            **consts,
         )
+        tmpl = self._wire_tmpls.get(rt_key)
+        if tmpl is None:
+            from . import wire
+
+            tmpl = wire.make_struct_template(
+                spec, ("task_id", "args", "kwargs", "trace_ctx"))
+            self._wire_tmpls[rt_key] = tmpl
+        spec._wire_tmpl = tmpl
+        if self._norm_demand is None:
+            from .resources import normalize
+
+            # publish _demand_key FIRST: a racing second submission
+            # branches on _norm_demand and then reads _demand_key
+            nd = normalize(spec.resources)
+            self._demand_key = tuple(sorted(nd.items()))
+            self._norm_demand = nd
+        spec._demand = self._norm_demand
+        spec._demand_key = self._demand_key
         refs = rt.submit_spec(spec)
+        num_returns = consts["num_returns"]
         if num_returns == STREAMING_RETURNS:
             from .object_ref import ObjectRefGenerator
 

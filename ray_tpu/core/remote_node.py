@@ -118,9 +118,10 @@ class RemoteNode(Node):
         self._lock = threading.RLock()
         self._workers: Dict[WorkerId, WorkerHandle] = {}
         self._idle = deque()
-        self._lease_queue = deque()
+        self._lease_queue = {}  # (demand, pg, env) sig -> deque (Node's shape)
         self._bundles = {}
         self._starting_count = 0
+        self._prefetch_depth = max(1, int(config.worker_task_prefetch))
         self.alive = True
         self.channel = channel
         self.peer_addr = None  # agent's P2P object-server (host, port)
@@ -242,7 +243,7 @@ class RemoteNode(Node):
             if not self.alive:
                 return
             self.alive = False
-            queued = list(self._lease_queue)
+            queued = [r for b in self._lease_queue.values() for r in b]
             self._lease_queue.clear()
         for req in queued:
             if not req.future.done():

@@ -20,6 +20,12 @@ from multiprocessing.connection import Client, Connection, Listener
 from typing import Any, Callable, Dict, Optional
 
 _REQ, _RESP, _ERR, _ONEWAY = 0, 1, 2, 3
+# a coalesced frame: payload is a list of already-encoded frames. Under
+# burst (task pushes, done floods) the writer drains its queue into one
+# send and the reader dispatches the whole batch with one wakeup —
+# syscalls and thread hops amortize across the batch
+_BATCH = 4
+_BATCH_MAX = 64
 _CLOSE = object()  # writer-thread sentinel
 
 # per-handler instrumentation (ref: the reference's per-RPC gRPC stats,
@@ -152,28 +158,57 @@ class RpcChannel:
                 # typed frames, never pickle: see wire.py (the reference's
                 # control plane is protobuf/gRPC; pickle framing here was
                 # an RCE amplifier behind one shared token)
-                self._conn.send_bytes(wire.encode(msg))
-            except wire.WireEncodeError as e:
-                traceback.print_exc()
-                # one bad payload must not kill the channel — but it must
-                # not strand its correlated future either: fail a _REQ's
-                # future locally; answer a _RESP's caller with an _ERR
-                kind, msg_id = msg[0], msg[1]
-                if kind == _REQ:
-                    with self._lock:
-                        fut = self._pending.pop(msg_id, None)
-                    if fut is not None and not fut.done():
-                        fut.set_exception(e)
-                elif kind == _RESP:
+                frame = wire.encode(msg)
+                extra = []
+                close_after = False
+                while len(extra) < _BATCH_MAX - 1:
                     try:
-                        self._conn.send_bytes(wire.encode(
-                            (_ERR, msg_id, f"WireEncodeError: {e}", "")))
-                    except Exception:
-                        pass
+                        nxt = self._out_q.get_nowait()
+                    except queue_mod.Empty:
+                        break
+                    if nxt is _CLOSE:
+                        close_after = True
+                        break
+                    try:
+                        extra.append(wire.encode(nxt))
+                    except wire.WireEncodeError:
+                        traceback.print_exc()
+                        self._fail_encode(nxt)
+                if extra:
+                    self._conn.send_bytes(
+                        wire.encode((_BATCH, 0, None, [frame, *extra])))
+                else:
+                    self._conn.send_bytes(frame)
+                if close_after:
+                    return
+            except wire.WireEncodeError:
+                traceback.print_exc()
+                self._fail_encode(msg)
                 continue
             except Exception:
                 self._teardown()
                 return
+
+    def _fail_encode(self, msg) -> None:
+        """One bad payload must not kill the channel — but it must not
+        strand its correlated future either: fail a _REQ's future
+        locally; answer a _RESP's caller with an _ERR."""
+        from . import wire
+
+        kind, msg_id = msg[0], msg[1]
+        if kind == _REQ:
+            with self._lock:
+                fut = self._pending.pop(msg_id, None)
+            if fut is not None and not fut.done():
+                fut.set_exception(wire.WireEncodeError(
+                    f"payload for {msg[2]!r} not wire-encodable"))
+        elif kind == _RESP:
+            try:
+                self._conn.send_bytes(wire.encode(
+                    (_ERR, msg_id, "WireEncodeError: unencodable response",
+                     "")))
+            except Exception:
+                pass
 
     # -- server side -----------------------------------------------------------
 
@@ -202,28 +237,81 @@ class RpcChannel:
                     # would have executed it on recv)
                     traceback.print_exc()
                     continue
-                if kind == _RESP:
-                    with self._lock:
-                        fut = self._pending.pop(msg_id, None)
-                    if fut is not None:
-                        fut.set_result(b)
-                elif kind == _ERR:
-                    with self._lock:
-                        fut = self._pending.pop(msg_id, None)
-                    if fut is not None:
-                        fut.set_exception(_RemoteCallError(a, b))
-                elif kind == _REQ:
-                    try:
-                        self._pool.submit(self._handle, msg_id, a, b)
-                    except RuntimeError:
-                        break  # pool shut down: channel is closing
-                elif kind == _ONEWAY:
-                    try:
-                        self._oneway_pool.submit(self._handle_oneway, a, b)
-                    except RuntimeError:
+                if kind == _BATCH:
+                    if not self._dispatch_batch(b):
                         break
+                elif not self._dispatch_frame(kind, msg_id, a, b):
+                    break
         finally:
             self._teardown()
+
+    def _dispatch_batch(self, frames) -> bool:
+        """Decode and dispatch a writer-coalesced batch. Consecutive
+        oneways run as ONE pool item (they are FIFO on the oneway lane
+        anyway) so a 64-frame done-flood costs one thread hop."""
+        from . import wire
+
+        if not isinstance(frames, (list, tuple)):
+            return True  # malformed batch body: drop
+        oneway_run: list = []
+
+        def flush_oneways() -> bool:
+            if not oneway_run:
+                return True
+            run = list(oneway_run)
+            oneway_run.clear()
+            try:
+                self._oneway_pool.submit(self._handle_oneway_many, run)
+            except RuntimeError:
+                return False
+            return True
+
+        for data in frames:
+            try:
+                kind, msg_id, a, b = wire.decode(data)
+                if not isinstance(kind, int) or not isinstance(msg_id, int):
+                    raise wire.WireDecodeError("bad frame header")
+            except (wire.WireDecodeError, ValueError, TypeError):
+                traceback.print_exc()
+                continue
+            if kind == _ONEWAY:
+                oneway_run.append((a, b))
+                continue
+            if not flush_oneways():
+                return False
+            if kind == _BATCH:
+                continue  # no nesting
+            if not self._dispatch_frame(kind, msg_id, a, b):
+                return False
+        return flush_oneways()
+
+    def _dispatch_frame(self, kind: int, msg_id: int, a, b) -> bool:
+        """Route one decoded frame; False = channel is closing."""
+        if kind == _RESP:
+            with self._lock:
+                fut = self._pending.pop(msg_id, None)
+            if fut is not None:
+                fut.set_result(b)
+        elif kind == _ERR:
+            with self._lock:
+                fut = self._pending.pop(msg_id, None)
+            if fut is not None:
+                fut.set_exception(_RemoteCallError(a, b))
+        elif kind == _REQ:
+            try:
+                self._pool.submit(self._handle, msg_id, a, b)
+            except RuntimeError:
+                return False  # pool shut down: channel is closing
+        elif kind == _ONEWAY:
+            try:
+                self._oneway_pool.submit(self._handle_oneway, a, b)
+            except RuntimeError:
+                return False
+        return True
+
+    def _handle_oneway_many(self, items) -> None:
+        for a, b in items:
+            self._handle_oneway(a, b)
 
     def _handle(self, msg_id: int, method: str, payload: Any) -> None:
         t0 = time.perf_counter()

@@ -34,7 +34,7 @@ from .ids import ActorId, JobId, NodeId, ObjectId, PlacementGroupId, TaskId, Wor
 from .node import Node, WorkerHandle
 from .object_ref import ObjectRef
 from .object_store import SegmentReader
-from .resources import ResourceSet, normalize
+from .resources import ResourceSet, normalize, res_ge
 from .scheduling_policy import NodeView, Scheduler
 from .task_manager import ReferenceCounter, TaskManager
 from .task_spec import (ARG_REF, ARG_VALUE, STREAMING_RETURNS,
@@ -127,6 +127,16 @@ class DriverRuntime:
         self._pg_pending: "collections.deque[PlacementGroupId]" = collections.deque()
         self._pg_parked: Set[PlacementGroupId] = set()
         self._recovering: Set[ObjectId] = set()
+        # return-object id -> ObjectIds of refs nested in its result
+        # (pinned until the return object is freed; borrower protocol)
+        self._nested_refs: Dict[ObjectId, list] = {}
+        # bounded worker stdout/stderr store (dashboard log view;
+        # ref: dashboard/modules/log/log_manager.py — there files+agents,
+        # here the lines already ride the worker channels)
+        from collections import deque as _deque
+
+        self._worker_logs: _deque = _deque(
+            maxlen=int(self.config.worker_log_history))
         self._pull_futures: Dict[ObjectId, Future] = {}
         self._generators: Dict[TaskId, dict] = {}
         self._released_generators: Set[TaskId] = set()
@@ -412,7 +422,7 @@ class DriverRuntime:
                 return
             node.alive = False
             workers = list(node._workers.values())
-            queued = list(node._lease_queue)
+            queued = [r for b in node._lease_queue.values() for r in b]
             node._lease_queue.clear()
         from ..exceptions import WorkerCrashedError
 
@@ -582,10 +592,14 @@ class DriverRuntime:
             self._events.pop(oid, None)
             self._obj_sizes.pop(oid, None)
             nodes = [self.nodes.get(n) for n in copies]
+            nested = self._nested_refs.pop(oid, [])
         for node in nodes:
             if node is not None:
                 node.store.delete(oid)
         self.refcount.forget(oid)
+        # the return object dies -> its nested-result borrows unpin
+        for inner in nested:
+            self.refcount.remove_local(inner)
 
     def free(self, refs: Sequence[ObjectRef]) -> None:
         for r in refs:
@@ -862,7 +876,9 @@ class DriverRuntime:
 
     def _schedule(self, spec: TaskSpec) -> None:
         strat = spec.scheduling_strategy
-        demand = normalize(spec.resources)
+        demand = spec.__dict__.get("_demand")
+        if demand is None:
+            demand = normalize(spec.resources)
         node: Optional[Node] = None
         if strat.kind == "PLACEMENT_GROUP" and strat.placement_group_id is not None:
             pg = self.gcs.get_pg(strat.placement_group_id)
@@ -889,6 +905,13 @@ class DriverRuntime:
                 if n is not None and n.alive:
                     node = n
                     break
+        elif strat.kind == "DEFAULT" and len(self.nodes) == 1:
+            # single-node fast path: locality and hybrid scoring are
+            # cross-node concerns; the only question is feasibility
+            # (infeasible demand still parks, same as pick_node=None)
+            n = next(iter(self.nodes.values()))
+            node = n if (n.alive and res_ge(n.total_resources, demand)) \
+                else None
         else:
             if strat.kind == "NODE_AFFINITY" and not strat.soft:
                 target = self.nodes.get(strat.node_id)
@@ -1057,10 +1080,12 @@ class DriverRuntime:
         """Start-of-execution event: pairs with the FINISHED/FAILED event
         to give the timeline durations (ref: task_event_buffer.h:199 state
         transitions feeding GcsTaskManager)."""
-        self.gcs.add_task_event({
-            "task_id": spec.task_id.hex(), "name": spec.description,
-            "state": "RUNNING", "node_id": node_id.hex(),
-            "time": time.time()})
+        ev = {"task_id": spec.task_id.hex(), "name": spec.description,
+              "state": "RUNNING", "node_id": node_id.hex(),
+              "time": time.time()}
+        if spec.actor_id is not None:
+            ev["actor_id"] = spec.actor_id.hex()  # drill-down join key
+        self.gcs.add_task_event(ev)
 
     def _fail_task(self, spec: TaskSpec, error: Exception) -> None:
         self.task_manager.fail(spec.task_id)
@@ -1099,6 +1124,26 @@ class DriverRuntime:
                 self._on_actor_creation_failed(spec, node_id, worker)
         else:
             results = payload.get("results") or []
+            borrowed = payload.get("borrowed") or []
+            if borrowed and spec.num_returns > 0:
+                # refs nested inside EACH return value borrow through
+                # THAT return object: pin them for its lifetime so the
+                # producing worker dropping its own ref (function exit)
+                # can't free them before the caller deserializes
+                # (borrower protocol; ref: reference_count.h:61
+                # nested-ref ownership). `borrowed` aligns with
+                # return_ids; a legacy flat list pins through ret 0.
+                rids = spec.return_ids()
+                if borrowed and not isinstance(borrowed[0], list):
+                    borrowed = [list(borrowed)]
+                with self._lock:
+                    for rid, nested in zip(rids, borrowed):
+                        if nested:
+                            self._nested_refs.setdefault(
+                                rid, []).extend(nested)
+                for nested in borrowed:
+                    for oid in nested:
+                        self.refcount.add_local(oid)
             for oid, res in zip(spec.return_ids(), results):
                 if res[0] == "inline":
                     self.store_inline_bytes(oid, res[1])
@@ -1110,11 +1155,12 @@ class DriverRuntime:
                 self._on_actor_created(spec, node_id, worker)
         for ref in spec.arg_refs():
             self.refcount.unpin_for_task(ref.id)
-        self.gcs.add_task_event({
-            "task_id": spec.task_id.hex(), "name": spec.description,
-            "state": "FAILED" if error is not None else "FINISHED",
-            "node_id": node_id.hex(), "time": time.time(),
-        })
+        ev = {"task_id": spec.task_id.hex(), "name": spec.description,
+              "state": "FAILED" if error is not None else "FINISHED",
+              "node_id": node_id.hex(), "time": time.time()}
+        if spec.actor_id is not None:
+            ev["actor_id"] = spec.actor_id.hex()
+        self.gcs.add_task_event(ev)
 
     def on_worker_crashed(self, spec: TaskSpec, node_id: NodeId) -> None:
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
@@ -1593,6 +1639,22 @@ class DriverRuntime:
 
         return on_block, unblock
 
+    def recent_logs(self, worker_id: Optional[str] = None,
+                    node_id: Optional[str] = None,
+                    pid: Optional[int] = None,
+                    limit: int = 500) -> list:
+        """Tail of the worker stdout/stderr store, optionally filtered
+        (dashboard log view / `util.state.recent_logs`)."""
+        with self._lock:
+            rows = list(self._worker_logs)
+        if worker_id:
+            rows = [r for r in rows if r["worker_id"].startswith(worker_id)]
+        if node_id:
+            rows = [r for r in rows if r["node_id"].startswith(node_id)]
+        if pid:
+            rows = [r for r in rows if r["pid"] == pid]
+        return rows[-limit:]
+
     def handle_worker_call(self, node: Node, worker: Optional[WorkerHandle],
                            method: str, payload):
         if method == "get_objects":
@@ -1747,7 +1809,18 @@ class DriverRuntime:
         if method == "worker_log":
             # remote workers' stdout/stderr surface on the driver console
             # with a provenance prefix (ref: log_monitor.py -> driver
-            # stdout with the (name pid=..., ip=...) prefix)
+            # stdout with the (name pid=..., ip=...) prefix); every
+            # forwarded line also lands in the bounded log store that
+            # backs the dashboard's log view and util.state.recent_logs
+            now = time.time()
+            wid = worker.worker_id.hex() if worker is not None else ""
+            with self._lock:
+                for line in payload.get("lines", ()):
+                    self._worker_logs.append(
+                        {"t": now, "node_id": node.node_id.hex(),
+                         "worker_id": wid, "pid": payload.get("pid"),
+                         "stream": payload.get("stream", "stdout"),
+                         "line": line})
             if getattr(node, "is_remote", False):
                 import sys as _sys
 

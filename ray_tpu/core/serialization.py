@@ -63,29 +63,36 @@ class SerializedObject:
         return bytes(out)
 
 
+class _RefTrackingPickler(cloudpickle.CloudPickler):
+    """Tracks ObjectRefs serialized inside the value (borrowing protocol
+    hook). Defined once at module level — building a class object per
+    serialize() call cost ~15us on the task hot path."""
+
+    def __init__(self, f, contained_refs, **kw):
+        super().__init__(f, **kw)
+        self._contained_refs = contained_refs
+
+    def persistent_id(self, obj):  # noqa: N802
+        return None
+
+    def reducer_override(self, obj):
+        from .object_ref import ObjectRef  # local import to avoid cycle
+
+        if isinstance(obj, ObjectRef):
+            self._contained_refs.append(obj)
+        sup = super()
+        return sup.reducer_override(obj) \
+            if hasattr(sup, "reducer_override") else NotImplemented
+
+
 def serialize(value: Any) -> SerializedObject:
-    buffers: List[pickle.PickleBuffer] = []
-    contained_refs: list = []
-
-    # Track ObjectRefs serialized inside the value (borrowing protocol hook).
-    from .object_ref import ObjectRef  # local import to avoid cycle
-
-    def _reducer_override(obj):
-        return NotImplemented
-
-    class _Pickler(cloudpickle.CloudPickler):
-        def persistent_id(self, obj):  # noqa: N802
-            return None
-
-        def reducer_override(self, obj):
-            if isinstance(obj, ObjectRef):
-                contained_refs.append(obj)
-            return super().reducer_override(obj) if hasattr(super(), "reducer_override") else NotImplemented
-
     import io
 
+    buffers: List[pickle.PickleBuffer] = []
+    contained_refs: list = []
     f = io.BytesIO()
-    p = _Pickler(f, protocol=_PROTOCOL, buffer_callback=buffers.append)
+    p = _RefTrackingPickler(f, contained_refs, protocol=_PROTOCOL,
+                            buffer_callback=buffers.append)
     p.dump(value)
     views = [b.raw() for b in buffers]
     return SerializedObject(f.getvalue(), views, contained_refs)

@@ -79,8 +79,18 @@ class TaskSpec:
     trace_ctx: Optional[tuple] = None
 
     def return_ids(self) -> List[ObjectId]:
-        # STREAMING_RETURNS (-1): ids are minted per yielded item instead
-        return [ObjectId.for_task_return(self.task_id, i) for i in range(self.num_returns)]
+        # STREAMING_RETURNS (-1): ids are minted per yielded item instead.
+        # Memoized per task_id: the submit path asks three times per
+        # task. The cache is keyed on the id because actor restart
+        # copy.copy()s the creation spec and reassigns task_id — a bare
+        # memo would hand the restarted task the ORIGINAL return ids.
+        cached = self.__dict__.get("_rids")
+        if cached is not None and cached[0] is self.task_id:
+            return cached[1]
+        rids = [ObjectId.for_task_return(self.task_id, i)
+                for i in range(self.num_returns)]
+        self.__dict__["_rids"] = (self.task_id, rids)
+        return rids
 
     def arg_refs(self) -> List[ObjectRef]:
         refs = [a[1] for a in self.args if a[0] == ARG_REF]

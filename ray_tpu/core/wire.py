@@ -157,10 +157,50 @@ def _encode_value(buf: bytearray, v: Any) -> None:
             raise WireEncodeError(
                 f"type {t.__module__}.{t.__name__} is not wire-encodable; "
                 f"register it in core/wire.py or send it as bytes")
+        tmpl = getattr(v, "_wire_tmpl", None)
+        if tmpl is not None:
+            # template fast path (TaskSpec hot loop): constant fields of
+            # a RemoteFunction's specs are pre-encoded once; per call
+            # only the varying fields (task_id, args, ...) are walked —
+            # ~5 value encodes instead of ~40 per pushed task
+            buf.append(_T_STRUCT)
+            buf += _PACK_H(sid)
+            buf.append(_T_TUPLE)
+            buf += _PACK_I(tmpl[0])
+            for const, name in tmpl[1]:
+                buf += const
+                if name is not None:
+                    _encode_value(buf, getattr(v, name))
+            return
         _, enc, _ = _BY_ID[sid]
         buf.append(_T_STRUCT)
         buf += _PACK_H(sid)
         _encode_value(buf, tuple(enc(v)))
+
+
+def make_struct_template(obj, varying: tuple) -> tuple:
+    """Pre-encode the constant fields of a registered dataclass struct.
+
+    Returns (field_count, ((const_bytes, varying_name_or_None), ...)) for
+    the _wire_tmpl fast path in _encode_value. `varying` names are
+    re-encoded per call from the live object; every other field is
+    frozen to the bytes of its value on `obj` NOW — callers guarantee
+    those fields are identical for every object carrying this template
+    (RemoteFunction options are fixed at construction, so its specs
+    qualify)."""
+    import dataclasses
+
+    names = [f.name for f in dataclasses.fields(type(obj))]
+    segs = []
+    buf = bytearray()
+    for name in names:
+        if name in varying:
+            segs.append((bytes(buf), name))
+            buf = bytearray()
+        else:
+            _encode_value(buf, getattr(obj, name))
+    segs.append((bytes(buf), None))
+    return (len(names), tuple(segs))
 
 
 class _Reader:
@@ -260,7 +300,9 @@ def encode(obj: Any) -> bytes:
     return bytes(buf)
 
 
-def decode(data: bytes) -> Any:
+def decode_py(data: bytes) -> Any:
+    """Pure-Python decoder — the semantics reference and the fallback
+    when the C extension can't build."""
     if len(data) < 3 or data[:2] != MAGIC:
         raise WireDecodeError("bad magic: not a ray_tpu control frame")
     if data[2] != VERSION:
@@ -271,6 +313,39 @@ def decode(data: bytes) -> Any:
     if r.pos != len(data):
         raise WireDecodeError("trailing bytes after frame")
     return out
+
+
+def _struct_from_wire(sid: int, vals: tuple) -> Any:
+    """Registry dispatch for the C decoder (same error contract as the
+    _T_STRUCT branch of _decode_value)."""
+    entry = _BY_ID.get(sid)
+    if entry is None:
+        raise WireDecodeError(f"unknown struct id {sid}")
+    try:
+        return entry[2](vals)
+    except WireDecodeError:
+        raise
+    except Exception as e:
+        raise WireDecodeError(f"bad struct {sid} fields: {e!r}") from e
+
+
+decode = decode_py
+
+
+def _try_native_decode() -> None:
+    """Swap in the C decode path (ray_tpu/native/wirefast.c) when it
+    builds; ~5-10x on TaskSpec-shaped frames, bit-compatible by test."""
+    global decode
+    try:
+        from ..native import load_wirefast
+
+        mod = load_wirefast()
+    except Exception:
+        return
+    if mod is None:
+        return
+    mod.init(WireDecodeError, _struct_from_wire)
+    decode = mod.decode
 
 
 # ---------------------------------------------------------------------------
@@ -313,3 +388,4 @@ def _register_defaults() -> None:
 
 
 _register_defaults()
+_try_native_decode()

@@ -355,9 +355,16 @@ class WorkerProcess:
                 )
                 return
         results = []
+        borrowed = []  # aligned with results: [[oids], ...] per return
         return_ids = spec.return_ids()
         for oid, value in zip(return_ids, outs):
             sobj = serialization.serialize(value)
+            # refs nested inside EACH return value: the head pins them
+            # until THAT return object dies, or this worker's own ref
+            # dropping (function exit) can free them before the caller
+            # deserializes — the borrower-protocol gap a GC cycle used
+            # to mask (see on_task_done's nested-ref pin)
+            borrowed.append([r.id for r in sobj.contained_refs])
             if sobj.total_bytes <= cfg.max_direct_call_object_size:
                 results.append(("inline", sobj.to_bytes()))
             else:
@@ -369,11 +376,10 @@ class WorkerProcess:
                 self.reader.release(name)
                 self.channel.call("seal_object", {"object_id": oid})
                 results.append(("stored", None))
-        self.channel.notify("task_done", {
-            "task_id": spec.task_id,
-            "results": results,
-            "error": None,
-        })
+        msg = {"task_id": spec.task_id, "results": results, "error": None}
+        if any(borrowed):
+            msg["borrowed"] = borrowed
+        self.channel.notify("task_done", msg)
 
     def _stream_generator(self, spec: TaskSpec, result: Any) -> None:
         """Iterate the task's generator, reporting each item as it is
@@ -478,7 +484,23 @@ def main() -> None:
         return  # node shut down while we were starting; exit quietly
     wp = WorkerProcess(channel, worker_id, args.node_id)
     channel.set_handler(wp.handle)
-    channel.on_close(lambda: os._exit(0))
+    if os.environ.get("RTPU_WORKER_PROFILE"):
+        # perf debugging: dump this worker's cProfile stats on exit
+        import atexit
+        import cProfile
+        import pstats
+
+        prof = cProfile.Profile()
+        prof.enable()
+
+        def _dump(pid=os.getpid()):
+            prof.disable()
+            pstats.Stats(prof).dump_stats(
+                os.environ["RTPU_WORKER_PROFILE"] + f".{pid}")
+        atexit.register(_dump)
+        channel.on_close(lambda: (_dump(), os._exit(0)))
+    else:
+        channel.on_close(lambda: os._exit(0))
     resp = channel.call("register", {"worker_id": worker_id,
                                      "pid": os.getpid()}, timeout=30)
     if isinstance(resp, dict) and resp.get("forward_logs"):

@@ -55,6 +55,16 @@ _API = {
     "rpc": state_api.rpc_method_stats,
     "jobs": _jobs_rows,
     "serve": _serve_rows,
+    "logs": lambda: state_api.recent_logs(limit=400),
+    "timeline": state_api.timeline,
+}
+
+# parameterized drill-downs: /api/actor/<id>, /api/task/<id>,
+# /api/logs/<worker_id_prefix>
+_API_ONE = {
+    "actor": state_api.actor_detail,
+    "task": state_api.task_detail,
+    "logs": lambda wid: state_api.recent_logs(worker_id=wid, limit=400),
 }
 
 _HISTORY_LEN = 120  # 2s cadence -> 4 minutes of sparkline
@@ -124,14 +134,17 @@ svg.spark{vertical-align:middle}
 .empty{color:#99a;font-size:12px;padding:12px}
 </style></head><body>
 <header><h1>ray_tpu</h1><nav id=nav></nav>
+<a href="/api/timeline" download="timeline.json"
+   style="font-size:11px;color:#8bf;margin-left:8px">timeline</a>
 <span id=updated style="margin-left:auto;font-size:11px;color:#889"></span></header>
 <main id=main></main>
 <script>
-const TABS=["overview","nodes","actors","tasks","placement_groups","objects","jobs","serve"];
-let tab="overview", filter="";
+const TABS=["overview","nodes","actors","tasks","placement_groups","objects","jobs","serve","logs"];
+let tab="overview", filter="", detail=null;
 const nav=document.getElementById("nav");
 TABS.forEach(t=>{const b=document.createElement("button");b.textContent=t.replace("_"," ");
- b.onclick=()=>{tab=t;render()};b.id="tab_"+t;nav.appendChild(b)});
+ b.onclick=()=>{tab=t;detail=null;render()};b.id="tab_"+t;nav.appendChild(b)});
+function openDetail(kind,id){detail={kind,id};render()}
 function esc(s){return String(s??"").replace(/[&<>"']/g,c=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]))}
 async function api(p){const r=await fetch("/api/"+p);return r.json()}
 function spark(vals,w=140,h=28){if(!vals.length)return "";
@@ -143,14 +156,46 @@ function table(rows){if(!rows||!rows.length)return "<div class=empty>none</div>"
  let html="<table><tr>"+cols.map(c=>`<th>${esc(c)}</th>`).join("")+"</tr>";
  for(const r of rows.slice(0,200)){html+="<tr>"+cols.map(c=>{
   let v=r[c];if(v&&typeof v==="object")v=JSON.stringify(v);
+  // drill-down links: actor/task ids open their detail page
+  if(c==="actor_id"&&v)return `<td><a href="#" onclick="openDetail('actor','${esc(v)}');return false">${esc(v)}</a></td>`;
+  if(c==="task_id"&&v)return `<td><a href="#" onclick="openDetail('task','${esc(v)}');return false">${esc(v)}</a></td>`;
   return `<td title="${esc(v)}">${esc(v)}</td>`}).join("")+"</tr>"}
  return html+"</table>"}
+function logLines(rows){if(!rows||!rows.length)return "<div class=empty>no captured output</div>";
+ return "<pre style='background:#fff;border:1px solid #e2e5e9;padding:8px;font-size:11px;overflow:auto;max-height:480px'>"+
+  rows.map(r=>`<span style="color:#99a">${new Date(r.t*1000).toLocaleTimeString()} [${esc((r.worker_id||"").slice(0,8))} pid=${esc(r.pid)}${r.stream==="stderr"?" err":""}]</span> ${esc(r.line)}`).join("\\n")+"</pre>"}
 function card(k,v,extra=""){return `<div class=card><div class=v>${esc(v)}</div><div class=k>${esc(k)}</div>${extra}</div>`}
 async function render(){
  TABS.forEach(t=>document.getElementById("tab_"+t).classList.toggle("active",t===tab));
  const main=document.getElementById("main");
  try{
-  if(tab==="overview"){
+  if(detail){
+   const d=await api(detail.kind+"/"+detail.id);
+   let html=`<button onclick="detail=null;render()" style="margin-bottom:10px">&larr; back</button>`;
+   if(!d){html+="<div class=empty>not found</div>";main.innerHTML=html;return}
+   if(detail.kind==="actor"){
+    html+=`<h3 style="font-size:14px">actor ${esc(d.actor_id)} — ${esc(d.class_name)} (${esc(d.state)})</h3>`;
+    html+=table([{name:d.name,namespace:d.namespace,node:d.node_id,worker:d.worker_id,
+                  restarts:d.num_restarts,detached:d.detached,death_cause:d.death_cause}]);
+    html+=`<h4 style="font-size:12px">recent task events</h4>`+table(d.recent_events);
+    html+=`<h4 style="font-size:12px">worker logs</h4>`+logLines(d.logs);
+   } else {
+    html+=`<h3 style="font-size:14px">task ${esc(d.task_id)} — ${esc(d.name)}</h3>`;
+    if(d.pending)html+=table([d.pending]);
+    html+=`<h4 style="font-size:12px">state transitions</h4>`+table(d.events);
+   }
+   main.innerHTML=html;
+   document.getElementById("updated").textContent="updated "+new Date().toLocaleTimeString();
+   return;
+  }
+  if(tab==="logs"){
+   const rows=await api("logs");
+   const f=filter.toLowerCase();
+   const shown=f?rows.filter(r=>JSON.stringify(r).toLowerCase().includes(f)):rows;
+   main.innerHTML=`<input id=q placeholder="filter logs..." value="${esc(filter)}">`+logLines(shown);
+   const q=document.getElementById("q");
+   q.oninput=()=>{filter=q.value;render()};
+  } else if(tab==="overview"){
    const [s,nodes,hist]=await Promise.all([api("summary"),api("nodes"),api("metrics_history")]);
    let cards="";
    const nact=Object.values(s.actors_by_state||{}).reduce((a,b)=>a+b,0);
@@ -183,7 +228,7 @@ async function render(){
 function fmtB(b){if(!b)return "0";const u=["B","KB","MB","GB"];let i=0;
  while(b>=1024&&i<u.length-1){b/=1024;i++}return b.toFixed(1)+u[i]}
 render();
-setInterval(()=>{if(tab!=="tasks"||!filter)render()},2000);
+setInterval(()=>{if(detail)return;if((tab==="tasks"||tab==="logs")&&filter)return;render()},2000);
 </script></body></html>"""
 
 
@@ -215,7 +260,14 @@ class Dashboard:
                     self._send(200, body, "application/json")
                     return
                 if path.startswith("api/"):
-                    fn = _API.get(path[4:])
+                    rest = path[4:]
+                    fn = _API.get(rest)
+                    arg = None
+                    if fn is None and "/" in rest:
+                        kind, _, arg = rest.partition("/")
+                        one = _API_ONE.get(kind)
+                        if one is not None and arg:
+                            fn = lambda: one(arg)  # noqa: E731
                     if fn is None:
                         self._send(404, b'{"error": "unknown endpoint"}',
                                    "application/json")

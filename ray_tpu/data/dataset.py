@@ -84,7 +84,7 @@ class Dataset:
         def block_fn(block: Block) -> Block:
             return block_from_items([fn(r) for r in block_to_rows(block)])
 
-        return self._with(MapOp(block_fn, name="map"))
+        return self._with(MapOp(block_fn, name="map", commutes=True))
 
     def flat_map(self, fn: Callable[[Any], List[Any]]) -> "Dataset":
         def block_fn(block: Block) -> Block:
@@ -100,7 +100,7 @@ class Dataset:
             rows = [r for r in block_to_rows(block) if fn(r)]
             return block_from_items(rows)
 
-        return self._with(MapOp(block_fn, name="filter"))
+        return self._with(MapOp(block_fn, name="filter", commutes=True))
 
     def add_column(self, name: str, fn: Callable[[Dict[str, np.ndarray]], Any]
                    ) -> "Dataset":
@@ -109,13 +109,32 @@ class Dataset:
             out[name] = np.asarray(fn(block))
             return out
 
-        return self._with(MapOp(block_fn, name=f"add_column[{name}]"))
+        return self._with(MapOp(block_fn, name=f"add_column[{name}]",
+                                commutes=True))
 
     def drop_columns(self, cols: List[str]) -> "Dataset":
         def block_fn(block: Block) -> Block:
             return {k: v for k, v in block.items() if k not in cols}
 
-        return self._with(MapOp(block_fn, name="drop_columns"))
+        return self._with(MapOp(block_fn, name="drop_columns",
+                                commutes=True))
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        """Keep only `cols` (ref: dataset.py select_columns). Directly
+        after a column-aware read (parquet) the optimizer pushes the
+        projection into the read tasks, so dropped columns are never
+        fetched at all."""
+        cols = list(cols)
+
+        def block_fn(block: Block) -> Block:
+            missing = [c for c in cols if c not in block]
+            if missing:
+                raise KeyError(f"select_columns: missing {missing}; "
+                               f"have {sorted(block)}")
+            return {c: block[c] for c in cols}
+
+        return self._with(MapOp(block_fn, name=f"select[{','.join(cols)}]",
+                                commutes=True, projection=cols))
 
     def sort(self, key: str = "id", *, descending: bool = False) -> "Dataset":
         """Distributed sort by a column: sample -> range partition ->
@@ -142,9 +161,18 @@ class Dataset:
 
     # -- execution -----------------------------------------------------------
 
+    def _segments(self) -> List[dict]:
+        """Logical-plan optimization (optimizer.py rules) then fusion
+        (plan.build_segments); applied rules surface in stats()."""
+        from .optimizer import optimize
+
+        ops, rules = optimize(self._ops)
+        self._opt_rules = rules
+        return build_segments(ops)
+
     def _execute_refs(self) -> List[Any]:
         ex = StreamingExecutor(self._ctx)
-        refs = list(ex.execute(build_segments(self._ops)))
+        refs = list(ex.execute(self._segments()))
         self._last_stats = ex.stats.summary()
         return refs
 
@@ -152,7 +180,7 @@ class Dataset:
         ex = StreamingExecutor(self._ctx)
         limit = getattr(self, "_limit", None)
         seen = 0
-        for ref in ex.execute(build_segments(self._ops)):
+        for ref in ex.execute(self._segments()):
             block = ray_tpu.get(ref)
             if limit is not None:
                 take = min(block_num_rows(block), limit - seen)
@@ -267,7 +295,11 @@ class Dataset:
         return sum(block_size_bytes(b) for b in self._stream_blocks())
 
     def stats(self) -> dict:
-        return dict(self._last_stats or {})
+        out = dict(self._last_stats or {})
+        rules = getattr(self, "_opt_rules", None)
+        if rules:
+            out["optimizer_rules"] = list(rules)
+        return out
 
     # -- splitting (Train ingest) --------------------------------------------
 
@@ -432,16 +464,29 @@ def _file_read_fns(paths: Union[str, List[str]], reader: Callable[[str], Block],
     return [lambda f=f: reader(f) for f in files]
 
 
-def read_parquet(paths: Union[str, List[str]], **kw) -> Dataset:
-    def reader(path: str) -> Block:
-        import pyarrow.parquet as pq
+def read_parquet(paths: Union[str, List[str]],
+                 columns: Optional[List[str]] = None, **kw) -> Dataset:
+    def make_reader(cols):
+        def reader(path: str) -> Block:
+            import pyarrow.parquet as pq
 
-        table = pq.read_table(path)
-        return {name: table.column(name).to_numpy(zero_copy_only=False)
-                for name in table.column_names}
+            table = pq.read_table(path, columns=cols)
+            return {name: table.column(name).to_numpy(zero_copy_only=False)
+                    for name in table.column_names}
 
-    return _make_dataset(_file_read_fns(paths, reader, (".parquet",)),
-                         "read_parquet")
+        return reader
+
+    ds = _make_dataset(_file_read_fns(paths, make_reader(columns),
+                                      (".parquet",)), "read_parquet")
+    if columns is None:
+        # parquet is column-aware: a select_columns directly downstream
+        # rewrites the read tasks to fetch only those columns
+        # (optimizer.py projection pushdown)
+        ds._ops[0].project = lambda cols: [
+            cloudpickle.dumps(fn)
+            for fn in _file_read_fns(paths, make_reader(list(cols)),
+                                     (".parquet",))]
+    return ds
 
 
 def read_csv(paths: Union[str, List[str]], **kw) -> Dataset:

@@ -20,6 +20,10 @@ class SourceOp:
     read_fns: Optional[List[bytes]] = None   # cloudpickled () -> Block
     refs: Optional[List[Any]] = None
     name: str = "source"
+    # column-aware sources (parquet) accept a projection: called with the
+    # selected column names, returns replacement read_fns that fetch only
+    # those columns (optimizer.py projection pushdown)
+    project: Optional[Callable[[List[str]], List[bytes]]] = None
 
 
 @dataclass
@@ -28,6 +32,12 @@ class MapOp:
     fn: Callable  # Block -> Block
     name: str = "map"
     compute: Optional[Tuple[int, Optional[dict]]] = None  # (pool, resources)
+    # row-wise content-preserving ops commute with order-only all-to-all
+    # barriers (optimizer.py reordering); batch-boundary-dependent ops
+    # (map_batches) must keep False
+    commutes: bool = False
+    # set by select_columns: the column list, for projection pushdown
+    projection: Optional[List[str]] = None
 
 
 @dataclass

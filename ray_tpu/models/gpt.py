@@ -53,6 +53,11 @@ class GPTConfig:
     # dynamic-update-slices, worth ~6% MFU on the training bench
     scan_layers: bool = True
     seq_axis: Optional[str] = None  # set to "sp" to use ring attention
+    # hand-fused LN+matmul block entry / matmul+residual block exit
+    # (ops/fused.py Pallas kernels). A/B'd against XLA's own fusion in
+    # docs/PERF_NOTES.md round 5 — kept as a measured option, not the
+    # default
+    fused_entry_exit: bool = False
 
     @property
     def padded_vocab(self) -> int:
@@ -184,8 +189,17 @@ class GPT:
         drop = c.dropout > 0.0 and key is not None
         if drop:
             k_attn, k_mlp = jax.random.split(key)
-        h = layernorm(x, lp["ln1_g"], lp["ln1_b"])
-        qkv = (h @ lp["w_qkv"].astype(c.dtype)) + lp["b_qkv"].astype(c.dtype)
+        if c.fused_entry_exit:
+            from ..ops.fused import ln_matmul
+
+            qkv = ln_matmul(
+                x.reshape(B * S, D), lp["ln1_g"], lp["ln1_b"],
+                lp["w_qkv"].astype(c.dtype),
+                lp["b_qkv"].astype(c.dtype)).reshape(B, S, 3 * D)
+        else:
+            h = layernorm(x, lp["ln1_g"], lp["ln1_b"])
+            qkv = (h @ lp["w_qkv"].astype(c.dtype)) \
+                + lp["b_qkv"].astype(c.dtype)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, S, H, hd)
         k = k.reshape(B, S, H, hd)
@@ -201,6 +215,21 @@ class GPT:
 
             attn = mha_reference(q, k, v, causal=True)
         attn = attn.reshape(B, S, D)
+        if c.fused_entry_exit and not drop:
+            from ..ops.fused import ln_matmul, matmul_residual
+
+            x = matmul_residual(attn.reshape(B * S, D),
+                                lp["w_proj"].astype(c.dtype),
+                                lp["b_proj"].astype(c.dtype),
+                                x.reshape(B * S, D)).reshape(B, S, D)
+            h = ln_matmul(x.reshape(B * S, D), lp["ln2_g"], lp["ln2_b"],
+                          lp["w_fc"].astype(c.dtype),
+                          lp["b_fc"].astype(c.dtype))
+            h = gelu(h)
+            x = matmul_residual(h, lp["w_out"].astype(c.dtype),
+                                lp["b_out"].astype(c.dtype),
+                                x.reshape(B * S, D)).reshape(B, S, D)
+            return x
         proj = (attn @ lp["w_proj"].astype(c.dtype)) + lp["b_proj"].astype(c.dtype)
         if drop:
             proj = self._dropout(proj, k_attn)

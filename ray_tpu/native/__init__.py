@@ -51,6 +51,58 @@ def _build() -> Optional[str]:
         return None
 
 
+_WIRE_SRC = os.path.join(os.path.dirname(__file__), "wirefast.c")
+_wire_mod = None
+_wire_tried = False
+
+
+def load_wirefast():
+    """The _rtpu_wirefast CPython extension (wire-codec decode hot path),
+    or None — callers fall back to the pure-Python decoder, which stays
+    the semantics reference."""
+    global _wire_mod, _wire_tried
+    with _lock:
+        if _wire_tried:
+            return _wire_mod
+        _wire_tried = True
+        if os.environ.get("RTPU_NATIVE_WIRE", "1") != "1":
+            return None
+        import sysconfig
+
+        with open(_WIRE_SRC, "rb") as f:
+            src = f.read()
+        tag = hashlib.sha1(src).hexdigest()[:16]
+        out = os.path.join(_cache_dir(), f"_rtpu_wirefast_{tag}.so")
+        if not os.path.exists(out):
+            tmp = out + f".tmp.{os.getpid()}"
+            cmd = ["gcc", "-O2", "-shared", "-fPIC",
+                   "-I", sysconfig.get_paths()["include"],
+                   "-o", tmp, _WIRE_SRC]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True,
+                               timeout=120)
+                os.replace(tmp, out)
+            except Exception:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                return None
+        try:
+            import importlib.machinery
+            import importlib.util
+
+            loader = importlib.machinery.ExtensionFileLoader(
+                "_rtpu_wirefast", out)
+            spec = importlib.util.spec_from_file_location(
+                "_rtpu_wirefast", out, loader=loader)
+            _wire_mod = importlib.util.module_from_spec(spec)
+            loader.exec_module(_wire_mod)
+        except Exception:
+            _wire_mod = None
+        return _wire_mod
+
+
 def load_store_lib() -> Optional[ctypes.CDLL]:
     """The C++ store library, or None (no compiler / build failure)."""
     global _lib, _tried

@@ -1,0 +1,171 @@
+"""Fused block-entry / block-exit Pallas kernels for the transformer
+block — the round-3-plan item whose A/B number the round-4 verdict asked
+for (docs/PERF_NOTES.md round-5 MFU section for the measured result).
+
+`ln_matmul`     : layernorm(x) @ w + b in one kernel — the LN read/write
+                  of the [N, D] activation never round-trips HBM.
+`matmul_residual`: a @ w + b + residual in one kernel — the residual add
+                  fuses into the projection's output store.
+
+Both are forward-only Pallas with a custom_vjp whose backward is the
+plain XLA composition (recompute-from-inputs), so training A/B runs
+measure the forward fusion inside an otherwise identical step. bf16
+inputs feed the MXU (preferred_element_type=f32); LN statistics are f32
+on the VPU (pallas_guide.md recipe).
+
+Reference capability (not design): the reference leaves this fusion to
+torch.compile/Inductor; on TPU it is XLA's job, and these kernels exist
+to measure whether hand-fusion beats XLA's — see PERF_NOTES for the
+answer.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _ln_matmul_kernel(x_ref, g_ref, b_ref, w_ref, wb_ref, o_ref, *,
+                      eps: float):
+    x = x_ref[...].astype(jnp.float32)              # [bm, D]
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    h = (x - mean) * jax.lax.rsqrt(var + eps)
+    h = h * g_ref[0, :].astype(jnp.float32) \
+        + b_ref[0, :].astype(jnp.float32)
+    h = h.astype(w_ref.dtype)
+    acc = jax.lax.dot_general(h, w_ref[...], (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    o_ref[...] = (acc + wb_ref[0, :].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _ln_matmul_fwd_impl(x, g, b, w, wb, *, eps: float, block_m: int,
+                        block_n: int):
+    N, D = x.shape
+    _, F = w.shape
+    bm = min(block_m, N)
+    while N % bm:
+        bm //= 2
+    bn = min(block_n, F)
+    while F % bn:
+        bn //= 2
+    grid = (N // bm, F // bn)
+    # 1-D params ride as [1, D]/[1, F]: Mosaic tiles 1-D operands in
+    # lane-sized chunks that partial 1-D blocks can't satisfy
+    return pl.pallas_call(
+        functools.partial(_ln_matmul_kernel, eps=eps),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, D), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, D), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, D), lambda i, j: (0, 0)),
+            pl.BlockSpec((D, bn), lambda i, j: (0, j)),
+            pl.BlockSpec((1, bn), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((N, F), x.dtype),
+        interpret=_use_interpret(),
+    )(x, g.reshape(1, D), b.reshape(1, D), w, wb.reshape(1, F))
+
+
+def _ln_ref(x, g, b, eps):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    return ((xf - mean) * jax.lax.rsqrt(var + eps) * g.astype(jnp.float32)
+            + b.astype(jnp.float32))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def ln_matmul(x, g, b, w, wb, eps: float = 1e-5, block_m: int = 256,
+              block_n: int = 768):
+    """layernorm(x, g, b) @ w + wb, fused. x [N,D], w [D,F] -> [N,F]."""
+    return _ln_matmul_fwd_impl(x, g, b, w, wb, eps=eps, block_m=block_m,
+                               block_n=block_n)
+
+
+def _ln_matmul_fwd(x, g, b, w, wb, eps, block_m, block_n):
+    out = _ln_matmul_fwd_impl(x, g, b, w, wb, eps=eps, block_m=block_m,
+                              block_n=block_n)
+    return out, (x, g, b, w)
+
+
+def _ln_matmul_bwd(eps, block_m, block_n, saved, dout):
+    x, g, b, w = saved
+    # plain XLA backward via recompute — measures only the fwd fusion
+
+    def f(x, g, b, w, wb):
+        h = _ln_ref(x, g, b, eps).astype(w.dtype)
+        return (h @ w).astype(jnp.float32) + wb.astype(jnp.float32)
+
+    wb0 = jnp.zeros((w.shape[1],), x.dtype)
+    _, vjp = jax.vjp(f, x, g, b, w, wb0)
+    dx, dg, db, dw, dwb = vjp(dout.astype(jnp.float32))
+    return (dx.astype(x.dtype), dg.astype(g.dtype), db.astype(b.dtype),
+            dw.astype(w.dtype), dwb.astype(x.dtype))
+
+
+ln_matmul.defvjp(_ln_matmul_fwd, _ln_matmul_bwd)
+
+
+def _mm_res_kernel(a_ref, w_ref, b_ref, r_ref, o_ref):
+    acc = jax.lax.dot_general(a_ref[...], w_ref[...],
+                              (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    acc = acc + b_ref[0, :].astype(jnp.float32) \
+        + r_ref[...].astype(jnp.float32)
+    o_ref[...] = acc.astype(o_ref.dtype)
+
+
+def _mm_res_impl(a, w, b, res, *, block_m: int, block_n: int):
+    N, D = a.shape
+    _, F = w.shape
+    bm = min(block_m, N)
+    while N % bm:
+        bm //= 2
+    bn = min(block_n, F)
+    while F % bn:
+        bn //= 2
+    grid = (N // bm, F // bn)
+    return pl.pallas_call(
+        _mm_res_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, D), lambda i, j: (i, 0)),
+            pl.BlockSpec((D, bn), lambda i, j: (0, j)),
+            pl.BlockSpec((1, bn), lambda i, j: (0, j)),
+            pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((N, F), a.dtype),
+        interpret=_use_interpret(),
+    )(a, w, b.reshape(1, F), res)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def matmul_residual(a, w, b, res, block_m: int = 256, block_n: int = 768):
+    """a @ w + b + res, fused. a [N,D], w [D,F], res [N,F] -> [N,F]."""
+    return _mm_res_impl(a, w, b, res, block_m=block_m, block_n=block_n)
+
+
+def _mm_res_fwd(a, w, b, res, block_m, block_n):
+    return _mm_res_impl(a, w, b, res, block_m=block_m,
+                        block_n=block_n), (a, w)
+
+
+def _mm_res_bwd(block_m, block_n, saved, dout):
+    a, w = saved
+    d32 = dout.astype(jnp.float32)
+    da = (d32 @ w.astype(jnp.float32).T).astype(a.dtype)
+    dw = (a.astype(jnp.float32).T @ d32).astype(w.dtype)
+    db = jnp.sum(d32, axis=0).astype(a.dtype)
+    return da, dw, db, dout
+
+
+matmul_residual.defvjp(_mm_res_fwd, _mm_res_bwd)

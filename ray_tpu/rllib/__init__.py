@@ -44,6 +44,11 @@ _EXPORTS = {
     "JaxVectorEnv": "jax_env", "CartPoleJax": "jax_env",
     "BreakoutShapedJax": "jax_env", "make_jax_env": "jax_env",
     "register_jax_env": "jax_env",
+    "ES": "es", "ESConfig": "es", "ESWorker": "es",
+    "QMIX": "qmix", "QMIXConfig": "qmix",
+    "PolicyServerInput": "policy_server",
+    "ExternalPPO": "policy_server", "ExternalPPOConfig": "policy_server",
+    "PolicyClient": "policy_client",
 }
 
 __all__ = sorted(_EXPORTS)

@@ -1,0 +1,229 @@
+"""Evolution Strategies (OpenAI-ES) — embarrassingly parallel policy
+search on the task plane.
+
+ref: rllib/algorithms/es/es.py (+ es_tf_policy / optimizers.py): N
+antithetic Gaussian perturbations of the policy parameters are evaluated
+as full episodes on a pool of rollout actors; returns are centered-rank
+normalized and combined into a gradient estimate
+    g = (1 / (N * sigma)) * sum_i rank_i * eps_i
+applied with Adam. The reference ships noise via a shared 250MB noise
+table; here workers REGENERATE each perturbation from its integer seed
+(np.default_rng(seed)), so only (seed, sign, return) triples cross the
+object store — the single-controller reduction of the same trick.
+
+Rollouts are pure numpy (np_policy.forward_np); no jax in workers — ES
+is a showcase of the runtime's task fan-out, not the chip.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+
+from .env import make_env
+from .np_policy import forward_np
+from .rollout_worker import worker_opts
+
+
+def _flat_params(shapes: List[Tuple[str, tuple]], theta: np.ndarray
+                 ) -> Dict[str, np.ndarray]:
+    out = {}
+    off = 0
+    for name, shp in shapes:
+        n = int(np.prod(shp))
+        out[name] = theta[off:off + n].reshape(shp).astype(np.float32)
+        off += n
+    return out
+
+
+def _init_shapes(obs_dim: int, num_actions: int,
+                 hidden: Tuple[int, ...]) -> List[Tuple[str, tuple]]:
+    shapes: List[Tuple[str, tuple]] = []
+    last = obs_dim
+    for i, h in enumerate(hidden):
+        shapes.append((f"w{i}", (last, h)))
+        shapes.append((f"b{i}", (h,)))
+        last = h
+    shapes += [("w_pi", (last, num_actions)), ("b_pi", (num_actions,)),
+               ("w_v", (last, 1)), ("b_v", (1,))]
+    return shapes
+
+
+def _episode_return(params: Dict[str, np.ndarray], env, max_steps: int,
+                    greedy: bool = True) -> float:
+    obs = env.reset()
+    total = 0.0
+    for _ in range(max_steps):
+        logits, _ = forward_np(params, obs)
+        actions = np.argmax(logits, axis=1)
+        obs, reward, done, _ = env.step(actions)
+        total += float(reward.sum())
+        if done.all():
+            break
+    return total / env.num_envs
+
+
+class ESWorker:
+    """Evaluates perturbations: regenerates eps from the seed, runs one
+    greedy episode per (seed, sign) (ref: es.py Worker.do_rollouts)."""
+
+    def __init__(self, env_name: str, hidden: tuple, sigma: float,
+                 max_steps: int, seed: int = 0, env_creator=None):
+        import cloudpickle
+
+        if env_creator is not None:
+            self.env = cloudpickle.loads(env_creator)(num_envs=1, seed=seed)
+        else:
+            self.env = make_env(env_name, num_envs=1, seed=seed)
+        self.shapes = _init_shapes(self.env.obs_dim, self.env.num_actions,
+                                   tuple(hidden))
+        self.sigma = sigma
+        self.max_steps = max_steps
+
+    def dim(self) -> int:
+        return int(sum(np.prod(s) for _, s in self.shapes))
+
+    def evaluate(self, theta: np.ndarray,
+                 seeds: List[int]) -> List[Tuple[int, int, float]]:
+        out = []
+        for seed in seeds:
+            eps = np.random.default_rng(seed).standard_normal(
+                theta.shape[0]).astype(np.float32)
+            for sign in (1, -1):
+                params = _flat_params(self.shapes,
+                                      theta + sign * self.sigma * eps)
+                ret = _episode_return(params, self.env, self.max_steps)
+                out.append((seed, sign, ret))
+        return out
+
+    def evaluate_center(self, theta: np.ndarray) -> float:
+        return _episode_return(_flat_params(self.shapes, theta), self.env,
+                               self.max_steps)
+
+
+def _centered_ranks(x: np.ndarray) -> np.ndarray:
+    """ref: es/utils.py compute_centered_ranks."""
+    ranks = np.empty(len(x), dtype=np.float32)
+    ranks[x.argsort()] = np.arange(len(x), dtype=np.float32)
+    return ranks / (len(x) - 1) - 0.5
+
+
+@dataclass
+class ESConfig:
+    """ref: es.py ESConfig (episodes_per_batch, noise_stdev, stepsize)."""
+    env: str = "CartPole-v1"
+    env_creator: Optional[Callable] = None
+    num_workers: int = 2
+    episodes_per_batch: int = 32    # perturbation PAIRS per iteration
+    sigma: float = 0.1
+    lr: float = 0.02
+    l2_coeff: float = 0.005
+    hidden: tuple = (32, 32)
+    max_episode_steps: int = 500
+    seed: int = 0
+    worker_resources: Dict[str, float] = field(default_factory=dict)
+
+    def build(self) -> "ES":
+        return ES(self)
+
+
+class ES:
+    """Tune-trainable ES driver."""
+
+    def __init__(self, config: ESConfig):
+        import cloudpickle
+
+        c = self.config = config
+        creator_blob = (cloudpickle.dumps(c.env_creator)
+                        if c.env_creator is not None else None)
+        cls = ray_tpu.remote(ESWorker)
+        opts = worker_opts(c.worker_resources)
+        self.workers = [
+            cls.options(**opts).remote(
+                c.env, tuple(c.hidden), c.sigma, c.max_episode_steps,
+                seed=c.seed + 100 * i, env_creator=creator_blob)
+            for i in range(c.num_workers)
+        ]
+        dim = ray_tpu.get(self.workers[0].dim.remote(), timeout=60)
+        rng = np.random.default_rng(c.seed)
+        self.theta = (rng.standard_normal(dim) * 0.05).astype(np.float32)
+        # Adam state
+        self._m = np.zeros(dim, np.float32)
+        self._v = np.zeros(dim, np.float32)
+        self._t = 0
+        self._seed_seq = c.seed * 1_000_003 + 1
+        self._iteration = 0
+        self._total_episodes = 0
+
+    def train(self) -> Dict[str, float]:
+        c = self.config
+        t0 = time.monotonic()
+        n_pairs = c.episodes_per_batch
+        seeds = [self._seed_seq + i for i in range(n_pairs)]
+        self._seed_seq += n_pairs
+        theta_ref = ray_tpu.put(self.theta)
+        chunks = np.array_split(np.asarray(seeds), len(self.workers))
+        futs = [w.evaluate.remote(theta_ref, [int(s) for s in chunk])
+                for w, chunk in zip(self.workers, chunks) if len(chunk)]
+        triples = [t for batch in ray_tpu.get(futs, timeout=600)
+                   for t in batch]
+        returns = {}
+        for seed, sign, ret in triples:
+            returns.setdefault(seed, {})[sign] = ret
+        pos = np.array([returns[s][1] for s in seeds], np.float32)
+        neg = np.array([returns[s][-1] for s in seeds], np.float32)
+        ranks = _centered_ranks(np.concatenate([pos, neg]))
+        advantage = ranks[:n_pairs] - ranks[n_pairs:]
+        grad = np.zeros_like(self.theta)
+        for adv, seed in zip(advantage, seeds):
+            eps = np.random.default_rng(seed).standard_normal(
+                self.theta.shape[0]).astype(np.float32)
+            grad += adv * eps
+        grad = grad / (2 * n_pairs * c.sigma) - c.l2_coeff * self.theta
+        # Adam ascent (ref: es/optimizers.py Adam)
+        self._t += 1
+        self._m = 0.9 * self._m + 0.1 * grad
+        self._v = 0.999 * self._v + 0.001 * grad * grad
+        mh = self._m / (1 - 0.9 ** self._t)
+        vh = self._v / (1 - 0.999 ** self._t)
+        self.theta = self.theta + c.lr * mh / (np.sqrt(vh) + 1e-8)
+
+        center = ray_tpu.get(
+            self.workers[0].evaluate_center.remote(
+                ray_tpu.put(self.theta)), timeout=120)
+        self._iteration += 1
+        self._total_episodes += 2 * n_pairs
+        return {
+            "training_iteration": self._iteration,
+            "episodes_total": self._total_episodes,
+            "episode_reward_mean": float(center),
+            "perturbation_reward_mean": float(np.mean([pos, neg])),
+            "time_this_iter_s": time.monotonic() - t0,
+        }
+
+    # -- Tune-trainable surface ------------------------------------------
+
+    def save(self) -> Dict:
+        return {"theta": self.theta.copy(), "m": self._m.copy(),
+                "v": self._v.copy(), "t": self._t,
+                "iteration": self._iteration,
+                "seed_seq": self._seed_seq}
+
+    def restore(self, ckpt: Dict) -> None:
+        self.theta = np.asarray(ckpt["theta"], np.float32)
+        self._m = np.asarray(ckpt.get("m", np.zeros_like(self.theta)))
+        self._v = np.asarray(ckpt.get("v", np.zeros_like(self.theta)))
+        self._t = int(ckpt.get("t", 0))
+        self._iteration = int(ckpt.get("iteration", 0))
+        self._seed_seq = int(ckpt.get("seed_seq", 1))
+
+    def stop(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

@@ -1,0 +1,63 @@
+"""PolicyClient — drive a remote policy from an external simulator.
+
+ref: rllib/env/policy_client.py. Deliberately dependency-free (stdlib
+urllib + json only): an external process embedding a game engine or a
+hardware rig talks to a PolicyServerInput with four calls and never
+imports ray_tpu:
+
+    client = PolicyClient("http://host:port")
+    eid = client.start_episode()
+    action = client.get_action(eid, observation)   # list of floats
+    client.log_returns(eid, reward)
+    client.end_episode(eid, observation, truncated=False)
+"""
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any, List, Optional
+
+
+class PolicyClient:
+    def __init__(self, address: str, timeout: float = 30.0):
+        self._addr = address.rstrip("/")
+        self._timeout = timeout
+
+    def _post(self, route: str, payload: dict) -> dict:
+        req = urllib.request.Request(
+            f"{self._addr}/{route}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self._timeout) as r:
+                out = json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                out = json.loads(e.read() or b"{}")
+            except Exception:
+                out = {"error": f"HTTP {e.code}"}
+        if isinstance(out, dict) and out.get("error"):
+            raise RuntimeError(out["error"])
+        return out
+
+    def start_episode(self, episode_id: Optional[str] = None) -> str:
+        return self._post("start_episode",
+                          {"episode_id": episode_id})["episode_id"]
+
+    def get_action(self, episode_id: str, observation: List[float]) -> Any:
+        return self._post("get_action", {
+            "episode_id": episode_id,
+            "observation": list(map(float, observation))})["action"]
+
+    def log_returns(self, episode_id: str, reward: float) -> None:
+        self._post("log_returns", {"episode_id": episode_id,
+                                   "reward": float(reward)})
+
+    def end_episode(self, episode_id: str,
+                    observation: Optional[List[float]] = None,
+                    truncated: bool = False) -> None:
+        payload: dict = {"episode_id": episode_id, "truncated": truncated}
+        if observation is not None:
+            payload["observation"] = list(map(float, observation))
+        self._post("end_episode", payload)

@@ -1,0 +1,279 @@
+"""External-environment protocol: PolicyServerInput + ExternalPPO.
+
+ref: rllib/env/policy_server_input.py + rllib/env/policy_client.py —
+an external simulator (a game engine, a robot, another process) drives
+episodes against a policy served over HTTP: it asks for actions,
+reports rewards, and the server turns the completed episodes into
+train-ready batches. The reference speaks pickle over HTTP between its
+client/server; here the protocol is JSON (obs/actions as lists) so a
+client needs nothing but an HTTP library — no ray_tpu import, no
+codegen, no pickle trust.
+
+Server side: on-policy inference runs the same numpy policy path as the
+rollout workers (np_policy.sample_actions — action, logp, value per
+request), and episode completion computes GAE exactly like
+RolloutWorker.sample, so ExternalPPO's learner consumes identical
+batches whether experience came from local workers or external sims.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import sample_batch as sb
+from .np_policy import ensure_numpy, sample_actions
+
+
+class _Episode:
+    __slots__ = ("obs", "actions", "logp", "values", "rewards")
+
+    def __init__(self):
+        self.obs: List[np.ndarray] = []
+        self.actions: List[int] = []
+        self.logp: List[float] = []
+        self.values: List[float] = []
+        self.rewards: List[float] = []
+
+
+class PolicyServerInput:
+    """Serves get_action over HTTP and accumulates completed episodes
+    into PPO sample batches (ref: policy_server_input.py)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 gamma: float = 0.99, lam: float = 0.95):
+        self._gamma, self._lam = gamma, lam
+        self._params: Optional[Dict[str, np.ndarray]] = None
+        self._rng = np.random.default_rng(0)
+        self._episodes: Dict[str, _Episode] = {}
+        self._done: List[Tuple[dict, float]] = []  # (columns, ep_return)
+        self._lock = threading.Lock()
+        self._have_data = threading.Condition(self._lock)
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    out = srv._handle(self.path.strip("/"), req)
+                    body = json.dumps(out).encode()
+                    code = 200
+                except Exception as e:  # noqa: BLE001
+                    body = json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode()
+                    code = 400
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.address = self._server.server_address
+        threading.Thread(target=self._server.serve_forever, daemon=True,
+                         name="policy-server").start()
+
+    # -- protocol ---------------------------------------------------------
+
+    def _handle(self, route: str, req: dict) -> dict:
+        if route == "start_episode":
+            eid = req.get("episode_id") or uuid.uuid4().hex[:12]
+            with self._lock:
+                self._episodes[eid] = _Episode()
+            return {"episode_id": eid}
+        if route == "get_action":
+            eid = req["episode_id"]
+            obs = np.asarray(req["observation"], np.float32)
+            with self._lock:
+                ep = self._episodes.get(eid)
+                params = self._params
+            if ep is None:
+                raise KeyError(f"unknown episode {eid}")
+            if params is None:
+                raise RuntimeError("no policy set yet")
+            a, logp, v = sample_actions(params, obs[None], self._rng)
+            with self._lock:
+                ep.obs.append(obs)
+                ep.actions.append(int(a[0]))
+                ep.logp.append(float(logp[0]))
+                ep.values.append(float(v[0]))
+            return {"action": int(a[0])}
+        if route == "log_returns":
+            with self._lock:
+                ep = self._episodes.get(req["episode_id"])
+                if ep is None:
+                    raise KeyError("unknown episode")
+                ep.rewards.append(float(req["reward"]))
+            return {}
+        if route == "end_episode":
+            eid = req["episode_id"]
+            # done=True episode: no bootstrap. A truncated episode may
+            # pass its final observation for V(s_T) bootstrapping.
+            final_obs = req.get("observation")
+            with self._lock:
+                ep = self._episodes.pop(eid, None)
+                params = self._params
+            if ep is None or not ep.obs:
+                return {}
+            last_v = 0.0
+            if final_obs is not None and req.get("truncated") and params:
+                _, _, v = sample_actions(
+                    params, np.asarray(final_obs, np.float32)[None],
+                    self._rng)
+                last_v = float(v[0])
+            cols = self._finish(ep, last_v)
+            if cols is not None:
+                with self._have_data:
+                    self._done.append((cols, float(np.sum(ep.rewards))))
+                    self._have_data.notify_all()
+            return {}
+        raise ValueError(f"unknown route {route!r}")
+
+    def _finish(self, ep: _Episode, last_value: float) -> Optional[dict]:
+        T = min(len(ep.actions), len(ep.rewards))
+        if T == 0:
+            return None  # actions with no logged rewards: nothing usable
+        rew = np.asarray(ep.rewards[:T], np.float32)[:, None]
+        val = np.asarray(ep.values[:T], np.float32)[:, None]
+        dones = np.zeros((T, 1), np.bool_)
+        dones[-1] = True
+        adv, ret = sb.compute_gae(rew, val, dones,
+                                  np.asarray([last_value], np.float32),
+                                  self._gamma, self._lam)
+        return {
+            sb.OBS: np.stack(ep.obs[:T]),
+            sb.ACTIONS: np.asarray(ep.actions[:T], np.int64),
+            sb.LOGP: np.asarray(ep.logp[:T], np.float32),
+            sb.VALUES: val[:, 0],
+            sb.REWARDS: rew[:, 0],
+            sb.DONES: dones[:, 0],
+            sb.ADVANTAGES: adv[:, 0],
+            sb.RETURNS: ret[:, 0],
+        }
+
+    # -- trainer surface ---------------------------------------------------
+
+    def set_policy(self, params: Dict[str, np.ndarray]) -> None:
+        with self._lock:
+            self._params = ensure_numpy(params)
+
+    def collect(self, min_steps: int, timeout: float = 300.0
+                ) -> Tuple[Optional[dict], List[float]]:
+        """Block until >= min_steps of completed-episode experience is
+        buffered; -> (concatenated batch, episode returns)."""
+        deadline = time.monotonic() + timeout
+        with self._have_data:
+            while True:
+                have = sum(len(c[sb.ACTIONS]) for c, _ in self._done)
+                if have >= min_steps:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._have_data.wait(
+                        min(1.0, remaining)):
+                    if time.monotonic() >= deadline:
+                        break
+            done, self._done = self._done, []
+        if not done:
+            return None, []
+        cols = [c for c, _ in done]
+        batch = {k: np.concatenate([c[k] for c in cols]) for k in cols[0]}
+        return batch, [r for _, r in done]
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+@dataclass
+class ExternalPPOConfig:
+    """PPO trained purely from external-client experience."""
+    obs_dim: int = 4
+    num_actions: int = 2
+    train_batch_size: int = 512
+    gamma: float = 0.99
+    lam: float = 0.95
+    lr: float = 3e-4
+    sgd_minibatch_size: int = 128
+    num_sgd_epochs: int = 4
+    hidden: tuple = (64, 64)
+    host: str = "127.0.0.1"
+    port: int = 0
+    seed: int = 0
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def build(self) -> "ExternalPPO":
+        return ExternalPPO(self)
+
+
+class ExternalPPO:
+    """PPO whose only experience source is a PolicyServerInput — the
+    reference's external-env deployment shape (ref: rllib/examples/
+    serving/cartpole_server.py)."""
+
+    def __init__(self, config: ExternalPPOConfig):
+        from .learner import PPOLearner
+
+        c = self.config = config
+        self.learner = PPOLearner(
+            c.obs_dim, c.num_actions, lr=c.lr,
+            minibatch_size=c.sgd_minibatch_size,
+            num_epochs=c.num_sgd_epochs, hidden=tuple(c.hidden),
+            seed=c.seed)
+        self.server = PolicyServerInput(c.host, c.port, gamma=c.gamma,
+                                        lam=c.lam)
+        self.server.set_policy(self.learner.get_params())
+        self.address = self.server.address
+        self._iteration = 0
+        self._total_steps = 0
+        self._recent: List[float] = []
+
+    def train(self) -> Dict[str, float]:
+        c = self.config
+        batch, returns = self.server.collect(c.train_batch_size)
+        stats: Dict[str, float] = {}
+        steps = 0
+        if batch is not None:
+            steps = len(batch[sb.ACTIONS])
+            stats = self.learner.update(batch)
+            self.server.set_policy(self.learner.get_params())
+        self._recent.extend(returns)
+        self._recent = self._recent[-100:]
+        self._total_steps += steps
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_total": self._total_steps,
+            "timesteps_this_iter": steps,
+            "episode_reward_mean": (float(np.mean(self._recent))
+                                    if self._recent else float("nan")),
+            **stats,
+        }
+
+    def save(self) -> Dict:
+        import jax
+
+        return {"params": jax.device_get(self.learner.params),
+                "iteration": self._iteration,
+                "total_steps": self._total_steps}
+
+    def restore(self, ckpt: Dict) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.learner.params = jax.tree.map(jnp.asarray, ckpt["params"])
+        self.server.set_policy(self.learner.get_params())
+        self._iteration = int(ckpt.get("iteration", 0))
+        self._total_steps = int(ckpt.get("total_steps", 0))
+
+    def stop(self) -> None:
+        self.server.shutdown()

@@ -41,6 +41,61 @@ def list_nodes() -> List[Dict[str, Any]]:
     return out
 
 
+def recent_logs(worker_id: Optional[str] = None,
+                node_id: Optional[str] = None, pid: Optional[int] = None,
+                limit: int = 500) -> List[Dict[str, Any]]:
+    """Tail of worker stdout/stderr captured on the head (ref:
+    dashboard/modules/log/log_manager.py — there via log files + agents,
+    here the lines ride the worker RPC channels into a ring buffer)."""
+    return _rt().recent_logs(worker_id=worker_id, node_id=node_id,
+                             pid=pid, limit=limit)
+
+
+def actor_detail(actor_id_prefix: str) -> Optional[Dict[str, Any]]:
+    """One actor's full picture: info + its recent task events + the
+    log tail of its worker (dashboard drill-down)."""
+    rt = _rt()
+    for a in rt.gcs.list_actors():
+        if a.actor_id.hex().startswith(actor_id_prefix):
+            wid = a.worker_id.hex() if a.worker_id else None
+            # exact actor_id match only: class-name substrings would pull
+            # in sibling actors' events
+            events = [e for e in rt.gcs.task_events()
+                      if e.get("actor_id") == a.actor_id.hex()]
+            return {
+                "actor_id": a.actor_id.hex(), "name": a.name,
+                "namespace": a.namespace, "state": a.state.name,
+                "class_name": a.creation_spec.description.split(".")[0],
+                "node_id": a.node_id.hex() if a.node_id else None,
+                "worker_id": wid,
+                "num_restarts": a.num_restarts,
+                "death_cause": a.death_cause,
+                "detached": a.detached,
+                "recent_events": events[-50:],
+                "logs": (rt.recent_logs(worker_id=wid, limit=200)
+                         if wid else []),
+            }
+    return None
+
+
+def task_detail(task_id_prefix: str) -> Optional[Dict[str, Any]]:
+    """One task's state transitions + lineage summary (dashboard
+    drill-down)."""
+    rt = _rt()
+    events = [e for e in rt.gcs.task_events()
+              if str(e.get("task_id", "")).startswith(task_id_prefix)]
+    if not events:
+        return None
+    pend = None
+    for tid, pt in list(rt.task_manager._pending.items()):
+        if tid.hex().startswith(task_id_prefix):
+            pend = {"state": pt.state, "retries_left": pt.retries_left}
+            break
+    return {"task_id": events[-1].get("task_id"),
+            "name": events[-1].get("name"),
+            "pending": pend, "events": events[-100:]}
+
+
 def list_actors(state: Optional[str] = None) -> List[Dict[str, Any]]:
     rt = _rt()
     out = []

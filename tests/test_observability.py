@@ -211,3 +211,63 @@ def test_untraced_tasks_emit_no_spans(cluster):
     # every worker that just ran a traced task must come back clean
     out = ray_tpu.get([plain.remote() for _ in range(8)], timeout=60)
     assert all(ctx is None for ctx in out), out
+
+
+def test_dashboard_logs_and_drilldown(cluster):
+    """Click-path equivalent: worker prints land in the head's log
+    store; /api/logs, /api/actor/<id> (with its worker's logs inline)
+    and /api/task/<id> serve the drill-downs (ref:
+    dashboard/modules/log/log_manager.py + actor/task detail pages)."""
+    import json as _json
+    import time as _time
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu.dashboard import Dashboard
+    from ray_tpu.util import state as state_api
+
+    @ray_tpu.remote
+    class Chatty:
+        def speak(self):
+            print("hello-from-chatty")
+            return "ok"
+
+    a = Chatty.remote()
+    assert ray_tpu.get(a.speak.remote(), timeout=60) == "ok"
+    # the tee flushes on newline; give the oneway a beat to land
+    deadline = _time.time() + 10
+    while _time.time() < deadline:
+        if any("hello-from-chatty" in r["line"]
+               for r in state_api.recent_logs()):
+            break
+        _time.sleep(0.2)
+    logs = state_api.recent_logs()
+    assert any("hello-from-chatty" in r["line"] for r in logs), logs[-5:]
+
+    actors = state_api.list_actors()
+    aid = next(r["actor_id"] for r in actors
+               if r["class_name"] == "Chatty")
+    dash = Dashboard(port=0)
+    try:
+        host, port = dash.address()
+
+        def get(p):
+            with urllib.request.urlopen(f"http://{host}:{port}/{p}",
+                                        timeout=10) as r:
+                return _json.load(r)
+
+        rows = get("api/logs")
+        assert any("hello-from-chatty" in r["line"] for r in rows)
+        detail = get(f"api/actor/{aid}")
+        assert detail["actor_id"] == aid and detail["state"] == "ALIVE"
+        assert any("hello-from-chatty" in r["line"]
+                   for r in detail["logs"]), "actor detail carries logs"
+        tid = rows and get("api/tasks")[-1].get("task_id")
+        if tid:
+            td = get(f"api/task/{tid}")
+            assert td and td["task_id"] == tid and td["events"]
+        tl = get("api/timeline")
+        assert isinstance(tl, list)
+    finally:
+        dash.shutdown()
+        ray_tpu.kill(a)

@@ -3,6 +3,7 @@ protocol; round-1 weak #4 — results of worker-submitted tasks were freed
 out from under the workers holding them)."""
 import time
 
+import numpy as np
 import pytest
 
 import ray_tpu
@@ -117,3 +118,52 @@ def test_dead_worker_refs_released(rt):
             break
         time.sleep(0.1)
     assert all(rt.refcount.counts(o)[2] == 0 for o in oids)
+
+
+def test_nested_result_ref_survives_producer_gc(rt):
+    """A ref returned FROM a task must stay alive after the producing
+    worker's own local ref dies (function exit + gc): the result's
+    nested refs are pinned to the return object's lifetime (borrower
+    protocol). Regression: the pin was masked by a pickler GC cycle."""
+    import gc as _gc
+
+    @ray_tpu.remote
+    def put_inside():
+        import gc
+
+        ref = ray_tpu.put(np.ones((256, 256), dtype=np.float32))
+        out = [ref]
+        del ref
+        gc.collect()  # worker's own reference is gone NOW
+        return out
+
+    inner = ray_tpu.get(put_inside.remote(), timeout=30)[0]
+    time.sleep(0.5)  # let any stray remove-ref notifications land
+    _gc.collect()
+    val = ray_tpu.get(inner, timeout=30)
+    assert val.shape == (256, 256)
+
+
+def test_multi_return_nested_refs_pinned_per_return(rt):
+    """Each return value's nested refs borrow through THAT return object
+    — freeing ret0 must not free a ref nested in ret1."""
+    import gc as _gc
+
+    @ray_tpu.remote(num_returns=2)
+    def two():
+        import gc
+
+        inner = ray_tpu.put(np.arange(1000, dtype=np.int64))
+        out = (None, [inner])
+        del inner
+        gc.collect()
+        return out
+
+    r0, r1 = two.remote()
+    ray_tpu.get(r0, timeout=30)
+    del r0  # free the FIRST return object
+    _gc.collect()
+    time.sleep(0.3)
+    inner = ray_tpu.get(r1, timeout=30)[0]
+    val = ray_tpu.get(inner, timeout=30)
+    assert val[999] == 999

@@ -27,7 +27,26 @@ def test_ten_thousand_tasks_complete(cluster):
     out = ray_tpu.get(refs, timeout=240)
     dt = time.monotonic() - t0
     assert out == list(range(10000))
-    assert dt < 120, f"10000 tasks took {dt:.1f}s"
+    # measured ~2.5s standalone after the r5 dispatch work (~4.5k/s);
+    # 2x-of-measured-plus-suite-noise bound so a 5x regression fails
+    assert dt < 12, f"10000 tasks took {dt:.1f}s"
+
+
+def test_hundred_thousand_queued_tasks(cluster):
+    """The reference's envelope claims 1M+ queued (release/benchmarks);
+    this pins a 100k burst: bucketed dispatch + lease reuse must hold
+    throughput, not degrade O(queue^2)."""
+    @ray_tpu.remote(num_cpus=0.001)
+    def tiny(i):
+        return i
+
+    t0 = time.monotonic()
+    refs = [tiny.remote(i) for i in range(100000)]
+    out = ray_tpu.get(refs, timeout=600)
+    dt = time.monotonic() - t0
+    assert out == list(range(100000))
+    rate = 100000 / dt
+    assert rate > 2000, f"100k queued ran at {rate:.0f} tasks/s"
 
 
 def test_many_concurrent_waiters_wake_evently(cluster):

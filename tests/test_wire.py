@@ -169,3 +169,105 @@ class TestMaliciousFrameOverRpc:
         # UnicodeEncodeError (channel torn down)
         with pytest.raises(wire.WireEncodeError):
             wire.encode("bad\udce9name")
+
+
+class TestNativeDecoder:
+    """The C decode path (native/wirefast.c) must be bit-compatible with
+    the pure-Python reference decoder — same values out, same rejections.
+    Skipped when the extension didn't build (no compiler)."""
+
+    @pytest.fixture(autouse=True)
+    def _need_native(self):
+        if wire.decode is wire.decode_py:
+            pytest.skip("native wire decoder not built")
+
+    def test_differential_valid_frames(self):
+        import random
+
+        from ray_tpu.core.ids import ObjectId, TaskId
+        from ray_tpu.core.task_spec import (SchedulingStrategy, TaskSpec,
+                                            TaskType)
+
+        rng = random.Random(7)
+
+        def rand_value(depth=0):
+            kinds = ["int", "big", "float", "str", "bytes", "none", "bool"]
+            if depth < 3:
+                kinds += ["list", "tuple", "dict", "set", "id"]
+            k = rng.choice(kinds)
+            if k == "int":
+                return rng.randint(-2**62, 2**62)
+            if k == "big":
+                return rng.randint(2**64, 2**80)
+            if k == "float":
+                return rng.random() * 1e6
+            if k == "str":
+                return "".join(chr(rng.randint(32, 0x1000))
+                               for _ in range(rng.randint(0, 12)))
+            if k == "bytes":
+                return rng.randbytes(rng.randint(0, 32))
+            if k == "none":
+                return None
+            if k == "bool":
+                return rng.random() < 0.5
+            if k == "list":
+                return [rand_value(depth + 1)
+                        for _ in range(rng.randint(0, 4))]
+            if k == "tuple":
+                return tuple(rand_value(depth + 1)
+                             for _ in range(rng.randint(0, 4)))
+            if k == "dict":
+                return {rng.randint(0, 99): rand_value(depth + 1)
+                        for _ in range(rng.randint(0, 4))}
+            if k == "set":
+                return {rng.randint(0, 999)
+                        for _ in range(rng.randint(0, 4))}
+            return TaskId.from_random()
+
+        for _ in range(300):
+            v = rand_value()
+            blob = wire.encode(v)
+            assert wire.decode(blob) == wire.decode_py(blob) == v
+        # a full TaskSpec, templated and not
+        spec = TaskSpec(task_id=TaskId.from_random(),
+                        job_id=None, task_type=TaskType.NORMAL_TASK,
+                        func_id="f" * 40, description="fuzz",
+                        args=[(0, b"x")], kwargs={},
+                        scheduling_strategy=SchedulingStrategy())
+        blob = wire.encode(("push_task", spec))
+        a, b = wire.decode(blob), wire.decode_py(blob)
+        assert a[1].task_id == b[1].task_id == spec.task_id
+        assert a[1].args == b[1].args
+
+    def test_differential_malformed_frames(self):
+        """Mutated frames: both decoders must agree — either both accept
+        with equal values or both reject (any exception; the read loop
+        catches WireDecodeError/ValueError/TypeError alike)."""
+        import random
+
+        rng = random.Random(11)
+        base = wire.encode({"k": [1, "two", b"three", (4.0, None)],
+                            "s": {5, 6}})
+        for _ in range(2000):
+            blob = bytearray(base)
+            for _ in range(rng.randint(1, 4)):
+                op = rng.random()
+                if op < 0.5 and blob:
+                    blob[rng.randrange(len(blob))] = rng.randint(0, 255)
+                elif op < 0.75 and len(blob) > 4:
+                    del blob[rng.randrange(len(blob))]
+                else:
+                    blob.insert(rng.randrange(len(blob) + 1),
+                                rng.randint(0, 255))
+            data = bytes(blob)
+            try:
+                a = ("ok", wire.decode(data))
+            except Exception as e:
+                a = ("err", None)
+            try:
+                b = ("ok", wire.decode_py(data))
+            except Exception:
+                b = ("err", None)
+            assert a[0] == b[0], f"native={a} py={b} frame={data!r}"
+            if a[0] == "ok":
+                assert a[1] == b[1]
