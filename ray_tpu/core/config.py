@@ -74,6 +74,14 @@ _define("rpc_handler_threads", 4,
         "request-handler threads per RpcChannel (worker/agent channels)")
 _define("node_server_threads", 16,
         "handler threads for a node's worker-facing RPC server")
+_define("container_launcher",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))),
+            "scripts", "container_worker_launcher.sh"),
+        "executable that launches a containerized worker: invoked as "
+        "<launcher> <image> [run_options...] -- <worker cmd...>. The "
+        "default is the repo's docker reference script; point it at a "
+        "podman/k8s wrapper for other runtimes")
 _define("capture_worker_logs", 1,
         "tee every worker's stdout/stderr over its node channel into the "
         "head's bounded log store (dashboard log view / state API); "

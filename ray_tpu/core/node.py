@@ -90,7 +90,10 @@ class Node:
             node_id,
             capacity_bytes=int(resources.get("object_store_memory",
                                              config.object_store_memory)),
-            spill_dir=os.path.join(config.object_spilling_dir, node_id.hex()[:8]),
+            spill_dir=(f"{config.object_spilling_dir}/{node_id.hex()[:8]}"
+                       if "://" in str(config.object_spilling_dir)
+                       else os.path.join(config.object_spilling_dir,
+                                         node_id.hex()[:8])),
             min_spilling_size=int(config.min_spilling_size),
         )
         self.total_resources.pop("object_store_memory", None)
@@ -205,6 +208,7 @@ class Node:
         pg, env) signature, so the first head that can't be granted ends
         that bucket — no per-request walk of the backlog."""
         grants = []
+        failures = []
         with self._lock:
             if not self.alive:
                 return
@@ -217,7 +221,12 @@ class Node:
                         continue
                     if not self._fits(req):
                         break  # same demand behind it: none of it fits
-                    worker = self._pop_idle(req.env_hash)
+                    cont = ((req.spec.runtime_env or {}).get("container")
+                            if req.env_hash else None)
+                    # container envs need a worker LAUNCHED inside the
+                    # container — a fresh host worker can't be moved in
+                    worker = self._pop_idle(req.env_hash,
+                                            dedicated_only=cont is not None)
                     if worker is None:
                         # blocked workers don't count toward the cap:
                         # each freed its resources and waits on work that
@@ -229,11 +238,17 @@ class Node:
                             # cap reached but an idle worker bound to a
                             # DIFFERENT runtime_env may be the blocker:
                             # evict one to make room (ref: worker_pool.cc
-                            # idle-worker kill under pressure)
+                            # idle-worker kill under pressure). A
+                            # container request can't use unbound
+                            # workers either — they count as evictable
+                            # for it, or it would starve behind a warm
+                            # pool of plain idle workers.
                             victim = next(
                                 (w for w in self._idle
-                                 if w.state == "idle" and w.env_hash
-                                 not in (None, req.env_hash)), None)
+                                 if w.state == "idle"
+                                 and w.env_hash != req.env_hash
+                                 and (w.env_hash is not None
+                                      or cont is not None)), None)
                             if victim is not None:
                                 self._terminate_worker(victim)
                                 self._idle = deque(
@@ -241,7 +256,22 @@ class Node:
                                     if x is not victim)
                                 active -= 1
                         if active < self._max_workers or not self._workers:
-                            self._start_worker()
+                            try:
+                                self._start_worker(
+                                    container=cont,
+                                    env_hash=req.env_hash if cont else None)
+                            except OSError as e:
+                                # launcher missing/unexecutable: fail THIS
+                                # request with a clear error instead of
+                                # tearing down dispatch for everyone
+                                # (future resolved outside the lock, like
+                                # grants — callbacks may re-enter)
+                                bucket.popleft()
+                                failures.append((req, WorkerCrashedError(
+                                    "container worker launch failed ("
+                                    f"{self.config.container_launcher}): "
+                                    f"{e}")))
+                                continue
                         break  # this bucket needs a worker that isn't
                         # here yet; other buckets (different env) may
                         # still have one
@@ -256,6 +286,9 @@ class Node:
                     del self._lease_queue[sig]
         for req, worker in grants:
             req.future.set_result(worker)
+        for req, err in failures:
+            if not req.future.done():
+                req.future.set_exception(err)
 
     def _fits(self, req: _LeaseRequest) -> bool:
         if req.pg is not None:
@@ -330,7 +363,8 @@ class Node:
     def _worker_alive(self, w: WorkerHandle) -> bool:
         return w.channel is not None and not w.channel.closed
 
-    def _pop_idle(self, env_hash: str = "") -> Optional[WorkerHandle]:
+    def _pop_idle(self, env_hash: str = "",
+                  dedicated_only: bool = False) -> Optional[WorkerHandle]:
         """Pop an idle worker compatible with the request's runtime_env:
         one already dedicated to the same env, or a fresh unbound one (it
         gets dedicated on grant). A worker bound to a DIFFERENT env is
@@ -344,7 +378,8 @@ class Node:
             w = self._idle.popleft()
             if w.state != "idle" or not self._worker_alive(w):
                 continue
-            if w.env_hash is None or w.env_hash == env_hash:
+            if w.env_hash == env_hash or (w.env_hash is None
+                                          and not dedicated_only):
                 found = w
                 break
             kept.append(w)
@@ -353,7 +388,8 @@ class Node:
 
     # ---- worker lifecycle ----------------------------------------------------
 
-    def _start_worker(self) -> WorkerHandle:
+    def _start_worker(self, container: Optional[dict] = None,
+                      env_hash: Optional[str] = None) -> WorkerHandle:
         worker_id = WorkerId.from_random()
         env = dict(os.environ)
         env["PYTHONPATH"] = os.pathsep.join(p for p in sys.path if p)
@@ -368,8 +404,19 @@ class Node:
             "--worker-id", worker_id.hex(),
             "--node-id", self.node_id.hex(),
         ]
+        if container is not None:
+            # containerized worker (ref: runtime_env/container.py):
+            # <launcher> <image> [run_options...] -- <worker cmd...>
+            # scripts/container_worker_launcher.sh is the docker
+            # reference; RTPU_CONTAINER_LAUNCHER/config swaps it
+            launcher = str(self.config.container_launcher)
+            cmd = [launcher, container["image"],
+                   *container.get("run_options", []), "--", *cmd]
         proc = subprocess.Popen(cmd, env=env)
         handle = WorkerHandle(worker_id=worker_id, proc=proc, pid=proc.pid)
+        if env_hash is not None:
+            handle.env_hash = env_hash  # container workers: dedicated
+            # from birth (the env can't be applied to a host process)
         self._workers[worker_id] = handle
         self._starting_count += 1
         # watchdog: a worker that dies before registering must not strand the

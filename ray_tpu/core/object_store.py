@@ -88,6 +88,21 @@ class PlasmaStore:
         self._partial: Dict[ObjectId, int] = {}  # chunked-push progress
         self._entries: "OrderedDict[ObjectId, _Entry]" = OrderedDict()
         self._spill_dir = spill_dir
+        # external storage tier: an fsspec URL ("s3://...", "gs://...",
+        # "memory://...") spills over the network instead of local disk
+        # (ref: python/ray/_private/external_storage.py:72 — there via
+        # smart_open; here the same fsspec machinery as tune/syncer.py)
+        self._spill_fs = None
+        self._spill_root = ""
+        if spill_dir and "://" in spill_dir:
+            from ..tune.syncer import _split
+
+            self._spill_fs, self._spill_root = _split(spill_dir)
+            try:
+                self._spill_fs.makedirs(self._spill_root, exist_ok=True)
+            except Exception:
+                pass
+            spill_dir = ""  # no local mkdir below
         self._destroyed = False
         if spill_dir:
             os.makedirs(spill_dir, exist_ok=True)
@@ -232,10 +247,16 @@ class PlasmaStore:
             except FileNotFoundError:
                 pass
         if e.spilled_path:
-            try:
-                os.unlink(e.spilled_path)
-            except FileNotFoundError:
-                pass
+            if self._spill_fs is not None:
+                try:
+                    self._spill_fs.rm(e.spilled_path)
+                except Exception:
+                    pass  # remote tier cleanup is best-effort
+            else:
+                try:
+                    os.unlink(e.spilled_path)
+                except FileNotFoundError:
+                    pass  # anything else (EPERM, EROFS) must surface
 
     def _ensure_space(self, size: int) -> None:
         if size > self._capacity:
@@ -243,10 +264,23 @@ class PlasmaStore:
                 f"Object of {size} bytes exceeds store capacity {self._capacity}")
         while self._used + size > self._capacity:
             victim = None
+            spill_only = False
             for oid, e in self._entries.items():  # LRU order
                 if e.sealed and not e.pinned and e.shm is not None:
                     victim = (oid, e)
                     break
+            if victim is None and self._spill_dir:
+                # second pass: PINNED primaries may spill (never evict) —
+                # the data survives in the spill tier and restores on
+                # access. This is what spilling is FOR in the reference
+                # (local_object_manager.cc spills pinned primary copies
+                # when memory pressure demands it).
+                for oid, e in self._entries.items():
+                    if e.sealed and e.shm is not None \
+                            and e.size >= self._min_spilling_size:
+                        victim = (oid, e)
+                        spill_only = True
+                        break
             if victim is None:
                 raise ObjectStoreFullError(
                     f"Store full ({self._used}/{self._capacity} bytes) and no "
@@ -256,20 +290,41 @@ class PlasmaStore:
             # ones are simply evicted — their owner can reconstruct
             # (ref: min_spilling_size, local_object_manager.h:110)
             if self._spill_dir and e.size >= self._min_spilling_size:
-                self._spill(oid, e)
+                if not self._spill(oid, e) and spill_only:
+                    # spill tier failed for a PINNED primary: its bytes
+                    # must not be dropped — surface the pressure
+                    raise ObjectStoreFullError(
+                        f"Store full and spill tier unavailable for "
+                        f"pinned {oid.hex()[:12]}")
             else:
                 self._evict(oid, e)
 
-    def _spill(self, oid: ObjectId, e: _Entry) -> None:
-        path = os.path.join(self._spill_dir, f"{self._prefix}_{oid.hex()}")
-        with open(path, "wb") as f:
-            f.write(e.shm.buf[: e.size])
+    def _spill(self, oid: ObjectId, e: _Entry) -> bool:
+        """-> True if the bytes landed in the spill tier (False = the
+        entry was evicted instead; only legal for unpinned copies)."""
+        name = f"{self._prefix}_{oid.hex()}"
+        if self._spill_fs is not None:
+            path = f"{self._spill_root}/{name}"
+            try:
+                with self._spill_fs.open(path, "wb") as f:
+                    f.write(bytes(e.shm.buf[: e.size]))
+            except Exception:
+                # unreachable external storage: evict instead — the
+                # owner reconstructs via lineage (failure path, tested)
+                if not e.pinned:
+                    self._evict(oid, e)
+                return False
+        else:
+            path = os.path.join(self._spill_dir, name)
+            with open(path, "wb") as f:
+                f.write(e.shm.buf[: e.size])
         e.spilled_path = path
         e.shm.close()
         e.shm.unlink()
         e.shm = None
         self._used -= e.size
         self.num_spills += 1
+        return True
 
     def _evict(self, oid: ObjectId, e: _Entry) -> None:
         self._entries.pop(oid)
@@ -279,6 +334,12 @@ class PlasmaStore:
     def _read_spilled(self, e: _Entry) -> Optional[bytes]:
         if not e.spilled_path:
             return None
+        if self._spill_fs is not None:
+            try:
+                with self._spill_fs.open(e.spilled_path, "rb") as f:
+                    return f.read()
+            except Exception:
+                return None  # external copy gone: surfaces as object lost
         with open(e.spilled_path, "rb") as f:
             return f.read()
 
@@ -498,7 +559,9 @@ def make_store(node_id: NodeId, capacity_bytes: int, spill_dir: str = "",
     from ..native import load_store_lib
 
     lib = load_store_lib()
-    if lib is not None:
+    if lib is not None and "://" not in (spill_dir or ""):
+        # fsspec spill URLs route through the Python store (the C++ core
+        # spills to local paths only)
         return NativePlasmaStore(lib, node_id, capacity_bytes, spill_dir,
                                  min_spilling_size)
     return PlasmaStore(node_id, capacity_bytes, spill_dir,

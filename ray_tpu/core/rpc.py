@@ -396,6 +396,12 @@ class _RemoteCallError(Exception):
         self.summary = summary
         self.remote_tb = remote_tb
 
+    def __reduce__(self):
+        # default Exception reduction replays args=(message,) into the
+        # 2-arg __init__ and fails on unpickle — these DO cross process
+        # boundaries when a task result carries one
+        return (_RemoteCallError, (self.summary, self.remote_tb))
+
 
 _CLUSTER_TOKEN: Optional[bytes] = None
 

@@ -934,8 +934,10 @@ class DriverRuntime:
         def _granted(f: Future, node=node):
             try:
                 worker = f.result()
-            except Exception:
-                self.on_worker_crashed(spec, node.node_id)
+            except Exception as e:
+                # the lease error (e.g. container launcher failure) rides
+                # into the final retries-exhausted message
+                self.on_worker_crashed(spec, node.node_id, reason=str(e))
                 return
             self._event_running(spec, node.node_id)
             node.push_task(worker, spec)
@@ -1162,7 +1164,8 @@ class DriverRuntime:
             ev["actor_id"] = spec.actor_id.hex()
         self.gcs.add_task_event(ev)
 
-    def on_worker_crashed(self, spec: TaskSpec, node_id: NodeId) -> None:
+    def on_worker_crashed(self, spec: TaskSpec, node_id: NodeId,
+                          reason: str = "") -> None:
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
             return  # actor FSM handles restart / death
         if spec.num_returns == STREAMING_RETURNS:
@@ -1199,9 +1202,10 @@ class DriverRuntime:
         if retry is not None:
             self._schedule(retry)
             return
+        detail = f": {reason}" if reason else ""
         self._fail_task(spec, exc.WorkerCrashedError(
             f"Worker died while running {spec.description} "
-            f"(node {node_id.hex()[:8]}); retries exhausted"))
+            f"(node {node_id.hex()[:8]}); retries exhausted{detail}"))
 
     # ---- actors --------------------------------------------------------------
 

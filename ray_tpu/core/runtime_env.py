@@ -32,8 +32,15 @@ import tempfile
 import zipfile
 from typing import Callable, Dict, List, Optional
 
-ALLOWED_KEYS = {"env_vars", "working_dir", "py_modules", "pip", "config"}
-GATED_KEYS = {"conda", "container", "image_uri", "uv"}
+ALLOWED_KEYS = {"env_vars", "working_dir", "py_modules", "pip", "config",
+                "container"}
+# conda stays gated by design (README "runtime_env design stance"):
+# TPU hosts run hermetic images whose Python stack must match the
+# baked-in jax/libtpu; pip-in-venv (--system-site-packages) layers on
+# top of it, while a conda env REPLACES the interpreter and would
+# detach workers from the host's TPU stack. Container isolation is the
+# supported heavyweight path.
+GATED_KEYS = {"conda", "image_uri", "uv"}
 # ref: runtime_env/packaging.py GCS_STORAGE_MAX_SIZE guard
 MAX_PACKAGE_BYTES = 500 * 1024 * 1024
 _EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
@@ -66,6 +73,23 @@ def validate(renv: Optional[dict]) -> Optional[dict]:
     mods = renv.get("py_modules") or []
     if mods:
         out["py_modules"] = [str(m) for m in mods]
+    if "container" in renv and renv["container"] is not None:
+        cont = renv["container"]
+        # ref: runtime_env/container.py (podman wrapper there). Shape:
+        # {"image": str, "run_options": [str]}; workers for this env are
+        # LAUNCHED inside the container via the configured launcher
+        # (config.container_launcher; scripts/container_worker_launcher
+        # is the docker reference) — a running worker can't be moved
+        # into one after the fact.
+        if isinstance(cont, str):
+            cont = {"image": cont}
+        if not isinstance(cont, dict) or not cont.get("image"):
+            raise TypeError('container must be {"image": str, '
+                            '"run_options": [str]} or an image string')
+        out["container"] = {
+            "image": str(cont["image"]),
+            "run_options": [str(o) for o in cont.get("run_options", [])],
+        }
     if "pip" in renv and renv["pip"] is not None:
         pip = renv["pip"]
         # ref: runtime_env/pip.py — list of requirement strings, or

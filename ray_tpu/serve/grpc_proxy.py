@@ -25,6 +25,9 @@ import ray_tpu
 from ray_tpu.exceptions import GetTimeoutError
 
 _PREFIX = "/ray_tpu.serve/"
+# typed v1 contract (serve.proto; codegen-able by external clients)
+_TYPED_PREFIX = "/ray_tpu.serve.v1.ServeAPI/"
+_CONTRACT_VERSION = 1
 
 
 class GrpcProxy:
@@ -42,6 +45,15 @@ class GrpcProxy:
         class Handler(grpc.GenericRpcHandler):
             def service(self, call_details):
                 method = call_details.method
+                if method.startswith(_TYPED_PREFIX):
+                    rpc = method[len(_TYPED_PREFIX):]
+                    if rpc == "Predict":
+                        return grpc.unary_unary_rpc_method_handler(
+                            proxy._typed_predict)
+                    if rpc == "PredictStream":
+                        return grpc.unary_stream_rpc_method_handler(
+                            proxy._typed_predict_stream)
+                    return None
                 if not method.startswith(_PREFIX):
                     return None
                 target = method[len(_PREFIX):]
@@ -97,10 +109,8 @@ class GrpcProxy:
                 # honor the CLIENT's gRPC deadline (capped so an
                 # abandoned no-deadline call can't pin a pool thread
                 # forever)
-                remaining = context.time_remaining()
-                timeout = min(remaining, 600.0) if remaining else 60.0
                 result = ray_tpu.get(h.remote(self._payload(request)),
-                                     timeout=timeout)
+                                     timeout=self._deadline(context))
                 return json.dumps(_jsonable(result)).encode()
             except Exception as e:  # noqa: BLE001 — surfaced as INTERNAL
                 context.abort(grpc.StatusCode.INTERNAL,
@@ -133,6 +143,150 @@ class GrpcProxy:
                               f"{type(e).__name__}: {e}")
 
         return rpc
+
+    # -- typed v1 contract (serve.proto) ------------------------------------
+
+    @staticmethod
+    def _pb2():
+        from . import serve_pb2
+
+        return serve_pb2
+
+    def _typed_parse(self, request: bytes):
+        """-> (req, error_response|None). Wire-level garbage and version
+        skew surface as TYPED codes, not transport errors."""
+        pb = self._pb2()
+        try:
+            req = pb.PredictRequest.FromString(request)
+        except Exception:  # noqa: BLE001 — malformed protobuf
+            return None, pb.PredictResponse(
+                version=_CONTRACT_VERSION, code=pb.BAD_REQUEST,
+                message="malformed PredictRequest")
+        if req.version not in (0, _CONTRACT_VERSION):
+            return None, pb.PredictResponse(
+                version=_CONTRACT_VERSION, code=pb.UNSUPPORTED_VERSION,
+                message=f"server speaks v{_CONTRACT_VERSION}, "
+                        f"got v{req.version}")
+        return req, None
+
+    def _typed_body(self, req):
+        if req.content_type in ("", "application/json"):
+            return json.loads(req.payload) if req.payload else None
+        return bytes(req.payload)
+
+    def _typed_result(self, pb, result):
+        if isinstance(result, (bytes, bytearray)):
+            return pb.PredictResponse(
+                version=_CONTRACT_VERSION, code=pb.OK,
+                payload=bytes(result),
+                content_type="application/octet-stream")
+        return pb.PredictResponse(
+            version=_CONTRACT_VERSION, code=pb.OK,
+            payload=json.dumps(_jsonable(result)).encode(),
+            content_type="application/json")
+
+    def _routes_cached(self):
+        """Route set with a short TTL — consulted off the hot path (only
+        to classify failures / reject unknown apps) so the controller is
+        not a per-request serialization point."""
+        import time as _time
+
+        now = _time.monotonic()
+        cached = getattr(self, "_routes_cache", None)
+        if cached is not None and now - cached[0] < 5.0:
+            return cached[1]
+        from .controller import CONTROLLER_NAME
+
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        routes = set(ray_tpu.get(controller.list_deployments.remote(),
+                                 timeout=10))
+        self._routes_cache = (now, routes)
+        return routes
+
+    def _typed_call(self, req, context, stream: bool):
+        """Shared routing for Predict/PredictStream."""
+        pb = self._pb2()
+        try:
+            if req.app not in self._routes_cached():
+                return None, pb.PredictResponse(
+                    version=_CONTRACT_VERSION, code=pb.APP_NOT_FOUND,
+                    message=f"unknown app {req.app!r}; "
+                            f"deployed: {sorted(self._routes_cached())}")
+        except Exception as e:  # noqa: BLE001
+            return None, pb.PredictResponse(
+                version=_CONTRACT_VERSION, code=pb.INTERNAL,
+                message=f"controller unavailable: {e}")
+        h = self._get_handle(req.app)
+        if stream or req.model_id:
+            h = h.options(stream=stream,
+                          multiplexed_model_id=req.model_id or "")
+        try:
+            body = self._typed_body(req)
+        except Exception as e:  # noqa: BLE001
+            return None, pb.PredictResponse(
+                version=_CONTRACT_VERSION, code=pb.BAD_REQUEST,
+                message=f"payload does not parse as "
+                        f"{req.content_type or 'application/json'}: {e}")
+        return (h, body), None
+
+    @staticmethod
+    def _deadline(context, default: float = 60.0, cap: float = 600.0
+                  ) -> float:
+        """Honor the client's gRPC deadline, capped (shared by unary
+        paths so the policy can't drift)."""
+        remaining = context.time_remaining()
+        return min(remaining, cap) if remaining else default
+
+    def _typed_predict(self, request: bytes, context):
+        pb = self._pb2()
+        req, err = self._typed_parse(request)
+        if err is not None:
+            return err.SerializeToString()
+        routed, err = self._typed_call(req, context, stream=False)
+        if err is not None:
+            return err.SerializeToString()
+        h, body = routed
+        try:
+            result = ray_tpu.get(h.remote(body),
+                                 timeout=self._deadline(context))
+        except GetTimeoutError:
+            return pb.PredictResponse(
+                version=_CONTRACT_VERSION, code=pb.TIMEOUT,
+                message=f"deployment {req.app!r} timed out"
+            ).SerializeToString()
+        except Exception as e:  # noqa: BLE001
+            return pb.PredictResponse(
+                version=_CONTRACT_VERSION, code=pb.INTERNAL,
+                message=f"{type(e).__name__}: {e}").SerializeToString()
+        return self._typed_result(pb, result).SerializeToString()
+
+    def _typed_predict_stream(self, request: bytes, context):
+        pb = self._pb2()
+        req, err = self._typed_parse(request)
+        if err is not None:
+            yield err.SerializeToString()
+            return
+        routed, err = self._typed_call(req, context, stream=True)
+        if err is not None:
+            yield err.SerializeToString()
+            return
+        h, body = routed
+        try:
+            gen = h.remote(body)
+            while True:
+                try:
+                    item = gen.next(timeout=600.0)
+                except StopIteration:
+                    break
+                yield self._typed_result(pb, item).SerializeToString()
+        except GetTimeoutError:
+            yield pb.PredictResponse(
+                version=_CONTRACT_VERSION, code=pb.TIMEOUT,
+                message="stream item timed out").SerializeToString()
+        except Exception as e:  # noqa: BLE001
+            yield pb.PredictResponse(
+                version=_CONTRACT_VERSION, code=pb.INTERNAL,
+                message=f"{type(e).__name__}: {e}").SerializeToString()
 
     def _routes_rpc(self, request: bytes, context) -> bytes:
         import grpc

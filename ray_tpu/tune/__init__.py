@@ -12,11 +12,13 @@ from .syncer import Syncer, pull_experiment
 from .schedulers import (ASHAScheduler, AsyncHyperBandScheduler,
                          FIFOScheduler, HyperBandForBOHB,
                          HyperBandScheduler, MedianStoppingRule,
-                         PopulationBasedTraining, TrialScheduler)
-from .search import (BasicVariantGenerator, Choice, Domain, GridSearch,
-                     LogUniform, Randint, RandomSearch, Searcher,
-                     TPESearcher, TuneBOHB, Uniform, choice, grid_search,
-                     loguniform, randint, uniform)
+                         PopulationBasedTraining,
+                         ResourceChangingScheduler, TrialScheduler,
+                         even_cpu_distribution)
+from .search import (BasicVariantGenerator, Choice, Domain, GPSearcher,
+                     GridSearch, LogUniform, Randint, RandomSearch,
+                     Searcher, TPESearcher, TuneBOHB, Uniform, choice,
+                     grid_search, loguniform, randint, uniform)
 from .session import get_checkpoint, report
 from .trainable import Trainable
 from .tuner import (ResultGrid, Trial, TuneConfig, TuneController, Tuner,
@@ -30,7 +32,8 @@ __all__ = [
     "MedianStoppingRule", "PB2", "PopulationBasedTraining",
     "Syncer", "pull_experiment",
     "Searcher", "BasicVariantGenerator", "RandomSearch", "TPESearcher",
-    "TuneBOHB",
+    "TuneBOHB", "GPSearcher",
+    "ResourceChangingScheduler", "even_cpu_distribution",
     "Domain", "Uniform", "LogUniform", "Randint", "Choice", "GridSearch",
     "uniform", "loguniform", "randint", "choice", "grid_search",
 ]

@@ -335,3 +335,82 @@ class PopulationBasedTraining(TrialScheduler):
                     continue
                 new_config = self._explore(donor.config)
                 controller.exploit_trial(t, donor, new_config)
+
+
+class ResourceChangingScheduler(TrialScheduler):
+    """Reallocate trial resources mid-run (ref:
+    tune/schedulers/resource_changing_scheduler.py). Wraps a base
+    scheduler; after each result, `resources_allocation_function(
+    controller, trial, result, scheduler) -> Optional[dict]` may return
+    a new resource dict for the trial. A change pauses the trial
+    (checkpoint + release its placement group) and resumes it with the
+    new allocation — the same save/stop/restart mechanics HyperBand
+    rungs use, so trainables need only normal checkpointing."""
+
+    def __init__(self, base_scheduler: Optional[TrialScheduler] = None,
+                 resources_allocation_function=None):
+        self.base = base_scheduler or FIFOScheduler()
+        self.fn = resources_allocation_function
+        self._pending: list = []      # trials awaiting reallocation
+        self._resuming: set = set()   # trial_ids we paused for resize
+
+    def set_metric(self, metric: str, mode: str) -> None:
+        super().set_metric(metric, mode)
+        self.base.set_metric(metric, mode)
+
+    def on_result(self, trial, result: dict) -> str:
+        decision = self.base.on_result(trial, result)
+        if decision == CONTINUE and self.fn is not None:
+            self._pending.append((trial, dict(result)))
+        return decision
+
+    def on_complete(self, trial, result) -> None:
+        self.base.on_complete(trial, result)
+
+    def choose_action(self, controller) -> None:
+        self.base.choose_action(controller)
+        pending, self._pending = self._pending, []
+        for trial, result in pending:
+            if trial.status != "RUNNING":
+                continue
+            try:
+                new_res = self.fn(controller, trial, result, self)
+            except Exception:
+                continue
+            if not new_res:
+                continue
+            current = trial.resources or controller.tc.trial_resources
+            if dict(new_res) == dict(current):
+                continue
+            trial.resources = dict(new_res)
+            controller._pause_trial(trial)
+            self._resuming.add(trial.trial_id)
+        # resume resized trials immediately (their pause was ours, not a
+        # rung barrier)
+        for t in controller.paused_trials():
+            if t.trial_id in self._resuming:
+                self._resuming.discard(t.trial_id)
+                controller.resume_trial(t)
+
+    def on_deadlock(self, controller) -> None:
+        for t in controller.paused_trials():
+            if t.trial_id in self._resuming:
+                self._resuming.discard(t.trial_id)
+                controller.resume_trial(t)
+        self.base.on_deadlock(controller)
+
+
+def even_cpu_distribution(max_cpu_per_trial: float = 4.0):
+    """A simple resources_allocation_function: spread the cluster's CPUs
+    evenly over live trials, capped (the reference's
+    DistributeResources analog)."""
+    import ray_tpu
+
+    def fn(controller, trial, result, scheduler):
+        live = max(1, len(controller.running_trials())
+                   + len(controller.paused_trials()))
+        total = ray_tpu.cluster_resources().get("CPU", 1.0)
+        share = max(1.0, min(max_cpu_per_trial, total // live))
+        return {"CPU": float(share)}
+
+    return fn

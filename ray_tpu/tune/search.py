@@ -99,6 +99,10 @@ class Searcher:
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
         raise NotImplementedError
 
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        """Intermediate feedback (every reported result) — budget-aware
+        searchers (GP/BOHB) refine on rung results, not just finals."""
+
     def on_trial_complete(self, trial_id: str, result: Optional[dict]) -> None:
         pass
 
@@ -283,6 +287,128 @@ class TPESearcher(Searcher):
         if self.mode == "min":
             score = -score
         self._obs.append((cfg, score))
+
+
+class GPSearcher(Searcher):
+    """Native Bayesian optimization: the PB2 GP promoted to a standalone
+    searcher (ref: tune/search/bayesopt/bayesopt_search.py — there via
+    the bayesian-optimization package; here the same RBF-GP that powers
+    PB2, with Expected Improvement over a random candidate pool).
+
+    Configs encode into the unit cube (LogUniform in log space, Randint
+    scaled, Choice as index); y is z-normalized per fit. Budget-aware
+    observations (on_trial_result) keep only each trial's HIGHEST-budget
+    score, so pairing this searcher with HyperBand brackets gives the
+    BOHB shape: the model trains on the deepest evaluations available
+    (ref: tune/search/bohb/bohb_search.py)."""
+
+    def __init__(self, n_initial_points: int = 6, n_candidates: int = 256,
+                 kappa_ei: float = 0.01, seed: Optional[int] = None):
+        self.n_initial = n_initial_points
+        self.n_candidates = n_candidates
+        self.xi = kappa_ei
+        self._rng = random.Random(seed)
+        self._np_rng = None  # numpy rng, created lazily (pickle-friendly)
+        # trial_id -> (config, score, budget); model uses the latest
+        self._obs: Dict[str, tuple] = {}
+        self._suggested: Dict[str, Dict[str, Any]] = {}
+
+    # encoding -----------------------------------------------------------
+
+    def _dims(self):
+        out = {}
+        for k, v in self.param_space.items():
+            if _is_grid(v):
+                out[k] = Choice(list(v["grid_search"]))
+            elif isinstance(v, Domain):
+                out[k] = v
+        return out
+
+    @staticmethod
+    def _unit(dom, x) -> float:
+        if isinstance(dom, LogUniform):
+            lo, hi = math.log(dom.low), math.log(dom.high)
+            return (math.log(x) - lo) / max(hi - lo, 1e-12)
+        if isinstance(dom, Uniform):
+            return (x - dom.low) / max(dom.high - dom.low, 1e-12)
+        if isinstance(dom, Randint):
+            return (x - dom.low) / max(dom.high - 1 - dom.low, 1)
+        if isinstance(dom, Choice):
+            vals = list(map(repr, dom.values))
+            return vals.index(repr(x)) / max(len(vals) - 1, 1)
+        return 0.0
+
+    @staticmethod
+    def _from_unit(dom, u: float):
+        u = min(max(u, 0.0), 1.0)
+        if isinstance(dom, LogUniform):
+            lo, hi = math.log(dom.low), math.log(dom.high)
+            return math.exp(lo + u * (hi - lo))
+        if isinstance(dom, Uniform):
+            return dom.low + u * (dom.high - dom.low)
+        if isinstance(dom, Randint):
+            return dom.low + int(round(u * (dom.high - 1 - dom.low)))
+        if isinstance(dom, Choice):
+            return dom.values[int(round(u * (len(dom.values) - 1)))]
+        return u
+
+    # Searcher API -------------------------------------------------------
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        import numpy as np
+
+        dims = self._dims()
+        fixed = {k: v for k, v in self.param_space.items() if k not in dims}
+        obs = list(self._obs.values())
+        if len(obs) < self.n_initial or not dims:
+            cfg = {k: d.sample(self._rng) for k, d in dims.items()}
+        else:
+            from .pb2 import _GP
+
+            keys = sorted(dims)
+            X = np.array([[self._unit(dims[k], c.get(k)) for k in keys]
+                          for c, _, _ in obs], np.float64)
+            y = np.array([s for _, s, _ in obs], np.float64)
+            mu_y, sd_y = float(y.mean()), float(y.std() or 1.0)
+            gp = _GP(lengthscale=0.25)
+            gp.fit(X, (y - mu_y) / sd_y)
+            if self._np_rng is None:
+                self._np_rng = np.random.default_rng(
+                    self._rng.randrange(2 ** 31))
+            cand = self._np_rng.random((self.n_candidates, len(keys)))
+            mu, sd = gp.predict(cand)
+            best = float(((y - mu_y) / sd_y).max())
+            # Expected Improvement (maximization in normalized space)
+            z = (mu - best - self.xi) / np.maximum(sd, 1e-9)
+            phi = np.exp(-0.5 * z * z) / math.sqrt(2 * math.pi)
+            Phi = 0.5 * (1.0 + np.vectorize(math.erf)(z / math.sqrt(2)))
+            ei = (mu - best - self.xi) * Phi + sd * phi
+            u = cand[int(np.argmax(ei))]
+            cfg = {k: self._from_unit(dims[k], float(u[i]))
+                   for i, k in enumerate(keys)}
+        cfg.update(fixed)
+        self._suggested[trial_id] = dict(cfg)
+        return cfg
+
+    def _record(self, trial_id: str, result: Optional[dict]) -> None:
+        cfg = self._suggested.get(trial_id)
+        if cfg is None or not result or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if self.mode == "min":
+            score = -score
+        budget = float(result.get("training_iteration", 0))
+        prev = self._obs.get(trial_id)
+        if prev is None or budget >= prev[2]:
+            self._obs[trial_id] = (cfg, score, budget)
+
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        self._record(trial_id, result)
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[dict]) -> None:
+        self._record(trial_id, result)
+        self._suggested.pop(trial_id, None)
 
 
 # the BOHB pairing name (model-based half; pair with HyperBandForBOHB)

@@ -69,6 +69,9 @@ class Trial:
         self.latest_checkpoint: Optional[dict] = None
         self.error: Optional[BaseException] = None
         self.pbt_ready = False
+        # per-trial resource override (ResourceChangingScheduler); None
+        # = the experiment-wide TuneConfig.trial_resources
+        self.resources: Optional[Dict[str, float]] = None
 
     def __repr__(self):
         return f"Trial({self.trial_id}, {self.status})"
@@ -273,7 +276,7 @@ class TuneController:
     # -- trial lifecycle -----------------------------------------------------
 
     def _start_runner(self, trial: Trial, checkpoint: Optional[dict] = None):
-        res = dict(self.tc.trial_resources)
+        res = dict(trial.resources or self.tc.trial_resources)
         if trial.pg is None:
             trial.pg = placement_group([dict(res)], strategy="PACK")
             if not trial.pg.ready(timeout=60.0):
@@ -418,6 +421,11 @@ class TuneController:
                 trial.last_result = result
                 trial.metrics_history.append(result)
                 self._maybe_checkpoint(trial, result)
+                # hook probe (not try/except — that would also swallow
+                # AttributeErrors raised INSIDE a searcher's own hook)
+                hook = getattr(self.searcher, "on_trial_result", None)
+                if hook is not None:
+                    hook(trial.trial_id, result)
                 decision = self.scheduler.on_result(trial, result)
                 if decision == STOP or self._should_stop(result):
                     self._finish(trial, TERMINATED)

@@ -148,3 +148,73 @@ class TestNativeOnly:
         assert not s.contains(oid)
         with pytest.raises(ObjectStoreFullError):
             s.create(ObjectId.from_random(), 10)
+
+
+class TestExternalSpillStorage:
+    """Spill-to-cloud tier: an fsspec URL as the spilling target (ref:
+    python/ray/_private/external_storage.py:72 — S3/smart_open there,
+    fsspec here, same machinery as tune/syncer.py)."""
+
+    def _store(self, root):
+        from ray_tpu.core.ids import NodeId
+        from ray_tpu.core.object_store import PlasmaStore
+
+        return PlasmaStore(NodeId.from_random(), capacity_bytes=1 << 20,
+                           spill_dir=root, min_spilling_size=1)
+
+    def test_spill_restore_roundtrip_via_memory_fs(self):
+        import fsspec
+
+        store = self._store("memory://spill_rt")
+        payloads = {}
+        from ray_tpu.core.ids import ObjectId
+
+        # overfill the 1MiB store with 3 x 512KiB objects -> spills
+        for i in range(3):
+            oid = ObjectId(bytes([i]) * 16)
+            data = bytes([i]) * (512 * 1024)
+            payloads[oid] = data
+            name = store.create(oid, len(data))
+            import multiprocessing.shared_memory as shm_mod
+
+            seg = shm_mod.SharedMemory(name=name)
+            seg.buf[:len(data)] = data
+            seg.close()
+            store.seal(oid)
+        assert store.stats()["num_spills"] >= 1
+        fs = fsspec.filesystem("memory")
+        assert fs.ls("/spill_rt", detail=False), \
+            "spilled files exist in external tier"
+        # every object restores bit-exact (spilled ones pulled back)
+        for oid, data in payloads.items():
+            got = store.get_bytes(oid)
+            assert got == data
+        store.destroy()
+
+    def test_external_copy_lost_surfaces_as_missing(self):
+        import fsspec
+
+        store = self._store("memory://spill_lost")
+        from ray_tpu.core.ids import ObjectId
+
+        oids = []
+        for i in range(3):
+            oid = ObjectId(bytes([16 + i]) * 16)
+            data = bytes([i]) * (512 * 1024)
+            name = store.create(oid, len(data))
+            import multiprocessing.shared_memory as shm_mod
+
+            seg = shm_mod.SharedMemory(name=name)
+            seg.buf[:len(data)] = data
+            seg.close()
+            store.seal(oid)
+            oids.append(oid)
+        assert store.stats()["num_spills"] >= 1
+        fs = fsspec.filesystem("memory")
+        for p in fs.ls("/spill_lost", detail=False):
+            fs.rm(p)
+        # the spilled object's bytes are gone: read reports missing
+        # (lineage recovery's signal), no crash
+        spilled = [o for o in oids if store.get_bytes(o) is None]
+        assert spilled, "at least one object was in the lost tier"
+        store.destroy()

@@ -1,6 +1,7 @@
 """ray_tpu.serve: deploy/scale/route/recover + sharded mesh inference
 (ref test model: python/ray/serve/tests/ controller/replica/handle e2e)."""
 import json
+import os
 import time
 import urllib.request
 
@@ -515,3 +516,87 @@ class TestGrpcIngress:
         with _pytest.raises(grpc.RpcError) as ei:
             grpc_call(addr, "Boom", {})
         assert ei.value.code() == grpc.StatusCode.INTERNAL
+
+
+class TestTypedGrpcContract:
+    """The versioned serve.proto contract (ref:
+    src/ray/protobuf/serve.proto): an external client codegens from the
+    .proto and calls Predict/PredictStream with plain grpc — no ray_tpu
+    import on the client side (proved via subprocess with a scrubbed
+    sys.path)."""
+
+    CLIENT = r'''
+import json, sys
+sys.path = [p for p in sys.path if "repo" not in p]  # no ray_tpu
+sys.path.insert(0, sys.argv[2])  # the codegen output dir only
+import grpc
+import serve_pb2
+
+addr = sys.argv[1]
+ch = grpc.insecure_channel(addr)
+call = ch.unary_unary(
+    "/ray_tpu.serve.v1.ServeAPI/Predict",
+    request_serializer=lambda m: m.SerializeToString(),
+    response_deserializer=serve_pb2.PredictResponse.FromString)
+
+# happy path
+resp = call(serve_pb2.PredictRequest(
+    version=1, app="Doubler", payload=json.dumps({"x": 21}).encode()))
+assert resp.code == serve_pb2.OK, resp
+assert json.loads(resp.payload) == {"y": 42}, resp.payload
+
+# typed APP_NOT_FOUND (not a transport error)
+resp2 = call(serve_pb2.PredictRequest(version=1, app="Nope"))
+assert resp2.code == serve_pb2.APP_NOT_FOUND, resp2
+
+# version negotiation
+resp3 = call(serve_pb2.PredictRequest(version=99, app="Doubler"))
+assert resp3.code == serve_pb2.UNSUPPORTED_VERSION, resp3
+
+# streaming
+stream = ch.unary_stream(
+    "/ray_tpu.serve.v1.ServeAPI/PredictStream",
+    request_serializer=lambda m: m.SerializeToString(),
+    response_deserializer=serve_pb2.PredictResponse.FromString)
+items = [json.loads(r.payload) for r in stream(serve_pb2.PredictRequest(
+    version=1, app="Ticker", payload=json.dumps({"n": 3}).encode()))]
+assert items == [{"i": 0}, {"i": 1}, {"i": 2}], items
+print("TYPED-CLIENT-OK")
+'''
+
+    def test_codegen_client_without_ray_tpu(self, cluster, tmp_path):
+        import subprocess
+        import sys as _sys
+
+        @serve.deployment
+        class Doubler:
+            def __call__(self, body):
+                return {"y": body["x"] * 2}
+
+        @serve.deployment
+        class Ticker:
+            def __call__(self, body):
+                for i in range(body["n"]):
+                    yield {"i": i}
+
+        serve.run(Doubler.bind())
+        serve.run(Ticker.bind())
+        addr = serve.start_grpc_proxy(port=0)
+
+        # the contract is the .proto: codegen into a bare dir
+        import shutil
+
+        proto_dir = tmp_path / "gen"
+        proto_dir.mkdir()
+        src = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "ray_tpu", "serve", "serve.proto")
+        shutil.copy(src, proto_dir / "serve.proto")
+        subprocess.run(["protoc", f"--python_out={proto_dir}",
+                        "serve.proto"], cwd=proto_dir, check=True)
+        script = tmp_path / "client.py"
+        script.write_text(self.CLIENT)
+        out = subprocess.run(
+            [_sys.executable, str(script), f"{addr[0]}:{addr[1]}",
+             str(proto_dir)],
+            capture_output=True, text=True, timeout=120)
+        assert "TYPED-CLIENT-OK" in out.stdout, (out.stdout, out.stderr)
