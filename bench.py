@@ -150,11 +150,20 @@ def _probe_achievable_tflops(n: int = 8192, iters: int = 4) -> float:
     scripts/mfu_calibrate.py)."""
     try:
         a = jnp.ones((n, n), jnp.bfloat16)
-        mm = jax.jit(lambda a: a @ a)
-        float(jnp.sum(mm(a)[:1, :1]))  # compile + sync (tunnel-safe)
+
+        # one dispatch scanning `iters` dependent matmuls: per-dispatch
+        # tunnel RTT amortizes away (the calibrate script's method 3)
+        @jax.jit
+        def fused(a):
+            def body(acc, _):
+                return acc, jnp.sum((a @ a)[:1, :1])
+
+            _, outs = jax.lax.scan(body, a, None, length=iters)
+            return outs
+
+        float(jnp.sum(fused(a)))  # compile + sync (tunnel-safe)
         t0 = time.perf_counter()
-        outs = [mm(a) for _ in range(iters)]
-        float(jnp.sum(outs[-1][:1, :1]))
+        float(jnp.sum(fused(a)))
         dt = (time.perf_counter() - t0) / iters
         return 2 * n * n * n / dt
     except Exception:

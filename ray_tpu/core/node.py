@@ -118,6 +118,9 @@ class Node:
         self._max_workers = max(int(config.num_workers_soft_limit),
                                 int(self.total_resources.get("CPU", 1)))
         self._prefetch_depth = max(1, int(config.worker_task_prefetch))
+        # env_hash -> consecutive died-before-register count (reset on a
+        # successful register; see _note_launch_failure)
+        self._launch_failures: Dict[str, int] = {}
         for _ in range(int(config.worker_prestart_count)):
             self._start_worker()
         # idle-worker reclamation (ref: worker_pool.cc idle worker killing;
@@ -405,13 +408,11 @@ class Node:
             "--node-id", self.node_id.hex(),
         ]
         if container is not None:
-            # containerized worker (ref: runtime_env/container.py):
-            # <launcher> <image> [run_options...] -- <worker cmd...>
-            # scripts/container_worker_launcher.sh is the docker
-            # reference; RTPU_CONTAINER_LAUNCHER/config swaps it
-            launcher = str(self.config.container_launcher)
-            cmd = [launcher, container["image"],
-                   *container.get("run_options", []), "--", *cmd]
+            # containerized worker (ref: runtime_env/container.py)
+            from .runtime_env import container_command
+
+            cmd = container_command(self.config.container_launcher,
+                                    container, cmd)
         proc = subprocess.Popen(cmd, env=env)
         handle = WorkerHandle(worker_id=worker_id, proc=proc, pid=proc.pid)
         if env_hash is not None:
@@ -446,6 +447,7 @@ class Node:
             handle.channel = channel
             handle.pid = payload.get("pid", handle.pid)
             handle.state = "idle"
+            self._launch_failures.pop(handle.env_hash or "", None)
             handle.idle_since = time.monotonic()
             self._starting_count = max(0, self._starting_count - 1)
             self._idle.append(handle)
@@ -456,6 +458,7 @@ class Node:
         with self._lock:
             if worker.state == "dead":
                 return
+            was_starting = worker.state == "starting"
             worker.state = "dead"
             self._workers.pop(worker.worker_id, None)
             if worker.blocked_depth > 0:
@@ -476,7 +479,35 @@ class Node:
         if actor_id is not None and self.alive:
             self.runtime.gcs.on_actor_failure(
                 actor_id, f"worker {worker.worker_id.hex()[:8]} died")
+        if was_starting:
+            # died before registering: a broken launch recipe (bad
+            # container launcher, missing runtime inside the image) would
+            # otherwise loop start->die->restart forever — after three
+            # consecutive strikes, fail the env's queued work instead
+            self._note_launch_failure(worker.env_hash or "")
         self._dispatch()
+
+    _LAUNCH_STRIKES = 3
+
+    def _note_launch_failure(self, env_hash: str) -> None:
+        to_fail: list = []
+        with self._lock:
+            n = self._launch_failures.get(env_hash, 0) + 1
+            self._launch_failures[env_hash] = n
+            if n < self._LAUNCH_STRIKES:
+                return
+            self._launch_failures[env_hash] = 0
+            for sig in list(self._lease_queue.keys()):
+                if sig[2] == env_hash:
+                    to_fail.extend(self._lease_queue.pop(sig))
+        for req in to_fail:
+            if not req.future.done():
+                req.future.set_exception(WorkerCrashedError(
+                    f"workers for runtime_env {env_hash or '<plain>'} "
+                    f"exited before registering {self._LAUNCH_STRIKES} "
+                    f"times in a row on node {self.node_id.hex()[:8]} — "
+                    f"check the worker launch recipe (container "
+                    f"launcher / image) and worker logs"))
 
     def _terminate_worker(self, worker: WorkerHandle) -> None:
         worker.state = "dead"

@@ -128,7 +128,8 @@ class NodeAgent:
 
     def _handle_head_command(self, method: str, payload):
         if method == "start_worker":
-            self._start_worker(payload["worker_id"])
+            self._start_worker(payload["worker_id"],
+                               container=payload.get("container"))
             return True
         if method == "push_task":
             ch = self._channels.get(payload["worker_id"])
@@ -220,7 +221,8 @@ class NodeAgent:
 
     # ---- worker lifecycle ----------------------------------------------------
 
-    def _start_worker(self, worker_id: WorkerId) -> None:
+    def _start_worker(self, worker_id: WorkerId,
+                      container: dict | None = None) -> None:
         env = dict(os.environ)
         env["PYTHONPATH"] = os.pathsep.join(p for p in sys.path if p)
         env["RTPU_AUTHKEY"] = cluster_token().hex()  # env, never argv
@@ -230,7 +232,23 @@ class NodeAgent:
             "--worker-id", worker_id.hex(),
             "--node-id", self.node_id.hex(),
         ]
-        proc = subprocess.Popen(cmd, env=env)
+        if container:
+            # same launcher contract as Node._start_worker, on THIS host
+            from .runtime_env import container_command
+
+            cmd = container_command(self.config.container_launcher,
+                                    container, cmd)
+        try:
+            proc = subprocess.Popen(cmd, env=env)
+        except OSError as e:
+            # launcher missing/unexecutable: report the launch failure so
+            # the head releases the 'starting' slot and fails the lease
+            # instead of waiting forever for a register
+            if not self._stopped.is_set() and not self.head.closed:
+                self.head.notify("worker_exit", {
+                    "worker_id": worker_id,
+                    "error": f"worker launch failed ({cmd[0]}): {e}"})
+            return
         with self._lock:
             self._procs[worker_id] = proc
         threading.Thread(target=self._reap, args=(worker_id, proc),

@@ -95,9 +95,9 @@ class PlasmaStore:
         self._spill_fs = None
         self._spill_root = ""
         if spill_dir and "://" in spill_dir:
-            from ..tune.syncer import _split
+            from ..util.fs import split_fs_url
 
-            self._spill_fs, self._spill_root = _split(spill_dir)
+            self._spill_fs, self._spill_root = split_fs_url(spill_dir)
             try:
                 self._spill_fs.makedirs(self._spill_root, exist_ok=True)
             except Exception:
@@ -210,6 +210,11 @@ class PlasmaStore:
                 return None
             if e.shm is None:  # spilled: restore
                 data = self._read_spilled(e)
+                if data is None:
+                    # external spill copy lost/unreachable: report the
+                    # object missing (lineage recovery's signal) rather
+                    # than poisoning the entry with a half-made segment
+                    return None
                 self._ensure_space(e.size)
                 shm = shared_memory.SharedMemory(
                     name=self.segment_name(object_id), create=True, size=max(e.size, 1))

@@ -122,6 +122,7 @@ class RemoteNode(Node):
         self._bundles = {}
         self._starting_count = 0
         self._prefetch_depth = max(1, int(config.worker_task_prefetch))
+        self._launch_failures = {}  # Node's launch-strike breaker state
         self.alive = True
         self.channel = channel
         self.peer_addr = None  # agent's P2P object-server (host, port)
@@ -137,13 +138,21 @@ class RemoteNode(Node):
 
     # ---- worker lifecycle (forwarded) ---------------------------------------
 
-    def _start_worker(self) -> WorkerHandle:
+    def _start_worker(self, container=None,
+                      env_hash=None) -> WorkerHandle:
         worker_id = WorkerId.from_random()
         handle = WorkerHandle(worker_id=worker_id, proc=None)  # type: ignore
+        if env_hash is not None:
+            handle.env_hash = env_hash  # container workers: dedicated
         self._workers[worker_id] = handle
         self._starting_count += 1
+        msg = {"worker_id": worker_id}
+        if container is not None:
+            # the agent launches inside the container on ITS host via
+            # its configured launcher (same contract as the local Node)
+            msg["container"] = dict(container)
         try:
-            self.channel.notify("start_worker", {"worker_id": worker_id})
+            self.channel.notify("start_worker", msg)
         except Exception:
             self._on_worker_exit(handle)
         return handle
@@ -158,16 +167,39 @@ class RemoteNode(Node):
             handle.pid = pid
             handle.state = "idle"
             self._starting_count = max(0, self._starting_count - 1)
+            self._launch_failures.pop(handle.env_hash or "", None)
             self._idle.append(handle)
         self._dispatch()
 
-    def on_remote_worker_exit(self, worker_id: WorkerId) -> None:
+    def on_remote_worker_exit(self, worker_id: WorkerId,
+                              error: str = None) -> None:
+        fail_req = None
         with self._lock:
             handle = self._workers.get(worker_id)
             if handle is None:
                 return
+            launch_failed = handle.state == "starting" and error
             if handle.state == "starting":
                 self._starting_count = max(0, self._starting_count - 1)
+            if launch_failed:
+                # the worker never came up (e.g. container launcher
+                # missing on the agent host): fail one queued request of
+                # the env this worker was started for, instead of
+                # looping start->fail forever
+                want_env = handle.env_hash or ""
+                for sig in list(self._lease_queue.keys()):
+                    if sig[2] == want_env:
+                        bucket = self._lease_queue[sig]
+                        fail_req = bucket.popleft()
+                        if not bucket:
+                            del self._lease_queue[sig]
+                        break
+        if fail_req is not None and not fail_req.future.done():
+            from ..exceptions import WorkerCrashedError
+
+            fail_req.future.set_exception(WorkerCrashedError(
+                f"remote worker launch failed on node "
+                f"{self.node_id.hex()[:8]}: {error}"))
         self._on_worker_exit(handle)
 
     def _worker_alive(self, w: WorkerHandle) -> bool:

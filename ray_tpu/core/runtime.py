@@ -310,7 +310,8 @@ class DriverRuntime:
                                                payload.get("pid", 0))
                 return True
             if method == "worker_exit":
-                node.on_remote_worker_exit(payload["worker_id"])
+                node.on_remote_worker_exit(payload["worker_id"],
+                                           error=payload.get("error"))
                 return None
             if method == "task_done":
                 worker = node.get_worker(payload["worker_id"])

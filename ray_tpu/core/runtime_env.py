@@ -224,6 +224,16 @@ def cache_key(renv: dict) -> str:
     return json.dumps({"env": renv, "fp": fps}, sort_keys=True)
 
 
+def container_command(launcher: str, container: dict,
+                      base_cmd: list) -> list:
+    """THE launcher invocation contract, shared by the local Node and
+    remote NodeAgent worker starts:
+        <launcher> <image> [run_options...] -- <worker cmd...>
+    (scripts/container_worker_launcher.sh is the docker reference)."""
+    return [str(launcher), container["image"],
+            *container.get("run_options", []), "--", *base_cmd]
+
+
 def env_hash(packaged: Optional[dict]) -> str:
     """'' = the plain environment (no runtime_env)."""
     return packaged.get("_hash", "") if packaged else ""

@@ -45,6 +45,11 @@ _EXPORTS = {
     "BreakoutShapedJax": "jax_env", "make_jax_env": "jax_env",
     "register_jax_env": "jax_env",
     "ES": "es", "ESConfig": "es", "ESWorker": "es",
+    "TD3": "td3", "TD3Config": "td3", "DDPGConfig": "td3",
+    "TD3Learner": "td3",
+    "Bandit": "bandit", "BanditConfig": "bandit",
+    "BanditLinUCBConfig": "bandit", "BanditLinTSConfig": "bandit",
+    "LinearBanditEnv": "bandit", "register_bandit_env": "bandit",
     "QMIX": "qmix", "QMIXConfig": "qmix",
     "PolicyServerInput": "policy_server",
     "ExternalPPO": "policy_server", "ExternalPPOConfig": "policy_server",

@@ -1,0 +1,189 @@
+"""Contextual bandits — LinUCB and linear Thompson sampling.
+
+ref: rllib/algorithms/bandit/bandit.py (+ bandit_torch_model.py
+DiscreteLinearModel): per-arm Bayesian linear models over the context
+    A_k = I*lambda + sum x x^T      b_k = sum r x
+    theta_k = A_k^-1 b_k
+LinUCB scores theta_k.x + alpha * sqrt(x^T A_k^-1 x) (Li et al. 2010);
+LinTS samples theta ~ N(theta_k, v^2 A_k^-1) (Agrawal & Goyal 2013).
+
+Bandits are single-step decisions — no rollout workers, no replay, no
+device: the posteriors are tiny dense matrices updated in closed form
+on the driver. The numpy solve IS the algorithm; a chip would only add
+dispatch latency (same judgment as np_policy's rollout stance).
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+
+class ContextualBanditEnv:
+    """Batch contextual bandit: observe contexts, pick arms, get
+    rewards. The test model is the reference's SimpleContextualBandit
+    (rllib/examples/env/bandit_envs_discrete.py)."""
+
+    num_arms: int
+    context_dim: int
+
+    def observe(self, n: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def pull(self, contexts: np.ndarray, arms: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def best_reward(self, contexts: np.ndarray) -> np.ndarray:
+        """Oracle per-context best expected reward (for regret)."""
+        raise NotImplementedError
+
+
+class LinearBanditEnv(ContextualBanditEnv):
+    """Rewards are arm-specific linear functions of the context plus
+    Gaussian noise — the canonical LinUCB testbed."""
+
+    def __init__(self, num_arms: int = 5, context_dim: int = 8,
+                 noise: float = 0.1, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        self.num_arms, self.context_dim = num_arms, context_dim
+        self.noise = noise
+        self._theta = rng.standard_normal((num_arms, context_dim))
+        self._theta /= np.linalg.norm(self._theta, axis=1, keepdims=True)
+        self._rng = rng
+
+    def observe(self, n: int) -> np.ndarray:
+        x = self._rng.standard_normal((n, self.context_dim))
+        return (x / np.linalg.norm(x, axis=1, keepdims=True)
+                ).astype(np.float32)
+
+    def pull(self, contexts, arms):
+        mean = np.einsum("nd,nd->n", self._theta[arms], contexts)
+        return (mean + self._rng.normal(0, self.noise, len(arms))
+                ).astype(np.float32)
+
+    def best_reward(self, contexts):
+        return (contexts @ self._theta.T).max(axis=1)
+
+
+_BANDIT_ENVS: Dict[str, Callable[..., ContextualBanditEnv]] = {
+    "LinearBandit-v0": LinearBanditEnv,
+}
+
+
+def register_bandit_env(name: str, creator) -> None:
+    _BANDIT_ENVS[name] = creator
+
+
+@dataclass
+class BanditConfig:
+    """ref: bandit.py BanditLinUCBConfig / BanditLinTSConfig."""
+    env: str = "LinearBandit-v0"
+    env_creator: Optional[Callable] = None
+    exploration: str = "ucb"        # "ucb" | "thompson"
+    alpha: float = 1.0              # UCB width / TS variance scale
+    lambda_reg: float = 1.0
+    batch_size: int = 64            # decisions per train() iteration
+    seed: int = 0
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def build(self) -> "Bandit":
+        return Bandit(self)
+
+
+def BanditLinUCBConfig(**kw) -> BanditConfig:  # noqa: N802 — ref naming
+    return BanditConfig(exploration="ucb", **kw)
+
+
+def BanditLinTSConfig(**kw) -> BanditConfig:  # noqa: N802
+    return BanditConfig(exploration="thompson", **kw)
+
+
+class Bandit:
+    """Tune-trainable bandit driver with per-arm linear posteriors."""
+
+    def __init__(self, config: BanditConfig):
+        c = self.config = config
+        if c.env_creator is not None:
+            self.env = c.env_creator()
+        else:
+            self.env = _BANDIT_ENVS[c.env](seed=c.seed)
+        K, D = self.env.num_arms, self.env.context_dim
+        self._A = np.stack([np.eye(D) * c.lambda_reg for _ in range(K)])
+        self._b = np.zeros((K, D))
+        self._rng = np.random.default_rng(c.seed + 1)
+        self._iteration = 0
+        self._total_pulls = 0
+        self._cum_reward = 0.0
+        self._cum_regret = 0.0
+
+    def _scores(self, contexts: np.ndarray) -> np.ndarray:
+        c = self.config
+        K = self.env.num_arms
+        n = len(contexts)
+        A_inv = np.linalg.inv(self._A)                  # [K, D, D]
+        theta = np.einsum("kde,ke->kd", A_inv, self._b)  # [K, D]
+        mean = contexts @ theta.T                        # [n, K]
+        if c.exploration == "thompson":
+            # one posterior sample per arm per decision batch
+            out = np.empty((n, K))
+            for k in range(K):
+                L = np.linalg.cholesky(
+                    A_inv[k] * (c.alpha ** 2)
+                    + 1e-12 * np.eye(A_inv.shape[1]))
+                th = theta[k] + L @ self._rng.standard_normal(len(L))
+                out[:, k] = contexts @ th
+            return out
+        # LinUCB
+        var = np.einsum("nd,kde,ne->nk", contexts, A_inv, contexts)
+        return mean + c.alpha * np.sqrt(np.clip(var, 0, None))
+
+    def train(self) -> Dict[str, float]:
+        c = self.config
+        t0 = time.monotonic()
+        contexts = self.env.observe(c.batch_size)
+        arms = np.argmax(self._scores(contexts), axis=1)
+        rewards = self.env.pull(contexts, arms)
+        for x, k, r in zip(contexts, arms, rewards):
+            self._A[k] += np.outer(x, x)
+            self._b[k] += r * x
+        self._total_pulls += len(arms)
+        self._cum_reward += float(rewards.sum())
+        self._cum_regret += float(
+            (self.env.best_reward(contexts) - rewards).sum())
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_total": self._total_pulls,
+            "episode_reward_mean": float(rewards.mean()),
+            "cumulative_reward": self._cum_reward,
+            "cumulative_regret": self._cum_regret,
+            "regret_per_pull": self._cum_regret / self._total_pulls,
+            "time_this_iter_s": time.monotonic() - t0,
+        }
+
+    # -- Tune-trainable surface ------------------------------------------
+
+    def save(self) -> Dict:
+        return {"A": self._A.copy(), "b": self._b.copy(),
+                "iteration": self._iteration,
+                "total_pulls": self._total_pulls,
+                "cum_reward": self._cum_reward,
+                "cum_regret": self._cum_regret,
+                "rng": self._rng.bit_generator.state}
+
+    def restore(self, ckpt: Dict) -> None:
+        self._A = np.asarray(ckpt["A"])
+        self._b = np.asarray(ckpt["b"])
+        self._iteration = int(ckpt.get("iteration", 0))
+        self._total_pulls = int(ckpt.get("total_pulls", 0))
+        # cumulative metrics continue, not restart — regret_per_pull
+        # divides by the restored pull count
+        self._cum_reward = float(ckpt.get("cum_reward", 0.0))
+        self._cum_regret = float(ckpt.get("cum_regret", 0.0))
+        if "rng" in ckpt:
+            self._rng.bit_generator.state = ckpt["rng"]
+
+    def stop(self) -> None:
+        pass

@@ -411,6 +411,10 @@ def even_cpu_distribution(max_cpu_per_trial: float = 4.0):
                    + len(controller.paused_trials()))
         total = ray_tpu.cluster_resources().get("CPU", 1.0)
         share = max(1.0, min(max_cpu_per_trial, total // live))
-        return {"CPU": float(share)}
+        # only the CPU share changes — accelerator/custom reservations
+        # from the trial's current allocation ride along untouched
+        current = dict(trial.resources or controller.tc.trial_resources)
+        current["CPU"] = float(share)
+        return current
 
     return fn

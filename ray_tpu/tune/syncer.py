@@ -18,14 +18,7 @@ import traceback
 from typing import Optional
 
 
-def _split(uri: str):
-    """-> (fsspec filesystem or None for plain-local, root path)."""
-    if "://" not in uri:
-        return None, uri
-    import fsspec
-
-    fs, _, paths = fsspec.get_fs_token_paths(uri)
-    return fs, paths[0] if paths else uri.split("://", 1)[1]
+from ..util.fs import split_fs_url as _split  # shared with the spill tier
 
 
 class Syncer:

@@ -1,0 +1,14 @@
+"""Shared fsspec URL helper — neutral ground for the layers that take
+storage URLs (tune syncer uploads, object-store spill tier), so core
+never imports from a library package."""
+from __future__ import annotations
+
+
+def split_fs_url(uri: str):
+    """-> (fsspec filesystem or None for plain-local, root path)."""
+    if "://" not in uri:
+        return None, uri
+    import fsspec
+
+    fs, _, paths = fsspec.get_fs_token_paths(uri)
+    return fs, paths[0] if paths else uri.split("://", 1)[1]

@@ -1,27 +1,26 @@
-#!/bin/sh
+#!/bin/bash
 # Reference docker launcher for containerized workers
 # (ref: python/ray/_private/runtime_env/container.py — podman there).
 #
-# Invoked by the node as:
+# Invoked by the node/agent as:
 #   container_worker_launcher.sh <image> [run_options...] -- <cmd...>
 #
 # The worker talks to its node over a unix socket and shared-memory
 # segments, so the container must share the host's network/IPC/pid
 # namespaces and see the session directory; RTPU_AUTHKEY and PYTHONPATH
-# ride the environment. Swap this script (config.container_launcher /
-# RTPU_CONTAINER_LAUNCHER) for podman/nerdctl/k8s equivalents.
+# ride the environment. Swap this script (config.container_launcher)
+# for podman/nerdctl/k8s equivalents.
 set -eu
 
 IMAGE="$1"; shift
-OPTS=""
+OPTS=()
 while [ $# -gt 0 ] && [ "$1" != "--" ]; do
-    OPTS="$OPTS $1"; shift
+    OPTS+=("$1"); shift
 done
 [ $# -gt 0 ] && shift  # drop the --
 
-# shellcheck disable=SC2086
 exec docker run --rm \
     --network=host --ipc=host --pid=host \
     -e RTPU_AUTHKEY -e PYTHONPATH \
     -v /tmp:/tmp -v /dev/shm:/dev/shm \
-    $OPTS "$IMAGE" "$@"
+    "${OPTS[@]+"${OPTS[@]}"}" "$IMAGE" "$@"

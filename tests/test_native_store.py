@@ -218,3 +218,34 @@ class TestExternalSpillStorage:
         spilled = [o for o in oids if store.get_bytes(o) is None]
         assert spilled, "at least one object was in the lost tier"
         store.destroy()
+
+    def test_lost_external_copy_get_segment_returns_none(self):
+        """get_segment on an object whose external copy vanished must
+        report missing (not crash or poison the entry with a half-made
+        segment)."""
+        import fsspec
+
+        store = self._store("memory://spill_seg")
+        from ray_tpu.core.ids import ObjectId
+
+        oids = []
+        import multiprocessing.shared_memory as shm_mod
+
+        for i in range(3):
+            oid = ObjectId(bytes([96 + i]) * 16)
+            data = bytes([i]) * (512 * 1024)
+            name = store.create(oid, len(data))
+            seg = shm_mod.SharedMemory(name=name)
+            seg.buf[:len(data)] = data
+            seg.close()
+            store.seal(oid)
+            oids.append(oid)
+        fs = fsspec.filesystem("memory")
+        for p in fs.ls("/spill_seg", detail=False):
+            fs.rm(p)
+        spilled = [o for o in oids if store.get_bytes(o) is None]
+        assert spilled
+        # repeated calls stay None, never FileExistsError
+        assert store.get_segment(spilled[0]) is None
+        assert store.get_segment(spilled[0]) is None
+        store.destroy()

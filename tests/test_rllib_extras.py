@@ -190,3 +190,106 @@ while time.time() < deadline:
                 client.get_action("nope", [0, 0, 0, 0])
         finally:
             srv.shutdown()
+
+
+class TestTD3:
+    def test_td3_learns_pendulum(self, cluster):
+        from ray_tpu.rllib import TD3Config
+
+        algo = TD3Config(num_rollout_workers=1, num_envs_per_worker=8,
+                         rollout_fragment_length=50, learning_starts=1000,
+                         train_batch_size=256, num_updates_per_iter=400,
+                         explore_sigma=0.2, hidden=(128, 128),
+                         seed=1).build()
+        try:
+            rews = []
+            for _ in range(50):
+                r = algo.train()
+                m = r["episode_reward_mean"]
+                if np.isfinite(m):
+                    rews.append(m)
+                if rews and rews[-1] > -750:
+                    break
+            # random play sits near -1300; learning must be decisive
+            assert rews and rews[-1] > -900, rews[-3:]
+            assert rews[-1] > rews[0] + 250, (rews[0], rews[-1])
+        finally:
+            algo.stop()
+
+    def test_td3_checkpoint_roundtrip(self, cluster):
+        import jax
+
+        from ray_tpu.rllib import TD3Config
+
+        cfg = TD3Config(num_rollout_workers=1, num_envs_per_worker=4,
+                        rollout_fragment_length=25, learning_starts=100,
+                        train_batch_size=64, num_updates_per_iter=8,
+                        seed=3)
+        a = cfg.build()
+        try:
+            a.train()
+            a.train()
+            ckpt = a.save()
+            b = cfg.build()
+            try:
+                b.restore(ckpt)
+                xa = jax.tree.leaves(a.learner.params)
+                xb = jax.tree.leaves(b.learner.params)
+                for u, v in zip(xa, xb):
+                    np.testing.assert_allclose(np.asarray(u),
+                                               np.asarray(v))
+                assert len(b.buffer) == len(a.buffer) > 0
+            finally:
+                b.stop()
+        finally:
+            a.stop()
+
+    def test_ddpg_config_is_td3_degenerate(self, cluster):
+        from ray_tpu.rllib import DDPGConfig
+
+        cfg = DDPGConfig(num_rollout_workers=1, seed=0)
+        assert cfg.policy_delay == 1 and cfg.target_noise == 0.0
+        algo = cfg.build()
+        try:
+            r = algo.train()
+            assert r["timesteps_this_iter"] > 0
+        finally:
+            algo.stop()
+
+    def test_td3_rejects_discrete_env(self, cluster):
+        from ray_tpu.rllib import TD3Config
+
+        with pytest.raises(ValueError, match="continuous"):
+            TD3Config(env="CartPole-v1").build()
+
+
+class TestBandits:
+    def test_linucb_regret_decreases(self):
+        from ray_tpu.rllib import BanditLinUCBConfig
+
+        algo = BanditLinUCBConfig(seed=0, alpha=0.5).build()
+        first = algo.train()["regret_per_pull"]
+        for _ in range(40):
+            r = algo.train()
+        assert r["regret_per_pull"] < first * 0.5, (first, r)
+
+    def test_thompson_regret_decreases(self):
+        from ray_tpu.rllib import BanditLinTSConfig
+
+        algo = BanditLinTSConfig(seed=1, alpha=0.5).build()
+        first = algo.train()["regret_per_pull"]
+        for _ in range(40):
+            r = algo.train()
+        assert r["regret_per_pull"] < first * 0.5, (first, r)
+
+    def test_bandit_checkpoint_roundtrip(self):
+        from ray_tpu.rllib import BanditLinUCBConfig
+
+        a = BanditLinUCBConfig(seed=2).build()
+        for _ in range(5):
+            a.train()
+        ckpt = a.save()
+        b = BanditLinUCBConfig(seed=2).build()
+        b.restore(ckpt)
+        np.testing.assert_allclose(a._A, b._A)
+        np.testing.assert_allclose(a._b, b._b)
