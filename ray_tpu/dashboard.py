@@ -97,13 +97,21 @@ class _MetricsSampler:
                 used = sum(st.get("used_bytes", st.get("used", 0))
                            for st in stores if isinstance(st, dict))
                 tasks = s.get("task_events_by_state", {})
+                fin = int(tasks.get("FINISHED", 0))
                 with self._lock:
+                    prev = self.history[-1] if self.history else None
+                    rate = 0.0
+                    if prev is not None:
+                        dt = max(1e-9, time.time() - prev["t"])
+                        rate = max(0.0,
+                                   (fin - prev["finished_tasks"]) / dt)
                     self.history.append({
                         "t": time.time(),
                         "alive_nodes": s.get("nodes_alive", 0),
                         "actors": sum(s.get("actors_by_state",
                                             {}).values()),
-                        "finished_tasks": int(tasks.get("FINISHED", 0)),
+                        "finished_tasks": fin,
+                        "task_rate": round(rate, 2),
                         "store_used_bytes": used,
                     })
             except Exception:
@@ -139,7 +147,7 @@ svg.spark{vertical-align:middle}
 <span id=updated style="margin-left:auto;font-size:11px;color:#889"></span></header>
 <main id=main></main>
 <script>
-const TABS=["overview","nodes","actors","tasks","placement_groups","objects","jobs","serve","logs"];
+const TABS=["overview","nodes","actors","tasks","placement_groups","objects","jobs","serve","logs","metrics"];
 let tab="overview", filter="", detail=null;
 const nav=document.getElementById("nav");
 TABS.forEach(t=>{const b=document.createElement("button");b.textContent=t.replace("_"," ");
@@ -188,7 +196,22 @@ async function render(){
    document.getElementById("updated").textContent="updated "+new Date().toLocaleTimeString();
    return;
   }
-  if(tab==="logs"){
+  if(tab==="metrics"){
+   const [hist,rpc]=await Promise.all([api("metrics_history"),api("rpc")]);
+   let html="";
+   const series=[["finished tasks/s",h=>h.task_rate],["actors",h=>h.actors],
+                 ["store used bytes",h=>h.store_used_bytes],["alive nodes",h=>h.alive_nodes]];
+   for(const [name,f] of series){
+    const vals=hist.map(f).map(v=>v??0);
+    html+=`<div style="margin-bottom:14px"><div style="font-size:12px;color:#667">${esc(name)}
+      <span style="float:right">${esc(vals.length?(Math.round(vals[vals.length-1]*100)/100):"-")}</span></div>
+      ${spark(vals,560,60)}</div>`;
+   }
+   html+=`<h4 style="font-size:12px">per-RPC-method stats</h4>`;
+   const rows=Object.entries(rpc).map(([m,s])=>({method:m,...s}));
+   html+=table(rows.sort((a,b)=>(b.count||0)-(a.count||0)));
+   main.innerHTML=html;
+  } else if(tab==="logs"){
    const rows=await api("logs");
    const f=filter.toLowerCase();
    const shown=f?rows.filter(r=>JSON.stringify(r).toLowerCase().includes(f)):rows;

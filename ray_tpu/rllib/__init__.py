@@ -23,6 +23,7 @@ _EXPORTS = {
     "APPO": "impala", "APPOConfig": "impala",
     "MARWIL": "offline", "MARWILConfig": "offline",
     "BC": "offline", "BCConfig": "offline",
+    "CQL": "cql", "CQLConfig": "cql",
     "collect_experiences": "offline", "read_experiences": "offline",
     "write_experiences": "offline",
     "MeanStdFilter": "connectors", "RunningStat": "connectors",

@@ -1,0 +1,237 @@
+"""CQL — conservative Q-learning for offline RL (discrete actions).
+
+ref: rllib/algorithms/cql/cql.py (+ cql_torch_policy.py; Kumar et al.
+2020). The continuous reference builds on SAC; this discrete variant
+builds on the double-DQN learner, adding the conservative penalty
+
+    L_CQL = alpha * E_s[ logsumexp_a Q(s,a) - Q(s, a_data) ] + L_TD
+
+which pushes down Q on out-of-distribution actions so a policy greedy
+in Q stays inside the dataset's support — the failure mode plain
+off-policy TD has on static datasets.
+
+House TPU shape: the dataset loads once, the whole per-iteration update
+block (K minibatches of TD + penalty, periodic target sync inside the
+scan via lax.cond) is ONE jitted dispatch. Consumes the experience
+JSONL format of rllib.offline (write_experiences / read_experiences),
+so datasets collected for MARWIL/BC train CQL unchanged.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .env import make_env
+from .offline import read_experiences
+
+
+def _episodes_to_transitions(episodes: List[Dict[str, np.ndarray]],
+                             ) -> Dict[str, np.ndarray]:
+    obs, acts, rews, dones, nxt = [], [], [], [], []
+    for ep in episodes:
+        T = len(ep["actions"])
+        obs.append(ep["obs"][:T])
+        acts.append(ep["actions"][:T])
+        rews.append(ep["rewards"][:T])
+        d = np.zeros(T, np.float32)
+        d[-1] = 1.0
+        dones.append(d)
+        nx = np.concatenate([ep["obs"][1:T], ep["obs"][T - 1:T]], axis=0)
+        nxt.append(nx)
+    return {"obs": np.concatenate(obs).astype(np.float32),
+            "actions": np.concatenate(acts).astype(np.int32),
+            "rewards": np.concatenate(rews).astype(np.float32),
+            "dones": np.concatenate(dones),
+            "next_obs": np.concatenate(nxt).astype(np.float32)}
+
+
+@dataclass
+class CQLConfig:
+    """ref: cql.py CQLConfig (bc_iters warmup omitted: the conservative
+    penalty with a decent alpha covers the cold start on discrete
+    benches)."""
+    input_paths: Any = None           # JSONL file/dir(s) of experiences
+    env: str = "CartPole-v1"          # for evaluate()
+    gamma: float = 0.99
+    lr: float = 5e-4
+    cql_alpha: float = 1.0
+    train_batch_size: int = 256
+    num_updates_per_iter: int = 200
+    target_update_freq: int = 100     # in updates, inside the scan
+    hidden: tuple = (128, 128)
+    seed: int = 0
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def build(self) -> "CQL":
+        return CQL(self)
+
+
+class CQL:
+    """Tune-trainable offline learner; evaluate() rolls the greedy
+    policy in the (held-out) environment."""
+
+    def __init__(self, config: CQLConfig):
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        c = self.config = config
+        if c.input_paths is None:
+            raise ValueError("CQL is offline: set input_paths to the "
+                             "experience JSONL file(s)")
+        self.data = _episodes_to_transitions(
+            read_experiences(c.input_paths))
+        self._eval_env = make_env(c.env, num_envs=8, seed=c.seed + 9)
+        obs_dim = self.data["obs"].shape[1]
+        num_actions = int(self.data["actions"].max()) + 1
+        num_actions = max(num_actions, self._eval_env.num_actions)
+        self.num_actions = num_actions
+
+        from .td3 import _mlp_init as mlp_init  # shared He-init MLP
+
+        def mlp(p, x):
+            i = 0
+            while f"w{i}" in p:
+                x = jnp.maximum(x @ p[f"w{i}"] + p[f"b{i}"], 0.0)
+                i += 1
+            return x @ p["w_out"] + p["b_out"]
+
+        self._mlp = mlp
+        self.params = mlp_init(jax.random.PRNGKey(c.seed),
+                               (obs_dim, *c.hidden), num_actions)
+        self.target = jax.tree.map(lambda a: a.copy(), self.params)
+        self.opt = optax.adam(c.lr)
+        self.opt_state = self.opt.init(self.params)
+        self.num_updates = 0
+
+        def loss_fn(params, target, batch):
+            q = mlp(params, batch["obs"])                     # [B, A]
+            q_data = jnp.take_along_axis(
+                q, batch["actions"][:, None], axis=1)[:, 0]
+            # double-DQN target
+            a_next = jnp.argmax(mlp(params, batch["next_obs"]), axis=1)
+            tq = jnp.take_along_axis(
+                mlp(target, batch["next_obs"]), a_next[:, None],
+                axis=1)[:, 0]
+            y = batch["rewards"] + c.gamma * (1 - batch["dones"]) * tq
+            td = jnp.mean(jnp.square(q_data - jax.lax.stop_gradient(y)))
+            # conservative penalty: soft-max over ALL actions minus the
+            # dataset action's Q
+            penalty = jnp.mean(
+                jax.scipy.special.logsumexp(q, axis=1) - q_data)
+            return td + c.cql_alpha * penalty, (td, penalty)
+
+        def one_update(carry, xs):
+            params, target, opt_state = carry
+            batch, step_i = xs
+            (loss, (td, pen)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target, batch)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            # step_i is the GLOBAL update index (offset rides in as a
+            # traced scalar) — a scan-local index would never hit the
+            # sync cadence when num_updates_per_iter < target_update_freq
+            target = jax.lax.cond(
+                (step_i + 1) % c.target_update_freq == 0,
+                lambda _: jax.tree.map(lambda a: a.copy(), params),
+                lambda t: t, target)
+            return (params, target, opt_state), {
+                "loss": loss, "td_loss": td, "cql_penalty": pen}
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def update_many(params, target, opt_state, batches, offset):
+            K = batches["rewards"].shape[0]
+            (params, target, opt_state), stats = jax.lax.scan(
+                one_update, (params, target, opt_state),
+                (batches, offset + jnp.arange(K)))
+            return params, target, opt_state, jax.tree.map(
+                jnp.mean, stats)
+
+        self._update_many = update_many
+        self._rng = np.random.default_rng(c.seed + 1)
+        self._iteration = 0
+
+    def train(self) -> Dict[str, float]:
+        import jax
+        import jax.numpy as jnp
+
+        c = self.config
+        t0 = time.monotonic()
+        N = len(self.data["rewards"])
+        K, B = c.num_updates_per_iter, min(c.train_batch_size, N)
+        idx = self._rng.integers(0, N, K * B)
+        stacked = {k: v[idx].reshape(K, B, *v.shape[1:])
+                   for k, v in self.data.items()}
+        batches = {k: jnp.asarray(v) for k, v in stacked.items()}
+        self.params, self.target, self.opt_state, stats = \
+            self._update_many(self.params, self.target, self.opt_state,
+                              batches, jnp.asarray(self.num_updates))
+        self.num_updates += K
+        self._iteration += 1
+        return {"training_iteration": self._iteration,
+                "num_updates": self.num_updates,
+                "dataset_size": N,
+                "time_this_iter_s": time.monotonic() - t0,
+                **{k: float(v)
+                   for k, v in jax.device_get(stats).items()}}
+
+    def evaluate(self, num_episodes: int = 20,
+                 max_steps: int = 500) -> Dict[str, float]:
+        import jax
+
+        from .td3 import _mlp_np
+
+        p = {k: np.asarray(v, np.float32)
+             for k, v in jax.device_get(self.params).items()}
+
+        def mlp_np(x):
+            return _mlp_np(p, x)
+
+        env = self._eval_env
+        obs = env.reset(seed=self.config.seed + 77)
+        returns: List[float] = []
+        ep_ret = np.zeros(env.num_envs)
+        for _ in range(max_steps * (num_episodes // env.num_envs + 2)):
+            actions = np.argmax(mlp_np(obs), axis=1)
+            obs, r, done, _ = env.step(actions)
+            ep_ret += r
+            if done.any():
+                idx = np.nonzero(done)[0]
+                returns.extend(ep_ret[idx].tolist())
+                ep_ret[idx] = 0.0
+            if len(returns) >= num_episodes:
+                break
+        return {"evaluation_reward_mean":
+                float(np.mean(returns[:num_episodes]))
+                if returns else float("nan")}
+
+    # -- Tune-trainable surface ------------------------------------------
+
+    def save(self) -> Dict:
+        import jax
+
+        return {"params": jax.device_get(self.params),
+                "target": jax.device_get(self.target),
+                "opt_state": jax.device_get(self.opt_state),
+                "num_updates": self.num_updates,
+                "iteration": self._iteration}
+
+    def restore(self, ckpt: Dict) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        as_jnp = lambda t: jax.tree.map(jnp.asarray, t)  # noqa: E731
+        self.params = as_jnp(ckpt["params"])
+        self.target = as_jnp(ckpt["target"])
+        if "opt_state" in ckpt:
+            self.opt_state = as_jnp(ckpt["opt_state"])
+        self.num_updates = int(ckpt.get("num_updates", 0))
+        self._iteration = int(ckpt.get("iteration", 0))
+
+    def stop(self) -> None:
+        pass

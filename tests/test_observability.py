@@ -271,3 +271,47 @@ def test_dashboard_logs_and_drilldown(cluster):
     finally:
         dash.shutdown()
         ray_tpu.kill(a)
+
+
+def test_dashboard_metrics_tab_data(cluster):
+    """The metrics tab's data sources: history carries the derived task
+    rate; /api/rpc serves per-method stats."""
+    import json as _json
+    import time as _time
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu.dashboard import Dashboard
+
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    dash = Dashboard(port=0)
+    try:
+        ray_tpu.get([noop.remote() for _ in range(20)], timeout=60)
+        deadline = _time.time() + 12
+        host, port = dash.address()
+
+        def get(p):
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/{p}", timeout=10) as r:
+                return _json.load(r)
+
+        hist = []
+        while _time.time() < deadline:
+            hist = get("api/metrics_history")
+            if len(hist) >= 2:
+                break
+            _time.sleep(0.5)
+        assert hist and "task_rate" in hist[-1]
+        rpc = get("api/rpc")
+        assert isinstance(rpc, dict) and rpc, "per-method stats present"
+        page_html = urllib.request.urlopen(
+            f"http://{host}:{port}/", timeout=10).read().decode()
+        # the TABS entry specifically, not the pre-existing
+        # "metrics_history" substring
+        assert '"metrics"' in page_html
+        assert "per-RPC-method stats" in page_html
+    finally:
+        dash.shutdown()
