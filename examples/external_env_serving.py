@@ -1,0 +1,89 @@
+"""External-environment serving: a simulator OUTSIDE the cluster (here,
+a subprocess with its own CartPole physics and zero ray_tpu imports
+beyond the thin HTTP PolicyClient) drives episodes against a policy
+server; PPO trains on whatever the clients produce.
+
+ref: rllib/examples/serving/cartpole_server.py + cartpole_client.py.
+"""
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+CLIENT = r'''
+import math, sys, time
+sys.path.insert(0, sys.argv[3])
+from ray_tpu.rllib.policy_client import PolicyClient
+
+def step(s, a):
+    x, xd, th, thd = s
+    force = 10.0 if a == 1 else -10.0
+    costh, sinth = math.cos(th), math.sin(th)
+    temp = (force + 0.05 * thd * thd * sinth) / 1.1
+    thacc = (9.8 * sinth - costh * temp) / (0.5 * (4/3 - 0.1 * costh**2 / 1.1))
+    xacc = temp - 0.05 * thacc * costh / 1.1
+    x += 0.02 * xd; xd += 0.02 * xacc; th += 0.02 * thd; thd += 0.02 * thacc
+    return [x, xd, th, thd], 1.0, abs(x) > 2.4 or abs(th) > 0.2095
+
+import random
+client = PolicyClient(sys.argv[1])
+deadline = time.time() + float(sys.argv[2])
+rng = random.Random(0)
+while time.time() < deadline:
+    eid = client.start_episode()
+    s = [rng.uniform(-0.05, 0.05) for _ in range(4)]
+    done = False
+    for t in range(500):
+        a = client.get_action(eid, s)
+        s, r, done = step(s, a)
+        client.log_returns(eid, r)
+        if done:
+            break
+    client.end_episode(eid, None if done else s, truncated=not done)
+'''
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=2)
+    ap.add_argument("--seconds", type=float, default=120.0)
+    ap.add_argument("--target", type=float, default=150.0)
+    args = ap.parse_args()
+
+    from ray_tpu.rllib import ExternalPPOConfig
+
+    algo = ExternalPPOConfig(obs_dim=4, num_actions=2,
+                             train_batch_size=384, num_sgd_epochs=4,
+                             lr=3e-3).build()
+    host, port = algo.address
+    print(f"policy server listening on http://{host}:{port}")
+    with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                     delete=False) as f:
+        f.write(CLIENT)
+        script = f.name
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = [subprocess.Popen(
+        [sys.executable, script, f"http://{host}:{port}",
+         str(args.seconds), repo]) for _ in range(args.clients)]
+    try:
+        best, t0 = 0.0, time.time()
+        while time.time() - t0 < args.seconds:
+            r = algo.train()
+            m = r["episode_reward_mean"]
+            if m == m:
+                best = max(best, m)
+            print(f"reward={m:7.1f} best={best:7.1f} "
+                  f"steps={r['timesteps_total']}")
+            if best >= args.target:
+                print("target reached")
+                break
+    finally:
+        for p in procs:
+            p.kill()
+        algo.stop()
+
+
+if __name__ == "__main__":
+    main()

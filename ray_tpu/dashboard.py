@@ -209,7 +209,7 @@ async function render(){
    }
    html+=`<h4 style="font-size:12px">per-RPC-method stats</h4>`;
    const rows=Object.entries(rpc).map(([m,s])=>({method:m,...s}));
-   html+=table(rows.sort((a,b)=>(b.count||0)-(a.count||0)));
+   html+=table(rows.sort((a,b)=>(b.calls||0)-(a.calls||0)));
    main.innerHTML=html;
   } else if(tab==="logs"){
    const rows=await api("logs");

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 import numpy as np
 
@@ -92,14 +92,8 @@ class CQL:
         num_actions = max(num_actions, self._eval_env.num_actions)
         self.num_actions = num_actions
 
-        from .td3 import _mlp_init as mlp_init  # shared He-init MLP
-
-        def mlp(p, x):
-            i = 0
-            while f"w{i}" in p:
-                x = jnp.maximum(x @ p[f"w{i}"] + p[f"b{i}"], 0.0)
-                i += 1
-            return x @ p["w_out"] + p["b_out"]
+        from .sac import _mlp_forward as mlp  # one canonical jnp MLP
+        from .td3 import _mlp_init as mlp_init  # shared He-init
 
         self._mlp = mlp
         self.params = mlp_init(jax.random.PRNGKey(c.seed),

@@ -158,12 +158,7 @@ class TD3Learner:
         self._key = jax.random.PRNGKey(c.seed + 7)
         self.num_updates = 0
 
-        def mlp(p, x):
-            i = 0
-            while f"w{i}" in p:
-                x = jnp.maximum(x @ p[f"w{i}"] + p[f"b{i}"], 0.0)
-                i += 1
-            return x @ p["w_out"] + p["b_out"]
+        from .sac import _mlp_forward as mlp  # one canonical jnp MLP
 
         def q(p, obs, act):
             return mlp(p, jnp.concatenate([obs, act], axis=-1))[:, 0]

@@ -52,3 +52,15 @@ def test_ppo_breakout_example():
     out = _run("ppo_breakout.py", "--workers", "1", "--iters", "1",
                "--target", "-1")
     assert "best reward:" in out
+
+
+def test_ppo_jax_fused_example():
+    out = _run("ppo_jax_fused.py", "--steps", "3", "--num-envs", "16",
+               "--rollout-len", "16", "--iters-per-step", "2")
+    assert "done:" in out and "steps/s" in out
+
+
+def test_external_env_serving_example():
+    out = _run("external_env_serving.py", "--clients", "1",
+               "--seconds", "20", "--target", "15")
+    assert "policy server listening" in out and "reward=" in out
