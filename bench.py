@@ -125,10 +125,11 @@ def main() -> None:
         "vs_baseline": round(mfu / 0.50, 4),
         "detail": {
             "mfu": round(mfu, 4),
-            # vs the chip's MEASURED clean-matmul ceiling (see
-            # scripts/mfu_calibrate.py + docs/PERF_NOTES.md round 5:
-            # the nominal 197 TF/s denominator is ~3.7x what this
-            # device sustains on isolated 8192^3 bf16 matmuls)
+            # vs the chip's MEASURED clean-matmul rate (delta-method
+            # probe below; scripts/mfu_calibrate.py is the full
+            # artifact). Measured correctly the device reaches 80-100%
+            # of nominal, so this usually tracks `mfu` — kept as the
+            # standing check that the denominator stays honest
             "achievable_tflops": round(achievable / 1e12, 1),
             "mfu_achievable": (round(achieved / achievable, 4)
                                if achievable else None),
@@ -144,28 +145,51 @@ def main() -> None:
     }))
 
 
-def _probe_achievable_tflops(n: int = 8192, iters: int = 4) -> float:
+def _probe_achievable_tflops(n: int = 8192, iters: int = 48) -> float:
     """Quick sustained-TF/s probe on a clean [n,n]x[n,n] bf16 matmul —
     the denominator for mfu_achievable (full method comparison lives in
     scripts/mfu_calibrate.py)."""
     try:
         a = jnp.ones((n, n), jnp.bfloat16)
 
-        # one dispatch scanning `iters` dependent matmuls: per-dispatch
-        # tunnel RTT amortizes away (the calibrate script's method 3)
-        @jax.jit
-        def fused(a):
-            def body(acc, _):
-                return acc, jnp.sum((a @ a)[:1, :1])
+        # dependent matmul chain (each output feeds the next, scaled so
+        # ones stay ones): hoisting/DCE can't elide the work. Timing the
+        # DIFFERENCE between a 2N- and an N-length chain cancels the
+        # fixed per-dispatch overhead (tunnel RTT), which otherwise
+        # dominates short probes.
+        def make(length):
+            @jax.jit
+            def fused(x):
+                def body(x, _):
+                    return ((x @ a) * jnp.bfloat16(1.0 / n)), None
 
-            _, outs = jax.lax.scan(body, a, None, length=iters)
-            return outs
+                x, _ = jax.lax.scan(body, x, None, length=length)
+                return jnp.sum(x[:1, :1])
 
-        float(jnp.sum(fused(a)))  # compile + sync (tunnel-safe)
-        t0 = time.perf_counter()
-        float(jnp.sum(fused(a)))
-        dt = (time.perf_counter() - t0) / iters
-        return 2 * n * n * n / dt
+            return fused
+
+        short, long_ = make(iters), make(2 * iters)
+        float(short(a))
+        float(long_(a))  # compile + sync (tunnel-safe)
+        deltas = []
+        t_long_min = None
+        for _ in range(3):  # dispatch-overhead noise >> signal; sample
+            t0 = time.perf_counter()
+            float(short(a))
+            t_short = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            float(long_(a))
+            t_long = time.perf_counter() - t0
+            t_long_min = (t_long if t_long_min is None
+                          else min(t_long_min, t_long))
+            deltas.append(t_long - t_short)
+        deltas.sort()
+        delta = deltas[1]  # median of 3
+        if delta <= 0:
+            # noise swamped the delta: fall back to the raw 2N chain
+            # (a LOWER bound — still overhead-polluted, never absurd)
+            delta = t_long_min / 2
+        return 2 * n * n * n / (delta / iters)
     except Exception:
         return 0.0
 

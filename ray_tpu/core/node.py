@@ -57,6 +57,7 @@ class WorkerHandle:
     # other = bound to that packaged runtime_env for life
     env_hash: Optional[str] = None
     idle_since: float = 0.0  # monotonic timestamp of the last idle entry
+    started_at: float = 0.0  # monotonic launch time (launch-strike gate)
 
 
 @dataclass
@@ -414,7 +415,8 @@ class Node:
             cmd = container_command(self.config.container_launcher,
                                     container, cmd)
         proc = subprocess.Popen(cmd, env=env)
-        handle = WorkerHandle(worker_id=worker_id, proc=proc, pid=proc.pid)
+        handle = WorkerHandle(worker_id=worker_id, proc=proc, pid=proc.pid,
+                              started_at=time.monotonic())
         if env_hash is not None:
             handle.env_hash = env_hash  # container workers: dedicated
             # from birth (the env can't be applied to a host process)
@@ -479,22 +481,31 @@ class Node:
         if actor_id is not None and self.alive:
             self.runtime.gcs.on_actor_failure(
                 actor_id, f"worker {worker.worker_id.hex()[:8]} died")
-        if was_starting:
+        if was_starting and self.alive:
             # died before registering: a broken launch recipe (bad
-            # container launcher, missing runtime inside the image) would
-            # otherwise loop start->die->restart forever — after three
-            # consecutive strikes, fail the env's queued work instead
-            self._note_launch_failure(worker.env_hash or "")
+            # container launcher, image pull failure) would otherwise
+            # loop start->die->restart forever. Quick deaths (<30s) trip
+            # the breaker at 3 consecutive strikes; slow deaths (a
+            # loaded box can stall registration) still count but only
+            # trip at 6 — slow-but-broken recipes (registry timeouts)
+            # must fail eventually too, just with more patience.
+            fast = bool(worker.started_at) and \
+                time.monotonic() - worker.started_at < 30.0
+            self._note_launch_failure(worker.env_hash or "", fast)
         self._dispatch()
 
     _LAUNCH_STRIKES = 3
+    _LAUNCH_STRIKES_SLOW = 6
 
-    def _note_launch_failure(self, env_hash: str) -> None:
+    def _note_launch_failure(self, env_hash: str,
+                             fast: bool = True) -> None:
         to_fail: list = []
         with self._lock:
             n = self._launch_failures.get(env_hash, 0) + 1
             self._launch_failures[env_hash] = n
-            if n < self._LAUNCH_STRIKES:
+            limit = (self._LAUNCH_STRIKES if fast
+                     else self._LAUNCH_STRIKES_SLOW)
+            if n < limit:
                 return
             self._launch_failures[env_hash] = 0
             for sig in list(self._lease_queue.keys()):

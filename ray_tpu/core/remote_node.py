@@ -14,6 +14,7 @@ duplex TCP channel; bulk object bytes move as chunked reads
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Optional
 
 from .ids import NodeId, ObjectId, WorkerId
@@ -141,7 +142,8 @@ class RemoteNode(Node):
     def _start_worker(self, container=None,
                       env_hash=None) -> WorkerHandle:
         worker_id = WorkerId.from_random()
-        handle = WorkerHandle(worker_id=worker_id, proc=None)  # type: ignore
+        handle = WorkerHandle(worker_id=worker_id, proc=None,  # type: ignore
+                              started_at=time.monotonic())
         if env_hash is not None:
             handle.env_hash = env_hash  # container workers: dedicated
         self._workers[worker_id] = handle
