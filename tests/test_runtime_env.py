@@ -129,12 +129,14 @@ def test_job_level_default_env():
             return (os.environ.get("RTPU_JOB_VAR"),
                     os.environ.get("RTPU_SHARED"))
 
-        # plain task sees the job default
-        assert ray_tpu.get(read.remote(), timeout=60) == ("job", "job")
+        # plain task sees the job default (generous timeouts: this
+        # test tends to land late in long suite runs when the box is
+        # saturated and dedicated-env worker starts take seconds)
+        assert ray_tpu.get(read.remote(), timeout=180) == ("job", "job")
         # task env overrides colliding vars, keeps the rest
         task = read.options(
             runtime_env={"env_vars": {"RTPU_SHARED": "task"}})
-        assert ray_tpu.get(task.remote(), timeout=60) == ("job", "task")
+        assert ray_tpu.get(task.remote(), timeout=180) == ("job", "task")
     finally:
         rt.default_runtime_env = old
 
