@@ -100,7 +100,7 @@ class PPO:
                 observation_filter=c.observation_filter)
             for i in range(c.num_rollout_workers)
         ]
-        info = ray_tpu.get(self.workers[0].env_info.remote(), timeout=60)
+        info = ray_tpu.get(self.workers[0].env_info.remote(), timeout=180)
         self.obs_filter = make_connector(
             c.observation_filter,
             info.get("obs_shape", (info["obs_dim"],)))

@@ -298,7 +298,7 @@ class DQN:
                 c.env, c.num_envs_per_worker, c.rollout_fragment_length,
                 seed=c.seed + 1000 * i, env_creator=creator_blob)
             for i in range(c.num_rollout_workers)]
-        info = ray_tpu.get(self.workers[0].env_info.remote(), timeout=60)
+        info = ray_tpu.get(self.workers[0].env_info.remote(), timeout=180)
         self.learner = DQNLearner(
             info.get("obs_shape", info["obs_dim"]), info["num_actions"], lr=c.lr, gamma=c.gamma,
             double_q=c.double_q, hidden=c.hidden, seed=c.seed)

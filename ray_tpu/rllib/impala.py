@@ -284,7 +284,7 @@ class Impala:
                 gamma=c.gamma, seed=c.seed + 1000 * i,
                 env_creator=creator_blob)
             for i in range(c.num_rollout_workers)]
-        info = ray_tpu.get(self.workers[0].env_info.remote(), timeout=60)
+        info = ray_tpu.get(self.workers[0].env_info.remote(), timeout=180)
         self.learner = ImpalaLearner(
             info.get("obs_shape", info["obs_dim"]), info["num_actions"], lr=c.lr, gamma=c.gamma,
             rho_clip=c.rho_clip, c_clip=c.c_clip, vf_coeff=c.vf_coeff,

@@ -285,7 +285,7 @@ class MultiAgentPPO:
                 c.gamma, c.lam, mapping_blob, seed=c.seed + 1000 * i,
                 env_creator=creator_blob)
             for i in range(c.num_rollout_workers)]
-        info = ray_tpu.get(self.workers[0].env_info.remote(), timeout=60)
+        info = ray_tpu.get(self.workers[0].env_info.remote(), timeout=180)
         self._num_agents = max(1, len(info.get("agent_ids", ())))
         self.learners: Dict[str, PPOLearner] = {
             pid: PPOLearner(
