@@ -46,6 +46,8 @@ _EXPORTS = {
     "BreakoutShapedJax": "jax_env", "make_jax_env": "jax_env",
     "register_jax_env": "jax_env",
     "ES": "es", "ESConfig": "es", "ESWorker": "es",
+    "ARS": "ars", "ARSConfig": "ars", "ARSWorker": "ars",
+    "A2C": "a2c", "A2CConfig": "a2c", "A2CLearner": "a2c",
     "TD3": "td3", "TD3Config": "td3", "DDPGConfig": "td3",
     "TD3Learner": "td3",
     "Bandit": "bandit", "BanditConfig": "bandit",

@@ -1,0 +1,180 @@
+"""ARS — Augmented Random Search (Mania et al. 2018).
+
+ref: rllib/algorithms/ars/ars.py (ARSConfig: num_rollouts,
+rollouts_used (top-k), noise_stdev, sd_of_noise used to scale the step)
++ ars_tf_policy.py (observation filter applied inside the policy).
+Differences from ES that make it "augmented": (1) only the top-k
+best-performing perturbation directions (by max(pos, neg) return) enter
+the update, (2) the step is divided by the standard deviation of the
+returns actually used, and (3) observations are normalized by a running
+mean/std whose statistics merge across workers every iteration (the
+MeanStdFilter connector protocol, same as PPO's).
+
+Same seed-regeneration trick as es.py: only (seed, sign, return)
+triples plus filter deltas cross the object store.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+
+from .connectors import MeanStdFilter, merge_deltas
+from .es import ESWorker
+from .rollout_worker import worker_opts
+
+
+class ARSWorker(ESWorker):
+    """ESWorker plus a synced observation filter (ref: ars.py
+    Worker.do_rollouts + ars_tf_policy.py observation_filter). Only the
+    episode loop changes — perturbation regeneration, shapes, and the
+    evaluate protocol are inherited."""
+
+    def __init__(self, env_name: str, hidden: tuple, sigma: float,
+                 max_steps: int, seed: int = 0, env_creator=None):
+        super().__init__(env_name, hidden, sigma, max_steps, seed=seed,
+                         env_creator=env_creator)
+        self.filter = MeanStdFilter(self.env.obs_shape)
+
+    def _episode(self, params: Dict[str, np.ndarray],
+                 update_filter: bool = True) -> float:
+        from .es import _episode_return
+
+        return _episode_return(
+            params, self.env, self.max_steps,
+            obs_fn=lambda o: self.filter(o, update=update_filter))
+
+    def evaluate(self, theta: np.ndarray, seeds: List[int],
+                 filter_state: Optional[Dict] = None
+                 ) -> Tuple[List[Tuple[int, int, float]], Dict]:
+        if filter_state is not None:
+            self.filter.set_state(filter_state)
+        return super().evaluate(theta, seeds), self.filter.delta()
+
+    def evaluate_center(self, theta: np.ndarray,
+                        filter_state: Optional[Dict] = None) -> float:
+        if filter_state is not None:
+            self.filter.set_state(filter_state)
+        return super().evaluate_center(theta)
+
+
+@dataclass
+class ARSConfig:
+    """ref: ars.py ARSConfig (num_rollouts, rollouts_used, noise_stdev,
+    sgd_stepsize)."""
+    env: str = "CartPole-v1"
+    env_creator: Optional[Callable] = None
+    num_workers: int = 2
+    num_rollouts: int = 32       # perturbation PAIRS per iteration
+    rollouts_used: int = 16      # top-k directions entering the update
+    sigma: float = 0.05
+    lr: float = 0.05
+    hidden: tuple = (32,)
+    max_episode_steps: int = 500
+    seed: int = 0
+    worker_resources: Dict[str, float] = field(default_factory=dict)
+
+    def build(self) -> "ARS":
+        return ARS(self)
+
+
+class ARS:
+    """Tune-trainable ARS driver."""
+
+    def __init__(self, config: ARSConfig):
+        import cloudpickle
+
+        c = self.config = config
+        creator_blob = (cloudpickle.dumps(c.env_creator)
+                        if c.env_creator is not None else None)
+        cls = ray_tpu.remote(ARSWorker)
+        opts = worker_opts(c.worker_resources)
+        self.workers = [
+            cls.options(**opts).remote(
+                c.env, tuple(c.hidden), c.sigma, c.max_episode_steps,
+                seed=c.seed + 100 * i, env_creator=creator_blob)
+            for i in range(c.num_workers)
+        ]
+        dim, obs_shape = ray_tpu.get(
+            [self.workers[0].dim.remote(),
+             self.workers[0].obs_shape.remote()], timeout=180)
+        rng = np.random.default_rng(c.seed)
+        # near-zero init is the ARS default (linear-policy heritage); the
+        # tiny noise just breaks argmax ties deterministically
+        self.theta = (rng.standard_normal(dim) * 1e-3).astype(np.float32)
+        self.filter = MeanStdFilter(tuple(obs_shape))
+        self._seed_seq = c.seed * 1_000_003 + 1
+        self._iteration = 0
+        self._total_episodes = 0
+
+    def train(self) -> Dict[str, float]:
+        c = self.config
+        t0 = time.monotonic()
+        n_pairs = c.num_rollouts
+        seeds = [self._seed_seq + i for i in range(n_pairs)]
+        self._seed_seq += n_pairs
+        theta_ref = ray_tpu.put(self.theta)
+        fstate = self.filter.state()
+        chunks = np.array_split(np.asarray(seeds), len(self.workers))
+        futs = [w.evaluate.remote(theta_ref, [int(s) for s in chunk], fstate)
+                for w, chunk in zip(self.workers, chunks) if len(chunk)]
+        results = ray_tpu.get(futs, timeout=600)
+        triples = [t for batch, _ in results for t in batch]
+        merge_deltas(self.filter, [d for _, d in results])
+        returns: Dict[int, Dict[int, float]] = {}
+        for seed, sign, ret in triples:
+            returns.setdefault(seed, {})[sign] = ret
+        pos = np.array([returns[s][1] for s in seeds], np.float32)
+        neg = np.array([returns[s][-1] for s in seeds], np.float32)
+
+        # top-k directions by best-of-pair (ref: ars.py max filtering)
+        k = min(c.rollouts_used, n_pairs)
+        order = np.argsort(np.maximum(pos, neg))[::-1][:k]
+        used = np.concatenate([pos[order], neg[order]])
+        sigma_r = float(used.std()) + 1e-8
+        grad = np.zeros_like(self.theta)
+        for i in order:
+            eps = np.random.default_rng(seeds[int(i)]).standard_normal(
+                self.theta.shape[0]).astype(np.float32)
+            grad += (pos[i] - neg[i]) * eps
+        self.theta = self.theta + c.lr / (k * sigma_r) * grad
+
+        center = ray_tpu.get(
+            self.workers[0].evaluate_center.remote(
+                ray_tpu.put(self.theta), self.filter.state()), timeout=120)
+        self._iteration += 1
+        self._total_episodes += 2 * n_pairs
+        return {
+            "training_iteration": self._iteration,
+            "episodes_total": self._total_episodes,
+            "episode_reward_mean": float(center),
+            "perturbation_reward_mean": float(np.mean([pos, neg])),
+            "reward_std_used": sigma_r,
+            "time_this_iter_s": time.monotonic() - t0,
+        }
+
+    # -- Tune-trainable surface ------------------------------------------
+
+    def save(self) -> Dict:
+        return {"theta": self.theta.copy(),
+                "filter": self.filter.state(),
+                "iteration": self._iteration,
+                "seed_seq": self._seed_seq}
+
+    def restore(self, ckpt: Dict) -> None:
+        self.theta = np.asarray(ckpt["theta"], np.float32)
+        if "filter" in ckpt:
+            self.filter.set_state(ckpt["filter"])
+        self._iteration = int(ckpt.get("iteration", 0))
+        self._seed_seq = int(ckpt.get("seed_seq", 1))
+
+    def stop(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

@@ -54,11 +54,13 @@ def _init_shapes(obs_dim: int, num_actions: int,
 
 
 def _episode_return(params: Dict[str, np.ndarray], env, max_steps: int,
-                    greedy: bool = True) -> float:
+                    obs_fn=None) -> float:
+    """One greedy episode; obs_fn (ARS's observation filter) transforms
+    each obs batch before the policy sees it."""
     obs = env.reset()
     total = 0.0
     for _ in range(max_steps):
-        logits, _ = forward_np(params, obs)
+        logits, _ = forward_np(params, obs_fn(obs) if obs_fn else obs)
         actions = np.argmax(logits, axis=1)
         obs, reward, done, _ = env.step(actions)
         total += float(reward.sum())
@@ -87,6 +89,14 @@ class ESWorker:
     def dim(self) -> int:
         return int(sum(np.prod(s) for _, s in self.shapes))
 
+    def obs_shape(self) -> tuple:
+        return tuple(self.env.obs_shape)
+
+    def _episode(self, params: Dict[str, np.ndarray],
+                 update_filter: bool = True) -> float:
+        """One greedy episode; ARS overrides with a filtered variant."""
+        return _episode_return(params, self.env, self.max_steps)
+
     def evaluate(self, theta: np.ndarray,
                  seeds: List[int]) -> List[Tuple[int, int, float]]:
         out = []
@@ -96,13 +106,12 @@ class ESWorker:
             for sign in (1, -1):
                 params = _flat_params(self.shapes,
                                       theta + sign * self.sigma * eps)
-                ret = _episode_return(params, self.env, self.max_steps)
-                out.append((seed, sign, ret))
+                out.append((seed, sign, self._episode(params)))
         return out
 
     def evaluate_center(self, theta: np.ndarray) -> float:
-        return _episode_return(_flat_params(self.shapes, theta), self.env,
-                               self.max_steps)
+        return self._episode(_flat_params(self.shapes, theta),
+                             update_filter=False)
 
 
 def _centered_ranks(x: np.ndarray) -> np.ndarray:
@@ -148,7 +157,7 @@ class ES:
                 seed=c.seed + 100 * i, env_creator=creator_blob)
             for i in range(c.num_workers)
         ]
-        dim = ray_tpu.get(self.workers[0].dim.remote(), timeout=60)
+        dim = ray_tpu.get(self.workers[0].dim.remote(), timeout=180)
         rng = np.random.default_rng(c.seed)
         self.theta = (rng.standard_normal(dim) * 0.05).astype(np.float32)
         # Adam state
