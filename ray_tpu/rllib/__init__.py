@@ -35,6 +35,8 @@ _EXPORTS = {
     "ReplayBuffer": "replay_buffer",
     "PrioritizedReplayBuffer": "replay_buffer",
     "CartPoleVecEnv": "env", "PendulumVecEnv": "env", "VectorEnv": "env",
+    "MemoryCueVecEnv": "env",
+    "R2D2": "r2d2", "R2D2Config": "r2d2", "R2D2Learner": "r2d2",
     "make_env": "env", "register_env": "env",
     "BreakoutShapedVecEnv": "preprocessors", "wrap_atari": "preprocessors",
     "WarpFrameVec": "preprocessors", "FrameStackVec": "preprocessors",

@@ -173,9 +173,57 @@ class PendulumVecEnv(VectorEnv):
         return self._obs(), (-cost).astype(np.float32), done, info
 
 
+class MemoryCueVecEnv(VectorEnv):
+    """Recurrence probe: a cue (0 or 1) is shown in the FIRST observation
+    only; the episode then runs `delay` blank steps; on the final step the
+    agent earns +1 for choosing the action matching the cue. A memoryless
+    policy caps at 0.5 expected return — solving it requires carrying the
+    cue through time (the T-maze family of memory tasks; R2D2's test env
+    here). obs = (cue0, cue1, time/len)."""
+
+    def __init__(self, num_envs: int = 8, seed: int = 0, delay: int = 6):
+        self.num_envs = num_envs
+        self.obs_dim = 3
+        self.num_actions = 2
+        self.episode_len = delay + 2  # cue step + delay blanks + decision
+        self._rng = np.random.default_rng(seed)
+        self._cue = np.zeros(num_envs, np.int64)
+        self._t = np.zeros(num_envs, np.int64)
+
+    def _obs(self) -> np.ndarray:
+        out = np.zeros((self.num_envs, 3), np.float32)
+        show = self._t == 0
+        out[show, 0] = self._cue[show] == 0
+        out[show, 1] = self._cue[show] == 1
+        out[:, 2] = self._t / self.episode_len
+        return out
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._cue = self._rng.integers(0, 2, self.num_envs)
+        self._t[:] = 0
+        return self._obs()
+
+    def step(self, actions: np.ndarray):
+        self._t += 1
+        done = self._t >= self.episode_len
+        reward = np.zeros(self.num_envs, np.float32)
+        reward[done] = (np.asarray(actions)[done]
+                        == self._cue[done]).astype(np.float32)
+        info: Dict[str, Any] = {}
+        if done.any():
+            idx = np.nonzero(done)[0]
+            info["final_obs"] = self._obs()
+            self._cue[idx] = self._rng.integers(0, 2, len(idx))
+            self._t[idx] = 0
+        return self._obs(), reward, done.astype(np.bool_), info
+
+
 _REGISTRY: Dict[str, Callable[..., VectorEnv]] = {
     "CartPole-v1": CartPoleVecEnv,
     "Pendulum-v1": PendulumVecEnv,
+    "MemoryCue-v0": MemoryCueVecEnv,
 }
 
 
