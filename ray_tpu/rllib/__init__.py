@@ -37,6 +37,8 @@ _EXPORTS = {
     "CartPoleVecEnv": "env", "PendulumVecEnv": "env", "VectorEnv": "env",
     "MemoryCueVecEnv": "env",
     "R2D2": "r2d2", "R2D2Config": "r2d2", "R2D2Learner": "r2d2",
+    "ApexDQN": "apex", "ApexDQNConfig": "apex",
+    "ReplayShardActor": "apex", "per_worker_epsilons": "apex",
     "make_env": "env", "register_env": "env",
     "BreakoutShapedVecEnv": "preprocessors", "wrap_atari": "preprocessors",
     "WarpFrameVec": "preprocessors", "FrameStackVec": "preprocessors",

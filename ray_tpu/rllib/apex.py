@@ -1,0 +1,336 @@
+"""Ape-X DQN — distributed prioritized experience replay
+(Horgan et al. 2018).
+
+ref: rllib/algorithms/apex_dqn/apex_dqn.py (ApexDQNConfig:
+num_replay_buffer_shards, per-worker exploration epsilons, worker-side
+initial priorities) + rllib/utils/replay_buffers/multi_agent_replay_buffer
+sharding and execution/learner_thread.py.
+
+The Ape-X topology maps 1:1 onto this runtime's actor plane:
+
+    rollout actors --(batch + initial |TD|)--> replay-shard actors
+    driver learner <--(sampled minibatches)--- replay-shard actors
+    driver learner --(new priorities)--------> replay-shard actors
+
+Rollout workers hold per-actor epsilons eps_i = base^(1 + i*alpha/(N-1))
+(the paper's exploration ladder), compute initial priorities with their
+local numpy net, and push straight to a replay shard — the driver is NOT
+on the experience path (worker->shard is an actor-to-actor call through
+the object store). The learner is the house DQNLearner: all K updates of
+an iteration ride one jitted lax.scan dispatch.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+import numpy as np
+
+import ray_tpu
+
+from . import sample_batch as sb
+from .dqn import DQNLearner, DQNRolloutWorker, NEXT_OBS
+from .replay_buffer import PrioritizedReplayBuffer
+from .rollout_worker import worker_opts
+
+
+class ReplayShardActor:
+    """One shard of the distributed prioritized replay (ref: apex_dqn.py
+    ReplayActor). Additions carry worker-computed priorities instead of
+    the max-priority default."""
+
+    def __init__(self, capacity: int, alpha: float, beta: float,
+                 seed: int = 0):
+        self.buffer = PrioritizedReplayBuffer(capacity, alpha=alpha,
+                                              beta=beta, seed=seed)
+        self._added = 0
+        # per-slot write generation: learner priority write-backs race
+        # with worker pushes once the ring wraps; a generation mismatch
+        # means the slot was overwritten mid-flight and the update must
+        # be dropped, not applied to the unrelated new transition
+        self._gen = np.zeros(self.buffer.capacity, np.int64)
+
+    def add(self, batch: Dict[str, np.ndarray],
+            priorities: np.ndarray) -> int:
+        idx = self.buffer.add(batch)
+        self.buffer.update_priorities(idx, np.asarray(priorities))
+        self._gen[idx] += 1
+        self._added += len(idx)
+        return self._added
+
+    def sample(self, batch_size: int):
+        """-> (batch, ring_idx, slot_generations, weights) or None while
+        warming up."""
+        if len(self.buffer) < batch_size:
+            return None
+        batch, idx, w = self.buffer.sample(batch_size)
+        return batch, idx, self._gen[idx].copy(), w
+
+    def update_priorities(self, idx: np.ndarray, gen: np.ndarray,
+                          td_abs: np.ndarray) -> int:
+        """Applies updates only where the slot generation still matches
+        the sample-time snapshot; returns how many were dropped as
+        stale."""
+        idx = np.asarray(idx)
+        live = self._gen[idx] == np.asarray(gen)
+        if live.any():
+            self.buffer.update_priorities(idx[live],
+                                          np.asarray(td_abs)[live])
+        return int((~live).sum())
+
+    def size(self) -> int:
+        return len(self.buffer)
+
+    def state(self) -> Dict:
+        return {"buffer": self.buffer.state(), "added": self._added}
+
+    def restore_state(self, s: Dict) -> bool:
+        self.buffer.restore(s["buffer"])
+        self._added = int(s.get("added", 0))
+        self._gen = np.zeros(self.buffer.capacity, np.int64)
+        self._gen[:len(self.buffer)] = 1
+        return True
+
+
+class ApexRolloutWorker(DQNRolloutWorker):
+    """DQN sampling plus worker-side initial priorities and direct
+    pushes to a replay shard (ref: apex_dqn.py workers computing
+    td_error before ReplayActor.add)."""
+
+    def __init__(self, env_name: str, num_envs: int, rollout_len: int,
+                 epsilon: float, gamma: float, seed: int = 0,
+                 env_creator=None):
+        super().__init__(env_name, num_envs, rollout_len, seed,
+                         env_creator)
+        self.epsilon = epsilon
+        self.gamma = gamma
+
+    def _initial_priorities(self, p: Dict, batch: sb.Batch) -> np.ndarray:
+        from .np_policy import forward_np
+
+        q, _ = forward_np(p, batch[sb.OBS])
+        q_sa = np.take_along_axis(q, batch[sb.ACTIONS][:, None],
+                                  axis=1)[:, 0]
+        q_next, _ = forward_np(p, batch[NEXT_OBS])
+        not_done = 1.0 - batch[sb.DONES].astype(np.float32)
+        y = batch[sb.REWARDS] + self.gamma * not_done * q_next.max(axis=1)
+        return np.abs(q_sa - y) + 1e-6
+
+    def sample_and_push(self, params: Dict, shard) -> int:
+        """One rollout -> priorities -> push to the shard actor. Returns
+        env-steps collected (the driver's accounting)."""
+        from .np_policy import ensure_numpy
+
+        p = ensure_numpy(params)
+        batch = self.sample(p, self.epsilon)
+        prios = self._initial_priorities(p, batch)
+        # actor-to-actor: the batch goes worker->shard through the
+        # object store; the driver never touches it
+        shard.add.remote(batch, prios)
+        return len(batch[sb.REWARDS])
+
+
+def per_worker_epsilons(n: int, base: float = 0.4,
+                        alpha: float = 7.0) -> List[float]:
+    """The Ape-X exploration ladder: eps_i = base^(1 + i*alpha/(N-1))."""
+    if n == 1:
+        return [base]
+    return [base ** (1 + i * alpha / (n - 1)) for i in range(n)]
+
+
+@dataclass
+class ApexDQNConfig:
+    """ref: apex_dqn.py ApexDQNConfig (n_replay_shards, per-worker
+    epsilon, training-intensity-style learner loop)."""
+    env: str = "CartPole-v1"
+    env_creator: Optional[Callable] = None
+    num_rollout_workers: int = 4
+    num_envs_per_worker: int = 8
+    rollout_fragment_length: int = 32
+    num_replay_shards: int = 2
+    gamma: float = 0.99
+    lr: float = 5e-4
+    buffer_size: int = 50_000            # per shard
+    prioritized_replay_alpha: float = 0.6
+    prioritized_replay_beta: float = 0.4
+    train_batch_size: int = 64
+    num_updates_per_iter: int = 16
+    learning_starts: int = 1_000         # transitions across all shards
+    target_update_freq: int = 200
+    epsilon_base: float = 0.4
+    epsilon_alpha: float = 7.0
+    double_q: bool = True
+    hidden: tuple = (64, 64)
+    seed: int = 0
+    # gather all shard buffers into save() (the dqn.py warm-restore
+    # rationale); off by default because Ape-X buffers are sized for
+    # throughput (shards x buffer_size transitions per checkpoint)
+    checkpoint_replay_buffer: bool = False
+    worker_resources: Dict[str, float] = field(default_factory=dict)
+
+    def build(self) -> "ApexDQN":
+        return ApexDQN(self)
+
+
+class ApexDQN:
+    """Ape-X driver: async sample/push riding alongside the learner loop
+    (Tune-trainable shaped)."""
+
+    def __init__(self, config: ApexDQNConfig):
+        self.config = c = config
+        creator_blob = (cloudpickle.dumps(c.env_creator)
+                        if c.env_creator else None)
+        shard_cls = ray_tpu.remote(ReplayShardActor)
+        self.shards = [
+            # memory-service actors: zero CPU demand so N workers + M
+            # shards fit a num_cpus=N cluster (shards only do pointer
+            # math between rollout bursts)
+            shard_cls.options(num_cpus=0.0).remote(
+                c.buffer_size, c.prioritized_replay_alpha,
+                c.prioritized_replay_beta, seed=c.seed + i)
+            for i in range(c.num_replay_shards)]
+        eps = per_worker_epsilons(c.num_rollout_workers, c.epsilon_base,
+                                  c.epsilon_alpha)
+        worker_cls = ray_tpu.remote(ApexRolloutWorker)
+        opts = worker_opts(c.worker_resources)
+        self.workers: List = [
+            worker_cls.options(**opts).remote(
+                c.env, c.num_envs_per_worker, c.rollout_fragment_length,
+                eps[i], c.gamma, seed=c.seed + 1000 * i,
+                env_creator=creator_blob)
+            for i in range(c.num_rollout_workers)]
+        info = ray_tpu.get(self.workers[0].env_info.remote(), timeout=180)
+        self.learner = DQNLearner(
+            info.get("obs_shape", info["obs_dim"]), info["num_actions"],
+            lr=c.lr, gamma=c.gamma, double_q=c.double_q, hidden=c.hidden,
+            seed=c.seed)
+        self._iteration = 0
+        self._total_steps = 0
+        self._total_episodes = 0
+        self._recent: List[float] = []
+        self._recent_greedy: List[float] = []
+
+    def train(self) -> Dict[str, Any]:
+        c = self.config
+        t0 = time.monotonic()
+        params_ref = ray_tpu.put(self.learner.get_params())
+        # kick off all rollouts; each worker pushes to a shard on its own
+        # (round-robin across iterations so shards fill evenly)
+        sample_futs = [
+            w.sample_and_push.remote(
+                params_ref,
+                self.shards[(i + self._iteration)
+                            % len(self.shards)])
+            for i, w in enumerate(self.workers)]
+
+        # learner loop overlaps the rollouts: pull minibatches from the
+        # shards, update in one dispatch, write priorities back
+        stats: Dict[str, Any] = {}
+        learn_time = 0.0
+        sizes = ray_tpu.get([s.size.remote() for s in self.shards],
+                            timeout=60)
+        if sum(sizes) >= c.learning_starts:
+            t1 = time.monotonic()
+            K = c.num_updates_per_iter
+            draw_shards = [self.shards[k % len(self.shards)]
+                           for k in range(K)]
+            draw_futs = [s.sample.remote(c.train_batch_size)
+                         for s in draw_shards]
+            # keep the (shard, draw) pairing through the None filter so
+            # priority updates go back to the ring that produced the rows
+            pairs = [(s, d) for s, d in
+                     zip(draw_shards, ray_tpu.get(draw_futs, timeout=120))
+                     if d is not None]
+            if pairs:
+                draws = [d for _, d in pairs]
+                stacked = {k: np.stack([d[0][k] for d in draws])
+                           for k in draws[0][0]}
+                out = self.learner.update_many(
+                    stacked, np.stack([d[3] for d in draws]))
+                for k, (shard, (_, idx, gen, _)) in enumerate(pairs):
+                    # generation-tagged: the shard drops updates whose
+                    # slot was overwritten by a concurrent worker push
+                    shard.update_priorities.remote(idx, gen,
+                                                   out["td_abs"][k])
+                n = self.learner.num_updates
+                if (n // c.target_update_freq
+                        > (n - len(draws)) // c.target_update_freq):
+                    self.learner.sync_target()
+                stats = {"loss": out["loss"], "mean_q": out["mean_q"],
+                         "num_updates": n}
+            learn_time = time.monotonic() - t1
+
+        steps = sum(ray_tpu.get(sample_futs, timeout=300))
+        self._total_steps += steps
+        all_rets = ray_tpu.get(
+            [w.episode_returns.remote() for w in self.workers],
+            timeout=60)
+        for i, rets in enumerate(all_rets):
+            self._recent.extend(rets)
+            self._total_episodes += len(rets)
+            if i == len(self.workers) - 1:
+                # the last worker sits at the greedy end of the epsilon
+                # ladder — its returns are the policy-quality signal
+                # (the paper evaluates greedily; the ladder mean is
+                # dominated by the eps~0.4 explorers)
+                self._recent_greedy.extend(rets)
+        self._recent = self._recent[-100:]
+        self._recent_greedy = self._recent_greedy[-100:]
+        self._iteration += 1
+        dt = time.monotonic() - t0
+        return {"training_iteration": self._iteration,
+                "timesteps_total": self._total_steps,
+                "timesteps_this_iter": steps,
+                "episode_reward_mean": (float(np.mean(self._recent))
+                                        if self._recent else float("nan")),
+                "episode_reward_mean_greedy": (
+                    float(np.mean(self._recent_greedy))
+                    if self._recent_greedy else float("nan")),
+                "episodes_total": self._total_episodes,
+                "replay_transitions": int(sum(sizes)),
+                "env_steps_per_sec": steps / max(1e-9, dt),
+                "learn_time_s": learn_time,
+                **stats}
+
+    # -- Tune-trainable surface ------------------------------------------
+
+    def save(self) -> Dict:
+        import jax
+
+        ckpt = {"params": jax.device_get(self.learner.params),
+                "target_params": jax.device_get(
+                    self.learner.target_params),
+                "opt_state": jax.device_get(self.learner.opt_state),
+                "iteration": self._iteration,
+                "total_steps": self._total_steps,
+                "num_updates": self.learner.num_updates}
+        if self.config.checkpoint_replay_buffer:
+            ckpt["shards"] = ray_tpu.get(
+                [s.state.remote() for s in self.shards], timeout=300)
+        return ckpt
+
+    def restore(self, ckpt: Dict) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        as_jnp = lambda t: jax.tree.map(jnp.asarray, t)  # noqa: E731
+        self.learner.params = as_jnp(ckpt["params"])
+        self.learner.target_params = as_jnp(ckpt["target_params"])
+        if "opt_state" in ckpt:
+            self.learner.opt_state = as_jnp(ckpt["opt_state"])
+        self.learner.num_updates = int(ckpt.get("num_updates", 0))
+        self._iteration = int(ckpt.get("iteration", 0))
+        self._total_steps = int(ckpt.get("total_steps", 0))
+        if "shards" in ckpt:
+            ray_tpu.get(
+                [s.restore_state.remote(state) for s, state in
+                 zip(self.shards, ckpt["shards"])], timeout=300)
+
+    def stop(self) -> None:
+        for a in self.workers + self.shards:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass

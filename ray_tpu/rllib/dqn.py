@@ -341,21 +341,11 @@ class DQN:
             # trade for distributed/batched DQN variants (cf. Ape-X,
             # where actors' priorities are a full generation stale).
             K = c.num_updates_per_iter
-            if isinstance(self.buffer, PrioritizedReplayBuffer):
-                draws = [self.buffer.sample(c.train_batch_size)
-                         for _ in range(K)]
-                stacked = {k: np.stack([d[0][k] for d in draws])
-                           for k in draws[0][0]}
-                out = self.learner.update_many(
-                    stacked, np.stack([d[2] for d in draws]))
-                for i, (_, idx, _) in enumerate(draws):
-                    self.buffer.update_priorities(idx, out["td_abs"][i])
-            else:
-                draws = [self.buffer.sample(c.train_batch_size)
-                         for _ in range(K)]
-                stacked = {k: np.stack([d[k] for d in draws])
-                           for k in draws[0]}
-                out = self.learner.update_many(stacked)
+            from .replay_buffer import fused_replay_update
+
+            out = fused_replay_update(self.buffer,
+                                      self.learner.update_many, K,
+                                      c.train_batch_size, "td_abs")
             # target sync at block granularity (at most K updates late)
             n = self.learner.num_updates
             if n // c.target_update_freq > (n - K) // c.target_update_freq:

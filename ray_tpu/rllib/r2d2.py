@@ -402,21 +402,12 @@ class R2D2:
         t1 = time.monotonic()
         stats: Dict[str, Any] = {}
         if len(self.buffer) >= c.learning_starts:
-            K, B = c.num_updates_per_iter, c.train_batch_size
-            if isinstance(self.buffer, PrioritizedReplayBuffer):
-                draws = [self.buffer.sample(B) for _ in range(K)]
-                stacked = {k: np.stack([d[0][k] for d in draws])
-                           for k in draws[0][0]}
-                out = self.learner.update_many(
-                    stacked, np.stack([d[2] for d in draws]))
-                for i, (_, idx, _) in enumerate(draws):
-                    self.buffer.update_priorities(idx,
-                                                  out["priorities"][i])
-            else:
-                draws = [self.buffer.sample(B) for _ in range(K)]
-                stacked = {k: np.stack([d[k] for d in draws])
-                           for k in draws[0]}
-                out = self.learner.update_many(stacked)
+            from .replay_buffer import fused_replay_update
+
+            K = c.num_updates_per_iter
+            out = fused_replay_update(self.buffer,
+                                      self.learner.update_many, K,
+                                      c.train_batch_size, "priorities")
             n = self.learner.num_updates
             if n // c.target_update_freq > (n - K) // c.target_update_freq:
                 self.learner.sync_target()

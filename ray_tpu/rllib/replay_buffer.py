@@ -80,6 +80,29 @@ class ReplayBuffer:
         return order
 
 
+def fused_replay_update(buffer, update_many, K: int, B: int,
+                        priority_key: str = "td_abs"):
+    """The shared off-policy learner block (DQN / R2D2 / SAC shape):
+    K draws -> stacked [K, B, ...] arrays -> ONE fused update_many
+    dispatch -> PER priority refresh. `priority_key` names the
+    per-minibatch |TD|/priority array in update_many's result. Returns
+    the update_many stats dict (ref: dqn.py training_step's
+    sample-then-learn block, shared here so the arithmetic lives once).
+    """
+    if isinstance(buffer, PrioritizedReplayBuffer):
+        draws = [buffer.sample(B) for _ in range(K)]
+        stacked = {k: np.stack([d[0][k] for d in draws])
+                   for k in draws[0][0]}
+        out = update_many(stacked, np.stack([d[2] for d in draws]))
+        for i, (_, idx, _) in enumerate(draws):
+            buffer.update_priorities(idx, out[priority_key][i])
+    else:
+        draws = [buffer.sample(B) for _ in range(K)]
+        stacked = {k: np.stack([d[k] for d in draws]) for k in draws[0]}
+        out = update_many(stacked)
+    return out
+
+
 class SumTree:
     """Binary-indexed sum tree over `capacity` leaves: O(log n) update and
     prefix-sum sampling (ref: the segment tree in
