@@ -21,6 +21,9 @@ _EXPORTS = {
     "ImpalaLearner": "impala",
     "SAC": "sac", "SACConfig": "sac", "SACLearner": "sac",
     "APPO": "impala", "APPOConfig": "impala",
+    "DT": "dt", "DTConfig": "dt",
+    "Dreamer": "dreamer", "DreamerConfig": "dreamer",
+    "DreamerLearner": "dreamer",
     "MARWIL": "offline", "MARWILConfig": "offline",
     "BC": "offline", "BCConfig": "offline",
     "CQL": "cql", "CQLConfig": "cql",
@@ -58,6 +61,8 @@ _EXPORTS = {
     "BanditLinUCBConfig": "bandit", "BanditLinTSConfig": "bandit",
     "LinearBanditEnv": "bandit", "register_bandit_env": "bandit",
     "QMIX": "qmix", "QMIXConfig": "qmix",
+    "MADDPG": "maddpg", "MADDPGConfig": "maddpg",
+    "RendezvousVecEnv": "maddpg",
     "PolicyServerInput": "policy_server",
     "ExternalPPO": "policy_server", "ExternalPPOConfig": "policy_server",
     "PolicyClient": "policy_client",

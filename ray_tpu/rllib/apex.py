@@ -84,7 +84,11 @@ class ReplayShardActor:
         return len(self.buffer)
 
     def state(self) -> Dict:
-        return {"buffer": self.buffer.state(), "added": self._added}
+        # alpha rides along: stored leaf priorities are p^alpha, and a
+        # cross-config restore must de-exponentiate with the SOURCE
+        # alpha, not the destination's
+        return {"buffer": self.buffer.state(), "added": self._added,
+                "alpha": self.buffer.alpha}
 
     def restore_state(self, s: Dict) -> bool:
         self.buffer.restore(s["buffer"])
@@ -193,6 +197,9 @@ class ApexDQN:
             for i in range(c.num_replay_shards)]
         eps = per_worker_epsilons(c.num_rollout_workers, c.epsilon_base,
                                   c.epsilon_alpha)
+        # the metric label is only as greedy as the ladder's last rung
+        # (n=1 means eps_base itself) — reported so consumers can see it
+        self._greedy_eps = eps[-1]
         worker_cls = ray_tpu.remote(ApexRolloutWorker)
         opts = worker_opts(c.worker_resources)
         self.workers: List = [
@@ -288,6 +295,7 @@ class ApexDQN:
                 "episode_reward_mean_greedy": (
                     float(np.mean(self._recent_greedy))
                     if self._recent_greedy else float("nan")),
+                "greedy_epsilon": self._greedy_eps,
                 "episodes_total": self._total_episodes,
                 "replay_transitions": int(sum(sizes)),
                 "env_steps_per_sec": steps / max(1e-9, dt),
@@ -324,9 +332,41 @@ class ApexDQN:
         self._iteration = int(ckpt.get("iteration", 0))
         self._total_steps = int(ckpt.get("total_steps", 0))
         if "shards" in ckpt:
-            ray_tpu.get(
-                [s.restore_state.remote(state) for s, state in
-                 zip(self.shards, ckpt["shards"])], timeout=300)
+            states = ckpt["shards"]
+            if len(states) == len(self.shards):
+                ray_tpu.get(
+                    [s.restore_state.remote(state) for s, state in
+                     zip(self.shards, states)], timeout=300)
+            else:
+                # shard-count change (PBT exploit across differently
+                # configured trials): pool every checkpointed row and
+                # its leaf priority and re-add in chunks round-robin so
+                # every destination shard gets an even share. Rows
+                # beyond the destination's total capacity follow the
+                # ring's newest-wins semantics (the same rule
+                # ReplayBuffer.restore applies on shrink).
+                futs = []
+                chunk_i = 0
+                for state in states:
+                    cols = state["buffer"]["cols"]
+                    n_rows = len(next(iter(cols.values())))
+                    leaves = state["buffer"].get("priorities")
+                    # stored leaves are p^alpha_src; add() re-applies the
+                    # destination alpha, so hand it the raw priority
+                    # de-exponentiated with the SOURCE alpha
+                    a_src = float(state.get(
+                        "alpha", self.config.prioritized_replay_alpha))
+                    prios = (np.maximum(np.asarray(leaves), 1e-12)
+                             ** (1.0 / a_src) if leaves is not None
+                             else np.ones(n_rows))
+                    for lo in range(0, n_rows, 1024):
+                        sl = slice(lo, min(lo + 1024, n_rows))
+                        dst = self.shards[chunk_i % len(self.shards)]
+                        chunk_i += 1
+                        futs.append(dst.add.remote(
+                            {k: v[sl] for k, v in cols.items()},
+                            prios[sl]))
+                ray_tpu.get(futs, timeout=600)
 
     def stop(self) -> None:
         for a in self.workers + self.shards:

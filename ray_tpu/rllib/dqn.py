@@ -24,7 +24,8 @@ import ray_tpu
 from . import sample_batch as sb
 from .np_policy import ensure_numpy, forward_np
 from .rollout_worker import EnvWorkerBase, worker_opts
-from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+from .replay_buffer import (PrioritizedReplayBuffer, ReplayBuffer,
+                            fused_replay_update)
 
 NEXT_OBS = "next_obs"
 
@@ -341,8 +342,6 @@ class DQN:
             # trade for distributed/batched DQN variants (cf. Ape-X,
             # where actors' priorities are a full generation stale).
             K = c.num_updates_per_iter
-            from .replay_buffer import fused_replay_update
-
             out = fused_replay_update(self.buffer,
                                       self.learner.update_many, K,
                                       c.train_batch_size, "td_abs")

@@ -1,0 +1,351 @@
+"""Decision Transformer — offline RL as return-conditioned sequence
+modeling (Chen et al. 2021).
+
+ref: rllib/algorithms/dt/dt.py (DTConfig: context K, target_return,
+loss = action cross-entropy over trajectory segments) +
+rllib/algorithms/dt/dt_torch_model.py (interleaved (R̂, s, a) tokens,
+action predicted from the state token, timestep embedding added to all
+three token types).
+
+House shape: consumes the same JSONL experience files as MARWIL/BC
+(offline.py), trains a compact causal transformer as ONE jitted
+lax.scan over pre-sampled segment minibatches per train() call, and
+evaluates by autoregressive return-conditioned rollout in a VectorEnv.
+The model is deliberately self-contained jax (the segment length
+3K ~ 60 tokens is far below where the GPT flash path earns its keep;
+models/gpt.py stays the LM flagship)."""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .env import make_env
+from .offline import read_experiences
+
+MAX_TIMESTEP = 1024  # timestep-embedding table size (episode-step clamp)
+
+
+def init_dt_params(rng, obs_dim: int, num_actions: int, d_model: int,
+                   n_layer: int, n_head: int) -> Dict:
+    import jax
+    import jax.numpy as jnp
+
+    D = d_model
+    ks = jax.random.split(rng, 6 + 6 * n_layer)
+    std = 0.02
+    p = {
+        "w_rtg": jax.random.normal(ks[0], (1, D), jnp.float32) * std,
+        "w_obs": jax.random.normal(ks[1], (obs_dim, D),
+                                   jnp.float32) * std,
+        "w_act": jax.random.normal(ks[2], (num_actions, D),
+                                   jnp.float32) * std,
+        "wte_t": jax.random.normal(ks[3], (MAX_TIMESTEP, D),
+                                   jnp.float32) * std,
+        "ln_f_g": jnp.ones((D,), jnp.float32),
+        "ln_f_b": jnp.zeros((D,), jnp.float32),
+        "w_head": jax.random.normal(ks[4], (D, num_actions),
+                                    jnp.float32) * std,
+        "b_head": jnp.zeros((num_actions,), jnp.float32),
+    }
+    for li in range(n_layer):
+        k = ks[6 + 6 * li:12 + 6 * li]
+        p[f"l{li}_ln1_g"] = jnp.ones((D,), jnp.float32)
+        p[f"l{li}_ln1_b"] = jnp.zeros((D,), jnp.float32)
+        p[f"l{li}_qkv"] = jax.random.normal(k[0], (D, 3 * D),
+                                            jnp.float32) * std
+        p[f"l{li}_proj"] = jax.random.normal(
+            k[1], (D, D), jnp.float32) * std / np.sqrt(2 * n_layer)
+        p[f"l{li}_ln2_g"] = jnp.ones((D,), jnp.float32)
+        p[f"l{li}_ln2_b"] = jnp.zeros((D,), jnp.float32)
+        p[f"l{li}_fc"] = jax.random.normal(k[2], (D, 4 * D),
+                                           jnp.float32) * std
+        p[f"l{li}_fc_b"] = jnp.zeros((4 * D,), jnp.float32)
+        p[f"l{li}_out"] = jax.random.normal(
+            k[3], (4 * D, D), jnp.float32) * std / np.sqrt(2 * n_layer)
+        p[f"l{li}_out_b"] = jnp.zeros((D,), jnp.float32)
+    return p
+
+
+def dt_forward(params: Dict, rtg, obs, acts, timesteps, pad_mask,
+               n_layer: int, n_head: int):
+    """Batch forward: rtg [B,K,1], obs [B,K,obs_dim], acts [B,K] int,
+    timesteps [B,K] int, pad_mask [B,K] (1=real) -> action logits at the
+    STATE token of every step, [B,K,A]."""
+    import jax
+    import jax.numpy as jnp
+
+    B, K = acts.shape
+    D = params["w_rtg"].shape[1]
+    A = params["w_act"].shape[0]
+    t_emb = params["wte_t"][jnp.clip(timesteps, 0, MAX_TIMESTEP - 1)]
+    e_rtg = rtg @ params["w_rtg"] + t_emb
+    e_obs = obs @ params["w_obs"] + t_emb
+    e_act = jax.nn.one_hot(acts, A, dtype=jnp.float32) @ params["w_act"] \
+        + t_emb
+    # interleave (rtg_t, s_t, a_t): [B, 3K, D]
+    x = jnp.stack([e_rtg, e_obs, e_act], axis=2).reshape(B, 3 * K, D)
+
+    tok_mask = jnp.repeat(pad_mask, 3, axis=1)          # [B, 3K]
+    causal = jnp.tril(jnp.ones((3 * K, 3 * K), jnp.bool_))
+    attn_mask = causal[None] & tok_mask[:, None, :].astype(bool)
+    bias = jnp.where(attn_mask, 0.0, -1e9)[:, None]     # [B,1,3K,3K]
+
+    def ln(x, g, b):
+        mu = x.mean(-1, keepdims=True)
+        var = ((x - mu) ** 2).mean(-1, keepdims=True)
+        return (x - mu) / jnp.sqrt(var + 1e-5) * g + b
+
+    hd = D // n_head
+    for li in range(n_layer):
+        h = ln(x, params[f"l{li}_ln1_g"], params[f"l{li}_ln1_b"])
+        qkv = h @ params[f"l{li}_qkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, 3 * K, n_head, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(B, 3 * K, n_head, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(B, 3 * K, n_head, hd).transpose(0, 2, 1, 3)
+        scores = q @ k.transpose(0, 1, 3, 2) / np.sqrt(hd) + bias
+        att = jax.nn.softmax(scores, axis=-1) @ v       # [B,H,3K,hd]
+        att = att.transpose(0, 2, 1, 3).reshape(B, 3 * K, D)
+        x = x + att @ params[f"l{li}_proj"]
+        h = ln(x, params[f"l{li}_ln2_g"], params[f"l{li}_ln2_b"])
+        h = jax.nn.gelu(h @ params[f"l{li}_fc"] + params[f"l{li}_fc_b"])
+        x = x + h @ params[f"l{li}_out"] + params[f"l{li}_out_b"]
+
+    x = ln(x, params["ln_f_g"], params["ln_f_b"])
+    state_tok = x.reshape(B, K, 3, D)[:, :, 1]          # the s_t token
+    return state_tok @ params["w_head"] + params["b_head"]
+
+
+@dataclass
+class DTConfig:
+    """ref: dt.py DTConfig (context K, target_return, embed/layer dims)."""
+    env: str = "CartPole-v1"          # evaluation env
+    env_creator: Optional[Callable] = None
+    input_paths: Any = None
+    episodes: Optional[List[Dict[str, np.ndarray]]] = None
+    context_len: int = 20             # K
+    d_model: int = 128
+    n_layer: int = 3
+    n_head: int = 4
+    lr: float = 1e-3
+    weight_decay: float = 1e-4
+    train_batch_size: int = 64        # segments per minibatch
+    num_updates_per_iter: int = 32
+    target_return: float = 500.0      # eval conditioning
+    rtg_scale: float = 500.0          # rtg normalization divisor
+    evaluation_num_episodes: int = 8
+    max_eval_steps: int = 600
+    seed: int = 0
+
+    def build(self) -> "DT":
+        return DT(self)
+
+
+class DT:
+    """Offline trainer (MARWIL driver shape): train() consumes the fixed
+    dataset; evaluate() runs return-conditioned autoregressive rollouts."""
+
+    def __init__(self, config: DTConfig):
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.config = c = config
+        episodes = c.episodes or read_experiences(c.input_paths)
+        # per-episode arrays + undiscounted return-to-go suffix sums
+        self._eps = []
+        for ep in episodes:
+            r = np.asarray(ep["rewards"], np.float32)
+            rtg = np.cumsum(r[::-1])[::-1].copy()
+            self._eps.append({
+                "obs": np.asarray(ep["obs"], np.float32),
+                "actions": np.asarray(ep["actions"], np.int64),
+                "rtg": rtg})
+        self._num_actions = int(max(int(e["actions"].max())
+                                    for e in self._eps)) + 1
+        self._obs_dim = self._eps[0]["obs"].shape[1]
+        self.params = init_dt_params(
+            jax.random.PRNGKey(c.seed), self._obs_dim, self._num_actions,
+            c.d_model, c.n_layer, c.n_head)
+        self.optimizer = optax.adamw(c.lr, weight_decay=c.weight_decay)
+        self.opt_state = self.optimizer.init(self.params)
+        self._rng = np.random.default_rng(c.seed)
+        self._iteration = 0
+
+        fwd = functools.partial(dt_forward, n_layer=c.n_layer,
+                                n_head=c.n_head)
+        self._fwd = jax.jit(fwd)
+
+        def loss_fn(params, batch):
+            logits = fwd(params, batch["rtg"], batch["obs"],
+                         batch["acts"], batch["t"], batch["mask"])
+            logp = jax.nn.log_softmax(logits)
+            ll = jnp.take_along_axis(logp, batch["acts"][..., None],
+                                     axis=2)[..., 0]
+            m = batch["mask"]
+            return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+        def update_many(params, opt_state, batches):
+            def body(carry, mb):
+                params, opt_state = carry
+                loss, grads = jax.value_and_grad(loss_fn)(params, mb)
+                updates, opt_state = self.optimizer.update(
+                    grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), loss
+
+            (params, opt_state), losses = jax.lax.scan(
+                body, (params, opt_state), batches)
+            return params, opt_state, losses.mean()
+
+        self._update_many = jax.jit(update_many, donate_argnums=(0, 1))
+
+    def _sample_segments(self, n: int) -> Dict[str, np.ndarray]:
+        """Random length-K segments, left-padded (the reference
+        right-aligns context the same way)."""
+        c = self.config
+        K = c.context_len
+        out = {"rtg": np.zeros((n, K, 1), np.float32),
+               "obs": np.zeros((n, K, self._obs_dim), np.float32),
+               "acts": np.zeros((n, K), np.int64),
+               "t": np.zeros((n, K), np.int64),
+               "mask": np.zeros((n, K), np.float32)}
+        ep_idx = self._rng.integers(0, len(self._eps), size=n)
+        for i, ei in enumerate(ep_idx):
+            ep = self._eps[ei]
+            T = len(ep["actions"])
+            si = int(self._rng.integers(0, T))
+            seg = slice(si, min(si + K, T))
+            L = seg.stop - seg.start
+            out["rtg"][i, K - L:, 0] = ep["rtg"][seg] / c.rtg_scale
+            out["obs"][i, K - L:] = ep["obs"][seg]
+            out["acts"][i, K - L:] = ep["actions"][seg]
+            out["t"][i, K - L:] = np.arange(seg.start, seg.stop)
+            out["mask"][i, K - L:] = 1.0
+        return out
+
+    def train(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        c = self.config
+        t0 = time.monotonic()
+        K_upd, B = c.num_updates_per_iter, c.train_batch_size
+        mbs = [self._sample_segments(B) for _ in range(K_upd)]
+        stacked = {k: jnp.asarray(np.stack([m[k] for m in mbs]))
+                   for k in mbs[0]}
+        self.params, self.opt_state, loss = self._update_many(
+            self.params, self.opt_state, stacked)
+        self._iteration += 1
+        return {"training_iteration": self._iteration,
+                "loss": float(loss),
+                "num_episodes": len(self._eps),
+                "train_time_s": time.monotonic() - t0}
+
+    def evaluate(self, target_return: Optional[float] = None,
+                 num_episodes: Optional[int] = None,
+                 seed: int = 123) -> Dict[str, float]:
+        """Return-conditioned autoregressive rollout: rtg starts at the
+        target and decrements by observed rewards (ref: dt.py inference
+        loop)."""
+        import jax.numpy as jnp
+
+        c = self.config
+        tgt = c.target_return if target_return is None else target_return
+        n_eps = num_episodes or c.evaluation_num_episodes
+        n = 4
+        env = (c.env_creator(num_envs=n, seed=seed) if c.env_creator
+               else make_env(c.env, num_envs=n, seed=seed))
+        K = c.context_len
+        obs = env.reset(seed=seed)
+        hist_obs = [np.zeros((0, self._obs_dim), np.float32)
+                    for _ in range(n)]
+        hist_act = [np.zeros((0,), np.int64) for _ in range(n)]
+        hist_rtg = [np.zeros((0,), np.float32) for _ in range(n)]
+        rtg_now = np.full(n, tgt, np.float64)
+        t_now = np.zeros(n, np.int64)
+        done_rets: List[float] = []
+        ep_ret = np.zeros(n)
+        # per-env episode quota: without it, fast-failing envs finish
+        # many short episodes before a long-running env finishes one,
+        # biasing the mean toward low returns
+        quota = -(-n_eps // n)
+        ep_count = np.zeros(n, np.int64)
+        for _ in range(c.max_eval_steps * 4):
+            batch = {"rtg": np.zeros((n, K, 1), np.float32),
+                     "obs": np.zeros((n, K, self._obs_dim), np.float32),
+                     "acts": np.zeros((n, K), np.int64),
+                     "t": np.zeros((n, K), np.int64),
+                     "mask": np.zeros((n, K), np.float32)}
+            for i in range(n):
+                # current step enters as (rtg, s, dummy-a); history fills
+                # the earlier positions
+                ho = np.concatenate([hist_obs[i], obs[i:i + 1]])[-K:]
+                hr = np.concatenate(
+                    [hist_rtg[i], [rtg_now[i]]])[-K:].astype(np.float32)
+                ha = np.concatenate([hist_act[i], [0]])[-K:]
+                L = len(ho)
+                batch["obs"][i, K - L:] = ho
+                batch["rtg"][i, K - L:, 0] = hr / c.rtg_scale
+                batch["acts"][i, K - L:] = ha
+                batch["t"][i, K - L:] = np.arange(
+                    max(0, t_now[i] - L + 1), t_now[i] + 1)
+                batch["mask"][i, K - L:] = 1.0
+            logits = np.asarray(self._fwd(
+                self.params, jnp.asarray(batch["rtg"]),
+                jnp.asarray(batch["obs"]), jnp.asarray(batch["acts"]),
+                jnp.asarray(batch["t"]), jnp.asarray(batch["mask"])))
+            actions = logits[:, -1].argmax(axis=1)
+            new_obs, reward, done, _ = env.step(actions)
+            for i in range(n):
+                hist_obs[i] = np.concatenate(
+                    [hist_obs[i], obs[i:i + 1]])[-K:]
+                hist_act[i] = np.concatenate(
+                    [hist_act[i], [actions[i]]])[-K:]
+                hist_rtg[i] = np.concatenate(
+                    [hist_rtg[i], [rtg_now[i]]])[-K:].astype(np.float32)
+                ep_ret[i] += reward[i]
+                rtg_now[i] = max(rtg_now[i] - reward[i], 1.0)
+                t_now[i] += 1
+                if done[i]:
+                    if ep_count[i] < quota:
+                        done_rets.append(float(ep_ret[i]))
+                        ep_count[i] += 1
+                    ep_ret[i] = 0.0
+                    rtg_now[i] = tgt
+                    t_now[i] = 0
+                    hist_obs[i] = np.zeros((0, self._obs_dim), np.float32)
+                    hist_act[i] = np.zeros((0,), np.int64)
+                    hist_rtg[i] = np.zeros((0,), np.float32)
+            obs = new_obs
+            if (ep_count >= quota).all():
+                break
+        return {"episode_reward_mean": (float(np.mean(done_rets))
+                                        if done_rets else 0.0),
+                "episodes": len(done_rets),
+                "target_return": float(tgt)}
+
+    # -- Tune-trainable surface ------------------------------------------
+
+    def save(self) -> Dict:
+        import jax
+
+        return {"params": jax.device_get(self.params),
+                "opt_state": jax.device_get(self.opt_state),
+                "iteration": self._iteration}
+
+    def restore(self, ckpt: Dict) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.params = jax.tree.map(jnp.asarray, ckpt["params"])
+        if "opt_state" in ckpt:
+            self.opt_state = jax.tree.map(jnp.asarray, ckpt["opt_state"])
+        self._iteration = int(ckpt.get("iteration", 0))
+
+    def stop(self) -> None:
+        pass  # offline: no workers

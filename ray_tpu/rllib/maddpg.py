@@ -1,0 +1,431 @@
+"""MADDPG — multi-agent DDPG with centralized critics
+(Lowe et al. 2017).
+
+ref: rllib/algorithms/maddpg/maddpg.py (MADDPGConfig: per-agent actors,
+critics conditioned on ALL agents' obs+actions, target nets + Gaussian
+exploration) over the ddpg losses. Decentralized execution /
+centralized training: each actor mu_i sees only its own observation;
+each critic Q_i(o_1..o_N, a_1..a_N) sees everything, which removes the
+non-stationarity independent DDPG suffers as other agents learn.
+
+House shape: the TD3 module's numpy-MLP rollout machinery
+(td3._mlp_np), a joint-transition replay buffer, and ALL agents'
+critic+actor+polyak updates for K minibatches fused into ONE jitted
+lax.scan dispatch per train() call. Ships RendezvousVecEnv — a
+continuous cooperative two-agent task (meet in the middle) — as the
+test surface, registered as "Rendezvous-v0"."""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+import numpy as np
+
+import ray_tpu
+
+from .multi_agent import MultiAgentVecEnv, register_multi_agent_env
+from .replay_buffer import ReplayBuffer
+from .rollout_worker import worker_opts
+from .td3 import _mlp_init, _mlp_np
+
+
+class RendezvousVecEnv(MultiAgentVecEnv):
+    """Two point agents on the [-1,1]^2 plane; action = velocity in
+    [-1,1]^2; shared reward = -distance(a0, a1) each step; 25-step
+    episodes. Cooperative continuous control — the MPE simple-spread
+    family reduced to its testable core (ref:
+    rllib/examples/env/mock_env or MPE simple_spread usage in
+    maddpg tests)."""
+
+    EPISODE_LEN = 25
+    DT = 0.1
+
+    agent_ids = ("a0", "a1")
+    continuous = True
+    action_dim = 2
+    action_low = -1.0
+    action_high = 1.0
+
+    def __init__(self, num_envs: int = 8, seed: int = 0):
+        self.num_envs = num_envs
+        self.obs_dim = 4  # own pos (2) + other's pos (2)
+        self.num_actions = 0  # discrete interface N/A
+        self._rng = np.random.default_rng(seed)
+        self._pos = np.zeros((num_envs, 2, 2), np.float64)
+        self._t = np.zeros(num_envs, np.int64)
+
+    def _obs(self) -> Dict[str, np.ndarray]:
+        p0 = self._pos[:, 0].astype(np.float32)
+        p1 = self._pos[:, 1].astype(np.float32)
+        return {"a0": np.concatenate([p0, p1], axis=1),
+                "a1": np.concatenate([p1, p0], axis=1)}
+
+    def reset(self, seed: Optional[int] = None) -> Dict[str, np.ndarray]:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._pos = self._rng.uniform(-1, 1, (self.num_envs, 2, 2))
+        self._t[:] = 0
+        return self._obs()
+
+    def step(self, actions: Dict[str, np.ndarray]):
+        for i, aid in enumerate(self.agent_ids):
+            a = np.clip(np.asarray(actions[aid], np.float64), -1, 1)
+            self._pos[:, i] = np.clip(self._pos[:, i] + self.DT * a,
+                                      -1, 1)
+        dist = np.linalg.norm(self._pos[:, 0] - self._pos[:, 1], axis=1)
+        r = (-dist).astype(np.float32)
+        rewards = {"a0": r.copy(), "a1": r.copy()}
+        self._t += 1
+        done = self._t >= self.EPISODE_LEN
+        info: Dict[str, Any] = {}
+        if done.any():
+            info["truncated"] = done.copy()
+            info["final_obs"] = self._obs()
+            idx = np.nonzero(done)[0]
+            self._pos[idx] = self._rng.uniform(-1, 1, (len(idx), 2, 2))
+            self._t[idx] = 0
+        return self._obs(), rewards, done, info
+
+
+register_multi_agent_env("Rendezvous-v0", RendezvousVecEnv)
+
+
+class MADDPGRolloutWorker:
+    """Steps all agents' deterministic actors + exploration noise; emits
+    joint transitions keyed obs_<aid>/act_<aid>/rew_<aid> (the critic
+    needs the joint view — ref maddpg.py before_learn_on_batch gathering
+    all agents' columns)."""
+
+    def __init__(self, env_name: str, num_envs: int, rollout_len: int,
+                 explore_sigma: float, seed: int = 0, env_creator=None):
+        from .multi_agent import make_multi_agent_env
+
+        self.env = (cloudpickle.loads(env_creator)(num_envs=num_envs,
+                                                   seed=seed)
+                    if env_creator else
+                    make_multi_agent_env(env_name, num_envs, seed))
+        self.rollout_len = rollout_len
+        self.sigma = explore_sigma
+        self._rng = np.random.default_rng(seed + 1)
+        self._obs = self.env.reset(seed=seed)
+        self._ep_return = np.zeros(self.env.num_envs, np.float64)
+        self._finished: List[float] = []
+
+    def env_info(self) -> dict:
+        return {"obs_dim": self.env.obs_dim,
+                "action_dim": self.env.action_dim,
+                "agent_ids": tuple(self.env.agent_ids),
+                "num_envs": self.env.num_envs}
+
+    def episode_returns(self, clear: bool = True) -> List[float]:
+        out = list(self._finished)
+        if clear:
+            self._finished.clear()
+        return out
+
+    def sample(self, actor_params: Dict[str, Dict],
+               random_actions: bool = False) -> Dict[str, np.ndarray]:
+        agents = list(self.env.agent_ids)
+        ps = {a: {k: np.asarray(v, np.float32)
+                  for k, v in actor_params[a].items()} for a in agents}
+        T, n = self.rollout_len, self.env.num_envs
+        ad = self.env.action_dim
+        od = self.env.obs_dim
+        buf = {f"obs_{a}": np.empty((T, n, od), np.float32)
+               for a in agents}
+        buf.update({f"act_{a}": np.empty((T, n, ad), np.float32)
+                    for a in agents})
+        buf.update({f"rew_{a}": np.empty((T, n), np.float32)
+                    for a in agents})
+        buf.update({f"next_obs_{a}": np.empty((T, n, od), np.float32)
+                    for a in agents})
+        buf["dones"] = np.empty((T, n), np.bool_)
+        obs = self._obs
+        for t in range(T):
+            acts = {}
+            for a in agents:
+                if random_actions:
+                    act = self._rng.uniform(-1, 1, (n, ad))
+                else:
+                    act = np.tanh(_mlp_np(ps[a], obs[a])) \
+                        + self._rng.normal(0, self.sigma, (n, ad))
+                acts[a] = np.clip(act, -1.0, 1.0)
+                buf[f"obs_{a}"][t] = obs[a]
+                buf[f"act_{a}"][t] = acts[a]
+            obs, rewards, done, info = self.env.step(acts)
+            for a in agents:
+                buf[f"rew_{a}"][t] = rewards[a]
+                buf[f"next_obs_{a}"][t] = obs[a]
+            buf["dones"][t] = done
+            if done.any():
+                idx = np.nonzero(done)[0]
+                if "final_obs" in info:
+                    for a in agents:
+                        buf[f"next_obs_{a}"][t, idx] = \
+                            info["final_obs"][a][idx]
+                if "truncated" in info:
+                    buf["dones"][t] &= ~info["truncated"]
+            # shared-task return bookkeeping: mean over agents
+            step_r = np.mean([rewards[a] for a in agents], axis=0)
+            self._ep_return += step_r
+            if done.any():
+                for i in np.nonzero(done)[0]:
+                    self._finished.append(float(self._ep_return[i]))
+                    self._ep_return[i] = 0.0
+        self._obs = obs
+        flat = lambda x: x.reshape(T * n, *x.shape[2:])  # noqa: E731
+        return {k: flat(v) for k, v in buf.items()}
+
+
+@dataclass
+class MADDPGConfig:
+    """ref: maddpg.py MADDPGConfig (actor/critic lr, tau, smooth targets
+    off by default — plain DDPG-style per the original paper)."""
+    env: str = "Rendezvous-v0"
+    env_creator: Optional[Callable] = None
+    num_rollout_workers: int = 1
+    num_envs_per_worker: int = 8
+    rollout_fragment_length: int = 25
+    gamma: float = 0.95
+    tau: float = 0.01
+    actor_lr: float = 1e-3
+    critic_lr: float = 1e-3
+    buffer_size: int = 100_000
+    train_batch_size: int = 256
+    num_updates_per_iter: int = 16
+    learning_starts: int = 1_000
+    explore_sigma: float = 0.1
+    hidden: tuple = (64, 64)
+    seed: int = 0
+    checkpoint_replay_buffer: bool = True
+    worker_resources: Dict[str, float] = field(default_factory=dict)
+
+    def build(self) -> "MADDPG":
+        return MADDPG(self)
+
+
+class MADDPGLearner:
+    """All agents' centralized-critic + actor + polyak updates fused
+    into one jitted scan (ref: maddpg losses; Lowe et al. eq. 6-7)."""
+
+    def __init__(self, agents: List[str], obs_dim: int, action_dim: int,
+                 c: MADDPGConfig):
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from .sac import _mlp_forward as mlp
+
+        self.agents = agents
+        N = len(agents)
+        joint_dim = N * (obs_dim + action_dim)
+        keys = jax.random.split(jax.random.PRNGKey(c.seed), 2 * N)
+        self.params = {}
+        for i, a in enumerate(agents):
+            self.params[f"actor_{a}"] = _mlp_init(
+                keys[2 * i], (obs_dim, *c.hidden), action_dim)
+            self.params[f"critic_{a}"] = _mlp_init(
+                keys[2 * i + 1], (joint_dim, *c.hidden), 1)
+        self.target = jax.tree.map(lambda x: x.copy(), self.params)
+        self.opt_actor = optax.adam(c.actor_lr)
+        self.opt_critic = optax.adam(c.critic_lr)
+        self.state_actor = self.opt_actor.init(
+            {a: self.params[f"actor_{a}"] for a in agents})
+        self.state_critic = self.opt_critic.init(
+            {a: self.params[f"critic_{a}"] for a in agents})
+        self.num_updates = 0
+
+        def joint_x(batch, acts: Dict):
+            cols = [batch[f"obs_{a}"] for a in agents] \
+                + [acts[a] for a in agents]
+            return jnp.concatenate(cols, axis=-1)
+
+        def critic_loss(critics, target, batch):
+            # target actions from target actors on next obs
+            next_acts = {a: jnp.tanh(mlp(target[f"actor_{a}"],
+                                         batch[f"next_obs_{a}"]))
+                         for a in agents}
+            xn = jnp.concatenate(
+                [batch[f"next_obs_{a}"] for a in agents]
+                + [next_acts[a] for a in agents], axis=-1)
+            x = joint_x(batch, {a: batch[f"act_{a}"] for a in agents})
+            not_done = 1.0 - batch["dones"].astype(jnp.float32)
+            total = 0.0
+            for a in agents:
+                qn = mlp(target[f"critic_{a}"], xn)[:, 0]
+                y = batch[f"rew_{a}"] + c.gamma * not_done \
+                    * jax.lax.stop_gradient(qn)
+                q = mlp(critics[a], x)[:, 0]
+                total = total + jnp.mean((q - y) ** 2)
+            return total / N
+
+        def actor_loss(actors, params, batch):
+            # each agent's actor ascends its own centralized critic with
+            # the OTHER agents' batch actions held fixed
+            total = 0.0
+            for a in agents:
+                acts = {b: (jnp.tanh(mlp(actors[a], batch[f"obs_{a}"]))
+                            if b == a else batch[f"act_{b}"])
+                        for b in agents}
+                q = mlp(params[f"critic_{a}"], joint_x(batch, acts))[:, 0]
+                total = total - jnp.mean(q)
+            return total / N
+
+        def polyak(t, p):
+            return jax.tree.map(
+                lambda x, y: x * (1 - c.tau) + y * c.tau, t, p)
+
+        def one_update(carry, batch):
+            params, target, s_a, s_c = carry
+            critics = {a: params[f"critic_{a}"] for a in agents}
+            closs, cgrads = jax.value_and_grad(critic_loss)(
+                critics, target, batch)
+            cu, s_c = self.opt_critic.update(cgrads, s_c, critics)
+            critics = optax.apply_updates(critics, cu)
+            params = {**params,
+                      **{f"critic_{a}": critics[a] for a in agents}}
+            actors = {a: params[f"actor_{a}"] for a in agents}
+            aloss, agrads = jax.value_and_grad(actor_loss)(
+                actors, params, batch)
+            au, s_a = self.opt_actor.update(agrads, s_a, actors)
+            actors = optax.apply_updates(actors, au)
+            params = {**params,
+                      **{f"actor_{a}": actors[a] for a in agents}}
+            target = polyak(target, params)
+            return (params, target, s_a, s_c), \
+                {"critic_loss": closs, "actor_loss": aloss}
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def update_many(params, target, s_a, s_c, batches):
+            (params, target, s_a, s_c), stats = jax.lax.scan(
+                one_update, (params, target, s_a, s_c), batches)
+            return params, target, s_a, s_c, jax.tree.map(
+                jnp.mean, stats)
+
+        self._update_many = update_many
+
+    def update(self, stacked: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax
+        import jax.numpy as jnp
+
+        batches = {k: jnp.asarray(v) for k, v in stacked.items()}
+        (self.params, self.target, self.state_actor, self.state_critic,
+         stats) = self._update_many(self.params, self.target,
+                                    self.state_actor, self.state_critic,
+                                    batches)
+        self.num_updates += int(stacked["dones"].shape[0])
+        return {k: float(v) for k, v in jax.device_get(stats).items()}
+
+    def actor_params_np(self) -> Dict[str, Dict]:
+        import jax
+
+        return {a: jax.device_get(self.params[f"actor_{a}"])
+                for a in self.agents}
+
+
+class MADDPG:
+    """Tune-trainable MADDPG driver (TD3 shape, joint transitions)."""
+
+    def __init__(self, config: MADDPGConfig):
+        self.config = c = config
+        creator_blob = (cloudpickle.dumps(c.env_creator)
+                        if c.env_creator else None)
+        cls = ray_tpu.remote(MADDPGRolloutWorker)
+        opts = worker_opts(c.worker_resources)
+        self.workers = [
+            cls.options(**opts).remote(
+                c.env, c.num_envs_per_worker, c.rollout_fragment_length,
+                c.explore_sigma, seed=c.seed + 31 * i,
+                env_creator=creator_blob)
+            for i in range(c.num_rollout_workers)]
+        info = ray_tpu.get(self.workers[0].env_info.remote(), timeout=180)
+        self.agents = list(info["agent_ids"])
+        self.learner = MADDPGLearner(self.agents, info["obs_dim"],
+                                     info["action_dim"], c)
+        self.buffer = ReplayBuffer(c.buffer_size, seed=c.seed)
+        self._iteration = 0
+        self._total_steps = 0
+        self._total_episodes = 0
+        self._recent: List[float] = []
+
+    def train(self) -> Dict[str, Any]:
+        c = self.config
+        t0 = time.monotonic()
+        warmup = self._total_steps < c.learning_starts
+        actors_ref = ray_tpu.put(self.learner.actor_params_np())
+        batches = ray_tpu.get(
+            [w.sample.remote(actors_ref, random_actions=warmup)
+             for w in self.workers], timeout=300)
+        steps = 0
+        for b in batches:
+            self.buffer.add(b)
+            steps += len(b["dones"])
+        self._total_steps += steps
+        stats: Dict[str, float] = {}
+        if len(self.buffer) >= max(c.learning_starts,
+                                   c.train_batch_size):
+            K, B = c.num_updates_per_iter, c.train_batch_size
+            mb = self.buffer.sample(K * B)
+            stacked = {k: v.reshape(K, B, *v.shape[1:])
+                       for k, v in mb.items()}
+            stats = self.learner.update(stacked)
+        for rets in ray_tpu.get(
+                [w.episode_returns.remote() for w in self.workers],
+                timeout=60):
+            self._recent.extend(rets)
+            self._total_episodes += len(rets)
+        self._recent = self._recent[-100:]
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_total": self._total_steps,
+            "timesteps_this_iter": steps,
+            "episode_reward_mean": (float(np.mean(self._recent))
+                                    if self._recent else float("nan")),
+            "episodes_total": self._total_episodes,
+            "num_updates": self.learner.num_updates,
+            "time_this_iter_s": time.monotonic() - t0,
+            **stats,
+        }
+
+    # -- Tune-trainable surface ------------------------------------------
+
+    def save(self) -> Dict:
+        import jax
+
+        L = self.learner
+        ckpt = {"params": jax.device_get(L.params),
+                "target": jax.device_get(L.target),
+                "opt_states": jax.device_get((L.state_actor,
+                                              L.state_critic)),
+                "iteration": self._iteration,
+                "total_steps": self._total_steps}
+        if self.config.checkpoint_replay_buffer:
+            ckpt["buffer"] = self.buffer.state()
+        return ckpt
+
+    def restore(self, ckpt: Dict) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        as_jnp = lambda t: jax.tree.map(jnp.asarray, t)  # noqa: E731
+        L = self.learner
+        L.params = as_jnp(ckpt["params"])
+        L.target = as_jnp(ckpt["target"])
+        if "opt_states" in ckpt:
+            L.state_actor, L.state_critic = as_jnp(ckpt["opt_states"])
+        self._iteration = int(ckpt.get("iteration", 0))
+        self._total_steps = int(ckpt.get("total_steps", 0))
+        if "buffer" in ckpt:
+            self.buffer.restore(ckpt["buffer"])
+
+    def stop(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

@@ -27,7 +27,8 @@ import numpy as np
 import ray_tpu
 
 from . import sample_batch as sb
-from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+from .replay_buffer import (PrioritizedReplayBuffer, ReplayBuffer,
+                            fused_replay_update)
 from .rollout_worker import EnvWorkerBase, worker_opts
 
 H0, C0 = "h0", "c0"
@@ -402,8 +403,6 @@ class R2D2:
         t1 = time.monotonic()
         stats: Dict[str, Any] = {}
         if len(self.buffer) >= c.learning_starts:
-            from .replay_buffer import fused_replay_update
-
             K = c.num_updates_per_iter
             out = fused_replay_update(self.buffer,
                                       self.learner.update_many, K,
