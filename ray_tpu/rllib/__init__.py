@@ -24,6 +24,9 @@ _EXPORTS = {
     "DT": "dt", "DTConfig": "dt",
     "Dreamer": "dreamer", "DreamerConfig": "dreamer",
     "DreamerLearner": "dreamer",
+    "AlphaZero": "alpha_zero", "AlphaZeroConfig": "alpha_zero",
+    "TicTacToe": "alpha_zero", "register_game": "alpha_zero",
+    "mcts_policy": "alpha_zero",
     "MARWIL": "offline", "MARWILConfig": "offline",
     "BC": "offline", "BCConfig": "offline",
     "CQL": "cql", "CQLConfig": "cql",
