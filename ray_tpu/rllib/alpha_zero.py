@@ -37,16 +37,21 @@ from .rollout_worker import worker_opts
 
 
 class TicTacToe:
-    """Vector-friendly two-player game: boards are [n, 9] int8 arrays
-    with +1 (player to move... stored absolutely: +1 = X, -1 = O).
+    """Vector-friendly two-player game: boards are [n, board_size] int8
+    arrays with stones stored absolutely (+1 = X, -1 = O).
 
-    Static-method protocol so MCTS/self-play need no instances:
-      initial(n) -> boards, players
+    Static/class-method protocol so MCTS/self-play need no instances —
+    custom games implement exactly these names (A, OBS_DIM class attrs
+    plus):
+      initial(n) -> (boards [n, board_size], players [n])
       legal(boards) -> [n, A] bool
-      play(boards, players, actions) -> (boards, players)
-      outcome(boards, players) -> [n] float in {-1,0,1} from the
-        perspective of the player who JUST moved; nan while ongoing
-      canonical(boards, players) -> [n, obs_dim] float32 net input
+      play(boards, players, actions) -> (boards, players)  # next mover
+      terminal_value(boards, players) -> [n] float in {-1, 0, +1} from
+        the perspective of the PLAYER TO MOVE (players[i]): -1 means
+        the mover has already lost (the usual case — the opponent just
+        completed a line); nan while the game is live
+      canonical(boards, players) -> [n, OBS_DIM] float32 net input from
+        the player-to-move's perspective
     """
 
     A = 9
@@ -120,12 +125,12 @@ class _Tree:
     """One game's search tree in flat arrays (ref: mcts.py Node — here
     arrays-of-nodes instead of node objects)."""
 
-    def __init__(self, max_nodes: int, A: int):
+    def __init__(self, max_nodes: int, A: int, board_size: int):
         self.N = np.zeros((max_nodes, A), np.float32)   # visit counts
         self.W = np.zeros((max_nodes, A), np.float32)   # total value
         self.P = np.zeros((max_nodes, A), np.float32)   # priors
         self.children = np.full((max_nodes, A), -1, np.int32)
-        self.boards = np.zeros((max_nodes, 9), np.int8)
+        self.boards = np.zeros((max_nodes, board_size), np.int8)
         self.players = np.zeros(max_nodes, np.int8)
         self.legal = np.zeros((max_nodes, A), bool)
         self.terminal_v = np.full(max_nodes, np.nan, np.float32)
@@ -148,8 +153,9 @@ def mcts_policy(game, forward_fn, boards: np.ndarray,
     distributions [n, A] (ref: mcts.py compute_action + the AlphaZero
     paper's search)."""
     n, A = len(boards), game.A
+    board_size = boards.shape[1]
     max_nodes = num_sims + 2
-    trees = [_Tree(max_nodes, A) for _ in range(n)]
+    trees = [_Tree(max_nodes, A, board_size) for _ in range(n)]
     # root eval (batched) + Dirichlet noise
     probs, _ = forward_fn(game.canonical(boards, players))
     for i, t in enumerate(trees):
@@ -165,7 +171,7 @@ def mcts_policy(game, forward_fn, boards: np.ndarray,
     for _ in range(num_sims):
         # phase 1: descend every tree to a leaf
         paths: List[List[Tuple[int, int]]] = []
-        leaf_boards = np.zeros((n, 9), np.int8)
+        leaf_boards = np.zeros((n, board_size), np.int8)
         leaf_players = np.zeros(n, np.int8)
         leaf_node = np.zeros(n, np.int32)
         needs_eval = np.zeros(n, bool)

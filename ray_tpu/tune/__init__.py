@@ -15,9 +15,10 @@ from .schedulers import (ASHAScheduler, AsyncHyperBandScheduler,
                          PopulationBasedTraining,
                          ResourceChangingScheduler, TrialScheduler,
                          even_cpu_distribution)
-from .search import (BasicVariantGenerator, Choice, Domain, GPSearcher,
+from .search import (BasicVariantGenerator, Choice, ConcurrencyLimiter,
+                     Domain, GPSearcher,
                      GridSearch, LogUniform, Randint, RandomSearch,
-                     Searcher, TPESearcher, TuneBOHB, Uniform, choice,
+                     Repeater, Searcher, TPESearcher, TuneBOHB, Uniform, choice,
                      grid_search, loguniform, randint, uniform)
 from .session import get_checkpoint, report
 from .trainable import Trainable
@@ -32,7 +33,7 @@ __all__ = [
     "MedianStoppingRule", "PB2", "PopulationBasedTraining",
     "Syncer", "pull_experiment",
     "Searcher", "BasicVariantGenerator", "RandomSearch", "TPESearcher",
-    "TuneBOHB", "GPSearcher",
+    "TuneBOHB", "GPSearcher", "ConcurrencyLimiter", "Repeater",
     "ResourceChangingScheduler", "even_cpu_distribution",
     "Domain", "Uniform", "LogUniform", "Randint", "Choice", "GridSearch",
     "uniform", "loguniform", "randint", "choice", "grid_search",

@@ -88,8 +88,13 @@ def _is_grid(v) -> bool:
 
 class Searcher:
     """Suggestion plug-in (ref: tune/search/searcher.py). suggest() returns a
-    config dict or None when exhausted; on_trial_complete feeds results back
-    (used by adaptive searchers)."""
+    config dict, None when exhausted, or Searcher.PENDING when the searcher
+    cannot produce a config RIGHT NOW but is not done (the reference's
+    Searcher.FINISHED/None distinction; the tuner retries PENDING on its
+    next loop tick). on_trial_complete feeds results back (used by
+    adaptive searchers)."""
+
+    PENDING = "__searcher_pending__"
 
     def set_space(self, param_space: Dict[str, Any], metric: str, mode: str):
         self.param_space = param_space
@@ -409,6 +414,121 @@ class GPSearcher(Searcher):
                           result: Optional[dict]) -> None:
         self._record(trial_id, result)
         self._suggested.pop(trial_id, None)
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps how many of the wrapped searcher's suggestions run at once
+    (ref: tune/search/concurrency_limiter.py). Model-based searchers
+    (GP/TPE) suggest better when each batch of results lands before the
+    next batch of suggestions; this enforces that independently of the
+    cluster's trial capacity."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        if max_concurrent < 1:
+            raise ValueError("max_concurrent must be >= 1")
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    def set_space(self, param_space, metric, mode):
+        super().set_space(param_space, metric, mode)
+        self.searcher.set_space(param_space, metric, mode)
+
+    def suggest(self, trial_id: str):
+        if len(self._live) >= self.max_concurrent:
+            return Searcher.PENDING
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None and cfg is not Searcher.PENDING:
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        self.searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[dict]) -> None:
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result)
+
+    def on_experiment_end(self) -> None:
+        hook = getattr(self.searcher, "on_experiment_end", None)
+        if hook is not None:
+            hook()
+
+
+class Repeater(Searcher):
+    """Runs every underlying suggestion `repeat` times and reports the
+    MEAN metric back to the wrapped searcher (ref:
+    tune/search/repeater.py — variance reduction for noisy objectives;
+    the wrapped searcher sees one averaged observation per config)."""
+
+    def __init__(self, searcher: Searcher, repeat: int = 3):
+        if repeat < 1:
+            raise ValueError("repeat must be >= 1")
+        self.searcher = searcher
+        self.repeat = repeat
+        # lead_tid -> {cfg, dispatched, completed, scores}
+        self._groups: Dict[str, Dict[str, Any]] = {}
+        self._open: Optional[str] = None   # lead of the filling group
+        self._group_of: Dict[str, str] = {}
+
+    def set_space(self, param_space, metric, mode):
+        super().set_space(param_space, metric, mode)
+        self.searcher.set_space(param_space, metric, mode)
+
+    def suggest(self, trial_id: str):
+        if self._open is None:
+            cfg = self.searcher.suggest(trial_id)
+            if cfg is None or cfg is Searcher.PENDING:
+                return cfg
+            self._open = trial_id
+            self._groups[trial_id] = {"cfg": dict(cfg), "dispatched": 0,
+                                      "completed": 0, "scores": []}
+        lead = self._open
+        g = self._groups[lead]
+        g["dispatched"] += 1
+        self._group_of[trial_id] = lead
+        if g["dispatched"] >= self.repeat:
+            self._open = None
+        return dict(g["cfg"])
+
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        pass  # intermediate results are per-repeat noise; hold them back
+
+    def _maybe_close(self, lead: str, final: bool = False) -> None:
+        g = self._groups.get(lead)
+        if g is None or g["completed"] < g["dispatched"]:
+            return
+        if g["dispatched"] < self.repeat and not final:
+            return  # group still filling (or truncated — see flush)
+        # report the mean; an all-errored group resolves the inner
+        # searcher's pending suggestion with None instead of leaking it
+        if g["scores"]:
+            mean = sum(g["scores"]) / len(g["scores"])
+            self.searcher.on_trial_complete(lead, {self.metric: mean})
+        else:
+            self.searcher.on_trial_complete(lead, None)
+        self._groups.pop(lead, None)
+        if self._open == lead:
+            self._open = None
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[dict]) -> None:
+        lead = self._group_of.pop(trial_id, None)
+        if lead is None or lead not in self._groups:
+            return
+        g = self._groups[lead]
+        g["completed"] += 1
+        if result and self.metric in result:
+            g["scores"].append(float(result[self.metric]))
+        self._maybe_close(lead)
+
+    def on_experiment_end(self) -> None:
+        """Flush partially-dispatched groups (a num_samples budget can
+        truncate the final group) so the wrapped searcher still sees
+        their observations and drops its pending state."""
+        for lead in list(self._groups):
+            self._maybe_close(lead, final=True)
 
 
 # the BOHB pairing name (model-based half; pair with HyperBandForBOHB)

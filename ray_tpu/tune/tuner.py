@@ -361,6 +361,11 @@ class TuneController:
                 if cfg is None:
                     self._exhausted = True
                     return
+                if cfg is Searcher.PENDING:
+                    # not exhausted — the searcher (ConcurrencyLimiter,
+                    # batched BO) wants results back first; retry on the
+                    # next loop tick
+                    return
                 t = Trial(cfg, trial_id=tid)
                 self.trials.append(t)
             else:
@@ -441,6 +446,11 @@ class TuneController:
                             and trial.future is fut):
                         trial.future = trial.runner.step.remote()
             self.scheduler.choose_action(self)
+        # let composite searchers flush partial state (Repeater groups
+        # truncated by the num_samples budget)
+        end_hook = getattr(self.searcher, "on_experiment_end", None)
+        if end_hook is not None:
+            end_hook()
         return self.trials
 
 

@@ -517,3 +517,104 @@ def test_resource_changing_scheduler(rt):
     trial = results._trials[0] if hasattr(results, "_trials") else None
     if trial is not None:
         assert trial.resources == {"CPU": 2.0}
+
+
+def test_concurrency_limiter_caps_inflight(rt):
+    """The limiter must keep the wrapped searcher's in-flight count at
+    max_concurrent without ending the experiment (PENDING, not None)."""
+    from ray_tpu.tune import ConcurrencyLimiter, TPESearcher
+
+    seen_live = []
+
+    class Spy(TPESearcher):
+        def suggest(self, tid):
+            return super().suggest(tid)
+
+    limiter = ConcurrencyLimiter(Spy(seed=0), max_concurrent=2)
+    orig_suggest = limiter.suggest
+
+    def counting_suggest(tid):
+        seen_live.append(len(limiter._live))
+        return orig_suggest(tid)
+
+    limiter.suggest = counting_suggest
+
+    def train_fn(config):
+        tune.report(score=config["x"])
+
+    results = Tuner(
+        train_fn,
+        param_space={"x": tune.uniform(0, 1)},
+        tune_config=TuneConfig(metric="score", mode="max", num_samples=6,
+                               search_alg=limiter),
+    ).fit()
+    assert len(results) == 6
+    assert not results.errors
+    assert max(seen_live) <= 2  # never more than 2 outstanding
+
+
+def test_repeater_averages_noisy_objective(rt):
+    """Each config runs `repeat` times; the inner searcher sees ONE
+    averaged observation per config."""
+    from ray_tpu.tune import Repeater, TPESearcher
+
+    inner = TPESearcher(seed=1)
+    completed = []
+    orig = inner.on_trial_complete
+
+    def spy_complete(tid, result):
+        completed.append(result)
+        return orig(tid, result)
+
+    inner.on_trial_complete = spy_complete
+    rep = Repeater(inner, repeat=3)
+
+    def train_fn(config):
+        import random as _r
+
+        tune.report(score=config["x"] + _r.Random().uniform(-0.1, 0.1))
+
+    results = Tuner(
+        train_fn,
+        param_space={"x": tune.uniform(0, 1)},
+        tune_config=TuneConfig(metric="score", mode="max", num_samples=6,
+                               search_alg=rep),
+    ).fit()
+    assert len(results) == 6            # 2 configs x 3 repeats
+    assert not results.errors
+    assert len(completed) == 2          # inner saw one mean per config
+    xs = sorted(set(round(r.metrics["config"]["x"], 6) for r in results))
+    assert len(xs) == 2                 # exactly two distinct configs
+
+
+def test_repeater_flushes_truncated_group(rt):
+    """num_samples that isn't a multiple of `repeat` truncates the last
+    group; the experiment-end hook must still report its partial mean to
+    the inner searcher (no leaked pending state)."""
+    from ray_tpu.tune import Repeater, TPESearcher
+
+    inner = TPESearcher(seed=2)
+    completed = []
+    orig = inner.on_trial_complete
+
+    def spy_complete(tid, result):
+        completed.append((tid, result))
+        return orig(tid, result)
+
+    inner.on_trial_complete = spy_complete
+    rep = Repeater(inner, repeat=3)
+
+    def train_fn(config):
+        tune.report(score=config["x"])
+
+    results = Tuner(
+        train_fn,
+        param_space={"x": tune.uniform(0, 1)},
+        tune_config=TuneConfig(metric="score", mode="max", num_samples=4,
+                               search_alg=rep),
+    ).fit()
+    assert len(results) == 4            # 1 full group + 1 single-run
+    assert not results.errors
+    assert len(completed) == 2          # truncated group flushed too
+    assert not rep._groups              # nothing leaked
+    assert not inner._suggested         # inner pending state resolved
