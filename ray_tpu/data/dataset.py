@@ -154,6 +154,133 @@ class Dataset:
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
         return self._with(AllToAllOp("random_shuffle", seed))
 
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Lazy concatenation of datasets (ref: dataset.py union):
+        the inputs' plans execute when the union executes; blocks flow
+        through in order."""
+        parts = [self, *others]
+
+        def thunk():
+            refs: List[Any] = []
+            for p in parts:
+                refs.extend(p._execute_refs())
+            return refs
+
+        return Dataset([SourceOp(thunk=thunk, name="union")], self._ctx)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise zip of two equal-length datasets (ref: dataset.py
+        zip — the right side's conflicting column names get a "_1"
+        suffix). Blocks realign on the driver, so this materializes both
+        sides; prefer add_column for derived columns."""
+        def thunk():
+            a = block_concat([ray_tpu.get(r)
+                              for r in self._execute_refs()])
+            b = block_concat([ray_tpu.get(r)
+                              for r in other._execute_refs()])
+            na, nb = block_num_rows(a), block_num_rows(b)
+            if na != nb:
+                raise ValueError(
+                    f"zip needs equal row counts, got {na} vs {nb}")
+            merged = dict(a)
+            for k, v in b.items():
+                name, i = k, 1
+                while name in merged:  # find a FREE suffix: zipping an
+                    name = f"{k}_{i}"  # already-zipped ds must not
+                    i += 1             # clobber its existing k_1
+                merged[name] = v
+            n_blocks = max(1, min(self._ctx.default_parallelism,
+                                  math.ceil(na / max(
+                                      1, self._ctx.target_min_rows_per_block)
+                                  )))
+            refs = []
+            for i in builtins.range(n_blocks):
+                lo = na * i // n_blocks
+                hi = na * (i + 1) // n_blocks
+                if hi > lo:
+                    refs.append(ray_tpu.put(
+                        {k: v[lo:hi] for k, v in merged.items()}))
+            return refs
+
+        return Dataset([SourceOp(thunk=thunk, name="zip")], self._ctx)
+
+    def train_test_split(self, test_size: float, *,
+                         shuffle: bool = False,
+                         seed: Optional[int] = None
+                         ) -> tuple:
+        """-> (train, test) row-split at 1 - test_size (ref: dataset.py
+        train_test_split). The upstream plan executes ONCE; both halves
+        are views over the cached block refs."""
+        if not 0.0 < test_size < 1.0:
+            raise ValueError("test_size must be in (0, 1)")
+        base = self.random_shuffle(seed=seed) if shuffle else self
+        cache: Dict[str, Any] = {}
+
+        def _splits():
+            if "parts" not in cache:
+                from .block import block_slice
+
+                refs = base._execute_refs()
+                blocks = [ray_tpu.get(r) for r in refs]
+                total = sum(block_num_rows(b) for b in blocks)
+                cut = int(total * (1.0 - test_size))
+                train_refs, test_refs, seen = [], [], 0
+                for r, b in zip(refs, blocks):
+                    n = block_num_rows(b)
+                    if seen + n <= cut:
+                        train_refs.append(r)  # whole block: reuse ref
+                    elif seen >= cut:
+                        test_refs.append(r)
+                    else:  # only the straddling block is re-put
+                        k = cut - seen
+                        train_refs.append(
+                            ray_tpu.put(block_slice(b, 0, k)))
+                        test_refs.append(
+                            ray_tpu.put(block_slice(b, k, n)))
+                    seen += n
+                cache["parts"] = (train_refs, test_refs)
+            return cache["parts"]
+
+        train = Dataset([SourceOp(thunk=lambda: list(_splits()[0]),
+                                  name="train_split")], self._ctx)
+        test = Dataset([SourceOp(thunk=lambda: list(_splits()[1]),
+                                 name="test_split")], self._ctx)
+        return train, test
+
+    def random_sample(self, fraction: float, *,
+                      seed: Optional[int] = None) -> "Dataset":
+        """Bernoulli row sample (ref: dataset.py random_sample). Each
+        block samples with its own derived seed in a remote task."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError("fraction must be in [0, 1]")
+
+        def _sample(block: Block, frac: float, s: int) -> Block:
+            rng = np.random.default_rng(s)
+            mask = rng.random(block_num_rows(block)) < frac
+            return {k: v[mask] for k, v in block.items()}
+
+        sample_remote = ray_tpu.remote(_sample)
+
+        # unseeded calls must be independent draws (the reference's
+        # contract) — freeze a fresh base per random_sample() call
+        base = (int(np.random.default_rng().integers(2 ** 31))
+                if seed is None else seed)
+
+        def thunk():
+            return [sample_remote.remote(r, fraction, base + 7919 * i)
+                    for i, r in enumerate(self._execute_refs())]
+
+        return Dataset([SourceOp(thunk=thunk, name="random_sample")],
+                       self._ctx)
+
+    def unique(self, column: str) -> List[Any]:
+        """Distinct values of a column (ref: dataset.py unique)."""
+        seen: set = set()
+        for b in self._stream_blocks():
+            if column in b:
+                seen.update(np.unique(b[column]).tolist())
+        return sorted(seen)
+
     def limit(self, n: int) -> "Dataset":
         """Applied exactly at iteration time (truncates the block stream)."""
         ds = Dataset(self._ops, self._ctx)
@@ -175,6 +302,24 @@ class Dataset:
         ex = StreamingExecutor(self._ctx)
         refs = list(ex.execute(self._segments()))
         self._last_stats = ex.stats.summary()
+        limit = getattr(self, "_limit", None)
+        if limit is not None:
+            # ref-path consumers (materialize, union/zip/split thunks,
+            # to_arrow_refs) must see the truncation too, not just the
+            # block-stream path
+            from .block import block_slice
+
+            kept, seen = [], 0
+            for r in refs:
+                if seen >= limit:
+                    break
+                b = ray_tpu.get(r)
+                n = block_num_rows(b)
+                take = min(n, limit - seen)
+                kept.append(r if take == n
+                            else ray_tpu.put(block_slice(b, 0, take)))
+                seen += take
+            refs = kept
         return refs
 
     def _stream_blocks(self) -> Iterator[Block]:
@@ -388,6 +533,41 @@ class Dataset:
                 total += float(np.sum(b[column]))
         return total
 
+    def _column_stats(self, column: str) -> tuple:
+        """Streaming (n, sum, sumsq, min, max) over one pass."""
+        n, s, ss = 0, 0.0, 0.0
+        mn, mx = math.inf, -math.inf
+        for b in self._stream_blocks():
+            if column in b and block_num_rows(b):
+                v = np.asarray(b[column], np.float64)
+                n += v.size
+                s += float(v.sum())
+                ss += float((v * v).sum())
+                mn = min(mn, float(v.min()))
+                mx = max(mx, float(v.max()))
+        return n, s, ss, mn, mx
+
+    def mean(self, column: str = "item") -> float:
+        n, s, _, _, _ = self._column_stats(column)
+        return s / n if n else float("nan")
+
+    def std(self, column: str = "item", ddof: int = 1) -> float:
+        """ref: dataset.py std (sample std by default, like the
+        reference's ddof=1)."""
+        n, s, ss, _, _ = self._column_stats(column)
+        if n <= ddof:
+            return float("nan")
+        var = (ss - s * s / n) / (n - ddof)
+        return math.sqrt(max(var, 0.0))
+
+    def min(self, column: str = "item") -> float:
+        n, _, _, mn, _ = self._column_stats(column)
+        return mn if n else float("nan")
+
+    def max(self, column: str = "item") -> float:
+        n, _, _, _, mx = self._column_stats(column)
+        return mx if n else float("nan")
+
     def schema(self) -> Optional[Dict[str, str]]:
         for b in self._stream_blocks():
             return {k: str(v.dtype) for k, v in b.items()}
@@ -395,6 +575,14 @@ class Dataset:
 
     def num_blocks(self) -> int:
         src = self._ops[0]
+        if src.read_fns is None and src.refs is None \
+                and src.thunk is not None:
+            # deferred source (union/zip/split): block count is only
+            # knowable by running the upstream plans — execute once and
+            # cache the refs on the op so repeated metadata calls don't
+            # re-execute
+            src.refs = list(src.thunk())
+            src.thunk = None
         n = len(src.read_fns) if src.read_fns is not None else len(src.refs or [])
         for op in self._ops[1:]:
             if isinstance(op, AllToAllOp) and op.kind == "repartition":

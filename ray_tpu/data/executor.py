@@ -484,6 +484,10 @@ class StreamingExecutor:
                 continue
             if kind == "refs":
                 inputs: Iterator[Any] = iter(payload)
+            elif kind == "thunk":
+                # deferred source (union/split views): the upstream
+                # dataset plans execute now, on the driver
+                inputs = iter(payload())
             elif kind == "chained":
                 assert stream is not None
                 inputs = stream

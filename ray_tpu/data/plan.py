@@ -16,9 +16,12 @@ import cloudpickle
 
 @dataclass
 class SourceOp:
-    """Produces blocks: read-task callables, or already-materialized refs."""
+    """Produces blocks: read-task callables, already-materialized refs,
+    or a deferred thunk () -> [refs] (union / split views over other
+    datasets: the upstream plans execute when THIS plan executes)."""
     read_fns: Optional[List[bytes]] = None   # cloudpickled () -> Block
     refs: Optional[List[Any]] = None
+    thunk: Optional[Callable[[], List[Any]]] = None
     name: str = "source"
     # column-aware sources (parquet) accept a projection: called with the
     # selected column names, returns replacement read_fns that fetch only
@@ -55,6 +58,8 @@ def build_segments(ops: List[Any]) -> List[dict]:
     src = ops[0]
     if src.read_fns is not None:
         pending_source = ("reads", list(src.read_fns))
+    elif src.thunk is not None:
+        pending_source = ("thunk", src.thunk)
     else:
         pending_source = ("refs", list(src.refs or []))
     chain: List[Callable] = []

@@ -720,3 +720,94 @@ class TestWrites:
         small.write_parquet(str(tmp_path / "out"))
         back = rd.read_parquet(str(tmp_path / "out"))
         assert sorted(r["a"] for r in back.take_all()) == list(range(10))
+
+
+class TestRound5DatasetOps:
+    def test_union(self, cluster):
+        import ray_tpu.data as data
+
+        a = data.range(5)
+        b = data.range(3).map(lambda r: {"id": r["id"] + 100})
+        u = a.union(b)
+        ids = sorted(r["id"] for r in u.iter_rows())
+        assert ids == [0, 1, 2, 3, 4, 100, 101, 102]
+        assert u.count() == 8
+
+    def test_zip_renames_conflicts(self, cluster):
+        import ray_tpu.data as data
+
+        a = data.range(6)
+        b = data.range(6).map(lambda r: {"id": r["id"] * 10})
+        z = a.zip(b)
+        rows = z.take_all()
+        assert all(r["id_1"] == r["id"] * 10 for r in rows)
+        with pytest.raises(Exception):
+            data.range(4).zip(data.range(5)).count()
+
+    def test_train_test_split_exact_partition(self, cluster):
+        import ray_tpu.data as data
+
+        train, test = data.range(100).train_test_split(0.2)
+        assert train.count() == 80
+        assert test.count() == 20
+        # both sides together hold every row exactly once
+        ids = sorted(list(r["id"] for r in train.iter_rows())
+                     + list(r["id"] for r in test.iter_rows()))
+        assert ids == list(range(100))
+
+    def test_random_sample_fraction(self, cluster):
+        import ray_tpu.data as data
+
+        ds = data.range(4000).random_sample(0.25, seed=0)
+        n = ds.count()
+        assert 800 <= n <= 1200  # ~1000 expected
+        # different blocks must not sample identical masks: ids spread
+        ids = [r["id"] for r in ds.iter_rows()]
+        assert min(ids) < 500 and max(ids) > 3500
+
+    def test_unique_and_aggregates(self, cluster):
+        import ray_tpu.data as data
+        import numpy as np
+
+        ds = data.from_items([1.0, 2.0, 2.0, 3.0, 4.0])
+        assert ds.unique("item") == [1.0, 2.0, 3.0, 4.0]
+        assert ds.mean() == pytest.approx(2.4)
+        assert ds.min() == 1.0
+        assert ds.max() == 4.0
+        assert ds.std() == pytest.approx(
+            float(np.std([1, 2, 2, 3, 4], ddof=1)))
+
+    def test_limit_respected_by_ref_consumers(self, cluster):
+        import ray_tpu.data as data
+
+        u = data.range(10).limit(3).union(data.range(2))
+        assert u.count() == 5
+        assert data.range(10).limit(4).materialize().count() == 4
+
+    def test_window_over_union(self, cluster):
+        import ray_tpu.data as data
+
+        pipe = data.range(20).union(data.range(20)).window(
+            blocks_per_window=2)
+        total = sum(b["id"].sum() for w in pipe.iter_windows()
+                    for b in w._stream_blocks())
+        assert total == 2 * sum(range(20))
+
+    def test_unseeded_random_sample_is_independent(self, cluster):
+        import ray_tpu.data as data
+
+        ds = data.range(2000)
+        a = set(r["id"] for r in ds.random_sample(0.5).iter_rows())
+        b = set(r["id"] for r in ds.random_sample(0.5).iter_rows())
+        assert a != b  # fresh randomness per call
+
+    def test_double_zip_keeps_all_columns(self, cluster):
+        import ray_tpu.data as data
+
+        base = data.range(5)
+        z1 = base.zip(data.range(5).map(lambda r: {"id": r["id"] * 10}))
+        z2 = z1.zip(data.range(5).map(lambda r: {"id": r["id"] * 100}))
+        row = z2.take(1)[0]
+        assert set(row) == {"id", "id_1", "id_2"}
+        assert row["id_1"] == row["id"] * 10
+        assert row["id_2"] == row["id"] * 100
