@@ -28,6 +28,14 @@ from .iterator import DataShard, _iter_batches_from_blocks
 from .plan import AllToAllOp, MapOp, SourceOp, build_segments
 
 
+def _block_rows(block: Block) -> int:
+    return block_num_rows(block)
+
+
+# tiny metadata task: count a block's rows where it lives (no transfer)
+_num_rows_remote = ray_tpu.remote(_block_rows)
+
+
 @dataclass
 class ActorPoolStrategy:
     """compute= strategy running the UDF on a pool of actors (ref:
@@ -45,6 +53,13 @@ class Dataset:
     # -- transforms (lazy) ---------------------------------------------------
 
     def _with(self, op) -> "Dataset":
+        if getattr(self, "_limit", None) is not None:
+            # limit() then transform: the transform must see only the
+            # truncated rows (ds.limit(3).flat_map(f) maps 3 rows, not
+            # all). A deferred thunk source applies the limit when the
+            # derived plan executes, keeping the chain lazy.
+            src = SourceOp(thunk=self._execute_refs, name="limited")
+            return Dataset([src, op], self._ctx)
         return Dataset(self._ops + [op], self._ctx)
 
     def map_batches(self, fn: Union[Callable, type], *,
@@ -306,18 +321,20 @@ class Dataset:
         if limit is not None:
             # ref-path consumers (materialize, union/zip/split thunks,
             # to_arrow_refs) must see the truncation too, not just the
-            # block-stream path
+            # block-stream path. Row counts come from tiny remote tasks
+            # so whole-kept blocks never travel to the driver; only the
+            # one straddling block is fetched and re-put sliced.
             from .block import block_slice
 
+            counts = ray_tpu.get(
+                [_num_rows_remote.remote(r) for r in refs], timeout=600)
             kept, seen = [], 0
-            for r in refs:
+            for r, n in zip(refs, counts):
                 if seen >= limit:
                     break
-                b = ray_tpu.get(r)
-                n = block_num_rows(b)
                 take = min(n, limit - seen)
-                kept.append(r if take == n
-                            else ray_tpu.put(block_slice(b, 0, take)))
+                kept.append(r if take == n else ray_tpu.put(
+                    block_slice(ray_tpu.get(r), 0, take)))
                 seen += take
             refs = kept
         return refs
@@ -578,11 +595,11 @@ class Dataset:
         if src.read_fns is None and src.refs is None \
                 and src.thunk is not None:
             # deferred source (union/zip/split): block count is only
-            # knowable by running the upstream plans — execute once and
-            # cache the refs on the op so repeated metadata calls don't
-            # re-execute
-            src.refs = list(src.thunk())
-            src.thunk = None
+            # knowable by running the upstream plans. Executed LOCALLY —
+            # mutating the shared SourceOp here would silently freeze
+            # one execution's blocks into every derived view (an
+            # unseeded shuffle upstream would stop reshuffling)
+            return len(list(src.thunk()))
         n = len(src.read_fns) if src.read_fns is not None else len(src.refs or [])
         for op in self._ops[1:]:
             if isinstance(op, AllToAllOp) and op.kind == "repartition":

@@ -146,10 +146,10 @@ def window_dataset(ds, *, blocks_per_window: int = 10) -> DatasetPipeline:
     if src.read_fns is None and src.refs is None \
             and getattr(src, "thunk", None) is not None:
         # deferred source (union/zip/split view): windowing needs a
-        # concrete block list — run the upstream plans once and window
-        # over the resulting refs
-        src.refs = list(src.thunk())
-        src.thunk = None
+        # concrete block list — run the upstream plans once, into a
+        # LOCAL SourceOp (mutating the shared op would freeze these
+        # blocks into every other derived view of `ds`)
+        src = SourceOp(refs=list(src.thunk()), name=src.name)
     items = src.read_fns if src.read_fns is not None else src.refs
     use_fns = src.read_fns is not None
     nwin = max(1, math.ceil(len(items) / blocks_per_window))

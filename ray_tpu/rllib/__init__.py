@@ -58,6 +58,8 @@ _EXPORTS = {
     "ES": "es", "ESConfig": "es", "ESWorker": "es",
     "ARS": "ars", "ARSConfig": "ars", "ARSWorker": "ars",
     "A2C": "a2c", "A2CConfig": "a2c", "A2CLearner": "a2c",
+    "PGConfig": "a2c",
+    "CRR": "crr", "CRRConfig": "crr",
     "TD3": "td3", "TD3Config": "td3", "DDPGConfig": "td3",
     "TD3Learner": "td3",
     "Bandit": "bandit", "BanditConfig": "bandit",

@@ -57,6 +57,17 @@ class A2CConfig:
         return A2C(self)
 
 
+def PGConfig(**kw) -> A2CConfig:  # noqa: N802 — ref naming
+    """Vanilla policy gradient / REINFORCE (ref: rllib/algorithms/pg/
+    pg.py — the reference implements PG as the minimal policy-gradient
+    loss; here that is A2C with the critic's loss weight zeroed and
+    Monte-Carlo returns, the same reduction DDPGConfig makes over
+    TD3)."""
+    kw.setdefault("vf_loss_coeff", 0.0)
+    kw.setdefault("lam", 1.0)
+    return A2CConfig(**kw)
+
+
 class A2CLearner:
     """One jitted grad-accumulate + apply per update()."""
 

@@ -1,0 +1,232 @@
+"""CRR — critic-regularized regression for offline RL (discrete).
+
+ref: rllib/algorithms/crr/crr.py (+ torch policy; Wang et al. 2020).
+The actor is trained by ADVANTAGE-WEIGHTED behavior cloning
+
+    L_pi = -E[(f(A(s, a_data)) * log pi(a_data | s)]
+    f = exp(A / beta) clipped (the "exp" mode) or 1[A > 0] ("binary")
+
+with the advantage measured by a learned Q critic under the CURRENT
+policy, A(s,a) = Q(s,a) - E_{a'~pi} Q(s,a'); the critic trains by
+expected-SARSA TD against a periodically synced target. Where MARWIL
+weights imitation by Monte-Carlo advantage against a V baseline, CRR's
+Q-critic weighting is the off-policy-correct version — the distinction
+the reference keeps both algorithms for.
+
+House TPU shape (the CQL recipe): dataset loads once, the whole
+per-iteration block — K minibatches of critic TD + weighted-BC actor,
+target sync inside the scan via lax.cond — is ONE jitted dispatch.
+Consumes the rllib.offline experience JSONL format unchanged.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .cql import _episodes_to_transitions
+from .env import make_env
+from .offline import read_experiences
+from .td3 import _mlp_init
+
+
+@dataclass
+class CRRConfig:
+    """ref: crr.py CRRConfig (weight_type exp/bin, temperature beta,
+    max_weight clip)."""
+    input_paths: Any = None
+    episodes: Optional[List[Dict[str, np.ndarray]]] = None
+    env: str = "CartPole-v1"          # for evaluate()
+    gamma: float = 0.99
+    actor_lr: float = 3e-4
+    critic_lr: float = 3e-4
+    beta: float = 1.0                 # exp-weight temperature
+    weight_mode: str = "exp"          # "exp" | "binary"
+    max_weight: float = 20.0
+    train_batch_size: int = 256
+    num_updates_per_iter: int = 200
+    target_update_freq: int = 100     # in updates, inside the scan
+    hidden: tuple = (128, 128)
+    seed: int = 0
+    evaluation_num_episodes: int = 8
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def build(self) -> "CRR":
+        return CRR(self)
+
+
+class CRR:
+    """Tune-trainable offline learner; evaluate() rolls the greedy actor
+    in the (held-out) environment."""
+
+    def __init__(self, config: CRRConfig):
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.config = c = config
+        episodes = (c.episodes if c.episodes is not None
+                    else read_experiences(c.input_paths))
+        if not episodes:
+            raise ValueError("CRR needs offline data: pass episodes or "
+                             "input_paths with at least one episode")
+        self._data = _episodes_to_transitions(episodes)
+        # the dataset's behavior policy may never have taken some
+        # actions — the ENV defines the action space (the cql.py guard)
+        env_actions = make_env(c.env, num_envs=1, seed=0).num_actions
+        self._num_actions = max(int(self._data["actions"].max()) + 1,
+                                env_actions)
+        obs_dim = self._data["obs"].shape[1]
+        A = self._num_actions
+
+        ka, kq = jax.random.split(jax.random.PRNGKey(c.seed))
+        self.actor = _mlp_init(ka, (obs_dim, *c.hidden), A)
+        self.critic = _mlp_init(kq, (obs_dim, *c.hidden), A)
+        self.target = jax.tree.map(lambda a: a.copy(), self.critic)
+        self.opt_actor = optax.adam(c.actor_lr)
+        self.opt_critic = optax.adam(c.critic_lr)
+        self.s_actor = self.opt_actor.init(self.actor)
+        self.s_critic = self.opt_critic.init(self.critic)
+        self._rng = np.random.default_rng(c.seed)
+        self._iteration = 0
+        self.num_updates = 0
+
+        from .sac import _mlp_forward as mlp
+
+        def critic_loss(critic, target, actor, mb):
+            pi_next = jax.nn.softmax(mlp(actor, mb["next_obs"]))
+            q_next = mlp(target, mb["next_obs"])
+            v_next = jnp.sum(pi_next * q_next, axis=1)  # expected SARSA
+            y = mb["rewards"] + c.gamma * (1.0 - mb["dones"]) \
+                * jax.lax.stop_gradient(v_next)
+            q_sa = jnp.take_along_axis(
+                mlp(critic, mb["obs"]),
+                mb["actions"][:, None].astype(jnp.int32), axis=1)[:, 0]
+            return jnp.mean((q_sa - y) ** 2)
+
+        def actor_loss(actor, critic, mb):
+            logits = mlp(actor, mb["obs"])
+            logp = jax.nn.log_softmax(logits)
+            lp_a = jnp.take_along_axis(
+                logp, mb["actions"][:, None].astype(jnp.int32),
+                axis=1)[:, 0]
+            q = mlp(critic, mb["obs"])
+            q_sa = jnp.take_along_axis(
+                q, mb["actions"][:, None].astype(jnp.int32), axis=1)[:, 0]
+            v = jnp.sum(jax.nn.softmax(logits) * q, axis=1)
+            adv = jax.lax.stop_gradient(q_sa - v)
+            if c.weight_mode == "binary":
+                w = (adv > 0).astype(jnp.float32)
+            else:
+                w = jnp.minimum(jnp.exp(adv / c.beta), c.max_weight)
+            w = jax.lax.stop_gradient(w)
+            return -jnp.mean(w * lp_a), jnp.mean(adv)
+
+        def one_update(carry, xs):
+            actor, critic, target, s_a, s_c, step_i = carry
+            mb = xs
+            closs, cg = jax.value_and_grad(critic_loss)(
+                critic, target, actor, mb)
+            cu, s_c = self.opt_critic.update(cg, s_c, critic)
+            critic = optax.apply_updates(critic, cu)
+            (aloss, adv), ag = jax.value_and_grad(
+                actor_loss, has_aux=True)(actor, critic, mb)
+            au, s_a = self.opt_actor.update(ag, s_a, actor)
+            actor = optax.apply_updates(actor, au)
+            step_i = step_i + 1
+            target = jax.lax.cond(
+                step_i % c.target_update_freq == 0,
+                lambda _: jax.tree.map(lambda x: x.copy(), critic),
+                lambda t: t, target)
+            return (actor, critic, target, s_a, s_c, step_i), \
+                {"critic_loss": closs, "actor_loss": aloss,
+                 "mean_advantage": adv}
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
+        def update_many(actor, critic, target, s_a, s_c, step_i, mbs):
+            (actor, critic, target, s_a, s_c, step_i), stats = \
+                jax.lax.scan(one_update,
+                             (actor, critic, target, s_a, s_c, step_i),
+                             mbs)
+            return actor, critic, target, s_a, s_c, step_i, \
+                jax.tree.map(jnp.mean, stats)
+
+        self._update_many = update_many
+
+    def train(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        c = self.config
+        t0 = time.monotonic()
+        n = len(self._data["rewards"])
+        K, B = c.num_updates_per_iter, min(c.train_batch_size, n)
+        idx = self._rng.integers(0, n, size=(K, B))
+        mbs = {k: jnp.asarray(v[idx]) for k, v in self._data.items()}
+        (self.actor, self.critic, self.target, self.s_actor,
+         self.s_critic, step_i, stats) = self._update_many(
+            self.actor, self.critic, self.target, self.s_actor,
+            self.s_critic, jnp.asarray(self.num_updates), mbs)
+        self.num_updates = int(step_i)
+        self._iteration += 1
+        return {"training_iteration": self._iteration,
+                "num_updates": self.num_updates,
+                "num_transitions": n,
+                "train_time_s": time.monotonic() - t0,
+                **{k: float(v)
+                   for k, v in jax.device_get(stats).items()}}
+
+    def evaluate(self, num_episodes: Optional[int] = None,
+                 seed: int = 123) -> Dict[str, float]:
+        import jax
+
+        c = self.config
+        n_eps = num_episodes or c.evaluation_num_episodes
+        env = make_env(c.env, num_envs=4, seed=seed)
+        from .td3 import _mlp_np
+
+        p = jax.device_get(self.actor)
+        obs = env.reset(seed=seed)
+        ep_ret = np.zeros(env.num_envs)
+        done_rets: List[float] = []
+        while len(done_rets) < n_eps:
+            logits = _mlp_np(p, obs.astype(np.float32))
+            obs, r, done, _ = env.step(logits.argmax(axis=1))
+            ep_ret += r
+            for i in np.nonzero(done)[0]:
+                done_rets.append(float(ep_ret[i]))
+                ep_ret[i] = 0.0
+        return {"episode_reward_mean": float(np.mean(done_rets[:n_eps])),
+                "episodes": n_eps}
+
+    # -- Tune-trainable surface ------------------------------------------
+
+    def save(self) -> Dict:
+        import jax
+
+        return {"actor": jax.device_get(self.actor),
+                "critic": jax.device_get(self.critic),
+                "target": jax.device_get(self.target),
+                "opt": jax.device_get((self.s_actor, self.s_critic)),
+                "iteration": self._iteration,
+                "num_updates": self.num_updates}
+
+    def restore(self, ckpt: Dict) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        as_jnp = lambda t: jax.tree.map(jnp.asarray, t)  # noqa: E731
+        self.actor = as_jnp(ckpt["actor"])
+        self.critic = as_jnp(ckpt["critic"])
+        self.target = as_jnp(ckpt["target"])
+        if "opt" in ckpt:
+            self.s_actor, self.s_critic = as_jnp(ckpt["opt"])
+        self._iteration = int(ckpt.get("iteration", 0))
+        self.num_updates = int(ckpt.get("num_updates", 0))
+
+    def stop(self) -> None:
+        pass  # offline: no workers

@@ -156,7 +156,11 @@ class DT:
         import optax
 
         self.config = c = config
-        episodes = c.episodes or read_experiences(c.input_paths)
+        episodes = (c.episodes if c.episodes is not None
+                    else read_experiences(c.input_paths))
+        if not episodes:
+            raise ValueError("DT needs offline data: pass episodes or "
+                             "input_paths with at least one episode")
         # per-episode arrays + undiscounted return-to-go suffix sums
         self._eps = []
         for ep in episodes:
@@ -166,8 +170,13 @@ class DT:
                 "obs": np.asarray(ep["obs"], np.float32),
                 "actions": np.asarray(ep["actions"], np.int64),
                 "rtg": rtg})
-        self._num_actions = int(max(int(e["actions"].max())
-                                    for e in self._eps)) + 1
+        # env floor: the behavior policy may never have taken some
+        # actions (the cql.py num_actions guard)
+        probe = (c.env_creator(num_envs=1, seed=0) if c.env_creator
+                 else make_env(c.env, num_envs=1, seed=0))
+        self._num_actions = max(
+            int(max(int(e["actions"].max()) for e in self._eps)) + 1,
+            probe.num_actions)
         self._obs_dim = self._eps[0]["obs"].shape[1]
         self.params = init_dt_params(
             jax.random.PRNGKey(c.seed), self._obs_dim, self._num_actions,

@@ -147,7 +147,12 @@ class MARWIL:
         import optax
 
         self.config = c = config
-        episodes = c.episodes or read_experiences(c.input_paths)
+        episodes = (c.episodes if c.episodes is not None
+                    else read_experiences(c.input_paths))
+        if not episodes:
+            raise ValueError("MARWIL/BC needs offline data: pass "
+                             "episodes or input_paths with at least one "
+                             "episode")
         # flatten episodes into transitions with discounted returns
         obs, acts, rets = [], [], []
         for ep in episodes:
@@ -169,7 +174,12 @@ class MARWIL:
         self._ret_mean = float(rets_all.mean())
         self._ret_std = float(rets_all.std() + 1e-8)
         self._rets = (rets_all - self._ret_mean) / self._ret_std
-        self._num_actions = int(self._acts.max()) + 1
+        # env floor: the behavior policy may never have taken some
+        # actions (the cql.py num_actions guard)
+        probe = (c.env_creator(num_envs=1, seed=0) if c.env_creator
+                 else make_env(c.env, num_envs=1, seed=0))
+        self._num_actions = max(int(self._acts.max()) + 1,
+                                probe.num_actions)
         obs_shape = self._obs.shape[1:]
         self.params = init_policy_params(
             jax.random.PRNGKey(c.seed),

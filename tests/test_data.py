@@ -811,3 +811,12 @@ class TestRound5DatasetOps:
         assert set(row) == {"id", "id_1", "id_2"}
         assert row["id_1"] == row["id"] * 10
         assert row["id_2"] == row["id"] * 100
+
+    def test_limit_then_transform(self, cluster):
+        import ray_tpu.data as data
+
+        # the transform must see only the truncated rows
+        out = data.range(10).limit(3).flat_map(lambda x: [x, x])
+        assert out.count() == 6
+        assert data.range(10).limit(3).map(
+            lambda r: {"id": r["id"]}).count() == 3
