@@ -24,6 +24,8 @@ _EXPORTS = {
     "DT": "dt", "DTConfig": "dt",
     "Dreamer": "dreamer", "DreamerConfig": "dreamer",
     "DreamerLearner": "dreamer",
+    "MAML": "maml", "MAMLConfig": "maml",
+    "PointGoalVecEnv": "maml", "sample_point_goal": "maml",
     "AlphaZero": "alpha_zero", "AlphaZeroConfig": "alpha_zero",
     "TicTacToe": "alpha_zero", "register_game": "alpha_zero",
     "mcts_policy": "alpha_zero",

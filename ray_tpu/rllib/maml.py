@@ -1,0 +1,426 @@
+"""MAML — model-agnostic meta-learning for RL (Finn et al. 2017).
+
+ref: rllib/algorithms/maml/maml.py (+ maml_torch_policy.py: inner
+adaptation on per-task rollouts, outer meta-update through the
+adaptation step; the reference needs a TensorFlow tape / torch
+higher-order machinery for d theta'/d theta — in jax the meta-gradient
+is literally `jax.grad` composed over an inner `jax.grad`, vmapped over
+tasks, which is the cleanest argument in this repo for the functional
+compute stack).
+
+Loop shape (the reference's, on this runtime's actor plane):
+  1. per-task rollout workers sample pre-adaptation trajectories with
+     the meta-parameters theta;
+  2. the learner computes EVERY task's adapted parameters
+     theta_i' = theta - alpha * grad L_inner(theta; tau_i) in one
+     vmapped jitted call;
+  3. workers sample post-adaptation trajectories with their theta_i';
+  4. the learner takes the meta-step
+     theta <- theta - beta * grad_theta mean_i L_outer(theta_i'(theta);
+     tau_i') — second-order by construction (jax traces through the
+     inner update; first_order=True stops those gradients for the
+     FOMAML variant).
+
+Task family: PointGoalVecEnv — 2D point agent, per-task goal, reward
+-dist(pos, goal): the canonical MAML-RL probe (Finn et al. 5.2).
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import cloudpickle
+import numpy as np
+
+import ray_tpu
+
+from .rollout_worker import worker_opts
+
+
+class PointGoalVecEnv:
+    """2D point navigation, vectorized; the TASK is the goal position.
+    obs = position (2,), action = velocity in [-0.1, 0.1]^2, reward =
+    -||pos - goal||; 20-step episodes from the origin."""
+
+    EPISODE_LEN = 20
+    STEP = 0.1
+
+    continuous = True
+    action_dim = 2
+    action_low = -1.0
+    action_high = 1.0
+
+    def __init__(self, num_envs: int = 8, seed: int = 0,
+                 goal: Tuple[float, float] = (0.5, 0.5)):
+        self.num_envs = num_envs
+        self.obs_dim = 2
+        self.num_actions = 0
+        self.goal = np.asarray(goal, np.float64)
+        self._rng = np.random.default_rng(seed)
+        self._pos = np.zeros((num_envs, 2))
+        self._t = np.zeros(num_envs, np.int64)
+
+    def set_task(self, goal) -> None:
+        self.goal = np.asarray(goal, np.float64)
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._pos[:] = 0.0
+        self._t[:] = 0
+        return self._pos.astype(np.float32)
+
+    def step(self, actions: np.ndarray):
+        a = np.clip(np.asarray(actions, np.float64), -1, 1) * self.STEP
+        self._pos = self._pos + a
+        self._t += 1
+        reward = -np.linalg.norm(self._pos - self.goal,
+                                 axis=1).astype(np.float32)
+        done = self._t >= self.EPISODE_LEN
+        info: Dict[str, Any] = {}
+        if done.any():
+            info["truncated"] = done.copy()
+            info["final_obs"] = self._pos.astype(np.float32)
+            idx = np.nonzero(done)[0]
+            self._pos[idx] = 0.0
+            self._t[idx] = 0
+        return self._pos.astype(np.float32), reward, done, info
+
+
+def sample_point_goal(rng: np.random.Generator) -> Tuple[float, float]:
+    """Goals on the unit half-circle (ref: the point-robot task
+    distribution in the MAML paper's experiments)."""
+    ang = rng.uniform(0, np.pi)
+    r = rng.uniform(0.3, 0.7)
+    return (float(r * np.cos(ang)), float(r * np.sin(ang)))
+
+
+def _policy_init(rng, obs_dim: int, action_dim: int,
+                 hidden: Tuple[int, ...]):
+    import jax
+    import jax.numpy as jnp
+
+    p = {}
+    last = obs_dim
+    ks = jax.random.split(rng, len(hidden) + 1)
+    for i, h in enumerate(hidden):
+        p[f"w{i}"] = jax.random.normal(ks[i], (last, h),
+                                       jnp.float32) * np.sqrt(2.0 / last)
+        p[f"b{i}"] = jnp.zeros((h,), jnp.float32)
+        last = h
+    p["w_mu"] = jax.random.normal(ks[-1], (last, action_dim),
+                                  jnp.float32) * 0.01
+    p["b_mu"] = jnp.zeros((action_dim,), jnp.float32)
+    p["log_std"] = jnp.full((action_dim,), -0.7, jnp.float32)
+    return p
+
+
+def _mu_np(p: Dict[str, np.ndarray], x: np.ndarray) -> np.ndarray:
+    i = 0
+    while f"w{i}" in p:
+        x = np.tanh(x @ p[f"w{i}"] + p[f"b{i}"])
+        i += 1
+    return x @ p["w_mu"] + p["b_mu"]
+
+
+class MAMLTaskWorker:
+    """One actor = one task: holds the env, resamples its task on
+    request, and collects full-episode batches with given parameters
+    (Gaussian policy, actions sampled worker-side)."""
+
+    def __init__(self, num_envs: int, episodes_per_rollout: int,
+                 seed: int = 0, env_creator=None,
+                 task_sampler=None):
+        self._rng = np.random.default_rng(seed)
+        if env_creator is not None:
+            self.env = cloudpickle.loads(env_creator)(
+                num_envs=num_envs, seed=seed)
+        else:
+            self.env = PointGoalVecEnv(num_envs=num_envs, seed=seed)
+        self._task_sampler = (cloudpickle.loads(task_sampler)
+                              if task_sampler else sample_point_goal)
+        self.episodes_per_rollout = episodes_per_rollout
+
+    def resample_task(self) -> Any:
+        task = self._task_sampler(self._rng)
+        self.env.set_task(task)
+        return task
+
+    def set_task(self, task) -> Any:
+        self.env.set_task(task)
+        return task
+
+    def rollout(self, params: Dict) -> Dict[str, np.ndarray]:
+        """-> [n_episodes, T, ...] arrays (full fixed-length episodes —
+        the inner/outer losses need per-episode reward-to-go)."""
+        p = {k: np.asarray(v, np.float32) for k, v in params.items()}
+        std = np.exp(p["log_std"])
+        env = self.env
+        T = env.EPISODE_LEN
+        rounds = self.episodes_per_rollout
+        n = env.num_envs
+        obs_b = np.empty((rounds, T, n, env.obs_dim), np.float32)
+        act_b = np.empty((rounds, T, n, env.action_dim), np.float32)
+        rew_b = np.empty((rounds, T, n), np.float32)
+        for e in range(rounds):
+            obs = env.reset()
+            for t in range(T):
+                mu = _mu_np(p, obs)
+                a = mu + self._rng.normal(0, 1, mu.shape) * std
+                obs_b[e, t], act_b[e, t] = obs, a
+                obs, r, done, _ = env.step(a)
+                rew_b[e, t] = r
+        # [rounds, T, n, ...] -> [rounds*n episodes, T, ...]
+        def eps(x):
+            return np.swapaxes(x, 1, 2).reshape(rounds * n, T,
+                                                *x.shape[3:])
+
+        return {"obs": eps(obs_b), "actions": eps(act_b),
+                "rewards": eps(rew_b)}
+
+
+@dataclass
+class MAMLConfig:
+    """ref: maml.py MAMLConfig (inner_adaptation_steps=1, inner_lr,
+    maml_optimizer_stepsize, rollout_fragment_length per task)."""
+    num_tasks: int = 4                # parallel task workers
+    num_envs_per_worker: int = 8
+    episodes_per_rollout: int = 2     # episodes per env per phase
+    inner_lr: float = 0.1             # alpha
+    outer_lr: float = 1e-3            # beta (meta Adam)
+    gamma: float = 0.99
+    first_order: bool = False         # FOMAML when True
+    hidden: tuple = (64, 64)
+    env_creator: Optional[Callable] = None
+    task_sampler: Optional[Callable] = None
+    seed: int = 0
+    worker_resources: Dict[str, float] = field(default_factory=dict)
+
+    def build(self) -> "MAML":
+        return MAML(self)
+
+
+class MAMLLearner:
+    """adapt(): vmapped inner updates; meta_update(): grad through
+    them. Both single jitted dispatches."""
+
+    def __init__(self, obs_dim: int, action_dim: int, c: MAMLConfig):
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.params = _policy_init(jax.random.PRNGKey(c.seed), obs_dim,
+                                   action_dim, tuple(c.hidden))
+        self.optimizer = optax.adam(c.outer_lr)
+        self.opt_state = self.optimizer.init(self.params)
+
+        def mu_fn(p, x):
+            i = 0
+            while f"w{i}" in p:
+                x = jnp.tanh(x @ p[f"w{i}"] + p[f"b{i}"])
+                i += 1
+            return x @ p["w_mu"] + p["b_mu"]
+
+        def pg_loss(p, batch):
+            """REINFORCE with discounted reward-to-go, episodes
+            [E, T, ...] (ref: maml policy's surrogate)."""
+            obs, acts, rews = (batch["obs"], batch["actions"],
+                               batch["rewards"])
+            mu = mu_fn(p, obs)
+            std = jnp.exp(p["log_std"])
+            logp = -0.5 * jnp.sum(
+                ((acts - mu) / std) ** 2
+                + 2 * p["log_std"] + jnp.log(2 * jnp.pi), axis=-1)
+            # discounted rewards-to-go along T
+            def disc(carry, r):
+                g = r + c.gamma * carry
+                return g, g
+
+            _, rtg = jax.lax.scan(disc, jnp.zeros(rews.shape[0]),
+                                  rews.swapaxes(0, 1)[::-1])
+            rtg = rtg[::-1].swapaxes(0, 1)            # [E, T]
+            # per-TIMESTEP baseline: rtg is dominated by how many steps
+            # remain, so a global mean would turn the advantage into a
+            # time ramp that drowns the action signal (the role of the
+            # reference MAML's fitted linear-feature baseline)
+            base = rtg.mean(axis=0, keepdims=True)    # [1, T]
+            adv = (rtg - base) / (rtg.std() + 1e-8)
+            return -jnp.mean(logp * jax.lax.stop_gradient(adv))
+
+        def adapt_one(theta, batch):
+            g = jax.grad(pg_loss)(theta, batch)
+            # clip the inner gradient: a raw REINFORCE step at
+            # inner_lr=0.1 sends log_std to overflow within a few
+            # compounded adaptations (measured)
+            norm = jnp.sqrt(sum(jnp.sum(x * x)
+                                for x in jax.tree.leaves(g)))
+            scale = jnp.minimum(1.0, 1.0 / (norm + 1e-8))
+            theta = jax.tree.map(
+                lambda p, gg: p - c.inner_lr * scale * gg, theta, g)
+            return {**theta,
+                    "log_std": jnp.clip(theta["log_std"], -3.0, 0.5)}
+
+        @jax.jit
+        def adapt(theta, batches):
+            """batches: [num_tasks, ...] stacked -> per-task theta'."""
+            return jax.vmap(lambda b: adapt_one(theta, b))(batches)
+
+        def meta_loss(theta, pre_batches, post_batches):
+            def per_task(pre, post):
+                theta_i = adapt_one(theta, pre)
+                if c.first_order:
+                    theta_i = jax.lax.stop_gradient(theta_i)
+                return pg_loss(theta_i, post)
+
+            return jnp.mean(jax.vmap(per_task)(pre_batches,
+                                               post_batches))
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def meta_update(theta, opt_state, pre_batches, post_batches):
+            loss, grads = jax.value_and_grad(meta_loss)(
+                theta, pre_batches, post_batches)
+            updates, opt_state = self.optimizer.update(grads, opt_state)
+            theta = optax.apply_updates(theta, updates)
+            return theta, opt_state, loss
+
+        self._adapt = adapt
+        self._meta_update = meta_update
+        self._pg_loss = pg_loss
+
+    def adapt(self, pre_batches: Dict[str, np.ndarray],
+              params: Optional[Dict] = None) -> List[Dict]:
+        """Per-task inner updates from `params` (default: the
+        meta-parameters) — multi-step adaptation passes the previous
+        step's adapted params back in."""
+        import jax
+        import jax.numpy as jnp
+
+        theta = (self.params if params is None
+                 else jax.tree.map(jnp.asarray, params))
+        stacked = {k: jnp.asarray(v) for k, v in pre_batches.items()}
+        thetas = self._adapt(theta, stacked)
+        thetas_np = jax.device_get(thetas)
+        n = next(iter(thetas_np.values())).shape[0]
+        return [{k: v[i] for k, v in thetas_np.items()}
+                for i in range(n)]
+
+    def meta_update(self, pre_batches, post_batches) -> float:
+        import jax.numpy as jnp
+
+        pre = {k: jnp.asarray(v) for k, v in pre_batches.items()}
+        post = {k: jnp.asarray(v) for k, v in post_batches.items()}
+        self.params, self.opt_state, loss = self._meta_update(
+            self.params, self.opt_state, pre, post)
+        return float(loss)
+
+
+class MAML:
+    """Tune-trainable MAML driver over task-worker actors."""
+
+    def __init__(self, config: MAMLConfig):
+        self.config = c = config
+        env_blob = (cloudpickle.dumps(c.env_creator)
+                    if c.env_creator else None)
+        task_blob = (cloudpickle.dumps(c.task_sampler)
+                     if c.task_sampler else None)
+        cls = ray_tpu.remote(MAMLTaskWorker)
+        opts = worker_opts(c.worker_resources)
+        self.workers = [
+            cls.options(**opts).remote(
+                c.num_envs_per_worker, c.episodes_per_rollout,
+                seed=c.seed + 97 * i, env_creator=env_blob,
+                task_sampler=task_blob)
+            for i in range(c.num_tasks)]
+        probe = (c.env_creator(num_envs=1, seed=0) if c.env_creator
+                 else PointGoalVecEnv(num_envs=1))
+        self.learner = MAMLLearner(probe.obs_dim, probe.action_dim, c)
+        self._iteration = 0
+
+    @staticmethod
+    def _stack(batches: List[Dict[str, np.ndarray]]
+               ) -> Dict[str, np.ndarray]:
+        return {k: np.stack([b[k] for b in batches])
+                for k in batches[0]}
+
+    def train(self) -> Dict[str, Any]:
+        import jax
+
+        t0 = time.monotonic()
+        # new tasks each meta-iteration (ref: maml.py resampling)
+        ray_tpu.get([w.resample_task.remote() for w in self.workers],
+                    timeout=120)
+        theta_ref = ray_tpu.put(jax.device_get(self.learner.params))
+        pre = ray_tpu.get(
+            [w.rollout.remote(theta_ref) for w in self.workers],
+            timeout=600)
+        pre_stacked = self._stack(pre)
+        adapted = self.learner.adapt(pre_stacked)
+        post = ray_tpu.get(
+            [w.rollout.remote(ray_tpu.put(adapted[i]))
+             for i, w in enumerate(self.workers)], timeout=600)
+        post_stacked = self._stack(post)
+        loss = self.learner.meta_update(pre_stacked, post_stacked)
+        self._iteration += 1
+        pre_rew = float(np.mean([b["rewards"].sum(axis=1).mean()
+                                 for b in pre]))
+        post_rew = float(np.mean([b["rewards"].sum(axis=1).mean()
+                                  for b in post]))
+        return {"training_iteration": self._iteration,
+                "meta_loss": loss,
+                "pre_adaptation_reward": pre_rew,
+                "post_adaptation_reward": post_rew,
+                "adaptation_gain": post_rew - pre_rew,
+                "episode_reward_mean": post_rew,
+                "time_this_iter_s": time.monotonic() - t0}
+
+    def adapt_to(self, task, adaptation_steps: int = 1) -> Dict:
+        """Meta-test: adapt the meta-parameters to ONE given task;
+        returns {pre_reward, post_reward, params}."""
+        import jax
+
+        w = self.workers[0]
+        ray_tpu.get(w.set_task.remote(task), timeout=60)
+        theta = jax.device_get(self.learner.params)
+        pre = ray_tpu.get(w.rollout.remote(ray_tpu.put(theta)),
+                          timeout=600)
+        params = theta
+        batch = pre
+        for _ in range(adaptation_steps):
+            # compound: each step adapts from the PREVIOUS step's params
+            params = self.learner.adapt(self._stack([batch]),
+                                        params=params)[0]
+            batch = ray_tpu.get(
+                w.rollout.remote(ray_tpu.put(params)), timeout=600)
+        return {"pre_reward": float(pre["rewards"].sum(axis=1).mean()),
+                "post_reward": float(
+                    batch["rewards"].sum(axis=1).mean()),
+                "params": params}
+
+    # -- Tune-trainable surface ------------------------------------------
+
+    def save(self) -> Dict:
+        import jax
+
+        return {"params": jax.device_get(self.learner.params),
+                "opt_state": jax.device_get(self.learner.opt_state),
+                "iteration": self._iteration}
+
+    def restore(self, ckpt: Dict) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.learner.params = jax.tree.map(jnp.asarray, ckpt["params"])
+        if "opt_state" in ckpt:
+            self.learner.opt_state = jax.tree.map(jnp.asarray,
+                                                  ckpt["opt_state"])
+        self._iteration = int(ckpt.get("iteration", 0))
+
+    def stop(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
