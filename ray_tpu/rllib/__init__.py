@@ -24,6 +24,8 @@ _EXPORTS = {
     "DT": "dt", "DTConfig": "dt",
     "Dreamer": "dreamer", "DreamerConfig": "dreamer",
     "DreamerLearner": "dreamer",
+    "SlateQ": "slateq", "SlateQConfig": "slateq",
+    "InterestEvolutionVecEnv": "slateq",
     "MAML": "maml", "MAMLConfig": "maml",
     "PointGoalVecEnv": "maml", "sample_point_goal": "maml",
     "AlphaZero": "alpha_zero", "AlphaZeroConfig": "alpha_zero",

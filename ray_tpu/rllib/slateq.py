@@ -1,0 +1,472 @@
+"""SlateQ — slate recommendation Q-learning (Ie et al. 2019).
+
+ref: rllib/algorithms/slateq/slateq.py (+ slateq_torch_policy.py:
+per-item Q decomposition under a conditional user choice model,
+myopic/SARSA/QL learning targets; RecSim interest-evolution envs).
+The decomposition: with a multinomial-logit user choice over the slate
+(plus a no-click option),
+
+    Q(s, slate) = sum_{i in slate} P(click i | s, slate) * Q(s, i)
+
+so only per-ITEM Q-values are learned and the combinatorial slate space
+never materializes. Greedy slate selection uses the paper's top-k
+approximation: rank documents by v(s,d) * Q(s,d) (choice score times
+item value).
+
+Ships InterestEvolutionVecEnv — a vectorized numpy reduction of
+RecSim's interest-evolution environment: users hold an interest vector
+over topics, click via multinomial logit on doc-topic affinity, clicked
+docs nudge interests and yield engagement reward; sessions last a fixed
+budget. House TPU shape: numpy choice/rollout in actor workers, one
+fused jitted TD block per train() call over the replay (the DQN
+recipe at slate granularity)."""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import cloudpickle
+import numpy as np
+
+import ray_tpu
+
+from .replay_buffer import ReplayBuffer
+from .rollout_worker import worker_opts
+
+
+class InterestEvolutionVecEnv:
+    """n parallel user sessions. Per step the recommender picks a slate
+    of `slate_size` docs from a fixed `num_docs` corpus; the user
+    clicks one (or none) via multinomial logit over interest·topic
+    affinities; clicks give engagement reward and drift the interest.
+
+    obs = user interest vector [num_topics]; the corpus doc features
+    are static and exposed via `doc_features` ([num_docs, num_topics]).
+    """
+
+    SESSION_LEN = 20
+    CHOICE_SHARPNESS = 5.0   # logit scale of the user choice model
+
+    def __init__(self, num_envs: int = 8, seed: int = 0,
+                 num_docs: int = 20, num_topics: int = 5,
+                 slate_size: int = 3, no_click_mass: float = 1.0):
+        self.num_envs = num_envs
+        self.num_docs = num_docs
+        self.num_topics = num_topics
+        self.slate_size = slate_size
+        self.no_click_mass = no_click_mass
+        self.obs_dim = num_topics
+        self.num_actions = num_docs     # per-ITEM action space
+        self._rng = np.random.default_rng(seed)
+        # static corpus: unit-norm topic mixtures + a quality scalar
+        feats = self._rng.dirichlet(np.ones(num_topics), num_docs)
+        self.doc_features = feats.astype(np.float32)
+        self.doc_quality = self._rng.uniform(
+            0.2, 1.0, num_docs).astype(np.float32)
+        self._interest = np.zeros((num_envs, num_topics))
+        self._t = np.zeros(num_envs, np.int64)
+
+    def _sample_users(self, n: int) -> np.ndarray:
+        u = self._rng.dirichlet(np.ones(self.num_topics), n)
+        return u
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            # offset stream: reusing default_rng(seed) verbatim would
+            # replay the ctor's corpus draws, making every user's
+            # interest EQUAL a doc_features row bit-for-bit
+            self._rng = np.random.default_rng(seed + 0x9E3779B9)
+        self._interest = self._sample_users(self.num_envs)
+        self._t[:] = 0
+        return self._interest.astype(np.float32)
+
+    def choice_probs(self, slates: np.ndarray) -> np.ndarray:
+        """Multinomial logit over the slate + no-click:
+        [n, slate_size + 1] (last column = no click)."""
+        aff = np.einsum("nt,nkt->nk", self._interest,
+                        self.doc_features[slates])     # [n, k]
+        scores = np.exp(aff * self.CHOICE_SHARPNESS)
+        total = scores.sum(axis=1) + self.no_click_mass
+        p = np.concatenate(
+            [scores / total[:, None],
+             (self.no_click_mass / total)[:, None]], axis=1)
+        return p
+
+    def step(self, slates: np.ndarray):
+        """slates: [n, slate_size] doc indices -> (obs, reward, done,
+        info with per-step click column)."""
+        n, k = slates.shape
+        p = self.choice_probs(slates)
+        # sample the click (k = no-click)
+        cdf = p.cumsum(axis=1)
+        u = self._rng.random((n, 1))
+        choice = (u > cdf).sum(axis=1)                  # in [0, k]
+        clicked = choice < k
+        doc = np.where(clicked, slates[np.arange(n),
+                                       np.minimum(choice, k - 1)], -1)
+        reward = np.where(
+            clicked, self.doc_quality[np.maximum(doc, 0)],
+            0.0).astype(np.float32)
+        # interest drift toward the clicked doc's topics
+        drift = np.where(clicked[:, None],
+                         self.doc_features[np.maximum(doc, 0)], 0.0)
+        self._interest = self._interest + 0.1 * drift
+        self._interest /= self._interest.sum(axis=1, keepdims=True)
+        self._t += 1
+        done = self._t >= self.SESSION_LEN
+        info: Dict[str, Any] = {"choice": choice, "clicked_doc": doc}
+        if done.any():
+            info["truncated"] = done.copy()
+            info["final_obs"] = self._interest.astype(np.float32)
+            idx = np.nonzero(done)[0]
+            self._interest[idx] = self._sample_users(len(idx))
+            self._t[idx] = 0
+        return (self._interest.astype(np.float32), reward,
+                done.astype(np.bool_), info)
+
+
+class SlateQRolloutWorker:
+    """Collects slate transitions with epsilon-greedy top-k slates under
+    the current per-item Q (ref: slateq exploration via per-item
+    scores)."""
+
+    def __init__(self, num_envs: int, rollout_len: int, seed: int = 0,
+                 env_creator=None, **env_kw):
+        self._rng = np.random.default_rng(seed + 1)
+        if env_creator is not None:
+            self.env = cloudpickle.loads(env_creator)(
+                num_envs=num_envs, seed=seed)
+        else:
+            self.env = InterestEvolutionVecEnv(num_envs=num_envs,
+                                               seed=seed, **env_kw)
+        self.rollout_len = rollout_len
+        self._obs = self.env.reset(seed=seed)
+        self._ep_return = np.zeros(self.env.num_envs)
+        self._finished: List[float] = []
+
+    def env_info(self) -> dict:
+        e = self.env
+        return {"obs_dim": e.obs_dim, "num_docs": e.num_docs,
+                "slate_size": e.slate_size,
+                "doc_features": e.doc_features,
+                "no_click_mass": e.no_click_mass,
+                "choice_sharpness": getattr(e, "CHOICE_SHARPNESS", 5.0)}
+
+    def episode_returns(self, clear: bool = True) -> List[float]:
+        out = list(self._finished)
+        if clear:
+            self._finished.clear()
+        return out
+
+    def _item_q_np(self, p: Dict, obs: np.ndarray) -> np.ndarray:
+        """Q(s, d) for all docs: MLP on [user_interest, doc_feature]
+        pairs, vectorized over the corpus."""
+        n = len(obs)
+        D = self.env.num_docs
+        x = np.concatenate(
+            [np.repeat(obs, D, axis=0),
+             np.tile(self.env.doc_features, (n, 1))], axis=1)
+        h = x
+        i = 0
+        while f"w{i}" in p:
+            h = np.maximum(h @ p[f"w{i}"] + p[f"b{i}"], 0.0)
+            i += 1
+        return (h @ p["w_out"] + p["b_out"]).reshape(n, D)
+
+    def sample(self, params: Dict, epsilon: float) -> Dict[str, np.ndarray]:
+        p = {k: np.asarray(v, np.float32) for k, v in params.items()}
+        env = self.env
+        T, n, k = self.rollout_len, env.num_envs, env.slate_size
+        obs_b = np.empty((T, n, env.obs_dim), np.float32)
+        slate_b = np.empty((T, n, k), np.int64)
+        choice_b = np.empty((T, n), np.int64)
+        rew_b = np.empty((T, n), np.float32)
+        done_b = np.empty((T, n), np.bool_)
+        next_b = np.empty((T, n, env.obs_dim), np.float32)
+        obs = self._obs
+        for t in range(T):
+            q = self._item_q_np(p, obs)                 # [n, D]
+            # choice-score-weighted ranking (the paper's top-k rule):
+            # v(s,d) ~ exp(5 * interest·topics)
+            aff = obs @ env.doc_features.T
+            sharp = getattr(env, "CHOICE_SHARPNESS", 5.0)
+            score = np.exp(aff * sharp) * q
+            slate = np.argsort(-score, axis=1)[:, :k]
+            explore = self._rng.random(n) < epsilon
+            for i in np.nonzero(explore)[0]:
+                slate[i] = self._rng.choice(env.num_docs, k,
+                                            replace=False)
+            obs_b[t], slate_b[t] = obs, slate
+            obs, reward, done, info = env.step(slate)
+            choice_b[t], rew_b[t], done_b[t] = (info["choice"], reward,
+                                                done)
+            next_b[t] = obs
+            if done.any():
+                idx = np.nonzero(done)[0]
+                if "final_obs" in info:
+                    next_b[t, idx] = info["final_obs"][idx]
+                if "truncated" in info:
+                    done_b[t] &= ~info["truncated"]
+            self._ep_return += reward
+            for i in np.nonzero(done)[0]:
+                self._finished.append(float(self._ep_return[i]))
+                self._ep_return[i] = 0.0
+        self._obs = obs
+        flat = lambda a: a.reshape(T * n, *a.shape[2:])  # noqa: E731
+        return {"obs": flat(obs_b), "slates": flat(slate_b),
+                "choice": flat(choice_b), "rewards": flat(rew_b),
+                "dones": flat(done_b), "next_obs": flat(next_b)}
+
+
+@dataclass
+class SlateQConfig:
+    """ref: slateq.py SlateQConfig (slate_size, learning target QL,
+    no-click handling)."""
+    num_rollout_workers: int = 2
+    num_envs_per_worker: int = 8
+    rollout_fragment_length: int = 40
+    num_docs: int = 20
+    num_topics: int = 5
+    slate_size: int = 3
+    gamma: float = 0.95
+    lr: float = 1e-3
+    buffer_size: int = 50_000
+    train_batch_size: int = 128
+    num_updates_per_iter: int = 16
+    learning_starts: int = 1_000
+    target_update_freq: int = 100
+    epsilon_initial: float = 1.0
+    epsilon_final: float = 0.05
+    epsilon_decay_steps: int = 8_000
+    hidden: tuple = (64, 64)
+    env_creator: Optional[Callable] = None
+    seed: int = 0
+    checkpoint_replay_buffer: bool = True
+    worker_resources: Dict[str, float] = field(default_factory=dict)
+
+    def build(self) -> "SlateQ":
+        return SlateQ(self)
+
+
+class SlateQLearner:
+    """Fused per-iteration TD on the decomposed slate Q (ref:
+    slateq_torch_policy.py build_slateq_losses, 'QL' target)."""
+
+    def __init__(self, obs_dim: int, doc_features: np.ndarray,
+                 slate_size: int, no_click_mass: float,
+                 choice_sharpness: float, c: SlateQConfig):
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from .td3 import _mlp_init
+
+        D, Tn = doc_features.shape
+        self.params = _mlp_init(jax.random.PRNGKey(c.seed),
+                                (obs_dim + Tn, *c.hidden), 1)
+        self.target = jax.tree.map(lambda a: a.copy(), self.params)
+        self.optimizer = optax.adam(c.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.num_updates = 0
+        feats = jnp.asarray(doc_features)
+        k = slate_size
+        sharp = choice_sharpness
+
+        def mlp(p, x):
+            i = 0
+            while f"w{i}" in p:
+                x = jax.nn.relu(x @ p[f"w{i}"] + p[f"b{i}"])
+                i += 1
+            return x @ p["w_out"] + p["b_out"]
+
+        def item_q(p, obs):
+            """[B, obs] -> [B, D]: Q for every corpus doc."""
+            B = obs.shape[0]
+            x = jnp.concatenate(
+                [jnp.repeat(obs, D, axis=0),
+                 jnp.tile(feats, (B, 1))], axis=1)
+            return mlp(p, x).reshape(B, D)
+
+        def choice_p(obs, slates):
+            aff = jnp.einsum("bt,bkt->bk", obs, feats[slates])
+            sc = jnp.exp(aff * sharp)
+            tot = sc.sum(axis=1) + no_click_mass
+            return sc / tot[:, None]                   # [B, k] click probs
+
+        def slate_value(p, obs):
+            """max_slate Q(s, slate) via the top-k approximation."""
+            q = item_q(p, obs)                          # [B, D]
+            aff = obs @ feats.T
+            score = jnp.exp(aff * sharp) * q
+            top = jax.lax.top_k(score, k)[1]            # [B, k]
+            pc = choice_p(obs, top)
+            q_top = jnp.take_along_axis(q, top, axis=1)
+            return (pc * q_top).sum(axis=1)
+
+        def loss_fn(p, target, mb):
+            # TD on the CLICKED item's Q (no-click steps carry no item
+            # gradient — the decomposition's per-item credit)
+            clicked = mb["choice"] < k
+            doc = jnp.take_along_axis(
+                mb["slates"], jnp.minimum(mb["choice"],
+                                          k - 1)[:, None], axis=1)[:, 0]
+            q_all = item_q(p, mb["obs"])
+            q_sd = jnp.take_along_axis(q_all, doc[:, None], axis=1)[:, 0]
+            v_next = slate_value(target, mb["next_obs"])
+            y = mb["rewards"] + c.gamma \
+                * (1.0 - mb["dones"].astype(jnp.float32)) \
+                * jax.lax.stop_gradient(v_next)
+            w = clicked.astype(jnp.float32)
+            return jnp.sum(w * (q_sd - y) ** 2) / jnp.maximum(
+                w.sum(), 1.0)
+
+        def one_update(carry, mb):
+            p, target, opt_state, step_i = carry
+            loss, g = jax.value_and_grad(loss_fn)(p, target, mb)
+            up, opt_state = self.optimizer.update(g, opt_state)
+            p = optax.apply_updates(p, up)
+            step_i = step_i + 1
+            target = jax.lax.cond(
+                step_i % c.target_update_freq == 0,
+                lambda _: jax.tree.map(lambda x: x.copy(), p),
+                lambda t: t, target)
+            return (p, target, opt_state, step_i), loss
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def update_many(p, target, opt_state, step_i, mbs):
+            (p, target, opt_state, step_i), losses = jax.lax.scan(
+                one_update, (p, target, opt_state, step_i), mbs)
+            return p, target, opt_state, step_i, losses.mean()
+
+        self._update_many = update_many
+
+    def update(self, stacked: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax.numpy as jnp
+
+        mbs = {key: jnp.asarray(v) for key, v in stacked.items()}
+        (self.params, self.target, self.opt_state, step_i,
+         loss) = self._update_many(self.params, self.target,
+                                   self.opt_state,
+                                   jnp.asarray(self.num_updates), mbs)
+        self.num_updates = int(step_i)
+        return {"loss": float(loss)}
+
+    def get_params(self) -> Dict:
+        import jax
+
+        return jax.device_get(self.params)
+
+
+class SlateQ:
+    """Tune-trainable SlateQ driver (DQN shape, slate transitions)."""
+
+    def __init__(self, config: SlateQConfig):
+        self.config = c = config
+        creator_blob = (cloudpickle.dumps(c.env_creator)
+                        if c.env_creator else None)
+        cls = ray_tpu.remote(SlateQRolloutWorker)
+        opts = worker_opts(c.worker_resources)
+        self.workers = [
+            cls.options(**opts).remote(
+                c.num_envs_per_worker, c.rollout_fragment_length,
+                seed=c.seed + 1000 * i, env_creator=creator_blob,
+                num_docs=c.num_docs, num_topics=c.num_topics,
+                slate_size=c.slate_size)
+            for i in range(c.num_rollout_workers)]
+        info = ray_tpu.get(self.workers[0].env_info.remote(), timeout=180)
+        self.learner = SlateQLearner(
+            info["obs_dim"], np.asarray(info["doc_features"]),
+            info["slate_size"], info["no_click_mass"],
+            info["choice_sharpness"], c)
+        self.buffer = ReplayBuffer(c.buffer_size, seed=c.seed)
+        self._iteration = 0
+        self._total_steps = 0
+        self._total_episodes = 0
+        self._recent: List[float] = []
+
+    def _epsilon(self) -> float:
+        c = self.config
+        frac = min(1.0, self._total_steps / max(1, c.epsilon_decay_steps))
+        return c.epsilon_initial + frac * (c.epsilon_final
+                                           - c.epsilon_initial)
+
+    def train(self) -> Dict[str, Any]:
+        c = self.config
+        t0 = time.monotonic()
+        eps = self._epsilon()
+        params_ref = ray_tpu.put(self.learner.get_params())
+        batches = ray_tpu.get(
+            [w.sample.remote(params_ref, eps) for w in self.workers],
+            timeout=300)
+        steps = 0
+        for b in batches:
+            self.buffer.add(b)
+            steps += len(b["rewards"])
+        self._total_steps += steps
+        stats: Dict[str, float] = {}
+        if len(self.buffer) >= c.learning_starts:
+            K, B = c.num_updates_per_iter, c.train_batch_size
+            mb = self.buffer.sample(K * B)
+            stacked = {key: v.reshape(K, B, *v.shape[1:])
+                       for key, v in mb.items()}
+            stats = self.learner.update(stacked)
+        for rets in ray_tpu.get(
+                [w.episode_returns.remote() for w in self.workers],
+                timeout=60):
+            self._recent.extend(rets)
+            self._total_episodes += len(rets)
+        self._recent = self._recent[-100:]
+        self._iteration += 1
+        return {"training_iteration": self._iteration,
+                "timesteps_total": self._total_steps,
+                "timesteps_this_iter": steps,
+                "episode_reward_mean": (float(np.mean(self._recent))
+                                        if self._recent
+                                        else float("nan")),
+                "episodes_total": self._total_episodes,
+                "epsilon": eps,
+                "num_updates": self.learner.num_updates,
+                "time_this_iter_s": time.monotonic() - t0,
+                **stats}
+
+    # -- Tune-trainable surface ------------------------------------------
+
+    def save(self) -> Dict:
+        import jax
+
+        L = self.learner
+        ckpt = {"params": jax.device_get(L.params),
+                "target": jax.device_get(L.target),
+                "opt_state": jax.device_get(L.opt_state),
+                "iteration": self._iteration,
+                "total_steps": self._total_steps,
+                "num_updates": L.num_updates}
+        if self.config.checkpoint_replay_buffer:
+            ckpt["buffer"] = self.buffer.state()
+        return ckpt
+
+    def restore(self, ckpt: Dict) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        as_jnp = lambda t: jax.tree.map(jnp.asarray, t)  # noqa: E731
+        L = self.learner
+        L.params = as_jnp(ckpt["params"])
+        L.target = as_jnp(ckpt["target"])
+        if "opt_state" in ckpt:
+            L.opt_state = as_jnp(ckpt["opt_state"])
+        L.num_updates = int(ckpt.get("num_updates", 0))
+        self._iteration = int(ckpt.get("iteration", 0))
+        self._total_steps = int(ckpt.get("total_steps", 0))
+        if "buffer" in ckpt:
+            self.buffer.restore(ckpt["buffer"])
+
+    def stop(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
