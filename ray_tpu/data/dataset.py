@@ -32,8 +32,11 @@ def _block_rows(block: Block) -> int:
     return block_num_rows(block)
 
 
-# tiny metadata task: count a block's rows where it lives (no transfer)
-_num_rows_remote = ray_tpu.remote(_block_rows)
+def _num_rows_remote():
+    """Tiny metadata task: count a block's rows where it lives (no
+    transfer). Wrapped at call time — the house convention keeps
+    RemoteFunction construction out of import paths."""
+    return ray_tpu.remote(_block_rows)
 
 
 @dataclass
@@ -326,8 +329,9 @@ class Dataset:
             # one straddling block is fetched and re-put sliced.
             from .block import block_slice
 
+            nrows = _num_rows_remote()
             counts = ray_tpu.get(
-                [_num_rows_remote.remote(r) for r in refs], timeout=600)
+                [nrows.remote(r) for r in refs], timeout=600)
             kept, seen = [], 0
             for r, n in zip(refs, counts):
                 if seen >= limit:
