@@ -1,0 +1,127 @@
+"""Remote pdb — debug a worker process over a TCP socket.
+
+ref: python/ray/util/rpdb.py (the reference wires its remote debugger
+through GCS + the `ray debug` CLI; this is the direct-socket reduction:
+the breakpoint prints its address to the worker log, and any `nc`/
+`telnet` session gets a full pdb prompt).
+
+    from ray_tpu.util.rpdb import set_trace
+
+    @ray_tpu.remote
+    def task():
+        set_trace()        # blocks until a debugger client attaches
+
+Then from any shell on the host:  nc 127.0.0.1 <printed port>
+"""
+from __future__ import annotations
+
+import pdb
+import socket
+import sys
+from typing import Optional
+
+
+class _SocketIO:
+    """File-ish adapter over a connected socket for Pdb's stdin/stdout."""
+
+    def __init__(self, conn: socket.socket):
+        self._conn = conn
+        self._rfile = conn.makefile("r", encoding="utf-8")
+        self._wfile = conn.makefile("w", encoding="utf-8")
+
+    def readline(self) -> str:
+        return self._rfile.readline()
+
+    def write(self, data: str) -> int:
+        self._wfile.write(data)
+        return len(data)
+
+    def flush(self) -> None:
+        try:
+            self._wfile.flush()
+        except (BrokenPipeError, OSError):
+            pass
+
+    def close(self) -> None:
+        for f in (self._rfile, self._wfile):
+            try:
+                f.close()
+            except OSError:
+                pass
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+
+
+class RemotePdb(pdb.Pdb):
+    """Pdb bound to a TCP listener; one client per breakpoint hit.
+    __init__ only BINDS (so `addr` is readable before any client
+    exists); interact() blocks in accept() and runs the session."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 quiet: bool = False):
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(1)
+        self.addr = self._listener.getsockname()
+        self._quiet = quiet
+        self._io: Optional[_SocketIO] = None
+
+    def interact(self, frame) -> None:
+        if not self._quiet:
+            print(f"RemotePdb waiting on {self.addr[0]}:{self.addr[1]} "
+                  f"(connect with: nc {self.addr[0]} {self.addr[1]})",
+                  file=sys.stderr, flush=True)
+        conn, _ = self._listener.accept()
+        self._io = _SocketIO(conn)
+        super().__init__(stdin=self._io, stdout=self._io)
+        self.prompt = "(rpdb) "
+        self.set_trace(frame)
+
+    def do_continue(self, arg):
+        out = super().do_continue(arg)
+        if not self.breaks:
+            # no breakpoints pending: the session is over. With
+            # breakpoints set, the socket stays open — the next hit
+            # prompts over the SAME connection
+            self._close()
+        return out
+
+    do_c = do_cont = do_continue
+
+    def do_quit(self, arg):
+        out = super().do_quit(arg)
+        self._close()
+        return out
+
+    do_q = do_exit = do_quit
+
+    def do_EOF(self, arg):  # noqa: N802 — pdb naming
+        """Client disconnected (Ctrl-C on nc, dropped connection):
+        release the sockets instead of leaking them for the worker's
+        lifetime, then quit the session."""
+        self._close()
+        return super().do_quit(arg)
+
+    def _close(self) -> None:
+        if self._io is not None:
+            self._io.close()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+def set_trace(host: str = "127.0.0.1", port: int = 0,
+              quiet: bool = False, frame: Optional[object] = None,
+              _debugger_box: Optional[dict] = None) -> None:
+    """Open a remote pdb session and break at the caller's frame.
+    Blocks until a client connects (nc/telnet). `_debugger_box`, if
+    given, receives the RemotePdb instance before blocking (tests read
+    the bound address from it)."""
+    debugger = RemotePdb(host=host, port=port, quiet=quiet)
+    if _debugger_box is not None:
+        _debugger_box["debugger"] = debugger
+    debugger.interact(frame or sys._getframe().f_back)

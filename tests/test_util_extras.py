@@ -93,3 +93,90 @@ def test_mp_pool_chunksize(cluster):
     with Pool() as pool:
         assert pool.map(_sq, range(10), chunksize=3) == [
             x * x for x in range(10)]
+
+
+class TestJoblibBackend:
+    def test_sklearn_style_parallel_over_tasks(self, cluster):
+        import joblib
+        from joblib import Parallel, delayed
+
+        from ray_tpu.util.joblib_backend import register_ray_tpu
+
+        register_ray_tpu()
+        register_ray_tpu()  # idempotent
+        with joblib.parallel_backend("ray_tpu", n_jobs=4):
+            out = Parallel()(delayed(lambda x: x * x)(i)
+                             for i in range(20))
+        assert out == [i * i for i in range(20)]
+
+    def test_errors_propagate(self, cluster):
+        import joblib
+        from joblib import Parallel, delayed
+
+        from ray_tpu.util.joblib_backend import register_ray_tpu
+
+        def boom(i):
+            if i == 3:
+                raise ValueError("boom-3")
+            return i
+
+        register_ray_tpu()
+        with joblib.parallel_backend("ray_tpu", n_jobs=2):
+            with pytest.raises(Exception, match="boom-3"):
+                Parallel()(delayed(boom)(i) for i in range(6))
+
+    def test_negative_n_jobs_joblib_convention(self, cluster):
+        from joblib import parallel
+
+        from ray_tpu.util.joblib_backend import register_ray_tpu
+
+        register_ray_tpu()
+        b = parallel.BACKENDS["ray_tpu"]()
+        cpus = b._cluster_cpus()
+        assert b.effective_n_jobs(-1) == cpus
+        assert b.effective_n_jobs(-2) == max(1, cpus - 1)
+        assert b.effective_n_jobs(3) == 3
+
+
+class TestRemotePdb:
+    def test_breakpoint_serves_a_session_and_continues(self):
+        import socket
+        import threading
+        import time as _time
+
+        from ray_tpu.util.rpdb import set_trace
+
+        state = {}
+        box = {}
+
+        def target():
+            x = 41
+            set_trace(quiet=True, port=0, _debugger_box=box)
+            state["x_after"] = x + 1
+
+        t = threading.Thread(target=target, daemon=True)
+        t.start()
+        for _ in range(200):
+            if "debugger" in box:
+                break
+            _time.sleep(0.05)
+        host, port = box["debugger"].addr
+        c = socket.create_connection((host, port), timeout=10)
+        c.settimeout(10)
+        f = c.makefile("rw", encoding="utf-8")
+        f.write("p x\n")
+        f.flush()
+        # the pdb prompt must answer with the inspected value
+        got = b""
+        while b"41" not in got:
+            chunk = c.recv(4096)
+            if not chunk:
+                pytest.fail(f"pdb session closed without answering: "
+                            f"{got!r}")
+            got += chunk
+        f.write("c\n")
+        f.flush()
+        t.join(timeout=15)
+        assert not t.is_alive()
+        assert state["x_after"] == 42
+        c.close()
