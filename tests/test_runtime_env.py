@@ -24,14 +24,14 @@ def test_env_vars_per_task_and_isolation(cluster):
 
     with_env = read_env.options(
         runtime_env={"env_vars": {"RTPU_TEST_FLAG": "on"}})
-    assert ray_tpu.get(with_env.remote(), timeout=60) == "on"
+    assert ray_tpu.get(with_env.remote(), timeout=180) == "on"
     # a plain task must NOT land on the dedicated worker
-    assert ray_tpu.get(read_env.remote(), timeout=60) == "<unset>"
+    assert ray_tpu.get(read_env.remote(), timeout=180) == "<unset>"
     # two different envs get two different workers
     other = read_env.options(
         runtime_env={"env_vars": {"RTPU_TEST_FLAG": "other"}})
-    assert ray_tpu.get(other.remote(), timeout=60) == "other"
-    assert ray_tpu.get(with_env.remote(), timeout=60) == "on"
+    assert ray_tpu.get(other.remote(), timeout=180) == "other"
+    assert ray_tpu.get(with_env.remote(), timeout=180) == "on"
 
 
 def test_working_dir_ships_files_and_cwd(cluster, tmp_path):
@@ -47,7 +47,7 @@ def test_working_dir_ships_files_and_cwd(cluster, tmp_path):
         return open("data.txt").read(), helper.VALUE  # cwd == working_dir
 
     task = use_working_dir.options(runtime_env={"working_dir": str(proj)})
-    text, value = ray_tpu.get(task.remote(), timeout=60)
+    text, value = ray_tpu.get(task.remote(), timeout=180)
     assert text == "payload-42" and value == 42
 
 
@@ -63,7 +63,7 @@ def test_py_modules_import_by_name(cluster, tmp_path):
         return mylib.answer()
 
     task = use_module.options(runtime_env={"py_modules": [str(pkg)]})
-    assert ray_tpu.get(task.remote(), timeout=60) == 99
+    assert ray_tpu.get(task.remote(), timeout=180) == 99
 
 
 def test_actor_runtime_env(cluster):
@@ -74,7 +74,7 @@ def test_actor_runtime_env(cluster):
 
     a = EnvActor.options(
         runtime_env={"env_vars": {"RTPU_ACTOR_FLAG": "actor-on"}}).remote()
-    assert ray_tpu.get(a.flag.remote(), timeout=60) == "actor-on"
+    assert ray_tpu.get(a.flag.remote(), timeout=180) == "actor-on"
     ray_tpu.kill(a)
 
 
@@ -85,10 +85,10 @@ def test_nested_task_inherits_env(cluster):
 
     @ray_tpu.remote
     def parent():
-        return ray_tpu.get(child.remote(), timeout=60)
+        return ray_tpu.get(child.remote(), timeout=180)
 
     task = parent.options(runtime_env={"env_vars": {"RTPU_NESTED": "deep"}})
-    assert ray_tpu.get(task.remote(), timeout=120) == "deep"
+    assert ray_tpu.get(task.remote(), timeout=240) == "deep"
 
 
 def test_gated_and_unknown_keys_raise(cluster):
@@ -174,7 +174,7 @@ def test_edited_working_dir_ships_fresh_package(cluster, tmp_path):
 
     env = {"working_dir": str(proj)}
     assert ray_tpu.get(read_version.options(runtime_env=env).remote(),
-                       timeout=60) == "v1"
+                       timeout=180) == "v1"
     (proj / "version.txt").write_text("v2")
     # bump mtime defensively: same-second writes share st_mtime on coarse fs
     st = _os.stat(proj / "version.txt")
@@ -184,7 +184,7 @@ def test_edited_working_dir_ships_fresh_package(cluster, tmp_path):
     # tests drop the memo instead of sleeping
     renv_mod._fp_cache.clear()
     assert ray_tpu.get(read_version.options(runtime_env=env).remote(),
-                       timeout=60) == "v2"
+                       timeout=180) == "v2"
 
 
 def _build_test_wheel(tmp_path, name="rtpu_testpkg", value=41):
@@ -240,7 +240,7 @@ def test_pip_runtime_env_installs_into_venv(cluster, tmp_path):
         except ImportError:
             return "isolated"
 
-    assert ray_tpu.get(plain.remote(), timeout=60) == "isolated"
+    assert ray_tpu.get(plain.remote(), timeout=180) == "isolated"
 
 
 def test_pip_env_validation():
@@ -301,7 +301,7 @@ class TestContainerRuntimeEnv:
             def plain():
                 return 1
 
-            assert ray_tpu.get(plain.remote(), timeout=60) == 1
+            assert ray_tpu.get(plain.remote(), timeout=180) == 1
             assert log.read_text() == before
         finally:
             ray_tpu.shutdown()
@@ -346,13 +346,13 @@ class TestContainerRuntimeEnv:
 
             # fill the pool with plain workers, then let them idle
             assert ray_tpu.get([warm.remote() for _ in range(4)],
-                               timeout=60) == [1] * 4
+                               timeout=180) == [1] * 4
 
             @ray_tpu.remote(runtime_env={"container": "img:x"})
             def inside():
                 return "ran"
 
-            assert ray_tpu.get(inside.remote(), timeout=60) == "ran"
+            assert ray_tpu.get(inside.remote(), timeout=180) == "ran"
         finally:
             ray_tpu.shutdown()
 
