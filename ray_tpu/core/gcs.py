@@ -26,6 +26,7 @@ from collections import defaultdict, deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
+from ..devtools.locks import instrumented_lock
 from .ids import ActorId, JobId, NodeId, PlacementGroupId, TaskId, WorkerId
 from .resources import ResourceSet
 from .task_spec import TaskSpec
@@ -90,7 +91,7 @@ class Pubsub:
 
     def __init__(self):
         self._subs: Dict[str, List[Callable[[Any], None]]] = defaultdict(list)
-        self._lock = threading.Lock()
+        self._lock = instrumented_lock("gcs.pubsub")
 
     def subscribe(self, channel: str, cb: Callable[[Any], None]) -> Callable[[], None]:
         with self._lock:
@@ -117,7 +118,7 @@ class Pubsub:
 
 class Gcs:
     def __init__(self, storage_path: str = ""):
-        self._lock = threading.RLock()
+        self._lock = instrumented_lock("gcs.tables", reentrant=True)
         self.pubsub = Pubsub()
         self._nodes: Dict[NodeId, NodeInfo] = {}
         self._jobs: Dict[JobId, JobInfo] = {}
@@ -131,7 +132,7 @@ class Gcs:
         self.schedule_actor_cb: Optional[Callable[[ActorInfo], None]] = None
         self._dirty = threading.Event()
         self._stop_flusher = threading.Event()
-        self._flush_file_lock = threading.Lock()
+        self._flush_file_lock = instrumented_lock("gcs.flush_file")
         self._event_counts: Dict[str, int] = {}  # monotonic, for /metrics
         if storage_path:
             os.makedirs(storage_path, exist_ok=True)

@@ -25,6 +25,7 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ..devtools.locks import instrumented_lock
 from ..exceptions import WorkerCrashedError
 from .config import Config
 from .gcs import NodeInfo
@@ -99,7 +100,7 @@ class Node:
         )
         self.total_resources.pop("object_store_memory", None)
         self.available.pop("object_store_memory", None)
-        self._lock = threading.RLock()
+        self._lock = instrumented_lock("node", reentrant=True)
         self._workers: Dict[WorkerId, WorkerHandle] = {}
         self._idle: deque = deque()
         # lease backlog bucketed by (demand, pg, env) signature: a burst

@@ -27,6 +27,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from ..devtools.locks import instrumented_lock
 from .config import Config
 from .ids import NodeId, ObjectId, WorkerId
 from .object_store import (make_store, SegmentReader, pull_chunks,
@@ -69,7 +70,7 @@ class NodeAgent:
             min_spilling_size=int(self.config.min_spilling_size),
         )
         self.reader = SegmentReader()
-        self._lock = threading.RLock()
+        self._lock = instrumented_lock("node_agent", reentrant=True)
         self._procs: Dict[WorkerId, subprocess.Popen] = {}
         self._channels: Dict[WorkerId, RpcChannel] = {}
         self._stopped = threading.Event()

@@ -29,6 +29,7 @@ from dataclasses import dataclass, field
 from multiprocessing import shared_memory
 from typing import Dict, Optional
 
+from ..devtools.locks import instrumented_lock
 from ..exceptions import ObjectStoreFullError
 from .ids import NodeId, ObjectId
 from .serialization import SerializedObject
@@ -84,7 +85,7 @@ class PlasmaStore:
         self._capacity = capacity_bytes
         self._min_spilling_size = min_spilling_size
         self._used = 0
-        self._lock = threading.RLock()
+        self._lock = instrumented_lock("object_store", reentrant=True)
         self._partial: Dict[ObjectId, int] = {}  # chunked-push progress
         self._entries: "OrderedDict[ObjectId, _Entry]" = OrderedDict()
         self._spill_dir = spill_dir
@@ -390,7 +391,7 @@ class NativePlasmaStore:
                                       spill_dir.encode() or None,
                                       min_spilling_size)
         self._destroyed = False
-        self._lock = threading.RLock()
+        self._lock = instrumented_lock("object_store.native", reentrant=True)
         self._partial: Dict[ObjectId, int] = {}  # chunked-push progress
 
     def segment_name(self, object_id: ObjectId) -> str:
@@ -624,7 +625,7 @@ class SegmentReader:
 
     def __init__(self):
         self._attached: Dict[str, mmap.mmap] = {}
-        self._lock = threading.Lock()
+        self._lock = instrumented_lock("segment_reader")
 
     def read(self, shm_name: str, size: int) -> memoryview:
         with self._lock:

@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 import cloudpickle
 
 from .. import exceptions as exc
+from ..devtools.locks import instrumented_lock
 from . import serialization
 from .config import Config
 from .gcs import ActorInfo, ActorState, Gcs, JobInfo, NodeInfo
@@ -40,7 +41,7 @@ from .task_manager import ReferenceCounter, TaskManager
 from .task_spec import (ARG_REF, ARG_VALUE, STREAMING_RETURNS,
                         SchedulingStrategy, TaskSpec, TaskType)
 
-_runtime_lock = threading.Lock()
+_runtime_lock = instrumented_lock("runtime.global_registry")
 _runtime: Optional[object] = None
 
 
@@ -86,7 +87,8 @@ class _ActorRecord:
     worker: Optional[WorkerHandle] = None
     node_id: Optional[NodeId] = None
     queued: List[TaskSpec] = field(default_factory=list)
-    lock: threading.Lock = field(default_factory=threading.Lock)
+    lock: Any = field(
+        default_factory=lambda: instrumented_lock("runtime.actor_record"))
 
 
 class DriverRuntime:
@@ -147,7 +149,7 @@ class DriverRuntime:
         self._fn_cache: Dict[int, str] = {}
         self._renv_cache: Dict[str, dict] = {}
         self.default_runtime_env: Optional[dict] = None  # job-level env
-        self._lock = threading.RLock()
+        self._lock = instrumented_lock("runtime.driver", reentrant=True)
         self._pool = ThreadPoolExecutor(
             max_workers=int(self.config.driver_pool_threads),
             thread_name_prefix="rt")
@@ -1944,10 +1946,10 @@ class WorkerRuntime:
         self._current: "contextvars.ContextVar[Optional[_TaskCtx]]" = \
             contextvars.ContextVar("rtpu_current_task", default=None)
         self._fn_cache: Dict[int, tuple] = {}
-        self._put_lock = threading.Lock()
+        self._put_lock = instrumented_lock("worker.put_counter")
         self._put_counter = 0
         self.worker_id = worker_process.worker_id
-        self._held_lock = threading.Lock()
+        self._held_lock = instrumented_lock("worker.held_refs")
         self._held: Dict[ObjectId, int] = {}
 
     # -- worker-held reference accounting (ref: reference_count.h:61 borrower

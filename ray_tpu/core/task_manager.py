@@ -17,6 +17,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set
 
+from ..devtools.locks import instrumented_lock
 from .ids import ObjectId, TaskId
 from .task_spec import TaskSpec
 
@@ -32,7 +33,7 @@ class PendingTask:
 
 class TaskManager:
     def __init__(self, lineage_max_bytes: int = 256 * 1024 * 1024):
-        self._lock = threading.RLock()
+        self._lock = instrumented_lock("task_manager", reentrant=True)
         self._pending: Dict[TaskId, PendingTask] = {}
         # lineage: task prefix (first 12 id bytes) -> spec of the task that
         # created those objects; bounded by _lineage_bytes budget
@@ -117,7 +118,7 @@ class ReferenceCounter:
     reach zero. (ref: reference_count.h:61)"""
 
     def __init__(self, on_free: Callable[[ObjectId], None]):
-        self._lock = threading.Lock()
+        self._lock = instrumented_lock("refcounter")
         self._local: Dict[ObjectId, int] = {}
         self._task_pins: Dict[ObjectId, int] = {}
         self._holders: Dict[ObjectId, Dict[object, int]] = {}

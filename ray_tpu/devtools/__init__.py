@@ -1,0 +1,28 @@
+"""Developer tooling for the ray_tpu core.
+
+Two halves (see docs/GRAFTCHECK.md):
+
+- ``graftcheck`` — a framework-aware static linter (stdlib ``ast``, no
+  third-party deps) with rules GC001..GC006 targeting the correctness
+  hazards this runtime shares with the reference (blocking get inside
+  remote bodies, unserializable closure capture, global mutation from
+  tasks, blocking sleeps on the actor event loop, swallowed framework
+  errors, leak-prone manual lock handling). Run it as
+  ``python -m ray_tpu.devtools.graftcheck [paths]``.
+
+- ``locks`` — a debug-mode instrumented lock (``RAY_TPU_DEBUG_LOCKS=1``)
+  that the core runtime's hot locks are built from; it records per-thread
+  acquisition stacks and reports lock-order inversions and over-long hold
+  times through the observability path.
+"""
+from __future__ import annotations
+
+from .locks import (LockReport, get_lock_reports, instrumented_lock,
+                    reset_lock_state)
+
+__all__ = [
+    "instrumented_lock",
+    "get_lock_reports",
+    "reset_lock_state",
+    "LockReport",
+]

@@ -1,0 +1,558 @@
+"""graftcheck — framework-aware static analysis for ray_tpu code.
+
+An AST-based linter (stdlib ``ast`` only) whose rules encode the
+correctness hazards this runtime shares with the reference framework —
+hazards a generic linter cannot see because they depend on what
+``@remote`` means:
+
+====== =================================================================
+GC001  blocking ``get()`` (``ray_tpu.get`` / ``runtime.get`` /
+       ``ref.get()``) inside a ``@remote`` function or actor method body
+       — nested-task deadlock risk when the pool is saturated
+GC002  capture of a known-unserializable module-level object (lock,
+       condition, file handle, socket, thread) in a remote closure —
+       fails at submission time, or worse, pickles stale state
+GC003  mutation of a module-level global from a task body — the write
+       lands in the *worker* process and silently never propagates
+GC004  ``time.sleep`` inside an ``async def`` — blocks the actor event
+       loop (use ``await asyncio.sleep``)
+GC005  bare ``except:`` that never re-raises — swallows ``TaskError`` /
+       ``ActorDiedError`` / ``SystemExit`` and hides worker death
+GC006  ``lock.acquire()`` outside ``with``/try-finally — the lock leaks
+       on any exception path and wedges every later acquirer
+====== =================================================================
+
+Suppression: append ``# graftcheck: disable=GC001`` (comma-separate for
+several rules, or ``disable=all``) to the flagged line or put it alone
+on the line above. ``# graftcheck: disable-file=GC005`` anywhere in a
+file suppresses that rule file-wide.
+
+CLI::
+
+    python -m ray_tpu.devtools.graftcheck [--json] [--rules GC001,GC006] paths...
+
+Exit status: 0 = clean, 1 = findings, 2 = usage/parse errors only.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+RULES: Dict[str, str] = {
+    "GC001": "blocking get() inside a @remote function or actor method "
+             "(nested-task deadlock risk)",
+    "GC002": "remote closure captures a known-unserializable module-level "
+             "object",
+    "GC003": "module-level global mutated from a task body (the write stays "
+             "in the worker process)",
+    "GC004": "blocking time.sleep() in an async function (blocks the actor "
+             "event loop; use await asyncio.sleep)",
+    "GC005": "bare except: without re-raise swallows TaskError/"
+             "ActorDiedError/SystemExit",
+    "GC006": "lock.acquire() without with-statement or try/finally release "
+             "(leaks the lock on exception paths)",
+}
+
+# module-level constructors whose results cannot ride a cloudpickle'd
+# closure into a worker process
+_UNSERIALIZABLE_CTORS: Dict[Tuple[str, ...], str] = {
+    ("threading", "Lock"): "threading.Lock",
+    ("threading", "RLock"): "threading.RLock",
+    ("threading", "Condition"): "threading.Condition",
+    ("threading", "Event"): "threading.Event",
+    ("threading", "Semaphore"): "threading.Semaphore",
+    ("threading", "Thread"): "threading.Thread",
+    ("socket", "socket"): "socket.socket",
+    ("socket", "create_connection"): "socket.create_connection",
+    ("open",): "open() file handle",
+    ("io", "open"): "open() file handle",
+    ("subprocess", "Popen"): "subprocess.Popen",
+    ("mmap", "mmap"): "mmap.mmap",
+}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftcheck:\s*disable(?P<scope>-file)?\s*=\s*"
+    r"(?P<rules>all|[Gg][Cc]\d{3}(?:\s*,\s*[Gg][Cc]\d{3})*)")
+
+
+@dataclass
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def as_dict(self) -> dict:
+        return {"path": self.path, "line": self.line, "col": self.col,
+                "rule": self.rule, "message": self.message}
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# suppression comments
+
+
+def _parse_suppressions(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """-> ({line: {rules}}, file_wide_rules). 'all' expands to every rule."""
+    per_line: Dict[int, Set[str]] = {}
+    file_wide: Set[str] = set()
+    for lineno, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        raw = m.group("rules").strip()
+        rules = (set(RULES) if raw == "all"
+                 else {r.strip().upper() for r in raw.split(",") if r.strip()})
+        if m.group("scope"):
+            file_wide |= rules
+        else:
+            per_line.setdefault(lineno, set()).update(rules)
+            if text.strip().startswith("#"):
+                # a standalone suppression comment also covers the next line
+                per_line.setdefault(lineno + 1, set()).update(rules)
+    return per_line, file_wide
+
+
+# ---------------------------------------------------------------------------
+# AST helpers
+
+
+def _dotted(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """('a','b','c') for a.b.c / ('f',) for f; None for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _is_remote_decorator(dec: ast.AST) -> bool:
+    """@remote / @ray_tpu.remote / @ray.remote, bare or called, plus
+    .options(...) chains hanging off any of those."""
+    if isinstance(dec, ast.Call):
+        func = dec.func
+        if isinstance(func, ast.Attribute) and func.attr == "options":
+            return _is_remote_decorator(func.value)
+        return _is_remote_decorator(func)
+    dotted = _dotted(dec)
+    return dotted is not None and dotted[-1] == "remote"
+
+
+def _is_lockish(node: ast.AST, known_locks: Set[str]) -> bool:
+    """Heuristic: the receiver of .acquire() looks like a lock."""
+    dotted = _dotted(node)
+    if dotted is None:
+        return False
+    name = dotted[-1]
+    return "lock" in name.lower() or ".".join(dotted) in known_locks \
+        or name in known_locks
+
+
+def _assigned_names(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Name):
+        return [node.id]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for elt in node.elts:
+            out.extend(_assigned_names(elt))
+        return out
+    return []
+
+
+def _iter_own_exprs(stmt: ast.stmt):
+    """Expression nodes belonging to this statement only — prunes nested
+    statements (handled by the block walk) and function/class bodies
+    (handled with their own scope context)."""
+    stack: List[ast.AST] = []
+    for child in ast.iter_child_nodes(stmt):
+        if not isinstance(child, (ast.stmt, ast.ExceptHandler)):
+            stack.append(child)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, (ast.stmt, ast.ExceptHandler)):
+                stack.append(child)
+
+
+def _ctor_kind(value: ast.AST) -> Optional[str]:
+    """If `value` is a call to a known-unserializable constructor, name it."""
+    if not isinstance(value, ast.Call):
+        return None
+    dotted = _dotted(value.func)
+    if dotted is None:
+        return None
+    return _UNSERIALIZABLE_CTORS.get(dotted) \
+        or _UNSERIALIZABLE_CTORS.get(dotted[-1:])
+
+
+# ---------------------------------------------------------------------------
+# the checker
+
+
+class _FileChecker:
+    def __init__(self, path: str, source: str, tree: ast.Module,
+                 enabled: Set[str]):
+        self.path = path
+        self.enabled = enabled
+        self.findings: List[Finding] = []
+        per_line, file_wide = _parse_suppressions(source)
+        self._suppress_line = per_line
+        self._suppress_file = file_wide
+        self.tree = tree
+        # module-level unserializable objects: name -> ctor description
+        self.module_unserializable: Dict[str, str] = {}
+        # names `from ray_tpu import get/wait` was bound to
+        self.bare_get_names: Set[str] = set()
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign):
+                kind = _ctor_kind(stmt.value)
+                if kind:
+                    for t in stmt.targets:
+                        for name in _assigned_names(t):
+                            self.module_unserializable[name] = kind
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                kind = _ctor_kind(stmt.value)
+                if kind and isinstance(stmt.target, ast.Name):
+                    self.module_unserializable[stmt.target.id] = kind
+            elif isinstance(stmt, ast.ImportFrom) and stmt.module \
+                    and stmt.module.split(".")[0] in ("ray_tpu", "ray"):
+                for alias in stmt.names:
+                    if alias.name == "get":
+                        self.bare_get_names.add(alias.asname or alias.name)
+
+    # -- reporting --------------------------------------------------------
+
+    def report(self, rule: str, node: ast.AST, message: str) -> None:
+        if rule not in self.enabled or rule in self._suppress_file:
+            return
+        line = getattr(node, "lineno", 0)
+        sup = self._suppress_line.get(line, ())
+        if rule in sup:
+            return
+        self.findings.append(Finding(
+            path=self.path, line=line,
+            col=getattr(node, "col_offset", 0) + 1, rule=rule,
+            message=message))
+
+    # -- entry ------------------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        self._walk_block(self.tree.body, remote=False, is_async=False,
+                         fn=None)
+        return self.findings
+
+    # -- recursive walk with scope context --------------------------------
+
+    def _walk_block(self, stmts: Sequence[ast.stmt], remote: bool,
+                    is_async: bool, fn: Optional[dict],
+                    actor_class: bool = False) -> None:
+        for idx, stmt in enumerate(stmts):
+            self._walk_stmt(stmt, stmts, idx, remote, is_async, fn,
+                            actor_class)
+
+    def _walk_stmt(self, stmt: ast.stmt, siblings: Sequence[ast.stmt],
+                   idx: int, remote: bool, is_async: bool,
+                   fn: Optional[dict], actor_class: bool) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn_remote = remote or actor_class \
+                or any(_is_remote_decorator(d) for d in stmt.decorator_list)
+            fn_async = isinstance(stmt, ast.AsyncFunctionDef)
+            ctx = self._fn_context(stmt)
+            self._walk_block(stmt.body, remote=fn_remote, is_async=fn_async,
+                             fn=ctx)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            cls_remote = any(_is_remote_decorator(d)
+                             for d in stmt.decorator_list)
+            self._walk_block(stmt.body, remote=remote, is_async=is_async,
+                             fn=fn, actor_class=cls_remote or actor_class)
+            return
+        if isinstance(stmt, ast.Global) and remote and fn is not None:
+            mutated = [n for n in stmt.names if n in fn["stores"]]
+            if mutated:
+                self.report(
+                    "GC003", stmt,
+                    f"task body mutates module global(s) "
+                    f"{', '.join(sorted(mutated))}; the write happens in the "
+                    f"worker process and is lost — return the value or use "
+                    f"an actor")
+        if isinstance(stmt, ast.Try):
+            self._check_gc005(stmt)
+        # GC006 on statement-position acquire() calls
+        self._check_gc006(stmt, siblings, idx)
+        # this statement's own expressions: GC001/GC002/GC004
+        for node in _iter_own_exprs(stmt):
+            self._check_expr(node, remote, is_async, fn)
+        # recurse into child statement blocks (for/while/if/with/try bodies)
+        for field_name in ("body", "orelse", "finalbody"):
+            child = getattr(stmt, field_name, None)
+            if isinstance(child, list) and child \
+                    and isinstance(child[0], ast.stmt):
+                self._walk_block(child, remote, is_async, fn, actor_class)
+        for handler in getattr(stmt, "handlers", ()):
+            self._walk_block(handler.body, remote, is_async, fn, actor_class)
+        for case in getattr(stmt, "cases", ()):
+            self._walk_block(case.body, remote, is_async, fn, actor_class)
+
+    def _fn_context(self, fndef) -> dict:
+        """Names a function binds locally (params + assignments) and
+        names it stores to (for GC003)."""
+        locals_: Set[str] = set()
+        stores: Set[str] = set()
+        args = fndef.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            locals_.add(a.arg)
+        for node in ast.walk(fndef):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, (ast.Store, ast.Del)):
+                locals_.add(node.id)
+                stores.add(node.id)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fndef:
+                locals_.add(node.name)
+        # names declared global are NOT locals (they resolve to the module)
+        declared_global: Set[str] = set()
+        for node in ast.walk(fndef):
+            if isinstance(node, ast.Global):
+                declared_global.update(node.names)
+        return {"locals": locals_ - declared_global, "stores": stores}
+
+    # -- expression-level rules -------------------------------------------
+
+    def _check_expr(self, node: ast.AST, remote: bool, is_async: bool,
+                    fn: Optional[dict]) -> None:
+        if isinstance(node, ast.Call):
+            if remote:
+                self._check_gc001(node)
+            if is_async:
+                dotted = _dotted(node.func)
+                if dotted == ("time", "sleep"):
+                    self.report(
+                        "GC004", node,
+                        "time.sleep() in an async function blocks the "
+                        "actor's event loop for every queued request; use "
+                        "`await asyncio.sleep(...)`")
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                and remote and fn is not None:
+            kind = self.module_unserializable.get(node.id)
+            if kind and node.id not in fn["locals"]:
+                self.report(
+                    "GC002", node,
+                    f"remote closure captures module-level {kind} "
+                    f"'{node.id}' which cannot be serialized to a worker; "
+                    f"create it inside the task or hold it in an actor")
+
+    def _check_gc001(self, call: ast.Call) -> None:
+        func = call.func
+        flagged = False
+        if isinstance(func, ast.Attribute) and func.attr == "get":
+            recv = func.value
+            dotted = _dotted(recv)
+            if dotted in (("ray_tpu",), ("ray",)):
+                flagged = True  # ray_tpu.get(...) inside a task
+            elif isinstance(recv, ast.Call):
+                inner = _dotted(recv.func)
+                if inner is not None and inner[-1] in ("get_runtime",):
+                    flagged = True  # get_runtime().get(...)
+                elif isinstance(recv.func, ast.Attribute) \
+                        and recv.func.attr == "remote":
+                    flagged = True  # f.remote(...).get()
+        elif isinstance(func, ast.Name) and func.id in self.bare_get_names:
+            flagged = True  # `from ray_tpu import get` then get(...)
+        if flagged:
+            self.report(
+                "GC001", call,
+                "blocking get() inside a @remote function/actor method can "
+                "deadlock when the worker pool is saturated (the waiting "
+                "task holds the lease its child needs); restructure with "
+                "ref-passing, or suppress if the nesting depth is bounded")
+
+    # -- statement-level rules --------------------------------------------
+
+    def _check_gc005(self, node: ast.Try) -> None:
+        for handler in node.handlers:
+            if handler.type is not None:
+                continue
+            reraises = any(isinstance(n, ast.Raise) and n.exc is None
+                           for n in ast.walk(handler))
+            if not reraises:
+                self.report(
+                    "GC005", handler,
+                    "bare `except:` without re-raise swallows TaskError/"
+                    "ActorDiedError (and SystemExit/KeyboardInterrupt), "
+                    "hiding worker death; catch Exception or specific "
+                    "framework errors instead")
+
+    def _check_gc006(self, stmt: ast.stmt, siblings: Sequence[ast.stmt],
+                     idx: int) -> None:
+        call = None
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+        elif isinstance(stmt, ast.Assign) \
+                and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+        if call is None or not isinstance(call.func, ast.Attribute) \
+                or call.func.attr != "acquire":
+            return
+        recv = call.func.value
+        known = set(self.module_unserializable)
+        if not _is_lockish(recv, known):
+            return
+        recv_dump = ast.dump(recv)
+        # pattern A: lock.acquire() immediately followed by
+        # try: ... finally: lock.release()
+        nxt = siblings[idx + 1] if idx + 1 < len(siblings) else None
+        if isinstance(nxt, ast.Try) \
+                and self._releases(nxt.finalbody, recv_dump):
+            return
+        # pattern A': timed acquire — `got = lock.acquire(timeout=...)`
+        # guarded by `if got:` wrapping a try/finally release
+        if isinstance(stmt, ast.Assign) and isinstance(nxt, ast.If):
+            for n in ast.walk(nxt):
+                if isinstance(n, ast.Try) \
+                        and self._releases(n.finalbody, recv_dump):
+                    return
+        # pattern B: the acquire sits inside a try whose finally releases
+        # (acquire-inside-try is its own subtle bug, but the lock does get
+        # released; GC006 targets the leak)
+        if self._enclosing_try_releases(stmt, recv_dump):
+            return
+        self.report(
+            "GC006", stmt,
+            "lock acquired without `with` or try/finally: an exception "
+            "between acquire() and release() leaks the lock and wedges "
+            "every later acquirer; use `with lock:` (preferred) or "
+            "acquire();try/finally:release()")
+
+    def _releases(self, stmts: Sequence[ast.stmt], recv_dump: str) -> bool:
+        for s in stmts:
+            for n in ast.walk(s):
+                if isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr == "release" \
+                        and ast.dump(n.func.value) == recv_dump:
+                    return True
+        return False
+
+    def _enclosing_try_releases(self, stmt: ast.stmt,
+                                recv_dump: str) -> bool:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Try):
+                in_body = any(stmt is s or any(stmt is d for d in ast.walk(s))
+                              for s in node.body)
+                if in_body and self._releases(node.finalbody, recv_dump):
+                    return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+
+def check_source(source: str, path: str = "<string>",
+                 rules: Optional[Set[str]] = None) -> List[Finding]:
+    """Lint one source blob; parse errors raise SyntaxError."""
+    tree = ast.parse(source, filename=path)
+    checker = _FileChecker(path, source, tree, rules or set(RULES))
+    return checker.run()
+
+
+def check_file(path: str,
+               rules: Optional[Set[str]] = None) -> List[Finding]:
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        return check_source(f.read(), path, rules)
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if not d.startswith(".") and d != "__pycache__"]
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        out.append(os.path.join(root, name))
+        else:
+            raise FileNotFoundError(p)
+    return out
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.devtools.graftcheck",
+        description="framework-aware static analysis for ray_tpu code")
+    parser.add_argument("paths", nargs="*", help="files or directories")
+    parser.add_argument("--json", action="store_true",
+                        help="emit findings as a JSON array")
+    parser.add_argument("--rules", default="",
+                        help="comma-separated subset (default: all)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule table and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+    if not args.paths:
+        parser.error("the following arguments are required: paths")
+
+    rules = set(RULES)
+    if args.rules:
+        rules = {r.strip().upper() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(RULES)
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+    try:
+        files = iter_python_files(args.paths)
+    except FileNotFoundError as e:
+        print(f"no such file or directory: {e}", file=sys.stderr)
+        return 2
+
+    findings: List[Finding] = []
+    errors = 0
+    for path in files:
+        try:
+            findings.extend(check_file(path, rules))
+        except SyntaxError as e:
+            errors += 1
+            print(f"{path}: parse error: {e}", file=sys.stderr)
+    if args.json:
+        print(json.dumps([f.as_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        n = len(findings)
+        print(f"graftcheck: {n} finding{'s' if n != 1 else ''} "
+              f"in {len(files)} file{'s' if len(files) != 1 else ''}")
+    if errors:
+        return 2
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

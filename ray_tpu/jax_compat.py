@@ -1,0 +1,72 @@
+"""Version-compat shims for the moving parts of the jax API surface.
+
+The repo targets current jax idiom (top-level ``jax.shard_map``,
+``pltpu.CompilerParams``), but must also run on the jax 0.4.x line where
+``shard_map`` still lives in ``jax.experimental.shard_map`` with the
+``auto=`` spelling instead of ``axis_names=``. Centralizing the fallback
+here keeps every kernel/pipeline call site on the modern spelling.
+"""
+from __future__ import annotations
+
+try:  # jax >= 0.5: top-level export
+    from jax import shard_map as _new_shard_map
+
+    _experimental = None
+except ImportError:  # jax 0.4.x
+    _new_shard_map = None
+    from jax.experimental.shard_map import shard_map as _experimental
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+              axis_names=None, **kwargs):
+    """``jax.shard_map`` with the modern signature on every jax version.
+
+    ``axis_names`` (modern: the mesh axes the body is *manual* over) is
+    translated to the 0.4.x ``auto=`` parameter (its complement) when
+    running on the experimental implementation.
+    """
+    if _new_shard_map is not None:
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        return _new_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kwargs)
+    if axis_names is not None:
+        # The modern axis_names= means "manual over these axes only".
+        # 0.4.x's partial-manual spelling (auto=complement) lowers a
+        # PartitionId op its SPMD partitioner rejects, so run fully
+        # manual instead — equivalent as long as in/out specs never
+        # reference the extra axes (our callers' specs only name the
+        # manual axes; the body never touches the others). The static
+        # replication checker predates varying types, so it is disabled
+        # to admit the pvary()-marked carries.
+        kwargs.setdefault("check_rep", False)
+    if "check_vma" in kwargs:  # modern name for 0.4.x's check_rep
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _experimental(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, **kwargs)
+
+
+def pvary(x, axis_names):
+    """Mark ``x`` as varying over ``axis_names`` inside a manual
+    shard_map body. Modern jax spells this ``jax.lax.pvary`` (earlier
+    preview: ``pcast(..., to="varying")``); jax 0.4.x has no varying
+    types at all, so there it is the identity (pair with the
+    ``check_rep=False`` fallback in :func:`shard_map`)."""
+    import jax
+
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, tuple(axis_names), to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, tuple(axis_names))
+    return x
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` (jax >= 0.5) / ``TPUCompilerParams``
+    (jax 0.4.x). Imported lazily: pallas-tpu is only needed on the
+    kernel path."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kwargs)

@@ -202,7 +202,7 @@ class PPOJax:
         if mesh is not None and c.mesh_axis is not None:
             from jax.sharding import PartitionSpec as P
 
-            from jax import shard_map
+            from ..jax_compat import shard_map
 
             if c.num_envs % mesh.shape[c.mesh_axis]:
                 raise ValueError(

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Static gate for the repo: graftcheck (framework-aware rules GC001-GC006,
+# see docs/GRAFTCHECK.md) plus a bytecode-compile pass over the package.
+# Usage: scripts/lint.sh [extra graftcheck paths...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== graftcheck =="
+python -m ray_tpu.devtools.graftcheck ray_tpu/ examples/ tests/ "$@"
+
+echo "== compileall =="
+python -m compileall -q ray_tpu
+
+echo "lint OK"

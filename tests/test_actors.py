@@ -78,7 +78,7 @@ def test_actor_handle_in_task(ray_start_regular):
 
     @ray_tpu.remote
     def bump(h, k):
-        return ray_tpu.get(h.incr.remote(k))
+        return ray_tpu.get(h.incr.remote(k))  # graftcheck: disable=GC001
 
     assert ray_tpu.get(bump.remote(c, 42)) == 42
 
@@ -88,7 +88,7 @@ def test_actor_creates_actor(ray_start_regular):
     class Parent:
         def spawn(self):
             child = Counter.remote(99)
-            return ray_tpu.get(child.read.remote())
+            return ray_tpu.get(child.read.remote())  # graftcheck: disable=GC001
 
     p = Parent.remote()
     assert ray_tpu.get(p.spawn.remote()) == 99

@@ -1,0 +1,447 @@
+"""graftcheck linter + instrumented-lock detector tests.
+
+One positive and one negative fixture per rule GC001-GC006, suppression
+coverage, CLI behavior, and the runtime lock-order/long-hold detectors.
+"""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from ray_tpu.devtools import graftcheck
+from ray_tpu.devtools import locks as lockmod
+
+
+def rules_found(src: str):
+    return sorted({f.rule for f in graftcheck.check_source(src, "fix.py")})
+
+
+# ---------------------------------------------------------------------------
+# GC001 — blocking get() inside remote bodies
+
+
+def test_gc001_positive_nested_get():
+    src = """
+import ray_tpu
+
+@ray_tpu.remote
+def outer(ref):
+    return ray_tpu.get(ref)
+"""
+    assert rules_found(src) == ["GC001"]
+
+
+def test_gc001_positive_actor_method_and_bare_import():
+    src = """
+import ray_tpu
+from ray_tpu import get
+
+@ray_tpu.remote
+class A:
+    def m(self, ref):
+        return get(ref)
+"""
+    assert rules_found(src) == ["GC001"]
+
+
+def test_gc001_negative_driver_get_and_dict_get():
+    src = """
+import ray_tpu
+
+def driver(ref):
+    return ray_tpu.get(ref)          # not a remote scope
+
+@ray_tpu.remote
+def task(d):
+    return d.get("key")              # dict.get, not runtime.get
+"""
+    assert rules_found(src) == []
+
+
+# ---------------------------------------------------------------------------
+# GC002 — unserializable closure capture
+
+
+def test_gc002_positive_module_lock_capture():
+    src = """
+import threading
+import ray_tpu
+
+_LOCK = threading.Lock()
+
+@ray_tpu.remote
+def task():
+    with _LOCK:
+        return 1
+"""
+    assert rules_found(src) == ["GC002"]
+
+
+def test_gc002_negative_local_lock():
+    src = """
+import threading
+import ray_tpu
+
+_LOCK = threading.Lock()
+
+@ray_tpu.remote
+def task():
+    _LOCK = threading.Lock()         # local shadow: created in the worker
+    with _LOCK:
+        return 1
+
+def driver():
+    with _LOCK:                      # non-remote scope: fine
+        return 2
+"""
+    assert rules_found(src) == []
+
+
+# ---------------------------------------------------------------------------
+# GC003 — module-global mutation from task bodies
+
+
+def test_gc003_positive_global_write():
+    src = """
+import ray_tpu
+
+COUNTER = 0
+
+@ray_tpu.remote
+def bump():
+    global COUNTER
+    COUNTER += 1
+"""
+    assert rules_found(src) == ["GC003"]
+
+
+def test_gc003_negative_global_read_only():
+    src = """
+import ray_tpu
+
+LIMIT = 10
+
+@ray_tpu.remote
+def check(x):
+    global LIMIT
+    return x < LIMIT
+"""
+    assert rules_found(src) == []
+
+
+# ---------------------------------------------------------------------------
+# GC004 — time.sleep on the actor event loop
+
+
+def test_gc004_positive_async_sleep():
+    src = """
+import time
+import ray_tpu
+
+@ray_tpu.remote
+class A:
+    async def tick(self):
+        time.sleep(0.5)
+"""
+    assert rules_found(src) == ["GC004"]
+
+
+def test_gc004_negative_sync_sleep_and_asyncio():
+    src = """
+import asyncio
+import time
+import ray_tpu
+
+@ray_tpu.remote
+class A:
+    def sync_method(self):
+        time.sleep(0.5)              # sync method: worker thread, fine
+
+    async def tick(self):
+        await asyncio.sleep(0.5)
+"""
+    assert rules_found(src) == []
+
+
+# ---------------------------------------------------------------------------
+# GC005 — bare except swallowing framework errors
+
+
+def test_gc005_positive_bare_except():
+    src = """
+import ray_tpu
+
+def poll(ref):
+    try:
+        return ray_tpu.get(ref)
+    except:
+        return None
+"""
+    assert rules_found(src) == ["GC005"]
+
+
+def test_gc005_negative_reraise_and_typed():
+    src = """
+import ray_tpu
+
+def poll(ref):
+    try:
+        return ray_tpu.get(ref)
+    except ray_tpu.exceptions.TaskError:
+        return None
+
+def cleanup(ref):
+    try:
+        return ray_tpu.get(ref)
+    except:
+        release_things()
+        raise
+"""
+    assert rules_found(src) == []
+
+
+# ---------------------------------------------------------------------------
+# GC006 — manual lock handling
+
+
+def test_gc006_positive_unprotected_acquire():
+    src = """
+import threading
+
+lock = threading.Lock()
+
+def work():
+    lock.acquire()
+    do_stuff()
+    lock.release()
+"""
+    assert rules_found(src) == ["GC006"]
+
+
+def test_gc006_negative_timed_acquire_guard():
+    src = """
+import threading
+
+lock = threading.Lock()
+
+def timed():
+    got = lock.acquire(timeout=5)
+    if got:
+        try:
+            do_stuff()
+        finally:
+            lock.release()
+"""
+    assert rules_found(src) == []
+
+
+def test_gc006_negative_with_and_try_finally():
+    src = """
+import threading
+
+lock = threading.Lock()
+
+def good_with():
+    with lock:
+        do_stuff()
+
+def good_try():
+    lock.acquire()
+    try:
+        do_stuff()
+    finally:
+        lock.release()
+"""
+    assert rules_found(src) == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions + CLI
+
+
+def test_suppression_same_line_and_file_wide():
+    src = """
+import ray_tpu
+
+@ray_tpu.remote
+def a(ref):
+    return ray_tpu.get(ref)  # graftcheck: disable=GC001
+"""
+    assert rules_found(src) == []
+    src_file_wide = """
+# graftcheck: disable-file=GC001
+import ray_tpu
+
+@ray_tpu.remote
+def a(ref):
+    return ray_tpu.get(ref)
+
+@ray_tpu.remote
+def b(ref):
+    return ray_tpu.get(ref)
+"""
+    assert rules_found(src_file_wide) == []
+
+
+def test_suppression_with_trailing_justification():
+    src = """
+import ray_tpu
+
+@ray_tpu.remote
+def a(ref):
+    return ray_tpu.get(ref)  # graftcheck: disable=GC001 bounded depth
+"""
+    assert rules_found(src) == []
+
+
+def test_suppression_preceding_comment_line():
+    src = """
+import ray_tpu
+
+@ray_tpu.remote
+def a(ref):
+    # graftcheck: disable=GC001
+    return ray_tpu.get(ref)
+"""
+    assert rules_found(src) == []
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import ray_tpu\n"
+        "@ray_tpu.remote\n"
+        "def f(r):\n"
+        "    return ray_tpu.get(r)\n")
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+
+    assert graftcheck.main([str(good)]) == 0
+    assert graftcheck.main([str(bad)]) == 1
+    capsys.readouterr()
+    assert graftcheck.main(["--json", str(bad)]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert len(out) == 1 and out[0]["rule"] == "GC001" \
+        and out[0]["line"] == 4
+
+
+def test_cli_rule_filter(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import ray_tpu\n"
+        "@ray_tpu.remote\n"
+        "def f(r):\n"
+        "    return ray_tpu.get(r)\n")
+    assert graftcheck.main(["--rules", "GC006", str(bad)]) == 0
+    assert graftcheck.main(["--rules", "GC001", str(bad)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# instrumented locks
+
+
+@pytest.fixture
+def debug_locks(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_DEBUG_LOCKS", "1")
+    lockmod.reset_lock_state()
+    yield
+    lockmod.reset_lock_state()
+
+
+def test_factory_returns_plain_locks_when_disabled(monkeypatch):
+    monkeypatch.delenv("RAY_TPU_DEBUG_LOCKS", raising=False)
+    lk = lockmod.instrumented_lock("x")
+    assert not isinstance(lk, lockmod.InstrumentedLock)
+    with lk:
+        pass
+    rlk = lockmod.instrumented_lock("y", reentrant=True)
+    with rlk:
+        with rlk:
+            pass
+
+
+def test_lock_order_inversion_detected(debug_locks):
+    """Two threads, opposite acquisition order -> inversion report."""
+    a = lockmod.instrumented_lock("lock.a")
+    b = lockmod.instrumented_lock("lock.b")
+    assert isinstance(a, lockmod.InstrumentedLock)
+
+    def order_ab():
+        with a:
+            with b:
+                pass
+
+    def order_ba():
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=order_ab)
+    t1.start()
+    t1.join()
+    assert lockmod.get_lock_reports() == []  # one order alone is fine
+
+    t2 = threading.Thread(target=order_ba)
+    t2.start()
+    t2.join()
+    reports = lockmod.get_lock_reports()
+    assert any(r.kind == "lock-order-inversion" for r in reports)
+    inv = next(r for r in reports if r.kind == "lock-order-inversion")
+    assert set(inv.locks) == {"lock.a", "lock.b"}
+    assert inv.stacks.get("this_acquisition")
+
+
+def test_no_inversion_for_consistent_order(debug_locks):
+    a = lockmod.instrumented_lock("ord.a")
+    b = lockmod.instrumented_lock("ord.b")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert [r for r in lockmod.get_lock_reports()
+            if r.kind == "lock-order-inversion"] == []
+
+
+def test_reentrant_lock_no_self_report(debug_locks):
+    r = lockmod.instrumented_lock("reent", reentrant=True)
+    with r:
+        with r:
+            pass
+    assert lockmod.get_lock_reports() == []
+
+
+def test_long_hold_reported(debug_locks, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_LOCK_HOLD_WARN_S", "0.05")
+    lk = lockmod.instrumented_lock("slow.lock")
+    with lk:
+        time.sleep(0.12)
+    reports = lockmod.get_lock_reports()
+    assert any(r.kind == "long-hold" and "slow.lock" in r.locks
+               for r in reports)
+
+
+def test_three_lock_cycle_detected(debug_locks):
+    """Inversions across a chain (a->b, b->c, then c->a) are caught even
+    though no single pair is ever taken in both orders."""
+    a = lockmod.instrumented_lock("tri.a")
+    b = lockmod.instrumented_lock("tri.b")
+    c = lockmod.instrumented_lock("tri.c")
+
+    def run(first, second):
+        t = threading.Thread(target=lambda: _nest(first, second))
+        t.start()
+        t.join()
+
+    def _nest(x, y):
+        with x:
+            with y:
+                pass
+
+    run(a, b)
+    run(b, c)
+    assert lockmod.get_lock_reports() == []
+    run(c, a)
+    reports = lockmod.get_lock_reports()
+    assert any(r.kind == "lock-order-inversion" for r in reports)

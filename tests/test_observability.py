@@ -164,7 +164,7 @@ def test_trace_spans_propagate_across_tasks(cluster):
 
     @ray_tpu.remote
     def parent(x):
-        return ray_tpu.get(child.remote(x), timeout=60)
+        return ray_tpu.get(child.remote(x), timeout=60)  # graftcheck: disable=GC001
 
     with tracing.trace("root-op", user="tester") as root:
         assert ray_tpu.get(parent.remote(1), timeout=60) == 2

@@ -64,7 +64,7 @@ class TestFlashAttention:
 class TestRingAttention:
     @pytest.mark.parametrize("causal", [True, False])
     def test_matches_dense(self, causal):
-        from jax import shard_map
+        from ray_tpu.jax_compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         mesh = virtual_mesh(8, MeshSpec(dp=1, sp=4, tp=2))
@@ -80,7 +80,7 @@ class TestRingAttention:
                                    atol=2e-5, rtol=2e-5)
 
     def test_grad_matches_dense(self):
-        from jax import shard_map
+        from ray_tpu.jax_compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         mesh = virtual_mesh(8, MeshSpec(dp=2, sp=4))

@@ -90,7 +90,10 @@ class TestPPOJax:
                             iters_per_step=4, sgd_minibatch_size=512,
                             num_sgd_epochs=4, lr=3e-4, seed=0).build()
         best = 0.0
-        for _ in range(90):
+        # 140 iters, early-exit at 300: converged runs stop around iter
+        # 60-90; the margin absorbs learning-curve drift across jax
+        # versions (0.4.37 reaches 298 at iter 90 with this seed)
+        for _ in range(140):
             r = algo.train()
             m = r["episode_reward_mean"]
             if np.isfinite(m):

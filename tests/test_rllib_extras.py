@@ -83,11 +83,17 @@ class TestQMIX:
         'beats independent/no learning' bar)."""
         from ray_tpu.rllib import QMIXConfig
 
-        fresh = QMIXConfig(num_envs=8, rollout_len=30, seed=3,
-                           epsilon_start=0.0, epsilon_end=0.0).build()
-        r0 = fresh.train()
-        base = r0["episode_reward_mean"]
-        assert not np.isfinite(base) or base < 18
+        # a single fresh init is a random variable — one lucky seed can
+        # match well above chance (seed 3 greedy-scores 24.4 on jax
+        # 0.4.37), so bound the MEAN over a few independent inits
+        bases = []
+        for seed in (3, 4, 5):
+            fresh = QMIXConfig(num_envs=8, rollout_len=30, seed=seed,
+                               epsilon_start=0.0, epsilon_end=0.0).build()
+            r0 = fresh.train()
+            if np.isfinite(r0["episode_reward_mean"]):
+                bases.append(float(r0["episode_reward_mean"]))
+        assert not bases or float(np.mean(bases)) < 18, bases
 
     def test_qmix_checkpoint_roundtrip(self):
         import jax

@@ -85,7 +85,7 @@ def test_nested_task_inherits_env(cluster):
 
     @ray_tpu.remote
     def parent():
-        return ray_tpu.get(child.remote(), timeout=180)
+        return ray_tpu.get(child.remote(), timeout=180)  # graftcheck: disable=GC001
 
     task = parent.options(runtime_env={"env_vars": {"RTPU_NESTED": "deep"}})
     assert ray_tpu.get(task.remote(), timeout=240) == "deep"

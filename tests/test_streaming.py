@@ -85,7 +85,7 @@ class TestStreamingGenerators:
         def endless(p):
             i = 0
             while True:
-                ray_tpu.get(p.bump.remote(), timeout=30)
+                ray_tpu.get(p.bump.remote(), timeout=30)  # graftcheck: disable=GC001
                 yield i
                 i += 1
 
@@ -122,7 +122,7 @@ class TestStreamingGenerators:
 
         @ray_tpu.remote
         def consume():
-            return sum(ray_tpu.get(r, timeout=30) for r in gen.remote(4))
+            return sum(ray_tpu.get(r, timeout=30) for r in gen.remote(4))  # graftcheck: disable=GC001
 
         assert ray_tpu.get(consume.remote(), timeout=60) == 6
 
