@@ -89,6 +89,22 @@ def init(num_cpus: Optional[float] = None,
         res["object_store_memory"] = float(object_store_memory)
     rt = DriverRuntime(resources=res, num_nodes=num_nodes,
                        config=Config(system_config), namespace=namespace)
+    if int(rt.config.metrics_export_port):
+        # opt-in Prometheus exposition at a fixed port (config/env
+        # RTPU_METRICS_EXPORT_PORT); ephemeral-port serving remains
+        # available any time via metrics.start_metrics_server()
+        from .util import metrics as _metrics_mod
+
+        global _metrics_server_from_init
+        was_running = _metrics_mod._server is not None
+        try:
+            _metrics_mod.start_metrics_server(
+                port=int(rt.config.metrics_export_port))
+            # only own the lifecycle when init() actually bound it — a
+            # user-started server must survive ray_tpu.shutdown()
+            _metrics_server_from_init = not was_running
+        except OSError:
+            pass  # port taken: init must not fail over observability
     if runtime_env:
         # job-level default: merged under every task/actor env (ref:
         # job_config.py runtime_env; validated now so errors hit at init)
@@ -99,11 +115,26 @@ def init(num_cpus: Optional[float] = None,
     return rt
 
 
+_metrics_server_from_init = False
+
+
 def shutdown() -> None:
+    global _metrics_server_from_init
     rt = _runtime_mod.maybe_runtime()
     if rt is not None:
         rt.shutdown()
         _runtime_mod.set_runtime(None)
+        if isinstance(rt, DriverRuntime):
+            # the shipped worker/agent series died with the cluster; a
+            # re-init must not serve them merged into the new cluster's
+            from .util import metrics as _metrics_mod
+
+            _metrics_mod.reset_remote_metrics()
+            if _metrics_server_from_init:
+                # init() bound it, so init() owns its lifecycle — a
+                # re-init with a different port must actually rebind
+                _metrics_server_from_init = False
+                _metrics_mod.stop_metrics_server()
 
 
 def is_initialized() -> bool:

@@ -67,6 +67,9 @@ def _cmd_list(args) -> int:
 
     if runtime_mod.maybe_runtime() is None:
         return _no_runtime_help()
+    if args.what == "latency":
+        _print_latency_table(state.latency_summary())
+        return 0
     fn = {"nodes": state.list_nodes, "actors": state.list_actors,
           "tasks": state.list_tasks, "objects": state.list_objects,
           "pgs": state.list_placement_groups,
@@ -74,6 +77,27 @@ def _cmd_list(args) -> int:
     rows = fn()
     print(json.dumps(rows, indent=2, default=str))
     return 0
+
+
+def _print_latency_table(summary: dict) -> None:
+    """Aligned p50/p95/p99 table per latency histogram (cluster-wide:
+    worker/agent-shipped series are already merged in)."""
+    cols = ("histogram", "count", "mean_ms", "p50_ms", "p95_ms", "p99_ms")
+
+    def ms(v):
+        return "-" if v is None else f"{v * 1e3:.2f}"
+
+    rows = [(name, str(s["count"]), ms(s["mean"]), ms(s["p50"]),
+             ms(s["p95"]), ms(s["p99"]))
+            for name, s in sorted(summary.items(),
+                                  key=lambda kv: -kv[1]["count"])]
+    widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
+              for i, c in enumerate(cols)]
+    print("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
+    for r in rows:
+        print("  ".join(v.ljust(w) for v, w in zip(r, widths)))
+    if not rows:
+        print("(no latency observations yet)")
 
 
 def _cmd_timeline(args) -> int:
@@ -292,10 +316,10 @@ def main(argv=None) -> int:
     st.set_defaults(fn=_cmd_status)
 
     ls = sub.add_parser(
-        "list", help="list tasks/actors/objects/nodes/pgs/summary "
+        "list", help="list tasks/actors/objects/nodes/pgs/summary/latency "
                      "(run from the driver process)")
     ls.add_argument("what", choices=["tasks", "actors", "objects", "nodes",
-                                     "pgs", "summary"])
+                                     "pgs", "summary", "latency"])
     ls.set_defaults(fn=_cmd_list)
 
     tl = sub.add_parser("timeline", help="export Chrome-trace of task events")

@@ -115,13 +115,20 @@ _define("lineage_max_bytes", 256 * 1024 * 1024,
 # --- gcs ---
 _define("gcs_storage_path", "",
         "non-empty => persist KV/tables to this dir (head restart FT)")
-_define("task_events_max_buffered", 10000,
-        "task-event ring size backing the state API / timeline")
+_define("task_events_max_buffered", 20000,
+        "task-event ring size backing the state API / timeline (a task "
+        "now emits SUBMITTED/SCHEDULED/RUNNING/FINISHED, ~4 events)")
 # --- misc ---
 _define("log_dir", "/tmp/ray_tpu/logs",
         "worker/agent log directory")
 _define("metrics_export_port", 0,
         "non-zero => Prometheus exposition server on this port")
+_define("metrics_export_interval_s", 1.0,
+        "cadence at which worker processes ship metric deltas to the "
+        "head's /metrics exposition (agents piggyback on heartbeat). "
+        "Workers read this from their own environment, so set it via "
+        "RTPU_METRICS_EXPORT_INTERVAL_S — init(system_config=...) only "
+        "reaches the head process")
 
 
 class Config:

@@ -27,9 +27,28 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from ..devtools.locks import instrumented_lock
+from ..util import metrics as _metrics
 from .ids import ActorId, JobId, NodeId, PlacementGroupId, TaskId, WorkerId
 from .resources import ResourceSet
 from .task_spec import TaskSpec
+
+# task-lifecycle phase latencies, derived from the state-transition
+# event stream (ref: src/ray/stats/metric_defs.cc task latency metrics;
+# gcs_task_manager.h:61). Tagged by task name so one straggling function
+# is visible next to its siblings; cardinality = #distinct remote fns.
+_H_SUBMIT_TO_SCHED = _metrics.Histogram(
+    "ray_tpu_task_submit_to_sched_seconds",
+    "submit -> node-picked scheduling latency", tag_keys=("name",))
+_H_QUEUE_WAIT = _metrics.Histogram(
+    "ray_tpu_task_queue_wait_seconds",
+    "node-picked -> RUNNING queue/lease wait", tag_keys=("name",))
+_H_EXEC = _metrics.Histogram(
+    "ray_tpu_task_exec_seconds",
+    "RUNNING -> FINISHED/FAILED execution time", tag_keys=("name",))
+
+# phase marks outlive the bounded event ring but must stay bounded too:
+# tasks that never reach a terminal state are evicted oldest-first
+_PHASE_MARKS_MAX = 20000
 
 
 class ActorState(enum.Enum):
@@ -117,7 +136,7 @@ class Pubsub:
 
 
 class Gcs:
-    def __init__(self, storage_path: str = ""):
+    def __init__(self, storage_path: str = "", config=None):
         self._lock = instrumented_lock("gcs.tables", reentrant=True)
         self.pubsub = Pubsub()
         self._nodes: Dict[NodeId, NodeInfo] = {}
@@ -126,7 +145,17 @@ class Gcs:
         self._named_actors: Dict[tuple, ActorId] = {}  # (namespace, name) -> id
         self._kv: Dict[str, Dict[str, bytes]] = defaultdict(dict)  # namespace -> k -> v
         self._pgs: Dict[PlacementGroupId, PlacementGroupInfo] = {}
-        self._task_events: deque = deque(maxlen=10000)
+        # ring sized from the runtime's config (was hardcoded 10000 and
+        # ignored the flag): SUBMITTED/SCHEDULED roughly doubled
+        # events-per-task, so the default doubled with it — timeline()
+        # slices keep the same effective task history as before
+        if config is None:
+            from .config import DEFAULT as config
+
+        self._task_events: deque = deque(
+            maxlen=int(config.task_events_max_buffered))
+        # task_id -> (last_state, last_time, name): feeds phase histograms
+        self._phase_marks: Dict[str, tuple] = {}
         self._storage_path = storage_path
         # set by the Runtime: asks the scheduler to (re)create an actor
         self.schedule_actor_cb: Optional[Callable[[ActorInfo], None]] = None
@@ -351,10 +380,47 @@ class Gcs:
     # ---- task events (timeline / state API backing store) --------------------
 
     def add_task_event(self, event: dict) -> None:
+        observe = None  # (histogram, seconds, name) — fired outside _lock
         with self._lock:
             self._task_events.append(event)
             st = event.get("state", "?")
             self._event_counts[st] = self._event_counts.get(st, 0) + 1
+            tid = event.get("task_id")
+            t = event.get("time")
+            if tid and isinstance(t, (int, float)):
+                observe = self._mark_phase(tid, st, float(t),
+                                           event.get("name", ""))
+        if observe is not None:
+            hist, dt, name = observe
+            hist.observe(dt, tags={"name": name})
+
+    def _mark_phase(self, tid: str, state: str, t: float,
+                    name: str):
+        """SUBMITTED -> SCHEDULED -> RUNNING -> FINISHED/FAILED phase
+        durations. Called under _lock; returns the observation to make
+        (metric locks must not nest inside the table lock)."""
+        prev = self._phase_marks.get(tid)
+        out = None
+        if state in ("FINISHED", "FAILED"):
+            self._phase_marks.pop(tid, None)
+            if prev is not None and prev[0] == "RUNNING":
+                out = (_H_EXEC, max(0.0, t - prev[1]), prev[2] or name)
+            return out
+        if state not in ("SUBMITTED", "SCHEDULED", "RUNNING"):
+            return None
+        if prev is not None:
+            pstate, pt, pname = prev
+            name = name or pname
+            if state == "SCHEDULED" and pstate == "SUBMITTED":
+                out = (_H_SUBMIT_TO_SCHED, max(0.0, t - pt), name)
+            elif state == "RUNNING" and pstate in ("SUBMITTED", "SCHEDULED"):
+                # actor tasks skip SCHEDULED (direct push): their queue
+                # wait spans from submission
+                out = (_H_QUEUE_WAIT, max(0.0, t - pt), name)
+        elif len(self._phase_marks) >= _PHASE_MARKS_MAX:
+            self._phase_marks.pop(next(iter(self._phase_marks)))
+        self._phase_marks[tid] = (state, t, name)
+        return out
 
     def task_event_counts(self) -> Dict[str, int]:
         """Monotonic per-state totals (unlike the bounded ring buffer,

@@ -118,9 +118,22 @@ class NodeAgent:
 
     def _heartbeat_loop(self, period_s: float) -> None:
         period = max(0.05, float(period_s) / 2)
+        backlog: list = []  # deltas snapshotted but not yet shipped
         while not self._stopped.is_set() and not self.head.closed:
             try:
-                self.head.notify("heartbeat", None)
+                # piggyback this agent process's metric deltas (store
+                # ops, RPC latency) on the liveness signal — the head
+                # merges them node-tagged into its /metrics exposition
+                from ..util import metrics as metrics_mod
+
+                try:
+                    backlog = metrics_mod.carry_backlog(backlog)
+                except Exception:
+                    pass
+                if self.head.closed:
+                    break
+                self.head.notify("heartbeat", backlog or None)
+                backlog = []
             except Exception:
                 break  # channel closed mid-send; head loss handler runs
             self._stopped.wait(period)
@@ -317,7 +330,7 @@ class NodeAgent:
             if method == "get_objects":
                 return self._get_objects(payload["ids"],
                                          payload.get("timeout"))
-            if method in ("log_event", "worker_log"):
+            if method in ("log_event", "worker_log", "metrics_push"):
                 self.head.notify("worker_call", {"worker_id": wid,
                                                  "method": method,
                                                  "payload": payload})

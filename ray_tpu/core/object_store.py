@@ -31,8 +31,28 @@ from typing import Dict, Optional
 
 from ..devtools.locks import instrumented_lock
 from ..exceptions import ObjectStoreFullError
+from ..util import metrics as _metrics
 from .ids import NodeId, ObjectId
 from .serialization import SerializedObject
+
+# store-op latency + transfer volume (ref: the reference's plasma store
+# and object_manager stats). In worker/agent processes these live in the
+# local registry and ship to the head node-tagged via metrics_push /
+# heartbeat piggyback.
+_H_STORE_OP = _metrics.Histogram(
+    "ray_tpu_object_store_op_seconds",
+    "shared-memory store operation latency",
+    boundaries=_metrics.FAST_BOUNDARIES, tag_keys=("op",))
+_C_TRANSFER_BYTES = _metrics.Counter(
+    "ray_tpu_object_transfer_bytes_total",
+    "bytes moved by store puts/gets and inter-node pulls",
+    tag_keys=("op",))
+
+
+def _observe_op(op: str, t0: float, nbytes: Optional[int] = None) -> None:
+    _H_STORE_OP.observe(time.perf_counter() - t0, tags={"op": op})
+    if nbytes:
+        _C_TRANSFER_BYTES.inc(nbytes, tags={"op": op})
 
 
 # Note on resource tracking: only the driver process creates SharedMemory
@@ -147,18 +167,22 @@ class PlasmaStore:
     def put_serialized(self, object_id: ObjectId, sobj: SerializedObject,
                        pin: bool = True) -> None:
         """Create+write+seal in one step (server-local fast path)."""
+        t0 = time.perf_counter()
         self.create(object_id, sobj.total_bytes)
         e = self._entries[object_id]
         sobj.write_into(memoryview(e.shm.buf))
         e.pinned = pin
         self.seal(object_id)
+        _observe_op("put", t0, sobj.total_bytes)
 
     def put_bytes(self, object_id: ObjectId, data: bytes, pin: bool = True) -> None:
+        t0 = time.perf_counter()
         self.create(object_id, len(data))
         e = self._entries[object_id]
         e.shm.buf[: len(data)] = data
         e.pinned = pin
         self.seal(object_id)
+        _observe_op("put", t0, len(data))
 
     def put_chunk(self, object_id: ObjectId, offset: int, total: int,
                   data: bytes, pin: bool = True) -> bool:
@@ -205,6 +229,7 @@ class PlasmaStore:
     def get_segment(self, object_id: ObjectId) -> Optional[tuple[str, int]]:
         """Return (shm_name, size) for zero-copy local access; restores a
         spilled object back into shared memory first if needed."""
+        t0 = time.perf_counter()
         with self._lock:
             e = self._entries.get(object_id)
             if e is None or not e.sealed:
@@ -223,7 +248,9 @@ class PlasmaStore:
                 e.shm = shm
                 self._used += e.size
             self._entries.move_to_end(object_id)
-            return self.segment_name(object_id), e.size
+            size = e.size
+        _observe_op("get", t0, size)
+        return self.segment_name(object_id), size
 
     # -- lifetime --------------------------------------------------------------
 
@@ -446,6 +473,7 @@ class NativePlasmaStore:
         # would munmap the segment mid-write and the ctypes view write
         # would SIGSEGV (the Python store fails safe via BufferError;
         # the native mapping has no such guard)
+        t0 = time.perf_counter()
         with self._lock:
             self.create(object_id, sobj.total_bytes)
             mv, _, _ = self._view(object_id)
@@ -454,6 +482,7 @@ class NativePlasmaStore:
             if pin:
                 self.pin(object_id)
             self.seal(object_id)
+        _observe_op("put", t0, sobj.total_bytes)
 
     def put_chunk(self, object_id: ObjectId, offset: int, total: int,
                   data: bytes, pin: bool = True) -> bool:
@@ -477,6 +506,7 @@ class NativePlasmaStore:
 
     def put_bytes(self, object_id: ObjectId, data: bytes,
                   pin: bool = True) -> None:
+        t0 = time.perf_counter()
         with self._lock:  # see put_serialized: write under the lock
             self.create(object_id, len(data))
             mv, _, _ = self._view(object_id)
@@ -485,6 +515,7 @@ class NativePlasmaStore:
             if pin:
                 self.pin(object_id)
             self.seal(object_id)
+        _observe_op("put", t0, len(data))
 
     # -- reads -------------------------------------------------------------
 
@@ -502,12 +533,14 @@ class NativePlasmaStore:
             return out
 
     def get_segment(self, object_id: ObjectId) -> Optional[tuple]:
+        t0 = time.perf_counter()
         with self._lock:
             mv, n, sealed = self._view(object_id)  # restores spilled
             if mv is None or not sealed:
                 return None
             del mv
-            return self.segment_name(object_id), n
+        _observe_op("get", t0, n)
+        return self.segment_name(object_id), n
 
     def object_size(self, object_id: ObjectId) -> Optional[int]:
         with self._lock:

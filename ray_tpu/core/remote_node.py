@@ -252,16 +252,23 @@ class RemoteNode(Node):
         so the caller retries instead of wrongly declaring the copy lost
         — conflating the two made a get() on an evicted remote copy hang
         forever (advisor r2)."""
+        import time as _time
+
+        from .object_store import _observe_op
+
+        t0 = _time.perf_counter()
         size = self.channel.call("object_info", {"object_id": oid},
                                  timeout=30)
         if size is None:
             return None
-        return pull_chunks(
+        data = pull_chunks(
             lambda off, n: self.channel.call(
                 "read_chunk",
                 {"object_id": oid, "offset": off, "length": n},
                 timeout=60),
             size)
+        _observe_op("pull", t0, len(data) if data is not None else 0)
+        return data
 
     # ---- lifecycle -----------------------------------------------------------
 

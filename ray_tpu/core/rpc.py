@@ -30,9 +30,21 @@ _CLOSE = object()  # writer-thread sentinel
 
 # per-handler instrumentation (ref: the reference's per-RPC gRPC stats,
 # src/ray/stats/metric_defs.cc grpc_server_req_* counters): method ->
-# [calls, errors, total_seconds]. Process-wide; read via rpc_stats().
+# [calls, errors, total_seconds] backing rpc_stats(), plus a bucketed
+# latency histogram per method in the shared metrics registry — in
+# worker/agent processes the histogram ships to the head's /metrics
+# with node/worker tags (util/metrics.py snapshot_deltas).
+from ..util import metrics as _metrics
+
 _RPC_STATS: Dict[str, list] = {}
 _RPC_STATS_LOCK = threading.Lock()
+_RPC_LATENCY = _metrics.Histogram(
+    "ray_tpu_rpc_handler_seconds",
+    "per-RPC-method handler latency (request and oneway frames)",
+    boundaries=_metrics.FAST_BOUNDARIES, tag_keys=("method",))
+_RPC_ERRORS = _metrics.Counter(
+    "ray_tpu_rpc_errors_total", "per-RPC-method handler errors",
+    tag_keys=("method",))
 
 
 def _record_rpc(method: str, seconds: float, error: bool) -> None:
@@ -44,6 +56,9 @@ def _record_rpc(method: str, seconds: float, error: bool) -> None:
         if error:
             row[1] += 1
         row[2] += seconds
+    _RPC_LATENCY.observe(seconds, tags={"method": method})
+    if error:
+        _RPC_ERRORS.inc(tags={"method": method})
 
 
 def rpc_stats() -> Dict[str, dict]:

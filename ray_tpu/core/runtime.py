@@ -28,6 +28,7 @@ import cloudpickle
 
 from .. import exceptions as exc
 from ..devtools.locks import instrumented_lock
+from ..util import metrics as metrics_mod
 from . import serialization
 from .config import Config
 from .gcs import ActorInfo, ActorState, Gcs, JobInfo, NodeInfo
@@ -43,6 +44,16 @@ from .task_spec import (ARG_REF, ARG_VALUE, STREAMING_RETURNS,
 
 _runtime_lock = instrumented_lock("runtime.global_registry")
 _runtime: Optional[object] = None
+
+# hot-path latency instruments (head side; the worker-side mirrors live
+# in each worker's registry and ship to the head via metrics_push)
+_H_GET_WAIT = metrics_mod.Histogram(
+    "ray_tpu_get_wait_seconds",
+    "blocking wait in ray_tpu.get() / fetch_one")
+_H_RESULT_PUT = metrics_mod.Histogram(
+    "ray_tpu_task_result_put_seconds",
+    "head-side intake of a finished task's results",
+    boundaries=metrics_mod.FAST_BOUNDARIES)
 
 
 def set_runtime(rt) -> None:
@@ -107,7 +118,8 @@ class DriverRuntime:
         self.session_dir = session_dir or os.path.join(
             "/tmp/ray_tpu", f"session_{int(time.time() * 1000)}_{os.getpid()}")
         os.makedirs(self.session_dir, exist_ok=True)
-        self.gcs = Gcs(storage_path=self.config.gcs_storage_path)
+        self.gcs = Gcs(storage_path=self.config.gcs_storage_path,
+                       config=self.config)
         self.gcs.register_job(JobInfo(job_id=self.job_id, driver_pid=os.getpid()))
         self.gcs.schedule_actor_cb = self._restart_actor
         self.gcs.pubsub.subscribe("actor", self._on_actor_state)
@@ -306,6 +318,11 @@ class DriverRuntime:
                 return None
             if method == "heartbeat":
                 self.gcs.heartbeat(node.node_id)
+                # agents piggyback their process's metric deltas (store
+                # ops, RPC latency, user metrics) on the liveness signal
+                if payload:
+                    metrics_mod.merge_remote(
+                        payload, node=node.node_id.hex()[:12])
                 return None
             if method == "worker_register":
                 node.on_remote_worker_register(payload["worker_id"],
@@ -769,7 +786,12 @@ class DriverRuntime:
         single = isinstance(refs, ObjectRef)
         if single:
             refs = [refs]
-        out = [self.deserialize_fetched(self.fetch_one(r.id, timeout)) for r in refs]
+        t0 = time.perf_counter()
+        try:
+            out = [self.deserialize_fetched(self.fetch_one(r.id, timeout))
+                   for r in refs]
+        finally:
+            _H_GET_WAIT.observe(time.perf_counter() - t0)
         return out[0] if single else out
 
     def get_many(self, oids: List[ObjectId], timeout: Optional[float] = None):
@@ -866,6 +888,13 @@ class DriverRuntime:
 
     def submit_spec(self, spec: TaskSpec) -> List[ObjectRef]:
         self.task_manager.register(spec)
+        # SUBMITTED opens the lifecycle phase chain (-> SCHEDULED ->
+        # RUNNING -> FINISHED); the GCS derives phase histograms from it
+        ev = {"task_id": spec.task_id.hex(), "name": spec.description,
+              "state": "SUBMITTED", "time": time.time()}
+        if spec.actor_id is not None:
+            ev["actor_id"] = spec.actor_id.hex()
+        self.gcs.add_task_event(ev)
         for ref in spec.arg_refs():
             self.refcount.pin_for_task(ref.id)
         for oid in spec.return_ids():
@@ -931,6 +960,10 @@ class DriverRuntime:
             with self._lock:
                 self._parked.append(spec)
             return
+        self.gcs.add_task_event({
+            "task_id": spec.task_id.hex(), "name": spec.description,
+            "state": "SCHEDULED", "node_id": node.node_id.hex(),
+            "time": time.time()})
         self.task_manager.mark_running(spec.task_id)
         fut = node.request_lease(spec)
 
@@ -1149,10 +1182,13 @@ class DriverRuntime:
                 for nested in borrowed:
                     for oid in nested:
                         self.refcount.add_local(oid)
+            t_put = time.perf_counter()
             for oid, res in zip(spec.return_ids(), results):
                 if res[0] == "inline":
                     self.store_inline_bytes(oid, res[1])
                 # "stored" results were registered at seal time
+            if results:
+                _H_RESULT_PUT.observe(time.perf_counter() - t_put)
             if spec.num_returns == STREAMING_RETURNS:
                 self._generator_finish(spec.task_id)
             self.task_manager.complete(spec.task_id)
@@ -1811,6 +1847,16 @@ class DriverRuntime:
         if method == "log_event":
             self.gcs.add_task_event(payload)
             return None
+        if method == "metrics_push":
+            # worker-process metric deltas -> the head's single /metrics
+            # exposition, tagged with their origin (the metrics-agent
+            # aggregation path; ref: python/ray/_private/metrics_agent.py)
+            metrics_mod.merge_remote(
+                payload.get("deltas") or [],
+                node=node.node_id.hex()[:12],
+                worker=(worker.worker_id.hex()[:12]
+                        if worker is not None else ""))
+            return None
         if method == "task_events":
             return list(self.gcs.task_events())
         if method == "worker_log":
@@ -2036,8 +2082,14 @@ class WorkerRuntime:
         return ref
 
     def get_many(self, oids: List[ObjectId], timeout: Optional[float] = None):
-        results = self.channel.call("get_objects", {"ids": oids, "timeout": timeout},
-                                    timeout=None)
+        t0 = time.perf_counter()
+        try:
+            results = self.channel.call("get_objects",
+                                        {"ids": oids, "timeout": timeout},
+                                        timeout=None)
+        finally:
+            # worker-local registry: ships to the head node/worker-tagged
+            _H_GET_WAIT.observe(time.perf_counter() - t0)
         out = []
         for res in results:
             out.append(self._deserialize(res))

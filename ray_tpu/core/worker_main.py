@@ -22,6 +22,7 @@ import os
 import queue
 import sys
 import threading
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional
@@ -199,6 +200,52 @@ class WorkerProcess:
         from .object_ref import _set_borrow_hook
 
         _set_borrow_hook(self.runtime.register_borrowed_ref)
+        # metrics export: this process's registry (user metrics observed
+        # inside tasks + the built-in rpc/store/get instruments) ships
+        # deltas to the head — throttled after each task, plus a periodic
+        # sweep so idle-period observations still surface (the
+        # metrics-agent analog; ref: python/ray/_private/metrics_agent.py)
+        self._metrics_last_flush = 0.0
+        self._metrics_flush_lock = threading.Lock()
+        self._metrics_backlog: list = []  # deltas that failed to ship
+        from .config import DEFAULT as _cfg
+
+        self._metrics_interval = max(
+            0.1, float(_cfg.metrics_export_interval_s))
+        threading.Thread(target=self._metrics_loop, daemon=True,
+                         name="worker-metrics").start()
+
+    def _flush_metrics(self, min_interval: Optional[float] = None) -> None:
+        now = time.monotonic()
+        with self._metrics_flush_lock:
+            if min_interval is not None \
+                    and now - self._metrics_last_flush < min_interval:
+                return
+            self._metrics_last_flush = now
+            from ..util import metrics as metrics_mod
+
+            try:
+                deltas = metrics_mod.carry_backlog(self._metrics_backlog)
+            except Exception:
+                return
+            if not deltas:
+                return
+            if self.channel.closed:
+                self._metrics_backlog = deltas
+                return
+            self._metrics_backlog = []
+            # notify inside the lock (it only enqueues to the writer
+            # thread): a later gauge snapshot shipping before an earlier
+            # one would roll the head's last-write-wins value backwards
+            try:
+                self.channel.notify("metrics_push", {"deltas": deltas})
+            except Exception:
+                self._metrics_backlog = deltas
+
+    def _metrics_loop(self) -> None:
+        while not self._stop.is_set() and not self.channel.closed:
+            self._stop.wait(self._metrics_interval)
+            self._flush_metrics()
 
     # -- incoming RPC ----------------------------------------------------------
 
@@ -292,6 +339,9 @@ class WorkerProcess:
 
         with task_span(spec):
             self._execute_task_inner(spec, instance, token)
+        # ship metric deltas promptly after each task (throttled) so a
+        # head scrape right after ray_tpu.get() sees them
+        self._flush_metrics(min_interval=0.25)
 
     def _execute_task_inner(self, spec: TaskSpec, instance: Any,
                             token) -> None:
@@ -332,6 +382,7 @@ class WorkerProcess:
                 self._report_error(spec, e)
             finally:
                 self.runtime.clear_current_task(token)
+        self._flush_metrics(min_interval=0.25)
 
     # -- result reporting ------------------------------------------------------
 

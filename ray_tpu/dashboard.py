@@ -53,6 +53,7 @@ _API = {
     "object_store": state_api.object_store_stats,
     "summary": state_api.summary,
     "rpc": state_api.rpc_method_stats,
+    "latency": state_api.latency_summary,
     "jobs": _jobs_rows,
     "serve": _serve_rows,
     "logs": lambda: state_api.recent_logs(limit=400),
@@ -197,7 +198,7 @@ async function render(){
    return;
   }
   if(tab==="metrics"){
-   const [hist,rpc]=await Promise.all([api("metrics_history"),api("rpc")]);
+   const [hist,rpc,lat]=await Promise.all([api("metrics_history"),api("rpc"),api("latency")]);
    let html="";
    const series=[["finished tasks/s",h=>h.task_rate],["actors",h=>h.actors],
                  ["store used bytes",h=>h.store_used_bytes],["alive nodes",h=>h.alive_nodes]];
@@ -207,6 +208,10 @@ async function render(){
       <span style="float:right">${esc(vals.length?(Math.round(vals[vals.length-1]*100)/100):"-")}</span></div>
       ${spark(vals,560,60)}</div>`;
    }
+   html+=`<h4 style="font-size:12px">latency percentiles (s, cluster-wide)</h4>`;
+   const lrows=Object.entries(lat).map(([m,s])=>({histogram:m,count:s.count,
+     mean:s.mean,p50:s.p50,p95:s.p95,p99:s.p99}));
+   html+=table(lrows.sort((a,b)=>(b.count||0)-(a.count||0)));
    html+=`<h4 style="font-size:12px">per-RPC-method stats</h4>`;
    const rows=Object.entries(rpc).map(([m,s])=>({method:m,...s}));
    html+=table(rows.sort((a,b)=>(b.calls||0)-(a.calls||0)));

@@ -19,8 +19,17 @@ from typing import Any, Dict, Optional
 
 import ray_tpu
 from ray_tpu.exceptions import ActorDiedError, WorkerCrashedError
+from ray_tpu.util import metrics as _metrics
 
 from .controller import CONTROLLER_NAME
+
+# end-to-end request latency as the router sees it: replica pick +
+# queueing + execution + result fetch (ref: the reference's
+# serve_deployment_processing_latency_ms family)
+_H_SERVE_REQUEST = _metrics.Histogram(
+    "ray_tpu_serve_request_seconds",
+    "end-to-end serve request latency through the routing handle",
+    tag_keys=("deployment",))
 
 
 class DeploymentResponse:
@@ -258,6 +267,16 @@ class DeploymentHandle:
             kwargs = {**kwargs, MUX_KWARG: mux_id}
         rt = runtime_mod.get_runtime()
         backoff = 0.005
+        t_start = time.perf_counter()
+        try:
+            return self._route_with_retries(rt, method, args, kwargs,
+                                            deadline, mux_id, backoff)
+        finally:
+            _H_SERVE_REQUEST.observe(time.perf_counter() - t_start,
+                                     tags={"deployment": self._name})
+
+    def _route_with_retries(self, rt, method, args, kwargs, deadline,
+                            mux_id, backoff):
         while True:
             self._refresh()
             replica = self._pick(mux_id)

@@ -9,9 +9,19 @@ mesh workers.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, Optional
 
 import cloudpickle
+
+from ..util import metrics as _metrics
+
+# replica-side execution latency; lives in the replica worker's registry
+# and ships to the head node/worker-tagged (util/metrics.py aggregation)
+_H_REPLICA_EXEC = _metrics.Histogram(
+    "ray_tpu_serve_replica_exec_seconds",
+    "user-callable execution time inside a serve replica",
+    tag_keys=("deployment",))
 
 
 class Replica:
@@ -47,9 +57,12 @@ class Replica:
             self._ongoing += 1
             self._total += 1
         token = _current_model_id.set(mux_id)
+        t0 = time.perf_counter()
         try:
             return self._resolve(method)(*args, **kwargs)
         finally:
+            _H_REPLICA_EXEC.observe(time.perf_counter() - t0,
+                                    tags={"deployment": self._deployment})
             _current_model_id.reset(token)
             with self._lock:
                 self._ongoing -= 1

@@ -173,6 +173,16 @@ def rpc_method_stats() -> Dict[str, dict]:
     return rpc_stats()
 
 
+def latency_summary() -> Dict[str, dict]:
+    """p50/p95/p99 per latency histogram — task lifecycle phases, get(),
+    store ops, RPC methods, serve — aggregated cluster-wide (worker- and
+    agent-shipped series included). Backs /api/latency and
+    `ray_tpu list latency`."""
+    from . import metrics as metrics_mod
+
+    return metrics_mod.latency_summary()
+
+
 def summary() -> Dict[str, Any]:
     rt = _rt()
     events = rt.gcs.task_events()
@@ -202,16 +212,34 @@ def timeline(output_path: Optional[str] = None) -> List[dict]:
     rt = _rt()
     events = rt.gcs.task_events()
     starts: Dict[str, dict] = {}
+    phases: Dict[str, Dict[str, float]] = {}  # tid -> {state: wall time}
     trace: List[dict] = []
     for e in events:
         tid = e.get("task_id", "")
         state = e.get("state")
-        if state == "RUNNING":
+        if state in ("SUBMITTED", "SCHEDULED"):
+            phases.setdefault(tid, {})[state] = e.get("time", 0.0)
+        elif state == "RUNNING":
             starts[tid] = e
         elif state in ("FINISHED", "FAILED"):
             begin = starts.pop(tid, None)
             t_end = e.get("time", 0.0)
             t_begin = begin.get("time", t_end) if begin else t_end
+            # phase breakdown joins the lifecycle events into the trace
+            # slice: how long scheduling and the queue wait took before
+            # this exec span started (straggler-phase triage args)
+            args: Dict[str, Any] = {"state": state}
+            marks = phases.pop(tid, {})
+            t_sub = marks.get("SUBMITTED")
+            t_sched = marks.get("SCHEDULED")
+            if t_sub is not None and t_sched is not None:
+                args["submit_to_sched_ms"] = round(
+                    max(0.0, (t_sched - t_sub)) * 1e3, 3)
+            queued_from = t_sched if t_sched is not None else t_sub
+            if queued_from is not None and begin is not None:
+                args["queue_wait_ms"] = round(
+                    max(0.0, (t_begin - queued_from)) * 1e3, 3)
+            args["exec_ms"] = round(max(0.0, (t_end - t_begin)) * 1e3, 3)
             trace.append({
                 "name": e.get("name", tid[:8]),
                 "cat": "task",
@@ -220,7 +248,7 @@ def timeline(output_path: Optional[str] = None) -> List[dict]:
                 "dur": max(1.0, (t_end - t_begin) * 1e6),
                 "pid": e.get("node_id", "head")[:12],
                 "tid": tid[:12],
-                "args": {"state": state},
+                "args": args,
             })
     if output_path:
         with open(output_path, "w") as f:

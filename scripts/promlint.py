@@ -1,0 +1,288 @@
+#!/usr/bin/env python3
+"""promlint — Prometheus text-format (version 0.0.4) validator.
+
+graftcheck-style CI gate for the /metrics exposition: parses a scrape
+body and reports structural errors instead of letting a malformed
+exposition (bad label escaping, orphan TYPE lines, non-monotonic
+histogram buckets) ship and silently break a real Prometheus scraper.
+
+Checks
+ - comment lines: well-formed `# HELP <name> ...` / `# TYPE <name> <kind>`
+   with a known kind; at most one HELP and one TYPE per metric family;
+   TYPE must precede the family's samples
+ - sample lines: valid metric/label names, correctly escaped label
+   values (`\\`, `\"`, `\n`), no duplicate label names, parseable value
+ - family grouping: all samples of a family must be contiguous
+ - histograms: `_bucket` needs an `le` label with a parseable bound,
+   cumulative counts must be non-decreasing in `le` order, the `+Inf`
+   bucket must exist and equal `_count` for the same label set
+
+Usage:
+    promlint.py <file-or-url>     lint a saved body or live endpoint
+    promlint.py --live            spin up an in-process ray_tpu cluster,
+                                  run work, scrape, lint (the CI mode)
+Exit code 0 = clean, 1 = findings (one per line on stderr).
+"""
+from __future__ import annotations
+
+import math
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_KINDS = {"counter", "gauge", "histogram", "summary", "untyped"}
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _family_of(sample_name: str, typed: Dict[str, str]) -> str:
+    """Map a sample name to its family: histogram/summary samples carry
+    a suffix on the declared family name."""
+    for suf in _SUFFIXES:
+        if sample_name.endswith(suf):
+            base = sample_name[: -len(suf)]
+            if typed.get(base) in ("histogram", "summary"):
+                return base
+    return sample_name
+
+
+def _parse_labels(raw: str) -> Tuple[Optional[List[Tuple[str, str]]], str]:
+    """Parse `k="v",k2="v2"` with escape validation; returns
+    (pairs, error). A None pairs means unparseable."""
+    pairs: List[Tuple[str, str]] = []
+    i, n = 0, len(raw)
+    while i < n:
+        j = raw.find("=", i)
+        if j < 0:
+            return None, f"missing '=' in labels at {raw[i:]!r}"
+        name = raw[i:j].strip()
+        if not _LABEL_RE.match(name):
+            return None, f"bad label name {name!r}"
+        if j + 1 >= n or raw[j + 1] != '"':
+            return None, f"label {name!r}: value not quoted"
+        k = j + 2
+        val = []
+        closed = False
+        while k < n:
+            c = raw[k]
+            if c == "\\":
+                if k + 1 >= n or raw[k + 1] not in ('\\', '"', 'n'):
+                    return None, (f"label {name!r}: invalid escape "
+                                  f"\\{raw[k + 1] if k + 1 < n else ''}")
+                val.append({"\\": "\\", '"': '"', "n": "\n"}[raw[k + 1]])
+                k += 2
+            elif c == '"':
+                closed = True
+                k += 1
+                break
+            elif c == "\n":
+                return None, f"label {name!r}: raw newline in value"
+            else:
+                val.append(c)
+                k += 1
+        if not closed:
+            return None, f"label {name!r}: unterminated value"
+        pairs.append((name, "".join(val)))
+        if k < n:
+            if raw[k] != ",":
+                return None, f"junk after label {name!r}: {raw[k:]!r}"
+            k += 1
+        i = k
+    return pairs, ""
+
+
+def _parse_value(s: str) -> Optional[float]:
+    try:
+        return float(s)
+    except ValueError:
+        if s in ("+Inf", "-Inf", "NaN"):
+            return {"+Inf": math.inf, "-Inf": -math.inf,
+                    "NaN": math.nan}[s]
+        return None
+
+
+def lint(body: str) -> List[str]:
+    errors: List[str] = []
+    helped: Dict[str, int] = {}
+    typed: Dict[str, str] = {}
+    closed_families: set = set()
+    current_family: Optional[str] = None
+    # histogram accumulation: (family, frozenset(non-le labels)) ->
+    # [(le, value)], and _count values for the +Inf cross-check
+    buckets: Dict[tuple, List[Tuple[float, float]]] = {}
+    counts: Dict[tuple, float] = {}
+
+    for lineno, line in enumerate(body.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) < 2 or parts[1] not in ("HELP", "TYPE"):
+                continue  # free-form comment: legal
+            if len(parts) < 3 or not _NAME_RE.match(parts[2]):
+                errors.append(f"line {lineno}: malformed {parts[1]} line")
+                continue
+            name = parts[2]
+            if parts[1] == "HELP":
+                if name in helped:
+                    errors.append(
+                        f"line {lineno}: duplicate HELP for {name}")
+                helped[name] = lineno
+            else:
+                kind = parts[3].strip() if len(parts) > 3 else ""
+                if kind not in _KINDS:
+                    errors.append(
+                        f"line {lineno}: TYPE {name}: unknown kind "
+                        f"{kind!r}")
+                if name in typed:
+                    errors.append(
+                        f"line {lineno}: duplicate TYPE for {name}")
+                if name in closed_families or name == current_family:
+                    errors.append(
+                        f"line {lineno}: TYPE for {name} appears after "
+                        f"its samples")
+                typed.setdefault(name, kind)
+            continue
+        # sample line: name[{labels}] value [timestamp]
+        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+                     r"(?:\{(.*)\})?\s+(\S+)(?:\s+(-?\d+))?$", line)
+        if m is None:
+            errors.append(f"line {lineno}: unparseable sample: {line!r}")
+            continue
+        sname, rawlabels, rawval = m.group(1), m.group(2), m.group(3)
+        fam = _family_of(sname, typed)
+        if fam != current_family:
+            if current_family is not None:
+                closed_families.add(current_family)
+            if fam in closed_families:
+                errors.append(
+                    f"line {lineno}: samples of {fam} are not contiguous")
+            current_family = fam
+        labels: List[Tuple[str, str]] = []
+        if rawlabels:
+            parsed, err = _parse_labels(rawlabels)
+            if parsed is None:
+                errors.append(f"line {lineno}: {sname}: {err}")
+                continue
+            labels = parsed
+            names = [k for k, _ in labels]
+            if len(names) != len(set(names)):
+                errors.append(
+                    f"line {lineno}: {sname}: duplicate label name")
+        value = _parse_value(rawval)
+        if value is None:
+            errors.append(
+                f"line {lineno}: {sname}: unparseable value {rawval!r}")
+            continue
+        if typed.get(fam) == "histogram":
+            others = frozenset((k, v) for k, v in labels if k != "le")
+            if sname.endswith("_bucket"):
+                le = dict(labels).get("le")
+                bound = _parse_value(le) if le is not None else None
+                if bound is None:
+                    errors.append(
+                        f"line {lineno}: {sname}: _bucket needs a "
+                        f"parseable le label, got {le!r}")
+                else:
+                    buckets.setdefault((fam, others), []).append(
+                        (bound, value))
+            elif sname.endswith("_count"):
+                counts[(fam, others)] = value
+
+    for (fam, others), rows in buckets.items():
+        tag = dict(others)
+        rows = sorted(rows, key=lambda r: r[0])
+        bounds = [b for b, _ in rows]
+        if not any(math.isinf(b) for b in bounds):
+            errors.append(f"{fam}{tag}: histogram has no +Inf bucket")
+        if len(bounds) != len(set(bounds)):
+            errors.append(f"{fam}{tag}: duplicate le bound")
+        prev = -math.inf
+        for b, v in rows:
+            if v < prev:
+                errors.append(
+                    f"{fam}{tag}: bucket le={b} count {v} < previous "
+                    f"{prev} (not cumulative)")
+            prev = v
+        cnt = counts.get((fam, others))
+        inf_rows = [v for b, v in rows if math.isinf(b) and b > 0]
+        if cnt is not None and inf_rows and inf_rows[0] != cnt:
+            errors.append(
+                f"{fam}{tag}: +Inf bucket {inf_rows[0]} != _count {cnt}")
+    return errors
+
+
+def _fetch(target: str) -> str:
+    if target.startswith(("http://", "https://")):
+        import urllib.request
+
+        with urllib.request.urlopen(target, timeout=10) as r:
+            return r.read().decode()
+    with open(target) as f:
+        return f.read()
+
+
+def _live_scrape() -> str:
+    """CI mode: stand up an in-process cluster, generate traffic across
+    the instrumented paths (tasks, puts/gets, a worker-side user
+    metric), then scrape the real /metrics server."""
+    import time
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu.util import metrics as metrics_mod
+
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        def work(x):
+            from ray_tpu.util.metrics import Counter
+
+            Counter("promlint_worker_events_total", "live-lint probe",
+                    tag_keys=("k",)).inc(tags={"k": 'q"uote\\slash'})
+            return x * 2
+
+        ref = ray_tpu.put(b"x" * 200_000)  # exercise the store path
+        assert ray_tpu.get([work.remote(i) for i in range(8)],
+                           timeout=120) == [2 * i for i in range(8)]
+        assert len(ray_tpu.get(ref, timeout=60)) == 200_000
+        host, port = metrics_mod.start_metrics_server()
+        deadline = time.time() + 20
+        body = ""
+        while time.time() < deadline:  # wait for the worker delta ship
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/metrics", timeout=10) as r:
+                body = r.read().decode()
+            if "promlint_worker_events_total" in body:
+                break
+            time.sleep(0.5)
+        return body
+    finally:
+        ray_tpu.shutdown()
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print(__doc__, file=sys.stderr)
+        return 2
+    if argv[0] == "--live":
+        sys.path.insert(0, ".")
+        body = _live_scrape()
+        if "promlint_worker_events_total" not in body:
+            print("promlint --live: worker metric never reached the head "
+                  "scrape", file=sys.stderr)
+            return 1
+    else:
+        body = _fetch(argv[0])
+    errors = lint(body)
+    for e in errors:
+        print(e, file=sys.stderr)
+    print(f"promlint: {len(body.splitlines())} lines, "
+          f"{len(errors)} error(s)")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

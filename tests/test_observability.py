@@ -1,6 +1,7 @@
 """State API, timeline export, Prometheus metrics (ref test model:
 python/ray/tests/test_state_api.py; test_metrics_agent.py)."""
 import json
+import re
 import time
 import urllib.request
 
@@ -271,6 +272,243 @@ def test_dashboard_logs_and_drilldown(cluster):
     finally:
         dash.shutdown()
         ray_tpu.kill(a)
+
+
+def _scrape(host, port):
+    with urllib.request.urlopen(f"http://{host}:{port}/metrics",
+                                timeout=10) as resp:
+        return resp.read().decode()
+
+
+def _bucket_counts(body, metric, **tags):
+    """Parse a histogram's NON-cumulative bucket counts from an
+    exposition body for the series matching all given tags.
+    -> (boundaries, counts) with counts aligned to boundaries + [+Inf]."""
+    rows = []
+    for line in body.splitlines():
+        if not line.startswith(metric + "_bucket"):
+            continue
+        raw = line[line.index("{") + 1:line.rindex("}")]
+        kv = dict(re.findall(r'(\w+)="((?:[^"\\]|\\.)*)"', raw))
+        if all(kv.get(k) == v for k, v in tags.items()):
+            le = kv["le"]
+            bound = float("inf") if le == "+Inf" else float(le)
+            rows.append((bound, int(float(line.rsplit(" ", 1)[1]))))
+    rows.sort(key=lambda r: r[0])
+    bounds = [b for b, _ in rows if b != float("inf")]
+    cum = [c for _, c in rows]
+    counts = [c - (cum[i - 1] if i else 0) for i, c in enumerate(cum)]
+    return bounds, counts
+
+
+def test_histogram_buckets_render_cumulative_with_inf(cluster):
+    """Tentpole core: Histogram honors `boundaries` and renders proper
+    cumulative `_bucket{le=...}` series with the +Inf terminal."""
+    h = metrics_mod.Histogram("t_obs_render_seconds", "render check",
+                              boundaries=[0.01, 0.1, 1.0],
+                              tag_keys=("op",))
+    for v in (0.005, 0.05, 0.05, 0.5, 5.0):
+        h.observe(v, tags={"op": "x"})
+    host, port = metrics_mod.start_metrics_server()
+    body = _scrape(host, port)
+    assert 't_obs_render_seconds_bucket{op="x",le="0.01"} 1' in body
+    assert 't_obs_render_seconds_bucket{op="x",le="0.1"} 3' in body
+    assert 't_obs_render_seconds_bucket{op="x",le="1"} 4' in body
+    assert 't_obs_render_seconds_bucket{op="x",le="+Inf"} 5' in body
+    assert 't_obs_render_seconds_count{op="x"} 5' in body
+    assert "# TYPE t_obs_render_seconds histogram" in body
+    # _sum keeps working alongside buckets
+    assert 't_obs_render_seconds_sum{op="x"} 5.605' in body
+
+
+def test_histogram_percentile_math():
+    """percentile() interpolates inside the bracketing bucket and clamps
+    overflow observations to the last finite boundary."""
+    h = metrics_mod.Histogram("t_obs_pctl_seconds", "",
+                              boundaries=[0.1, 0.2, 0.4])
+    for _ in range(50):
+        h.observe(0.15)  # (0.1, 0.2] bucket
+    for _ in range(50):
+        h.observe(0.3)  # (0.2, 0.4] bucket
+    p50 = h.percentile(50)
+    assert 0.1 < p50 <= 0.2, p50
+    p99 = h.percentile(99)
+    assert 0.2 < p99 <= 0.4, p99
+    h.observe(99.0)  # overflow
+    assert h.percentile(100) == 0.4
+    assert metrics_mod.Histogram("t_obs_empty_seconds",
+                                 "").percentile(95) is None
+    with pytest.raises(ValueError):
+        metrics_mod.Histogram("t_obs_bad", "", boundaries=[2.0, 1.0])
+
+
+def test_fmt_tags_escapes_prometheus_special_chars():
+    """Satellite regression: `"`, `\\` and newlines in tag values must
+    escape per the Prometheus text format instead of corrupting the
+    exposition."""
+    out = metrics_mod._fmt_tags({"k": 'a"b\\c\nd'})
+    assert out == '{k="a\\"b\\\\c\\nd"}'
+    # empty values are spec-equivalent to absent labels and are omitted
+    assert metrics_mod._fmt_tags({"k": "", "j": "v"}) == '{j="v"}'
+
+
+def test_start_metrics_server_warns_on_mismatched_rebind(cluster):
+    """Satellite: the singleton server must not silently 'succeed' when
+    re-requested on a different host/port."""
+    import warnings as _warnings
+
+    host, port = metrics_mod.start_metrics_server()
+    with _warnings.catch_warnings(record=True) as w:
+        _warnings.simplefilter("always")
+        again = metrics_mod.start_metrics_server(port=port + 1)
+        assert again == (host, port)  # original binding kept
+    assert any("already bound" in str(x.message) for x in w), \
+        [str(x.message) for x in w]
+    with _warnings.catch_warnings(record=True) as w:
+        _warnings.simplefilter("always")
+        assert metrics_mod.start_metrics_server() == (host, port)
+    assert not w  # same request: no warning
+
+
+def test_worker_metric_aggregation_node_tagged(cluster):
+    """Tentpole acceptance: a metric incremented inside a remote task
+    (a different process; its registry is not the head's) appears
+    node-tagged in a head scrape."""
+    @ray_tpu.remote
+    def bump():
+        from ray_tpu.util.metrics import Counter
+
+        Counter("t_obs_worker_events_total", "from-a-worker",
+                tag_keys=("kind",)).inc(tags={"kind": "agg"})
+        return 1
+
+    assert sum(ray_tpu.get([bump.remote() for _ in range(4)],
+                           timeout=60)) == 4
+    host, port = metrics_mod.start_metrics_server()
+    deadline = time.monotonic() + 20
+    matched = []
+    while time.monotonic() < deadline:
+        body = _scrape(host, port)
+        matched = [ln for ln in body.splitlines()
+                   if ln.startswith("t_obs_worker_events_total{")]
+        if sum(int(float(ln.rsplit(" ", 1)[1])) for ln in matched) >= 4:
+            break
+        time.sleep(0.3)
+    assert matched, "worker counter never reached the head scrape"
+    assert all('kind="agg"' in ln and 'node="' in ln and 'worker="' in ln
+               for ln in matched), matched
+    assert sum(int(float(ln.rsplit(" ", 1)[1])) for ln in matched) >= 4
+
+
+def test_task_phase_histograms_p95_brackets_injected_sleep(cluster):
+    """Tentpole acceptance: lifecycle phase histograms expose bucketed
+    latencies, and a p95 computed from the scraped bucket counts
+    brackets a known injected sleep."""
+    @ray_tpu.remote
+    def obs_sleeper():
+        time.sleep(0.2)
+        return 1
+
+    ray_tpu.get([obs_sleeper.remote() for _ in range(6)], timeout=120)
+    # exercise the shared-memory store paths (inline-size results don't)
+    big = ray_tpu.put(b"x" * 200_000)
+    assert len(ray_tpu.get(big, timeout=60)) == 200_000
+    host, port = metrics_mod.start_metrics_server()
+    body = _scrape(host, port)
+    for fam in ("ray_tpu_task_submit_to_sched_seconds",
+                "ray_tpu_task_queue_wait_seconds",
+                "ray_tpu_task_exec_seconds",
+                "ray_tpu_get_wait_seconds",
+                "ray_tpu_object_store_op_seconds",
+                "ray_tpu_rpc_handler_seconds"):
+        assert f"# TYPE {fam} histogram" in body, fam
+        assert f"{fam}_bucket" in body, fam
+    bounds, counts = _bucket_counts(body, "ray_tpu_task_exec_seconds",
+                                    name="obs_sleeper")
+    assert sum(counts) == 6
+    p95 = metrics_mod.percentile_from_buckets(bounds, counts, 95)
+    # 0.2s sleep (+ scheduling jitter) must land between the 0.1s and
+    # 1.0s boundaries — the bucket estimate brackets the injected value
+    assert 0.1 < p95 <= 1.0, (p95, counts)
+
+
+def test_latency_summary_api_cli_and_dashboard(cluster, capsys):
+    """Surfaces: /api/latency percentile summary + the CLI table."""
+    from ray_tpu.cli import main as cli_main
+    from ray_tpu.dashboard import Dashboard
+    from ray_tpu.util import state as state_api
+
+    @ray_tpu.remote
+    def quick():
+        return 1
+
+    ray_tpu.get([quick.remote() for _ in range(3)], timeout=60)
+    summ = state_api.latency_summary()
+    assert "ray_tpu_task_exec_seconds" in summ
+    row = summ["ray_tpu_task_exec_seconds"]
+    assert row["count"] >= 3
+    assert row["p50"] is not None and row["p95"] is not None \
+        and row["p99"] is not None
+    assert row["p50"] <= row["p95"] <= row["p99"]
+    assert any(s["tags"].get("name") == "quick" for s in row["series"])
+    dash = Dashboard(port=0)
+    try:
+        host, port = dash.address()
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/api/latency", timeout=10) as r:
+            api = json.load(r)
+        assert "ray_tpu_task_exec_seconds" in api
+        assert api["ray_tpu_task_exec_seconds"]["p95"] is not None
+    finally:
+        dash.shutdown()
+    assert cli_main(["list", "latency"]) == 0
+    out = capsys.readouterr().out
+    assert "ray_tpu_task_exec_seconds" in out and "p95_ms" in out
+
+
+def test_timeline_phase_breakdown_args(cluster, tmp_path):
+    """Satellite: the lifecycle events (SUBMITTED/SCHEDULED/RUNNING/
+    FINISHED) join into per-slice phase args on the Chrome trace."""
+    @ray_tpu.remote
+    def phased():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([phased.remote() for _ in range(2)], timeout=60)
+    events = state.timeline()
+    mine = [e for e in events if e["name"].startswith("phased")
+            and e["args"].get("state") == "FINISHED"]
+    assert mine, "no finished trace slices for phased()"
+    for e in mine:
+        assert "exec_ms" in e["args"] and e["args"]["exec_ms"] >= 40
+        assert "queue_wait_ms" in e["args"] \
+            and e["args"]["queue_wait_ms"] >= 0
+        assert "submit_to_sched_ms" in e["args"]
+
+
+def test_promlint_clean_on_live_scrape(cluster):
+    """CI-tooling satellite: the real exposition passes the Prometheus
+    text-format validator (HELP/TYPE pairing, escaping, bucket
+    monotonicity)."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "promlint", os.path.join(os.path.dirname(__file__), "..",
+                                 "scripts", "promlint.py"))
+    promlint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(promlint)
+    # include a hostile tag value so escaping is exercised end-to-end
+    metrics_mod.Gauge("t_obs_hostile_gauge", "hostile tags",
+                      tag_keys=("k",)).set(
+        1, tags={"k": 'a"b\\c\nd'})
+    host, port = metrics_mod.start_metrics_server()
+    body = _scrape(host, port)
+    assert promlint.lint(body) == []
+    # and the linter actually catches corruption
+    assert promlint.lint('# TYPE m histogram\nm_bucket{le="0.1"} 5\n'
+                         'm_bucket{le="+Inf"} 3\nm_count 3\n')
+    assert promlint.lint('bad{k="unterminated} 1\n')
 
 
 def test_dashboard_metrics_tab_data(cluster):
