@@ -112,6 +112,145 @@ def _cmd_timeline(args) -> int:
     return 0
 
 
+def _fmt_log_record(r: dict) -> str:
+    import datetime
+
+    t = datetime.datetime.fromtimestamp(r.get("ts") or 0).strftime(
+        "%H:%M:%S.%f")[:-3]
+    who = f"{(r.get('worker_id') or '')[:8]} pid={r.get('pid')}"
+    attrib = ""
+    if r.get("task_id"):
+        attrib += f" task={r['task_id'][:8]}"
+    if r.get("actor_id"):
+        attrib += f" actor={r['actor_id'][:8]}"
+    stream = r.get("stream", "")
+    mark = {"stderr": " err", "log": f" {r.get('level', 'INFO')}"}.get(
+        stream, "")
+    return (f"[{t} {(r.get('node_id') or '')[:8]} {who}{attrib}{mark}] "
+            f"{r.get('line', '')}")
+
+
+def _logs_backend(args):
+    """-> query(dict)->{"records","cursor"} against the in-process head
+    or, with --address, a running head over TCP (plus a closer)."""
+    if getattr(args, "address", ""):
+        ch = _head_channel(args)
+        return (lambda q: ch.call("logs_query", q, timeout=None)), ch.close
+    from .core import runtime as runtime_mod
+
+    if runtime_mod.maybe_runtime() is None:
+        return None, None
+    from .util import state
+
+    return (lambda q: state.logs(**q)), (lambda: None)
+
+
+def _cmd_logs(args) -> int:
+    """`ray_tpu logs [--follow] [--task|--actor|--worker|--node|--errors]`
+    — query/stream the head's attributed log store (ref: `ray logs`)."""
+    query, closer = _logs_backend(args)
+    if query is None:
+        return _no_runtime_help()
+    base = {"job_id": args.job or None, "task_id": args.task or None,
+            "actor_id": args.actor or None,
+            "worker_id": args.worker or None,
+            "node_id": args.node or None,
+            "stream": args.stream or None,
+            "errors_only": bool(args.errors)}
+    try:
+        res = query({**base, "limit": args.limit})
+        for r in res["records"]:
+            print(_fmt_log_record(r))
+        if not args.follow:
+            if not res["records"]:
+                print("(no matching log records)", file=sys.stderr)
+            return 0
+        cursor = res["cursor"]
+        while True:
+            res = query({**base, "since": cursor, "limit": 1000,
+                         "follow_timeout": 10.0})
+            cursor = res["cursor"]
+            for r in res["records"]:
+                print(_fmt_log_record(r))
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        closer()
+
+
+def _cmd_stack(args) -> int:
+    """`ray_tpu stack` — merged thread stacks of the driver and every
+    live worker (ref: `ray stack`)."""
+    from .util.introspect import format_stacks
+
+    if getattr(args, "address", ""):
+        ch = _head_channel(args)
+        try:
+            report = ch.call("stack_report", {"timeout": args.timeout},
+                             timeout=args.timeout + 30)
+        finally:
+            ch.close()
+    else:
+        from .core import runtime as runtime_mod
+
+        if runtime_mod.maybe_runtime() is None:
+            return _no_runtime_help()
+        from .util import state
+
+        report = state.stack_report(timeout=args.timeout)
+    drv = report.get("driver") or {}
+    print(format_stacks(drv, header=f"=== driver pid={drv.get('pid')} ==="))
+    workers = report.get("workers", [])
+    for w in workers:
+        head = (f"=== worker {w.get('worker_id', '')[:12]} "
+                f"pid={w.get('pid')} node={w.get('node_id', '')[:8]} "
+                f"state={w.get('state')}"
+                + (f" actor={w['actor_id'][:8]}" if w.get("actor_id")
+                   else "") + " ===")
+        if w.get("error"):
+            print(f"{head}\n  <no stacks: {w['error']}>")
+        else:
+            print(format_stacks(w, header=head))
+    print(f"--- {len(workers)} worker(s), "
+          f"{sum(1 for w in workers if w.get('error'))} unresponsive ---")
+    return 0
+
+
+def _cmd_profile(args) -> int:
+    """`ray_tpu profile --worker ID [--duration S]` — on-demand sampling
+    profile of one worker; prints a pstats-style table and (with
+    --output) writes flamegraph collapsed-stack text."""
+    from .util.introspect import collapsed_to_text, profile_to_text
+
+    payload = {"worker_id": args.worker, "duration_s": args.duration,
+               "interval_s": args.interval}
+    if getattr(args, "address", ""):
+        ch = _head_channel(args)
+        try:
+            res = ch.call("profile_worker", payload,
+                          timeout=args.duration + 60)
+        finally:
+            ch.close()
+    else:
+        from .core import runtime as runtime_mod
+
+        if runtime_mod.maybe_runtime() is None:
+            return _no_runtime_help()
+        from .util import state
+
+        res = state.profile_worker(args.worker, duration_s=args.duration,
+                                   interval_s=args.interval)
+    print(f"worker {res.get('worker_id', '')[:12]} "
+          f"node={res.get('node_id', '')[:8]} pid={res.get('pid')}")
+    print(profile_to_text(res, top=args.top))
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(collapsed_to_text(res) + "\n")
+        print(f"wrote collapsed stacks to {args.output} "
+              f"(flamegraph.pl / speedscope input)")
+    return 0
+
+
 def _cmd_status(args) -> int:
     from .core import runtime as runtime_mod
 
@@ -325,6 +464,51 @@ def main(argv=None) -> int:
     tl = sub.add_parser("timeline", help="export Chrome-trace of task events")
     tl.add_argument("--output", default="/tmp/ray_tpu_timeline.json")
     tl.set_defaults(fn=_cmd_timeline)
+
+    lg = sub.add_parser(
+        "logs", help="query/stream the cluster's attributed worker logs "
+                     "(ref: `ray logs`); from the driver process or with "
+                     "--address against a running head")
+    lg.add_argument("--follow", "-f", action="store_true",
+                    help="keep streaming new lines (long-poll)")
+    lg.add_argument("--task", default="", help="task id (hex prefix)")
+    lg.add_argument("--actor", default="", help="actor id (hex prefix)")
+    lg.add_argument("--worker", default="", help="worker id (hex prefix)")
+    lg.add_argument("--node", default="", help="node id (hex prefix)")
+    lg.add_argument("--job", default="", help="job id (hex prefix)")
+    lg.add_argument("--stream", default="",
+                    choices=["", "stdout", "stderr", "log"])
+    lg.add_argument("--errors", action="store_true",
+                    help="only stderr lines and WARNING+ structured logs")
+    lg.add_argument("--limit", type=int, default=200)
+    lg.add_argument("--address", default="",
+                    help="head HOST:PORT (omit for the in-process head)")
+    lg.add_argument("--authkey", default="")
+    lg.set_defaults(fn=_cmd_logs)
+
+    sk = sub.add_parser(
+        "stack", help="dump merged thread stacks of the driver and every "
+                      "live worker (ref: `ray stack`)")
+    sk.add_argument("--timeout", type=float, default=5.0)
+    sk.add_argument("--address", default="",
+                    help="head HOST:PORT (omit for the in-process head)")
+    sk.add_argument("--authkey", default="")
+    sk.set_defaults(fn=_cmd_stack)
+
+    pf = sub.add_parser(
+        "profile", help="on-demand sampling profile of one worker "
+                        "(pstats-style table + flamegraph collapsed "
+                        "stacks)")
+    pf.add_argument("--worker", required=True,
+                    help="worker id (hex prefix; see `ray_tpu stack`)")
+    pf.add_argument("--duration", type=float, default=5.0)
+    pf.add_argument("--interval", type=float, default=0.01)
+    pf.add_argument("--top", type=int, default=25)
+    pf.add_argument("--output", default="",
+                    help="write flamegraph collapsed-stack text here")
+    pf.add_argument("--address", default="")
+    pf.add_argument("--authkey", default="")
+    pf.set_defaults(fn=_cmd_profile)
 
     sj = sub.add_parser(
         "submit", help="run an entrypoint command as a job on a running "

@@ -86,9 +86,25 @@ _define("capture_worker_logs", 1,
         "tee every worker's stdout/stderr over its node channel into the "
         "head's bounded log store (dashboard log view / state API); "
         "0 = only remote workers forward, for console display")
-_define("worker_log_history", 4000,
-        "lines of worker stdout/stderr retained in the head's in-memory "
-        "log store (ring buffer)")
+_define("log_store_max_bytes", 16 * 1024 * 1024,
+        "byte budget for the head's attributed log store; oldest records "
+        "evict first (ref: dashboard log retention)")
+_define("log_batch_lines", 200,
+        "worker-side log forwarder flushes when this many lines are "
+        "pending (or on the flush interval, whichever first)")
+_define("log_flush_interval_s", 0.2,
+        "worker-side log forwarder flush cadence")
+_define("log_rate_limit_lines_per_s", 2000.0,
+        "per-worker log forwarding budget; lines over it are DROPPED "
+        "(counted in ray_tpu_logs_dropped_total) — capture must never "
+        "block or OOM the task")
+_define("agent_log_ring_lines", 2000,
+        "per-worker log ring retained on each node agent (local triage "
+        "when the head evicted or the link dropped batches)")
+_define("log_to_driver", 1,
+        "mirror remote workers' stdout/stderr onto the driver console "
+        "with a colored (worker pid=, node=) prefix; 0 silences the "
+        "mirror (records still reach the head store)")
 _define("worker_task_prefetch", 16,
         "max same-signature tasks pushed onto one leased worker's queue "
         "(executed sequentially; only the lease's resources are held). "

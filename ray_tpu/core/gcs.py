@@ -154,6 +154,12 @@ class Gcs:
 
         self._task_events: deque = deque(
             maxlen=int(config.task_events_max_buffered))
+        # attributed worker log records (stdout/stderr/structured),
+        # byte-budgeted with long-poll follow — the `ray logs` analog
+        # (ref: dashboard/modules/log/log_manager.py; gcs as the index)
+        from .log_store import LogStore
+
+        self.logs = LogStore(max_bytes=int(config.log_store_max_bytes))
         # task_id -> (last_state, last_time, name): feeds phase histograms
         self._phase_marks: Dict[str, tuple] = {}
         self._storage_path = storage_path

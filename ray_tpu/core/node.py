@@ -669,6 +669,32 @@ class Node:
         with self._lock:
             return self._workers.get(worker_id)
 
+    def list_workers(self) -> List[WorkerHandle]:
+        with self._lock:
+            return list(self._workers.values())
+
+    # ---- on-demand introspection (ref: `ray stack` per-node fan-out) ---------
+
+    def worker_stack(self, worker: WorkerHandle,
+                     timeout: float = 5.0) -> dict:
+        """One worker's thread stacks, served by its dump_stacks RPC
+        (answered from the worker's handler pool — works while the
+        executor thread is blocked in user code or get())."""
+        if worker.channel is None or worker.channel.closed:
+            raise RuntimeError("worker has no live channel")
+        return worker.channel.call("dump_stacks", None, timeout=timeout)
+
+    def worker_profile(self, worker: WorkerHandle, duration_s: float = 5.0,
+                       interval_s: float = 0.01) -> dict:
+        """On-demand sampling profile of one worker (start/stop happens
+        worker-side; the call returns the aggregated result)."""
+        if worker.channel is None or worker.channel.closed:
+            raise RuntimeError("worker has no live channel")
+        return worker.channel.call(
+            "profile", {"duration_s": float(duration_s),
+                        "interval_s": float(interval_s)},
+            timeout=float(duration_s) + 30.0)
+
     def num_workers(self) -> int:
         with self._lock:
             return len(self._workers)

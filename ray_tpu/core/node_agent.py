@@ -73,6 +73,13 @@ class NodeAgent:
         self._lock = instrumented_lock("node_agent", reentrant=True)
         self._procs: Dict[WorkerId, subprocess.Popen] = {}
         self._channels: Dict[WorkerId, RpcChannel] = {}
+        # bounded per-worker log ring: the local tail survives head-side
+        # eviction / link loss for on-node triage (ref: per-node worker
+        # log files in the reference; here in-memory, byte-light)
+        from collections import deque as _deque
+
+        self._log_ring_lines = int(self.config.agent_log_ring_lines)
+        self._log_rings: Dict[WorkerId, _deque] = {}
         self._stopped = threading.Event()
         self._sock_path = os.path.join(
             self.session_dir, f"agent_{self.node_id.hex()[:12]}.sock")
@@ -161,6 +168,35 @@ class NodeAgent:
             return True
         if method == "store_stats":
             return self.store.stats()
+        if method == "worker_stack":
+            # on-demand stack dump relay: head -> this agent -> worker
+            # (remote workers have no head-side channel; ref: `ray stack`
+            # fans out through each node's agent)
+            ch = self._channels.get(payload["worker_id"])
+            if ch is None or ch.closed:
+                raise RuntimeError("worker is not connected to this agent")
+            return ch.call("dump_stacks", None,
+                           timeout=float(payload.get("timeout", 5.0)))
+        if method == "worker_profile":
+            ch = self._channels.get(payload["worker_id"])
+            if ch is None or ch.closed:
+                raise RuntimeError("worker is not connected to this agent")
+            duration = float(payload.get("duration_s", 5.0))
+            return ch.call("profile",
+                           {"duration_s": duration,
+                            "interval_s": payload.get("interval_s", 0.01)},
+                           timeout=duration + 30.0)
+        if method == "agent_logs":
+            # the local per-worker ring (head-store-independent tail)
+            wid = payload.get("worker_id")
+            with self._lock:
+                rings = ([self._log_rings.get(wid)] if wid is not None
+                         else list(self._log_rings.values()))
+            out = []
+            for ring in rings:
+                if ring:
+                    out.extend(list(ring))
+            return out[-int(payload.get("limit", 1000)):]
         if method == "object_info":
             seg = self.store.get_segment(payload["object_id"])
             return None if seg is None else seg[1]
@@ -331,6 +367,27 @@ class NodeAgent:
                 return self._get_objects(payload["ids"],
                                          payload.get("timeout"))
             if method in ("log_event", "worker_log", "metrics_push"):
+                if method == "worker_log":
+                    from collections import deque as _deque
+
+                    with self._lock:
+                        ring = self._log_rings.get(wid)
+                        if ring is None:
+                            # rings outlive their worker (post-mortem
+                            # tail) but the table stays bounded: evict
+                            # a dead worker's ring past the cap
+                            if len(self._log_rings) >= 64:
+                                for old in list(self._log_rings):
+                                    if old not in self._channels:
+                                        self._log_rings.pop(old, None)
+                                        break
+                            ring = self._log_rings[wid] = _deque(
+                                maxlen=self._log_ring_lines)
+                        whex = wid.hex() if wid is not None else ""
+                        for rec in payload.get("recs", ()):
+                            ring.append({"worker_id": whex,
+                                         "pid": payload.get("pid"),
+                                         "rec": list(rec)})
                 self.head.notify("worker_call", {"worker_id": wid,
                                                  "method": method,
                                                  "payload": payload})
@@ -486,8 +543,10 @@ def main(argv=None) -> int:
                       labels=json.loads(args.labels),
                       node_id=NodeId(bytes.fromhex(args.node_id))
                       if args.node_id else None)
-    print(f"ray_tpu node agent {agent.node_id.hex()[:12]} joined "
-          f"{args.address}", flush=True)
+    from ..util.logs import get_logger
+
+    get_logger("ray_tpu.agent").info(
+        "node agent %s joined %s", agent.node_id.hex()[:12], args.address)
     try:
         agent.wait()
     except KeyboardInterrupt:

@@ -240,6 +240,23 @@ class RemoteNode(Node):
         except Exception:
             pass
 
+    # ---- on-demand introspection (relayed through the agent) -----------------
+
+    def worker_stack(self, worker: WorkerHandle,
+                     timeout: float = 5.0) -> dict:
+        return self.channel.call(
+            "worker_stack", {"worker_id": worker.worker_id,
+                             "timeout": float(timeout)},
+            timeout=float(timeout) + 10.0)
+
+    def worker_profile(self, worker: WorkerHandle, duration_s: float = 5.0,
+                       interval_s: float = 0.01) -> dict:
+        return self.channel.call(
+            "worker_profile", {"worker_id": worker.worker_id,
+                               "duration_s": float(duration_s),
+                               "interval_s": float(interval_s)},
+            timeout=float(duration_s) + 40.0)
+
     # ---- object transfer -----------------------------------------------------
 
     def pull_object_bytes(self, oid: ObjectId) -> Optional[bytes]:

@@ -144,13 +144,14 @@ class DriverRuntime:
         # return-object id -> ObjectIds of refs nested in its result
         # (pinned until the return object is freed; borrower protocol)
         self._nested_refs: Dict[ObjectId, list] = {}
-        # bounded worker stdout/stderr store (dashboard log view;
-        # ref: dashboard/modules/log/log_manager.py — there files+agents,
-        # here the lines already ride the worker channels)
-        from collections import deque as _deque
+        # attributed worker logs live in gcs.logs (LogStore); the mirror
+        # prints remote workers' lines on the driver console with a
+        # colored provenance prefix + repeated-line dedup (ref:
+        # log_monitor.py -> driver stdout mirroring, `log_to_driver`)
+        from ..util.logs import DriverMirror
 
-        self._worker_logs: _deque = _deque(
-            maxlen=int(self.config.worker_log_history))
+        self._log_mirror = DriverMirror(
+            enabled=bool(int(self.config.log_to_driver)))
         self._pull_futures: Dict[ObjectId, Future] = {}
         self._generators: Dict[TaskId, dict] = {}
         self._released_generators: Set[TaskId] = set()
@@ -274,6 +275,19 @@ class DriverRuntime:
                 return [{"node_id": n.node_id.hex(), "alive": n.alive,
                          "resources": dict(n.total_resources)}
                         for n in self.gcs.nodes()]
+            # debugging plane, served to unregistered channels too so
+            # `ray_tpu logs/stack/profile --address H:P` work against a
+            # running head (ref: `ray logs` / `ray stack` CLI)
+            if method == "logs_query":
+                return self.query_logs(**(payload or {}))
+            if method == "stack_report":
+                return self.stack_report(
+                    float((payload or {}).get("timeout", 5.0)))
+            if method == "profile_worker":
+                return self.profile_worker(
+                    payload["worker_id"],
+                    duration_s=float(payload.get("duration_s", 5.0)),
+                    interval_s=float(payload.get("interval_s", 0.01)))
             if method == "stop_job":
                 from .. import jobs
 
@@ -1682,21 +1696,140 @@ class DriverRuntime:
 
         return on_block, unblock
 
+    def query_logs(self, **kw) -> dict:
+        """Attributed log query against the GCS LogStore —
+        {"records": [...], "cursor": n}; kwargs are LogStore.query's
+        (job/task/actor/worker/node id prefixes, stream, errors_only,
+        since, limit, follow_timeout)."""
+        return self.gcs.logs.query(**kw)
+
     def recent_logs(self, worker_id: Optional[str] = None,
                     node_id: Optional[str] = None,
                     pid: Optional[int] = None,
                     limit: int = 500) -> list:
-        """Tail of the worker stdout/stderr store, optionally filtered
-        (dashboard log view / `util.state.recent_logs`)."""
-        with self._lock:
-            rows = list(self._worker_logs)
-        if worker_id:
-            rows = [r for r in rows if r["worker_id"].startswith(worker_id)]
-        if node_id:
-            rows = [r for r in rows if r["node_id"].startswith(node_id)]
+        """Legacy tail view over the attributed store (dashboard log
+        view / `util.state.recent_logs`); rows keep the pre-LogStore
+        `t` field alongside `ts`."""
+        res = self.gcs.logs.query(worker_id=worker_id or None,
+                                  node_id=node_id or None,
+                                  limit=max(limit, 1)
+                                  if not pid else 100000)
+        rows = [{**r, "t": r.get("ts")} for r in res["records"]]
         if pid:
-            rows = [r for r in rows if r["pid"] == pid]
+            rows = [r for r in rows if r.get("pid") == pid]
         return rows[-limit:]
+
+    def stack_report(self, timeout_s: float = 5.0) -> dict:
+        """Merged thread stacks from the driver and EVERY live worker
+        (local and remote), fanned out in parallel — the `ray stack`
+        analog. Workers that fail to answer in time appear with an
+        `error` entry instead of blocking the merge."""
+        from ..util.introspect import dump_stacks
+
+        report = {"driver": dump_stacks(), "workers": []}
+        targets = []
+        for node in list(self.nodes.values()):
+            if not node.alive:
+                continue
+            for w in node.list_workers():
+                targets.append((node, w))
+
+        def one(node, w):
+            base = {"node_id": node.node_id.hex(),
+                    "worker_id": w.worker_id.hex(),
+                    "pid": w.pid, "state": w.state,
+                    "actor_id": w.actor_id.hex() if w.actor_id else ""}
+            try:
+                base.update(node.worker_stack(w, timeout=timeout_s))
+            except Exception as e:
+                base["error"] = repr(e)
+            return base
+
+        if targets:
+            pool = ThreadPoolExecutor(
+                max_workers=min(16, len(targets)),
+                thread_name_prefix="stack-fanout")
+            try:
+                futs = [pool.submit(one, n, w) for n, w in targets]
+                for f in futs:
+                    try:
+                        report["workers"].append(
+                            f.result(timeout=timeout_s + 15.0))
+                    except Exception as e:  # noqa: BLE001 — merge goes on
+                        report["workers"].append({"error": repr(e)})
+            finally:
+                pool.shutdown(wait=False)
+        return report
+
+    def profile_worker(self, worker_id_prefix: str,
+                       duration_s: float = 5.0,
+                       interval_s: float = 0.01) -> dict:
+        """On-demand sampling profile of one live worker, addressed by
+        worker-id prefix; returns the collapsed-stack + function table
+        result (ray_tpu profile CLI / state API)."""
+        for node in list(self.nodes.values()):
+            if not node.alive:
+                continue
+            for w in node.list_workers():
+                if w.worker_id.hex().startswith(worker_id_prefix):
+                    res = node.worker_profile(w, duration_s=duration_s,
+                                              interval_s=interval_s)
+                    res["worker_id"] = w.worker_id.hex()
+                    res["node_id"] = node.node_id.hex()
+                    return res
+        raise ValueError(
+            f"no live worker with id prefix {worker_id_prefix!r}")
+
+    def _ingest_worker_logs(self, node: Node,
+                            worker: Optional[WorkerHandle],
+                            payload: dict) -> None:
+        """A worker_log batch arrived: stamp node/worker provenance,
+        index into the GCS LogStore, and mirror remote stdout/stderr to
+        the driver console."""
+        from ..util import logs as logs_mod
+
+        recs = payload.get("recs") or ()
+        pid = payload.get("pid")
+        nhex = node.node_id.hex()
+        whex = worker.worker_id.hex() if worker is not None else ""
+        out = []
+        mirror: Dict[str, list] = {}
+        counts: Dict[str, int] = {}
+        for rec in recs:
+            try:
+                stream, seq, ts, job, task, actor, level, line = rec
+            except Exception:
+                continue  # one malformed record must not drop the batch
+            out.append({"ts": ts, "node_id": nhex, "worker_id": whex,
+                        "pid": pid, "job_id": job, "task_id": task,
+                        "actor_id": actor, "stream": stream,
+                        "level": level, "seq": seq, "line": line})
+            counts[stream] = counts.get(stream, 0) + 1
+            if stream in ("stdout", "stderr"):
+                mirror.setdefault(stream, []).append(line)
+            elif stream == "log":
+                # structured lines (incl. the rpdb connect banner) must
+                # reach the driver console too — the remote machine's
+                # stderr is invisible to the operator
+                mirror.setdefault("log", []).append(
+                    f"{level} {line}" if level else line)
+        dropped = int(payload.get("dropped") or 0)
+        if dropped:
+            # surface the gap IN the stream, where a reader will see it
+            out.append({"ts": time.time(), "node_id": nhex,
+                        "worker_id": whex, "pid": pid, "job_id": "",
+                        "task_id": "", "actor_id": "", "stream": "log",
+                        "level": "WARNING", "seq": -1,
+                        "line": f"[ray_tpu] {dropped} log line(s) dropped "
+                                f"by the per-worker rate limit"})
+        if not out:
+            return
+        self.gcs.logs.append(out)
+        for stream, n in counts.items():
+            logs_mod.LINES_TOTAL.inc(n, tags={"stream": stream})
+        if getattr(node, "is_remote", False):
+            for stream, lines in mirror.items():
+                self._log_mirror.emit(nhex, pid, stream, lines)
 
     def handle_worker_call(self, node: Node, worker: Optional[WorkerHandle],
                            method: str, payload):
@@ -1860,30 +1993,11 @@ class DriverRuntime:
         if method == "task_events":
             return list(self.gcs.task_events())
         if method == "worker_log":
-            # remote workers' stdout/stderr surface on the driver console
-            # with a provenance prefix (ref: log_monitor.py -> driver
-            # stdout with the (name pid=..., ip=...) prefix); every
-            # forwarded line also lands in the bounded log store that
-            # backs the dashboard's log view and util.state.recent_logs
-            now = time.time()
-            wid = worker.worker_id.hex() if worker is not None else ""
-            with self._lock:
-                for line in payload.get("lines", ()):
-                    self._worker_logs.append(
-                        {"t": now, "node_id": node.node_id.hex(),
-                         "worker_id": wid, "pid": payload.get("pid"),
-                         "stream": payload.get("stream", "stdout"),
-                         "line": line})
-            if getattr(node, "is_remote", False):
-                import sys as _sys
-
-                out = (_sys.stderr if payload.get("stream") == "stderr"
-                       else _sys.stdout)
-                prefix = (f"(worker pid={payload.get('pid')}, "
-                          f"node={node.node_id.hex()[:8]}) ")
-                for line in payload.get("lines", ()):
-                    print(prefix + line, file=out)
+            # attributed log batches: LogStore index + driver mirroring
+            self._ingest_worker_logs(node, worker, payload or {})
             return None
+        if method == "logs_query":
+            return self.query_logs(**(payload or {}))
         raise ValueError(f"unknown worker call: {method}")
 
     # ---- cancellation --------------------------------------------------------

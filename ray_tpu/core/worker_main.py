@@ -38,55 +38,11 @@ from .task_spec import (ARG_REF, ARG_VALUE, STREAMING_RETURNS, TaskSpec,
                         TaskType)
 
 
-class _StreamTee:
-    """Line-buffered tee of a worker's stdout/stderr to the node channel —
-    the log plane (ref: python/ray/_private/log_monitor.py tails worker
-    log files to the driver; here lines ride the existing RPC channel).
-    Local writes still reach the original stream (the agent's console)."""
-
-    def __init__(self, channel: RpcChannel, stream: str, orig):
-        self._ch = channel
-        self._stream = stream
-        self._orig = orig
-        self._buf = ""
-        self._lock = threading.Lock()
-        # file-object surface libraries probe before writing
-        self.encoding = getattr(orig, "encoding", "utf-8")
-        self.errors = getattr(orig, "errors", "strict")
-
-    def writelines(self, lines) -> None:
-        for line in lines:
-            self.write(line)
-
-    @property
-    def buffer(self):
-        return getattr(self._orig, "buffer", self._orig)
-
-    def write(self, s: str) -> int:
-        self._orig.write(s)
-        lines = None
-        with self._lock:
-            self._buf += s
-            if "\n" in self._buf:
-                done, self._buf = self._buf.rsplit("\n", 1)
-                lines = done.split("\n")
-        if lines:
-            try:
-                self._ch.notify("worker_log", {
-                    "stream": self._stream, "lines": lines,
-                    "pid": os.getpid()})
-            except Exception:
-                pass  # channel down: the local stream still has the line
-        return len(s)
-
-    def flush(self) -> None:
-        self._orig.flush()
-
-    def isatty(self) -> bool:
-        return False
-
-    def fileno(self):
-        return self._orig.fileno()
+# line-buffered stdout/stderr capture now lives in util/logs.py
+# (StreamTee -> LogBatcher): lines are stamped with {stream, seq, ts,
+# job/task/actor} from the current-task contextvar, batched, and
+# rate-limited before riding the channel — see that module's docstring.
+from ..util.logs import LogBatcher, StreamTee as _StreamTee  # noqa: E402
 
 
 def _aiter_to_iter(agen):
@@ -214,6 +170,27 @@ class WorkerProcess:
             0.1, float(_cfg.metrics_export_interval_s))
         threading.Thread(target=self._metrics_loop, daemon=True,
                          name="worker-metrics").start()
+        # outbound log plane: stdout/stderr tees and the structured
+        # logger emit into this batcher; attribution is read from the
+        # current-task contextvar at write time (async-actor lines on
+        # one loop thread attribute to their own asyncio.Task context)
+        self.log_batcher = LogBatcher(
+            send=lambda p: self.channel.notify("worker_log", p),
+            task_ids=self._current_task_ids,
+            batch_lines=int(_cfg.log_batch_lines),
+            flush_interval_s=float(_cfg.log_flush_interval_s),
+            rate_lines_per_s=float(_cfg.log_rate_limit_lines_per_s))
+        self._profiling = threading.Lock()  # one profile run at a time
+
+    def _current_task_ids(self):
+        spec = self.runtime.current_task()
+        if spec is None:
+            # actor workers between calls: background threads still
+            # attribute to the resident actor
+            aid = self._actor_id.hex() if self._actor_id else ""
+            return ("", "", aid)
+        aid = spec.actor_id.hex() if spec.actor_id else ""
+        return (spec.job_id.hex(), spec.task_id.hex(), aid)
 
     def _flush_metrics(self, min_interval: Optional[float] = None) -> None:
         now = time.monotonic()
@@ -259,6 +236,30 @@ class WorkerProcess:
             return None
         if method == "ping":
             return "pong"
+        if method == "dump_stacks":
+            # answered from the RPC handler pool — works while the main
+            # executor thread is wedged in user code or a blocking get()
+            # (ref: `ray stack`; the SIGUSR1 faulthandler hook remains
+            # the signal-safe fallback when even RPC is unresponsive)
+            from ..util.introspect import dump_stacks
+
+            return dump_stacks()
+        if method == "profile":
+            from ..util.introspect import SamplingProfiler
+
+            if not self._profiling.acquire(blocking=False):
+                raise RuntimeError("a profile run is already active "
+                                   "on this worker")
+            try:
+                prof = SamplingProfiler(
+                    interval_s=float((payload or {}).get("interval_s",
+                                                         0.01)))
+                res = prof.run(float((payload or {}).get("duration_s",
+                                                         5.0)))
+            finally:
+                self._profiling.release()
+            res["pid"] = os.getpid()
+            return res
         if method == "cancel_task":
             self._cancelled.add(payload)
             return None
@@ -555,12 +556,17 @@ def main() -> None:
     resp = channel.call("register", {"worker_id": worker_id,
                                      "pid": os.getpid()}, timeout=30)
     if isinstance(resp, dict) and resp.get("forward_logs"):
-        # remote node: the driver can't see this console — tee prints back
-        sys.stdout = _StreamTee(channel, "stdout", sys.stdout)
-        sys.stderr = _StreamTee(channel, "stderr", sys.stderr)
+        # tee prints into the attributed log plane (and still to the
+        # local console); remote nodes additionally get driver mirroring
+        sys.stdout = _StreamTee(wp.log_batcher, "stdout", sys.stdout)
+        sys.stderr = _StreamTee(wp.log_batcher, "stderr", sys.stderr)
     try:
         wp.run()
     finally:
+        try:
+            wp.log_batcher.stop()  # final flush before the channel drops
+        except Exception:
+            pass
         channel.close()
 
 

@@ -56,7 +56,9 @@ _API = {
     "latency": state_api.latency_summary,
     "jobs": _jobs_rows,
     "serve": _serve_rows,
-    "logs": lambda: state_api.recent_logs(limit=400),
+    "logs": lambda: state_api.logs(limit=400)["records"],
+    "stacks": lambda: state_api.stack_report(timeout=3.0),
+    "log_store": state_api.log_store_stats,
     "timeline": state_api.timeline,
 }
 
@@ -172,7 +174,9 @@ function table(rows){if(!rows||!rows.length)return "<div class=empty>none</div>"
  return html+"</table>"}
 function logLines(rows){if(!rows||!rows.length)return "<div class=empty>no captured output</div>";
  return "<pre style='background:#fff;border:1px solid #e2e5e9;padding:8px;font-size:11px;overflow:auto;max-height:480px'>"+
-  rows.map(r=>`<span style="color:#99a">${new Date(r.t*1000).toLocaleTimeString()} [${esc((r.worker_id||"").slice(0,8))} pid=${esc(r.pid)}${r.stream==="stderr"?" err":""}]</span> ${esc(r.line)}`).join("\\n")+"</pre>"}
+  rows.map(r=>{const attrib=(r.task_id?` task=${esc(r.task_id.slice(0,8))}`:"")+(r.actor_id?` actor=${esc(r.actor_id.slice(0,8))}`:"");
+   const mark=r.stream==="stderr"?" err":(r.stream==="log"?` ${esc(r.level||"INFO")}`:"");
+   return `<span style="color:#99a">${new Date((r.ts||r.t)*1000).toLocaleTimeString()} [${esc((r.worker_id||"").slice(0,8))} pid=${esc(r.pid)}${attrib}${mark}]</span> ${esc(r.line)}`}).join("\\n")+"</pre>"}
 function card(k,v,extra=""){return `<div class=card><div class=v>${esc(v)}</div><div class=k>${esc(k)}</div>${extra}</div>`}
 async function render(){
  TABS.forEach(t=>document.getElementById("tab_"+t).classList.toggle("active",t===tab));
@@ -281,6 +285,30 @@ class Dashboard:
 
             def do_GET(self):  # noqa: N802
                 path = self.path.split("?")[0].strip("/")
+                if path == "api/logs" and "?" in self.path:
+                    # filtered log queries: /api/logs?task=&actor=&
+                    # worker=&node=&stream=&errors=1&limit=N
+                    from urllib.parse import parse_qs, urlparse
+
+                    q = {k: v[0] for k, v in
+                         parse_qs(urlparse(self.path).query).items()}
+                    try:
+                        rows = state_api.logs(
+                            task_id=q.get("task") or None,
+                            actor_id=q.get("actor") or None,
+                            worker_id=q.get("worker") or None,
+                            node_id=q.get("node") or None,
+                            stream=q.get("stream") or None,
+                            errors_only=q.get("errors") in ("1", "true"),
+                            limit=int(q.get("limit", 400)))["records"]
+                        self._send(200, json.dumps(
+                            rows, default=str).encode(),
+                            "application/json")
+                    except Exception as e:  # noqa: BLE001
+                        self._send(500, json.dumps(
+                            {"error": repr(e)}).encode(),
+                            "application/json")
+                    return
                 if path == "api/metrics_history":
                     samples = (dash._sampler.snapshot()
                                if dash._sampler is not None else [])

@@ -20,6 +20,12 @@ GC005  bare ``except:`` that never re-raises — swallows ``TaskError`` /
        ``ActorDiedError`` / ``SystemExit`` and hides worker death
 GC006  ``lock.acquire()`` outside ``with``/try-finally — the lock leaks
        on any exception path and wedges every later acquirer
+GC007  bare ``print()`` in ``ray_tpu`` library code — un-attributed,
+       un-queryable output; route it through the structured logger
+       (``ray_tpu.util.logs.get_logger``) so it reaches the cluster log
+       store with task attribution. User-facing surfaces (CLI,
+       dashboard, devtools, examples, tests, scripts) are exempt by
+       path; load-bearing prints take a line suppression.
 ====== =================================================================
 
 Suppression: append ``# graftcheck: disable=GC001`` (comma-separate for
@@ -57,7 +63,23 @@ RULES: Dict[str, str] = {
              "ActorDiedError/SystemExit",
     "GC006": "lock.acquire() without with-statement or try/finally release "
              "(leaks the lock on exception paths)",
+    "GC007": "bare print() in library code (use the structured logger "
+             "ray_tpu.util.logs.get_logger so output is attributed and "
+             "queryable)",
 }
+
+# GC007 targets library code only: user-facing surfaces where print IS
+# the product are exempt by path (basename or any path segment)
+_GC007_EXEMPT_BASENAMES = {"cli.py", "dashboard.py", "__main__.py"}
+_GC007_EXEMPT_SEGMENTS = {"examples", "devtools", "scripts", "tests",
+                          "docs", "bench"}
+
+
+def _gc007_exempt(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    if os.path.basename(norm) in _GC007_EXEMPT_BASENAMES:
+        return True
+    return bool(_GC007_EXEMPT_SEGMENTS.intersection(norm.split("/")))
 
 # module-level constructors whose results cannot ride a cloudpickle'd
 # closure into a worker process
@@ -214,6 +236,8 @@ class _FileChecker:
         per_line, file_wide = _parse_suppressions(source)
         self._suppress_line = per_line
         self._suppress_file = file_wide
+        if _gc007_exempt(path):
+            self._suppress_file = set(file_wide) | {"GC007"}
         self.tree = tree
         # module-level unserializable objects: name -> ctor description
         self.module_unserializable: Dict[str, str] = {}
@@ -341,6 +365,13 @@ class _FileChecker:
     def _check_expr(self, node: ast.AST, remote: bool, is_async: bool,
                     fn: Optional[dict]) -> None:
         if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name) and node.func.id == "print":
+                self.report(
+                    "GC007", node,
+                    "bare print() in library code is un-attributed and "
+                    "un-queryable; use ray_tpu.util.logs.get_logger() so "
+                    "the line reaches the cluster log store with task "
+                    "attribution (suppress where print IS the surface)")
             if remote:
                 self._check_gc001(node)
             if is_async:

@@ -71,9 +71,14 @@ class RemotePdb(pdb.Pdb):
 
     def interact(self, frame) -> None:
         if not self._quiet:
-            print(f"RemotePdb waiting on {self.addr[0]}:{self.addr[1]} "
-                  f"(connect with: nc {self.addr[0]} {self.addr[1]})",
-                  file=sys.stderr, flush=True)
+            # the structured channel carries the banner to the driver's
+            # log store with task attribution (the operator needs the
+            # connect address even when this worker's console is remote)
+            from .logs import get_logger
+
+            get_logger("ray_tpu.rpdb").warning(
+                "RemotePdb waiting on %s:%s (connect with: nc %s %s)",
+                self.addr[0], self.addr[1], self.addr[0], self.addr[1])
         conn, _ = self._listener.accept()
         self._io = _SocketIO(conn)
         super().__init__(stdin=self._io, stdout=self._io)

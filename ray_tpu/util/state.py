@@ -44,11 +44,49 @@ def list_nodes() -> List[Dict[str, Any]]:
 def recent_logs(worker_id: Optional[str] = None,
                 node_id: Optional[str] = None, pid: Optional[int] = None,
                 limit: int = 500) -> List[Dict[str, Any]]:
-    """Tail of worker stdout/stderr captured on the head (ref:
-    dashboard/modules/log/log_manager.py — there via log files + agents,
-    here the lines ride the worker RPC channels into a ring buffer)."""
+    """Legacy tail of worker stdout/stderr captured on the head; see
+    :func:`logs` for the attributed/filterable surface."""
     return _rt().recent_logs(worker_id=worker_id, node_id=node_id,
                              pid=pid, limit=limit)
+
+
+def logs(job_id: Optional[str] = None, task_id: Optional[str] = None,
+         actor_id: Optional[str] = None, worker_id: Optional[str] = None,
+         node_id: Optional[str] = None, stream: Optional[str] = None,
+         errors_only: bool = False, since: Optional[int] = None,
+         limit: int = 500,
+         follow_timeout: Optional[float] = None) -> Dict[str, Any]:
+    """Attributed cluster log query (the `ray logs` analog): records
+    carry {ts, node_id, worker_id, pid, job_id, task_id, actor_id,
+    stream, level, seq, line}; id filters match hex prefixes. Returns
+    {"records": [...], "cursor": n} — pass `since=cursor` (optionally
+    with `follow_timeout`) to stream new lines."""
+    return _rt().query_logs(job_id=job_id, task_id=task_id,
+                            actor_id=actor_id, worker_id=worker_id,
+                            node_id=node_id, stream=stream,
+                            errors_only=errors_only, since=since,
+                            limit=limit, follow_timeout=follow_timeout)
+
+
+def stack_report(timeout: float = 5.0) -> Dict[str, Any]:
+    """Merged thread stacks from the driver and every live worker
+    (`ray stack` analog): {"driver": {...}, "workers": [{node_id,
+    worker_id, pid, state, actor_id, threads|error}]}."""
+    return _rt().stack_report(timeout_s=timeout)
+
+
+def profile_worker(worker_id_prefix: str, duration_s: float = 5.0,
+                   interval_s: float = 0.01) -> Dict[str, Any]:
+    """On-demand sampling profile of one live worker; the result feeds
+    introspect.profile_to_text / collapsed_to_text."""
+    return _rt().profile_worker(worker_id_prefix, duration_s=duration_s,
+                                interval_s=interval_s)
+
+
+def log_store_stats() -> Dict[str, int]:
+    """Retention counters of the head's log store (lines, bytes,
+    evicted; the byte budget is config `log_store_max_bytes`)."""
+    return _rt().gcs.logs.stats()
 
 
 def actor_detail(actor_id_prefix: str) -> Optional[Dict[str, Any]]:
@@ -72,8 +110,12 @@ def actor_detail(actor_id_prefix: str) -> Optional[Dict[str, Any]]:
                 "death_cause": a.death_cause,
                 "detached": a.detached,
                 "recent_events": events[-50:],
-                "logs": (rt.recent_logs(worker_id=wid, limit=200)
-                         if wid else []),
+                # attributed store: actor-stamped lines first (survives
+                # worker restarts), worker tail as the fallback
+                "logs": (rt.query_logs(actor_id=a.actor_id.hex(),
+                                       limit=200)["records"]
+                         or (rt.recent_logs(worker_id=wid, limit=200)
+                             if wid else [])),
             }
     return None
 
@@ -214,10 +256,13 @@ def timeline(output_path: Optional[str] = None) -> List[dict]:
     starts: Dict[str, dict] = {}
     phases: Dict[str, Dict[str, float]] = {}  # tid -> {state: wall time}
     trace: List[dict] = []
+    spans: List[dict] = []
     for e in events:
         tid = e.get("task_id", "")
         state = e.get("state")
-        if state in ("SUBMITTED", "SCHEDULED"):
+        if state == "SPAN":
+            spans.append(e)
+        elif state in ("SUBMITTED", "SCHEDULED"):
             phases.setdefault(tid, {})[state] = e.get("time", 0.0)
         elif state == "RUNNING":
             starts[tid] = e
@@ -250,7 +295,55 @@ def timeline(output_path: Optional[str] = None) -> List[dict]:
                 "tid": tid[:12],
                 "args": args,
             })
+    trace.extend(_span_trace_events(spans))
     if output_path:
         with open(output_path, "w") as f:
             json.dump(trace, f)
     return trace
+
+
+def _span_trace_events(spans: List[dict]) -> List[dict]:
+    """SPAN events -> chrome-trace slices + flow arrows.
+
+    Spans from one OS process share a `spans pid=N` lane on their node's
+    row, so sibling/child spans nest naturally by time containment;
+    parent -> child edges that CROSS processes (submitter's span -> the
+    task's span in a worker) are drawn as flow events (`ph: s/f`, bound
+    by span_id), which Perfetto renders as arrows — the cross-worker
+    call tree (satellite; ref: `ray timeline` + OTel span trees)."""
+    out: List[dict] = []
+    by_id = {s.get("span_id"): s for s in spans}
+
+    def lane(s: dict) -> tuple:
+        node = str(s.get("node_id") or "head")[:12]
+        return node, f"spans pid={s.get('pid', '?')}"
+
+    def bounds(s: dict) -> tuple:
+        t0 = float(s.get("time") or 0.0)
+        return t0, float(s.get("end_time") or t0)
+
+    for s in spans:
+        t0, t1 = bounds(s)
+        pid, tid = lane(s)
+        args = dict(s.get("attributes") or {})
+        args["trace_id"] = s.get("trace_id")
+        args["span_id"] = s.get("span_id")
+        out.append({"name": s.get("name", "span"), "cat": "span",
+                    "ph": "X", "ts": t0 * 1e6,
+                    "dur": max(1.0, (t1 - t0) * 1e6),
+                    "pid": pid, "tid": tid, "args": args})
+        parent = by_id.get(s.get("parent_span_id"))
+        if parent is None:
+            continue
+        p0, p1 = bounds(parent)
+        ppid, ptid = lane(parent)
+        # the flow start must land INSIDE the parent slice to attach
+        anchor = min(max(t0, p0), p1)
+        flow_id = str(s.get("span_id"))
+        out.append({"name": "span-link", "cat": "span", "ph": "s",
+                    "id": flow_id, "pid": ppid, "tid": ptid,
+                    "ts": anchor * 1e6})
+        out.append({"name": "span-link", "cat": "span", "ph": "f",
+                    "bp": "e", "id": flow_id, "pid": pid, "tid": tid,
+                    "ts": t0 * 1e6})
+    return out

@@ -29,6 +29,30 @@ _current: "contextvars.ContextVar[Optional[tuple]]" = contextvars.ContextVar(
 # optional exporter hook: called with each finished span dict
 span_export: Optional[Callable[[dict], None]] = None
 
+# span-export failures are never allowed to break user code, but they
+# must not vanish either: every swallowed failure counts here (shipped
+# to the head's /metrics from workers) and the FIRST one per process
+# warns with the cause (satellite: _record used to drop silently)
+from . import metrics as _metrics  # noqa: E402
+
+SPANS_DROPPED = _metrics.Counter(
+    "ray_tpu_spans_dropped_total",
+    "trace spans dropped before reaching the task-event stream",
+    tag_keys=("reason",))
+_warned_reasons: set = set()
+
+
+def _note_span_drop(reason: str, err: BaseException) -> None:
+    SPANS_DROPPED.inc(tags={"reason": reason})
+    if reason not in _warned_reasons:
+        _warned_reasons.add(reason)
+        import warnings
+
+        warnings.warn(
+            f"tracing: span {reason} export failed ({err!r}); further "
+            f"failures are counted in ray_tpu_spans_dropped_total "
+            f"without warning", RuntimeWarning, stacklevel=3)
+
 
 def _new_id() -> str:
     return os.urandom(8).hex()
@@ -116,24 +140,33 @@ def _record(span: Span) -> None:
         "parent_span_id": span.parent_span_id,
         "time": span.start, "end_time": span.end,
         "attributes": span.attributes,
+        # provenance: timeline() groups span slices into per-process
+        # lanes and draws cross-process flow arrows from these
+        "pid": os.getpid(),
     }
-    if span_export is not None:
-        try:
-            span_export(event)
-        except Exception:
-            pass
     try:
         from ..core import runtime as runtime_mod
 
         rt = runtime_mod.maybe_runtime()
+    except Exception:
+        rt = None
+    if rt is not None:
+        node = getattr(getattr(rt, "worker", None), "node_id_hex", None)
+        event["node_id"] = node or ("head" if hasattr(rt, "gcs") else "")
+    if span_export is not None:
+        try:
+            span_export(event)
+        except Exception as e:  # noqa: BLE001 — counted, warned once
+            _note_span_drop("exporter", e)
+    try:
         if rt is None:
             return
         if hasattr(rt, "gcs"):
             rt.gcs.add_task_event(event)
         else:  # worker/client: ship to the head
             rt.channel.notify("log_event", event)
-    except Exception:
-        pass
+    except Exception as e:  # noqa: BLE001 — counted, warned once
+        _note_span_drop("ship", e)
 
 
 def get_trace(trace_id: str) -> List[dict]:

@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""CI smoke for the log/introspection plane (graftcheck-style gate).
+
+Spins up an in-process head plus one REAL remote node agent (a second
+OS process over localhost TCP), runs chatty tasks on both nodes plus
+one deliberately blocked in get(), then drives the actual CLI surfaces:
+
+- `ray_tpu logs`            -> nonzero attributed lines from BOTH nodes
+- `ray_tpu logs --task ID`  -> only that task's lines
+- `ray_tpu stack`           -> every registered live worker present in
+                               the merge, including the blocked one
+
+Exit 0 = healthy; any assertion prints the evidence and exits 1.
+Run: python scripts/logs_smoke.py   (CI invokes it after promlint)
+"""
+import contextlib
+import io
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import ray_tpu
+    from ray_tpu.cli import main as cli_main
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util import state
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    c = Cluster(head_resources={"CPU": 2.0})
+    try:
+        remote = c.add_remote_node(num_cpus=2.0)
+        pin = NodeAffinitySchedulingStrategy(node_id=remote.node_id,
+                                             soft=False)
+
+        @ray_tpu.remote
+        def chatty(tag):
+            for i in range(5):
+                print(f"smoke-{tag}-{i}")
+            return ray_tpu.get_runtime_context().get_node_id()
+
+        @ray_tpu.remote
+        def slow_dep():
+            time.sleep(6)
+            return 1
+
+        @ray_tpu.remote
+        def blocked(x):
+            return ray_tpu.get(x, timeout=120)  # graftcheck: disable=GC001
+
+        dep = slow_dep.remote()
+        blocked_ref = blocked.remote([dep])
+        local_nid = ray_tpu.get(chatty.remote("local"), timeout=60)
+        remote_nid = ray_tpu.get(
+            chatty.options(scheduling_strategy=pin).remote("remote"),
+            timeout=60)
+        assert remote_nid == remote.node_id.hex()
+        time.sleep(1.5)  # let batches land
+
+        def cli(args):
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                rc = cli_main(args)
+            return rc, buf.getvalue()
+
+        # 1) nonzero lines, both nodes represented
+        rc, out = cli(["logs", "--limit", "1000"])
+        assert rc == 0, f"ray_tpu logs rc={rc}"
+        lines = [ln for ln in out.splitlines() if "smoke-" in ln]
+        assert len(lines) >= 10, f"expected >=10 smoke lines:\n{out}"
+        assert any(local_nid[:8] in ln for ln in lines), out
+        assert any(remote_nid[:8] in ln for ln in lines), out
+
+        # 2) task filtering: only the remote chatty task's lines
+        recs = state.logs(node_id=remote_nid, limit=1000)["records"]
+        tids = {r["task_id"] for r in recs
+                if r["line"].startswith("smoke-remote-")}
+        assert len(tids) == 1 and "" not in tids, tids
+        rc, out = cli(["logs", "--task", tids.pop(), "--limit", "1000"])
+        assert rc == 0
+        got = [ln for ln in out.splitlines() if "smoke-" in ln]
+        assert got and all("smoke-remote-" in ln for ln in got), out
+
+        # 3) stack merge covers every registered live worker
+        live = set()
+        for node in c.runtime.nodes.values():
+            if not node.alive:
+                continue
+            for w in node.list_workers():
+                if w.state not in ("starting", "dead"):
+                    live.add(w.worker_id.hex()[:12])
+        rc, out = cli(["stack"])
+        assert rc == 0, f"ray_tpu stack rc={rc}"
+        assert "=== driver pid=" in out
+        reported = set(re.findall(r"=== worker ([0-9a-f]{12}) ", out))
+        missing = live - reported
+        assert not missing, (
+            f"workers missing from stack merge: {missing}\n{out[-4000:]}")
+        assert "get_many" in out or "fetch_one" in out, \
+            "blocked-in-get worker's frames not visible"
+
+        ray_tpu.get(blocked_ref, timeout=120)
+        print(f"logs+stack smoke OK: {len(lines)} lines, "
+              f"{len(reported)} workers in merge")
+        return 0
+    finally:
+        c.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
