@@ -141,6 +141,7 @@ def main() -> None:
             "sec_per_step": round(dt / steps, 4),
             "ppo_env_steps_per_sec": rl_steps_per_sec,
             **_bench_ppo_atari(),
+            **_bench_cgraph_chain(),
         },
     }))
 
@@ -192,6 +193,26 @@ def _probe_achievable_tflops(n: int = 8192, iters: int = 48) -> float:
         return 2 * n * n * n / (delta / iters)
     except Exception:
         return 0.0
+
+
+def _bench_cgraph_chain() -> dict:
+    """Compiled-graph vs dynamic 3-actor chain round trip (ISSUE 4 —
+    tracked per round in BENCH_r*.json detail so the cgraph speedup is a
+    standing regression line next to the model numbers)."""
+    try:
+        import ray_tpu
+        from bench_core import chain_roundtrip_us
+
+        ray_tpu.init(num_cpus=4)
+        try:
+            return chain_roundtrip_us(50 if SMOKE else 300)
+        finally:
+            ray_tpu.shutdown()
+    except Exception:
+        import traceback
+
+        traceback.print_exc()  # broken actor plane must not look like 0
+        return {}
 
 
 def _bench_ppo_steps() -> float:

@@ -28,6 +28,56 @@ def _rate(name: str, count: float, dt: float, unit: str) -> dict:
     return rec
 
 
+def chain_roundtrip_us(n_iters: int = 200) -> dict:
+    """3-actor chain round-trip: the dynamic `.remote()` path vs the same
+    chain compiled into a cgraph pipeline (ISSUE 4 acceptance: compiled
+    must be >= 5x faster). Assumes ray_tpu.init() already ran; returns
+    {remote_chain_roundtrip_us, cgraph_chain_roundtrip_us, cgraph_speedup}
+    for the bench JSON `detail`."""
+    import ray_tpu
+    from ray_tpu.cgraph import InputNode
+
+    @ray_tpu.remote
+    class Stage:
+        def __init__(self, k):
+            self.k = k
+
+        def add(self, x):
+            return x + self.k
+
+    a, b, c = Stage.remote(1), Stage.remote(10), Stage.remote(100)
+
+    # dynamic path: submit -> schedule -> lease -> RPC -> put -> get, x3
+    ray_tpu.get(c.add.remote(b.add.remote(a.add.remote(0))), timeout=120)
+    n_remote = max(10, n_iters // 4)
+    t0 = time.perf_counter()
+    for i in range(n_remote):
+        out = ray_tpu.get(c.add.remote(b.add.remote(a.add.remote(i))),
+                          timeout=120)
+        assert out == i + 111
+    remote_us = (time.perf_counter() - t0) / n_remote * 1e6
+
+    # compiled path: pre-allocated channels + resident loops, zero
+    # per-call scheduling
+    with InputNode() as inp:
+        dag = c.add.bind(b.add.bind(a.add.bind(inp)))
+    compiled = dag.experimental_compile()
+    try:
+        for i in range(10):  # warm the loops + channel attachments
+            compiled.execute(i).get(timeout=60)
+        t0 = time.perf_counter()
+        for i in range(n_iters):
+            assert compiled.execute(i).get(timeout=60) == i + 111
+        cgraph_us = (time.perf_counter() - t0) / n_iters * 1e6
+    finally:
+        compiled.teardown()
+    return {
+        "remote_chain_roundtrip_us": round(remote_us, 1),
+        "cgraph_chain_roundtrip_us": round(cgraph_us, 1),
+        "cgraph_speedup": round(remote_us / cgraph_us, 2),
+    }
+
+
 def main() -> int:
     import ray_tpu
 
@@ -121,6 +171,17 @@ def main() -> int:
     assert vals == list(range(64))
     rec = {"metric": "returns_per_task", "value": 64,
            "unit": f"returns in {round(time.perf_counter() - t0, 2)}s"}
+    print(json.dumps(rec), flush=True)
+    results.append(rec)
+
+    # -- compiled graph vs .remote() chain (ISSUE 4: >= 5x) -----------------
+    chain = chain_roundtrip_us(50 if SMOKE else 300)
+    for name in ("remote_chain_roundtrip_us", "cgraph_chain_roundtrip_us"):
+        rec = {"metric": name, "value": chain[name], "unit": "us"}
+        print(json.dumps(rec), flush=True)
+        results.append(rec)
+    rec = {"metric": "cgraph_speedup", "value": chain["cgraph_speedup"],
+           "unit": "x"}
     print(json.dumps(rec), flush=True)
     results.append(rec)
 

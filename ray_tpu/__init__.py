@@ -13,6 +13,8 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from ._version import __version__
 from . import exceptions
+from . import cgraph
+from .cgraph import InputNode, MultiOutputNode
 from .core import runtime as _runtime_mod
 from .core.actor import ActorClass, ActorHandle, get_actor
 from .core.config import Config
@@ -31,6 +33,7 @@ __all__ = [
     "ObjectRefGenerator",
     "placement_group", "remove_placement_group", "placement_group_table",
     "PlacementGroup", "exceptions", "method", "__version__",
+    "cgraph", "InputNode", "MultiOutputNode",
 ]
 
 

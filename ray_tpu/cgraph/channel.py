@@ -1,0 +1,265 @@
+"""Compiled-graph channels: pre-allocated, single-slot, point-to-point.
+
+The data plane of `ray_tpu.cgraph` (ref: the reference's accelerated-DAG
+channels — python/ray/experimental/channel/shared_memory_channel.py: a
+mutable plasma object written in place per execution instead of one
+immutable object per call). Two transports behind one reader/writer
+contract:
+
+- ``ShmChannel``: a pinned, never-sealed PlasmaStore segment
+  (``store.allocate_channel``) shared by two processes on one host. The
+  segment head is a tiny seq ledger (write_seq / read_seq / len /
+  closed); the writer spins for slot vacancy (read_seq == write_seq),
+  writes the envelope, and publishes by bumping write_seq; the reader
+  mirrors it. Single-slot occupancy IS the backpressure: a producer can
+  run at most one execution ahead of its consumer.
+
+- ``QueueChannel``: the cross-node fallback fed by the existing worker
+  RPC path — the producer ships the envelope up its node channel
+  (``cgraph_send``), the head routes it to the consumer process
+  (``cgraph_push``), and the consumer's loop pops it from this local
+  queue. Latency is one control-plane hop; ordering is preserved by the
+  per-channel monotonic seq.
+
+Envelope: ``<II`` (flags, trace_len) + trace utf-8 + serialized body.
+flags bit 0 = the body is a serialized exception (error propagation
+through the graph); trace carries "trace_id:span_id" so per-stage SPANs
+link into one cross-process flow in ``timeline()``.
+"""
+from __future__ import annotations
+
+import queue as queue_mod
+import struct
+import threading
+import time
+from typing import Callable, Optional, Tuple
+
+from ..exceptions import (ChannelFullError, CompiledGraphClosedError,
+                          GetTimeoutError)
+from ..util import metrics as _metrics
+
+FLAG_ERROR = 1
+
+# segment layout: header then the slot payload area
+_HDR = struct.Struct("<QQQQ")  # write_seq, read_seq, data_len, closed
+HEADER_BYTES = 64
+_ENV = struct.Struct("<II")  # flags, trace_len
+
+_H_EDGE_WAIT = _metrics.Histogram(
+    "ray_tpu_cgraph_edge_wait_seconds",
+    "blocking wait for a compiled-graph channel slot (read side)",
+    boundaries=_metrics.FAST_BOUNDARIES, tag_keys=("edge",))
+
+
+def pack_envelope(flags: int, trace: str, body: bytes) -> bytes:
+    t = trace.encode()
+    return _ENV.pack(flags, len(t)) + t + body
+
+
+def unpack_envelope(data: bytes) -> Tuple[int, str, bytes]:
+    flags, tlen = _ENV.unpack_from(data, 0)
+    off = _ENV.size
+    trace = data[off:off + tlen].decode()
+    return flags, trace, data[off + tlen:]
+
+
+class _Backoff:
+    """Spin-then-yield-then-sleep poll ladder. The hot window (pipelined
+    steady state) resolves in the spin/yield phases; an idle resident
+    loop decays to ~2 ms sleeps so parked graphs cost ~no CPU."""
+
+    __slots__ = ("spins",)
+
+    def __init__(self):
+        self.spins = 0
+
+    def wait(self) -> None:
+        self.spins += 1
+        if self.spins < 100:
+            return
+        if self.spins < 5000:
+            time.sleep(0)  # yield the core between probes
+            return
+        time.sleep(min(0.002, 0.00005 * (self.spins / 5000.0)))
+
+
+class ShmChannel:
+    """One endpoint of a single-slot shared-memory channel.
+
+    Both endpoints attach to the same segment through a SegmentReader
+    mmap; role (reader/writer) is fixed at compile time. `interrupt` is
+    an optional Event polled while blocked (teardown / stop signal)."""
+
+    def __init__(self, reader, name: str, size: int, edge: str = "",
+                 interrupt: Optional[threading.Event] = None):
+        self._segreader = reader
+        self._name = name
+        self._size = size
+        self.edge = edge
+        self._interrupt = interrupt
+        self._mv = reader.read(name, size)
+        self.capacity = size - HEADER_BYTES
+
+    # -- ledger ----------------------------------------------------------
+
+    def _hdr(self) -> Tuple[int, int, int, int]:
+        return _HDR.unpack_from(self._mv, 0)
+
+    def _check_alive(self) -> None:
+        if self._mv is None:
+            raise CompiledGraphClosedError(
+                f"channel {self._name} is closed")
+        closed = _HDR.unpack_from(self._mv, 0)[3]
+        if closed:
+            raise CompiledGraphClosedError(
+                f"channel {self._name} was closed by its peer")
+        if self._interrupt is not None and self._interrupt.is_set():
+            raise CompiledGraphClosedError(
+                f"channel {self._name}: graph stopping")
+
+    def mark_closed(self) -> None:
+        """Poison the ledger so the peer's next poll raises (teardown /
+        fault fencing); safe to call from either endpoint."""
+        if self._mv is not None:
+            try:
+                struct.pack_into("<Q", self._mv, 24, 1)
+            except ValueError:
+                pass  # segment already unmapped
+
+    # -- writer ----------------------------------------------------------
+
+    def send(self, data: bytes, timeout: Optional[float] = None) -> None:
+        if len(data) > self.capacity:
+            raise ChannelFullError(
+                f"payload of {len(data)} bytes exceeds channel capacity "
+                f"{self.capacity} (raise channel_bytes at compile time)")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        bo = _Backoff()
+        while True:
+            self._check_alive()
+            w, r, _, _ = self._hdr()
+            if w == r:  # slot vacant
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                raise GetTimeoutError(
+                    f"channel {self.edge or self._name}: send timed out "
+                    f"(slot occupied — consumer stalled)")
+            bo.wait()
+        self._mv[HEADER_BYTES:HEADER_BYTES + len(data)] = data
+        struct.pack_into("<Q", self._mv, 16, len(data))
+        struct.pack_into("<Q", self._mv, 0, w + 1)  # publish
+
+    # -- reader ----------------------------------------------------------
+
+    def recv(self, timeout: Optional[float] = None) -> bytes:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        bo = _Backoff()
+        t0 = time.perf_counter()
+        while True:
+            self._check_alive()
+            w, r, n, _ = self._hdr()
+            if w > r:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                raise GetTimeoutError(
+                    f"channel {self.edge or self._name}: recv timed out")
+            bo.wait()
+        waited = time.perf_counter() - t0
+        if waited > 1e-5:
+            _H_EDGE_WAIT.observe(waited, tags={"edge": self.edge})
+        # copy out BEFORE releasing the slot: the deserialized value may
+        # alias these bytes zero-copy, and the producer overwrites the
+        # slot the moment read_seq advances
+        data = bytes(self._mv[HEADER_BYTES:HEADER_BYTES + n])
+        struct.pack_into("<Q", self._mv, 8, r + 1)  # release the slot
+        return data
+
+    def close(self) -> None:
+        self.mark_closed()
+        mv = self._mv
+        self._mv = None
+        if mv is not None:
+            del mv
+            try:
+                self._segreader.release(self._name)
+            except Exception:
+                pass
+
+
+class QueueChannel:
+    """Consumer endpoint of a cross-node edge: a local queue fed by
+    ``cgraph_push`` deliveries relayed through the head. Per-channel seq
+    numbers assert FIFO delivery (the RPC path preserves order; a gap
+    means a routing bug, not data loss)."""
+
+    def __init__(self, cid: str, edge: str = "",
+                 interrupt: Optional[threading.Event] = None):
+        self.cid = cid
+        self.edge = edge
+        self._interrupt = interrupt
+        self._q: "queue_mod.Queue" = queue_mod.Queue()
+        self._next_seq = 0
+        self._closed = threading.Event()
+
+    def deliver(self, seq: int, data: bytes) -> None:
+        self._q.put((seq, data))
+
+    def recv(self, timeout: Optional[float] = None) -> bytes:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        t0 = time.perf_counter()
+        while True:
+            if self._closed.is_set() or (
+                    self._interrupt is not None
+                    and self._interrupt.is_set()):
+                raise CompiledGraphClosedError(
+                    f"channel {self.edge or self.cid}: graph stopping")
+            try:
+                seq, data = self._q.get(timeout=0.05)
+            except queue_mod.Empty:
+                if deadline is not None and time.monotonic() > deadline:
+                    raise GetTimeoutError(
+                        f"channel {self.edge or self.cid}: recv timed out")
+                continue
+            if data is None:  # close sentinel
+                raise CompiledGraphClosedError(
+                    f"channel {self.edge or self.cid} closed")
+            if seq != self._next_seq:
+                raise CompiledGraphClosedError(
+                    f"channel {self.edge or self.cid}: out-of-order "
+                    f"delivery (seq {seq}, expected {self._next_seq})")
+            self._next_seq += 1
+            waited = time.perf_counter() - t0
+            if waited > 1e-5:
+                _H_EDGE_WAIT.observe(waited, tags={"edge": self.edge})
+            return data
+
+    def close(self) -> None:
+        self._closed.set()
+        self._q.put((0, None))
+
+    def mark_closed(self) -> None:
+        self.close()
+
+
+class RpcSender:
+    """Producer endpoint of a cross-node edge: ships each envelope up the
+    process's control channel (`send_fn`); the head routes it to the
+    consumer. Seq stamps preserve the single-slot FIFO contract."""
+
+    def __init__(self, send_fn: Callable[[str, int, bytes], None],
+                 cid: str, edge: str = ""):
+        self._send_fn = send_fn
+        self.cid = cid
+        self.edge = edge
+        self._seq = 0
+
+    def send(self, data: bytes, timeout: Optional[float] = None) -> None:
+        seq = self._seq
+        self._seq += 1
+        self._send_fn(self.cid, seq, data)
+
+    def close(self) -> None:
+        pass
+
+    def mark_closed(self) -> None:
+        pass

@@ -1,0 +1,299 @@
+"""Worker-side compiled-graph execution: the resident loop.
+
+The worker half of `ray_tpu.cgraph` (ref: the reference's accelerated-DAG
+executor — python/ray/_private/worker.py exec_compiled_dag loop): at
+``cgraph_load`` the worker builds its channel endpoints and method
+dispatch table ONCE, then a resident thread runs the static plan forever
+— read input slots, call the bound actor methods, write output slots —
+with zero per-call scheduling, leasing, or task-spec traffic. Normal
+``.remote()`` dispatch on the actor keeps working alongside the loop.
+
+Error semantics: a stage exception becomes an error envelope forwarded
+through the SAME channels (downstream stages skip execution and
+propagate), so the driver's ``execute()`` ref raises the original
+``TaskError``. An unexpected loop death poisons the node's channels so
+peers (and ultimately the driver) fail fast instead of wedging.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+from ..core import serialization
+from ..exceptions import CompiledGraphClosedError, TaskError
+from ..util import metrics as _metrics
+from ..util.logs import get_logger
+from .channel import (FLAG_ERROR, QueueChannel, RpcSender, ShmChannel,
+                      pack_envelope, unpack_envelope)
+
+_H_NODE_EXEC = _metrics.Histogram(
+    "ray_tpu_cgraph_node_exec_seconds",
+    "compiled-graph per-node method execution time",
+    boundaries=_metrics.FAST_BOUNDARIES, tag_keys=("method",))
+
+_log = get_logger("ray_tpu.cgraph")
+
+
+class _NodePlan:
+    __slots__ = ("key", "method", "fn", "num_returns", "concurrency_group",
+                 "args", "kwargs", "outs")
+
+
+class _GraphRun:
+    """One loaded graph on one actor worker."""
+
+    def __init__(self, graph_id: bytes):
+        self.graph_id = graph_id
+        self.stop = threading.Event()
+        self.readers: Dict[str, Any] = {}  # cid hex -> channel endpoint
+        self.writers: List[Any] = []
+        self.nodes: List[_NodePlan] = []
+        self.thread: Optional[threading.Thread] = None
+
+
+class CGraphExecutor:
+    """Per-worker registry of loaded graphs + their resident threads."""
+
+    def __init__(self, worker):
+        self.worker = worker  # WorkerProcess
+        self._lock = threading.Lock()
+        self._graphs: Dict[bytes, _GraphRun] = {}
+        # dedicated segment reader: channel attachments must not collide
+        # with the task-result reader's cache lifecycle
+        from ..core.object_store import SegmentReader
+
+        self._segreader = SegmentReader()
+
+    # -- control-plane entry points (worker_main.handle) -----------------
+
+    def load(self, plan: dict) -> bool:
+        actor = self.worker._actor
+        if actor is None:
+            raise RuntimeError(
+                "cgraph_load sent to a worker that hosts no actor")
+        gid = plan["graph_id"]
+        with self._lock:
+            if self._graphs:
+                raise RuntimeError(
+                    "actor already participates in a live compiled graph; "
+                    "teardown() it before compiling another")
+            run = _GraphRun(gid)
+            self._graphs[gid] = run
+        try:
+            self._build(run, plan, actor)
+        except BaseException:
+            with self._lock:
+                self._graphs.pop(gid, None)
+            raise
+        run.thread = threading.Thread(
+            target=self._loop, args=(run,), daemon=True,
+            name=f"cgraph-{gid.hex()[:8]}")
+        run.thread.start()
+        return True
+
+    def push(self, payload: dict) -> None:
+        """A cross-node envelope routed to one of our queue channels."""
+        with self._lock:
+            run = self._graphs.get(payload["graph_id"])
+        if run is None:
+            return  # late delivery after stop: drop
+        ch = run.readers.get(payload["cid"])
+        if isinstance(ch, QueueChannel):
+            ch.deliver(payload["seq"], payload["data"])
+
+    def stop(self, graph_id: bytes) -> bool:
+        with self._lock:
+            run = self._graphs.pop(graph_id, None)
+        if run is None:
+            return True
+        run.stop.set()
+        for ch in list(run.readers.values()) + run.writers:
+            try:
+                ch.mark_closed()
+            except Exception:
+                pass
+        if run.thread is not None:
+            run.thread.join(timeout=3.0)
+        for ch in list(run.readers.values()) + run.writers:
+            try:
+                ch.close()
+            except Exception:
+                pass
+        return True
+
+    def stop_all(self) -> None:
+        with self._lock:
+            gids = list(self._graphs)
+        for gid in gids:
+            self.stop(gid)
+
+    # -- plan materialization --------------------------------------------
+
+    def _make_reader(self, spec: dict, run: _GraphRun):
+        if spec["kind"] == "shm":
+            return ShmChannel(self._segreader, spec["name"], spec["size"],
+                              edge=spec.get("edge", ""), interrupt=run.stop)
+        return QueueChannel(spec["cid"], edge=spec.get("edge", ""),
+                            interrupt=run.stop)
+
+    def _make_writer(self, spec: dict, run: _GraphRun):
+        if spec["kind"] == "shm":
+            return ShmChannel(self._segreader, spec["name"], spec["size"],
+                              edge=spec.get("edge", ""), interrupt=run.stop)
+        gid = run.graph_id
+
+        def send(cid, seq, data):
+            self.worker.channel.call(
+                "cgraph_send", {"graph_id": gid, "cid": cid,
+                                "seq": seq, "data": data}, timeout=120)
+
+        return RpcSender(send, spec["cid"], edge=spec.get("edge", ""))
+
+    def _build(self, run: _GraphRun, plan: dict, actor) -> None:
+        for spec in plan["in_channels"]:
+            run.readers[spec["cid"]] = self._make_reader(spec, run)
+        groups = getattr(actor, "_group_pools", {}) or {}
+        for nspec in plan["nodes"]:
+            np = _NodePlan()
+            np.key = nspec["key"]
+            np.method = nspec["method"]
+            np.fn = getattr(actor.instance, nspec["method"])
+            np.num_returns = int(nspec.get("num_returns", 1))
+            np.concurrency_group = nspec.get("concurrency_group", "")
+            if np.concurrency_group and np.concurrency_group not in groups:
+                raise ValueError(
+                    f"concurrency group {np.concurrency_group!r} bound via "
+                    f".options() was not declared in concurrency_groups="
+                    f"{sorted(groups)}")
+            np.args = [self._load_argspec(a) for a in nspec["args"]]
+            np.kwargs = {k: self._load_argspec(a)
+                         for k, a in nspec["kwargs"].items()}
+            np.outs = [self._make_writer(w, run) for w in nspec["outs"]]
+            run.nodes.append(np)
+
+    @staticmethod
+    def _load_argspec(spec):
+        kind = spec[0]
+        if kind == "const":
+            return ("const", serialization.loads(spec[1]))
+        return tuple(spec)  # ("chan", cid) | ("local", key)
+
+    # -- the resident loop -----------------------------------------------
+
+    def _loop(self, run: _GraphRun) -> None:
+        try:
+            while not run.stop.is_set():
+                self._iteration(run)
+        except CompiledGraphClosedError:
+            pass  # clean stop/teardown
+        except BaseException:
+            # unexpected loop death: poison every endpoint so producers,
+            # consumers, and ultimately the driver unblock with a typed
+            # error instead of wedging on a silent half-dead pipeline
+            _log.error("compiled-graph loop died:\n%s",
+                       traceback.format_exc())
+            for ch in list(run.readers.values()) + run.writers:
+                try:
+                    ch.mark_closed()
+                except Exception:
+                    pass
+
+    def _iteration(self, run: _GraphRun) -> None:
+        local: Dict[str, tuple] = {}  # node key -> ("val", v)|("err", bytes)
+        chan_cache: Dict[str, tuple] = {}  # cid -> (flags, trace, body)
+        for np in run.nodes:
+            err_bytes = None
+            parent_trace = ""
+            args: List[Any] = []
+            kwargs: Dict[str, Any] = {}
+
+            def resolve(spec):
+                nonlocal err_bytes, parent_trace
+                kind = spec[0]
+                if kind == "const":
+                    return spec[1]
+                if kind == "chan":
+                    cid = spec[1]
+                    env = chan_cache.get(cid)
+                    if env is None:
+                        env = chan_cache[cid] = unpack_envelope(
+                            run.readers[cid].recv())
+                    flags, trace, body = env
+                    if trace:
+                        parent_trace = trace
+                    if flags & FLAG_ERROR:
+                        err_bytes = body
+                        return None
+                    return serialization.loads(body)
+                # ("local", key): same-actor edge, no channel round trip
+                state, val = local[spec[1]]
+                if state == "err":
+                    err_bytes = val
+                    return None
+                return val
+
+            for spec in np.args:
+                args.append(resolve(spec))
+            for k, spec in np.kwargs.items():
+                kwargs[k] = resolve(spec)
+            if run.stop.is_set():
+                raise CompiledGraphClosedError("graph stopping")
+
+            trace_out = ""
+            if err_bytes is None:
+                value, err_bytes, trace_out = self._exec_node(
+                    np, args, kwargs, parent_trace)
+            if err_bytes is not None:
+                local[np.key] = ("err", err_bytes)
+                env = pack_envelope(FLAG_ERROR, trace_out or parent_trace,
+                                    err_bytes)
+            else:
+                local[np.key] = ("val", value)
+                body = serialization.dumps(value) if np.outs else b""
+                env = pack_envelope(0, trace_out, body)
+            for w in np.outs:
+                w.send(env)
+
+    def _exec_node(self, np: _NodePlan, args, kwargs, parent_trace: str):
+        """-> (value, error_bytes, downstream_trace)."""
+        from ..util import tracing
+
+        span_ctx = None
+        token = None
+        if parent_trace:
+            tid, _, sid = parent_trace.partition(":")
+            token = tracing.activate((tid, sid))
+            span_ctx = tracing.trace(f"cgraph:{np.key}.{np.method}",
+                                     method=np.method,
+                                     concurrency_group=np.concurrency_group)
+            span = span_ctx.__enter__()
+        t0 = time.perf_counter()
+        try:
+            value = np.fn(*args, **kwargs)
+            if np.num_returns > 1:
+                if not isinstance(value, (tuple, list)) \
+                        or len(value) != np.num_returns:
+                    raise ValueError(
+                        f"{np.method} bound with num_returns="
+                        f"{np.num_returns} returned "
+                        f"{type(value).__name__} instead of a "
+                        f"{np.num_returns}-tuple")
+            err = None
+        except BaseException as e:  # noqa: BLE001 — shipped downstream
+            err = serialization.dumps(TaskError(
+                cause=e, remote_traceback=traceback.format_exc(),
+                task_desc=f"cgraph:{np.key}.{np.method}"))
+            value = None
+        finally:
+            _H_NODE_EXEC.observe(time.perf_counter() - t0,
+                                 tags={"method": np.method})
+            trace_out = ""
+            if span_ctx is not None:
+                try:
+                    span_ctx.__exit__(None, None, None)
+                    trace_out = f"{span.trace_id}:{span.span_id}"
+                finally:
+                    tracing.deactivate(token)
+        return value, err, trace_out

@@ -60,10 +60,23 @@ class ActorMethod:
                                     self._num_returns,
                                     self._concurrency_group)
 
+    def bind(self, *args, **kwargs):
+        """Declare this method as a node in a static compiled graph
+        (ray_tpu.cgraph). Args may be other DAG nodes (dataflow edges)
+        or plain values (compile-time constants). Options set via
+        ``.options(num_returns=, concurrency_group=)`` carry through
+        exactly as they do for ``.remote()``."""
+        from ..cgraph.dag import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._name, args, kwargs,
+                               num_returns=self._num_returns,
+                               concurrency_group=self._concurrency_group)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"Actor method '{self._name}' cannot be called directly; "
-            "use .remote().")
+            "use .remote() for a dynamic task, or .bind() to build a "
+            "compiled graph (ray_tpu.cgraph).")
 
 
 class ActorHandle:

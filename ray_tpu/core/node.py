@@ -695,6 +695,27 @@ class Node:
                         "interval_s": float(interval_s)},
             timeout=float(duration_s) + 30.0)
 
+    # ---- compiled-graph control plane (ray_tpu/cgraph) -----------------------
+
+    def worker_notify(self, worker: WorkerHandle, method: str,
+                      payload) -> None:
+        """Fire-and-forget message to one worker (cgraph envelope
+        delivery); RemoteNode overrides with the agent relay. Raises
+        when the channel is provably gone — a silently-dropped envelope
+        would strand the consumer waiting on a seq that never arrives,
+        while raising lets the sender's retraction/abort paths run."""
+        if worker.channel is None or worker.channel.closed:
+            raise RuntimeError(
+                f"worker {worker.worker_id.hex()[:8]} has no live channel")
+        worker.channel.notify(method, payload)
+
+    def worker_cgraph_call(self, worker: WorkerHandle, method: str,
+                           payload, timeout: float = 30.0):
+        """Request/response to one worker (cgraph_load / cgraph_stop)."""
+        if worker.channel is None or worker.channel.closed:
+            raise RuntimeError("worker has no live channel")
+        return worker.channel.call(method, payload, timeout=timeout)
+
     def num_workers(self) -> int:
         with self._lock:
             return len(self._workers)

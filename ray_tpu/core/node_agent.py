@@ -209,6 +209,30 @@ class NodeAgent:
             return self.store.put_chunk(
                 payload["object_id"], payload["offset"], payload["total"],
                 payload["data"])
+        if method == "worker_notify":
+            # generic head -> worker oneway relay (compiled-graph envelope
+            # delivery and stop fencing ride this)
+            ch = self._channels.get(payload["worker_id"])
+            if ch is not None and not ch.closed:
+                ch.notify(payload["method"], payload["payload"])
+            return None
+        if method == "worker_relay_call":
+            # generic head -> worker request relay (cgraph_load/stop —
+            # same shape as the worker_stack introspection relay)
+            ch = self._channels.get(payload["worker_id"])
+            if ch is None or ch.closed:
+                raise RuntimeError("worker is not connected to this agent")
+            return ch.call(payload["method"], payload["payload"],
+                           timeout=float(payload.get("timeout", 30.0)))
+        if method == "cgraph_alloc_channel":
+            # compiled-graph channel segment on THIS node's store: both
+            # endpoints are workers on this host; the head only needs the
+            # shm name for their plans
+            return self.store.allocate_channel(payload["cid"],
+                                               payload["size"])
+        if method == "cgraph_release_channel":
+            self.store.release_channel(payload["cid"])
+            return True
         if method == "shutdown":
             threading.Thread(target=self.shutdown,
                              kwargs={"kill": payload.get("kill", False)},

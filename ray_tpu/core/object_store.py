@@ -129,6 +129,7 @@ class PlasmaStore:
             os.makedirs(spill_dir, exist_ok=True)
         self.num_evictions = 0
         self.num_spills = 0
+        self._channels: set = set()  # live compiled-graph channel segments
 
     # -- plasma protocol: create -> write -> seal ------------------------------
 
@@ -201,6 +202,33 @@ class PlasmaStore:
                 write=lambda off, d: self._entries[object_id].shm.buf
                 .__setitem__(slice(off, off + len(d)), d),
                 finish=finish)
+
+    # -- compiled-graph channels (ray_tpu/cgraph) ------------------------------
+    # A channel is a pre-allocated single-slot segment reused for the life
+    # of a compiled graph: created once at compile time, written/read in
+    # place by the producer/consumer processes (never sealed — sealing
+    # means immutable), pinned so neither eviction nor spilling can touch
+    # it, and released by teardown. Backpressure comes from slot occupancy
+    # in the channel header (cgraph/channel.py), not from store capacity.
+
+    def allocate_channel(self, channel_id: ObjectId, size: int) -> str:
+        """Reserve a mutable, pinned segment for a compiled-graph channel;
+        returns the shm name both endpoints attach to."""
+        with self._lock:
+            name = self.create(channel_id, size)
+            e = self._entries[channel_id]
+            e.pinned = True  # belt: unsealed entries are already
+            # invisible to the LRU/spill scans, which require sealed
+            self._channels.add(channel_id)
+            return name
+
+    def release_channel(self, channel_id: ObjectId) -> None:
+        """Teardown: unlink the channel segment and return its capacity.
+        Attached readers keep their mapping until they release it (POSIX
+        unlink semantics), so a racing in-flight read cannot fault."""
+        with self._lock:
+            self._channels.discard(channel_id)
+            self.delete(channel_id)
 
     # -- reads -----------------------------------------------------------------
 
@@ -384,6 +412,7 @@ class PlasmaStore:
                 "num_objects": len(self._entries),
                 "num_evictions": self.num_evictions,
                 "num_spills": self.num_spills,
+                "num_channels": len(self._channels),
             }
 
     def destroy(self) -> None:
@@ -420,6 +449,7 @@ class NativePlasmaStore:
         self._destroyed = False
         self._lock = instrumented_lock("object_store.native", reentrant=True)
         self._partial: Dict[ObjectId, int] = {}  # chunked-push progress
+        self._channels: set = set()  # live compiled-graph channel segments
 
     def segment_name(self, object_id: ObjectId) -> str:
         return f"{self._prefix}_{object_id.hex()}"
@@ -517,6 +547,20 @@ class NativePlasmaStore:
             self.seal(object_id)
         _observe_op("put", t0, len(data))
 
+    # -- compiled-graph channels (same contract as PlasmaStore's) ----------
+
+    def allocate_channel(self, channel_id: ObjectId, size: int) -> str:
+        with self._lock:
+            name = self.create(channel_id, size)
+            self.pin(channel_id)  # channels must never evict or spill
+            self._channels.add(channel_id)
+            return name
+
+    def release_channel(self, channel_id: ObjectId) -> None:
+        with self._lock:
+            self._channels.discard(channel_id)
+            self.delete(channel_id)
+
     # -- reads -------------------------------------------------------------
 
     def contains(self, object_id: ObjectId) -> bool:
@@ -580,7 +624,8 @@ class NativePlasmaStore:
         return {"capacity": vals[1].value, "used": vals[0].value,
                 "num_objects": vals[2].value,
                 "num_evictions": vals[3].value,
-                "num_spills": vals[4].value, "native": True}
+                "num_spills": vals[4].value, "native": True,
+                "num_channels": len(self._channels)}
 
     def destroy(self) -> None:
         with self._lock:

@@ -257,6 +257,29 @@ class RemoteNode(Node):
                                "interval_s": float(interval_s)},
             timeout=float(duration_s) + 40.0)
 
+    # ---- compiled-graph control plane (relayed through the agent) ------------
+
+    def worker_notify(self, worker: WorkerHandle, method: str,
+                      payload) -> None:
+        # raise on a provably-dead channel: the caller (cgraph execute /
+        # head routing) must see the envelope as undelivered and run its
+        # retraction/abort path rather than strand the consumer on a
+        # seq that never arrives
+        if not self.alive or self.channel.closed:
+            raise RuntimeError(
+                f"node {self.node_id.hex()[:8]} channel closed")
+        self.channel.notify("worker_notify",
+                            {"worker_id": worker.worker_id,
+                             "method": method, "payload": payload})
+
+    def worker_cgraph_call(self, worker: WorkerHandle, method: str,
+                           payload, timeout: float = 30.0):
+        return self.channel.call(
+            "worker_relay_call", {"worker_id": worker.worker_id,
+                                  "method": method, "payload": payload,
+                                  "timeout": float(timeout)},
+            timeout=timeout + 10.0)
+
     # ---- object transfer -----------------------------------------------------
 
     def pull_object_bytes(self, oid: ObjectId) -> Optional[bytes]:

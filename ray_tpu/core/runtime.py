@@ -153,6 +153,13 @@ class DriverRuntime:
         self._log_mirror = DriverMirror(
             enabled=bool(int(self.config.log_to_driver)))
         self._pull_futures: Dict[ObjectId, Future] = {}
+        # compiled graphs (ray_tpu/cgraph): live graphs by id, the
+        # actor-exclusivity ledger, and the cross-node channel routing
+        # table (cid hex -> ("driver", dag, None, gid) |
+        # ("worker", node, worker, gid))
+        self._cgraphs: Dict[bytes, object] = {}
+        self._cgraph_actors: Dict[bytes, bytes] = {}
+        self._cgraph_routes: Dict[str, tuple] = {}
         self._generators: Dict[TaskId, dict] = {}
         self._released_generators: Set[TaskId] = set()
         self._reader = SegmentReader()
@@ -1998,7 +2005,50 @@ class DriverRuntime:
             return None
         if method == "logs_query":
             return self.query_logs(**(payload or {}))
+        if method == "cgraph_send":
+            # compiled-graph cross-node edge: producer -> head -> consumer
+            return self._cgraph_route(payload)
         raise ValueError(f"unknown worker call: {method}")
+
+    # ---- compiled graphs (ray_tpu/cgraph) ------------------------------------
+
+    def _cgraph_register(self, dag) -> None:
+        with self._lock:
+            self._cgraphs[dag.graph_id] = dag
+            for akey in dag._actor_plans:
+                self._cgraph_actors[akey] = dag.graph_id
+
+    def _cgraph_unregister(self, dag) -> None:
+        with self._lock:
+            self._cgraphs.pop(dag.graph_id, None)
+            for akey in [k for k, g in self._cgraph_actors.items()
+                         if g == dag.graph_id]:
+                self._cgraph_actors.pop(akey, None)
+            for cid in [c for c, r in self._cgraph_routes.items()
+                        if r[3] == dag.graph_id]:
+                self._cgraph_routes.pop(cid, None)
+
+    def _cgraph_actor_in_use(self, actor_id: ActorId) -> bool:
+        with self._lock:
+            return actor_id.binary() in self._cgraph_actors
+
+    def _cgraph_route(self, payload: dict) -> bool:
+        """Route one cross-node compiled-graph envelope: a producer
+        worker shipped it up its node channel; deliver it to the
+        consumer process (driver queue, or a worker's cgraph_push)."""
+        with self._lock:
+            route = self._cgraph_routes.get(payload["cid"])
+        if route is None:
+            return False  # late send after teardown: drop
+        kind, target, worker, gid = route
+        msg = {"graph_id": gid, "cid": payload["cid"],
+               "seq": payload["seq"], "data": payload["data"]}
+        if kind == "driver":
+            target._deliver(payload["cid"], payload["seq"],
+                            payload["data"])
+        else:
+            target.worker_notify(worker, "cgraph_push", msg)
+        return True
 
     # ---- cancellation --------------------------------------------------------
 
@@ -2053,6 +2103,11 @@ class DriverRuntime:
         if self._shutdown:
             return
         self._shutdown = True
+        for dag in list(self._cgraphs.values()):
+            try:
+                dag.teardown()  # release channel segments + stop loops
+            except Exception:
+                pass
         with self._pg_cv:
             self._pg_cv.notify()
         for node in list(self.nodes.values()):

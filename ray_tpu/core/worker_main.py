@@ -181,6 +181,9 @@ class WorkerProcess:
             flush_interval_s=float(_cfg.log_flush_interval_s),
             rate_lines_per_s=float(_cfg.log_rate_limit_lines_per_s))
         self._profiling = threading.Lock()  # one profile run at a time
+        # compiled-graph executor (ray_tpu/cgraph): created lazily on the
+        # first cgraph_load so plain task workers never pay the import
+        self._cgraph = None
 
     def _current_task_ids(self):
         spec = self.runtime.current_task()
@@ -263,10 +266,29 @@ class WorkerProcess:
         if method == "cancel_task":
             self._cancelled.add(payload)
             return None
+        if method == "cgraph_load":
+            # resident-loop execution mode: build channel endpoints + the
+            # method dispatch table once, then run the static plan beside
+            # normal task dispatch (ray_tpu/cgraph/executor.py)
+            if self._cgraph is None:
+                from ..cgraph.executor import CGraphExecutor
+
+                self._cgraph = CGraphExecutor(self)
+            return self._cgraph.load(payload)
+        if method == "cgraph_push":
+            if self._cgraph is not None:
+                self._cgraph.push(payload)
+            return None
+        if method == "cgraph_stop":
+            if self._cgraph is not None:
+                return self._cgraph.stop(payload["graph_id"])
+            return True
         if method == "kill_actor":
             os._exit(0)
         if method == "shutdown":
             self._stop.set()
+            if self._cgraph is not None:
+                self._cgraph.stop_all()
             self._task_queue.put(None)
             return None
         raise ValueError(f"unknown method {method}")

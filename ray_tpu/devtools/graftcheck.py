@@ -26,6 +26,13 @@ GC007  bare ``print()`` in ``ray_tpu`` library code — un-attributed,
        store with task attribution. User-facing surfaces (CLI,
        dashboard, devtools, examples, tests, scripts) are exempt by
        path; load-bearing prints take a line suppression.
+GC008  blocking ``get()`` or dynamic ``.remote()`` submission inside an
+       actor method that is bound into a compiled graph
+       (``X.method.bind(...)`` elsewhere in the module) — the compiled
+       graph's resident loop executes these methods with NO scheduler
+       behind them; dynamic calls reintroduce per-call RPC/scheduling
+       and can deadlock against the loop. Keep bound methods pure
+       compute; do dynamic work outside the graph.
 ====== =================================================================
 
 Suppression: append ``# graftcheck: disable=GC001`` (comma-separate for
@@ -66,6 +73,8 @@ RULES: Dict[str, str] = {
     "GC007": "bare print() in library code (use the structured logger "
              "ray_tpu.util.logs.get_logger so output is attributed and "
              "queryable)",
+    "GC008": "blocking get() or dynamic .remote() inside a method bound "
+             "into a compiled graph (static graphs must stay static)",
 }
 
 # GC007 targets library code only: user-facing surfaces where print IS
@@ -212,6 +221,19 @@ def _iter_own_exprs(stmt: ast.stmt):
                 stack.append(child)
 
 
+def _remote_handle_class(call: ast.Call) -> Optional[str]:
+    """'Cls' for `Cls.remote(...)` / `Cls.options(...).remote(...)`;
+    None for anything else (the GC008 receiver->class correlation)."""
+    func = call.func
+    if not isinstance(func, ast.Attribute) or func.attr != "remote":
+        return None
+    base = func.value
+    if isinstance(base, ast.Call) and isinstance(base.func, ast.Attribute) \
+            and base.func.attr == "options":
+        base = base.func.value
+    return base.id if isinstance(base, ast.Name) else None
+
+
 def _ctor_kind(value: ast.AST) -> Optional[str]:
     """If `value` is a call to a known-unserializable constructor, name it."""
     if not isinstance(value, ast.Call):
@@ -243,6 +265,32 @@ class _FileChecker:
         self.module_unserializable: Dict[str, str] = {}
         # names `from ray_tpu import get/wait` was bound to
         self.bare_get_names: Set[str] = set()
+        # GC008: methods bound into a compiled graph anywhere in this
+        # module (`<expr>.<method>.bind(...)` call sites). Stored as
+        # (class_name, method) when the receiver resolves to a known
+        # `x = Cls.remote()` / `x = Cls.options(...).remote()` handle —
+        # so a same-named method on an UNRELATED actor class is not
+        # flagged — and ("", method) when the receiver is dynamic (loop
+        # var, container element): conservative module-wide match.
+        handle_cls: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                cls = _remote_handle_class(node.value)
+                if cls:
+                    for t in node.targets:
+                        for nm in _assigned_names(t):
+                            handle_cls[nm] = cls
+        self.cgraph_bound: Set[Tuple[str, str]] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "bind" \
+                    and isinstance(node.func.value, ast.Attribute):
+                recv = node.func.value.value
+                cls = (handle_cls.get(recv.id, "")
+                       if isinstance(recv, ast.Name) else "")
+                self.cgraph_bound.add((cls, node.func.value.attr))
         for stmt in tree.body:
             if isinstance(stmt, ast.Assign):
                 kind = _ctor_kind(stmt.value)
@@ -285,27 +333,39 @@ class _FileChecker:
 
     def _walk_block(self, stmts: Sequence[ast.stmt], remote: bool,
                     is_async: bool, fn: Optional[dict],
-                    actor_class: bool = False) -> None:
+                    actor_class: bool = False,
+                    cgraph: bool = False,
+                    class_name: str = "") -> None:
         for idx, stmt in enumerate(stmts):
             self._walk_stmt(stmt, stmts, idx, remote, is_async, fn,
-                            actor_class)
+                            actor_class, cgraph, class_name)
 
     def _walk_stmt(self, stmt: ast.stmt, siblings: Sequence[ast.stmt],
                    idx: int, remote: bool, is_async: bool,
-                   fn: Optional[dict], actor_class: bool) -> None:
+                   fn: Optional[dict], actor_class: bool,
+                   cgraph: bool = False, class_name: str = "") -> None:
         if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
             fn_remote = remote or actor_class \
                 or any(_is_remote_decorator(d) for d in stmt.decorator_list)
             fn_async = isinstance(stmt, ast.AsyncFunctionDef)
+            # GC008 context: an actor method bound into a compiled graph
+            # somewhere in this module — matched by (class, method) when
+            # the bind receiver resolved to a handle of THIS class, or
+            # by bare method name for dynamic receivers (nested defs
+            # inherit the context)
+            fn_cgraph = cgraph or (actor_class and (
+                (class_name, stmt.name) in self.cgraph_bound
+                or ("", stmt.name) in self.cgraph_bound))
             ctx = self._fn_context(stmt)
             self._walk_block(stmt.body, remote=fn_remote, is_async=fn_async,
-                             fn=ctx)
+                             fn=ctx, cgraph=fn_cgraph)
             return
         if isinstance(stmt, ast.ClassDef):
             cls_remote = any(_is_remote_decorator(d)
                              for d in stmt.decorator_list)
             self._walk_block(stmt.body, remote=remote, is_async=is_async,
-                             fn=fn, actor_class=cls_remote or actor_class)
+                             fn=fn, actor_class=cls_remote or actor_class,
+                             cgraph=cgraph, class_name=stmt.name)
             return
         if isinstance(stmt, ast.Global) and remote and fn is not None:
             mutated = [n for n in stmt.names if n in fn["stores"]]
@@ -320,19 +380,22 @@ class _FileChecker:
             self._check_gc005(stmt)
         # GC006 on statement-position acquire() calls
         self._check_gc006(stmt, siblings, idx)
-        # this statement's own expressions: GC001/GC002/GC004
+        # this statement's own expressions: GC001/GC002/GC004/GC008
         for node in _iter_own_exprs(stmt):
-            self._check_expr(node, remote, is_async, fn)
+            self._check_expr(node, remote, is_async, fn, cgraph)
         # recurse into child statement blocks (for/while/if/with/try bodies)
         for field_name in ("body", "orelse", "finalbody"):
             child = getattr(stmt, field_name, None)
             if isinstance(child, list) and child \
                     and isinstance(child[0], ast.stmt):
-                self._walk_block(child, remote, is_async, fn, actor_class)
+                self._walk_block(child, remote, is_async, fn, actor_class,
+                                 cgraph, class_name)
         for handler in getattr(stmt, "handlers", ()):
-            self._walk_block(handler.body, remote, is_async, fn, actor_class)
+            self._walk_block(handler.body, remote, is_async, fn,
+                             actor_class, cgraph, class_name)
         for case in getattr(stmt, "cases", ()):
-            self._walk_block(case.body, remote, is_async, fn, actor_class)
+            self._walk_block(case.body, remote, is_async, fn, actor_class,
+                             cgraph, class_name)
 
     def _fn_context(self, fndef) -> dict:
         """Names a function binds locally (params + assignments) and
@@ -363,7 +426,7 @@ class _FileChecker:
     # -- expression-level rules -------------------------------------------
 
     def _check_expr(self, node: ast.AST, remote: bool, is_async: bool,
-                    fn: Optional[dict]) -> None:
+                    fn: Optional[dict], cgraph: bool = False) -> None:
         if isinstance(node, ast.Call):
             if isinstance(node.func, ast.Name) and node.func.id == "print":
                 self.report(
@@ -374,6 +437,8 @@ class _FileChecker:
                     "attribution (suppress where print IS the surface)")
             if remote:
                 self._check_gc001(node)
+            if cgraph:
+                self._check_gc008(node)
             if is_async:
                 dotted = _dotted(node.func)
                 if dotted == ("time", "sleep"):
@@ -392,30 +457,54 @@ class _FileChecker:
                     f"'{node.id}' which cannot be serialized to a worker; "
                     f"create it inside the task or hold it in an actor")
 
-    def _check_gc001(self, call: ast.Call) -> None:
+    def _is_blocking_get(self, call: ast.Call) -> bool:
         func = call.func
-        flagged = False
         if isinstance(func, ast.Attribute) and func.attr == "get":
             recv = func.value
             dotted = _dotted(recv)
             if dotted in (("ray_tpu",), ("ray",)):
-                flagged = True  # ray_tpu.get(...) inside a task
-            elif isinstance(recv, ast.Call):
+                return True  # ray_tpu.get(...) inside a task
+            if isinstance(recv, ast.Call):
                 inner = _dotted(recv.func)
                 if inner is not None and inner[-1] in ("get_runtime",):
-                    flagged = True  # get_runtime().get(...)
-                elif isinstance(recv.func, ast.Attribute) \
+                    return True  # get_runtime().get(...)
+                if isinstance(recv.func, ast.Attribute) \
                         and recv.func.attr == "remote":
-                    flagged = True  # f.remote(...).get()
+                    return True  # f.remote(...).get()
         elif isinstance(func, ast.Name) and func.id in self.bare_get_names:
-            flagged = True  # `from ray_tpu import get` then get(...)
-        if flagged:
+            return True  # `from ray_tpu import get` then get(...)
+        return False
+
+    def _check_gc001(self, call: ast.Call) -> None:
+        if self._is_blocking_get(call):
             self.report(
                 "GC001", call,
                 "blocking get() inside a @remote function/actor method can "
                 "deadlock when the worker pool is saturated (the waiting "
                 "task holds the lease its child needs); restructure with "
                 "ref-passing, or suppress if the nesting depth is bounded")
+
+    def _check_gc008(self, call: ast.Call) -> None:
+        """Inside a method bound into a compiled graph: dynamic task
+        submission (`.remote(...)`) and blocking gets defeat the static
+        contract — the resident loop has no scheduler behind it."""
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "remote":
+            self.report(
+                "GC008", call,
+                "dynamic .remote() submission inside a method bound into "
+                "a compiled graph reintroduces per-call scheduling and "
+                "can deadlock against the resident loop; keep bound "
+                "methods pure compute and do dynamic work outside the "
+                "graph")
+            return
+        if self._is_blocking_get(call):
+            self.report(
+                "GC008", call,
+                "blocking get() inside a method bound into a compiled "
+                "graph stalls the resident loop (and every downstream "
+                "stage) on the dynamic task plane; pass the value "
+                "through the graph's channels instead")
 
     # -- statement-level rules --------------------------------------------
 

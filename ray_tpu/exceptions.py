@@ -82,3 +82,18 @@ class RuntimeEnvSetupError(RayTpuError):
 
 class PlacementGroupUnschedulableError(RayTpuError):
     pass
+
+
+class CompiledGraphError(RayTpuError):
+    """Base class for compiled-graph (ray_tpu.cgraph) failures."""
+
+
+class CompiledGraphClosedError(CompiledGraphError):
+    """The compiled graph was torn down (explicitly, or because a
+    participating actor or channel peer died) while executions were in
+    flight; every pending ``execute()`` ref raises this."""
+
+
+class ChannelFullError(CompiledGraphError):
+    """A compiled-graph channel write could not complete: the payload
+    exceeds the channel's pre-allocated slot capacity."""

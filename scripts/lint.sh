@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# Static gate for the repo: graftcheck (framework-aware rules GC001-GC007,
+# Static gate for the repo: graftcheck (framework-aware rules GC001-GC008,
 # see docs/GRAFTCHECK.md) plus a bytecode-compile pass over the package.
 # Usage: scripts/lint.sh [extra graftcheck paths...]
 set -euo pipefail

@@ -54,6 +54,11 @@ def test_ppo_breakout_example():
     assert "best reward:" in out
 
 
+def test_gpt_pipeline_cgraph_example():
+    out = _run("gpt_pipeline_cgraph.py", "--iters", "6", timeout=300)
+    assert "tokens/s" in out
+
+
 def test_ppo_jax_fused_example():
     out = _run("ppo_jax_fused.py", "--steps", "3", "--num-envs", "16",
                "--rollout-len", "16", "--iters-per-step", "2")

@@ -258,6 +258,128 @@ def good_try():
 
 
 # ---------------------------------------------------------------------------
+# GC008 — dynamic calls inside compiled-graph-bound methods
+
+
+def test_gc008_positive_remote_in_bound_method():
+    src = """
+import ray_tpu
+from ray_tpu.cgraph import InputNode
+
+@ray_tpu.remote
+def helper(x):
+    return x
+
+@ray_tpu.remote
+class Stage:
+    def fwd(self, x):
+        return helper.remote(x)      # dynamic submission in the loop
+
+with InputNode() as inp:
+    dag = stage.fwd.bind(inp)
+"""
+    assert rules_found(src) == ["GC008"]
+
+
+def test_gc008_positive_blocking_get_in_bound_method():
+    src = """
+import ray_tpu
+
+@ray_tpu.remote
+class Stage:
+    def fwd(self, ref):
+        return ray_tpu.get(ref)
+
+dag = stage.fwd.bind(inp)
+"""
+    # both rules fire: the method is a remote scope (GC001) AND bound
+    # into a compiled graph (GC008)
+    assert rules_found(src) == ["GC001", "GC008"]
+
+
+def test_gc008_negative_unbound_method_and_plain_bind():
+    src = """
+import ray_tpu
+
+@ray_tpu.remote
+def helper(x):
+    return x
+
+@ray_tpu.remote
+class Stage:
+    def fwd(self, x):
+        return x + 1                 # bound, but pure compute
+
+    def dynamic(self, x):
+        return helper.remote(x)      # dynamic, but never bound
+
+dag = stage.fwd.bind(inp)
+sock.bind(("127.0.0.1", 0))          # not a method-node bind
+"""
+    assert rules_found(src) == []
+
+
+def test_gc008_negative_bind_on_non_actor_class():
+    src = """
+class Plain:
+    def fwd(self, x):
+        return helper.remote(x)      # not an actor method: GC008 n/a
+
+dag = stage.fwd.bind(inp)
+"""
+    assert rules_found(src) == []
+
+
+def test_gc008_negative_same_name_on_unrelated_class():
+    src = """
+import ray_tpu
+
+@ray_tpu.remote
+class Pipeline:
+    def step(self, x):
+        return x + 1                 # bound below via a Pipeline handle
+
+@ray_tpu.remote
+class Unrelated:
+    def step(self, x):
+        return helper.remote(x)      # same NAME, different class: clean
+
+stage = Pipeline.remote()
+dag = stage.step.bind(inp)
+"""
+    assert rules_found(src) == []
+
+
+def test_gc008_positive_options_chain_handle():
+    src = """
+import ray_tpu
+
+@ray_tpu.remote
+class Pipeline:
+    def step(self, x):
+        return helper.remote(x)
+
+stage = Pipeline.options(num_cpus=2).remote()
+dag = stage.step.bind(inp)
+"""
+    assert rules_found(src) == ["GC008"]
+
+
+def test_gc008_suppression():
+    src = """
+import ray_tpu
+
+@ray_tpu.remote
+class Stage:
+    def fwd(self, x):
+        return helper.remote(x)  # graftcheck: disable=GC008
+
+dag = stage.fwd.bind(inp)
+"""
+    assert rules_found(src) == []
+
+
+# ---------------------------------------------------------------------------
 # suppressions + CLI
 
 
