@@ -2,13 +2,15 @@
 
 Two halves (see docs/GRAFTCHECK.md):
 
-- ``graftcheck`` — a framework-aware static linter (stdlib ``ast``, no
-  third-party deps) with rules GC001..GC006 targeting the correctness
-  hazards this runtime shares with the reference (blocking get inside
-  remote bodies, unserializable closure capture, global mutation from
-  tasks, blocking sleeps on the actor event loop, swallowed framework
-  errors, leak-prone manual lock handling). Run it as
-  ``python -m ray_tpu.devtools.graftcheck [paths]``.
+- ``graftcheck`` — a framework-aware whole-program analyzer (stdlib
+  ``ast``, no third-party deps): per-file rules GC001..GC008 plus an
+  engine that builds a project symbol table and remote call graph
+  (content-hash cached) for actor-deadlock wait-cycle detection
+  (GC010), interprocedural serialization flow (GC011), and the GC020
+  TPU/SPMD series (unbound collective axes, in_specs arity,
+  donated-buffer reuse). Run it as
+  ``python -m ray_tpu.devtools.graftcheck [paths]`` (``--sarif``,
+  ``--baseline``, ``graph`` DOT subcommand).
 
 - ``locks`` — a debug-mode instrumented lock (``RAY_TPU_DEBUG_LOCKS=1``)
   that the core runtime's hot locks are built from; it records per-thread

@@ -1,0 +1,75 @@
+"""graftcheck — framework-aware static analysis for ray_tpu code.
+
+Two layers (docs/GRAFTCHECK.md has the full rule tables):
+
+- **Per-file rules** (:mod:`.local`): GC001-GC008, decidable from one
+  module alone — blocking get() in remote bodies, unserializable
+  closure capture, worker-side global mutation, event-loop sleeps,
+  swallowed framework errors, leak-prone lock handling, bare print()
+  in library code, dynamic calls in compiled-graph-bound methods.
+
+- **Whole-program engine** (:mod:`.engine`): builds a symbol table over
+  every file, resolves imports (including package re-export chains),
+  and constructs the *remote call graph* — which functions are
+  ``@remote`` tasks / actor methods, which call sites submit to which,
+  and where blocking ``get()`` waits occur — with a content-hash file
+  cache so repeat runs only re-parse changed files. On top of it run
+  GC010 (actor-deadlock wait cycles), GC011 (interprocedural
+  serialization flow), one-level interprocedural upgrades of
+  GC001/GC003, call-graph-resolved GC008 binding, and the GC020 SPMD
+  series (unbound collective axes, in_specs arity, donated-buffer
+  reuse) — see :mod:`.rules_project` / :mod:`.rules_spmd`.
+
+``check_source`` / ``check_file`` compose both layers for a single
+blob (the whole-program passes then see exactly one module);
+``check_project`` runs the full engine; ``main`` is the CLI
+(``python -m ray_tpu.devtools.graftcheck``, with ``--sarif``,
+``--baseline``, caching flags, and the ``graph`` DOT subcommand).
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Set
+
+from .local import (LOCAL_RULES, RULES, Finding, _FileChecker,
+                    iter_python_files)
+from .engine import (ProjectIndex, ProjectResult, build_call_graph,
+                     check_project, to_dot)
+from .summary import extract
+from . import rules_project, rules_spmd
+from .cli import main
+
+__all__ = [
+    "RULES", "LOCAL_RULES", "Finding",
+    "check_source", "check_file", "check_project", "iter_python_files",
+    "ProjectIndex", "ProjectResult", "build_call_graph", "to_dot",
+    "main",
+]
+
+
+def check_source(source: str, path: str = "<string>",
+                 rules: Optional[Set[str]] = None) -> List[Finding]:
+    """Lint one source blob with both layers; the whole-program rules
+    see a single-module project (GC008 keeps the module-local
+    heuristic here — engine-resolved binding needs ``check_project``).
+    Parse errors raise SyntaxError."""
+    enabled = set(rules) if rules is not None else set(RULES)
+    tree = ast.parse(source, filename=path)
+    findings = _FileChecker(path, source, tree, enabled).run()
+    module = os.path.splitext(os.path.basename(path))[0] or "<string>"
+    summary, extra = extract(path, source, tree, module)
+    findings.extend(f for f in extra if f.rule in enabled)
+    index = ProjectIndex([summary])
+    graph = build_call_graph(index)
+    # GC008 already ran module-locally above; don't double-report
+    findings.extend(rules_project.run(index, graph, enabled - {"GC008"}))
+    findings.extend(rules_spmd.run(index, enabled))
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
+
+
+def check_file(path: str,
+               rules: Optional[Set[str]] = None) -> List[Finding]:
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        return check_source(f.read(), path, rules)

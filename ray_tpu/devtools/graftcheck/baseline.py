@@ -1,0 +1,60 @@
+"""Baseline support: adopt graftcheck on a tree with pre-existing
+findings without blessing new ones.
+
+``--write-baseline FILE`` records every current finding as a
+fingerprint; ``--baseline FILE`` then filters findings whose
+fingerprint is known. Fingerprints hash (rule, path, stripped source
+line text) — NOT the line number — so unrelated edits above a finding
+don't resurrect it; moving or editing the flagged line itself does,
+which is the desired behavior (the code changed, re-review it).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Set
+
+from .local import Finding
+
+
+def _line_text(path: str, line: int,
+               cache: Dict[str, List[str]]) -> str:
+    if path not in cache:
+        try:
+            with open(path, "r", encoding="utf-8", errors="replace") as f:
+                cache[path] = f.read().splitlines()
+        except OSError:
+            cache[path] = []
+    lines = cache[path]
+    return lines[line - 1].strip() if 0 < line <= len(lines) else ""
+
+
+def fingerprint(f: Finding, cache: Dict[str, List[str]]) -> str:
+    text = _line_text(f.path, f.line, cache)
+    key = f"{f.rule}\x00{os.path.normpath(f.path)}\x00{text}"
+    return hashlib.sha256(key.encode("utf-8")).hexdigest()[:16]
+
+
+def write(path: str, findings: Sequence[Finding]) -> None:
+    cache: Dict[str, List[str]] = {}
+    entries = [{"rule": f.rule, "path": f.path, "line": f.line,
+                "fingerprint": fingerprint(f, cache)} for f in findings]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "findings": entries}, fh, indent=2)
+        fh.write("\n")
+
+
+def load(path: str) -> Set[str]:
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return {e["fingerprint"] for e in data.get("findings", ())}
+
+
+def filter_findings(findings: Sequence[Finding],
+                    baseline_path: Optional[str]) -> List[Finding]:
+    if not baseline_path:
+        return list(findings)
+    known = load(baseline_path)
+    cache: Dict[str, List[str]] = {}
+    return [f for f in findings if fingerprint(f, cache) not in known]

@@ -1,0 +1,194 @@
+"""graftcheck CLI: the ``check`` surface (default) plus the ``graph``
+subcommand.
+
+::
+
+    python -m ray_tpu.devtools.graftcheck [--json] [--sarif F] \
+        [--baseline F] [--write-baseline F] [--rules ...] \
+        [--cache F | --no-cache] [--no-project] [--stats] paths...
+    python -m ray_tpu.devtools.graftcheck graph [--out F] paths...
+
+Exit status: 0 = clean, 1 = findings, 2 = usage/parse errors only.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import List, Optional, Sequence
+
+from . import baseline as baseline_mod
+from . import engine as engine_mod
+from . import sarif as sarif_mod
+from .local import RULES, Finding, check_file, iter_python_files
+
+
+def _parse_rules(spec: str) -> Optional[set]:
+    rules = {r.strip().upper() for r in spec.split(",") if r.strip()}
+    unknown = rules - set(RULES)
+    if unknown:
+        print(f"unknown rule(s): {', '.join(sorted(unknown))}",
+              file=sys.stderr)
+        return None
+    return rules
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(argv) if argv is not None else sys.argv[1:]
+    if argv and argv[0] == "graph":
+        return _graph_main(argv[1:])
+    return _check_main(argv)
+
+
+# ---------------------------------------------------------------------------
+# check (default)
+
+
+def _check_main(argv: List[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.devtools.graftcheck",
+        description="framework-aware static analysis for ray_tpu code "
+                    "(whole-program engine; see docs/GRAFTCHECK.md)")
+    parser.add_argument("paths", nargs="*", help="files or directories")
+    parser.add_argument("--json", action="store_true",
+                        help="emit findings as a JSON array")
+    parser.add_argument("--sarif", metavar="FILE",
+                        help="write SARIF 2.1.0 to FILE ('-' = stdout) "
+                             "for GitHub code-scanning annotations")
+    parser.add_argument("--baseline", metavar="FILE",
+                        help="suppress findings fingerprinted in FILE")
+    parser.add_argument("--write-baseline", metavar="FILE",
+                        help="record current findings as the baseline "
+                             "and exit 0")
+    parser.add_argument("--rules", default="",
+                        help="comma-separated subset (default: all)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule table and exit")
+    parser.add_argument("--cache", metavar="FILE",
+                        default=engine_mod.default_cache_path(),
+                        help="content-hash file cache (default: "
+                             "$GRAFTCHECK_CACHE or ~/.cache/graftcheck/"
+                             "cache.json)")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="disable the file cache")
+    parser.add_argument("--no-project", action="store_true",
+                        help="per-file rules only: skip the whole-program "
+                             "engine (GC010/GC011/GC020-series; GC008 "
+                             "falls back to module-local matching)")
+    parser.add_argument("--stats", action="store_true",
+                        help="print engine timing + cache hit counts to "
+                             "stderr")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+    if not args.paths:
+        parser.error("the following arguments are required: paths")
+
+    rules = set(RULES)
+    if args.rules:
+        parsed = _parse_rules(args.rules)
+        if parsed is None:
+            return 2
+        rules = parsed
+
+    t0 = time.monotonic()
+    if args.no_project:
+        try:
+            files = iter_python_files(args.paths)
+        except FileNotFoundError as e:
+            print(f"no such file or directory: {e}", file=sys.stderr)
+            return 2
+        findings: List[Finding] = []
+        errors = 0
+        for path in files:
+            try:
+                findings.extend(check_file(path, rules))
+            except SyntaxError as e:
+                errors += 1
+                print(f"{path}: parse error: {e}", file=sys.stderr)
+        parsed_n, cached_n = len(files), 0
+    else:
+        try:
+            result = engine_mod.check_project(
+                args.paths, rules=rules,
+                cache_path=None if args.no_cache else args.cache)
+        except FileNotFoundError as e:
+            print(f"no such file or directory: {e}", file=sys.stderr)
+            return 2
+        findings, errors = result.findings, result.errors
+        files = result.files
+        parsed_n, cached_n = result.parsed, result.cached
+    elapsed = time.monotonic() - t0
+
+    if args.write_baseline:
+        baseline_mod.write(args.write_baseline, findings)
+        print(f"graftcheck: wrote baseline with {len(findings)} "
+              f"finding{'s' if len(findings) != 1 else ''} to "
+              f"{args.write_baseline}")
+        return 0
+    if args.baseline:
+        findings = baseline_mod.filter_findings(findings, args.baseline)
+
+    if args.sarif:
+        doc = sarif_mod.to_sarif(findings)
+        if args.sarif == "-":
+            json.dump(doc, sys.stdout, indent=2)
+            print()
+        else:
+            with open(args.sarif, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, indent=2)
+                fh.write("\n")
+
+    if args.json:
+        print(json.dumps([f.as_dict() for f in findings], indent=2))
+    elif not (args.sarif == "-"):
+        for f in findings:
+            print(f.render())
+        n = len(findings)
+        print(f"graftcheck: {n} finding{'s' if n != 1 else ''} "
+              f"in {len(files)} file{'s' if len(files) != 1 else ''}")
+    if args.stats:
+        print(f"graftcheck: {elapsed:.2f}s ({parsed_n} parsed, "
+              f"{cached_n} from cache)", file=sys.stderr)
+    if errors:
+        return 2
+    return 1 if findings else 0
+
+
+# ---------------------------------------------------------------------------
+# graph
+
+
+def _graph_main(argv: List[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.devtools.graftcheck graph",
+        description="dump the remote call graph (tasks, actor methods, "
+                    "submit/sync-get/bind edges) as GraphViz DOT")
+    parser.add_argument("paths", nargs="+", help="files or directories")
+    parser.add_argument("--out", metavar="FILE", default="-",
+                        help="output path (default: stdout)")
+    parser.add_argument("--cache", metavar="FILE",
+                        default=engine_mod.default_cache_path())
+    parser.add_argument("--no-cache", action="store_true")
+    args = parser.parse_args(argv)
+
+    try:
+        result = engine_mod.check_project(
+            args.paths, rules=set(),
+            cache_path=None if args.no_cache else args.cache)
+    except FileNotFoundError as e:
+        print(f"no such file or directory: {e}", file=sys.stderr)
+        return 2
+    dot = engine_mod.to_dot(result.graph)
+    if args.out == "-":
+        sys.stdout.write(dot)
+    else:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(dot)
+        print(f"graftcheck: wrote {len(result.graph.nodes)} nodes / "
+              f"{len(result.graph.edges)} edges to {args.out}")
+    return 2 if result.errors else 0

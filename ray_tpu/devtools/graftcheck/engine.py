@@ -1,0 +1,509 @@
+"""Whole-program analysis engine: project index, import resolution,
+remote call graph, and the content-hash file cache.
+
+The engine owns the project-wide pass:
+
+1. **Discover + parse** every file (``iter_python_files``), running the
+   per-file rules (:mod:`.local`, minus GC008 which is re-derived on the
+   real call graph) and the fact extractor (:mod:`.summary`) on each.
+   Both outputs are cached keyed by the file's content hash, so repeat
+   runs only re-parse files whose bytes changed.
+2. **Index** the summaries: module table keyed by root-relative dotted
+   name, functions/classes by fully-qualified name, and a resolver that
+   follows imports (including package ``__init__`` re-export chains and
+   relative imports) to the defining module.
+3. **Remote call graph**: which functions are ``@remote`` tasks / actor
+   methods, which call sites submit to which, and where blocking
+   ``get()`` waits occur. GC010 walks its synchronous-wait edges for
+   cycles; GC008 uses its bind-site resolution; ``graftcheck graph``
+   dumps it as DOT.
+4. **Project rule passes** (:mod:`.rules_project`, :mod:`.rules_spmd`)
+   run over the index every time — they are dict-walks over cached
+   facts, which is what keeps warm runs under the lint.sh budget.
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from .local import LOCAL_RULES, RULES, Finding, _FileChecker, \
+    iter_python_files
+from .summary import SUMMARY_VERSION, extract, suppressed
+
+# Any change to local-rule or extraction logic must bump one of these:
+# the pair keys every cache entry.
+ENGINE_VERSION = 1
+CACHE_VERSION = f"{ENGINE_VERSION}.{SUMMARY_VERSION}"
+
+SHARD_MAP_FQS = {
+    "ray_tpu.jax_compat.shard_map",
+    "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+}
+
+
+def default_cache_path() -> str:
+    env = os.environ.get("GRAFTCHECK_CACHE")
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache", "graftcheck",
+                        "cache.json")
+
+
+# ---------------------------------------------------------------------------
+# project index
+
+
+class ProjectIndex:
+    """Symbol table over a set of file summaries."""
+
+    def __init__(self, summaries: Sequence[Dict[str, Any]]):
+        self.summaries = list(summaries)
+        self.modules: Dict[str, Dict[str, Any]] = {}
+        self.functions: Dict[str, Tuple[Dict[str, Any], Dict[str, Any]]] = {}
+        self.classes: Dict[str, Tuple[Dict[str, Any], Dict[str, Any]]] = {}
+        for s in summaries:
+            m = s["module"]
+            self.modules[m] = s
+            for q, fn in s["functions"].items():
+                self.functions[f"{m}.{q}"] = (s, fn)
+            for cname, crec in s["classes"].items():
+                self.classes[f"{m}.{cname}"] = (s, crec)
+
+    # -- name resolution ---------------------------------------------------
+
+    def _split_module(self, fq: str) -> Tuple[Optional[str], str]:
+        """Longest known module prefix of `fq` -> (module, rest)."""
+        parts = fq.split(".")
+        for i in range(len(parts), 0, -1):
+            mod = ".".join(parts[:i])
+            if mod in self.modules:
+                return mod, ".".join(parts[i:])
+        return None, fq
+
+    def canonical(self, fq: str, depth: int = 8) -> str:
+        """Follow re-export chains (``from .dag import InputNode`` in a
+        package ``__init__``) to the defining module."""
+        for _ in range(depth):
+            mod, rest = self._split_module(fq)
+            if mod is None or not rest:
+                return fq
+            s = self.modules[mod]
+            head = rest.split(".", 1)[0]
+            tail = rest.split(".", 1)[1] if "." in rest else ""
+            if head in s["functions"] or head in s["classes"] \
+                    or head in s["str_consts"] or head in s["tuple_consts"] \
+                    or head in s["mesh_vars"] or head in s["module_unser"] \
+                    or head in s["handles"]:
+                return fq
+            if head in s["imports"]:
+                fq = s["imports"][head] + (("." + tail) if tail else "")
+                continue
+            return fq
+        return fq
+
+    def _defined(self, fq: str) -> bool:
+        if fq in self.functions or fq in self.classes or fq in self.modules:
+            return True
+        mod, rest = self._split_module(fq)
+        if mod is None or "." in rest or not rest:
+            return False
+        s = self.modules[mod]
+        return rest in s["str_consts"] or rest in s["tuple_consts"] \
+            or rest in s["mesh_vars"] or rest in s["handles"]
+
+    def resolve(self, summary: Dict[str, Any], name: str) -> str:
+        """Dotted name as written in `summary`'s module -> canonical
+        fully-qualified name (best effort; external names pass through)."""
+        parts = name.split(".")
+        imports = summary["imports"]
+        if parts[0] in imports:
+            rest = ".".join(parts[1:])
+            fq = imports[parts[0]] + (("." + rest) if rest else "")
+        else:
+            fq = f"{summary['module']}.{name}"
+        fq = self.canonical(fq)
+        if not self._defined(fq) and "." in name:
+            # string annotations are often written fully qualified
+            # ("pkg.a.A") with no matching import — try as-absolute
+            alt = self.canonical(name)
+            if self._defined(alt):
+                return alt
+        return fq
+
+    def resolve_function(self, summary: Dict[str, Any], name: str
+                         ) -> Optional[str]:
+        fq = self.resolve(summary, name)
+        return fq if fq in self.functions else None
+
+    def resolve_class(self, summary: Dict[str, Any], name: str
+                      ) -> Optional[str]:
+        fq = self.resolve(summary, name)
+        return fq if fq in self.classes else None
+
+    def lookup_str_const(self, summary: Dict[str, Any], name: str
+                         ) -> Optional[str]:
+        fq = self.resolve(summary, name)
+        mod, rest = self._split_module(fq)
+        if mod is None or "." in rest or not rest:
+            return None
+        return self.modules[mod]["str_consts"].get(rest)
+
+    def lookup_mesh_axes(self, summary: Dict[str, Any], name: str
+                         ) -> Optional[List[str]]:
+        fq = self.resolve(summary, name)
+        mod, rest = self._split_module(fq)
+        if mod is None or "." in rest or not rest:
+            return None
+        s = self.modules[mod]
+        return s["mesh_vars"].get(rest) \
+            or ([*s["tuple_consts"][rest]] if rest in s["tuple_consts"]
+                else None)
+
+    # -- actor concurrency -------------------------------------------------
+
+    def single_concurrency(self, cls_fq: str) -> bool:
+        """True unless any creation site passes max_concurrency > 1."""
+        for s in self.summaries:
+            for opt in s["actor_options"]:
+                if self.resolve_class(s, opt["cls"]) != cls_fq:
+                    continue
+                mc = opt.get("max_concurrency")
+                if mc is not None and mc > 1:
+                    return False
+        return True
+
+
+# ---------------------------------------------------------------------------
+# remote call graph
+
+
+@dataclass
+class Edge:
+    src: str          # fq of the calling function ("mod.<module>" for
+                      # driver-level code)
+    dst: str          # fq of the submitted remote function/actor method
+    path: str
+    line: int
+    sync: bool        # result synchronously get()-waited in the caller
+    kind: str = "submit"   # submit | create | bind
+
+    def key(self) -> Tuple:
+        return (self.src, self.dst, self.path, self.line, self.kind)
+
+
+@dataclass
+class CallGraph:
+    nodes: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    edges: List[Edge] = field(default_factory=list)
+
+    def sync_adj(self) -> Dict[str, List[Edge]]:
+        adj: Dict[str, List[Edge]] = {}
+        for e in self.edges:
+            if e.sync and e.kind == "submit":
+                adj.setdefault(e.src, []).append(e)
+        return adj
+
+
+def resolve_call_target(index: ProjectIndex, summary: Dict[str, Any],
+                        fn: Dict[str, Any], name: str) -> Optional[str]:
+    """Resolve a plain call name to a project function fq (methods on
+    ``self`` included), else None."""
+    if name.startswith("self.") and fn.get("cls"):
+        cand = f"{summary['module']}.{fn['cls']}.{name[5:]}"
+        return cand if cand in index.functions else None
+    return index.resolve_function(summary, name)
+
+
+def _blocking_helper_call_lines(index: ProjectIndex,
+                                summary: Dict[str, Any],
+                                fn: Dict[str, Any]) -> Set[int]:
+    """Lines where a submit's ref is handed straight to a helper that
+    blocks in get() — `fetch_sync(h.m.remote(x))` is a synchronous wait
+    even though no get() is lexically present (one level deep, matching
+    the interprocedural GC001 upgrade)."""
+    lines: Set[int] = set()
+    for call in fn["calls"]:
+        if not any(a.get("kind") == "submit" for a in call["args"]):
+            continue
+        callee = resolve_call_target(index, summary, fn, call["name"])
+        if callee is None:
+            continue
+        _, cfn = index.functions[callee]
+        if not cfn["is_remote"] and cfn["gets"]:
+            lines.add(call["lineno"])
+    return lines
+
+
+def resolve_submit_target(index: ProjectIndex, summary: Dict[str, Any],
+                          fn: Dict[str, Any], sub: Dict[str, Any]
+                          ) -> Optional[Tuple[str, str]]:
+    """-> (kind, dst_fq) where kind is 'task' | 'method' | 'create'."""
+    if sub["form"] == "func":
+        fq = index.resolve(summary, sub["name"])
+        if fq in index.classes:
+            return ("create", fq)
+        if fq in index.functions and index.functions[fq][1]["is_remote"]:
+            return ("task", fq)
+        return None
+    recv = sub.get("recv") or {}
+    cls_written: Optional[str] = None
+    if recv.get("kind") in ("name",) and recv.get("cls"):
+        cls_written = recv["cls"]
+    elif recv.get("kind") == "self" and recv.get("cls"):
+        cls_written = recv["cls"]
+    elif recv.get("kind") == "selfattr" and fn.get("cls"):
+        crec = summary["classes"].get(fn["cls"])
+        if crec:
+            cls_written = crec["attr_handles"].get(recv.get("attr"))
+    if not cls_written:
+        return None
+    cls_fq = index.resolve_class(summary, cls_written)
+    if cls_fq is None:
+        return None
+    _, crec = index.classes[cls_fq]
+    if sub.get("method") not in crec["methods"]:
+        return None
+    return ("method", f"{cls_fq}.{sub['method']}")
+
+
+def build_call_graph(index: ProjectIndex) -> CallGraph:
+    g = CallGraph()
+    for fq, (s, fn) in index.functions.items():
+        is_actor_method = bool(
+            fn.get("cls")
+            and s["classes"].get(fn["cls"], {}).get("is_actor"))
+        if fn["is_remote"] or fn["submits"] or fn["gets"]:
+            g.nodes.setdefault(fq, {
+                "remote": fn["is_remote"],
+                "actor_method": is_actor_method,
+                "path": s["path"], "line": fn["lineno"],
+                "cls": (f"{s['module']}.{fn['cls']}" if fn.get("cls")
+                        else None)})
+        helper_waits = _blocking_helper_call_lines(index, s, fn)
+        for sub in fn["submits"]:
+            tgt = resolve_submit_target(index, s, fn, sub)
+            if tgt is None:
+                continue
+            kind, dst = tgt
+            sync = bool(sub["sync"]) or sub["lineno"] in helper_waits
+            # sync edges anchor at the get() (where the wait parks),
+            # async ones at the submit
+            line = sub["sync_line"] if sub["sync"] and sub["sync_line"] \
+                else sub["lineno"]
+            g.edges.append(Edge(
+                src=fq, dst=dst, path=s["path"], line=line, sync=sync,
+                kind="create" if kind == "create" else "submit"))
+    # compiled-graph bind sites become 'bind' edges (driver -> method)
+    for s in index.summaries:
+        for b in s["bind_sites"]:
+            if not b.get("resolved"):
+                continue
+            cls_fq = index.resolve_class(s, b["cls"])
+            if cls_fq is None:
+                continue
+            g.edges.append(Edge(
+                src=f"{s['module']}.<module>", dst=f"{cls_fq}.{b['method']}",
+                path=s["path"], line=b["lineno"], sync=False, kind="bind"))
+    # make every edge endpoint a node so DOT output is closed
+    for e in g.edges:
+        for n in (e.src, e.dst):
+            if n not in g.nodes:
+                info = index.functions.get(n)
+                g.nodes[n] = {
+                    "remote": bool(info and info[1]["is_remote"]),
+                    "actor_method": bool(info and info[1].get("cls")),
+                    "path": info[0]["path"] if info else "",
+                    "line": info[1]["lineno"] if info else 0,
+                    "cls": None}
+    return g
+
+
+def to_dot(graph: CallGraph) -> str:
+    """Render the remote call graph as GraphViz DOT (for
+    ``graftcheck graph``: debugging deadlock cycles and cgraph wiring)."""
+    out = ["digraph remote_calls {", "  rankdir=LR;",
+           "  node [fontsize=10];"]
+
+    def q(s: str) -> str:
+        return '"' + s.replace('"', '\\"') + '"'
+
+    for name, info in sorted(graph.nodes.items()):
+        shape = "box" if info.get("actor_method") else "ellipse"
+        style = ' style=filled fillcolor="#e8f0fe"' if info.get("remote") \
+            else ""
+        label = name
+        if info.get("path"):
+            label += f"\\n{os.path.basename(info['path'])}:{info['line']}"
+        out.append(f"  {q(name)} [shape={shape}{style} label={q(label)}];")
+    for e in sorted(graph.edges, key=lambda e: e.key()):
+        attrs = []
+        if e.kind == "bind":
+            attrs.append('color="#7b1fa2" label="bind"')
+        elif e.kind == "create":
+            attrs.append('style=dotted label="create"')
+        elif e.sync:
+            attrs.append(f'label="sync get L{e.line}"')
+        else:
+            attrs.append(f'style=dashed label="submit L{e.line}"')
+        out.append(f"  {q(e.src)} -> {q(e.dst)} [{' '.join(attrs)}];")
+    out.append("}")
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# the engine (discovery + cache + passes)
+
+
+@dataclass
+class ProjectResult:
+    findings: List[Finding]
+    errors: int
+    files: List[str]
+    parsed: int          # files parsed this run (cache misses)
+    cached: int          # files served from cache
+    index: ProjectIndex
+    graph: CallGraph
+
+
+def _module_name(path: str, root: str) -> str:
+    rel = os.path.relpath(os.path.abspath(path), root)
+    rel = rel[:-3] if rel.endswith(".py") else rel
+    parts = [p for p in rel.replace("\\", "/").split("/") if p != "."]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) or os.path.basename(root)
+
+
+def _common_root(paths: Sequence[str]) -> str:
+    abspaths = [os.path.abspath(p) for p in paths]
+    dirs = [p if os.path.isdir(p) else os.path.dirname(p) for p in abspaths]
+    root = os.path.commonpath(dirs) if dirs else os.getcwd()
+    # `graftcheck ray_tpu/` must still derive the package-qualified
+    # module names (ray_tpu.x.y), or absolute self-imports resolve to
+    # nothing and every cross-file rule silently dies: walk up past
+    # directories that are themselves packages
+    while os.path.exists(os.path.join(root, "__init__.py")):
+        parent = os.path.dirname(root)
+        if parent == root:
+            break
+        root = parent
+    return root
+
+
+def _load_cache(path: Optional[str]) -> Dict[str, Any]:
+    if not path or not os.path.exists(path):
+        return {}
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        if data.get("version") != CACHE_VERSION:
+            return {}
+        return data.get("files", {})
+    except (OSError, ValueError):
+        return {}
+
+
+_CACHE_MAX_ENTRIES = 4096
+
+
+def _save_cache(path: Optional[str], prior: Dict[str, Any],
+                files: Dict[str, Any]) -> None:
+    if not path:
+        return
+    # merge over the prior entries (the shared default cache serves
+    # multiple path sets); evict to the current run's files when the
+    # merged map outgrows the bound
+    merged = dict(prior)
+    merged.update(files)
+    if len(merged) > _CACHE_MAX_ENTRIES:
+        merged = files
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"version": CACHE_VERSION, "files": merged}, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass  # caching is best-effort; never fail the lint for it
+
+
+def check_project(paths: Sequence[str],
+                  rules: Optional[Set[str]] = None,
+                  cache_path: Optional[str] = None,
+                  root: Optional[str] = None,
+                  stderr=None) -> ProjectResult:
+    """Run the full engine over `paths`: cached per-file rules + fact
+    extraction, then the whole-program passes."""
+    from . import rules_project, rules_spmd
+
+    stderr = stderr if stderr is not None else sys.stderr
+    # None means "all rules"; an explicit empty set means none (the
+    # graph subcommand wants the index without any rule passes)
+    enabled = set(rules) if rules is not None else set(RULES)
+    files = iter_python_files(paths)
+    root = os.path.abspath(root) if root else _common_root(files or ["."])
+    cache = _load_cache(cache_path)
+    new_cache: Dict[str, Any] = {}
+
+    local_findings: List[Finding] = []
+    summaries: List[Dict[str, Any]] = []
+    errors = 0
+    parsed = cached = 0
+    # every local rule except GC008 (recomputed on the call graph) runs
+    # on cache misses regardless of --rules: entries stay filter-agnostic
+    local_rules = (LOCAL_RULES - {"GC008"})
+
+    for path in files:
+        apath = os.path.abspath(path)
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError as e:
+            errors += 1
+            print(f"{path}: {e}", file=stderr)
+            continue
+        sha = hashlib.sha256(raw).hexdigest()
+        ent = cache.get(apath)
+        if ent and ent.get("sha") == sha and ent.get("root") == root:
+            cached += 1
+            summary = ent["summary"]
+            summary["path"] = path   # report with the path as given
+            findings = [Finding(**fd) for fd in ent["local"]]
+        else:
+            parsed += 1
+            source = raw.decode("utf-8", errors="replace")
+            module = _module_name(apath, root)
+            try:
+                tree = ast.parse(source, filename=path)
+            except SyntaxError as e:
+                errors += 1
+                print(f"{path}: parse error: {e}", file=stderr)
+                continue
+            checker = _FileChecker(path, source, tree, set(local_rules))
+            findings = checker.run()
+            summary, extra = extract(path, source, tree, module)
+            findings.extend(extra)
+        new_cache[apath] = {
+            "sha": sha, "root": root,
+            "local": [f.as_dict() for f in findings],
+            "summary": summary,
+        }
+        summaries.append(summary)
+        local_findings.extend(f for f in findings if f.rule in enabled)
+
+    index = ProjectIndex(summaries)
+    graph = build_call_graph(index)
+    findings = list(local_findings)
+    findings.extend(rules_project.run(index, graph, enabled))
+    findings.extend(rules_spmd.run(index, enabled))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    _save_cache(cache_path, cache, new_cache)
+    return ProjectResult(findings=findings, errors=errors, files=files,
+                         parsed=parsed, cached=cached, index=index,
+                         graph=graph)

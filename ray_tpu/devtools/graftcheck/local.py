@@ -1,9 +1,15 @@
-"""graftcheck — framework-aware static analysis for ray_tpu code.
+"""graftcheck per-file analysis — the single-module half of the linter.
 
-An AST-based linter (stdlib ``ast`` only) whose rules encode the
+An AST-based checker (stdlib ``ast`` only) whose rules encode the
 correctness hazards this runtime shares with the reference framework —
 hazards a generic linter cannot see because they depend on what
-``@remote`` means:
+``@remote`` means. This module owns the rules that are decidable from
+one file alone (GC001-GC008); the whole-program rules (GC010/GC011,
+the GC020 SPMD series, and the call-graph-resolved GC008 upgrade) live
+in :mod:`.summary` / :mod:`.engine` / :mod:`.rules_project` /
+:mod:`.rules_spmd` and run over the project index. The package
+``__init__`` composes both halves behind the same ``check_source`` /
+``check_file`` API the single-file linter always had.
 
 ====== =================================================================
 GC001  blocking ``get()`` (``ray_tpu.get`` / ``runtime.get`` /
@@ -40,20 +46,18 @@ several rules, or ``disable=all``) to the flagged line or put it alone
 on the line above. ``# graftcheck: disable-file=GC005`` anywhere in a
 file suppresses that rule file-wide.
 
-CLI::
+CLI (see :mod:`.cli`)::
 
-    python -m ray_tpu.devtools.graftcheck [--json] [--rules GC001,GC006] paths...
+    python -m ray_tpu.devtools.graftcheck [--json] [--sarif F] [--baseline F] paths...
+    python -m ray_tpu.devtools.graftcheck graph paths...
 
 Exit status: 0 = clean, 1 = findings, 2 = usage/parse errors only.
 """
 from __future__ import annotations
 
-import argparse
 import ast
-import json
 import os
 import re
-import sys
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
@@ -75,6 +79,19 @@ RULES: Dict[str, str] = {
              "queryable)",
     "GC008": "blocking get() or dynamic .remote() inside a method bound "
              "into a compiled graph (static graphs must stay static)",
+    # whole-program rules (engine-backed; see rules_project.py/rules_spmd.py)
+    "GC010": "actor-deadlock: cycle of synchronous get() waits through the "
+             "remote call graph (incl. self-calls on single-concurrency "
+             "actors)",
+    "GC011": "known-unserializable value (lock/socket/file/thread) flows "
+             "into .remote() args or a task return, possibly through "
+             "helper functions",
+    "GC020": "collective (psum/pmean/ppermute/...) names an axis not bound "
+             "by the enclosing shard_map mesh/axis_names",
+    "GC021": "shard_map in_specs arity does not match the wrapped "
+             "function's signature",
+    "GC022": "buffer donated via donate_argnums is read after the jitted "
+             "call (its memory was reused by XLA)",
 }
 
 # GC007 targets library code only: user-facing surfaces where print IS
@@ -221,17 +238,37 @@ def _iter_own_exprs(stmt: ast.stmt):
                 stack.append(child)
 
 
-def _remote_handle_class(call: ast.Call) -> Optional[str]:
-    """'Cls' for `Cls.remote(...)` / `Cls.options(...).remote(...)`;
-    None for anything else (the GC008 receiver->class correlation)."""
+def _remote_handle_class_info(call: ast.Call
+                              ) -> Tuple[Optional[str], Optional[int]]:
+    """``Cls.remote(...)`` / ``Cls.options(...).remote(...)`` ->
+    (dotted class name as written, max_concurrency literal or None).
+    Only CamelCase final components count as classes — ``h.m.remote()``
+    is a method submit, not a handle creation. Shared by the local
+    GC008 prepass and the engine's fact extractor so the
+    receiver->class correlation cannot diverge between the two."""
     func = call.func
     if not isinstance(func, ast.Attribute) or func.attr != "remote":
-        return None
+        return None, None
     base = func.value
+    max_conc = None
     if isinstance(base, ast.Call) and isinstance(base.func, ast.Attribute) \
             and base.func.attr == "options":
+        for kw in base.keywords:
+            if kw.arg == "max_concurrency" \
+                    and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, int):
+                max_conc = kw.value.value
         base = base.func.value
-    return base.id if isinstance(base, ast.Name) else None
+    dotted = _dotted(base)
+    name = ".".join(dotted) if dotted else None
+    if not name or not name.split(".")[-1][:1].isupper():
+        return None, None
+    return name, max_conc
+
+
+def _remote_handle_class(call: ast.Call) -> Optional[str]:
+    """The GC008 receiver->class correlation: just the class name."""
+    return _remote_handle_class_info(call)[0]
 
 
 def _ctor_kind(value: ast.AST) -> Optional[str]:
@@ -273,6 +310,7 @@ class _FileChecker:
         # flagged — and ("", method) when the receiver is dynamic (loop
         # var, container element): conservative module-wide match.
         handle_cls: Dict[str, str] = {}
+        bind_calls: List[ast.Call] = []
         for node in ast.walk(tree):
             if isinstance(node, ast.Assign) \
                     and isinstance(node.value, ast.Call):
@@ -281,16 +319,17 @@ class _FileChecker:
                     for t in node.targets:
                         for nm in _assigned_names(t):
                             handle_cls[nm] = cls
-        self.cgraph_bound: Set[Tuple[str, str]] = set()
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Call) \
+            elif isinstance(node, ast.Call) \
                     and isinstance(node.func, ast.Attribute) \
                     and node.func.attr == "bind" \
                     and isinstance(node.func.value, ast.Attribute):
-                recv = node.func.value.value
-                cls = (handle_cls.get(recv.id, "")
-                       if isinstance(recv, ast.Name) else "")
-                self.cgraph_bound.add((cls, node.func.value.attr))
+                bind_calls.append(node)
+        self.cgraph_bound: Set[Tuple[str, str]] = set()
+        for node in bind_calls:
+            recv = node.func.value.value
+            cls = (handle_cls.get(recv.id, "")
+                   if isinstance(recv, ast.Name) else "")
+            self.cgraph_bound.add((cls, node.func.value.attr))
         for stmt in tree.body:
             if isinstance(stmt, ast.Assign):
                 kind = _ctor_kind(stmt.value)
@@ -402,6 +441,7 @@ class _FileChecker:
         names it stores to (for GC003)."""
         locals_: Set[str] = set()
         stores: Set[str] = set()
+        declared_global: Set[str] = set()
         args = fndef.args
         for a in (list(args.posonlyargs) + list(args.args)
                   + list(args.kwonlyargs)
@@ -416,10 +456,8 @@ class _FileChecker:
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                     and node is not fndef:
                 locals_.add(node.name)
-        # names declared global are NOT locals (they resolve to the module)
-        declared_global: Set[str] = set()
-        for node in ast.walk(fndef):
-            if isinstance(node, ast.Global):
+            elif isinstance(node, ast.Global):
+                # declared-global names resolve to the module, not locals
                 declared_global.update(node.names)
         return {"locals": locals_ - declared_global, "stores": stores}
 
@@ -609,8 +647,12 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
             out.append(p)
         elif os.path.isdir(p):
             for root, dirs, files in os.walk(p):
+                # _graftcheck_fixtures holds intentionally-buggy test
+                # inputs; they are linted by passing the path explicitly,
+                # never by tree discovery (lint.sh must stay green)
                 dirs[:] = [d for d in dirs
-                           if not d.startswith(".") and d != "__pycache__"]
+                           if not d.startswith(".") and d != "__pycache__"
+                           and d != "_graftcheck_fixtures"]
                 for name in sorted(files):
                     if name.endswith(".py"):
                         out.append(os.path.join(root, name))
@@ -619,60 +661,7 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
     return out
 
 
-def main(argv: Optional[Sequence[str]] = None) -> int:
-    parser = argparse.ArgumentParser(
-        prog="python -m ray_tpu.devtools.graftcheck",
-        description="framework-aware static analysis for ray_tpu code")
-    parser.add_argument("paths", nargs="*", help="files or directories")
-    parser.add_argument("--json", action="store_true",
-                        help="emit findings as a JSON array")
-    parser.add_argument("--rules", default="",
-                        help="comma-separated subset (default: all)")
-    parser.add_argument("--list-rules", action="store_true",
-                        help="print the rule table and exit")
-    args = parser.parse_args(argv)
-
-    if args.list_rules:
-        for rule, desc in sorted(RULES.items()):
-            print(f"{rule}  {desc}")
-        return 0
-    if not args.paths:
-        parser.error("the following arguments are required: paths")
-
-    rules = set(RULES)
-    if args.rules:
-        rules = {r.strip().upper() for r in args.rules.split(",") if r.strip()}
-        unknown = rules - set(RULES)
-        if unknown:
-            print(f"unknown rule(s): {', '.join(sorted(unknown))}",
-                  file=sys.stderr)
-            return 2
-    try:
-        files = iter_python_files(args.paths)
-    except FileNotFoundError as e:
-        print(f"no such file or directory: {e}", file=sys.stderr)
-        return 2
-
-    findings: List[Finding] = []
-    errors = 0
-    for path in files:
-        try:
-            findings.extend(check_file(path, rules))
-        except SyntaxError as e:
-            errors += 1
-            print(f"{path}: parse error: {e}", file=sys.stderr)
-    if args.json:
-        print(json.dumps([f.as_dict() for f in findings], indent=2))
-    else:
-        for f in findings:
-            print(f.render())
-        n = len(findings)
-        print(f"graftcheck: {n} finding{'s' if n != 1 else ''} "
-              f"in {len(files)} file{'s' if len(files) != 1 else ''}")
-    if errors:
-        return 2
-    return 1 if findings else 0
-
-
-if __name__ == "__main__":
-    sys.exit(main())
+# Local rules only — the engine runs these per file (cache-keyed by
+# content hash) and layers the whole-program rules on top.
+LOCAL_RULES: Set[str] = {"GC001", "GC002", "GC003", "GC004", "GC005",
+                         "GC006", "GC007", "GC008"}

@@ -1,0 +1,425 @@
+"""Whole-program distributed-correctness rules.
+
+These passes run over the :class:`~.engine.ProjectIndex` (cached file
+summaries — no AST access), every run:
+
+GC010
+    Actor-deadlock detection: cycles of *synchronous* ``get()`` waits
+    through the remote call graph. A cycle of actor methods that each
+    block on the next deadlocks the moment the calls coincide — every
+    actor in the cycle is parked in ``get()`` and cannot serve the
+    incoming call that would unblock it. Self-calls on
+    single-concurrency actors are the 1-cycle special case. Cycles
+    touching an actor created with ``max_concurrency > 1`` anywhere in
+    the project are skipped (a second thread can serve the call).
+
+GC011
+    Interprocedural serialization flow: a known-unserializable value
+    (lock, socket, file handle, thread, ...) flowing into ``.remote()``
+    arguments or out of a task return — including values laundered
+    through helper functions (``f.remote(make_lock())`` where
+    ``make_lock`` returns ``threading.Lock()`` two modules away).
+
+GC001/GC003 (interprocedural upgrade)
+    The local rules only see blocking ``get()`` / global mutation
+    lexically inside the remote body. Here we follow plain calls one
+    level deep: a remote function calling a project-local helper that
+    blocks or mutates module globals gets flagged at the call site.
+    Helpers whose own ``get()`` line carries a GC001 suppression are
+    treated as reviewed and stay silent.
+
+GC008 (call-graph resolution)
+    Replaces the module-local name-matching heuristic: bind receivers
+    are resolved through the project index (handle variables, list-of-
+    handle loop vars, ``self.<attr>`` bindings, imports), so a
+    same-named method on an unrelated actor class is no longer flagged.
+    Unresolvable receivers keep the conservative name-wide fallback.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from .engine import (CallGraph, Edge, ProjectIndex, resolve_call_target,
+                     resolve_submit_target)
+from .local import Finding
+from .summary import suppressed
+
+
+def run(index: ProjectIndex, graph: CallGraph,
+        enabled: Set[str]) -> List[Finding]:
+    out: List[Finding] = []
+    if "GC010" in enabled:
+        out.extend(_gc010(index, graph))
+    if "GC011" in enabled:
+        out.extend(_gc011(index))
+    if "GC001" in enabled or "GC003" in enabled:
+        out.extend(_interprocedural(index, enabled))
+    if "GC008" in enabled:
+        out.extend(_gc008(index))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GC010 — synchronous wait cycles
+
+
+def _gc010(index: ProjectIndex, graph: CallGraph) -> List[Finding]:
+    adj = graph.sync_adj()
+    nodes = set(adj)
+    for edges in adj.values():
+        nodes.update(e.dst for e in edges)
+
+    # Tarjan SCC (iterative)
+    idx_of: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v0: str) -> None:
+        work = [(v0, iter(adj.get(v0, ())))]
+        idx_of[v0] = low[v0] = counter[0]
+        counter[0] += 1
+        stack.append(v0)
+        on_stack.add(v0)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for e in it:
+                w = e.dst
+                if w not in idx_of:
+                    idx_of[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(adj.get(w, ()))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], idx_of[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == idx_of[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                sccs.append(comp)
+
+    for v in sorted(nodes):
+        if v not in idx_of:
+            strongconnect(v)
+
+    findings: List[Finding] = []
+    seen_cycles: Set[Tuple[str, ...]] = set()
+    for comp in sccs:
+        comp_set = set(comp)
+        cyclic = len(comp) > 1 or any(
+            e.dst == comp[0] for e in adj.get(comp[0], ()))
+        if not cyclic:
+            continue
+        cycle = _extract_cycle(adj, comp_set)
+        if not cycle:
+            continue
+        key = _canonical_cycle_key(cycle)
+        if key in seen_cycles:
+            continue
+        seen_cycles.add(key)
+        findings.extend(_report_cycle(index, graph, cycle))
+    return findings
+
+
+def _extract_cycle(adj: Dict[str, List[Edge]],
+                   comp: Set[str]) -> Optional[List[Edge]]:
+    """One elementary cycle inside an SCC (DFS back to the start)."""
+    start = sorted(comp)[0]
+    path: List[Edge] = []
+    visited: Set[str] = set()
+
+    def dfs(v: str) -> bool:
+        for e in sorted(adj.get(v, ()), key=lambda e: (e.dst, e.line)):
+            if e.dst not in comp:
+                continue
+            if e.dst == start:
+                path.append(e)
+                return True
+            if e.dst in visited:
+                continue
+            visited.add(e.dst)
+            path.append(e)
+            if dfs(e.dst):
+                return True
+            path.pop()
+        return False
+
+    visited.add(start)
+    return path if dfs(start) else None
+
+
+def _canonical_cycle_key(cycle: Sequence[Edge]) -> Tuple[str, ...]:
+    names = [e.src for e in cycle]
+    rotations = [tuple(names[i:] + names[:i]) for i in range(len(names))]
+    return min(rotations)
+
+
+def _report_cycle(index: ProjectIndex, graph: CallGraph,
+                  cycle: List[Edge]) -> List[Finding]:
+    # at least one hop must be an actor method: task-only recursion is
+    # GC001's territory (bounded nesting is supported)
+    classes: Set[str] = set()
+    any_actor = False
+    for e in cycle:
+        info = graph.nodes.get(e.dst, {})
+        if info.get("actor_method"):
+            any_actor = True
+        if info.get("cls"):
+            classes.add(info["cls"])
+        src_info = graph.nodes.get(e.src, {})
+        if src_info.get("cls"):
+            classes.add(src_info["cls"])
+    if not any_actor:
+        return []
+    if not all(index.single_concurrency(c) for c in classes):
+        return []
+    # annotating any edge of the cycle acknowledges the whole cycle
+    for e in cycle:
+        s = _summary_for_path(index, e.path)
+        if s is not None and suppressed(s, e.line, "GC010"):
+            return []
+    hops = " -> ".join(
+        f"{e.dst} ({e.path}:{e.line})" for e in cycle)
+    first = cycle[0]
+    concurrency_note = "single-concurrency " if len(cycle) == 1 else ""
+    return [Finding(
+        path=first.path, line=first.line, col=1, rule="GC010",
+        message=f"synchronous get() wait cycle through the remote call "
+                f"graph: {first.src} ({first.path}:{first.line}) -> {hops}; "
+                f"each hop blocks in get() while the {concurrency_note}"
+                f"callee needs the caller to return — this deadlocks when "
+                f"the calls coincide. Break the cycle with async waits, "
+                f"ref-passing, or max_concurrency > 1")]
+
+
+def _summary_for_path(index: ProjectIndex,
+                      path: str) -> Optional[Dict[str, Any]]:
+    for s in index.summaries:
+        if s["path"] == path:
+            return s
+    return None
+
+
+# ---------------------------------------------------------------------------
+# GC011 — serialization flow
+
+
+def _returns_unserializable(index: ProjectIndex) -> Dict[str, str]:
+    """Fixpoint: fq -> unserializable kind for functions whose return
+    value cannot ride the wire (directly or through helpers)."""
+    out: Dict[str, str] = {}
+    for _ in range(4):   # call chains deeper than 4 don't happen here
+        changed = False
+        for fq, (s, fn) in index.functions.items():
+            if fq in out:
+                continue
+            for p in fn["returns"]:
+                kind = _prov_unser_kind(index, s, fn, p, out)
+                if kind:
+                    out[fq] = kind
+                    changed = True
+                    break
+        if not changed:
+            break
+    return out
+
+
+def _prov_unser_kind(index: ProjectIndex, summary: Dict[str, Any],
+                     fn: Dict[str, Any], prov: Dict[str, Any],
+                     returns_map: Dict[str, str]) -> Optional[str]:
+    if prov["kind"] == "ctor":
+        return prov["ctor"]
+    if prov["kind"] == "var":
+        direct = fn["local_unser"].get(prov["name"]) \
+            or summary["module_unser"].get(prov["name"])
+        if direct:
+            return direct
+        # var assigned from a helper call: lk = make_lock()
+        callee_name = fn.get("call_assigns", {}).get(prov["name"])
+        if callee_name:
+            callee = _resolve_call(index, summary, fn, callee_name)
+            if callee:
+                return returns_map.get(callee)
+        return None
+    if prov["kind"] == "call" and prov.get("name"):
+        callee = _resolve_call(index, summary, fn, prov["name"])
+        if callee:
+            return returns_map.get(callee)
+    return None
+
+
+_resolve_call = resolve_call_target
+
+
+def _gc011(index: ProjectIndex) -> List[Finding]:
+    returns_map = _returns_unserializable(index)
+    findings: List[Finding] = []
+    for fq, (s, fn) in index.functions.items():
+        # (a) unserializable values flowing into .remote() args
+        for sub in fn["submits"]:
+            if "GC011" in sub["suppress"]:
+                continue
+            provs = list(enumerate(sub["args"])) + \
+                [(k, v) for k, v in sub["kwargs"].items()]
+            for pos, p in provs:
+                kind = _prov_unser_kind(index, s, fn, p, returns_map)
+                if not kind:
+                    continue
+                via = ""
+                if p["kind"] == "call":
+                    callee = _resolve_call(index, s, fn, p["name"])
+                    loc = ""
+                    if callee:
+                        cs, cfn = index.functions[callee]
+                        loc = f" ({cs['path']}:{cfn['lineno']})"
+                    via = f" via helper {p['name']}(){loc}"
+                elif p["kind"] == "var":
+                    via = f" via '{p['name']}'"
+                findings.append(Finding(
+                    path=s["path"], line=sub["lineno"], col=sub["col"],
+                    rule="GC011",
+                    message=f"argument {pos} of this .remote() call is a "
+                            f"{kind}{via}; it cannot be serialized to a "
+                            f"worker — create it inside the task or hold "
+                            f"it in an actor"))
+        # (b) remote functions / actor methods returning unserializable.
+        # Nested closures inside actor methods inherit is_remote for the
+        # other passes but their returns don't cross the wire — only the
+        # method itself ("Cls.m", depth 1) serializes its return value.
+        if not fn["is_remote"]:
+            continue
+        if fn.get("cls") and fn["qname"].count(".") != 1:
+            continue
+        for p in fn["returns"]:
+            kind = _prov_unser_kind(index, s, fn, p, returns_map)
+            if not kind:
+                continue
+            line = p.get("lineno", fn["lineno"])
+            if suppressed(s, line, "GC011"):
+                continue
+            via = f" via helper {p['name']}()" if p["kind"] == "call" else ""
+            findings.append(Finding(
+                path=s["path"], line=line, col=1, rule="GC011",
+                message=f"remote {fn['qname']} returns a {kind}{via}; task "
+                        f"returns must be serializable — return a handle "
+                        f"or plain data instead"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# interprocedural GC001 / GC003 (one level deep)
+
+
+def _interprocedural(index: ProjectIndex,
+                     enabled: Set[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for fq, (s, fn) in index.functions.items():
+        if not fn["is_remote"]:
+            continue
+        for call in fn["calls"]:
+            callee = _resolve_call(index, s, fn, call["name"])
+            if callee is None or callee == fq:
+                continue
+            cs, cfn = index.functions[callee]
+            if cfn["is_remote"]:
+                continue   # direct remote-body gets are the local rule's job
+            if "GC001" in enabled and "GC001" not in call["suppress"]:
+                hot = [g for g in cfn["gets"]
+                       if "GC001" not in g["suppress"]]
+                if hot:
+                    g0 = hot[0]
+                    findings.append(Finding(
+                        path=s["path"], line=call["lineno"],
+                        col=call["col"], rule="GC001",
+                        message=f"helper {call['name']}() blocks in get() "
+                                f"at {cs['path']}:{g0['lineno']} and is "
+                                f"called from remote {fn['qname']} — same "
+                                f"nested-get deadlock risk as a direct "
+                                f"get() (interprocedural, one level)"))
+            if "GC003" in enabled and "GC003" not in call["suppress"] \
+                    and cfn["global_writes"]:
+                findings.append(Finding(
+                    path=s["path"], line=call["lineno"], col=call["col"],
+                    rule="GC003",
+                    message=f"helper {call['name']}() "
+                            f"({cs['path']}:{cfn['lineno']}) mutates module "
+                            f"global(s) {', '.join(cfn['global_writes'])} "
+                            f"and is called from remote {fn['qname']}; the "
+                            f"write lands in the worker process and is "
+                            f"lost (interprocedural, one level)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# GC008 — call-graph-resolved compiled-graph binding
+
+
+_GC008_REMOTE_MSG = (
+    "dynamic .remote() submission inside a method bound into a compiled "
+    "graph reintroduces per-call scheduling and can deadlock against the "
+    "resident loop; keep bound methods pure compute and do dynamic work "
+    "outside the graph")
+_GC008_GET_MSG = (
+    "blocking get() inside a method bound into a compiled graph stalls "
+    "the resident loop (and every downstream stage) on the dynamic task "
+    "plane; pass the value through the graph's channels instead")
+
+
+def _gc008(index: ProjectIndex) -> List[Finding]:
+    resolved: Set[Tuple[str, str]] = set()     # (cls_fq, method)
+    fallback: Set[str] = set()                 # method names, name-wide
+    for s in index.summaries:
+        for b in s["bind_sites"]:
+            if b.get("resolved") and b.get("cls"):
+                cls_fq = index.resolve_class(s, b["cls"])
+                if cls_fq is not None:
+                    resolved.add((cls_fq, b["method"]))
+                    continue
+            fallback.add(b["method"])
+
+    findings: List[Finding] = []
+    for fq, (s, fn) in index.functions.items():
+        cls = fn.get("cls")
+        if not cls:
+            continue
+        crec = s["classes"].get(cls)
+        if not crec or not crec["is_actor"]:
+            continue
+        # "Cls.method" or nested "Cls.method.inner" — the bound method is
+        # the first component after the class name
+        qparts = fn["qname"].split(".")
+        if len(qparts) < 2:
+            continue
+        method = qparts[1]
+        cls_fq = f"{s['module']}.{cls}"
+        if (cls_fq, method) not in resolved and method not in fallback:
+            continue
+        for sub in fn["submits"]:
+            if "GC008" in sub["suppress"]:
+                continue
+            findings.append(Finding(
+                path=s["path"], line=sub["lineno"], col=sub["col"],
+                rule="GC008", message=_GC008_REMOTE_MSG))
+        for g in fn["gets"]:
+            if "GC008" in g["suppress"]:
+                continue
+            findings.append(Finding(
+                path=s["path"], line=g["lineno"], col=g["col"],
+                rule="GC008", message=_GC008_GET_MSG))
+    return findings

@@ -1,0 +1,233 @@
+"""TPU/SPMD sharding-consistency rules (GC020 series).
+
+Mis-sharded SPMD code rarely fails loudly: a collective over an axis
+the enclosing mesh never bound either errors deep inside XLA lowering
+or — worse — silently materializes cross-replica transfers. These
+passes check ``shard_map`` discipline statically, over the project
+index (so a mesh defined in a ``mesh.py``-style module and a kernel in
+another file still line up):
+
+GC020
+    A collective (``psum``/``pmean``/``ppermute``/``pvary``/
+    ``axis_index``/...) inside a shard-mapped function names an axis
+    that is not bound by the enclosing ``axis_names=`` set or the mesh's
+    axis names. Symbolic axes are matched by symbol (``pp_axis`` in the
+    body vs ``axis_names=frozenset({pp_axis})``) and through module-
+    level string constants; anything unresolvable stays silent —
+    the rule only fires when both sides are fully known.
+
+GC021
+    ``in_specs`` arity mismatched to the wrapped function's signature:
+    ``shard_map(f, in_specs=(a, b))`` where ``f`` takes three required
+    arguments fails at trace time with a pytree error that names
+    neither side. Resolves local defs, imported project functions,
+    ``functools.partial`` (bound positionals + keywords), and lambdas.
+
+GC022 (evaluated at extraction time, cached with the local findings)
+    A buffer passed at a ``donate_argnums`` position of a jitted call
+    and read afterwards — XLA may have reused its memory.
+
+Only calls that resolve to the real ``shard_map`` (``jax.shard_map``,
+``jax.experimental.shard_map.shard_map``, or the repo's
+``ray_tpu.jax_compat.shard_map`` shim) are checked; Pallas
+``in_specs=[pl.BlockSpec...]`` grids never match.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from .engine import SHARD_MAP_FQS, ProjectIndex
+from .local import Finding
+
+
+def run(index: ProjectIndex, enabled: Set[str]) -> List[Finding]:
+    if not ({"GC020", "GC021"} & enabled):
+        return []
+    out: List[Finding] = []
+    for s in index.summaries:
+        for site in s["shardmap"]:
+            if not _is_real_shard_map(index, s, site):
+                continue
+            target = _resolve_wrapped(index, s, site)
+            if "GC021" in enabled and "GC021" not in site["suppress"]:
+                out.extend(_gc021(s, site, target))
+            if "GC020" in enabled:
+                out.extend(_gc020(index, s, site, target))
+    return out
+
+
+def _is_real_shard_map(index: ProjectIndex, summary: Dict[str, Any],
+                       site: Dict[str, Any]) -> bool:
+    fq = index.resolve(summary, site["callee"])
+    return fq in SHARD_MAP_FQS
+
+
+def _resolve_wrapped(index: ProjectIndex, summary: Dict[str, Any],
+                     site: Dict[str, Any]
+                     ) -> Optional[Tuple[Dict[str, Any], Dict[str, Any]]]:
+    """The wrapped function's (summary, fn record), resolving nested
+    defs in the enclosing scope, module-level defs, and imports."""
+    fnref = site["fn"]
+    if fnref["kind"] not in ("name", "partial"):
+        return None
+    name = fnref["name"]
+    if not name or name.startswith("self."):
+        return None
+    # nested def in the enclosing scope: encl qname + "." + name
+    encl = site["encl"]
+    if encl and encl != "<module>":
+        cand = f"{encl}.{name}"
+        if cand in summary["functions"]:
+            return summary, summary["functions"][cand]
+    if name in summary["functions"]:
+        return summary, summary["functions"][name]
+    fq = index.resolve_function(summary, name)
+    return index.functions[fq] if fq else None
+
+
+# ---------------------------------------------------------------------------
+# GC021 — in_specs arity
+
+
+def _gc021(summary: Dict[str, Any], site: Dict[str, Any],
+           target: Optional[Tuple[Dict[str, Any], Dict[str, Any]]]
+           ) -> List[Finding]:
+    arity = site["in_specs_arity"]
+    if arity is None:
+        return []
+    fnref = site["fn"]
+    if fnref["kind"] == "lambda":
+        lo = fnref["nparams"] - fnref["ndefaults"]
+        hi = None if fnref["vararg"] else fnref["nparams"]
+        desc = "lambda"
+    elif target is not None:
+        ts, tfn = target
+        if tfn.get("cls"):
+            return []   # bound methods: `self` skews the count
+        params = list(tfn["params"])
+        n_def = tfn["n_defaults"]
+        defaulted = set(params[len(params) - n_def:]) if n_def else set()
+        if fnref["kind"] == "partial":
+            params = params[fnref["npos"]:]
+            params = [p for p in params if p not in set(fnref["kw"])]
+            defaulted = {p for p in defaulted if p in params}
+        lo = len(params) - len(defaulted)
+        hi = None if tfn["has_vararg"] else len(params)
+        desc = f"{tfn['qname']}() ({ts['path']}:{tfn['lineno']})"
+    else:
+        return []
+    if arity < lo or (hi is not None and arity > hi):
+        want = str(lo) if hi == lo else \
+            (f"{lo}..{hi}" if hi is not None else f">= {lo}")
+        return [Finding(
+            path=summary["path"], line=site["lineno"], col=1, rule="GC021",
+            message=f"shard_map in_specs has {arity} "
+                    f"entr{'y' if arity == 1 else 'ies'} but the wrapped "
+                    f"{desc} takes {want} positional argument(s); the "
+                    f"mismatch fails at trace time with an opaque pytree "
+                    f"error — make in_specs match the call arity")]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# GC020 — unbound collective axes
+
+
+def _bound_axes(index: ProjectIndex, summary: Dict[str, Any],
+                site: Dict[str, Any]
+                ) -> Optional[Tuple[Set[str], Set[str]]]:
+    """-> (literal axis names, unresolved symbolic names) bound by this
+    shard_map site, or None when the bound set is unknowable."""
+    lits: Set[str] = set()
+    syms: Set[str] = set()
+    if site["axis_given"]:
+        ax = site["axis"]
+        if ax is None or not ax["clean"]:
+            return None
+        lits.update(ax["lits"])
+        for sym in ax["syms"]:
+            const = index.lookup_str_const(summary, sym)
+            if const is not None:
+                lits.add(const)
+                continue
+            axes = index.lookup_mesh_axes(summary, sym)
+            if axes is not None:
+                lits.update(axes)
+                continue
+            syms.add(sym)
+        return lits, syms
+    # no axis_names=: manual over every mesh axis — need the mesh
+    if site["mesh"]:
+        axes = index.lookup_mesh_axes(summary, site["mesh"])
+        if axes is not None:
+            return set(axes), set()
+    return None
+
+
+def _gc020(index: ProjectIndex, summary: Dict[str, Any],
+           site: Dict[str, Any],
+           target: Optional[Tuple[Dict[str, Any], Dict[str, Any]]]
+           ) -> List[Finding]:
+    if target is None:
+        return []
+    bound = _bound_axes(index, summary, site)
+    if bound is None:
+        return []
+    bound_lits, bound_syms = bound
+    ts, tfn = target
+    tq = tfn["qname"]
+    findings: List[Finding] = []
+    for coll in ts["collectives"]:
+        if coll["encl"] != tq and not coll["encl"].startswith(tq + "."):
+            continue
+        if "GC020" in coll["suppress"] or "GC020" in site["suppress"]:
+            continue
+        ax = coll["axis"]
+        if ax is None or not ax["clean"]:
+            continue
+        if not _is_real_collective(index, ts, coll):
+            continue
+        bad: List[str] = []
+        if not bound_syms:
+            # fully literal bound set: literals must be members, symbols
+            # must resolve to members
+            for lit in ax["lits"]:
+                if lit not in bound_lits:
+                    bad.append(lit)
+            for sym in ax["syms"]:
+                const = index.lookup_str_const(ts, sym)
+                if const is not None and const not in bound_lits:
+                    bad.append(f"{sym}={const!r}")
+        else:
+            # symbolic bound set: only symbol-by-symbol matches are
+            # provable; unknown symbols/literals stay silent
+            for sym in ax["syms"]:
+                if sym not in bound_syms:
+                    const = index.lookup_str_const(ts, sym)
+                    if const is not None and const not in bound_lits:
+                        bad.append(f"{sym}={const!r}")
+        if not bad:
+            continue
+        bound_desc = ", ".join(sorted(bound_lits)
+                               + [f"<{x}>" for x in sorted(bound_syms)])
+        findings.append(Finding(
+            path=ts["path"], line=coll["lineno"], col=coll["col"],
+            rule="GC020",
+            message=f"collective {coll['op']}() names axis "
+                    f"{', '.join(repr(b) for b in bad)} which is not "
+                    f"bound by the enclosing shard_map at "
+                    f"{summary['path']}:{site['lineno']} (bound axes: "
+                    f"{bound_desc or 'none'}); unbound axes fail at "
+                    f"lowering or silently change collective scope"))
+    return findings
+
+
+def _is_real_collective(index: ProjectIndex, summary: Dict[str, Any],
+                        coll: Dict[str, Any]) -> bool:
+    d = coll["dotted"]
+    if "." in d:
+        parts = d.split(".")
+        return "lax" in parts[:-1]
+    fq = index.resolve(summary, d)
+    return "jax" in fq.split(".")[0] or ".jax_compat." in fq \
+        or fq.startswith("ray_tpu.jax_compat")

@@ -1,0 +1,72 @@
+"""SARIF 2.1.0 output — GitHub code-scanning ingests this directly, so
+CI findings surface as inline PR annotations (`--sarif`, wired in
+.github/workflows/ci.yml)."""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Sequence
+
+from .local import RULES, Finding
+
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+SARIF_VERSION = "2.1.0"
+TOOL_VERSION = "2.0.0"
+
+
+def _uri(path: str, base: str) -> str:
+    try:
+        rel = os.path.relpath(os.path.abspath(path), base)
+    except ValueError:
+        rel = path
+    if rel.startswith(".."):
+        rel = path
+    return rel.replace(os.sep, "/")
+
+
+def to_sarif(findings: Sequence[Finding],
+             base_dir: str = ".") -> Dict[str, Any]:
+    """Findings -> a SARIF 2.1.0 log (one run, one result per finding,
+    URIs relative to `base_dir` so code-scanning can anchor them)."""
+    base = os.path.abspath(base_dir)
+    rules: List[Dict[str, Any]] = [
+        {"id": rid,
+         "shortDescription": {"text": desc},
+         "helpUri": "docs/GRAFTCHECK.md",
+         "defaultConfiguration": {"level": "warning"}}
+        for rid, desc in sorted(RULES.items())]
+    results: List[Dict[str, Any]] = []
+    for f in findings:
+        results.append({
+            "ruleId": f.rule,
+            "ruleIndex": sorted(RULES).index(f.rule),
+            "level": "warning",
+            "message": {"text": f"{f.rule}: {f.message}"},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": _uri(f.path, base),
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(f.line, 1),
+                               "startColumn": max(f.col, 1)},
+                }}],
+            "partialFingerprints": {
+                "graftcheck/v1": f"{f.rule}:{_uri(f.path, base)}:{f.line}",
+            }})
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftcheck",
+                "informationUri":
+                    "https://github.com/ray-tpu/ray_tpu"
+                    "/blob/main/docs/GRAFTCHECK.md",
+                "version": TOOL_VERSION,
+                "rules": rules,
+            }},
+            "originalUriBaseIds": {
+                "SRCROOT": {"uri": "file://" + base.replace(os.sep, "/")
+                            + "/"}},
+            "results": results,
+        }],
+    }

@@ -1,0 +1,835 @@
+"""Per-file fact extraction for the whole-program engine.
+
+One parse of a file yields a JSON-serializable *summary* — the facts the
+project-level rule passes need without ever touching the AST again:
+
+- imports (absolute + relative, resolved to dotted targets),
+- function/method records: params, decorators, blocking ``get()`` sites,
+  ``.remote()`` submissions (with receiver + argument provenance and
+  whether the result is synchronously waited on), plain calls, returns,
+  module-global writes, locally-created unserializable objects,
+- class records: actor-ness, methods, ``self.x = <handle>`` bindings,
+- compiled-graph ``<recv>.<method>.bind(...)`` sites with receiver
+  resolution (handle var / list-of-handles loop var / self attribute),
+- SPMD facts: ``shard_map`` call sites (wrapped fn, in_specs arity,
+  axis_names, mesh), collective call sites with their axis argument,
+  module-level mesh/str constants,
+- the file's suppression map, so project findings honor the same
+  ``# graftcheck: disable=`` comments as the local rules.
+
+Summaries are cached by content hash (see :mod:`.engine`); the project
+passes (:mod:`.rules_project`, :mod:`.rules_spmd`) run over summaries
+only, which is what makes warm runs cheap.
+
+GC022 (donated-buffer read after a jitted call) is evaluated *here*,
+during extraction: it is purely local, needs statement ordering, and
+computing it alongside the other local rules keeps the warm path
+parse-free (its findings are cached with the local ones).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from .local import (Finding, _assigned_names, _ctor_kind, _dotted,
+                    _is_remote_decorator, _parse_suppressions,
+                    _remote_handle_class_info as _handle_class)
+
+# Folded into the cache key (engine.CACHE_VERSION): bump when the
+# summary schema or extraction logic changes.
+SUMMARY_VERSION = 1
+
+# collective -> positional index of its axis argument
+COLLECTIVE_AXIS_ARG: Dict[str, int] = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "ppermute": 1,
+    "all_gather": 1, "psum_scatter": 1, "all_to_all": 1, "pshuffle": 1,
+    "axis_index": 0, "pvary": 1, "pcast": 1,
+}
+_AXIS_KWARGS = ("axis_name", "axis_names")
+
+
+def _dotted_str(node: Optional[ast.AST]) -> Optional[str]:
+    if node is None:
+        return None
+    d = _dotted(node)
+    return ".".join(d) if d else None
+
+
+def _axis_value(node: ast.AST) -> Dict[str, Any]:
+    """Classify an axis argument: literal strings, symbolic names, and
+    whether every element was understood (``clean``)."""
+    lits: List[str] = []
+    syms: List[str] = []
+    clean = True
+
+    def _one(n: ast.AST) -> None:
+        nonlocal clean
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            lits.append(n.value)
+        elif isinstance(n, ast.Name):
+            syms.append(n.id)
+        elif isinstance(n, (ast.Tuple, ast.List, ast.Set)):
+            for e in n.elts:
+                _one(e)
+        elif isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id in ("frozenset", "set", "tuple", "list") \
+                and len(n.args) == 1:
+            _one(n.args[0])
+        else:
+            clean = False
+
+    _one(node)
+    return {"lits": lits, "syms": syms, "clean": clean}
+
+
+def _prov(expr: Optional[ast.AST]) -> Dict[str, Any]:
+    """Provenance of a value expression, as far as one file can tell."""
+    if expr is None:
+        return {"kind": "none"}
+    if isinstance(expr, ast.Await):
+        return _prov(expr.value)
+    if isinstance(expr, ast.Call):
+        kind = _ctor_kind(expr)
+        if kind:
+            return {"kind": "ctor", "ctor": kind}
+        if isinstance(expr.func, ast.Attribute) and expr.func.attr == "remote":
+            return {"kind": "submit"}
+        return {"kind": "call", "name": _dotted_str(expr.func) or ""}
+    if isinstance(expr, ast.Name):
+        return {"kind": "var", "name": expr.id}
+    return {"kind": "other"}
+
+
+def _int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, int)):
+                return None
+            out.append(e.value)
+        return tuple(out)
+    return None
+
+
+def _jit_donate_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """``jax.jit(f, donate_argnums=...)`` /
+    ``functools.partial(jax.jit, donate_argnums=...)`` -> positions."""
+    func_d = _dotted(call.func)
+    if func_d is None:
+        return None
+    is_jit = func_d[-1] == "jit"
+    is_partial_jit = False
+    if func_d[-1] == "partial" and call.args:
+        arg_d = _dotted(call.args[0])
+        is_partial_jit = arg_d is not None and arg_d[-1] == "jit"
+    if not (is_jit or is_partial_jit):
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return _int_tuple(kw.value)
+    return None
+
+
+def _child_defs(stmts: Sequence[ast.stmt]) -> List[ast.stmt]:
+    """Function/class defs directly owned by this scope — any depth of
+    control flow, but not inside other defs."""
+    out: List[ast.stmt] = []
+    stack: List[ast.stmt] = list(stmts)
+    while stack:
+        st = stack.pop(0)
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            out.append(st)
+            continue
+        for fld in ("body", "orelse", "finalbody"):
+            child = getattr(st, fld, None)
+            if isinstance(child, list):
+                stack.extend(c for c in child if isinstance(c, ast.stmt))
+        for handler in getattr(st, "handlers", ()):
+            stack.extend(handler.body)
+        for case in getattr(st, "cases", ()):
+            stack.extend(case.body)
+    return out
+
+
+def suppressed(summary: Dict[str, Any], line: int, rule: str) -> bool:
+    return rule in summary.get("suppress_file", ()) \
+        or rule in summary.get("suppress_line", {}).get(str(line), ())
+
+
+# ---------------------------------------------------------------------------
+# the extractor
+
+
+class _Extractor:
+    def __init__(self, path: str, source: str, tree: ast.Module,
+                 module: str):
+        self.path = path
+        self.tree = tree
+        per_line, file_wide = _parse_suppressions(source)
+        self.summary: Dict[str, Any] = {
+            "path": path,
+            "module": module,
+            "suppress_line": {str(k): sorted(v) for k, v in per_line.items()},
+            "suppress_file": sorted(file_wide),
+            "imports": {},
+            "module_unser": {},
+            "str_consts": {},
+            "tuple_consts": {},
+            "mesh_vars": {},
+            "handles": {},        # module var -> dotted class (as written)
+            "handle_lists": {},   # module list-of-handles var -> class
+            "functions": {},      # qname -> fn record
+            "classes": {},        # name -> class record
+            "bind_sites": [],
+            "shardmap": [],
+            "collectives": [],
+            "actor_options": [],  # creation-site concurrency facts
+        }
+        self.extra_findings: List[Finding] = []
+        self._bare_get_names: Set[str] = set()
+        self._seen_submits: Set[int] = set()   # id(Call) dedup
+
+    # -- imports ----------------------------------------------------------
+
+    def _import_base(self, node: ast.ImportFrom) -> Optional[str]:
+        if node.level == 0:
+            return node.module or ""
+        parts = self.summary["module"].split(".")
+        if node.level > len(parts):
+            return None
+        base = parts[:len(parts) - node.level]
+        if node.module:
+            base = base + node.module.split(".")
+        return ".".join(base)
+
+    def _iter_statements(self):
+        """Every statement in the file (imports can hide inside function
+        bodies and try/if blocks) without visiting expression nodes —
+        ast.walk over full trees dominates cold-run time otherwise."""
+        stack: List[ast.stmt] = list(self.tree.body)
+        while stack:
+            st = stack.pop()
+            yield st
+            for fld in ("body", "orelse", "finalbody"):
+                child = getattr(st, fld, None)
+                if isinstance(child, list):
+                    stack.extend(c for c in child
+                                 if isinstance(c, ast.stmt))
+            for handler in getattr(st, "handlers", ()):
+                stack.extend(handler.body)
+            for case in getattr(st, "cases", ()):
+                stack.extend(case.body)
+
+    def _collect_imports(self) -> None:
+        imports = self.summary["imports"]
+        for node in self._iter_statements():
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        imports[alias.asname] = alias.name
+                    else:
+                        root = alias.name.split(".")[0]
+                        imports.setdefault(root, root)
+            elif isinstance(node, ast.ImportFrom):
+                base = self._import_base(node)
+                if base is None:
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    target = f"{base}.{alias.name}" if base else alias.name
+                    imports[alias.asname or alias.name] = target
+                    if alias.name == "get" and base.split(".")[0] in (
+                            "ray_tpu", "ray"):
+                        self._bare_get_names.add(alias.asname or alias.name)
+
+    # -- module level -----------------------------------------------------
+
+    def run(self) -> Tuple[Dict[str, Any], List[Finding]]:
+        self._collect_imports()
+        for stmt in self.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                self._module_assign(stmt.targets[0], stmt.value)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None \
+                    and isinstance(stmt.target, ast.Name):
+                self._module_assign(stmt.target, stmt.value)
+        # module-level executable code behaves like one implicit function
+        # (drivers/examples submit + get at module scope)
+        mod_fn = self._fn_record("<module>", "<module>", lineno=0, cls=None,
+                                 is_remote=False)
+        self._scan_scope(self.tree.body, mod_fn,
+                         scope_handles=dict(self.summary["handles"]),
+                         scope_lists=dict(self.summary["handle_lists"]))
+        self.summary["functions"]["<module>"] = mod_fn
+        for d in _child_defs(self.tree.body):
+            if isinstance(d, ast.ClassDef):
+                self._visit_class(d)
+            else:
+                self._visit_fn(d, qprefix="", cls=None)
+        return self.summary, self.extra_findings
+
+    def _module_assign(self, target: ast.AST, value: ast.AST) -> None:
+        s = self.summary
+        names = _assigned_names(target)
+        if len(names) != 1:
+            return
+        name = names[0]
+        kind = _ctor_kind(value)
+        if kind:
+            s["module_unser"][name] = kind
+            return
+        if isinstance(value, ast.Constant) and isinstance(value.value, str):
+            s["str_consts"][name] = value.value
+            return
+        if isinstance(value, (ast.Tuple, ast.List)) and value.elts \
+                and all(isinstance(e, ast.Constant)
+                        and isinstance(e.value, str) for e in value.elts):
+            s["tuple_consts"][name] = [e.value for e in value.elts]
+            return
+        if isinstance(value, ast.Call):
+            cls, max_conc = _handle_class(value)
+            if cls:
+                s["handles"][name] = cls
+                s["actor_options"].append(
+                    {"cls": cls, "max_concurrency": max_conc,
+                     "lineno": value.lineno})
+                return
+            axes = self._mesh_axes(value)
+            if axes is not None:
+                s["mesh_vars"][name] = axes
+                return
+        cls = self._handle_list_class(value)
+        if cls:
+            s["handle_lists"][name] = cls
+
+    def _mesh_axes(self, call: ast.Call) -> Optional[List[str]]:
+        """Literal axis names of a ``Mesh(devs, axes)`` /
+        ``...(axis_names=axes)`` construction, else None."""
+        d = _dotted(call.func)
+        if d is None:
+            return None
+        cand: Optional[ast.AST] = None
+        if d[-1] == "Mesh" and len(call.args) >= 2:
+            cand = call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "axis_names":
+                cand = kw.value
+        if cand is None:
+            return None
+        v = _axis_value(cand)
+        if v["clean"] and not v["syms"]:
+            return v["lits"]
+        if v["clean"] and not v["lits"] and len(v["syms"]) == 1:
+            t = self.summary["tuple_consts"].get(v["syms"][0])
+            if t is not None:
+                return list(t)
+        return None
+
+    def _handle_list_class(self, value: ast.AST) -> Optional[str]:
+        """``[Cls.remote(...) for ...]`` / ``[Cls.remote(), ...]`` ->
+        dotted class name when every element is a handle of one class."""
+        elts: List[ast.AST] = []
+        if isinstance(value, (ast.ListComp, ast.GeneratorExp)):
+            elts = [value.elt]
+        elif isinstance(value, (ast.List, ast.Tuple)) and value.elts:
+            elts = list(value.elts)
+        classes = set()
+        for e in elts:
+            if not isinstance(e, ast.Call):
+                return None
+            cls, _ = _handle_class(e)
+            if cls is None:
+                return None
+            classes.add(cls)
+        return classes.pop() if len(classes) == 1 else None
+
+    # -- defs --------------------------------------------------------------
+
+    def _visit_class(self, node: ast.ClassDef) -> None:
+        is_actor = any(_is_remote_decorator(d) for d in node.decorator_list)
+        rec = {
+            "lineno": node.lineno,
+            "is_actor": is_actor,
+            "methods": [],
+            "attr_handles": {},   # self.<attr> -> dotted class
+            "has_async": False,
+        }
+        self.summary["classes"].setdefault(node.name, rec)
+        for d in _child_defs(node.body):
+            if isinstance(d, ast.ClassDef):
+                self._visit_class(d)
+            else:
+                rec["methods"].append(d.name)
+                if isinstance(d, ast.AsyncFunctionDef):
+                    rec["has_async"] = True
+                self._visit_fn(d, qprefix=node.name + ".", cls=node.name)
+
+    def _fn_record(self, name: str, qname: str, lineno: int,
+                   cls: Optional[str], is_remote: bool) -> Dict[str, Any]:
+        return {
+            "name": name, "qname": qname, "lineno": lineno, "cls": cls,
+            "is_remote": is_remote, "params": [], "n_defaults": 0,
+            "has_vararg": False, "annotations": {},
+            "gets": [], "submits": [], "calls": [], "returns": [],
+            "global_writes": [], "local_unser": {}, "call_assigns": {},
+        }
+
+    def _visit_fn(self, node: ast.AST, qprefix: str,
+                  cls: Optional[str]) -> None:
+        qname = qprefix + node.name
+        cls_rec = self.summary["classes"].get(cls) if cls else None
+        is_remote = any(_is_remote_decorator(d)
+                        for d in node.decorator_list) \
+            or bool(cls_rec and cls_rec["is_actor"])
+        fn = self._fn_record(node.name, qname, node.lineno, cls, is_remote)
+        args = node.args
+        pos = list(args.posonlyargs) + list(args.args)
+        fn["params"] = [a.arg for a in pos]
+        fn["n_defaults"] = len(args.defaults)
+        fn["has_vararg"] = args.vararg is not None
+        for a in pos + list(args.kwonlyargs):
+            ann = a.annotation
+            if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+                fn["annotations"][a.arg] = ann.value
+            elif ann is not None:
+                d = _dotted_str(ann)
+                if d:
+                    fn["annotations"][a.arg] = d
+
+        scope_handles: Dict[str, str] = dict(self.summary["handles"])
+        scope_lists: Dict[str, str] = dict(self.summary["handle_lists"])
+        # annotated params act as handles of the annotated class (only
+        # CamelCase annotations can be actor classes)
+        for p, ann in fn["annotations"].items():
+            if ann.split(".")[-1][:1].isupper():
+                scope_handles.setdefault(p, ann)
+
+        self._scan_scope(node.body, fn, scope_handles, scope_lists)
+        self.summary["functions"][qname] = fn
+        for d in _child_defs(node.body):
+            if isinstance(d, ast.ClassDef):
+                self._visit_class(d)
+            else:
+                self._visit_fn(d, qprefix=qname + ".", cls=cls)
+
+    # -- one-scope statement scan -----------------------------------------
+
+    def _scan_scope(self, stmts: Sequence[ast.stmt], fn: Dict[str, Any],
+                    scope_handles: Dict[str, str],
+                    scope_lists: Dict[str, str]) -> None:
+        donated: Dict[str, Tuple[int, ...]] = {}
+        donated_args: List[Tuple[str, int, int]] = []  # (var, line, end)
+        loads: Dict[str, List[int]] = {}
+        stores: Dict[str, List[int]] = {}
+        globals_declared: Set[str] = set()
+        ctx = {"fn": fn, "handles": scope_handles, "lists": scope_lists,
+               "donated": donated, "donated_args": donated_args,
+               "loads": loads, "stores": stores}
+
+        def walk_stmt(stmt: ast.stmt) -> None:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                # nested scopes get their own record; a nested def carrying
+                # @partial(jax.jit, donate_argnums=...) registers as a
+                # donated callable of THIS scope
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for dec in stmt.decorator_list:
+                        if isinstance(dec, ast.Call):
+                            p = _jit_donate_positions(dec)
+                            if p:
+                                donated[stmt.name] = p
+                return
+            if isinstance(stmt, ast.Global):
+                globals_declared.update(stmt.names)
+            if isinstance(stmt, ast.Assign):
+                self._scan_assign(stmt, ctx)
+            if isinstance(stmt, ast.For) and isinstance(stmt.iter, ast.Name):
+                lcls = scope_lists.get(stmt.iter.id)
+                if lcls:
+                    for nm in _assigned_names(stmt.target):
+                        scope_handles[nm] = lcls
+            if isinstance(stmt, ast.Return):
+                p = _prov(stmt.value)
+                p["lineno"] = stmt.lineno
+                fn["returns"].append(p)
+            for node in ast.iter_child_nodes(stmt):
+                if not isinstance(node, (ast.stmt, ast.ExceptHandler)):
+                    self._scan_expr_tree(node, stmt, ctx)
+            for fld in ("body", "orelse", "finalbody"):
+                child = getattr(stmt, fld, None)
+                if isinstance(child, list):
+                    for c in child:
+                        if isinstance(c, ast.stmt):
+                            walk_stmt(c)
+            for handler in getattr(stmt, "handlers", ()):
+                for c in handler.body:
+                    walk_stmt(c)
+            for case in getattr(stmt, "cases", ()):
+                for c in case.body:
+                    walk_stmt(c)
+
+        for stmt in stmts:
+            walk_stmt(stmt)
+
+        fn["global_writes"] = sorted(globals_declared & set(stores))
+
+        # sync-marking: a get() over a var holding a submit result
+        for g in fn["gets"]:
+            for var in g.get("vars", ()):
+                for sub in fn["submits"]:
+                    if var in sub["assigned"] and sub["lineno"] <= g["lineno"]:
+                        sub["sync"] = True
+                        sub["sync_line"] = g["lineno"]
+                        g["matched"] = True
+        # `ref.get()`-style maybe-gets only count when matched to a submit
+        fn["gets"] = [g for g in fn["gets"]
+                      if not g.get("maybe") or g.get("matched")]
+
+        # GC022: donated buffers read after the jitted call
+        for var, call_line, call_end in donated_args:
+            later = [ln for ln in loads.get(var, ()) if ln > call_end]
+            if not later:
+                continue
+            first = min(later)
+            if any(call_line <= ln <= first for ln in stores.get(var, ())):
+                continue  # rebound (e.g. params, opt = update(params, opt))
+            if suppressed(self.summary, first, "GC022"):
+                continue
+            self.extra_findings.append(Finding(
+                path=self.path, line=first, col=1, rule="GC022",
+                message=f"'{var}' was donated to the jitted call at line "
+                        f"{call_line} (donate_argnums) and is read here "
+                        f"afterwards; XLA may have reused its buffer — "
+                        f"rebind the result to the same name or drop the "
+                        f"donation"))
+
+    def _scan_assign(self, stmt: ast.Assign, ctx: Dict[str, Any]) -> None:
+        fn = ctx["fn"]
+        value = stmt.value
+        names = _assigned_names(stmt.targets[0]) if len(stmt.targets) == 1 \
+            else []
+        if len(names) == 1:
+            name = names[0]
+            kind = _ctor_kind(value)
+            if kind:
+                fn["local_unser"][name] = kind
+            if isinstance(value, ast.Call):
+                cls, max_conc = _handle_class(value)
+                if cls:
+                    ctx["handles"][name] = cls
+                    self.summary["actor_options"].append(
+                        {"cls": cls, "max_concurrency": max_conc,
+                         "lineno": value.lineno})
+                pos = _jit_donate_positions(value)
+                if pos:
+                    ctx["donated"][name] = pos
+                if not kind and not cls:
+                    callee = _dotted_str(value.func)
+                    if callee:
+                        fn["call_assigns"][name] = callee
+            lcls = self._handle_list_class(value)
+            if lcls:
+                ctx["lists"][name] = lcls
+            return
+        if len(stmt.targets) == 1 and isinstance(stmt.targets[0],
+                                                 ast.Attribute):
+            tgt = stmt.targets[0]
+            dotted_tgt = _dotted_str(tgt)
+            if isinstance(value, ast.Call):
+                pos = _jit_donate_positions(value)
+                if pos and dotted_tgt:
+                    ctx["donated"][dotted_tgt] = pos
+            # self.<attr> = <handle>: class-level attr handle table
+            if isinstance(tgt.value, ast.Name) and tgt.value.id == "self" \
+                    and fn["cls"]:
+                cls_rec = self.summary["classes"].get(fn["cls"])
+                if cls_rec is not None:
+                    hcls = None
+                    if isinstance(value, ast.Call):
+                        hcls, _ = _handle_class(value)
+                    if hcls is None and isinstance(value, ast.Name):
+                        hcls = ctx["handles"].get(value.id)
+                    if hcls:
+                        cls_rec["attr_handles"][tgt.attr] = hcls
+
+    # -- expression scan ---------------------------------------------------
+
+    def _scan_expr_tree(self, root: ast.AST, stmt: ast.stmt,
+                        ctx: Dict[str, Any]) -> None:
+        loads, stores = ctx["loads"], ctx["stores"]
+        stack: List[ast.AST] = [root]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load):
+                    loads.setdefault(node.id, []).append(node.lineno)
+                else:
+                    stores.setdefault(node.id, []).append(node.lineno)
+            elif isinstance(node, ast.Call):
+                self._scan_call(node, stmt, ctx)
+            for child in ast.iter_child_nodes(node):
+                if not isinstance(child, (ast.stmt, ast.ExceptHandler)):
+                    stack.append(child)
+
+    def _line_suppressions(self, line: int) -> List[str]:
+        out = list(self.summary["suppress_line"].get(str(line), ()))
+        out.extend(self.summary["suppress_file"])
+        return out
+
+    def _scan_call(self, call: ast.Call, stmt: ast.stmt,
+                   ctx: Dict[str, Any]) -> None:
+        fn = ctx["fn"]
+        func = call.func
+        d = _dotted(func)
+
+        get_rec = self._blocking_get(call)
+        if get_rec is not None:
+            fn["gets"].append(get_rec)
+            # an inline submit inside the get is synchronous immediately
+            for sub in ast.walk(call):
+                if isinstance(sub, ast.Call) and sub is not call \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr == "remote":
+                    rec = self._submit_record(sub, stmt, ctx)
+                    if rec is not None:
+                        rec["sync"] = True
+                        rec["sync_line"] = call.lineno
+            return
+
+        if isinstance(func, ast.Attribute) and func.attr == "remote":
+            self._submit_record(call, stmt, ctx)
+            return
+
+        if isinstance(func, ast.Attribute) and func.attr == "bind" \
+                and isinstance(func.value, ast.Attribute):
+            self._bind_site(call, ctx)
+
+        if d is not None and d[-1] == "shard_map":
+            self._shardmap_site(call, d, fn)
+
+        if d is not None and d[-1] in COLLECTIVE_AXIS_ARG \
+                and (len(d) == 1 or "lax" in d):
+            self._collective_site(call, d, fn)
+
+        if d is not None:
+            positions = ctx["donated"].get(".".join(d))
+            if positions:
+                # the call's own argument loads span through end_lineno
+                # on wrapped calls — they are the donation, not a read
+                end = getattr(call, "end_lineno", None) or call.lineno
+                for p in positions:
+                    if p < len(call.args) and isinstance(call.args[p],
+                                                         ast.Name):
+                        ctx["donated_args"].append(
+                            (call.args[p].id, call.lineno, end))
+
+        if d is not None and d[-1] not in ("remote", "bind", "options",
+                                           "get"):
+            fn["calls"].append({
+                "lineno": call.lineno, "col": call.col_offset + 1,
+                "name": ".".join(d),
+                "args": [_prov(a) for a in call.args],
+                "suppress": self._line_suppressions(call.lineno)})
+
+    def _blocking_get(self, call: ast.Call) -> Optional[Dict[str, Any]]:
+        """A blocking-get record, or None. ``maybe`` marks ``ref.get()``
+        forms that only count once matched to a submit in this scope
+        (``d.get(...)`` on dicts must stay silent)."""
+        func = call.func
+        maybe = False
+        args: Sequence[ast.AST] = call.args
+        if isinstance(func, ast.Attribute) and func.attr == "get":
+            recv = func.value
+            dd = _dotted(recv)
+            if dd in (("ray_tpu",), ("ray",)):
+                pass
+            elif isinstance(recv, ast.Call):
+                inner = _dotted(recv.func)
+                if inner is not None and inner[-1] == "get_runtime":
+                    pass
+                elif isinstance(recv.func, ast.Attribute) \
+                        and recv.func.attr == "remote":
+                    args = ()  # f.remote().get(): inline-marked
+                else:
+                    return None
+            elif isinstance(recv, ast.Name) and not call.args:
+                maybe = True
+                args = (recv,)
+            else:
+                return None
+        elif isinstance(func, ast.Name) and func.id in self._bare_get_names:
+            pass
+        else:
+            return None
+        out: List[str] = []
+        for a in list(args)[:1]:
+            if isinstance(a, ast.Name):
+                out.append(a.id)
+            elif isinstance(a, (ast.List, ast.Tuple)):
+                out.extend(e.id for e in a.elts if isinstance(e, ast.Name))
+        return {"lineno": call.lineno, "col": call.col_offset + 1,
+                "vars": out, "maybe": maybe,
+                "suppress": self._line_suppressions(call.lineno)}
+
+    def _submit_record(self, call: ast.Call, stmt: ast.stmt,
+                       ctx: Dict[str, Any]) -> Optional[dict]:
+        if id(call) in self._seen_submits:
+            return None
+        fn = ctx["fn"]
+        base = call.func.value
+        rec: Dict[str, Any] = {
+            "lineno": call.lineno, "col": call.col_offset + 1,
+            "sync": False, "sync_line": None, "assigned": [],
+            "args": [_prov(a) for a in call.args],
+            "kwargs": {kw.arg: _prov(kw.value) for kw in call.keywords
+                       if kw.arg},
+            "suppress": self._line_suppressions(call.lineno),
+        }
+        cls, max_conc = _handle_class(call)
+        if cls is not None:
+            # creation site (Cls.remote / Cls.options(...).remote) OR a
+            # plain remote-function submit spelled mod.f — the project
+            # pass disambiguates by what the name resolves to
+            rec.update({"form": "func", "name": cls,
+                        "options": {"max_concurrency": max_conc}})
+        elif isinstance(base, ast.Name):
+            rec.update({"form": "func", "name": base.id, "options": None})
+        elif isinstance(base, ast.Attribute):
+            hroot = base.value
+            if isinstance(hroot, ast.Name) and hroot.id == "self":
+                # self.m.remote(): a task submitted to our own handle is
+                # not expressible this way in the API; treat the method
+                # name as a same-class target (current_actor() pattern)
+                rec.update({"form": "method", "method": base.attr,
+                            "recv": {"kind": "self", "cls": fn["cls"]}})
+            elif isinstance(hroot, ast.Name):
+                rec.update({"form": "method", "method": base.attr,
+                            "recv": {"kind": "name", "name": hroot.id,
+                                     "cls": ctx["handles"].get(hroot.id)}})
+            elif isinstance(hroot, ast.Attribute) \
+                    and isinstance(hroot.value, ast.Name) \
+                    and hroot.value.id == "self":
+                rec.update({"form": "method", "method": base.attr,
+                            "recv": {"kind": "selfattr", "attr": hroot.attr,
+                                     "cls": None}})
+            elif isinstance(hroot, ast.Subscript) \
+                    and isinstance(hroot.value, ast.Name):
+                rec.update({"form": "method", "method": base.attr,
+                            "recv": {"kind": "name",
+                                     "name": hroot.value.id,
+                                     "cls": ctx["lists"].get(
+                                         hroot.value.id)}})
+            else:
+                rec.update({"form": "method", "method": base.attr,
+                            "recv": {"kind": "other", "cls": None}})
+        else:
+            return None
+        if isinstance(stmt, ast.Assign) and stmt.value is call:
+            for t in stmt.targets:
+                rec["assigned"].extend(_assigned_names(t))
+        self._seen_submits.add(id(call))
+        fn["submits"].append(rec)
+        return rec
+
+    def _bind_site(self, call: ast.Call, ctx: Dict[str, Any]) -> None:
+        fn = ctx["fn"]
+        method_ref = call.func.value          # <recv>.<method>
+        recv = method_ref.value
+        if isinstance(recv, ast.Name) and recv.id == "self":
+            # `self.X.bind(...)`: X is an instance attribute (sockets,
+            # listeners), not an actor-method node — a cgraph self-bind
+            # spells `self.<handle>.<method>.bind(...)` (3 levels)
+            return
+        site: Dict[str, Any] = {
+            "lineno": call.lineno, "method": method_ref.attr,
+            "cls": None, "resolved": False, "cls_ctx": fn["cls"],
+        }
+        if isinstance(recv, ast.Name):
+            cls = ctx["handles"].get(recv.id)
+            if cls:
+                site.update({"cls": cls, "resolved": True})
+        elif isinstance(recv, ast.Attribute) \
+                and isinstance(recv.value, ast.Name) \
+                and recv.value.id == "self" and fn["cls"]:
+            cls_rec = self.summary["classes"].get(fn["cls"])
+            cls = cls_rec["attr_handles"].get(recv.attr) if cls_rec else None
+            if cls:
+                site.update({"cls": cls, "resolved": True})
+        elif isinstance(recv, ast.Subscript) \
+                and isinstance(recv.value, ast.Name):
+            cls = ctx["lists"].get(recv.value.id)
+            if cls:
+                site.update({"cls": cls, "resolved": True})
+        self.summary["bind_sites"].append(site)
+
+    def _shardmap_site(self, call: ast.Call, d: Tuple[str, ...],
+                       fn: Dict[str, Any]) -> None:
+        site: Dict[str, Any] = {
+            "lineno": call.lineno, "callee": ".".join(d),
+            "encl": fn["qname"], "fn": {"kind": "other"},
+            "in_specs_arity": None, "axis_given": False,
+            "axis": None, "mesh": None,
+            "suppress": self._line_suppressions(call.lineno),
+        }
+        pos = list(call.args)
+        kw = {k.arg: k.value for k in call.keywords if k.arg}
+        fn_expr = pos[0] if pos else None
+        if isinstance(fn_expr, (ast.Name, ast.Attribute)):
+            site["fn"] = {"kind": "name", "name": _dotted_str(fn_expr) or ""}
+        elif isinstance(fn_expr, ast.Lambda):
+            a = fn_expr.args
+            site["fn"] = {"kind": "lambda",
+                          "nparams": len(a.posonlyargs) + len(a.args),
+                          "ndefaults": len(a.defaults),
+                          "vararg": a.vararg is not None}
+        elif isinstance(fn_expr, ast.Call):
+            fd = _dotted(fn_expr.func)
+            if fd is not None and fd[-1] == "partial" and fn_expr.args:
+                site["fn"] = {"kind": "partial",
+                              "name": _dotted_str(fn_expr.args[0]) or "",
+                              "npos": len(fn_expr.args) - 1,
+                              "kw": [k.arg for k in fn_expr.keywords
+                                     if k.arg]}
+        mesh_expr = kw.get("mesh") or (pos[1] if len(pos) > 1 else None)
+        site["mesh"] = _dotted_str(mesh_expr) if mesh_expr is not None \
+            else None
+        specs = kw.get("in_specs") if "in_specs" in kw \
+            else (pos[2] if len(pos) > 2 else None)
+        if isinstance(specs, (ast.Tuple, ast.List)):
+            site["in_specs_arity"] = len(specs.elts)
+        ax = kw.get("axis_names")
+        if ax is not None:
+            site["axis_given"] = True
+            site["axis"] = _axis_value(ax)
+        self.summary["shardmap"].append(site)
+
+    def _collective_site(self, call: ast.Call, d: Tuple[str, ...],
+                         fn: Dict[str, Any]) -> None:
+        op = d[-1]
+        idx = COLLECTIVE_AXIS_ARG[op]
+        ax_expr: Optional[ast.AST] = None
+        if idx < len(call.args):
+            ax_expr = call.args[idx]
+        for k in call.keywords:
+            if k.arg in _AXIS_KWARGS:
+                ax_expr = k.value
+        self.summary["collectives"].append({
+            "lineno": call.lineno, "col": call.col_offset + 1,
+            "op": op, "dotted": ".".join(d),
+            "axis": _axis_value(ax_expr) if ax_expr is not None else None,
+            "encl": fn["qname"],
+            "suppress": self._line_suppressions(call.lineno)})
+
+
+def extract(path: str, source: str, tree: ast.Module,
+            module: str) -> Tuple[Dict[str, Any], List[Finding]]:
+    """Parse-once fact extraction: returns (summary, findings from
+    extraction-time local rules — currently GC022)."""
+    ex = _Extractor(path, source, tree, module)
+    return ex.run()

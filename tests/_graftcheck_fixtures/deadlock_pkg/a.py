@@ -1,0 +1,19 @@
+"""Fixture: stage A of a 3-actor synchronous wait cycle (A -> B -> C -> A).
+
+Each hop submits to the next actor and blocks in get(); when the calls
+coincide every actor is parked in get() and none can serve the incoming
+call that would unblock it. GC010 must report the full cycle path with
+one file:line per edge. (Never imported at runtime — lint fixture only.)
+"""
+import ray_tpu
+
+from .b import B
+
+
+@ray_tpu.remote
+class A:
+    def __init__(self, peer: B):
+        self.peer = peer
+
+    def ping(self, x):
+        return ray_tpu.get(self.peer.pong.remote(x + 1))

@@ -1,0 +1,14 @@
+"""Fixture: stage B of the A -> B -> C -> A wait cycle."""
+import ray_tpu
+
+from .c import C
+
+
+@ray_tpu.remote
+class B:
+    def __init__(self, peer: C):
+        self.peer = peer
+
+    def pong(self, x):
+        ref = self.peer.relay.remote(x + 1)
+        return ray_tpu.get(ref)

@@ -1,0 +1,15 @@
+"""Fixture: stage C closes the A -> B -> C -> A wait cycle (ref.get()
+spelling; the other hops use ray_tpu.get)."""
+import ray_tpu
+
+from .a import A
+
+
+@ray_tpu.remote
+class C:
+    def __init__(self, peer: "A"):
+        self.peer = peer
+
+    def relay(self, x):
+        ref = self.peer.ping.remote(x + 1)
+        return ref.get()

@@ -1,0 +1,35 @@
+"""Fixture: two unrelated actor classes sharing a method NAME. Only
+Pipeline.step is ever bound into a compiled graph; Unrelated.step does
+dynamic work and must stay clean now that bind receivers resolve
+through the call graph (the old name-wide fallback flagged it)."""
+import ray_tpu
+
+
+@ray_tpu.remote
+def helper(x):
+    return x
+
+
+@ray_tpu.remote
+class Pipeline:
+    def step(self, x):
+        return x + 1            # bound below: pure compute, clean
+
+
+@ray_tpu.remote
+class Unrelated:
+    def step(self, x):
+        return helper.remote(x)  # same NAME, never bound: clean
+
+
+def build(inp):
+    stage = Pipeline.remote()
+    return stage.step.bind(inp)
+
+
+def build_from_list(inp):
+    stages = [Pipeline.remote() for _ in range(4)]
+    node = inp
+    for s in stages:
+        node = s.step.bind(node)   # list-of-handles loop receiver
+    return node

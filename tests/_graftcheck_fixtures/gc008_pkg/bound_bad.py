@@ -1,0 +1,26 @@
+"""Fixture: a bound method that DOES dynamic work — must be flagged
+(resolved receiver), and a dynamic receiver that falls back to
+name-wide matching."""
+import ray_tpu
+
+from .actors import helper
+
+
+@ray_tpu.remote
+class Dirty:
+    def fwd(self, x):
+        return helper.remote(x)      # GC008: dynamic submit in bound method
+
+
+@ray_tpu.remote
+class Opaque:
+    def run(self, ref):
+        return ray_tpu.get(ref)      # GC008 via fallback (+ GC001 locally)
+
+
+def build(inp, lookup):
+    d = Dirty.remote()
+    node = d.fwd.bind(inp)
+    # receiver comes out of a dict: unresolvable -> name-wide fallback
+    o = lookup["opaque"]
+    return o.run.bind(node)

@@ -1,0 +1,20 @@
+"""Fixture negative: the same self-call shape is legal when the actor
+is created with max_concurrency > 1 — a second thread serves the
+recursive call. GC010 must stay silent for this class."""
+import ray_tpu
+
+
+@ray_tpu.remote
+class Reentrant:
+    def __init__(self, me: "Reentrant"):
+        self.me = me
+
+    def step(self, x):
+        if x > 0:
+            return ray_tpu.get(self.me.step.remote(x - 1))
+        return 0
+
+
+def make():
+    me = Reentrant.options(max_concurrency=4).remote(None)
+    return me

@@ -1,0 +1,19 @@
+"""Fixture: synchronous self-call on a single-concurrency actor.
+
+The actor holds a handle to itself and blocks on its own method: with
+the default max_concurrency=1 the recursive call can never be served —
+the single execution slot is occupied by the caller sitting in get().
+GC010 must flag this 1-cycle.
+"""
+import ray_tpu
+
+
+@ray_tpu.remote
+class Worker:
+    def __init__(self, me: "Worker"):
+        self.me = me
+
+    def step(self, x):
+        if x > 0:
+            return ray_tpu.get(self.me.step.remote(x - 1))
+        return 0

@@ -1,0 +1,16 @@
+"""Fixture: helpers that launder unserializable values across a module
+boundary (GC011 must follow the return through the import)."""
+import threading
+
+
+def make_lock():
+    return threading.Lock()
+
+
+def make_lock_indirect():
+    lk = make_lock()
+    return lk
+
+
+def make_count():
+    return 41 + 1

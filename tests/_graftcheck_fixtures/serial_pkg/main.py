@@ -1,0 +1,24 @@
+"""Fixture: a known-unserializable value flows into .remote() args via
+a helper defined in another module (GC011), and a task returns one.
+The plain-data path (make_count) must stay clean.
+"""
+import ray_tpu
+
+from .helpers import make_count, make_lock, make_lock_indirect
+
+
+@ray_tpu.remote
+def consume(payload):
+    return payload
+
+
+@ray_tpu.remote
+def leak_return():
+    return make_lock()
+
+
+def driver():
+    ok = consume.remote(make_count())
+    bad = consume.remote(make_lock())
+    worse = consume.remote(make_lock_indirect())
+    return ok, bad, worse

@@ -1,0 +1,30 @@
+"""Fixture: SPMD kernel with two seeded bugs against meshdef.MESH
+(axes dp, tp):
+
+- the collective reduces over axis 'pp', which the mesh never binds
+  (GC020, resolved cross-file);
+- in_specs carries a single spec but the wrapped body takes two
+  required arguments (GC021).
+
+The well-formed kernel below them must stay clean.
+"""
+import jax
+from jax.sharding import PartitionSpec as P
+
+from .meshdef import MESH
+
+
+def bad_kernel(params, x):
+    def body(p, v):
+        return jax.lax.psum(v, "pp")
+
+    fn = jax.shard_map(body, mesh=MESH, in_specs=(P(),), out_specs=P())
+    return fn(params, x)
+
+
+def good_kernel(params, x):
+    def body(p, v):
+        return jax.lax.psum(v, "dp")
+
+    fn = jax.shard_map(body, mesh=MESH, in_specs=(P(), P()), out_specs=P())
+    return fn(params, x)

@@ -1,0 +1,9 @@
+"""Fixture: mesh.py-style module — the mesh (and its axis names) live
+here; the kernel that mis-uses them lives in kernel.py. GC020 must
+resolve the axes across the module boundary."""
+import jax
+from jax.sharding import Mesh
+
+MESH_AXES = ("dp", "tp")
+
+MESH = Mesh(jax.devices(), MESH_AXES)

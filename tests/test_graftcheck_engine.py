@@ -1,0 +1,698 @@
+"""Whole-program graftcheck engine tests.
+
+Covers the cross-module fixture packages under
+tests/_graftcheck_fixtures/ (a 3-file deadlock cycle, a
+single-concurrency self-call, helper-laundered unserializable args, a
+mesh/axis mismatch split across meshdef/kernel files, GC008 call-graph
+binding), cache behavior (hit/miss/invalidation on edit), SARIF output
+validation, baseline files, the DOT graph dump, and the one-run
+tree-clean regression for every engine-backed rule family.
+"""
+import json
+import os
+import shutil
+
+import pytest
+
+from ray_tpu.devtools import graftcheck
+from ray_tpu.devtools.graftcheck import check_source
+from ray_tpu.devtools.graftcheck.engine import check_project, to_dot
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "_graftcheck_fixtures")
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def run_pkg(pkg, rules=None):
+    res = check_project([os.path.join(FIXTURES, pkg)], rules=rules,
+                        cache_path=None, root=FIXTURES)
+    return res
+
+
+def rules_of(res):
+    return sorted({f.rule for f in res.findings})
+
+
+# ---------------------------------------------------------------------------
+# GC010 — deadlock cycles
+
+
+class TestGC010:
+    def test_three_file_cycle_detected_with_full_path(self):
+        res = run_pkg("deadlock_pkg", rules={"GC010"})
+        assert rules_of(res) == ["GC010"]
+        assert len(res.findings) == 1
+        msg = res.findings[0].message
+        # every hop appears with its file:line
+        assert "deadlock_pkg.a.A.ping" in msg
+        assert "deadlock_pkg.b.B.pong" in msg
+        assert "deadlock_pkg.c.C.relay" in msg
+        for f, line in (("a.py", 19), ("b.py", 14), ("c.py", 15)):
+            assert f"{f}:{line}" in msg, (f, line, msg)
+
+    def test_single_concurrency_self_call_flagged(self):
+        res = run_pkg("selfcall_pkg", rules={"GC010"})
+        assert rules_of(res) == ["GC010"]
+        assert len(res.findings) == 1
+        f = res.findings[0]
+        assert f.path.endswith("worker.py")
+        assert "Worker.step" in f.message
+
+    def test_max_concurrency_escape_stays_clean(self):
+        res = run_pkg("selfcall_pkg", rules={"GC010"})
+        # concurrent_ok.py has the identical shape + max_concurrency=4
+        assert not any(f.path.endswith("concurrent_ok.py")
+                       for f in res.findings)
+
+    def test_single_module_cycle_via_check_source(self):
+        src = """
+import ray_tpu
+
+@ray_tpu.remote
+class A:
+    def __init__(self, peer: "B"):
+        self.peer = peer
+    def ping(self, x):
+        return ray_tpu.get(self.peer.pong.remote(x))
+
+@ray_tpu.remote
+class B:
+    def __init__(self, peer: "A"):
+        self.peer = peer
+    def pong(self, x):
+        return ray_tpu.get(self.peer.ping.remote(x))
+"""
+        found = {f.rule for f in check_source(src, "cyc.py",
+                                              rules={"GC010"})}
+        assert found == {"GC010"}
+
+    def test_cycle_through_helper_waited_submit(self):
+        # the wait can hide one level down: fetch(h.m.remote(x)) where
+        # fetch() blocks in get() is still a synchronous edge
+        src = """
+import ray_tpu
+
+def fetch(ref):
+    return ray_tpu.get(ref)
+
+@ray_tpu.remote
+class A:
+    def __init__(self, peer: "B"):
+        self.peer = peer
+    def ping(self, x):
+        return fetch(self.peer.pong.remote(x))
+
+@ray_tpu.remote
+class B:
+    def __init__(self, peer: "A"):
+        self.peer = peer
+    def pong(self, x):
+        return fetch(self.peer.ping.remote(x))
+"""
+        found = {f.rule for f in check_source(src, "h.py",
+                                              rules={"GC010"})}
+        assert found == {"GC010"}
+
+    def test_async_submit_without_get_is_not_a_cycle(self):
+        src = """
+import ray_tpu
+
+@ray_tpu.remote
+class A:
+    def __init__(self, peer: "B"):
+        self.peer = peer
+    def ping(self, x):
+        return self.peer.pong.remote(x)   # ref passed, never waited
+
+@ray_tpu.remote
+class B:
+    def __init__(self, peer: "A"):
+        self.peer = peer
+    def pong(self, x):
+        return self.peer.ping.remote(x)
+"""
+        assert check_source(src, "ok.py", rules={"GC010"}) == []
+
+    def test_suppression_on_any_edge_silences_cycle(self):
+        src = """
+import ray_tpu
+
+@ray_tpu.remote
+class A:
+    def __init__(self, peer: "B"):
+        self.peer = peer
+    def ping(self, x):
+        # graftcheck: disable=GC010 bounded two-hop handshake by design
+        return ray_tpu.get(self.peer.pong.remote(x))
+
+@ray_tpu.remote
+class B:
+    def __init__(self, peer: "A"):
+        self.peer = peer
+    def pong(self, x):
+        return ray_tpu.get(self.peer.ping.remote(x))
+"""
+        assert check_source(src, "sup.py", rules={"GC010"}) == []
+
+
+# ---------------------------------------------------------------------------
+# GC011 — serialization flow
+
+
+class TestGC011:
+    def test_helper_laundered_arg_cross_module(self):
+        res = run_pkg("serial_pkg", rules={"GC011"})
+        assert rules_of(res) == ["GC011"]
+        by_line = {f.line: f for f in res.findings}
+        # direct helper arg, indirect (two-hop) helper arg, task return
+        assert 22 in by_line and "make_lock()" in by_line[22].message
+        assert 23 in by_line \
+            and "make_lock_indirect()" in by_line[23].message
+        assert any("leak_return" in f.message for f in res.findings)
+        # the plain-data path stays clean
+        assert 21 not in by_line
+
+    def test_local_ctor_arg_and_suppression(self):
+        src = """
+import threading
+import ray_tpu
+
+@ray_tpu.remote
+def task(x):
+    return x
+
+def bad():
+    return task.remote(threading.Lock())
+
+def reviewed():
+    return task.remote(threading.Lock())  # graftcheck: disable=GC011 negative-path test input
+"""
+        fs = check_source(src, "f.py", rules={"GC011"})
+        assert [f.line for f in fs] == [10]
+
+    def test_plain_values_stay_clean(self):
+        src = """
+import ray_tpu
+
+def make_payload():
+    return {"a": 1}
+
+@ray_tpu.remote
+def task(x):
+    return x
+
+def driver():
+    return task.remote(make_payload())
+"""
+        assert check_source(src, "ok.py", rules={"GC011"}) == []
+
+
+# ---------------------------------------------------------------------------
+# interprocedural GC001 / GC003
+
+
+class TestInterprocedural:
+    def test_helper_get_one_level(self):
+        src = """
+import ray_tpu
+
+def fetch(ref):
+    return ray_tpu.get(ref)
+
+@ray_tpu.remote
+def outer(ref):
+    return fetch(ref)
+"""
+        fs = check_source(src, "ip.py", rules={"GC001"})
+        assert len(fs) == 1 and fs[0].line == 9
+        assert "fetch()" in fs[0].message
+
+    def test_suppressed_helper_get_stays_quiet(self):
+        src = """
+import ray_tpu
+
+def fetch(ref):
+    return ray_tpu.get(ref)  # graftcheck: disable=GC001 bounded depth
+
+@ray_tpu.remote
+def outer(ref):
+    return fetch(ref)
+"""
+        assert check_source(src, "ip.py", rules={"GC001"}) == []
+
+    def test_helper_global_write(self):
+        src = """
+import ray_tpu
+
+COUNT = 0
+
+def bump():
+    global COUNT
+    COUNT += 1
+
+@ray_tpu.remote
+def task():
+    bump()
+"""
+        fs = check_source(src, "g.py", rules={"GC003"})
+        assert len(fs) == 1 and fs[0].line == 12
+        assert "COUNT" in fs[0].message
+
+    def test_helper_called_from_driver_is_fine(self):
+        src = """
+import ray_tpu
+
+def fetch(ref):
+    return ray_tpu.get(ref)
+
+def driver(ref):
+    return fetch(ref)
+"""
+        assert check_source(src, "d.py", rules={"GC001", "GC003"}) == []
+
+
+# ---------------------------------------------------------------------------
+# GC020 / GC021 — SPMD rules
+
+
+class TestSPMD:
+    def test_cross_file_mesh_axis_mismatch(self):
+        res = run_pkg("spmd_pkg", rules={"GC020", "GC021"})
+        assert rules_of(res) == ["GC020", "GC021"]
+        gc020 = [f for f in res.findings if f.rule == "GC020"]
+        assert len(gc020) == 1
+        assert "'pp'" in gc020[0].message
+        assert "dp" in gc020[0].message and "tp" in gc020[0].message
+        gc021 = [f for f in res.findings if f.rule == "GC021"]
+        assert len(gc021) == 1
+        assert "1 entry" in gc021[0].message
+        # good_kernel (same file) stays clean
+        assert all(f.line < 24 for f in res.findings), res.findings
+
+    def test_symbolic_axis_names_match(self):
+        # pipeline.py-style: axis_names=frozenset({pp_axis}) with the
+        # collectives using the same symbol — must stay clean
+        src = """
+import jax
+from ray_tpu.jax_compat import shard_map
+
+def pipeline(mesh, x, pp_axis="pp"):
+    def body(v):
+        return jax.lax.psum(v, pp_axis)
+    fn = shard_map(body, mesh=mesh, in_specs=(jax.P(),),
+                   out_specs=jax.P(), axis_names=frozenset({pp_axis}))
+    return fn(x)
+"""
+        assert check_source(src, "p.py", rules={"GC020", "GC021"}) == []
+
+    def test_unknown_mesh_stays_silent(self):
+        src = """
+import jax
+
+def kern(mesh, x):
+    def body(v):
+        return jax.lax.psum(v, "anything")
+    return jax.shard_map(body, mesh=mesh, in_specs=(jax.P(),),
+                         out_specs=jax.P())(x)
+"""
+        assert check_source(src, "u.py", rules={"GC020"}) == []
+
+    def test_pallas_blockspecs_never_match(self):
+        # pallas_call also takes in_specs=[...]; only real shard_map
+        # callees are checked
+        src = """
+import jax
+from jax.experimental import pallas as pl
+
+def kern(x):
+    return pl.pallas_call(lambda r, o: None,
+                          in_specs=[pl.BlockSpec((8,), lambda i: i)],
+                          out_specs=pl.BlockSpec((8,), lambda i: i))(x)
+"""
+        assert check_source(src, "pl.py", rules={"GC020", "GC021"}) == []
+
+    def test_lambda_arity_mismatch(self):
+        src = """
+import jax
+
+def kern(mesh, q, k):
+    fn = jax.shard_map(lambda q, k, v: q, mesh=mesh,
+                       in_specs=(jax.P(), jax.P()), out_specs=jax.P())
+    return fn(q, k)
+"""
+        fs = check_source(src, "l.py", rules={"GC021"})
+        assert len(fs) == 1 and "2 entries" in fs[0].message
+
+    def test_partial_bound_kwargs_counted(self):
+        src = """
+import functools
+import jax
+from ray_tpu.jax_compat import shard_map
+
+def attention(q, k, v, axis_name="sp", causal=True):
+    return q
+
+def wrapper(mesh, q, k, v):
+    fn = shard_map(
+        functools.partial(attention, axis_name="sp", causal=False),
+        mesh=mesh, in_specs=(jax.P(), jax.P(), jax.P()),
+        out_specs=jax.P())
+    return fn(q, k, v)
+"""
+        assert check_source(src, "pt.py", rules={"GC021"}) == []
+
+
+# ---------------------------------------------------------------------------
+# GC022 — donated buffers
+
+
+class TestGC022:
+    def test_read_after_donation(self):
+        src = """
+import functools
+import jax
+
+def step(params, batch):
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def update(p, b):
+        return p
+    new_params = update(params, batch)
+    return params
+"""
+        fs = check_source(src, "d.py", rules={"GC022"})
+        assert len(fs) == 1 and fs[0].line == 10
+        assert "'params'" in fs[0].message
+
+    def test_rebinding_is_clean(self):
+        src = """
+import jax
+
+def step(params, opt, batch):
+    update = jax.jit(lambda p, o, b: (p, o), donate_argnums=(0, 1))
+    params, opt = update(params, opt, batch)
+    return params, opt
+"""
+        assert check_source(src, "ok.py", rules={"GC022"}) == []
+
+    def test_non_donated_position_is_clean(self):
+        src = """
+import jax
+
+def step(params, batch):
+    update = jax.jit(lambda p, b: p, donate_argnums=(0,))
+    new = update(params, batch)
+    return batch
+"""
+        assert check_source(src, "ok2.py", rules={"GC022"}) == []
+
+
+# ---------------------------------------------------------------------------
+# GC008 — call-graph-resolved binding
+
+
+class TestGC008Resolution:
+    def test_same_named_method_on_unrelated_class_is_clean(self):
+        res = run_pkg("gc008_pkg", rules={"GC008"})
+        files_lines = {(os.path.basename(f.path), f.line)
+                       for f in res.findings}
+        # Dirty.fwd (resolved receiver) and Opaque.run (fallback) flagged
+        assert ("bound_bad.py", 12) in files_lines
+        assert ("bound_bad.py", 18) in files_lines
+        # Unrelated.step shares Pipeline.step's NAME but resolves to a
+        # different class: no fallback needed, stays clean
+        assert not any(os.path.basename(f.path) == "actors.py"
+                       for f in res.findings), res.findings
+
+    def test_list_of_handles_loop_receiver_resolves(self):
+        # build_from_list binds Pipeline.step via a loop variable over a
+        # list of handles; Unrelated.step must still stay clean (above),
+        # proving the receiver resolved rather than name-matched
+        res = run_pkg("gc008_pkg", rules={"GC008"})
+        assert all(os.path.basename(f.path) == "bound_bad.py"
+                   for f in res.findings)
+
+
+# ---------------------------------------------------------------------------
+# cache
+
+
+class TestCache:
+    def _write_proj(self, tmp_path):
+        (tmp_path / "mod.py").write_text(
+            "import ray_tpu\n"
+            "@ray_tpu.remote\n"
+            "def f(r):\n"
+            "    return ray_tpu.get(r)\n")
+        (tmp_path / "clean.py").write_text("x = 1\n")
+
+    def test_hit_miss_and_invalidation_on_edit(self, tmp_path):
+        self._write_proj(tmp_path)
+        cache = str(tmp_path / "cache.json")
+        res1 = check_project([str(tmp_path)], cache_path=cache)
+        assert res1.parsed == 2 and res1.cached == 0
+        assert [f.rule for f in res1.findings] == ["GC001"]
+
+        res2 = check_project([str(tmp_path)], cache_path=cache)
+        assert res2.parsed == 0 and res2.cached == 2
+        assert [f.rule for f in res2.findings] == ["GC001"]
+
+        # fixing the file invalidates exactly its entry
+        (tmp_path / "mod.py").write_text(
+            "import ray_tpu\n"
+            "@ray_tpu.remote\n"
+            "def f(r):\n"
+            "    return r\n")
+        res3 = check_project([str(tmp_path)], cache_path=cache)
+        assert res3.parsed == 1 and res3.cached == 1
+        assert res3.findings == []
+
+    def test_cached_findings_identical_to_cold(self, tmp_path):
+        self._write_proj(tmp_path)
+        cache = str(tmp_path / "cache.json")
+        cold = check_project([str(tmp_path)], cache_path=cache)
+        warm = check_project([str(tmp_path)], cache_path=cache)
+        assert [f.as_dict() for f in cold.findings] \
+            == [f.as_dict() for f in warm.findings]
+
+    def test_package_dir_invocation_keeps_absolute_imports(self, tmp_path):
+        # `graftcheck pkg/` must anchor module names at the PACKAGE
+        # root, or `from pkg.b import B` resolves to nothing and every
+        # cross-file rule silently dies
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "a.py").write_text(
+            "import ray_tpu\n"
+            "from pkg.b import B\n"
+            "@ray_tpu.remote\n"
+            "class A:\n"
+            "    def __init__(self, peer: B):\n"
+            "        self.peer = peer\n"
+            "    def ping(self, x):\n"
+            "        return ray_tpu.get(self.peer.pong.remote(x))\n")
+        (pkg / "b.py").write_text(
+            "import ray_tpu\n"
+            "@ray_tpu.remote\n"
+            "class B:\n"
+            "    def __init__(self, peer: 'pkg.a.A'):\n"
+            "        self.peer = peer\n"
+            "    def pong(self, x):\n"
+            "        return ray_tpu.get(self.peer.ping.remote(x))\n")
+        res = check_project([str(pkg)], rules={"GC010"}, cache_path=None)
+        assert [f.rule for f in res.findings] == ["GC010"]
+
+    def test_corrupt_cache_is_ignored(self, tmp_path):
+        self._write_proj(tmp_path)
+        cache = tmp_path / "cache.json"
+        cache.write_text("{not json")
+        res = check_project([str(tmp_path)], cache_path=str(cache))
+        assert res.parsed == 2
+        assert [f.rule for f in res.findings] == ["GC001"]
+
+
+# ---------------------------------------------------------------------------
+# SARIF
+
+
+class TestSarif:
+    def test_sarif_document_structure(self, tmp_path):
+        self_dir = os.path.join(FIXTURES, "serial_pkg")
+        out = tmp_path / "out.sarif"
+        rc = graftcheck.main(["--no-cache", "--sarif", str(out),
+                              "--rules", "GC011", self_dir])
+        assert rc == 1   # findings exist
+        doc = json.loads(out.read_text())
+        # SARIF 2.1.0 structural requirements (what GitHub ingests)
+        assert doc["version"] == "2.1.0"
+        assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
+        (run,) = doc["runs"]
+        driver = run["tool"]["driver"]
+        assert driver["name"] == "graftcheck"
+        rule_ids = [r["id"] for r in driver["rules"]]
+        assert rule_ids == sorted(rule_ids)
+        assert "GC011" in rule_ids
+        for r in driver["rules"]:
+            assert r["shortDescription"]["text"]
+        assert run["results"], "expected GC011 results"
+        for result in run["results"]:
+            assert result["ruleId"] in rule_ids
+            assert result["level"] == "warning"
+            assert result["message"]["text"].startswith(result["ruleId"])
+            (loc,) = result["locations"]
+            phys = loc["physicalLocation"]
+            uri = phys["artifactLocation"]["uri"]
+            assert not uri.startswith("/") and "\\" not in uri
+            region = phys["region"]
+            assert region["startLine"] >= 1
+            assert region["startColumn"] >= 1
+            assert result["partialFingerprints"]["graftcheck/v1"]
+
+    def test_jsonschema_validation_when_available(self, tmp_path):
+        jsonschema = pytest.importorskip("jsonschema")
+        from ray_tpu.devtools.graftcheck.sarif import to_sarif
+        from ray_tpu.devtools.graftcheck.local import Finding
+
+        doc = to_sarif([Finding("a.py", 3, 1, "GC001", "m")])
+        # minimal inline schema for the parts code-scanning requires
+        schema = {
+            "type": "object",
+            "required": ["version", "runs"],
+            "properties": {
+                "version": {"const": "2.1.0"},
+                "runs": {"type": "array", "minItems": 1, "items": {
+                    "type": "object",
+                    "required": ["tool", "results"],
+                    "properties": {"tool": {
+                        "type": "object", "required": ["driver"]}},
+                }},
+            },
+        }
+        jsonschema.validate(doc, schema)
+
+
+# ---------------------------------------------------------------------------
+# baseline
+
+
+class TestBaseline:
+    def test_write_then_filter(self, tmp_path):
+        proj = tmp_path / "proj"
+        proj.mkdir()
+        bad = proj / "bad.py"
+        bad.write_text(
+            "import ray_tpu\n"
+            "@ray_tpu.remote\n"
+            "def f(r):\n"
+            "    return ray_tpu.get(r)\n")
+        base = str(tmp_path / "base.json")
+        rc = graftcheck.main(["--no-cache", "--write-baseline", base,
+                              str(proj)])
+        assert rc == 0
+        # baselined: clean exit
+        assert graftcheck.main(["--no-cache", "--baseline", base,
+                                str(proj)]) == 0
+        # a new finding in another file still fails
+        (proj / "new.py").write_text(
+            "import ray_tpu\n"
+            "@ray_tpu.remote\n"
+            "def g(r):\n"
+            "    return ray_tpu.get(r)\n")
+        assert graftcheck.main(["--no-cache", "--baseline", base,
+                                str(proj)]) == 1
+
+    def test_editing_flagged_line_resurrects(self, tmp_path):
+        proj = tmp_path / "proj"
+        proj.mkdir()
+        bad = proj / "bad.py"
+        bad.write_text(
+            "import ray_tpu\n"
+            "@ray_tpu.remote\n"
+            "def f(r):\n"
+            "    return ray_tpu.get(r)\n")
+        base = str(tmp_path / "base.json")
+        assert graftcheck.main(["--no-cache", "--write-baseline", base,
+                                str(proj)]) == 0
+        # unrelated edits above the finding do NOT resurrect it
+        bad.write_text(
+            "import ray_tpu\n"
+            "# a comment\n"
+            "@ray_tpu.remote\n"
+            "def f(r):\n"
+            "    return ray_tpu.get(r)\n")
+        assert graftcheck.main(["--no-cache", "--baseline", base,
+                                str(proj)]) == 0
+        # editing the flagged line itself does
+        bad.write_text(
+            "import ray_tpu\n"
+            "@ray_tpu.remote\n"
+            "def f(r):\n"
+            "    return ray_tpu.get(r) + 1\n")
+        assert graftcheck.main(["--no-cache", "--baseline", base,
+                                str(proj)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# graph subcommand / DOT
+
+
+class TestGraph:
+    def test_dot_contains_cycle_edges(self):
+        res = run_pkg("deadlock_pkg")
+        dot = to_dot(res.graph)
+        assert dot.startswith("digraph remote_calls")
+        assert '"deadlock_pkg.a.A.ping"' in dot
+        assert "sync get" in dot
+        # the three cycle edges are present
+        assert dot.count("sync get") >= 3
+
+    def test_graph_cli(self, tmp_path, capsys):
+        out = tmp_path / "g.dot"
+        rc = graftcheck.main(["graph", "--no-cache", "--out", str(out),
+                              os.path.join(FIXTURES, "deadlock_pkg")])
+        assert rc == 0
+        text = out.read_text()
+        assert "digraph remote_calls" in text
+        assert "A.ping" in text
+
+    def test_bind_edges_in_graph(self):
+        res = run_pkg("gc008_pkg")
+        dot = to_dot(res.graph)
+        assert 'label="bind"' in dot
+
+
+# ---------------------------------------------------------------------------
+# tree-clean regressions: one per engine-backed rule family (mirrors the
+# GC007 pattern), sharing a single engine run to keep tier-1 time flat
+
+
+@pytest.fixture(scope="module")
+def tree_result():
+    res = check_project(
+        [os.path.join(REPO, "ray_tpu"), os.path.join(REPO, "examples"),
+         os.path.join(REPO, "tests")],
+        rules={"GC008", "GC010", "GC011", "GC020", "GC021", "GC022"},
+        cache_path=None)
+    assert res.errors == 0
+    return res
+
+
+def _tree_findings(res, rules):
+    return [f.render() for f in res.findings if f.rule in rules]
+
+
+def test_library_tree_is_gc010_gc011_clean(tree_result):
+    """The sweep satellite stays swept: no un-annotated deadlock cycles
+    or serialization-flow findings (incl. the interprocedural layer)
+    anywhere in ray_tpu/, examples/ or tests/."""
+    assert _tree_findings(tree_result, {"GC010", "GC011"}) == []
+
+
+def test_library_tree_is_spmd_clean(tree_result):
+    """No un-annotated GC020/GC021/GC022 SPMD findings on the tree
+    (parallel/, ops/, rllib donation patterns, test kernels)."""
+    assert _tree_findings(tree_result, {"GC020", "GC021", "GC022"}) == []
+
+
+def test_library_tree_is_gc008_clean_under_call_graph(tree_result):
+    """Call-graph-resolved GC008 finds no un-annotated dynamic work in
+    compiled-graph-bound methods tree-wide."""
+    assert _tree_findings(tree_result, {"GC008"}) == []

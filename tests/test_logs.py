@@ -289,6 +289,7 @@ def test_structured_logger_level_and_errors_filter(cluster):
     def speak():
         from ray_tpu.util.logs import get_logger
 
+        # graftcheck: disable=GC003 per-worker lazy handler-install, not driver state
         log = get_logger("ray_tpu.t")
         log.info("structured-info-%d", 1)
         log.warning("structured-warn-%d", 2)
