@@ -142,6 +142,7 @@ def main() -> None:
             "ppo_env_steps_per_sec": rl_steps_per_sec,
             **_bench_ppo_atari(),
             **_bench_cgraph_chain(),
+            **_bench_dispatch(),
         },
     }))
 
@@ -212,6 +213,28 @@ def _bench_cgraph_chain() -> dict:
         import traceback
 
         traceback.print_exc()  # broken actor plane must not look like 0
+        return {}
+
+
+def _bench_dispatch() -> dict:
+    """Direct-dispatch rows (ISSUE 6): direct actor-call round trip /
+    pipelined rate and the multi-driver aggregate tasks/s envelope —
+    tracked per round in the BENCH json detail."""
+    try:
+        import ray_tpu
+        from bench_core import direct_actor_call_us, multi_driver_tasks_per_s
+
+        ray_tpu.init(num_cpus=max(4, os.cpu_count() or 4))
+        try:
+            out = direct_actor_call_us(50 if SMOKE else 300)
+            out.update(multi_driver_tasks_per_s())
+            return out
+        finally:
+            ray_tpu.shutdown()
+    except Exception:
+        import traceback
+
+        traceback.print_exc()  # a broken actor plane must not look like 0
         return {}
 
 

@@ -78,6 +78,93 @@ def chain_roundtrip_us(n_iters: int = 200) -> dict:
     }
 
 
+def multi_driver_tasks_per_s(n_drivers: int = 0,
+                             calls_per_driver: int = 0) -> dict:
+    """M DRIVER PROCESSES x pipelined actor calls (ISSUE 6): each driver
+    is a worker-process task pipelining direct worker-to-worker calls to
+    its own nop actor, so the measured bottleneck is the framework (and
+    the box), not one submitting process. Returns the aggregate rate plus
+    the direct/routed split observed by the cluster."""
+    import ray_tpu
+    from ray_tpu.util import metrics as metrics_mod
+
+    cores = os.cpu_count() or 2
+    if not n_drivers:
+        n_drivers = 2 if SMOKE else max(2, min(8, cores * 2))
+    if not calls_per_driver:
+        calls_per_driver = 50 if SMOKE else 500
+
+    @ray_tpu.remote(num_cpus=0.01)
+    class Nop:
+        def ping(self):
+            return None
+
+    @ray_tpu.remote(num_cpus=0.01)
+    def driver(handle, k):
+        import time as _t
+
+        t0 = _t.perf_counter()
+        ray_tpu.get([handle.ping.remote() for _ in range(k)], timeout=600)
+        return _t.perf_counter() - t0
+
+    actors = [Nop.remote() for _ in range(n_drivers)]
+    ray_tpu.get([a.ping.remote() for a in actors], timeout=120)
+    # pre-warm one driver worker per lane so the measured window isn't
+    # worker cold-start
+    ray_tpu.get([driver.remote(a, 2) for a in actors], timeout=120)
+    t0 = time.perf_counter()
+    outs = ray_tpu.get(
+        [driver.remote(a, calls_per_driver) for a in actors], timeout=900)
+    wall = time.perf_counter() - t0
+    total = n_drivers * calls_per_driver
+    for a in actors:
+        ray_tpu.kill(a)  # release the leases for later bench phases
+    return {
+        "multi_driver_tasks_per_s": round(total / wall, 1),
+        "multi_drivers": n_drivers,
+        "multi_driver_wall_s": round(wall, 2),
+        "multi_driver_slowest_s": round(max(outs), 2),
+    }
+
+
+def direct_actor_call_us(n: int = 300) -> dict:
+    """Synchronous direct actor-call round trip (submit -> execute ->
+    direct_result -> get) plus the pipelined direct rate, with the
+    direct/routed counter split for the run."""
+    import ray_tpu
+    from ray_tpu.core.runtime import dispatch_counts
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    ray_tpu.get(c.inc.remote(), timeout=60)
+    d0, r0 = dispatch_counts()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(c.inc.remote(), timeout=60)
+    rt_us = (time.perf_counter() - t0) / n * 1e6
+    k = n * 4
+    t0 = time.perf_counter()
+    out = ray_tpu.get([c.inc.remote() for _ in range(k)], timeout=600)
+    pipelined = k / (time.perf_counter() - t0)
+    d1, r1 = dispatch_counts()
+    assert out[-1] == 1 + n + k
+    ray_tpu.kill(c)  # release the lease for later bench phases
+    return {
+        "direct_actor_call_us": round(rt_us, 1),
+        "direct_actor_calls_per_s": round(pipelined, 1),
+        "direct_calls": int(d1 - d0),
+        "routed_calls": int(r1 - r0),
+    }
+
+
 def main() -> int:
     import ray_tpu
 
@@ -171,6 +258,24 @@ def main() -> int:
     assert vals == list(range(64))
     rec = {"metric": "returns_per_task", "value": 64,
            "unit": f"returns in {round(time.perf_counter() - t0, 2)}s"}
+    print(json.dumps(rec), flush=True)
+    results.append(rec)
+
+    # -- direct dispatch (ISSUE 6): round trip + multi-driver envelope ------
+    direct = direct_actor_call_us(50 if SMOKE else 300)
+    for name in ("direct_actor_call_us", "direct_actor_calls_per_s"):
+        rec = {"metric": name, "value": direct[name],
+               "unit": "us" if name.endswith("_us") else "calls/s"}
+        print(json.dumps(rec), flush=True)
+        results.append(rec)
+    print(json.dumps({"metric": "dispatch_split",
+                      "value": {"direct": direct["direct_calls"],
+                                "routed": direct["routed_calls"]}}),
+          flush=True)
+    md = multi_driver_tasks_per_s()
+    rec = {"metric": "multi_driver_tasks_per_s",
+           "value": md["multi_driver_tasks_per_s"],
+           "unit": f"tasks/s aggregate over {md['multi_drivers']} drivers"}
     print(json.dumps(rec), flush=True)
     results.append(rec)
 

@@ -49,6 +49,14 @@ class ClientRuntime(WorkerRuntime):
         hello = channel.call("register_client", {}, timeout=30)
         super().__init__(_ClientChannelShim(
             channel, WorkerId.from_hex(hello["client_id"])))
+        # Remote drivers never take the direct dispatch path: the client
+        # object plane is head-resident (client_get_objects below), so a
+        # direct result landing in this process would be invisible to the
+        # client's own get(); a cross-host client couldn't reach a
+        # worker's direct unix socket anyway. Every client call routes
+        # through the head, which submits it direct on the client's
+        # behalf when eligible.
+        self._direct = None
         self._hello = hello
 
     # -- object plane: bytes over the wire --------------------------------

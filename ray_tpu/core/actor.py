@@ -121,6 +121,20 @@ class ActorHandle:
             concurrency_group=concurrency_group,
             trace_ctx=_trace_ctx(),
         )
+        # wire template: the constant fields of this (actor, method,
+        # options) encode once; each call walks only task_id/args/kwargs/
+        # seq_no/owner_id/trace_ctx — the actor-call analog of
+        # RemoteFunction's template (the submit hot path)
+        cache = self.__dict__.setdefault("_tmpl_cache", {})
+        key = (method_name, num_returns, concurrency_group)
+        tmpl = cache.get(key)
+        if tmpl is None:
+            from . import wire
+
+            tmpl = cache[key] = wire.make_struct_template(
+                spec, ("task_id", "args", "kwargs", "seq_no", "owner_id",
+                       "trace_ctx"))
+        spec._wire_tmpl = tmpl
         refs = rt.submit_spec(spec)
         if num_returns == STREAMING_RETURNS:
             from .object_ref import ObjectRefGenerator

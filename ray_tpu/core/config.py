@@ -113,6 +113,25 @@ _define("worker_task_prefetch", 16,
 _define("agent_server_threads", 32,
         "handler threads for the head's agent-facing TCP server (blocking "
         "fetches must not starve worker_call relays)")
+# --- decentralized dispatch (docs/DISPATCH.md) ---
+_define("direct_actor_calls", 1,
+        "steady-state actor calls bypass the head: the caller resolves "
+        "placement once, then submits straight to the owning worker over "
+        "a cached peer connection (0 = route everything through the head)")
+_define("direct_worker_server", 1,
+        "each worker listens on a direct-call socket so peers (other "
+        "workers, the driver) can submit actor tasks without a head hop")
+_define("direct_event_batch", 200,
+        "direct-path task completions are batched into one "
+        "task_events_batch message at this size (or the flush interval)")
+_define("direct_event_flush_s", 0.5,
+        "flush cadence for the batched direct-path task-event stream")
+_define("head_event_shards", 8,
+        "GCS task-event intake shards (per-shard ring + phase table + "
+        "lock, keyed by task id) so event floods don't serialize on one "
+        "lock; merged on read")
+_define("refcount_shards", 16,
+        "reference-counter shards keyed by object id")
 _define("pg_placer_tick_s", 0.5,
         "parked placement groups re-check capacity at this cadence when "
         "no cluster event fires")

@@ -135,6 +135,20 @@ class Pubsub:
                 pass
 
 
+class _EventShard:
+    """One shard of the task-event intake: ring slice + monotonic counts
+    + phase-mark table, with its own lock."""
+
+    __slots__ = ("lock", "events", "counts", "phase_marks", "marks_max")
+
+    def __init__(self, index: int, maxlen: int, marks_max: int):
+        self.lock = instrumented_lock(f"gcs.events.s{index}")
+        self.events: deque = deque(maxlen=maxlen)
+        self.counts: Dict[str, int] = {}
+        self.phase_marks: Dict[str, tuple] = {}
+        self.marks_max = max(64, marks_max)
+
+
 class Gcs:
     def __init__(self, storage_path: str = "", config=None):
         self._lock = instrumented_lock("gcs.tables", reentrant=True)
@@ -152,23 +166,29 @@ class Gcs:
         if config is None:
             from .config import DEFAULT as config
 
-        self._task_events: deque = deque(
-            maxlen=int(config.task_events_max_buffered))
+        # event intake is SHARDED by task id (docs/DISPATCH.md): each
+        # shard owns a ring slice + phase-mark table + lock, so a flood of
+        # completion events from many clients doesn't serialize on one
+        # lock; task_events() merges by timestamp on (rare) reads. One
+        # task's events always land in one shard, keeping its
+        # state-transition chain ordered.
+        n_shards = max(1, int(getattr(config, "head_event_shards", 8)))
+        per_shard = max(64, int(config.task_events_max_buffered) // n_shards)
+        self._event_shards = [
+            _EventShard(i, per_shard, _PHASE_MARKS_MAX // n_shards)
+            for i in range(n_shards)]
         # attributed worker log records (stdout/stderr/structured),
         # byte-budgeted with long-poll follow — the `ray logs` analog
         # (ref: dashboard/modules/log/log_manager.py; gcs as the index)
         from .log_store import LogStore
 
         self.logs = LogStore(max_bytes=int(config.log_store_max_bytes))
-        # task_id -> (last_state, last_time, name): feeds phase histograms
-        self._phase_marks: Dict[str, tuple] = {}
         self._storage_path = storage_path
         # set by the Runtime: asks the scheduler to (re)create an actor
         self.schedule_actor_cb: Optional[Callable[[ActorInfo], None]] = None
         self._dirty = threading.Event()
         self._stop_flusher = threading.Event()
         self._flush_file_lock = instrumented_lock("gcs.flush_file")
-        self._event_counts: Dict[str, int] = {}  # monotonic, for /metrics
         if storage_path:
             os.makedirs(storage_path, exist_ok=True)
             self._load()
@@ -385,30 +405,36 @@ class Gcs:
 
     # ---- task events (timeline / state API backing store) --------------------
 
+    def _event_shard(self, event: dict) -> _EventShard:
+        tid = event.get("task_id") or event.get("trace_id") or ""
+        return self._event_shards[hash(tid) % len(self._event_shards)]
+
     def add_task_event(self, event: dict) -> None:
-        observe = None  # (histogram, seconds, name) — fired outside _lock
-        with self._lock:
-            self._task_events.append(event)
+        shard = self._event_shard(event)
+        observe = None  # (histogram, seconds, name) — fired outside locks
+        with shard.lock:
+            shard.events.append(event)
             st = event.get("state", "?")
-            self._event_counts[st] = self._event_counts.get(st, 0) + 1
+            shard.counts[st] = shard.counts.get(st, 0) + 1
             tid = event.get("task_id")
             t = event.get("time")
             if tid and isinstance(t, (int, float)):
-                observe = self._mark_phase(tid, st, float(t),
+                observe = self._mark_phase(shard, tid, st, float(t),
                                            event.get("name", ""))
         if observe is not None:
             hist, dt, name = observe
             hist.observe(dt, tags={"name": name})
 
-    def _mark_phase(self, tid: str, state: str, t: float,
+    @staticmethod
+    def _mark_phase(shard: _EventShard, tid: str, state: str, t: float,
                     name: str):
         """SUBMITTED -> SCHEDULED -> RUNNING -> FINISHED/FAILED phase
-        durations. Called under _lock; returns the observation to make
-        (metric locks must not nest inside the table lock)."""
-        prev = self._phase_marks.get(tid)
+        durations. Called under the shard lock; returns the observation
+        to make (metric locks must not nest inside the table lock)."""
+        prev = shard.phase_marks.get(tid)
         out = None
         if state in ("FINISHED", "FAILED"):
-            self._phase_marks.pop(tid, None)
+            shard.phase_marks.pop(tid, None)
             if prev is not None and prev[0] == "RUNNING":
                 out = (_H_EXEC, max(0.0, t - prev[1]), prev[2] or name)
             return out
@@ -423,20 +449,37 @@ class Gcs:
                 # actor tasks skip SCHEDULED (direct push): their queue
                 # wait spans from submission
                 out = (_H_QUEUE_WAIT, max(0.0, t - pt), name)
-        elif len(self._phase_marks) >= _PHASE_MARKS_MAX:
-            self._phase_marks.pop(next(iter(self._phase_marks)))
-        self._phase_marks[tid] = (state, t, name)
+        elif len(shard.phase_marks) >= shard.marks_max:
+            shard.phase_marks.pop(next(iter(shard.phase_marks)))
+        shard.phase_marks[tid] = (state, t, name)
         return out
+
+    def add_task_events(self, events: List[dict]) -> None:
+        """Batched intake for the direct-dispatch completion stream (one
+        message per flush interval instead of per-call traffic)."""
+        for ev in events:
+            if isinstance(ev, dict):
+                self.add_task_event(ev)
 
     def task_event_counts(self) -> Dict[str, int]:
         """Monotonic per-state totals (unlike the bounded ring buffer,
         these never decrease — safe to export as Prometheus counters)."""
-        with self._lock:
-            return dict(self._event_counts)
+        out: Dict[str, int] = {}
+        for shard in self._event_shards:
+            with shard.lock:
+                for k, v in shard.counts.items():
+                    out[k] = out.get(k, 0) + v
+        return out
 
     def task_events(self) -> List[dict]:
-        with self._lock:
-            return list(self._task_events)
+        """Merged view over the intake shards, timestamp-ordered (reads
+        are rare — dashboards/state API; writes are the hot path)."""
+        merged: List[dict] = []
+        for shard in self._event_shards:
+            with shard.lock:
+                merged.extend(shard.events)
+        merged.sort(key=lambda e: e.get("time", 0.0))
+        return merged
 
     # ---- persistence (GCS fault-tolerance stand-in) --------------------------
 

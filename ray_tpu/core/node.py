@@ -59,6 +59,9 @@ class WorkerHandle:
     env_hash: Optional[str] = None
     idle_since: float = 0.0  # monotonic timestamp of the last idle entry
     started_at: float = 0.0  # monotonic launch time (launch-strike gate)
+    # peer-facing direct-call socket this worker listens on (direct
+    # dispatch; resolve_actor hands it to callers — docs/DISPATCH.md)
+    direct_addr: Optional[str] = None
 
 
 @dataclass
@@ -449,6 +452,7 @@ class Node:
                 self._workers[worker_id] = handle
             handle.channel = channel
             handle.pid = payload.get("pid", handle.pid)
+            handle.direct_addr = payload.get("direct_addr")
             handle.state = "idle"
             self._launch_failures.pop(handle.env_hash or "", None)
             handle.idle_since = time.monotonic()
@@ -641,6 +645,12 @@ class Node:
             if method == "task_done":
                 if worker is not None:
                     self.on_task_done(worker, payload)
+                return None
+            if method == "direct_result":
+                # a worker finished one of the DRIVER's direct calls
+                # (submitted over this same channel); hot path — handled
+                # before the generic worker-call chain
+                self.runtime.on_direct_result(payload)
                 return None
             if method == "create_object":
                 return self.store.create(payload["object_id"], payload["size"])

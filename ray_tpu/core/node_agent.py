@@ -366,7 +366,9 @@ class NodeAgent:
                 channel.on_close(lambda: self._on_worker_channel_close(wid))
                 self.head.call("worker_register",
                                {"worker_id": wid,
-                                "pid": payload.get("pid", 0)}, timeout=30)
+                                "pid": payload.get("pid", 0),
+                                "direct_addr": payload.get("direct_addr")},
+                               timeout=30)
                 # prints from workers on this host can't reach the driver's
                 # console — have them tee lines up the channel
                 return {"forward_logs": True}
@@ -390,7 +392,8 @@ class NodeAgent:
             if method == "get_objects":
                 return self._get_objects(payload["ids"],
                                          payload.get("timeout"))
-            if method in ("log_event", "worker_log", "metrics_push"):
+            if method in ("log_event", "worker_log", "metrics_push",
+                          "task_events_batch"):
                 if method == "worker_log":
                     from collections import deque as _deque
 

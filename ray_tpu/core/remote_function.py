@@ -60,12 +60,23 @@ def prepare_args(rt, args, kwargs):
     """Top-level ObjectRefs pass by reference; small plain values inline in
     the spec; large values are promoted to the object store first
     (ref: transport/dependency_resolver.cc + ray_config_def.h:516)."""
+    publish = getattr(rt, "ensure_published", None)
 
     def one(v):
         if isinstance(v, ObjectRef):
+            if publish is not None:
+                # a locally-held direct result escaping into a task arg
+                # must reach the head first (docs/DISPATCH.md)
+                publish(v.id)
             return (ARG_REF, v)
         sobj = serialization.serialize(v)
         if sobj.total_bytes <= cfg.max_direct_call_object_size:
+            if publish is not None:
+                # refs NESTED in an inlined container arg escape this
+                # process just like top-level ones: the executing worker
+                # will deserialize and fetch them through the head
+                for r in sobj.contained_refs:
+                    publish(r.id)
             return (ARG_VALUE, sobj.to_bytes())
         ref = rt.put(v)
         return (ARG_REF, ref)

@@ -159,7 +159,8 @@ class RemoteNode(Node):
             self._on_worker_exit(handle)
         return handle
 
-    def on_remote_worker_register(self, worker_id: WorkerId, pid: int) -> None:
+    def on_remote_worker_register(self, worker_id: WorkerId, pid: int,
+                                  direct_addr: Optional[str] = None) -> None:
         with self._lock:
             handle = self._workers.get(worker_id)
             if handle is None:
@@ -167,6 +168,7 @@ class RemoteNode(Node):
                                       pid=pid)
                 self._workers[worker_id] = handle
             handle.pid = pid
+            handle.direct_addr = direct_addr
             handle.state = "idle"
             self._starting_count = max(0, self._starting_count - 1)
             self._launch_failures.pop(handle.env_hash or "", None)

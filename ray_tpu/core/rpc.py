@@ -2,31 +2,40 @@
 
 Equivalent of the reference's rpc layer (ref: src/ray/rpc/grpc_server.h,
 client_call.h — callback-based client calls multiplexed on a shared channel).
-Here: one duplex byte pipe (Unix socket or TCP) per peer pair; a reader thread
-demultiplexes responses (resolving futures) and dispatches incoming requests
-to a handler pool, so nested calls never deadlock. The same protocol runs over
-AF_UNIX within a host and AF_INET across hosts (DCN control plane).
+Here: one duplex byte pipe (Unix socket or TCP) per peer pair. The same
+protocol runs over AF_UNIX within a host and AF_INET across hosts (DCN
+control plane).
+
+Threading model (the per-peer thread-pool era ended with the round-5
+219-thread flake): a process owns ONE reader hub thread multiplexing every
+channel's receive side via ``multiprocessing.connection.wait``, plus one
+shared elastic worker pool (threads spawn on demand and exit after an idle
+timeout). Each channel contributes zero dedicated threads — its request
+handlers, oneway lane, and writer are FIFO *lanes* drained on the shared
+pool, so process thread count tracks concurrent load, not peer count.
 """
 from __future__ import annotations
 
 import itertools
 import os
-import queue as queue_mod
+import socket
 import threading
 import time
 import traceback
-from concurrent.futures import Future, ThreadPoolExecutor
+from collections import deque
+from concurrent.futures import Future
 from multiprocessing.connection import Client, Connection, Listener
+from multiprocessing.connection import wait as _mpc_wait
 from typing import Any, Callable, Dict, Optional
 
 _REQ, _RESP, _ERR, _ONEWAY = 0, 1, 2, 3
 # a coalesced frame: payload is a list of already-encoded frames. Under
-# burst (task pushes, done floods) the writer drains its queue into one
-# send and the reader dispatches the whole batch with one wakeup —
-# syscalls and thread hops amortize across the batch
+# burst (task pushes, done floods, direct submits/results) the writer
+# lane drains its queue into one send and the reader dispatches the
+# whole batch with one wakeup — syscalls and thread hops amortize
+# across the batch
 _BATCH = 4
 _BATCH_MAX = 64
-_CLOSE = object()  # writer-thread sentinel
 
 # per-handler instrumentation (ref: the reference's per-RPC gRPC stats,
 # src/ray/stats/metric_defs.cc grpc_server_req_* counters): method ->
@@ -74,6 +83,244 @@ class ChannelClosed(Exception):
     pass
 
 
+class ElasticPool:
+    """Shared worker pool whose thread count tracks CONCURRENT load.
+
+    Unlike ThreadPoolExecutor (which holds every thread it ever spawned),
+    threads exit after ``idle_s`` without work, and a new thread spawns
+    only when a task arrives with no idle thread to take it. A blocked
+    handler therefore costs one thread for exactly as long as it blocks,
+    and a process serving 50 peers sequentially runs on ~1 thread.
+    The max_threads cap is a runaway backstop, far above real load."""
+
+    def __init__(self, name: str = "rpc", idle_s: float = 8.0,
+                 max_threads: int = 512):
+        self._name = name
+        self._idle_s = idle_s
+        self._max = max_threads
+        self._cv = threading.Condition()
+        self._q: deque = deque()
+        self._threads = 0
+        self._waiting = 0
+        self._seq = itertools.count()
+
+    def submit(self, fn: Callable, *args) -> None:
+        spawn = False
+        with self._cv:
+            self._q.append((fn, args))
+            if self._waiting:
+                self._cv.notify()
+            # spawn whenever queue depth exceeds the waiter count, not
+            # only when no waiter exists: a waiter that was ALREADY
+            # notified (but hasn't reacquired the lock, so _waiting still
+            # counts it) can absorb only one item — counting it for a
+            # second submit would lose that wakeup, stranding the item
+            # until an unrelated submit (deadlock if the running handler
+            # blocks on the stranded one, e.g. a fetch whose seal
+            # notification sits behind it). A spare thread idles out.
+            if len(self._q) > self._waiting and self._threads < self._max:
+                self._threads += 1
+                spawn = True
+        if spawn:
+            threading.Thread(
+                target=self._run, daemon=True,
+                name=f"{self._name}-{next(self._seq)}").start()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q:
+                    self._waiting += 1
+                    self._cv.wait(self._idle_s)
+                    self._waiting -= 1
+                    if not self._q:
+                        # idle timeout (or spurious wake with nothing to
+                        # do): retire — submit() spawns a fresh thread
+                        # when load returns
+                        self._threads -= 1
+                        return
+                fn, args = self._q.popleft()
+            try:
+                fn(*args)
+            except Exception:
+                traceback.print_exc()
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {"threads": self._threads, "waiting": self._waiting,
+                    "queued": len(self._q)}
+
+
+_POOL_LOCK = threading.Lock()
+_POOL: Optional[ElasticPool] = None
+
+
+def shared_pool() -> ElasticPool:
+    """The process-wide RPC worker pool (handlers, oneway lanes, writers)."""
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is None:
+            _POOL = ElasticPool("rpcw")
+        return _POOL
+
+
+class _Lane:
+    """FIFO work lane with bounded concurrency, drained on the shared pool.
+
+    Items keep arrival order; at most ``max_active`` drainers run at once
+    (1 = strict FIFO processing — the oneway and writer lanes; N = the
+    request lane's per-channel handler concurrency). No dedicated thread:
+    a drainer claims a pool thread only while items exist."""
+
+    __slots__ = ("_pool", "_fn", "_q", "_lock", "_active", "_max")
+
+    def __init__(self, pool: ElasticPool, fn: Callable[[Any], None],
+                 max_active: int = 1):
+        self._pool = pool
+        self._fn = fn
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+        self._active = 0
+        self._max = max(1, int(max_active))
+
+    def push(self, item) -> None:
+        with self._lock:
+            self._q.append(item)
+            if self._active >= self._max:
+                return
+            self._active += 1
+        self._pool.submit(self._drain)
+
+    def _drain(self) -> None:
+        while True:
+            with self._lock:
+                if not self._q:
+                    self._active -= 1
+                    return
+                item = self._q.popleft()
+            try:
+                self._fn(item)
+            except Exception:
+                traceback.print_exc()
+
+    def idle(self) -> bool:
+        with self._lock:
+            return not self._q and self._active == 0
+
+
+class _ReaderHub:
+    """One thread multiplexing every channel's receive side.
+
+    ``multiprocessing.connection.wait`` over all registered connections;
+    ready frames are decoded and dispatched to the owning channel's lanes
+    (which run on the shared pool), so the hub never blocks on a handler.
+    Only the hub closes a registered connection — deregistration is
+    requested via flag + wakeup, which keeps the fd out of the selector
+    before it goes invalid."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._channels: Dict[int, "RpcChannel"] = {}  # conn fileno -> ch
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._started = False
+
+    def _ensure_thread(self) -> None:
+        if not self._started:
+            self._started = True
+            threading.Thread(target=self._loop, daemon=True,
+                             name="rpc-hub").start()
+
+    def register(self, ch: "RpcChannel") -> None:
+        with self._lock:
+            self._channels[ch._conn.fileno()] = ch
+            self._ensure_thread()
+        self.wake()
+
+    def request_drop(self, ch: "RpcChannel") -> None:
+        """Ask the hub to stop watching + close the channel's conn."""
+        ch._drop_requested = True
+        self.wake()
+
+    def wake(self) -> None:
+        try:
+            self._wake_w.send(b"x")
+        except Exception:
+            pass
+
+    def _loop(self) -> None:
+        while True:
+            with self._lock:
+                dead = [ch for ch in self._channels.values()
+                        if ch._drop_requested]
+                for ch in dead:
+                    self._channels.pop(ch._conn.fileno(), None)
+                conns = {ch._conn: ch for ch in self._channels.values()}
+            for ch in dead:
+                try:
+                    ch._conn.close()
+                except Exception:
+                    pass
+            try:
+                ready = _mpc_wait([*conns.keys(), self._wake_r])
+            except Exception:
+                # a conn went bad between snapshot and wait (peer died
+                # mid-registration): probe each individually and drop
+                # the broken ones
+                for conn, ch in conns.items():
+                    try:
+                        conn.poll(0)
+                    except Exception:
+                        self._drop_broken(ch)
+                continue
+            for obj in ready:
+                if obj is self._wake_r:
+                    try:
+                        while self._wake_r.recv(4096):
+                            pass
+                    except BlockingIOError:
+                        pass
+                    except Exception:
+                        pass
+                    continue
+                ch = conns.get(obj)
+                if ch is None or ch._drop_requested:
+                    continue
+                try:
+                    data = obj.recv_bytes()
+                except Exception:
+                    # EOF / reset / torn down: this channel only
+                    self._drop_broken(ch)
+                    continue
+                try:
+                    ch._on_bytes(data)
+                except Exception:
+                    traceback.print_exc()
+
+    def _drop_broken(self, ch: "RpcChannel") -> None:
+        with self._lock:
+            self._channels.pop(ch._conn.fileno(), None)
+        try:
+            ch._conn.close()
+        except Exception:
+            pass
+        # teardown callbacks (worker-exit handling etc.) can be heavy:
+        # run them on the pool, never on the hub thread
+        shared_pool().submit(ch._teardown)
+
+
+_HUB_LOCK = threading.Lock()
+_HUB: Optional[_ReaderHub] = None
+
+
+def reader_hub() -> _ReaderHub:
+    global _HUB
+    with _HUB_LOCK:
+        if _HUB is None:
+            _HUB = _ReaderHub()
+        return _HUB
+
+
 class RpcChannel:
     """A duplex message channel with request/response correlation.
 
@@ -97,26 +344,28 @@ class RpcChannel:
         self._lock = threading.Lock()
         self._closed = threading.Event()
         self._started = False
+        self._drop_requested = False
         self._on_close_cbs = []
-        self._pool = ThreadPoolExecutor(max_workers=num_handler_threads,
-                                        thread_name_prefix=f"rpc-{name}")
-        # Notifications get their own single-thread lane: they stay FIFO
+        pool = shared_pool()
+        # request handlers: per-channel concurrency cap (the old
+        # per-channel ThreadPoolExecutor's max_workers), shared threads
+        self._req_lane = _Lane(pool, self._handle_req,
+                               max_active=num_handler_threads)
+        # Notifications get their own single-drainer lane: they stay FIFO
         # and can never be starved by blocking request handlers (e.g. a
         # fetch waiting on an object whose seal NOTIFICATION would satisfy
         # it — the reference keeps these planes separate too: pubsub
         # long-poll vs request RPCs).
-        self._oneway_pool = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix=f"rpc-ow-{name}")
-        self._reader = threading.Thread(target=self._read_loop, daemon=True,
-                                        name=f"rpc-reader-{name}")
-        # Single writer thread owns conn.send. Senders only enqueue, so a
-        # full socket buffer can never block the reader thread, a handler,
-        # or a GC finalizer (an ObjectRef finalizer notifying remove_ref
-        # from inside the reader's read loop deadlocked both pipe
-        # directions before this).
-        self._out_q: queue_mod.SimpleQueue = queue_mod.SimpleQueue()
-        self._writer = threading.Thread(target=self._write_loop, daemon=True,
-                                        name=f"rpc-writer-{name}")
+        self._ow_lane = _Lane(pool, self._handle_oneway_item, max_active=1)
+        # Single-drainer writer lane owns conn.send. Senders only enqueue,
+        # so a full socket buffer can never block the hub, a handler, or a
+        # GC finalizer (an ObjectRef finalizer notifying remove_ref from
+        # inside the reader's loop deadlocked both pipe directions before
+        # this). The drain coalesces queued frames into _BATCH sends.
+        self._outbox: deque = deque()
+        self._out_lock = threading.Lock()
+        self._out_active = False
+        self._out_idle = threading.Condition(self._out_lock)
         if autostart:
             self.start()
 
@@ -125,8 +374,7 @@ class RpcChannel:
         autostart=False — otherwise a message can race the handler install."""
         if not self._started:
             self._started = True
-            self._writer.start()
-            self._reader.start()
+            reader_hub().register(self)
 
     # -- client side -----------------------------------------------------------
 
@@ -160,49 +408,65 @@ class RpcChannel:
     def _send(self, msg) -> None:
         if self._closed.is_set():
             raise ChannelClosed(f"channel {self._name} closed")
-        self._out_q.put(msg)
+        with self._out_lock:
+            self._outbox.append(msg)
+            if self._out_active:
+                return
+            self._out_active = True
+        shared_pool().submit(self._write_drain)
 
-    def _write_loop(self) -> None:
+    def _write_drain(self) -> None:
         from . import wire
 
         while True:
-            msg = self._out_q.get()
-            if msg is _CLOSE:
-                return
-            try:
-                # typed frames, never pickle: see wire.py (the reference's
-                # control plane is protobuf/gRPC; pickle framing here was
-                # an RCE amplifier behind one shared token)
-                frame = wire.encode(msg)
-                extra = []
-                close_after = False
-                while len(extra) < _BATCH_MAX - 1:
-                    try:
-                        nxt = self._out_q.get_nowait()
-                    except queue_mod.Empty:
-                        break
-                    if nxt is _CLOSE:
-                        close_after = True
-                        break
-                    try:
-                        extra.append(wire.encode(nxt))
-                    except wire.WireEncodeError:
-                        traceback.print_exc()
-                        self._fail_encode(nxt)
-                if extra:
-                    self._conn.send_bytes(
-                        wire.encode((_BATCH, 0, None, [frame, *extra])))
-                else:
-                    self._conn.send_bytes(frame)
-                if close_after:
+            with self._out_lock:
+                if not self._outbox:
+                    self._out_active = False
+                    self._out_idle.notify_all()
                     return
-            except wire.WireEncodeError:
-                traceback.print_exc()
-                self._fail_encode(msg)
+                # drain up to a batch's worth under the lock; encoding
+                # and the send syscall happen outside it
+                msgs = [self._outbox.popleft()
+                        for _ in range(min(len(self._outbox), _BATCH_MAX))]
+            frames = []
+            for msg in msgs:
+                try:
+                    # typed frames, never pickle: see wire.py (the
+                    # reference's control plane is protobuf/gRPC; pickle
+                    # framing here was an RCE amplifier behind one token)
+                    frames.append(wire.encode(msg))
+                except wire.WireEncodeError:
+                    traceback.print_exc()
+                    self._fail_encode(msg)
+                except Exception:
+                    self._teardown()
+                    with self._out_lock:
+                        self._out_active = False
+                        self._out_idle.notify_all()
+                    return
+            if not frames:
                 continue
+            try:
+                if len(frames) == 1:
+                    self._conn.send_bytes(frames[0])
+                else:
+                    self._conn.send_bytes(
+                        wire.encode((_BATCH, 0, None, frames)))
             except Exception:
                 self._teardown()
+                with self._out_lock:
+                    self._out_active = False
+                    self._out_idle.notify_all()
                 return
+
+    def _flush_writer(self, timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        with self._out_lock:
+            while self._outbox or self._out_active:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return
+                self._out_idle.wait(remaining)
 
     def _fail_encode(self, msg) -> None:
         """One bad payload must not kill the channel — but it must not
@@ -230,57 +494,32 @@ class RpcChannel:
     def set_handler(self, handler: Callable[[str, Any], Any]) -> None:
         self._handler = handler
 
-    def _read_loop(self) -> None:
+    def _on_bytes(self, data: bytes) -> None:
+        """Hub delivery of one raw frame: decode + route to lanes. Runs on
+        the hub thread — must never block on a handler."""
         from . import wire
 
         try:
-            while not self._closed.is_set():
-                try:
-                    data = self._conn.recv_bytes()
-                except (EOFError, OSError, BrokenPipeError):
-                    break
-                except TypeError:
-                    break  # connection torn down mid-recv at interpreter exit
-                try:
-                    msg = wire.decode(data)
-                    kind, msg_id, a, b = msg
-                    if not isinstance(kind, int) or not isinstance(msg_id, int):
-                        raise wire.WireDecodeError("bad frame header")
-                except (wire.WireDecodeError, ValueError, TypeError):
-                    # malformed/malicious frame: it was never evaluated —
-                    # drop it and keep serving (a pickle-framing channel
-                    # would have executed it on recv)
-                    traceback.print_exc()
-                    continue
-                if kind == _BATCH:
-                    if not self._dispatch_batch(b):
-                        break
-                elif not self._dispatch_frame(kind, msg_id, a, b):
-                    break
-        finally:
-            self._teardown()
+            msg = wire.decode(data)
+            kind, msg_id, a, b = msg
+            if not isinstance(kind, int) or not isinstance(msg_id, int):
+                raise wire.WireDecodeError("bad frame header")
+        except (wire.WireDecodeError, ValueError, TypeError):
+            # malformed/malicious frame: it was never evaluated — drop it
+            # and keep serving (a pickle-framing channel would have
+            # executed it on recv)
+            traceback.print_exc()
+            return
+        if kind == _BATCH:
+            self._dispatch_batch(b)
+        else:
+            self._dispatch_frame(kind, msg_id, a, b)
 
-    def _dispatch_batch(self, frames) -> bool:
-        """Decode and dispatch a writer-coalesced batch. Consecutive
-        oneways run as ONE pool item (they are FIFO on the oneway lane
-        anyway) so a 64-frame done-flood costs one thread hop."""
+    def _dispatch_batch(self, frames) -> None:
         from . import wire
 
         if not isinstance(frames, (list, tuple)):
-            return True  # malformed batch body: drop
-        oneway_run: list = []
-
-        def flush_oneways() -> bool:
-            if not oneway_run:
-                return True
-            run = list(oneway_run)
-            oneway_run.clear()
-            try:
-                self._oneway_pool.submit(self._handle_oneway_many, run)
-            except RuntimeError:
-                return False
-            return True
-
+            return  # malformed batch body: drop
         for data in frames:
             try:
                 kind, msg_id, a, b = wire.decode(data)
@@ -289,19 +528,11 @@ class RpcChannel:
             except (wire.WireDecodeError, ValueError, TypeError):
                 traceback.print_exc()
                 continue
-            if kind == _ONEWAY:
-                oneway_run.append((a, b))
-                continue
-            if not flush_oneways():
-                return False
             if kind == _BATCH:
                 continue  # no nesting
-            if not self._dispatch_frame(kind, msg_id, a, b):
-                return False
-        return flush_oneways()
+            self._dispatch_frame(kind, msg_id, a, b)
 
-    def _dispatch_frame(self, kind: int, msg_id: int, a, b) -> bool:
-        """Route one decoded frame; False = channel is closing."""
+    def _dispatch_frame(self, kind: int, msg_id: int, a, b) -> None:
         if kind == _RESP:
             with self._lock:
                 fut = self._pending.pop(msg_id, None)
@@ -313,22 +544,12 @@ class RpcChannel:
             if fut is not None:
                 fut.set_exception(_RemoteCallError(a, b))
         elif kind == _REQ:
-            try:
-                self._pool.submit(self._handle, msg_id, a, b)
-            except RuntimeError:
-                return False  # pool shut down: channel is closing
+            self._req_lane.push((msg_id, a, b))
         elif kind == _ONEWAY:
-            try:
-                self._oneway_pool.submit(self._handle_oneway, a, b)
-            except RuntimeError:
-                return False
-        return True
+            self._ow_lane.push((a, b))
 
-    def _handle_oneway_many(self, items) -> None:
-        for a, b in items:
-            self._handle_oneway(a, b)
-
-    def _handle(self, msg_id: int, method: str, payload: Any) -> None:
+    def _handle_req(self, item) -> None:
+        msg_id, method, payload = item
         t0 = time.perf_counter()
         ok = False
         try:
@@ -338,13 +559,15 @@ class RpcChannel:
             # send IS a client-visible error and must count as one
         except Exception as e:
             try:
-                self._send((_ERR, msg_id, f"{type(e).__name__}: {e}", traceback.format_exc()))
+                self._send((_ERR, msg_id, f"{type(e).__name__}: {e}",
+                            traceback.format_exc()))
             except Exception:
                 pass
         finally:
             _record_rpc(method, time.perf_counter() - t0, not ok)
 
-    def _handle_oneway(self, method: str, payload: Any) -> None:
+    def _handle_oneway_item(self, item) -> None:
+        method, payload = item
         t0 = time.perf_counter()
         ok = False
         try:
@@ -377,7 +600,6 @@ class RpcChannel:
             self._closed.set()
             pending = list(self._pending.values())
             self._pending.clear()
-        self._out_q.put(_CLOSE)  # let the writer drain queued sends, then exit
         for fut in pending:
             if not fut.done():
                 fut.set_exception(ChannelClosed(f"channel {self._name} closed"))
@@ -386,19 +608,21 @@ class RpcChannel:
                 cb()
             except Exception:
                 traceback.print_exc()
-        self._pool.shutdown(wait=False)
-        self._oneway_pool.shutdown(wait=False)
 
     def close(self) -> None:
         self._teardown()
-        # give the writer a moment to flush already-queued messages (e.g. a
-        # final "shutdown" notify) before the connection drops
-        if self._started and threading.current_thread() is not self._writer:
-            self._writer.join(timeout=2.0)
-        try:
-            self._conn.close()
-        except Exception:
-            pass
+        # give the writer lane a moment to flush already-queued messages
+        # (e.g. a final "shutdown" notify) before the connection drops
+        self._flush_writer(2.0)
+        if self._started:
+            # a registered conn is only closed by the hub, so the fd never
+            # goes invalid inside the selector
+            reader_hub().request_drop(self)
+        else:
+            try:
+                self._conn.close()
+            except Exception:
+                pass
 
     @property
     def closed(self) -> bool:
@@ -454,7 +678,11 @@ class RpcServer:
     def __init__(self, address, handler_factory: Callable[[RpcChannel], Callable],
                  family: Optional[str] = None, authkey: Optional[bytes] = None,
                  num_handler_threads: int = 16):
-        self._listener = Listener(address, family=family,
+        # backlog: the multiprocessing default of 1 refuses concurrent
+        # connects (peer direct-call channels + multi-driver bursts all
+        # land at once); a refused connect reads as "unreachable" and
+        # would push callers onto the routed path
+        self._listener = Listener(address, family=family, backlog=64,
                                   authkey=authkey or cluster_token())
         self._handler_factory = handler_factory
         self._num_handler_threads = num_handler_threads

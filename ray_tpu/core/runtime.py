@@ -54,6 +54,56 @@ _H_RESULT_PUT = metrics_mod.Histogram(
     "ray_tpu_task_result_put_seconds",
     "head-side intake of a finished task's results",
     boundaries=metrics_mod.FAST_BOUNDARIES)
+# decentralized dispatch (docs/DISPATCH.md): per-process counters for the
+# two submission paths; worker processes' increments ship to the head via
+# the metrics plane, so a cluster-wide scrape shows the split
+_C_DIRECT = metrics_mod.Counter(
+    "ray_tpu_task_direct_total",
+    "actor tasks submitted on the direct path (no head hop)")
+_C_ROUTED = metrics_mod.Counter(
+    "ray_tpu_task_routed_total",
+    "tasks submitted through the head (routed path)")
+
+
+def dispatch_counts() -> Tuple[float, float]:
+    """(direct, routed) submissions counted IN THIS PROCESS — the test
+    hook for 'steady-state actor calls make zero head RPCs'."""
+    return _C_DIRECT.total(), _C_ROUTED.total()
+
+
+class ShardedLoop:
+    """N worker threads, each owning a FIFO queue; work is keyed so every
+    item with one key runs on one thread IN ORDER (docs/DISPATCH.md —
+    the sharded head event loop).
+
+    The agent channel multiplexes every remote worker onto ONE oneway
+    lane; keying its intake (task_done / object_sealed / worker_call /
+    worker_exit) by worker id spreads the head's dispatch work across
+    cores while preserving the per-worker FIFO that the crash/completion
+    protocol relies on."""
+
+    def __init__(self, name: str, shards: int):
+        import queue as _q
+
+        self._queues = [_q.SimpleQueue() for _ in range(max(1, shards))]
+        self._n = len(self._queues)
+        for i, q in enumerate(self._queues):
+            threading.Thread(target=self._run, args=(q,), daemon=True,
+                             name=f"{name}-s{i}").start()
+
+    def submit(self, key, fn, *args) -> None:
+        self._queues[hash(key) % self._n].put((fn, args))
+
+    @staticmethod
+    def _run(q) -> None:
+        import traceback as _tb
+
+        while True:
+            fn, args = q.get()
+            try:
+                fn(*args)
+            except Exception:
+                _tb.print_exc()
 
 
 def set_runtime(rt) -> None:
@@ -91,6 +141,28 @@ class RuntimeContext:
         return self.actor_id.hex() if self.actor_id else None
 
 
+class _ObjShard:
+    """One shard of the head's object state (docs/DISPATCH.md): the
+    in-memory store, location directory, availability events, waiter
+    lists, sizes, nested-result pins, and in-flight pull futures for the
+    object ids hashing here — under one shard lock. Every object
+    operation is single-oid, so shards never deadlock each other; only
+    node-death sweeps iterate all shards."""
+
+    __slots__ = ("lock", "mem", "dir", "events", "sizes", "waiters",
+                 "nested", "pulls")
+
+    def __init__(self, index: int):
+        self.lock = instrumented_lock(f"runtime.obj.s{index}")
+        self.mem: Dict[ObjectId, bytes] = {}
+        self.dir: Dict[ObjectId, Set[NodeId]] = {}
+        self.events: Dict[ObjectId, threading.Event] = {}
+        self.sizes: Dict[ObjectId, int] = {}
+        self.waiters: Dict[ObjectId, list] = {}
+        self.nested: Dict[ObjectId, list] = {}
+        self.pulls: Dict[ObjectId, Future] = {}
+
+
 @dataclass
 class _ActorRecord:
     info: ActorInfo
@@ -100,6 +172,30 @@ class _ActorRecord:
     queued: List[TaskSpec] = field(default_factory=list)
     lock: Any = field(
         default_factory=lambda: instrumented_lock("runtime.actor_record"))
+    # direct dispatch (docs/DISPATCH.md): placement epoch (bumped each
+    # time the actor lands on a worker — the version stamp callers cache),
+    # the driver's own direct-lane sequence counter, its in-flight direct
+    # tasks (resubmitted via the head on worker/peer failure), and the
+    # cached peer channel for remote-node workers
+    epoch: int = 0
+    dseq: int = 0
+    # connection-era token for the direct lane: bumped on every new peer
+    # channel (dseq restarts at 0 with it), carried in each direct_submit
+    # frame so the worker's lane can distinguish a reconnected caller
+    # (reset the lane) from a straggler frame of the dead connection
+    # (drop it — its task was recovered through the routed path). Local
+    # workers ride the node channel, which lives as long as the worker,
+    # so their era never moves within an epoch.
+    dlane: int = 0
+    direct_inflight: Dict[TaskId, TaskSpec] = field(default_factory=dict)
+    direct_chan: Any = None
+    # negative cache for the peer connect: monotonic deadline before which
+    # no reconnect is attempted (0.0 = try). Time-bounded, not permanent:
+    # a transiently refused connect (accept backlog, listener busy) must
+    # not strand the actor on the routed path for the whole epoch, while
+    # a truly unreachable socket (cross-host) costs one failed connect
+    # per window instead of one per call.
+    direct_bad: float = 0.0
 
 
 class DriverRuntime:
@@ -126,13 +222,14 @@ class DriverRuntime:
         self.gcs.pubsub.subscribe("node", self._on_node_state)
         self.scheduler = Scheduler(self.config.scheduler_spread_threshold)
         self.task_manager = TaskManager(self.config.lineage_max_bytes)
-        self.refcount = ReferenceCounter(self._free_object)
+        self.refcount = ReferenceCounter(
+            self._free_object, shards=int(self.config.refcount_shards))
         self.nodes: Dict[NodeId, Node] = {}
-        self._memory_store: Dict[ObjectId, bytes] = {}
-        self._directory: Dict[ObjectId, Set[NodeId]] = {}
-        self._events: Dict[ObjectId, threading.Event] = {}
-        self._obj_waiters: Dict[ObjectId, list] = {}
-        self._obj_sizes: Dict[ObjectId, int] = {}  # locality weights
+        # object state lives in per-oid shards (memory store, directory,
+        # events, waiters, sizes, nested pins, pull dedup) — the head's
+        # hottest tables no longer serialize on the big runtime lock
+        self._oshards = [_ObjShard(i) for i in range(16)]
+        self._no = len(self._oshards)
         # PG placement: one dedicated placer thread drains a FIFO of
         # pending groups (ref: gcs_placement_group_scheduler.cc — the GCS
         # schedules PGs from a single queue). A per-PG thread-pool task per
@@ -141,9 +238,6 @@ class DriverRuntime:
         self._pg_pending: "collections.deque[PlacementGroupId]" = collections.deque()
         self._pg_parked: Set[PlacementGroupId] = set()
         self._recovering: Set[ObjectId] = set()
-        # return-object id -> ObjectIds of refs nested in its result
-        # (pinned until the return object is freed; borrower protocol)
-        self._nested_refs: Dict[ObjectId, list] = {}
         # attributed worker logs live in gcs.logs (LogStore); the mirror
         # prints remote workers' lines on the driver console with a
         # colored provenance prefix + repeated-line dedup (ref:
@@ -152,7 +246,6 @@ class DriverRuntime:
 
         self._log_mirror = DriverMirror(
             enabled=bool(int(self.config.log_to_driver)))
-        self._pull_futures: Dict[ObjectId, Future] = {}
         # compiled graphs (ray_tpu/cgraph): live graphs by id, the
         # actor-exclusivity ledger, and the cross-node channel routing
         # table (cid hex -> ("driver", dag, None, gid) |
@@ -173,6 +266,10 @@ class DriverRuntime:
         self._pool = ThreadPoolExecutor(
             max_workers=int(self.config.driver_pool_threads),
             thread_name_prefix="rt")
+        # direct dispatch: steady-state actor calls skip the routed path
+        # (task_manager / GCS events / lease machinery) and go straight to
+        # the owning worker; see docs/DISPATCH.md
+        self._direct_enabled = bool(int(self.config.direct_actor_calls))
         self._shutdown = False
         threading.Thread(target=self._pg_placer_loop, daemon=True,
                          name="pg-placer").start()
@@ -223,6 +320,11 @@ class DriverRuntime:
 
         if getattr(self, "_remote_server", None) is not None:
             return self._remote_server.address
+        # the agent channel multiplexes every remote worker onto one
+        # oneway lane: shard its intake by worker id so dispatch work
+        # parallelizes across cores with per-worker FIFO preserved
+        self._agent_loop = ShardedLoop(
+            "head-agent", min(8, (os.cpu_count() or 2) * 2))
         # one agent channel multiplexes every worker on that host; size the
         # pool so blocking fetches can't starve the worker_call relay
         self._remote_server = RpcServer(
@@ -346,29 +448,31 @@ class DriverRuntime:
                         payload, node=node.node_id.hex()[:12])
                 return None
             if method == "worker_register":
-                node.on_remote_worker_register(payload["worker_id"],
-                                               payload.get("pid", 0))
+                node.on_remote_worker_register(
+                    payload["worker_id"], payload.get("pid", 0),
+                    direct_addr=payload.get("direct_addr"))
                 return True
             if method == "worker_exit":
-                node.on_remote_worker_exit(payload["worker_id"],
-                                           error=payload.get("error"))
+                # sharded with task_done on the same worker-id key: exit
+                # processing must not overtake a completion already queued
+                self._agent_loop.submit(
+                    payload["worker_id"], node.on_remote_worker_exit,
+                    payload["worker_id"], payload.get("error"))
                 return None
             if method == "task_done":
-                worker = node.get_worker(payload["worker_id"])
-                if worker is not None:
-                    node.on_task_done(worker, payload["payload"])
+                self._agent_loop.submit(payload["worker_id"],
+                                        self._agent_task_done, node, payload)
                 return None
             if method == "object_sealed":
-                self.on_object_sealed(payload["object_id"], node.node_id,
-                                      size=payload.get("size"))
-                if payload.get("is_put") and payload.get("worker_id"):
-                    self.refcount.add_holder_ref(payload["object_id"],
-                                                 payload["worker_id"])
+                self._agent_loop.submit(
+                    payload.get("worker_id") or payload["object_id"],
+                    self._agent_object_sealed, node, payload)
                 return None
             if method == "object_copy":
-                with self._lock:
-                    self._directory.setdefault(
-                        payload["object_id"], set()).add(node.node_id)
+                oid = payload["object_id"]
+                sh = self._oshard(oid)
+                with sh.lock:
+                    sh.dir.setdefault(oid, set()).add(node.node_id)
                 return None
             if method == "fetch_for_agent":
                 return self._fetch_for_agent(node, payload["object_id"],
@@ -380,18 +484,42 @@ class DriverRuntime:
                                               payload["offset"],
                                               payload["length"])
             if method == "worker_call":
-                worker = node.get_worker(payload["worker_id"])
-                if worker is None:
-                    # raced an exit notification; holder accounting still
-                    # needs the id, nothing else does
-                    worker = WorkerHandle(worker_id=payload["worker_id"],
-                                          proc=None)  # type: ignore
-                return self.handle_worker_call(node, worker,
-                                               payload["method"],
-                                               payload["payload"])
+                if payload["method"] in ("metrics_push", "worker_log",
+                                         "log_event", "task_events_batch"):
+                    # always notify-relayed by the agent (no reply):
+                    # sharded off the channel lane, keyed per worker
+                    self._agent_loop.submit(
+                        payload.get("worker_id") or 0,
+                        self._agent_worker_call, node, payload)
+                    return None
+                return self._agent_worker_call(node, payload)
             raise ValueError(f"unknown agent message {method}")
 
         return handler
+
+    def _agent_task_done(self, node, payload: dict) -> None:
+        worker = node.get_worker(payload["worker_id"])
+        if worker is not None:
+            node.on_task_done(worker, payload["payload"])
+
+    def _agent_object_sealed(self, node, payload: dict) -> None:
+        self.on_object_sealed(payload["object_id"], node.node_id,
+                              size=payload.get("size"))
+        if payload.get("is_put") and payload.get("worker_id"):
+            self.refcount.add_holder_ref(payload["object_id"],
+                                         payload["worker_id"])
+
+    def _agent_worker_call(self, node, payload: dict):
+        from .node import WorkerHandle
+
+        worker = node.get_worker(payload["worker_id"])
+        if worker is None:
+            # raced an exit notification; holder accounting still
+            # needs the id, nothing else does
+            worker = WorkerHandle(worker_id=payload["worker_id"],
+                                  proc=None)  # type: ignore
+        return self.handle_worker_call(node, worker, payload["method"],
+                                       payload["payload"])
 
     def _fetch_for_agent(self, node, oid: ObjectId,
                          timeout: Optional[float], relay: bool = False):
@@ -409,9 +537,10 @@ class DriverRuntime:
             if not ev.wait(remaining):
                 raise exc.GetTimeoutError(
                     f"Get timed out waiting for object {oid.hex()[:12]}")
-            with self._lock:
-                data = self._memory_store.get(oid)
-                copies = list(self._directory.get(oid, ()))
+            sh = self._oshard(oid)
+            with sh.lock:
+                data = sh.mem.get(oid)
+                copies = list(sh.dir.get(oid, ()))
             if data is not None:
                 return ("inline", data)
             peers = []
@@ -440,8 +569,9 @@ class DriverRuntime:
         """Serve a chunk of a locally-stored object (transfer source side)."""
         from .object_store import read_store_chunk
 
-        with self._lock:
-            copies = list(self._directory.get(oid, ()))
+        sh = self._oshard(oid)
+        with sh.lock:
+            copies = list(sh.dir.get(oid, ()))
         for nid in copies:
             n = self.nodes.get(nid)
             if n is None or not n.alive or getattr(n, "is_remote", False):
@@ -481,10 +611,15 @@ class DriverRuntime:
         except Exception:
             pass
         self.gcs.mark_node_dead(node_id, "agent disconnected")
-        with self._lock:
-            for oid, copies in list(self._directory.items()):
-                copies.discard(node_id)
+        self._drop_node_copies(node_id)
         self._reschedule_parked()
+
+    def _drop_node_copies(self, node_id: NodeId) -> None:
+        """Node died: purge it from every object's location set."""
+        for sh in self._oshards:
+            with sh.lock:
+                for copies in sh.dir.values():
+                    copies.discard(node_id)
 
     def add_node(self, resources: ResourceSet,
                  labels: Optional[Dict[str, str]] = None) -> Node:
@@ -506,9 +641,7 @@ class DriverRuntime:
         node.shutdown(kill=kill)
         self.gcs.mark_node_dead(node_id, "removed" if not kill else "killed")
         # objects whose only copies were on this node are now lost
-        with self._lock:
-            for oid, copies in list(self._directory.items()):
-                copies.discard(node_id)
+        self._drop_node_copies(node_id)
 
     def _on_node_state(self, msg) -> None:
         state, node_id = msg
@@ -546,11 +679,38 @@ class DriverRuntime:
 
     # ---- object API ----------------------------------------------------------
 
+    def _oshard(self, oid: ObjectId) -> _ObjShard:
+        return self._oshards[hash(oid) % self._no]
+
+    def object_locations(self, oid: ObjectId) -> Set[NodeId]:
+        sh = self._oshard(oid)
+        with sh.lock:
+            return set(sh.dir.get(oid, ()))
+
+    def add_object_location(self, oid: ObjectId, node_id: NodeId) -> None:
+        sh = self._oshard(oid)
+        with sh.lock:
+            sh.dir.setdefault(oid, set()).add(node_id)
+
+    def object_table_snapshot(self) -> Tuple[Dict[ObjectId, Set[NodeId]],
+                                             Set[ObjectId]]:
+        """(directory, inline-object ids) merged over the shards — the
+        state-API view; not a hot path."""
+        directory: Dict[ObjectId, Set[NodeId]] = {}
+        inline: Set[ObjectId] = set()
+        for sh in self._oshards:
+            with sh.lock:
+                for oid, nids in sh.dir.items():
+                    directory[oid] = set(nids)
+                inline.update(sh.mem)
+        return directory, inline
+
     def _event(self, oid: ObjectId) -> threading.Event:
-        with self._lock:
-            ev = self._events.get(oid)
+        sh = self._oshard(oid)
+        with sh.lock:
+            ev = sh.events.get(oid)
             if ev is None:
-                ev = self._events[oid] = threading.Event()
+                ev = sh.events[oid] = threading.Event()
             return ev
 
     def _notify_object(self, oid: ObjectId) -> None:
@@ -560,17 +720,19 @@ class DriverRuntime:
         polling loop — SURVEY §6's 10k-concurrent-task envelope dies on
         N_waiters × 500 wakeups/s)."""
         self._event(oid).set()
-        with self._lock:
-            waiters = self._obj_waiters.pop(oid, None)
+        sh = self._oshard(oid)
+        with sh.lock:
+            waiters = sh.waiters.pop(oid, None)
         if waiters:
             for w in waiters:
                 w.set()
 
     def _object_available(self, oid: ObjectId) -> bool:
-        with self._lock:
-            if oid in self._memory_store:
+        sh = self._oshard(oid)
+        with sh.lock:
+            if oid in sh.mem:
                 return True
-            copies = self._directory.get(oid) or ()
+            copies = sh.dir.get(oid) or ()
             return any(
                 (n := self.nodes.get(nid)) is not None and n.alive
                 for nid in copies)
@@ -597,8 +759,9 @@ class DriverRuntime:
     def store_serialized(self, oid: ObjectId, sobj: serialization.SerializedObject,
                          node_id: Optional[NodeId] = None) -> None:
         if sobj.total_bytes <= self.config.max_direct_call_object_size:
-            with self._lock:
-                self._memory_store[oid] = sobj.to_bytes()
+            sh = self._oshard(oid)
+            with sh.lock:
+                sh.mem[oid] = sobj.to_bytes()
         else:
             node = self.nodes.get(node_id) if node_id else None
             if node is None:
@@ -607,33 +770,37 @@ class DriverRuntime:
                         "Cannot store a large object: cluster has no nodes yet")
                 node = self.nodes[self.head_node_id]
             node.store.put_serialized(oid, sobj, pin=True)
-            with self._lock:
-                self._directory.setdefault(oid, set()).add(node.node_id)
-                self._obj_sizes[oid] = sobj.total_bytes
+            sh = self._oshard(oid)
+            with sh.lock:
+                sh.dir.setdefault(oid, set()).add(node.node_id)
+                sh.sizes[oid] = sobj.total_bytes
         self._notify_object(oid)
 
     def store_inline_bytes(self, oid: ObjectId, data: bytes) -> None:
-        with self._lock:
-            self._memory_store[oid] = data
+        sh = self._oshard(oid)
+        with sh.lock:
+            sh.mem[oid] = data
         self._notify_object(oid)
 
     def on_object_sealed(self, oid: ObjectId, node_id: NodeId,
                          size: Optional[int] = None) -> None:
-        with self._lock:
-            self._directory.setdefault(oid, set()).add(node_id)
+        sh = self._oshard(oid)
+        with sh.lock:
+            sh.dir.setdefault(oid, set()).add(node_id)
             if size:
-                self._obj_sizes[oid] = int(size)
+                sh.sizes[oid] = int(size)
         self.refcount.add_owned(oid)
         self._notify_object(oid)
 
     def _free_object(self, oid: ObjectId) -> None:
-        with self._lock:
-            self._memory_store.pop(oid, None)
-            copies = self._directory.pop(oid, set())
-            self._events.pop(oid, None)
-            self._obj_sizes.pop(oid, None)
+        sh = self._oshard(oid)
+        with sh.lock:
+            sh.mem.pop(oid, None)
+            copies = sh.dir.pop(oid, set())
+            sh.events.pop(oid, None)
+            sh.sizes.pop(oid, None)
             nodes = [self.nodes.get(n) for n in copies]
-            nested = self._nested_refs.pop(oid, [])
+            nested = sh.nested.pop(oid, [])
         for node in nodes:
             if node is not None:
                 node.store.delete(oid)
@@ -660,9 +827,10 @@ class DriverRuntime:
             if not ev.wait(remaining):
                 raise exc.GetTimeoutError(
                     f"Get timed out waiting for object {oid.hex()[:12]}")
-            with self._lock:
-                data = self._memory_store.get(oid)
-                copies = list(self._directory.get(oid, ()))
+            sh = self._oshard(oid)
+            with sh.lock:
+                data = sh.mem.get(oid)
+                copies = list(sh.dir.get(oid, ()))
             if data is not None:
                 return ("inline", data)
             transient_failure = False
@@ -700,8 +868,8 @@ class DriverRuntime:
                         if seg is not None:
                             return ("shm", seg[0], seg[1])
                 # node dead, or store confirms the object is gone
-                with self._lock:
-                    d = self._directory.get(oid)
+                with sh.lock:
+                    d = sh.dir.get(oid)
                     if d is not None:
                         d.discard(nid)
             if transient_failure:
@@ -716,11 +884,12 @@ class DriverRuntime:
     def _pull_once(self, oid: ObjectId, node) -> Optional[Tuple]:
         """One chunked transfer per object however many getters: the first
         caller pulls, the rest wait on its Future."""
-        with self._lock:
-            fut = self._pull_futures.get(oid)
+        sh = self._oshard(oid)
+        with sh.lock:
+            fut = sh.pulls.get(oid)
             owner = fut is None
             if owner:
-                fut = self._pull_futures[oid] = Future()
+                fut = sh.pulls[oid] = Future()
         if not owner:
             # propagate the owner's outcome: None = definitively absent,
             # exception = transient failure (caller retries)
@@ -734,8 +903,8 @@ class DriverRuntime:
             fut.set_exception(e)
             raise
         finally:
-            with self._lock:
-                self._pull_futures.pop(oid, None)
+            with sh.lock:
+                sh.pulls.pop(oid, None)
 
     def _promote_pulled(self, oid: ObjectId, data: bytes) -> Tuple:
         """Store bytes pulled from a remote node into the head-local store
@@ -746,8 +915,9 @@ class DriverRuntime:
             try:
                 if not head.store.contains(oid):
                     head.store.put_bytes(oid, data, pin=True)
-                with self._lock:
-                    self._directory.setdefault(oid, set()).add(head.node_id)
+                sh = self._oshard(oid)
+                with sh.lock:
+                    sh.dir.setdefault(oid, set()).add(head.node_id)
                 seg = head.store.get_segment(oid)
                 if seg is not None:
                     return ("shm", seg[0], seg[1])
@@ -766,10 +936,12 @@ class DriverRuntime:
         if spec.task_type != TaskType.NORMAL_TASK:
             raise exc.ObjectLostError(
                 oid.hex(), "Only normal-task outputs can be reconstructed.")
-        with self._lock:
-            ev = self._events.get(oid)
+        sh = self._oshard(oid)
+        with sh.lock:
+            ev = sh.events.get(oid)
             if ev is not None:
                 ev.clear()
+        with self._lock:
             # single reconstruction per task, however many getters noticed
             if spec.task_id in self._recovering:
                 return
@@ -854,13 +1026,17 @@ class DriverRuntime:
             wake = threading.Event()
             registered: List[ObjectId] = []
             fired = False
-            with self._lock:
-                for r in pending:
-                    ev = self._events.get(r.id)
+            for r in pending:
+                sh = self._oshard(r.id)
+                with sh.lock:
+                    # per-oid atomicity is what matters: the event-set
+                    # check and waiter registration can't race THIS oid's
+                    # _notify_object
+                    ev = sh.events.get(r.id)
                     if ev is not None and ev.is_set():
                         fired = True  # raced a completion: re-scan now
                         break
-                    self._obj_waiters.setdefault(r.id, []).append(wake)
+                    sh.waiters.setdefault(r.id, []).append(wake)
                     registered.append(r.id)
             if not fired:
                 if on_block is not None:
@@ -869,16 +1045,17 @@ class DriverRuntime:
                 remaining = (None if deadline is None
                              else max(0.0, deadline - time.monotonic()))
                 wake.wait(remaining)
-            with self._lock:
-                for oid in registered:
-                    ws = self._obj_waiters.get(oid)
+            for oid in registered:
+                sh = self._oshard(oid)
+                with sh.lock:
+                    ws = sh.waiters.get(oid)
                     if ws is not None:
                         try:
                             ws.remove(wake)
                         except ValueError:
                             pass
                         if not ws:
-                            self._obj_waiters.pop(oid, None)
+                            sh.waiters.pop(oid, None)
         return ready, pending
 
     # ---- task submission -----------------------------------------------------
@@ -907,7 +1084,13 @@ class DriverRuntime:
             self._renv_cache[key] = cached
         return cached
 
-    def submit_spec(self, spec: TaskSpec) -> List[ObjectRef]:
+    def submit_spec(self, spec: TaskSpec, _count: bool = True) -> List[ObjectRef]:
+        if spec.task_type == TaskType.ACTOR_TASK and self._direct_enabled:
+            refs = self._submit_actor_direct(spec)
+            if refs is not None:
+                return refs
+        if _count:
+            _C_ROUTED.inc()
         self.task_manager.register(spec)
         # SUBMITTED opens the lifecycle phase chain (-> SCHEDULED ->
         # RUNNING -> FINISHED); the GCS derives phase histograms from it
@@ -1007,18 +1190,17 @@ class DriverRuntime:
         the same map from the ownership/locality data). Inline args are
         location-free and contribute nothing."""
         weights: Dict[NodeId, int] = {}
-        with self._lock:
-            for ref in spec.arg_refs():
-                oid = ref.id
-                nodes = self._directory.get(oid)
-                if not nodes:
-                    continue
+        for ref in spec.arg_refs():
+            oid = ref.id
+            sh = self._oshard(oid)
+            with sh.lock:
+                nodes = list(sh.dir.get(oid) or ())
                 # real sealed sizes tracked at seal/put time; unknown
                 # sizes weigh 1 MiB (big enough to beat emptiness, small
                 # enough not to drown real size info)
-                size = self._obj_sizes.get(oid) or (1 << 20)
-                for nid in nodes:
-                    weights[nid] = weights.get(nid, 0) + size
+                size = sh.sizes.get(oid) or (1 << 20)
+            for nid in nodes:
+                weights[nid] = weights.get(nid, 0) + size
         return weights
 
     def _reschedule_parked_tasks(self) -> None:
@@ -1195,11 +1377,11 @@ class DriverRuntime:
                 rids = spec.return_ids()
                 if borrowed and not isinstance(borrowed[0], list):
                     borrowed = [list(borrowed)]
-                with self._lock:
-                    for rid, nested in zip(rids, borrowed):
-                        if nested:
-                            self._nested_refs.setdefault(
-                                rid, []).extend(nested)
+                for rid, nested in zip(rids, borrowed):
+                    if nested:
+                        sh = self._oshard(rid)
+                        with sh.lock:
+                            sh.nested.setdefault(rid, []).extend(nested)
                 for nested in borrowed:
                     for oid in nested:
                         self.refcount.add_local(oid)
@@ -1301,6 +1483,14 @@ class DriverRuntime:
             rec.seq = 0  # fresh worker instance expects sequence from 0;
             # must happen BEFORE ALIVE is visible so no direct submission can
             # grab a sequence number that the flush below will reuse
+            # new placement epoch: direct callers' cached lanes are keyed
+            # by it (a restarted actor's fresh ActorQueue expects every
+            # lane from 0) and the peer channel must be re-established
+            rec.epoch += 1
+            rec.dseq = 0
+            rec.dlane = 0  # fresh ActorQueue: lane numbering starts over
+            rec.direct_chan = None
+            rec.direct_bad = 0.0
         self.gcs.set_actor_state(spec.actor_id, ActorState.ALIVE,
                                  node_id=node_id, worker_id=worker.worker_id)
         self._flush_actor_queue(spec.actor_id)
@@ -1332,7 +1522,14 @@ class DriverRuntime:
     def _on_actor_state(self, msg) -> None:
         actor_id, state = msg
         if state == ActorState.DEAD:
+            # direct in-flights first: their routed resubmission hits the
+            # DEAD record and surfaces the typed ActorDiedError
+            self._recover_direct_inflight(actor_id)
             self._drain_actor_queue_with_error(actor_id, "actor is dead")
+        elif state == ActorState.RESTARTING:
+            # re-queue un-answered direct calls through the head; they run
+            # on the new incarnation in head-lane order
+            self._recover_direct_inflight(actor_id)
 
     def _submit_actor_spec(self, spec: TaskSpec) -> None:
         rec = self._actors.get(spec.actor_id)
@@ -1436,6 +1633,227 @@ class DriverRuntime:
         for spec in queued:
             self._fail_task(spec, exc.ActorDiedError(
                 f"Actor {actor_id.hex()[:8]}: {cause}"))
+
+    # ---- direct dispatch (docs/DISPATCH.md) ----------------------------------
+    #
+    # Steady-state actor calls bypass the routed machinery: once the actor
+    # is ALIVE with no queued backlog, the driver numbers the call in its
+    # own lane (owner_id = driver worker id) and ships it straight to the
+    # owning worker — over the worker's own channel (local nodes: that
+    # channel already connects this process to the worker process) or a
+    # cached peer connection to the worker's direct socket (remote nodes).
+    # No task_manager entry, no per-call GCS events, no lease traffic; the
+    # worker replies with a direct_result frame and batches lifecycle
+    # events separately. Fallback on any failure is resubmission through
+    # the routed path, which owns the actor FSM / retry / typed-error
+    # semantics.
+
+    @staticmethod
+    def _direct_eligible(spec: TaskSpec) -> bool:
+        if spec.num_returns == STREAMING_RETURNS:
+            return False
+        # ref args would make the executing worker fetch through the head
+        # anyway, and need submit-time pinning the direct path skips
+        for a in spec.args:
+            if a[0] == ARG_REF:
+                return False
+        for a in spec.kwargs.values():
+            if a[0] == ARG_REF:
+                return False
+        return True
+
+    def _submit_actor_direct(self, spec: TaskSpec) -> Optional[List[ObjectRef]]:
+        if not self._direct_eligible(spec):
+            return None
+        rec = self._actors.get(spec.actor_id)
+        if rec is None:
+            return None
+        with rec.lock:
+            if rec.worker is None or rec.queued:
+                return None
+            info = self.gcs.get_actor(spec.actor_id)
+            if info is None or info.state != ActorState.ALIVE:
+                return None
+            node = self.nodes.get(rec.node_id)
+            if node is None or not node.alive:
+                return None
+            worker = rec.worker
+            if not getattr(node, "is_remote", False):
+                chan = worker.channel
+                if chan is None or chan.closed:
+                    return None
+            else:
+                chan = rec.direct_chan
+                if chan is None or chan.closed:
+                    if rec.direct_bad > time.monotonic() \
+                            or not worker.direct_addr:
+                        return None
+                    from .rpc import connect as _rpc_connect
+
+                    try:
+                        # same-host agents expose the worker's unix socket;
+                        # an unreachable path (true cross-host, or a
+                        # transiently refused connect) stays routed for the
+                        # negative-cache window, then retries
+                        chan = _rpc_connect(worker.direct_addr,
+                                            handler=self._direct_peer_handler,
+                                            name="dpeer")
+                    except Exception:
+                        rec.direct_bad = time.monotonic() + 5.0
+                        return None
+                    chan.on_close(
+                        lambda aid=spec.actor_id, ch=chan:
+                        self._on_direct_peer_close(aid, ch))
+                    rec.direct_chan = chan
+                    # new connection era: seq numbering restarts with it
+                    # (frames lost in the old socket would otherwise leave
+                    # the worker lane's expected counter behind forever)
+                    rec.dlane += 1
+                    rec.dseq = 0
+            spec.owner_id = self.worker_id
+            spec.seq_no = rec.dseq
+            rec.dseq += 1
+            # gate: the worker runs this lane only after dispatching every
+            # head-routed task numbered below rec.seq — my earlier routed
+            # calls all are, so per-caller FIFO survives the transition
+            gate = rec.seq
+            era = rec.dlane
+            rec.direct_inflight[spec.task_id] = spec
+        for oid in spec.return_ids():
+            self.refcount.add_owned(oid)
+        refs = [self.make_ref(oid) for oid in spec.return_ids()]
+        chan.notify("direct_submit", {"spec": spec, "gate": gate,
+                                      "lane": era})
+        _C_DIRECT.inc()
+        if chan.closed:
+            # raced the worker's death: the notify may be lost — recover
+            # now (idempotent; results that did land are respected)
+            self._recover_direct_inflight(spec.actor_id)
+        return refs
+
+    def _direct_peer_handler(self, method: str, payload):
+        if method == "direct_result":
+            self.on_direct_result(payload)
+            return None
+        raise ValueError(f"unknown direct peer message {method}")
+
+    def _on_direct_peer_close(self, actor_id: ActorId, chan=None) -> None:
+        rec = self._actors.get(actor_id)
+        if rec is None:
+            return
+        with rec.lock:
+            # a late close callback must not clobber a channel that was
+            # already re-established; recovery still runs (idempotent —
+            # results that landed are respected, the rest resubmit routed)
+            if chan is None or rec.direct_chan is chan:
+                rec.direct_chan = None
+        self._recover_direct_inflight(actor_id)
+
+    def on_direct_result(self, payload: dict) -> None:
+        """A worker finished one of this driver's direct calls: results
+        land straight in the driver's store — no refcount pins, no
+        task_manager entry to retire, no per-call GCS event."""
+        rec = self._actors.get(payload.get("actor_id"))
+        if rec is None:
+            return
+        with rec.lock:
+            spec = rec.direct_inflight.pop(payload["task_id"], None)
+        if spec is None:
+            return
+        if payload.get("stale"):
+            # the socket now belongs to a process not hosting this actor:
+            # drop the cache and re-route through the head (the next
+            # placement epoch resets the deadline early)
+            with rec.lock:
+                rec.direct_bad = time.monotonic() + 5.0
+                rec.direct_chan = None
+            self._resubmit_direct(spec)
+            return
+        error = payload.get("error")
+        if error is not None:
+            for oid in spec.return_ids():
+                self.store_inline_bytes(oid, error)
+            return
+        for oid, res in zip(spec.return_ids(), payload.get("results") or []):
+            if res[0] == "inline":
+                self.store_inline_bytes(oid, res[1])
+            # ("stored", None): sealed into a store / shipped via
+            # direct_result_stored — registered at seal time
+
+    def _recover_direct_inflight(self, actor_id: ActorId) -> None:
+        """Peer/worker failure or actor restart: every un-answered direct
+        call re-enters the routed path, which applies the actor FSM's
+        semantics (queue for restart, or typed ActorDiedError)."""
+        rec = self._actors.get(actor_id)
+        if rec is None:
+            return
+        with rec.lock:
+            inflight = sorted(rec.direct_inflight.values(),
+                              key=lambda s: s.seq_no)
+            rec.direct_inflight.clear()
+        for spec in inflight:
+            self._resubmit_direct(spec)
+
+    def _resubmit_direct(self, spec: TaskSpec) -> None:
+        import copy
+
+        if spec.num_returns > 0 and all(
+                self._object_available(oid) for oid in spec.return_ids()):
+            return  # the result landed before the failure was noticed
+        # Routed-path retry semantics: a direct task in flight when its
+        # worker died is "crashed while running" — it re-runs only with a
+        # retry budget (max_task_retries), else fails typed. Re-running
+        # unconditionally would replay a crash-causing call into the
+        # restarted incarnation and burn its restart budget. If the actor
+        # is still ALIVE (a dropped peer connection, not a death), the
+        # call may simply have been lost — resubmit regardless.
+        info = self.gcs.get_actor(spec.actor_id)
+        alive = info is not None and info.state == ActorState.ALIVE
+        if not alive and spec.max_retries == 0:
+            self._fail_task(spec, exc.ActorDiedError(
+                f"Actor {spec.actor_id.hex()[:8]} died while running "
+                f"{spec.description}"))
+            return
+        # copy before mutating: the original direct frame may still sit in
+        # an outbox, and a late encode must not see head-lane fields
+        spec = copy.copy(spec)
+        spec.owner_id = None  # back to the head-routed lane
+        spec.seq_no = 0
+        _C_ROUTED.inc()
+        self.task_manager.register(spec)
+        self._submit_actor_spec(spec)
+
+    def resolve_actor(self, actor_id: ActorId) -> Optional[dict]:
+        """Placement lookup for a direct caller (worker): returns the
+        owning worker's direct address + the epoch stamp callers key
+        their lane state by + the head-lane gate. None = not directly
+        reachable right now (not ALIVE, queued backlog, or no direct
+        socket) — the caller stays routed and may re-resolve later."""
+        if not self._direct_enabled:
+            return None
+        rec = self._actors.get(actor_id)
+        if rec is None:
+            return None
+        with rec.lock:
+            info = self.gcs.get_actor(actor_id)
+            if info is None or info.state != ActorState.ALIVE:
+                return None
+            if rec.worker is None or rec.queued:
+                return None
+            addr = rec.worker.direct_addr
+            if not addr:
+                return None
+            return {"addr": addr, "worker_id": rec.worker.worker_id,
+                    "node_id": rec.node_id, "epoch": rec.epoch,
+                    "gate": rec.seq}
+
+    def ensure_published(self, oid: ObjectId) -> None:
+        """Driver direct results land in the store at arrival — nothing
+        to publish (the WorkerRuntime override is the real one)."""
+
+    def dispatch_stats(self) -> dict:
+        d, r = dispatch_counts()
+        return {"direct": d, "routed": r}
 
     def kill_actor(self, actor_id: ActorId, no_restart: bool = True) -> None:
         info = self.gcs.get_actor(actor_id)
@@ -1677,8 +2095,9 @@ class DriverRuntime:
                 self.store_inline_bytes(oid, data)
             else:
                 head.store.put_bytes(oid, data, pin=True)
-                with self._lock:
-                    self._directory.setdefault(oid, set()).add(head.node_id)
+                sh = self._oshard(oid)
+                with sh.lock:
+                    sh.dir.setdefault(oid, set()).add(head.node_id)
                 self._notify_object(oid)
             self.refcount.add_owned(oid)
             self.refcount.add_holder_ref(oid, client.worker_id)
@@ -1866,7 +2285,9 @@ class DriverRuntime:
         if method == "get_function":
             return self.get_function_blob(payload)
         if method == "submit_task":
-            refs = self.submit_spec(payload)
+            # the submitting process already counted this task in its own
+            # direct/routed split
+            refs = self.submit_spec(payload, _count=False)
             if worker is not None:
                 # count the submitting worker as holder of the return refs;
                 # the transient driver-side refs created by submit_spec are
@@ -2008,6 +2429,32 @@ class DriverRuntime:
         if method == "cgraph_send":
             # compiled-graph cross-node edge: producer -> head -> consumer
             return self._cgraph_route(payload)
+        if method == "resolve_actor":
+            # direct dispatch: a worker asks where an actor lives (once
+            # per caller x actor x epoch — NOT per call)
+            return self.resolve_actor(payload)
+        if method == "direct_result_stored":
+            # a direct result whose value contains ObjectRefs (or is
+            # large): it must live in the head's store so the borrower
+            # pins (_nested_refs) protect the nested objects exactly as
+            # the routed path does
+            oid = payload["object_id"]
+            nested = payload.get("borrowed") or []
+            if nested:
+                sh = self._oshard(oid)
+                with sh.lock:
+                    sh.nested.setdefault(oid, []).extend(nested)
+                for n in nested:
+                    self.refcount.add_local(n)
+            self.store_inline_bytes(oid, payload["data"])
+            self.refcount.add_owned(oid)
+            return True
+        if method == "task_events_batch":
+            # batched lifecycle events for direct-path tasks: the head
+            # learns of completions in one message per interval instead
+            # of per-call GCS traffic
+            self.gcs.add_task_events(payload or [])
+            return None
         raise ValueError(f"unknown worker call: {method}")
 
     # ---- compiled graphs (ray_tpu/cgraph) ------------------------------------
@@ -2108,6 +2555,13 @@ class DriverRuntime:
                 dag.teardown()  # release channel segments + stop loops
             except Exception:
                 pass
+        for rec in list(self._actors.values()):
+            chan = rec.direct_chan
+            if chan is not None:
+                try:
+                    chan.close()
+                except Exception:
+                    pass
         with self._pg_cv:
             self._pg_cv.notify()
         for node in list(self.nodes.values()):
@@ -2148,6 +2602,394 @@ class _ClientShell:
         self.blocked_depth = 0
 
 
+class _WorkerDirectState:
+    """Worker-side half of decentralized dispatch (docs/DISPATCH.md).
+
+    A worker calling ``handle.method.remote()`` resolves the actor's
+    placement ONCE through the head, then submits every subsequent call
+    straight to the owning worker over a cached peer connection — zero
+    head RPCs in steady state. Results come back inline on the peer
+    channel and are resolved from a local table; refs that ESCAPE this
+    process (task args, values put/returned containing them) are first
+    published to the head so the rest of the cluster can see them. Any
+    peer failure falls back to the routed path."""
+
+    def __init__(self, wr: "WorkerRuntime"):
+        self.wr = wr
+        self._lock = instrumented_lock("worker.direct")
+        self._actors: Dict[ActorId, dict] = {}   # actor -> cache entry
+        self._peers: Dict[str, Any] = {}         # addr -> RpcChannel
+        self._rows: Dict[ObjectId, dict] = {}    # return oid -> row
+        self._tasks: Dict[TaskId, dict] = {}     # task_id -> task row
+
+    # -- submission -----------------------------------------------------------
+
+    def try_submit(self, spec: TaskSpec) -> Optional[List[ObjectRef]]:
+        if not DriverRuntime._direct_eligible(spec):
+            return None
+        entry = self._entry_for(spec.actor_id)
+        if entry is None:
+            return None
+        chan = entry["chan"]
+        ev = threading.Event()
+        trow = {"spec": spec, "event": ev, "done": False, "chan": chan,
+                "actor_id": spec.actor_id}
+        with self._lock:
+            if not entry.get("ok"):
+                return None
+            spec.owner_id = self.wr.worker_id
+            spec.seq_no = entry["seq"]
+            entry["seq"] += 1
+            gate, era = entry["gate"], entry["lane"]
+            self._tasks[spec.task_id] = trow
+            for oid in spec.return_ids():
+                self._rows[oid] = {"state": "pending", "data": None,
+                                   "trow": trow, "head_ref": False}
+        chan.notify("direct_submit", {"spec": spec, "gate": gate,
+                                      "lane": era})
+        _C_DIRECT.inc()
+        if chan.closed:
+            # raced the peer's death: on_close may have swept before our
+            # rows registered — run the fallback for this task explicitly
+            self._fallback_task(trow)
+        refs = []
+        for oid in spec.return_ids():
+            ref = ObjectRef(oid)
+            weakref.finalize(ref, self._drop, oid)
+            refs.append(ref)
+        return refs
+
+    def _entry_for(self, actor_id: ActorId) -> Optional[dict]:
+        with self._lock:
+            entry = self._actors.get(actor_id)
+            if entry is not None and entry.get("ok") \
+                    and not entry["chan"].closed \
+                    and not entry.get("stale_gate"):
+                return entry
+            if entry is not None and entry.get("bad_until", 0) \
+                    > time.monotonic():
+                return None  # negative cache: don't pay a resolve RPC
+                # per call while the actor stays routed-only
+        try:
+            res = self.wr.channel.call("resolve_actor", actor_id, timeout=30)
+        except Exception:
+            res = None
+        with self._lock:
+            old = self._actors.get(actor_id)
+            if res is None or not res.get("addr"):
+                self._actors[actor_id] = {
+                    "ok": False, "bad_until": time.monotonic() + 0.5,
+                    "seq": (old or {}).get("seq", 0),
+                    "lane": (old or {}).get("lane", 0),
+                    "chan": (old or {}).get("chan"),
+                    "epoch": (old or {}).get("epoch", -1)}
+                return None
+        chan = self._peer(res["addr"])
+        if chan is None:
+            with self._lock:
+                old = self._actors.get(actor_id) or {}
+                self._actors[actor_id] = {
+                    "ok": False, "bad_until": time.monotonic() + 5.0,
+                    "seq": 0, "lane": old.get("lane", 0),
+                    "epoch": res["epoch"]}
+            return None
+        with self._lock:
+            old = self._actors.get(actor_id) or {}
+            # same epoch over the SAME live connection: the worker's lane
+            # for this caller survives — seq continues (a restart would
+            # collide with frames already buffered there). A new channel
+            # is a new era: frames lost in the old socket would strand
+            # the receiver's expected counter, so bump the lane and
+            # restart seq (the receiver resets on a higher era).
+            same = (old.get("epoch") == res["epoch"]
+                    and old.get("chan") is chan)
+            entry = {"ok": True, "addr": res["addr"], "chan": chan,
+                     "epoch": res["epoch"], "gate": res["gate"],
+                     "actor_id": actor_id,
+                     "lane": old.get("lane", 0) + (0 if same else 1),
+                     "seq": old.get("seq", 0) if same else 0}
+            self._actors[actor_id] = entry
+            return entry
+
+    def note_routed(self, actor_id: Optional[ActorId]) -> None:
+        """A routed actor submission happened (streaming / ref args): the
+        cached gate no longer covers it — force a re-resolve (fresh gate,
+        same lane) before the next direct call so per-caller FIFO holds."""
+        if actor_id is None:
+            return
+        with self._lock:
+            entry = self._actors.get(actor_id)
+            if entry is not None and entry.get("ok"):
+                entry["stale_gate"] = True
+
+    def _peer(self, addr: str):
+        with self._lock:
+            ch = self._peers.get(addr)
+            if ch is not None and not ch.closed:
+                return ch
+        from .rpc import connect as _rpc_connect
+
+        try:
+            ch = _rpc_connect(addr, handler=self._peer_handler, name="dpeer")
+        except Exception:
+            return None
+        ch.on_close(lambda a=addr: self._on_peer_close(a))
+        with self._lock:
+            old = self._peers.get(addr)
+            if old is not None and not old.closed:
+                ch.close()
+                return old
+            self._peers[addr] = ch
+        return ch
+
+    def _peer_handler(self, method: str, payload):
+        if method == "direct_result":
+            self.on_direct_result(payload)
+            return None
+        raise ValueError(f"unknown direct peer message {method}")
+
+    # -- results --------------------------------------------------------------
+
+    def on_direct_result(self, payload: dict) -> None:
+        with self._lock:
+            trow = self._tasks.pop(payload["task_id"], None)
+            if trow is None or trow["done"]:
+                return
+            trow["done"] = True
+            spec = trow["spec"]
+            if payload.get("stale"):
+                entry = self._actors.get(spec.actor_id)
+                if entry is not None:
+                    entry["ok"] = False
+                stale = True
+            else:
+                stale = False
+                error = payload.get("error")
+                rids = spec.return_ids()
+                results = payload.get("results") or []
+                add_refs = []
+                for i, oid in enumerate(rids):
+                    row = self._rows.get(oid)
+                    if row is None:
+                        continue
+                    if error is not None:
+                        row["state"] = "error"
+                        row["data"] = error
+                    elif i < len(results) and results[i][0] == "inline":
+                        row["state"] = "done"
+                        row["data"] = results[i][1]
+                    else:
+                        # ("stored"): the head's store owns it — count
+                        # this process as holder for the ref's lifetime
+                        row["state"] = "stored"
+                        row["head_ref"] = True
+                        add_refs.append(oid)
+        if stale:
+            self._fallback_task(trow)
+            return
+        for oid in add_refs:
+            try:
+                self.wr.channel.notify("add_ref", oid)
+            except Exception:
+                pass
+        trow["event"].set()
+
+    def _on_peer_close(self, addr: str) -> None:
+        with self._lock:
+            self._peers.pop(addr, None)
+            victims = [t for t in self._tasks.values()
+                       if not t["done"] and t["chan"].closed]
+            for e in self._actors.values():
+                if e.get("ok") and e.get("addr") == addr:
+                    e["ok"] = False
+        for trow in sorted(victims, key=lambda t: t["spec"].seq_no):
+            self._fallback_task(trow)
+
+    def _fallback_task(self, trow: dict) -> None:
+        """Peer died / stale placement: resubmit through the head, which
+        owns restart/death semantics. Idempotent per task. Mirrors the
+        driver's retry rule: a task whose worker died re-runs only with a
+        retry budget (or when the actor is in fact still ALIVE — lost
+        connection, not a death); otherwise it fails typed."""
+        with self._lock:
+            if trow.get("routed"):
+                return
+            if not trow["done"]:
+                self._tasks.pop(trow["spec"].task_id, None)
+                trow["done"] = True
+            trow["routed"] = True
+            spec = trow["spec"]
+            rows = [self._rows.get(oid) for oid in spec.return_ids()]
+        if spec.max_retries == 0:
+            try:
+                alive = self.wr.channel.call(
+                    "actor_state", spec.actor_id, timeout=30) == "ALIVE"
+            except Exception:
+                alive = False
+            if not alive:
+                blob = serialization.dumps(exc.ActorDiedError(
+                    f"Actor {spec.actor_id.hex()[:8]} died while running "
+                    f"{spec.description}"))
+                with self._lock:
+                    for row in rows:
+                        if row is not None and row["state"] == "pending":
+                            row["state"] = "error"
+                            row["data"] = blob
+                trow["event"].set()
+                return
+        import copy
+
+        spec = copy.copy(spec)  # the direct frame may still be queued
+        spec.owner_id = None
+        spec.seq_no = 0
+        _C_ROUTED.inc()
+        try:
+            self.wr.channel.call("submit_task", spec)
+        except Exception:
+            # head unreachable too: the worker is dying; leave rows
+            # pending — getters time out
+            return
+        with self._lock:
+            for row in rows:
+                if row is not None and row["state"] == "pending":
+                    # the head now counts this worker as holder of the
+                    # return refs (submit_task handler)
+                    row["state"] = "routed"
+                    row["head_ref"] = True
+        trow["event"].set()
+
+    def _drop(self, oid: ObjectId) -> None:
+        with self._lock:
+            row = self._rows.pop(oid, None)
+        if row is not None and row.get("head_ref"):
+            try:
+                self.wr.channel.notify("remove_ref", oid)
+            except Exception:
+                pass
+
+    # -- resolution into the get/wait planes ----------------------------------
+
+    def involves(self, oids) -> bool:
+        with self._lock:
+            return any(o in self._rows for o in oids)
+
+    def ensure_published(self, oid: ObjectId) -> None:
+        """This ref is escaping the process (task arg, nested in a put or
+        a return): the head must own a copy first, or the consumer's
+        fetch would hang on an object only this process knows about.
+        Blocks until the direct result arrives if it is still in flight."""
+        with self._lock:
+            row = self._rows.get(oid)
+            if row is None or row.get("published") or row.get("head_ref"):
+                return
+            trow = row["trow"]
+        trow["event"].wait(300)
+        with self._lock:
+            if row.get("published") or row["state"] not in ("done", "error"):
+                return  # stored/routed rows already live head-side;
+                # error blobs publish too (the consumer must see the
+                # typed failure, not hang)
+            data = row["data"]
+            row["published"] = True
+            row["head_ref"] = True
+        try:
+            self.wr.channel.call("put_inline", {"object_id": oid,
+                                                "data": data})
+        except Exception:
+            pass
+
+    def get_many(self, oids: List[ObjectId], timeout: Optional[float]):
+        """Resolve direct-result oids locally; delegate the rest to the
+        head. Returns fetch-result tuples aligned with oids (the caller
+        deserializes)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out: Dict[int, Tuple] = {}
+        head_ids: List[Tuple[int, ObjectId]] = []
+        for i, oid in enumerate(oids):
+            with self._lock:
+                row = self._rows.get(oid)
+            if row is None:
+                head_ids.append((i, oid))
+                continue
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            if not row["trow"]["event"].wait(remaining):
+                raise exc.GetTimeoutError(
+                    f"Get timed out waiting for object {oid.hex()[:12]}")
+            with self._lock:
+                state, data = row["state"], row["data"]
+            if state in ("done", "error"):
+                out[i] = ("inline", data)
+            else:  # stored / routed / pending-after-fallback: head-side
+                head_ids.append((i, oid))
+        if head_ids:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            fetched = self.wr.channel.call(
+                "get_objects", {"ids": [o for _, o in head_ids],
+                                "timeout": remaining}, timeout=None)
+            for (i, _), res in zip(head_ids, fetched):
+                out[i] = res
+        return [out[i] for i in range(len(oids))]
+
+    def wait(self, refs, num_returns: int, timeout: Optional[float]):
+        """wait() over a mix of local direct results and head-side refs."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ready, pending = [], []
+            head_pending = []
+            for r in refs:
+                with self._lock:
+                    row = self._rows.get(r.id)
+                if row is None or row["state"] in ("stored", "routed"):
+                    head_pending.append(r)
+                    pending.append(r)
+                elif row["trow"]["event"].is_set():
+                    ready.append(r)
+                else:
+                    pending.append(r)
+            if len(ready) >= num_returns or not pending:
+                return ready[:num_returns], \
+                    [r for r in refs if r not in ready[:num_returns]]
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                return ready, pending
+            if head_pending:
+                if len(head_pending) < len(pending):
+                    # mixed wait: short head slices so a local direct
+                    # result firing mid-wait can still cut it short
+                    slice_t = 0.1 if remaining is None \
+                        else max(0.0, min(0.1, remaining))
+                else:
+                    # every pending ref is head-side: nothing local can
+                    # change, so ONE blocking call with the full budget
+                    # (the head's wait is event-driven) — not a 100 ms
+                    # poll loop multiplying head traffic per waiter
+                    slice_t = remaining
+                ready_ids, _ = self.wr.channel.call(
+                    "wait", {"ids": [r.id for r in head_pending],
+                             "num_returns": min(num_returns - len(ready),
+                                                len(head_pending)),
+                             "timeout": slice_t}, timeout=None)
+                ready_set = set(ready_ids)
+                newly = [r for r in head_pending if r.id in ready_set]
+                if newly:
+                    ready.extend(newly)
+                    if len(ready) >= num_returns:
+                        return ready[:num_returns], \
+                            [r for r in refs if r not in ready[:num_returns]]
+            else:
+                # purely local: park on the first pending event briefly
+                first = next((r for r in pending), None)
+                with self._lock:
+                    row = self._rows.get(first.id) if first else None
+                if row is not None:
+                    slice_t = 0.1 if remaining is None \
+                        else max(0.0, min(0.1, remaining))
+                    row["trow"]["event"].wait(slice_t)
+
+
 class WorkerRuntime:
     """Thin runtime inside worker processes: proxies the core API over the
     node channel (the analog of _raylet.pyx calling into CoreWorker)."""
@@ -2166,6 +3008,10 @@ class WorkerRuntime:
         self.worker_id = worker_process.worker_id
         self._held_lock = instrumented_lock("worker.held_refs")
         self._held: Dict[ObjectId, int] = {}
+        from .config import DEFAULT as _cfg
+
+        self._direct = (_WorkerDirectState(self)
+                        if int(_cfg.direct_actor_calls) else None)
 
     # -- worker-held reference accounting (ref: reference_count.h:61 borrower
     # reports; the head aggregates per-holder counts and frees only when all
@@ -2231,6 +3077,9 @@ class WorkerRuntime:
 
         oid = self.next_put_id()
         sobj = serialization.serialize(value)
+        for r in sobj.contained_refs:
+            # direct results nested in a put value escape this process
+            self.ensure_published(r.id)
         if sobj.total_bytes <= cfg.max_direct_call_object_size:
             self.channel.call("put_inline", {"object_id": oid,
                                              "data": sobj.to_bytes()})
@@ -2253,9 +3102,12 @@ class WorkerRuntime:
     def get_many(self, oids: List[ObjectId], timeout: Optional[float] = None):
         t0 = time.perf_counter()
         try:
-            results = self.channel.call("get_objects",
-                                        {"ids": oids, "timeout": timeout},
-                                        timeout=None)
+            if self._direct is not None and self._direct.involves(oids):
+                results = self._direct.get_many(oids, timeout)
+            else:
+                results = self.channel.call("get_objects",
+                                            {"ids": oids, "timeout": timeout},
+                                            timeout=None)
         finally:
             # worker-local registry: ships to the head node/worker-tagged
             _H_GET_WAIT.observe(time.perf_counter() - t0)
@@ -2263,6 +3115,19 @@ class WorkerRuntime:
         for res in results:
             out.append(self._deserialize(res))
         return out
+
+    def on_direct_result(self, payload: dict) -> None:
+        """direct_result frames arriving on the NODE channel (a peer that
+        replied through it) route here from WorkerProcess.handle_direct."""
+        if self._direct is not None:
+            self._direct.on_direct_result(payload)
+
+    def ensure_published(self, oid: ObjectId) -> None:
+        """A ref is escaping this process (task arg / nested in a put or
+        return): make sure the head owns the object first. No-op for
+        anything that isn't a locally-held direct result."""
+        if self._direct is not None:
+            self._direct.ensure_published(oid)
 
     def _deserialize(self, res):
         if res[0] == "inline":
@@ -2293,6 +3158,9 @@ class WorkerRuntime:
         return loop.run_in_executor(None, lambda: self.get(ref))
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        if self._direct is not None \
+                and self._direct.involves([r.id for r in refs]):
+            return self._direct.wait(refs, num_returns, timeout)
         ready_ids, pending_ids = self.channel.call(
             "wait", {"ids": [r.id for r in refs], "num_returns": num_returns,
                      "timeout": timeout}, timeout=None)
@@ -2317,6 +3185,14 @@ class WorkerRuntime:
         return TaskId.from_random()
 
     def submit_spec(self, spec: TaskSpec) -> List[ObjectRef]:
+        if spec.task_type == TaskType.ACTOR_TASK and self._direct is not None:
+            refs = self._direct.try_submit(spec)
+            if refs is not None:
+                return refs
+            # routed actor call (streaming / ref args / not resolvable):
+            # the cached direct gate no longer covers it
+            self._direct.note_routed(spec.actor_id)
+        _C_ROUTED.inc()
         refs = [ObjectRef(oid) for oid in spec.return_ids()]
         self.channel.call("submit_task", spec)
         # the head counted this worker as holder of each return ref during

@@ -107,68 +107,95 @@ class TaskManager:
             return len(self._pending)
 
 
+class _RefShard:
+    """One shard of the reference counter: its own lock + the per-object
+    tables for the object ids hashing here."""
+
+    __slots__ = ("lock", "local", "task_pins", "holders", "owned",
+                 "dead_holders")
+
+    def __init__(self, index: int):
+        self.lock = instrumented_lock(f"refcounter.s{index}")
+        self.local: Dict[ObjectId, int] = {}
+        self.task_pins: Dict[ObjectId, int] = {}
+        self.holders: Dict[ObjectId, Dict[object, int]] = {}
+        self.owned: Set[ObjectId] = set()
+        # holders whose process has died: a late add_holder_ref (a relayed
+        # call racing the exit notification) must not resurrect a count
+        # nothing will ever decrement. WorkerIds are never reused, so the
+        # set only grows by one entry per worker lifetime (per shard).
+        self.dead_holders: Set[object] = set()
+
+
 class ReferenceCounter:
-    """Aggregated reference counts per object.
+    """Aggregated reference counts per object, SHARDED by object id.
 
     Counts: python-local references in the driver, per-HOLDER references
     reported by worker processes (a holder is a WorkerId; all of a dead
     worker's refs are dropped in one sweep — the single-controller
     reduction of the reference's borrower protocol), plus pins from
     pending task arguments. An object is freeable only when all three
-    reach zero. (ref: reference_count.h:61)"""
+    reach zero. (ref: reference_count.h:61)
 
-    def __init__(self, on_free: Callable[[ObjectId], None]):
-        self._lock = instrumented_lock("refcounter")
-        self._local: Dict[ObjectId, int] = {}
-        self._task_pins: Dict[ObjectId, int] = {}
-        self._holders: Dict[ObjectId, Dict[object, int]] = {}
-        # holders whose process has died: a late add_holder_ref (a relayed
-        # call racing the exit notification) must not resurrect a count
-        # nothing will ever decrement. WorkerIds are never reused, so the
-        # set only grows by one entry per worker lifetime.
-        self._dead_holders: Set[object] = set()
-        self._owned: Set[ObjectId] = set()
+    Sharding (docs/DISPATCH.md): every operation touches exactly one
+    object id, so the tables split into N independent lock+dict shards —
+    submit bursts from many clients stop serializing on one refcount
+    lock. Only release_holder (a worker died) sweeps all shards."""
+
+    def __init__(self, on_free: Callable[[ObjectId], None],
+                 shards: int = 16):
+        self._shards = [_RefShard(i) for i in range(max(1, int(shards)))]
+        self._n = len(self._shards)
         self._on_free = on_free
 
-    def add_owned(self, object_id: ObjectId) -> None:
-        with self._lock:
-            self._owned.add(object_id)
+    def _shard(self, object_id: ObjectId) -> _RefShard:
+        return self._shards[hash(object_id) % self._n]
 
-    def _freeable_locked(self, object_id: ObjectId) -> bool:
-        return (object_id not in self._local
-                and object_id not in self._task_pins
-                and object_id not in self._holders
-                and object_id in self._owned)
+    @staticmethod
+    def _freeable_locked(s: _RefShard, object_id: ObjectId) -> bool:
+        return (object_id not in s.local
+                and object_id not in s.task_pins
+                and object_id not in s.holders
+                and object_id in s.owned)
+
+    def add_owned(self, object_id: ObjectId) -> None:
+        s = self._shard(object_id)
+        with s.lock:
+            s.owned.add(object_id)
 
     def add_local(self, object_id: ObjectId, n: int = 1) -> None:
-        with self._lock:
-            self._local[object_id] = self._local.get(object_id, 0) + n
+        s = self._shard(object_id)
+        with s.lock:
+            s.local[object_id] = s.local.get(object_id, 0) + n
 
     def remove_local(self, object_id: ObjectId, n: int = 1) -> None:
+        s = self._shard(object_id)
         free = False
-        with self._lock:
-            c = self._local.get(object_id, 0) - n
+        with s.lock:
+            c = s.local.get(object_id, 0) - n
             if c <= 0:
-                self._local.pop(object_id, None)
-                free = self._freeable_locked(object_id)
+                s.local.pop(object_id, None)
+                free = self._freeable_locked(s, object_id)
             else:
-                self._local[object_id] = c
+                s.local[object_id] = c
         if free:
             self._on_free(object_id)
 
     def add_holder_ref(self, object_id: ObjectId, holder, n: int = 1) -> None:
         """A worker process holds (another) reference to the object."""
-        with self._lock:
-            if holder in self._dead_holders:
+        s = self._shard(object_id)
+        with s.lock:
+            if holder in s.dead_holders:
                 return
-            h = self._holders.setdefault(object_id, {})
+            h = s.holders.setdefault(object_id, {})
             h[holder] = h.get(holder, 0) + n
 
     def remove_holder_ref(self, object_id: ObjectId, holder,
                           n: int = 1) -> None:
+        s = self._shard(object_id)
         free = False
-        with self._lock:
-            h = self._holders.get(object_id)
+        with s.lock:
+            h = s.holders.get(object_id)
             if h is None:
                 return
             c = h.get(holder, 0) - n
@@ -177,54 +204,59 @@ class ReferenceCounter:
             else:
                 h[holder] = c
             if not h:
-                self._holders.pop(object_id, None)
-                free = self._freeable_locked(object_id)
+                s.holders.pop(object_id, None)
+                free = self._freeable_locked(s, object_id)
         if free:
             self._on_free(object_id)
 
     def release_holder(self, holder) -> None:
-        """Drop every reference a (dead) worker held."""
+        """Drop every reference a (dead) worker held (all shards)."""
         to_free = []
-        with self._lock:
-            self._dead_holders.add(holder)
-            for oid in list(self._holders):
-                h = self._holders[oid]
-                if holder in h:
-                    h.pop(holder, None)
-                    if not h:
-                        self._holders.pop(oid, None)
-                        if self._freeable_locked(oid):
-                            to_free.append(oid)
+        for s in self._shards:
+            with s.lock:
+                s.dead_holders.add(holder)
+                for oid in list(s.holders):
+                    h = s.holders[oid]
+                    if holder in h:
+                        h.pop(holder, None)
+                        if not h:
+                            s.holders.pop(oid, None)
+                            if self._freeable_locked(s, oid):
+                                to_free.append(oid)
         for oid in to_free:
             self._on_free(oid)
 
     def pin_for_task(self, object_id: ObjectId) -> None:
-        with self._lock:
-            self._task_pins[object_id] = self._task_pins.get(object_id, 0) + 1
+        s = self._shard(object_id)
+        with s.lock:
+            s.task_pins[object_id] = s.task_pins.get(object_id, 0) + 1
 
     def unpin_for_task(self, object_id: ObjectId) -> None:
+        s = self._shard(object_id)
         free = False
-        with self._lock:
-            c = self._task_pins.get(object_id, 0) - 1
+        with s.lock:
+            c = s.task_pins.get(object_id, 0) - 1
             if c <= 0:
-                self._task_pins.pop(object_id, None)
-                free = self._freeable_locked(object_id)
+                s.task_pins.pop(object_id, None)
+                free = self._freeable_locked(s, object_id)
             else:
-                self._task_pins[object_id] = c
+                s.task_pins[object_id] = c
         if free:
             self._on_free(object_id)
 
     def forget(self, object_id: ObjectId) -> None:
-        """Freed object: drop residual bookkeeping (the _owned marker and
+        """Freed object: drop residual bookkeeping (the owned marker and
         any stale per-holder rows) so long sessions don't accumulate ids."""
-        with self._lock:
-            self._owned.discard(object_id)
-            self._holders.pop(object_id, None)
-            self._local.pop(object_id, None)
-            self._task_pins.pop(object_id, None)
+        s = self._shard(object_id)
+        with s.lock:
+            s.owned.discard(object_id)
+            s.holders.pop(object_id, None)
+            s.local.pop(object_id, None)
+            s.task_pins.pop(object_id, None)
 
     def counts(self, object_id: ObjectId) -> tuple:
-        with self._lock:
-            return (self._local.get(object_id, 0),
-                    self._task_pins.get(object_id, 0),
-                    sum(self._holders.get(object_id, {}).values()))
+        s = self._shard(object_id)
+        with s.lock:
+            return (s.local.get(object_id, 0),
+                    s.task_pins.get(object_id, 0),
+                    sum(s.holders.get(object_id, {}).values()))

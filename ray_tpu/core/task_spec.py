@@ -59,6 +59,11 @@ class TaskSpec:
     max_retries: int = 0
     retry_exceptions: bool = False
     scheduling_strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
+    # direct dispatch: the submitting process's worker id = the actor
+    # queue LANE this task is sequenced in (None = head-routed lane).
+    # Per-caller FIFO is the ordering contract (ref:
+    # direct_actor_task_submitter.h client-side sequencing); seq_no
+    # counts within the lane.
     owner_id: Optional[WorkerId] = None
     # actor fields
     actor_id: Optional[ActorId] = None

@@ -59,18 +59,49 @@ def _aiter_to_iter(agen):
         loop.close()
 
 
+class _ActorLane:
+    """Per-caller sequencing lane (ref: the reference's client-side actor
+    task sequencing — each submitter numbers its own calls). The head's
+    routed lane is key b""; direct callers get their own lane keyed by
+    caller worker id. A direct lane carries a GATE: the number of
+    head-lane tasks that must have dispatched before the lane may run,
+    which pins the caller's routed->direct transition to per-caller FIFO
+    (its earlier routed calls all carry head seqs below the gate).
+
+    ``era`` is the caller's connection-era token: bumped by the caller
+    each time it (re)establishes the peer connection, at which point the
+    caller also restarts its seq numbering at 0. A higher era resets the
+    lane (frames lost in the dead connection would otherwise leave
+    ``expected`` behind forever); a lower era marks a straggler frame
+    from a connection whose unanswered calls the caller has already
+    recovered through the routed path — dropped, never a lost result."""
+
+    __slots__ = ("expected", "buffer", "gate", "era")
+
+    def __init__(self, gate: int = 0, era: int = 0):
+        self.expected = 0
+        self.buffer: Dict[int, TaskSpec] = {}
+        self.gate = gate
+        self.era = era
+
+
 class ActorQueue:
     """Ordered execution queue for one actor instance.
     (ref: transport/actor_scheduling_queue.cc — enforce seq order;
-    out_of_order_actor_submit_queue.cc for max_concurrency > 1)."""
+    out_of_order_actor_submit_queue.cc for max_concurrency > 1).
+
+    Tasks arrive on per-caller lanes (see _ActorLane); within a lane,
+    execution is dispatched in seq order. Lanes are independent — two
+    callers' calls interleave arbitrarily, exactly as they did racing
+    through the head."""
 
     def __init__(self, worker: "WorkerProcess", instance: Any, spec: TaskSpec):
         self.worker = worker
         self.instance = instance
         self.max_concurrency = max(1, spec.max_concurrency)
         self.is_async = spec.is_async_actor
-        self._expected_seq = 0
-        self._buffer: Dict[int, TaskSpec] = {}
+        self._lanes: Dict[bytes, _ActorLane] = {}
+        self._head_dispatched = 0
         self._lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=self.max_concurrency,
                                         thread_name_prefix="actor")
@@ -93,29 +124,72 @@ class ActorQueue:
     def _pool_for(self, spec: TaskSpec) -> ThreadPoolExecutor:
         return self._group_pools.get(spec.concurrency_group, self._pool)
 
-    def push(self, spec: TaskSpec) -> None:
+    def push(self, spec: TaskSpec, gate: int = 0, era: int = 0) -> None:
         # Dispatch under the lock: push_task messages are handled by a pool
         # of RPC threads, so releasing the lock before pool.submit would let
         # two threads invert the sequence order.
+        lane_key = spec.owner_id.binary() if spec.owner_id is not None else b""
         with self._lock:
-            self._buffer[spec.seq_no] = spec
-            while self._expected_seq in self._buffer:
-                s = self._buffer.pop(self._expected_seq)
-                self._expected_seq += 1
-                if s.concurrency_group \
-                        and s.concurrency_group not in self._group_pools:
-                    self._pool.submit(
-                        self.worker._report_error, s,
-                        ValueError(
-                            f"concurrency group {s.concurrency_group!r} was "
-                            f"not declared in concurrency_groups="
-                            f"{sorted(self._group_pools)}"))
+            lane = self._lanes.get(lane_key)
+            if lane is None:
+                lane = self._lanes[lane_key] = _ActorLane(gate, era)
+            elif era > lane.era:
+                # new connection era: the caller restarted seq numbering
+                # at 0 and has recovered everything unanswered from the
+                # old connection through the routed path — buffered old-
+                # era frames are covered by that recovery, and keeping
+                # the old `expected` would strand the lane forever if any
+                # old-era frame died in the dropped socket
+                lane.era = era
+                lane.expected = 0
+                lane.buffer.clear()
+                lane.gate = gate
+            elif era < lane.era:
+                return  # straggler from a recovered (dead) era
+            elif gate > lane.gate:
+                lane.gate = gate
+            lane.buffer[spec.seq_no] = spec
+            self._drain_locked()
+
+    def _drain_locked(self) -> None:
+        """Dispatch every runnable task: head lane first (its progress
+        opens direct-lane gates), then gated direct lanes; loop until a
+        full pass makes no progress."""
+        progress = True
+        while progress:
+            progress = False
+            head = self._lanes.get(b"")
+            if head is not None:
+                while head.expected in head.buffer:
+                    s = head.buffer.pop(head.expected)
+                    head.expected += 1
+                    self._head_dispatched += 1
+                    self._dispatch(s)
+                    progress = True
+            for key, lane in self._lanes.items():
+                if key == b"" or self._head_dispatched < lane.gate:
                     continue
-                if self.is_async:
-                    asyncio.run_coroutine_threadsafe(self._run_async(s), self._loop)
-                else:
-                    self._pool_for(s).submit(self.worker.execute_task, s,
-                                             self.instance)
+                while lane.expected in lane.buffer:
+                    s = lane.buffer.pop(lane.expected)
+                    lane.expected += 1
+                    self._dispatch(s)
+                    progress = True
+
+    def _dispatch(self, s: TaskSpec) -> None:
+        if s.concurrency_group \
+                and s.concurrency_group not in self._group_pools:
+            self._pool.submit(
+                self.worker._report_error, s,
+                ValueError(
+                    f"concurrency group {s.concurrency_group!r} was "
+                    f"not declared in concurrency_groups="
+                    f"{sorted(self._group_pools)}"))
+            return
+        if self.is_async:
+            asyncio.run_coroutine_threadsafe(self._run_async(s), self._loop)
+        else:
+            self._pool_for(s).submit(self.worker.execute_task, s,
+                                     self.instance)
 
     async def _run_async(self, spec: TaskSpec) -> None:
         if self._is_coroutine(spec):
@@ -168,6 +242,13 @@ class WorkerProcess:
 
         self._metrics_interval = max(
             0.1, float(_cfg.metrics_export_interval_s))
+        # direct-dispatch state must exist before the metrics loop starts
+        # (it flushes the batched direct-event stream on the same thread)
+        self._direct_reply = {}
+        self._direct_lock = threading.Lock()
+        self._devents: list = []
+        self._devents_interval = max(0.05, float(_cfg.direct_event_flush_s))
+        self._devents_batch = max(1, int(_cfg.direct_event_batch))
         threading.Thread(target=self._metrics_loop, daemon=True,
                          name="worker-metrics").start()
         # outbound log plane: stdout/stderr tees and the structured
@@ -184,6 +265,92 @@ class WorkerProcess:
         # compiled-graph executor (ray_tpu/cgraph): created lazily on the
         # first cgraph_load so plain task workers never pay the import
         self._cgraph = None
+        # direct dispatch (docs/DISPATCH.md): tasks submitted straight to
+        # this worker by a peer (another worker, or the driver over this
+        # node channel) reply on the channel they arrived on, not via the
+        # head's task_done intake; the reply map / batched-event state is
+        # initialized above, before the metrics thread starts
+        self._direct_server = None
+        self.direct_addr: Optional[str] = None
+
+    def start_direct_server(self, sock_dir: str) -> None:
+        """Listen for peer direct-call connections (worker-to-worker and
+        driver-to-remote-worker submissions). Unix socket next to the
+        node's: same-host peers connect directly; cross-host callers fall
+        back to head routing when the connect fails."""
+        from .rpc import RpcServer
+
+        path = os.path.join(sock_dir, f"dw_{self.worker_id.hex()[:12]}.sock")
+
+        def factory(channel: RpcChannel):
+            return lambda method, payload: self.handle_direct(
+                channel, method, payload)
+
+        try:
+            self._direct_server = RpcServer(path, factory, family="AF_UNIX",
+                                            num_handler_threads=4)
+            self.direct_addr = path
+        except Exception:
+            self.direct_addr = None
+
+    def handle_direct(self, channel: RpcChannel, method: str, payload):
+        """Handler for peer direct-call channels (and the direct_submit /
+        direct_result frames that ride the node channel when the driver is
+        the caller)."""
+        if method == "direct_submit":
+            spec: TaskSpec = payload["spec"]
+            if self._actor is None or self._actor_id != spec.actor_id:
+                # stale placement (this process hosts no/another actor —
+                # e.g. an OS-recycled address): tell the caller to
+                # invalidate its cache and re-resolve via the head
+                channel.notify("direct_result",
+                               {"task_id": spec.task_id,
+                                "actor_id": spec.actor_id, "stale": True})
+                return None
+            with self._direct_lock:
+                self._direct_reply[spec.task_id] = channel
+            self._actor.push(spec, gate=int(payload.get("gate", 0)),
+                             era=int(payload.get("lane", 0)))
+            return None
+        if method == "direct_result":
+            # this worker is the CALLER: a peer finished our direct task
+            self.runtime.on_direct_result(payload)
+            return None
+        if method == "ping":
+            return "pong"
+        raise ValueError(f"unknown direct message {method}")
+
+    def _direct_event(self, spec: TaskSpec, t_start: float, t_end: float,
+                      error: bool) -> None:
+        """Record one direct task's lifecycle for the batched event
+        stream; flushes by size here and by time in the metrics loop."""
+        tid = spec.task_id.hex()
+        aid = spec.actor_id.hex() if spec.actor_id else ""
+        flush = None
+        with self._direct_lock:
+            self._devents.append(
+                {"task_id": tid, "name": spec.description,
+                 "state": "RUNNING", "time": t_start, "actor_id": aid})
+            self._devents.append(
+                {"task_id": tid, "name": spec.description,
+                 "state": "FAILED" if error else "FINISHED",
+                 "time": t_end, "actor_id": aid})
+            if len(self._devents) >= self._devents_batch:
+                flush, self._devents = self._devents, []
+        if flush:
+            self._send_devents(flush)
+
+    def _flush_devents(self) -> None:
+        with self._direct_lock:
+            flush, self._devents = self._devents, []
+        if flush:
+            self._send_devents(flush)
+
+    def _send_devents(self, events: list) -> None:
+        try:
+            self.channel.notify("task_events_batch", events)
+        except Exception:
+            pass
 
     def _current_task_ids(self):
         spec = self.runtime.current_task()
@@ -223,9 +390,15 @@ class WorkerProcess:
                 self._metrics_backlog = deltas
 
     def _metrics_loop(self) -> None:
+        last_dev = 0.0
         while not self._stop.is_set() and not self.channel.closed:
-            self._stop.wait(self._metrics_interval)
-            self._flush_metrics()
+            self._stop.wait(min(self._metrics_interval,
+                                self._devents_interval))
+            now = time.monotonic()
+            if now - last_dev >= self._devents_interval:
+                last_dev = now
+                self._flush_devents()
+            self._flush_metrics(min_interval=self._metrics_interval)
 
     # -- incoming RPC ----------------------------------------------------------
 
@@ -237,6 +410,11 @@ class WorkerProcess:
             else:
                 self._task_queue.put(spec)
             return None
+        if method in ("direct_submit", "direct_result"):
+            # the driver submits direct calls over this node channel (it
+            # already connects straight to this process); replies ride it
+            # back as direct_result frames
+            return self.handle_direct(self.channel, method, payload)
         if method == "ping":
             return "pong"
         if method == "dump_stacks":
@@ -334,6 +512,8 @@ class WorkerProcess:
         if spec.task_id in self._cancelled:
             self._report_error(spec, _make_cancelled_error(spec))
             return
+        if spec.task_id in self._direct_reply:
+            spec.__dict__["_t_exec0"] = time.time()  # direct event stream
         if spec.runtime_env and not self._renv_applied:
             # the node's lease dispatch guarantees this worker is either
             # fresh or already dedicated to exactly this env, so a single
@@ -409,11 +589,106 @@ class WorkerProcess:
 
     # -- result reporting ------------------------------------------------------
 
+    def _pop_direct_reply(self, task_id) -> Optional[RpcChannel]:
+        with self._direct_lock:
+            return self._direct_reply.pop(task_id, None)
+
+    def _report_direct_success(self, spec: TaskSpec, result: Any,
+                               reply: RpcChannel) -> None:
+        """Ship a direct task's results straight back to the caller.
+
+        Small ref-free results travel inline on the peer channel — zero
+        head traffic. Results that are large OR contain ObjectRefs go
+        through the head's store instead (("stored") markers): nested
+        refs need the head's borrower pins (_nested_refs) so the
+        producer's own reference dropping at function exit can't free
+        them before the caller deserializes."""
+        from .config import DEFAULT as cfg
+
+        if spec.num_returns == 0:
+            outs = []
+        elif spec.num_returns == 1:
+            outs = [result]
+        else:
+            outs = list(result)
+            if len(outs) != spec.num_returns:
+                self._report_direct_error(spec, ValueError(
+                    f"Task returned {len(outs)} values, expected "
+                    f"{spec.num_returns}"), reply)
+                return
+        results = []
+        for oid, value in zip(spec.return_ids(), outs):
+            sobj = serialization.serialize(value)
+            if sobj.contained_refs:
+                for r in sobj.contained_refs:
+                    self.runtime.ensure_published(r.id)
+                data = sobj.to_bytes()
+                self.channel.call("direct_result_stored", {
+                    "object_id": oid, "data": data,
+                    "borrowed": [r.id for r in sobj.contained_refs]})
+                results.append(("stored", None))
+            elif sobj.total_bytes <= cfg.max_direct_call_object_size:
+                results.append(("inline", sobj.to_bytes()))
+            else:
+                name = self.channel.call(
+                    "create_object",
+                    {"object_id": oid, "size": sobj.total_bytes})
+                mv = self.reader.read(name, sobj.total_bytes)
+                sobj.write_into(mv)
+                del mv
+                self.reader.release(name)
+                self.channel.call("seal_object", {"object_id": oid})
+                results.append(("stored", None))
+        t_end = time.time()
+        self._direct_event(spec, spec.__dict__.get("_t_exec0", t_end),
+                           t_end, error=False)
+        reply.notify("direct_result", {
+            "task_id": spec.task_id, "actor_id": spec.actor_id,
+            "results": results, "error": None})
+
+    def _report_direct_error(self, spec: TaskSpec, exc: BaseException,
+                             reply: RpcChannel) -> None:
+        from ..exceptions import TaskError
+
+        if isinstance(exc, TaskError):
+            err = exc
+        else:
+            err = TaskError(cause=exc,
+                            remote_traceback=traceback.format_exc(),
+                            task_desc=spec.description)
+        try:
+            blob = serialization.dumps(err)
+        except Exception:
+            blob = serialization.dumps(
+                TaskError(remote_traceback=traceback.format_exc(),
+                          task_desc=spec.description))
+        t_end = time.time()
+        self._direct_event(spec, spec.__dict__.get("_t_exec0", t_end),
+                           t_end, error=True)
+        reply.notify("direct_result", {
+            "task_id": spec.task_id, "actor_id": spec.actor_id,
+            "results": None, "error": blob})
+
     def _report_success(self, spec: TaskSpec, result: Any) -> None:
         from .config import DEFAULT as cfg
 
         if spec.num_returns == STREAMING_RETURNS:
             self._stream_generator(spec, result)
+            return
+        reply = self._pop_direct_reply(spec.task_id)
+        if reply is not None:
+            try:
+                self._report_direct_success(spec, result, reply)
+            except Exception as e:  # e.g. head channel died mid-store
+                # the reply entry is already popped — report on the direct
+                # channel we hold, NOT _report_error (whose routed
+                # task_done the head would drop: direct tasks are never
+                # in worker.in_flight, so the caller would hang)
+                try:
+                    self._report_direct_error(spec, e, reply)
+                except Exception:
+                    pass  # reply channel dead too: the caller's
+                    # on_close recovery resubmits through the head
             return
         if spec.num_returns == 0:
             outs = []
@@ -433,6 +708,10 @@ class WorkerProcess:
         return_ids = spec.return_ids()
         for oid, value in zip(return_ids, outs):
             sobj = serialization.serialize(value)
+            for r in sobj.contained_refs:
+                # direct-result refs nested in a routed return escape this
+                # process: the head must own them before it pins them
+                self.runtime.ensure_published(r.id)
             # refs nested inside EACH return value: the head pins them
             # until THAT return object dies, or this worker's own ref
             # dropping (function exit) can free them before the caller
@@ -470,6 +749,8 @@ class WorkerProcess:
             for item in result:
                 oid = ObjectId.for_task_return(spec.task_id, n)
                 sobj = serialization.serialize(item)
+                for r in sobj.contained_refs:
+                    self.runtime.ensure_published(r.id)
                 if sobj.total_bytes <= cfg.max_direct_call_object_size:
                     ok = self.channel.call("generator_item", {
                         "task_id": spec.task_id, "index": n,
@@ -511,6 +792,10 @@ class WorkerProcess:
     def _report_error(self, spec: TaskSpec, exc: BaseException) -> None:
         from ..exceptions import TaskError
 
+        reply = self._pop_direct_reply(spec.task_id)
+        if reply is not None:
+            self._report_direct_error(spec, exc, reply)
+            return
         if isinstance(exc, TaskError):
             err = exc
         else:
@@ -558,6 +843,12 @@ def main() -> None:
         return  # node shut down while we were starting; exit quietly
     wp = WorkerProcess(channel, worker_id, args.node_id)
     channel.set_handler(wp.handle)
+    from .config import DEFAULT as _cfg
+
+    if int(_cfg.direct_worker_server):
+        # peer-facing direct-call socket, advertised through register so
+        # the head's resolve_actor can hand it to callers
+        wp.start_direct_server(os.path.dirname(args.address))
     if os.environ.get("RTPU_WORKER_PROFILE"):
         # perf debugging: dump this worker's cProfile stats on exit
         import atexit
@@ -576,7 +867,9 @@ def main() -> None:
     else:
         channel.on_close(lambda: os._exit(0))
     resp = channel.call("register", {"worker_id": worker_id,
-                                     "pid": os.getpid()}, timeout=30)
+                                     "pid": os.getpid(),
+                                     "direct_addr": wp.direct_addr},
+                        timeout=30)
     if isinstance(resp, dict) and resp.get("forward_logs"):
         # tee prints into the attributed log plane (and still to the
         # local console); remote nodes additionally get driver mirroring
@@ -585,6 +878,10 @@ def main() -> None:
     try:
         wp.run()
     finally:
+        try:
+            wp._flush_devents()  # late direct completions still reach GCS
+        except Exception:
+            pass
         try:
             wp.log_batcher.stop()  # final flush before the channel drops
         except Exception:

@@ -37,7 +37,7 @@ from .local import (Finding, _assigned_names, _ctor_kind, _dotted,
 
 # Folded into the cache key (engine.CACHE_VERSION): bump when the
 # summary schema or extraction logic changes.
-SUMMARY_VERSION = 1
+SUMMARY_VERSION = 2  # v2: method-level .options(...).remote() edges
 
 # collective -> positional index of its axis argument
 COLLECTIVE_AXIS_ARG: Dict[str, int] = {
@@ -692,6 +692,16 @@ class _Extractor:
             "suppress": self._line_suppressions(call.lineno),
         }
         cls, max_conc = _handle_class(call)
+        if cls is None and isinstance(base, ast.Call) \
+                and isinstance(base.func, ast.Attribute) \
+                and base.func.attr == "options" \
+                and isinstance(base.func.value, ast.Attribute):
+            # h.m.options(num_returns=..., ...).remote(): a method-level
+            # options wrapper — the submit edge is the same h.m edge the
+            # bare spelling produces (the direct-dispatch transport
+            # doesn't change the call graph, and GC010 must see these
+            # edges too)
+            base = base.func.value
         if cls is not None:
             # creation site (Cls.remote / Cls.options(...).remote) OR a
             # plain remote-function submit spelled mod.f — the project

@@ -123,6 +123,11 @@ class Counter(Metric):
         with self._lock:
             self._values[k] = self._values.get(k, 0.0) + value
 
+    def total(self) -> float:
+        """Sum across every tag combination, as counted in this process."""
+        with self._lock:
+            return sum(self._values.values())
+
 
 class Gauge(Metric):
     def set(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:

@@ -167,9 +167,7 @@ def list_tasks(limit: int = 1000) -> List[Dict[str, Any]]:
 def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
     rt = _rt()
     out = []
-    with rt._lock:
-        directory = {oid: set(nids) for oid, nids in rt._directory.items()}
-        inline = set(rt._memory_store)
+    directory, inline = rt.object_table_snapshot()
     for oid in list(inline)[:limit]:
         local, pins, holders = rt.refcount.counts(oid)
         out.append({"object_id": oid.hex(), "where": "inline",

@@ -1,0 +1,21 @@
+"""Fixture: half of a 2-actor wait cycle whose calls run over the
+DIRECT dispatch transport at runtime (worker-to-worker submission,
+docs/DISPATCH.md). The transport changes nothing about the call graph —
+GC010 must still see the cycle. This hop uses the method-level
+``options(...)`` spelling the direct path encourages (per-method
+num_returns), which the v1 extractor dropped. (Lint fixture only.)"""
+import ray_tpu
+
+from .pong import Pong
+
+
+@ray_tpu.remote
+class Ping:
+    def __init__(self, peer: Pong):
+        self.peer = peer
+
+    def serve(self, x):
+        # direct-submit edge: h.m.options(...).remote() — same edge as
+        # the bare spelling, new transport underneath
+        ref = self.peer.serve.options(num_returns=1).remote(x + 1)
+        return ray_tpu.get(ref)

@@ -1,0 +1,13 @@
+"""Fixture: the other half of the direct-transport wait cycle."""
+import ray_tpu
+
+from .ping import Ping
+
+
+@ray_tpu.remote
+class Pong:
+    def __init__(self, peer: "Ping"):
+        self.peer = peer
+
+    def serve(self, x):
+        return ray_tpu.get(self.peer.serve.remote(x + 1))

@@ -1,0 +1,281 @@
+"""Decentralized dispatch (ISSUE 6 / docs/DISPATCH.md): direct
+worker-to-worker actor calls, the routed->direct ordering contract,
+fault fallback, escape publishing, and the RPC thread-growth bound.
+
+The acceptance hooks live here: steady-state actor calls make ZERO head
+RPCs (asserted via the direct/routed counters), and every failure mode
+lands back on the routed path with typed errors."""
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.runtime import dispatch_counts
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    rt = ray_tpu.init(num_cpus=4)
+    yield rt
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+
+    def inc(self):
+        self.n += 1
+        return self.n
+
+    def echo(self, x):
+        return x
+
+    def die(self):
+        import os
+
+        os._exit(1)
+
+
+def test_steady_state_driver_calls_are_direct(cluster):
+    """Pipelined driver->actor calls ride the direct path: the routed
+    counter must not move once the actor is resolved."""
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote(), timeout=60) == 1
+    d0, r0 = dispatch_counts()
+    out = ray_tpu.get([c.inc.remote() for _ in range(200)], timeout=120)
+    assert out == list(range(2, 202))
+    d1, r1 = dispatch_counts()
+    assert d1 - d0 == 200, "steady-state calls must all go direct"
+    assert r1 - r0 == 0, "zero routed (head) submissions in steady state"
+    ray_tpu.kill(c)
+
+
+def test_worker_to_worker_direct(cluster):
+    """A worker holding an actor handle submits straight to the owning
+    worker: the CALLING WORKER's own counters show 0 routed."""
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote(), timeout=60) == 1
+
+    @ray_tpu.remote
+    def burst(handle, k):
+        # bounded nesting: the runtime releases the lease while blocked
+        out = ray_tpu.get([handle.echo.remote(i)  # graftcheck: disable=GC001
+                           for i in range(k)],
+                          timeout=120)
+        from ray_tpu.core.runtime import dispatch_counts as dc
+
+        d, r = dc()
+        return out, d, r
+
+    out, d, r = ray_tpu.get(burst.remote(c, 100), timeout=120)
+    assert out == list(range(100))
+    assert d >= 100, "worker-side submissions must be direct"
+    assert r == 0, "the calling worker made zero routed submissions"
+    ray_tpu.kill(c)
+
+
+def test_per_caller_order_survives_routed_to_direct_transition(cluster):
+    """Calls submitted while the actor is still being created are queued
+    through the head; calls after it is ALIVE go direct. The actor must
+    still observe this caller's submission order."""
+    @ray_tpu.remote
+    class Seq:
+        def __init__(self):
+            time.sleep(0.3)  # widen the PENDING_CREATION window
+            self.n = 0
+
+        def next(self):
+            self.n += 1
+            return self.n
+
+    a = Seq.remote()
+    refs = [a.next.remote() for _ in range(50)]   # mostly head-queued
+    ray_tpu.get(refs[0], timeout=60)              # actor is ALIVE now
+    refs += [a.next.remote() for _ in range(50)]  # direct lane, gated
+    out = ray_tpu.get(refs, timeout=120)
+    assert out == list(range(1, 101)), \
+        "direct-lane calls overtook this caller's earlier routed calls"
+    ray_tpu.kill(a)
+
+
+def test_actor_death_mid_direct_call_is_typed(cluster):
+    """The worker dies executing a direct call: the caller gets the same
+    typed ActorDiedError the routed path surfaces, and later calls fail
+    the same way (placement cache invalidated, re-resolve finds DEAD)."""
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote(), timeout=60) == 1
+    ref = c.die.remote()
+    with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+        ray_tpu.get(ref, timeout=60)
+    with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+        ray_tpu.get(c.inc.remote(), timeout=60)
+
+
+def test_direct_calls_resume_after_actor_restart(cluster):
+    """max_restarts actor: the crash-causing direct call fails typed
+    WITHOUT being replayed into the new incarnation (routed retry
+    semantics: no retry budget = no re-run), the restart re-places the
+    actor (new epoch), and steady state returns to the direct path."""
+    @ray_tpu.remote(max_restarts=1)
+    class Flaky:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+        def crash(self):
+            import os
+
+            os._exit(1)
+
+    a = Flaky.remote()
+    assert ray_tpu.get(a.inc.remote(), timeout=60) == 1
+    crash_ref = a.crash.remote()
+    with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+        ray_tpu.get(crash_ref, timeout=60)
+    # new calls run on the fresh incarnation (counter reset to 0)
+    deadline = time.monotonic() + 60
+    val = None
+    while time.monotonic() < deadline:
+        try:
+            val = ray_tpu.get(a.inc.remote(), timeout=60)
+            break
+        except ray_tpu.exceptions.ActorDiedError:
+            time.sleep(0.2)  # restart still landing
+    assert val == 1, f"restarted actor should reset state, got {val}"
+    # and the new incarnation is reached DIRECTLY again
+    ray_tpu.get(a.inc.remote(), timeout=60)
+    d0, _ = dispatch_counts()
+    ray_tpu.get([a.inc.remote() for _ in range(20)], timeout=60)
+    d1, _ = dispatch_counts()
+    assert d1 - d0 == 20
+    ray_tpu.kill(a)
+
+
+def test_user_exception_rides_direct_path(cluster):
+    """A user-level exception inside a direct call surfaces as the same
+    typed TaskError/cause the routed path produces."""
+    @ray_tpu.remote
+    class Boom:
+        def ok(self):
+            return 1
+
+        def fail(self):
+            raise ValueError("boom-direct")
+
+    b = Boom.remote()
+    assert ray_tpu.get(b.ok.remote(), timeout=60) == 1
+    d0, r0 = dispatch_counts()
+    with pytest.raises(Exception) as ei:
+        ray_tpu.get(b.fail.remote(), timeout=60)
+    assert "boom-direct" in str(ei.value)
+    d1, r1 = dispatch_counts()
+    assert d1 - d0 == 1 and r1 - r0 == 0, \
+        "error delivery must not have rerouted through the head"
+    ray_tpu.kill(b)
+
+
+def test_escaped_direct_ref_is_published(cluster):
+    """A ref produced by a direct call (held only in the caller) must be
+    usable everywhere: as a task arg, nested in a returned container,
+    and via ray_tpu.wait."""
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote(), timeout=60) == 1
+
+    @ray_tpu.remote
+    def consume(x):
+        return x * 10
+
+    @ray_tpu.remote
+    def worker_escape(handle):
+        ref = handle.inc.remote()            # direct, result held locally
+        ready, pending = ray_tpu.wait([ref], timeout=60)
+        assert len(ready) == 1 and not pending
+        # escape 1: top-level task arg (publish via prepare_args);
+        # bounded nesting — the lease is released while blocked
+        v1 = ray_tpu.get(consume.remote(ref),  # graftcheck: disable=GC001
+                         timeout=60)
+        # escape 2: nested in the return value (publish via report path)
+        return v1, ref
+
+    v1, inner = ray_tpu.get(worker_escape.remote(c), timeout=120)
+    base = v1 // 10
+    assert v1 == base * 10
+    assert ray_tpu.get(inner, timeout=60) == base
+    ray_tpu.kill(c)
+
+
+def test_multi_return_direct_call(cluster):
+    @ray_tpu.remote
+    class Pair:
+        @ray_tpu.method(num_returns=2)
+        def two(self, x):
+            return x, x + 1
+
+    p = Pair.remote()
+    r1, r2 = p.two.remote(5)
+    assert ray_tpu.get([r1, r2], timeout=60) == [5, 6]
+    d0, _ = dispatch_counts()
+    r1, r2 = p.two.remote(7)
+    assert ray_tpu.get([r1, r2], timeout=60) == [7, 8]
+    d1, _ = dispatch_counts()
+    assert d1 - d0 == 1
+    ray_tpu.kill(p)
+
+
+def test_large_direct_result_goes_through_store(cluster):
+    """Results over the inline threshold seal into the store; the direct
+    reply carries a ("stored") marker and the caller fetches normally."""
+    import numpy as np
+
+    @ray_tpu.remote
+    class Big:
+        def blob(self):
+            return np.zeros(1_000_000, dtype=np.uint8)  # ~1 MB
+
+    b = Big.remote()
+    out = ray_tpu.get(b.blob.remote(), timeout=120)
+    assert out.nbytes == 1_000_000
+    ray_tpu.kill(b)
+
+
+def test_direct_completions_reach_task_event_stream(cluster):
+    """The head still learns of direct completions — via the BATCHED
+    task-event stream, not per-call traffic."""
+    c = Counter.remote()
+    ray_tpu.get(c.inc.remote(), timeout=60)
+    marker = Counter.remote()  # unused; just spacing
+    ray_tpu.get([c.inc.remote() for _ in range(10)], timeout=60)
+    rt = cluster
+    deadline = time.monotonic() + 5.0
+    seen = 0
+    while time.monotonic() < deadline:
+        seen = sum(1 for e in rt.gcs.task_events()
+                   if e.get("name", "").startswith("Counter.inc")
+                   and e.get("state") == "FINISHED")
+        if seen >= 10:
+            break
+        time.sleep(0.2)
+    assert seen >= 10, f"only {seen} direct completions surfaced in events"
+    ray_tpu.kill(c)
+    ray_tpu.kill(marker)
+
+
+def test_thread_count_flat_across_1k_actor_calls(cluster):
+    """PERF_NOTES round-5 flake lead (driver at 219 threads): with the
+    pooled reader hub + elastic lanes, driver thread count must not grow
+    with call count."""
+    c = Counter.remote()
+    ray_tpu.get([c.inc.remote() for _ in range(50)], timeout=120)  # warm
+    time.sleep(0.3)
+    before = threading.active_count()
+    ray_tpu.get([c.inc.remote() for _ in range(1000)], timeout=300)
+    after = threading.active_count()
+    assert after - before <= 8, \
+        f"driver thread count grew {before} -> {after} across 1k calls"
+    ray_tpu.kill(c)

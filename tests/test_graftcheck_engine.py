@@ -49,6 +49,36 @@ class TestGC010:
         for f, line in (("a.py", 19), ("b.py", 14), ("c.py", 15)):
             assert f"{f}:{line}" in msg, (f, line, msg)
 
+    def test_direct_transport_cycle_detected(self):
+        """Direct dispatch (ISSUE 6) changes the transport, not the call
+        graph: a wait cycle whose hops will run worker-to-worker — one
+        spelled with the method-level .options(...).remote() form the
+        direct path encourages — must still trip GC010."""
+        res = run_pkg("direct_pkg", rules={"GC010"})
+        assert rules_of(res) == ["GC010"]
+        assert len(res.findings) == 1
+        msg = res.findings[0].message
+        assert "direct_pkg.ping.Ping.serve" in msg
+        assert "direct_pkg.pong.Pong.serve" in msg
+
+    def test_method_options_submit_edge_extracted(self):
+        """h.m.options(num_returns=...).remote() produces the same h.m
+        submit edge as the bare spelling (v1 dropped it entirely)."""
+        import ast as _ast
+
+        from ray_tpu.devtools.graftcheck.summary import extract
+
+        src = (
+            "import ray_tpu\n"
+            "def go(h):\n"
+            "    return h.work.options(num_returns=2).remote(1)\n"
+        )
+        s, _ = extract("m.py", src, _ast.parse(src), "m")
+        subs = s["functions"]["go"]["submits"]
+        assert len(subs) == 1
+        assert subs[0]["form"] == "method"
+        assert subs[0]["method"] == "work"
+
     def test_single_concurrency_self_call_flagged(self):
         res = run_pkg("selfcall_pkg", rules={"GC010"})
         assert rules_of(res) == ["GC010"]

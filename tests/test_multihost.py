@@ -219,7 +219,7 @@ def test_p2p_transfer_bypasses_head(cluster):
     rt = cluster.runtime
     oid = r.id
     # directory: copies on A and B only — never promoted into the head
-    copies = set(rt._directory.get(oid, ()))
+    copies = rt.object_locations(oid)
     assert a.node_id in copies and b.node_id in copies
     head_node = rt.nodes[rt.head_node_id]
     assert not head_node.store.contains(oid), \
@@ -263,8 +263,7 @@ def test_head_pushes_object_to_remote_store(cluster):
     node = rt.nodes[remote.node_id]
     node.store.put_serialized(oid, sobj, pin=True)
     rt.refcount.add_owned(oid)
-    with rt._lock:
-        rt._directory.setdefault(oid, set()).add(remote.node_id)
+    rt.add_object_location(oid, remote.node_id)
     rt._notify_object(oid)
     ref = rt.make_ref(oid)
     out = ray_tpu.get(ref, timeout=60)

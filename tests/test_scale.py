@@ -35,8 +35,10 @@ def test_ten_thousand_tasks_complete(cluster):
     out = ray_tpu.get(refs, timeout=240)
     dt = time.monotonic() - t0
     assert out == list(range(10000))
-    # measured ~2.5s standalone after the r5 dispatch work (~4.5k/s);
-    # 2x-of-measured-plus-suite-noise bound so a 5x regression fails
+    # r5 measured ~2.5s standalone (~4.5k/s); the r6 RPC rework helps the
+    # routed path too, but this bound stays at the r5 calibration — the
+    # r6 win is pinned by test_direct_actor_call_envelope below, which
+    # measures the path this round actually rebuilt
     assert dt < 12 * _BOUND_SCALE, f"10000 tasks took {dt:.1f}s"
 
 
@@ -54,7 +56,9 @@ def test_hundred_thousand_queued_tasks(cluster):
     dt = time.monotonic() - t0
     assert out == list(range(100000))
     rate = 100000 / dt
-    assert rate > 2000 / _BOUND_SCALE, \
+    # r6: bound raised 2000 -> 2500 (RPC rework headroom on the routed
+    # path; r5 measured 4.4-5.4k/s standalone on a >=4-core host)
+    assert rate > 2500 / _BOUND_SCALE, \
         f"100k queued ran at {rate:.0f} tasks/s"
 
 
@@ -104,6 +108,44 @@ def test_many_placement_groups_lifecycle(cluster):
     assert dt < 60, f"1000 PGs took {dt:.1f}s"
     for pg in pgs:
         remove_placement_group(pg)
+
+
+def test_direct_actor_call_envelope(cluster):
+    """ISSUE 6: steady-state actor calls ride the direct path (zero head
+    submissions) and the pipelined rate pins the decentralized-dispatch
+    win — ~3x the r5 routed actor-call rate on the same host class."""
+    from ray_tpu.core.runtime import dispatch_counts
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    ray_tpu.get(c.inc.remote(), timeout=60)
+    n = 3000
+    d0, r0 = dispatch_counts()
+    t0 = time.monotonic()
+    out = ray_tpu.get([c.inc.remote() for _ in range(n)], timeout=240)
+    dt = time.monotonic() - t0
+    assert out == list(range(2, n + 2))
+    d1, r1 = dispatch_counts()
+    assert d1 - d0 == n and r1 - r0 == 0, \
+        f"steady state must be all-direct (direct={d1-d0} routed={r1-r0})"
+    rate = n / dt
+    # r5 routed baseline: 8-9k calls/s on a >=4-core host, ~450/s on the
+    # 2-core CI class; direct dispatch measured 1.5-2.9k/s on the 2-core
+    # class (3.4-6.4x) and the floor must catch "the direct path broke"
+    # (a silent fall back to routed speed), so the small-host bound sits
+    # ABOVE the routed baseline but below the worst contended sample
+    floor = 4500 if not _SMALL_HOST else 750
+    assert rate > floor, \
+        f"pipelined direct actor calls ran at {rate:.0f}/s (floor {floor})"
+    ray_tpu.kill(c)
 
 
 def test_deep_queue_drains_in_order_per_actor(cluster):
