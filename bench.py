@@ -143,6 +143,7 @@ def main() -> None:
             **_bench_ppo_atari(),
             **_bench_cgraph_chain(),
             **_bench_dispatch(),
+            **_bench_llm_serve(),
         },
     }))
 
@@ -235,6 +236,21 @@ def _bench_dispatch() -> dict:
         import traceback
 
         traceback.print_exc()  # a broken actor plane must not look like 0
+        return {}
+
+
+def _bench_llm_serve() -> dict:
+    """LLM serving rows (ISSUE 7): continuous-batching vs sequential
+    tokens/s, sustained requests/s, TTFT/TPOT p50/p99 — tracked per
+    round in the BENCH json detail. In-process engine; no cluster."""
+    try:
+        from bench_core import llm_serve_bench
+
+        return llm_serve_bench(concurrency=4 if SMOKE else 8)
+    except Exception:
+        import traceback
+
+        traceback.print_exc()  # a broken engine must not look like 0
         return {}
 
 

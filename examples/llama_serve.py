@@ -1,13 +1,90 @@
-"""Llama pjit-sharded Serve inference (BASELINE: 'Llama-2-7B pjit-sharded
-Serve inference'). A MeshDeployment replica spans a gang of mesh workers;
-the model's parameters shard over the mesh per its logical axes and
-greedy decode runs jitted with a KV cache. --full uses llama2_7b sizes."""
+"""Llama Serve inference (BASELINE: 'Llama-2-7B pjit-sharded Serve
+inference') — now on the continuous-batching engine.
+
+Default path: an `LLMServer` deployment (ray_tpu.serve.llm) — paged KV
+cache, iteration-level batching, streamed tokens. The driver submits
+concurrent prompts through the streaming handle and prints per-request
+TTFT (time to first token) plus aggregate decode throughput.
+
+`--no-engine` keeps the legacy path for A/B: a MeshDeployment replica
+spanning a gang of mesh workers, full per-request prefill through one
+jitted decode step (the pre-engine baseline the BENCH llm_serve row
+measures against). --full uses llama2_7b sizes on either path.
+"""
 import argparse
+import threading
+import time
 
 import numpy as np
 
 import ray_tpu
 from ray_tpu import serve
+
+
+# ---------------------------------------------------------------------------
+# engine path (default)
+
+
+def run_engine(args) -> None:
+    from ray_tpu.serve.llm import LLMServer
+
+    app = serve.deployment(
+        num_replicas=1, health_check_timeout_s=120)(LLMServer).bind(
+        model="llama2-7b" if args.full else "llama-tiny",
+        engine_config={"max_batch": args.concurrency,
+                       "num_blocks": 256, "block_size": 16,
+                       "max_blocks_per_seq": 16,
+                       "prefill_buckets": (16, 32, 64)})
+    handle = serve.run(app, timeout=300)
+
+    prompts = [[1 + i, 5, 9] for i in range(args.requests)]
+    ttfts = [None] * len(prompts)
+    outs = [None] * len(prompts)
+    t0 = time.perf_counter()
+
+    errors = []
+
+    def client(i: int) -> None:
+        try:
+            gen = handle.options(stream=True).remote(
+                {"tokens": prompts[i], "max_tokens": args.max_new,
+                 "stream": True})
+            toks = []
+            for tok in gen:
+                if not toks:
+                    ttfts[i] = time.perf_counter() - t0
+                toks.append(tok)
+            outs[i] = toks
+        except Exception as e:  # noqa: BLE001 — surfaced after join
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    wall = time.perf_counter() - t0
+
+    if errors:
+        raise RuntimeError(f"streaming clients failed: {errors}")
+    failed = [i for i, o in enumerate(outs) if o is None]
+    if failed:
+        raise RuntimeError(f"clients {failed} timed out")
+    total = sum(len(o) for o in outs)
+    for i, (p, o) in enumerate(zip(prompts, outs)):
+        print(f"req {i}: ttft={ttfts[i] * 1e3:.1f}ms "
+              f"generated token ids: {p + o}")
+    print(f"aggregate: {total} tokens in {wall:.2f}s "
+          f"({total / max(wall, 1e-9):.1f} tok/s, "
+          f"concurrency {len(prompts)})")
+    stats = ray_tpu.get(handle.stats.remote(), timeout=30)
+    print(f"engine stats: {stats}")
+    serve.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# legacy path (--no-engine): MeshDeployment, per-request prefill
 
 
 def build(mesh, config):
@@ -29,8 +106,8 @@ def build(mesh, config):
     def apply(params, payload):
         prompt = list(np.asarray(payload["tokens"][0]).tolist())
         cache = model.init_cache(batch=1)
-        # prefill the cache one token at a time (static shapes; a batched
-        # prefill kernel is the production upgrade)
+        # prefill the cache one token at a time (static shapes; the
+        # engine path's bucketed prefill is the production upgrade)
         logits = None
         for tok in prompt:
             logits, cache = decode(params, cache,
@@ -46,12 +123,7 @@ def build(mesh, config):
     return params, apply
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--full", action="store_true")
-    ap.add_argument("--num-workers", type=int, default=2)
-    args = ap.parse_args()
-    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+def run_legacy(args) -> None:
     full = args.full
 
     @serve.deployment(num_replicas=1, health_check_timeout_s=120)
@@ -61,10 +133,34 @@ def main():
                              devices_per_worker=2, config={"full": full})
 
     handle = serve.run(LlamaServer.bind(), timeout=300)
+    t0 = time.perf_counter()
     out = ray_tpu.get(handle.remote(
-        {"tokens": [[1, 5, 9]], "max_new": 4}), timeout=120)
+        {"tokens": [[1, 5, 9]], "max_new": args.max_new}), timeout=120)
+    wall = time.perf_counter() - t0
     print("generated token ids:", out)
+    print(f"full round trip {wall * 1e3:.1f}ms (prefill recomputed "
+          f"per request — the engine path amortizes it)")
     serve.shutdown()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--full", action="store_true")
+    ap.add_argument("--no-engine", action="store_true",
+                    help="legacy MeshDeployment path (A/B baseline)")
+    ap.add_argument("--num-workers", type=int, default=2,
+                    help="mesh gang size (legacy path)")
+    ap.add_argument("--requests", type=int, default=4,
+                    help="concurrent streaming clients (engine path)")
+    ap.add_argument("--concurrency", type=int, default=4,
+                    help="engine max_batch (engine path)")
+    ap.add_argument("--max-new", type=int, default=8)
+    args = ap.parse_args()
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    if args.no_engine:
+        run_legacy(args)
+    else:
+        run_engine(args)
 
 
 if __name__ == "__main__":

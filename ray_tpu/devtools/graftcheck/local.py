@@ -39,6 +39,11 @@ GC008  blocking ``get()`` or dynamic ``.remote()`` submission inside an
        behind them; dynamic calls reintroduce per-call RPC/scheduling
        and can deadlock against the loop. Keep bound methods pure
        compute; do dynamic work outside the graph.
+GC009  blocking ``ray_tpu.get()`` or synchronous handle resolution
+       (``handle.remote(...).result()``) inside an ``async def`` method
+       of a ``@serve.deployment`` class — stalls the replica's event
+       loop for every queued request; ``await`` the response (or hop to
+       an executor) instead.
 ====== =================================================================
 
 Suppression: append ``# graftcheck: disable=GC001`` (comma-separate for
@@ -79,6 +84,9 @@ RULES: Dict[str, str] = {
              "queryable)",
     "GC008": "blocking get() or dynamic .remote() inside a method bound "
              "into a compiled graph (static graphs must stay static)",
+    "GC009": "blocking get()/.result() inside an async serve deployment "
+             "method (stalls the replica event loop for every queued "
+             "request)",
     # whole-program rules (engine-backed; see rules_project.py/rules_spmd.py)
     "GC010": "actor-deadlock: cycle of synchronous get() waits through the "
              "remote call graph (incl. self-calls on single-concurrency "
@@ -196,6 +204,18 @@ def _is_remote_decorator(dec: ast.AST) -> bool:
         return _is_remote_decorator(func)
     dotted = _dotted(dec)
     return dotted is not None and dotted[-1] == "remote"
+
+
+def _is_serve_deployment_decorator(dec: ast.AST) -> bool:
+    """@serve.deployment / @deployment, bare or called, plus
+    .options(...) chains (GC009 class detection)."""
+    if isinstance(dec, ast.Call):
+        func = dec.func
+        if isinstance(func, ast.Attribute) and func.attr == "options":
+            return _is_serve_deployment_decorator(func.value)
+        return _is_serve_deployment_decorator(func)
+    dotted = _dotted(dec)
+    return dotted is not None and dotted[-1] == "deployment"
 
 
 def _is_lockish(node: ast.AST, known_locks: Set[str]) -> bool:
@@ -374,15 +394,20 @@ class _FileChecker:
                     is_async: bool, fn: Optional[dict],
                     actor_class: bool = False,
                     cgraph: bool = False,
-                    class_name: str = "") -> None:
+                    class_name: str = "",
+                    serve_async: bool = False,
+                    serve_class: bool = False) -> None:
         for idx, stmt in enumerate(stmts):
             self._walk_stmt(stmt, stmts, idx, remote, is_async, fn,
-                            actor_class, cgraph, class_name)
+                            actor_class, cgraph, class_name, serve_async,
+                            serve_class)
 
     def _walk_stmt(self, stmt: ast.stmt, siblings: Sequence[ast.stmt],
                    idx: int, remote: bool, is_async: bool,
                    fn: Optional[dict], actor_class: bool,
-                   cgraph: bool = False, class_name: str = "") -> None:
+                   cgraph: bool = False, class_name: str = "",
+                   serve_async: bool = False,
+                   serve_class: bool = False) -> None:
         if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
             fn_remote = remote or actor_class \
                 or any(_is_remote_decorator(d) for d in stmt.decorator_list)
@@ -395,16 +420,25 @@ class _FileChecker:
             fn_cgraph = cgraph or (actor_class and (
                 (class_name, stmt.name) in self.cgraph_bound
                 or ("", stmt.name) in self.cgraph_bound))
+            # GC009 context: an async method of a serve deployment class
+            # (nested defs inherit it — a sync helper called inline from
+            # the async method still blocks the replica's event loop)
+            fn_serve_async = serve_async or (serve_class and fn_async)
             ctx = self._fn_context(stmt)
             self._walk_block(stmt.body, remote=fn_remote, is_async=fn_async,
-                             fn=ctx, cgraph=fn_cgraph)
+                             fn=ctx, cgraph=fn_cgraph,
+                             serve_async=fn_serve_async)
             return
         if isinstance(stmt, ast.ClassDef):
             cls_remote = any(_is_remote_decorator(d)
                              for d in stmt.decorator_list)
+            cls_serve = any(_is_serve_deployment_decorator(d)
+                            for d in stmt.decorator_list)
             self._walk_block(stmt.body, remote=remote, is_async=is_async,
                              fn=fn, actor_class=cls_remote or actor_class,
-                             cgraph=cgraph, class_name=stmt.name)
+                             cgraph=cgraph, class_name=stmt.name,
+                             serve_async=serve_async,
+                             serve_class=cls_serve or serve_class)
             return
         if isinstance(stmt, ast.Global) and remote and fn is not None:
             mutated = [n for n in stmt.names if n in fn["stores"]]
@@ -419,22 +453,25 @@ class _FileChecker:
             self._check_gc005(stmt)
         # GC006 on statement-position acquire() calls
         self._check_gc006(stmt, siblings, idx)
-        # this statement's own expressions: GC001/GC002/GC004/GC008
+        # this statement's own expressions: GC001/GC002/GC004/GC008/GC009
         for node in _iter_own_exprs(stmt):
-            self._check_expr(node, remote, is_async, fn, cgraph)
+            self._check_expr(node, remote, is_async, fn, cgraph,
+                             serve_async)
         # recurse into child statement blocks (for/while/if/with/try bodies)
         for field_name in ("body", "orelse", "finalbody"):
             child = getattr(stmt, field_name, None)
             if isinstance(child, list) and child \
                     and isinstance(child[0], ast.stmt):
                 self._walk_block(child, remote, is_async, fn, actor_class,
-                                 cgraph, class_name)
+                                 cgraph, class_name, serve_async,
+                                 serve_class)
         for handler in getattr(stmt, "handlers", ()):
             self._walk_block(handler.body, remote, is_async, fn,
-                             actor_class, cgraph, class_name)
+                             actor_class, cgraph, class_name, serve_async,
+                             serve_class)
         for case in getattr(stmt, "cases", ()):
             self._walk_block(case.body, remote, is_async, fn, actor_class,
-                             cgraph, class_name)
+                             cgraph, class_name, serve_async, serve_class)
 
     def _fn_context(self, fndef) -> dict:
         """Names a function binds locally (params + assignments) and
@@ -464,7 +501,8 @@ class _FileChecker:
     # -- expression-level rules -------------------------------------------
 
     def _check_expr(self, node: ast.AST, remote: bool, is_async: bool,
-                    fn: Optional[dict], cgraph: bool = False) -> None:
+                    fn: Optional[dict], cgraph: bool = False,
+                    serve_async: bool = False) -> None:
         if isinstance(node, ast.Call):
             if isinstance(node.func, ast.Name) and node.func.id == "print":
                 self.report(
@@ -477,6 +515,8 @@ class _FileChecker:
                 self._check_gc001(node)
             if cgraph:
                 self._check_gc008(node)
+            if serve_async:
+                self._check_gc009(node)
             if is_async:
                 dotted = _dotted(node.func)
                 if dotted == ("time", "sleep"):
@@ -543,6 +583,31 @@ class _FileChecker:
                 "graph stalls the resident loop (and every downstream "
                 "stage) on the dynamic task plane; pass the value "
                 "through the graph's channels instead")
+
+    def _check_gc009(self, call: ast.Call) -> None:
+        """Inside an async serve-deployment method: a blocking get() or
+        a synchronous `<handle>.remote(...).result()` pins the replica's
+        event loop — every queued request on this replica stalls behind
+        it."""
+        if self._is_blocking_get(call):
+            self.report(
+                "GC009", call,
+                "blocking get() inside an async serve deployment method "
+                "stalls the replica's event loop for every queued "
+                "request; await the response (or run the blocking call "
+                "in an executor)")
+            return
+        func = call.func
+        if isinstance(func, ast.Attribute) and func.attr == "result" \
+                and isinstance(func.value, ast.Call) \
+                and isinstance(func.value.func, ast.Attribute) \
+                and func.value.func.attr == "remote":
+            self.report(
+                "GC009", call,
+                "synchronous handle call (.remote(...).result()) inside "
+                "an async serve deployment method blocks the event loop "
+                "until the downstream deployment answers; await the "
+                "DeploymentResponse instead")
 
     # -- statement-level rules --------------------------------------------
 
@@ -664,4 +729,4 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
 # Local rules only — the engine runs these per file (cache-keyed by
 # content hash) and layers the whole-program rules on top.
 LOCAL_RULES: Set[str] = {"GC001", "GC002", "GC003", "GC004", "GC005",
-                         "GC006", "GC007", "GC008"}
+                         "GC006", "GC007", "GC008", "GC009"}

@@ -380,6 +380,110 @@ class GPT:
         return self._chunked_head_nll(params["wte"], x, targets,
                                       num_chunks or M)
 
+    # ---- paged-KV serving path (ray_tpu.serve.llm) -------------------------
+
+    def init_paged_cache(self, num_blocks: int,
+                         block_size: int) -> Dict[str, jax.Array]:
+        """Block-pool KV cache shared by every resident sequence:
+        k/v [L, num_blocks, block_size, H, hd] (GPT has no GQA: KH=H)."""
+        c = self.config
+        shape = (c.n_layer, num_blocks, block_size, c.n_head, c.head_dim)
+        return {"k": jnp.zeros(shape, c.dtype),
+                "v": jnp.zeros(shape, c.dtype)}
+
+    def _paged_layer_params(self, params: Dict[str, jax.Array], li: int):
+        return {n: params[n][li] for n in
+                ("ln1_g", "ln1_b", "w_qkv", "b_qkv", "w_proj", "b_proj",
+                 "ln2_g", "ln2_b", "w_fc", "b_fc", "w_out", "b_out")}
+
+    def _paged_mlp(self, x: jax.Array, lp: Dict[str, jax.Array]) -> jax.Array:
+        c = self.config
+        h = layernorm(x, lp["ln2_g"], lp["ln2_b"])
+        h = gelu((h @ lp["w_fc"].astype(c.dtype)) + lp["b_fc"].astype(c.dtype))
+        return x + (h @ lp["w_out"].astype(c.dtype)) \
+            + lp["b_out"].astype(c.dtype)
+
+    def paged_prefill(self, params: Dict[str, jax.Array],
+                      cache: Dict[str, jax.Array], tokens: jax.Array,
+                      length: jax.Array, block_row: jax.Array
+                      ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        """Prompt pass at a static bucket shape. tokens [1, S] (padded to
+        the bucket), length scalar int32 (true prompt length), block_row
+        [M] — the sequence's block table. Writes the prompt's K/V into
+        the paged cache and returns (last-real-token logits [V], cache).
+        One XLA program per bucket size, not per request."""
+        from ..ops import (mha_reference, paged_write_prefill)
+
+        c = self.config
+        S = tokens.shape[1]
+        H, hd = c.n_head, c.head_dim
+        x = self._embed(params["wte"], params["wpe"], tokens)   # [1, S, D]
+        kc, vc = cache["k"], cache["v"]
+        new_k, new_v = [], []
+        for li in range(c.n_layer):
+            lp = self._paged_layer_params(params, li)
+            h = layernorm(x, lp["ln1_g"], lp["ln1_b"])
+            qkv = (h @ lp["w_qkv"].astype(c.dtype)) \
+                + lp["b_qkv"].astype(c.dtype)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(1, S, H, hd)
+            k = k.reshape(1, S, H, hd)
+            v = v.reshape(1, S, H, hd)
+            attn = mha_reference(q, k, v, causal=True)
+            new_k.append(paged_write_prefill(kc[li], block_row, k[0], length))
+            new_v.append(paged_write_prefill(vc[li], block_row, v[0], length))
+            x = x + attn.reshape(1, S, H * hd) @ lp["w_proj"].astype(c.dtype) \
+                + lp["b_proj"].astype(c.dtype)
+            x = self._paged_mlp(x, lp)
+        x = layernorm(x, params["lnf_g"], params["lnf_b"])
+        last = jax.lax.dynamic_index_in_dim(
+            x[0], jnp.maximum(length - 1, 0), axis=0, keepdims=False)
+        logits = jnp.einsum("d,vd->v", last.astype(jnp.float32),
+                            params["wte"].astype(jnp.float32))
+        return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+    def paged_decode_step(self, params: Dict[str, jax.Array],
+                          cache: Dict[str, jax.Array], tokens: jax.Array,
+                          positions: jax.Array, block_rows: jax.Array,
+                          active: jax.Array
+                          ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        """One continuous-batching iteration at a fixed batch shape.
+        tokens/positions [B] (position = index the token is written at),
+        block_rows [B, M], active [B] bool (padded slots write nothing).
+        Returns (logits [B, V] f32, cache). Dense layer loop — each layer
+        scatters its cache slice; decode is bandwidth-bound anyway."""
+        from ..ops import paged_attention_decode, paged_write_step
+
+        c = self.config
+        B = tokens.shape[0]
+        H, hd = c.n_head, c.head_dim
+        x = self._embed(params["wte"], params["wpe"], tokens[:, None],
+                        positions[:, None])[:, 0]              # [B, D]
+        kc, vc = cache["k"], cache["v"]
+        lengths = positions + 1           # attend over context incl. self
+        new_k, new_v = [], []
+        for li in range(c.n_layer):
+            lp = self._paged_layer_params(params, li)
+            h = layernorm(x, lp["ln1_g"], lp["ln1_b"])
+            qkv = (h @ lp["w_qkv"].astype(c.dtype)) \
+                + lp["b_qkv"].astype(c.dtype)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            kl = paged_write_step(kc[li], block_rows, positions,
+                                  k.reshape(B, H, hd), active)
+            vl = paged_write_step(vc[li], block_rows, positions,
+                                  v.reshape(B, H, hd), active)
+            new_k.append(kl)
+            new_v.append(vl)
+            attn = paged_attention_decode(q.reshape(B, H, hd), kl, vl,
+                                          block_rows, lengths)
+            x = x + attn.reshape(B, H * hd) @ lp["w_proj"].astype(c.dtype) \
+                + lp["b_proj"].astype(c.dtype)
+            x = self._paged_mlp(x, lp)
+        x = layernorm(x, params["lnf_g"], params["lnf_b"])
+        logits = jnp.einsum("bd,vd->bv", x.astype(jnp.float32),
+                            params["wte"].astype(jnp.float32))
+        return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
     def _backbone(self, params: Dict[str, jax.Array], tokens: jax.Array,
                   rng: Optional[jax.Array] = None,
                   positions: Optional[jax.Array] = None) -> jax.Array:

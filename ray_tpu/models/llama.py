@@ -166,6 +166,100 @@ class Llama:
     def loss(self, params, tokens, targets):
         return cross_entropy_loss(self.apply(params, tokens), targets)
 
+    # ---- paged-KV serving path (ray_tpu.serve.llm) ------------------------
+
+    def init_paged_cache(self, num_blocks: int,
+                         block_size: int) -> Dict[str, jax.Array]:
+        """Block-pool KV cache: k/v [L, num_blocks, block_size, KH, hd]."""
+        c = self.config
+        shape = (c.n_layer, num_blocks, block_size, c.n_kv_head, c.head_dim)
+        return {"k": jnp.zeros(shape, c.dtype),
+                "v": jnp.zeros(shape, c.dtype)}
+
+    _PAGED_LP = ("attn_norm", "w_q", "w_k", "w_v", "w_o", "mlp_norm",
+                 "w_gate", "w_up", "w_down")
+
+    def _paged_mlp(self, x, lp):
+        c = self.config
+        h = rmsnorm(x, lp["mlp_norm"], c.rms_eps)
+        gate = jax.nn.silu(h @ lp["w_gate"].astype(c.dtype))
+        up = h @ lp["w_up"].astype(c.dtype)
+        return x + (gate * up) @ lp["w_down"].astype(c.dtype)
+
+    def paged_prefill(self, params, cache, tokens, length, block_row):
+        """Prompt pass at a static bucket shape (see GPT.paged_prefill —
+        same contract: tokens [1, S], length scalar, block_row [M] ->
+        (last-token logits [V], cache))."""
+        from ..ops import paged_write_prefill
+
+        c = self.config
+        S = tokens.shape[1]
+        H, KH, hd = c.n_head, c.n_kv_head, c.head_dim
+        x = params["wte"].astype(c.dtype)[tokens]              # [1, S, D]
+        cos, sin = rope_cache(c.max_seq, hd, c.rope_base)
+        kc, vc = cache["k"], cache["v"]
+        new_k, new_v = [], []
+        for li in range(c.n_layer):
+            lp = {n: params[n][li] for n in self._PAGED_LP}
+            h = rmsnorm(x, lp["attn_norm"], c.rms_eps)
+            q = (h @ lp["w_q"].astype(c.dtype)).reshape(1, S, H, hd)
+            k = (h @ lp["w_k"].astype(c.dtype)).reshape(1, S, KH, hd)
+            v = (h @ lp["w_v"].astype(c.dtype)).reshape(1, S, KH, hd)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            new_k.append(paged_write_prefill(kc[li], block_row, k[0], length))
+            new_v.append(paged_write_prefill(vc[li], block_row, v[0], length))
+            if KH != H:
+                rep = H // KH
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            attn = mha_reference(q, k, v, causal=True)
+            x = x + attn.reshape(1, S, H * hd) @ lp["w_o"].astype(c.dtype)
+            x = self._paged_mlp(x, lp)
+        x = rmsnorm(x, params["out_norm"], c.rms_eps)
+        last = jax.lax.dynamic_index_in_dim(
+            x[0], jnp.maximum(length - 1, 0), axis=0, keepdims=False)
+        logits = jnp.einsum("d,vd->v", last.astype(jnp.float32),
+                            params["lm_head"].astype(jnp.float32))
+        return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+    def paged_decode_step(self, params, cache, tokens, positions,
+                          block_rows, active):
+        """One continuous-batching iteration at a fixed batch shape (see
+        GPT.paged_decode_step — same contract)."""
+        from ..ops import paged_attention_decode, paged_write_step
+
+        c = self.config
+        B = tokens.shape[0]
+        H, KH, hd = c.n_head, c.n_kv_head, c.head_dim
+        x = params["wte"].astype(c.dtype)[tokens]              # [B, D]
+        cos, sin = rope_cache(c.max_seq, hd, c.rope_base)
+        kc, vc = cache["k"], cache["v"]
+        lengths = positions + 1
+        new_k, new_v = [], []
+        for li in range(c.n_layer):
+            lp = {n: params[n][li] for n in self._PAGED_LP}
+            h = rmsnorm(x, lp["attn_norm"], c.rms_eps)
+            q = (h @ lp["w_q"].astype(c.dtype)).reshape(B, 1, H, hd)
+            k = (h @ lp["w_k"].astype(c.dtype)).reshape(B, 1, KH, hd)
+            v = (h @ lp["w_v"].astype(c.dtype)).reshape(B, 1, KH, hd)
+            q = apply_rope(q, cos, sin, positions[:, None])
+            k = apply_rope(k, cos, sin, positions[:, None])
+            kl = paged_write_step(kc[li], block_rows, positions,
+                                  k[:, 0], active)
+            vl = paged_write_step(vc[li], block_rows, positions,
+                                  v[:, 0], active)
+            new_k.append(kl)
+            new_v.append(vl)
+            attn = paged_attention_decode(q[:, 0], kl, vl, block_rows,
+                                          lengths)
+            x = x + attn.reshape(B, H * hd) @ lp["w_o"].astype(c.dtype)
+            x = self._paged_mlp(x, lp)
+        x = rmsnorm(x, params["out_norm"], c.rms_eps)
+        logits = jnp.einsum("bd,vd->bv", x.astype(jnp.float32),
+                            params["lm_head"].astype(jnp.float32))
+        return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
     # ---- decode path (Serve) ----------------------------------------------
 
     def init_cache(self, batch: int) -> Dict[str, jax.Array]:

@@ -1,0 +1,107 @@
+"""Paged KV-cache primitives for the continuous-batching LLM engine.
+
+The serving-side analog of vLLM's PagedAttention (PAPERS.md): the KV
+cache is a pool of fixed-size blocks ``[num_blocks, block_size, KH, hd]``
+shared by every resident sequence, and each sequence addresses its
+context through a *block table* — a row of block ids. Three shape-static
+primitives cover the whole lifecycle, so XLA compiles exactly one decode
+program regardless of which sequences are live:
+
+- :func:`paged_write_step` scatters one new (K, V) per batch slot at its
+  sequence position (decode iteration).
+- :func:`paged_write_prefill` scatters a whole prompt's (K, V) into the
+  blocks named by one block-table row (bucketed prefill).
+- :func:`paged_attention_decode` attends one query token per slot over
+  the gathered, length-masked paged context.
+
+Inactive slots / padded positions are routed out-of-bounds and dropped
+(``mode="drop"``), so garbage slots never corrupt pool blocks owned by
+other sequences. All attention math runs in f32 (matches mha_reference).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def paged_gather_kv(cache: jax.Array, block_rows: jax.Array) -> jax.Array:
+    """Gather per-sequence context from the pool.
+
+    cache: [N, Bs, KH, hd]; block_rows: [B, M] int32 (unused entries may
+    be any value — callers mask by length). Returns [B, M*Bs, KH, hd].
+    """
+    b, m = block_rows.shape
+    _, bs, kh, hd = cache.shape
+    # clip (jnp default) is fine here: out-of-range rows gather garbage
+    # that the caller's length mask removes before the softmax
+    gathered = cache[jnp.clip(block_rows, 0, cache.shape[0] - 1)]
+    return gathered.reshape(b, m * bs, kh, hd)
+
+
+def paged_write_step(cache: jax.Array, block_rows: jax.Array,
+                     positions: jax.Array, new: jax.Array,
+                     active: jax.Array) -> jax.Array:
+    """Scatter one token's K (or V) per batch slot into the pool.
+
+    cache: [N, Bs, KH, hd]; block_rows: [B, M]; positions: [B] (the
+    sequence index being written); new: [B, KH, hd]; active: [B] bool.
+    Inactive slots are dropped (scattered out of bounds), so a padded
+    slot can never clobber a block owned by a live sequence.
+    """
+    n, bs = cache.shape[0], cache.shape[1]
+    b = positions.shape[0]
+    m = block_rows.shape[1]
+    block_idx = jnp.clip(positions // bs, 0, m - 1)
+    bids = block_rows[jnp.arange(b), block_idx]
+    bids = jnp.where(active, bids, n)  # out of bounds -> dropped
+    return cache.at[bids, positions % bs].set(
+        new.astype(cache.dtype), mode="drop")
+
+
+def paged_write_prefill(cache: jax.Array, block_row: jax.Array,
+                        seq: jax.Array, length: jax.Array) -> jax.Array:
+    """Scatter a prompt's K (or V) sequence into one block-table row.
+
+    cache: [N, Bs, KH, hd]; block_row: [M]; seq: [S, KH, hd] (S is the
+    static prefill bucket); length: scalar int32 — positions >= length
+    are padding and dropped.
+    """
+    n, bs = cache.shape[0], cache.shape[1]
+    s = seq.shape[0]
+    pos = jnp.arange(s)
+    bids = block_row[jnp.clip(pos // bs, 0, block_row.shape[0] - 1)]
+    bids = jnp.where(pos < length, bids, n)  # pad -> dropped
+    return cache.at[bids, pos % bs].set(seq.astype(cache.dtype),
+                                        mode="drop")
+
+
+def paged_attention_decode(q: jax.Array, k_cache: jax.Array,
+                           v_cache: jax.Array, block_rows: jax.Array,
+                           lengths: jax.Array) -> jax.Array:
+    """One-token-per-slot attention over the paged context.
+
+    q: [B, H, hd]; k_cache/v_cache: [N, Bs, KH, hd]; block_rows: [B, M];
+    lengths: [B] — number of valid context positions (INCLUDING the
+    token just written this step). GQA (KH < H) broadcasts KV heads.
+    Returns [B, H, hd] in q's dtype; math in f32.
+    """
+    b, h, hd = q.shape
+    kh = k_cache.shape[2]
+    k = paged_gather_kv(k_cache, block_rows)  # [B, S, KH, hd]
+    v = paged_gather_kv(v_cache, block_rows)
+    if kh != h:
+        rep = h // kh
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    s = k.shape[1]
+    scores = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) / math.sqrt(hd)
+    mask = jnp.arange(s)[None, :] < lengths[:, None]          # [B, S]
+    scores = jnp.where(mask[:, None, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhs,bshd->bhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)

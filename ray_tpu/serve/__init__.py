@@ -34,9 +34,19 @@ from .multiplex import (get_multiplexed_model_id,  # noqa: F401
 __all__ = [
     "AutoscalingConfig", "Application", "Deployment", "DeploymentHandle",
     "MeshDeployment", "delete", "deployment", "get_deployment_handle",
-    "get_multiplexed_model_id", "multiplexed", "run", "shutdown",
+    "get_multiplexed_model_id", "llm", "multiplexed", "run", "shutdown",
     "start_grpc_proxy", "start_http_proxy", "status",
 ]
+
+
+def __getattr__(name):
+    # serve.llm pulls in jax + the model zoo; load it lazily so plain
+    # serve users (and the controller actor) never pay that import
+    if name == "llm":
+        import importlib
+
+        return importlib.import_module(".llm", __name__)
+    raise AttributeError(name)
 
 
 @dataclass

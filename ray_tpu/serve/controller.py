@@ -223,7 +223,12 @@ class ServeController:
             try:
                 info = ray_tpu.get(ref, timeout=timeout)
                 r.starting = False
-                r.last_ongoing = int(info.get("ongoing", 0))
+                # autoscaling load = max(in-flight RPCs, app-reported
+                # backlog): streaming/engine replicas report queue_depth
+                # in the ping (replica.py) — in-flight alone undercounts
+                # a deep engine queue behind one streaming call
+                r.last_ongoing = max(int(info.get("ongoing", 0)),
+                                     int(info.get("queue_depth", 0)))
             except Exception:
                 grace = st.config.health_check_timeout_s * 3
                 if r.starting and time.monotonic() - r.started_at < grace:

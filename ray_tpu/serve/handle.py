@@ -49,6 +49,27 @@ class DeploymentResponse:
         return self._fut.done()
 
 
+async def executor_anext(next_fn):
+    """One async pull of a blocking `.next()`-style iterator: the call
+    hops to the default executor so the caller's event loop stays free
+    — the shape async serve deployments and the LLM token streams need
+    (serve.llm's TokenStream shares this). Raises StopAsyncIteration
+    when the iterator is exhausted."""
+    import asyncio
+
+    def pull():
+        try:
+            return (False, next_fn())
+        except StopIteration:
+            return (True, None)
+
+    done, item = await asyncio.get_running_loop().run_in_executor(
+        None, pull)
+    if done:
+        raise StopAsyncIteration
+    return item
+
+
 class DeploymentResponseGenerator:
     """Iterator over a streaming deployment response (ref: handle.py
     DeploymentResponseGenerator). Wraps the core ObjectRefGenerator:
@@ -76,6 +97,14 @@ class DeploymentResponseGenerator:
         ref = self._gen.next(timeout=timeout)
         return ray_tpu.get(ref, timeout=max(0.0,
                                             deadline - time.monotonic()))
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        """Async iteration (`async for chunk in handle.options(
+        stream=True).remote(...)`)."""
+        return await executor_anext(lambda: self.next(timeout=600.0))
 
 
 class DeploymentHandle:

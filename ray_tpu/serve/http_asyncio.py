@@ -154,10 +154,11 @@ class AsyncHTTPProxy:
             data = {k: v[0] if len(v) == 1 else v for k, v in q.items()
                     if k not in ("stream", "model_id")} or None
         mux = (q.get("model_id") or [""])[0]
-        stream = (q.get("stream") or ["0"])[0] in ("1", "true")
-        if stream:
+        stream_mode = (q.get("stream") or ["0"])[0]
+        if stream_mode in ("1", "true", "sse"):
             try:
-                ok = await self._stream_response(writer, name, data, mux)
+                ok = await self._stream_response(writer, name, data, mux,
+                                                 sse=stream_mode == "sse")
             except Exception as e:  # noqa: BLE001 — pre-header failure
                 # nothing on the wire yet (submission/iterator setup
                 # failed): a normal 500 is still possible
@@ -183,16 +184,19 @@ class AsyncHTTPProxy:
                              {"error": f"{type(e).__name__}: {e}"}, keep)
         return keep
 
-    async def _stream_response(self, writer, name, data, mux) -> bool:
-        """Chunked NDJSON: generator items are pulled on the pool (each
-        next() blocks on the replica) and written as they arrive.
-        Exceptions BEFORE the headers go out propagate (caller sends a
-        500); a mid-stream failure closes the connection and returns
-        False."""
+    async def _stream_response(self, writer, name, data, mux,
+                               sse: bool = False) -> bool:
+        """Chunked streaming: generator items are pulled on the pool
+        (each next() blocks on the replica) and written as they arrive —
+        NDJSON lines by default, SSE `data:` frames with a terminal
+        `event: done` under ?stream=sse. Exceptions BEFORE the headers
+        go out propagate (caller sends a 500); a mid-stream failure
+        closes the connection and returns False."""
         gen = self._get_handle(name).options(
             stream=True, multiplexed_model_id=mux).remote(data)
+        ctype = b"text/event-stream" if sse else b"application/x-ndjson"
         writer.write(b"HTTP/1.1 200 OK\r\n"
-                     b"Content-Type: application/x-ndjson\r\n"
+                     b"Content-Type: " + ctype + b"\r\n"
                      b"Transfer-Encoding: chunked\r\n\r\n")
         _SENTINEL = object()
 
@@ -205,12 +209,18 @@ class AsyncHTTPProxy:
                 return gen.next(timeout=600.0)
             except StopIteration:
                 return _SENTINEL
+        def frame(item) -> bytes:
+            body = json.dumps(_jsonable(item)).encode()
+            if sse:
+                return b"data: " + body + b"\n\n"
+            return body + b"\n"
+
         try:
             while True:
                 item = await self._in_pool(pull)
                 if item is _SENTINEL:
                     break
-                payload = json.dumps(_jsonable(item)).encode() + b"\n"
+                payload = frame(item)
                 writer.write(f"{len(payload):X}\r\n".encode())
                 writer.write(payload + b"\r\n")
                 await writer.drain()
@@ -219,6 +229,11 @@ class AsyncHTTPProxy:
             # sees a framing error, not a truncated-but-"complete" stream
             writer.close()
             return False
+        if sse:
+            # terminal frame: SSE clients can't tell finished from dropped
+            done = b"event: done\ndata: [DONE]\n\n"
+            writer.write(f"{len(done):X}\r\n".encode())
+            writer.write(done + b"\r\n")
         writer.write(b"0\r\n\r\n")
         return True
 

@@ -44,23 +44,36 @@ class HTTPProxy:
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _stream_reply(self, gen) -> None:
-                """Chunked transfer of a streaming deployment: one JSON
-                line per yielded chunk (ref: http_proxy.py:775 streaming
-                via ASGI; NDJSON is the framework-free equivalent)."""
+            def _stream_reply(self, gen, sse: bool = False) -> None:
+                """Chunked transfer of a streaming deployment. Two
+                framings over the same chunked wire: NDJSON (one JSON
+                line per yielded chunk — ref: http_proxy.py:775
+                streaming via ASGI) and SSE (`?stream=sse` —
+                text/event-stream `data:` frames closed by an
+                `event: done` frame, the framing LLM token clients
+                expect)."""
                 self.send_response(200)
-                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Content-Type",
+                                 "text/event-stream" if sse
+                                 else "application/x-ndjson")
                 self.send_header("Transfer-Encoding", "chunked")
+                if sse:
+                    self.send_header("Cache-Control", "no-cache")
                 self.end_headers()
 
                 def chunk(b: bytes) -> None:
                     self.wfile.write(f"{len(b):X}\r\n".encode())
                     self.wfile.write(b + b"\r\n")
 
+                def frame(item) -> bytes:
+                    body = json.dumps(proxy._jsonable(item)).encode()
+                    if sse:
+                        return b"data: " + body + b"\n\n"
+                    return body + b"\n"
+
                 try:
                     for item in gen:
-                        chunk(json.dumps(proxy._jsonable(item)).encode()
-                              + b"\n")
+                        chunk(frame(item))
                 except Exception:  # noqa: BLE001
                     # headers are already on the wire: a clean terminator
                     # would present the truncated stream as success, and a
@@ -68,6 +81,10 @@ class HTTPProxy:
                     # the connection so the client sees a framing error
                     self.close_connection = True
                     return
+                if sse:
+                    # explicit terminal frame: SSE clients can't tell a
+                    # finished stream from a dropped one without it
+                    chunk(b"event: done\ndata: [DONE]\n\n")
                 self.wfile.write(b"0\r\n\r\n")
 
             def _dispatch(self, data) -> None:
@@ -83,11 +100,12 @@ class HTTPProxy:
                 try:
                     h = proxy._get_handle(name)
                     mux = (q.get("model_id") or [""])[0]
-                    if (q.get("stream") or ["0"])[0] in ("1", "true"):
+                    stream_mode = (q.get("stream") or ["0"])[0]
+                    if stream_mode in ("1", "true", "sse"):
                         gen = h.options(stream=True,
                                         multiplexed_model_id=mux
                                         ).remote(data)
-                        self._stream_reply(gen)
+                        self._stream_reply(gen, sse=stream_mode == "sse")
                         return
                     if mux:
                         h = h.options(multiplexed_model_id=mux)

@@ -1,0 +1,20 @@
+"""ray_tpu.serve.llm — continuous-batching LLM inference engine.
+
+The Serve-side LLM core (ROADMAP item 1): a paged KV cache over a
+preallocated block pool (kv_cache.py + ops/paged_attention.py), an
+iteration-level scheduler that admits prefills into running decode
+batches under token/block budgets and preempts-and-requeues on
+allocation failure (engine.py), a serve deployment with streaming token
+responses (deployment.py), and an optional disaggregated prefill/decode
+mode over compiled-graph channels (disagg.py). See docs/LLM_SERVE.md.
+"""
+from .deployment import LLMServer, build_model
+from .disagg import DecodeStage, DisaggLLM, PrefillStage
+from .engine import EngineConfig, LLMEngine, Request, TokenStream
+from .kv_cache import BlockPool, blocks_for_tokens
+
+__all__ = [
+    "BlockPool", "DecodeStage", "DisaggLLM", "EngineConfig", "LLMEngine",
+    "LLMServer", "PrefillStage", "Request", "TokenStream", "build_model",
+    "blocks_for_tokens",
+]

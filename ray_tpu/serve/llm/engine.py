@@ -1,0 +1,579 @@
+"""LLMEngine — continuous (iteration-level) batching over a paged KV cache.
+
+The modern LLM-serving core (vLLM/Orca-style, PAPERS.md) on this
+runtime's models: one engine owns a block-pool KV cache
+(`model.init_paged_cache`) and runs a scheduler loop where every
+iteration (a) admits waiting prompts into the running batch under a
+prefill-token budget and the block budget, (b) runs ONE fixed-shape
+decode step for every resident sequence, (c) retires finished sequences
+(EOS / max_tokens) and frees their blocks, and (d) preempts the
+latest-admitted sequence back to the waiting queue when the pool can't
+grow a running one — greedy decode makes the requeued continuation
+produce exactly the tokens the unpreempted run would have.
+
+XLA compiles a handful of programs, not one per request: decode is a
+single `(max_batch,)` program; prefill compiles once per bucket in
+`prefill_buckets` (prompts pad up to the nearest bucket).
+
+    engine = LLMEngine(model, params, EngineConfig(max_batch=8))
+    engine.start()                       # background scheduler thread
+    stream = engine.add_request([1, 5, 9], max_tokens=32)
+    for tok in stream: ...               # sync; `async for` also works
+
+Metrics (OBSERVABILITY.md schema): `ray_tpu_llm_queue_depth`,
+`ray_tpu_llm_kv_blocks_used`, `ray_tpu_llm_tokens_per_s` gauges and
+`ray_tpu_llm_ttft_seconds` / `ray_tpu_llm_tpot_seconds` histograms, all
+tagged by engine name — shipped to the head scrape by the standard
+worker delta path and consumed by the serve autoscaler via the
+replica's queue_depth (replica.py / controller.py).
+"""
+from __future__ import annotations
+
+import collections
+import functools
+import itertools
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...util import metrics as _metrics
+from .kv_cache import BlockPool, blocks_for_tokens
+
+_G_QUEUE = _metrics.Gauge(
+    "ray_tpu_llm_queue_depth",
+    "LLM engine requests waiting + running", tag_keys=("engine",))
+_G_BLOCKS = _metrics.Gauge(
+    "ray_tpu_llm_kv_blocks_used",
+    "KV-cache pool blocks currently allocated", tag_keys=("engine",))
+_G_TOKPS = _metrics.Gauge(
+    "ray_tpu_llm_tokens_per_s",
+    "generated tokens/s over the trailing window", tag_keys=("engine",))
+_H_TTFT = _metrics.Histogram(
+    "ray_tpu_llm_ttft_seconds",
+    "time to first token (submission -> first emit, queue wait included)",
+    tag_keys=("engine",))
+_H_TPOT = _metrics.Histogram(
+    "ray_tpu_llm_tpot_seconds",
+    "time per output token during decode (inter-token latency)",
+    boundaries=_metrics.FAST_BOUNDARIES, tag_keys=("engine",))
+
+
+@dataclass
+class EngineConfig:
+    """Scheduler + cache knobs (docs/LLM_SERVE.md)."""
+    block_size: int = 16
+    num_blocks: int = 128
+    max_batch: int = 8                 # decode program batch (slots)
+    max_blocks_per_seq: int = 16       # block-table width (M)
+    # prefill-token admission budget per scheduler iteration; at least
+    # one waiting request is always admitted so a long prompt can't starve
+    max_prefill_tokens_per_step: int = 256
+    prefill_buckets: Tuple[int, ...] = (16, 32, 64, 128, 256)
+    eos_id: Optional[int] = None       # engine-wide default EOS
+    idle_sleep_s: float = 0.002        # background-loop sleep when idle
+
+    @property
+    def max_context(self) -> int:
+        """Longest context a sequence can hold in its block table."""
+        return self.max_blocks_per_seq * self.block_size
+
+
+class TokenStream:
+    """Per-request token iterator — sync (`for tok in stream`) and async
+    (`async for tok in stream`) views over the same queue. The engine
+    pushes tokens as the scheduler emits them; a sentinel closes the
+    stream with `finish_reason` in {"eos","length","error"}."""
+
+    _DONE = object()
+
+    def __init__(self, request_id: str):
+        self.request_id = request_id
+        self.finish_reason: Optional[str] = None
+        self.error: Optional[BaseException] = None
+        self._q: "queue.Queue" = queue.Queue()
+        self._consumed_done = False
+
+    # engine side ----------------------------------------------------------
+    def _put(self, tok: int) -> None:
+        self._q.put(tok)
+
+    def _finish(self, reason: str,
+                error: Optional[BaseException] = None) -> None:
+        self.finish_reason = reason
+        self.error = error
+        self._q.put(self._DONE)
+
+    # consumer side --------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> int:
+        return self.next()
+
+    def next(self, timeout: Optional[float] = 300.0) -> int:
+        if self._consumed_done:
+            raise StopIteration
+        try:
+            item = self._q.get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError(
+                f"{self.request_id}: no token within {timeout}s") from None
+        if item is self._DONE:
+            self._consumed_done = True
+            if self.error is not None:
+                raise self.error
+            raise StopIteration
+        return item
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> int:
+        from ..handle import executor_anext
+
+        return await executor_anext(self.next)
+
+    def tokens(self, timeout: Optional[float] = 300.0) -> List[int]:
+        """Drain to completion -> the full completion, in order."""
+        out = []
+        while True:
+            try:
+                out.append(self.next(timeout=timeout))
+            except StopIteration:
+                return out
+
+
+@dataclass
+class Request:
+    request_id: str
+    prompt: List[int]                  # context to (re-)prefill
+    max_tokens: int
+    eos_id: Optional[int]
+    stream: TokenStream
+    submitted_at: float
+    generated: List[int] = field(default_factory=list)
+    first_token_at: Optional[float] = None
+    preemptions: int = 0
+
+
+class _Sequence:
+    """A running request's batch-slot state."""
+
+    __slots__ = ("req", "slot", "blocks", "seq_len", "pending",
+                 "last_emit_at")
+
+    def __init__(self, req: Request, slot: int, blocks: List[int],
+                 seq_len: int, pending: int):
+        self.req = req
+        self.slot = slot
+        self.blocks = blocks           # pool block ids, table order
+        self.seq_len = seq_len         # tokens whose KV is in cache
+        self.pending = pending         # emitted token awaiting its KV write
+        self.last_emit_at = time.perf_counter()
+
+
+class LLMEngine:
+    """One replica's inference engine. Thread-safe: `add_request` may be
+    called from any thread; the scheduler runs either on the background
+    thread (`start()`) or driven explicitly (`step()` /
+    `run_until_idle()` — never both)."""
+
+    _ids = itertools.count()
+
+    def __init__(self, model: Any, params: Dict[str, Any],
+                 config: Optional[EngineConfig] = None, name: str = ""):
+        import jax
+
+        self.model = model
+        self.params = params
+        cfg = config or EngineConfig()
+        buckets = tuple(sorted(set(
+            min(b, cfg.max_context, model.config.max_seq)
+            for b in cfg.prefill_buckets)))
+        if not buckets:
+            raise ValueError("prefill_buckets must be non-empty")
+        self.config = cfg
+        self.buckets = buckets
+        self.max_prompt = buckets[-1]
+        # hard context ceiling: the block table AND the model's trained
+        # positions — past max_seq the embedding/RoPE gathers clamp
+        # under jit and silently reuse the last row
+        self.max_seq_len = min(cfg.max_context, model.config.max_seq)
+        self.name = name or f"llm-{next(self._ids)}"
+        self.pool = BlockPool(cfg.num_blocks)
+        self._cache = model.init_paged_cache(cfg.num_blocks, cfg.block_size)
+        self._lock = threading.RLock()
+        self._waiting: "collections.deque[Request]" = collections.deque()
+        self._running: List[_Sequence] = []
+        self._free_slots = list(range(cfg.max_batch - 1, -1, -1))
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._total_generated = 0
+        self._total_preemptions = 0
+        self._tok_events: "collections.deque" = collections.deque()
+
+        # two jit entry points; jax caches one compiled program per
+        # argument shape, so decode compiles once and prefill once per
+        # bucket — the buckets BOUND the program count
+        @functools.partial(jax.jit)
+        def _decode(params, kc, vc, tokens, positions, rows, active):
+            logits, cache = model.paged_decode_step(
+                params, {"k": kc, "v": vc}, tokens, positions, rows, active)
+            return logits, cache["k"], cache["v"]
+
+        @functools.partial(jax.jit)
+        def _prefill(params, kc, vc, tokens, length, block_row):
+            logits, cache = model.paged_prefill(
+                params, {"k": kc, "v": vc}, tokens, length, block_row)
+            return logits, cache["k"], cache["v"]
+
+        self._decode_fn = _decode
+        self._prefill_fn = _prefill
+
+    # -- request intake -------------------------------------------------------
+
+    def add_request(self, prompt: Sequence[int], max_tokens: int = 16,
+                    eos_id: Any = "__default__",
+                    request_id: Optional[str] = None) -> TokenStream:
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) > self.max_prompt:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds engine capacity "
+                f"{self.max_prompt} (largest prefill bucket)")
+        if max_tokens < 1:
+            raise ValueError(f"max_tokens must be >= 1, got {max_tokens}")
+        rid = request_id or f"req-{next(self._ids)}"
+        stream = TokenStream(rid)
+        req = Request(rid, prompt, int(max_tokens),
+                      self.config.eos_id if eos_id == "__default__"
+                      else eos_id,
+                      stream, time.perf_counter())
+        with self._lock:
+            self._waiting.append(req)
+            self._update_gauges()
+        return stream
+
+    def add_prefilled(self, prompt: Sequence[int], kv_blocks: Dict[str, Any],
+                      first_token: int, max_tokens: int = 16,
+                      eos_id: Any = "__default__",
+                      timeout: float = 60.0) -> TokenStream:
+        """Disaggregated-prefill intake: the prompt's KV was computed by a
+        prefill stage (disagg.py) and arrives as block-shaped arrays
+        k/v [L, nb, block_size, KH, hd]; this engine copies them into
+        freshly allocated pool blocks and the sequence enters decode
+        directly — no local prefill pass."""
+        import jax.numpy as jnp
+
+        prompt = [int(t) for t in prompt]
+        nb = int(kv_blocks["k"].shape[1])
+        if nb != blocks_for_tokens(len(prompt), self.config.block_size):
+            raise ValueError(
+                f"shipped {nb} blocks for a {len(prompt)}-token prompt "
+                f"(block_size {self.config.block_size})")
+        rid = f"req-{next(self._ids)}"
+        stream = TokenStream(rid)
+        req = Request(rid, prompt, int(max_tokens),
+                      self.config.eos_id if eos_id == "__default__"
+                      else eos_id,
+                      stream, time.perf_counter())
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                blocks = self.pool.alloc(nb)
+                slot = self._free_slots.pop() if (
+                    blocks is not None and self._free_slots) else None
+                if blocks is not None and slot is None:
+                    self.pool.free(blocks)
+                    blocks = None
+                if blocks is not None:
+                    idx = jnp.asarray(blocks, jnp.int32)
+                    self._cache = {
+                        "k": self._cache["k"].at[:, idx].set(
+                            jnp.asarray(kv_blocks["k"],
+                                        self._cache["k"].dtype)),
+                        "v": self._cache["v"].at[:, idx].set(
+                            jnp.asarray(kv_blocks["v"],
+                                        self._cache["v"].dtype)),
+                    }
+                    seq = _Sequence(req, slot, blocks, len(prompt),
+                                    int(first_token))
+                    self._running.append(seq)
+                    req.first_token_at = time.perf_counter()
+                    _H_TTFT.observe(req.first_token_at - req.submitted_at,
+                                    tags={"engine": self.name})
+                    self._emit(seq, int(first_token), decode_step=False)
+                    self._update_gauges()
+                    return stream
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"{self.name}: no capacity for prefilled sequence "
+                    f"({nb} blocks) after {timeout}s")
+            time.sleep(0.005)
+
+    # -- scheduler ------------------------------------------------------------
+
+    def step(self) -> bool:
+        """One scheduler iteration: retire/admit/decode. Returns True if
+        any work was done (callers can sleep when False)."""
+        with self._lock:
+            admitted = self._admit()
+            decoded = self._decode_iteration()
+            self._update_gauges()
+            return admitted or decoded
+
+    def _admit(self) -> bool:
+        cfg = self.config
+        budget = cfg.max_prefill_tokens_per_step
+        admitted = False
+        while self._waiting and self._free_slots:
+            req = self._waiting[0]
+            p = len(req.prompt)
+            if p > self.max_prompt:
+                # grew past capacity through preemption requeues
+                self._waiting.popleft()
+                req.stream._finish("error", RuntimeError(
+                    f"{req.request_id}: context {p} exceeds engine "
+                    f"capacity {self.max_prompt}"))
+                continue
+            if admitted and p > budget:
+                break                     # token budget for this iteration
+            nb = blocks_for_tokens(p, cfg.block_size)
+            blocks = self.pool.alloc(nb)
+            if blocks is None:
+                if not self._running and nb > self.pool.num_blocks:
+                    self._waiting.popleft()
+                    req.stream._finish("error", RuntimeError(
+                        f"{req.request_id}: prompt needs {nb} blocks; "
+                        f"pool holds {self.pool.num_blocks}"))
+                    continue
+                break                     # wait for decode frees/preemption
+            self._waiting.popleft()
+            budget -= p
+            admitted = True
+            self._prefill_into(req, blocks)
+        return admitted
+
+    def _prefill_into(self, req: Request, blocks: List[int]) -> None:
+        import jax.numpy as jnp
+
+        cfg = self.config
+        p = len(req.prompt)
+        bucket = next(b for b in self.buckets if b >= p)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :p] = req.prompt
+        row = np.full((cfg.max_blocks_per_seq,), -1, np.int32)
+        row[:len(blocks)] = blocks
+        logits, kc, vc = self._prefill_fn(
+            self.params, self._cache["k"], self._cache["v"],
+            jnp.asarray(toks), jnp.int32(p), jnp.asarray(row))
+        self._cache = {"k": kc, "v": vc}
+        first = int(np.asarray(logits).argmax())
+        slot = self._free_slots.pop()
+        seq = _Sequence(req, slot, blocks, p, first)
+        self._running.append(seq)
+        now = time.perf_counter()
+        if req.first_token_at is None:
+            req.first_token_at = now
+            _H_TTFT.observe(now - req.submitted_at,
+                            tags={"engine": self.name})
+        self._emit(seq, first, decode_step=False)
+
+    def _decode_iteration(self) -> bool:
+        cfg = self.config
+        if not self._running:
+            return False
+        # grow block tables for this iteration's writes; preempt the
+        # latest-admitted sequence when the pool is out of blocks
+        i = 0
+        while i < len(self._running):
+            seq = self._running[i]
+            # this iteration writes the pending token at position
+            # seq_len, so the context must still have room for it
+            if seq.seq_len >= self.max_seq_len:
+                self._retire(seq, "length")
+                continue
+            need = seq.seq_len // cfg.block_size + 1
+            if need > cfg.max_blocks_per_seq:
+                self._retire(seq, "length")
+                continue
+            if need > len(seq.blocks):
+                got = self.pool.alloc(need - len(seq.blocks))
+                if got is None:
+                    victim = self._running[-1]
+                    if victim is seq and len(self._running) == 1:
+                        # sole runner and the pool still can't grow it:
+                        # blocks are held outside this engine — fail loud
+                        self._retire(seq, "error", RuntimeError(
+                            f"{seq.req.request_id}: KV pool exhausted with "
+                            f"no preemptible sequence"))
+                        continue
+                    self._preempt(victim)
+                    if victim is seq:
+                        continue          # seq left the running list
+                    continue              # retry the same seq
+                seq.blocks.extend(got)
+            i += 1
+        if not self._running:
+            return False
+        import jax.numpy as jnp
+
+        b, m = cfg.max_batch, cfg.max_blocks_per_seq
+        tokens = np.zeros((b,), np.int32)
+        positions = np.zeros((b,), np.int32)
+        rows = np.full((b, m), -1, np.int32)
+        active = np.zeros((b,), bool)
+        for seq in self._running:
+            tokens[seq.slot] = seq.pending
+            positions[seq.slot] = seq.seq_len
+            rows[seq.slot, :len(seq.blocks)] = seq.blocks
+            active[seq.slot] = True
+        logits, kc, vc = self._decode_fn(
+            self.params, self._cache["k"], self._cache["v"],
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(rows), jnp.asarray(active))
+        self._cache = {"k": kc, "v": vc}
+        arr = np.asarray(logits)
+        emitted = 0
+        for seq in list(self._running):
+            seq.seq_len += 1              # pending's KV landed this step
+            tok = int(arr[seq.slot].argmax())
+            seq.pending = tok
+            self._emit(seq, tok, decode_step=True)
+            emitted += 1
+        now = time.perf_counter()
+        self._tok_events.append((now, emitted))
+        self._total_generated += emitted
+        return True
+
+    def _emit(self, seq: _Sequence, tok: int, decode_step: bool) -> None:
+        req = seq.req
+        now = time.perf_counter()
+        if decode_step:
+            _H_TPOT.observe(now - seq.last_emit_at,
+                            tags={"engine": self.name})
+        seq.last_emit_at = now
+        req.generated.append(tok)
+        req.stream._put(tok)
+        if req.eos_id is not None and tok == req.eos_id:
+            self._retire(seq, "eos")
+        elif len(req.generated) >= req.max_tokens:
+            self._retire(seq, "length")
+
+    def _retire(self, seq: _Sequence, reason: str,
+                error: Optional[BaseException] = None) -> None:
+        self._running.remove(seq)
+        self.pool.free(seq.blocks)
+        self._free_slots.append(seq.slot)
+        seq.req.stream._finish(reason, error)
+
+    def _preempt(self, seq: _Sequence) -> None:
+        """Free everything the sequence holds and requeue it at the front
+        of the waiting queue with prompt = full context so far; greedy
+        re-prefill continues the exact token sequence."""
+        self._running.remove(seq)
+        self.pool.free(seq.blocks)
+        self._free_slots.append(seq.slot)
+        req = seq.req
+        # full context to re-prefill = what this run prefilled plus every
+        # token it generated (seq_len - prefill_len KV writes + pending)
+        n_new = seq.seq_len - len(req.prompt) + 1
+        req.prompt = list(req.prompt) + req.generated[-n_new:]
+        req.preemptions += 1
+        self._total_preemptions += 1
+        self._waiting.appendleft(req)
+
+    # -- loop drivers ---------------------------------------------------------
+
+    def start(self) -> None:
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"llm-engine-{self.name}")
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                worked = self.step()
+            except Exception as e:  # noqa: BLE001 — fail every stream loud
+                self._fail_all(e)
+                worked = False
+            if not worked:
+                self._stop.wait(self.config.idle_sleep_s)
+
+    def _fail_all(self, error: BaseException) -> None:
+        with self._lock:
+            for seq in list(self._running):
+                self._retire(seq, "error", error)
+            while self._waiting:
+                self._waiting.popleft().stream._finish("error", error)
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+        self._thread = None
+
+    def is_alive(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def run_until_idle(self, timeout: float = 300.0) -> None:
+        """Drive the scheduler inline until no request is waiting or
+        running (bench/test mode; don't mix with start())."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                idle = not self._waiting and not self._running
+            if idle:
+                return
+            self.step()
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"{self.name}: not idle after {timeout}s")
+
+    # -- introspection --------------------------------------------------------
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._waiting) + len(self._running)
+
+    def _tokens_per_s(self, window_s: float = 10.0) -> float:
+        now = time.perf_counter()
+        while self._tok_events and now - self._tok_events[0][0] > window_s:
+            self._tok_events.popleft()
+        if len(self._tok_events) < 2:
+            return 0.0
+        span = now - self._tok_events[0][0]
+        return sum(n for _, n in self._tok_events) / max(span, 1e-6)
+
+    def _update_gauges(self) -> None:
+        tags = {"engine": self.name}
+        _G_QUEUE.set(len(self._waiting) + len(self._running), tags=tags)
+        _G_BLOCKS.set(self.pool.used_count, tags=tags)
+        _G_TOKPS.set(round(self._tokens_per_s(), 1), tags=tags)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "engine": self.name,
+                "waiting": len(self._waiting),
+                "running": len(self._running),
+                "queue_depth": len(self._waiting) + len(self._running),
+                "kv_blocks_used": self.pool.used_count,
+                "kv_blocks_total": self.pool.num_blocks,
+                "kv_occupancy": round(
+                    self.pool.used_count / self.pool.num_blocks, 4),
+                "tokens_per_s": round(self._tokens_per_s(), 1),
+                "total_generated": self._total_generated,
+                "preemptions": self._total_preemptions,
+            }

@@ -1,0 +1,67 @@
+"""Block pool + block tables — the host-side half of the paged KV cache.
+
+The device arrays live in the engine (``model.init_paged_cache``); this
+module owns the *accounting*: which pool blocks are free, which sequence
+holds which blocks, and the alloc/free discipline whose failure path is
+preemption-and-requeue (engine.py). Kept separate so leak/accounting
+invariants are testable without touching jax at all.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class BlockPool:
+    """Fixed pool of KV blocks. alloc() is all-or-nothing: a partial
+    grant would deadlock two growing sequences against each other."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks <= 0:
+            raise ValueError(f"num_blocks must be positive, got {num_blocks}")
+        self.num_blocks = num_blocks
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        self._used = 0
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self._used
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n blocks, or None when the pool can't satisfy the request
+        (caller preempts or waits). n == 0 returns []."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        self._used += n
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if not 0 <= b < self.num_blocks:
+                raise ValueError(f"free of unknown block {b}")
+        if self._used < len(blocks):
+            raise ValueError("double free: more blocks returned than held")
+        self._used -= len(blocks)
+        self._free.extend(blocks)
+
+    def check_leaks(self) -> None:
+        """Invariant: every block is either free or accounted used."""
+        if len(self._free) + self._used != self.num_blocks:
+            raise AssertionError(
+                f"block leak: {len(self._free)} free + {self._used} used "
+                f"!= {self.num_blocks}")
+        if len(set(self._free)) != len(self._free):
+            raise AssertionError("duplicate block in free list")
+
+
+def blocks_for_tokens(num_tokens: int, block_size: int) -> int:
+    """Blocks needed to hold positions [0, num_tokens)."""
+    if num_tokens <= 0:
+        return 0
+    return (num_tokens - 1) // block_size + 1

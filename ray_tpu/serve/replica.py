@@ -103,8 +103,19 @@ class Replica:
         check = getattr(self._callable, "check_health", None)
         if callable(check):
             check()
-        return {"ok": True, "version": self._version,
+        info = {"ok": True, "version": self._version,
                 "ongoing": self._ongoing, "total": self._total}
+        # user callables with their own backlog (the LLM engine's
+        # waiting+running depth) expose queue_len(); shipping it in the
+        # ping lets the controller autoscale on engine backlog, which
+        # in-flight RPC counts undercount once requests stream
+        qfn = getattr(self._callable, "queue_len", None)
+        if callable(qfn):
+            try:
+                info["queue_depth"] = int(qfn())
+            except Exception:
+                pass
+        return info
 
     def queue_len(self) -> int:
         return self._ongoing

@@ -39,7 +39,14 @@ def test_ppo_example():
 
 
 def test_llama_serve_example():
-    out = _run("llama_serve.py", timeout=300)
+    out = _run("llama_serve.py", "--requests", "3", "--max-new", "6",
+               timeout=300)
+    assert "generated token ids:" in out
+    assert "ttft=" in out and "tok/s" in out
+
+
+def test_llama_serve_example_legacy():
+    out = _run("llama_serve.py", "--no-engine", timeout=300)
     assert "generated token ids:" in out
 
 

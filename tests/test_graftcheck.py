@@ -380,6 +380,106 @@ dag = stage.fwd.bind(inp)
 
 
 # ---------------------------------------------------------------------------
+# GC009 — blocking calls inside async serve deployment methods
+
+
+def test_gc009_positive_blocking_get_in_async_method():
+    src = """
+import ray_tpu
+from ray_tpu import serve
+
+@serve.deployment
+class Ingress:
+    async def __call__(self, x):
+        ref = self.downstream.remote(x)
+        return ray_tpu.get(ref)
+"""
+    assert rules_found(src) == ["GC009"]
+
+
+def test_gc009_positive_sync_handle_result():
+    src = """
+from ray_tpu import serve
+
+@serve.deployment(num_replicas=2)
+class Ingress:
+    async def handler(self, x):
+        return self.h.remote(x).result()
+"""
+    assert rules_found(src) == ["GC009"]
+
+
+def test_gc009_positive_sync_helper_called_inline():
+    # a nested def inside the async method inherits the event-loop
+    # context — calling it inline still stalls the loop
+    src = """
+import ray_tpu
+from ray_tpu import serve
+
+@serve.deployment
+class Ingress:
+    async def __call__(self, x):
+        def helper(ref):
+            return ray_tpu.get(ref)
+        return helper(self.h.remote(x))
+"""
+    assert rules_found(src) == ["GC009"]
+
+
+def test_gc009_negative_sync_method_and_await():
+    src = """
+import ray_tpu
+from ray_tpu import serve
+
+@serve.deployment
+class Ingress:
+    def sync_call(self, x):
+        return ray_tpu.get(self.h.remote(x))   # sync method: no loop
+
+    async def good(self, x):
+        return await self.h.remote(x)          # awaited: clean
+"""
+    assert rules_found(src) == []
+
+
+def test_gc009_negative_async_method_outside_deployment():
+    src = """
+import ray_tpu
+
+class NotADeployment:
+    async def __call__(self, x):
+        return ray_tpu.get(self.h.remote(x))
+"""
+    assert rules_found(src) == []
+
+
+def test_gc009_options_chain_decorator():
+    src = """
+import ray_tpu
+from ray_tpu import serve
+
+@serve.deployment(num_replicas=2).options(max_ongoing_requests=4)
+class Ingress:
+    async def __call__(self, x):
+        return ray_tpu.get(self.h.remote(x))
+"""
+    assert rules_found(src) == ["GC009"]
+
+
+def test_gc009_suppression():
+    src = """
+import ray_tpu
+from ray_tpu import serve
+
+@serve.deployment
+class Ingress:
+    async def __call__(self, x):
+        return ray_tpu.get(self.h.remote(x))  # graftcheck: disable=GC009
+"""
+    assert rules_found(src) == []
+
+
+# ---------------------------------------------------------------------------
 # suppressions + CLI
 
 
