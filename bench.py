@@ -144,6 +144,7 @@ def main() -> None:
             **_bench_cgraph_chain(),
             **_bench_dispatch(),
             **_bench_llm_serve(),
+            **_bench_pipeline(),
         },
     }))
 
@@ -247,6 +248,28 @@ def _bench_llm_serve() -> dict:
         from bench_core import llm_serve_bench
 
         return llm_serve_bench(concurrency=4 if SMOKE else 8)
+    except Exception:
+        import traceback
+
+        traceback.print_exc()  # a broken engine must not look like 0
+        return {}
+
+
+def _bench_pipeline() -> dict:
+    """Pipeline training-engine rows (ISSUE 8): compiled-graph 1F1B step
+    time vs the dynamic `.remote()` engine, GPT-tiny pipeline tokens/s,
+    and the ZeRO-sharded vs replicated dp=2 update — tracked per round
+    in the BENCH json detail. CPU actor plane; the in-mesh TPU path is
+    covered by the multichip dryrun."""
+    try:
+        import ray_tpu
+        from bench_core import pipeline_train_bench
+
+        ray_tpu.init(num_cpus=max(4, os.cpu_count() or 4))
+        try:
+            return pipeline_train_bench()
+        finally:
+            ray_tpu.shutdown()
     except Exception:
         import traceback
 

@@ -71,6 +71,8 @@ def chain_roundtrip_us(n_iters: int = 200) -> dict:
         cgraph_us = (time.perf_counter() - t0) / n_iters * 1e6
     finally:
         compiled.teardown()
+        for s in (a, b, c):
+            ray_tpu.kill(s)  # release the leases for later bench phases
     return {
         "remote_chain_roundtrip_us": round(remote_us, 1),
         "cgraph_chain_roundtrip_us": round(cgraph_us, 1),
@@ -255,6 +257,156 @@ def llm_serve_bench(n_requests: int = 0, concurrency: int = 8,
     }
 
 
+def _pipeline_mlp(num_chunks: int, width: int, M: int, mb_size: int = 2):
+    """Compute-light tanh-MLP pipeline fixture (the ISSUE 8 acceptance
+    config measures ENGINE overhead, not matmul time)."""
+    import jax
+    import jax.numpy as jnp
+
+    k = jax.random.PRNGKey(0)
+
+    def mk_mid():
+        def fn(p, x):
+            return jnp.tanh(x @ p["w"] + p["b"])
+        return fn
+
+    def mk_last():
+        def fn(p, x, targets):
+            return jnp.mean((x @ p["w"] + p["b"] - targets) ** 2)
+        return fn
+
+    fns = [mk_mid() for _ in range(num_chunks - 1)] + [mk_last()]
+    params = [
+        {"w": jax.random.normal(jax.random.fold_in(k, i),
+                                (width, width)) * 0.3,
+         "b": jnp.zeros((width,))}
+        for i in range(num_chunks)]
+    xs = jax.random.normal(jax.random.fold_in(k, 91), (M * mb_size, width))
+    ys = jax.random.normal(jax.random.fold_in(k, 92), (M * mb_size, width))
+    mbs = [xs[i * mb_size:(i + 1) * mb_size] for i in range(M)]
+    tgts = [ys[i * mb_size:(i + 1) * mb_size] for i in range(M)]
+    return fns, params, mbs, tgts
+
+
+def _timed_steps(eng, mbs, tgts, warmup: int, timed: int) -> float:
+    """Mean steady-state step seconds (warmup covers compile + channel
+    prime)."""
+    for _ in range(warmup):
+        eng.step(mbs, tgts)
+    t0 = time.perf_counter()
+    for _ in range(timed):
+        eng.step(mbs, tgts)
+    return (time.perf_counter() - t0) / timed
+
+
+def pipeline_train_bench() -> dict:
+    """Pipeline-engine rows (ISSUE 8). Assumes an initialized cluster.
+
+    - ``pipeline_vs_remote_speedup``: steady-state step time of the
+      compiled-graph engine vs the dynamic ``.remote()`` engine at the
+      acceptance config (2 stages x 8 microbatches, compute-light MLP so
+      per-microbatch dispatch is what's measured).
+    - ``pipeline_train_tokens_per_s``: GPT-tiny 2-stage 1F1B throughput
+      on the compiled engine (real tokens; the old engine re-traces
+      ``jax.vjp`` per microbatch on GPT and is benched at the MLP config
+      only — docs/PERF_NOTES.md round 7).
+    - ``zero_update_ms`` vs ``replicated_update_ms``: dp=2 update-phase
+      time and per-replica optimizer-state bytes from the stage reports
+      (adam, single-stage pure-dp engine).
+    """
+    import optax
+
+    from ray_tpu.train.pipeline_cgraph import CompiledPipelineEngine
+    from ray_tpu.train.pipeline_engine import PipelineEngine
+
+    warmup, timed = (1, 2) if SMOKE else (2, 4)
+    out: dict = {}
+
+    # -- old vs new at the acceptance config ------------------------------
+    M = 4 if SMOKE else 8
+    fns, params, mbs, tgts = _pipeline_mlp(2, 32, M)
+    tx = optax.sgd(1e-2)
+    old = PipelineEngine(fns, params, tx=tx)
+    try:
+        old_s = _timed_steps(old, mbs, tgts, warmup, timed)
+    finally:
+        old.shutdown()
+    new = CompiledPipelineEngine(fns, params, tx, num_microbatches=M,
+                                 channel_bytes=1 << 18)
+    try:
+        new_s = _timed_steps(new, mbs, tgts, warmup, timed)
+    finally:
+        new.shutdown()
+    out["pipeline_remote_step_ms"] = round(old_s * 1e3, 2)
+    out["pipeline_cgraph_step_ms"] = round(new_s * 1e3, 2)
+    out["pipeline_vs_remote_speedup"] = round(old_s / new_s, 2)
+    out["pipeline_stages"] = 2
+    out["pipeline_microbatches"] = M
+
+    # -- GPT-tiny tokens/s through the compiled engine --------------------
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models import GPT, GPTConfig
+        from ray_tpu.models.gpt import gpt_pipeline_stages
+
+        cfg = GPTConfig.tiny(dtype=jnp.float32, use_flash=False,
+                             scan_layers=True)
+        model = GPT(cfg)
+        gparams = jax.jit(model.init)(jax.random.PRNGKey(0))
+        stage_fns, stage_params, tied = gpt_pipeline_stages(model, gparams, 2)
+        gM, batch, seq = (2, 2, 64) if SMOKE else (8, 2, 128)
+        tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                    (gM * batch, seq), 0, cfg.vocab_size)
+        targets = jnp.roll(tokens, -1, axis=1)
+        gmbs = [tokens[i * batch:(i + 1) * batch] for i in range(gM)]
+        gtgts = [targets[i * batch:(i + 1) * batch] for i in range(gM)]
+        geng = CompiledPipelineEngine(stage_fns, stage_params,
+                                      optax.adam(1e-3), num_microbatches=gM,
+                                      tied=tied, channel_bytes=1 << 20)
+        try:
+            gpt_s = _timed_steps(geng, gmbs, gtgts, warmup, timed)
+        finally:
+            geng.shutdown()
+        out["pipeline_train_tokens_per_s"] = round(gM * batch * seq / gpt_s, 1)
+        out["pipeline_gpt_step_ms"] = round(gpt_s * 1e3, 2)
+        out["pipeline_gpt_tokens_per_step"] = gM * batch * seq
+    except Exception:
+        import traceback
+
+        traceback.print_exc()  # a broken GPT split must not zero the row
+
+    # -- ZeRO-sharded vs replicated dp=2 update ---------------------------
+    def dp_engine(zero: bool):
+        zfns, zparams, zmbs, ztgts = _pipeline_mlp(
+            1, 16 if SMOKE else 128, 2)
+        eng = CompiledPipelineEngine(
+            [zfns[-1]], [zparams[-1]], optax.adam(1e-3),
+            num_microbatches=2, dp=2, zero_update=zero,
+            channel_bytes=1 << 18)
+        try:
+            _timed_steps(eng, zmbs + zmbs, ztgts + ztgts, warmup, timed)
+            upd_ms = [r["update_ms"] for r in eng.last_reports]
+            opt_bytes = [r["opt_state_bytes"] for r in eng.last_reports]
+        finally:
+            eng.shutdown()
+        return round(max(upd_ms), 3), max(opt_bytes)
+
+    try:
+        zero_ms, zero_bytes = dp_engine(True)
+        repl_ms, repl_bytes = dp_engine(False)
+        out["zero_update_ms"] = zero_ms
+        out["replicated_update_ms"] = repl_ms
+        out["zero_opt_state_bytes_per_replica"] = zero_bytes
+        out["replicated_opt_state_bytes_per_replica"] = repl_bytes
+    except Exception:
+        import traceback
+
+        traceback.print_exc()
+    return out
+
+
 def main() -> int:
     import ray_tpu
 
@@ -393,6 +545,21 @@ def main() -> int:
                       "value": {k: llm[k] for k in
                                 ("llm_ttft_p50_ms", "llm_ttft_p99_ms",
                                  "llm_tpot_p50_ms", "llm_tpot_p99_ms")}}),
+          flush=True)
+
+    # -- pipeline training engine (ISSUE 8: cgraph vs .remote(), ZeRO) ------
+    ray_tpu.kill(c)  # release the Counter lease: the engines' placement
+    # groups need the CPUs, and a starved box skews the A/B step times
+    pipe = pipeline_train_bench()
+    for name in ("pipeline_train_tokens_per_s", "pipeline_vs_remote_speedup",
+                 "zero_update_ms"):
+        if name in pipe:
+            rec = {"metric": name, "value": pipe[name],
+                   "unit": ("x" if name.endswith("speedup") else
+                            "ms" if name.endswith("_ms") else "tokens/s")}
+            print(json.dumps(rec), flush=True)
+            results.append(rec)
+    print(json.dumps({"metric": "pipeline_detail", "value": pipe}),
           flush=True)
 
     ray_tpu.shutdown()

@@ -9,10 +9,14 @@ contract:
 - ``ShmChannel``: a pinned, never-sealed PlasmaStore segment
   (``store.allocate_channel``) shared by two processes on one host. The
   segment head is a tiny seq ledger (write_seq / read_seq / len /
-  closed); the writer spins for slot vacancy (read_seq == write_seq),
-  writes the envelope, and publishes by bumping write_seq; the reader
-  mirrors it. Single-slot occupancy IS the backpressure: a producer can
-  run at most one execution ahead of its consumer.
+  closed); the writer spins for slot vacancy (write_seq - read_seq <
+  slots), writes the envelope, and publishes by bumping write_seq; the
+  reader mirrors it. Slot occupancy IS the backpressure: a producer can
+  run at most ``slots`` envelopes ahead of its consumer. DAG-mode
+  compiled graphs use the classic single slot; the iterative pipeline
+  engine (train/pipeline_cgraph.py) allocates ``slots=num_microbatches``
+  rings so a whole 1F1B round's activations stream without a driver
+  round trip per hop.
 
 - ``QueueChannel``: the cross-node fallback fed by the existing worker
   RPC path — the producer ships the envelope up its node channel
@@ -83,22 +87,41 @@ class _Backoff:
         time.sleep(min(0.002, 0.00005 * (self.spins / 5000.0)))
 
 
+def segment_size(slot_bytes: int, slots: int = 1) -> int:
+    """Bytes to allocate for a channel of `slots` slots each holding
+    envelopes up to `slot_bytes`. Single-slot keeps the original compact
+    layout (len lives in the main header); rings prepend an 8-byte len
+    word to every slot."""
+    if slots <= 1:
+        return HEADER_BYTES + slot_bytes
+    return HEADER_BYTES + slots * (8 + slot_bytes)
+
+
 class ShmChannel:
-    """One endpoint of a single-slot shared-memory channel.
+    """One endpoint of a shared-memory ring channel (`slots` >= 1).
 
     Both endpoints attach to the same segment through a SegmentReader
     mmap; role (reader/writer) is fixed at compile time. `interrupt` is
-    an optional Event polled while blocked (teardown / stop signal)."""
+    an optional Event polled while blocked (teardown / stop signal).
+    slots=1 is the classic compiled-graph single-slot layout; slots>1
+    lays the payload area out as a ring of (len, data) slots indexed by
+    seq % slots — same ledger, deeper backpressure window."""
 
     def __init__(self, reader, name: str, size: int, edge: str = "",
-                 interrupt: Optional[threading.Event] = None):
+                 interrupt: Optional[threading.Event] = None,
+                 slots: int = 1):
         self._segreader = reader
         self._name = name
         self._size = size
         self.edge = edge
         self._interrupt = interrupt
         self._mv = reader.read(name, size)
-        self.capacity = size - HEADER_BYTES
+        self._slots = max(1, int(slots))
+        if self._slots == 1:
+            self.capacity = size - HEADER_BYTES
+        else:
+            self._slot_bytes = (size - HEADER_BYTES) // self._slots
+            self.capacity = self._slot_bytes - 8
 
     # -- ledger ----------------------------------------------------------
 
@@ -138,15 +161,23 @@ class ShmChannel:
         while True:
             self._check_alive()
             w, r, _, _ = self._hdr()
-            if w == r:  # slot vacant
+            if w - r < self._slots:  # a slot is vacant
                 break
             if deadline is not None and time.monotonic() > deadline:
                 raise GetTimeoutError(
                     f"channel {self.edge or self._name}: send timed out "
-                    f"(slot occupied — consumer stalled)")
+                    f"(all {self._slots} slots occupied — consumer "
+                    f"stalled)")
             bo.wait()
-        self._mv[HEADER_BYTES:HEADER_BYTES + len(data)] = data
-        struct.pack_into("<Q", self._mv, 16, len(data))
+        if self._slots == 1:
+            self._mv[HEADER_BYTES:HEADER_BYTES + len(data)] = data
+            struct.pack_into("<Q", self._mv, 16, len(data))
+        else:
+            # _slot_bytes INCLUDES the slot's 8-byte len word — it is
+            # the stride, not the payload capacity (capacity above)
+            off = HEADER_BYTES + (w % self._slots) * self._slot_bytes
+            struct.pack_into("<Q", self._mv, off, len(data))
+            self._mv[off + 8:off + 8 + len(data)] = data
         struct.pack_into("<Q", self._mv, 0, w + 1)  # publish
 
     # -- reader ----------------------------------------------------------
@@ -170,7 +201,12 @@ class ShmChannel:
         # copy out BEFORE releasing the slot: the deserialized value may
         # alias these bytes zero-copy, and the producer overwrites the
         # slot the moment read_seq advances
-        data = bytes(self._mv[HEADER_BYTES:HEADER_BYTES + n])
+        if self._slots == 1:
+            data = bytes(self._mv[HEADER_BYTES:HEADER_BYTES + n])
+        else:
+            off = HEADER_BYTES + (r % self._slots) * self._slot_bytes
+            n = struct.unpack_from("<Q", self._mv, off)[0]
+            data = bytes(self._mv[off + 8:off + 8 + n])
         struct.pack_into("<Q", self._mv, 8, r + 1)  # release the slot
         return data
 
@@ -188,9 +224,13 @@ class ShmChannel:
 
 class QueueChannel:
     """Consumer endpoint of a cross-node edge: a local queue fed by
-    ``cgraph_push`` deliveries relayed through the head. Per-channel seq
-    numbers assert FIFO delivery (the RPC path preserves order; a gap
-    means a routing bug, not data loss)."""
+    ``cgraph_push`` deliveries relayed through the head. Relay hops run
+    on RPC handler POOLS (worker -> agent -> head -> consumer), so two
+    back-to-back envelopes can arrive reordered when the pipeline engine
+    streams a whole microbatch round down one edge; ``deliver`` holds
+    early arrivals in a reorder buffer and releases them to the consumer
+    strictly in seq order. (DAG-mode graphs never have two envelopes in
+    flight per edge, so the buffer stays empty there.)"""
 
     def __init__(self, cid: str, edge: str = "",
                  interrupt: Optional[threading.Event] = None):
@@ -200,9 +240,17 @@ class QueueChannel:
         self._q: "queue_mod.Queue" = queue_mod.Queue()
         self._next_seq = 0
         self._closed = threading.Event()
+        self._dlock = threading.Lock()
+        self._deliver_seq = 0
+        self._pending: dict = {}
 
     def deliver(self, seq: int, data: bytes) -> None:
-        self._q.put((seq, data))
+        with self._dlock:
+            self._pending[seq] = data
+            while self._deliver_seq in self._pending:
+                self._q.put((self._deliver_seq,
+                             self._pending.pop(self._deliver_seq)))
+                self._deliver_seq += 1
 
     def recv(self, timeout: Optional[float] = None) -> bytes:
         deadline = None if timeout is None else time.monotonic() + timeout

@@ -33,7 +33,7 @@ from ..exceptions import (CompiledGraphClosedError, CompiledGraphError,
 from ..util import metrics as _metrics
 from ..util import tracing
 from .channel import (FLAG_ERROR, QueueChannel, RpcSender, ShmChannel,
-                      HEADER_BYTES, pack_envelope, unpack_envelope)
+                      pack_envelope, segment_size, unpack_envelope)
 from .dag import (ClassMethodNode, DAGNode, InputNode, MultiOutputNode,
                   topological_nodes)
 
@@ -427,7 +427,7 @@ def compile_dag(output_node: DAGNode, channel_bytes: Optional[int] = None,
 
 def _compile_into(dag: CompiledDAG, rt, cnodes, input_node, terminals,
                   multi_output: bool) -> None:
-    seg_size = dag._channel_bytes + HEADER_BYTES
+    seg_size = segment_size(dag._channel_bytes)
     dag._multi_output = multi_output
 
     # -- placement: every bound actor must be alive with a resident worker

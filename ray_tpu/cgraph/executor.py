@@ -32,6 +32,15 @@ _H_NODE_EXEC = _metrics.Histogram(
     "ray_tpu_cgraph_node_exec_seconds",
     "compiled-graph per-node method execution time",
     boundaries=_metrics.FAST_BOUNDARIES, tag_keys=("method",))
+_H_STAGE_EXEC = _metrics.Histogram(
+    "ray_tpu_pipeline_stage_exec_seconds",
+    "pipeline-engine per-op compute time on a stage actor",
+    boundaries=_metrics.FAST_BOUNDARIES, tag_keys=("stage",))
+_H_BUBBLE_WAIT = _metrics.Histogram(
+    "ray_tpu_pipeline_bubble_wait_seconds",
+    "pipeline-engine time a stage spent blocked on channel input "
+    "before an op (the 1F1B bubble as observed from inside the stage)",
+    boundaries=_metrics.FAST_BOUNDARIES, tag_keys=("stage",))
 
 _log = get_logger("ray_tpu.cgraph")
 
@@ -49,8 +58,14 @@ class _GraphRun:
         self.stop = threading.Event()
         self.readers: Dict[str, Any] = {}  # cid hex -> channel endpoint
         self.writers: List[Any] = []
+        self.writer_cache: Dict[str, Any] = {}  # cid/shm name -> endpoint
         self.nodes: List[_NodePlan] = []
         self.thread: Optional[threading.Thread] = None
+        # iterative (pipeline) mode: the node list is a per-STEP op
+        # schedule — the same channel is read once per op, never cached
+        # across ops, and pipeline stage metrics are recorded
+        self.iterative = False
+        self.stage_tag = ""
 
 
 class CGraphExecutor:
@@ -134,24 +149,41 @@ class CGraphExecutor:
     def _make_reader(self, spec: dict, run: _GraphRun):
         if spec["kind"] == "shm":
             return ShmChannel(self._segreader, spec["name"], spec["size"],
-                              edge=spec.get("edge", ""), interrupt=run.stop)
+                              edge=spec.get("edge", ""), interrupt=run.stop,
+                              slots=spec.get("slots", 1))
         return QueueChannel(spec["cid"], edge=spec.get("edge", ""),
                             interrupt=run.stop)
 
     def _make_writer(self, spec: dict, run: _GraphRun):
+        # one endpoint per channel per run: several ops write the same
+        # edge in iterative (pipeline) plans — e.g. every microbatch's
+        # fwd shares the activation edge — and a fresh RpcSender per op
+        # would restart its seq stamp at 0 for each (shm endpoints share
+        # the segment ledger, which masked this on single-host graphs)
+        key = spec["name"] if spec["kind"] == "shm" else spec["cid"]
+        cached = run.writer_cache.get(key)
+        if cached is not None:
+            return cached
         if spec["kind"] == "shm":
-            return ShmChannel(self._segreader, spec["name"], spec["size"],
-                              edge=spec.get("edge", ""), interrupt=run.stop)
-        gid = run.graph_id
+            ch = ShmChannel(self._segreader, spec["name"], spec["size"],
+                            edge=spec.get("edge", ""), interrupt=run.stop,
+                            slots=spec.get("slots", 1))
+        else:
+            gid = run.graph_id
 
-        def send(cid, seq, data):
-            self.worker.channel.call(
-                "cgraph_send", {"graph_id": gid, "cid": cid,
-                                "seq": seq, "data": data}, timeout=120)
+            def send(cid, seq, data):
+                self.worker.channel.call(
+                    "cgraph_send", {"graph_id": gid, "cid": cid,
+                                    "seq": seq, "data": data}, timeout=120)
 
-        return RpcSender(send, spec["cid"], edge=spec.get("edge", ""))
+            ch = RpcSender(send, spec["cid"], edge=spec.get("edge", ""))
+        run.writer_cache[key] = ch
+        run.writers.append(ch)
+        return ch
 
     def _build(self, run: _GraphRun, plan: dict, actor) -> None:
+        run.iterative = bool(plan.get("iterative"))
+        run.stage_tag = str(plan.get("stage", ""))
         for spec in plan["in_channels"]:
             run.readers[spec["cid"]] = self._make_reader(spec, run)
         groups = getattr(actor, "_group_pools", {}) or {}
@@ -202,24 +234,47 @@ class CGraphExecutor:
 
     def _iteration(self, run: _GraphRun) -> None:
         local: Dict[str, tuple] = {}  # node key -> ("val", v)|("err", bytes)
-        chan_cache: Dict[str, tuple] = {}  # cid -> (flags, trace, body)
+        # DAG mode caches one envelope per cid per iteration so diamond
+        # fan-outs share a single slot read; iterative (pipeline) plans
+        # read the SAME channel once per op (M microbatches stream
+        # through one edge per step), so caching would replay stale data
+        chan_cache: Optional[Dict[str, tuple]] = (
+            None if run.iterative else {})
+        # iterative mode: errors can reach ops with NO outs (chunk 0's
+        # backward, tied_add) where the envelope would otherwise die —
+        # the step would then report clean losses over corrupted grads.
+        # Latch the first error and ship it from the final op (the
+        # update, whose out is the driver's report channel) instead of
+        # applying an update over a broken accumulation.
+        iter_err: Optional[bytes] = None
+        last = run.nodes[-1] if run.nodes else None
         for np in run.nodes:
             err_bytes = None
             parent_trace = ""
+            t_waited = 0.0
+            n_chan = 0
             args: List[Any] = []
             kwargs: Dict[str, Any] = {}
 
             def resolve(spec):
-                nonlocal err_bytes, parent_trace
+                nonlocal err_bytes, parent_trace, t_waited, n_chan
                 kind = spec[0]
                 if kind == "const":
                     return spec[1]
                 if kind == "chan":
+                    n_chan += 1
                     cid = spec[1]
-                    env = chan_cache.get(cid)
+                    env = None if chan_cache is None \
+                        else chan_cache.get(cid)
                     if env is None:
-                        env = chan_cache[cid] = unpack_envelope(
-                            run.readers[cid].recv())
+                        # time ONLY the blocking recv — deserialization
+                        # below is compute, not 1F1B bubble
+                        t0 = time.perf_counter()
+                        data = run.readers[cid].recv()
+                        t_waited += time.perf_counter() - t0
+                        env = unpack_envelope(data)
+                        if chan_cache is not None:
+                            chan_cache[cid] = env
                     flags, trace, body = env
                     if trace:
                         parent_trace = trace
@@ -238,19 +293,40 @@ class CGraphExecutor:
                 args.append(resolve(spec))
             for k, spec in np.kwargs.items():
                 kwargs[k] = resolve(spec)
+            if run.iterative and n_chan:
+                # ops with no channel inputs (update, tied_grad) would
+                # pad the bubble histogram with guaranteed-zero samples
+                _H_BUBBLE_WAIT.observe(t_waited,
+                                       tags={"stage": run.stage_tag})
             if run.stop.is_set():
                 raise CompiledGraphClosedError("graph stopping")
 
+            if err_bytes is None and run.iterative and np is last \
+                    and iter_err is not None:
+                err_bytes = iter_err  # poison the report, skip the update
             trace_out = ""
             if err_bytes is None:
+                t_exec0 = time.perf_counter()
                 value, err_bytes, trace_out = self._exec_node(
                     np, args, kwargs, parent_trace)
+                if run.iterative:
+                    _H_STAGE_EXEC.observe(time.perf_counter() - t_exec0,
+                                          tags={"stage": run.stage_tag})
             if err_bytes is not None:
-                local[np.key] = ("err", err_bytes)
+                if run.iterative:
+                    iter_err = iter_err or err_bytes
+                else:
+                    local[np.key] = ("err", err_bytes)
                 env = pack_envelope(FLAG_ERROR, trace_out or parent_trace,
                                     err_bytes)
             else:
-                local[np.key] = ("val", value)
+                # iterative (pipeline) plans wire everything through
+                # channels and never use ("local", key) args — retaining
+                # every op's output here would hold all M activations/
+                # cotangents live per step, breaking the bounded 1F1B
+                # in-flight-memory property
+                if not run.iterative:
+                    local[np.key] = ("val", value)
                 body = serialization.dumps(value) if np.outs else b""
                 env = pack_envelope(0, trace_out, body)
             for w in np.outs:

@@ -484,6 +484,18 @@ class GPT:
                             params["wte"].astype(jnp.float32))
         return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
 
+    # ---- pipeline-stage slicing (train/pipeline_cgraph.py) -----------------
+
+    def pipeline_stages(self, params: Dict[str, jax.Array],
+                        num_chunks: int):
+        """Split this GPT into ``num_chunks`` pipeline chunks for the
+        actor-hosted engines: chunk 0 carries the embedding, the last
+        chunk the final LN + tied LM head + loss, layer blocks divide
+        evenly. Returns ``(chunk_fns, chunk_params, tied)`` — with
+        ``num_chunks = P * virtual_stages`` the same entry point feeds
+        both the plain and the interleaved engine."""
+        return gpt_pipeline_stages(self, params, num_chunks)
+
     def _backbone(self, params: Dict[str, jax.Array], tokens: jax.Array,
                   rng: Optional[jax.Array] = None,
                   positions: Optional[jax.Array] = None) -> jax.Array:
@@ -519,3 +531,83 @@ class GPT:
                 lp = {k: v[i] for k, v in layer_params.items()}
                 x = blk(x, lp, rng)
         return layernorm(x, params["lnf_g"], params["lnf_b"])
+
+
+# ---------------------------------------------------------------------------
+# pipeline-stage slicing — the model side of the actor-hosted pipeline
+# engines (train/pipeline_engine.py dynamic, train/pipeline_cgraph.py
+# compiled). Lives with the model because the split points (embedding /
+# layer blocks / LN+head) are model knowledge, not engine knowledge.
+# ---------------------------------------------------------------------------
+
+
+def gpt_pipeline_stages(model: "GPT", params: Dict[str, jax.Array],
+                        num_chunks: int):
+    """Split a GPT into ``num_chunks`` pipeline chunks: chunk 0 carries
+    the embedding, the last chunk carries the final LN + tied LM head +
+    loss; layer blocks divide evenly. Returns
+    ``(chunk_fns, chunk_params, tied)`` where chunk fns are
+    ``fn(params, x) -> activation`` for every chunk but the last, which
+    is ``fn(params, x, targets) -> scalar loss``; ``tied`` names the
+    embedding/LM-head grad-exchange pair in GLOBAL chunk indices."""
+    c = model.config
+    L = c.n_layer
+    if num_chunks < 2:
+        raise ValueError("pipeline needs >= 2 chunks")
+    if L % num_chunks:
+        raise ValueError(
+            f"{L} layers not divisible by {num_chunks} chunks")
+    per = L // num_chunks
+    layer_keys = [k for k in params
+                  if k not in ("wte", "wpe", "lnf_g", "lnf_b")]
+
+    def slice_layers(lo, hi):
+        return {k: params[k][lo:hi] for k in layer_keys}
+
+    chunk_params = []
+    for i in range(num_chunks):
+        sp = {"layers": slice_layers(i * per, (i + 1) * per)}
+        if i == 0:
+            sp["wte"] = params["wte"]
+            sp["wpe"] = params["wpe"]
+        if i == num_chunks - 1:
+            sp["lnf_g"] = params["lnf_g"]
+            sp["lnf_b"] = params["lnf_b"]
+            if "wte" not in sp:
+                sp["head"] = params["wte"]  # tied head needs its own copy
+        chunk_params.append(sp)
+
+    def run_layers(model, sp, x):
+        def blk(h, lp):
+            return model._block(h, lp, None), None
+        h, _ = jax.lax.scan(blk, x, sp["layers"])
+        return h
+
+    def make_first(model):
+        def fn(sp, tokens):
+            x = model._embed(sp["wte"], sp["wpe"], tokens)
+            return run_layers(model, sp, x)
+        return fn
+
+    def make_mid(model):
+        def fn(sp, x):
+            return run_layers(model, sp, x)
+        return fn
+
+    def make_last(model):
+        def fn(sp, x, targets):
+            from ..ops import cross_entropy_loss, layernorm
+
+            h = run_layers(model, sp, x)
+            h = layernorm(h, sp["lnf_g"], sp["lnf_b"])
+            head = sp.get("head", sp.get("wte"))
+            return cross_entropy_loss(model._lm_head(head, h), targets)
+        return fn
+
+    chunk_fns = [make_first(model)]
+    for _ in range(num_chunks - 2):
+        chunk_fns.append(make_mid(model))
+    chunk_fns.append(make_last(model))
+    # the tied embedding/head copies must exchange grads every step
+    tied = [(0, "wte", num_chunks - 1, "head")]
+    return chunk_fns, chunk_params, tied

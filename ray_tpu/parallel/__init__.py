@@ -18,6 +18,9 @@ _setup_torch_process_group) with TPU-native equivalents:
   over XLA collectives (psum/all_gather/ppermute) inside jit.
 - `MeshGroup`: gang formation — hands each Train worker its mesh slice
   (the analog of TorchConfig handing each worker a process group).
+- `zero`: ZeRO-style cross-replica sharding of the optimizer update
+  (host-plane `ZeroUpdater` over the collective, in-jit
+  `make_zero_update_spmd` over a mesh dp axis).
 """
 from .mesh import (AxisRules, MeshSpec, build_mesh, default_axis_rules,
                    local_mesh, mesh_shape_for, named_sharding,
@@ -26,6 +29,7 @@ from .collective import (allgather, allreduce, barrier, broadcast,
                          create_collective_group, destroy_collective_group,
                          get_group, recv, reduce, reducescatter, send)
 from .mesh_group import MeshGroup, MeshWorkerMixin
+from .zero import ZeroUpdater, make_zero_update_spmd
 
 __all__ = [
     "MeshSpec", "build_mesh", "virtual_mesh", "local_mesh", "named_sharding",
@@ -35,4 +39,5 @@ __all__ = [
     "allreduce", "allgather", "reducescatter", "broadcast", "reduce",
     "send", "recv", "barrier",
     "MeshGroup", "MeshWorkerMixin",
+    "ZeroUpdater", "make_zero_update_spmd",
 ]

@@ -1,0 +1,302 @@
+"""ZeRO-style cross-replica sharding of the weight update.
+
+"Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+Training" (PAPERS.md): in plain data parallelism every replica holds the
+FULL optimizer state and applies the FULL update — O(N) redundant memory
+and compute per replica. Sharding the update makes both scale with the
+dp axis: each replica reduce-scatters gradients (so it receives only its
+1/dp shard, already summed), applies the optimizer to that shard with
+1/dp of the optimizer state, and all-gathers the fresh parameters.
+Elementwise optimizers (sgd/adam/adamw) commute with the flat-vector
+sharding, so the sharded update is numerically the replicated update.
+
+Two planes, mirroring parallel/collective.py's stance:
+
+- **Host plane** (:class:`ZeroUpdater`): cross-ACTOR dp groups over the
+  object-store collective (reducescatter/allgather from
+  parallel/collective.py). This is what the compiled-graph pipeline
+  engine (train/pipeline_cgraph.py) uses between dp replicas of one
+  stage — replicas live in different processes, often different hosts.
+
+- **In-jit plane** (:func:`make_zero_update_spmd`): ``psum_scatter`` /
+  ``all_gather`` inside one jitted program over a mesh dp axis, for the
+  case where a stage's replicas are chips of one mesh.
+
+Both operate on the FLAT parameter vector: pytrees are raveled into one
+1-D array (uniform dtype enforced), sharded in contiguous chunks that
+match ``np.array_split`` boundaries (what collective.reducescatter
+emits), and unraveled after the gather.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "TreeSpec", "flatten_tree", "unflatten_tree", "shard_bounds",
+    "tree_bytes", "ZeroUpdater", "make_zero_update_spmd",
+]
+
+
+class TreeSpec:
+    """Shapes/dtype/treedef needed to unflatten a flat vector."""
+
+    __slots__ = ("treedef", "shapes", "dtype", "size")
+
+    def __init__(self, treedef, shapes, dtype, size):
+        self.treedef = treedef
+        self.shapes = shapes
+        self.dtype = dtype
+        self.size = size
+
+
+def flatten_tree(tree) -> Tuple[Any, TreeSpec]:
+    """Pytree -> (flat 1-D array, spec). Leaves must share one dtype —
+    the flat shard boundary would otherwise cut through a dtype change
+    and reinterpret bytes."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree.flatten(tree)
+    if not leaves:
+        raise ValueError("cannot flatten an empty pytree")
+    dtypes = {jnp.asarray(l).dtype for l in leaves}
+    if len(dtypes) > 1:
+        raise ValueError(
+            f"ZeRO flat sharding needs a uniform leaf dtype, got "
+            f"{sorted(str(d) for d in dtypes)}")
+    shapes = [jnp.asarray(l).shape for l in leaves]
+    flat = jnp.concatenate([jnp.asarray(l).ravel() for l in leaves])
+    return flat, TreeSpec(treedef, shapes, flat.dtype, int(flat.size))
+
+
+def unflatten_tree(flat, spec: TreeSpec):
+    import jax
+    import numpy as _np
+
+    leaves = []
+    off = 0
+    for shape in spec.shapes:
+        n = int(_np.prod(shape)) if shape else 1
+        leaves.append(flat[off:off + n].reshape(shape))
+        off += n
+    return jax.tree.unflatten(spec.treedef, leaves)
+
+
+def shard_bounds(n: int, world: int) -> List[Tuple[int, int]]:
+    """Contiguous (lo, hi) per rank, matching np.array_split: the first
+    n % world shards get one extra element."""
+    base, extra = divmod(n, world)
+    bounds = []
+    lo = 0
+    for r in range(world):
+        hi = lo + base + (1 if r < extra else 0)
+        bounds.append((lo, hi))
+        lo = hi
+    return bounds
+
+
+def tree_bytes(tree) -> int:
+    """Total bytes across a pytree's array leaves (optimizer-state
+    footprint accounting; scalars count their numpy size)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        total += int(np.asarray(leaf).nbytes)
+    return total
+
+
+# ---------------------------------------------------------------------------
+# host plane: cross-actor dp groups over parallel/collective.py
+# ---------------------------------------------------------------------------
+
+
+class ZeroUpdater:
+    """Rank-local view of a ZeRO-sharded optimizer over a host collective
+    group.
+
+    Each dp replica constructs one with its rank, inits optimizer state
+    for ITS shard only (the ~1/dp memory win), and calls
+    :meth:`update` once per optimizer step. The gradient mean, shard
+    update, and parameter gather all ride the named collective group —
+    every rank must call update() collectively.
+    """
+
+    def __init__(self, tx, world: int, rank: int,
+                 group_name: str = "default"):
+        self.tx = tx
+        self.world = int(world)
+        self.rank = int(rank)
+        self.group_name = group_name
+        self._spec: Optional[TreeSpec] = None
+        self._opt_state = None
+        self._jit_update = None
+
+    def init(self, params) -> "ZeroUpdater":
+        import jax
+
+        flat, spec = flatten_tree(params)
+        self._spec = spec
+        lo, hi = shard_bounds(spec.size, self.world)[self.rank]
+        self._opt_state = jax.jit(self.tx.init)(flat[lo:hi])
+
+        @jax.jit
+        def _upd(g_shard, opt_state, p_shard):
+            import optax
+
+            updates, new_state = self.tx.update(g_shard, opt_state,
+                                                p_shard)
+            return optax.apply_updates(p_shard, updates), new_state
+
+        self._jit_update = _upd
+        return self
+
+    def opt_state_bytes(self) -> int:
+        """Bytes of optimizer state THIS replica holds (~ full/dp)."""
+        return tree_bytes(self._opt_state)
+
+    def update(self, params, grads):
+        """Collective optimizer step: reduce-scatter the gradient mean,
+        update this rank's shard, all-gather fresh parameters. Returns
+        the full updated parameter pytree."""
+        import jax.numpy as jnp
+
+        from . import collective
+
+        if self._spec is None:
+            raise RuntimeError("ZeroUpdater.update() before init()")
+        flat_g, gspec = flatten_tree(grads)
+        if gspec.size != self._spec.size:
+            raise ValueError(
+                f"grad tree size {gspec.size} != param tree size "
+                f"{self._spec.size}")
+        # reducescatter SUMS then slices; divide for the dp mean
+        g_shard = collective.reducescatter(
+            np.asarray(flat_g), self.group_name) / self.world
+        flat_p, _ = flatten_tree(params)
+        lo, hi = shard_bounds(self._spec.size, self.world)[self.rank]
+        new_shard, self._opt_state = self._jit_update(
+            jnp.asarray(g_shard, dtype=self._spec.dtype),
+            self._opt_state, flat_p[lo:hi])
+        parts = collective.allgather(np.asarray(new_shard),
+                                     self.group_name)
+        full = jnp.asarray(np.concatenate(parts), dtype=self._spec.dtype)
+        return unflatten_tree(full, self._spec)
+
+
+# ---------------------------------------------------------------------------
+# in-jit plane: psum_scatter / all_gather over a mesh dp axis
+# ---------------------------------------------------------------------------
+
+
+def make_zero_update_spmd(tx, mesh, axis: str = "dp"
+                          ) -> Tuple[Callable, Callable]:
+    """Build the in-mesh sharded update: ``(init_fn, update_fn)``.
+
+    - ``init_fn(params)`` -> flat optimizer state laid out over the
+      mesh ``axis`` (each device materializes only its 1/dp chunk under
+      shard_map).
+    - ``update_fn(params, grads_stacked, opt_state)`` ->
+      ``(new_params, new_opt_state)`` where ``grads_stacked`` carries a
+      leading ``axis``-sharded replica dimension (each replica's own
+      gradients, e.g. from per-shard ``value_and_grad``). Inside the
+      program: ``psum_scatter`` hands each device its summed 1/dp
+      gradient chunk, the optimizer updates that chunk, and a tiled
+      ``all_gather`` rebuilds the full parameter vector — no device
+      ever holds full optimizer state.
+
+    The flat vector is zero-padded to a multiple of the axis size so
+    chunks tile exactly.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from ..jax_compat import shard_map
+
+    world = mesh.shape[axis]
+
+    def _pad(flat):
+        pad = (-flat.size) % world
+        if pad:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((pad,), flat.dtype)])
+        return flat
+
+    def _opt_specs(chunk, dtype):
+        # moment leaves ([chunk] per rank) shard over the axis; scalar
+        # leaves (adam's step count) stay replicated
+        shapes = jax.eval_shape(tx.init,
+                                jax.ShapeDtypeStruct((chunk,), dtype))
+        return jax.tree.map(
+            lambda s: P(axis) if len(s.shape) >= 1 else P(), shapes)
+
+    def init_fn(params):
+        flat, _spec = flatten_tree(params)
+        flat = _pad(flat)
+        chunk = flat.size // world
+
+        def _init_local(p_local):
+            idx = jax.lax.axis_index(axis)
+            p_shard = jax.lax.dynamic_slice(p_local, (idx * chunk,),
+                                            (chunk,))
+            return tx.init(p_shard)
+
+        fn = shard_map(_init_local, mesh=mesh, in_specs=(P(),),
+                       out_specs=_opt_specs(chunk, flat.dtype),
+                       axis_names=frozenset({axis}))
+        return jax.jit(fn)(flat)
+
+    # one jitted program per (param size, grad width, dtype) — a fresh
+    # shard_map closure per call would miss jit's identity-keyed cache
+    # and re-trace + re-compile the update EVERY training step
+    _progs: dict = {}
+
+    def _update_prog(chunk, g_width, dtype):
+        key = (chunk, g_width, str(dtype))
+        prog = _progs.get(key)
+        if prog is not None:
+            return prog
+
+        def _upd_local(p_local, g_local, opt_local):
+            idx = jax.lax.axis_index(axis)
+            # g_local: [1, Np] — this replica's own full gradient.
+            # psum_scatter hands back chunk #idx of the cross-replica SUM
+            g_shard = jax.lax.psum_scatter(
+                g_local[0], axis, tiled=True) / world
+            p_shard = jax.lax.dynamic_slice(p_local, (idx * chunk,),
+                                            (chunk,))
+            updates, new_opt = tx.update(g_shard, opt_local, p_shard)
+            new_shard = optax.apply_updates(p_shard, updates)
+            new_flat = jax.lax.all_gather(new_shard, axis, tiled=True)
+            return new_flat, new_opt
+
+        ospecs = _opt_specs(chunk, dtype)
+        prog = jax.jit(shard_map(_upd_local, mesh=mesh,
+                                 in_specs=(P(), P(axis), ospecs),
+                                 out_specs=(P(), ospecs),
+                                 axis_names=frozenset({axis})))
+        _progs[key] = prog
+        return prog
+
+    def update_fn(params, grads_stacked, opt_state):
+        flat_p, spec = flatten_tree(params)
+        flat_p = _pad(flat_p)
+        chunk = flat_p.size // world
+        g_leaves, _ = jax.tree.flatten(grads_stacked)
+        flat_g = jnp.concatenate(
+            [jnp.asarray(l).reshape(world, -1) for l in g_leaves],
+            axis=1)
+        pad = (-flat_g.shape[1]) % world
+        if pad:
+            flat_g = jnp.concatenate(
+                [flat_g, jnp.zeros((world, pad), flat_g.dtype)], axis=1)
+        prog = _update_prog(chunk, flat_g.shape[1], flat_p.dtype)
+        new_flat, new_opt = prog(flat_p, flat_g, opt_state)
+        return unflatten_tree(new_flat[:spec.size], spec), new_opt
+
+    return init_fn, update_fn

@@ -10,16 +10,20 @@ does pjit/shard_map SPMD — collectives ride ICI, reporting/checkpoints
 ride the runtime.
 """
 from .checkpoint import Checkpoint
-from .config import (CheckpointConfig, FailureConfig, Result, RunConfig,
-                     ScalingConfig)
+from .config import (CheckpointConfig, FailureConfig, PipelineConfig,
+                     Result, RunConfig, ScalingConfig)
 from .session import (get_checkpoint, get_context, get_dataset_shard,
                       get_mesh, report)
 from .trainer import DataParallelTrainer, JaxTrainer, TorchTrainer
 from .backend_executor import BackendExecutor, TrainWorkerError
+from .pipeline_cgraph import CompiledPipelineEngine, run_reference_1f1b
+from .pipeline_engine import PipelineEngine
 
 __all__ = [
     "Checkpoint", "CheckpointConfig", "FailureConfig", "Result", "RunConfig",
-    "ScalingConfig", "report", "get_context", "get_checkpoint", "get_mesh",
+    "ScalingConfig", "PipelineConfig", "report", "get_context",
+    "get_checkpoint", "get_mesh",
     "get_dataset_shard", "DataParallelTrainer", "JaxTrainer", "TorchTrainer",
     "BackendExecutor", "TrainWorkerError",
+    "CompiledPipelineEngine", "PipelineEngine", "run_reference_1f1b",
 ]

@@ -32,6 +32,31 @@ class ScalingConfig:
 
 
 @dataclass
+class PipelineConfig:
+    """Knobs for the compiled-graph pipeline engine
+    (train/pipeline_cgraph.py CompiledPipelineEngine). Carried as one
+    object so trainers/benches/smokes configure the engine uniformly."""
+    num_microbatches: int = 4
+    virtual_stages: int = 1      # model chunks per actor (interleaving)
+    dp: int = 1                  # data-parallel pipeline replicas
+    zero_update: bool = True     # ZeRO-shard the dp optimizer update
+    remat: bool = False          # recompute fwd in bwd (activation remat)
+    channel_bytes: int = 1 << 20  # per-slot channel capacity
+    resources_per_stage: Dict[str, float] = field(default_factory=dict)
+
+    def engine_kwargs(self) -> Dict[str, Any]:
+        return {
+            "num_microbatches": self.num_microbatches,
+            "virtual_stages": self.virtual_stages,
+            "dp": self.dp,
+            "zero_update": self.zero_update,
+            "remat": self.remat,
+            "channel_bytes": self.channel_bytes,
+            "resources_per_stage": self.resources_per_stage or None,
+        }
+
+
+@dataclass
 class FailureConfig:
     max_failures: int = 0    # 0 = fail fast; -1 = unlimited restarts
 

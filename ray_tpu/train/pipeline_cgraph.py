@@ -1,0 +1,1026 @@
+"""MPMD pipeline-parallel training engine on compiled graphs.
+
+The successor to the dynamic actor engine in pipeline_engine.py: same
+1F1B semantics, but the steady-state microbatch loop runs over
+PRE-ALLOCATED cgraph channels instead of per-call ``.remote()`` task
+specs — the exact hot path PR 4's compiled graphs made ~10x faster.
+
+Shape ("Scaling Deep Learning Training with MPMD Pipeline Parallelism",
+PAPERS.md): each stage actor holds resident JITTED fwd/bwd/update
+programs for its (possibly several, interleaved) model chunks, plus a
+compiled per-STEP op schedule loaded into the cgraph executor's
+iterative mode (cgraph/executor.py). One ``engine.step(batch)`` then
+drives a full interleaved 1F1B round with zero per-microbatch
+scheduling, leasing, or GCS traffic:
+
+    driver ──act──▶ [stage 0] ──act──▶ [stage 1] ─ ... ─▶ [stage P-1]
+           ──tgt──────────────────────────────────────────▶   │
+           ◀──loss─────────────────────────────────────────────┘
+           ◀─... grads flow backward over their own channels ...─
+
+Channels are multi-slot rings (``slots=num_microbatches``), so a whole
+round's activations stream through one edge without the driver in the
+loop; with ``virtual_stages > 1`` actor i hosts global chunks
+``i, i+P, ...`` and runs the interleaved schedule
+(parallel/pipeline.schedule_interleaved_1f1b).
+
+Weight update ("Automatic Cross-Replica Sharding of Weight Update in
+Data-Parallel Training", PAPERS.md): with ``dp > 1`` replicas of the
+pipeline, each stage's dp group applies a ZeRO-sharded update — grads
+reduce-scatter over the host collective, each replica updates its 1/dp
+parameter shard with 1/dp of the optimizer state, and all-gathers fresh
+params (parallel/zero.ZeroUpdater; ``zero_update=False`` falls back to
+the replicated allreduce update for A/B).
+
+Fault contract matches compiled graphs: a stage-actor death aborts the
+engine — ``step()`` raises ``CompiledGraphClosedError`` — and
+``shutdown()`` releases every pre-allocated channel segment.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.core.placement_group import placement_group, remove_placement_group
+
+from ..exceptions import (CompiledGraphClosedError, CompiledGraphError,
+                          GetTimeoutError)
+from ..parallel.pipeline import schedule_interleaved_1f1b
+from ..util import metrics as _metrics
+from ..util import tracing
+
+_H_STEP = _metrics.Histogram(
+    "ray_tpu_pipeline_step_seconds",
+    "pipeline-engine full step() latency as observed by the driver",
+    boundaries=_metrics.DEFAULT_BOUNDARIES, tag_keys=("engine",))
+
+DEFAULT_CHANNEL_BYTES = 1 << 20
+
+
+# ---------------------------------------------------------------------------
+# resident jitted programs — shared by the stage actor AND the
+# single-process reference (run_reference_1f1b), so the engine's loss
+# trajectory can be compared bit-for-bit against the reference
+# ---------------------------------------------------------------------------
+
+
+def _make_programs(fn: Callable, has_targets: bool, remat: bool):
+    """(fwd, bwd) jitted programs for one model chunk.
+
+    remat=False: fwd returns ``(out, pullback)`` — the vjp closure is a
+    pytree of residuals that crosses the jit boundary and lives on the
+    actor between fwd and bwd (the 1F1B in-flight activation memory);
+    bwd replays it. remat=True: fwd stores only its primal inputs and
+    bwd re-runs the forward inside the backward program (activation
+    rematerialization — ~1/3 more FLOPs, O(inputs) residual memory).
+    """
+    import jax
+
+    if not remat:
+        if has_targets:
+            def fwd_core(p, x, tgt):
+                return jax.vjp(lambda pp, xx: fn(pp, xx, tgt), p, x)
+        else:
+            def fwd_core(p, x):
+                return jax.vjp(fn, p, x)
+        fwd = jax.jit(fwd_core)
+        bwd = jax.jit(lambda pull, g: pull(g))
+        return fwd, bwd
+
+    if has_targets:
+        fwd = jax.jit(lambda p, x, tgt: fn(p, x, tgt))
+
+        def bwd_core(p, x, tgt, g):
+            _, pull = jax.vjp(lambda pp, xx: fn(pp, xx, tgt), p, x)
+            return pull(g)
+    else:
+        fwd = jax.jit(fn)
+
+        def bwd_core(p, x, g):
+            _, pull = jax.vjp(fn, p, x)
+            return pull(g)
+    return fwd, jax.jit(bwd_core)
+
+
+def _make_update(tx):
+    """Jitted replicated optimizer core: (grads, opt_state, params) ->
+    (new_params, new_opt_state)."""
+    import jax
+
+    @jax.jit
+    def _upd(grads, opt_state, params):
+        import optax
+
+        updates, new_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_state
+
+    return _upd
+
+
+def run_reference_1f1b(stage_fns: Sequence[Callable],
+                       stage_params: Sequence[Any],
+                       tx,
+                       steps: Sequence[Tuple[Sequence[Any], Sequence[Any]]],
+                       remat: bool = False,
+                       tied: Sequence[tuple] = ()):
+    """Single-process reference executing the SAME jitted chunk programs
+    in the same order/arithmetic as the compiled engine (dp=1): fwd per
+    microbatch ascending, bwd per microbatch ascending, grads
+    accumulated in arrival order, tied grads exchanged once, update
+    scaled by 1/M. Returns ``(losses_per_step, final_stage_params)`` —
+    the engine's trajectory must match this bit-for-bit at a fixed seed.
+    """
+    import jax
+
+    G = len(stage_fns)
+    progs = [_make_programs(fn, g == G - 1, remat)
+             for g, fn in enumerate(stage_fns)]
+    params = list(stage_params)
+    opt_states = [jax.jit(tx.init)(p) for p in params]
+    upd = _make_update(tx)
+    losses_out: List[float] = []
+    for mbs, tgts in steps:
+        M = len(mbs)
+        acc: List[Any] = [None] * G
+        residuals: Dict[Tuple[int, int], Any] = {}
+        step_losses = []
+        for m in range(M):
+            x = mbs[m]
+            for g in range(G):
+                fwd, _ = progs[g]
+                if g == G - 1:
+                    if remat:
+                        out = fwd(params[g], x, tgts[m])
+                        residuals[(g, m)] = (x, tgts[m])
+                    else:
+                        out, pull = fwd(params[g], x, tgts[m])
+                        residuals[(g, m)] = pull
+                else:
+                    if remat:
+                        out = fwd(params[g], x)
+                        residuals[(g, m)] = (x,)
+                    else:
+                        out, pull = fwd(params[g], x)
+                        residuals[(g, m)] = pull
+                x = out
+            step_losses.append(out)
+        for m in range(M):
+            import jax.numpy as jnp
+
+            cot = jnp.float32(1.0)
+            for g in reversed(range(G)):
+                _, bwd = progs[g]
+                res = residuals.pop((g, m))
+                if remat:
+                    gp, gx = bwd(params[g], *res, cot)
+                else:
+                    gp, gx = bwd(res, cot)
+                acc[g] = gp if acc[g] is None else jax.tree.map(
+                    lambda a, b: a + b, acc[g], gp)
+                cot = gx
+        for (gi, ki, gj, kj) in tied:
+            a, b = acc[gi][ki], acc[gj][kj]
+            acc[gi][ki] = a + b
+            acc[gj][kj] = b + a
+        scale = 1.0 / M
+        for g in range(G):
+            grads = jax.tree.map(lambda t: t * scale, acc[g])
+            params[g], opt_states[g] = upd(grads, opt_states[g],
+                                           params[g])
+        losses_out.append(
+            float(sum(float(l) for l in step_losses) / M))
+    return losses_out, params
+
+
+# ---------------------------------------------------------------------------
+# the stage actor
+# ---------------------------------------------------------------------------
+
+
+class _CGStage:
+    """One pipeline stage actor: hosts ``virtual`` model chunks with
+    resident jitted fwd/bwd programs, accumulates grads per chunk, and
+    applies the (optionally ZeRO-sharded) optimizer update. Its methods
+    are never called per-microbatch over the task plane — the cgraph
+    executor's iterative loop drives them from the compiled schedule."""
+
+    def setup(self, actor_idx: int, num_actors: int, virtual: int,
+              fn_blobs: List[bytes], chunk_params: List[Any],
+              chunk_meta: List[dict], tx_blob: Optional[bytes],
+              remat: bool, dp: int, dp_rank: int,
+              group_name: str, zero_update: bool) -> bool:
+        import jax
+
+        self.idx = actor_idx
+        self.num_actors = num_actors
+        self.virtual = virtual
+        self.meta = chunk_meta
+        self.dp = dp
+        self.dp_rank = dp_rank
+        self.zero_update = zero_update
+        self.group_name = group_name
+        self._jax = jax
+        self.params: Dict[str, Any] = {
+            str(v): chunk_params[v] for v in range(virtual)}
+        fns = [cloudpickle.loads(b) for b in fn_blobs]
+        self._progs = [
+            _make_programs(fns[v], chunk_meta[v]["last"], remat)
+            for v in range(virtual)]
+        self._remat = remat
+        self._residuals: Dict[Tuple[int, int], Any] = {}
+        self._grad_acc: Dict[str, Any] = {}
+        self.tx = cloudpickle.loads(tx_blob) if tx_blob else None
+        self._zero = None
+        self._opt_state = None
+        self._upd = None
+        if self.tx is not None:
+            if dp > 1:
+                from ..parallel import collective
+
+                collective.create_collective_group(
+                    dp, dp_rank, group_name=group_name)
+            if dp > 1 and zero_update:
+                from ..parallel.zero import ZeroUpdater
+
+                self._zero = ZeroUpdater(
+                    self.tx, dp, dp_rank,
+                    group_name=group_name).init(self.params)
+            else:
+                self._opt_state = jax.jit(self.tx.init)(self.params)
+                self._upd = _make_update(self.tx)
+        return True
+
+    # -- schedule ops (driven by the cgraph iterative loop) ---------------
+
+    def forward(self, v: int, mb: int, x, targets=None):
+        """Chunk ``v``'s microbatch forward. Returns the activation for
+        the next chunk — or, on the LAST global chunk, the scalar loss
+        (which the schedule routes to the driver's loss channel)."""
+        fwd, _ = self._progs[v]
+        p = self.params[str(v)]
+        if self.meta[v]["last"]:
+            if self._remat:
+                out = fwd(p, x, targets)
+                self._residuals[(v, mb)] = (x, targets)
+            else:
+                out, pull = fwd(p, x, targets)
+                self._residuals[(v, mb)] = pull
+        else:
+            if self._remat:
+                out = fwd(p, x)
+                self._residuals[(v, mb)] = (x,)
+            else:
+                out, pull = fwd(p, x)
+                self._residuals[(v, mb)] = pull
+        return out
+
+    def backward(self, v: int, mb: int, g=None):
+        """Chunk ``v``'s microbatch backward: consumes the saved
+        residual, accumulates this chunk's param grads, and returns the
+        cotangent for the upstream chunk (None seed on the last global
+        chunk — the loss pulls back from 1.0)."""
+        import jax.numpy as jnp
+
+        _, bwd = self._progs[v]
+        res = self._residuals.pop((v, mb))
+        if g is None:
+            g = jnp.float32(1.0)
+        if self._remat:
+            gp, gx = bwd(self.params[str(v)], *res, g)
+        else:
+            gp, gx = bwd(res, g)
+        key = str(v)
+        if key not in self._grad_acc or self._grad_acc[key] is None:
+            self._grad_acc[key] = gp
+        else:
+            self._grad_acc[key] = self._jax.tree.map(
+                lambda a, b: a + b, self._grad_acc[key], gp)
+        return gx
+
+    def tied_grad(self, v: int, key: str):
+        """Ship this chunk's accumulated grad for a tied weight to the
+        partner chunk (Megatron-style tied-embedding exchange)."""
+        return self._grad_acc[str(v)][key]
+
+    def tied_add(self, v: int, key: str, g) -> bool:
+        self._grad_acc[str(v)][key] = self._grad_acc[str(v)][key] + g
+        return True
+
+    def update(self, scale: float) -> dict:
+        """End-of-step optimizer update over every hosted chunk. With a
+        dp group: ZeRO reduce-scatter/shard-update/all-gather (or the
+        replicated allreduce update when zero_update=False). Returns the
+        stage report shipped to the driver."""
+        t0 = time.perf_counter()
+        grads = {k: self._jax.tree.map(lambda t: t * scale, v)
+                 for k, v in self._grad_acc.items()}
+        from ..parallel.zero import tree_bytes
+
+        if self.tx is None:
+            pass  # evaluation engine: grads dropped
+        elif self._zero is not None:
+            self.params = self._zero.update(self.params, grads)
+        elif self.dp > 1:
+            # replicated A/B path: allreduce-mean over the flat vector,
+            # full-tree update on every replica (full opt state each)
+            import jax.numpy as jnp
+
+            from ..parallel import collective
+            from ..parallel.zero import flatten_tree, unflatten_tree
+
+            flat_g, spec = flatten_tree(grads)
+            import numpy as np
+
+            mean = collective.allreduce(
+                np.asarray(flat_g), self.group_name) / self.dp
+            grads = unflatten_tree(
+                jnp.asarray(mean, dtype=spec.dtype), spec)
+            self.params, self._opt_state = self._upd(
+                grads, self._opt_state, self.params)
+        else:
+            self.params, self._opt_state = self._upd(
+                grads, self._opt_state, self.params)
+        self._grad_acc = {}
+        return {
+            "stage": self.idx, "dp_rank": self.dp_rank,
+            "update_ms": round((time.perf_counter() - t0) * 1e3, 3),
+            "opt_state_bytes": self.opt_state_bytes(),
+            "in_flight_residuals": len(self._residuals),
+        }
+
+    # -- dynamic-path surface (driver calls between steps) ----------------
+
+    def get_params(self) -> List[Any]:
+        return [self.params[str(v)] for v in range(self.virtual)]
+
+    def opt_state_bytes(self) -> int:
+        from ..parallel.zero import tree_bytes
+
+        if self._zero is not None:
+            return self._zero.opt_state_bytes()
+        return tree_bytes(self._opt_state) \
+            if self._opt_state is not None else 0
+
+    def cleanup(self) -> bool:
+        """Tear down this stage's dp collective group (rank 0 kills the
+        rendezvous store so nothing detached outlives the engine)."""
+        if self.dp > 1 and self.dp_rank == 0:
+            from ..parallel import collective
+
+            collective.destroy_collective_group(self.group_name)
+        return True
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+
+class _StagePlan:
+    __slots__ = ("actor_id", "node", "worker", "handle", "in_specs",
+                 "nodes", "stage", "replica", "_report_w")
+
+    def __init__(self, actor_id, node, worker, handle, stage, replica):
+        self.actor_id = actor_id
+        self.node = node
+        self.worker = worker
+        self.handle = handle
+        self.stage = stage
+        self.replica = replica
+        self.in_specs: List[dict] = []
+        self.nodes: List[dict] = []
+        self._report_w = None
+
+
+class CompiledPipelineEngine:
+    """Drives ``dp`` replicas x ``P`` stage actors through interleaved
+    1F1B over pre-allocated cgraph channels.
+
+    stage_fns: G = P * virtual_stages chunk callables in global order.
+        Chunks 0..G-2: ``fn(params, x) -> activation``; the last chunk:
+        ``fn(params, x, targets) -> scalar loss`` (G == 1 collapses both
+        into the last-chunk signature — a pure-dp engine).
+    stage_params: G parameter pytrees (one per chunk).
+    tx: optax optimizer (None = forward/backward only, no update).
+    num_microbatches: 1F1B round size M; ``step()`` takes dp*M
+        microbatches (contiguous M-slices per dp replica).
+    virtual_stages: model chunks per actor (interleaved 1F1B when > 1).
+    dp: data-parallel pipeline replicas; each stage's dp group syncs
+        grads at update time.
+    zero_update: ZeRO-shard the dp update (1/dp optimizer state per
+        replica) vs the replicated allreduce update.
+    remat: recompute chunk forwards in the backward instead of holding
+        vjp residuals (activation rematerialization knob).
+    tied: [(chunk_i, key_i, chunk_j, key_j), ...] tied-weight pairs
+        whose grads are exchanged and summed before each update.
+    """
+
+    def __init__(self, stage_fns: Sequence[Callable],
+                 stage_params: Sequence[Any],
+                 tx=None, *,
+                 num_microbatches: int,
+                 virtual_stages: int = 1,
+                 dp: int = 1,
+                 zero_update: bool = True,
+                 remat: bool = False,
+                 tied: Sequence[tuple] = (),
+                 channel_bytes: int = DEFAULT_CHANNEL_BYTES,
+                 resources_per_stage: Optional[dict] = None,
+                 scheduling_strategies: Optional[Sequence] = None,
+                 setup_timeout: float = 120.0):
+        G = len(stage_fns)
+        V = int(virtual_stages)
+        if G < 1 or len(stage_params) != G:
+            raise ValueError("need one param tree per stage fn")
+        if V < 1 or G % V:
+            raise ValueError(
+                f"{G} chunks not divisible into virtual_stages={V}")
+        M = int(num_microbatches)
+        if M < 1:
+            raise ValueError("num_microbatches must be >= 1")
+        self.num_chunks = G
+        self.num_stages = G // V
+        self.virtual = V
+        self.num_microbatches = M
+        self.dp = int(dp)
+        self.zero_update = bool(zero_update)
+        self.tied = list(tied)
+        self.graph_id = os.urandom(16)
+        self._gtag = self.graph_id.hex()[:8]
+        self._channel_bytes = int(channel_bytes)
+        self._lock = threading.Lock()
+        # serializes the teardown BODY (not just the torn flag):
+        # an abort tears down on a background thread, and a concurrent
+        # shutdown() must block until the channels are actually released
+        self._teardown_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._torn = False
+        self._poisoned: Optional[Exception] = None
+        self._closed_error: Optional[Exception] = None
+        self._alloc: List[Tuple[Any, Any]] = []
+        self._unsub = None
+        self._actor_plans: Dict[bytes, _StagePlan] = {}
+        self._in_writers: List[Any] = []      # per dp replica
+        self._tgt_writers: List[Any] = []
+        self._loss_readers: List[Any] = []
+        self._report_readers: List[List[Any]] = []  # [r][stage]
+        self._qreaders: Dict[str, Any] = {}
+        self.last_reports: List[dict] = []
+        self.last_step_s: float = 0.0
+        self._pg = None
+
+        from ..core import runtime as runtime_mod
+
+        rt = runtime_mod.get_runtime()
+        if not hasattr(rt, "gcs"):
+            raise CompiledGraphError(
+                "CompiledPipelineEngine must be built on the driver")
+        self._rt = rt
+
+        try:
+            self._spawn_actors(stage_fns, stage_params, tx,
+                               resources_per_stage,
+                               scheduling_strategies, remat,
+                               setup_timeout)
+            self._compile()
+        except BaseException:
+            try:
+                self.shutdown()
+            except Exception:
+                pass
+            raise
+
+    # -- construction ------------------------------------------------------
+
+    def _spawn_actors(self, stage_fns, stage_params, tx, res, strategies,
+                      remat, setup_timeout) -> None:
+        P, V, dp = self.num_stages, self.virtual, self.dp
+        res = dict(res or {"CPU": 1.0})
+        actor_cls = ray_tpu.remote(_CGStage)
+        tx_blob = cloudpickle.dumps(tx) if tx is not None else None
+        if strategies is None:
+            self._pg = placement_group(
+                [dict(res) for _ in range(P * dp)], strategy="SPREAD")
+            if not self._pg.ready(timeout=60):
+                raise TimeoutError(
+                    "pipeline placement group not ready")
+        self.actors: List[Any] = []
+        self.actor_grid: List[List[Any]] = []
+        setups = []
+        for r in range(dp):
+            row = []
+            for i in range(P):
+                flat = r * P + i
+                if strategies is not None:
+                    a = actor_cls.options(
+                        num_cpus=res.get("CPU", 1.0),
+                        scheduling_strategy=strategies[flat]).remote()
+                else:
+                    a = actor_cls.options(
+                        num_cpus=res.get("CPU", 1.0),
+                        placement_group=self._pg,
+                        placement_group_bundle_index=flat).remote()
+                row.append(a)
+                self.actors.append(a)
+                chunks = [i + v * P for v in range(V)]
+                meta = [{"global": g, "first": g == 0,
+                         "last": g == self.num_chunks - 1}
+                        for g in chunks]
+                setups.append(a.setup.remote(
+                    i, P, V,
+                    [cloudpickle.dumps(stage_fns[g]) for g in chunks],
+                    [stage_params[g] for g in chunks], meta, tx_blob,
+                    remat, dp, r, f"zpipe-{self._gtag}-s{i}",
+                    self.zero_update))
+            self.actor_grid.append(row)
+        ray_tpu.get(setups, timeout=setup_timeout)
+
+    def _compile(self) -> None:
+        from ..cgraph.channel import (QueueChannel, RpcSender, ShmChannel,
+                                      segment_size)
+        from ..core.ids import ObjectId
+        from ..core.object_store import SegmentReader
+
+        rt = self._rt
+        P, V, dp, M = (self.num_stages, self.virtual, self.dp,
+                       self.num_microbatches)
+        G = self.num_chunks
+        self._segreader = SegmentReader()
+
+        # resolve each actor's placement once (cgraph/compiled.py rules)
+        plans: List[List[_StagePlan]] = []
+        for r in range(dp):
+            row = []
+            for i in range(P):
+                h = self.actor_grid[r][i]
+                if rt._cgraph_actor_in_use(h._actor_id):
+                    raise CompiledGraphError(
+                        f"actor {h._actor_id.hex()[:8]} already "
+                        f"participates in another live compiled graph")
+                rt.wait_for_actor(h._actor_id, timeout=60.0)
+                rec = rt._actors.get(h._actor_id)
+                if rec is None or rec.worker is None \
+                        or rec.node_id is None:
+                    raise CompiledGraphError(
+                        f"stage actor {h._actor_id.hex()[:8]} has no "
+                        f"resident worker to compile onto")
+                node = rt.nodes.get(rec.node_id)
+                if node is None or not node.alive:
+                    raise CompiledGraphError(
+                        f"stage actor {h._actor_id.hex()[:8]}'s node "
+                        f"is gone")
+                plan = _StagePlan(h._actor_id, node, rec.worker, h, i, r)
+                self._actor_plans[h._actor_id.binary()] = plan
+                row.append(plan)
+            plans.append(row)
+        self._plans = plans
+
+        def alloc_on(node, slots):
+            cid = ObjectId.from_random()
+            size = segment_size(self._channel_bytes, slots)
+            if getattr(node, "is_remote", False):
+                name = node.channel.call(
+                    "cgraph_alloc_channel",
+                    {"cid": cid, "size": size}, timeout=30)
+            else:
+                name = node.store.allocate_channel(cid, size)
+            self._alloc.append((node, cid))
+            return cid, name, size
+
+        def make_edge(producer, consumer, edge, slots):
+            """producer/consumer: "driver" or _StagePlan. Returns
+            (writer_spec_or_endpoint, reader_spec_or_endpoint) — dict
+            specs for plan sides, live endpoints for driver sides."""
+            pnode = None if producer == "driver" else producer.node
+            cnode = None if consumer == "driver" else consumer.node
+            anode = cnode if cnode is not None else pnode
+            same_host = (
+                (pnode is None and not getattr(cnode, "is_remote",
+                                               False))
+                or (cnode is None and not getattr(pnode, "is_remote",
+                                                  False))
+                or (pnode is not None and pnode is cnode))
+            if same_host:
+                cid, name, size = alloc_on(anode, slots)
+                spec = {"kind": "shm", "name": name, "size": size,
+                        "slots": slots, "cid": cid.hex(), "edge": edge}
+                wr = spec if producer != "driver" else ShmChannel(
+                    self._segreader, name, size, edge=edge,
+                    interrupt=self._stop, slots=slots)
+                rd = dict(spec) if consumer != "driver" else ShmChannel(
+                    self._segreader, name, size, edge=edge,
+                    interrupt=self._stop, slots=slots)
+                return wr, rd
+            cid = ObjectId.from_random()
+            if consumer == "driver":
+                q = QueueChannel(cid.hex(), edge=edge,
+                                 interrupt=self._stop)
+                self._qreaders[cid.hex()] = q
+                rt._cgraph_routes[cid.hex()] = (
+                    "driver", self, None, self.graph_id)
+                return {"kind": "rpc", "cid": cid.hex(),
+                        "edge": edge}, q
+            rt._cgraph_routes[cid.hex()] = (
+                "worker", consumer.node, consumer.worker, self.graph_id)
+            rspec = {"kind": "queue", "cid": cid.hex(), "edge": edge}
+            if producer == "driver":
+                gid = self.graph_id
+
+                def send(chan_id, seq, data, _c=consumer):
+                    _c.node.worker_notify(
+                        _c.worker, "cgraph_push",
+                        {"graph_id": gid, "cid": chan_id,
+                         "seq": seq, "data": data})
+
+                return RpcSender(send, cid.hex(), edge=edge), rspec
+            return {"kind": "rpc", "cid": cid.hex(), "edge": edge}, rspec
+
+        def plan_of(r, g):
+            return plans[r][g % P]
+
+        # -- wire every edge, per dp replica ------------------------------
+        sched = schedule_interleaved_1f1b(P, M, V)
+        for r in range(dp):
+            fwd_w: Dict[int, Any] = {}   # chunk g -> writer spec at g
+            fwd_r: Dict[int, Any] = {}   # chunk g -> reader spec at g
+            bwd_w: Dict[int, Any] = {}
+            bwd_r: Dict[int, Any] = {}
+            # activations: driver -> chunk0, chunk g -> g+1, loss -> driver
+            wr, rd = make_edge("driver", plan_of(r, 0),
+                               f"r{r}:in->c0", M)
+            self._in_writers.append(wr)
+            plan_of(r, 0).in_specs.append(rd)
+            fwd_r[0] = rd
+            for g in range(G - 1):
+                wr, rd = make_edge(plan_of(r, g), plan_of(r, g + 1),
+                                   f"r{r}:c{g}->c{g + 1}", M)
+                fwd_w[g] = wr
+                plan_of(r, g + 1).in_specs.append(rd)
+                fwd_r[g + 1] = rd
+            wr, rd = make_edge(plan_of(r, G - 1), "driver",
+                               f"r{r}:c{G - 1}->loss", M)
+            fwd_w[G - 1] = wr
+            self._loss_readers.append(rd)
+            # targets: driver -> last chunk's actor
+            wr, rd = make_edge("driver", plan_of(r, G - 1),
+                               f"r{r}:in->targets", M)
+            self._tgt_writers.append(wr)
+            plan_of(r, G - 1).in_specs.append(rd)
+            tgt_r = rd
+            # cotangents: chunk g -> g-1
+            for g in range(1, G):
+                wr, rd = make_edge(plan_of(r, g), plan_of(r, g - 1),
+                                   f"r{r}:c{g}->c{g - 1}:grad", M)
+                bwd_w[g] = wr
+                plan_of(r, g - 1).in_specs.append(rd)
+                bwd_r[g - 1] = rd
+            # tied-grad exchange channels (both directions per pair)
+            tied_w: Dict[tuple, Any] = {}
+            tied_r: Dict[tuple, Any] = {}
+            n_tied: Dict[tuple, int] = {}
+            for (gi, ki, gj, kj) in self.tied:
+                for a, b in ((gi, gj), (gj, gi)):
+                    n_tied[(a, b)] = n_tied.get((a, b), 0) + 1
+            for (a, b), cnt in n_tied.items():
+                wr, rd = make_edge(plan_of(r, a), plan_of(r, b),
+                                   f"r{r}:tied:c{a}->c{b}", cnt)
+                tied_w[(a, b)] = wr
+                plan_of(r, b).in_specs.append(rd)
+                tied_r[(a, b)] = rd
+            # per-stage end-of-step report to the driver
+            reports = []
+            for i in range(P):
+                wr, rd = make_edge(plans[r][i], "driver",
+                                   f"r{r}:s{i}->report", 2)
+                reports.append(rd)
+                plans[r][i]._report_w = wr
+            self._report_readers.append(reports)
+
+            # -- per-actor op schedules into node plans -------------------
+            from ..core import serialization
+
+            def const(v):
+                return ("const", serialization.dumps(v))
+
+            for i in range(P):
+                plan = plans[r][i]
+                ops: List[dict] = []
+                for kind, v, mb in sched[i]:
+                    g = v * P + i
+                    if kind == "fwd":
+                        args = [const(v), const(mb)]
+                        args.append(("chan", fwd_r[g]["cid"]))
+                        if g == G - 1:
+                            args.append(("chan", tgt_r["cid"]))
+                        outs = [fwd_w[g]] if g in fwd_w else []
+                        ops.append({"key": f"f{g}.{mb}",
+                                    "method": "forward",
+                                    "num_returns": 1,
+                                    "concurrency_group": "",
+                                    "args": args, "kwargs": {},
+                                    "outs": outs})
+                    else:
+                        args = [const(v), const(mb)]
+                        if g < G - 1:
+                            args.append(("chan", bwd_r[g]["cid"]))
+                        outs = [bwd_w[g]] if g in bwd_w else []
+                        ops.append({"key": f"b{g}.{mb}",
+                                    "method": "backward",
+                                    "num_returns": 1,
+                                    "concurrency_group": "",
+                                    "args": args, "kwargs": {},
+                                    "outs": outs})
+                # tied exchange: all sends first, then all receives —
+                # single-pass, deadlock-free for any pair structure
+                for (gi, ki, gj, kj) in self.tied:
+                    for g_send, key, g_peer in ((gi, ki, gj),
+                                                (gj, kj, gi)):
+                        if g_send % P != i:
+                            continue
+                        ops.append({
+                            "key": f"tg{g_send}.{key}",
+                            "method": "tied_grad", "num_returns": 1,
+                            "concurrency_group": "",
+                            "args": [const(g_send // P), const(key)],
+                            "kwargs": {},
+                            "outs": [tied_w[(g_send, g_peer)]]})
+                for (gi, ki, gj, kj) in self.tied:
+                    for g_recv, key, g_peer in ((gi, ki, gj),
+                                                (gj, kj, gi)):
+                        if g_recv % P != i:
+                            continue
+                        ops.append({
+                            "key": f"ta{g_recv}.{key}",
+                            "method": "tied_add", "num_returns": 1,
+                            "concurrency_group": "",
+                            "args": [const(g_recv // P), const(key),
+                                     ("chan",
+                                      tied_r[(g_peer, g_recv)]["cid"])],
+                            "kwargs": {}, "outs": []})
+                ops.append({"key": f"u{i}", "method": "update",
+                            "num_returns": 1, "concurrency_group": "",
+                            "args": [const(1.0 / M)], "kwargs": {},
+                            "outs": [plan._report_w]})
+                plan.nodes = ops
+
+        # -- register + load (routes must exist before loops start) -------
+        rt._cgraph_register(self)
+        for plan in self._actor_plans.values():
+            payload = {"graph_id": self.graph_id,
+                       "actor_id": plan.actor_id,
+                       "iterative": True,
+                       "stage": f"{plan.replica}.{plan.stage}",
+                       "in_channels": plan.in_specs,
+                       "nodes": plan.nodes}
+            plan.node.worker_cgraph_call(plan.worker, "cgraph_load",
+                                         payload, timeout=30.0)
+        self._unsub = rt.gcs.pubsub.subscribe("actor",
+                                              self._on_actor_event)
+
+    # -- execution surface -------------------------------------------------
+
+    def step(self, microbatches: Sequence[Any], targets: Sequence[Any],
+             timeout: float = 300.0) -> float:
+        """One full (interleaved) 1F1B training step. Takes dp * M
+        microbatches/targets — replica r consumes the contiguous slice
+        ``[r*M:(r+1)*M]``. Returns the mean loss across every
+        microbatch of every replica."""
+        M, dp = self.num_microbatches, self.dp
+        if len(microbatches) != M * dp or len(targets) != M * dp:
+            raise ValueError(
+                f"step() needs num_microbatches*dp = {M * dp} "
+                f"microbatches, got {len(microbatches)}")
+        with self._lock:
+            self._check_open()
+        from ..cgraph.channel import FLAG_ERROR, pack_envelope, \
+            unpack_envelope
+        from ..core import serialization
+
+        deadline = time.monotonic() + timeout
+        ctx = tracing.current_context()
+        trace = f"{ctx[0]}:{ctx[1]}" if ctx else ""
+        t0 = time.perf_counter()
+        try:
+            for r in range(dp):
+                for m in range(M):
+                    k = r * M + m
+                    self._in_writers[r].send(
+                        pack_envelope(0, trace,
+                                      serialization.dumps(
+                                          microbatches[k])),
+                        timeout=max(0.0, deadline - time.monotonic()))
+                    self._tgt_writers[r].send(
+                        pack_envelope(0, trace,
+                                      serialization.dumps(targets[k])),
+                        timeout=max(0.0, deadline - time.monotonic()))
+            losses: List[Any] = []
+            first_err = None
+            for r in range(dp):
+                for m in range(M):
+                    data = self._loss_readers[r].recv(
+                        timeout=max(0.0, deadline - time.monotonic()))
+                    flags, _tr, body = unpack_envelope(data)
+                    val = serialization.loads(body)
+                    if flags & FLAG_ERROR:
+                        first_err = first_err or val
+                    else:
+                        losses.append(val)
+            reports: List[dict] = []
+            for r in range(dp):
+                for rd in self._report_readers[r]:
+                    data = rd.recv(
+                        timeout=max(0.0, deadline - time.monotonic()))
+                    flags, _tr, body = unpack_envelope(data)
+                    val = serialization.loads(body)
+                    if flags & FLAG_ERROR:
+                        first_err = first_err or val
+                    else:
+                        reports.append(val)
+        except CompiledGraphClosedError:
+            with self._lock:
+                if self._closed_error is None:
+                    self._closed_error = CompiledGraphClosedError(
+                        f"pipeline engine {self._gtag}: channel peer "
+                        f"closed mid-step")
+            raise self._closed_reason() from None
+        except GetTimeoutError:
+            self._poisoned = GetTimeoutError(
+                f"pipeline engine {self._gtag}: step timed out — "
+                f"in-flight state is indeterminate; shutdown() and "
+                f"rebuild")
+            raise
+        except BaseException as e:
+            # anything else raised mid-step (a serialization failure, a
+            # channel-capacity error) can leave a partial round in the
+            # rings — e.g. microbatch k sent with no matching target —
+            # so the next step would consume stale envelopes and pair
+            # activations with the wrong targets. Not resumable.
+            self._poisoned = e
+            raise
+        self.last_step_s = time.perf_counter() - t0
+        _H_STEP.observe(self.last_step_s, tags={"engine": self._gtag})
+        if first_err is not None:
+            # envelope error propagation kept every channel count
+            # aligned, but residual/grad state on the stages is gone —
+            # the engine is not safely resumable after a stage raise
+            self._poisoned = first_err
+            raise first_err
+        self.last_reports = reports
+        return float(sum(float(l) for l in losses) / (M * dp))
+
+    def _check_open(self) -> None:
+        if self._closed_error is not None or self._torn:
+            raise self._closed_reason()
+        if self._poisoned is not None:
+            raise CompiledGraphError(
+                f"pipeline engine {self._gtag} is poisoned by an "
+                f"earlier step failure ({type(self._poisoned).__name__}"
+                f": {self._poisoned}); shutdown() and rebuild")
+
+    def _closed_reason(self) -> Exception:
+        err = self._closed_error
+        if err is None:
+            err = CompiledGraphClosedError(
+                f"pipeline engine {self._gtag} was shut down")
+        return type(err)(str(err))
+
+    def get_params(self) -> List[Any]:
+        """Chunk params in GLOBAL chunk order (replica 0's copy)."""
+        P, V = self.num_stages, self.virtual
+        per_actor = ray_tpu.get(
+            [a.get_params.remote() for a in self.actor_grid[0]],
+            timeout=120)
+        return [per_actor[g % P][g // P] for g in range(self.num_chunks)]
+
+    def opt_state_bytes(self) -> List[int]:
+        """Per-stage optimizer-state bytes on replica 0 (the ~1/dp
+        ZeRO shrink shows up here)."""
+        return ray_tpu.get(
+            [a.opt_state_bytes.remote() for a in self.actor_grid[0]],
+            timeout=60)
+
+    # -- fault + teardown --------------------------------------------------
+
+    def _deliver(self, cid: str, seq: int, data: bytes) -> None:
+        q = self._qreaders.get(cid)
+        if q is not None:
+            q.deliver(seq, data)
+
+    def _on_actor_event(self, msg) -> None:
+        try:
+            actor_id, state = msg
+        except Exception:
+            return
+        from ..core.gcs import ActorState
+
+        if state != ActorState.DEAD:
+            return
+        key = actor_id.binary() if hasattr(actor_id, "binary") else None
+        if key in self._actor_plans and not self._torn:
+            self._abort(CompiledGraphClosedError(
+                f"pipeline engine {self._gtag}: stage actor "
+                f"{actor_id.hex()[:8]} died while the engine was live"))
+
+    def _abort(self, err: Exception) -> None:
+        with self._lock:
+            if self._closed_error is None:
+                self._closed_error = err
+        # unblock any in-flight step() NOW (driver endpoints poll this),
+        # and run the teardown off-thread: this is called from the GCS
+        # pubsub callback, and blocking control-plane calls made from
+        # that thread can't be serviced until the callback returns
+        self._stop.set()
+        threading.Thread(target=self.teardown, daemon=True,
+                         name=f"pipeline-abort-{self._gtag}").start()
+
+    def teardown(self) -> None:
+        """Stop the resident loops and release every channel segment
+        (leak-asserted in tests); actors stay alive. Idempotent; a
+        second caller blocks until the first finishes releasing."""
+        with self._teardown_lock:
+            self._teardown_locked()
+
+    def _teardown_locked(self) -> None:
+        with self._lock:
+            if self._torn:
+                return
+            self._torn = True
+            if self._closed_error is None:
+                self._closed_error = CompiledGraphClosedError(
+                    f"pipeline engine {self._gtag} was shut down")
+        self._stop.set()
+        if self._unsub is not None:
+            try:
+                self._unsub()
+            except Exception:
+                pass
+        endpoints = (self._in_writers + self._tgt_writers
+                     + self._loss_readers
+                     + [rd for row in self._report_readers for rd in row])
+        for ch in endpoints:
+            try:
+                ch.mark_closed()
+            except Exception:
+                pass
+        for plan in self._actor_plans.values():
+            try:
+                plan.node.worker_cgraph_call(
+                    plan.worker, "cgraph_stop",
+                    {"graph_id": self.graph_id}, timeout=10.0)
+            except Exception:
+                pass
+        for ch in endpoints:
+            try:
+                ch.close()
+            except Exception:
+                pass
+        for node, cid in self._alloc:
+            try:
+                if getattr(node, "is_remote", False):
+                    node.channel.call("cgraph_release_channel",
+                                      {"cid": cid}, timeout=10)
+                else:
+                    node.store.release_channel(cid)
+            except Exception:
+                pass
+        self._alloc = []
+        try:
+            self._rt._cgraph_unregister(self)
+        except Exception:
+            pass
+
+    def shutdown(self) -> None:
+        """Full teardown: stop loops, release channels, destroy dp
+        collective groups, kill the stage actors, drop the placement
+        group."""
+        self.teardown()
+        if self.dp > 1 and getattr(self, "actor_grid", None):
+            try:
+                ray_tpu.get(
+                    [row[i].cleanup.remote()
+                     for row in self.actor_grid[:1]
+                     for i in range(len(row))], timeout=30)
+            except Exception:
+                pass
+        for a in getattr(self, "actors", []):
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        if self._pg is not None:
+            try:
+                remove_placement_group(self._pg)
+            except Exception:
+                pass
+
+    def __del__(self):
+        try:
+            if not self._torn:
+                self.teardown()
+        except Exception:
+            pass

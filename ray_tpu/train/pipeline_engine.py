@@ -239,70 +239,10 @@ class PipelineEngine:
 
 
 def gpt_pipeline_stages(model, params, num_stages: int):
-    """Split a GPT (models/gpt.py) into pipeline stages: stage 0 carries
-    the embedding, the last stage carries the final LN + tied LM head +
-    loss; layer blocks divide evenly. Returns (stage_fns, stage_params)
-    for PipelineEngine."""
-    import jax.numpy as jnp
+    """Split a GPT into pipeline stages. The split logic lives with the
+    model (models/gpt.py gpt_pipeline_stages — chunk-count aware so the
+    same entry point feeds the interleaved compiled engine); this
+    wrapper keeps the historical import path."""
+    from ..models.gpt import gpt_pipeline_stages as _split
 
-    c = model.config
-    L = c.n_layer
-    if L % num_stages:
-        raise ValueError(f"{L} layers not divisible by {num_stages} stages")
-    per = L // num_stages
-    layer_keys = [k for k in params
-                  if k not in ("wte", "wpe", "lnf_g", "lnf_b")]
-
-    def slice_layers(lo, hi):
-        return {k: params[k][lo:hi] for k in layer_keys}
-
-    stage_params = []
-    for i in range(num_stages):
-        sp = {"layers": slice_layers(i * per, (i + 1) * per)}
-        if i == 0:
-            sp["wte"] = params["wte"]
-            sp["wpe"] = params["wpe"]
-        if i == num_stages - 1:
-            sp["lnf_g"] = params["lnf_g"]
-            sp["lnf_b"] = params["lnf_b"]
-            if "wte" not in sp:
-                sp["head"] = params["wte"]  # tied head needs its own copy
-        stage_params.append(sp)
-
-    def run_layers(model, sp, x):
-        import jax
-
-        def blk(h, lp):
-            return model._block(h, lp, None), None
-        h, _ = jax.lax.scan(blk, x, sp["layers"])
-        return h
-
-    def make_first(model):
-        def fn(sp, tokens):
-            x = model._embed(sp["wte"], sp["wpe"], tokens)
-            return run_layers(model, sp, x)
-        return fn
-
-    def make_mid(model):
-        def fn(sp, x):
-            return run_layers(model, sp, x)
-        return fn
-
-    def make_last(model):
-        def fn(sp, x, targets):
-            from ..ops import cross_entropy_loss, layernorm
-            h = run_layers(model, sp, x)
-            h = layernorm(h, sp["lnf_g"], sp["lnf_b"])
-            head = sp.get("head", sp.get("wte"))
-            return cross_entropy_loss(model._lm_head(head, h), targets)
-        return fn
-
-    if num_stages < 2:
-        raise ValueError("pipeline needs >= 2 stages")
-    stage_fns: List[Callable] = [make_first(model)]
-    for _ in range(num_stages - 2):
-        stage_fns.append(make_mid(model))
-    stage_fns.append(make_last(model))
-    # the tied embedding/head copies must exchange grads every step
-    tied = [(0, "wte", num_stages - 1, "head")]
-    return stage_fns, stage_params, tied
+    return _split(model, params, num_stages)

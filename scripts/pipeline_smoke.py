@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""CI smoke for the compiled-graph pipeline training engine (ISSUE 8).
+
+Spins up an in-process head plus one REAL remote node agent (a second
+OS process over localhost TCP), builds a 2-stage
+`CompiledPipelineEngine` with stage 1 pinned to the remote node, and
+drives 8 microbatches x 5 training steps through the 1F1B loop. Gates:
+
+- the loss trajectory DECREASES (the engine is really training, not
+  just moving bytes)
+- per-stage SPAN events (cgraph:*) landed in the task-event stream
+  from BOTH stage processes (the timeline flow-arrow source)
+- `ray_tpu_pipeline_{step,stage_exec,bubble_wait}_seconds` are present
+  in a /metrics render (stage rows ship on the throttled delta path)
+- `engine.shutdown()` returns every store's channel accounting to the
+  pre-engine baseline — zero leaked segments on either node
+
+Exit 0 = healthy; any assertion prints the evidence and exits 1.
+Run: python scripts/pipeline_smoke.py   (CI invokes it after llm_smoke)
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _mlp(num_chunks: int, width: int, M: int, mb_size: int):
+    import jax
+    import jax.numpy as jnp
+
+    k = jax.random.PRNGKey(0)
+
+    def mk_mid():
+        def fn(p, x):
+            return jnp.tanh(x @ p["w"] + p["b"])
+        return fn
+
+    def mk_last():
+        def fn(p, x, targets):
+            return jnp.mean((x @ p["w"] + p["b"] - targets) ** 2)
+        return fn
+
+    fns = [mk_mid() for _ in range(num_chunks - 1)] + [mk_last()]
+    params = [
+        {"w": jax.random.normal(jax.random.fold_in(k, i),
+                                (width, width)) * 0.3,
+         "b": jnp.zeros((width,))}
+        for i in range(num_chunks)]
+    xs = jax.random.normal(jax.random.fold_in(k, 5), (M * mb_size, width))
+    # a learnable fixed target map keeps the MSE trajectory cleanly
+    # decreasing under sgd (random targets would flatten out fast)
+    w_true = jax.random.normal(jax.random.fold_in(k, 6), (width, width)) * 0.5
+    ys = jnp.tanh(xs @ w_true)
+    mbs = [xs[i * mb_size:(i + 1) * mb_size] for i in range(M)]
+    tgts = [ys[i * mb_size:(i + 1) * mb_size] for i in range(M)]
+    return fns, params, mbs, tgts
+
+
+def main() -> int:
+    import optax
+
+    import ray_tpu  # noqa: F401 — Cluster below owns init
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.train import CompiledPipelineEngine, PipelineConfig
+    from ray_tpu.util import metrics, tracing
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    c = Cluster(head_resources={"CPU": 2.0})
+    try:
+        remote = c.add_remote_node(num_cpus=2.0)
+
+        def store_channels() -> dict:
+            return {nid: n.store.stats().get("num_channels", 0)
+                    for nid, n in c.runtime.nodes.items()}
+
+        baseline = store_channels()
+
+        fns, params, mbs, tgts = _mlp(2, 16, M=8, mb_size=4)
+        cfg = PipelineConfig(num_microbatches=8, channel_bytes=1 << 18)
+        eng = CompiledPipelineEngine(
+            fns, params, optax.sgd(0.05), **cfg.engine_kwargs(),
+            scheduling_strategies=[
+                NodeAffinitySchedulingStrategy(node_id=c.runtime.head_node_id,
+                                               soft=False),
+                NodeAffinitySchedulingStrategy(node_id=remote.node_id,
+                                               soft=False)])
+        losses = []
+        with tracing.trace("pipeline-smoke") as span:
+            for _ in range(5):
+                losses.append(eng.step(mbs, tgts))
+        print(f"5 steps OK, losses {[round(l, 5) for l in losses]}")
+
+        # 1) training signal: every step strictly improves the loss
+        assert all(b < a for a, b in zip(losses, losses[1:])), \
+            f"loss did not decrease: {losses}"
+        assert all(r["in_flight_residuals"] == 0 for r in eng.last_reports), \
+            f"leaked fwd residuals: {eng.last_reports}"
+        print("loss trajectory OK")
+
+        # 2) per-stage spans from both stage processes
+        time.sleep(2.0)  # let task-event batches land
+        spans = tracing.get_trace(span.trace_id)
+        cg = [s for s in spans if s.get("name", "").startswith("cgraph:")]
+        pids = {s.get("pid") for s in cg}
+        assert len(cg) >= 10, \
+            f"expected >=10 cgraph:* stage spans, got {len(cg)}"
+        assert len(pids) >= 2, \
+            f"expected spans from both stage processes, pids={pids}"
+        print(f"timeline spans OK ({len(cg)} spans, {len(pids)} processes)")
+
+        # 3) pipeline metrics present (stage rows ride the throttled
+        # worker delta path — poll briefly)
+        deadline = time.monotonic() + 15
+        want = ("ray_tpu_pipeline_step_seconds",
+                "ray_tpu_pipeline_stage_exec_seconds",
+                "ray_tpu_pipeline_bubble_wait_seconds")
+        body = metrics._render()
+        while (not all(w in body for w in want)
+               and time.monotonic() < deadline):
+            time.sleep(0.3)
+            body = metrics._render()
+        missing = [w for w in want if w not in body]
+        assert not missing, f"missing metrics: {missing}"
+        print("pipeline metrics OK")
+
+        # 4) shutdown releases every channel segment on every node
+        eng.shutdown()
+        after = store_channels()
+        assert after == baseline, \
+            f"leaked channels: baseline={baseline} after={after}"
+        print("shutdown channel accounting OK")
+        print("pipeline smoke OK")
+        return 0
+    finally:
+        c.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

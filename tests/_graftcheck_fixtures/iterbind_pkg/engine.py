@@ -1,0 +1,41 @@
+"""Fixture: the engine driver for the iterative-bind pattern. Stage
+methods are bound into a cyclic graph (fwd chain out, bwd chain back —
+both actors appear twice on the chain), and the engine's OWN dynamic
+surface (setup fan-out, param fetch) blocks in driver-side get()s.
+Those gets belong to the engine, not to the bound stage methods —
+neither GC008 nor GC010 may attribute them to the stages."""
+import ray_tpu
+
+from .stages import DirtyStage, PipeStage
+
+
+class Engine:
+    def __init__(self, params):
+        self.a = PipeStage.remote()
+        self.b = PipeStage.remote()
+        # engine-internal fan-out get: driver-side, must stay clean
+        ray_tpu.get([self.a.setup.remote(0, params),
+                     self.b.setup.remote(1, params)])
+
+    def compile_step(self, inp):
+        # cyclic iterative bind: a.fwd -> b.fwd -> b.bwd -> a.bwd — the
+        # same actors appear on both the forward and backward arcs, so
+        # the bind graph has an a->b->a shape; it is channel dataflow,
+        # not a synchronous wait cycle
+        h1 = self.a.forward.bind(0, 0, inp)
+        h2 = self.b.forward.bind(0, 0, h1)
+        g1 = self.b.backward.bind(0, 0, h2)
+        g0 = self.a.backward.bind(0, 0, g1)
+        u0 = self.a.update.bind(0.1)
+        u1 = self.b.update.bind(0.1)
+        return g0, u0, u1
+
+    def get_params(self):
+        # more engine-internal gets between steps
+        return ray_tpu.get([self.a.update.remote(0.0),
+                            self.b.update.remote(0.0)])
+
+
+def build_dirty(inp):
+    d = DirtyStage.remote()
+    return d.forward.bind(0, 0, inp)

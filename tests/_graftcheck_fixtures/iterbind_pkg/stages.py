@@ -1,0 +1,39 @@
+"""Fixture: pipeline-engine stage actors (ISSUE 8 iterative-bind
+shape). PipeStage's methods are bound into a CYCLIC compiled graph by
+engine.py — forward feeds the peer stage, whose backward feeds back to
+this stage's backward, so the same two actors appear twice on the bind
+chain. The methods are pure compute and must stay GC008-clean, and the
+bind-graph cycle is dataflow over channels (no synchronous waits), so
+GC010 must NOT report an actor-deadlock cycle. DirtyStage is the
+positive control: same shape, but its bound forward does dynamic
+submit work — still flagged."""
+import ray_tpu
+
+
+@ray_tpu.remote
+def helper(x):
+    return x
+
+
+@ray_tpu.remote
+class PipeStage:
+    def setup(self, idx, params):
+        self.idx = idx
+        self.params = params
+        return True
+
+    def forward(self, v, mb, x):
+        return x + self.params          # bound: pure compute, clean
+
+    def backward(self, v, mb, g):
+        return g * 2                    # bound: pure compute, clean
+
+    def update(self, scale):
+        self.params = self.params - scale
+        return {"stage": self.idx}      # bound: pure compute, clean
+
+
+@ray_tpu.remote
+class DirtyStage:
+    def forward(self, v, mb, x):
+        return helper.remote(x)         # GC008: dynamic submit in bound method

@@ -210,6 +210,52 @@ def test_cross_node_edges():
         ray_tpu.shutdown()
 
 
+def test_shm_ring_full_capacity_every_slot(ray_start_regular):
+    """A payload at the advertised capacity must fit in EVERY ring slot
+    — the stride once double-counted the slot's len word, so a
+    near-capacity envelope into the last slot overran the segment."""
+    from ray_tpu.cgraph.channel import ShmChannel, segment_size
+    from ray_tpu.core.ids import ObjectId
+    from ray_tpu.core.object_store import SegmentReader
+
+    rt = ray_start_regular
+    store = rt.nodes[rt.head_node_id].store
+    slots, payload = 4, 64
+    cid = ObjectId.from_random()
+    size = segment_size(payload, slots)
+    name = store.allocate_channel(cid, size)
+    reader = SegmentReader()
+    try:
+        wr = ShmChannel(reader, name, size, edge="t", slots=slots)
+        rd = ShmChannel(reader, name, size, edge="t", slots=slots)
+        for seq in range(2 * slots + 1):  # wraps the ring twice
+            blob = bytes([seq % 251]) * wr.capacity
+            wr.send(blob, timeout=5)
+            assert rd.recv(timeout=5) == blob, seq
+    finally:
+        reader.release(name)
+        store.release_channel(cid)
+
+
+def test_queue_channel_reorders_concurrent_deliveries():
+    """Cross-node envelopes relay through RPC handler POOLS, so two
+    back-to-back sends on one edge can arrive reordered (the pipeline
+    engine streams a whole microbatch round down each edge). deliver()
+    must hand them to the consumer strictly in seq order."""
+    from ray_tpu.cgraph.channel import QueueChannel
+
+    q = QueueChannel("test", edge="t")
+    q.deliver(2, b"two")
+    q.deliver(0, b"zero")
+    q.deliver(1, b"one")
+    assert [q.recv(timeout=5) for _ in range(3)] == [b"zero", b"one", b"two"]
+    q.deliver(4, b"four")   # gap: held until 3 arrives
+    with pytest.raises(exceptions.GetTimeoutError):
+        q.recv(timeout=0.1)
+    q.deliver(3, b"three")
+    assert [q.recv(timeout=5) for _ in range(2)] == [b"three", b"four"]
+
+
 # ---------------------------------------------------------------------------
 # validation + guard rails
 

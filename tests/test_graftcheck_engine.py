@@ -461,6 +461,40 @@ class TestGC008Resolution:
                    for f in res.findings)
 
 
+class TestIterativeBindPattern:
+    """ISSUE 8: stage methods bound into a CYCLIC compiled graph (the
+    pipeline-engine shape — fwd chain out, bwd chain back, the same
+    actors twice on the chain) with the engine's own dynamic surface
+    doing driver-side gets between steps."""
+
+    def test_pure_bound_stage_methods_stay_gc008_clean(self):
+        res = run_pkg("iterbind_pkg", rules={"GC008"})
+        # only the DirtyStage positive control fires; PipeStage's
+        # fwd/bwd/update are bound on a cycle but pure — clean, and the
+        # engine's internal get()s are not attributed to them
+        assert len(res.findings) == 1, res.findings
+        f = res.findings[0]
+        assert os.path.basename(f.path) == "stages.py"
+        assert f.line == 39  # DirtyStage.forward's dynamic submit
+
+    def test_cyclic_bind_dataflow_is_not_a_gc010_deadlock(self):
+        # the a->b->a bind shape is channel dataflow, not synchronous
+        # waiting; no stage method blocks on a peer call
+        res = run_pkg("iterbind_pkg", rules={"GC010"})
+        assert res.findings == [], res.findings
+
+    def test_real_engine_module_clean_for_bind_rules(self):
+        # the regression the fixture models: the shipped engine
+        # (train/pipeline_cgraph.py + cgraph/executor.py) must not trip
+        # the bind/deadlock rules on its own internal gets and loops
+        res = check_project(
+            [os.path.join(REPO, "ray_tpu", "train"),
+             os.path.join(REPO, "ray_tpu", "cgraph")],
+            rules={"GC008", "GC010"}, cache_path=None,
+            root=os.path.join(REPO, "ray_tpu"))
+        assert res.findings == [], res.findings
+
+
 # ---------------------------------------------------------------------------
 # cache
 
