@@ -44,6 +44,10 @@ from ..util import metrics as _metrics
 
 FLAG_ERROR = 1
 
+# fault-injection hook (ray_tpu.chaos): None until chaos.enable()
+# installs an engine; hot paths pay one global is-None test
+_CHAOS = None
+
 # segment layout: header then the slot payload area
 _HDR = struct.Struct("<QQQQ")  # write_seq, read_seq, data_len, closed
 HEADER_BYTES = 64
@@ -152,6 +156,8 @@ class ShmChannel:
     # -- writer ----------------------------------------------------------
 
     def send(self, data: bytes, timeout: Optional[float] = None) -> None:
+        if _CHAOS is not None and _CHAOS.channel_poison(self.edge):
+            self.mark_closed()  # _check_alive below raises for both ends
         if len(data) > self.capacity:
             raise ChannelFullError(
                 f"payload of {len(data)} bytes exceeds channel capacity "

@@ -96,6 +96,13 @@ class CompiledDAG:
         # serializes execute(): input-slot writes must land in issue
         # order or concurrent submitters would cross-wire result seqs
         self._send_lock = threading.Lock()
+        # serializes the teardown BODY: a concurrent teardown (atexit vs
+        # actor-death abort vs explicit call) must block until channels
+        # are actually released, not return while segments are still
+        # allocated. REENTRANT so a signal handler or close-callback
+        # re-entering on the tearing thread returns via the torn flag
+        # instead of self-deadlocking.
+        self._teardown_lock = threading.RLock()
         self._stop = threading.Event()  # interrupt for blocked endpoints
         self._torn = False
         self._closed_error: Optional[Exception] = None
@@ -300,8 +307,14 @@ class CompiledDAG:
 
     def teardown(self) -> None:
         """Stop the resident loops, release every pre-allocated channel
-        segment, and error any still-pending refs. Idempotent; the
-        actors stay alive and usable afterwards."""
+        segment, and error any still-pending refs. Idempotent AND
+        race-safe: a second concurrent caller blocks until the first
+        finished releasing; a reentrant call (signal handler on the
+        tearing thread) returns immediately via the torn flag."""
+        with self._teardown_lock:
+            self._teardown_locked()
+
+    def _teardown_locked(self) -> None:
         with self._cond:
             if self._torn:
                 return

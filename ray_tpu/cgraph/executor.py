@@ -136,6 +136,14 @@ class CGraphExecutor:
                 ch.close()
             except Exception:
                 pass
+        # ship this run's metric deltas NOW: a short-lived graph (a fast
+        # pipeline engine torn down within the export interval) would
+        # otherwise lose its stage_exec/bubble_wait samples when the
+        # driver kills the actor right after this stop returns
+        try:
+            self.worker._flush_metrics()
+        except Exception:
+            pass
         return True
 
     def stop_all(self) -> None:

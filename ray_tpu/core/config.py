@@ -145,6 +145,11 @@ _define("health_check_period_s", 1.0,
         "(ref: gcs_health_check_manager)")
 _define("health_check_timeout_s", 10.0,
         "an agent silent for this long is declared dead and fenced")
+_define("heartbeat_miss_threshold", 0,
+        "declare a node dead only after this many consecutive missed "
+        "heartbeat periods, when stricter than health_check_timeout_s "
+        "(0 = timeout alone governs); every silent period counts in "
+        "ray_tpu_heartbeat_misses_total{node}")
 _define("lineage_max_bytes", 256 * 1024 * 1024,
         "lineage (resubmittable task specs) memory budget")
 # --- gcs ---

@@ -28,6 +28,7 @@ import time
 from typing import Dict, Optional
 
 from ..devtools.locks import instrumented_lock
+from ..util.retry import RetryPolicy, call_with_retry
 from .config import Config
 from .ids import NodeId, ObjectId, WorkerId
 from .object_store import (make_store, SegmentReader, pull_chunks,
@@ -81,6 +82,13 @@ class NodeAgent:
         self._log_ring_lines = int(self.config.agent_log_ring_lines)
         self._log_rings: Dict[WorkerId, _deque] = {}
         self._stopped = threading.Event()
+        self._shutdown_claim = threading.Lock()
+        # deterministic fault injection on this agent process too (env is
+        # inherited from the launcher): frame-level chaos applies to the
+        # agent's head/worker/peer channels
+        from .. import chaos as _chaos_mod
+
+        _chaos_mod.maybe_enable_from_env()
         self._sock_path = os.path.join(
             self.session_dir, f"agent_{self.node_id.hex()[:12]}.sock")
         self._server = RpcServer(self._sock_path, self._make_worker_handler,
@@ -104,9 +112,18 @@ class NodeAgent:
         self._peer_channels: Dict[tuple, RpcChannel] = {}
         # one duplex channel to the head: requests out, commands in.
         # authkey = the cluster token (from --authkey / RTPU_AUTHKEY).
-        self.head = connect(conn_addr, name="agent",
+        # Joining retries with backoff (util/retry.py): on pod bring-up
+        # the agent routinely starts before the head is listening, and a
+        # restarted head should find its agents reconnecting rather than
+        # dead (docs/FAULT_TOLERANCE.md).
+        self.head = call_with_retry(
+            lambda: connect(conn_addr, name="agent",
                             handler=self._handle_head_command,
-                            num_handler_threads=8)
+                            num_handler_threads=8),
+            policy=RetryPolicy(initial_backoff_s=0.2, multiplier=2.0,
+                               max_backoff_s=2.0, deadline_s=30.0),
+            retry_on=(OSError, ConnectionError),
+            description=f"agent join {conn_addr}")
         self.head.on_close(self._on_head_lost)
         reply = self.head.call("register_node", {
             "node_id": self.node_id,
@@ -257,13 +274,22 @@ class NodeAgent:
 
         return handler
 
+    # peer reconnect policy (util/retry.py): an accept-backlog refusal
+    # on a busy holder must not immediately push the pull onto the head
+    # relay, but a truly dead peer should fail over fast
+    _PEER_CONNECT = RetryPolicy(initial_backoff_s=0.05, multiplier=2.0,
+                                max_backoff_s=0.4, max_attempts=3)
+
     def _peer_channel(self, addr: tuple) -> RpcChannel:
         with self._lock:
             ch = self._peer_channels.get(addr)
             if ch is not None and not ch.closed:
                 return ch
-        ch = connect(addr, name="peer",
-                     num_handler_threads=2)
+        ch = call_with_retry(
+            lambda: connect(addr, name="peer", num_handler_threads=2),
+            policy=self._PEER_CONNECT,
+            retry_on=(OSError, ConnectionError),
+            description=f"peer connect {addr}")
         with self._lock:
             old = self._peer_channels.get(addr)
             if old is not None and not old.closed:
@@ -497,9 +523,13 @@ class NodeAgent:
             self.shutdown(kill=True)
 
     def shutdown(self, kill: bool = False) -> None:
-        if self._stopped.is_set():
-            return
-        self._stopped.set()
+        # atomic claim: the head-loss callback, a head "shutdown" command,
+        # and SIGINT can all race here — exactly one caller runs the body
+        # (Event.is_set()+set() as two steps let two callers both enter)
+        with self._shutdown_claim:
+            if self._stopped.is_set():
+                return
+            self._stopped.set()
         with self._lock:
             procs = dict(self._procs)
             channels = dict(self._channels)

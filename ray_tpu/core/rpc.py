@@ -47,6 +47,11 @@ from ..util import metrics as _metrics
 
 _RPC_STATS: Dict[str, list] = {}
 _RPC_STATS_LOCK = threading.Lock()
+
+# fault-injection hook (ray_tpu.chaos): None until chaos.enable()
+# installs an engine — the frame paths pay one global is-None test when
+# disabled, and this module never imports the chaos package
+_CHAOS = None
 _RPC_LATENCY = _metrics.Histogram(
     "ray_tpu_rpc_handler_seconds",
     "per-RPC-method handler latency (request and oneway frames)",
@@ -428,6 +433,12 @@ class RpcChannel:
                 # and the send syscall happen outside it
                 msgs = [self._outbox.popleft()
                         for _ in range(min(len(self._outbox), _BATCH_MAX))]
+            if _CHAOS is not None:
+                # seeded drop/delay/duplicate/reorder of outbound frames
+                # (oneway only for drop/dup — see ray_tpu.chaos docs)
+                msgs = _CHAOS.rpc_send(msgs)
+                if not msgs:
+                    continue
             frames = []
             for msg in msgs:
                 try:
@@ -546,6 +557,8 @@ class RpcChannel:
         elif kind == _REQ:
             self._req_lane.push((msg_id, a, b))
         elif kind == _ONEWAY:
+            if _CHAOS is not None and _CHAOS.recv_drop(a):
+                return  # injected receiver-side loss
             self._ow_lane.push((a, b))
 
     def _handle_req(self, item) -> None:

@@ -29,6 +29,7 @@ import cloudpickle
 from .. import exceptions as exc
 from ..devtools.locks import instrumented_lock
 from ..util import metrics as metrics_mod
+from ..util.retry import RetryPolicy
 from . import serialization
 from .config import Config
 from .gcs import ActorInfo, ActorState, Gcs, JobInfo, NodeInfo
@@ -44,6 +45,21 @@ from .task_spec import (ARG_REF, ARG_VALUE, STREAMING_RETURNS,
 
 _runtime_lock = instrumented_lock("runtime.global_registry")
 _runtime: Optional[object] = None
+
+# fault-injection hook (ray_tpu.chaos): None until chaos.enable()
+# installs an engine; the pull path pays one global is-None test
+_CHAOS = None
+
+_C_HEARTBEAT_MISSES = metrics_mod.Counter(
+    "ray_tpu_heartbeat_misses_total",
+    "health-check periods that elapsed without an agent heartbeat",
+    tag_keys=("node",))
+
+# dispatch-fallback reconnect policy (util/retry.py): how long a failed
+# direct-peer connect keeps the actor on the routed path before the next
+# attempt — grows per consecutive failure, resets on success
+_DIRECT_RECONNECT = RetryPolicy(initial_backoff_s=2.5, multiplier=2.0,
+                                max_backoff_s=30.0, jitter=0.3)
 
 # hot-path latency instruments (head side; the worker-side mirrors live
 # in each worker's registry and ship to the head via metrics_push)
@@ -194,8 +210,11 @@ class _ActorRecord:
     # a transiently refused connect (accept backlog, listener busy) must
     # not strand the actor on the routed path for the whole epoch, while
     # a truly unreachable socket (cross-host) costs one failed connect
-    # per window instead of one per call.
+    # per window instead of one per call. The window grows per
+    # consecutive failure on the shared reconnect policy (util/retry.py)
+    # and resets on success / new placement epoch.
     direct_bad: float = 0.0
+    direct_fails: int = 0
 
 
 class DriverRuntime:
@@ -271,6 +290,8 @@ class DriverRuntime:
         # the owning worker; see docs/DISPATCH.md
         self._direct_enabled = bool(int(self.config.direct_actor_calls))
         self._shutdown = False
+        self._shutdown_lock = threading.Lock()
+        self._shutdown_owner: Optional[int] = None
         threading.Thread(target=self._pg_placer_loop, daemon=True,
                          name="pg-placer").start()
         default_res = resources or {"CPU": float(os.cpu_count() or 1)}
@@ -286,6 +307,11 @@ class DriverRuntime:
             weakref.finalize(ref, self.refcount.remove_local, ref.id)
 
         _set_borrow_hook(_driver_borrow)
+        # deterministic fault injection (RAY_TPU_CHAOS env): installs the
+        # seeded drop/delay/kill hooks and starts the kill schedule
+        from .. import chaos as _chaos_mod
+
+        _chaos_mod.maybe_enable_from_env(runtime=self)
         self._revive_detached_actors()
         # head restart: PGs restored as RESCHEDULING (gcs restore path)
         # need a placement pass once nodes re-register
@@ -341,6 +367,13 @@ class DriverRuntime:
     def _health_check_loop(self) -> None:
         period = float(self.config.health_check_period_s)
         timeout = float(self.config.health_check_timeout_s)
+        # consecutive-miss fencing: heartbeat_miss_threshold > 0 extends
+        # the death bar to threshold*period when that is stricter than
+        # timeout alone (docs/FAULT_TOLERANCE.md); every silent period
+        # counts in ray_tpu_heartbeat_misses_total{node} either way
+        threshold = int(self.config.heartbeat_miss_threshold)
+        if threshold > 0:
+            timeout = max(timeout, threshold * period)
         while not self._shutdown:
             time.sleep(period)
             now = time.monotonic()
@@ -350,8 +383,13 @@ class DriverRuntime:
             for nid in remote_ids:
                 info = next((i for i in self.gcs.nodes()
                              if i.node_id == nid), None)
-                if info is not None and info.alive \
-                        and now - info.last_heartbeat > timeout:
+                if info is None or not info.alive:
+                    continue
+                silent = now - info.last_heartbeat
+                if silent > period:
+                    _C_HEARTBEAT_MISSES.inc(
+                        tags={"node": nid.hex()[:12]})
+                if silent > timeout:
                     self.on_remote_node_lost(nid)
 
     def _make_agent_handler(self, channel):
@@ -814,10 +852,17 @@ class DriverRuntime:
             self._free_object(r.id)
 
     # fetch: returns ("inline", bytes) or ("shm", name, size)
+    # pull-retry backoff (util/retry.py): transient RPC failures against
+    # a live holder back off exponentially instead of hammering at a
+    # fixed 10ms; the fetch deadline still bounds the whole wait
+    _PULL_RETRY = RetryPolicy(initial_backoff_s=0.01, multiplier=1.5,
+                              max_backoff_s=0.25, jitter=0.2)
+
     def fetch_one(self, oid: ObjectId, timeout: Optional[float],
                   on_block=None) -> Tuple:
         deadline = None if timeout is None else time.monotonic() + timeout
         attempts = 0
+        transient_attempts = 0
         while True:
             ev = self._event(oid)
             if on_block is not None and not ev.is_set():
@@ -873,8 +918,17 @@ class DriverRuntime:
                     if d is not None:
                         d.discard(nid)
             if transient_failure:
-                time.sleep(0.01)
+                # a set availability event makes ev.wait(0) return True,
+                # so the deadline must be enforced here too or transient
+                # failures past the timeout would retry forever
+                if deadline is not None and time.monotonic() > deadline:
+                    raise exc.GetTimeoutError(
+                        f"Get timed out retrying transient pull "
+                        f"failures for object {oid.hex()[:12]}")
+                time.sleep(self._PULL_RETRY.backoff(transient_attempts))
+                transient_attempts += 1
                 continue
+            transient_attempts = 0
             # all copies gone -> lineage reconstruction
             attempts += 1
             if attempts > 5:
@@ -895,6 +949,9 @@ class DriverRuntime:
             # exception = transient failure (caller retries)
             return fut.result(timeout=300)
         try:
+            if _CHAOS is not None and _CHAOS.pull_fail(oid.hex()):
+                raise RuntimeError(
+                    f"chaos: injected pull failure for {oid.hex()[:12]}")
             data = node.pull_object_bytes(oid)
             res = None if data is None else self._promote_pulled(oid, data)
             fut.set_result(res)
@@ -1699,8 +1756,13 @@ class DriverRuntime:
                                             handler=self._direct_peer_handler,
                                             name="dpeer")
                     except Exception:
-                        rec.direct_bad = time.monotonic() + 5.0
+                        # dispatch-fallback backoff (util/retry.py): the
+                        # routed window grows with consecutive failures
+                        rec.direct_bad = time.monotonic() + \
+                            _DIRECT_RECONNECT.backoff(rec.direct_fails)
+                        rec.direct_fails += 1
                         return None
+                    rec.direct_fails = 0
                     chan.on_close(
                         lambda aid=spec.actor_id, ch=chan:
                         self._on_direct_peer_close(aid, ch))
@@ -1765,7 +1827,9 @@ class DriverRuntime:
             # drop the cache and re-route through the head (the next
             # placement epoch resets the deadline early)
             with rec.lock:
-                rec.direct_bad = time.monotonic() + 5.0
+                rec.direct_bad = time.monotonic() + \
+                    _DIRECT_RECONNECT.backoff(rec.direct_fails)
+                rec.direct_fails += 1
                 rec.direct_chan = None
             self._resubmit_direct(spec)
             return
@@ -2547,9 +2611,33 @@ class DriverRuntime:
         return total
 
     def shutdown(self) -> None:
-        if self._shutdown:
-            return
-        self._shutdown = True
+        """Idempotent and race-safe: concurrent callers (atexit hook vs
+        signal handler vs explicit call) serialize on the shutdown lock —
+        the loser blocks until teardown actually finished instead of
+        returning while nodes/channels are still being released. A
+        REENTRANT call from the same thread (a signal delivered inside
+        shutdown, or an on_close callback calling back in) returns
+        immediately: blocking would self-deadlock."""
+        # no unlocked fast path on the _shutdown flag: the flag is set
+        # BEFORE the body runs, so a concurrent caller reading it early
+        # would return while teardown is still in progress — it must
+        # block on the lock below instead
+        if not self._shutdown_lock.acquire(blocking=False):
+            if self._shutdown_owner == threading.get_ident():
+                return  # reentrant (signal handler / close callback)
+            with self._shutdown_lock:  # concurrent: wait for completion
+                return
+        try:
+            if self._shutdown:
+                return
+            self._shutdown_owner = threading.get_ident()
+            self._shutdown = True
+            self._shutdown_body()
+        finally:
+            self._shutdown_owner = None
+            self._shutdown_lock.release()
+
+    def _shutdown_body(self) -> None:
         for dag in list(self._cgraphs.values()):
             try:
                 dag.teardown()  # release channel segments + stop loops
@@ -2688,8 +2776,12 @@ class _WorkerDirectState:
         if chan is None:
             with self._lock:
                 old = self._actors.get(actor_id) or {}
+                fails = old.get("fails", 0)
                 self._actors[actor_id] = {
-                    "ok": False, "bad_until": time.monotonic() + 5.0,
+                    "ok": False,
+                    "bad_until": time.monotonic()
+                    + _DIRECT_RECONNECT.backoff(fails),
+                    "fails": fails + 1,
                     "seq": 0, "lane": old.get("lane", 0),
                     "epoch": res["epoch"]}
             return None

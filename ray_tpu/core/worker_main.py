@@ -834,6 +834,12 @@ def main() -> None:
 
     faulthandler.register(signal.SIGUSR1, all_threads=True)
 
+    # deterministic fault injection (env inherited from the node): frame
+    # chaos applies to this worker's node channel and direct peer sockets
+    from .. import chaos as _chaos_mod
+
+    _chaos_mod.maybe_enable_from_env()
+
     worker_id = WorkerId.from_hex(args.worker_id)
     try:
         # auth token arrives via RTPU_AUTHKEY in the environment (connect's

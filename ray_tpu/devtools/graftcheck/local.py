@@ -44,6 +44,13 @@ GC009  blocking ``ray_tpu.get()`` or synchronous handle resolution
        of a ``@serve.deployment`` class — stalls the replica's event
        loop for every queued request; ``await`` the response (or hop to
        an executor) instead.
+GC012  unbounded bare retry loop: ``while True:`` wrapping a
+       try/except whose handler swallows-and-retries a remote call or
+       connection attempt, with no backoff growth, no deadline, and no
+       attempt budget anywhere in the loop — hammers a dead peer
+       forever and turns one fault into a spin. Route the loop through
+       ``ray_tpu.util.retry`` (RetryPolicy / call_with_retry) or add an
+       explicit deadline/attempt bound.
 ====== =================================================================
 
 Suppression: append ``# graftcheck: disable=GC001`` (comma-separate for
@@ -87,6 +94,9 @@ RULES: Dict[str, str] = {
     "GC009": "blocking get()/.result() inside an async serve deployment "
              "method (stalls the replica event loop for every queued "
              "request)",
+    "GC012": "unbounded bare retry loop around a remote call / connect "
+             "(no backoff, deadline, or attempt budget — use "
+             "ray_tpu.util.retry)",
     # whole-program rules (engine-backed; see rules_project.py/rules_spmd.py)
     "GC010": "actor-deadlock: cycle of synchronous get() waits through the "
              "remote call graph (incl. self-calls on single-concurrency "
@@ -451,6 +461,8 @@ class _FileChecker:
                     f"an actor")
         if isinstance(stmt, ast.Try):
             self._check_gc005(stmt)
+        if isinstance(stmt, ast.While):
+            self._check_gc012(stmt)
         # GC006 on statement-position acquire() calls
         self._check_gc006(stmt, siblings, idx)
         # this statement's own expressions: GC001/GC002/GC004/GC008/GC009
@@ -611,6 +623,94 @@ class _FileChecker:
 
     # -- statement-level rules --------------------------------------------
 
+    # names whose presence in a retry loop signals an explicit bound
+    _GC012_BOUND_NAMES = ("deadline", "attempt", "retries", "backoff",
+                          "budget")
+    # calls that make the loop policy-governed (util/retry.py)
+    _GC012_POLICY_CALLS = ("sleeps", "call_with_retry", "backoff")
+
+    def _check_gc012(self, loop: ast.While) -> None:
+        """Unbounded bare retry loop: ``while True`` + a try whose
+        handler swallows-and-continues around a remote/connect call,
+        with no deadline comparison, growing backoff, attempt counter,
+        or util.retry usage anywhere in the loop."""
+        if not (isinstance(loop.test, ast.Constant) and loop.test.value):
+            return
+        retry_site = None
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Try):
+                continue
+            if not any(self._gc012_retryable_call(c)
+                       for s in node.body for c in ast.walk(s)):
+                continue
+            for handler in node.handlers:
+                if self._gc012_handler_swallows(handler):
+                    retry_site = node
+                    break
+            if retry_site is not None:
+                break
+        if retry_site is None:
+            return
+        if self._gc012_loop_is_bounded(loop):
+            return
+        self.report(
+            "GC012", retry_site,
+            "unbounded bare retry loop: the handler swallows the error "
+            "and retries the remote call/connect forever with no "
+            "backoff, deadline, or attempt budget — hammers a dead peer "
+            "and hides the fault; use ray_tpu.util.retry (RetryPolicy."
+            "sleeps / call_with_retry) or add an explicit bound")
+
+    @staticmethod
+    def _gc012_retryable_call(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        func = node.func
+        if isinstance(func, ast.Attribute) \
+                and func.attr in ("remote", "connect",
+                                  "create_connection"):
+            return True
+        return isinstance(func, ast.Name) and func.id in (
+            "connect", "create_connection")
+
+    @staticmethod
+    def _gc012_handler_swallows(handler: ast.ExceptHandler) -> bool:
+        """Swallow-and-retry shape: the handler neither re-raises nor
+        leaves the loop (no raise/return/break anywhere in it)."""
+        for n in ast.walk(handler):
+            if isinstance(n, (ast.Raise, ast.Return, ast.Break)):
+                return False
+        return True
+
+    def _gc012_loop_is_bounded(self, loop: ast.While) -> bool:
+        for n in ast.walk(loop):
+            if isinstance(n, ast.Compare):
+                # a deadline/attempt comparison anywhere bounds the loop
+                for side in [n.left] + list(n.comparators):
+                    for leaf in ast.walk(side):
+                        if isinstance(leaf, ast.Name) and any(
+                                b in leaf.id.lower()
+                                for b in self._GC012_BOUND_NAMES):
+                            return True
+                        if isinstance(leaf, ast.Call):
+                            d = _dotted(leaf.func)
+                            if d and d[-1] in ("monotonic", "time",
+                                               "perf_counter"):
+                                return True
+            elif isinstance(n, ast.Call):
+                d = _dotted(n.func)
+                if d is None:
+                    continue
+                if d[-1] in self._GC012_POLICY_CALLS:
+                    return True
+                if d[-1] == "sleep" and n.args and not isinstance(
+                        n.args[0], ast.Constant):
+                    return True  # variable sleep = growing backoff
+            elif isinstance(n, ast.Name) and any(
+                    b in n.id.lower() for b in self._GC012_BOUND_NAMES):
+                return True
+        return False
+
     def _check_gc005(self, node: ast.Try) -> None:
         for handler in node.handlers:
             if handler.type is not None:
@@ -729,4 +829,4 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
 # Local rules only — the engine runs these per file (cache-keyed by
 # content hash) and layers the whole-program rules on top.
 LOCAL_RULES: Set[str] = {"GC001", "GC002", "GC003", "GC004", "GC005",
-                         "GC006", "GC007", "GC008", "GC009"}
+                         "GC006", "GC007", "GC008", "GC009", "GC012"}

@@ -158,6 +158,19 @@ class ZeroUpdater:
         """Bytes of optimizer state THIS replica holds (~ full/dp)."""
         return tree_bytes(self._opt_state)
 
+    def opt_state(self):
+        """This rank's optimizer-state SHARD (checkpointing surface —
+        the pipeline engine persists one shard per dp rank and hands it
+        back through :meth:`set_opt_state` on restore)."""
+        return self._opt_state
+
+    def set_opt_state(self, state) -> None:
+        """Restore this rank's shard (must come from the same (rank,
+        world, param-tree) layout it was saved under)."""
+        if self._spec is None:
+            raise RuntimeError("ZeroUpdater.set_opt_state() before init()")
+        self._opt_state = state
+
     def update(self, params, grads):
         """Collective optimizer step: reduce-scatter the gradient mean,
         update this rank's shard, all-gather fresh parameters. Returns

@@ -18,10 +18,18 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Dict, Optional
 
 import ray_tpu
-from ray_tpu.exceptions import ActorDiedError, WorkerCrashedError
+from ray_tpu.exceptions import (ActorDiedError, ActorUnavailableError,
+                                ObjectLostError, WorkerCrashedError)
 from ray_tpu.util import metrics as _metrics
+from ray_tpu.util.retry import RetryPolicy
 
 from .controller import CONTROLLER_NAME
+
+# errors that mean "the replica (or its worker) is gone" — the router
+# still owns the request and may reassign it; anything else is the
+# application's error and propagates
+REPLICA_LOST_ERRORS = (ActorDiedError, ActorUnavailableError,
+                       WorkerCrashedError, ObjectLostError)
 
 # end-to-end request latency as the router sees it: replica pick +
 # queueing + execution + result fetch (ref: the reference's
@@ -105,6 +113,116 @@ class DeploymentResponseGenerator:
         """Async iteration (`async for chunk in handle.options(
         stream=True).remote(...)`)."""
         return await executor_anext(lambda: self.next(timeout=600.0))
+
+
+class FailoverResponseGenerator:
+    """A streaming response that survives replica death (the LLM serving
+    failover surface — docs/FAULT_TOLERANCE.md).
+
+    The handle routes the stream to one replica and records the
+    request→replica assignment. When a pull raises a replica-loss error
+    (REPLICA_LOST_ERRORS), the generator drops the corpse from the
+    routing table, asks ``resume(args, kwargs, yielded_items)`` for the
+    continuation request — for LLM streams: already-streamed tokens
+    become the forced prefix of a re-prefill — and re-routes it to a
+    surviving replica. Items are only recorded AFTER they are handed to
+    the consumer, so a mid-flight death can neither lose nor duplicate
+    an item: everything the consumer saw is in the forced prefix, and
+    everything it didn't see is regenerated.
+
+    ``resume`` returning None means the stream was already complete
+    (every item was delivered before the death) — the generator ends
+    cleanly instead of re-submitting an empty continuation.
+    """
+
+    _MAX_FAILOVERS = 8
+
+    def __init__(self, handle: "DeploymentHandle", method: str, args,
+                 kwargs, mux_id: str, resume, deadline: float):
+        self._handle = handle
+        self._method = method
+        self._args = args
+        self._kwargs = kwargs
+        self._mux_id = mux_id
+        self._resume = resume
+        self._deadline = deadline
+        self._gen: Optional[DeploymentResponseGenerator] = None
+        self._replica = None
+        self._yielded: list = []
+        self.failovers = 0
+        self._finished = False
+        self._key = id(self)
+
+    @property
+    def replica_actor_id(self):
+        r = self._replica
+        return None if r is None else r._actor_id
+
+    def _ensure_stream(self) -> None:
+        if self._gen is not None:
+            return
+        self._gen, self._replica = self._handle._start_stream(
+            self._method, self._args, self._kwargs, self._mux_id,
+            self._deadline)
+        self._handle._assign_stream(self._key, self._replica._actor_id)
+
+    def _finish(self) -> None:
+        self._finished = True
+        self._handle._unassign_stream(self._key)
+
+    def next(self, timeout=None):
+        if self._finished:
+            raise StopIteration
+        while True:
+            self._ensure_stream()
+            try:
+                item = self._gen.next(timeout=timeout)
+            except StopIteration:
+                self._finish()
+                raise
+            except REPLICA_LOST_ERRORS as e:
+                self._handle._drop(self._replica)
+                self._handle._unassign_stream(self._key)
+                self._gen = None
+                self._replica = None
+                self.failovers += 1
+                if self.failovers > self._MAX_FAILOVERS:
+                    self._finish()
+                    raise
+                cont = self._resume(self._args, self._kwargs,
+                                    list(self._yielded))
+                if cont is None:
+                    # every item was already delivered: the death hit
+                    # between the last item and the end-of-stream marker
+                    self._finish()
+                    raise StopIteration from None
+                self._args, self._kwargs = cont
+                # the continuation args now BAKE IN everything yielded so
+                # far (forced prefix); reset the ledger to the new
+                # baseline — a second death must only replay items
+                # yielded since this resume, or the prefix double-counts
+                self._yielded = []
+                continue
+            self._yielded.append(item)
+            return item
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        return await executor_anext(lambda: self.next(timeout=600.0))
+
+    def __del__(self):
+        try:
+            self._handle._unassign_stream(self._key)
+        except Exception:
+            pass
 
 
 class DeploymentHandle:
@@ -295,17 +413,23 @@ class DeploymentHandle:
 
             kwargs = {**kwargs, MUX_KWARG: mux_id}
         rt = runtime_mod.get_runtime()
-        backoff = 0.005
         t_start = time.perf_counter()
         try:
             return self._route_with_retries(rt, method, args, kwargs,
-                                            deadline, mux_id, backoff)
+                                            deadline, mux_id)
         finally:
             _H_SERVE_REQUEST.observe(time.perf_counter() - t_start,
                                      tags={"deployment": self._name})
 
+    # shared routing backoff (util/retry.py): saturated/empty replica
+    # sets back off exponentially with full jitter so concurrent routers
+    # decorrelate; the per-request deadline bounds the whole wait
+    _ROUTE_BACKOFF = RetryPolicy(initial_backoff_s=0.0075, multiplier=2.0,
+                                 max_backoff_s=0.375, jitter=0.34)
+
     def _route_with_retries(self, rt, method, args, kwargs, deadline,
-                            mux_id, backoff):
+                            mux_id):
+        saturated = 0
         while True:
             self._refresh()
             replica = self._pick(mux_id)
@@ -314,8 +438,8 @@ class DeploymentHandle:
                     raise TimeoutError(
                         f"{self._name}: no replica accepted the request "
                         f"(all dead or saturated)")
-                time.sleep(backoff + random.random() * backoff)
-                backoff = min(backoff * 2, 0.25)
+                time.sleep(self._ROUTE_BACKOFF.backoff(saturated))
+                saturated += 1
                 self._refresh(force=True)
                 continue
             aid = replica._actor_id
@@ -325,19 +449,14 @@ class DeploymentHandle:
                 ref = replica.handle_request.remote(method, args, kwargs)
                 remaining = max(0.1, deadline - time.monotonic())
                 return ray_tpu.get(ref, timeout=remaining)
-            except (ActorDiedError, WorkerCrashedError):
+            except REPLICA_LOST_ERRORS:
                 # replica died before/while running the request: the router
                 # still owns it — drop the corpse and reassign (ref:
                 # router.py replica-death reassignment)
                 self._drop(replica)
                 continue
             finally:
-                with self._lock:
-                    c = self._inflight.get(aid, 0) - 1
-                    if c <= 0:
-                        self._inflight.pop(aid, None)
-                    else:
-                        self._inflight[aid] = c
+                self._dec_inflight(aid)
 
     def _submit(self, method: str, args, kwargs,
                 mux_id: str = "") -> DeploymentResponse:
@@ -351,40 +470,87 @@ class DeploymentHandle:
                             deadline, mux_id)
         return DeploymentResponse(fut)
 
-    def _submit_streaming(self, method: str, args, kwargs,
-                          mux_id: str = "") -> DeploymentResponseGenerator:
-        """Streaming requests route synchronously (picking a replica is
-        cheap; the chunks themselves are pull-driven) and do NOT re-route
-        mid-stream — a replica death surfaces to the consumer, matching
-        the reference's streaming semantics (http_proxy.py:775)."""
-        if mux_id:
-            from .multiplex import MUX_KWARG
-
-            kwargs = {**kwargs, MUX_KWARG: mux_id}
-        deadline = time.monotonic() + 300.0
-        backoff = 0.005
+    def _pick_replica_blocking(self, mux_id: str, deadline: float):
+        """Block until some replica accepts (p2c + saturation backoff);
+        raises TimeoutError at the deadline. The picked replica's
+        in-flight count was already incremented by _pick."""
+        saturated = 0
         while True:
             self._refresh()
             replica = self._pick(mux_id)
             if replica is not None:
-                break
+                return replica
             if time.monotonic() > deadline:
                 raise TimeoutError(f"{self._name}: no replica available")
-            time.sleep(backoff + random.random() * backoff)
-            backoff = min(backoff * 2, 0.25)
+            time.sleep(self._ROUTE_BACKOFF.backoff(saturated))
+            saturated += 1
             self._refresh(force=True)
+
+    def _dec_inflight(self, aid) -> None:
+        with self._lock:
+            c = self._inflight.get(aid, 0) - 1
+            if c <= 0:
+                self._inflight.pop(aid, None)
+            else:
+                self._inflight[aid] = c
+
+    def _start_stream(self, method: str, args, kwargs, mux_id: str,
+                      deadline: float):
+        """-> (DeploymentResponseGenerator, replica). One routed
+        streaming submission; the caller owns failover policy."""
+        replica = self._pick_replica_blocking(mux_id, deadline)
         aid = replica._actor_id
         try:
             ref_gen = replica.handle_request_streaming.options(
                 num_returns="streaming").remote(method, args, kwargs)
         finally:
-            with self._lock:
-                c = self._inflight.get(aid, 0) - 1
-                if c <= 0:
-                    self._inflight.pop(aid, None)
-                else:
-                    self._inflight[aid] = c
-        return DeploymentResponseGenerator(ref_gen)
+            self._dec_inflight(aid)
+        return DeploymentResponseGenerator(ref_gen), replica
+
+    def _submit_streaming(self, method: str, args, kwargs,
+                          mux_id: str = "", resume=None):
+        """Streaming requests route synchronously (picking a replica is
+        cheap; the chunks themselves are pull-driven).
+
+        Without ``resume`` they do NOT re-route mid-stream — a replica
+        death surfaces to the consumer, matching the reference's
+        streaming semantics (http_proxy.py:775). With a ``resume``
+        callable the stream becomes failover-aware: on replica death the
+        router, which tracked the request→replica assignment, rebuilds a
+        continuation request via ``resume(args, kwargs, items_yielded)``
+        and re-routes it to a surviving replica — the consumer sees a
+        stall, never an error or a duplicated/lost item (the LLM serving
+        path plugs its re-prefill semantics in here; see
+        serve/llm/failover.py)."""
+        if mux_id:
+            from .multiplex import MUX_KWARG
+
+            kwargs = {**kwargs, MUX_KWARG: mux_id}
+        deadline = time.monotonic() + 300.0
+        if resume is not None:
+            return FailoverResponseGenerator(self, method, args, kwargs,
+                                             mux_id, resume, deadline)
+        gen, _replica = self._start_stream(method, args, kwargs, mux_id,
+                                           deadline)
+        return gen
+
+    def stream_assignments(self) -> Dict[int, Any]:
+        """Live failover-stream → replica actor-id assignments (keyed by
+        stream id); the observability hook chaos_smoke asserts on."""
+        with self._lock:
+            return dict(getattr(self, "_stream_assign", {}) or {})
+
+    def _assign_stream(self, stream_key: int, aid) -> None:
+        with self._lock:
+            if not hasattr(self, "_stream_assign"):
+                self._stream_assign: Dict[int, Any] = {}
+            self._stream_assign[stream_key] = aid
+
+    def _unassign_stream(self, stream_key: int) -> None:
+        with self._lock:
+            table = getattr(self, "_stream_assign", None)
+            if table is not None:
+                table.pop(stream_key, None)
 
     # -- public API ------------------------------------------------------------
 

@@ -5,16 +5,20 @@ preallocated block pool (kv_cache.py + ops/paged_attention.py), an
 iteration-level scheduler that admits prefills into running decode
 batches under token/block budgets and preempts-and-requeues on
 allocation failure (engine.py), a serve deployment with streaming token
-responses (deployment.py), and an optional disaggregated prefill/decode
-mode over compiled-graph channels (disagg.py). See docs/LLM_SERVE.md.
+responses (deployment.py), an optional disaggregated prefill/decode
+mode over compiled-graph channels (disagg.py), and zero-loss replica
+failover for token streams (failover.py — streamed tokens become the
+forced prefix of a re-prefill on a surviving replica). See
+docs/LLM_SERVE.md and docs/FAULT_TOLERANCE.md.
 """
 from .deployment import LLMServer, build_model
 from .disagg import DecodeStage, DisaggLLM, PrefillStage
 from .engine import EngineConfig, LLMEngine, Request, TokenStream
+from .failover import llm_resume, resilient_stream
 from .kv_cache import BlockPool, blocks_for_tokens
 
 __all__ = [
     "BlockPool", "DecodeStage", "DisaggLLM", "EngineConfig", "LLMEngine",
     "LLMServer", "PrefillStage", "Request", "TokenStream", "build_model",
-    "blocks_for_tokens",
+    "blocks_for_tokens", "llm_resume", "resilient_stream",
 ]

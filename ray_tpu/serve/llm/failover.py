@@ -1,0 +1,68 @@
+"""Zero-loss LLM replica failover — the token-stream resume policy.
+
+The routing handle owns the generic machinery (serve/handle.py
+``FailoverResponseGenerator``): it tracks which replica a stream is
+assigned to and, on replica death, asks a ``resume`` callable for the
+continuation request. This module supplies the LLM semantics of that
+continuation: **already-streamed tokens become the forced prefix** of a
+re-prefill on a surviving replica.
+
+Why that is exact: every replica of one LLM deployment builds the same
+model from the same seed (deployment.build_model), and the engine
+decodes greedily — so prefilling ``prompt + streamed_tokens`` on any
+replica emits precisely the token the dead replica would have produced
+next (the same argument that makes engine-level preemption token-exact,
+serve/llm/engine.py _preempt). The client sees a stall while the new
+replica prefills, never an error, a duplicated token, or a corrupted
+stream.
+
+    handle = serve.run(app)
+    stream = resilient_stream(handle, {"tokens": [...],
+                                       "max_tokens": 64})
+    for tok in stream: ...       # survives replica kills mid-stream
+
+Bounds: a continuation's prompt is the original prompt plus everything
+already streamed, so it must still fit the engine's largest prefill
+bucket — the same ceiling engine preemption lives under. Streams whose
+context outgrows the bucket fail loudly on the resumed replica rather
+than silently truncating.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+
+def llm_resume(args: tuple, kwargs: dict,
+               yielded: list) -> Optional[Tuple[tuple, dict]]:
+    """Build the continuation request after a replica death: streamed
+    tokens are appended to the prompt (forced prefix) and deducted from
+    the generation budget. None = the stream was already complete."""
+    payload: Dict[str, Any] = dict(args[0])
+    remaining = int(payload.get("max_tokens", 16)) - len(yielded)
+    if remaining <= 0:
+        return None  # death landed between the final token and EOS mark
+    payload["tokens"] = (list(payload["tokens"])
+                         + [int(t) for t in yielded])
+    payload["max_tokens"] = remaining
+    return (payload,) + tuple(args[1:]), kwargs
+
+
+def resilient_stream(handle, payload: Dict[str, Any], *,
+                     multiplexed_model_id: str = ""):
+    """Stream tokens from an LLMServer deployment with replica-failover:
+    returns a generator (sync and async iterable) whose token sequence
+    is complete and prefix-consistent even when replicas die mid-stream.
+
+    ``payload`` is the LLMServer request dict ({"tokens", "max_tokens",
+    "eos_id"?}); "stream" is forced on.
+
+    Caveat: an ``eos_id`` request that dies after the EOS token was
+    generated but before the stream closed resumes with the EOS inside
+    the forced prefix — the continuation then runs to its (reduced)
+    max_tokens. Consumers that stop at EOS themselves (the standard
+    client shape) are unaffected.
+    """
+    payload = {**payload, "stream": True}
+    return handle._submit_streaming(
+        "__call__", (payload,), {}, mux_id=multiplexed_model_id,
+        resume=llm_resume)

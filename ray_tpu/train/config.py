@@ -43,6 +43,11 @@ class PipelineConfig:
     remat: bool = False          # recompute fwd in bwd (activation remat)
     channel_bytes: int = 1 << 20  # per-slot channel capacity
     resources_per_stage: Dict[str, float] = field(default_factory=dict)
+    # fault tolerance (docs/FAULT_TOLERANCE.md): non-empty dir enables
+    # atomic rename-commit checkpoints; every > 0 snapshots after each
+    # Nth step and engine.recover() resumes from the newest commit
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 0
 
     def engine_kwargs(self) -> Dict[str, Any]:
         return {
@@ -53,6 +58,8 @@ class PipelineConfig:
             "remat": self.remat,
             "channel_bytes": self.channel_bytes,
             "resources_per_stage": self.resources_per_stage or None,
+            "checkpoint_dir": self.checkpoint_dir,
+            "checkpoint_every": self.checkpoint_every,
         }
 
 

@@ -358,6 +358,59 @@ class _CGStage:
     def get_params(self) -> List[Any]:
         return [self.params[str(v)] for v in range(self.virtual)]
 
+    def get_state(self) -> dict:
+        """Checkpoint payload for this actor: hosted chunk params plus
+        the optimizer state it owns — the full tree when replicated, the
+        1/dp SHARD when ZeRO-sharded (each dp rank persists its own
+        shard; restore hands each rank its shard back). Pulled by the
+        driver BETWEEN steps, when no residuals are in flight."""
+        import numpy as np_mod
+
+        import jax
+
+        def host(t):
+            # device -> host copies: the checkpoint must not pin device
+            # buffers, and numpy pickles leaner than jax.Array
+            return jax.tree.map(np_mod.asarray, t)
+
+        if self._zero is not None:
+            opt, kind = host(self._zero.opt_state()), "zero"
+        elif self._opt_state is not None:
+            opt, kind = host(self._opt_state), "full"
+        else:
+            opt, kind = None, "none"
+        return {"params": [host(self.params[str(v)])
+                           for v in range(self.virtual)],
+                "opt": opt, "kind": kind}
+
+    def load_state(self, chunk_params: Optional[List[Any]], opt_state,
+                   kind: str) -> bool:
+        """Restore a get_state() payload: params replace the hosted
+        chunks (None = keep what setup() installed — the recover path
+        ships checkpoint params through setup already and must not pay
+        the serialization twice), optimizer state replaces what setup()
+        initialized, and any in-flight residual/grad accumulation is
+        discarded (restore happens at a step boundary by construction)."""
+        if chunk_params is not None:
+            self.params = {str(v): chunk_params[v]
+                           for v in range(self.virtual)}
+        self._residuals = {}
+        self._grad_acc = {}
+        if kind == "zero":
+            if self._zero is None:
+                raise ValueError(
+                    "checkpoint holds a ZeRO opt-state shard but this "
+                    "stage runs a replicated update (zero_update flag "
+                    "changed between save and restore)")
+            self._zero.set_opt_state(opt_state)
+        elif kind == "full":
+            if self._opt_state is None:
+                raise ValueError(
+                    "checkpoint holds a replicated opt state but this "
+                    "stage is ZeRO-sharded or has no optimizer")
+            self._opt_state = opt_state
+        return True
+
     def opt_state_bytes(self) -> int:
         from ..parallel.zero import tree_bytes
 
@@ -418,6 +471,15 @@ class CompiledPipelineEngine:
         vjp residuals (activation rematerialization knob).
     tied: [(chunk_i, key_i, chunk_j, key_j), ...] tied-weight pairs
         whose grads are exchanged and summed before each update.
+    checkpoint_dir: non-empty => the engine can persist per-stage params
+        + optimizer state (ZeRO shards stay sharded) to this directory
+        with atomic rename-commit; with checkpoint_every > 0 a snapshot
+        is pulled off the actors after every Nth step and written on a
+        background thread (the pull is synchronous — between steps — so
+        the snapshot is a consistent step boundary; only the disk IO is
+        async). ``recover()`` restores from the newest commit, and the
+        restored trajectory is bit-identical to a clean restart from the
+        same checkpoint (docs/FAULT_TOLERANCE.md).
     """
 
     def __init__(self, stage_fns: Sequence[Callable],
@@ -432,7 +494,9 @@ class CompiledPipelineEngine:
                  channel_bytes: int = DEFAULT_CHANNEL_BYTES,
                  resources_per_stage: Optional[dict] = None,
                  scheduling_strategies: Optional[Sequence] = None,
-                 setup_timeout: float = 120.0):
+                 setup_timeout: float = 120.0,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 0):
         G = len(stage_fns)
         V = int(virtual_stages)
         if G < 1 or len(stage_params) != G:
@@ -454,11 +518,33 @@ class CompiledPipelineEngine:
         self._gtag = self.graph_id.hex()[:8]
         self._channel_bytes = int(channel_bytes)
         self._lock = threading.Lock()
-        # serializes the teardown BODY (not just the torn flag):
-        # an abort tears down on a background thread, and a concurrent
-        # shutdown() must block until the channels are actually released
-        self._teardown_lock = threading.Lock()
+        # serializes the teardown BODY (not just the torn flag): an abort
+        # tears down on a background thread, and a concurrent shutdown()
+        # must block until the channels are actually released. REENTRANT:
+        # a signal handler or close-callback re-entering teardown on the
+        # thread already inside it must return (via the torn flag), not
+        # self-deadlock.
+        self._teardown_lock = threading.RLock()
         self._stop = threading.Event()
+        # fault-recovery state: everything needed to respawn stages and
+        # recompile channels after a kill (docs/FAULT_TOLERANCE.md)
+        self._fn_blobs = [cloudpickle.dumps(fn) for fn in stage_fns]
+        self._tx_blob = cloudpickle.dumps(tx) if tx is not None else None
+        self._init_params = list(stage_params)
+        self._remat = bool(remat)
+        self._res = resources_per_stage
+        self._strategies = scheduling_strategies
+        self._setup_timeout = float(setup_timeout)
+        self.checkpoint_dir = checkpoint_dir or None
+        self.checkpoint_every = int(checkpoint_every)
+        if self.checkpoint_dir:
+            os.makedirs(self.checkpoint_dir, exist_ok=True)
+        self._step_count = 0
+        self.last_checkpoint_path: Optional[str] = None
+        self._latest_step = -1
+        self._ckpt_lock = threading.Lock()
+        self._ckpt_pending: List[threading.Thread] = []
+        self._shutdown_done = False
         self._torn = False
         self._poisoned: Optional[Exception] = None
         self._closed_error: Optional[Exception] = None
@@ -483,10 +569,7 @@ class CompiledPipelineEngine:
         self._rt = rt
 
         try:
-            self._spawn_actors(stage_fns, stage_params, tx,
-                               resources_per_stage,
-                               scheduling_strategies, remat,
-                               setup_timeout)
+            self._spawn_actors(self._init_params)
             self._compile()
         except BaseException:
             try:
@@ -494,16 +577,27 @@ class CompiledPipelineEngine:
             except Exception:
                 pass
             raise
+        if self.checkpoint_dir and self.checkpoint_every > 0:
+            # step-0 commit: recover() always has a restore point, and a
+            # restart-from-scratch replays the same trajectory
+            self.save_checkpoint()
 
     # -- construction ------------------------------------------------------
 
-    def _spawn_actors(self, stage_fns, stage_params, tx, res, strategies,
-                      remat, setup_timeout) -> None:
+    def _spawn_actors(self, chunk_params: Sequence[Any],
+                      per_actor_state: Optional[List[List[dict]]] = None
+                      ) -> None:
+        """Spawn dp x P stage actors and run setup. ``chunk_params`` are
+        G parameter pytrees in global chunk order; ``per_actor_state``
+        (recover/restore path) additionally carries each actor's
+        get_state() payload — params land via setup, optimizer state via
+        load_state afterwards. Reuses an existing placement group (the
+        recover path respawns into the same bundles)."""
         P, V, dp = self.num_stages, self.virtual, self.dp
-        res = dict(res or {"CPU": 1.0})
+        res = dict(self._res or {"CPU": 1.0})
+        strategies = self._strategies
         actor_cls = ray_tpu.remote(_CGStage)
-        tx_blob = cloudpickle.dumps(tx) if tx is not None else None
-        if strategies is None:
+        if strategies is None and self._pg is None:
             self._pg = placement_group(
                 [dict(res) for _ in range(P * dp)], strategy="SPREAD")
             if not self._pg.ready(timeout=60):
@@ -531,14 +625,28 @@ class CompiledPipelineEngine:
                 meta = [{"global": g, "first": g == 0,
                          "last": g == self.num_chunks - 1}
                         for g in chunks]
+                if per_actor_state is not None:
+                    cp = per_actor_state[r][i]["params"]
+                else:
+                    cp = [chunk_params[g] for g in chunks]
                 setups.append(a.setup.remote(
                     i, P, V,
-                    [cloudpickle.dumps(stage_fns[g]) for g in chunks],
-                    [stage_params[g] for g in chunks], meta, tx_blob,
-                    remat, dp, r, f"zpipe-{self._gtag}-s{i}",
+                    [self._fn_blobs[g] for g in chunks],
+                    cp, meta, self._tx_blob,
+                    self._remat, dp, r, f"zpipe-{self._gtag}-s{i}",
                     self.zero_update))
             self.actor_grid.append(row)
-        ray_tpu.get(setups, timeout=setup_timeout)
+        ray_tpu.get(setups, timeout=self._setup_timeout)
+        if per_actor_state is not None:
+            loads = []
+            for r in range(dp):
+                for i in range(P):
+                    st = per_actor_state[r][i]
+                    # params already traveled through setup(); ship only
+                    # the optimizer state on this second hop
+                    loads.append(self.actor_grid[r][i].load_state.remote(
+                        None, st["opt"], st["kind"]))
+            ray_tpu.get(loads, timeout=self._setup_timeout)
 
     def _compile(self) -> None:
         from ..cgraph.channel import (QueueChannel, RpcSender, ShmChannel,
@@ -871,6 +979,8 @@ class CompiledPipelineEngine:
             self._poisoned = first_err
             raise first_err
         self.last_reports = reports
+        self._step_count += 1
+        self._maybe_checkpoint()
         return float(sum(float(l) for l in losses) / (M * dp))
 
     def _check_open(self) -> None:
@@ -904,7 +1014,215 @@ class CompiledPipelineEngine:
             [a.opt_state_bytes.remote() for a in self.actor_grid[0]],
             timeout=60)
 
-    # -- fault + teardown --------------------------------------------------
+    # -- checkpoint / restore ----------------------------------------------
+
+    def _pull_state_grid(self, timeout: float = 120.0) -> List[List[dict]]:
+        """[r][i] -> stage get_state() payload, pulled over the dynamic
+        path (the iterative loops are idle between steps)."""
+        refs = [[a.get_state.remote() for a in row]
+                for row in self.actor_grid]
+        return [ray_tpu.get(row, timeout=timeout) for row in refs]
+
+    def save_checkpoint(self, blocking: bool = False) -> str:
+        """Snapshot every stage's params + optimizer state at the current
+        step boundary and commit it to ``checkpoint_dir`` atomically
+        (write to a temp file, ``os.replace`` into place, then replace
+        the LATEST pointer). The state pull is synchronous — it must see
+        a step boundary — but the serialization + disk IO runs on a
+        background thread unless ``blocking``. Returns the target path
+        (readable once committed; ``wait_for_checkpoints()`` joins)."""
+        if not self.checkpoint_dir:
+            raise ValueError(
+                "save_checkpoint() needs checkpoint_dir= at construction")
+        with self._lock:
+            self._check_open()
+        step = self._step_count
+        states = self._pull_state_grid()
+        path = os.path.join(self.checkpoint_dir, f"ckpt-{step:08d}.pkl")
+        payload = {
+            "step": step,
+            "engine": {"num_chunks": self.num_chunks,
+                       "num_stages": self.num_stages,
+                       "virtual": self.virtual, "dp": self.dp,
+                       "zero_update": self.zero_update,
+                       "num_microbatches": self.num_microbatches},
+            "states": states,
+        }
+
+        def _write() -> None:
+            tmp = path + f".tmp.{os.getpid()}"
+            try:
+                with open(tmp, "wb") as f:
+                    cloudpickle.dump(payload, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)  # rename-commit: readers never
+                # observe a torn checkpoint
+                with self._ckpt_lock:
+                    # concurrent writer threads can finish out of order
+                    # (a large step-N pickle outliving step-N+1's): only
+                    # advance LATEST, never roll it back to an older step
+                    if step < self._latest_step:
+                        return
+                    latest_tmp = os.path.join(
+                        self.checkpoint_dir, f"LATEST.tmp.{os.getpid()}")
+                    with open(latest_tmp, "w") as f:
+                        f.write(os.path.basename(path))
+                    os.replace(latest_tmp,
+                               os.path.join(self.checkpoint_dir,
+                                            "LATEST"))
+                    self._latest_step = step
+                    self.last_checkpoint_path = path
+            finally:
+                if os.path.exists(tmp):
+                    try:
+                        os.remove(tmp)
+                    except OSError:
+                        pass
+
+        if blocking:
+            _write()
+        else:
+            t = threading.Thread(target=_write, daemon=True,
+                                 name=f"pipeline-ckpt-{self._gtag}")
+            with self._ckpt_lock:
+                self._ckpt_pending = [
+                    p for p in self._ckpt_pending if p.is_alive()]
+                self._ckpt_pending.append(t)
+            t.start()
+        return path
+
+    def wait_for_checkpoints(self, timeout: float = 60.0) -> None:
+        """Join every in-flight async checkpoint write."""
+        with self._ckpt_lock:
+            pending = list(self._ckpt_pending)
+        deadline = time.monotonic() + timeout
+        for t in pending:
+            t.join(max(0.0, deadline - time.monotonic()))
+
+    def _maybe_checkpoint(self) -> None:
+        if self.checkpoint_dir and self.checkpoint_every > 0 \
+                and self._step_count % self.checkpoint_every == 0:
+            self.save_checkpoint()
+
+    @staticmethod
+    def latest_checkpoint(checkpoint_dir: str) -> Optional[str]:
+        """Newest committed checkpoint path in a directory (via the
+        LATEST pointer; falls back to a name scan)."""
+        ptr = os.path.join(checkpoint_dir, "LATEST")
+        try:
+            with open(ptr) as f:
+                path = os.path.join(checkpoint_dir, f.read().strip())
+            if os.path.exists(path):
+                return path
+        except OSError:
+            pass
+        cands = sorted(
+            n for n in (os.listdir(checkpoint_dir)
+                        if os.path.isdir(checkpoint_dir) else ())
+            if n.startswith("ckpt-") and n.endswith(".pkl"))
+        return os.path.join(checkpoint_dir, cands[-1]) if cands else None
+
+    @staticmethod
+    def load_checkpoint(path: str) -> dict:
+        with open(path, "rb") as f:
+            return cloudpickle.load(f)
+
+    def restore(self, checkpoint: str) -> int:
+        """Load a committed checkpoint into the LIVE engine (fresh-build
+        restart path): every stage's params + optimizer state replace the
+        current ones at the next step boundary. Returns the restored
+        step count. ``recover()`` is the respawn-then-restore path for an
+        engine whose stages died."""
+        ckpt = self.load_checkpoint(checkpoint)
+        self._check_ckpt_shape(ckpt)
+        with self._lock:
+            self._check_open()
+        loads = []
+        for r in range(self.dp):
+            for i in range(self.num_stages):
+                st = ckpt["states"][r][i]
+                loads.append(self.actor_grid[r][i].load_state.remote(
+                    st["params"], st["opt"], st["kind"]))
+        ray_tpu.get(loads, timeout=self._setup_timeout)
+        self._step_count = int(ckpt["step"])
+        return self._step_count
+
+    def _check_ckpt_shape(self, ckpt: dict) -> None:
+        want = {"num_chunks": self.num_chunks, "virtual": self.virtual,
+                "dp": self.dp, "zero_update": self.zero_update}
+        have = {k: ckpt.get("engine", {}).get(k) for k in want}
+        if have != want:
+            raise ValueError(
+                f"checkpoint shape {have} does not match engine {want}")
+
+    # -- recovery ----------------------------------------------------------
+
+    def recover(self, checkpoint: Optional[str] = None,
+                timeout: float = 120.0) -> int:
+        """Bring a faulted engine back: tear down whatever channels are
+        left (idempotent — a stage-death abort already did most of it),
+        kill and respawn EVERY stage actor (survivors hold residual/grad
+        state from the aborted step and must not leak it into the resumed
+        trajectory), recompile channels under a fresh graph id, and
+        restore from ``checkpoint`` (default: the newest commit in
+        checkpoint_dir, else a step-0 restart from the construction-time
+        params). Returns the step count training resumes from.
+
+        The resumed loss trajectory is bit-identical to a clean restart
+        from the same checkpoint: both paths run the same jitted programs
+        over the same restored arrays (test_pipeline_cgraph asserts
+        this)."""
+        deadline = time.monotonic() + timeout
+        self.wait_for_checkpoints()
+        # serialize against an in-flight abort teardown, then reset
+        self.teardown()
+        ckpt_path = checkpoint
+        if ckpt_path is None and self.checkpoint_dir:
+            ckpt_path = self.latest_checkpoint(self.checkpoint_dir)
+        state_grid = None
+        step = 0
+        if ckpt_path is not None:
+            ckpt = self.load_checkpoint(ckpt_path)
+            self._check_ckpt_shape(ckpt)
+            state_grid = ckpt["states"]
+            step = int(ckpt["step"])
+        # kill every stage (dead ones no-op) and wait for the records to
+        # reach DEAD so placement slots free up for the respawn
+        for a in getattr(self, "actors", []):
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        for a in getattr(self, "actors", []):
+            while self._rt.actor_state(a._actor_id) not in ("DEAD",):
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"stage actor {a._actor_id.hex()[:8]} did not "
+                        f"reach DEAD during recover()")
+                time.sleep(0.05)
+        # reset engine plumbing for a fresh compile
+        with self._lock:
+            self._torn = False
+            self._poisoned = None
+            self._closed_error = None
+        self._stop = threading.Event()
+        self.graph_id = os.urandom(16)
+        self._gtag = self.graph_id.hex()[:8]
+        self._actor_plans = {}
+        self._alloc = []
+        self._in_writers = []
+        self._tgt_writers = []
+        self._loss_readers = []
+        self._report_readers = []
+        self._qreaders = {}
+        self._unsub = None
+        self._shutdown_done = False
+        self._spawn_actors(self._init_params,
+                           per_actor_state=state_grid)
+        self._compile()
+        self._step_count = step
+        return step
 
     def _deliver(self, cid: str, seq: int, data: bytes) -> None:
         q = self._qreaders.get(cid)
@@ -997,8 +1315,18 @@ class CompiledPipelineEngine:
     def shutdown(self) -> None:
         """Full teardown: stop loops, release channels, destroy dp
         collective groups, kill the stage actors, drop the placement
-        group."""
+        group. Idempotent under double-invocation (atexit + signal
+        handler + explicit call); a reentrant call returns once teardown
+        marked the engine torn."""
         self.teardown()
+        try:
+            self.wait_for_checkpoints(timeout=30.0)
+        except Exception:
+            pass
+        with self._ckpt_lock:
+            if self._shutdown_done:
+                return
+            self._shutdown_done = True
         if self.dp > 1 and getattr(self, "actor_grid", None):
             try:
                 ray_tpu.get(

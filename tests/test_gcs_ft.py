@@ -88,6 +88,67 @@ def test_head_restart_restores_named_actor_metadata(tmp_path):
         c2.shutdown()
 
 
+def test_direct_calls_inflight_across_head_restart_never_hang(tmp_path):
+    """ISSUE 10 satellite: direct (head-bypassing) actor calls in flight
+    while the GCS/head goes down must each either complete or fail with
+    a typed error — no get() may hang. After a head restart over the
+    same storage, the revived detached actor serves direct calls again
+    (fresh resolve, fresh epoch)."""
+    import threading
+
+    from ray_tpu.core.runtime import dispatch_counts
+
+    storage = str(tmp_path / "gcs")
+
+    @ray_tpu.remote
+    class Slow:
+        def work(self, i, delay=0.0):
+            time.sleep(delay)
+            return i
+
+    c = Cluster(head_resources={"CPU": 4.0},
+                system_config={"gcs_storage_path": storage})
+    a = Slow.options(name="slow", lifetime="detached").remote()
+    assert ray_tpu.get(a.work.remote(0), timeout=60) == 0  # direct lane up
+    refs = [a.work.remote(i, 0.25) for i in range(8)]      # in flight
+    results = {}
+
+    def drain():
+        for i, r in enumerate(refs):
+            try:
+                results[i] = ("ok", ray_tpu.get(r, timeout=30))
+            except BaseException as e:  # noqa: BLE001 — typed check below
+                results[i] = ("err", e)
+
+    t = threading.Thread(target=drain)
+    t.start()
+    time.sleep(0.1)  # calls are executing when the head goes down
+    c.shutdown()
+    t.join(timeout=90)
+    assert not t.is_alive(), "get() hung across head shutdown"
+    assert len(results) == 8
+    for kind, val in results.values():
+        if kind == "err":
+            assert isinstance(val, Exception), val
+
+    # head restart over the same storage: detached metadata survives,
+    # the actor revives, and the direct path re-establishes
+    c2 = Cluster(head_resources={"CPU": 4.0},
+                 system_config={"gcs_storage_path": storage})
+    try:
+        h = ray_tpu.get_actor("slow")
+        assert ray_tpu.get(h.work.remote(1), timeout=60) == 1
+        d0, r0 = dispatch_counts()
+        out = ray_tpu.get([h.work.remote(i) for i in range(30)],
+                          timeout=120)
+        assert out == list(range(30))
+        d1, _ = dispatch_counts()
+        assert d1 - d0 >= 30, \
+            "steady-state calls did not return to the direct path"
+    finally:
+        c2.shutdown()
+
+
 def test_non_detached_actor_marked_dead_after_restart(tmp_path):
     storage = str(tmp_path / "gcs")
 

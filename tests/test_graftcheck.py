@@ -480,6 +480,132 @@ class Ingress:
 
 
 # ---------------------------------------------------------------------------
+# GC012 — unbounded bare retry loops
+
+
+def test_gc012_positive_remote_retry_without_bound():
+    src = """
+def keep_calling(handle):
+    while True:
+        try:
+            return_ref = handle.ping.remote()
+        except Exception:
+            continue
+"""
+    assert rules_found(src) == ["GC012"]
+
+
+def test_gc012_positive_connect_with_constant_sleep():
+    src = """
+import time
+from ray_tpu.core.rpc import connect
+
+def join(addr):
+    while True:
+        try:
+            return connect(addr)
+        except OSError:
+            time.sleep(0.5)
+"""
+    # a fixed sleep paces the hammering but never bounds it
+    assert rules_found(src) == ["GC012"]
+
+
+def test_gc012_negative_deadline_bound():
+    src = """
+import time
+from ray_tpu.core.rpc import connect
+
+def join(addr, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            return connect(addr)
+        except OSError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.5)
+"""
+    assert rules_found(src) == []
+
+
+def test_gc012_negative_policy_and_growing_backoff():
+    src_policy = """
+from ray_tpu.util.retry import RetryPolicy
+from ray_tpu.core.rpc import connect
+
+def join(addr):
+    for attempt in RetryPolicy(deadline_s=30).sleeps():
+        try:
+            return connect(addr)
+        except OSError:
+            continue
+    raise TimeoutError(addr)
+"""
+    assert rules_found(src_policy) == []
+    src_backoff = """
+import time
+from ray_tpu.core.rpc import connect
+
+def join(addr):
+    delay = 0.1
+    while True:
+        try:
+            return connect(addr)
+        except OSError:
+            time.sleep(delay)
+            delay = min(delay * 2, 5.0)
+"""
+    # variable sleep = a backoff the author grows; GC012 stays quiet
+    assert rules_found(src_backoff) == []
+
+
+def test_gc012_negative_handler_reraises_or_breaks():
+    src = """
+def drain(handle):
+    while True:
+        try:
+            handle.step.remote()
+        except Exception:
+            raise
+"""
+    assert rules_found(src) == []
+    src_break = """
+def drain(handle):
+    while True:
+        try:
+            handle.step.remote()
+        except Exception:
+            break
+"""
+    assert rules_found(src_break) == []
+
+
+def test_gc012_negative_non_remote_loop_body():
+    src = """
+def pump(q):
+    while True:
+        try:
+            q.put(1)
+        except Exception:
+            continue
+"""
+    assert rules_found(src) == []
+
+
+def test_gc012_suppression():
+    src = """
+def keep_calling(handle):
+    while True:
+        try:  # graftcheck: disable=GC012
+            handle.ping.remote()
+        except Exception:
+            continue
+"""
+    assert rules_found(src) == []
+
+
+# ---------------------------------------------------------------------------
 # suppressions + CLI
 
 

@@ -7,6 +7,7 @@ replicated update with ~1/dp optimizer-state bytes, stage death
 surfaces a typed error, shutdown leaks no channel segments, and the
 steady-state step beats the dynamic `.remote()` engine.
 """
+import os
 import threading
 import time
 
@@ -491,6 +492,178 @@ class TestFaultsAndLifecycle:
                 eng.step(mbs[:2], tgts[:2])
         finally:
             eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint + recover (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+
+class TestCheckpointRecover:
+    def test_checkpoint_commit_is_atomic_and_latest_points_at_it(
+            self, ray_start_regular, tmp_path):
+        import optax
+
+        from ray_tpu.train.pipeline_cgraph import CompiledPipelineEngine
+
+        fns, params = _mlp_chunks(2)
+        mbs, tgts = _mlp_batches(2)
+        d = str(tmp_path / "ck")
+        eng = CompiledPipelineEngine(fns, params, optax.adam(1e-2),
+                                     num_microbatches=2,
+                                     channel_bytes=1 << 18,
+                                     checkpoint_dir=d, checkpoint_every=1)
+        try:
+            eng.step(mbs, tgts)
+            eng.step(mbs, tgts)
+            eng.wait_for_checkpoints()
+            names = sorted(os.listdir(d))
+            # step-0 commit at construction + one per step, no tmp litter
+            assert names == ["LATEST", "ckpt-00000000.pkl",
+                             "ckpt-00000001.pkl", "ckpt-00000002.pkl"]
+            latest = CompiledPipelineEngine.latest_checkpoint(d)
+            assert latest.endswith("ckpt-00000002.pkl")
+            ckpt = CompiledPipelineEngine.load_checkpoint(latest)
+            assert ckpt["step"] == 2
+            assert len(ckpt["states"]) == 1          # dp rows
+            assert len(ckpt["states"][0]) == 2       # stages
+        finally:
+            eng.shutdown()
+
+    def test_recover_after_stage_kill_matches_clean_restart_bitwise(
+            self, ray_start_regular, tmp_path):
+        """The ISSUE 10 acceptance bar: kill a stage mid-step, recover,
+        and the resumed loss trajectory + final params are bit-identical
+        to a fresh engine restarted from the same checkpoint."""
+        import jax
+        import optax
+
+        from ray_tpu.train.pipeline_cgraph import CompiledPipelineEngine
+
+        fns, params = _mlp_chunks(2)
+        mbs, tgts = _mlp_batches(4)
+        tx = optax.adam(1e-2)
+        d = str(tmp_path / "ck")
+        eng = CompiledPipelineEngine(fns, params, tx, num_microbatches=4,
+                                     channel_bytes=1 << 18,
+                                     checkpoint_dir=d, checkpoint_every=2)
+        eng.step(mbs, tgts)
+        eng.step(mbs, tgts)                      # checkpoint at step 2
+        eng.wait_for_checkpoints()
+        ray_tpu.kill(eng.actor_grid[0][1])       # stage death
+        with pytest.raises((exceptions.CompiledGraphClosedError,
+                            exceptions.CompiledGraphError)):
+            # the death may abort before or during the next step
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                eng.step(mbs, tgts, timeout=30)
+        ck_at_kill = CompiledPipelineEngine.latest_checkpoint(d)
+        resumed_from = eng.recover()
+        assert resumed_from == 2
+        resumed = [eng.step(mbs, tgts) for _ in range(2)]
+        params_a = eng.get_params()
+        eng.shutdown()
+
+        fresh = CompiledPipelineEngine(fns, params, tx,
+                                       num_microbatches=4,
+                                       channel_bytes=1 << 18)
+        try:
+            assert fresh.restore(ck_at_kill) == 2
+            replay = [fresh.step(mbs, tgts) for _ in range(2)]
+            params_b = fresh.get_params()
+        finally:
+            fresh.shutdown()
+        assert resumed == replay
+        for a, b in zip(jax.tree.leaves(params_a),
+                        jax.tree.leaves(params_b)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_recover_without_checkpoint_restarts_from_step_zero(
+            self, ray_start_regular):
+        """No checkpoint_dir: recover() respawns with the construction
+        params — a step-0 restart with the exact initial trajectory."""
+        import optax
+
+        from ray_tpu.train.pipeline_cgraph import CompiledPipelineEngine
+
+        fns, params = _mlp_chunks(2)
+        mbs, tgts = _mlp_batches(2)
+        tx = optax.sgd(1e-2)
+        eng = CompiledPipelineEngine(fns, params, tx, num_microbatches=2,
+                                     channel_bytes=1 << 18)
+        try:
+            first = eng.step(mbs, tgts)
+            ray_tpu.kill(eng.actor_grid[0][0])
+            deadline = time.monotonic() + 30
+            while eng._closed_error is None:
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+            assert eng.recover() == 0
+            assert eng.step(mbs, tgts) == first
+        finally:
+            eng.shutdown()
+
+    def test_zero_sharded_opt_state_roundtrips_through_checkpoint(
+            self, ray_start_regular, tmp_path):
+        """dp=2 ZeRO: each rank's 1/dp opt-state shard is persisted and
+        restored shard-for-shard — the restored trajectory matches an
+        uninterrupted run exactly."""
+        import optax
+
+        from ray_tpu.train.pipeline_cgraph import CompiledPipelineEngine
+
+        fns, params = _mlp_chunks(2, width=16)
+        mbs, tgts = _mlp_batches(8, width=16)    # dp=2 x M=4
+        tx = optax.adam(1e-2)
+        res = {"CPU": 0.5}
+        d = str(tmp_path / "ck")
+        eng = CompiledPipelineEngine(fns, params, tx, num_microbatches=4,
+                                     dp=2, channel_bytes=1 << 18,
+                                     resources_per_stage=res,
+                                     checkpoint_dir=d, checkpoint_every=2)
+        losses = [eng.step(mbs, tgts) for _ in range(4)]
+        eng.wait_for_checkpoints()
+        eng.shutdown()
+        fresh = CompiledPipelineEngine(fns, params, tx,
+                                       num_microbatches=4, dp=2,
+                                       channel_bytes=1 << 18,
+                                       resources_per_stage=res)
+        try:
+            ck = os.path.join(d, "ckpt-00000002.pkl")
+            ckpt = CompiledPipelineEngine.load_checkpoint(ck)
+            assert ckpt["states"][0][0]["kind"] == "zero"
+            assert fresh.restore(ck) == 2
+            replay = [fresh.step(mbs, tgts) for _ in range(2)]
+        finally:
+            fresh.shutdown()
+        assert replay == losses[2:]
+
+    def test_restore_rejects_mismatched_shape(self, ray_start_regular,
+                                              tmp_path):
+        import optax
+
+        from ray_tpu.train.pipeline_cgraph import CompiledPipelineEngine
+
+        fns, params = _mlp_chunks(2)
+        mbs, tgts = _mlp_batches(2)
+        d = str(tmp_path / "ck")
+        eng = CompiledPipelineEngine(fns, params, optax.sgd(1e-2),
+                                     num_microbatches=2,
+                                     channel_bytes=1 << 18,
+                                     checkpoint_dir=d)
+        try:
+            path = eng.save_checkpoint(blocking=True)
+        finally:
+            eng.shutdown()
+        fns3, params3 = _mlp_chunks(3)
+        other = CompiledPipelineEngine(fns3, params3, optax.sgd(1e-2),
+                                       num_microbatches=2,
+                                       channel_bytes=1 << 18)
+        try:
+            with pytest.raises(ValueError, match="shape"):
+                other.restore(path)
+        finally:
+            other.shutdown()
 
 
 # ---------------------------------------------------------------------------

@@ -264,6 +264,74 @@ def test_streaming_response_through_handle(cluster):
     assert chunks == [f"tok-{i}" for i in range(5)]
 
 
+def test_streaming_failover_zero_loss_on_replica_kill(cluster):
+    """ISSUE 10 LLM-failover machinery, exercised with a deterministic
+    token server (the model-free analog of greedy LLM decode: the next
+    token is a pure function of the context). Killing the serving
+    replica mid-stream must yield the complete, prefix-consistent
+    sequence — no error, no duplicated or lost tokens — because the
+    router re-prefills the remainder on the survivor with the streamed
+    tokens as forced prefix."""
+    from ray_tpu.serve.llm import resilient_stream
+
+    @serve.deployment(num_replicas=2, health_check_period_s=0.5,
+                      health_check_timeout_s=2.0)
+    class DetLLM:
+        def __call__(self, payload):
+            toks = list(payload["tokens"])
+            n = int(payload.get("max_tokens", 16))
+
+            def gen(ctx=toks, n=n):
+                ctx = list(ctx)
+                for _ in range(n):
+                    t = (sum(ctx) * 31 + len(ctx)) % 97
+                    ctx.append(t)
+                    time.sleep(0.04)  # a kill lands mid-stream
+                    yield t
+
+            return gen()
+
+    h = serve.run(DetLLM.bind())
+    prompt, n = [3, 1, 4], 30
+    want, ctx = [], list(prompt)
+    for _ in range(n):
+        t = (sum(ctx) * 31 + len(ctx)) % 97
+        ctx.append(t)
+        want.append(t)
+
+    stream = resilient_stream(h, {"tokens": prompt, "max_tokens": n})
+    got, killed = [], False
+    for tok in stream:
+        got.append(tok)
+        if len(got) == 6 and not killed:
+            killed = True
+            # the router tracked the request->replica assignment
+            aid = stream.replica_actor_id
+            assert aid is not None
+            assert aid in h.stream_assignments().values()
+            controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+            _, _, reps = ray_tpu.get(
+                controller.get_replicas.remote("DetLLM"), timeout=30)
+            victim = next(r for r in reps if r._actor_id == aid)
+            ray_tpu.kill(victim)
+    assert got == want
+    assert stream.failovers >= 1, "kill landed after the stream ended"
+    assert not h.stream_assignments()  # assignment released at EOS
+
+
+def test_llm_resume_builds_forced_prefix():
+    from ray_tpu.serve.llm import llm_resume
+
+    args, kwargs = llm_resume(
+        ({"tokens": [1, 2], "max_tokens": 10, "stream": True},), {},
+        [7, 8, 9])
+    assert args[0]["tokens"] == [1, 2, 7, 8, 9]
+    assert args[0]["max_tokens"] == 7
+    # completed stream: resume signals end instead of an empty request
+    assert llm_resume(({"tokens": [1], "max_tokens": 3},), {},
+                      [5, 6, 7]) is None
+
+
 def test_streaming_through_http_proxy(cluster):
     @serve.deployment
     class Counter:
