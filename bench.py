@@ -145,6 +145,7 @@ def main() -> None:
             **_bench_dispatch(),
             **_bench_llm_serve(),
             **_bench_pipeline(),
+            **_bench_sharding(),
         },
     }))
 
@@ -274,6 +275,39 @@ def _bench_pipeline() -> dict:
         import traceback
 
         traceback.print_exc()  # a broken engine must not look like 0
+        return {}
+
+
+def _bench_sharding() -> dict:
+    """Sharded-execution rows (ISSUE 11): llm tokens/s at tp in
+    {1,2,4} and pipeline step ms at fsdp in {1,2}, with the
+    token-identity / loss-bitwise acceptance booleans riding along.
+    Runs in a SUBPROCESS because the tp/fsdp meshes need
+    --xla_force_host_platform_device_count seeded before jax import —
+    this process already initialized the backend."""
+    import subprocess
+    import sys as _sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=4")
+    try:
+        proc = subprocess.run(
+            [_sys.executable, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "bench_core.py"),
+             "--sharding-json"],
+            env=env, capture_output=True, text=True, timeout=1200)
+        for line in proc.stdout.splitlines():
+            if line.startswith("SHARDING_JSON:"):
+                return json.loads(line[len("SHARDING_JSON:"):])
+        print(proc.stdout[-2000:])
+        print(proc.stderr[-2000:])
+        return {}
+    except Exception:
+        import traceback
+
+        traceback.print_exc()  # a broken sharded path must not look like 0
         return {}
 
 

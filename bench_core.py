@@ -407,6 +407,93 @@ def pipeline_train_bench() -> dict:
     return out
 
 
+def sharding_bench() -> dict:
+    """Sharded-execution rows (ISSUE 11, docs/SHARDING.md bench
+    methodology). MUST run in a process whose XLA_FLAGS forced >= 4
+    host devices BEFORE jax import (bench.py spawns one; `python
+    bench_core.py --sharding-json` is the entry point).
+
+    - ``llm_tokens_per_s_tp{1,2,4}``: gpt-tiny engine decode
+      throughput under the tp mesh, token-identity asserted against
+      tp=1 (the acceptance bar rides along with the number).
+    - ``pipeline_step_ms_fsdp{1,2}``: 2-stage MLP 1F1B step time with
+      the stage params/opt-state on the fsdp plane, loss bitwise
+      against fsdp=1.
+
+    On the CPU verification backend tp/fsdp ADD work (the collectives
+    are real, the chips aren't), so these rows pin the *overhead* of
+    the sharded lowering, not a speedup — the speedup story needs ICI
+    (MULTICHIP dryruns).
+    """
+    import jax
+
+    from ray_tpu.serve.llm import EngineConfig, LLMEngine, build_model
+
+    out: dict = {}
+    n_dev = len(jax.devices())
+    widths = [w for w in (1, 2, 4) if w <= n_dev]
+    m, params = build_model("gpt-tiny")
+    prompts = [[1 + (i % 50), 5, 9, 2] for i in range(8)]
+    max_tokens = 16 if SMOKE else 32
+    base_tokens = None
+    for tp in widths:
+        eng = LLMEngine(m, params, EngineConfig(
+            max_batch=4, num_blocks=64, block_size=8,
+            max_blocks_per_seq=8, prefill_buckets=(8,), tp=tp),
+            name=f"bench-tp{tp}")
+        s = eng.add_request([1, 2, 3], max_tokens=2)
+        eng.run_until_idle(timeout=600)     # compile warmup
+        s.tokens()
+        t0 = time.perf_counter()
+        streams = [eng.add_request(p, max_tokens=max_tokens)
+                   for p in prompts]
+        eng.run_until_idle(timeout=900)
+        dt = time.perf_counter() - t0
+        toks = [st.tokens(timeout=60) for st in streams]
+        eng.pool.check_leaks()
+        out[f"llm_tokens_per_s_tp{tp}"] = round(
+            sum(len(t) for t in toks) / dt, 1)
+        if tp == 1:
+            base_tokens = toks
+        else:
+            out[f"llm_tp{tp}_token_identical"] = toks == base_tokens
+
+    # -- fsdp pipeline step time ------------------------------------------
+    import optax
+
+    import ray_tpu
+    from ray_tpu.train.pipeline_cgraph import CompiledPipelineEngine
+
+    ray_tpu.init(num_cpus=max(4, os.cpu_count() or 4),
+                 ignore_reinit_error=True)
+    fns, params, mbs, tgts = _pipeline_mlp(2, 64, 4)
+    warmup, timed = (1, 2) if SMOKE else (2, 4)
+    base_loss = None
+    for fsdp in [w for w in (1, 2) if w <= n_dev]:
+        eng = CompiledPipelineEngine(fns, params, optax.adam(1e-3),
+                                     num_microbatches=4, fsdp=fsdp,
+                                     channel_bytes=1 << 18)
+        try:
+            for _ in range(warmup):
+                loss = eng.step(mbs, tgts)
+            t0 = time.perf_counter()
+            for _ in range(timed):
+                loss = eng.step(mbs, tgts)
+            step_s = (time.perf_counter() - t0) / timed
+            if eng.last_reports and fsdp > 1:
+                out["fsdp_bytes_per_chip"] = \
+                    eng.last_reports[0].get("fsdp_bytes_per_chip")
+        finally:
+            eng.shutdown()
+        out[f"pipeline_step_ms_fsdp{fsdp}"] = round(step_s * 1e3, 2)
+        if fsdp == 1:
+            base_loss = loss
+        else:
+            out[f"pipeline_fsdp{fsdp}_loss_bitwise"] = loss == base_loss
+    ray_tpu.shutdown()
+    return out
+
+
 def main() -> int:
     import ray_tpu
 
@@ -570,4 +657,9 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if "--sharding-json" in sys.argv:
+        # bench.py subprocess entry: the parent seeded XLA_FLAGS with
+        # forced host devices before this interpreter imported jax
+        print("SHARDING_JSON:" + json.dumps(sharding_bench()), flush=True)
+        sys.exit(0)
     sys.exit(main())

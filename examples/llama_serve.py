@@ -12,6 +12,7 @@ jitted decode step (the pre-engine baseline the BENCH llm_serve row
 measures against). --full uses llama2_7b sizes on either path.
 """
 import argparse
+import os
 import threading
 import time
 
@@ -34,7 +35,8 @@ def run_engine(args) -> None:
         engine_config={"max_batch": args.concurrency,
                        "num_blocks": 256, "block_size": 16,
                        "max_blocks_per_seq": 16,
-                       "prefill_buckets": (16, 32, 64)})
+                       "prefill_buckets": (16, 32, 64),
+                       "tp": args.tp})
     handle = serve.run(app, timeout=300)
 
     prompts = [[1 + i, 5, 9] for i in range(args.requests)]
@@ -80,6 +82,18 @@ def run_engine(args) -> None:
           f"concurrency {len(prompts)})")
     stats = ray_tpu.get(handle.stats.remote(), timeout=30)
     print(f"engine stats: {stats}")
+    if args.tp > 1:
+        # the sharded-serve acceptance surface: one replica spans tp
+        # chips, KV pool block-sharded per chip (docs/SHARDING.md);
+        # the engine tracks peak occupancy so the fast tiny-model runs
+        # still show the resident-block split
+        print(f"tp={args.tp} replica mesh — per-chip KV occupancy at "
+              f"peak ({stats['kv_blocks_peak']} blocks live):")
+        for chip, used in enumerate(
+                stats.get("kv_blocks_peak_per_chip", [])):
+            byts = stats.get("kv_bytes_per_chip", {}).get(str(chip), "?")
+            print(f"  chip {chip}: {used} blocks in use, "
+                  f"{byts} cache bytes resident")
     serve.shutdown()
 
 
@@ -155,7 +169,18 @@ def main():
     ap.add_argument("--concurrency", type=int, default=4,
                     help="engine max_batch (engine path)")
     ap.add_argument("--max-new", type=int, default=8)
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel width: the replica's engine "
+                         "lowers under a tp-chip mesh (forced host "
+                         "devices off-TPU; docs/SHARDING.md)")
     args = ap.parse_args()
+    if args.tp > 1 and "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        # must land in the environment BEFORE any process (driver or
+        # replica worker) imports jax: workers inherit it at spawn
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.tp}")
     ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
     if args.no_engine:
         run_legacy(args)

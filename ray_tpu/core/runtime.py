@@ -2825,13 +2825,22 @@ class _WorkerDirectState:
             ch = _rpc_connect(addr, handler=self._peer_handler, name="dpeer")
         except Exception:
             return None
-        ch.on_close(lambda a=addr: self._on_peer_close(a))
+        ch.on_close(lambda a=addr, c=ch: self._on_peer_close(a, c))
+        dup = None
         with self._lock:
             old = self._peers.get(addr)
             if old is not None and not old.closed:
-                ch.close()
-                return old
-            self._peers[addr] = ch
+                dup = ch
+                ch = old
+            else:
+                self._peers[addr] = ch
+        if dup is not None:
+            # lost the connect race: close the duplicate OUTSIDE the
+            # lock — close() runs on_close callbacks synchronously, and
+            # _on_peer_close takes the same (non-reentrant) lock. Closing
+            # under the lock self-deadlocked every router thread in the
+            # process (100-in-flight serve load on multi-core boxes).
+            dup.close()
         return ch
 
     def _peer_handler(self, method: str, payload):
@@ -2886,13 +2895,18 @@ class _WorkerDirectState:
                 pass
         trow["event"].set()
 
-    def _on_peer_close(self, addr: str) -> None:
+    def _on_peer_close(self, addr: str, ch=None) -> None:
         with self._lock:
-            self._peers.pop(addr, None)
+            # identity check: a duplicate connection losing the connect
+            # race must not evict the winner from the cache (mirrors the
+            # driver-side _on_direct_peer_close hardening)
+            if ch is None or self._peers.get(addr) is ch:
+                self._peers.pop(addr, None)
             victims = [t for t in self._tasks.values()
                        if not t["done"] and t["chan"].closed]
             for e in self._actors.values():
-                if e.get("ok") and e.get("addr") == addr:
+                if e.get("ok") and e.get("addr") == addr \
+                        and (ch is None or e.get("chan") is ch):
                     e["ok"] = False
         for trow in sorted(victims, key=lambda t: t["spec"].seq_no):
             self._fallback_task(trow)

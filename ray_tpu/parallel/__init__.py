@@ -29,6 +29,8 @@ from .collective import (allgather, allreduce, barrier, broadcast,
                          create_collective_group, destroy_collective_group,
                          get_group, recv, reduce, reducescatter, send)
 from .mesh_group import MeshGroup, MeshWorkerMixin
+from .sharding import (FsdpPlane, MeshOwner, SpecLayout, lower_jit,
+                       lower_shard_map)
 from .zero import ZeroUpdater, make_zero_update_spmd
 
 __all__ = [
@@ -39,5 +41,6 @@ __all__ = [
     "allreduce", "allgather", "reducescatter", "broadcast", "reduce",
     "send", "recv", "barrier",
     "MeshGroup", "MeshWorkerMixin",
+    "MeshOwner", "SpecLayout", "FsdpPlane", "lower_jit", "lower_shard_map",
     "ZeroUpdater", "make_zero_update_spmd",
 ]

@@ -11,6 +11,12 @@ over which pjit/shard_map place XLA collectives on ICI. This module owns:
 - logical axis rules: model code annotates pytrees with *logical* axes
   ("batch", "embed", "heads", ...) which map to mesh axes here — the
   flax `logical_axis_rules` idea, reimplemented standalone.
+
+Mesh OWNERSHIP (who builds/validates the mesh and hands out
+NamedShardings) lives one level up in `parallel.sharding.MeshOwner`:
+this module provides the topology primitives, the sharding package the
+layer both serve (LLM tp) and train (pipeline fsdp) consume
+(docs/SHARDING.md).
 """
 from __future__ import annotations
 

@@ -19,12 +19,17 @@ from typing import Any, Callable, List, Optional, Sequence
 import ray_tpu
 from ray_tpu.core.placement_group import placement_group, remove_placement_group
 
-from .mesh import MeshSpec, build_mesh
+from .mesh import MeshSpec
 
 
 class MeshWorkerMixin:
     """Mixin giving an actor the mesh-formation protocol. Train workers and
-    RL learners inherit this; `setup_mesh` is invoked once by MeshGroup."""
+    RL learners inherit this; `setup_mesh` is invoked once by MeshGroup.
+
+    Mesh construction/validation goes through the shared ownership layer
+    (parallel.sharding.MeshOwner) — the same object the LLM engine's tp
+    lowering and the pipeline stages' fsdp plane consume, so every stack
+    agrees on axis names and sharding factories (docs/SHARDING.md)."""
 
     def setup_mesh(self, process_id: int, num_processes: int,
                    coordinator: Optional[str], spec_kwargs: dict,
@@ -44,13 +49,23 @@ class MeshWorkerMixin:
         if devices_per_process is not None:
             lo = process_id * devices_per_process
             devs = devs[lo:lo + devices_per_process]
+        from .sharding import MeshOwner
+
         self._mesh_devices = devs
-        self._mesh = build_mesh(MeshSpec(**spec_kwargs), devices=devs)
+        self._owner = MeshOwner(MeshSpec(**spec_kwargs), devices=devs,
+                                name=f"gang-p{process_id}")
+        self._mesh = self._owner.mesh
         return len(devs)
 
     @property
     def mesh(self):
         return self._mesh
+
+    @property
+    def mesh_owner(self):
+        """The sharding-layer MeshOwner (NamedSharding factory, layout,
+        per-device accounting) backing :attr:`mesh`."""
+        return self._owner
 
     def mesh_run(self, fn_blob: bytes, *args, **kwargs):
         import cloudpickle

@@ -1,0 +1,27 @@
+"""ray_tpu.parallel.sharding — the sharded execution layer.
+
+One subsystem owns mesh construction and parameter/activation layout
+for the whole framework (docs/SHARDING.md):
+
+- :class:`SpecLayout` (layout.py) — the ``data``/``fsdp``/``tp`` axis
+  vocabulary producing canonical PartitionSpecs per parameter family,
+  bridged to model ``logical_axes()`` tables.
+- :class:`MeshOwner` (owner.py) — builds/validates device meshes and is
+  the single NamedSharding factory; serve replicas and train stage
+  actors consume the same object.
+- lowering helpers (lower.py) — :func:`lower_jit` (GSPMD/pjit plane:
+  the LLM engine's tp prefill/decode) and :func:`lower_shard_map`
+  (manual plane: explicit collectives over owner-bound axes).
+- :class:`FsdpPlane` (fsdp.py) — in-jit sharded param/opt-state storage
+  for the pipeline stage programs (bit-identical to replicated).
+"""
+from .fsdp import FsdpParams, FsdpPlane
+from .layout import DEFAULT_LAYOUT, LOGICAL_TO_AXES, SpecLayout, prune_spec
+from .lower import lower_jit, lower_shard_map, sharded_init
+from .owner import MeshOwner
+
+__all__ = [
+    "DEFAULT_LAYOUT", "FsdpParams", "FsdpPlane", "LOGICAL_TO_AXES",
+    "MeshOwner", "SpecLayout", "lower_jit", "lower_shard_map",
+    "prune_spec", "sharded_init",
+]

@@ -20,6 +20,12 @@ proxies) sees an ordinary user callable:
 `queue_len()` reports the engine's waiting+running depth; the replica
 ships it in its health ping so the controller's request-based autoscaler
 scales on engine backlog, not just in-flight RPCs (controller.py).
+
+Tensor parallelism: ``engine_config={"tp": N}`` makes this replica span
+an N-chip mesh — prefill/decode lower sharded (heads/FFN on ``tp``, KV
+pool block-sharded per chip; docs/SHARDING.md) while the serve layer
+still sees one replica actor. ``stats()`` then carries
+``kv_blocks_per_chip`` / ``kv_bytes_per_chip``.
 """
 from __future__ import annotations
 

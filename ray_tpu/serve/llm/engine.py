@@ -15,6 +15,13 @@ XLA compiles a handful of programs, not one per request: decode is a
 single `(max_batch,)` program; prefill compiles once per bucket in
 `prefill_buckets` (prompts pad up to the nearest bucket).
 
+With ``tp > 1`` the same programs lower under a per-replica device mesh
+(parallel.sharding MeshOwner + SpecLayout, docs/SHARDING.md): attention
+heads, FFN hidden, and vocab shard on the ``tp`` axis, the paged KV
+pool block-shards per chip (BlockPool mirrors the layout and balances
+allocation across chips), and greedy decode is token-identical to
+tp=1 — the scheduler, streams, and serve integration are unchanged.
+
     engine = LLMEngine(model, params, EngineConfig(max_batch=8))
     engine.start()                       # background scheduler thread
     stream = engine.add_request([1, 5, 9], max_tokens=32)
@@ -30,7 +37,6 @@ replica's queue_depth (replica.py / controller.py).
 from __future__ import annotations
 
 import collections
-import functools
 import itertools
 import queue
 import threading
@@ -48,7 +54,9 @@ _G_QUEUE = _metrics.Gauge(
     "LLM engine requests waiting + running", tag_keys=("engine",))
 _G_BLOCKS = _metrics.Gauge(
     "ray_tpu_llm_kv_blocks_used",
-    "KV-cache pool blocks currently allocated", tag_keys=("engine",))
+    "KV-cache pool blocks currently allocated (chip label: per-chip "
+    "occupancy of a tp-sharded pool; unlabeled: engine total)",
+    tag_keys=("engine", "chip"))
 _G_TOKPS = _metrics.Gauge(
     "ray_tpu_llm_tokens_per_s",
     "generated tokens/s over the trailing window", tag_keys=("engine",))
@@ -69,6 +77,11 @@ class EngineConfig:
     num_blocks: int = 128
     max_batch: int = 8                 # decode program batch (slots)
     max_blocks_per_seq: int = 16       # block-table width (M)
+    # tensor parallelism: one replica = one mesh spanning tp chips. The
+    # prefill/decode programs lower under the mesh with attention heads
+    # + FFN sharded on `tp` and the KV pool block-sharded per chip
+    # (docs/SHARDING.md); num_blocks must be a multiple of tp
+    tp: int = 1
     # prefill-token admission budget per scheduler iteration; at least
     # one waiting request is always admitted so a long prompt can't starve
     max_prefill_tokens_per_step: int = 256
@@ -204,8 +217,29 @@ class LLMEngine:
         # under jit and silently reuse the last row
         self.max_seq_len = min(cfg.max_context, model.config.max_seq)
         self.name = name or f"llm-{next(self._ids)}"
-        self.pool = BlockPool(cfg.num_blocks)
+        self.tp = int(cfg.tp)
+        self.owner = None
+        self.pool = BlockPool(cfg.num_blocks, shards=self.tp)
         self._cache = model.init_paged_cache(cfg.num_blocks, cfg.block_size)
+        self._cache_sharding = None
+        if self.tp > 1:
+            # sharded execution layer (docs/SHARDING.md): one mesh per
+            # replica; params shard per SpecLayout family (heads/FFN/
+            # vocab on tp), the KV pool block-shards per chip, and the
+            # host-side scheduler stays unchanged
+            from ...parallel.sharding import MeshOwner
+
+            self.owner = MeshOwner.tp_mesh(self.tp,
+                                           name=f"llm-{self.name}")
+            pspecs = self.owner.layout.param_specs(model)
+            self.params = params = {
+                n: jax.device_put(v, self.owner.sharding(pspecs[n]))
+                for n, v in params.items()}
+            self._cache_sharding = self.owner.sharding(
+                self.owner.layout.kv_cache_blocks())
+            self._cache = {
+                k: jax.device_put(v, self._cache_sharding)
+                for k, v in self._cache.items()}
         self._lock = threading.RLock()
         self._waiting: "collections.deque[Request]" = collections.deque()
         self._running: List[_Sequence] = []
@@ -214,25 +248,49 @@ class LLMEngine:
         self._stop = threading.Event()
         self._total_generated = 0
         self._total_preemptions = 0
+        self._peak_blocks = 0
+        self._peak_per_chip: List[int] = [0] * self.tp
         self._tok_events: "collections.deque" = collections.deque()
 
         # two jit entry points; jax caches one compiled program per
         # argument shape, so decode compiles once and prefill once per
         # bucket — the buckets BOUND the program count
-        @functools.partial(jax.jit)
         def _decode(params, kc, vc, tokens, positions, rows, active):
             logits, cache = model.paged_decode_step(
                 params, {"k": kc, "v": vc}, tokens, positions, rows, active)
             return logits, cache["k"], cache["v"]
 
-        @functools.partial(jax.jit)
         def _prefill(params, kc, vc, tokens, length, block_row):
             logits, cache = model.paged_prefill(
                 params, {"k": kc, "v": vc}, tokens, length, block_row)
             return logits, cache["k"], cache["v"]
 
-        self._decode_fn = _decode
-        self._prefill_fn = _prefill
+        if self.owner is None:
+            self._decode_fn = jax.jit(_decode)
+            self._prefill_fn = jax.jit(_prefill)
+        else:
+            # pjit plane (sharding/lower.py): GSPMD partitions the body
+            # under the replica's mesh. Host-side inputs (tokens/rows/
+            # lengths) replicate; logits come back replicated so the
+            # scheduler's argmax sees full vocab; the cache stays
+            # block-sharded across calls. Decode donates its cache
+            # buffers on accelerator backends so the pool updates in
+            # place (the forced-host CPU backend has no donation).
+            from ...parallel.sharding import lower_jit
+
+            rep = self.owner.layout.replicated()
+            kvspec = self.owner.layout.kv_cache_blocks()
+            donate = (1, 2) if \
+                self.owner.devices[0].platform != "cpu" else ()
+            self._decode_fn = lower_jit(
+                _decode, self.owner,
+                in_specs=(pspecs, kvspec, kvspec, rep, rep, rep, rep),
+                out_specs=(rep, kvspec, kvspec),
+                donate_argnums=donate)
+            self._prefill_fn = lower_jit(
+                _prefill, self.owner,
+                in_specs=(pspecs, kvspec, kvspec, rep, rep, rep),
+                out_specs=(rep, kvspec, kvspec))
 
     # -- request intake -------------------------------------------------------
 
@@ -301,6 +359,17 @@ class LLMEngine:
                             jnp.asarray(kv_blocks["v"],
                                         self._cache["v"].dtype)),
                     }
+                    if self._cache_sharding is not None:
+                        # the host-side scatter above runs outside the
+                        # lowered programs and may leave the result on
+                        # GSPMD's preferred layout — pin it back to the
+                        # block-sharded residence the decode program
+                        # expects
+                        import jax as _jax
+
+                        self._cache = {
+                            k: _jax.device_put(v, self._cache_sharding)
+                            for k, v in self._cache.items()}
                     seq = _Sequence(req, slot, blocks, len(prompt),
                                     int(first_token))
                     self._running.append(seq)
@@ -561,10 +630,30 @@ class LLMEngine:
         _G_QUEUE.set(len(self._waiting) + len(self._running), tags=tags)
         _G_BLOCKS.set(self.pool.used_count, tags=tags)
         _G_TOKPS.set(round(self._tokens_per_s(), 1), tags=tags)
+        self._peak_blocks = max(self._peak_blocks, self.pool.used_count)
+        if self.tp > 1:
+            for chip, used in enumerate(self.pool.used_per_shard()):
+                _G_BLOCKS.set(used, tags={"engine": self.name,
+                                          "chip": str(chip)})
+                self._peak_per_chip[chip] = max(
+                    self._peak_per_chip[chip], used)
+
+    def kv_bytes_per_chip(self) -> Dict[int, int]:
+        """Resident KV-cache bytes per CHIP — keyed by mesh position
+        0..tp-1 (same keying as the pool's shard accounting and the
+        `{chip=}` gauge; raw jax device ids are global on multi-host
+        TPUs and would not line up)."""
+        if self.owner is None:
+            total = sum(int(np.asarray(v).nbytes)
+                        for v in self._cache.values())
+            return {0: total}
+        by_dev = self.owner.per_device_bytes(self._cache)
+        return {chip: by_dev.get(d.id, 0)
+                for chip, d in enumerate(self.owner.devices)}
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
-            return {
+            out = {
                 "engine": self.name,
                 "waiting": len(self._waiting),
                 "running": len(self._running),
@@ -576,4 +665,12 @@ class LLMEngine:
                 "tokens_per_s": round(self._tokens_per_s(), 1),
                 "total_generated": self._total_generated,
                 "preemptions": self._total_preemptions,
+                "tp": self.tp,
+                "kv_blocks_peak": self._peak_blocks,
             }
+            if self.tp > 1:
+                out["kv_blocks_per_chip"] = self.pool.used_per_shard()
+                out["kv_blocks_peak_per_chip"] = list(self._peak_per_chip)
+                out["kv_bytes_per_chip"] = {
+                    str(d): b for d, b in self.kv_bytes_per_chip().items()}
+            return out

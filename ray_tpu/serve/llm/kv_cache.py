@@ -5,6 +5,13 @@ module owns the *accounting*: which pool blocks are free, which sequence
 holds which blocks, and the alloc/free discipline whose failure path is
 preemption-and-requeue (engine.py). Kept separate so leak/accounting
 invariants are testable without touching jax at all.
+
+With ``shards > 1`` (tensor-parallel engines, docs/SHARDING.md) the pool
+mirrors the device layout of the block-sharded cache arrays: block ids
+``[c*N/shards, (c+1)*N/shards)`` live on chip ``c``, and allocation
+balances across chips (most-free-first) so per-chip KV memory stays
+even. ``used_per_shard()`` backs the per-chip occupancy gauge
+``ray_tpu_llm_kv_blocks_used{chip=}``.
 """
 from __future__ import annotations
 
@@ -15,29 +22,57 @@ class BlockPool:
     """Fixed pool of KV blocks. alloc() is all-or-nothing: a partial
     grant would deadlock two growing sequences against each other."""
 
-    def __init__(self, num_blocks: int):
+    def __init__(self, num_blocks: int, shards: int = 1):
         if num_blocks <= 0:
             raise ValueError(f"num_blocks must be positive, got {num_blocks}")
+        if shards < 1:
+            raise ValueError(f"shards must be >= 1, got {shards}")
+        if num_blocks % shards:
+            raise ValueError(
+                f"num_blocks {num_blocks} not divisible into {shards} "
+                f"shards — the pool must tile the block-sharded cache "
+                f"exactly (raise num_blocks to a multiple of tp)")
         self.num_blocks = num_blocks
-        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        self.shards = shards
+        per = num_blocks // shards
+        self._per_shard = per
+        # per-shard LIFO free lists (ascending ids pop first)
+        self._free_by_shard: List[List[int]] = [
+            list(range((s + 1) * per - 1, s * per - 1, -1))
+            for s in range(shards)]
         self._used = 0
 
     @property
     def free_count(self) -> int:
-        return len(self._free)
+        return sum(len(f) for f in self._free_by_shard)
 
     @property
     def used_count(self) -> int:
         return self._used
 
+    def shard_of(self, block: int) -> int:
+        """Which chip's cache slice holds this block id."""
+        return block // self._per_shard
+
+    def used_per_shard(self) -> List[int]:
+        """Allocated blocks per chip (the {chip=} gauge series)."""
+        return [self._per_shard - len(f) for f in self._free_by_shard]
+
     def alloc(self, n: int) -> Optional[List[int]]:
         """n blocks, or None when the pool can't satisfy the request
-        (caller preempts or waits). n == 0 returns []."""
+        (caller preempts or waits). n == 0 returns []. Blocks come from
+        the fullest-free shard first, so tp chips fill evenly."""
         if n < 0:
             raise ValueError(f"alloc({n})")
-        if n > len(self._free):
+        if n > self.free_count:
             return None
-        out = [self._free.pop() for _ in range(n)]
+        out: List[int] = []
+        for _ in range(n):
+            # most-free shard (lowest index on ties): O(shards) per
+            # block with shards <= tp <= 8 — not a hot path
+            s = max(range(self.shards),
+                    key=lambda i: (len(self._free_by_shard[i]), -i))
+            out.append(self._free_by_shard[s].pop())
         self._used += n
         return out
 
@@ -48,16 +83,24 @@ class BlockPool:
         if self._used < len(blocks):
             raise ValueError("double free: more blocks returned than held")
         self._used -= len(blocks)
-        self._free.extend(blocks)
+        for b in blocks:
+            self._free_by_shard[self.shard_of(b)].append(b)
 
     def check_leaks(self) -> None:
         """Invariant: every block is either free or accounted used."""
-        if len(self._free) + self._used != self.num_blocks:
+        free = [b for f in self._free_by_shard for b in f]
+        if len(free) + self._used != self.num_blocks:
             raise AssertionError(
-                f"block leak: {len(self._free)} free + {self._used} used "
+                f"block leak: {len(free)} free + {self._used} used "
                 f"!= {self.num_blocks}")
-        if len(set(self._free)) != len(self._free):
+        if len(set(free)) != len(free):
             raise AssertionError("duplicate block in free list")
+        for s, f in enumerate(self._free_by_shard):
+            for b in f:
+                if self.shard_of(b) != s:
+                    raise AssertionError(
+                        f"block {b} filed under shard {s}, belongs to "
+                        f"{self.shard_of(b)}")
 
 
 def blocks_for_tokens(num_tokens: int, block_size: int) -> int:

@@ -23,7 +23,9 @@ from ..parallel.mesh_group import MeshWorkerMixin
 
 class _MeshInferenceWorker(MeshWorkerMixin):
     """One host of the replica's gang: builds the model and jits the
-    sharded forward on its mesh slice."""
+    sharded forward on its mesh slice. ``self.mesh_owner`` (the shared
+    ownership layer from parallel.sharding) is available to build fns
+    that want SpecLayout-driven shardings rather than raw mesh axes."""
 
     def build_model(self, build_blob: bytes, config: Optional[dict]) -> bool:
         import cloudpickle

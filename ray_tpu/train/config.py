@@ -39,6 +39,10 @@ class PipelineConfig:
     num_microbatches: int = 4
     virtual_stages: int = 1      # model chunks per actor (interleaving)
     dp: int = 1                  # data-parallel pipeline replicas
+    # in-actor sharded param/opt-state axis (parallel.sharding
+    # FsdpPlane): each stage's chunk params + moments live 1/fsdp per
+    # chip; composes with dp and the stages into pp x dp x fsdp
+    fsdp: int = 1
     zero_update: bool = True     # ZeRO-shard the dp optimizer update
     remat: bool = False          # recompute fwd in bwd (activation remat)
     channel_bytes: int = 1 << 20  # per-slot channel capacity
@@ -54,6 +58,7 @@ class PipelineConfig:
             "num_microbatches": self.num_microbatches,
             "virtual_stages": self.virtual_stages,
             "dp": self.dp,
+            "fsdp": self.fsdp,
             "zero_update": self.zero_update,
             "remat": self.remat,
             "channel_bytes": self.channel_bytes,

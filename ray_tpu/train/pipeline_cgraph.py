@@ -213,7 +213,7 @@ class _CGStage:
               fn_blobs: List[bytes], chunk_params: List[Any],
               chunk_meta: List[dict], tx_blob: Optional[bytes],
               remat: bool, dp: int, dp_rank: int,
-              group_name: str, zero_update: bool) -> bool:
+              group_name: str, zero_update: bool, fsdp: int = 1) -> bool:
         import jax
 
         self.idx = actor_idx
@@ -222,11 +222,10 @@ class _CGStage:
         self.meta = chunk_meta
         self.dp = dp
         self.dp_rank = dp_rank
+        self.fsdp = int(fsdp)
         self.zero_update = zero_update
         self.group_name = group_name
         self._jax = jax
-        self.params: Dict[str, Any] = {
-            str(v): chunk_params[v] for v in range(virtual)}
         fns = [cloudpickle.loads(b) for b in fn_blobs]
         self._progs = [
             _make_programs(fns[v], chunk_meta[v]["last"], remat)
@@ -238,13 +237,41 @@ class _CGStage:
         self._zero = None
         self._opt_state = None
         self._upd = None
+        self._plane = None
+        self._fsdp_state: Dict[str, Any] = {}
+        self._fsdp_opt: Dict[str, Any] = {}
+        self._param_cache: Dict[str, Any] = {}
+        if self.fsdp > 1:
+            # sharded execution layer (docs/SHARDING.md): this stage's
+            # chunk params + optimizer moments live 1/fsdp per chip on
+            # an in-actor mesh; forwards gather exactly, the update is
+            # shard-local — loss trajectory bit-identical to replicated
+            from ..parallel.sharding import FsdpPlane, MeshOwner
+
+            owner = MeshOwner.fsdp_mesh(
+                self.fsdp, name=f"stage{actor_idx}-r{dp_rank}")
+            self._plane = FsdpPlane(owner, self.tx)
+            for v in range(virtual):
+                self._fsdp_state[str(v)] = self._plane.shard(
+                    chunk_params[v])
+            self.params = {}
+        else:
+            self.params = {
+                str(v): chunk_params[v] for v in range(virtual)}
         if self.tx is not None:
             if dp > 1:
                 from ..parallel import collective
 
                 collective.create_collective_group(
                     dp, dp_rank, group_name=group_name)
-            if dp > 1 and zero_update:
+            if self._plane is not None:
+                # fsdp composes with dp through a host-collective grad
+                # sync (update() allreduces the mean before the sharded
+                # step); the dp-plane ZeRO updater stays the fsdp=1 path
+                for v in range(virtual):
+                    self._fsdp_opt[str(v)] = self._plane.init_opt(
+                        self._fsdp_state[str(v)])
+            elif dp > 1 and zero_update:
                 from ..parallel.zero import ZeroUpdater
 
                 self._zero = ZeroUpdater(
@@ -255,6 +282,19 @@ class _CGStage:
                 self._upd = _make_update(self.tx)
         return True
 
+    def _params_of(self, v: int):
+        """Chunk ``v``'s full parameter tree. fsdp: gathered on demand
+        from the sharded residence, cached for the step (update()
+        drops the cache so only shards persist between steps)."""
+        if self._plane is None:
+            return self.params[str(v)]
+        key = str(v)
+        cached = self._param_cache.get(key)
+        if cached is None:
+            cached = self._param_cache[key] = self._plane.gather(
+                self._fsdp_state[key])
+        return cached
+
     # -- schedule ops (driven by the cgraph iterative loop) ---------------
 
     def forward(self, v: int, mb: int, x, targets=None):
@@ -262,7 +302,7 @@ class _CGStage:
         the next chunk — or, on the LAST global chunk, the scalar loss
         (which the schedule routes to the driver's loss channel)."""
         fwd, _ = self._progs[v]
-        p = self.params[str(v)]
+        p = self._params_of(v)
         if self.meta[v]["last"]:
             if self._remat:
                 out = fwd(p, x, targets)
@@ -291,7 +331,7 @@ class _CGStage:
         if g is None:
             g = jnp.float32(1.0)
         if self._remat:
-            gp, gx = bwd(self.params[str(v)], *res, g)
+            gp, gx = bwd(self._params_of(v), *res, g)
         else:
             gp, gx = bwd(res, g)
         key = str(v)
@@ -322,7 +362,31 @@ class _CGStage:
         from ..parallel.zero import tree_bytes
 
         if self.tx is None:
-            pass  # evaluation engine: grads dropped
+            self._param_cache = {}  # evaluation engine: grads dropped
+        elif self._plane is not None:
+            # fsdp plane: dp-sync the full grads first (host allreduce
+            # mean — same arithmetic as the replicated path), then the
+            # shard-local sharded update; the per-step gather cache
+            # drops so only 1/fsdp params+moments persist
+            if self.dp > 1:
+                import jax.numpy as jnp
+                import numpy as np
+
+                from ..parallel import collective
+                from ..parallel.zero import flatten_tree, unflatten_tree
+
+                flat_g, spec = flatten_tree(grads)
+                mean = collective.allreduce(
+                    np.asarray(flat_g), self.group_name) / self.dp
+                grads = unflatten_tree(
+                    jnp.asarray(mean, dtype=spec.dtype), spec)
+            for v in range(self.virtual):
+                key = str(v)
+                self._fsdp_state[key], self._fsdp_opt[key] = \
+                    self._plane.update(self._fsdp_state[key],
+                                       grads[key],
+                                       self._fsdp_opt[key])
+            self._param_cache = {}
         elif self._zero is not None:
             self.params = self._zero.update(self.params, grads)
         elif self.dp > 1:
@@ -346,16 +410,33 @@ class _CGStage:
             self.params, self._opt_state = self._upd(
                 grads, self._opt_state, self.params)
         self._grad_acc = {}
-        return {
+        report = {
             "stage": self.idx, "dp_rank": self.dp_rank,
             "update_ms": round((time.perf_counter() - t0) * 1e3, 3),
             "opt_state_bytes": self.opt_state_bytes(),
             "in_flight_residuals": len(self._residuals),
         }
+        if self._plane is not None:
+            per_chip: Dict[int, int] = {}
+            for v in range(self.virtual):
+                key = str(v)
+                for dev, b in self._plane.per_device_bytes(
+                        self._fsdp_state[key],
+                        self._fsdp_opt.get(key)).items():
+                    per_chip[dev] = per_chip.get(dev, 0) + b
+            report["fsdp"] = self.fsdp
+            report["fsdp_bytes_per_chip"] = {
+                str(d): b for d, b in sorted(per_chip.items())}
+        return report
 
     # -- dynamic-path surface (driver calls between steps) ----------------
 
     def get_params(self) -> List[Any]:
+        if self._plane is not None:
+            # transient gather, NOT through the step cache: a between-
+            # steps inspection must not leave full params resident
+            return [self._plane.gather(self._fsdp_state[str(v)])
+                    for v in range(self.virtual)]
         return [self.params[str(v)] for v in range(self.virtual)]
 
     def get_state(self) -> dict:
@@ -373,6 +454,18 @@ class _CGStage:
             # buffers, and numpy pickles leaner than jax.Array
             return jax.tree.map(np_mod.asarray, t)
 
+        if self._plane is not None:
+            # plane.to_host: full (gathered) params; opt moments as
+            # globally-shaped flat arrays — restore re-shards both
+            # (same fsdp width, enforced by the engine geometry check)
+            params, opt = [], {}
+            for v in range(self.virtual):
+                p, o = self._plane.to_host(
+                    self._fsdp_state[str(v)], self._fsdp_opt.get(str(v)))
+                params.append(p)
+                if o is not None:
+                    opt[str(v)] = o
+            return {"params": params, "opt": opt or None, "kind": "fsdp"}
         if self._zero is not None:
             opt, kind = host(self._zero.opt_state()), "zero"
         elif self._opt_state is not None:
@@ -392,11 +485,27 @@ class _CGStage:
         initialized, and any in-flight residual/grad accumulation is
         discarded (restore happens at a step boundary by construction)."""
         if chunk_params is not None:
-            self.params = {str(v): chunk_params[v]
-                           for v in range(self.virtual)}
+            if self._plane is not None:
+                for v in range(self.virtual):
+                    self._fsdp_state[str(v)] = self._plane.shard(
+                        chunk_params[v])
+            else:
+                self.params = {str(v): chunk_params[v]
+                               for v in range(self.virtual)}
         self._residuals = {}
         self._grad_acc = {}
-        if kind == "zero":
+        self._param_cache = {}
+        if kind == "fsdp":
+            if self._plane is None:
+                raise ValueError(
+                    "checkpoint holds fsdp-sharded state but this stage "
+                    "runs unsharded (fsdp flag changed between save and "
+                    "restore)")
+            if opt_state is not None:
+                for v in range(self.virtual):
+                    self._fsdp_opt[str(v)] = self._plane.place_opt(
+                        self._fsdp_state[str(v)], opt_state[str(v)])
+        elif kind == "zero":
             if self._zero is None:
                 raise ValueError(
                     "checkpoint holds a ZeRO opt-state shard but this "
@@ -414,6 +523,8 @@ class _CGStage:
     def opt_state_bytes(self) -> int:
         from ..parallel.zero import tree_bytes
 
+        if self._plane is not None:
+            return sum(tree_bytes(o) for o in self._fsdp_opt.values())
         if self._zero is not None:
             return self._zero.opt_state_bytes()
         return tree_bytes(self._opt_state) \
@@ -465,8 +576,15 @@ class CompiledPipelineEngine:
     virtual_stages: model chunks per actor (interleaved 1F1B when > 1).
     dp: data-parallel pipeline replicas; each stage's dp group syncs
         grads at update time.
+    fsdp: in-jit sharded param/opt-state axis INSIDE each stage actor
+        (parallel.sharding.FsdpPlane over the host's chips): chunk
+        params and optimizer moments live 1/fsdp per chip, forwards
+        gather exactly, the update is shard-local — loss trajectory
+        bit-identical to fsdp=1. Composes with dp (host grad sync) and
+        the pipeline stages into pp x dp x fsdp (docs/SHARDING.md).
     zero_update: ZeRO-shard the dp update (1/dp optimizer state per
-        replica) vs the replicated allreduce update.
+        replica) vs the replicated allreduce update (fsdp=1 path; with
+        fsdp > 1 the sharded update runs on the fsdp plane instead).
     remat: recompute chunk forwards in the backward instead of holding
         vjp residuals (activation rematerialization knob).
     tied: [(chunk_i, key_i, chunk_j, key_j), ...] tied-weight pairs
@@ -488,6 +606,7 @@ class CompiledPipelineEngine:
                  num_microbatches: int,
                  virtual_stages: int = 1,
                  dp: int = 1,
+                 fsdp: int = 1,
                  zero_update: bool = True,
                  remat: bool = False,
                  tied: Sequence[tuple] = (),
@@ -512,6 +631,9 @@ class CompiledPipelineEngine:
         self.virtual = V
         self.num_microbatches = M
         self.dp = int(dp)
+        self.fsdp = int(fsdp)
+        if self.fsdp < 1:
+            raise ValueError(f"fsdp must be >= 1, got {fsdp}")
         self.zero_update = bool(zero_update)
         self.tied = list(tied)
         self.graph_id = os.urandom(16)
@@ -634,7 +756,7 @@ class CompiledPipelineEngine:
                     [self._fn_blobs[g] for g in chunks],
                     cp, meta, self._tx_blob,
                     self._remat, dp, r, f"zpipe-{self._gtag}-s{i}",
-                    self.zero_update))
+                    self.zero_update, self.fsdp))
             self.actor_grid.append(row)
         ray_tpu.get(setups, timeout=self._setup_timeout)
         if per_actor_state is not None:
@@ -1044,6 +1166,7 @@ class CompiledPipelineEngine:
             "engine": {"num_chunks": self.num_chunks,
                        "num_stages": self.num_stages,
                        "virtual": self.virtual, "dp": self.dp,
+                       "fsdp": self.fsdp,
                        "zero_update": self.zero_update,
                        "num_microbatches": self.num_microbatches},
             "states": states,
@@ -1150,8 +1273,13 @@ class CompiledPipelineEngine:
 
     def _check_ckpt_shape(self, ckpt: dict) -> None:
         want = {"num_chunks": self.num_chunks, "virtual": self.virtual,
-                "dp": self.dp, "zero_update": self.zero_update}
-        have = {k: ckpt.get("engine", {}).get(k) for k in want}
+                "dp": self.dp, "fsdp": self.fsdp,
+                "zero_update": self.zero_update}
+        # fsdp joined the payload later: checkpoints written before it
+        # are unsharded by construction, so default the key to 1 rather
+        # than rejecting a compatible restore
+        have = {k: ckpt.get("engine", {}).get(k, 1 if k == "fsdp" else None)
+                for k in want}
         if have != want:
             raise ValueError(
                 f"checkpoint shape {have} does not match engine {want}")

@@ -1,0 +1,11 @@
+"""Fixture: sharding-layer mesh module in the new idiom — the owning
+mesh and its SpecLayout-style axis vocabulary live here; the plane
+kernels that use (and mis-use) the axes live in plane.py. GC020 must
+resolve the owner's axes across the module boundary exactly as it does
+for the shipped parallel/sharding/ tree."""
+import jax
+from jax.sharding import Mesh
+
+AXES = ("fsdp", "tp")
+
+OWNER_MESH = Mesh(jax.devices(), AXES)

@@ -1,0 +1,50 @@
+"""Fixture: fsdp-plane-shaped kernels against layoutdef.OWNER_MESH
+(axes fsdp, tp), written in the sharding-layer idiom (nested body defs,
+axis_names= bound from the owning mesh's vocabulary). Two seeded bugs:
+
+- bad_update's collective gathers over axis 'dp', which the owning
+  mesh never binds (GC020, resolved cross-file);
+- bad_arity's in_specs carries two specs but the wrapped update body
+  takes three required arguments — the FsdpPlane update signature
+  (params, grads, opt) — failing at trace time with an opaque pytree
+  error (GC021).
+
+The well-formed plane below them must stay clean: its collectives name
+only bound axes and its in_specs match the body arity.
+"""
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.jax_compat import shard_map
+
+from .layoutdef import OWNER_MESH
+
+
+def bad_update(flat, grads, opt):
+    def body(p_shard, g_full, opt_local):
+        return jax.lax.all_gather(p_shard, "dp", tiled=True)
+
+    fn = shard_map(body, mesh=OWNER_MESH, in_specs=(P("fsdp"), P(), P()),
+                   out_specs=P(), axis_names=frozenset({"fsdp"}))
+    return fn(flat, grads, opt)
+
+
+def bad_arity(flat, grads, opt):
+    def body(p_shard, g_full, opt_local):
+        idx = jax.lax.axis_index("fsdp")
+        return jax.lax.dynamic_slice(g_full, (idx,), (1,))
+
+    fn = shard_map(body, mesh=OWNER_MESH, in_specs=(P("fsdp"), P()),
+                   out_specs=P("fsdp"), axis_names=frozenset({"fsdp"}))
+    return fn(flat, grads, opt)
+
+
+def good_plane(flat, grads, opt):
+    def body(p_shard, g_full, opt_local):
+        idx = jax.lax.axis_index("fsdp")
+        gathered = jax.lax.all_gather(p_shard, "fsdp", tiled=True)
+        return gathered * g_full[idx] + opt_local
+
+    fn = shard_map(body, mesh=OWNER_MESH, in_specs=(P("fsdp"), P(), P()),
+                   out_specs=P(), axis_names=frozenset({"fsdp"}))
+    return fn(flat, grads, opt)

@@ -333,3 +333,49 @@ def test_thread_count_flat_across_1k_actor_calls(cluster):
     assert after - before <= 8, \
         f"driver thread count grew {before} -> {after} across 1k calls"
     ray_tpu.kill(c)
+
+
+def test_worker_concurrent_first_calls_no_peer_race_deadlock(cluster):
+    """Regression (found via serve's 100-in-flight load): concurrent
+    worker-side FIRST direct calls to actors on the same peer worker
+    race to establish the peer connection. The loser used to close its
+    duplicate channel while holding the peer-cache lock — the close's
+    on_close callback re-took that lock and every caller thread in the
+    process deadlocked until its get() timeout. The duplicate must be
+    closed outside the lock AND must not evict the winner from the
+    cache (identity-checked on_close)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    # fractional CPUs: 4 targets + the burster must fit the module
+    # fixture's num_cpus=4 budget or the burst never schedules
+    targets = [Counter.options(max_concurrency=8,
+                               num_cpus=0.5).remote()
+               for _ in range(4)]
+    ray_tpu.get([t.echo.remote(0) for t in targets], timeout=60)  # ALIVE
+
+    @ray_tpu.remote
+    class Burster:
+        def __init__(self, targets):
+            self.targets = targets
+
+        def burst(self, n):
+            # fresh process: every target is a first-time direct
+            # resolve, so the connect race is as wide as the pool
+            t0 = time.monotonic()
+            with ThreadPoolExecutor(n) as pool:
+                out = list(pool.map(
+                    lambda i: ray_tpu.get(  # graftcheck: disable=GC001
+                        self.targets[i % len(self.targets)].echo.remote(i),
+                        timeout=45),
+                    range(n)))
+            return time.monotonic() - t0, out
+
+    b = Burster.options(max_concurrency=4).remote(targets)
+    wall, out = ray_tpu.get(b.burst.remote(16), timeout=90)
+    assert out == list(range(16))
+    # pre-fix this took the full 45s get timeout; allow generous slack
+    # for slow CI boxes while still catching the wedge
+    assert wall < 30, f"concurrent first-call burst took {wall:.1f}s"
+    ray_tpu.kill(b)
+    for t in targets:
+        ray_tpu.kill(t)

@@ -50,6 +50,20 @@ def test_llama_serve_example_legacy():
     assert "generated token ids:" in out
 
 
+def test_llama_serve_example_tp():
+    """--tp 2: the replica's engine lowers under a 2-chip mesh (the
+    subprocess env already forces 8 host devices) and the per-chip KV
+    occupancy print shows blocks resident on BOTH chips."""
+    out = _run("llama_serve.py", "--tp", "2", "--requests", "3",
+               "--max-new", "6", timeout=300)
+    assert "per-chip KV occupancy" in out
+    assert "chip 0:" in out and "chip 1:" in out
+    import re
+
+    used = [int(m) for m in re.findall(r"chip \d: (\d+) blocks", out)]
+    assert len(used) == 2 and all(u > 0 for u in used), out
+
+
 def test_vit_pbt_example():
     out = _run("vit_pbt_sweep.py", "--population", "2", timeout=300)
     assert "best lr:" in out

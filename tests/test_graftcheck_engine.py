@@ -318,6 +318,38 @@ class TestSPMD:
         # good_kernel (same file) stays clean
         assert all(f.line < 24 for f in res.findings), res.findings
 
+    def test_sharding_layer_idioms(self):
+        """ISSUE 11 fixture package: kernels written against the
+        sharding layer's owning-mesh idiom (layoutdef.OWNER_MESH +
+        axis_names= vocabulary, FsdpPlane-shaped nested bodies). GC020
+        flags the collective over the unbound 'dp' axis, GC021 the
+        in_specs/arity mismatch through the update-body signature;
+        good_plane stays clean."""
+        res = run_pkg("sharding_pkg", rules={"GC020", "GC021"})
+        assert rules_of(res) == ["GC020", "GC021"]
+        gc020 = [f for f in res.findings if f.rule == "GC020"]
+        assert len(gc020) == 1
+        assert "'dp'" in gc020[0].message
+        assert "fsdp" in gc020[0].message
+        assert gc020[0].path.endswith("plane.py")
+        gc021 = [f for f in res.findings if f.rule == "GC021"]
+        assert len(gc021) == 1
+        assert "2 entries" in gc021[0].message
+        # both findings land in the bad kernels, none in good_plane
+        assert all(f.line < 42 for f in res.findings), res.findings
+
+    def test_shipped_sharding_tree_is_clean(self):
+        """The shipped sharding subsystem sweeps clean under the SPMD
+        family it introduces idioms for (the tree-wide sweep below
+        covers it too; this pins the subsystem on its own so a local
+        regression names the right culprit)."""
+        res = check_project(
+            [os.path.join(REPO, "ray_tpu", "parallel", "sharding")],
+            rules={"GC020", "GC021", "GC022"}, cache_path=None,
+            root=os.path.join(REPO, "ray_tpu"))
+        assert res.errors == 0
+        assert [f.render() for f in res.findings] == []
+
     def test_symbolic_axis_names_match(self):
         # pipeline.py-style: axis_names=frozenset({pp_axis}) with the
         # collectives using the same symbol — must stay clean
@@ -433,6 +465,36 @@ def step(params, batch):
     return batch
 """
         assert check_source(src, "ok2.py", rules={"GC022"}) == []
+
+    def test_tp_decode_donated_cache_reuse(self):
+        """The sharded-serve idiom (ISSUE 11): the tp decode step
+        donates its KV cache buffers. Reading the donated cache var
+        after the call is the bug; the engine's rebind-the-cache idiom
+        (cache = decode(...)) is the fix and stays clean."""
+        src = """
+import functools
+import jax
+
+def serve_decode(params, kc, vc, tokens):
+    decode = jax.jit(lambda p, k, v, t: (t, k, v),
+                     donate_argnums=(1, 2))
+    logits, new_k, new_v = decode(params, kc, vc, tokens)
+    return logits, kc
+"""
+        fs = check_source(src, "tp.py", rules={"GC022"})
+        assert len(fs) == 1
+        assert "'kc'" in fs[0].message
+        ok = """
+import functools
+import jax
+
+def serve_decode(params, kc, vc, tokens):
+    decode = jax.jit(lambda p, k, v, t: (t, k, v),
+                     donate_argnums=(1, 2))
+    logits, kc, vc = decode(params, kc, vc, tokens)
+    return logits, kc
+"""
+        assert check_source(ok, "tp_ok.py", rules={"GC022"}) == []
 
 
 # ---------------------------------------------------------------------------

@@ -465,7 +465,17 @@ class TestAsyncioProxy:
     def test_load_100_in_flight_4_replicas(self, cluster):
         """100 concurrent requests through the asyncio proxy against 4
         replicas: all succeed, the load spreads across replicas
-        (power-of-two-choices routing), p2c stats exposed."""
+        (power-of-two-choices routing), p2c stats exposed.
+
+        Regression anchor: on multi-core boxes this burst used to wedge
+        every proxy router thread — concurrent first-time direct calls
+        racing to connect to the same peer worker closed the duplicate
+        channel while holding the peer-cache lock, and the close's
+        on_close callback re-took that same lock
+        (_WorkerDirectState._peer). Fixed in runtime.py; the spread
+        floor stays CPU-count-aware for boxes whose GIL-serialized
+        clients can't reach real concurrency (PR 2 test_scale
+        treatment)."""
         import http.client
         from concurrent.futures import ThreadPoolExecutor
 
@@ -495,7 +505,8 @@ class TestAsyncioProxy:
             results = list(pool.map(one, range(100)))
         assert all(code == 200 for code, _ in results)
         pids = {body["pid"] for _, body in results}
-        assert len(pids) >= 3, f"load not spread: {pids}"
+        spread_floor = 3 if (os.cpu_count() or 1) >= 4 else 2
+        assert len(pids) >= spread_floor, f"load not spread: {pids}"
         proxy = ray_tpu.get_actor("SERVE_PROXY")
         stats = ray_tpu.get(proxy.stats.remote(), timeout=30)
         assert stats["requests"] >= 100

@@ -1,0 +1,548 @@
+"""Sharded execution layer (ray_tpu/parallel/sharding/, ISSUE 11).
+
+Acceptance surface (docs/SHARDING.md):
+- tp=2 and tp=4 LLM decode on a forced host-device mesh produce token
+  streams identical to tp=1 for greedy decode, with the paged KV pool
+  genuinely block-sharded per chip (per-chip occupancy gauge + bytes).
+- fsdp pipeline training matches the replicated reference loss
+  trajectory bit-for-bit, with per-chip param/opt-state bytes ~1/fsdp.
+- SpecLayout/MeshOwner/lowering helpers behave (pruning, validation,
+  exact gather, shard-local update).
+
+All of it runs on the conftest 8-virtual-CPU-device mesh.
+"""
+import numpy as np
+import pytest
+
+import ray_tpu
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# SpecLayout — no devices needed
+# ---------------------------------------------------------------------------
+
+
+class TestSpecLayout:
+    def test_family_specs(self):
+        from ray_tpu.parallel.sharding import SpecLayout
+
+        lay = SpecLayout()
+        assert lay.embeddings() == P("tp", None)
+        assert lay.qkv_projection() == P(None, None, "tp")
+        assert lay.attn_output() == P(None, "tp", None)
+        assert lay.ffn_up() == P(None, None, "tp")
+        assert lay.ffn_down() == P(None, "tp", None)
+        assert lay.norm() == P()
+        assert lay.kv_cache_blocks() == P(None, "tp", None, None, None)
+        assert lay.flat_params() == P("fsdp")
+
+    def test_axis_rebinding(self):
+        from ray_tpu.parallel.sharding import SpecLayout
+
+        lay = SpecLayout(tp_axis="model")
+        assert lay.qkv_projection() == P(None, None, "model")
+        assert lay.spec_for_logical((None, "embed", "heads")) \
+            == P(None, None, "model")
+
+    def test_spec_for_logical_model_rows(self):
+        """The gpt/llama logical_axes tables map to tp on heads/mlp/
+        vocab and keep contraction dims (embed) whole."""
+        from ray_tpu.models import GPT, GPTConfig
+        from ray_tpu.parallel.sharding import SpecLayout
+
+        lay = SpecLayout()
+        specs = lay.param_specs(GPT(GPTConfig.tiny()))
+        assert specs["wte"] == P("tp")               # vocab rows
+        assert specs["w_qkv"] == P(None, None, "tp")  # output heads
+        assert specs["w_proj"] == P(None, "tp")       # input heads
+        assert specs["ln1_g"] == P()                  # replicated
+        assert specs["w_fc"] == P(None, None, "tp")   # ffn hidden
+
+    def test_prune_spec(self):
+        from ray_tpu.parallel.sharding import prune_spec
+
+        sizes = {"tp": 2}
+        assert prune_spec(P("fsdp", "tp"), sizes) == P(None, "tp")
+        assert prune_spec(P(("fsdp", "tp"), None), sizes) == P("tp")
+        assert prune_spec(P("fsdp"), sizes) == P()
+        # size-1 axes prune too (replication is cheaper to express)
+        assert prune_spec(P("tp"), {"tp": 1}) == P()
+
+
+# ---------------------------------------------------------------------------
+# MeshOwner
+# ---------------------------------------------------------------------------
+
+
+class TestMeshOwner:
+    def test_tp_mesh_and_describe(self):
+        from ray_tpu.parallel.sharding import MeshOwner
+
+        o = MeshOwner.tp_mesh(2)
+        assert o.axis_sizes == {"tp": 2}
+        assert o.num_devices == 2
+        d = o.describe()
+        assert d["devices"] == 2 and d["axes"] == {"tp": 2}
+
+    def test_too_many_devices_is_loud(self):
+        from ray_tpu.parallel.sharding import MeshOwner
+
+        with pytest.raises(ValueError, match="devices"):
+            MeshOwner.tp_mesh(999)
+        with pytest.raises(ValueError, match="devices"):
+            MeshOwner.fsdp_mesh(999)
+        with pytest.raises(ValueError, match="devices"):
+            MeshOwner({"tp": 999})
+
+    def test_partial_dict_spec(self):
+        from ray_tpu.parallel.sharding import MeshOwner
+
+        o = MeshOwner({"tp": 2, "dp": 2})
+        assert o.axis_size("tp") == 2 and o.axis_size("dp") == 2
+        assert o.num_devices == 4
+
+    def test_sharding_prunes_absent_axes(self):
+        from ray_tpu.parallel.sharding import MeshOwner
+
+        o = MeshOwner.tp_mesh(2)
+        sh = o.sharding(P("fsdp", "tp"))
+        assert sh.spec == P(None, "tp")
+        assert o.sharding(None).spec == P()
+
+    def test_place_and_per_device_bytes(self):
+        from ray_tpu.parallel.sharding import MeshOwner
+
+        o = MeshOwner.tp_mesh(2)
+        x = jnp.zeros((4, 8), jnp.float32)
+        placed = o.place({"x": x}, P(None, "tp"))
+        per = o.per_device_bytes(placed)
+        assert set(per) == {d.id for d in o.devices}
+        assert all(b == x.nbytes // 2 for b in per.values())
+
+    def test_validate_divisible(self):
+        from ray_tpu.parallel.sharding import MeshOwner
+
+        o = MeshOwner.tp_mesh(2)
+        o.validate_divisible("tp", 8, "heads")       # fine
+        o.validate_divisible("absent", 3, "heads")   # size-1: fine
+        with pytest.raises(ValueError, match="heads"):
+            o.validate_divisible("tp", 3, "heads")
+
+    def test_mesh_gauge(self):
+        from ray_tpu.parallel.sharding import MeshOwner
+        from ray_tpu.parallel.sharding.owner import _G_MESH
+
+        o = MeshOwner.tp_mesh(4, name="gauge-probe")
+        with _G_MESH._lock:
+            assert _G_MESH._values[("gauge-probe",)] == 4.0
+
+
+# ---------------------------------------------------------------------------
+# lowering helpers
+# ---------------------------------------------------------------------------
+
+
+class TestLowering:
+    def test_lower_jit_matches_unsharded(self):
+        from ray_tpu.parallel.sharding import MeshOwner, lower_jit
+
+        o = MeshOwner.tp_mesh(2)
+        w = jax.random.normal(jax.random.PRNGKey(0), (8, 16))
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 8))
+
+        def fn(w, x):
+            return jnp.tanh(x @ w)
+
+        lowered = lower_jit(fn, o, in_specs=(P(None, "tp"), P()),
+                            out_specs=P(None, "tp"))
+        got = lowered(o.place(w, P(None, "tp")), x)
+        want = fn(w, x)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        # the output really is column-sharded across the two chips
+        assert {s.data.shape for s in got.addressable_shards} == {(4, 8)}
+
+    def test_lower_shard_map_collective(self):
+        from ray_tpu.parallel.sharding import MeshOwner, lower_shard_map
+
+        o = MeshOwner.tp_mesh(4)
+
+        def body(x):
+            return jax.lax.psum(x, "tp")
+
+        prog = lower_shard_map(body, o, in_specs=(P("tp"),),
+                               out_specs=P("tp"),
+                               axis_names=frozenset({"tp"}))
+        x = jnp.arange(8, dtype=jnp.float32)
+        got = np.asarray(prog(o.place(x, P("tp"))))
+        # psum over 4 shards of 2: every shard sees the cross-shard sum
+        want = (x.reshape(4, 2).sum(0)[None, :]
+                * np.ones((4, 1))).reshape(8)
+        np.testing.assert_allclose(got, want)
+
+
+# ---------------------------------------------------------------------------
+# fsdp plane
+# ---------------------------------------------------------------------------
+
+
+def _tree(seed=0, n=33):
+    k = jax.random.PRNGKey(seed)
+    return {"w": jax.random.normal(k, (16, n)),
+            "b": jnp.zeros((n,))}
+
+
+class TestFsdpPlane:
+    def test_shard_gather_roundtrip_bitwise(self):
+        import optax
+
+        from ray_tpu.parallel.sharding import FsdpPlane, MeshOwner
+
+        plane = FsdpPlane(MeshOwner.fsdp_mesh(2), optax.adam(1e-3))
+        tree = _tree()                # 16*33+33 = 561, odd => padded
+        fp = plane.shard(tree)
+        assert fp.pad == 1
+        back = plane.gather(fp)
+        for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(back)):
+            assert (np.asarray(a) == np.asarray(b)).all()
+        # persistent residence is split evenly
+        per = fp.nbytes_per_device()
+        assert len(per) == 2
+        assert len(set(per.values())) == 1
+
+    def test_update_bitwise_vs_replicated(self):
+        import optax
+
+        from ray_tpu.parallel.sharding import FsdpPlane, MeshOwner
+        from ray_tpu.parallel.zero import flatten_tree
+
+        tx = optax.adam(1e-3)
+        for world in (2, 4):
+            plane = FsdpPlane(MeshOwner.fsdp_mesh(world), tx)
+            tree = _tree()
+            fp = plane.shard(tree)
+            opt = plane.init_opt(fp)
+            ref_p = tree
+            ref_opt = jax.jit(tx.init)(ref_p)
+
+            @jax.jit
+            def ref_upd(g, o, p):
+                import optax as _o
+
+                u, no = tx.update(g, o, p)
+                return _o.apply_updates(p, u), no
+
+            for i in range(3):
+                g = jax.tree.map(
+                    lambda l, i=i: jax.random.normal(
+                        jax.random.PRNGKey(100 + i), l.shape), tree)
+                fp, opt = plane.update(fp, g, opt)
+                ref_p, ref_opt = ref_upd(g, ref_opt, ref_p)
+                got = plane.gather(fp)
+                for a, b in zip(jax.tree.leaves(got),
+                                jax.tree.leaves(ref_p)):
+                    assert (np.asarray(a) == np.asarray(b)).all(), \
+                        f"world={world} step={i} diverged"
+            # per-chip param+moment bytes ~ 1/world of the total
+            flat, _ = flatten_tree(tree)
+            per = plane.per_device_bytes(fp, opt)
+            assert len(per) == world
+            total = sum(per.values())
+            assert max(per.values()) <= total / world + 64
+
+    def test_host_roundtrip_resumes_bitwise(self):
+        import optax
+
+        from ray_tpu.parallel.sharding import FsdpPlane, MeshOwner
+
+        tx = optax.adam(1e-3)
+        plane = FsdpPlane(MeshOwner.fsdp_mesh(2), tx)
+        fp = plane.shard(_tree())
+        opt = plane.init_opt(fp)
+        g = jax.tree.map(lambda l: jnp.ones_like(l), _tree())
+        fp, opt = plane.update(fp, g, opt)
+        params_h, opt_h = plane.to_host(fp, opt)
+        fp2, opt2 = plane.from_host(params_h, opt_h)
+        a, _ = plane.update(fp, g, opt)
+        b, _ = plane.update(fp2, g, opt2)
+        for la, lb in zip(jax.tree.leaves(plane.gather(a)),
+                          jax.tree.leaves(plane.gather(b))):
+            assert (np.asarray(la) == np.asarray(lb)).all()
+
+    def test_world_one_rejected(self):
+        import optax
+
+        from ray_tpu.parallel.sharding import FsdpPlane, MeshOwner
+
+        with pytest.raises(ValueError, match="fsdp"):
+            FsdpPlane(MeshOwner.tp_mesh(2), optax.adam(1e-3))
+
+
+# ---------------------------------------------------------------------------
+# sharded BlockPool — pure host accounting
+# ---------------------------------------------------------------------------
+
+
+class TestShardedBlockPool:
+    def test_divisibility_enforced(self):
+        from ray_tpu.serve.llm import BlockPool
+
+        with pytest.raises(ValueError, match="divisible"):
+            BlockPool(10, shards=4)
+
+    def test_balanced_alloc_and_per_shard(self):
+        from ray_tpu.serve.llm import BlockPool
+
+        p = BlockPool(16, shards=4)
+        got = p.alloc(8)
+        assert p.used_per_shard() == [2, 2, 2, 2]
+        assert {p.shard_of(b) for b in got} == {0, 1, 2, 3}
+        p.free(got[:4])
+        assert sum(p.used_per_shard()) == p.used_count == 4
+        # refill balances again
+        p.alloc(4)
+        assert max(p.used_per_shard()) - min(p.used_per_shard()) <= 1
+        p.check_leaks()
+
+    def test_unsharded_pool_unchanged(self):
+        from ray_tpu.serve.llm import BlockPool
+
+        p = BlockPool(8)
+        assert p.alloc(3) == [0, 1, 2]
+        assert p.used_per_shard() == [3]
+        p.free([1])
+        p.check_leaks()
+
+
+# ---------------------------------------------------------------------------
+# serve tp: the LLM engine under the mesh
+# ---------------------------------------------------------------------------
+
+
+def _engine(model_name, tp, name):
+    from ray_tpu.serve.llm import EngineConfig, LLMEngine, build_model
+
+    m, params = build_model(model_name)
+    return LLMEngine(m, params, EngineConfig(
+        max_batch=4, num_blocks=32, block_size=8, max_blocks_per_seq=4,
+        prefill_buckets=(8, 16), tp=tp), name=name)
+
+
+PROMPTS = [[1, 5, 9, 2], [3, 4], [7, 8, 9, 10, 11, 12], [2, 9]]
+
+
+class TestEngineTp:
+    @pytest.mark.parametrize("model_name", ["gpt-tiny", "llama-tiny"])
+    def test_tp_token_identical_to_tp1(self, model_name):
+        """tp=2 and tp=4 greedy decode == tp=1, for the GPT family and
+        the GQA llama family (n_kv_head=2 < tp=4: GSPMD pads)."""
+        outs = {}
+        for tp in (1, 2, 4):
+            eng = _engine(model_name, tp, f"t-{model_name}-tp{tp}")
+            streams = [eng.add_request(p, max_tokens=8) for p in PROMPTS]
+            eng.run_until_idle(timeout=600)
+            outs[tp] = [s.tokens() for s in streams]
+            eng.pool.check_leaks()
+        assert outs[2] == outs[1]
+        assert outs[4] == outs[1]
+
+    def test_kv_blocks_sharded_per_chip(self):
+        """The pool really is block-sharded: per-chip cache bytes are
+        total/tp, the {chip=} gauge matches the pool accounting, and
+        allocation stays balanced while sequences run."""
+        from ray_tpu.serve.llm.engine import _G_BLOCKS
+
+        eng = _engine("gpt-tiny", 2, "t-chips")
+        streams = [eng.add_request(p, max_tokens=4) for p in PROMPTS]
+        # drive one step so sequences are resident, then inspect
+        while not eng._running:
+            eng.step()
+        per_chip = eng.pool.used_per_shard()
+        assert sum(per_chip) == eng.pool.used_count > 0
+        assert max(per_chip) - min(per_chip) <= 1
+        with _G_BLOCKS._lock:
+            for chip, used in enumerate(per_chip):
+                assert _G_BLOCKS._values[("t-chips", str(chip))] == used
+        byts = eng.kv_bytes_per_chip()
+        assert len(byts) == 2
+        assert len(set(byts.values())) == 1  # exactly total/tp each
+        st = eng.stats()
+        assert st["tp"] == 2
+        assert st["kv_blocks_per_chip"] == per_chip
+        eng.run_until_idle(timeout=600)
+        for s in streams:
+            s.tokens()
+        eng.pool.check_leaks()
+
+    def test_tp_preemption_token_equivalent(self):
+        """Preempt-and-requeue under tp: a pool too small for both
+        sequences forces preemption; greedy re-prefill still reproduces
+        the unpreempted tokens (the tp=1 engine with a roomy pool)."""
+        from ray_tpu.serve.llm import EngineConfig, LLMEngine, build_model
+
+        m, params = build_model("gpt-tiny")
+        roomy = LLMEngine(m, params, EngineConfig(
+            max_batch=2, num_blocks=32, block_size=4,
+            max_blocks_per_seq=8, prefill_buckets=(8,)), name="t-roomy")
+        tight = LLMEngine(m, params, EngineConfig(
+            max_batch=2, num_blocks=6, block_size=4,
+            max_blocks_per_seq=8, prefill_buckets=(8, 16), tp=2),
+            name="t-tight")
+        prompts = [[1, 5, 9, 2, 7], [3, 4, 6, 8]]
+        want = []
+        for p in prompts:
+            s = roomy.add_request(p, max_tokens=10)
+            roomy.run_until_idle(timeout=600)
+            want.append(s.tokens())
+        streams = [tight.add_request(p, max_tokens=10) for p in prompts]
+        tight.run_until_idle(timeout=600)
+        got = [s.tokens() for s in streams]
+        assert got == want
+        assert tight._total_preemptions >= 1
+        tight.pool.check_leaks()
+
+    def test_num_blocks_must_tile_tp(self):
+        from ray_tpu.serve.llm import EngineConfig, LLMEngine, build_model
+
+        m, params = build_model("gpt-tiny")
+        with pytest.raises(ValueError, match="divisible"):
+            LLMEngine(m, params, EngineConfig(
+                num_blocks=30, tp=4), name="t-bad")
+
+
+# ---------------------------------------------------------------------------
+# train fsdp: the pipeline engine on the plane
+# ---------------------------------------------------------------------------
+
+
+def _mlp_chunks(num_chunks, width=8, seed=0):
+    k = jax.random.PRNGKey(seed)
+
+    def mk_mid():
+        def fn(p, x):
+            return jnp.tanh(x @ p["w"] + p["b"])
+        return fn
+
+    def mk_last():
+        def fn(p, x, targets):
+            return jnp.mean((x @ p["w"] + p["b"] - targets) ** 2)
+        return fn
+
+    fns = [mk_mid() for _ in range(num_chunks - 1)] + [mk_last()]
+    params = [
+        {"w": jax.random.normal(jax.random.fold_in(k, i),
+                                (width, width)) * 0.3,
+         "b": jnp.zeros((width,))}
+        for i in range(num_chunks)]
+    return fns, params
+
+
+def _mlp_batches(M, width=8, mb_size=2, seed=7):
+    k = jax.random.PRNGKey(seed)
+    xs = jax.random.normal(jax.random.fold_in(k, 0), (M * mb_size, width))
+    ys = jax.random.normal(jax.random.fold_in(k, 1), (M * mb_size, width))
+    return ([xs[i * mb_size:(i + 1) * mb_size] for i in range(M)],
+            [ys[i * mb_size:(i + 1) * mb_size] for i in range(M)])
+
+
+class TestPipelineFsdp:
+    def test_fsdp_matches_reference_bit_for_bit(self, ray_start_regular):
+        """fsdp=2 2-stage pipeline: 3-step loss trajectory AND final
+        params equal the replicated single-process reference exactly;
+        per-chip param+opt bytes are ~1/fsdp of the stage total."""
+        import optax
+
+        from ray_tpu.train.pipeline_cgraph import (CompiledPipelineEngine,
+                                                   run_reference_1f1b)
+
+        fns, params = _mlp_chunks(2)
+        mbs, tgts = _mlp_batches(4)
+        tx = optax.adam(1e-2)
+        eng = CompiledPipelineEngine(fns, params, tx, num_microbatches=4,
+                                     fsdp=2, channel_bytes=1 << 18)
+        try:
+            losses = [eng.step(mbs, tgts) for _ in range(3)]
+            new_params = eng.get_params()
+            reports = list(eng.last_reports)
+        finally:
+            eng.shutdown()
+        ref_losses, ref_params = run_reference_1f1b(
+            fns, params, tx, [(mbs, tgts)] * 3)
+        assert losses == ref_losses
+        for a, b in zip(jax.tree.leaves(new_params),
+                        jax.tree.leaves(ref_params)):
+            assert (np.asarray(a) == np.asarray(b)).all()
+        for r in reports:
+            assert r["fsdp"] == 2
+            per = list(r["fsdp_bytes_per_chip"].values())
+            assert len(per) == 2
+            total = sum(per)
+            # even split (pad slack only)
+            assert max(per) <= total / 2 + 64
+
+    def test_fsdp_composes_with_dp(self, ray_start_regular):
+        """dp=2 x fsdp=2 (4 stage actors for one stage): host grad sync
+        + shard-local update still matches the reference bitwise."""
+        import optax
+
+        from ray_tpu.train.pipeline_cgraph import (CompiledPipelineEngine,
+                                                   run_reference_1f1b)
+
+        fns, params = _mlp_chunks(1)
+        mbs, tgts = _mlp_batches(2)
+        tx = optax.adam(1e-2)
+        eng = CompiledPipelineEngine(fns, params, tx, num_microbatches=2,
+                                     dp=2, fsdp=2,
+                                     channel_bytes=1 << 18)
+        try:
+            # both replicas consume the same microbatches: the dp-mean
+            # equals the single-replica gradient, so the reference
+            # trajectory is unchanged
+            losses = [eng.step(mbs + mbs, tgts + tgts) for _ in range(2)]
+        finally:
+            eng.shutdown()
+        ref_losses, _ = run_reference_1f1b(fns, params, tx,
+                                           [(mbs, tgts)] * 2)
+        assert losses == ref_losses
+
+    def test_fsdp_checkpoint_restore_bitwise(self, ray_start_regular,
+                                             tmp_path):
+        """Save under fsdp=2, restore into a fresh fsdp=2 engine: the
+        continued trajectory equals the uninterrupted run bitwise; a
+        mismatched fsdp geometry is rejected."""
+        import optax
+
+        from ray_tpu.train.pipeline_cgraph import CompiledPipelineEngine
+
+        fns, params = _mlp_chunks(2)
+        mbs, tgts = _mlp_batches(4)
+        tx = optax.adam(1e-2)
+        ckdir = str(tmp_path / "ck")
+        eng = CompiledPipelineEngine(fns, params, tx, num_microbatches=4,
+                                     fsdp=2, channel_bytes=1 << 18,
+                                     checkpoint_dir=ckdir)
+        try:
+            eng.step(mbs, tgts)
+            eng.step(mbs, tgts)
+            path = eng.save_checkpoint(blocking=True)
+            cont = [eng.step(mbs, tgts) for _ in range(2)]
+        finally:
+            eng.shutdown()
+        eng2 = CompiledPipelineEngine(fns, params, tx, num_microbatches=4,
+                                      fsdp=2, channel_bytes=1 << 18)
+        try:
+            assert eng2.restore(path) == 2
+            resumed = [eng2.step(mbs, tgts) for _ in range(2)]
+        finally:
+            eng2.shutdown()
+        assert resumed == cont
+        bad = CompiledPipelineEngine(fns, params, tx, num_microbatches=4,
+                                     channel_bytes=1 << 18)
+        try:
+            with pytest.raises(ValueError, match="fsdp"):
+                bad.restore(path)
+        finally:
+            bad.shutdown()
