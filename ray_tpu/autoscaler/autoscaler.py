@@ -87,8 +87,12 @@ class StandardAutoscaler:
             if not node.alive:
                 continue
             with node._lock:
-                for req in list(node._lease_queue):
-                    demands.append(dict(req.demand))
+                # _lease_queue is bucketed by (demand, pg, env) signature
+                # since the dispatch rework — walk the buckets, not the
+                # keys (iterating the dict yields signature tuples)
+                for bucket in node._lease_queue.values():
+                    for req in bucket:
+                        demands.append(dict(req.demand))
         for pg in rt.gcs.list_pgs():
             if pg.state == "PENDING":
                 demands.extend(normalize(b) for b in pg.bundles)
@@ -99,11 +103,13 @@ class StandardAutoscaler:
     def _unmet_after_packing(self, demands: List[ResourceSet]) -> int:
         """Greedy first-fit of demands onto current availability; returns
         how many demands no node can absorb (ref:
-        resource_demand_scheduler.py bin packing)."""
+        resource_demand_scheduler.py bin packing). Draining
+        (preemption-noticed) nodes are NOT supply: their capacity is
+        already promised to the axe, so replacements launch now."""
         rt = self.runtime
         avail = []
         for node in rt.nodes.values():
-            if node.alive:
+            if node.alive and not getattr(node, "draining", False):
                 with node._lock:
                     avail.append(dict(node.available))
         unmet = 0
@@ -116,18 +122,58 @@ class StandardAutoscaler:
                 unmet += 1
         return unmet
 
+    # -- preemption notices ----------------------------------------------------
+
+    def _is_draining(self, node_id: NodeId) -> bool:
+        node = self.runtime.nodes.get(node_id)
+        return node is not None and getattr(node, "draining", False)
+
+    def _deliver_preemptions(self) -> int:
+        """Pull the provider's preemption notices and turn each into the
+        runtime's drain path: ``NODE_PREEMPTING`` GCS event (workloads
+        subscribe), scheduler drain filter, serve-replica draining, and
+        the agent's clean-exit backstop. Returns notices delivered."""
+        try:
+            notices = self.provider.poll_preemptions()
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+            return 0
+        delivered = 0
+        for node_id, grace_s in notices:
+            try:
+                self.runtime.on_preemption_notice(
+                    node_id, grace_s, reason="provider preemption notice")
+                delivered += 1
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+        return delivered
+
     # -- one reconcile pass ----------------------------------------------------
 
     def update(self) -> dict:
         cfg = self.config
+        # preemption notices first: a noticed node must stop being
+        # supply BEFORE this pass packs demand, so the replacement
+        # launches in the same tick the notice arrives
+        preempting = self._deliver_preemptions()
         provider_nodes = set(self.provider.non_terminated_nodes())
+        active_nodes = {nid for nid in provider_nodes
+                        if not self._is_draining(nid)}
         demands = self._pending_demands()
         unmet = self._unmet_after_packing(demands)
 
         launched = 0
         per_node = self.provider.node_resources()
+        # the cap counts only non-draining nodes: a preemption-noticed
+        # node is leaving anyway, and its replacement must launch NOW
+        # (brief real-node overlap during the grace window is the whole
+        # point of drain-before-the-axe)
         while (unmet > 0 and launched < cfg.max_launch_batch
-               and len(provider_nodes) + launched < cfg.max_workers):
+               and len(active_nodes) + launched < cfg.max_workers):
             # each new node absorbs however many unmet demands fit on it
             cap = dict(per_node)
             absorbed = 0
@@ -140,7 +186,10 @@ class StandardAutoscaler:
             self.provider.create_node()
             launched += 1
             unmet = max(0, unmet - absorbed)
-        while len(provider_nodes) + launched < cfg.min_workers:
+        # min_workers floor counts only non-draining nodes: a noticed
+        # node is already promised to the axe, so its replacement
+        # launches without waiting for it to actually die
+        while len(active_nodes) + launched < cfg.min_workers:
             self.provider.create_node()
             launched += 1
 
@@ -148,26 +197,55 @@ class StandardAutoscaler:
         # queue for idle_timeout_s gets terminated (never below min_workers)
         now = time.monotonic()
         terminated = []
+        idle_terminated = 0  # non-draining reclaims only (floor math)
         provider_nodes = set(self.provider.non_terminated_nodes())
         for nid in list(provider_nodes):
             node = self.runtime.nodes.get(nid)
             if node is None or not node.alive:
                 self._last_busy.pop(nid, None)
+                if node is not None and not node.alive:
+                    # the node died out from under the provider (the
+                    # axe beat the drain, a crash): terminate anyway so
+                    # the provider prunes its ledger — a TPU slice host
+                    # occupied by a corpse can never relaunch otherwise
+                    try:
+                        self.provider.terminate_node(nid)
+                        terminated.append(nid)
+                    except Exception:
+                        pass
                 continue
             with node._lock:
                 busy = (bool(node._lease_queue)
                         or any(w.state in ("leased", "actor")
                                for w in node._workers.values()))
+            if getattr(node, "draining", False):
+                # shrink-before-the-axe: the moment a noticed node has
+                # no busy workers left, terminate it CLEANLY — don't
+                # gift the platform a SIGKILL target. No idle_timeout,
+                # no min_workers guard (the node is doomed either way).
+                if not busy:
+                    self.provider.terminate_node(nid)
+                    terminated.append(nid)
+                    self._last_busy.pop(nid, None)
+                continue
             if busy:
                 self._last_busy[nid] = now
                 continue
+            # drained terminations never counted toward the active sum,
+            # so only idle reclaims of ACTIVE nodes deplete the floor
+            active_left = sum(1 for n in provider_nodes
+                              if not self._is_draining(n)) - idle_terminated
             if now - self._last_busy.setdefault(nid, now) \
                     > cfg.idle_timeout_s \
-                    and len(provider_nodes) - len(terminated) \
-                    > cfg.min_workers:
+                    and active_left > cfg.min_workers:
                 self.provider.terminate_node(nid)
                 terminated.append(nid)
+                idle_terminated += 1
                 self._last_busy.pop(nid, None)
         return {"pending_demands": len(demands), "unmet": unmet,
                 "launched": launched, "terminated": len(terminated),
+                "notices_delivered": preempting,
+                "preempting": sum(
+                    1 for nid in self.provider.non_terminated_nodes()
+                    if self._is_draining(nid)),
                 "provider_nodes": len(self.provider.non_terminated_nodes())}

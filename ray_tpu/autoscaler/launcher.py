@@ -48,6 +48,8 @@ import sys
 import time
 from typing import Dict, List, Optional
 
+from ..util.retry import RetryPolicy, call_with_retry
+
 STATE_DIR = os.path.expanduser("~/.ray_tpu/clusters")
 
 
@@ -103,6 +105,14 @@ class SSHCommandRunner(CommandRunner):
     SSHCommandRunner; BatchMode so a missing key fails fast instead of
     prompting)."""
 
+    # transport-level retries (util/retry.py, the GC012-clean shape):
+    # ssh exits 255 when the CONNECTION failed — the remote command never
+    # ran, so retrying is safe; scp is idempotent (full re-copy). Nodes
+    # routinely answer a beat after boot, so a couple of backed-off
+    # attempts is the difference between `up` working first try and not.
+    _TRANSPORT_RETRY = RetryPolicy(initial_backoff_s=0.5, multiplier=2.0,
+                                   max_backoff_s=4.0, max_attempts=4)
+
     def __init__(self, host: str, user: str = "", key: str = ""):
         self.host = host
         self.user = user
@@ -125,23 +135,55 @@ class SSHCommandRunner(CommandRunner):
                   f">{log} 2>&1 &") if background else f"{envs} {cmd}"
         return subprocess.Popen(self._ssh_base() + [remote])
 
+    class _SSHConnectError(RuntimeError):
+        """ssh rc=255 with client-side transport diagnostics: the
+        connection failed, the remote command never ran — the only
+        failure class check() retries."""
+
+    @staticmethod
+    def _is_transport_error(stderr: str) -> bool:
+        s = (stderr or "").lower()
+        return any(m in s for m in (
+            "ssh:", "connection refused", "connection timed out",
+            "connection reset", "connection closed",
+            "no route to host", "could not resolve",
+            "operation timed out", "kex_exchange", "broken pipe"))
+
     def check(self, cmd, env=None, timeout=120.0):
         envs = " ".join(f"{k}={shlex.quote(v)}" for k, v in (env or {}).items())
-        out = subprocess.run(self._ssh_base() + [f"{envs} {cmd}"],
-                             timeout=timeout, capture_output=True, text=True)
-        if out.returncode != 0:
-            raise RuntimeError(
-                f"ssh {self.host} failed rc={out.returncode}: {cmd}\n"
-                f"{out.stderr}")
-        return out.stdout
+
+        def _once():
+            out = subprocess.run(self._ssh_base() + [f"{envs} {cmd}"],
+                                 timeout=timeout, capture_output=True,
+                                 text=True)
+            if out.returncode == 255 and self._is_transport_error(
+                    out.stderr):
+                # rc=255 ALONE is ambiguous (a remote command may itself
+                # exit 255); only the ssh client's own transport
+                # diagnostics make a retry safe — the command never ran
+                raise self._SSHConnectError(
+                    f"ssh {self.host} unreachable: {out.stderr}")
+            if out.returncode != 0:
+                raise RuntimeError(
+                    f"ssh {self.host} failed rc={out.returncode}: {cmd}\n"
+                    f"{out.stderr}")
+            return out.stdout
+
+        return call_with_retry(_once, policy=self._TRANSPORT_RETRY,
+                               retry_on=(self._SSHConnectError,),
+                               description=f"ssh {self.host}")
 
     def put(self, local, remote):
         target = f"{self.user}@{self.host}" if self.user else self.host
         scp = ["scp", "-o", "BatchMode=yes", "-r"]
         if self.key:
             scp += ["-i", os.path.expanduser(self.key)]
-        subprocess.run(scp + [local, f"{target}:{remote}"], check=True,
-                       timeout=300)
+        call_with_retry(
+            lambda: subprocess.run(scp + [local, f"{target}:{remote}"],
+                                   check=True, timeout=300),
+            policy=self._TRANSPORT_RETRY,
+            retry_on=(subprocess.CalledProcessError,),
+            description=f"scp {local} -> {self.host}")
 
 
 # ---------------------------------------------------------------------------
@@ -291,16 +333,25 @@ def cluster_up(config_path: str, wait_workers_s: float = 60.0) -> dict:
     return state
 
 
+# bring-up polls (util/retry.py): fixed-cadence attempts under a hard
+# deadline — the launcher's old hand-rolled while/sleep loops, now on
+# the shared policy so GC012 has one shape to bless
+_PORT_WAIT = RetryPolicy(initial_backoff_s=0.2, multiplier=1.0,
+                         max_backoff_s=0.2, jitter=0.0)
+_WORKER_WAIT = RetryPolicy(initial_backoff_s=0.5, multiplier=1.0,
+                           max_backoff_s=0.5, jitter=0.0)
+
+
 def _wait_port(host: str, port: int, timeout: float) -> None:
     import socket
 
     deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
+    for _attempt in _PORT_WAIT.sleeps(deadline=deadline):
         try:
             with socket.create_connection((host, port), timeout=1):
                 return
         except OSError:
-            time.sleep(0.2)
+            continue
     raise TimeoutError(f"head {host}:{port} did not come up in {timeout}s")
 
 
@@ -308,13 +359,12 @@ def _wait_workers(address: str, authkey: str, count: int,
                   timeout: float) -> None:
     """Poll the head's node table until all workers joined."""
     deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
+    for _attempt in _WORKER_WAIT.sleeps(deadline=deadline):
         try:
             if len(_alive_nodes(address, authkey)) >= count + 1:
                 return
         except Exception:
-            pass
-        time.sleep(0.5)
+            continue
     raise TimeoutError(f"{count} workers did not join within {timeout}s")
 
 

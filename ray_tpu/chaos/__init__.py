@@ -26,6 +26,12 @@ separated by ``;``::
     channel_poison=0.001:c0->c1   poison matching cgraph channels
     kill=actor:trainer@5.0        kill the named actor 5s after enable
     kill=worker@7.5               kill a seeded-random live worker at 7.5s
+    preempt=node:ab12@5+2.0       scheduled preemption of the node whose
+                                  id starts ab12: NOTICE at t=5 (the
+                                  NODE_PREEMPTING drain path runs), then
+                                  SIGKILL of its agent at t=5+2.0 —
+                                  scale-down rehearsals, seeded and
+                                  replayable like every other fault
 
 Only ONEWAY frames are droppable/duplicable: dropping a request or
 response frame models a hang the channel layer has no retransmit for
@@ -55,7 +61,7 @@ from typing import Any, Callable, Dict, List, Optional, Union
 from ..util import metrics as _metrics
 
 __all__ = [
-    "ChaosRule", "KillSpec", "ChaosPlan", "ChaosEngine",
+    "ChaosRule", "KillSpec", "PreemptSpec", "ChaosPlan", "ChaosEngine",
     "enable", "disable", "is_enabled", "engine",
     "plan_from_env", "maybe_enable_from_env", "ENV_VAR",
 ]
@@ -91,16 +97,32 @@ class KillSpec:
 
 
 @dataclass(frozen=True)
+class PreemptSpec:
+    """Scheduled node preemption: notice at ``at_s`` (the runtime's
+    ``NODE_PREEMPTING`` drain path runs — scheduler drain filter, serve
+    replica draining, pipeline shrink-before-the-axe), SIGKILL of the
+    node's agent process at ``at_s + grace_s`` whether or not anyone
+    drained. Target: "node:<hex-prefix>" or "node" (seeded random
+    remote node)."""
+
+    at_s: float
+    grace_s: float = 5.0
+    target: str = "node"
+
+
+@dataclass(frozen=True)
 class ChaosPlan:
     seed: int = 0
     rules: tuple = ()
     kills: tuple = ()
+    preempts: tuple = ()
 
     @classmethod
     def parse(cls, spec: str) -> "ChaosPlan":
         seed = 0
         rules: List[ChaosRule] = []
         kills: List[KillSpec] = []
+        preempts: List[PreemptSpec] = []
         for raw in spec.split(";"):
             entry = raw.strip()
             if not entry:
@@ -114,6 +136,14 @@ class ChaosPlan:
                 target, _, at = value.partition("@")
                 kills.append(KillSpec(at_s=float(at or 0.0),
                                       target=target))
+            elif key == "preempt":
+                # preempt=node:<id>@t+grace — notice at t, axe at t+grace
+                target, _, timing = value.partition("@")
+                at_s, _, grace = timing.partition("+")
+                preempts.append(PreemptSpec(
+                    at_s=float(at_s or 0.0),
+                    grace_s=float(grace) if grace else 5.0,
+                    target=target or "node"))
             elif key in _RULE_KINDS:
                 body, _, match = value.partition(":")
                 prob_s, _, param_s = body.partition("@")
@@ -124,8 +154,9 @@ class ChaosPlan:
             else:
                 raise ValueError(
                     f"unknown chaos spec entry {entry!r} (known: seed, "
-                    f"kill, {', '.join(_RULE_KINDS)})")
-        return cls(seed=seed, rules=tuple(rules), kills=tuple(kills))
+                    f"kill, preempt, {', '.join(_RULE_KINDS)})")
+        return cls(seed=seed, rules=tuple(rules), kills=tuple(kills),
+                   preempts=tuple(preempts))
 
 
 class ChaosEngine:
@@ -145,6 +176,7 @@ class ChaosEngine:
             self._rngs[r] = random.Random(f"{plan.seed}/{i}/{r.kind}")
             self._rng_locks[r] = threading.Lock()
         self._kill_rng = random.Random(f"{plan.seed}/kill")
+        self._preempt_victims: Dict[PreemptSpec, Any] = {}
         self.injected: Dict[str, int] = {}
         self._inj_lock = threading.Lock()
         self._stop = threading.Event()
@@ -225,7 +257,8 @@ class ChaosEngine:
     # -- kill schedule -----------------------------------------------------
 
     def start_kills(self, runtime) -> None:
-        if not self.plan.kills or self._kill_thread is not None:
+        if (not self.plan.kills and not self.plan.preempts) \
+                or self._kill_thread is not None:
             return
         self._kill_thread = threading.Thread(
             target=self._kill_loop, args=(runtime,), daemon=True,
@@ -233,15 +266,28 @@ class ChaosEngine:
         self._kill_thread.start()
 
     def _kill_loop(self, runtime) -> None:
-        for spec in sorted(self.plan.kills, key=lambda k: k.at_s):
-            wait = self.t0 + spec.at_s - time.monotonic()
+        # one merged timeline: kills fire once; each preempt expands to
+        # a NOTICE event at t and an AXE event at t+grace — the axe
+        # falls whether or not anything drained (spot semantics)
+        events = [(spec.at_s, "kill", spec) for spec in self.plan.kills]
+        for spec in self.plan.preempts:
+            events.append((spec.at_s, "preempt_notice", spec))
+            events.append((spec.at_s + spec.grace_s, "preempt_kill",
+                           spec))
+        for at_s, kind, spec in sorted(events, key=lambda e: e[0]):
+            wait = self.t0 + at_s - time.monotonic()
             if wait > 0 and self._stop.wait(wait):
                 return
             if self._stop.is_set():
                 return
             try:
-                self._execute_kill(runtime, spec)
-                self.record("kill")
+                if kind == "kill":
+                    self._execute_kill(runtime, spec)
+                elif kind == "preempt_notice":
+                    self._execute_preempt_notice(runtime, spec)
+                else:
+                    self._execute_preempt_kill(runtime, spec)
+                self.record(kind)
             except Exception:
                 import traceback
 
@@ -304,6 +350,49 @@ class ChaosEngine:
         cands.sort(key=lambda p: p.pid)
         victim = cands[self._kill_rng.randrange(len(cands))]
         os.kill(victim.pid, signal.SIGKILL)
+
+    # -- preempt schedule (notice at t, SIGKILL at t+grace) ----------------
+
+    def _resolve_preempt_node(self, runtime, spec: PreemptSpec):
+        kind, _, sel = spec.target.partition(":")
+        if kind != "node":
+            raise ValueError(
+                f"preempt target must be node[:<hex-prefix>], got "
+                f"{spec.target!r}")
+        cands = sorted(
+            (node for node in getattr(runtime, "nodes", {}).values()
+             if node.alive and getattr(node, "is_remote", False)
+             and (not sel or node.node_id.hex().startswith(sel))),
+            key=lambda n: n.node_id.hex())
+        if not cands:
+            raise ValueError(
+                f"chaos preempt: no live remote node matches {sel!r}")
+        if sel:
+            return cands[0]
+        return cands[self._kill_rng.randrange(len(cands))]
+
+    def _execute_preempt_notice(self, runtime, spec: PreemptSpec) -> None:
+        node = self._resolve_preempt_node(runtime, spec)
+        # remember the victim so the axe hits the SAME node the notice
+        # named even if other nodes joined/left in the grace window
+        self._preempt_victims[spec] = node.node_id
+        runtime.on_preemption_notice(node.node_id, spec.grace_s,
+                                     reason="chaos preempt schedule")
+
+    def _execute_preempt_kill(self, runtime, spec: PreemptSpec) -> None:
+        import signal
+
+        node_id = self._preempt_victims.pop(spec, None)
+        node = runtime.nodes.get(node_id) if node_id is not None else None
+        if node is None or not node.alive:
+            return  # drained and exited before the axe: nothing to kill
+        proc = getattr(node, "_agent_proc", None)
+        if proc is not None and proc.poll() is None:
+            os.kill(proc.pid, signal.SIGKILL)
+        else:
+            # no local process handle (agent launched elsewhere): model
+            # the platform kill head-side — channel loss semantics
+            runtime.on_remote_node_lost(node_id)
 
     def stop(self) -> None:
         self._stop.set()

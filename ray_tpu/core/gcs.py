@@ -83,6 +83,12 @@ class NodeInfo:
     labels: Dict[str, str] = field(default_factory=dict)
     alive: bool = True
     last_heartbeat: float = field(default_factory=time.monotonic)
+    # planned capacity loss (docs/FAULT_TOLERANCE.md "Elasticity"): a
+    # preemption notice arrived — the node is still ALIVE and serving,
+    # but the scheduler stops placing new work on it and workloads that
+    # subscribed to the "node" channel drain/resize before the axe
+    draining: bool = False
+    preempt_deadline: float = 0.0  # monotonic; 0 = no notice
 
 
 @dataclass
@@ -245,6 +251,24 @@ class Gcs:
         with self._lock:
             self._nodes[info.node_id] = info
         self.pubsub.publish("node", ("ALIVE", info.node_id))
+
+    def mark_node_preempting(self, node_id: NodeId, grace_s: float,
+                             reason: str = "") -> None:
+        """Planned-capacity node event, DISTINCT from fencing: the node
+        is still alive for ``grace_s`` more seconds. Publishes
+        ``("PREEMPTING", node_id, grace_s)`` on the "node" channel so
+        live workloads (pipeline engines, the serve control loop) can
+        drain/hand off/resize before the kill lands. Idempotent per
+        notice window."""
+        with self._lock:
+            info = self._nodes.get(node_id)
+            if info is None or not info.alive:
+                return
+            if info.draining:
+                return  # one notice per axe; re-deliveries are no-ops
+            info.draining = True
+            info.preempt_deadline = time.monotonic() + max(0.0, grace_s)
+        self.pubsub.publish("node", ("PREEMPTING", node_id, grace_s))
 
     def mark_node_dead(self, node_id: NodeId, reason: str = "") -> None:
         with self._lock:

@@ -115,6 +115,7 @@ class Node:
         self._bundles: Dict[tuple, _Bundle] = {}  # (pg_id, idx) -> bundle
         self._starting_count = 0
         self.alive = True
+        self.draining = False  # preemption-noticed: no NEW work lands here
         self._sock_path = os.path.join(session_dir, f"node_{node_id.hex()[:12]}.sock")
         self._server = RpcServer(self._sock_path, self._make_handler,
                                  num_handler_threads=int(
@@ -193,6 +194,38 @@ class Node:
             self._lease_queue.setdefault(sig, deque()).append(req)
         self._dispatch()
         return fut
+
+    def steal_queued_leases(self, everything: bool = False) -> list:
+        """Remove and return queued (not yet granted) NON-placement-group
+        lease requests so the runtime can re-route them — the spillback
+        half of elastic capacity (docs/FAULT_TOLERANCE.md "Elasticity").
+
+        Default: steal only buckets this node cannot grant from its
+        CURRENT availability (a request parked behind a full node, which
+        a freshly joined node could serve right now). ``everything``
+        steals every queued non-PG request — the draining path, where
+        this node must not start new work at all. PG-bundle leases stay:
+        their bundle reservation pins them here by construction.
+
+        A stolen request's future is simply abandoned (nothing holds it
+        once it leaves the queue — its grant callback never fires); the
+        caller re-enters the TaskSpec through the scheduler."""
+        stolen = []
+        with self._lock:
+            if not self.alive:
+                return []
+            for sig in list(self._lease_queue.keys()):
+                dkey, pg, _env, _ttype = sig
+                if pg is not None:
+                    continue
+                if not everything and res_ge(self.available, dict(dkey)):
+                    continue  # grantable here as soon as a worker frees
+                bucket = self._lease_queue[sig]
+                reqs = [r for r in bucket if not r.future.cancelled()]
+                if reqs:
+                    stolen.extend(reqs)
+                del self._lease_queue[sig]
+        return stolen
 
     def _pick_bundle(self, pg_id: PlacementGroupId, index: int,
                      demand: ResourceSet) -> Optional[tuple]:

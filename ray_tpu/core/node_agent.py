@@ -83,6 +83,7 @@ class NodeAgent:
         self._log_rings: Dict[WorkerId, _deque] = {}
         self._stopped = threading.Event()
         self._shutdown_claim = threading.Lock()
+        self._drain_deadline = 0.0  # set by the head's "drain" command
         # deterministic fault injection on this agent process too (env is
         # inherited from the launcher): frame-level chaos applies to the
         # agent's head/worker/peer channels
@@ -249,6 +250,25 @@ class NodeAgent:
                                                payload["size"])
         if method == "cgraph_release_channel":
             self.store.release_channel(payload["cid"])
+            return True
+        if method == "drain":
+            # preemption notice relayed by the head (docs/FAULT_TOLERANCE
+            # "Elasticity"): the platform kills this host in grace_s. The
+            # head already stopped scheduling here; usually the autoscaler
+            # terminates us cleanly once the workloads drained. This is
+            # the backstop: exit gracefully just BEFORE the axe so the
+            # head sees an orderly channel close, never a mid-write kill.
+            grace = max(0.0, float(payload.get("grace_s", 0.0)))
+            self._drain_deadline = time.monotonic() + grace
+
+            def _drain_backstop():
+                wait = max(0.0, grace - max(1.0, 0.1 * grace)) \
+                    if grace > 1.5 else grace * 0.9
+                if not self._stopped.wait(wait):
+                    self.shutdown(kill=False)
+
+            threading.Thread(target=_drain_backstop, daemon=True,
+                             name="agent-drain").start()
             return True
         if method == "shutdown":
             threading.Thread(target=self.shutdown,

@@ -125,6 +125,7 @@ class RemoteNode(Node):
         self._prefetch_depth = max(1, int(config.worker_task_prefetch))
         self._launch_failures = {}  # Node's launch-strike breaker state
         self.alive = True
+        self.draining = False  # preemption-noticed: no NEW work lands here
         self.channel = channel
         self.peer_addr = None  # agent's P2P object-server (host, port)
         self._server = None

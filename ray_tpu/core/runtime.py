@@ -55,6 +55,15 @@ _C_HEARTBEAT_MISSES = metrics_mod.Counter(
     "health-check periods that elapsed without an agent heartbeat",
     tag_keys=("node",))
 
+# elastic capacity (docs/FAULT_TOLERANCE.md "Elasticity"): every node
+# that leaves after a preemption notice counts here — outcome=drained
+# when it left with no busy workers (the notice worked), outcome=lost
+# when the axe beat the drain and live work died with it
+_C_PREEMPTIONS = metrics_mod.Counter(
+    "ray_tpu_node_preemptions_total",
+    "preemption-noticed nodes that left the cluster, by drain outcome",
+    tag_keys=("outcome",))
+
 # dispatch-fallback reconnect policy (util/retry.py): how long a failed
 # direct-peer connect keeps the actor on the routed path before the next
 # attempt — grows per consecutive failure, resets on success
@@ -467,6 +476,8 @@ class DriverRuntime:
                     self.nodes[node.node_id] = node
                 self.gcs.register_node(node.info())
                 self._reschedule_parked()
+                # new capacity: spill leases stuck behind full nodes
+                self._spill_queued_leases()
                 # the head's health cadence governs the agent's heartbeat
                 # period — local agent config must not race a stricter head
                 return {"health_check_period_s":
@@ -620,12 +631,80 @@ class DriverRuntime:
                 return chunk
         return None
 
+    def on_preemption_notice(self, node_id: NodeId, grace_s: float,
+                             reason: str = "") -> None:
+        """Planned capacity loss: a provider preemption notice (or chaos
+        ``preempt=`` schedule) says ``node_id`` dies in ``grace_s``
+        seconds. The node stays ALIVE and keeps serving in-flight work,
+        but (a) the scheduler stops placing new leases/bundles on it
+        (``_views`` drain filter), (b) the GCS publishes a
+        ``NODE_PREEMPTING`` event workloads subscribe to (pipeline
+        engines resize, docs/FAULT_TOLERANCE.md), (c) the serve
+        controller — when one is running — is told to drain the replicas
+        living there, and (d) a remote agent gets a ``drain`` command so
+        it exits cleanly once its workers are gone instead of waiting
+        for the axe."""
+        node = self.nodes.get(node_id)
+        if node is None or not node.alive:
+            return
+        already = getattr(node, "draining", False)
+        node.draining = True
+        if already:
+            return  # one notice per axe window
+        self.gcs.mark_node_preempting(node_id, grace_s, reason)
+        # queued-but-ungranted work must not start on a doomed node:
+        # spill it back through the scheduler (other nodes or parked)
+        self._spill_queued_leases(node=node, everything=True)
+        if getattr(node, "is_remote", False):
+            try:
+                node.channel.notify("drain", {"grace_s": float(grace_s)})
+            except Exception:
+                pass
+        self._notify_serve_drain(node_id, grace_s)
+
+    def _notify_serve_drain(self, node_id: NodeId, grace_s: float) -> None:
+        """Best-effort: hand the serve controller the actor ids living on
+        the preempting node so it marks those replicas draining (router
+        stops assigning new streams; in-flight ones finish or fail over
+        before the node dies). The controller runs in a worker process
+        and cannot subscribe to head pubsub itself."""
+        from ..serve.controller import CONTROLLER_NAME
+
+        try:
+            info = self.gcs.get_named_actor(CONTROLLER_NAME, self.namespace)
+            if info is None or info.state != ActorState.ALIVE:
+                return
+            ids = [a.actor_id.hex()
+                   for a in self.gcs.actors_on_node(node_id)
+                   if a.actor_id != info.actor_id]
+            if not ids:
+                return
+            import ray_tpu
+
+            ray_tpu.get_actor(CONTROLLER_NAME).drain_replicas.remote(
+                ids, float(grace_s))
+        except Exception:
+            pass
+
+    def _count_preempt_outcome(self, node) -> None:
+        """Called exactly when a node leaves (lost channel or explicit
+        removal): if it had a preemption notice, grade the drain."""
+        if not getattr(node, "draining", False) \
+                or getattr(node, "_preempt_counted", False):
+            return
+        node._preempt_counted = True
+        with node._lock:
+            busy = any(w.state in ("leased", "actor")
+                       for w in node._workers.values())
+        _C_PREEMPTIONS.inc(tags={"outcome": "lost" if busy else "drained"})
+
     def on_remote_node_lost(self, node_id: NodeId) -> None:
         """Agent channel dropped: fail in-flight work, restart actors
         (ref: gcs_node_manager.cc death broadcast)."""
         node = self.nodes.get(node_id)
         if node is None:
             return
+        self._count_preempt_outcome(node)
         with node._lock:
             if not node.alive:
                 return
@@ -669,6 +748,7 @@ class DriverRuntime:
                 self.head_node_id = node.node_id
         self.gcs.register_node(node.info())
         self._reschedule_parked()
+        self._spill_queued_leases()
         return node
 
     def remove_node(self, node_id: NodeId, kill: bool = True) -> None:
@@ -676,23 +756,34 @@ class DriverRuntime:
             node = self.nodes.get(node_id)
         if node is None:
             return
+        self._count_preempt_outcome(node)
         node.shutdown(kill=kill)
         self.gcs.mark_node_dead(node_id, "removed" if not kill else "killed")
         # objects whose only copies were on this node are now lost
         self._drop_node_copies(node_id)
 
     def _on_node_state(self, msg) -> None:
-        state, node_id = msg
+        state, node_id = msg[0], msg[1]
         if state == "DEAD":
             self._reschedule_parked()
+        elif state == "PREEMPTING":
+            # keep the runtime-side drain flag in sync no matter which
+            # entrypoint published the notice (autoscaler, chaos, API)
+            node = self.nodes.get(node_id)
+            if node is not None:
+                node.draining = True
 
     def _views(self) -> List[NodeView]:
+        # draining nodes (preemption-noticed) are excluded: no new
+        # leases, actors, or placement-group bundles land on a node the
+        # provider has promised to kill — in-flight work drains instead
         with self._lock:
             return [
                 NodeView(node_id=n.node_id, total=dict(n.total_resources),
                          available=dict(n.available), alive=n.alive,
                          labels=dict(n.labels))
-                for n in self.nodes.values() if n.alive
+                for n in self.nodes.values()
+                if n.alive and not getattr(n, "draining", False)
             ]
 
     # ---- function export (ref: python/ray/_private/function_manager.py) -----
@@ -1203,7 +1294,8 @@ class DriverRuntime:
             # cross-node concerns; the only question is feasibility
             # (infeasible demand still parks, same as pick_node=None)
             n = next(iter(self.nodes.values()))
-            node = n if (n.alive and res_ge(n.total_resources, demand)) \
+            node = n if (n.alive and not getattr(n, "draining", False)
+                         and res_ge(n.total_resources, demand)) \
                 else None
         else:
             if strat.kind == "NODE_AFFINITY" and not strat.soft:
@@ -1280,6 +1372,37 @@ class DriverRuntime:
         # cluster membership/capacity changed: parked pending PGs get
         # another placement pass through the single placer thread
         self._wake_pg_placer(recheck_parked=True)
+
+    def _spill_queued_leases(self, node=None,
+                             everything: bool = False) -> int:
+        """Lease spillback (the reference's raylet spillback, reduced):
+        queued-but-ungranted lease requests move back through the
+        scheduler when the cluster's shape changed under them — a new
+        node joined (a request stuck behind a full node can run there
+        NOW), or ``node`` started draining (``everything=True``: nothing
+        new may start there). Without this, a request queued on a
+        busy-but-feasible node waits for THAT node forever and fresh
+        autoscaler capacity goes unused."""
+        victims = [node] if node is not None else [
+            n for n in list(self.nodes.values())
+            if n.alive and not getattr(n, "draining", False)]
+        moved = 0
+        for n in victims:
+            try:
+                stolen = n.steal_queued_leases(everything=everything)
+            except Exception:
+                continue
+            for req in stolen:
+                moved += 1
+                try:
+                    self._schedule(req.spec)
+                except Exception as e:
+                    try:
+                        self._fail_task(req.spec, exc.RayTpuError(
+                            f"lease spillback failed: {e!r}"))
+                    except Exception:
+                        pass
+        return moved
 
     # ---- streaming generators (ref: core_worker.proto:436) -------------------
 

@@ -37,6 +37,8 @@ import numpy as np
 __all__ = [
     "TreeSpec", "flatten_tree", "unflatten_tree", "shard_bounds",
     "tree_bytes", "ZeroUpdater", "make_zero_update_spmd",
+    "merge_opt_shards", "split_opt_state", "flatten_opt_state",
+    "unflatten_opt_state",
 ]
 
 
@@ -107,6 +109,103 @@ def tree_bytes(tree) -> int:
     for leaf in jax.tree.leaves(tree):
         total += int(np.asarray(leaf).nbytes)
     return total
+
+
+# ---------------------------------------------------------------------------
+# opt-state resharding — the elastic-capacity vocabulary
+# (docs/FAULT_TOLERANCE.md "Elasticity"): ZeRO shards saved at one dp
+# width re-split exactly across another, and the flat plane converts
+# to/from the replicated tree plane, so `resize(dp±k)` and
+# cross-width checkpoint restores are pure byte movement — bit-exact.
+# ---------------------------------------------------------------------------
+
+
+def merge_opt_shards(shards: List[Any]):
+    """Per-rank ZeRO opt-state shards (rank order) -> one flat-vector
+    opt state over the FULL parameter vector. Array leaves are
+    shard-sized (optimizer moments) and concatenate in rank order —
+    matching the ``shard_bounds`` contiguous layout they were split
+    under; scalar leaves (adam's step count) are collectively identical
+    and come from rank 0."""
+    import jax
+    import numpy as _np
+
+    if not shards:
+        raise ValueError("merge_opt_shards needs at least one shard")
+
+    def _merge(*leaves):
+        if _np.ndim(leaves[0]) >= 1:
+            return _np.concatenate([_np.asarray(l) for l in leaves])
+        return leaves[0]
+
+    return jax.tree.map(_merge, *shards)
+
+
+def split_opt_state(full, world: int, size: int) -> List[Any]:
+    """Inverse of :func:`merge_opt_shards`: a flat-vector opt state over
+    ``size`` parameters -> ``world`` per-rank shards on the
+    ``shard_bounds`` layout. Array leaves of length ``size`` are
+    sliced; everything else (scalars, oddly-shaped leaves) replicates."""
+    import jax
+    import numpy as _np
+
+    bounds = shard_bounds(size, world)
+
+    def _slice(lo, hi):
+        def f(leaf):
+            arr = _np.asarray(leaf)
+            if arr.ndim == 1 and arr.shape[0] == size:
+                return arr[lo:hi]
+            return leaf
+        return f
+
+    return [jax.tree.map(_slice(lo, hi), full) for lo, hi in bounds]
+
+
+def flatten_opt_state(state, params):
+    """Replicated TREE-plane opt state (``tx.init(params_tree)``) -> the
+    flat-vector plane (``tx.init(flat_params)``): every params-shaped
+    subtree of the state (adam's mu/nu, momentum's trace, ...) collapses
+    into one raveled vector on the :func:`flatten_tree` layout; scalar
+    leaves pass through. This is the grow path — a dp=1 engine's full
+    opt state becomes ZeRO shards for dp>1."""
+    import jax
+    import jax.numpy as jnp
+
+    p_def = jax.tree.structure(params)
+    p_shapes = [jnp.shape(l) for l in jax.tree.leaves(params)]
+
+    def _params_shaped(x) -> bool:
+        try:
+            if jax.tree.structure(x) != p_def:
+                return False
+            return [jnp.shape(l) for l in jax.tree.leaves(x)] == p_shapes
+        except Exception:
+            return False
+
+    def _collapse(sub):
+        if _params_shaped(sub):
+            return jnp.concatenate(
+                [jnp.asarray(l).ravel() for l in jax.tree.leaves(sub)])
+        return sub
+
+    return jax.tree.map(_collapse, state, is_leaf=_params_shaped)
+
+
+def unflatten_opt_state(flat_state, spec: TreeSpec):
+    """Flat-vector-plane opt state -> the replicated TREE plane: leaves
+    of length ``spec.size`` unflatten back into params-shaped subtrees
+    (the shrink-to-dp=1 path)."""
+    import jax
+    import numpy as _np
+
+    def _expand(leaf):
+        arr = _np.asarray(leaf)
+        if arr.ndim == 1 and arr.shape[0] == spec.size:
+            return unflatten_tree(leaf, spec)
+        return leaf
+
+    return jax.tree.map(_expand, flat_state)
 
 
 # ---------------------------------------------------------------------------

@@ -30,6 +30,13 @@ class _ReplicaState:
         self.starting = True           # until first successful ping
         self.started_at = time.monotonic()
         self.last_ongoing = 0
+        # preemption-notice draining (docs/FAULT_TOLERANCE.md
+        # "Elasticity"): a draining replica takes no NEW requests
+        # (excluded from get_replicas), finishes what it has, and is
+        # killed once idle or at the drain deadline — whichever first
+        self.draining = False
+        self.drain_deadline = 0.0
+        self.drain_marked_at = 0.0
 
 
 class _DeploymentState:
@@ -102,14 +109,51 @@ class ServeController:
         return True
 
     def get_replicas(self, name: str):
-        """-> (version, max_concurrent_queries, [actor handles]) for routing."""
+        """-> (version, max_concurrent_queries, [actor handles]) for
+        routing. Draining replicas are EXCLUDED: the router stops
+        assigning new requests/streams the moment its next refresh
+        lands, while in-flight work on them runs to completion."""
         with self._lock:
             st = self._deployments.get(name)
             if st is None:
                 return (0, 0, [])
             handles = [r.handle for r in st.replicas
-                       if not r.starting and r.version == st.version]
+                       if not r.starting and not r.draining
+                       and r.version == st.version]
             return (st.version, st.config.max_concurrent_queries, handles)
+
+    def drain_replicas(self, actor_id_hexes, grace_s: float = 30.0) -> int:
+        """Preemption-notice draining: mark every replica whose actor id
+        is in ``actor_id_hexes`` (hex strings) as draining, across all
+        deployments. The runtime calls this when a node gets a
+        ``NODE_PREEMPTING`` event; operators/tests may call it directly
+        for scripted scale-downs. Returns the number of replicas newly
+        marked. Replacement replicas start on the next reconcile pass
+        (draining replicas stop counting toward target), and the
+        drained corpse is killed once idle or at the deadline."""
+        wanted = {h.lower() for h in actor_id_hexes}
+        marked = []
+        deadline = time.monotonic() + max(0.0, float(grace_s))
+        with self._lock:
+            for st in self._deployments.values():
+                for r in st.replicas:
+                    if r.draining:
+                        continue
+                    if r.handle._actor_id.hex().lower() in wanted:
+                        r.draining = True
+                        r.drain_deadline = deadline
+                        r.drain_marked_at = time.monotonic()
+                        marked.append(r)
+        for r in marked:
+            # the replica reports draining in its own health ping from
+            # here on (observability surface; the routing decision
+            # already happened via get_replicas exclusion)
+            try:
+                r.handle.set_draining.options(
+                    concurrency_group="control").remote(True)
+            except Exception:
+                pass
+        return len(marked)
 
     def status(self) -> Dict[str, dict]:
         with self._lock:
@@ -117,7 +161,9 @@ class ServeController:
                 name: {"status": st.status, "version": st.version,
                        "target": st.target,
                        "running": sum(1 for r in st.replicas
-                                      if not r.starting)}
+                                      if not r.starting and not r.draining),
+                       "draining": sum(1 for r in st.replicas
+                                       if r.draining)}
                 for name, st in self._deployments.items() if not st.deleted
             }
 
@@ -173,7 +219,24 @@ class ServeController:
             current = list(st.replicas)
             target = st.target
             version = st.version
-        running = [r for r in current if not r.starting]
+        # drain completion: a draining replica dies the moment it is
+        # idle (after at least one post-mark health ping, so a stream
+        # assigned just before the mark is visible) or at the deadline.
+        # It stopped counting toward target below, so its replacement
+        # is already starting — notice → drain → handoff → clean exit.
+        now = time.monotonic()
+        for r in [r for r in current if r.draining]:
+            settled = now - getattr(r, "drain_marked_at", 0.0) \
+                > st.config.health_check_period_s
+            idle = not r.starting and r.last_ongoing == 0 and settled
+            if idle or now > r.drain_deadline:
+                with self._lock:
+                    if r in st.replicas:
+                        st.replicas.remove(r)
+                self._kill(r, st.config.graceful_shutdown_timeout_s)
+                current.remove(r)
+        active = [r for r in current if not r.draining]
+        running = [r for r in active if not r.starting]
         # rolling update: at most one old replica replaced per cycle, and
         # only while the deployment is at healthy strength (ref:
         # deployment_state.py rolling update semantics)
@@ -184,25 +247,27 @@ class ServeController:
                 if victim in st.replicas:
                     st.replicas.remove(victim)
             self._kill(victim, st.config.graceful_shutdown_timeout_s)
-            current = [r for r in current if r is not victim]
-        # scale up
-        while len(current) < target:
+            active = [r for r in active if r is not victim]
+        # scale up (draining replicas do not count: their capacity is
+        # already promised away, so replacements start NOW)
+        while len(active) < target:
             r = self._start_replica(st, version)
             if r is None:
                 break
-            current.append(r)
+            active.append(r)
         # scale down (newest starting first, then newest running)
-        while len(current) > target:
-            victim = sorted(current, key=lambda r: (not r.starting,
-                                                    -r.started_at))[0]
+        while len(active) > target:
+            victim = sorted(active, key=lambda r: (not r.starting,
+                                                   -r.started_at))[0]
             with self._lock:
                 if victim in st.replicas:
                     st.replicas.remove(victim)
             self._kill(victim, st.config.graceful_shutdown_timeout_s)
-            current.remove(victim)
+            active.remove(victim)
         with self._lock:
             healthy = sum(1 for r in st.replicas
-                          if not r.starting and r.version == version)
+                          if not r.starting and not r.draining
+                          and r.version == version)
             if healthy >= st.target and not old:
                 st.status = HEALTHY
             elif not st.replicas:
@@ -243,7 +308,8 @@ class ServeController:
         if cfg is None:
             return
         with self._lock:
-            running = [r for r in st.replicas if not r.starting]
+            running = [r for r in st.replicas
+                       if not r.starting and not r.draining]
             ongoing = sum(r.last_ongoing for r in running)
         if not running:
             return

@@ -34,6 +34,7 @@ class Replica:
         self._version = version
         self._ongoing = 0
         self._total = 0
+        self._draining = False
         self._lock = threading.Lock()
         if isinstance(target, type):
             self._callable = target(*init_args, **init_kwargs)
@@ -104,7 +105,8 @@ class Replica:
         if callable(check):
             check()
         info = {"ok": True, "version": self._version,
-                "ongoing": self._ongoing, "total": self._total}
+                "ongoing": self._ongoing, "total": self._total,
+                "draining": self._draining}
         # user callables with their own backlog (the LLM engine's
         # waiting+running depth) expose queue_len(); shipping it in the
         # ping lets the controller autoscale on engine backlog, which
@@ -119,6 +121,20 @@ class Replica:
 
     def queue_len(self) -> int:
         return self._ongoing
+
+    def set_draining(self, flag: bool) -> bool:
+        """Controller-set preemption-drain mark (docs/FAULT_TOLERANCE.md
+        "Elasticity"): reported in every health ping, and forwarded to
+        the user callable's ``drain()`` hook when it defines one (an
+        LLM engine could stop admitting prompts, flush caches, ...)."""
+        self._draining = bool(flag)
+        hook = getattr(self._callable, "drain", None)
+        if callable(hook):
+            try:
+                hook(self._draining)
+            except Exception:
+                pass
+        return self._draining
 
     def reconfigure(self, user_config: Any) -> bool:
         fn = getattr(self._callable, "reconfigure", None)

@@ -16,7 +16,8 @@ from .session import (get_checkpoint, get_context, get_dataset_shard,
                       get_mesh, report)
 from .trainer import DataParallelTrainer, JaxTrainer, TorchTrainer
 from .backend_executor import BackendExecutor, TrainWorkerError
-from .pipeline_cgraph import CompiledPipelineEngine, run_reference_1f1b
+from .pipeline_cgraph import (CompiledPipelineEngine,
+                              reshard_checkpoint, run_reference_1f1b)
 from .pipeline_engine import PipelineEngine
 
 __all__ = [
@@ -25,5 +26,6 @@ __all__ = [
     "get_checkpoint", "get_mesh",
     "get_dataset_shard", "DataParallelTrainer", "JaxTrainer", "TorchTrainer",
     "BackendExecutor", "TrainWorkerError",
-    "CompiledPipelineEngine", "PipelineEngine", "run_reference_1f1b",
+    "CompiledPipelineEngine", "PipelineEngine", "reshard_checkpoint",
+    "run_reference_1f1b",
 ]

@@ -59,6 +59,13 @@ _H_STEP = _metrics.Histogram(
     "pipeline-engine full step() latency as observed by the driver",
     boundaries=_metrics.DEFAULT_BOUNDARIES, tag_keys=("engine",))
 
+# elastic capacity (docs/FAULT_TOLERANCE.md "Elasticity"): wall-clock of
+# one resize(dp±k) — drain, opt-state reshard, respawn, recompile, resume
+_H_RESIZE = _metrics.Histogram(
+    "ray_tpu_resize_seconds",
+    "pipeline-engine resize(dp±k) end-to-end latency",
+    boundaries=_metrics.DEFAULT_BOUNDARIES, tag_keys=("direction",))
+
 DEFAULT_CHANNEL_BYTES = 1 << 20
 
 
@@ -195,6 +202,84 @@ def run_reference_1f1b(stage_fns: Sequence[Callable],
         losses_out.append(
             float(sum(float(l) for l in step_losses) / M))
     return losses_out, params
+
+
+# ---------------------------------------------------------------------------
+# elastic resharding — checkpoints move across dp widths bit-exactly
+# ---------------------------------------------------------------------------
+
+
+def reshard_checkpoint(ckpt: dict, dp: int) -> dict:
+    """Re-shard a checkpoint payload (``save_checkpoint`` /
+    ``_pull_state_grid`` shape) to a new dp width — the data plane of
+    ``CompiledPipelineEngine.resize``.
+
+    Parameters are identical across dp rows by construction (the update
+    all-gathers/replicates them), so row 0's copy seeds every new row.
+    Optimizer state moves by kind:
+
+    - ``full`` (replicated tree) / ``fsdp`` (dp-replicated host arrays)
+      / ``none``: row 0 replicates to every new row; growing a
+      ``full``-kind state under a ``zero_update`` engine converts it to
+      flat ZeRO shards (:func:`parallel.zero.flatten_opt_state`).
+    - ``zero``: per-rank flat shards merge in rank order and re-split
+      across the new width (pure byte movement — bit-exact); shrinking
+      to dp=1 converts back to the replicated tree plane.
+
+    ``num_microbatches`` rescales so the GLOBAL batch (dp * M
+    microbatches per step) is invariant: the resized trajectory is the
+    same arithmetic a fixed-size run at the new width would execute.
+    """
+    from ..parallel.zero import (flatten_opt_state, flatten_tree,
+                                 merge_opt_shards, split_opt_state,
+                                 unflatten_opt_state)
+
+    meta = dict(ckpt["engine"])
+    old_dp = int(meta["dp"])
+    new_dp = int(dp)
+    if new_dp < 1:
+        raise ValueError(f"dp must be >= 1, got {dp}")
+    total_mb = int(meta["num_microbatches"]) * old_dp
+    if total_mb % new_dp:
+        raise ValueError(
+            f"global batch of {total_mb} microbatches does not divide "
+            f"across dp={new_dp}; valid widths divide {total_mb}")
+    states = ckpt["states"]
+    P = len(states[0])
+    zero_update = bool(meta.get("zero_update", True))
+    new_rows: List[List[dict]] = [[None] * P for _ in range(new_dp)]
+    for i in range(P):
+        row0 = states[0][i]
+        kind = row0.get("kind", "none")
+        params = row0["params"]
+        params_dict = {str(v): params[v] for v in range(len(params))}
+        if kind == "zero":
+            shards = [states[r][i]["opt"] for r in range(old_dp)]
+            flat, spec = flatten_tree(params_dict)
+            merged = merge_opt_shards(shards)
+            if new_dp == 1:
+                opts = [unflatten_opt_state(merged, spec)]
+                new_kind = "full"
+            else:
+                opts = split_opt_state(merged, new_dp, spec.size)
+                new_kind = "zero"
+        elif kind == "full" and new_dp > 1 and zero_update:
+            flat, spec = flatten_tree(params_dict)
+            opts = split_opt_state(
+                flatten_opt_state(row0["opt"], params_dict),
+                new_dp, spec.size)
+            new_kind = "zero"
+        else:
+            # none / fsdp / replicated-full: dp rows are identical copies
+            opts = [row0["opt"]] * new_dp
+            new_kind = kind
+        for r in range(new_dp):
+            new_rows[r][i] = {"params": params, "opt": opts[r],
+                              "kind": new_kind}
+    meta["dp"] = new_dp
+    meta["num_microbatches"] = total_mb // new_dp
+    return {"step": int(ckpt.get("step", 0)), "engine": meta,
+            "states": new_rows}
 
 
 # ---------------------------------------------------------------------------
@@ -1020,6 +1105,11 @@ class CompiledPipelineEngine:
         microbatches/targets — replica r consumes the contiguous slice
         ``[r*M:(r+1)*M]``. Returns the mean loss across every
         microbatch of every replica."""
+        # hands-off elasticity: a preemption notice / node join observed
+        # since the last step resizes dp HERE, at the step boundary —
+        # the global batch (dp * M) is invariant, so callers never
+        # change what they feed
+        self._apply_pending_resize()
         M, dp = self.num_microbatches, self.dp
         if len(microbatches) != M * dp or len(targets) != M * dp:
             raise ValueError(
@@ -1163,12 +1253,7 @@ class CompiledPipelineEngine:
         path = os.path.join(self.checkpoint_dir, f"ckpt-{step:08d}.pkl")
         payload = {
             "step": step,
-            "engine": {"num_chunks": self.num_chunks,
-                       "num_stages": self.num_stages,
-                       "virtual": self.virtual, "dp": self.dp,
-                       "fsdp": self.fsdp,
-                       "zero_update": self.zero_update,
-                       "num_microbatches": self.num_microbatches},
+            "engine": self._engine_meta(),
             "states": states,
         }
 
@@ -1222,6 +1307,14 @@ class CompiledPipelineEngine:
         deadline = time.monotonic() + timeout
         for t in pending:
             t.join(max(0.0, deadline - time.monotonic()))
+
+    def _engine_meta(self) -> dict:
+        return {"num_chunks": self.num_chunks,
+                "num_stages": self.num_stages,
+                "virtual": self.virtual, "dp": self.dp,
+                "fsdp": self.fsdp,
+                "zero_update": self.zero_update,
+                "num_microbatches": self.num_microbatches}
 
     def _maybe_checkpoint(self) -> None:
         if self.checkpoint_dir and self.checkpoint_every > 0 \
@@ -1312,11 +1405,69 @@ class CompiledPipelineEngine:
         step = 0
         if ckpt_path is not None:
             ckpt = self.load_checkpoint(ckpt_path)
+            if int(ckpt.get("engine", {}).get("dp", self.dp)) != self.dp:
+                # the newest commit predates a resize: re-shard it to
+                # the engine's current width (bit-exact byte movement)
+                ckpt = reshard_checkpoint(ckpt, self.dp)
             self._check_ckpt_shape(ckpt)
             state_grid = ckpt["states"]
             step = int(ckpt["step"])
-        # kill every stage (dead ones no-op) and wait for the records to
-        # reach DEAD so placement slots free up for the respawn
+        self._kill_stages_and_wait(deadline, "recover()")
+        self._destroy_collective_groups()
+        self._drop_pg_if_degraded()
+        self._reset_graph_state()
+        self._spawn_actors(self._init_params,
+                           per_actor_state=state_grid)
+        self._compile()
+        self._step_count = step
+        return step
+
+    def _drop_pg_if_degraded(self) -> None:
+        """A bundle whose node died (or is draining toward a preemption
+        deadline) would strand the respawn — actor creations against a
+        dead bundle park forever. Drop the group so the respawn sizes a
+        fresh one over the nodes that remain."""
+        if self._pg is None:
+            return
+        degraded = True
+        try:
+            info = self._rt.gcs.get_pg(self._pg.id)
+            if info is not None:
+                degraded = False
+                for nid in info.bundle_nodes:
+                    node = self._rt.nodes.get(nid) if nid else None
+                    if node is None or not node.alive \
+                            or getattr(node, "draining", False):
+                        degraded = True
+                        break
+        except Exception:
+            pass
+        if degraded:
+            try:
+                remove_placement_group(self._pg)
+            except Exception:
+                pass
+            self._pg = None
+
+    def _destroy_collective_groups(self) -> None:
+        """Kill the dp collective groups' detached rendezvous store
+        actors from the DRIVER (named ``rtpu_collective:<group>:<dp>``).
+        recover()/resize() kill the stage actors without a cleanup()
+        hop, so the stores would otherwise leak — and a store stranded
+        on a draining node keeps it 'busy' forever, blocking the clean
+        preemption exit. Must run while the OLD gtag/dp are current."""
+        if self.dp <= 1 or self._tx_blob is None:
+            return
+        for i in range(self.num_stages):
+            name = f"rtpu_collective:zpipe-{self._gtag}-s{i}:{self.dp}"
+            try:
+                ray_tpu.kill(ray_tpu.get_actor(name))
+            except Exception:
+                pass
+
+    def _kill_stages_and_wait(self, deadline: float, what: str) -> None:
+        """Kill every stage actor (dead ones no-op) and wait for the
+        records to reach DEAD so placement slots free up for a respawn."""
         for a in getattr(self, "actors", []):
             try:
                 ray_tpu.kill(a)
@@ -1327,9 +1478,11 @@ class CompiledPipelineEngine:
                 if time.monotonic() > deadline:
                     raise TimeoutError(
                         f"stage actor {a._actor_id.hex()[:8]} did not "
-                        f"reach DEAD during recover()")
+                        f"reach DEAD during {what}")
                 time.sleep(0.05)
-        # reset engine plumbing for a fresh compile
+
+    def _reset_graph_state(self) -> None:
+        """Reset engine plumbing for a fresh compile (recover/resize)."""
         with self._lock:
             self._torn = False
             self._poisoned = None
@@ -1346,11 +1499,207 @@ class CompiledPipelineEngine:
         self._qreaders = {}
         self._unsub = None
         self._shutdown_done = False
+
+    # -- elastic capacity (docs/FAULT_TOLERANCE.md "Elasticity") -----------
+
+    def resize(self, dp: int, timeout: float = 300.0,
+               scheduling_strategies: Optional[Sequence] = None) -> int:
+        """Change the engine's data-parallel width IN PLACE, between
+        steps: drain is implicit (the caller is between step() calls),
+        state is pulled at the step boundary, ZeRO optimizer shards
+        re-split across the new width (``reshard_checkpoint`` — pure
+        byte movement, bit-exact), every stage actor respawns into
+        freshly-sized placement bundles (draining nodes excluded by the
+        scheduler), channels recompile under a fresh graph id, and
+        training resumes at the SAME step count and global batch:
+        ``num_microbatches`` rescales so dp * M is invariant, and the
+        resumed trajectory is bit-identical to a fixed-size run at the
+        new width restored from the same (resharded) checkpoint.
+
+        Returns the step count training resumes from. The new width must
+        divide the global microbatch count; engines built with explicit
+        ``scheduling_strategies`` must pass a new P*dp-sized list."""
+        new_dp = int(dp)
+        if new_dp < 1:
+            raise ValueError(f"dp must be >= 1, got {dp}")
+        if new_dp == self.dp:
+            return self._step_count
+        total_mb = self.num_microbatches * self.dp
+        if total_mb % new_dp:
+            raise ValueError(
+                f"global batch of {total_mb} microbatches does not "
+                f"divide across dp={new_dp}")
+        if self._strategies is not None and scheduling_strategies is None:
+            raise CompiledGraphError(
+                "engine was built with explicit scheduling_strategies; "
+                f"resize(dp={new_dp}) needs a new "
+                f"{self.num_stages * new_dp}-entry list")
+        with self._lock:
+            self._check_open()
+        t0 = time.perf_counter()
+        deadline = time.monotonic() + timeout
+        direction = "grow" if new_dp > self.dp else "shrink"
+        self.wait_for_checkpoints()
+        states = self._pull_state_grid()
+        resharded = reshard_checkpoint(
+            {"step": self._step_count, "engine": self._engine_meta(),
+             "states": states}, new_dp)
+        self.teardown()
+        self._kill_stages_and_wait(deadline, f"resize(dp={new_dp})")
+        self._destroy_collective_groups()
+        if self._pg is not None:
+            # bundle count changes with dp: drop the old group so the
+            # respawn sizes a fresh one (and lands off draining nodes)
+            try:
+                remove_placement_group(self._pg)
+            except Exception:
+                pass
+            self._pg = None
+        if scheduling_strategies is not None:
+            self._strategies = list(scheduling_strategies)
+        self._reset_graph_state()
+        self.dp = new_dp
+        self.num_microbatches = total_mb // new_dp
         self._spawn_actors(self._init_params,
-                           per_actor_state=state_grid)
+                           per_actor_state=resharded["states"])
         self._compile()
-        self._step_count = step
-        return step
+        _H_RESIZE.observe(time.perf_counter() - t0,
+                          tags={"direction": direction})
+        return self._step_count
+
+    def enable_elastic(self, *, min_dp: int = 1,
+                       max_dp: Optional[int] = None,
+                       grow_on_join: bool = True) -> None:
+        """Hands-off elasticity: subscribe to the GCS "node" channel and
+        ride capacity changes without operator intervention
+        (ROADMAP item 4 / docs/FAULT_TOLERANCE.md "Elasticity").
+
+        - ``NODE_PREEMPTING`` (a provider preemption notice, or a chaos
+          ``preempt=`` schedule) for a node hosting any of this engine's
+          stage actors ⇒ the next ``step()`` first shrinks dp below the
+          doomed rows — *shrink before the axe*. If no valid smaller
+          width exists the notice is ignored and an early kill falls
+          back to the ``recover()`` path.
+        - a node joining (``ALIVE``) with ``grow_on_join`` ⇒ the next
+          ``step()`` grows dp to the next valid width up to ``max_dp``
+          (default: the CURRENT width — "grow back to where I started"
+          after preemption shrinks; pass a larger cap to scale beyond).
+
+        The resize itself runs inside ``step()`` — at a step boundary by
+        construction — so callers keep feeding the same dp*M global
+        batch and never see the width change beyond a slower step."""
+        if getattr(self, "_elastic_unsub", None) is not None:
+            return
+        # grow_on_join without an explicit cap grows back to the width
+        # the engine had when elasticity was enabled — a silent
+        # never-grow default would contradict the flag
+        cap = int(max_dp) if max_dp \
+            else (self.dp if grow_on_join else None)
+        self._elastic = {"min": max(1, int(min_dp)),
+                         "max": cap,
+                         "grow": bool(grow_on_join)}
+        self._pending_dp: Optional[int] = None
+        self._elastic_unsub = self._rt.gcs.pubsub.subscribe(
+            "node", self._on_elastic_node_event)
+
+    def _valid_widths(self) -> List[int]:
+        total_mb = self.num_microbatches * self.dp
+        return [d for d in range(1, total_mb + 1) if total_mb % d == 0]
+
+    def _on_elastic_node_event(self, msg) -> None:
+        try:
+            state, node_id = msg[0], msg[1]
+        except Exception:
+            return
+        cfg = getattr(self, "_elastic", None)
+        if cfg is None:
+            return
+        if state == "PREEMPTING":
+            plans = getattr(self, "_plans", None)
+            if not plans:
+                return
+            n_on_node = sum(1 for row in plans for p in row
+                            if p.node.node_id == node_id)
+            if n_on_node == 0:
+                return
+            # the resize respawns EVERY stage off the draining node, so
+            # the question is only how much total capacity to give back:
+            # at least the doomed node's share, rounded up to whole rows
+            import math
+
+            doomed = max(1, math.ceil(n_on_node / self.num_stages))
+            floor = cfg["min"]
+            with self._lock:
+                pending = getattr(self, "_pending_dp", None)
+                # two nodes doomed in the same window: the second notice
+                # shrinks from the already-queued target, not from the
+                # current width — give-backs accumulate
+                base = pending if pending is not None \
+                    and pending < self.dp else self.dp
+                cands = [d for d in self._valid_widths()
+                         if floor <= d <= base - doomed]
+                if not cands:
+                    # can't give back that much: shrink as far as widths
+                    # allow; at the floor already, the axe + recover()
+                    # is the fallback (the notice/SIGKILL race test)
+                    cands = [d for d in self._valid_widths()
+                             if floor <= d < base]
+                if not cands:
+                    return
+                self._pending_dp = max(cands)
+        elif state == "ALIVE" and cfg["grow"]:
+            cap = cfg["max"]
+            if cap is None:
+                return
+            with self._lock:
+                pending = getattr(self, "_pending_dp", None)
+            base = pending if pending is not None else self.dp
+            if base >= cap:
+                return
+            cands = [d for d in self._valid_widths() if base < d <= cap]
+            if not cands:
+                return
+            target = min(cands)
+            with self._lock:
+                if pending is not None and pending < self.dp:
+                    # a shrink is queued for a doomed node: it must land
+                    # first — remember the grow and apply it right after
+                    self._regrow_dp = target
+                else:
+                    self._pending_dp = target
+
+    def _grow_feasible(self, dp_new: int) -> bool:
+        """Cheap placement pre-check before a grow: the respawn kills
+        the current actors first (freeing their CPU), then needs
+        P * dp_new bundles — refuse the grow when the non-draining
+        cluster clearly cannot hold it, rather than tearing the engine
+        down into a placement timeout."""
+        try:
+            res = dict(self._res or {"CPU": 1.0})
+            per = float(res.get("CPU", 1.0))
+            need = per * self.num_stages * dp_new
+            avail = sum(float(v.available.get("CPU", 0.0))
+                        for v in self._rt._views())
+            freed = per * self.num_stages * self.dp
+            return avail + freed >= need
+        except Exception:
+            return True
+
+    def _apply_pending_resize(self) -> None:
+        with self._lock:
+            pending, self._pending_dp = getattr(self, "_pending_dp",
+                                                None), None
+        if pending is not None and pending != self.dp:
+            if pending > self.dp and not self._grow_feasible(pending):
+                pending = None  # capacity shrank again since the event
+            else:
+                self.resize(pending)
+        with self._lock:
+            regrow = getattr(self, "_regrow_dp", None)
+            self._regrow_dp = None
+            if regrow is not None and regrow != self.dp \
+                    and self._pending_dp is None:
+                self._pending_dp = regrow  # lands at the NEXT boundary
 
     def _deliver(self, cid: str, seq: int, data: bytes) -> None:
         q = self._qreaders.get(cid)
@@ -1447,6 +1796,13 @@ class CompiledPipelineEngine:
         handler + explicit call); a reentrant call returns once teardown
         marked the engine torn."""
         self.teardown()
+        unsub = getattr(self, "_elastic_unsub", None)
+        if unsub is not None:
+            self._elastic_unsub = None
+            try:
+                unsub()
+            except Exception:
+                pass
         try:
             self.wait_for_checkpoints(timeout=30.0)
         except Exception:
@@ -1463,6 +1819,10 @@ class CompiledPipelineEngine:
                      for i in range(len(row))], timeout=30)
             except Exception:
                 pass
+            # backstop: when the stages are already dead (post-abort
+            # shutdown) the cleanup hop failed — kill the rendezvous
+            # stores from the driver so nothing detached leaks
+            self._destroy_collective_groups()
         for a in getattr(self, "actors", []):
             try:
                 ray_tpu.kill(a)

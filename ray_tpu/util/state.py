@@ -30,6 +30,9 @@ def list_nodes() -> List[Dict[str, Any]]:
         out.append({
             "node_id": info.node_id.hex(),
             "alive": info.alive,
+            # preemption-notice drain state (docs/FAULT_TOLERANCE.md
+            # "Elasticity"): alive but taking no new work
+            "draining": bool(info.draining),
             "resources_total": dict(info.total_resources),
             "resources_available": (dict(node.available)
                                     if node is not None else {}),

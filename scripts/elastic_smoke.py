@@ -1,0 +1,291 @@
+#!/usr/bin/env python
+"""CI smoke for elastic capacity on preemptible pods (ISSUE 12).
+
+A live cluster where the AUTOSCALER — not the test — owns capacity:
+
+1. **Demand-driven scale-up to real nodes**: an LLMServer deployment
+   asks for 2 replicas sized so the second cannot fit on the head; the
+   parked actor creation is the autoscaler's demand signal, a
+   FakeSliceProvider node agent (separate OS process) is launched, and
+   the replica lands on it.
+2. **Scale-down through a scripted preemption**: the provider schedules
+   a preemption (notice now, SIGKILL at +grace). The reconcile loop
+   turns the notice into the NODE_PREEMPTING drain: the serve replica
+   on the doomed node drains (router stops assigning it new streams;
+   4 concurrent `resilient_stream` clients riding it finish with every
+   token), the live pipeline-training engine shrinks dp=2 -> 1 at its
+   next step boundary (hands-off, `enable_elastic`), and the node exits
+   CLEANLY before the axe (`ray_tpu_node_preemptions_total`
+   outcome=drained).
+3. **Scale-up again**: the drained replica's replacement parks, a
+   second node launches, and the engine grows back to dp=2 on the
+   join event.
+4. **Zero failed requests + fixed-size final-params check**: every
+   stream is token-identical to a driver-local ground-truth engine, no
+   step of the training loop failed, and the post-scale-up trajectory +
+   final params are BIT-IDENTICAL to a fixed-size dp=2 engine restored
+   from the same checkpoint.
+
+Exit 0 = healthy; any assertion prints the evidence and exits 1.
+Run: python scripts/elastic_smoke.py   (CI invokes it after chaos_smoke)
+"""
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _mlp_pure_dp(width: int, M: int, mb_size: int):
+    """Single-chunk (G=1) engine pieces: a pure data-parallel pipeline."""
+    import jax
+    import jax.numpy as jnp
+
+    k = jax.random.PRNGKey(0)
+
+    def fn(p, x, targets):
+        return jnp.mean((jnp.tanh(x @ p["w"]) @ p["v"] - targets) ** 2)
+
+    params = [{
+        "w": jax.random.normal(jax.random.fold_in(k, 1),
+                               (width, width)) * 0.3,
+        "v": jax.random.normal(jax.random.fold_in(k, 2),
+                               (width, width)) * 0.3,
+    }]
+    xs = jax.random.normal(jax.random.fold_in(k, 5), (M * mb_size, width))
+    w_true = jax.random.normal(jax.random.fold_in(k, 6),
+                               (width, width)) * 0.5
+    ys = jnp.tanh(xs @ w_true)
+    mbs = [xs[i * mb_size:(i + 1) * mb_size] for i in range(M)]
+    tgts = [ys[i * mb_size:(i + 1) * mb_size] for i in range(M)]
+    return [fn], params, mbs, tgts
+
+
+def main() -> int:  # noqa: PLR0915 — one linear smoke story
+    import tempfile
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.autoscaler import (AutoscalerConfig, FakeSliceProvider,
+                                    StandardAutoscaler)
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.serve.llm import (EngineConfig, LLMEngine, LLMServer,
+                                   build_model, resilient_stream)
+    from ray_tpu.train import CompiledPipelineEngine
+    from ray_tpu.util import metrics
+
+    c = Cluster(head_resources={"CPU": 3.5, "replica_slot": 1.0,
+                                "stage_slot": 1.0},
+                system_config={"health_check_period_s": 0.3})
+    provider = None
+    sc = None
+    try:
+        provider = FakeSliceProvider(
+            c.runtime, resources_per_node={"CPU": 3.0, "replica_slot": 1.0,
+                                "stage_slot": 1.0})
+        sc = StandardAutoscaler(c.runtime, provider, AutoscalerConfig(
+            min_workers=0, max_workers=2, idle_timeout_s=120.0,
+            update_interval_s=0.4)).start()
+
+        # -- phase 1: serve demand pulls a real node out of the provider
+        app = serve.deployment(
+            num_replicas=2, health_check_period_s=0.5,
+            health_check_timeout_s=2.0,
+            ray_actor_options={"num_cpus": 1.0,
+                               "resources": {"replica_slot": 1.0}})(
+            LLMServer).bind(
+            model="gpt-tiny",
+            engine_config={"max_batch": 4, "num_blocks": 64})
+        h = serve.run(app, timeout=300)
+        deadline = time.monotonic() + 240
+        while serve.status()["LLMServer"]["running"] != 2:
+            assert time.monotonic() < deadline, "replicas never came up"
+            time.sleep(0.5)
+        nodes1 = provider.non_terminated_nodes()
+        assert len(nodes1) == 1, (
+            f"serve demand should have launched exactly 1 provider node, "
+            f"got {len(nodes1)}")
+        doomed = nodes1[0]
+        on_doomed = [a.actor_id.hex() for a in
+                     c.runtime.gcs.actors_on_node(doomed)]
+        assert on_doomed, "no replica landed on the autoscaled node"
+        print(f"scale-up OK: node {doomed.hex()[:8]} launched by serve "
+              f"demand, hosts {len(on_doomed)} actor(s)")
+
+        # -- ground truth for the streams (chaos_smoke pattern)
+        n_clients, max_tokens = 4, 48
+        prompts = [[2, 5, 9], [1, 1, 4], [7, 3], [4, 8, 6, 2]]
+        model, params = build_model("gpt-tiny", seed=0)
+        ref = LLMEngine(model, params,
+                        EngineConfig(max_batch=4, num_blocks=64),
+                        name="truth")
+        streams = [ref.add_request(p, max_tokens=max_tokens, eos_id=None)
+                   for p in prompts]
+        ref.run_until_idle(timeout=300)
+        truth = [s.tokens(timeout=60) for s in streams]
+        print("ground truth computed")
+
+        # -- phase 2: live training engine, elastic, spread across nodes
+        # M here is the GLOBAL microbatch count (dp * num_microbatches):
+        # invariant across every resize the run rides through
+        fns, sp, mbs, tgts = _mlp_pure_dp(16, M=8, mb_size=4)
+        import optax
+
+        ckpt_dir = tempfile.mkdtemp(prefix="elastic_smoke_ck_")
+        eng = CompiledPipelineEngine(
+            fns, sp, optax.adam(1e-2), num_microbatches=4, dp=2,
+            channel_bytes=1 << 18, resources_per_stage={"CPU": 0.5, "stage_slot": 1.0},
+            checkpoint_dir=ckpt_dir, checkpoint_every=0)
+        eng.enable_elastic(min_dp=1, max_dp=2, grow_on_join=True)
+        n_on_doomed = sum(1 for row in eng._plans for p in row
+                          if p.node.node_id == doomed)
+        assert n_on_doomed >= 1, \
+            "no stage actor landed on the provider node"
+        dp_seen = []
+        losses = []
+        train_err = []
+        stop = threading.Event()
+        boundary = threading.Event()
+
+        def train_loop():
+            try:
+                while not stop.is_set():
+                    losses.append(eng.step(mbs, tgts, timeout=120))
+                    dp_seen.append(eng.dp)
+                    boundary.set()
+            except BaseException as e:  # noqa: BLE001 — asserted below
+                train_err.append(e)
+
+        trainer = threading.Thread(target=train_loop, name="train")
+        trainer.start()
+        boundary.wait(120)
+        assert dp_seen and dp_seen[-1] == 2, (
+            f"first step never landed: err={train_err!r} "
+            f"losses={losses} dp={dp_seen}")
+
+        # -- phase 3: clients stream while the scale-down is scripted
+        gens = [resilient_stream(h, {"tokens": prompts[i],
+                                     "max_tokens": max_tokens,
+                                     "eos_id": None})
+                for i in range(n_clients)]
+        got = [[] for _ in range(n_clients)]
+        cerrs = [None] * n_clients
+
+        def client(i):
+            try:
+                for tok in gens[i]:
+                    got[i].append(tok)
+            except BaseException as e:  # noqa: BLE001 — asserted below
+                cerrs[i] = e
+
+        cthreads = [threading.Thread(target=client, args=(i,))
+                    for i in range(n_clients)]
+        for t in cthreads:
+            t.start()
+        deadline = time.monotonic() + 240
+        while any(len(g) < 2 for g in got):  # prefills compiled, flowing
+            assert time.monotonic() < deadline, "streams never started"
+            time.sleep(0.2)
+
+        # grace generous enough that the drain (streams finishing on the
+        # marked replica) beats the axe on a slow CI box — the premature-
+        # axe race is tests/test_elastic.py's job, not this gate's
+        print(f"scripting preemption of {doomed.hex()[:8]} "
+              f"(grace 90s) with 4 live streams + dp=2 training")
+        provider.schedule_preemption(doomed, notice_in_s=0.0, grace_s=90.0)
+
+        # the training loop must shrink hands-off at a step boundary
+        deadline = time.monotonic() + 60
+        while not (dp_seen and dp_seen[-1] == 1):
+            assert not train_err, f"training failed: {train_err}"
+            assert time.monotonic() < deadline, \
+                f"engine never shrank; dp history tail {dp_seen[-5:]}"
+            time.sleep(0.2)
+        assert all(p.node.node_id != doomed
+                   for row in eng._plans for p in row)
+        print("training shrank to dp=1 off the doomed node")
+
+        # streams complete with zero failures, token-identical
+        for t in cthreads:
+            t.join(timeout=420)
+        assert not any(t.is_alive() for t in cthreads), "a client hung"
+        assert not any(cerrs), f"client errors: {cerrs}"
+        for i in range(n_clients):
+            assert got[i] == truth[i], (
+                f"stream {i} corrupted through the drain:\n"
+                f"  got  {got[i]}\n  want {truth[i]}")
+        print(f"4/4 streams complete + token-identical through the drain "
+              f"({sum(g.failovers for g in gens)} failover(s))")
+
+        # the doomed node leaves cleanly; a replacement node + replica
+        # arrive; the engine grows back — all autoscaler-driven
+        deadline = time.monotonic() + 180
+        while True:
+            live = provider.non_terminated_nodes()
+            grown = dp_seen and dp_seen[-1] == 2
+            serving = serve.status()["LLMServer"]["running"] == 2
+            if doomed not in live and len(live) >= 1 and grown and serving:
+                break
+            assert not train_err, f"training failed: {train_err}"
+            assert time.monotonic() < deadline, (
+                f"scale-up incomplete: nodes={[n.hex()[:8] for n in live]} "
+                f"dp={dp_seen[-1] if dp_seen else None} "
+                f"serve={serve.status()['LLMServer']}")
+            time.sleep(0.5)
+        print("scale-down -> scale-up complete: node drained + replaced, "
+              "dp back to 2, 2 replicas serving")
+
+        # -- phase 4: final-params check vs the fixed-size run
+        stop.set()
+        trainer.join(timeout=120)
+        assert not trainer.is_alive(), "training loop wedged"
+        assert not train_err, f"training failed: {train_err}"
+        ck = eng.save_checkpoint(blocking=True)
+        tail = [eng.step(mbs, tgts, timeout=120) for _ in range(3)]
+        import jax
+        import numpy as np
+
+        params_a = eng.get_params()
+        step_at_ck = CompiledPipelineEngine.load_checkpoint(ck)["step"]
+        eng.shutdown()
+        fixed = CompiledPipelineEngine(
+            fns, sp, optax.adam(1e-2), num_microbatches=4, dp=2,
+            channel_bytes=1 << 18, resources_per_stage={"CPU": 0.5, "stage_slot": 1.0})
+        try:
+            assert fixed.restore(ck) == step_at_ck
+            replay = [fixed.step(mbs, tgts, timeout=120) for _ in range(3)]
+            params_b = fixed.get_params()
+        finally:
+            fixed.shutdown()
+        assert tail == replay, (
+            f"elastic tail diverged from the fixed-size run: "
+            f"{tail} vs {replay}")
+        for a, b in zip(jax.tree.leaves(params_a),
+                        jax.tree.leaves(params_b)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        print(f"final-params check OK: elastic tail {tail} == fixed-size "
+              f"replay (bitwise)")
+
+        body = metrics._render()
+        assert 'ray_tpu_node_preemptions_total{outcome="drained"}' in body, \
+            "preemption not counted as drained"
+        assert "ray_tpu_resize_seconds" in body, "resize metric missing"
+        for direction in ("shrink", "grow"):
+            assert f'direction="{direction}"' in body, \
+                f"no {direction} resize recorded"
+        serve.shutdown()
+        print("elastic smoke OK")
+        return 0
+    finally:
+        try:
+            if sc is not None:
+                sc.stop()
+            if provider is not None:
+                provider.shutdown()
+        finally:
+            c.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
