@@ -145,6 +145,7 @@ def main() -> None:
             **_bench_dispatch(),
             **_bench_llm_serve(),
             **_bench_pipeline(),
+            **_bench_collectives(),
             **_bench_sharding(),
         },
     }))
@@ -275,6 +276,28 @@ def _bench_pipeline() -> dict:
         import traceback
 
         traceback.print_exc()  # a broken engine must not look like 0
+        return {}
+
+
+def _bench_collectives() -> dict:
+    """Quantized-collective rows (ISSUE 13, docs/COLLECTIVES.md):
+    host-plane ZeRO dp=2 sync time + per-rank bytes at a fixed 1M-param
+    vector, fp32 vs int8 (the <= 30% bytes acceptance bar rides along
+    as `zero_sync_bytes_ratio`), and the disagg prefill->decode
+    generate latency with the KV shipment raw vs quantized."""
+    try:
+        import ray_tpu
+        from bench_core import collective_codec_bench
+
+        ray_tpu.init(num_cpus=max(4, os.cpu_count() or 4))
+        try:
+            return collective_codec_bench()
+        finally:
+            ray_tpu.shutdown()
+    except Exception:
+        import traceback
+
+        traceback.print_exc()  # a broken codec must not look like 0
         return {}
 
 

@@ -407,6 +407,120 @@ def pipeline_train_bench() -> dict:
     return out
 
 
+class _CodecRank:
+    """One rank of the codec bench's dp=2 host-collective group: runs
+    the full ZeRO sync (reduce-scatter + shard update + all-gather)
+    over a fixed-size flat parameter vector, with or without a wire
+    codec, and reports wall time + the bytes its contributions put on
+    the wire."""
+
+    def __init__(self, rank: int, n: int, group: str):
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.parallel import collective
+        from ray_tpu.parallel.zero import ZeroUpdater
+
+        collective.create_collective_group(2, rank, group_name=group)
+        self._rank = rank
+        self._n = n
+        self._group = group
+        self._params = {"w": jnp.linspace(-1.0, 1.0, n,
+                                          dtype=jnp.float32)}
+        self._grads = {"w": jnp.linspace(1.0, -1.0, n,
+                                         dtype=jnp.float32)}
+        self._tx = optax.adam(1e-3)
+        self._ZeroUpdater = ZeroUpdater
+
+    def sync(self, codec, warmup: int, timed: int) -> dict:
+        import time as _t
+
+        import numpy as np
+
+        from ray_tpu.parallel import quant
+
+        z = self._ZeroUpdater(self._tx, 2, self._rank,
+                              group_name=self._group, grad_codec=codec)
+        z.init(self._params)
+        params = self._params
+        for _ in range(warmup):
+            params = z.update(params, self._grads)
+        t0 = _t.perf_counter()
+        for _ in range(timed):
+            params = z.update(params, self._grads)
+        ms = (_t.perf_counter() - t0) / timed * 1e3
+        vec = np.zeros((self._n,), np.float32)
+        leg = quant.quantize(vec, codec).nbytes() if codec \
+            else vec.nbytes
+        # one sync = grad reduce-scatter (full vector out) + param
+        # all-gather (1/dp shard out) per rank
+        shard = np.zeros((self._n // 2,), np.float32)
+        leg2 = quant.quantize(shard, codec).nbytes() if codec \
+            else shard.nbytes
+        return {"ms": round(ms, 3), "bytes": int(leg + leg2)}
+
+
+def collective_codec_bench() -> dict:
+    """Quantized-collective rows (ISSUE 13, docs/COLLECTIVES.md bench
+    methodology). Assumes an initialized cluster.
+
+    - ``zero_sync_ms_{fp32,int8}`` + ``bytes_moved_{fp32,int8}``: one
+      full ZeRO dp=2 sync (reduce-scatter + shard adam + all-gather)
+      over a fixed 1M-param fp32 vector on the host-collective plane;
+      bytes are the per-rank wire contribution per step (int8 payload
+      + per-block scales ~25.4% of fp32 — the <= 30% acceptance bar).
+      On this CPU sandbox the rendezvous-store round trip dominates
+      the sync time, so the ms win is modest here; the bytes column is
+      the DCN story.
+    - ``disagg_kv_ms_{raw,codec}``: one prefill->decode generate()
+      through the disagg cgraph channel with the KV shipment raw vs
+      int8-quantized (token-identical on gpt-tiny — pinned in
+      tests/test_collective_codec.py).
+    """
+    import ray_tpu
+
+    out: dict = {}
+    n = (1 << 18) if SMOKE else (1 << 20)
+    warmup, timed = (1, 2) if SMOKE else (2, 5)
+    R = ray_tpu.remote(_CodecRank)
+    try:
+        ranks = [R.remote(r, n, "codec-bench") for r in (0, 1)]
+        for codec, tag in ((None, "fp32"), ("int8", "int8")):
+            rows = ray_tpu.get(
+                [a.sync.remote(codec, warmup, timed) for a in ranks],
+                timeout=300)
+            out[f"zero_sync_ms_{tag}"] = max(r["ms"] for r in rows)
+            out[f"bytes_moved_{tag}"] = rows[0]["bytes"]
+        out["zero_sync_bytes_ratio"] = round(
+            out["bytes_moved_int8"] / out["bytes_moved_fp32"], 4)
+        for a in ranks:
+            ray_tpu.kill(a)
+    except Exception:
+        import traceback
+
+        traceback.print_exc()  # a broken sync must not look like 0
+    try:
+        from ray_tpu.serve.llm.disagg import DisaggLLM
+
+        reps = 2 if SMOKE else 4
+        for codec, tag in ((None, "raw"), ("int8", "codec")):
+            llm = DisaggLLM(model="gpt-tiny", codec=codec)
+            try:
+                llm.generate([1, 5, 9], max_tokens=8)  # compile warmup
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    llm.generate([1, 5, 9], max_tokens=8)
+                out[f"disagg_kv_ms_{tag}"] = round(
+                    (time.perf_counter() - t0) / reps * 1e3, 2)
+            finally:
+                llm.shutdown()
+    except Exception:
+        import traceback
+
+        traceback.print_exc()
+    return out
+
+
 def sharding_bench() -> dict:
     """Sharded-execution rows (ISSUE 11, docs/SHARDING.md bench
     methodology). MUST run in a process whose XLA_FLAGS forced >= 4

@@ -27,8 +27,17 @@ contract:
 
 Envelope: ``<II`` (flags, trace_len) + trace utf-8 + serialized body.
 flags bit 0 = the body is a serialized exception (error propagation
-through the graph); trace carries "trace_id:span_id" so per-stage SPANs
-link into one cross-process flow in ``timeline()``.
+through the graph); flags bits 8-15 carry the negotiated wire-codec id
+(0 = raw, cgraph/codec.py — the body's large float arrays are
+block-quantized and the READER decodes statelessly from this byte, so
+mixed raw/compressed traffic shares one channel and seq/error
+semantics never change); trace carries "trace_id:span_id" so per-stage
+SPANs link into one cross-process flow in ``timeline()``.
+
+Every producer-side ``send`` counts its envelope into
+``ray_tpu_cgraph_channel_bytes_total{edge,codec}`` (the codec label
+read from the envelope's own flag byte), so the bytes a codec saves on
+a given edge are scrape-visible (docs/OBSERVABILITY.md).
 """
 from __future__ import annotations
 
@@ -43,6 +52,13 @@ from ..exceptions import (ChannelFullError, CompiledGraphClosedError,
 from ..util import metrics as _metrics
 
 FLAG_ERROR = 1
+# bits 8-15 of flags: wire-codec id stamped by the producer at pack
+# time; 0 = raw body. The mapping is part of the envelope format so
+# readers decode without per-edge negotiation state.
+FLAG_CODEC_SHIFT = 8
+FLAG_CODEC_MASK = 0xFF << FLAG_CODEC_SHIFT
+CODEC_IDS = {"int8": 1, "e4m3": 2}
+CODEC_NAMES = {v: k for k, v in CODEC_IDS.items()}
 
 # fault-injection hook (ray_tpu.chaos): None until chaos.enable()
 # installs an engine; hot paths pay one global is-None test
@@ -57,6 +73,24 @@ _H_EDGE_WAIT = _metrics.Histogram(
     "ray_tpu_cgraph_edge_wait_seconds",
     "blocking wait for a compiled-graph channel slot (read side)",
     boundaries=_metrics.FAST_BOUNDARIES, tag_keys=("edge",))
+_C_CHAN_BYTES = _metrics.Counter(
+    "ray_tpu_cgraph_channel_bytes_total",
+    "envelope bytes written to compiled-graph channels, by edge and "
+    "the envelope's own wire-codec byte",
+    tag_keys=("edge", "codec"))
+
+
+def _count_send(edge: str, data: bytes) -> None:
+    """Producer-side bytes accounting; codec label comes from the
+    envelope's flag byte so the counter reports what actually shipped
+    (a small payload under the codec floor counts as raw)."""
+    try:
+        flags = _ENV.unpack_from(data, 0)[0]
+        codec = CODEC_NAMES.get(
+            (flags & FLAG_CODEC_MASK) >> FLAG_CODEC_SHIFT, "none")
+    except struct.error:
+        codec = "none"
+    _C_CHAN_BYTES.inc(len(data), tags={"edge": edge, "codec": codec})
 
 
 def pack_envelope(flags: int, trace: str, body: bytes) -> bytes:
@@ -185,6 +219,7 @@ class ShmChannel:
             struct.pack_into("<Q", self._mv, off, len(data))
             self._mv[off + 8:off + 8 + len(data)] = data
         struct.pack_into("<Q", self._mv, 0, w + 1)  # publish
+        _count_send(self.edge or self._name, data)
 
     # -- reader ----------------------------------------------------------
 
@@ -311,6 +346,7 @@ class RpcSender:
         seq = self._seq
         self._seq += 1
         self._send_fn(self.cid, seq, data)
+        _count_send(self.edge or self.cid, data)
 
     def close(self) -> None:
         pass

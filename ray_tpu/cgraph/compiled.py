@@ -34,6 +34,7 @@ from ..util import metrics as _metrics
 from ..util import tracing
 from .channel import (FLAG_ERROR, QueueChannel, RpcSender, ShmChannel,
                       pack_envelope, segment_size, unpack_envelope)
+from .codec import decode_value, encode_value
 from .dag import (ClassMethodNode, DAGNode, InputNode, MultiOutputNode,
                   topological_nodes)
 
@@ -85,12 +86,15 @@ class CompiledDAG:
     ``DAGNode.experimental_compile()``); never constructed directly."""
 
     def __init__(self, rt, output_node: DAGNode, channel_bytes: int,
-                 max_inflight: int):
+                 max_inflight: int, codec: Optional[str] = None):
         self._rt = rt
         self._output_node = output_node
         self.graph_id = os.urandom(16)
         self._channel_bytes = int(channel_bytes)
         self._max_inflight = int(max_inflight)
+        # wire codec for every edge payload (cgraph/codec.py): large
+        # float arrays ship block-quantized; None = raw envelopes
+        self._codec = codec
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         # serializes execute(): input-slot writes must land in issue
@@ -147,7 +151,8 @@ class CompiledDAG:
                 self._issue_t[seq] = time.perf_counter()
             ctx = tracing.current_context()
             trace = f"{ctx[0]}:{ctx[1]}" if ctx else ""
-            env = pack_envelope(0, trace, serialization.dumps(value))
+            cbits, body = encode_value(value, self._codec)
+            env = pack_envelope(cbits, trace, body)
             sent = 0
             try:
                 for w in self._input_writers:
@@ -255,7 +260,7 @@ class CompiledDAG:
             if flags & FLAG_ERROR:
                 outs.append(("err", serialization.loads(body)))
             else:
-                outs.append(("val", serialization.loads(body)))
+                outs.append(("val", decode_value(flags, body)))
         self._partial_outs = []
         err = next((o for o in outs if o[0] == "err"), None)
         if err is not None:
@@ -381,7 +386,8 @@ class CompiledDAG:
 
 
 def compile_dag(output_node: DAGNode, channel_bytes: Optional[int] = None,
-                max_inflight: int = 16) -> CompiledDAG:
+                max_inflight: int = 16,
+                codec: Optional[str] = None) -> CompiledDAG:
     from ..core import runtime as runtime_mod
 
     rt = runtime_mod.get_runtime()
@@ -421,8 +427,12 @@ def compile_dag(output_node: DAGNode, channel_bytes: Optional[int] = None,
                 f"compiled graphs (streaming methods need the dynamic "
                 f".remote() path)")
 
+    if codec is not None:
+        from ..parallel.quant import check_codec
+
+        check_codec(codec)
     dag = CompiledDAG(rt, output_node, channel_bytes
-                      or DEFAULT_CHANNEL_BYTES, max_inflight)
+                      or DEFAULT_CHANNEL_BYTES, max_inflight, codec=codec)
     try:
         _compile_into(dag, rt, cnodes, inputs[0], terminals,
                       multi is not None)
@@ -559,6 +569,7 @@ def _compile_into(dag: CompiledDAG, rt, cnodes, input_node, terminals,
         nspec = {"key": nkey, "method": n._method_name,
                  "num_returns": int(n._num_returns),
                  "concurrency_group": n._concurrency_group,
+                 "codec": dag._codec,
                  "args": [argspec(a) for a in n._bound_args],
                  "kwargs": {k: argspec(v)
                             for k, v in n._bound_kwargs.items()},

@@ -22,13 +22,17 @@ class DAGNode:
     """Base: a node in a statically-declared dataflow graph."""
 
     def experimental_compile(self, channel_bytes: Optional[int] = None,
-                             max_inflight: int = 16):
+                             max_inflight: int = 16,
+                             codec: Optional[str] = None):
         """Compile the graph rooted at this output node. See
-        ``CompiledDAG`` for the execution surface."""
+        ``CompiledDAG`` for the execution surface. ``codec``
+        ("int8"/"e4m3", docs/COLLECTIVES.md) block-quantizes large
+        float arrays in every edge payload — lossy, ~1/4 the channel
+        bytes; error/seq semantics unchanged."""
         from .compiled import compile_dag
 
         return compile_dag(self, channel_bytes=channel_bytes,
-                           max_inflight=max_inflight)
+                           max_inflight=max_inflight, codec=codec)
 
     def _upstream(self) -> List["DAGNode"]:
         return []

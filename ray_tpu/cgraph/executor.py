@@ -27,6 +27,7 @@ from ..util import metrics as _metrics
 from ..util.logs import get_logger
 from .channel import (FLAG_ERROR, QueueChannel, RpcSender, ShmChannel,
                       pack_envelope, unpack_envelope)
+from .codec import decode_value, encode_value
 
 _H_NODE_EXEC = _metrics.Histogram(
     "ray_tpu_cgraph_node_exec_seconds",
@@ -47,7 +48,7 @@ _log = get_logger("ray_tpu.cgraph")
 
 class _NodePlan:
     __slots__ = ("key", "method", "fn", "num_returns", "concurrency_group",
-                 "args", "kwargs", "outs")
+                 "args", "kwargs", "outs", "codec")
 
 
 class _GraphRun:
@@ -211,6 +212,10 @@ class CGraphExecutor:
             np.kwargs = {k: self._load_argspec(a)
                          for k, a in nspec["kwargs"].items()}
             np.outs = [self._make_writer(w, run) for w in nspec["outs"]]
+            # wire codec negotiated at compile time for this node's
+            # output envelopes (cgraph/codec.py); readers are stateless
+            # — the codec id rides in each envelope's flag byte
+            np.codec = nspec.get("codec")
             run.nodes.append(np)
 
     @staticmethod
@@ -289,7 +294,7 @@ class CGraphExecutor:
                     if flags & FLAG_ERROR:
                         err_bytes = body
                         return None
-                    return serialization.loads(body)
+                    return decode_value(flags, body)
                 # ("local", key): same-actor edge, no channel round trip
                 state, val = local[spec[1]]
                 if state == "err":
@@ -335,8 +340,11 @@ class CGraphExecutor:
                 # in-flight-memory property
                 if not run.iterative:
                     local[np.key] = ("val", value)
-                body = serialization.dumps(value) if np.outs else b""
-                env = pack_envelope(0, trace_out, body)
+                if np.outs:
+                    cbits, body = encode_value(value, np.codec)
+                else:
+                    cbits, body = 0, b""
+                env = pack_envelope(cbits, trace_out, body)
             for w in np.outs:
                 w.send(env)
 

@@ -29,6 +29,7 @@ from .collective import (allgather, allreduce, barrier, broadcast,
                          create_collective_group, destroy_collective_group,
                          get_group, recv, reduce, reducescatter, send)
 from .mesh_group import MeshGroup, MeshWorkerMixin
+from .quant import QuantizedTensor, dequantize, quantize
 from .sharding import (FsdpPlane, MeshOwner, SpecLayout, lower_jit,
                        lower_shard_map)
 from .zero import ZeroUpdater, make_zero_update_spmd
@@ -43,4 +44,5 @@ __all__ = [
     "MeshGroup", "MeshWorkerMixin",
     "MeshOwner", "SpecLayout", "FsdpPlane", "lower_jit", "lower_shard_map",
     "ZeroUpdater", "make_zero_update_spmd",
+    "QuantizedTensor", "quantize", "dequantize",
 ]

@@ -10,6 +10,16 @@ ppermute over ICI inside jit — see ray_tpu.parallel.mesh); this module is
 the host/DCN plane used for control tensors, rollout-weight broadcast, and
 CPU-side aggregation, implemented over the object store with a named
 rendezvous actor instead of NCCL rings.
+
+Every collective that moves tensors accepts ``codec=`` — an EQuARX-style
+block-scaled wire codec (``"int8"`` / ``"e4m3"``, parallel/quant.py):
+each rank quantizes its contribution BEFORE it crosses the wire (per
+block absmax scales, deterministic rounding) and every reduction runs
+over the dequantized fp32 values, so accumulation precision is full
+even when the wire carries ~1/4 of the bytes. ``codec=None`` (default)
+is byte-identical to the pre-codec behavior. Bytes shipped per op are
+counted in ``ray_tpu_collective_bytes_total{op,codec}``
+(docs/OBSERVABILITY.md; design in docs/COLLECTIVES.md).
 """
 from __future__ import annotations
 
@@ -20,6 +30,15 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
+
+from ..util import metrics as _metrics
+from . import quant as _quant
+
+_C_BYTES = _metrics.Counter(
+    "ray_tpu_collective_bytes_total",
+    "bytes this process shipped into host collectives (the rank's "
+    "wire contribution per op, after any codec)",
+    tag_keys=("op", "codec"))
 
 _REDUCE_OPS = {
     "sum": lambda xs: _tree_reduce(xs, np.add),
@@ -56,6 +75,11 @@ class _CollectiveStore:
         if row is None or len(row) < self._world:
             return None
         return [row[r] for r in range(self._world)]
+
+    def present(self, seq: int) -> List[int]:
+        """Ranks that have deposited for ``seq`` — the timeout
+        diagnostic surface (which ranks a wedged sync is missing)."""
+        return sorted(self._slots.get(seq, {}))
 
     def done(self, seq: int, rank: int):
         """Each rank acks after consuming; last ack frees the row."""
@@ -108,8 +132,12 @@ class CollectiveGroup:
         self._seq += 1
         return self._seq
 
-    def _exchange(self, value, timeout: float = 120.0) -> List[Any]:
+    def _exchange(self, value, timeout: float = 120.0,
+                  op: str = "exchange",
+                  codec: Optional[str] = None) -> List[Any]:
         seq = self._next_seq()
+        _C_BYTES.inc(_quant.wire_bytes(value),
+                     tags={"op": op, "codec": codec or "none"})
         ray_tpu.get(self._store.put.remote(seq, self.rank, value))
         deadline = time.monotonic() + timeout
         delay = 0.0005
@@ -119,9 +147,20 @@ class CollectiveGroup:
                 self._store.done.remote(seq, self.rank)
                 return row
             if time.monotonic() > deadline:
+                # name exactly what a wedged multi-node sync needs: the
+                # group, the op, the seq, and which ranks never showed
+                try:
+                    present = ray_tpu.get(
+                        self._store.present.remote(seq), timeout=5.0)
+                    missing = [r for r in range(self.world_size)
+                               if r not in present]
+                    who = f"missing ranks {missing} of {self.world_size}"
+                except Exception:
+                    who = "missing-rank query failed (store unreachable?)"
                 raise TimeoutError(
-                    f"collective {self.group_name} seq={seq} rank={self.rank} "
-                    f"timed out after {timeout}s")
+                    f"collective {op} on group {self.group_name!r} "
+                    f"seq={seq} timed out after {timeout}s at rank "
+                    f"{self.rank}: {who}")
             time.sleep(delay)
             delay = min(delay * 2, 0.05)
 
@@ -163,40 +202,63 @@ def get_group(group_name: str = "default") -> CollectiveGroup:
             "process; call create_collective_group first") from None
 
 
-def allreduce(tensor, group_name: str = "default", op: str = "sum"):
+def _encode(tensor, codec: Optional[str]):
+    """Quantize a contribution for the wire (None = pass through)."""
+    if codec is None:
+        return tensor
+    _quant.check_codec(codec)
+    return _quant.quantize(np.asarray(tensor), codec)
+
+
+def _decode_row(row: List[Any]) -> List[Any]:
+    """Dequantize gathered contributions to fp32 — reductions always
+    accumulate over full-precision values, never over the narrow
+    payloads themselves."""
+    return [_quant.dequantize(v) if isinstance(v, _quant.QuantizedTensor)
+            else v for v in row]
+
+
+def allreduce(tensor, group_name: str = "default", op: str = "sum",
+              codec: Optional[str] = None):
     g = get_group(group_name)
-    row = g._exchange(tensor)
-    return _REDUCE_OPS[op](row)
+    row = g._exchange(_encode(tensor, codec), op="allreduce", codec=codec)
+    return _REDUCE_OPS[op](_decode_row(row))
 
 
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
-           op: str = "sum"):
+           op: str = "sum", codec: Optional[str] = None):
     g = get_group(group_name)
-    row = g._exchange(tensor)
+    row = g._exchange(_encode(tensor, codec), op="reduce", codec=codec)
     if g.rank == dst_rank:
-        return _REDUCE_OPS[op](row)
+        return _REDUCE_OPS[op](_decode_row(row))
     return tensor
 
 
-def allgather(tensor, group_name: str = "default") -> List[Any]:
-    return get_group(group_name)._exchange(tensor)
-
-
-def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
+def allgather(tensor, group_name: str = "default",
+              codec: Optional[str] = None) -> List[Any]:
     g = get_group(group_name)
-    row = g._exchange(tensor)
-    total = _REDUCE_OPS[op](row)
+    row = g._exchange(_encode(tensor, codec), op="allgather", codec=codec)
+    return _decode_row(row)
+
+
+def reducescatter(tensor, group_name: str = "default", op: str = "sum",
+                  codec: Optional[str] = None):
+    g = get_group(group_name)
+    row = g._exchange(_encode(tensor, codec), op="reducescatter",
+                      codec=codec)
+    total = _REDUCE_OPS[op](_decode_row(row))
     return np.array_split(np.asarray(total), g.world_size)[g.rank]
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     g = get_group(group_name)
-    row = g._exchange(tensor if g.rank == src_rank else None)
+    row = g._exchange(tensor if g.rank == src_rank else None,
+                      op="broadcast")
     return row[src_rank]
 
 
 def barrier(group_name: str = "default") -> None:
-    get_group(group_name)._exchange(0)
+    get_group(group_name)._exchange(0, op="barrier")
 
 
 def send(tensor, dst_rank: int, group_name: str = "default",

@@ -1,0 +1,181 @@
+"""Block-scaled quantization codecs for slow-wire transfers.
+
+EQuARX ("Efficient Quantized AllReduce in XLA", PAPERS.md) shows that
+block-scaled low-precision collectives recover near-fp32 quality at a
+fraction of the bytes: split the flat tensor into fixed-size blocks,
+scale each block by its absmax so the payload fits the narrow format's
+range, ship narrow payload + one fp32 scale per block, and accumulate
+the *dequantized* (fp32) values at the reduce point. This module is the
+numpy/host half of that recipe — the wire format every slow-wire hop in
+the framework shares:
+
+- host collectives (`parallel/collective.py` ``codec=``) quantize the
+  contribution each rank deposits in the rendezvous store;
+- the ZeRO dp sync (`parallel/zero.py` ``grad_codec=``) compresses the
+  gradient reduce-scatter and the parameter all-gather;
+- cgraph channels (`cgraph/codec.py`) quantize large float arrays
+  inside envelope payloads (pipeline activations/cotangents, disagg
+  prefill→decode KV blocks).
+
+The in-jit analog (quantize → all_to_all → dequantize under shard_map)
+lives in `parallel/sharding/codec.py`.
+
+Codecs:
+
+- ``"int8"``: symmetric linear int8; per-block ``scale = absmax / 127``,
+  payload ``rint(x / scale)`` (ties-to-even — deterministic, and the
+  rounding numpy and XLA agree on). 4 bytes -> 1 + 4/block.
+- ``"e4m3"``: float8 e4m3fn (4 exponent / 3 mantissa bits, max 448)
+  via ml_dtypes (a jax dependency — no new install); per-block
+  ``scale = absmax / 448`` so every block spends the format's full
+  dynamic range. Same wire size as int8; relative error is more
+  uniform across magnitudes within a block.
+
+Both dequantize to fp32 and cast back to the source dtype; reductions
+over quantized rows always happen AFTER dequantization, in fp32
+("fp32 accumulation of scales").
+
+Design notes (docs/COLLECTIVES.md): scales are fp32 absmax — never
+rounded themselves; all-zero blocks keep scale 0 and decode to exact
+zeros; payload + scales ship as one picklable :class:`QuantizedTensor`.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "CODECS", "DEFAULT_BLOCK", "QuantizedTensor", "check_codec",
+    "dequantize", "quantize", "wire_bytes",
+]
+
+CODECS = ("int8", "e4m3")
+DEFAULT_BLOCK = 256
+
+_INT8_MAX = 127.0
+_E4M3_MAX = 448.0  # ml_dtypes.finfo(float8_e4m3fn).max
+
+
+def check_codec(codec: Optional[str]) -> Optional[str]:
+    """Validate a codec name (None passes through)."""
+    if codec is None:
+        return None
+    if codec not in CODECS:
+        raise ValueError(
+            f"unknown codec {codec!r}; known codecs: {CODECS} "
+            f"(None = full precision)")
+    return codec
+
+
+class QuantizedTensor:
+    """One block-scaled quantized array: narrow payload + fp32 scales +
+    the metadata to reconstruct shape/dtype. Picklable — this IS the
+    wire record the host collectives and cgraph channels ship."""
+
+    __slots__ = ("codec", "shape", "dtype", "block", "payload", "scales")
+
+    def __init__(self, codec: str, shape: Tuple[int, ...], dtype: str,
+                 block: int, payload: np.ndarray, scales: np.ndarray):
+        self.codec = codec
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.block = int(block)
+        self.payload = payload   # int8 [nblocks, block] (e4m3: uint8 bits)
+        self.scales = scales     # float32 [nblocks]
+
+    def __getstate__(self):
+        return (self.codec, self.shape, self.dtype, self.block,
+                self.payload, self.scales)
+
+    def __setstate__(self, st):
+        (self.codec, self.shape, self.dtype, self.block,
+         self.payload, self.scales) = st
+
+    def nbytes(self) -> int:
+        """Bytes this record puts on the wire (payload + scales)."""
+        return int(self.payload.nbytes + self.scales.nbytes)
+
+    def source_nbytes(self) -> int:
+        """Bytes the full-precision original would have shipped."""
+        return int(np.prod(self.shape, dtype=np.int64)
+                   * np.dtype(self.dtype).itemsize) if self.shape else \
+            np.dtype(self.dtype).itemsize
+
+    def __repr__(self) -> str:
+        return (f"QuantizedTensor(codec={self.codec}, shape={self.shape},"
+                f" dtype={self.dtype}, block={self.block},"
+                f" wire={self.nbytes()}B)")
+
+
+def _block_view(flat: np.ndarray, block: int) -> np.ndarray:
+    """Pad to a block multiple and view as [nblocks, block]."""
+    pad = (-flat.size) % block
+    if pad:
+        flat = np.concatenate([flat, np.zeros((pad,), flat.dtype)])
+    return flat.reshape(-1, block)
+
+
+def quantize(arr, codec: str = "int8",
+             block: int = DEFAULT_BLOCK) -> QuantizedTensor:
+    """Block-scaled quantization of an array-like to a wire record.
+
+    Deterministic: same input bytes -> same output bytes, on every
+    host (pure numpy, ties-to-even rounding).
+    """
+    check_codec(codec)
+    a = np.asarray(arr)
+    src_dtype = str(a.dtype)
+    flat = np.ascontiguousarray(a, dtype=np.float32).ravel()
+    blocks = _block_view(flat, block)
+    absmax = np.max(np.abs(blocks), axis=1)
+    if codec == "int8":
+        scales = (absmax / _INT8_MAX).astype(np.float32)
+        # all-zero blocks: scale 0 -> divide-by-1, payload exact zeros
+        denom = np.where(scales > 0.0, scales, 1.0)[:, None]
+        q = np.rint(blocks / denom)
+        payload = np.clip(q, -_INT8_MAX, _INT8_MAX).astype(np.int8)
+    else:  # e4m3
+        import ml_dtypes
+
+        scales = (absmax / _E4M3_MAX).astype(np.float32)
+        denom = np.where(scales > 0.0, scales, 1.0)[:, None]
+        scaled = (blocks / denom).astype(ml_dtypes.float8_e4m3fn)
+        payload = scaled.view(np.uint8)
+    return QuantizedTensor(codec, a.shape, src_dtype, block, payload,
+                           scales)
+
+
+def dequantize(qt: QuantizedTensor) -> np.ndarray:
+    """Wire record -> array in the source shape/dtype. Values decode in
+    fp32 (payload * per-block scale) before the final dtype cast."""
+    if qt.codec == "int8":
+        vals = qt.payload.astype(np.float32)
+    else:
+        import ml_dtypes
+
+        vals = qt.payload.view(ml_dtypes.float8_e4m3fn).astype(np.float32)
+    out = (vals * qt.scales[:, None]).ravel()
+    n = int(np.prod(qt.shape, dtype=np.int64)) if qt.shape else 1
+    out = out[:n].reshape(qt.shape)
+    return out.astype(np.dtype(qt.dtype), copy=False)
+
+
+def wire_bytes(value) -> int:
+    """Bytes a collective contribution occupies on the wire: quantized
+    records report payload+scales, arrays report nbytes, scalars their
+    numpy size; opaque values report 0 (counted nowhere rather than
+    paying a serialization just to measure)."""
+    if isinstance(value, QuantizedTensor):
+        return value.nbytes()
+    if isinstance(value, np.ndarray):
+        return int(value.nbytes)
+    if isinstance(value, (int, float, np.number, bool)):
+        return int(np.asarray(value).nbytes)
+    try:
+        a = np.asarray(value)
+        if a.dtype != object:
+            return int(a.nbytes)
+    except Exception:
+        pass
+    return 0

@@ -1,0 +1,135 @@
+"""In-jit quantized collective kernels — the shard_map codec plane.
+
+The device-side half of the EQuARX recipe (parallel/quant.py is the
+host/numpy half, docs/COLLECTIVES.md the design): inside a jitted
+shard_map program, a quantized reduce-scatter is
+
+    quantize (per-block absmax) → all_to_all (narrow payload + fp32
+    scales move over the wire) → dequantize → fp32 sum
+
+— the interconnect carries ~1/4 of the fp32 bytes while every
+accumulation happens in fp32 AFTER dequantization, exactly like the
+host plane. ``jax.lax.psum_scatter`` itself would sum in transit (and
+sum int8 payloads into garbage), so the kernel decomposes it: the
+all_to_all delivers each device the OTHER replicas' quantized images of
+*its* chunk, and the sum runs locally.
+
+Kernels here are written for shard_map bodies in the GC020/GC021
+idiom (docs/GRAFTCHECK.md): collectives name only the axis the caller
+passes — which the *enclosing* shard_map must bind — and the
+:func:`lower_quantized_scatter` builder wraps the body through
+``lower_shard_map`` so ``axis_names`` is always owner-bound.
+
+Customers: ``parallel.zero.make_zero_update_spmd(grad_codec=...)``
+swaps its gradient psum_scatter for :func:`quantized_scatter_mean`;
+the train backends reach it through the same knob.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+__all__ = [
+    "dequantize_blocks", "lower_quantized_scatter", "quantize_blocks",
+    "quantized_scatter_mean",
+]
+
+_INT8_MAX = 127.0
+_E4M3_MAX = 448.0
+
+
+def quantize_blocks(x, codec: str = "int8", block: int = 256):
+    """Pure per-block quantization of ``x`` along its LAST dim (must be
+    a multiple of ``block``): -> ``(payload, scales)`` where payload is
+    int8 (or e4m3 bits as uint8) shaped like ``x`` and scales is fp32
+    with the last dim reduced to blocks. Deterministic ties-to-even
+    rounding — the same grid the host codec lands on."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..quant import check_codec
+
+    check_codec(codec)
+    shape = x.shape
+    blocks = x.reshape(shape[:-1] + (shape[-1] // block, block))
+    absmax = jnp.max(jnp.abs(blocks), axis=-1)
+    if codec == "int8":
+        scales = (absmax / _INT8_MAX).astype(jnp.float32)
+        denom = jnp.where(scales > 0.0, scales, 1.0)[..., None]
+        q = jnp.clip(jnp.round(blocks / denom), -_INT8_MAX, _INT8_MAX)
+        payload = q.astype(jnp.int8)
+    else:  # e4m3
+        scales = (absmax / _E4M3_MAX).astype(jnp.float32)
+        denom = jnp.where(scales > 0.0, scales, 1.0)[..., None]
+        f8 = (blocks / denom).astype(jnp.float8_e4m3fn)
+        # bitcast for transport: collectives over u8 are supported on
+        # every backend; the receiver bitcasts back before dequantize
+        payload = jax.lax.bitcast_convert_type(f8, jnp.uint8)
+    return payload.reshape(shape[:-1] + (-1, block)), scales
+
+
+def dequantize_blocks(payload, scales, codec: str = "int8"):
+    """Inverse of :func:`quantize_blocks` (fp32 out, blocks merged back
+    into the last dim)."""
+    import jax
+    import jax.numpy as jnp
+
+    if codec == "int8":
+        vals = payload.astype(jnp.float32)
+    else:
+        vals = jax.lax.bitcast_convert_type(
+            payload, jnp.float8_e4m3fn).astype(jnp.float32)
+    out = vals * scales[..., None]
+    return out.reshape(out.shape[:-2] + (-1,))
+
+
+def quantized_scatter_mean(g, axis: str, world: int,
+                           codec: str = "int8", block: int = 256):
+    """Quantized reduce-scatter-mean INSIDE a shard_map body.
+
+    ``g``: this replica's full flat gradient ``[world * chunk]``
+    (``axis`` must be bound by the enclosing shard_map). Returns this
+    device's ``[chunk]`` slice of the cross-replica MEAN. The wire
+    carries the narrow payload + per-block fp32 scales; the sum over
+    replicas runs in fp32 after dequantize.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    chunk = g.shape[0] // world
+    gb = g.reshape(world, chunk)
+    pad = (-chunk) % block
+    if pad:
+        gb = jnp.pad(gb, ((0, 0), (0, pad)))
+    payload, scales = quantize_blocks(gb, codec, block)
+    # row r of payload is the image of rank r's chunk: all_to_all hands
+    # each device every replica's image of ITS chunk (row axis 0)
+    wire_q = jax.lax.all_to_all(payload, axis, split_axis=0,
+                                concat_axis=0)
+    wire_s = jax.lax.all_to_all(scales, axis, split_axis=0,
+                                concat_axis=0)
+    deq = dequantize_blocks(wire_q, wire_s, codec)  # [world, chunk+pad]
+    summed = jnp.sum(deq, axis=0)[:chunk]
+    return summed / world
+
+
+def lower_quantized_scatter(owner, axis: str, codec: str = "int8",
+                            block: int = 256,
+                            jit: bool = True) -> Callable:
+    """Build a jitted ``grads_stacked [world, n] -> mean shard
+    [ceil(n/world)]-per-device`` program over an owning mesh — the
+    standalone spelling of the kernel for callers outside
+    ``make_zero_update_spmd`` (and the shape graftcheck's codec
+    fixtures pin). ``axis`` must be one of the owner's mesh axes."""
+    from jax.sharding import PartitionSpec as P
+
+    from .lower import lower_shard_map
+
+    world = owner.mesh.shape[axis]
+
+    def body(g_stacked):
+        return quantized_scatter_mean(g_stacked[0], axis, world,
+                                      codec=codec, block=block)
+
+    return lower_shard_map(body, owner, in_specs=(P(axis),),
+                           out_specs=P(axis),
+                           axis_names=frozenset({axis}), jit=jit)

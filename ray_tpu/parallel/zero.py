@@ -222,16 +222,34 @@ class ZeroUpdater:
     :meth:`update` once per optimizer step. The gradient mean, shard
     update, and parameter gather all ride the named collective group —
     every rank must call update() collectively.
+
+    ``grad_codec`` (``"int8"``/``"e4m3"``, docs/COLLECTIVES.md)
+    compresses BOTH wire legs of the dp sync with the block-scaled
+    codec: the gradient reduce-scatter ships quantized grads (summed in
+    fp32 after dequantize) and the parameter all-gather ships quantized
+    fresh shards. So the wire-precision params don't become the
+    optimization state itself (sub-quantization-step updates would
+    round away and training would stall on the int8 grid), each rank
+    keeps a persistent fp32 MASTER copy of its own shard: the optimizer
+    updates the master, the wire carries its quantized image, and
+    compute everywhere runs on the wire-precision params — standard
+    master-weight mixed precision, applied to the ZeRO gather.
+    ``grad_codec=None`` is bit-identical to the pre-codec updater.
     """
 
     def __init__(self, tx, world: int, rank: int,
-                 group_name: str = "default"):
+                 group_name: str = "default",
+                 grad_codec: Optional[str] = None):
+        from . import quant as _quant
+
         self.tx = tx
         self.world = int(world)
         self.rank = int(rank)
         self.group_name = group_name
+        self.grad_codec = _quant.check_codec(grad_codec)
         self._spec: Optional[TreeSpec] = None
         self._opt_state = None
+        self._master = None   # fp32 shard master copy (codec path only)
         self._jit_update = None
 
     def init(self, params) -> "ZeroUpdater":
@@ -241,6 +259,8 @@ class ZeroUpdater:
         self._spec = spec
         lo, hi = shard_bounds(spec.size, self.world)[self.rank]
         self._opt_state = jax.jit(self.tx.init)(flat[lo:hi])
+        if self.grad_codec is not None:
+            self._master = flat[lo:hi]
 
         @jax.jit
         def _upd(g_shard, opt_state, p_shard):
@@ -260,20 +280,38 @@ class ZeroUpdater:
     def opt_state(self):
         """This rank's optimizer-state SHARD (checkpointing surface —
         the pipeline engine persists one shard per dp rank and hands it
-        back through :meth:`set_opt_state` on restore)."""
+        back through :meth:`set_opt_state` on restore). With a
+        ``grad_codec`` the fp32 master shard rides along as a shard-
+        sized leaf (``{"tx": ..., "master": ...}``) so the elastic
+        reshard vocabulary (merge/split over shard-sized leaves) moves
+        it across dp widths like any other moment."""
+        if self.grad_codec is not None:
+            return {"tx": self._opt_state,
+                    "master": np.asarray(self._master)}
         return self._opt_state
 
     def set_opt_state(self, state) -> None:
         """Restore this rank's shard (must come from the same (rank,
-        world, param-tree) layout it was saved under)."""
+        world, param-tree) layout it was saved under). Accepts both the
+        raw optimizer state and the codec-era ``{"tx", "master"}``
+        wrapper; a raw state under a codec updater re-seeds the master
+        from the next update's incoming params."""
         if self._spec is None:
             raise RuntimeError("ZeroUpdater.set_opt_state() before init()")
-        self._opt_state = state
+        if isinstance(state, dict) and set(state) == {"tx", "master"}:
+            self._opt_state = state["tx"]
+            self._master = state["master"]
+        else:
+            self._opt_state = state
+            if self.grad_codec is not None:
+                self._master = None  # lazily re-seeded at next update()
 
     def update(self, params, grads):
         """Collective optimizer step: reduce-scatter the gradient mean,
         update this rank's shard, all-gather fresh parameters. Returns
-        the full updated parameter pytree."""
+        the full updated parameter pytree. With ``grad_codec`` both
+        collectives ship block-scaled quantized payloads and the
+        optimizer runs on this rank's fp32 master shard."""
         import jax.numpy as jnp
 
         from . import collective
@@ -285,16 +323,25 @@ class ZeroUpdater:
             raise ValueError(
                 f"grad tree size {gspec.size} != param tree size "
                 f"{self._spec.size}")
+        codec = self.grad_codec
         # reducescatter SUMS then slices; divide for the dp mean
+        # (codec: rows dequantize to fp32 BEFORE the sum, so gradient
+        # accumulation precision is full — only the wire is narrow)
         g_shard = collective.reducescatter(
-            np.asarray(flat_g), self.group_name) / self.world
+            np.asarray(flat_g), self.group_name, codec=codec) / self.world
         flat_p, _ = flatten_tree(params)
         lo, hi = shard_bounds(self._spec.size, self.world)[self.rank]
+        if codec is not None and self._master is None:
+            self._master = flat_p[lo:hi]
+        p_shard = flat_p[lo:hi] if codec is None \
+            else jnp.asarray(self._master, dtype=self._spec.dtype)
         new_shard, self._opt_state = self._jit_update(
             jnp.asarray(g_shard, dtype=self._spec.dtype),
-            self._opt_state, flat_p[lo:hi])
+            self._opt_state, p_shard)
+        if codec is not None:
+            self._master = new_shard
         parts = collective.allgather(np.asarray(new_shard),
-                                     self.group_name)
+                                     self.group_name, codec=codec)
         full = jnp.asarray(np.concatenate(parts), dtype=self._spec.dtype)
         return unflatten_tree(full, self._spec)
 
@@ -304,9 +351,20 @@ class ZeroUpdater:
 # ---------------------------------------------------------------------------
 
 
-def make_zero_update_spmd(tx, mesh, axis: str = "dp"
+def make_zero_update_spmd(tx, mesh, axis: str = "dp",
+                          grad_codec: Optional[str] = None,
+                          codec_block: int = 256
                           ) -> Tuple[Callable, Callable]:
     """Build the in-mesh sharded update: ``(init_fn, update_fn)``.
+
+    ``grad_codec`` ("int8"/"e4m3") swaps the gradient ``psum_scatter``
+    for the quantized scatter kernel
+    (parallel/sharding/codec.quantized_scatter_mean): per-block absmax
+    quantize → all_to_all → dequantize → fp32 sum, so the dp wire
+    carries ~1/4 of the gradient bytes; the parameter all-gather stays
+    full precision (the in-jit plane syncs over ICI/one host, where
+    params are cheap relative to the DCN-crossing host plane).
+    ``grad_codec=None`` compiles the exact pre-codec program.
 
     - ``init_fn(params)`` -> flat optimizer state laid out over the
       mesh ``axis`` (each device materializes only its 1/dp chunk under
@@ -330,6 +388,9 @@ def make_zero_update_spmd(tx, mesh, axis: str = "dp"
 
     from ..jax_compat import shard_map
 
+    from . import quant as _quant
+
+    _quant.check_codec(grad_codec)
     world = mesh.shape[axis]
 
     def _pad(flat):
@@ -377,9 +438,19 @@ def make_zero_update_spmd(tx, mesh, axis: str = "dp"
         def _upd_local(p_local, g_local, opt_local):
             idx = jax.lax.axis_index(axis)
             # g_local: [1, Np] — this replica's own full gradient.
-            # psum_scatter hands back chunk #idx of the cross-replica SUM
-            g_shard = jax.lax.psum_scatter(
-                g_local[0], axis, tiled=True) / world
+            # psum_scatter hands back chunk #idx of the cross-replica
+            # SUM; with a codec the quantized kernel decomposes it so
+            # only narrow payloads cross the wire (fp32 sum after
+            # dequantize — parallel/sharding/codec.py)
+            if grad_codec is None:
+                g_shard = jax.lax.psum_scatter(
+                    g_local[0], axis, tiled=True) / world
+            else:
+                from .sharding.codec import quantized_scatter_mean
+
+                g_shard = quantized_scatter_mean(
+                    g_local[0], axis, world, codec=grad_codec,
+                    block=codec_block)
             p_shard = jax.lax.dynamic_slice(p_local, (idx * chunk,),
                                             (chunk,))
             updates, new_opt = tx.update(g_shard, opt_local, p_shard)

@@ -108,11 +108,20 @@ class DecodeStage:
 
 class DisaggLLM:
     """Driver-side convenience: two stage actors + the compiled 2-stage
-    pipeline. `generate()` pushes one request through the channel."""
+    pipeline. `generate()` pushes one request through the channel.
+
+    ``codec`` ("int8"/"e4m3", docs/COLLECTIVES.md) block-quantizes the
+    prefill→decode KV shipment on the wire — the dominant payload of
+    the disagg split drops to ~1/4 of its fp32 bytes; the decode engine
+    adopts the dequantized blocks, so decode runs on a KV image with
+    per-block quantization error (greedy completions on well-separated
+    logits are typically unchanged; the bench row pins the latency/
+    bytes trade). None = exact, byte-identical to the pre-codec path.
+    """
 
     def __init__(self, model: Any = "gpt-tiny", block_size: int = 16,
                  engine_config: Optional[Dict[str, Any]] = None,
-                 seed: int = 0):
+                 seed: int = 0, codec: Optional[str] = None):
         import ray_tpu
         from ray_tpu.cgraph import InputNode
 
@@ -124,7 +133,7 @@ class DisaggLLM:
         self._decode = decode_cls.remote(model, eng_cfg, seed=seed)
         with InputNode() as inp:
             dag = self._decode.ingest.bind(self._prefill.prefill.bind(inp))
-        self._compiled = dag.experimental_compile()
+        self._compiled = dag.experimental_compile(codec=codec)
 
     def generate(self, tokens: List[int], max_tokens: int = 16,
                  eos_id: Any = "__default__",

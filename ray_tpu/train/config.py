@@ -44,6 +44,13 @@ class PipelineConfig:
     # chip; composes with dp and the stages into pp x dp x fsdp
     fsdp: int = 1
     zero_update: bool = True     # ZeRO-shard the dp optimizer update
+    # slow-wire codecs (docs/COLLECTIVES.md): "int8"/"e4m3" block-scaled
+    # quantization, None = full precision. grad_codec compresses the dp
+    # gradient sync (ZeRO reduce-scatter/all-gather or the replicated
+    # allreduce); wire_codec compresses the cgraph activation/cotangent
+    # channel payloads between stages.
+    grad_codec: Optional[str] = None
+    wire_codec: Optional[str] = None
     remat: bool = False          # recompute fwd in bwd (activation remat)
     channel_bytes: int = 1 << 20  # per-slot channel capacity
     resources_per_stage: Dict[str, float] = field(default_factory=dict)
@@ -60,6 +67,8 @@ class PipelineConfig:
             "dp": self.dp,
             "fsdp": self.fsdp,
             "zero_update": self.zero_update,
+            "grad_codec": self.grad_codec,
+            "wire_codec": self.wire_codec,
             "remat": self.remat,
             "channel_bytes": self.channel_bytes,
             "resources_per_stage": self.resources_per_stage or None,

@@ -258,6 +258,12 @@ def reshard_checkpoint(ckpt: dict, dp: int) -> dict:
             flat, spec = flatten_tree(params_dict)
             merged = merge_opt_shards(shards)
             if new_dp == 1:
+                # grad_codec updaters wrap their state as {"tx",
+                # "master"}; dp=1 has no dp wire, so the master copy is
+                # dropped and the bare optimizer state unflattens
+                if isinstance(merged, dict) \
+                        and set(merged) == {"tx", "master"}:
+                    merged = merged["tx"]
                 opts = [unflatten_opt_state(merged, spec)]
                 new_kind = "full"
             else:
@@ -298,7 +304,8 @@ class _CGStage:
               fn_blobs: List[bytes], chunk_params: List[Any],
               chunk_meta: List[dict], tx_blob: Optional[bytes],
               remat: bool, dp: int, dp_rank: int,
-              group_name: str, zero_update: bool, fsdp: int = 1) -> bool:
+              group_name: str, zero_update: bool, fsdp: int = 1,
+              grad_codec: Optional[str] = None) -> bool:
         import jax
 
         self.idx = actor_idx
@@ -310,6 +317,9 @@ class _CGStage:
         self.fsdp = int(fsdp)
         self.zero_update = zero_update
         self.group_name = group_name
+        # dp-sync wire codec (docs/COLLECTIVES.md): block-scaled
+        # quantized collectives on every grad-sync leg; None = fp32
+        self.grad_codec = grad_codec
         self._jax = jax
         fns = [cloudpickle.loads(b) for b in fn_blobs]
         self._progs = [
@@ -360,8 +370,8 @@ class _CGStage:
                 from ..parallel.zero import ZeroUpdater
 
                 self._zero = ZeroUpdater(
-                    self.tx, dp, dp_rank,
-                    group_name=group_name).init(self.params)
+                    self.tx, dp, dp_rank, group_name=group_name,
+                    grad_codec=grad_codec).init(self.params)
             else:
                 self._opt_state = jax.jit(self.tx.init)(self.params)
                 self._upd = _make_update(self.tx)
@@ -462,7 +472,8 @@ class _CGStage:
 
                 flat_g, spec = flatten_tree(grads)
                 mean = collective.allreduce(
-                    np.asarray(flat_g), self.group_name) / self.dp
+                    np.asarray(flat_g), self.group_name,
+                    codec=self.grad_codec) / self.dp
                 grads = unflatten_tree(
                     jnp.asarray(mean, dtype=spec.dtype), spec)
             for v in range(self.virtual):
@@ -486,7 +497,8 @@ class _CGStage:
             import numpy as np
 
             mean = collective.allreduce(
-                np.asarray(flat_g), self.group_name) / self.dp
+                np.asarray(flat_g), self.group_name,
+                codec=self.grad_codec) / self.dp
             grads = unflatten_tree(
                 jnp.asarray(mean, dtype=spec.dtype), spec)
             self.params, self._opt_state = self._upd(
@@ -670,6 +682,17 @@ class CompiledPipelineEngine:
     zero_update: ZeRO-shard the dp update (1/dp optimizer state per
         replica) vs the replicated allreduce update (fsdp=1 path; with
         fsdp > 1 the sharded update runs on the fsdp plane instead).
+    grad_codec: block-scaled wire codec ("int8"/"e4m3",
+        docs/COLLECTIVES.md) for the dp gradient sync — the ZeRO
+        reduce-scatter/all-gather (fp32 master shards) or the
+        replicated/fsdp allreduce ship quantized payloads, ~1/4 the
+        bytes over the dp wire; None (default) = full precision,
+        bit-identical to the pre-codec engine.
+    wire_codec: same codec vocabulary applied to the cgraph CHANNEL
+        payloads — pipeline activations and cotangents cross their
+        hops block-quantized (large float arrays only; small/non-float
+        payloads like losses and reports pass through raw). Lossy by
+        construction; seq/error-envelope semantics are unchanged.
     remat: recompute chunk forwards in the backward instead of holding
         vjp residuals (activation rematerialization knob).
     tied: [(chunk_i, key_i, chunk_j, key_j), ...] tied-weight pairs
@@ -693,6 +716,8 @@ class CompiledPipelineEngine:
                  dp: int = 1,
                  fsdp: int = 1,
                  zero_update: bool = True,
+                 grad_codec: Optional[str] = None,
+                 wire_codec: Optional[str] = None,
                  remat: bool = False,
                  tied: Sequence[tuple] = (),
                  channel_bytes: int = DEFAULT_CHANNEL_BYTES,
@@ -720,6 +745,10 @@ class CompiledPipelineEngine:
         if self.fsdp < 1:
             raise ValueError(f"fsdp must be >= 1, got {fsdp}")
         self.zero_update = bool(zero_update)
+        from ..parallel.quant import check_codec
+
+        self.grad_codec = check_codec(grad_codec)
+        self.wire_codec = check_codec(wire_codec)
         self.tied = list(tied)
         self.graph_id = os.urandom(16)
         self._gtag = self.graph_id.hex()[:8]
@@ -841,7 +870,7 @@ class CompiledPipelineEngine:
                     [self._fn_blobs[g] for g in chunks],
                     cp, meta, self._tx_blob,
                     self._remat, dp, r, f"zpipe-{self._gtag}-s{i}",
-                    self.zero_update, self.fsdp))
+                    self.zero_update, self.fsdp, self.grad_codec))
             self.actor_grid.append(row)
         ray_tpu.get(setups, timeout=self._setup_timeout)
         if per_actor_state is not None:
@@ -1027,6 +1056,11 @@ class CompiledPipelineEngine:
                 ops: List[dict] = []
                 for kind, v, mb in sched[i]:
                     g = v * P + i
+                    # wire_codec compresses the activation/cotangent
+                    # hops — fwd/bwd outputs; the loss envelope off the
+                    # last chunk is a scalar and passes through raw
+                    # under the codec's size floor anyway
+                    codec = self.wire_codec
                     if kind == "fwd":
                         args = [const(v), const(mb)]
                         args.append(("chan", fwd_r[g]["cid"]))
@@ -1037,6 +1071,7 @@ class CompiledPipelineEngine:
                                     "method": "forward",
                                     "num_returns": 1,
                                     "concurrency_group": "",
+                                    "codec": codec,
                                     "args": args, "kwargs": {},
                                     "outs": outs})
                     else:
@@ -1048,6 +1083,7 @@ class CompiledPipelineEngine:
                                     "method": "backward",
                                     "num_returns": 1,
                                     "concurrency_group": "",
+                                    "codec": codec,
                                     "args": args, "kwargs": {},
                                     "outs": outs})
                 # tied exchange: all sends first, then all receives —
@@ -1119,6 +1155,7 @@ class CompiledPipelineEngine:
             self._check_open()
         from ..cgraph.channel import FLAG_ERROR, pack_envelope, \
             unpack_envelope
+        from ..cgraph.codec import decode_value
         from ..core import serialization
 
         deadline = time.monotonic() + timeout
@@ -1145,7 +1182,8 @@ class CompiledPipelineEngine:
                     data = self._loss_readers[r].recv(
                         timeout=max(0.0, deadline - time.monotonic()))
                     flags, _tr, body = unpack_envelope(data)
-                    val = serialization.loads(body)
+                    val = serialization.loads(body) \
+                        if flags & FLAG_ERROR else decode_value(flags, body)
                     if flags & FLAG_ERROR:
                         first_err = first_err or val
                     else:
@@ -1156,7 +1194,8 @@ class CompiledPipelineEngine:
                     data = rd.recv(
                         timeout=max(0.0, deadline - time.monotonic()))
                     flags, _tr, body = unpack_envelope(data)
-                    val = serialization.loads(body)
+                    val = serialization.loads(body) \
+                        if flags & FLAG_ERROR else decode_value(flags, body)
                     if flags & FLAG_ERROR:
                         first_err = first_err or val
                     else:
@@ -1314,6 +1353,7 @@ class CompiledPipelineEngine:
                 "virtual": self.virtual, "dp": self.dp,
                 "fsdp": self.fsdp,
                 "zero_update": self.zero_update,
+                "grad_codec": self.grad_codec,
                 "num_microbatches": self.num_microbatches}
 
     def _maybe_checkpoint(self) -> None:

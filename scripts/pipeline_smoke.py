@@ -14,6 +14,12 @@ drives 8 microbatches x 5 training steps through the 1F1B loop. Gates:
   in a /metrics render (stage rows ship on the throttled delta path)
 - `engine.shutdown()` returns every store's channel accounting to the
   pre-engine baseline — zero leaked segments on either node
+- a second engine with `wire_codec="int8"` (ISSUE 13,
+  docs/COLLECTIVES.md) trains across the SAME head+remote split — the
+  block-quantized activation/cotangent envelopes really cross the
+  node boundary — with a decreasing loss,
+  `ray_tpu_cgraph_channel_bytes_total{...codec="int8"}` visible in the
+  /metrics render, and channel accounting clean after shutdown
 
 Exit 0 = healthy; any assertion prints the evidence and exits 1.
 Run: python scripts/pipeline_smoke.py   (CI invokes it after llm_smoke)
@@ -131,6 +137,41 @@ def main() -> int:
         assert after == baseline, \
             f"leaked channels: baseline={baseline} after={after}"
         print("shutdown channel accounting OK")
+
+        # 5) wire-codec engine, live 2-node: stage 1 stays pinned to
+        # the remote agent so the int8-quantized activation/cotangent
+        # envelopes cross a REAL process/TCP boundary (RpcSender ->
+        # QueueChannel reorder path), not just shm
+        cfns, cparams, cmbs, ctgts = _mlp(2, 32, M=4, mb_size=32)
+        ceng = CompiledPipelineEngine(
+            cfns, cparams, optax.sgd(0.05),
+            num_microbatches=4, wire_codec="int8",
+            channel_bytes=1 << 18,
+            scheduling_strategies=[
+                NodeAffinitySchedulingStrategy(
+                    node_id=c.runtime.head_node_id, soft=False),
+                NodeAffinitySchedulingStrategy(
+                    node_id=remote.node_id, soft=False)])
+        closses = [ceng.step(cmbs, ctgts) for _ in range(4)]
+        assert all(b < a for a, b in zip(closses, closses[1:])), \
+            f"codec loss did not decrease: {closses}"
+        deadline = time.monotonic() + 15
+        body = metrics._render()
+        while ('codec="int8"' not in body
+               and time.monotonic() < deadline):
+            time.sleep(0.3)
+            body = metrics._render()
+        int8_rows = [ln for ln in body.splitlines()
+                     if ln.startswith("ray_tpu_cgraph_channel_bytes_total")
+                     and 'codec="int8"' in ln]
+        assert int8_rows, "no int8-tagged channel byte series scraped"
+        ceng.shutdown()
+        after = store_channels()
+        assert after == baseline, \
+            f"codec engine leaked channels: {baseline} -> {after}"
+        print(f"wire-codec engine OK, losses "
+              f"{[round(l, 5) for l in closses]}, "
+              f"{len(int8_rows)} int8 byte series")
         print("pipeline smoke OK")
         return 0
     finally:

@@ -1,0 +1,55 @@
+"""Fixture: quantize→collective→dequantize kernels against
+meshdef.CODEC_MESH (axis dp), written in the codec-plane idiom
+(parallel/sharding/codec.py: nested bodies that quantize per block,
+move the narrow payload with an axis-bound collective, and dequantize
+before the fp32 sum). Two seeded bugs:
+
+- bad_scatter's all_to_all moves the quantized payload over axis 'tp',
+  which the owning mesh never binds (GC020, resolved cross-file);
+- bad_arity's in_specs carries one spec but the wrapped kernel body
+  takes two required arguments — the (payload, scales) pair every
+  dequantize step needs — failing at trace time with an opaque pytree
+  error (GC021).
+
+The well-formed kernel below them must stay clean: its collectives
+name only the bound dp axis and its in_specs match the body arity.
+"""
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.jax_compat import shard_map
+
+from .meshdef import CODEC_MESH
+
+
+def bad_scatter(grads):
+    def body(g_stacked):
+        q = jnp.clip(jnp.round(g_stacked * 127.0), -127, 127)
+        return jax.lax.all_to_all(q, "tp", split_axis=0, concat_axis=0)
+
+    fn = shard_map(body, mesh=CODEC_MESH, in_specs=(P("dp"),),
+                   out_specs=P("dp"), axis_names=frozenset({"dp"}))
+    return fn(grads)
+
+
+def bad_arity(payload, scales):
+    def body(q_shard, s_shard):
+        return q_shard.astype(jnp.float32) * s_shard
+
+    fn = shard_map(body, mesh=CODEC_MESH, in_specs=(P("dp"),),
+                   out_specs=P("dp"), axis_names=frozenset({"dp"}))
+    return fn(payload, scales)
+
+
+def good_quantized_scatter(grads, world):
+    def body(g_stacked, s_full):
+        q = jnp.clip(jnp.round(g_stacked / s_full), -127, 127)
+        wire = jax.lax.all_to_all(q.astype(jnp.int8), "dp",
+                                  split_axis=0, concat_axis=0)
+        deq = wire.astype(jnp.float32) * s_full
+        return jnp.sum(deq, axis=0) / world
+
+    fn = shard_map(body, mesh=CODEC_MESH, in_specs=(P("dp"), P()),
+                   out_specs=P("dp"), axis_names=frozenset({"dp"}))
+    return fn(grads, jnp.float32(0.01))

@@ -1,0 +1,12 @@
+"""Fixture: the mesh module for the quantized-collective codec idiom
+(ISSUE 13). The dp mesh the codec kernels run over lives here; the
+quantize/dequantize shard_map kernels that use (and mis-use) its axis
+live in kernels.py — GC020 must resolve the bound axis across this
+module boundary exactly as it does for the shipped
+parallel/sharding/codec.py tree."""
+import jax
+from jax.sharding import Mesh
+
+AXES = ("dp",)
+
+CODEC_MESH = Mesh(jax.devices(), AXES)

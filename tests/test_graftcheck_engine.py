@@ -339,16 +339,38 @@ class TestSPMD:
         assert all(f.line < 42 for f in res.findings), res.findings
 
     def test_shipped_sharding_tree_is_clean(self):
-        """The shipped sharding subsystem sweeps clean under the SPMD
-        family it introduces idioms for (the tree-wide sweep below
-        covers it too; this pins the subsystem on its own so a local
-        regression names the right culprit)."""
+        """The shipped sharding subsystem — including the quantized
+        codec kernels (parallel/sharding/codec.py, ISSUE 13) — sweeps
+        clean under the SPMD family it introduces idioms for (the
+        tree-wide sweep below covers it too; this pins the subsystem on
+        its own so a local regression names the right culprit)."""
         res = check_project(
             [os.path.join(REPO, "ray_tpu", "parallel", "sharding")],
             rules={"GC020", "GC021", "GC022"}, cache_path=None,
             root=os.path.join(REPO, "ray_tpu"))
         assert res.errors == 0
         assert [f.render() for f in res.findings] == []
+
+    def test_codec_kernel_idioms(self):
+        """ISSUE 13 fixture package: quantize→collective→dequantize
+        shard_map kernels in the codec-plane idiom. GC020 flags the
+        payload all_to_all over the unbound 'tp' axis (resolved
+        cross-file through meshdef.CODEC_MESH), GC021 the one-spec
+        in_specs against the two-argument (payload, scales) dequantize
+        body; the well-formed quantized scatter stays clean."""
+        res = run_pkg("codec_pkg", rules={"GC020", "GC021"})
+        assert rules_of(res) == ["GC020", "GC021"]
+        gc020 = [f for f in res.findings if f.rule == "GC020"]
+        assert len(gc020) == 1
+        assert "'tp'" in gc020[0].message
+        assert "dp" in gc020[0].message
+        assert gc020[0].path.endswith("kernels.py")
+        gc021 = [f for f in res.findings if f.rule == "GC021"]
+        assert len(gc021) == 1
+        assert "1 entry" in gc021[0].message
+        # both findings land in the bad kernels, none in
+        # good_quantized_scatter below them
+        assert all(f.line < 47 for f in res.findings), res.findings
 
     def test_symbolic_axis_names_match(self):
         # pipeline.py-style: axis_names=frozenset({pp_axis}) with the
