@@ -147,6 +147,7 @@ def main() -> None:
             **_bench_pipeline(),
             **_bench_collectives(),
             **_bench_sharding(),
+            **_bench_traffic(),
         },
     }))
 
@@ -255,6 +256,65 @@ def _bench_llm_serve() -> dict:
 
         traceback.print_exc()  # a broken engine must not look like 0
         return {}
+
+
+def _bench_traffic() -> dict:
+    """Traffic-shaped serving rows (ISSUE 14): (a) prefix-cache TTFT —
+    shared 512-token prefix, 32-token suffixes, concurrency 8, cache-on
+    vs cache-off on the same engine (acceptance: cached >= 3x better,
+    token-identical); (b) a trace replay through the REAL serve stack
+    (bursty Poisson arrivals, Zipf sessions, 60% shared prefix,
+    session-aware HTTP routing) reporting goodput + p99 TTFT/TPOT +
+    preemption/failover counts, run under chaos so zero-failed-streams
+    composes with the fault story. The replay runs in a subprocess: it
+    owns a whole serve cluster + proxy and must not inherit this
+    process's jax/cluster state."""
+    out: dict = {}
+    try:
+        from bench_core import prefix_cache_bench
+
+        out.update(prefix_cache_bench(concurrency=4 if SMOKE else 8))
+    except Exception:
+        import traceback
+
+        traceback.print_exc()  # a broken cache must not look like 0
+    try:
+        import subprocess
+        import sys as _sys
+        import tempfile
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        harness = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "scripts", "traffic_harness.py")
+        with tempfile.NamedTemporaryFile(suffix=".json") as tf:
+            argv = [_sys.executable, harness, "--json", tf.name,
+                    "--sessions", "12" if SMOKE else "40",
+                    "--max-turns", "2" if SMOKE else "3"]
+            if not SMOKE:
+                # chaos-on replay: a seeded mid-burst replica kill, with
+                # streams on the resilient transport — the acceptance
+                # run that must complete with zero failed streams
+                argv += ["--transport", "resilient",
+                         "--kill-replica-at", "4"]
+            proc = subprocess.run(argv, env=env, capture_output=True,
+                                  text=True, timeout=900)
+            if proc.returncode == 0:
+                with open(tf.name) as f:
+                    row = json.load(f)
+                out.update({k: v for k, v in row.items()
+                            if k.startswith(("traffic_", "prefix_hit",
+                                             "llm_preempt",
+                                             "session_"))})
+                out["traffic_chaos_on"] = not SMOKE
+            else:
+                print(proc.stdout[-2000:])
+                print(proc.stderr[-2000:])
+    except Exception:
+        import traceback
+
+        traceback.print_exc()  # a broken serve plane must not look like 0
+    return out
 
 
 def _bench_pipeline() -> dict:

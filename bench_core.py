@@ -257,6 +257,85 @@ def llm_serve_bench(n_requests: int = 0, concurrency: int = 8,
     }
 
 
+def prefix_cache_bench(prefix_len: int = 0, suffix_len: int = 32,
+                       concurrency: int = 8, max_tokens: int = 8) -> dict:
+    """Radix-prefix-cache rows (ISSUE 14 acceptance): ``concurrency``
+    requests sharing one long common prefix with short unique suffixes,
+    cache-off vs cache-on on the same engine shape. The cached run pays
+    a block-table splice plus a suffix prefill where the cold run pays
+    the full prompt — acceptance pins cached TTFT >= 3x better at the
+    512-token prefix, outputs token-identical both ways."""
+    import random as _random
+
+    import jax.numpy as jnp
+
+    from ray_tpu.serve.llm import EngineConfig, LLMEngine, build_model
+
+    if not prefix_len:
+        prefix_len = 128 if SMOKE else 512
+    rng = _random.Random(0)
+    prefix = [rng.randrange(1, 500) for _ in range(prefix_len)]
+    suffixes = [[rng.randrange(1, 500) for _ in range(suffix_len)]
+                for _ in range(concurrency)]
+    ctx = prefix_len + suffix_len + max_tokens + 8
+    m, params = build_model({"family": "gpt", "max_seq": ctx + 64,
+                             "dtype": jnp.float32, "use_flash": False})
+    bs = 16
+    n_seq_blocks = (ctx // bs) + 2
+    cfg = dict(block_size=bs,
+               num_blocks=(concurrency + 2) * n_seq_blocks,
+               max_batch=concurrency, max_blocks_per_seq=n_seq_blocks,
+               prefill_buckets=(64, prefix_len + suffix_len + bs),
+               max_prefill_tokens_per_step=prefix_len + suffix_len + bs)
+
+    # per-request TTFT comes from the engine's own Request bookkeeping
+    # (first_token_at - submitted_at); both runs carry the identical
+    # workload, with one full-prefix seeding request each so the cached
+    # run measures WARM-cache behaviour. The warmup requests compile
+    # every program (cold prefill bucket, extend bucket, decode) before
+    # the clock starts.
+    def run_with_ttft(prefix_cache: bool):
+        eng = LLMEngine(m, params, EngineConfig(prefix_cache=prefix_cache,
+                                                **cfg))
+        for warm in ([prefix[:48]] if not prefix_cache
+                     else [prefix[:48], prefix[:40] + [7] * 8]):
+            st = eng.add_request(warm, max_tokens=2)
+            eng.run_until_idle(timeout=900)
+            st.tokens()
+        st = eng.add_request(prefix + suffixes[0][:1], max_tokens=2)
+        eng.run_until_idle(timeout=900)
+        st.tokens()
+        t0 = time.perf_counter()
+        streams = [eng.add_request(prefix + sfx, max_tokens=max_tokens)
+                   for sfx in suffixes]
+        reqs = list(eng._waiting)
+        eng.run_until_idle(timeout=900)
+        wall = time.perf_counter() - t0
+        outs = [st.tokens(timeout=60) for st in streams]
+        ttfts = sorted(r.first_token_at - r.submitted_at for r in reqs)
+        eng.pool.check_leaks()
+        stats = eng.cache_stats() if prefix_cache else {}
+        return outs, wall, ttfts, stats
+
+    cold_outs, cold_wall, cold_ttfts, _ = run_with_ttft(False)
+    outs, wall, ttfts, stats = run_with_ttft(True)
+
+    def p50(v):
+        return v[len(v) // 2]
+
+    cold_ms = round(p50(cold_ttfts) * 1e3, 1)
+    cached_ms = round(p50(ttfts) * 1e3, 1)
+    return {
+        "llm_prefix_len": prefix_len,
+        "llm_ttft_ms_cold": cold_ms,
+        "llm_ttft_ms_cached": cached_ms,
+        "llm_ttft_prefix_speedup": round(cold_ms / max(cached_ms, 1e-3), 2),
+        "llm_prefix_wall_speedup": round(cold_wall / max(wall, 1e-6), 2),
+        "prefix_hit_rate": stats.get("cache_hit_rate", 0.0),
+        "prefix_tokens_identical": outs == cold_outs,
+    }
+
+
 def _pipeline_mlp(num_chunks: int, width: int, M: int, mb_size: int = 2):
     """Compute-light tanh-MLP pipeline fixture (the ISSUE 8 acceptance
     config measures ENGINE overhead, not matmul time)."""

@@ -71,8 +71,10 @@ class GPTConfig:
     # ---- presets ----------------------------------------------------------
     @staticmethod
     def tiny(**kw) -> "GPTConfig":
-        return GPTConfig(vocab_size=512, n_layer=2, n_head=2, d_model=64,
-                         d_ff=256, max_seq=128, **kw)
+        base = dict(vocab_size=512, n_layer=2, n_head=2, d_model=64,
+                    d_ff=256, max_seq=128)
+        base.update(kw)            # callers may stretch max_seq etc.
+        return GPTConfig(**base)
 
     @staticmethod
     def small(**kw) -> "GPTConfig":      # GPT-2 124M
@@ -432,6 +434,52 @@ class GPT:
             attn = mha_reference(q, k, v, causal=True)
             new_k.append(paged_write_prefill(kc[li], block_row, k[0], length))
             new_v.append(paged_write_prefill(vc[li], block_row, v[0], length))
+            x = x + attn.reshape(1, S, H * hd) @ lp["w_proj"].astype(c.dtype) \
+                + lp["b_proj"].astype(c.dtype)
+            x = self._paged_mlp(x, lp)
+        x = layernorm(x, params["lnf_g"], params["lnf_b"])
+        last = jax.lax.dynamic_index_in_dim(
+            x[0], jnp.maximum(length - 1, 0), axis=0, keepdims=False)
+        logits = jnp.einsum("d,vd->v", last.astype(jnp.float32),
+                            params["wte"].astype(jnp.float32))
+        return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+    def paged_prefill_extend(self, params: Dict[str, jax.Array],
+                             cache: Dict[str, jax.Array],
+                             tokens: jax.Array, start: jax.Array,
+                             length: jax.Array, block_row: jax.Array
+                             ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        """Suffix prefill over a cached prefix (prefix cache,
+        docs/LLM_SERVE.md): positions [0, start) already sit in the
+        blocks named by ``block_row`` (written by an earlier request
+        that shared them); only the suffix ``tokens`` [1, S] (padded to
+        the bucket, true length ``length``) is embedded, written at
+        positions start.., and attended causally over the FULL paged
+        context. Returns (last-real-token logits [V], cache) — exactly
+        :meth:`paged_prefill` output, at suffix cost."""
+        from ..ops import paged_attention_prefill, paged_write_prefill
+
+        c = self.config
+        S = tokens.shape[1]
+        H, hd = c.n_head, c.head_dim
+        positions = (start + jnp.arange(S))[None]               # [1, S]
+        x = self._embed(params["wte"], params["wpe"], tokens, positions)
+        kc, vc = cache["k"], cache["v"]
+        new_k, new_v = [], []
+        for li in range(c.n_layer):
+            lp = self._paged_layer_params(params, li)
+            h = layernorm(x, lp["ln1_g"], lp["ln1_b"])
+            qkv = (h @ lp["w_qkv"].astype(c.dtype)) \
+                + lp["b_qkv"].astype(c.dtype)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            kl = paged_write_prefill(kc[li], block_row,
+                                     k.reshape(S, H, hd), length, start)
+            vl = paged_write_prefill(vc[li], block_row,
+                                     v.reshape(S, H, hd), length, start)
+            new_k.append(kl)
+            new_v.append(vl)
+            attn = paged_attention_prefill(q.reshape(S, H, hd), kl, vl,
+                                           block_row, start, length)
             x = x + attn.reshape(1, S, H * hd) @ lp["w_proj"].astype(c.dtype) \
                 + lp["b_proj"].astype(c.dtype)
             x = self._paged_mlp(x, lp)

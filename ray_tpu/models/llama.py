@@ -48,8 +48,10 @@ class LlamaConfig:
 
     @staticmethod
     def tiny(**kw) -> "LlamaConfig":
-        return LlamaConfig(vocab_size=512, n_layer=2, n_head=4, n_kv_head=2,
-                           d_model=64, d_ff=128, max_seq=128, **kw)
+        base = dict(vocab_size=512, n_layer=2, n_head=4, n_kv_head=2,
+                    d_model=64, d_ff=128, max_seq=128)
+        base.update(kw)            # callers may stretch max_seq etc.
+        return LlamaConfig(**base)
 
     @staticmethod
     def llama2_7b(**kw) -> "LlamaConfig":
@@ -214,6 +216,48 @@ class Llama:
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)
             attn = mha_reference(q, k, v, causal=True)
+            x = x + attn.reshape(1, S, H * hd) @ lp["w_o"].astype(c.dtype)
+            x = self._paged_mlp(x, lp)
+        x = rmsnorm(x, params["out_norm"], c.rms_eps)
+        last = jax.lax.dynamic_index_in_dim(
+            x[0], jnp.maximum(length - 1, 0), axis=0, keepdims=False)
+        logits = jnp.einsum("d,vd->v", last.astype(jnp.float32),
+                            params["lm_head"].astype(jnp.float32))
+        return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+    def paged_prefill_extend(self, params, cache, tokens, start, length,
+                             block_row):
+        """Suffix prefill over a cached prefix (see
+        GPT.paged_prefill_extend — same contract: tokens [1, S] are the
+        suffix only, RoPE'd at absolute positions start.., written into
+        ``block_row`` at start.., attended over the full paged context
+        incl. the reused [0, start) KV)."""
+        from ..ops import paged_attention_prefill, paged_write_prefill
+
+        c = self.config
+        S = tokens.shape[1]
+        H, KH, hd = c.n_head, c.n_kv_head, c.head_dim
+        x = params["wte"].astype(c.dtype)[tokens]              # [1, S, D]
+        cos, sin = rope_cache(c.max_seq, hd, c.rope_base)
+        positions = (start + jnp.arange(S))[None]              # [1, S]
+        kc, vc = cache["k"], cache["v"]
+        new_k, new_v = [], []
+        for li in range(c.n_layer):
+            lp = {n: params[n][li] for n in self._PAGED_LP}
+            h = rmsnorm(x, lp["attn_norm"], c.rms_eps)
+            q = (h @ lp["w_q"].astype(c.dtype)).reshape(1, S, H, hd)
+            k = (h @ lp["w_k"].astype(c.dtype)).reshape(1, S, KH, hd)
+            v = (h @ lp["w_v"].astype(c.dtype)).reshape(1, S, KH, hd)
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
+            kl = paged_write_prefill(kc[li], block_row, k[0], length,
+                                     start)
+            vl = paged_write_prefill(vc[li], block_row, v[0], length,
+                                     start)
+            new_k.append(kl)
+            new_v.append(vl)
+            attn = paged_attention_prefill(q[0], kl, vl, block_row,
+                                           start, length)
             x = x + attn.reshape(1, S, H * hd) @ lp["w_o"].astype(c.dtype)
             x = self._paged_mlp(x, lp)
         x = rmsnorm(x, params["out_norm"], c.rms_eps)

@@ -18,13 +18,15 @@ from .flash_attention import flash_attention
 from .ring_attention import ring_attention
 from .layers import (cross_entropy_loss, gelu, layernorm, rmsnorm,
                      rope_cache, apply_rope)
-from .paged_attention import (paged_attention_decode, paged_gather_kv,
+from .paged_attention import (paged_attention_decode,
+                              paged_attention_prefill, paged_gather_kv,
                               paged_write_prefill, paged_write_step)
 
 __all__ = [
     "flash_attention", "ring_attention", "mha_reference",
     "rmsnorm", "layernorm", "gelu", "rope_cache", "apply_rope",
     "cross_entropy_loss",
-    "paged_attention_decode", "paged_gather_kv", "paged_write_prefill",
+    "paged_attention_decode", "paged_attention_prefill",
+    "paged_gather_kv", "paged_write_prefill",
     "paged_write_step",
 ]

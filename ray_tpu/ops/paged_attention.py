@@ -63,20 +63,67 @@ def paged_write_step(cache: jax.Array, block_rows: jax.Array,
 
 
 def paged_write_prefill(cache: jax.Array, block_row: jax.Array,
-                        seq: jax.Array, length: jax.Array) -> jax.Array:
+                        seq: jax.Array, length: jax.Array,
+                        start=0) -> jax.Array:
     """Scatter a prompt's K (or V) sequence into one block-table row.
 
     cache: [N, Bs, KH, hd]; block_row: [M]; seq: [S, KH, hd] (S is the
     static prefill bucket); length: scalar int32 — positions >= length
-    are padding and dropped.
+    are padding and dropped. ``start`` (scalar) offsets every write:
+    seq[i] lands at sequence position start + i — the suffix-prefill
+    path of the prefix cache, where positions [0, start) are already
+    resident in cached blocks named by the same row.
     """
     n, bs = cache.shape[0], cache.shape[1]
     s = seq.shape[0]
-    pos = jnp.arange(s)
+    pos = jnp.arange(s) + start
     bids = block_row[jnp.clip(pos // bs, 0, block_row.shape[0] - 1)]
-    bids = jnp.where(pos < length, bids, n)  # pad -> dropped
+    bids = jnp.where(pos < start + length, bids, n)  # pad -> dropped
     return cache.at[bids, pos % bs].set(seq.astype(cache.dtype),
                                         mode="drop")
+
+
+def paged_attention_prefill(q: jax.Array, k_cache: jax.Array,
+                            v_cache: jax.Array, block_row: jax.Array,
+                            start: jax.Array,
+                            length: jax.Array) -> jax.Array:
+    """Causal attention of a suffix over its full paged context.
+
+    The suffix-prefill primitive of the prefix cache: query token i sits
+    at sequence position start + i and attends every cached position
+    <= its own — the reused prefix ([0, start), written by an earlier
+    request) AND the suffix's K/V (written into the same row by
+    :func:`paged_write_prefill` with the same ``start`` before this
+    call).
+
+    q: [S, H, hd] (S is the static suffix bucket); k_cache/v_cache:
+    [N, Bs, KH, hd]; block_row: [M]; start, length: scalars. This op
+    does NOT mask pad queries — rows at index >= ``length`` attend
+    stale context and are GARBAGE; callers must read only rows below
+    ``length`` (the models read exactly the ``length - 1`` row for the
+    next-token logits). ``length`` is accepted so the signature mirrors
+    :func:`paged_write_prefill` and a masking variant can slot in
+    without touching call sites. GQA (KH < H) broadcasts KV heads.
+    Returns [S, H, hd] in q's dtype; math in f32.
+    """
+    del length  # contract documented above; rows >= length are garbage
+    s, h, hd = q.shape
+    kh = k_cache.shape[2]
+    k = paged_gather_kv(k_cache, block_row[None])[0]  # [M*Bs, KH, hd]
+    v = paged_gather_kv(v_cache, block_row[None])[0]
+    if kh != h:
+        rep = h // kh
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    ctx = k.shape[0]
+    scores = jnp.einsum("shd,chd->shc", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) / math.sqrt(hd)
+    q_pos = start + jnp.arange(s)                             # [S]
+    mask = jnp.arange(ctx)[None, :] <= q_pos[:, None]         # causal
+    scores = jnp.where(mask[:, None, :], scores, _NEG_INF)    # [S, H, C]
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("shc,chd->shd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
 
 
 def paged_attention_decode(q: jax.Array, k_cache: jax.Array,

@@ -37,6 +37,11 @@ class _ReplicaState:
         self.draining = False
         self.drain_deadline = 0.0
         self.drain_marked_at = 0.0
+        # prefix-cache warmth from the health ping (replica.py): the
+        # session router's tie-break and the scale-down victim pick
+        # both prefer keeping warm replicas
+        self.cache_hit_rate = 0.0
+        self.prefix_blocks_resident = 0
 
 
 class _DeploymentState:
@@ -108,19 +113,38 @@ class ServeController:
             st.target = 0
         return True
 
-    def get_replicas(self, name: str):
+    @staticmethod
+    def _routable(st: _DeploymentState):
+        """Replicas the router may assign work to — the ONE routability
+        definition get_replicas and replica_warmth both use."""
+        return [r for r in st.replicas
+                if not r.starting and not r.draining
+                and r.version == st.version]
+
+    @staticmethod
+    def _warmth_of(replicas) -> Dict[str, float]:
+        return {r.handle._actor_id.hex(): float(r.prefix_blocks_resident)
+                for r in replicas}
+
+    def get_replicas(self, name: str, with_warmth: bool = False):
         """-> (version, max_concurrent_queries, [actor handles]) for
-        routing. Draining replicas are EXCLUDED: the router stops
-        assigning new requests/streams the moment its next refresh
-        lands, while in-flight work on them runs to completion."""
+        routing — plus the cache-warmth map (actor hex -> resident
+        prefix blocks) when ``with_warmth``, so the handle gets both in
+        ONE round trip per refresh. Draining replicas are EXCLUDED: the
+        router stops assigning new requests/streams the moment its next
+        refresh lands, while in-flight work on them runs to
+        completion."""
         with self._lock:
             st = self._deployments.get(name)
             if st is None:
-                return (0, 0, [])
-            handles = [r.handle for r in st.replicas
-                       if not r.starting and not r.draining
-                       and r.version == st.version]
-            return (st.version, st.config.max_concurrent_queries, handles)
+                return (0, 0, [], {}) if with_warmth else (0, 0, [])
+            routable = self._routable(st)
+            handles = [r.handle for r in routable]
+            if not with_warmth:
+                return (st.version, st.config.max_concurrent_queries,
+                        handles)
+            return (st.version, st.config.max_concurrent_queries,
+                    handles, self._warmth_of(routable))
 
     def drain_replicas(self, actor_id_hexes, grace_s: float = 30.0) -> int:
         """Preemption-notice draining: mark every replica whose actor id
@@ -155,6 +179,20 @@ class ServeController:
                 pass
         return len(marked)
 
+    def replica_warmth(self, name: str) -> Dict[str, float]:
+        """actor_id hex -> CURRENT resident prefix-block count for
+        every routable replica (the health-ping `cache_stats` surface).
+        Resident blocks, not the cumulative hit rate, is the warmth
+        signal: a cleared or freshly-restarted cache reads 0 here no
+        matter what its historical ratio was. Introspection twin of the
+        map `get_replicas(..., with_warmth=True)` piggybacks to the
+        router."""
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None:
+                return {}
+            return self._warmth_of(self._routable(st))
+
     def status(self) -> Dict[str, dict]:
         with self._lock:
             return {
@@ -163,7 +201,9 @@ class ServeController:
                        "running": sum(1 for r in st.replicas
                                       if not r.starting and not r.draining),
                        "draining": sum(1 for r in st.replicas
-                                       if r.draining)}
+                                       if r.draining),
+                       "cache_blocks_resident": sum(
+                           r.prefix_blocks_resident for r in st.replicas)}
                 for name, st in self._deployments.items() if not st.deleted
             }
 
@@ -255,10 +295,16 @@ class ServeController:
             if r is None:
                 break
             active.append(r)
-        # scale down (newest starting first, then newest running)
+        # scale down (starting first; among running, the CACHE-COLDEST
+        # goes first — killing a warm replica throws away resident
+        # prefix KV that sessions pinned to it still want — then
+        # newest). Warmth = CURRENT resident blocks, not the cumulative
+        # hit rate: a cleared cache is cold regardless of its history
         while len(active) > target:
-            victim = sorted(active, key=lambda r: (not r.starting,
-                                                   -r.started_at))[0]
+            victim = sorted(active,
+                            key=lambda r: (not r.starting,
+                                           r.prefix_blocks_resident,
+                                           -r.started_at))[0]
             with self._lock:
                 if victim in st.replicas:
                     st.replicas.remove(victim)
@@ -294,6 +340,9 @@ class ServeController:
                 # a deep engine queue behind one streaming call
                 r.last_ongoing = max(int(info.get("ongoing", 0)),
                                      int(info.get("queue_depth", 0)))
+                r.cache_hit_rate = float(info.get("cache_hit_rate", 0.0))
+                r.prefix_blocks_resident = int(
+                    info.get("prefix_blocks_resident", 0))
             except Exception:
                 grace = st.config.health_check_timeout_s * 3
                 if r.starting and time.monotonic() - r.started_at < grace:

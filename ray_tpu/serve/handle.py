@@ -39,6 +39,14 @@ _H_SERVE_REQUEST = _metrics.Histogram(
     "end-to-end serve request latency through the routing handle",
     tag_keys=("deployment",))
 
+# a session whose affinity replica vanished (death, drain, saturation)
+# was re-pinned to a different replica — its cached prefix KV must be
+# rebuilt there, so this counts cache-warmth lost to replica churn
+_C_SESSION_REROUTES = _metrics.Counter(
+    "ray_tpu_serve_session_reroutes_total",
+    "session-affinity reassignments to a different replica",
+    tag_keys=("deployment",))
+
 
 class DeploymentResponse:
     """Future-like result of handle.remote(). `ray_tpu.get` accepts it
@@ -55,6 +63,22 @@ class DeploymentResponse:
 
     def done(self) -> bool:
         return self._fut.done()
+
+
+def extract_session(query: Dict[str, list], data) -> str:
+    """Session id for proxy routing: the ``?session=`` query param wins
+    over a payload-level ``"session_id"``. ONE precedence rule shared by
+    both HTTP proxies — they must never route the same request to
+    different sessions."""
+    sess = (query.get("session") or [""])[0]
+    if not sess and isinstance(data, dict):
+        sess = str(data.get("session_id") or "")
+    return sess
+
+
+# query keys the proxies consume themselves — never forwarded as
+# payload fields on GET requests
+PROXY_CONTROL_PARAMS = ("stream", "model_id", "session")
 
 
 async def executor_anext(next_fn):
@@ -138,7 +162,8 @@ class FailoverResponseGenerator:
     _MAX_FAILOVERS = 8
 
     def __init__(self, handle: "DeploymentHandle", method: str, args,
-                 kwargs, mux_id: str, resume, deadline: float):
+                 kwargs, mux_id: str, resume, deadline: float,
+                 session_id: str = ""):
         self._handle = handle
         self._method = method
         self._args = args
@@ -146,6 +171,7 @@ class FailoverResponseGenerator:
         self._mux_id = mux_id
         self._resume = resume
         self._deadline = deadline
+        self._session_id = session_id
         self._gen: Optional[DeploymentResponseGenerator] = None
         self._replica = None
         self._yielded: list = []
@@ -163,7 +189,7 @@ class FailoverResponseGenerator:
             return
         self._gen, self._replica = self._handle._start_stream(
             self._method, self._args, self._kwargs, self._mux_id,
-            self._deadline)
+            self._deadline, self._session_id)
         self._handle._assign_stream(self._key, self._replica._actor_id)
 
     def _finish(self) -> None:
@@ -231,12 +257,22 @@ class DeploymentHandle:
         self._init_local()
 
     def options(self, *, stream: bool = False,
-                multiplexed_model_id: str = "") -> "_OptionsHandle":
+                multiplexed_model_id: str = "",
+                session_id: str = "") -> "_OptionsHandle":
         """ref: handle.py DeploymentHandle.options(stream=...,
-        multiplexed_model_id=...)."""
-        return _OptionsHandle(self, stream, multiplexed_model_id)
+        multiplexed_model_id=...). ``session_id`` pins every request of
+        a multi-turn conversation to one replica (the one already
+        holding its prefix KV) until that replica dies, drains, or
+        saturates — then the session re-routes (counted in
+        ray_tpu_serve_session_reroutes_total)."""
+        return _OptionsHandle(self, stream, multiplexed_model_id,
+                              session_id)
+
+    _MAX_SESSIONS = 4096  # affinity-table LRU cap
 
     def _init_local(self) -> None:
+        import collections
+
         self._controller = None
         self._version = -1
         self._replicas: list = []
@@ -244,6 +280,15 @@ class DeploymentHandle:
         self._refreshed = 0.0
         self._inflight: Dict[Any, int] = {}  # replica actor_id -> count
         self._depth_cache: Dict[Any, tuple] = {}  # actor_id -> (ts, depth)
+        # session-aware routing (docs/LLM_SERVE.md "Prefix caching &
+        # sessions"): session_id -> replica actor_id, LRU-capped.
+        # Multi-turn conversations land on the replica already holding
+        # their prefix KV; a vanished replica (death/drain) breaks the
+        # pin and the next turn re-routes (counted).
+        self._sessions: "collections.OrderedDict" = collections.OrderedDict()
+        # actor_id hex -> resident prefix blocks (refreshed with the
+        # replica list; the p2c tie-break reads it without blocking)
+        self._warmth: Dict[str, float] = {}
         self._lock = threading.Lock()
         self._router: Optional[ThreadPoolExecutor] = None
 
@@ -260,10 +305,15 @@ class DeploymentHandle:
                 return
         if self._controller is None:
             self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
-        version, max_q, replicas = ray_tpu.get(
-            self._controller.get_replicas.remote(self._name), timeout=30)
+        # warmth (resident prefix blocks per replica) piggybacks on the
+        # SAME round trip — the _pick tie-break only ever reads the
+        # cached map, never blocks on the controller
+        version, max_q, replicas, warmth = ray_tpu.get(
+            self._controller.get_replicas.remote(self._name, True),
+            timeout=30)
         with self._lock:
             self._refreshed = time.monotonic()
+            self._warmth = warmth or {}
             if replicas:
                 self._replicas = replicas
                 self._max_q = max_q or 8
@@ -367,9 +417,38 @@ class DeploymentHandle:
                     hosts.append(r)
         return hosts
 
-    def _pick(self, mux_id: str = ""):
+    def _pin_session(self, session_id: str, replica) -> None:
+        """Record/refresh the session -> replica pin; a pin that moved
+        to a DIFFERENT replica counts as a reroute (the session's cached
+        prefix must be rebuilt there)."""
+        with self._lock:
+            old = self._sessions.pop(session_id, None)
+            self._sessions[session_id] = replica._actor_id
+            while len(self._sessions) > self._MAX_SESSIONS:
+                self._sessions.popitem(last=False)
+        if old is not None and old != replica._actor_id:
+            _C_SESSION_REROUTES.inc(tags={"deployment": self._name})
+
+    def _pick(self, mux_id: str = "", session_id: str = ""):
         """-> replica handle, or None when all replicas are saturated or
         unknown (caller backs off / refreshes)."""
+        if session_id:
+            with self._lock:
+                aid = self._sessions.get(session_id)
+                pinned = next((r for r in self._replicas
+                               if r._actor_id == aid), None) \
+                    if aid is not None else None
+            if pinned is not None:
+                depth = self._probe_depths([pinned])[0]
+                with self._lock:
+                    local = self._inflight.get(pinned._actor_id, 0)
+                    if max(depth, local) < self._max_q:
+                        self._inflight[pinned._actor_id] = local + 1
+                        if session_id in self._sessions:
+                            self._sessions.move_to_end(session_id)
+                        return pinned
+            # pin broken (replica dead/draining/saturated): fall through
+            # to p2c; _pin_session below records the reroute
         if mux_id:
             hosts = self._mux_candidates(mux_id)
             if hosts:
@@ -379,6 +458,8 @@ class DeploymentHandle:
                     with self._lock:
                         aid = hosts[j]._actor_id
                         self._inflight[aid] = self._inflight.get(aid, 0) + 1
+                    if session_id:
+                        self._pin_session(session_id, hosts[j])
                     return hosts[j]
             # no replica hosts the model (or all saturated): fall through
             # to plain p2c — the chosen replica will load it
@@ -392,7 +473,18 @@ class DeploymentHandle:
                 a, b = random.sample(range(n), 2)
                 cands = [self._replicas[a], self._replicas[b]]
         depths = self._probe_depths(cands)
-        j = min(range(len(cands)), key=lambda i: depths[i])
+        if len(cands) > 1 and depths[0] == depths[1]:
+            # equal load: prefer the cache-warm replica — its resident
+            # prefixes make the marginal request cheaper (ROADMAP 3's
+            # "balancer prefers cache-warm replicas"). The warmth map is
+            # refreshed on the _refresh cadence; this reads the cached
+            # copy and never blocks.
+            with self._lock:
+                warmth = self._warmth
+            j = max(range(len(cands)), key=lambda i: warmth.get(
+                cands[i]._actor_id.hex(), 0.0))
+        else:
+            j = min(range(len(cands)), key=lambda i: depths[i])
         cand, depth = cands[j], depths[j]
         with self._lock:
             local = self._inflight.get(cand._actor_id, 0)
@@ -400,12 +492,14 @@ class DeploymentHandle:
                 return None
             aid = cand._actor_id
             self._inflight[aid] = local + 1
-            return cand
+        if session_id:
+            self._pin_session(session_id, cand)
+        return cand
 
     # -- the router worker ----------------------------------------------------
 
     def _route_blocking(self, method: str, args, kwargs, deadline: float,
-                        mux_id: str = ""):
+                        mux_id: str = "", session_id: str = ""):
         import ray_tpu.core.runtime as runtime_mod
 
         if mux_id:
@@ -416,7 +510,7 @@ class DeploymentHandle:
         t_start = time.perf_counter()
         try:
             return self._route_with_retries(rt, method, args, kwargs,
-                                            deadline, mux_id)
+                                            deadline, mux_id, session_id)
         finally:
             _H_SERVE_REQUEST.observe(time.perf_counter() - t_start,
                                      tags={"deployment": self._name})
@@ -428,11 +522,11 @@ class DeploymentHandle:
                                  max_backoff_s=0.375, jitter=0.34)
 
     def _route_with_retries(self, rt, method, args, kwargs, deadline,
-                            mux_id):
+                            mux_id, session_id=""):
         saturated = 0
         while True:
             self._refresh()
-            replica = self._pick(mux_id)
+            replica = self._pick(mux_id, session_id)
             if replica is None:
                 if time.monotonic() > deadline:
                     raise TimeoutError(
@@ -458,8 +552,8 @@ class DeploymentHandle:
             finally:
                 self._dec_inflight(aid)
 
-    def _submit(self, method: str, args, kwargs,
-                mux_id: str = "") -> DeploymentResponse:
+    def _submit(self, method: str, args, kwargs, mux_id: str = "",
+                session_id: str = "") -> DeploymentResponse:
         with self._lock:
             if self._router is None:
                 self._router = ThreadPoolExecutor(
@@ -467,17 +561,18 @@ class DeploymentHandle:
             router = self._router
         deadline = time.monotonic() + 300.0
         fut = router.submit(self._route_blocking, method, args, kwargs,
-                            deadline, mux_id)
+                            deadline, mux_id, session_id)
         return DeploymentResponse(fut)
 
-    def _pick_replica_blocking(self, mux_id: str, deadline: float):
-        """Block until some replica accepts (p2c + saturation backoff);
-        raises TimeoutError at the deadline. The picked replica's
-        in-flight count was already incremented by _pick."""
+    def _pick_replica_blocking(self, mux_id: str, deadline: float,
+                               session_id: str = ""):
+        """Block until some replica accepts (affinity/p2c + saturation
+        backoff); raises TimeoutError at the deadline. The picked
+        replica's in-flight count was already incremented by _pick."""
         saturated = 0
         while True:
             self._refresh()
-            replica = self._pick(mux_id)
+            replica = self._pick(mux_id, session_id)
             if replica is not None:
                 return replica
             if time.monotonic() > deadline:
@@ -495,10 +590,10 @@ class DeploymentHandle:
                 self._inflight[aid] = c
 
     def _start_stream(self, method: str, args, kwargs, mux_id: str,
-                      deadline: float):
+                      deadline: float, session_id: str = ""):
         """-> (DeploymentResponseGenerator, replica). One routed
         streaming submission; the caller owns failover policy."""
-        replica = self._pick_replica_blocking(mux_id, deadline)
+        replica = self._pick_replica_blocking(mux_id, deadline, session_id)
         aid = replica._actor_id
         try:
             ref_gen = replica.handle_request_streaming.options(
@@ -508,7 +603,8 @@ class DeploymentHandle:
         return DeploymentResponseGenerator(ref_gen), replica
 
     def _submit_streaming(self, method: str, args, kwargs,
-                          mux_id: str = "", resume=None):
+                          mux_id: str = "", resume=None,
+                          session_id: str = ""):
         """Streaming requests route synchronously (picking a replica is
         cheap; the chunks themselves are pull-driven).
 
@@ -529,9 +625,10 @@ class DeploymentHandle:
         deadline = time.monotonic() + 300.0
         if resume is not None:
             return FailoverResponseGenerator(self, method, args, kwargs,
-                                             mux_id, resume, deadline)
+                                             mux_id, resume, deadline,
+                                             session_id)
         gen, _replica = self._start_stream(method, args, kwargs, mux_id,
-                                           deadline)
+                                           deadline, session_id)
         return gen
 
     def stream_assignments(self) -> Dict[int, Any]:
@@ -539,6 +636,12 @@ class DeploymentHandle:
         stream id); the observability hook chaos_smoke asserts on."""
         with self._lock:
             return dict(getattr(self, "_stream_assign", {}) or {})
+
+    def session_assignments(self) -> Dict[str, Any]:
+        """Live session → replica actor-id affinity pins (tests and the
+        traffic harness assert stickiness/reroutes on this view)."""
+        with self._lock:
+            return dict(self._sessions)
 
     def _assign_stream(self, stream_key: int, aid) -> None:
         with self._lock:
@@ -576,39 +679,46 @@ class _MethodCaller:
 
 
 class _OptionsHandle:
-    """handle.options(stream=..., multiplexed_model_id=...) view — same
-    underlying routing state, different submission mode."""
+    """handle.options(stream=..., multiplexed_model_id=...,
+    session_id=...) view — same underlying routing state, different
+    submission mode."""
 
     def __init__(self, handle: DeploymentHandle, stream: bool,
-                 mux_id: str):
+                 mux_id: str, session_id: str = ""):
         self._handle = handle
         self._stream = stream
         self._mux_id = mux_id
+        self._session_id = session_id
 
     def options(self, *, stream: Optional[bool] = None,
-                multiplexed_model_id: Optional[str] = None
-                ) -> "_OptionsHandle":
+                multiplexed_model_id: Optional[str] = None,
+                session_id: Optional[str] = None) -> "_OptionsHandle":
         return _OptionsHandle(
             self._handle,
             self._stream if stream is None else stream,
             self._mux_id if multiplexed_model_id is None
-            else multiplexed_model_id)
+            else multiplexed_model_id,
+            self._session_id if session_id is None else session_id)
 
     def remote(self, *args, **kwargs):
         if self._stream:
-            return self._handle._submit_streaming("__call__", args, kwargs,
-                                                  self._mux_id)
-        return self._handle._submit("__call__", args, kwargs, self._mux_id)
+            return self._handle._submit_streaming(
+                "__call__", args, kwargs, self._mux_id,
+                session_id=self._session_id)
+        return self._handle._submit("__call__", args, kwargs,
+                                    self._mux_id, self._session_id)
 
     def __getattr__(self, item: str):
         if item.startswith("_"):
             raise AttributeError(item)
-        h, stream, mux = self._handle, self._stream, self._mux_id
+        h, stream = self._handle, self._stream
+        mux, sess = self._mux_id, self._session_id
 
         class _Caller:
             def remote(self, *args, **kwargs):
                 if stream:
-                    return h._submit_streaming(item, args, kwargs, mux)
-                return h._submit(item, args, kwargs, mux)
+                    return h._submit_streaming(item, args, kwargs, mux,
+                                               session_id=sess)
+                return h._submit(item, args, kwargs, mux, sess)
 
         return _Caller()

@@ -151,13 +151,21 @@ class AsyncHTTPProxy:
                                  keep)
                 return keep
         else:
+            from .handle import PROXY_CONTROL_PARAMS
+
             data = {k: v[0] if len(v) == 1 else v for k, v in q.items()
-                    if k not in ("stream", "model_id")} or None
+                    if k not in PROXY_CONTROL_PARAMS} or None
         mux = (q.get("model_id") or [""])[0]
+        # session-aware routing: shared precedence rule (?session= beats
+        # payload "session_id") so both proxies pin identically
+        from .handle import extract_session
+
+        sess = extract_session(q, data)
         stream_mode = (q.get("stream") or ["0"])[0]
         if stream_mode in ("1", "true", "sse"):
             try:
                 ok = await self._stream_response(writer, name, data, mux,
+                                                 sess,
                                                  sse=stream_mode == "sse")
             except Exception as e:  # noqa: BLE001 — pre-header failure
                 # nothing on the wire yet (submission/iterator setup
@@ -176,7 +184,7 @@ class AsyncHTTPProxy:
             return keep
         try:
             result = await self._in_pool(self._call_blocking, name, data,
-                                         mux)
+                                         mux, sess)
             self._write_json(writer, 200, _jsonable(result), keep)
         except Exception as e:  # noqa: BLE001
             self._errors += 1
@@ -185,7 +193,7 @@ class AsyncHTTPProxy:
         return keep
 
     async def _stream_response(self, writer, name, data, mux,
-                               sse: bool = False) -> bool:
+                               sess: str = "", sse: bool = False) -> bool:
         """Chunked streaming: generator items are pulled on the pool
         (each next() blocks on the replica) and written as they arrive —
         NDJSON lines by default, SSE `data:` frames with a terminal
@@ -193,7 +201,8 @@ class AsyncHTTPProxy:
         go out propagate (caller sends a 500); a mid-stream failure
         closes the connection and returns False."""
         gen = self._get_handle(name).options(
-            stream=True, multiplexed_model_id=mux).remote(data)
+            stream=True, multiplexed_model_id=mux,
+            session_id=sess).remote(data)
         ctype = b"text/event-stream" if sse else b"application/x-ndjson"
         writer.write(b"HTTP/1.1 200 OK\r\n"
                      b"Content-Type: " + ctype + b"\r\n"
@@ -242,10 +251,10 @@ class AsyncHTTPProxy:
     def _in_pool(self, fn, *args):
         return self._loop.run_in_executor(self._pool, fn, *args)
 
-    def _call_blocking(self, name: str, data, mux: str):
+    def _call_blocking(self, name: str, data, mux: str, sess: str = ""):
         h = self._get_handle(name)
-        if mux:
-            h = h.options(multiplexed_model_id=mux)
+        if mux or sess:
+            h = h.options(multiplexed_model_id=mux, session_id=sess)
         return ray_tpu.get(h.remote(data), timeout=60)
 
     def _get_handle(self, name: str):

@@ -97,18 +97,24 @@ class HTTPProxy:
                 if not name:
                     self._reply(404, {"error": "no deployment in path"})
                     return
+                from .handle import extract_session
+
                 try:
                     h = proxy._get_handle(name)
                     mux = (q.get("model_id") or [""])[0]
+                    # session-aware routing: multi-turn conversations
+                    # stick to the replica holding their prefix KV
+                    sess = extract_session(q, data)
                     stream_mode = (q.get("stream") or ["0"])[0]
                     if stream_mode in ("1", "true", "sse"):
                         gen = h.options(stream=True,
-                                        multiplexed_model_id=mux
-                                        ).remote(data)
+                                        multiplexed_model_id=mux,
+                                        session_id=sess).remote(data)
                         self._stream_reply(gen, sse=stream_mode == "sse")
                         return
-                    if mux:
-                        h = h.options(multiplexed_model_id=mux)
+                    if mux or sess:
+                        h = h.options(multiplexed_model_id=mux,
+                                      session_id=sess)
                     ref = h.remote(data)
                     result = ray_tpu.get(ref, timeout=60)
                     self._reply(200, proxy._jsonable(result))
@@ -126,9 +132,11 @@ class HTTPProxy:
                 self._dispatch(data)
 
             def do_GET(self):  # noqa: N802
+                from .handle import PROXY_CONTROL_PARAMS
+
                 q = parse_qs(urlparse(self.path).query)
                 data = {k: v[0] if len(v) == 1 else v for k, v in q.items()
-                        if k not in ("stream", "model_id")}  # control params
+                        if k not in PROXY_CONTROL_PARAMS}
                 self._dispatch(data or None)
 
         self._server = ThreadingHTTPServer((host, port), Handler)

@@ -16,9 +16,11 @@ from .disagg import DecodeStage, DisaggLLM, PrefillStage
 from .engine import EngineConfig, LLMEngine, Request, TokenStream
 from .failover import llm_resume, resilient_stream
 from .kv_cache import BlockPool, blocks_for_tokens
+from .prefix_cache import PrefixCache, PrefixMatch
 
 __all__ = [
     "BlockPool", "DecodeStage", "DisaggLLM", "EngineConfig", "LLMEngine",
-    "LLMServer", "PrefillStage", "Request", "TokenStream", "build_model",
-    "blocks_for_tokens", "llm_resume", "resilient_stream",
+    "LLMServer", "PrefillStage", "PrefixCache", "PrefixMatch", "Request",
+    "TokenStream", "build_model", "blocks_for_tokens", "llm_resume",
+    "resilient_stream",
 ]

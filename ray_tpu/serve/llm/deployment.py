@@ -118,6 +118,13 @@ class LLMServer:
         by the controller's autoscaler (max'd with in-flight RPCs)."""
         return self.engine.queue_depth()
 
+    def cache_stats(self) -> Dict[str, Any]:
+        """Prefix-cache health (cache_hit_rate, prefix_blocks_resident,
+        ...) — the replica merges this into its health ping so the
+        controller and the session-aware router can prefer cache-warm
+        replicas (controller.py / handle.py)."""
+        return self.engine.cache_stats()
+
     def stats(self) -> Dict[str, Any]:
         return self.engine.stats()
 

@@ -68,6 +68,22 @@ _H_TPOT = _metrics.Histogram(
     "ray_tpu_llm_tpot_seconds",
     "time per output token during decode (inter-token latency)",
     boundaries=_metrics.FAST_BOUNDARIES, tag_keys=("engine",))
+_C_PREFIX_HIT = _metrics.Counter(
+    "ray_tpu_llm_prefix_hit_tokens",
+    "prompt tokens whose KV came from the radix prefix cache (block-"
+    "table reuse, no prefill compute)", tag_keys=("engine",))
+_C_PREFIX_MISS = _metrics.Counter(
+    "ray_tpu_llm_prefix_miss_tokens",
+    "prompt tokens that paid prefill compute (cold or divergent)",
+    tag_keys=("engine",))
+_G_HIT_RATE = _metrics.Gauge(
+    "ray_tpu_llm_cache_hit_rate",
+    "cumulative prefix-cache hit rate: hit_tokens / (hit + miss)",
+    tag_keys=("engine",))
+_C_PREEMPT = _metrics.Counter(
+    "ray_tpu_llm_preemptions_total",
+    "sequences preempted-and-requeued on KV pool exhaustion",
+    tag_keys=("engine",))
 
 
 @dataclass
@@ -88,6 +104,14 @@ class EngineConfig:
     prefill_buckets: Tuple[int, ...] = (16, 32, 64, 128, 256)
     eos_id: Optional[int] = None       # engine-wide default EOS
     idle_sleep_s: float = 0.002        # background-loop sleep when idle
+    # radix prefix cache (prefix_cache.py, docs/LLM_SERVE.md "Prefix
+    # caching & sessions"): retired/preempted sequences leave their
+    # full-block prompt+completion KV indexed in a radix tree; a new
+    # request reuses the longest cached prefix (refcounted block
+    # sharing, copy-on-write at a mid-block divergence) and prefills
+    # only its suffix. LRU-evicted under pool pressure. Greedy decode
+    # keeps outputs token-identical to cache-off.
+    prefix_cache: bool = False
 
     @property
     def max_context(self) -> int:
@@ -177,16 +201,21 @@ class _Sequence:
     """A running request's batch-slot state."""
 
     __slots__ = ("req", "slot", "blocks", "seq_len", "pending",
-                 "last_emit_at")
+                 "last_emit_at", "tokens")
 
     def __init__(self, req: Request, slot: int, blocks: List[int],
-                 seq_len: int, pending: int):
+                 seq_len: int, pending: int,
+                 tokens: Optional[List[int]] = None):
         self.req = req
         self.slot = slot
         self.blocks = blocks           # pool block ids, table order
         self.seq_len = seq_len         # tokens whose KV is in cache
         self.pending = pending         # emitted token awaiting its KV write
         self.last_emit_at = time.perf_counter()
+        # the token identity of the resident KV, position by position —
+        # what the prefix cache indexes at retire/preempt time
+        self.tokens: List[int] = list(tokens if tokens is not None
+                                      else req.prompt)
 
 
 class LLMEngine:
@@ -251,10 +280,18 @@ class LLMEngine:
         self._peak_blocks = 0
         self._peak_per_chip: List[int] = [0] * self.tp
         self._tok_events: "collections.deque" = collections.deque()
+        self.prefix_cache = None
+        self._prefix_hits = 0          # tokens served from cached KV
+        self._prefix_misses = 0        # tokens that paid prefill compute
+        if cfg.prefix_cache:
+            from .prefix_cache import PrefixCache
 
-        # two jit entry points; jax caches one compiled program per
-        # argument shape, so decode compiles once and prefill once per
-        # bucket — the buckets BOUND the program count
+            self.prefix_cache = PrefixCache(self.pool, cfg.block_size)
+
+        # jit entry points; jax caches one compiled program per argument
+        # shape, so decode compiles once and prefill (and the suffix
+        # extend variant) once per bucket — the buckets BOUND the
+        # program count
         def _decode(params, kc, vc, tokens, positions, rows, active):
             logits, cache = model.paged_decode_step(
                 params, {"k": kc, "v": vc}, tokens, positions, rows, active)
@@ -265,9 +302,23 @@ class LLMEngine:
                 params, {"k": kc, "v": vc}, tokens, length, block_row)
             return logits, cache["k"], cache["v"]
 
+        def _extend(params, kc, vc, tokens, start, length, block_row):
+            logits, cache = model.paged_prefill_extend(
+                params, {"k": kc, "v": vc}, tokens, start, length,
+                block_row)
+            return logits, cache["k"], cache["v"]
+
+        def _cow(kc, vc, src, dst):
+            # duplicate one pool block (copy-on-write divergence point):
+            # block axis is axis 1 of the [L, N, Bs, KH, hd] cache
+            return (kc.at[:, dst].set(kc[:, src]),
+                    vc.at[:, dst].set(vc[:, src]))
+
         if self.owner is None:
             self._decode_fn = jax.jit(_decode)
             self._prefill_fn = jax.jit(_prefill)
+            self._extend_fn = jax.jit(_extend)
+            self._cow_fn = jax.jit(_cow)
         else:
             # pjit plane (sharding/lower.py): GSPMD partitions the body
             # under the replica's mesh. Host-side inputs (tokens/rows/
@@ -291,6 +342,14 @@ class LLMEngine:
                 _prefill, self.owner,
                 in_specs=(pspecs, kvspec, kvspec, rep, rep, rep),
                 out_specs=(rep, kvspec, kvspec))
+            self._extend_fn = lower_jit(
+                _extend, self.owner,
+                in_specs=(pspecs, kvspec, kvspec, rep, rep, rep, rep),
+                out_specs=(rep, kvspec, kvspec))
+            self._cow_fn = lower_jit(
+                _cow, self.owner,
+                in_specs=(kvspec, kvspec, rep, rep),
+                out_specs=(kvspec, kvspec))
 
     # -- request intake -------------------------------------------------------
 
@@ -343,7 +402,10 @@ class LLMEngine:
         deadline = time.monotonic() + timeout
         while True:
             with self._lock:
-                blocks = self.pool.alloc(nb)
+                # evicting alloc: a prefix-cached decode stage would
+                # otherwise wedge once rc-1 cache residency drains the
+                # free list (nothing here runs _admit's eviction path)
+                blocks = self._alloc_with_evict(nb)
                 slot = self._free_slots.pop() if (
                     blocks is not None and self._free_slots) else None
                 if blocks is not None and slot is None:
@@ -396,6 +458,19 @@ class LLMEngine:
             self._update_gauges()
             return admitted or decoded
 
+    def _alloc_with_evict(self, n: int) -> Optional[List[int]]:
+        """Pool alloc that spends cached prefixes under pressure: when
+        the free list can't cover ``n``, LRU-evict refcount-1 cache
+        nodes until it can (cache residency is a best-effort use of idle
+        blocks, never a reason to preempt live work)."""
+        blocks = self.pool.alloc(n)
+        if blocks is None and self.prefix_cache is not None:
+            short = n - self.pool.free_count
+            if short > 0:
+                self.prefix_cache.evict(short)
+            blocks = self.pool.alloc(n)
+        return blocks
+
     def _admit(self) -> bool:
         cfg = self.config
         budget = cfg.max_prefill_tokens_per_step
@@ -410,22 +485,50 @@ class LLMEngine:
                     f"{req.request_id}: context {p} exceeds engine "
                     f"capacity {self.max_prompt}"))
                 continue
-            if admitted and p > budget:
+            # longest cached prefix (at most p-1: the last prompt token
+            # always prefills so its logits pick the first new token)
+            match = None
+            cached = 0
+            if self.prefix_cache is not None:
+                match = self.prefix_cache.match(req.prompt[:-1])
+                cached = match.num_tokens + match.partial_len
+            if admitted and p - cached > budget:
                 break                     # token budget for this iteration
             nb = blocks_for_tokens(p, cfg.block_size)
-            blocks = self.pool.alloc(nb)
+            reused = list(match.blocks) if match else []
+            # pin the matched blocks (and the COW source) before any
+            # eviction the alloc below may trigger can free them
+            if reused:
+                self.pool.retain(reused)
+            if match is not None and match.partial_block is not None:
+                self.pool.retain([match.partial_block])
+            blocks = self._alloc_with_evict(nb - len(reused))
             if blocks is None:
+                if reused:
+                    self.pool.free(reused)
+                if match is not None and match.partial_block is not None:
+                    self.pool.free([match.partial_block])
                 if not self._running and nb > self.pool.num_blocks:
                     self._waiting.popleft()
                     req.stream._finish("error", RuntimeError(
                         f"{req.request_id}: prompt needs {nb} blocks; "
                         f"pool holds {self.pool.num_blocks}"))
                     continue
+                if not self._running and self.prefix_cache is not None \
+                        and self.prefix_cache.resident_blocks:
+                    # nothing running will ever free blocks, and partial
+                    # matches can pin nodes eviction must skip: drop the
+                    # whole cache and retry cold — progress beats warmth
+                    self.prefix_cache.clear()
+                    continue
                 break                     # wait for decode frees/preemption
             self._waiting.popleft()
-            budget -= p
+            budget -= p - cached
             admitted = True
-            self._prefill_into(req, blocks)
+            if cached:
+                self._prefill_cached(req, match, blocks)
+            else:
+                self._prefill_into(req, blocks)
         return admitted
 
     def _prefill_into(self, req: Request, blocks: List[int]) -> None:
@@ -443,15 +546,73 @@ class LLMEngine:
             jnp.asarray(toks), jnp.int32(p), jnp.asarray(row))
         self._cache = {"k": kc, "v": vc}
         first = int(np.asarray(logits).argmax())
+        self._count_prefix(0, p)
+        self._start_sequence(req, blocks, p, first)
+
+    def _prefill_cached(self, req: Request, match, blocks: List[int]) -> None:
+        """Suffix-only prefill over a matched cached prefix: the
+        sequence's table is [reused full blocks | fresh blocks]; a
+        mid-block divergence first duplicates the partially-shared block
+        into the first fresh one (COW), then only prompt[cached:] runs
+        through the extend program — the dominant cost of a shared-
+        prefix request becomes this block-table splice."""
+        import jax.numpy as jnp
+
+        cfg = self.config
+        p = len(req.prompt)
+        cached = match.num_tokens + match.partial_len
+        table = list(match.blocks) + blocks
+        if match.partial_len:
+            # COW at the divergence point: blocks[0] becomes this
+            # sequence's private copy of the partially-shared block
+            kc, vc = self._cow_fn(
+                self._cache["k"], self._cache["v"],
+                jnp.int32(match.partial_block), jnp.int32(blocks[0]))
+            self._cache = {"k": kc, "v": vc}
+            # the pin taken at match time was only for the copy
+            self.pool.free([match.partial_block])
+        suffix = req.prompt[cached:]
+        s = len(suffix)
+        bucket = next(b for b in self.buckets if b >= s)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :s] = suffix
+        row = np.full((cfg.max_blocks_per_seq,), -1, np.int32)
+        row[:len(table)] = table
+        logits, kc, vc = self._extend_fn(
+            self.params, self._cache["k"], self._cache["v"],
+            jnp.asarray(toks), jnp.int32(cached), jnp.int32(s),
+            jnp.asarray(row))
+        self._cache = {"k": kc, "v": vc}
+        first = int(np.asarray(logits).argmax())
+        self._count_prefix(cached, s)
+        self._start_sequence(req, table, p, first)
+
+    def _start_sequence(self, req: Request, blocks: List[int], p: int,
+                        first: int) -> None:
         slot = self._free_slots.pop()
         seq = _Sequence(req, slot, blocks, p, first)
         self._running.append(seq)
+        if self.prefix_cache is not None:
+            # index the prompt's full blocks NOW so concurrent requests
+            # sharing the prefix hit before this sequence even retires
+            self.prefix_cache.insert(seq.tokens, seq.blocks)
         now = time.perf_counter()
         if req.first_token_at is None:
             req.first_token_at = now
             _H_TTFT.observe(now - req.submitted_at,
                             tags={"engine": self.name})
         self._emit(seq, first, decode_step=False)
+
+    def _count_prefix(self, hit: int, miss: int) -> None:
+        if self.prefix_cache is None:
+            return
+        tags = {"engine": self.name}
+        if hit:
+            self._prefix_hits += hit
+            _C_PREFIX_HIT.inc(hit, tags=tags)
+        if miss:
+            self._prefix_misses += miss
+            _C_PREFIX_MISS.inc(miss, tags=tags)
 
     def _decode_iteration(self) -> bool:
         cfg = self.config
@@ -471,21 +632,48 @@ class LLMEngine:
             if need > cfg.max_blocks_per_seq:
                 self._retire(seq, "length")
                 continue
-            if need > len(seq.blocks):
-                got = self.pool.alloc(need - len(seq.blocks))
+            grow = need - len(seq.blocks)
+            # a shared block under the write position must be
+            # duplicated before this sequence extends it (COW): decode
+            # structurally writes only private tail blocks, but a
+            # refcount > 1 here — however it arose — would corrupt
+            # every other holder's context
+            wi = seq.seq_len // cfg.block_size
+            cow = (grow <= 0 and self.prefix_cache is not None
+                   and self.pool.refcount(seq.blocks[wi]) > 1)
+            if grow > 0 or cow:
+                got = self._alloc_with_evict(max(grow, 0) + int(cow))
                 if got is None:
                     victim = self._running[-1]
                     if victim is seq and len(self._running) == 1:
-                        # sole runner and the pool still can't grow it:
-                        # blocks are held outside this engine — fail loud
-                        self._retire(seq, "error", RuntimeError(
-                            f"{seq.req.request_id}: KV pool exhausted with "
-                            f"no preemptible sequence"))
-                        continue
-                    self._preempt(victim)
-                    if victim is seq:
-                        continue          # seq left the running list
-                    continue              # retry the same seq
+                        if self.prefix_cache is not None and \
+                                self.prefix_cache.resident_blocks:
+                            # partially-shared nodes can pin blocks LRU
+                            # eviction must skip — drop the whole cache
+                            # before declaring the pool exhausted.
+                            # Clearing may also drop the only other
+                            # reference on the write block: recompute
+                            # cow so the rescue doesn't pay a pointless
+                            # device copy
+                            self.prefix_cache.clear()
+                            cow = (grow <= 0 and self.pool.refcount(
+                                seq.blocks[wi]) > 1)
+                            got = self.pool.alloc(max(grow, 0) + int(cow))
+                        if got is None:
+                            # sole runner and the pool still can't grow
+                            # it: blocks are held outside this engine —
+                            # fail loud
+                            self._retire(seq, "error", RuntimeError(
+                                f"{seq.req.request_id}: KV pool exhausted "
+                                f"with no preemptible sequence"))
+                            continue
+                    else:
+                        self._preempt(victim)
+                        if victim is seq:
+                            continue      # seq left the running list
+                        continue          # retry the same seq
+                if cow:
+                    self._cow_block(seq, wi, got.pop())
                 seq.blocks.extend(got)
             i += 1
         if not self._running:
@@ -511,6 +699,7 @@ class LLMEngine:
         emitted = 0
         for seq in list(self._running):
             seq.seq_len += 1              # pending's KV landed this step
+            seq.tokens.append(seq.pending)
             tok = int(arr[seq.slot].argmax())
             seq.pending = tok
             self._emit(seq, tok, decode_step=True)
@@ -534,9 +723,27 @@ class LLMEngine:
         elif len(req.generated) >= req.max_tokens:
             self._retire(seq, "length")
 
+    def _cow_block(self, seq: _Sequence, wi: int, fresh: int) -> None:
+        """Copy-on-write: duplicate seq.blocks[wi] into ``fresh`` on
+        device, swap the table entry, release this sequence's reference
+        on the shared original."""
+        import jax.numpy as jnp
+
+        kc, vc = self._cow_fn(self._cache["k"], self._cache["v"],
+                              jnp.int32(seq.blocks[wi]), jnp.int32(fresh))
+        self._cache = {"k": kc, "v": vc}
+        self.pool.free([seq.blocks[wi]])
+        seq.blocks[wi] = fresh
+
     def _retire(self, seq: _Sequence, reason: str,
                 error: Optional[BaseException] = None) -> None:
         self._running.remove(seq)
+        if self.prefix_cache is not None and error is None:
+            # leave the full-block KV of prompt+completion behind for
+            # followers (multi-turn sessions re-send this context); the
+            # cache takes its own references, so the free below releases
+            # only this sequence's claim
+            self.prefix_cache.insert(seq.tokens, seq.blocks)
         self.pool.free(seq.blocks)
         self._free_slots.append(seq.slot)
         seq.req.stream._finish(reason, error)
@@ -544,8 +751,12 @@ class LLMEngine:
     def _preempt(self, seq: _Sequence) -> None:
         """Free everything the sequence holds and requeue it at the front
         of the waiting queue with prompt = full context so far; greedy
-        re-prefill continues the exact token sequence."""
+        re-prefill continues the exact token sequence (and, with the
+        prefix cache on, mostly re-uses its own still-cached KV — the
+        private tail is the only real loss)."""
         self._running.remove(seq)
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(seq.tokens, seq.blocks)
         self.pool.free(seq.blocks)
         self._free_slots.append(seq.slot)
         req = seq.req
@@ -555,6 +766,7 @@ class LLMEngine:
         req.prompt = list(req.prompt) + req.generated[-n_new:]
         req.preemptions += 1
         self._total_preemptions += 1
+        _C_PREEMPT.inc(tags={"engine": self.name})
         self._waiting.appendleft(req)
 
     # -- loop drivers ---------------------------------------------------------
@@ -628,8 +840,15 @@ class LLMEngine:
     def _update_gauges(self) -> None:
         tags = {"engine": self.name}
         _G_QUEUE.set(len(self._waiting) + len(self._running), tags=tags)
+        # used_count counts shared blocks ONCE (refcounted pool), so
+        # this gauge can never report occupancy above pool capacity
         _G_BLOCKS.set(self.pool.used_count, tags=tags)
         _G_TOKPS.set(round(self._tokens_per_s(), 1), tags=tags)
+        if self.prefix_cache is not None:
+            seen = self._prefix_hits + self._prefix_misses
+            _G_HIT_RATE.set(
+                round(self._prefix_hits / seen, 4) if seen else 0.0,
+                tags=tags)
         self._peak_blocks = max(self._peak_blocks, self.pool.used_count)
         if self.tp > 1:
             for chip, used in enumerate(self.pool.used_per_shard()):
@@ -651,6 +870,23 @@ class LLMEngine:
         return {chip: by_dev.get(d.id, 0)
                 for chip, d in enumerate(self.owner.devices)}
 
+    def cache_stats(self) -> Dict[str, Any]:
+        """Prefix-cache health — the replica ships this in its health
+        ping (replica.py) so the controller/balancer can prefer
+        cache-warm replicas. All zeros with the cache disabled."""
+        with self._lock:
+            hit, miss = self._prefix_hits, self._prefix_misses
+            pc = self.prefix_cache
+            return {
+                "cache_hit_rate": round(hit / (hit + miss), 4)
+                if hit + miss else 0.0,
+                "prefix_hit_tokens": hit,
+                "prefix_miss_tokens": miss,
+                "prefix_blocks_resident": pc.resident_blocks if pc else 0,
+                "prefix_nodes": pc.num_nodes if pc else 0,
+                "prefix_evictions": pc.evictions if pc else 0,
+            }
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             out = {
@@ -668,6 +904,8 @@ class LLMEngine:
                 "tp": self.tp,
                 "kv_blocks_peak": self._peak_blocks,
             }
+            if self.prefix_cache is not None:
+                out.update(self.cache_stats())
             if self.tp > 1:
                 out["kv_blocks_per_chip"] = self.pool.used_per_shard()
                 out["kv_blocks_peak_per_chip"] = list(self._peak_per_chip)

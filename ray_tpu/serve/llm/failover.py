@@ -48,7 +48,8 @@ def llm_resume(args: tuple, kwargs: dict,
 
 
 def resilient_stream(handle, payload: Dict[str, Any], *,
-                     multiplexed_model_id: str = ""):
+                     multiplexed_model_id: str = "",
+                     session_id: str = ""):
     """Stream tokens from an LLMServer deployment with replica-failover:
     returns a generator (sync and async iterable) whose token sequence
     is complete and prefix-consistent even when replicas die mid-stream.
@@ -65,4 +66,4 @@ def resilient_stream(handle, payload: Dict[str, Any], *,
     payload = {**payload, "stream": True}
     return handle._submit_streaming(
         "__call__", (payload,), {}, mux_id=multiplexed_model_id,
-        resume=llm_resume)
+        resume=llm_resume, session_id=session_id)

@@ -6,6 +6,17 @@ holds which blocks, and the alloc/free discipline whose failure path is
 preemption-and-requeue (engine.py). Kept separate so leak/accounting
 invariants are testable without touching jax at all.
 
+Blocks are REFCOUNTED (prefix caching, docs/LLM_SERVE.md "Prefix
+caching & sessions"): the radix prefix cache and every sequence reusing
+a cached prefix hold one reference each on the shared blocks.
+``alloc`` grants fresh blocks at refcount 1, ``retain`` adds a
+reference, ``free`` drops one — a block returns to the free list only
+when its last reference is released. ``used_count`` counts every live
+block ONCE regardless of how many holders share it, so the
+``ray_tpu_llm_kv_blocks_used`` gauge can never report occupancy above
+pool capacity, and ``check_leaks`` verifies the shared-block invariant
+(free list and live refcounts partition the pool exactly).
+
 With ``shards > 1`` (tensor-parallel engines, docs/SHARDING.md) the pool
 mirrors the device layout of the block-sharded cache arrays: block ids
 ``[c*N/shards, (c+1)*N/shards)`` live on chip ``c``, and allocation
@@ -19,8 +30,9 @@ from typing import List, Optional
 
 
 class BlockPool:
-    """Fixed pool of KV blocks. alloc() is all-or-nothing: a partial
-    grant would deadlock two growing sequences against each other."""
+    """Fixed pool of refcounted KV blocks. alloc() is all-or-nothing: a
+    partial grant would deadlock two growing sequences against each
+    other."""
 
     def __init__(self, num_blocks: int, shards: int = 1):
         if num_blocks <= 0:
@@ -40,7 +52,8 @@ class BlockPool:
         self._free_by_shard: List[List[int]] = [
             list(range((s + 1) * per - 1, s * per - 1, -1))
             for s in range(shards)]
-        self._used = 0
+        self._refcnt: List[int] = [0] * num_blocks
+        self._used = 0                 # live blocks, each counted ONCE
 
     @property
     def free_count(self) -> int:
@@ -48,20 +61,31 @@ class BlockPool:
 
     @property
     def used_count(self) -> int:
+        """Live blocks, shared blocks counted once — the occupancy the
+        ``ray_tpu_llm_kv_blocks_used`` gauge reports. Never exceeds
+        ``num_blocks`` no matter how many holders share a block."""
         return self._used
+
+    def refcount(self, block: int) -> int:
+        """Current reference count of a block (0 = free)."""
+        if not 0 <= block < self.num_blocks:
+            raise ValueError(f"unknown block {block}")
+        return self._refcnt[block]
 
     def shard_of(self, block: int) -> int:
         """Which chip's cache slice holds this block id."""
         return block // self._per_shard
 
     def used_per_shard(self) -> List[int]:
-        """Allocated blocks per chip (the {chip=} gauge series)."""
+        """Live blocks per chip (the {chip=} gauge series) — shared
+        blocks counted once, same as used_count."""
         return [self._per_shard - len(f) for f in self._free_by_shard]
 
     def alloc(self, n: int) -> Optional[List[int]]:
-        """n blocks, or None when the pool can't satisfy the request
-        (caller preempts or waits). n == 0 returns []. Blocks come from
-        the fullest-free shard first, so tp chips fill evenly."""
+        """n fresh blocks at refcount 1, or None when the pool can't
+        satisfy the request (caller evicts cached prefixes, preempts, or
+        waits). n == 0 returns []. Blocks come from the fullest-free
+        shard first, so tp chips fill evenly."""
         if n < 0:
             raise ValueError(f"alloc({n})")
         if n > self.free_count:
@@ -72,22 +96,51 @@ class BlockPool:
             # block with shards <= tp <= 8 — not a hot path
             s = max(range(self.shards),
                     key=lambda i: (len(self._free_by_shard[i]), -i))
-            out.append(self._free_by_shard[s].pop())
+            b = self._free_by_shard[s].pop()
+            self._refcnt[b] = 1
+            out.append(b)
         self._used += n
         return out
 
+    def retain(self, blocks: List[int]) -> None:
+        """Add one reference to each (live) block — how a sequence
+        reusing a cached prefix, or the prefix cache itself, shares
+        blocks another holder allocated."""
+        for b in blocks:
+            if not 0 <= b < self.num_blocks:
+                raise ValueError(f"retain of unknown block {b}")
+            if self._refcnt[b] <= 0:
+                raise ValueError(f"retain of free block {b}")
+        for b in blocks:
+            self._refcnt[b] += 1
+
     def free(self, blocks: List[int]) -> None:
+        """Drop one reference per block; a block returns to the free
+        list when its last holder releases it."""
         for b in blocks:
             if not 0 <= b < self.num_blocks:
                 raise ValueError(f"free of unknown block {b}")
-        if self._used < len(blocks):
-            raise ValueError("double free: more blocks returned than held")
-        self._used -= len(blocks)
+        # validate the whole batch before mutating: a double free must
+        # not release the valid half of the list first
+        counts = {}
         for b in blocks:
-            self._free_by_shard[self.shard_of(b)].append(b)
+            counts[b] = counts.get(b, 0) + 1
+        for b, n in counts.items():
+            if self._refcnt[b] < n:
+                raise ValueError(
+                    f"double free: block {b} released {n}x with only "
+                    f"{self._refcnt[b]} reference(s) held")
+        for b in blocks:
+            self._refcnt[b] -= 1
+            if self._refcnt[b] == 0:
+                self._used -= 1
+                self._free_by_shard[self.shard_of(b)].append(b)
 
     def check_leaks(self) -> None:
-        """Invariant: every block is either free or accounted used."""
+        """Invariants: the free list and the live refcounts partition
+        the pool exactly — every block is either free (refcount 0) or
+        live (refcount >= 1) and counted once in used_count; no block
+        appears twice in a free list; shard filing is consistent."""
         free = [b for f in self._free_by_shard for b in f]
         if len(free) + self._used != self.num_blocks:
             raise AssertionError(
@@ -95,6 +148,20 @@ class BlockPool:
                 f"!= {self.num_blocks}")
         if len(set(free)) != len(free):
             raise AssertionError("duplicate block in free list")
+        free_set = set(free)
+        for b in range(self.num_blocks):
+            rc = self._refcnt[b]
+            if rc < 0:
+                raise AssertionError(f"block {b} refcount {rc} < 0")
+            if rc == 0 and b not in free_set:
+                raise AssertionError(
+                    f"block {b} has refcount 0 but is not on the free "
+                    f"list (leaked)")
+            if rc > 0 and b in free_set:
+                raise AssertionError(
+                    f"block {b} is free AND holds {rc} reference(s) — "
+                    f"a sequence or the prefix cache would read blocks "
+                    f"the allocator can hand out again")
         for s, f in enumerate(self._free_by_shard):
             for b in f:
                 if self.shard_of(b) != s:

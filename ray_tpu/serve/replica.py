@@ -117,6 +117,20 @@ class Replica:
                 info["queue_depth"] = int(qfn())
             except Exception:
                 pass
+        # prefix-cache health (the LLM engine's cache_stats()): the
+        # controller records cache_hit_rate / prefix_blocks_resident per
+        # replica so the balancer can prefer cache-warm replicas and
+        # scale-down can pick cache-cold victims (controller.py,
+        # handle.py _warmth_map)
+        cfn = getattr(self._callable, "cache_stats", None)
+        if callable(cfn):
+            try:
+                cs = cfn()
+                info["cache_hit_rate"] = float(cs.get("cache_hit_rate", 0.0))
+                info["prefix_blocks_resident"] = int(
+                    cs.get("prefix_blocks_resident", 0))
+            except Exception:
+                pass
         return info
 
     def queue_len(self) -> int:

@@ -1,0 +1,37 @@
+"""Fixture: two refcount-leak-shaped BUGS in the prefix-cache idiom —
+the mistakes a reviewer most expects in alloc/release code, each
+caught by an existing local rule:
+
+- ``leaky_admit`` takes the scheduler lock with a statement-position
+  ``acquire()`` and EARLY-RETURNS while holding it when the pool is
+  exhausted (GC006): the next admitter wedges forever — exactly the
+  failure shape of an alloc path without its paired release.
+- ``leaky_retire`` wraps the release in a bare ``except:`` that
+  swallows and returns (GC005): a framework error mid-release silently
+  leaks every reference the sequence held, and check_leaks fires hours
+  later with no culprit.
+
+The clean manager in radix.py is the negative control; the engine
+tests pin that EXACTLY these two findings fire for this package.
+"""
+import threading
+
+_lock = threading.Lock()
+
+
+def leaky_admit(pool, tokens):
+    _lock.acquire()
+    blocks = pool.alloc(len(tokens) // 4)
+    if blocks is None:
+        return None          # early return: the lock never releases
+    pool.retain(blocks)
+    _lock.release()
+    return blocks
+
+
+def leaky_retire(pool, blocks):
+    try:
+        pool.release(blocks)
+    except:  # noqa: E722 — the seeded GC005 positive
+        return None          # swallowed: the refcounts silently leak
+    return len(blocks)

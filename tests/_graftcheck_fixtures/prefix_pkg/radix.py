@@ -1,0 +1,62 @@
+"""Fixture: a radix prefix-cache manager in the shipped idiom
+(ray_tpu/serve/llm/prefix_cache.py + engine.py): block alloc/retain/
+release under one scheduler lock, insert-then-release at retire, LRU
+eviction under pressure. This file is the NEGATIVE control — it must
+stay clean under GC001–GC012 exactly as the shipped subsystem does
+(the leak-shaped positives live in leaky.py)."""
+import threading
+
+
+class MiniPool:
+    def __init__(self, n):
+        self._free = list(range(n))
+        self._refcnt = [0] * n
+
+    def alloc(self, k):
+        if k > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(k)]
+        for b in out:
+            self._refcnt[b] = 1
+        return out
+
+    def retain(self, blocks):
+        for b in blocks:
+            self._refcnt[b] += 1
+
+    def release(self, blocks):
+        for b in blocks:
+            self._refcnt[b] -= 1
+            if self._refcnt[b] == 0:
+                self._free.append(b)
+
+
+class RadixManager:
+    """The clean shape: every alloc path pairs with a release on EVERY
+    exit, the scheduler lock is only ever held via ``with``."""
+
+    def __init__(self, pool):
+        self.pool = pool
+        self._lock = threading.RLock()
+        self._nodes = {}
+
+    def admit(self, tokens):
+        with self._lock:
+            blocks = self.pool.alloc(len(tokens) // 4)
+            if blocks is None:
+                return None
+            try:
+                self._nodes[tuple(tokens)] = blocks
+                self.pool.retain(blocks)
+            except Exception:
+                self.pool.release(blocks)
+                raise
+            return blocks
+
+    def retire(self, tokens):
+        with self._lock:
+            blocks = self._nodes.get(tuple(tokens))
+            if blocks is None:
+                return 0
+            self.pool.release(blocks)
+            return len(blocks)

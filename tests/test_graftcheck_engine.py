@@ -844,3 +844,48 @@ def test_library_tree_is_gc008_clean_under_call_graph(tree_result):
     """Call-graph-resolved GC008 finds no un-annotated dynamic work in
     compiled-graph-bound methods tree-wide."""
     assert _tree_findings(tree_result, {"GC008"}) == []
+
+
+# ---------------------------------------------------------------------------
+# prefix-cache fixture package (ISSUE 14)
+
+
+class TestPrefixPkg:
+    LOCAL = {"GC001", "GC002", "GC003", "GC004", "GC005", "GC006",
+             "GC007", "GC008", "GC009", "GC012"}
+
+    def test_refcount_leak_shaped_positives(self):
+        """The two leak-shaped bugs in leaky.py fire — an alloc path
+        that early-returns holding the scheduler lock (GC006) and a
+        release swallowed by a bare except (GC005) — while the clean
+        radix manager next to them stays silent under the full
+        GC001–GC012 local family."""
+        res = run_pkg("prefix_pkg", rules=self.LOCAL)
+        assert rules_of(res) == ["GC005", "GC006"], res.findings
+        assert all(f.path.endswith("leaky.py") for f in res.findings), \
+            res.findings
+        gc006 = [f for f in res.findings if f.rule == "GC006"]
+        assert len(gc006) == 1 and "leak" in gc006[0].message
+        gc005 = [f for f in res.findings if f.rule == "GC005"]
+        assert len(gc005) == 1
+
+    def test_clean_manager_is_clean(self):
+        """radix.py alone — the shipped-idiom shape (with-locks, paired
+        retain/release, guard-with-reraise) — produces zero findings."""
+        res = check_project(
+            [os.path.join(FIXTURES, "prefix_pkg", "radix.py")],
+            rules=self.LOCAL, cache_path=None, root=FIXTURES)
+        assert [f.render() for f in res.findings] == []
+
+    def test_shipped_llm_serve_tree_is_clean(self):
+        """The shipped prefix-cache subsystem (serve/llm/ + the radix
+        tree + the session-aware routing files) sweeps clean under
+        every local rule AND the whole-program families — a local
+        regression names the right culprit without waiting for the
+        tree-wide sweep."""
+        res = check_project(
+            [os.path.join(REPO, "ray_tpu", "serve")],
+            rules=self.LOCAL | {"GC010", "GC011"},
+            cache_path=None, root=os.path.join(REPO, "ray_tpu"))
+        assert res.errors == 0
+        assert [f.render() for f in res.findings] == []

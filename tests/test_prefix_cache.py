@@ -1,0 +1,502 @@
+"""Radix prefix cache + session-aware routing (ISSUE 14,
+docs/LLM_SERVE.md "Prefix caching & sessions").
+
+Covers the refcounted BlockPool (shared blocks counted once, retain/
+release discipline, shared-block leak invariant), the radix tree
+(insert/match/evict/COW, block-aligned splits, LRU under pressure),
+engine-level token identity cached-vs-cold for gpt AND GQA llama at
+tp in {1, 2}, preemption with a shared prefix, the occupancy gauge
+under sharing, 8-way concurrent hit/miss streaming, and session
+affinity surviving a replica drain on a live cluster.
+
+Pure-accounting tests never touch jax; engine tests share per-module
+model fixtures so the suite pays for compilation once per model.
+"""
+import threading
+
+import pytest
+
+from ray_tpu.serve.llm import (BlockPool, EngineConfig, LLMEngine,
+                               PrefixCache, build_model)
+
+BS = 4  # block size used throughout the accounting tests
+
+
+# ---------------------------------------------------------------------------
+# refcounted block pool — no jax
+
+
+class TestRefcountedPool:
+    def test_retain_release_roundtrip(self):
+        pool = BlockPool(8)
+        a = pool.alloc(3)
+        pool.retain(a)                       # second holder
+        assert pool.used_count == 3          # shared counted ONCE
+        pool.free(a)                         # first holder releases
+        assert pool.used_count == 3          # still live via second
+        pool.check_leaks()
+        pool.free(a)
+        assert pool.used_count == 0 and pool.free_count == 8
+        pool.check_leaks()
+
+    def test_refcount_introspection(self):
+        pool = BlockPool(4)
+        (b,) = pool.alloc(1)
+        assert pool.refcount(b) == 1
+        pool.retain([b])
+        assert pool.refcount(b) == 2
+        pool.free([b])
+        pool.free([b])
+        assert pool.refcount(b) == 0
+        with pytest.raises(ValueError, match="unknown"):
+            pool.refcount(99)
+
+    def test_retain_free_block_rejected(self):
+        pool = BlockPool(4)
+        a = pool.alloc(1)
+        pool.free(a)
+        with pytest.raises(ValueError, match="free block"):
+            pool.retain(a)
+
+    def test_over_release_rejected_atomically(self):
+        pool = BlockPool(8)
+        a = pool.alloc(2)
+        with pytest.raises(ValueError, match="double free"):
+            pool.free(a + a)                 # 2 releases of 1 reference
+        # the failed call must not have released the valid half
+        assert pool.used_count == 2
+        pool.free(a)
+        pool.check_leaks()
+
+    def test_used_never_exceeds_capacity_under_sharing(self):
+        """The kv_blocks_used surface: N holders of one block still
+        count it once — occupancy can't exceed pool capacity."""
+        pool = BlockPool(4)
+        a = pool.alloc(4)
+        for _ in range(5):
+            pool.retain(a)
+        assert pool.used_count == 4 == pool.num_blocks
+        for _ in range(6):
+            pool.free(a)
+        assert pool.used_count == 0
+        pool.check_leaks()
+
+    def test_shared_block_leak_invariant(self):
+        pool = BlockPool(4)
+        a = pool.alloc(2)
+        # corrupt: a LIVE block replaces a free one on the free list —
+        # the allocator could now hand out a block a sequence still
+        # reads (counts stay balanced; only the shared-block invariant
+        # can catch this)
+        pool._free_by_shard[0][-1] = a[0]
+        with pytest.raises(AssertionError, match="free AND holds"):
+            pool.check_leaks()
+
+    def test_sharded_pool_counts_shared_once_per_chip(self):
+        pool = BlockPool(8, shards=2)
+        a = pool.alloc(4)                    # balanced 2+2
+        pool.retain(a)
+        per = pool.used_per_shard()
+        assert per == [2, 2] and sum(per) == pool.used_count
+        pool.free(a)
+        assert pool.used_per_shard() == [2, 2]   # second holder remains
+        pool.free(a)
+        assert pool.used_per_shard() == [0, 0]
+        pool.check_leaks()
+
+
+# ---------------------------------------------------------------------------
+# radix tree — no jax
+
+
+def _mk(n_blocks=64):
+    pool = BlockPool(n_blocks)
+    return pool, PrefixCache(pool, BS)
+
+
+class TestRadixTree:
+    def test_insert_match_roundtrip(self):
+        pool, pc = _mk()
+        toks = list(range(100, 100 + 3 * BS))
+        blocks = pool.alloc(3)
+        assert pc.insert(toks, blocks) == 3
+        m = pc.match(toks)
+        assert (m.num_tokens, m.blocks, m.partial_len) == (12, blocks, 0)
+        pc.check_invariants()
+
+    def test_partial_tail_not_cached(self):
+        pool, pc = _mk()
+        toks = list(range(10))               # 2 full blocks + 2 tokens
+        blocks = pool.alloc(3)
+        assert pc.insert(toks, blocks) == 2  # only full blocks indexed
+        m = pc.match(toks)
+        assert m.num_tokens == 8 and m.blocks == blocks[:2]
+        pc.check_invariants()
+
+    def test_mid_block_divergence_reports_cow_candidate(self):
+        pool, pc = _mk()
+        toks = list(range(100, 112))
+        blocks = pool.alloc(3)
+        pc.insert(toks, blocks)
+        m = pc.match(toks[:9] + [7, 7])      # diverges 1 token into b2
+        assert m.num_tokens == 8 and m.blocks == blocks[:2]
+        assert m.partial_block == blocks[2] and m.partial_len == 1
+
+    def test_block_aligned_split_and_sibling(self):
+        pool, pc = _mk()
+        a_toks = list(range(100, 112))
+        a = pool.alloc(3)
+        pc.insert(a_toks, a)
+        # shares exactly 1 block, then diverges at the boundary
+        b_toks = a_toks[:BS] + [7] * (2 * BS)
+        b = pool.alloc(3)
+        pc.insert(b_toks, b)
+        pc.check_invariants()
+        ma, mb = pc.match(a_toks), pc.match(b_toks)
+        assert ma.blocks == a
+        assert mb.blocks == a[:1] + b[1:]    # shared head, own tail
+        # the duplicate head block b[0] was NOT indexed
+        assert pc.resident_blocks == 5
+
+    def test_insert_idempotent_no_double_retain(self):
+        pool, pc = _mk()
+        toks = list(range(8))
+        blocks = pool.alloc(2)
+        assert pc.insert(toks, blocks) == 2
+        assert pc.insert(toks, blocks) == 0  # re-insert indexes nothing
+        assert pool.refcount(blocks[0]) == 2  # alloc + ONE cache ref
+        pool.free(blocks)
+        assert pc.evict(10) == 2
+        pool.check_leaks()
+
+    def test_lru_eviction_order_and_refcount_guard(self):
+        pool, pc = _mk(8)
+        old = pool.alloc(2)
+        pc.insert(list(range(0, 8)), old)
+        pool.free(old)                       # cache-only now
+        busy = pool.alloc(2)
+        pc.insert(list(range(50, 58)), busy)  # busy: alloc ref still held
+        fresh = pool.alloc(2)
+        pc.insert(list(range(80, 88)), fresh)
+        pool.free(fresh)
+        pc.match(list(range(0, 8)))          # touch old -> fresh is LRU
+        assert pc.evict(2) == 2
+        assert pc.match(list(range(80, 88))).num_tokens == 0  # fresh gone
+        assert pc.match(list(range(0, 8))).num_tokens == 8    # old kept
+        # busy blocks are never reclaimed while a sequence holds them
+        assert pc.evict(10) == 2             # evicts 'old' only
+        assert pc.match(list(range(50, 58))).num_tokens == 8
+        pool.free(busy)
+        assert pc.evict(10) == 2
+        assert pc.resident_blocks == 0 and pool.used_count == 0
+        pool.check_leaks()
+
+    def test_interior_nodes_evicted_after_children(self):
+        pool, pc = _mk()
+        head = list(range(100, 108))
+        a = pool.alloc(4)
+        pc.insert(head + [1] * 8, a)
+        b = pool.alloc(4)
+        pc.insert(head + [2] * 8, b)         # splits: head is interior
+        pool.free(a)
+        pool.free(b)
+        # two leaf tails (2 blocks each) + the shared interior head (2):
+        # leaves go first, the head becomes a leaf and follows
+        assert pc.evict(100) == 6
+        assert pc.resident_blocks == 0 and pc.num_nodes == 0
+        assert pool.used_count == 0
+        pc.check_invariants()
+        pool.check_leaks()
+
+    def test_clear_releases_everything(self):
+        pool, pc = _mk()
+        a = pool.alloc(4)
+        pc.insert(list(range(16)), a)
+        pool.free(a)
+        assert pc.clear() == 4
+        assert pc.resident_blocks == 0 and pc.num_nodes == 0
+        assert pool.used_count == 0
+        pool.check_leaks()
+
+    def test_clear_survives_deep_chain(self):
+        """A long-context session builds a one-node-per-block chain;
+        clear() (the pool-rescue/drain hook) must walk it iteratively —
+        a recursive walk would blow Python's frame limit inside the
+        engine scheduler and fail every stream on the replica."""
+        pool = BlockPool(1600)
+        pc = PrefixCache(pool, 1)       # block_size 1: deepest shape
+        toks, blocks = [], []
+        for i in range(1500):
+            toks.append(i % 7)
+            blocks.extend(pool.alloc(1))
+            pc.insert(toks, blocks)
+        pool.free(blocks)
+        assert pc.clear() == 1500
+        assert pc.resident_blocks == 0 and pool.used_count == 0
+        pool.check_leaks()
+        pc.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# engine integration — shared model fixtures
+
+
+@pytest.fixture(scope="module")
+def gpt_tiny():
+    return build_model("gpt-tiny")
+
+
+@pytest.fixture(scope="module")
+def llama_tiny():
+    return build_model("llama-tiny")
+
+
+def mk_engine(model, **over) -> LLMEngine:
+    m, params = model
+    kw = dict(block_size=4, num_blocks=32, max_batch=4,
+              max_blocks_per_seq=8, prefill_buckets=(8, 16),
+              max_prefill_tokens_per_step=32)
+    kw.update(over)
+    return LLMEngine(m, params, EngineConfig(**kw))
+
+
+def run_one(eng, prompt, n=8):
+    st = eng.add_request(prompt, max_tokens=n)
+    eng.run_until_idle(timeout=300)
+    return st.tokens()
+
+
+COMMON = [1, 5, 9, 2, 6, 4, 3, 7]            # 2 full blocks at BS=4
+
+
+@pytest.mark.parametrize("model_name,tp", [
+    ("gpt-tiny", 1), ("llama-tiny", 1), ("gpt-tiny", 2), ("llama-tiny", 2),
+])
+def test_cached_prefill_token_identity(model_name, tp, gpt_tiny,
+                                       llama_tiny):
+    """Acceptance: outputs token-identical with caching on/off, for GPT
+    and GQA llama, at tp=1 and tp=2 (conftest forces 8 host devices).
+    Covers full-block reuse, block-boundary divergence, AND the
+    mid-block COW path."""
+    model = gpt_tiny if model_name == "gpt-tiny" else llama_tiny
+    prompts = [COMMON + [11, 13],            # cold
+               COMMON + [12, 14],            # full-block + boundary hit
+               COMMON[:5] + [99, 98],        # mid-block divergence (COW)
+               COMMON + [11, 13]]            # deep replay incl. own tail
+    cold = mk_engine(model)
+    want = [run_one(cold, p) for p in prompts]
+
+    warm = mk_engine(model, prefix_cache=True, tp=tp)
+    got = [run_one(warm, p) for p in prompts]
+    assert got == want
+    cs = warm.cache_stats()
+    assert cs["prefix_hit_tokens"] > 0, cs
+    assert 0.0 < cs["cache_hit_rate"] < 1.0, cs
+    # every non-cache-resident block returned; sharing never overcounts
+    assert warm.pool.used_count == warm.prefix_cache.resident_blocks
+    assert warm.pool.used_count <= warm.pool.num_blocks
+    warm.pool.check_leaks()
+    warm.prefix_cache.check_invariants()
+
+
+def test_preemption_with_shared_prefix_equivalence(gpt_tiny):
+    """Two sequences sharing a prefix under a pool too small for both:
+    the victim preempts, requeues, and re-prefills THROUGH its own
+    still-cached prefix — tokens identical to the unconstrained run,
+    and the preempted sequence released only its private tail (the
+    shared blocks stayed resident)."""
+    pa = COMMON + [11]
+    pb = COMMON + [12]
+    want = {tuple(p): run_one(mk_engine(gpt_tiny, prefill_buckets=(8, 32)),
+                              p, 12)
+            for p in (pa, pb)}
+    # 7 blocks x 4 tokens: each sequence holds 3 blocks at admit (2
+    # shared) and grows to 5 (ctx 21) — 8 unique blocks needed, so one
+    # preempts; its requeued context (~20 tokens) re-prefills through
+    # the 32 bucket, mostly over its own still-cached chain
+    eng = mk_engine(gpt_tiny, prefix_cache=True, num_blocks=7,
+                    prefill_buckets=(8, 32))
+    sa = eng.add_request(pa, max_tokens=12)
+    sb = eng.add_request(pb, max_tokens=12)
+    eng.run_until_idle(timeout=300)
+    assert eng._total_preemptions >= 1, "scenario must actually preempt"
+    assert sa.tokens() == want[tuple(pa)]
+    assert sb.tokens() == want[tuple(pb)]
+    assert eng.pool.used_count == eng.prefix_cache.resident_blocks
+    eng.pool.check_leaks()
+    eng.prefix_cache.check_invariants()
+
+
+def test_eviction_under_pool_pressure(gpt_tiny):
+    """A full cache gives its blocks back: requests with disjoint
+    prefixes cycle through a pool smaller than their combined
+    footprint — later admissions LRU-evict earlier residents instead
+    of failing or preempting live work."""
+    eng = mk_engine(gpt_tiny, prefix_cache=True, num_blocks=8)
+    outs = []
+    for i in range(4):                       # each needs 3 blocks
+        outs.append(run_one(eng, [10 * i + 1, 10 * i + 2, 3, 4, 5, 6], 4))
+    assert eng.prefix_cache.evictions > 0, "pressure never evicted"
+    cold = mk_engine(gpt_tiny)
+    for i, got in enumerate(outs):
+        assert got == run_one(cold, [10 * i + 1, 10 * i + 2, 3, 4, 5, 6], 4)
+    eng.pool.check_leaks()
+    eng.prefix_cache.check_invariants()
+
+
+def test_add_prefilled_evicts_cached_blocks(gpt_tiny):
+    """The disagg intake path (DecodeStage.add_prefilled) must evict
+    rc-1 cache residency like every other alloc site — a prefix-cached
+    decode stage would otherwise wedge (TimeoutError) the moment
+    retired sequences drain the free list into the cache."""
+    import numpy as np
+
+    m, _params = gpt_tiny
+    # 8-token prompt + 5 emits = 12 KV-resident tokens = 3 full blocks
+    # cached per retire; two disjoint runs drain all 6 blocks into the
+    # cache
+    eng = mk_engine(gpt_tiny, prefix_cache=True, num_blocks=6)
+    run_one(eng, [1, 2, 3, 4, 5, 6, 7, 8], 5)
+    run_one(eng, [11, 12, 13, 14, 15, 16, 17, 18], 5)
+    assert eng.pool.free_count == 0          # fully cache-resident
+    c = m.config
+    kv = {k: np.zeros((c.n_layer, 1, 4, c.n_head, c.head_dim),
+                      np.float32) for k in ("k", "v")}
+    st = eng.add_prefilled([1, 2, 3], kv, first_token=5, max_tokens=2,
+                           timeout=10)
+    eng.run_until_idle(timeout=120)
+    assert len(st.tokens()) == 2
+    assert eng.prefix_cache.evictions > 0
+    eng.pool.check_leaks()
+    eng.prefix_cache.check_invariants()
+
+
+def test_kv_occupancy_gauge_counts_shared_once(gpt_tiny):
+    """Satellite: ray_tpu_llm_kv_blocks_used must not inflate above
+    pool capacity under refcounted sharing — the gauge tracks unique
+    live blocks even while cache + sequences share them."""
+    from ray_tpu.serve.llm.engine import _G_BLOCKS
+
+    eng = mk_engine(gpt_tiny, prefix_cache=True)
+
+    def gauge():
+        return _G_BLOCKS._values.get(_G_BLOCKS._key({"engine": eng.name}))
+
+    run_one(eng, COMMON + [11], 4)
+    st = eng.add_request(COMMON + [12], max_tokens=4)
+    eng.step()                               # admitted: shares 2 blocks
+    assert gauge() == eng.pool.used_count <= eng.pool.num_blocks
+    eng.run_until_idle(timeout=300)
+    st.tokens()
+    assert gauge() == eng.pool.used_count \
+        == eng.prefix_cache.resident_blocks
+    eng.pool.check_leaks()
+
+
+def test_concurrent_hit_miss_streams_no_leakage(gpt_tiny):
+    """8 client threads — half sharing one prefix, half unique — stream
+    concurrently against one cached engine; every client sees exactly
+    its reference completion (zero cross-request leakage), and the pool
+    drains to cache-resident-only."""
+    prompts = [(COMMON + [20 + i]) if i % 2 == 0
+               else [30 + i, 40 + i, 7, 8, 9]
+               for i in range(8)]
+    cold = mk_engine(gpt_tiny, max_batch=8, num_blocks=64)
+    want = [run_one(cold, p, 10) for p in prompts]
+
+    eng = mk_engine(gpt_tiny, prefix_cache=True, max_batch=4,
+                    num_blocks=64)           # max_batch 4 forces queuing
+    eng.start()
+    try:
+        got = [None] * len(prompts)
+
+        def client(i):
+            st = eng.add_request(prompts[i], max_tokens=10)
+            got[i] = [tok for tok in st]
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert got == want
+    finally:
+        eng.stop()
+    assert eng.cache_stats()["prefix_hit_tokens"] > 0
+    assert eng.pool.used_count == eng.prefix_cache.resident_blocks
+    eng.pool.check_leaks()
+    eng.prefix_cache.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# session-aware routing — live cluster
+
+
+def test_session_affinity_across_replica_drain():
+    """Satellite: a session pins to one replica across turns; draining
+    that replica (PR 11) invalidates the pin cleanly — the next turn
+    re-routes to a survivor (counted in
+    ray_tpu_serve_session_reroutes_total) and stays token-identical."""
+    import time
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.handle import _C_SESSION_REROUTES
+    from ray_tpu.serve.llm import LLMServer
+
+    cfg = dict(block_size=4, num_blocks=64, max_batch=4,
+               max_blocks_per_seq=8, prefill_buckets=(8, 16),
+               prefix_cache=True)
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    try:
+        app = serve.deployment(
+            num_replicas=2, health_check_period_s=0.5,
+            health_check_timeout_s=120)(LLMServer).bind(
+            model="gpt-tiny", engine_config=cfg)
+        handle = serve.run(app, timeout=300)
+        payload = {"tokens": [1, 5, 9, 2], "max_tokens": 4}
+        outs = [ray_tpu.get(
+            handle.options(session_id="conv-1").remote(payload),
+            timeout=120) for _ in range(3)]
+        pins = handle.session_assignments()
+        assert "conv-1" in pins, pins
+        pin0 = pins["conv-1"]
+        assert len({o["tokens"][0] for o in outs}) == 1
+
+        controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+        n = ray_tpu.get(
+            controller.drain_replicas.remote([pin0.hex()], 60.0),
+            timeout=30)
+        assert n == 1, f"drain marked {n} replicas"
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            # wait until the controller excludes the draining replica
+            _v, _q, reps = ray_tpu.get(
+                controller.get_replicas.remote("LLMServer"), timeout=30)
+            if all(r._actor_id != pin0 for r in reps) and reps:
+                break
+            time.sleep(0.2)
+        # ... and until the handle's 2s replica-cache TTL expires, so
+        # its next pick actually sees the exclusion (the documented
+        # drain semantics: routing stops at the router's next refresh)
+        time.sleep(2.1)
+        before = _C_SESSION_REROUTES.total()
+        out = ray_tpu.get(
+            handle.options(session_id="conv-1").remote(payload),
+            timeout=120)
+        pin1 = handle.session_assignments()["conv-1"]
+        assert pin1 != pin0, "session must leave the draining replica"
+        assert _C_SESSION_REROUTES.total() == before + 1
+        assert out["tokens"] == outs[0]["tokens"], \
+            "reroute changed the stream"
+        # warmth introspection surface: routable replicas only (the
+        # drained pin is gone), keyed by actor hex, resident-block
+        # valued — what operators read alongside the scrape
+        warmth = ray_tpu.get(
+            controller.replica_warmth.remote("LLMServer"), timeout=30)
+        assert pin0.hex() not in warmth and len(warmth) >= 1, warmth
+        assert all(isinstance(v, float) for v in warmth.values())
+        serve.shutdown()
+    finally:
+        ray_tpu.shutdown()
