@@ -2888,41 +2888,62 @@ class _WorkerDirectState:
         with self._lock:
             old = self._actors.get(actor_id)
             if res is None or not res.get("addr"):
-                self._actors[actor_id] = {
-                    "ok": False, "bad_until": time.monotonic() + 0.5,
-                    "seq": (old or {}).get("seq", 0),
-                    "lane": (old or {}).get("lane", 0),
-                    "chan": (old or {}).get("chan"),
-                    "epoch": (old or {}).get("epoch", -1)}
+                # mutate the EXISTING entry in place (never replace it:
+                # an in-flight try_submit may hold the dict — a fresh
+                # copy forks the seq counter, and in-place mutation is
+                # also what makes its ok-recheck see this failure)
+                if old is None:
+                    old = {"seq": 0, "lane": 0, "chan": None,
+                           "epoch": -1}
+                    self._actors[actor_id] = old
+                old["ok"] = False
+                old["bad_until"] = time.monotonic() + 0.5
                 return None
         chan = self._peer(res["addr"])
         if chan is None:
             with self._lock:
-                old = self._actors.get(actor_id) or {}
+                old = self._actors.get(actor_id)
+                if old is None:
+                    old = {"seq": 0, "lane": 0}
+                    self._actors[actor_id] = old
                 fails = old.get("fails", 0)
-                self._actors[actor_id] = {
-                    "ok": False,
-                    "bad_until": time.monotonic()
-                    + _DIRECT_RECONNECT.backoff(fails),
-                    "fails": fails + 1,
-                    "seq": 0, "lane": old.get("lane", 0),
-                    "epoch": res["epoch"]}
+                old["ok"] = False
+                old["bad_until"] = time.monotonic() \
+                    + _DIRECT_RECONNECT.backoff(fails)
+                old["fails"] = fails + 1
+                old["epoch"] = res["epoch"]
+                # the old socket is gone: dropping the chan forces the
+                # recovery path into a new lane era (seq restarts there)
+                old.pop("chan", None)
             return None
         with self._lock:
             old = self._actors.get(actor_id) or {}
             # same epoch over the SAME live connection: the worker's lane
             # for this caller survives — seq continues (a restart would
-            # collide with frames already buffered there). A new channel
-            # is a new era: frames lost in the old socket would strand
-            # the receiver's expected counter, so bump the lane and
-            # restart seq (the receiver resets on a higher era).
-            same = (old.get("epoch") == res["epoch"]
-                    and old.get("chan") is chan)
+            # collide with frames already buffered there), so the entry
+            # is refreshed IN PLACE. Replacing the dict forked the seq
+            # counter: a racing try_submit (first-call burst, or a
+            # stale_gate refresh racing an in-flight call) still held
+            # the old dict, two frames went out with the same lane+seq,
+            # the receiver dropped one as a duplicate and that caller
+            # hung to its get() timeout (found by scripts/locks_gate.py:
+            # instrumented-lock overhead widens the window to every run).
+            if old.get("epoch") == res["epoch"] and old.get("chan") is chan:
+                old.update({"ok": True, "addr": res["addr"],
+                            "gate": res["gate"], "actor_id": actor_id,
+                            "chan": chan, "epoch": res["epoch"]})
+                old.pop("stale_gate", None)
+                old.setdefault("lane", 0)
+                old.setdefault("seq", 0)
+                self._actors[actor_id] = old
+                return old
+            # a new channel is a new era: frames lost in the old socket
+            # would strand the receiver's expected counter, so bump the
+            # lane and restart seq (the receiver resets on a higher era)
             entry = {"ok": True, "addr": res["addr"], "chan": chan,
                      "epoch": res["epoch"], "gate": res["gate"],
                      "actor_id": actor_id,
-                     "lane": old.get("lane", 0) + (0 if same else 1),
-                     "seq": old.get("seq", 0) if same else 0}
+                     "lane": old.get("lane", 0) + 1, "seq": 0}
             self._actors[actor_id] = entry
             return entry
 

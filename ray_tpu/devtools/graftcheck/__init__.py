@@ -20,6 +20,18 @@ Two layers (docs/GRAFTCHECK.md has the full rule tables):
   series (unbound collective axes, in_specs arity, donated-buffer
   reuse) — see :mod:`.rules_project` / :mod:`.rules_spmd`.
 
+- **Path-sensitive dataflow layer** (v3): :mod:`.cfg` builds
+  per-function control-flow graphs (exception edges, ``finally``
+  duplication, ``with`` as acquire + guaranteed release, branch
+  assumes), :mod:`.dataflow` runs a generic forward
+  abstract-interpretation fixpoint over them, and
+  :mod:`.rules_lifecycle` polices the framework's paired-lifecycle
+  invariants with GC030-GC033 (leaks, double-release, except-swallowed
+  release, conditional-acquire/unconditional-release) — including
+  interprocedural ownership summaries resolved through the import
+  graph. The pass runs at parse time; its findings and pending facts
+  ride the file cache.
+
 ``check_source`` / ``check_file`` compose both layers for a single
 blob (the whole-program passes then see exactly one module);
 ``check_project`` runs the full engine; ``main`` is the CLI
@@ -37,7 +49,7 @@ from .local import (LOCAL_RULES, RULES, Finding, _FileChecker,
 from .engine import (ProjectIndex, ProjectResult, build_call_graph,
                      check_project, to_dot)
 from .summary import extract
-from . import rules_project, rules_spmd
+from . import rules_lifecycle, rules_project, rules_spmd
 from .cli import main
 
 __all__ = [
@@ -60,11 +72,14 @@ def check_source(source: str, path: str = "<string>",
     module = os.path.splitext(os.path.basename(path))[0] or "<string>"
     summary, extra = extract(path, source, tree, module)
     findings.extend(f for f in extra if f.rule in enabled)
+    findings.extend(f for f in rules_lifecycle.analyze_module(tree, summary)
+                    if f.rule in enabled)
     index = ProjectIndex([summary])
     graph = build_call_graph(index)
     # GC008 already ran module-locally above; don't double-report
     findings.extend(rules_project.run(index, graph, enabled - {"GC008"}))
     findings.extend(rules_spmd.run(index, enabled))
+    findings.extend(rules_lifecycle.resolve_pending(index, enabled))
     findings.sort(key=lambda f: (f.line, f.col, f.rule))
     return findings
 

@@ -4,16 +4,26 @@ findings without blessing new ones.
 ``--write-baseline FILE`` records every current finding as a
 fingerprint; ``--baseline FILE`` then filters findings whose
 fingerprint is known. Fingerprints hash (rule, path, stripped source
-line text) — NOT the line number — so unrelated edits above a finding
-don't resurrect it; moving or editing the flagged line itself does,
-which is the desired behavior (the code changed, re-review it).
+line text, same-text occurrence index) — NOT the line number — so
+unrelated edits above a finding don't resurrect it; moving or editing
+the flagged line itself does, which is the desired behavior (the code
+changed, re-review it).
+
+The rule id in the key means a GC030 and a GC032 anchored on the same
+line never mask each other when only one is baselined. The occurrence
+index (position among findings sharing the same rule+path+text,
+ordered by line) means two findings on *identical duplicated lines*
+(two ``pool.free(b)`` lines, say) get distinct fingerprints too —
+baselining one no longer hides the other. Index 0 is omitted from the
+key, so single-occurrence fingerprints (the overwhelmingly common
+case) are stable across this change.
 """
 from __future__ import annotations
 
 import hashlib
 import json
 import os
-from typing import Dict, List, Optional, Sequence, Set
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from .local import Finding
 
@@ -30,18 +40,48 @@ def _line_text(path: str, line: int,
     return lines[line - 1].strip() if 0 < line <= len(lines) else ""
 
 
-def fingerprint(f: Finding, cache: Dict[str, List[str]]) -> str:
-    text = _line_text(f.path, f.line, cache)
-    key = f"{f.rule}\x00{os.path.normpath(f.path)}\x00{text}"
+def _base_key(f: Finding, cache: Dict[str, List[str]]
+              ) -> Tuple[str, str, str]:
+    return (f.rule, os.path.normpath(f.path),
+            _line_text(f.path, f.line, cache))
+
+
+def _hash(base: Tuple[str, str, str], occurrence: int) -> str:
+    key = "\x00".join(base)
+    if occurrence:
+        key += f"\x00{occurrence}"
     return hashlib.sha256(key.encode("utf-8")).hexdigest()[:16]
+
+
+def fingerprint(f: Finding, cache: Dict[str, List[str]],
+                occurrence: int = 0) -> str:
+    return _hash(_base_key(f, cache), occurrence)
+
+
+def _fingerprints(findings: Sequence[Finding],
+                  cache: Dict[str, List[str]]) -> List[str]:
+    """One fingerprint per finding, disambiguating same-text repeats by
+    their order of appearance (sorted by line, then column)."""
+    order = sorted(range(len(findings)),
+                   key=lambda i: (findings[i].path, findings[i].line,
+                                  findings[i].col, findings[i].rule))
+    seen: Dict[Tuple[str, str, str], int] = {}
+    out: List[str] = [""] * len(findings)
+    for i in order:
+        base = _base_key(findings[i], cache)
+        occ = seen.get(base, 0)
+        seen[base] = occ + 1
+        out[i] = _hash(base, occ)
+    return out
 
 
 def write(path: str, findings: Sequence[Finding]) -> None:
     cache: Dict[str, List[str]] = {}
+    fps = _fingerprints(findings, cache)
     entries = [{"rule": f.rule, "path": f.path, "line": f.line,
-                "fingerprint": fingerprint(f, cache)} for f in findings]
+                "fingerprint": fp} for f, fp in zip(findings, fps)]
     with open(path, "w", encoding="utf-8") as fh:
-        json.dump({"version": 1, "findings": entries}, fh, indent=2)
+        json.dump({"version": 2, "findings": entries}, fh, indent=2)
         fh.write("\n")
 
 
@@ -51,10 +91,60 @@ def load(path: str) -> Set[str]:
     return {e["fingerprint"] for e in data.get("findings", ())}
 
 
+def _load_entries(path: str) -> List[dict]:
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return list(data.get("findings", ()))
+
+
 def filter_findings(findings: Sequence[Finding],
                     baseline_path: Optional[str]) -> List[Finding]:
+    """Suppress baselined findings. Matching is COUNT-based per
+    (rule, path, line text) group: N baseline entries for a group
+    suppress N of its current findings. Which N: findings sitting on a
+    line the baseline recorded are suppressed first — so a NEW
+    identical-text finding appearing ABOVE a baselined one is the one
+    reported, not the one silently absorbed into the old entry's
+    occurrence-0 fingerprint. Unmatched-line entries (the flagged code
+    moved) fall back to line order."""
     if not baseline_path:
         return list(findings)
-    known = load(baseline_path)
+    entries = _load_entries(baseline_path)
+    known: Set[str] = {e["fingerprint"] for e in entries}
+    lines_of: Dict[str, Set[int]] = {}
+    for e in entries:
+        lines_of.setdefault(e["fingerprint"], set()).add(
+            int(e.get("line", 0)))
     cache: Dict[str, List[str]] = {}
-    return [f for f in findings if fingerprint(f, cache) not in known]
+
+    groups: Dict[Tuple[str, str, str], List[int]] = {}
+    order = sorted(range(len(findings)),
+                   key=lambda i: (findings[i].path, findings[i].line,
+                                  findings[i].col, findings[i].rule))
+    for i in order:
+        groups.setdefault(_base_key(findings[i], cache), []).append(i)
+
+    suppressed: Set[int] = set()
+    for base, idxs in groups.items():
+        # how many entries did the baseline record for this group?
+        # (write() assigned contiguous occurrence indices 0..m-1)
+        m = 0
+        baselined_lines: Set[int] = set()
+        while m < len(idxs) + 64:
+            fp = _hash(base, m)
+            if fp not in known:
+                break
+            baselined_lines |= lines_of.get(fp, set())
+            m += 1
+        if m == 0:
+            continue
+        on_known_line = [i for i in idxs
+                         if findings[i].line in baselined_lines]
+        take = on_known_line[:m]
+        for i in idxs:       # drifted lines: fall back to line order
+            if len(take) >= m:
+                break
+            if i not in take:
+                take.append(i)
+        suppressed.update(take)
+    return [f for i, f in enumerate(findings) if i not in suppressed]

@@ -1,0 +1,418 @@
+"""Per-function control-flow graphs from the AST (graftcheck v3).
+
+The statement-level CFG that :mod:`.dataflow` runs its fixpoint over.
+One node per executable event — simple statements, branch tests, loop
+bindings, except-handler entries, ``with`` enter/exit — with two edge
+kinds:
+
+``flow``
+    Normal sequential/branch control transfer. Carries the node's
+    POST-state (its transfer function has applied).
+``exc``
+    The statement raised before (or instead of) completing. Carries the
+    node's PRE-state — an acquire that raised acquired nothing, a
+    release that raised released nothing. Every statement that can
+    raise gets one, targeted at the innermost enclosing handler
+    context (except dispatch, ``finally`` copy, ``with`` exit copy, or
+    the function's exception exit).
+
+Structure handled:
+
+- ``if``/``elif``/``else`` — branch tests become ``test`` nodes whose
+  outgoing flow edges carry *assume* labels (``("some", name)`` /
+  ``("none", name)``) for the ``x is None`` / ``not x`` / bare-name
+  shapes, giving the dataflow just enough condition sensitivity for
+  the ``if blocks is None: return`` allocation-failure idiom.
+- ``while``/``for`` + ``else`` — loop back edges, ``break`` skipping
+  the ``else``, ``continue``; ``while True`` omits the false edge.
+- ``try``/``except``/``else``/``finally`` — exception edges from every
+  raising statement of the body to the except dispatch; handler
+  bodies rejoin after the try (the *swallow* path) unless they
+  re-raise; the ``finally`` body is **duplicated per continuation**
+  (normal, raise, return, break, continue) so each abnormal exit is
+  routed through its own copy — the classic duplication approach, with
+  a node budget guarding pathological nesting.
+- ``with`` (and ``async with``) — an enter node plus one synthetic
+  ``with_exit`` node per continuation: acquire at entry, release
+  guaranteed on every path out, which is exactly the invariant the
+  lifecycle rules credit it for.
+- ``return``/``break``/``continue``/``raise`` — routed through any
+  enclosing ``finally``/``with`` copies to the right exit.
+
+Generator functions (a ``yield`` in the function's own scope) are the
+caller's job to skip — :func:`is_generator` decides; the lifecycle
+pass skips them with a stat counter (suspended frames hold resources
+across an unknowable caller-driven schedule).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# node kinds
+ENTRY = "entry"
+EXIT = "exit"                # normal function exit (return / fall off)
+RAISE_EXIT = "raise_exit"    # exception propagates out of the function
+STMT = "stmt"
+TEST = "test"                # if/while condition or for-iterator step
+FOR_BIND = "for_bind"        # loop-target binding for one iteration
+EXCEPT_ENTRY = "except_entry"
+EXCEPT_DISPATCH = "except_dispatch"
+WITH_ENTER = "with_enter"
+WITH_EXIT = "with_exit"
+
+FLOW = "flow"
+EXC = "exc"
+
+# finally/with duplication budget: beyond this the function is skipped
+# (counted by the caller) rather than analyzed partially
+MAX_NODES = 4000
+
+
+class CFGTooLarge(Exception):
+    pass
+
+
+class Node:
+    __slots__ = ("idx", "kind", "ast", "lineno")
+
+    def __init__(self, idx: int, kind: str, ast_node: Optional[ast.AST],
+                 lineno: int):
+        self.idx = idx
+        self.kind = kind
+        self.ast = ast_node
+        self.lineno = lineno
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<{self.kind}@{self.idx} L{self.lineno}>"
+
+
+# assume labels: (sense, name) with sense in {"some", "none"}
+Assume = Optional[Tuple[str, str]]
+
+
+class CFG:
+    def __init__(self) -> None:
+        self.nodes: List[Node] = []
+        # idx -> [(dst, edge_kind, assume)]
+        self.succ: Dict[int, List[Tuple[int, str, Assume]]] = {}
+        self.entry = -1
+        self.exit = -1
+        self.raise_exit = -1
+
+    def add_node(self, kind: str, ast_node: Optional[ast.AST] = None,
+                 lineno: int = 0) -> int:
+        if len(self.nodes) >= MAX_NODES:
+            raise CFGTooLarge()
+        n = Node(len(self.nodes), kind, ast_node, lineno)
+        self.nodes.append(n)
+        self.succ[n.idx] = []
+        return n.idx
+
+    def add_edge(self, src: int, dst: int, kind: str = FLOW,
+                 assume: Assume = None) -> None:
+        e = (dst, kind, assume)
+        if e not in self.succ[src]:
+            self.succ[src].append(e)
+
+
+class _Ctx:
+    """Where abnormal control transfers go from the current position."""
+    __slots__ = ("on_return", "on_raise", "on_break", "on_continue")
+
+    def __init__(self, on_return: int, on_raise: int,
+                 on_break: Optional[int], on_continue: Optional[int]):
+        self.on_return = on_return
+        self.on_raise = on_raise
+        self.on_break = on_break
+        self.on_continue = on_continue
+
+    def derive(self, **kw) -> "_Ctx":
+        c = _Ctx(self.on_return, self.on_raise, self.on_break,
+                 self.on_continue)
+        for k, v in kw.items():
+            setattr(c, k, v)
+        return c
+
+
+def is_generator(fndef: ast.AST) -> bool:
+    """Yield/YieldFrom in the function's own scope (not nested defs)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fndef))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            continue
+        if isinstance(n, (ast.Yield, ast.YieldFrom)):
+            return True
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+_NO_RAISE_STMTS = (ast.Pass, ast.Break, ast.Continue, ast.Global,
+                   ast.Nonlocal, ast.Import, ast.ImportFrom)
+_RAISING_EXPRS = (ast.Call, ast.Attribute, ast.Subscript, ast.BinOp,
+                  ast.Await, ast.Compare)
+
+
+def _can_raise(stmt: ast.stmt) -> bool:
+    if isinstance(stmt, _NO_RAISE_STMTS):
+        return False
+    if isinstance(stmt, (ast.Raise, ast.Assert, ast.Delete)):
+        return True
+    for node in ast.walk(stmt):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)) and node is not stmt:
+            break  # defs' bodies have their own CFGs
+        if isinstance(node, _RAISING_EXPRS):
+            return True
+    return False
+
+
+def _test_assumes(test: ast.expr) -> Tuple[Assume, Assume]:
+    """(true-branch assume, false-branch assume) for the narrow shapes
+    the lifecycle rules need condition sensitivity for."""
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        t, f = _test_assumes(test.operand)
+        return f, t
+    if isinstance(test, ast.BoolOp):
+        # `a and b` true => every conjunct true (any one assume is
+        # sound); which conjunct made it false is unknown. Dual for or.
+        if isinstance(test.op, ast.And):
+            for v in test.values:
+                t, _ = _test_assumes(v)
+                if t is not None:
+                    return t, None
+        else:
+            for v in test.values:
+                _, f = _test_assumes(v)
+                if f is not None:
+                    return None, f
+        return None, None
+    if isinstance(test, ast.Name):
+        return ("some", test.id), ("none", test.id)
+    if isinstance(test, ast.Call) and isinstance(test.func, ast.Attribute) \
+            and test.func.attr == "acquire":
+        # `if lock.acquire(blocking=False):` — the false branch did NOT
+        # take the lock (try-acquire); dotted receiver keys the resource
+        parts: List[str] = []
+        node = test.func.value
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            dotted = ".".join(reversed(parts))
+            return ("held", dotted), ("unheld", dotted)
+    if isinstance(test, ast.Compare) and len(test.ops) == 1 \
+            and isinstance(test.left, ast.Name) \
+            and isinstance(test.comparators[0], ast.Constant) \
+            and test.comparators[0].value is None:
+        if isinstance(test.ops[0], ast.Is):
+            return ("none", test.left.id), ("some", test.left.id)
+        if isinstance(test.ops[0], ast.IsNot):
+            return ("some", test.left.id), ("none", test.left.id)
+    return None, None
+
+
+def handler_swallows(handler: ast.ExceptHandler) -> bool:
+    """True when the handler neither re-raises nor raises anything —
+    the exception dies here and control rejoins the normal flow (the
+    GC005/GC032 swallow shape)."""
+    for n in ast.walk(handler):
+        if isinstance(n, ast.Raise):
+            return False
+    return True
+
+
+class _Builder:
+    def __init__(self, fndef: ast.AST):
+        self.cfg = CFG()
+        self.fndef = fndef
+
+    def build(self) -> CFG:
+        g = self.cfg
+        g.exit = g.add_node(EXIT, lineno=getattr(self.fndef, "lineno", 0))
+        g.raise_exit = g.add_node(RAISE_EXIT)
+        ctx = _Ctx(on_return=g.exit, on_raise=g.raise_exit,
+                   on_break=None, on_continue=None)
+        first = self._block(self.fndef.body, g.exit, ctx)
+        g.entry = g.add_node(ENTRY,
+                             lineno=getattr(self.fndef, "lineno", 0))
+        g.add_edge(g.entry, first)
+        return g
+
+    # -- blocks ------------------------------------------------------------
+
+    def _block(self, stmts: Sequence[ast.stmt], follow: int,
+               ctx: _Ctx) -> int:
+        entry = follow
+        for stmt in reversed(stmts):
+            entry = self._stmt(stmt, entry, ctx)
+        return entry
+
+    # -- statements --------------------------------------------------------
+
+    def _stmt(self, stmt: ast.stmt, follow: int, ctx: _Ctx) -> int:
+        g = self.cfg
+        if isinstance(stmt, ast.If):
+            return self._if(stmt, follow, ctx)
+        if isinstance(stmt, ast.While):
+            return self._while(stmt, follow, ctx)
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            return self._for(stmt, follow, ctx)
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, follow, ctx)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._with(stmt, follow, ctx)
+        if isinstance(stmt, ast.Match):
+            return self._match(stmt, follow, ctx)
+
+        n = g.add_node(STMT, stmt, stmt.lineno)
+        if isinstance(stmt, ast.Return):
+            g.add_edge(n, ctx.on_return)
+            g.add_edge(n, ctx.on_raise, EXC)
+        elif isinstance(stmt, ast.Raise):
+            g.add_edge(n, ctx.on_raise, EXC)
+        elif isinstance(stmt, ast.Break):
+            g.add_edge(n, ctx.on_break
+                       if ctx.on_break is not None else follow)
+        elif isinstance(stmt, ast.Continue):
+            g.add_edge(n, ctx.on_continue
+                       if ctx.on_continue is not None else follow)
+        else:
+            g.add_edge(n, follow)
+            if _can_raise(stmt):
+                g.add_edge(n, ctx.on_raise, EXC)
+        return n
+
+    def _if(self, stmt: ast.If, follow: int, ctx: _Ctx) -> int:
+        g = self.cfg
+        t = g.add_node(TEST, stmt.test, stmt.lineno)
+        then_entry = self._block(stmt.body, follow, ctx)
+        else_entry = self._block(stmt.orelse, follow, ctx)
+        a_true, a_false = _test_assumes(stmt.test)
+        g.add_edge(t, then_entry, FLOW, a_true)
+        g.add_edge(t, else_entry, FLOW, a_false)
+        if _can_raise_expr(stmt.test):
+            g.add_edge(t, ctx.on_raise, EXC)
+        return t
+
+    def _while(self, stmt: ast.While, follow: int, ctx: _Ctx) -> int:
+        g = self.cfg
+        t = g.add_node(TEST, stmt.test, stmt.lineno)
+        body_ctx = ctx.derive(on_break=follow, on_continue=t)
+        body_entry = self._block(stmt.body, t, body_ctx)
+        a_true, a_false = _test_assumes(stmt.test)
+        g.add_edge(t, body_entry, FLOW, a_true)
+        always = isinstance(stmt.test, ast.Constant) and bool(stmt.test.value)
+        if not always:
+            else_entry = self._block(stmt.orelse, follow, ctx)
+            g.add_edge(t, else_entry, FLOW, a_false)
+        if _can_raise_expr(stmt.test):
+            g.add_edge(t, ctx.on_raise, EXC)
+        return t
+
+    def _for(self, stmt, follow: int, ctx: _Ctx) -> int:
+        g = self.cfg
+        it = g.add_node(TEST, stmt.iter, stmt.lineno)
+        bind = g.add_node(FOR_BIND, stmt, stmt.lineno)
+        body_ctx = ctx.derive(on_break=follow, on_continue=it)
+        body_entry = self._block(stmt.body, it, body_ctx)
+        else_entry = self._block(stmt.orelse, follow, ctx)
+        g.add_edge(it, bind)              # next item produced
+        g.add_edge(it, else_entry)        # iterator exhausted
+        g.add_edge(it, ctx.on_raise, EXC)
+        g.add_edge(bind, body_entry)
+        g.add_edge(bind, ctx.on_raise, EXC)
+        return it
+
+    def _match(self, stmt: ast.Match, follow: int, ctx: _Ctx) -> int:
+        g = self.cfg
+        t = g.add_node(TEST, stmt.subject, stmt.lineno)
+        for case in stmt.cases:
+            g.add_edge(t, self._block(case.body, follow, ctx))
+        g.add_edge(t, follow)  # no case matched
+        if _can_raise_expr(stmt.subject):
+            g.add_edge(t, ctx.on_raise, EXC)
+        return t
+
+    def _try(self, stmt: ast.Try, follow: int, ctx: _Ctx) -> int:
+        g = self.cfg
+
+        def fin(cont: Optional[int]) -> Optional[int]:
+            """A fresh copy of the finally body flowing into `cont`."""
+            if cont is None:
+                return None
+            if not stmt.finalbody:
+                return cont
+            return self._block(stmt.finalbody, cont, ctx)
+
+        fin_norm = fin(follow)
+        fin_raise = fin(ctx.on_raise)
+        inner = ctx.derive(on_raise=fin_raise, on_return=fin(ctx.on_return),
+                           on_break=fin(ctx.on_break),
+                           on_continue=fin(ctx.on_continue))
+
+        if stmt.handlers:
+            dispatch = g.add_node(EXCEPT_DISPATCH, stmt, stmt.lineno)
+            for handler in stmt.handlers:
+                h = g.add_node(EXCEPT_ENTRY, handler, handler.lineno)
+                h_body = self._block(handler.body, fin_norm, inner)
+                g.add_edge(h, h_body)
+                g.add_edge(h, inner.on_raise, EXC)
+                g.add_edge(dispatch, h)
+            # no handler matched: the exception keeps propagating
+            g.add_edge(dispatch, fin_raise)
+            body_raise = dispatch
+        else:
+            body_raise = fin_raise
+
+        body_ctx = inner.derive(on_raise=body_raise)
+        # the else clause runs after the body completes; its exceptions
+        # are NOT caught by this try's handlers
+        else_entry = self._block(stmt.orelse, fin_norm, inner)
+        return self._block(stmt.body, else_entry, body_ctx)
+
+    def _with(self, stmt, follow: int, ctx: _Ctx) -> int:
+        # `with a, b:` is sugar for nested single-item withs
+        return self._with_items(stmt, list(stmt.items), follow, ctx)
+
+    def _with_items(self, stmt, items: List[ast.withitem], follow: int,
+                    ctx: _Ctx) -> int:
+        g = self.cfg
+        item = items[0]
+
+        def wexit(cont: Optional[int]) -> Optional[int]:
+            if cont is None:
+                return None
+            n = g.add_node(WITH_EXIT, item, stmt.lineno)
+            g.add_edge(n, cont)
+            return n
+
+        ex_norm = wexit(follow)
+        inner = _Ctx(on_return=wexit(ctx.on_return),
+                     on_raise=wexit(ctx.on_raise),
+                     on_break=wexit(ctx.on_break),
+                     on_continue=wexit(ctx.on_continue))
+        if len(items) == 1:
+            body_entry = self._block(stmt.body, ex_norm, inner)
+        else:
+            body_entry = self._with_items(stmt, items[1:], ex_norm, inner)
+        enter = g.add_node(WITH_ENTER, item, stmt.lineno)
+        g.add_edge(enter, body_entry)
+        g.add_edge(enter, ctx.on_raise, EXC)
+        return enter
+
+
+def _can_raise_expr(expr: ast.expr) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, _RAISING_EXPRS):
+            return True
+    return False
+
+
+def build_cfg(fndef: ast.AST) -> CFG:
+    """CFG for one FunctionDef/AsyncFunctionDef. Raises
+    :class:`CFGTooLarge` past the duplication budget."""
+    return _Builder(fndef).build()

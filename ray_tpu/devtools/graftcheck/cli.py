@@ -95,6 +95,7 @@ def _check_main(argv: List[str]) -> int:
             return 2
         rules = parsed
 
+    lifecycle_stats: dict = {}
     t0 = time.monotonic()
     if args.no_project:
         try:
@@ -122,6 +123,7 @@ def _check_main(argv: List[str]) -> int:
         findings, errors = result.findings, result.errors
         files = result.files
         parsed_n, cached_n = result.parsed, result.cached
+        lifecycle_stats = result.lifecycle_stats
     elapsed = time.monotonic() - t0
 
     if args.write_baseline:
@@ -154,6 +156,23 @@ def _check_main(argv: List[str]) -> int:
     if args.stats:
         print(f"graftcheck: {elapsed:.2f}s ({parsed_n} parsed, "
               f"{cached_n} from cache)", file=sys.stderr)
+        if lifecycle_stats:
+            # analysis-cost counters for the CFG/fixpoint pass so cost
+            # regressions are visible in CI logs (scripts/lint.sh)
+            ls = lifecycle_stats
+            print("graftcheck lifecycle: "
+                  f"{ls.get('fns_analyzed', 0)} fns analyzed "
+                  f"({ls.get('fns_total', 0)} seen, "
+                  f"{ls.get('fns_trivial', 0)} trivial, "
+                  f"{ls.get('fns_generators_skipped', 0)} generators, "
+                  f"{ls.get('fns_too_large', 0)} over-budget, "
+                  f"{ls.get('fns_errors', 0)} errors), "
+                  f"{ls.get('cfg_nodes', 0)} cfg nodes, "
+                  f"{ls.get('resources', 0)} resources, "
+                  f"{ls.get('fixpoint_iterations', 0)} fixpoint "
+                  f"iterations, "
+                  f"{ls.get('fns_nonconverged', 0)} non-converged",
+                  file=sys.stderr)
     if errors:
         return 2
     return 1 if findings else 0

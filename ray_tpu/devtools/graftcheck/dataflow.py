@@ -1,0 +1,102 @@
+"""Generic forward abstract-interpretation fixpoint over a :mod:`.cfg`
+control-flow graph (graftcheck v3).
+
+The framework is domain-agnostic: a *domain* object supplies the
+abstract semantics and the engine supplies termination. Domains
+implement:
+
+``initial() -> state``
+    The state at the function entry.
+
+``transfer(node, state) -> state``
+    The node's effect. Must NOT mutate its input (states are shared
+    between edges); return a new state when anything changes. Findings
+    are typically recorded on the domain itself during transfer —
+    the engine guarantees every node's transfer runs at least once per
+    distinct in-state, and dedup is the domain's job (states grow
+    monotonically, so a site can be visited several times).
+
+``join(a, b) -> state``
+    Least upper bound. The engine folds incoming edge states into the
+    node's in-state with this; the fixpoint terminates when joins stop
+    changing anything, so ``join`` must be monotone w.r.t. ``==``.
+
+``assume(state, label) -> state``
+    Applied to a flow edge's *assume* annotation (branch-condition
+    refinement, e.g. ``("none", "blocks")`` on the true edge of
+    ``if blocks is None:``). Return the input unchanged when the label
+    does not help.
+
+``exc_edge(node, state) -> state`` (optional)
+    Applied to the PRE-state carried along a node's exception edge —
+    the lifecycle domain uses it to tell "the release itself raised"
+    (best-effort close, benign) apart from "something before the
+    release raised" (the leak path).
+
+Edge semantics (matching :mod:`.cfg`):
+
+- ``flow`` edges propagate the node's POST-state (after ``transfer``),
+- ``exc`` edges propagate the node's PRE-state — the statement raised
+  before its effect took hold.
+
+``run(cfg, domain)`` returns a :class:`FixpointResult` with the
+in-state of every node (by index) plus iteration counts for the
+``--stats`` surface.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from .cfg import CFG, EXC
+
+# hard iteration ceiling: |nodes| * height-of-lattice is bounded for the
+# lifecycle domain, but a buggy domain must not hang the linter
+_MAX_VISITS_PER_NODE = 64
+
+
+class FixpointResult:
+    __slots__ = ("in_states", "iterations", "converged")
+
+    def __init__(self, in_states: Dict[int, Any], iterations: int,
+                 converged: bool):
+        self.in_states = in_states
+        self.iterations = iterations
+        self.converged = converged
+
+
+def run(cfg: CFG, domain) -> FixpointResult:
+    in_states: Dict[int, Any] = {cfg.entry: domain.initial()}
+    visits: Dict[int, int] = {}
+    worklist = deque([cfg.entry])
+    queued = {cfg.entry}
+    iterations = 0
+    converged = True
+    exc_edge = getattr(domain, "exc_edge", None)
+
+    while worklist:
+        idx = worklist.popleft()
+        queued.discard(idx)
+        iterations += 1
+        visits[idx] = visits.get(idx, 0) + 1
+        if visits[idx] > _MAX_VISITS_PER_NODE:
+            converged = False
+            continue
+        pre = in_states[idx]
+        node = cfg.nodes[idx]
+        post = domain.transfer(node, pre)
+        for dst, kind, assume in cfg.succ[idx]:
+            if kind == EXC:
+                carry = exc_edge(node, pre) if exc_edge is not None else pre
+            else:
+                carry = post
+            if assume is not None:
+                carry = domain.assume(carry, assume)
+            prev = in_states.get(dst)
+            nxt = carry if prev is None else domain.join(prev, carry)
+            if prev is None or nxt != prev:
+                in_states[dst] = nxt
+                if dst not in queued:
+                    queued.add(dst)
+                    worklist.append(dst)
+    return FixpointResult(in_states, iterations, converged)

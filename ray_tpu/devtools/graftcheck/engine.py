@@ -37,7 +37,7 @@ from .summary import SUMMARY_VERSION, extract, suppressed
 
 # Any change to local-rule or extraction logic must bump one of these:
 # the pair keys every cache entry.
-ENGINE_VERSION = 1
+ENGINE_VERSION = 2  # v2: lifecycle findings + ownership facts in entries
 CACHE_VERSION = f"{ENGINE_VERSION}.{SUMMARY_VERSION}"
 
 SHARD_MAP_FQS = {
@@ -369,6 +369,7 @@ class ProjectResult:
     cached: int          # files served from cache
     index: ProjectIndex
     graph: CallGraph
+    lifecycle_stats: Dict[str, int] = field(default_factory=dict)
 
 
 def _module_name(path: str, root: str) -> str:
@@ -440,7 +441,7 @@ def check_project(paths: Sequence[str],
                   stderr=None) -> ProjectResult:
     """Run the full engine over `paths`: cached per-file rules + fact
     extraction, then the whole-program passes."""
-    from . import rules_project, rules_spmd
+    from . import rules_lifecycle, rules_project, rules_spmd
 
     stderr = stderr if stderr is not None else sys.stderr
     # None means "all rules"; an explicit empty set means none (the
@@ -489,6 +490,10 @@ def check_project(paths: Sequence[str],
             findings = checker.run()
             summary, extra = extract(path, source, tree, module)
             findings.extend(extra)
+            # the CFG/dataflow lifecycle pass (GC030-033) runs at parse
+            # time too: its confirmed findings and pending/ownership
+            # facts ride the same cache entry
+            findings.extend(rules_lifecycle.analyze_module(tree, summary))
         new_cache[apath] = {
             "sha": sha, "root": root,
             "local": [f.as_dict() for f in findings],
@@ -502,8 +507,11 @@ def check_project(paths: Sequence[str],
     findings = list(local_findings)
     findings.extend(rules_project.run(index, graph, enabled))
     findings.extend(rules_spmd.run(index, enabled))
+    findings.extend(rules_lifecycle.resolve_pending(index, enabled))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     _save_cache(cache_path, cache, new_cache)
     return ProjectResult(findings=findings, errors=errors, files=files,
                          parsed=parsed, cached=cached, index=index,
-                         graph=graph)
+                         graph=graph,
+                         lifecycle_stats=rules_lifecycle.aggregate_stats(
+                             summaries))

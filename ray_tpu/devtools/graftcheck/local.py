@@ -7,8 +7,10 @@ hazards a generic linter cannot see because they depend on what
 one file alone (GC001-GC008); the whole-program rules (GC010/GC011,
 the GC020 SPMD series, and the call-graph-resolved GC008 upgrade) live
 in :mod:`.summary` / :mod:`.engine` / :mod:`.rules_project` /
-:mod:`.rules_spmd` and run over the project index. The package
-``__init__`` composes both halves behind the same ``check_source`` /
+:mod:`.rules_spmd`, and the CFG-based path-sensitive lifecycle family
+(GC030-GC033) in :mod:`.cfg` / :mod:`.dataflow` /
+:mod:`.rules_lifecycle`; both run over the project index. The package
+``__init__`` composes all layers behind the same ``check_source`` /
 ``check_file`` API the single-file linter always had.
 
 ====== =================================================================
@@ -110,6 +112,19 @@ RULES: Dict[str, str] = {
              "function's signature",
     "GC022": "buffer donated via donate_argnums is read after the jitted "
              "call (its memory was reused by XLA)",
+    # CFG-based path-sensitive lifecycle rules (engine-backed; see
+    # cfg.py/dataflow.py/rules_lifecycle.py)
+    "GC030": "resource leak: an acquired resource (pool alloc/retain, "
+             "channel segment, collective group, lock.acquire, open()) "
+             "reaches a function exit unreleased on some path",
+    "GC031": "double-release / use-after-release of a resource along "
+             "some path",
+    "GC032": "resource release skipped by a swallowing except: an "
+             "exception before the release rejoins the normal flow with "
+             "the resource still held",
+    "GC033": "conditional acquire with unconditional release (or vice "
+             "versa): the release runs on paths where the acquire never "
+             "did",
 }
 
 # GC007 targets library code only: user-facing surfaces where print IS

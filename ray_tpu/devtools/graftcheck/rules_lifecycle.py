@@ -1,0 +1,1379 @@
+"""GC030-GC033 — CFG-based path-sensitive resource-lifecycle analysis.
+
+The rule family that polices the paired-lifecycle invariants the
+framework actually lives by: BlockPool ``alloc``/``retain``/``free``,
+store/agent ``allocate_channel``/``release_channel``, collective-group
+``create``/``destroy``, raw ``lock.acquire``/``release``, and
+``open()``/sockets outside ``with``. A forward abstract interpretation
+(:mod:`.dataflow`) over the per-function CFG (:mod:`.cfg`) tracks each
+acquired resource's state along every path:
+
+====== =================================================================
+GC030  resource leak — an acquired resource reaches a normal function
+       exit unreleased on some path (early return, fall-through, a
+       swallowing ``except`` that rejoined the flow), is re-acquired in
+       a loop while the previous acquisition is still held, is orphaned
+       by rebinding its only name, or its allocation result is
+       discarded outright
+GC031  double-release / use-after-release along any path (the diamond:
+       a conditional release followed by an unconditional one; a retain
+       after every incoming path released), incl. a manual release
+       inside a ``with`` block that releases again on exit
+GC032  release skipped by a swallowing ``except``: the release exists
+       on the normal path, but an exception raised *before* it lands in
+       a handler that neither re-raises nor releases — the path rejoins
+       the normal flow with the resource still held. (A swallow around
+       *only* the release itself — best-effort close — stays clean.)
+GC033  conditional acquire with unconditional release: the release is
+       reached on paths where the acquire never ran (release of an
+       unheld lock raises; a pool double-accounting hazard). The
+       mirrored shape (unconditional acquire, conditional release) is a
+       GC030 leak on the skipping path.
+====== =================================================================
+
+Interprocedural ownership (riding the v2 engine's call-graph
+machinery):
+
+- a function that **returns** the resource or **stores it on self** /
+  into a container transfers ownership — no leak is reported in it;
+- a *local* helper that releases its parameter counts as a release at
+  the call site (module-level fixpoint, so helper chains resolve);
+- passing the resource to an **unresolvable** callee is treated as an
+  ownership transfer (silent) but recorded as a *pending* finding; the
+  project pass (:func:`resolve_pending`) resolves the callee through
+  the import graph — a cross-module helper that provably neither
+  releases nor takes ownership confirms the leak, one that releases
+  confirms a double-release, anything unresolvable stays silent.
+
+Per-function ownership summaries (``releases``/``owns`` param indices)
+are exported into the cached file summaries so cross-file resolution
+works against cached entries. Generator functions are skipped (a
+suspended frame holds resources across a caller-driven schedule) and
+counted in the ``--stats`` surface, as are functions past the CFG node
+budget.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from . import dataflow
+from .cfg import (ENTRY, EXCEPT_DISPATCH, EXCEPT_ENTRY, EXIT, FOR_BIND,
+                  RAISE_EXIT, STMT, TEST, WITH_ENTER, WITH_EXIT,
+                  CFGTooLarge, build_cfg, handler_swallows, is_generator)
+from .local import (Finding, _assigned_names, _dotted, _is_lockish,
+                    _iter_own_exprs)
+from .summary import suppressed
+
+LIFECYCLE_RULES: Set[str] = {"GC030", "GC031", "GC032", "GC033"}
+
+# -- abstract tokens --------------------------------------------------------
+BOT = "BOT"      # not acquired on this path
+ACQ = "ACQ"      # acquired and held
+PAR = "PAR"      # held by a parameter (caller owns it; we may release)
+REL = "REL"      # released
+RELX = "RELX"    # the release itself raised and was swallowed (best-effort)
+ESC = "ESC"      # ownership transferred (return / self-store / owning callee)
+# ("SW", handler_line)   — ACQ that survived into a swallowing except
+# ("SWP", handler_line)  — PAR that survived into a swallowing except
+# ("PESC", callee, pos)  — passed to an unresolved callee (pending)
+
+_KIND_DESC = {
+    "pool": "block-pool allocation",
+    "channel": "store channel segment",
+    "group": "collective group",
+    "lock": "lock",
+    "file": "file/socket handle",
+}
+
+_FILE_CTOR_NAMES = {
+    ("open",), ("io", "open"), ("socket", "socket"),
+    ("socket", "create_connection"),
+}
+
+_BENIGN_CALLEES = {
+    "len", "str", "repr", "int", "float", "bool", "sorted", "list",
+    "tuple", "set", "dict", "frozenset", "min", "max", "sum", "any",
+    "all", "enumerate", "zip", "isinstance", "print", "id", "hash",
+    "format", "iter", "next", "reversed", "range", "abs", "map",
+    "filter", "getattr", "hasattr", "type",
+}
+
+
+def _poolish(recv: ast.AST) -> bool:
+    d = _dotted(recv)
+    return d is not None and any("pool" in part.lower() for part in d)
+
+
+def _ctor_like(callee: str) -> bool:
+    """CamelCase (or _CamelCase) final component = a class constructor."""
+    last = callee.split(".")[-1].lstrip("_")
+    return bool(last[:1].isupper())
+
+
+def _recv_dotted(recv: ast.AST) -> Optional[str]:
+    d = _dotted(recv)
+    return ".".join(d) if d else None
+
+
+def classify_call(call: ast.Call, known_locks: Set[str]
+                  ) -> Optional[Tuple[str, ...]]:
+    """One call expression -> a lifecycle op, or None.
+
+    ("acquire", kind, mode)            mode: value | arg0
+    ("retain",)                        pool refcount++ on arg0
+    ("release", kind, "arg")           releases arg0's resource(s)
+    ("release", "group", "kindwide")   destroy releases every group rid
+    ("acquire"/"release", "lock", "recv", dotted)
+    ("close",)                         .close() on a tracked value
+    """
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        attr = func.attr
+        recv = func.value
+        if attr == "alloc" and _poolish(recv):
+            return ("acquire", "pool", "value")
+        if attr == "retain" and _poolish(recv):
+            return ("retain",)
+        if attr == "free" and _poolish(recv):
+            return ("release", "pool", "arg")
+        if attr == "allocate_channel":
+            return ("acquire", "channel", "arg0")
+        if attr == "release_channel":
+            return ("release", "channel", "arg")
+        if attr in ("acquire", "release") and _is_lockish(recv, known_locks):
+            dotted = _recv_dotted(recv)
+            if dotted:
+                return (attr if attr == "acquire" else "release",
+                        "lock", "recv", dotted)
+        if attr == "close":
+            return ("close",)
+    d = _dotted(func)
+    if d is not None:
+        if d[-1] == "create_collective_group":
+            return ("acquire", "group", "value")
+        if d[-1] == "destroy_collective_group":
+            return ("release", "group", "kindwide")
+        if d in _FILE_CTOR_NAMES:
+            return ("acquire", "file", "value")
+    return None
+
+
+def _walk_expr(root: ast.AST):
+    """`root` plus every sub-expression, pruning nested scopes."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, (ast.stmt, ast.ExceptHandler)):
+                stack.append(child)
+
+
+def _calls_in(node: ast.AST) -> List[ast.Call]:
+    it = _iter_own_exprs(node) if isinstance(node, ast.stmt) \
+        else _walk_expr(node)
+    return [n for n in it if isinstance(n, ast.Call)]
+
+
+# ---------------------------------------------------------------------------
+# per-module ownership oracle
+
+
+def collect_functions(tree: ast.Module
+                      ) -> List[Tuple[ast.AST, str, Optional[str]]]:
+    """(fndef, qname, class) triples with the same qname scheme the
+    summary extractor uses ("fn", "Cls.m", "fn.inner")."""
+    out: List[Tuple[ast.AST, str, Optional[str]]] = []
+
+    def visit_stmts(stmts, qprefix: str, cls: Optional[str]) -> None:
+        for d in _child_defs(stmts):
+            if isinstance(d, ast.ClassDef):
+                visit_class(d)
+            else:
+                out.append((d, qprefix + d.name, cls))
+                visit_stmts(d.body, qprefix + d.name + ".", cls)
+
+    def visit_class(c: ast.ClassDef) -> None:
+        for m in _child_defs(c.body):
+            if isinstance(m, ast.ClassDef):
+                visit_class(m)
+            else:
+                out.append((m, f"{c.name}.{m.name}", c.name))
+                visit_stmts(m.body, f"{c.name}.{m.name}.", c.name)
+
+    visit_stmts(tree.body, "", None)
+    return out
+
+
+def _child_defs(stmts: Sequence[ast.stmt]) -> List[ast.stmt]:
+    out: List[ast.stmt] = []
+    stack: List[ast.stmt] = list(stmts)
+    while stack:
+        st = stack.pop(0)
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            out.append(st)
+            continue
+        for fld in ("body", "orelse", "finalbody"):
+            child = getattr(st, fld, None)
+            if isinstance(child, list):
+                stack.extend(c for c in child if isinstance(c, ast.stmt))
+        for handler in getattr(st, "handlers", ()):
+            stack.extend(handler.body)
+        for case in getattr(st, "cases", ()):
+            stack.extend(case.body)
+    return out
+
+
+def _own_scope_stmts(fndef: ast.AST):
+    """Every statement in the function's own scope (nested defs pruned)."""
+    stack: List[ast.stmt] = list(fndef.body)
+    while stack:
+        st = stack.pop()
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            continue
+        yield st
+        for fld in ("body", "orelse", "finalbody"):
+            child = getattr(st, fld, None)
+            if isinstance(child, list):
+                stack.extend(c for c in child if isinstance(c, ast.stmt))
+        for handler in getattr(st, "handlers", ()):
+            stack.extend(handler.body)
+        for case in getattr(st, "cases", ()):
+            stack.extend(case.body)
+
+
+def _params_of(fndef: ast.AST) -> List[str]:
+    a = fndef.args
+    return [p.arg for p in list(a.posonlyargs) + list(a.args)]
+
+
+def _resolve_local(oracle: Dict[str, Dict[str, Any]], callee: str,
+                   cls: Optional[str]) -> Optional[Tuple[str, int]]:
+    """Callee name as written -> (oracle qname, arg->param offset)."""
+    if callee.startswith("self.") and cls:
+        q = f"{cls}.{callee[5:]}"
+        return (q, 1) if q in oracle else None
+    if "." in callee:
+        return None
+    return (callee, 0) if callee in oracle else None
+
+
+def build_ownership_oracle(tree: ast.Module, known_locks: Set[str]
+                           ) -> Dict[str, Dict[str, Any]]:
+    """qname -> {"params", "releases" (param idxs), "owns" (param idxs),
+    "self_releases" (dotted lock receivers released)}.
+
+    "releases" closes over same-module helper chains (3-round fixpoint);
+    "owns" = param returned, stored on self/a container, or appended.
+    """
+    fns = collect_functions(tree)
+    oracle: Dict[str, Dict[str, Any]] = {}
+    bodies: Dict[str, Tuple[ast.AST, Optional[str]]] = {}
+    for fndef, qname, cls in fns:
+        # "escapes": params handed to a callee THIS module cannot
+        # resolve — the function is then NOT provably non-owning, so a
+        # pending leak through it must stay silent instead of
+        # confirming (a one-hop delegation chain ends in another file)
+        oracle[qname] = {"params": _params_of(fndef), "releases": set(),
+                         "owns": set(), "self_releases": set(),
+                         "escapes": set()}
+        bodies[qname] = (fndef, cls)
+
+    helper_sites: Dict[str, List[Tuple[ast.Call, Optional[str]]]] = {}
+    for qname, (fndef, cls) in bodies.items():
+        rec = oracle[qname]
+        pidx = {p: i for i, p in enumerate(rec["params"])}
+        # `for b in blocks:` makes b an elementwise view of the param —
+        # releasing b inside the loop releases the param's resources
+        # (the free_all(pool, blocks) cleanup-helper idiom)
+        for st in _own_scope_stmts(fndef):
+            if isinstance(st, (ast.For, ast.AsyncFor)) \
+                    and isinstance(st.iter, ast.Name) \
+                    and st.iter.id in pidx:
+                for nm in _assigned_names(st.target):
+                    pidx.setdefault(nm, pidx[st.iter.id])
+        sites: List[Tuple[ast.Call, Optional[str]]] = []
+        for stmt in _own_scope_stmts(fndef):
+            if isinstance(stmt, ast.Return) and stmt.value is not None:
+                # structural "returns the param" forms only — a param
+                # merely READ inside the return expression (len(p),
+                # sum(x for x in p)) does not transfer ownership out
+                for n in _returned_names(stmt.value):
+                    if n in pidx:
+                        rec["owns"].add(pidx[n])
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                    else [stmt.target]
+                value = stmt.value
+                if value is not None and any(
+                        isinstance(t, (ast.Attribute, ast.Subscript))
+                        for t in targets):
+                    for n in ast.walk(value):
+                        if isinstance(n, ast.Name) and n.id in pidx:
+                            rec["owns"].add(pidx[n.id])
+            for node in _calls_in(stmt):
+                op = classify_call(node, known_locks)
+                if op is not None:
+                    if op[0] == "release" and op[1] == "lock":
+                        rec["self_releases"].add(op[3])
+                    elif op[0] == "release" and op[-1] == "arg":
+                        for a in _release_arg_names(node):
+                            if a in pidx:
+                                rec["releases"].add(pidx[a])
+                    elif op[0] == "close":
+                        recv = node.func.value
+                        if isinstance(recv, ast.Name) and recv.id in pidx:
+                            rec["releases"].add(pidx[recv.id])
+                    continue
+                func = node.func
+                if isinstance(func, ast.Attribute) \
+                        and func.attr in ("close", "release") \
+                        and isinstance(func.value, ast.Name) \
+                        and func.value.id in pidx:
+                    rec["releases"].add(pidx[func.value.id])
+                    continue
+                if isinstance(func, ast.Attribute) \
+                        and func.attr == "append":
+                    for a in node.args:
+                        if isinstance(a, ast.Name) and a.id in pidx:
+                            rec["owns"].add(pidx[a.id])
+                    continue
+                sites.append((node, cls))
+        helper_sites[qname] = sites
+
+    def _arg_params(node: ast.Call, pidx: Dict[str, int]):
+        """(arg-position-or-param-name, param index) pairs for every
+        param handed to `node`, positionals AND keywords."""
+        out: List[Tuple[Any, int]] = []
+        for pos, a in enumerate(node.args):
+            if isinstance(a, ast.Name) and a.id in pidx:
+                out.append((pos, pidx[a.id]))
+        for kw in node.keywords:
+            if kw.arg and isinstance(kw.value, ast.Name) \
+                    and kw.value.id in pidx:
+                out.append((kw.arg, pidx[kw.value.id]))
+        return out
+
+    # params escaping to callees this module cannot see through
+    for qname, sites in helper_sites.items():
+        rec = oracle[qname]
+        pidx = {p: i for i, p in enumerate(rec["params"])}
+        for node, cls in sites:
+            d = _dotted(node.func)
+            callee = ".".join(d) if d else None
+            if callee is not None \
+                    and _resolve_local(oracle, callee, cls) is not None:
+                continue
+            if callee is not None and (
+                    callee in _BENIGN_CALLEES
+                    or callee.split(".")[-1] in _BENIGN_CALLEES):
+                continue
+            for _, p in _arg_params(node, pidx):
+                rec["escapes"].add(p)
+
+    # close releases/owns over same-module helper chains; a param
+    # passed into a constructor counts as owned by the object
+    for _ in range(3):
+        changed = False
+        for qname, sites in helper_sites.items():
+            rec = oracle[qname]
+            pidx = {p: i for i, p in enumerate(rec["params"])}
+            for node, cls in sites:
+                d = _dotted(node.func)
+                if d is None:
+                    continue
+                callee = ".".join(d)
+                hit = _resolve_local(oracle, callee, cls)
+                if hit is None:
+                    if _ctor_like(callee) \
+                            and callee not in _BENIGN_CALLEES:
+                        for a in list(node.args) + \
+                                [k.value for k in node.keywords]:
+                            if isinstance(a, ast.Name) and a.id in pidx \
+                                    and pidx[a.id] not in rec["owns"]:
+                                rec["owns"].add(pidx[a.id])
+                                changed = True
+                    continue
+                cq, off = hit
+                crec = oracle[cq]
+                for key, p in _arg_params(node, pidx):
+                    if isinstance(key, int):
+                        cidx = key + off
+                    elif key in crec["params"]:
+                        cidx = crec["params"].index(key)
+                    else:
+                        continue
+                    if cidx in crec["releases"] \
+                            and p not in rec["releases"]:
+                        rec["releases"].add(p)
+                        changed = True
+                    if cidx in crec["owns"] and p not in rec["owns"]:
+                        rec["owns"].add(p)
+                        changed = True
+                    if cidx in crec["escapes"] and p not in rec["escapes"]:
+                        rec["escapes"].add(p)
+                        changed = True
+        if not changed:
+            break
+    return oracle
+
+
+def _returned_names(value: ast.AST) -> List[str]:
+    """Names a return expression hands to the caller structurally:
+    bare names, tuple/list/set elements, dict values, either arm of a
+    conditional — not names merely read inside calls/comprehensions."""
+    if isinstance(value, ast.Name):
+        return [value.id]
+    if isinstance(value, (ast.Tuple, ast.List, ast.Set)):
+        out: List[str] = []
+        for e in value.elts:
+            out.extend(_returned_names(e))
+        return out
+    if isinstance(value, ast.Dict):
+        out = []
+        for v in value.values:
+            out.extend(_returned_names(v))
+        return out
+    if isinstance(value, ast.IfExp):
+        return _returned_names(value.body) + _returned_names(value.orelse)
+    return []
+
+
+def _release_arg_names(call: ast.Call) -> List[str]:
+    """Names released by a ("release", kind, "arg") call: a bare Name
+    arg or a list/tuple literal of Names."""
+    if not call.args:
+        return []
+    a = call.args[0]
+    if isinstance(a, ast.Name):
+        return [a.id]
+    if isinstance(a, (ast.List, ast.Tuple)):
+        return [e.id for e in a.elts if isinstance(e, ast.Name)]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# resource ids
+
+
+class _Rid:
+    __slots__ = ("idx", "kind", "line", "col", "mode", "name", "recv",
+                 "accum")
+
+    def __init__(self, idx: int, kind: str, line: int, col: int, mode: str,
+                 name: Optional[str] = None, recv: Optional[str] = None):
+        self.idx = idx
+        self.kind = kind
+        self.line = line
+        self.col = col
+        self.mode = mode          # value | arg | recv | param | with
+        self.name = name          # bound variable name when known
+        self.recv = recv          # receiver dotted path (lock rids)
+        self.accum = False        # flows into an accumulator container
+
+    @property
+    def desc(self) -> str:
+        return _KIND_DESC[self.kind]
+
+
+# ---------------------------------------------------------------------------
+# the dataflow domain
+
+
+class _LifecycleDomain:
+    """State = (env, res): env maps local names to frozensets of rid
+    indices, res is a tuple with one frozenset of tokens per rid.
+    States are never mutated in place — `transfer` copies before
+    changing anything, since inputs are shared between edges."""
+
+    def __init__(self, analyzer: "_FunctionAnalysis"):
+        self.a = analyzer
+
+    # -- lattice -----------------------------------------------------------
+
+    def initial(self):
+        env: Dict[str, Any] = {}
+        res = []
+        for rid in self.a.rids:
+            if rid.mode == "param":
+                res.append(frozenset({PAR}))
+                env[rid.name] = env.get(rid.name, frozenset()) | {rid.idx}
+            else:
+                res.append(frozenset({BOT}))
+        return (env, tuple(res))
+
+    def join(self, s1, s2):
+        if s1 == s2:
+            return s1
+        env1, res1 = s1
+        env2, res2 = s2
+        env = dict(env1)
+        for k, v in env2.items():
+            env[k] = env.get(k, frozenset()) | v
+        res = tuple(a | b for a, b in zip(res1, res2))
+        return (env, res)
+
+    def assume(self, state, label):
+        sense, name = label
+        env, res = state
+        if sense in ("held", "unheld"):
+            # try-acquire condition: `name` is the lock's dotted receiver
+            rid = self.a.rid_by_recv.get(name)
+            if rid is None:
+                return state
+            out = list(res)
+            if sense == "unheld":
+                out[rid] = frozenset({BOT})
+            elif BOT in out[rid] and len(out[rid]) > 1:
+                out[rid] = out[rid] - {BOT}
+            return (env, tuple(out))
+        rids = env.get(name)
+        if not rids:
+            return state
+        out = list(res)
+        changed = False
+        for i in rids:
+            if sense == "none":
+                # on this path the name is None: the acquire bound to
+                # it produced nothing
+                if out[i] != frozenset({BOT}):
+                    out[i] = frozenset({BOT})
+                    changed = True
+            elif BOT in out[i] and len(out[i]) > 1:
+                out[i] = out[i] - {BOT}
+                changed = True
+        return (env, tuple(out)) if changed else state
+
+    # -- exception-edge refinement ----------------------------------------
+
+    def exc_edge(self, node, state):
+        """A pure-release statement raising: the resource is released-
+        or-failed-releasing (best-effort close) — not a leak path."""
+        if node.kind != STMT or not isinstance(node.ast, ast.Expr) \
+                or not isinstance(node.ast.value, ast.Call):
+            return state
+        op = classify_call(node.ast.value, self.a.known_locks)
+        if op is None or op[0] not in ("release", "close"):
+            return state
+        env, res = state
+        targets = self._release_targets(node.ast.value, op, env)
+        if not targets:
+            return state
+        out = list(res)
+        changed = False
+        for i in targets:
+            if ACQ in out[i] or PAR in out[i]:
+                out[i] = (out[i] - {ACQ, PAR}) | {RELX}
+                changed = True
+        return (env, tuple(out)) if changed else state
+
+    # -- transfer ----------------------------------------------------------
+
+    def transfer(self, node, state):
+        kind = node.kind
+        if kind in (ENTRY, RAISE_EXIT, EXCEPT_DISPATCH):
+            return state
+        if kind == EXIT:
+            self.a.report_exit(state)
+            return state
+        if kind == EXCEPT_ENTRY:
+            return self._except_entry(node, state)
+        if kind == WITH_ENTER:
+            return self._with_enter(node, state)
+        if kind == WITH_EXIT:
+            return self._with_exit(node, state)
+        env, res = dict(state[0]), list(state[1])
+        if kind == FOR_BIND:
+            self._rebind(_assigned_names(node.ast.target), env, res,
+                         node.lineno, protect=())
+        elif kind == TEST:
+            self._process_calls(node.ast, None, env, res)
+        else:
+            self._stmt(node.ast, env, res)
+        return (env, tuple(res))
+
+    # -- statement transfer ------------------------------------------------
+
+    def _stmt(self, stmt, env, res) -> None:
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            value = stmt.value
+            top_rid = self._process_calls(stmt, value, env, res)
+            name_targets: List[str] = []
+            attr_store = False
+            for t in targets:
+                names = _assigned_names(t)
+                if names:
+                    name_targets.extend(names)
+                else:
+                    attr_store = True
+            if attr_store and value is not None:
+                # self.x = b / d[k] = b: ownership transferred
+                self._escape_names(value, env, res)
+                if top_rid is not None and not any(
+                        _assigned_names(t) for t in targets):
+                    # self.x = open(...): acquired straight into a field
+                    res[top_rid] = frozenset({ESC})
+                    top_rid = None
+            alias = None
+            if isinstance(value, ast.Name) and len(name_targets) == 1:
+                alias = env.get(value.id)
+            self._rebind(name_targets, env, res, stmt.lineno,
+                         protect=(top_rid,) if top_rid is not None else ())
+            if name_targets:
+                if alias:
+                    env[name_targets[0]] = alias
+                elif top_rid is not None:
+                    for n in name_targets:
+                        env[n] = env.get(n, frozenset()) | {top_rid}
+        elif isinstance(stmt, ast.Return):
+            top_rid = self._process_calls(stmt, stmt.value, env, res)
+            if top_rid is not None:
+                res[top_rid] = frozenset({ESC})  # ownership to the caller
+            if stmt.value is not None:
+                self._escape_names(stmt.value, env, res)
+        else:
+            self._process_calls(stmt, None, env, res)
+
+    # -- pieces ------------------------------------------------------------
+
+    def _process_calls(self, node, top_value, env, res) -> Optional[int]:
+        """Run lifecycle ops for every call in the node's own
+        expressions. Returns the rid acquired by the `top_value` call
+        (to be bound by the caller), if any."""
+        a = self.a
+        top_rid: Optional[int] = None
+        none_calls = _none_asserted_calls(node)
+        for call in _calls_in(node):
+            if id(call) in a.expect_raise:
+                continue
+            op = classify_call(call, a.known_locks)
+            if op is None:
+                self._helper_call(call, env, res)
+                continue
+            if id(call) in none_calls:
+                # `assert pool.alloc(5) is None`: the acquisition is
+                # proven to have FAILED on the continuing path
+                rid = a.rid_by_call.get(id(call))
+                if rid is not None:
+                    res[rid] = frozenset({BOT})
+                continue
+            tag = op[0]
+            if tag == "acquire" and op[1] == "lock":
+                rid = a.rid_by_recv.get(op[3])
+                if rid is not None:
+                    res[rid] = frozenset({ACQ})
+                    if call is top_value:
+                        # `got = lock.acquire(timeout=...)`: bind the
+                        # result name so `if got:` branches refine the
+                        # lock's state like any None-guard
+                        top_rid = rid
+            elif tag == "acquire":
+                rid = a.rid_by_call.get(id(call))
+                if rid is None:
+                    continue
+                r = a.rids[rid]
+                bound = call is top_value
+                if r.kind == "file" and not bound:
+                    continue  # only track name-bound opens
+                if ACQ in res[rid] and not r.accum:
+                    a.report(
+                        "GC030", r.line, r.col,
+                        f"{r.desc} re-acquired here while a previous "
+                        f"acquisition from this site is still held on "
+                        f"the looping path — the earlier resource "
+                        f"leaks; release it before re-acquiring")
+                res[rid] = frozenset({ACQ})
+                if r.mode == "arg":
+                    if r.name is not None:
+                        env[r.name] = env.get(r.name, frozenset()) | {rid}
+                elif bound:
+                    top_rid = rid
+                elif r.kind == "pool" and isinstance(node, ast.Expr) \
+                        and node.value is call:
+                    a.report(
+                        "GC030", r.line, r.col,
+                        f"result of this {r.desc} is discarded — the "
+                        f"blocks can never be released; bind the result "
+                        f"and pair it with a release")
+            elif tag == "retain":
+                rid = a.rid_by_call.get(id(call))
+                if rid is None:
+                    continue
+                nm = a.rids[rid].name
+                # use-after-release only when NOTHING bound to the name
+                # is still held: with the refcount model an alloc-rid
+                # can legally stay live while an earlier retain-rid was
+                # consumed by a free (alloc;retain;free;retain is rc
+                # 1-2-1-2 — balanced, not a UAF)
+                others = [r0 for r0 in env.get(nm, ()) if r0 != rid]
+                if others and all(res[r0] == frozenset({REL})
+                                  for r0 in others):
+                    a.report(
+                        "GC031", call.lineno, call.col_offset + 1,
+                        f"'{nm}' is retained here after being "
+                        f"released on every incoming path "
+                        f"(use-after-release)")
+                res[rid] = frozenset({ACQ})
+                env[nm] = env.get(nm, frozenset()) | {rid}
+            else:  # release / close
+                self._release_selected(
+                    call, self._release_targets(call, op, env), res)
+        return top_rid
+
+    def _release_selected(self, call, targets: List[int], res) -> None:
+        """Release through the refcount model: several acquisitions
+        (alloc + retains) sharing one name mean one free consumes ONE
+        outstanding acquisition — release the latest still-held one;
+        only a free with nothing left held is a double release."""
+        a = self.a
+        if len(targets) > 1:
+            live = [rid for rid in targets
+                    if ACQ in res[rid] or _has_sw(res[rid])
+                    or any(isinstance(t, tuple) and t[0] == "PESC"
+                           for t in res[rid])]
+            pick = max(live or targets, key=lambda i: a.rids[i].line)
+            self._do_release(call, pick, res, rc_ambiguous=True)
+        else:
+            for rid in targets:
+                self._do_release(call, rid, res)
+
+    def _release_targets(self, call, op, env) -> List[int]:
+        a = self.a
+        if op[0] == "close":
+            recv = call.func.value
+            if isinstance(recv, ast.Name):
+                return [i for i in env.get(recv.id, ())
+                        if a.rids[i].kind == "file"]
+            return []
+        if op[1] == "lock":
+            rid = a.rid_by_recv.get(op[3])
+            return [rid] if rid is not None else []
+        if op[-1] == "kindwide":
+            return [r.idx for r in a.rids if r.kind == "group"]
+        out: List[int] = []
+        for nm in _release_arg_names(call):
+            out.extend(env.get(nm, ()))
+        return out
+
+    def _do_release(self, call, rid: int, res,
+                    rc_ambiguous: bool = False) -> None:
+        a = self.a
+        r = a.rids[rid]
+        tokens = res[rid]
+        line, col = call.lineno, call.col_offset + 1
+        if REL in tokens:
+            a.report(
+                "GC031", line, col,
+                f"{r.desc}{_at(r)} is released again here after an "
+                f"earlier release on some incoming path — double "
+                f"release (refcount corruption / unheld-lock error)")
+        pesc = [t for t in tokens
+                if isinstance(t, tuple) and t[0] == "PESC"]
+        if pesc:
+            a.pending(
+                "GC031", line, col,
+                callees=[(t[1], t[2]) for t in pesc], confirm="releases",
+                message=f"{r.desc}{_at(r)} is released here after being "
+                        f"passed to {{callee}}(), which also releases it "
+                        f"(resolved project-wide) — double release")
+        if not rc_ambiguous and not r.accum and BOT in tokens \
+                and (ACQ in tokens or _has_sw(tokens)):
+            a.report(
+                "GC033", line, col,
+                f"{r.desc}{_at(r)} is released here unconditionally but "
+                f"acquired only on some incoming paths — on the path "
+                f"that skipped the acquire this releases an unheld "
+                f"resource; mirror the acquire/release branch structure")
+        res[rid] = frozenset({REL})
+
+    def _helper_call(self, call, env, res) -> None:
+        a = self.a
+        d = _dotted(call.func)
+        callee = ".".join(d) if d else None
+        func = call.func
+        if isinstance(func, ast.Attribute) \
+                and func.attr in ("append", "extend") \
+                and isinstance(func.value, ast.Name):
+            # acc.extend(pool.alloc(1)): the acquisition accumulates
+            # into `acc` — bind the rid there so a later free(acc)
+            # releases it, and mark it re-acquirable (loop pattern)
+            linked = False
+            for arg in call.args:
+                if isinstance(arg, ast.Call):
+                    rid = a.rid_by_call.get(id(arg))
+                    if rid is not None:
+                        a.rids[rid].accum = True
+                        nm = func.value.id
+                        env[nm] = env.get(nm, frozenset()) | {rid}
+                        linked = True
+            if linked:
+                return
+        # positionals keyed by index, keywords by name — a resource
+        # passed as `_Seq(blocks=b)` transfers ownership like `_Seq(b)`
+        tracked = [(pos, arg.id) for pos, arg in enumerate(call.args)
+                   if isinstance(arg, ast.Name) and env.get(arg.id)]
+        tracked += [(kw.arg, kw.value.id) for kw in call.keywords
+                    if kw.arg and isinstance(kw.value, ast.Name)
+                    and env.get(kw.value.id)]
+        hit = _resolve_local(a.oracle, callee, a.cls) \
+            if callee and a.oracle else None
+        if hit is not None:
+            cq, off = hit
+            crec = a.oracle[cq]
+            for key, nm in tracked:
+                if isinstance(key, int):
+                    p = key + off
+                elif key in crec["params"]:
+                    p = crec["params"].index(key)
+                else:
+                    continue
+                if p in crec["releases"]:
+                    # same consume-one refcount semantics as a direct
+                    # free — a helper-routed free must not drain every
+                    # acquisition bound to the name at once
+                    self._release_selected(call, list(env.get(nm, ())),
+                                           res)
+                elif p in crec["owns"] or p in crec["escapes"]:
+                    # owns = transferred; escapes = the helper hands it
+                    # to a callee IT cannot see — not provable either
+                    # way, stay silent
+                    for rid in env.get(nm, ()):
+                        if ACQ in res[rid]:
+                            res[rid] = (res[rid] - {ACQ}) | {ESC}
+            if callee.startswith("self."):
+                # a helper releasing self-held locks releases them here
+                for dotted in crec["self_releases"]:
+                    rid = a.rid_by_recv.get(dotted)
+                    if rid is not None:
+                        self._do_release(call, rid, res)
+            return
+        if not tracked:
+            return
+        if callee is None:
+            for _, nm in tracked:
+                for rid in env.get(nm, ()):
+                    if ACQ in res[rid]:
+                        res[rid] = (res[rid] - {ACQ}) | {ESC}
+            return
+        if callee in _BENIGN_CALLEES \
+                or callee.split(".")[-1] in _BENIGN_CALLEES:
+            return
+        if isinstance(func, ast.Attribute) and _poolish(func.value):
+            # a pool method that is not alloc/retain/free is a query
+            # (refcount, used_count, check_leaks): no ownership change
+            return
+        if _ctor_like(callee):
+            # Cls(b) / _Seq(blocks=b): the object takes ownership
+            for _, nm in tracked:
+                for rid in env.get(nm, ()):
+                    if ACQ in res[rid]:
+                        res[rid] = (res[rid] - {ACQ}) | {ESC}
+            return
+        for key, nm in tracked:
+            for rid in env.get(nm, ()):
+                if ACQ not in res[rid]:
+                    continue
+                if isinstance(key, int):
+                    # pending: the project pass may still prove a leak
+                    res[rid] = (res[rid] - {ACQ}) | {("PESC", callee, key)}
+                else:
+                    # kwarg to an unresolved callee: silent transfer
+                    res[rid] = (res[rid] - {ACQ}) | {ESC}
+
+    def _escape_names(self, value: ast.AST, env, res) -> None:
+        for n in _walk_expr(value):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                for rid in env.get(n.id, ()):
+                    if ACQ in res[rid]:
+                        res[rid] = (res[rid] - {ACQ}) | {ESC}
+
+    def _rebind(self, names: List[str], env, res, lineno: int,
+                protect: Tuple) -> None:
+        a = self.a
+        for n in names:
+            old = env.pop(n, None)
+            if not old:
+                continue
+            others: Set[int] = set()
+            for v in env.values():
+                others.update(v)
+            for rid in old:
+                r = a.rids[rid]
+                if rid in protect or rid in others:
+                    continue
+                if r.mode not in ("value", "arg"):
+                    continue
+                # only claim an orphan when NO path handled the
+                # resource (a REL/ESC on some path means ownership is
+                # managed through state the env cannot see)
+                if ACQ in res[rid] and REL not in res[rid] \
+                        and ESC not in res[rid]:
+                    a.report(
+                        "GC030", lineno, 1,
+                        f"rebinding '{n}' here orphans the unreleased "
+                        f"{r.desc} acquired at line {r.line} — release "
+                        f"it before reusing the name")
+                # the binding is gone: reset the site so a stale REL
+                # from a previous loop iteration cannot fake a GC031
+                # against the next binding
+                res[rid] = frozenset({BOT})
+
+    def _except_entry(self, node, state):
+        handler = node.ast
+        env, res = state
+        if handler.name:
+            env = dict(env)
+            env.pop(handler.name, None)
+        if not handler_swallows(handler):
+            return (env, res)
+        hline = handler.lineno
+        out = list(res)
+        changed = False
+        for i, tokens in enumerate(out):
+            nt = tokens
+            if ACQ in nt:
+                nt = (nt - {ACQ}) | {("SW", hline)}
+            if PAR in nt:
+                nt = (nt - {PAR}) | {("SWP", hline)}
+            if nt is not tokens:
+                out[i] = nt
+                changed = True
+        return (env, tuple(out)) if changed else (env, res)
+
+    def _with_enter(self, node, state):
+        rid = self.a.rid_by_item.get(id(node.ast))
+        if rid is None:
+            return state
+        env, res = dict(state[0]), list(state[1])
+        res[rid] = frozenset({ACQ})
+        opt = node.ast.optional_vars
+        if isinstance(opt, ast.Name):
+            env[opt.id] = frozenset({rid})
+        return (env, tuple(res))
+
+    def _with_exit(self, node, state):
+        a = self.a
+        rid = a.rid_by_item.get(id(node.ast))
+        if rid is None:
+            return state
+        env, res = state
+        r = a.rids[rid]
+        if REL in res[rid] and r.kind == "lock":
+            a.report(
+                "GC031", node.lineno, 1,
+                f"this with block releases the {r.desc} on exit, but it "
+                f"was already released manually inside the block on "
+                f"some path — double release (unheld-lock error)")
+        out = list(res)
+        out[rid] = frozenset({REL})
+        return (env, tuple(out))
+
+
+def _at(r: _Rid) -> str:
+    return f" ('{r.name}')" if r.mode == "param" \
+        else f" acquired at line {r.line}"
+
+
+def _has_sw(tokens) -> bool:
+    return any(isinstance(t, tuple) and t[0] == "SW" for t in tokens)
+
+
+def _none_asserted_calls(node: ast.AST) -> frozenset:
+    """id()s of calls proven failed by `assert <call> is None` (and the
+    equivalent `assert <call> == None`)."""
+    if not isinstance(node, ast.Assert):
+        return frozenset()
+    out = set()
+    for n in _walk_expr(node.test):
+        if isinstance(n, ast.Compare) and len(n.ops) == 1 \
+                and isinstance(n.ops[0], (ast.Is, ast.Eq)) \
+                and isinstance(n.left, ast.Call) \
+                and isinstance(n.comparators[0], ast.Constant) \
+                and n.comparators[0].value is None:
+            out.add(id(n.left))
+    return frozenset(out)
+
+
+# ---------------------------------------------------------------------------
+# per-function analysis driver
+
+
+class _FunctionAnalysis:
+    def __init__(self, fndef: ast.AST, qname: str, cls: Optional[str],
+                 summary: Dict[str, Any], known_locks: Set[str],
+                 oracle: Dict[str, Dict[str, Any]],
+                 findings: List[Finding], pendings: List[Dict[str, Any]]):
+        self.fndef = fndef
+        self.qname = qname
+        self.cls = cls
+        self.summary = summary
+        self.known_locks = known_locks
+        self.oracle = oracle
+        self.findings = findings
+        self.pendings = pendings
+        self.rids: List[_Rid] = []
+        self.rid_by_call: Dict[int, int] = {}
+        self.rid_by_recv: Dict[str, int] = {}
+        self.rid_by_item: Dict[int, int] = {}
+        self.expect_raise: Set[int] = set()
+        self.release_lines: Dict[str, List[int]] = {}
+        self.any_release_lines: List[int] = []
+        self._reported: Set[Tuple] = set()
+        self._pending_keys: Set[Tuple] = set()
+
+    # -- reporting ---------------------------------------------------------
+
+    def report(self, rule: str, line: int, col: int, message: str) -> None:
+        key = (rule, line, message[:48])
+        if key in self._reported:
+            return
+        if suppressed(self.summary, line, rule):
+            return
+        self._reported.add(key)
+        self.findings.append(Finding(
+            path=self.summary["path"], line=line, col=col, rule=rule,
+            message=message))
+
+    def pending(self, rule: str, line: int, col: int,
+                callees: List[Tuple[str, int]], confirm: str,
+                message: str) -> None:
+        key = (rule, line, tuple(sorted(callees)))
+        if key in self._pending_keys:
+            return
+        if suppressed(self.summary, line, rule):
+            return
+        self._pending_keys.add(key)
+        self.pendings.append({
+            "rule": rule, "line": line, "col": col, "fn": self.qname,
+            "callees": sorted(set(callees)), "confirm": confirm,
+            "message": message,
+        })
+
+    def report_exit(self, state) -> None:
+        _env, res = state
+        for rid, tokens in zip(self.rids, res):
+            if rid.mode == "param":
+                swp = [t for t in tokens
+                       if isinstance(t, tuple) and t[0] == "SWP"]
+                if swp and self._has_release(rid.kind):
+                    self.report(
+                        "GC032", self._first_release(rid.kind), 1,
+                        f"the release of '{rid.name}' here is skipped "
+                        f"when the except at line {swp[0][1]} swallows "
+                        f"an exception raised before it — the path "
+                        f"rejoins the normal flow with the {rid.desc} "
+                        f"unreleased; move the release into a finally "
+                        f"block")
+                continue
+            if ACQ in tokens:
+                self.report(
+                    "GC030", rid.line, rid.col,
+                    f"{rid.desc} acquired here is not released on every "
+                    f"path: a normal exit is reachable with it still "
+                    f"held — release it in try/finally, store it on "
+                    f"self, or return it to transfer ownership")
+                continue
+            sw = [t for t in tokens
+                  if isinstance(t, tuple) and t[0] == "SW"]
+            if sw:
+                if self._has_release(rid.kind):
+                    self.report(
+                        "GC032", self._first_release(rid.kind), 1,
+                        f"the release of the {rid.desc} acquired at "
+                        f"line {rid.line} is skipped when the except at "
+                        f"line {sw[0][1]} swallows an exception raised "
+                        f"before it — the path rejoins the normal flow "
+                        f"with the resource unreleased; move the "
+                        f"release into a finally block")
+                else:
+                    self.report(
+                        "GC030", rid.line, rid.col,
+                        f"{rid.desc} acquired here leaks through the "
+                        f"swallowing except at line {sw[0][1]}: the "
+                        f"exception path rejoins the normal flow with "
+                        f"it unreleased and no release exists — release "
+                        f"in try/finally")
+                continue
+            pesc = [t for t in tokens
+                    if isinstance(t, tuple) and t[0] == "PESC"]
+            if pesc:
+                self.pending(
+                    "GC030", rid.line, rid.col,
+                    callees=[(t[1], t[2]) for t in pesc],
+                    confirm="none_own",
+                    message=f"{rid.desc} acquired here is passed to "
+                            f"{{callee}}(), which neither releases nor "
+                            f"takes ownership of it (resolved "
+                            f"project-wide), and is never released on "
+                            f"some path — a leak")
+
+    def _has_release(self, kind: str) -> bool:
+        return bool(self.release_lines.get(kind) or self.any_release_lines)
+
+    def _first_release(self, kind: str) -> int:
+        lines = self.release_lines.get(kind) or self.any_release_lines
+        return min(lines)
+
+    # -- pre-scan ----------------------------------------------------------
+
+    def prescan(self) -> bool:
+        """Enumerate resource ids; False when nothing is trackable."""
+        params = set(_params_of(self.fndef))
+        param_rids: Dict[str, int] = {}
+        with_calls: Set[int] = set()
+        # statement order here is arbitrary (stack walk): track the
+        # earliest ACQUIRE site per lock receiver so the rid anchors at
+        # the acquire, not at whichever release happened to be seen first
+        lock_acq_line: Dict[str, int] = {}
+
+        def new_rid(**kw) -> int:
+            rid = _Rid(idx=len(self.rids), **kw)
+            self.rids.append(rid)
+            return rid.idx
+
+        for stmt in _own_scope_stmts(self.fndef):
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    ce = item.context_expr
+                    if isinstance(ce, ast.Call):
+                        with_calls.add(id(ce))
+                        d0 = _dotted(ce.func)
+                        if d0 is not None and d0[-1] == "raises":
+                            # `with pytest.raises(...):` — every
+                            # lifecycle op inside is EXPECTED to fail;
+                            # tracking it would report the test's own
+                            # intent (parents are yielded before their
+                            # body statements, so this fills in time)
+                            for body_stmt in stmt.body:
+                                for sub in ast.walk(body_stmt):
+                                    if isinstance(sub, ast.Call):
+                                        self.expect_raise.add(id(sub))
+                        op = classify_call(ce, self.known_locks)
+                        if op and op[0] == "acquire" \
+                                and op[1] in ("file", "pool"):
+                            self.rid_by_item[id(item)] = new_rid(
+                                kind=op[1], line=ce.lineno,
+                                col=ce.col_offset + 1, mode="with")
+                    elif _is_lockish(ce, self.known_locks):
+                        dotted = _recv_dotted(ce)
+                        if dotted:
+                            rid = self.rid_by_recv.get(dotted)
+                            if rid is None:
+                                rid = new_rid(kind="lock", line=ce.lineno,
+                                              col=ce.col_offset + 1,
+                                              mode="with", recv=dotted)
+                                self.rid_by_recv[dotted] = rid
+                            self.rid_by_item[id(item)] = rid
+            for expr in _calls_in(stmt):
+                if id(expr) in with_calls or id(expr) in self.expect_raise:
+                    continue
+                op = classify_call(expr, self.known_locks)
+                if op is None:
+                    # a local helper releasing an arg still counts as a
+                    # release site for the GC032 "release exists" gate
+                    d = _dotted(expr.func)
+                    if d is not None and self.oracle:
+                        hit = _resolve_local(self.oracle, ".".join(d),
+                                             self.cls)
+                        if hit is not None:
+                            crel = self.oracle[hit[0]]["releases"]
+                            for pos, a in enumerate(expr.args):
+                                if isinstance(a, ast.Name) \
+                                        and (pos + hit[1]) in crel:
+                                    self.any_release_lines.append(
+                                        expr.lineno)
+                    continue
+                tag = op[0]
+                if tag == "acquire" and op[1] == "lock":
+                    if op[3] not in self.rid_by_recv:
+                        self.rid_by_recv[op[3]] = new_rid(
+                            kind="lock", line=expr.lineno,
+                            col=expr.col_offset + 1, mode="recv",
+                            recv=op[3])
+                    prev = lock_acq_line.get(op[3])
+                    if prev is None or expr.lineno < prev:
+                        lock_acq_line[op[3]] = expr.lineno
+                        r = self.rids[self.rid_by_recv[op[3]]]
+                        r.line = expr.lineno
+                        r.col = expr.col_offset + 1
+                elif tag == "acquire":
+                    if op[1] == "pool" and expr.args \
+                            and isinstance(expr.args[0], ast.Constant) \
+                            and expr.args[0].value == 0:
+                        continue  # alloc(0) acquires nothing
+                    mode = "value" if op[2] == "value" else "arg"
+                    nm = None
+                    if mode == "arg":
+                        if not (expr.args
+                                and isinstance(expr.args[0], ast.Name)):
+                            continue
+                        nm = expr.args[0].id
+                    self.rid_by_call[id(expr)] = new_rid(
+                        kind=op[1], line=expr.lineno,
+                        col=expr.col_offset + 1, mode=mode, name=nm)
+                elif tag == "retain":
+                    names = _release_arg_names(expr)
+                    if len(names) == 1:  # retain(b) or retain([b])
+                        self.rid_by_call[id(expr)] = new_rid(
+                            kind="pool", line=expr.lineno,
+                            col=expr.col_offset + 1, mode="arg",
+                            name=names[0])
+                elif tag == "close":
+                    recv = expr.func.value
+                    if isinstance(recv, ast.Name):
+                        self.release_lines.setdefault(
+                            "file", []).append(expr.lineno)
+                elif tag == "release":
+                    if op[1] == "lock":
+                        if op[3] not in self.rid_by_recv:
+                            self.rid_by_recv[op[3]] = new_rid(
+                                kind="lock", line=expr.lineno,
+                                col=expr.col_offset + 1, mode="recv",
+                                recv=op[3])
+                        self.release_lines.setdefault(
+                            "lock", []).append(expr.lineno)
+                    else:
+                        self.release_lines.setdefault(
+                            op[1], []).append(expr.lineno)
+                        if op[-1] == "arg":
+                            for nm in _release_arg_names(expr):
+                                if nm in params and nm not in param_rids:
+                                    param_rids[nm] = new_rid(
+                                        kind=op[1], line=expr.lineno,
+                                        col=1, mode="param", name=nm)
+        return bool(self.rids)
+
+    # -- run ---------------------------------------------------------------
+
+    def run(self, stats: Dict[str, int]) -> None:
+        if not self.prescan():
+            stats["fns_trivial"] = stats.get("fns_trivial", 0) + 1
+            return
+        try:
+            graph = build_cfg(self.fndef)
+        except CFGTooLarge:
+            stats["fns_too_large"] = stats.get("fns_too_large", 0) + 1
+            return
+        stats["fns_analyzed"] = stats.get("fns_analyzed", 0) + 1
+        stats["cfg_nodes"] = stats.get("cfg_nodes", 0) + len(graph.nodes)
+        stats["resources"] = stats.get("resources", 0) + len(self.rids)
+        result = dataflow.run(graph, _LifecycleDomain(self))
+        stats["fixpoint_iterations"] = \
+            stats.get("fixpoint_iterations", 0) + result.iterations
+        if not result.converged:
+            stats["fns_nonconverged"] = \
+                stats.get("fns_nonconverged", 0) + 1
+
+
+# ---------------------------------------------------------------------------
+# module entry point (runs at extraction time; results ride the cache)
+
+
+def analyze_module(tree: ast.Module, summary: Dict[str, Any]
+                   ) -> List[Finding]:
+    """Path-sensitive GC030-033 over every function of one module.
+    Returns the confirmed findings and mutates `summary`:
+
+    - ``summary["lifecycle"] = {"pending": [...], "stats": {...}}``
+    - ``summary["functions"][q]["lifecycle"] = {"releases", "owns"}``
+      for functions with ownership facts (cross-file resolution).
+    """
+    findings: List[Finding] = []
+    pendings: List[Dict[str, Any]] = []
+    stats: Dict[str, int] = {}
+    known_locks = set(summary.get("module_unser", ()))
+    try:
+        oracle = build_ownership_oracle(tree, known_locks)
+    except RecursionError:   # pragma: no cover - pathological input
+        oracle = {}
+    for qname, rec in oracle.items():
+        if rec["releases"] or rec["owns"] or rec["escapes"]:
+            fnrec = summary["functions"].get(qname)
+            if fnrec is not None:
+                fnrec["lifecycle"] = {
+                    "releases": sorted(rec["releases"]),
+                    "owns": sorted(rec["owns"]),
+                    "escapes": sorted(rec["escapes"]),
+                }
+    for fndef, qname, cls in collect_functions(tree):
+        stats["fns_total"] = stats.get("fns_total", 0) + 1
+        if is_generator(fndef):
+            stats["fns_generators_skipped"] = \
+                stats.get("fns_generators_skipped", 0) + 1
+            continue
+        fa = _FunctionAnalysis(fndef, qname, cls, summary, known_locks,
+                               oracle, findings, pendings)
+        try:
+            fa.run(stats)
+        except Exception:    # never fail the lint on one function
+            stats["fns_errors"] = stats.get("fns_errors", 0) + 1
+    summary["lifecycle"] = {"pending": pendings, "stats": stats}
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# project pass: resolve pending findings through the import graph
+
+
+def resolve_pending(index, enabled: Set[str]) -> List[Finding]:
+    from .engine import resolve_call_target
+
+    out: List[Finding] = []
+    for s in index.summaries:
+        lc = s.get("lifecycle") or {}
+        for p in lc.get("pending", ()):
+            if p["rule"] not in enabled:
+                continue
+            fnrec = s["functions"].get(p["fn"])
+            if fnrec is None:
+                continue
+            resolved: List[Tuple[str, Dict[str, Any], int]] = []
+            all_resolved = True
+            for callee, pos in p["callees"]:
+                fq = resolve_call_target(index, s, fnrec, callee)
+                if fq is None:
+                    all_resolved = False
+                    continue
+                _, cfn = index.functions[fq]
+                crec = cfn.get("lifecycle") or {"releases": [], "owns": []}
+                off = 1 if callee.startswith("self.") else 0
+                resolved.append((callee, crec, pos + off))
+            if p["confirm"] == "releases":
+                hits = [c for c, crec, idx in resolved
+                        if idx in crec["releases"]]
+                if hits:
+                    out.append(Finding(
+                        path=s["path"], line=p["line"], col=p["col"],
+                        rule=p["rule"],
+                        message=p["message"].replace("{callee}", hits[0])))
+            else:  # none_own: every callee must provably not take it
+                if not resolved or not all_resolved:
+                    continue
+                if any(idx in crec["releases"] or idx in crec["owns"]
+                       or idx in crec.get("escapes", ())
+                       for _, crec, idx in resolved):
+                    # a callee that releases/keeps it — or hands it to
+                    # someone IT cannot see — is not a proven leak
+                    continue
+                out.append(Finding(
+                    path=s["path"], line=p["line"], col=p["col"],
+                    rule=p["rule"],
+                    message=p["message"].replace(
+                        "{callee}", resolved[0][0])))
+    return out
+
+
+def aggregate_stats(summaries) -> Dict[str, int]:
+    total: Dict[str, int] = {}
+    for s in summaries:
+        for k, v in (s.get("lifecycle") or {}).get("stats", {}).items():
+            total[k] = total.get(k, 0) + int(v)
+    return total

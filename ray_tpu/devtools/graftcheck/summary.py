@@ -37,7 +37,7 @@ from .local import (Finding, _assigned_names, _ctor_kind, _dotted,
 
 # Folded into the cache key (engine.CACHE_VERSION): bump when the
 # summary schema or extraction logic changes.
-SUMMARY_VERSION = 2  # v2: method-level .options(...).remote() edges
+SUMMARY_VERSION = 3  # v3: lifecycle pending/ownership facts + stats
 
 # collective -> positional index of its axis argument
 COLLECTIVE_AXIS_ARG: Dict[str, int] = {

@@ -265,7 +265,7 @@ class InstrumentedLock:
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         # the wrapper IS the lock: release flows through self.release()
-        # graftcheck: disable=GC006
+        # graftcheck: disable=GC006,GC030
         got = self._lock.acquire(blocking, timeout)
         if not got:
             return False

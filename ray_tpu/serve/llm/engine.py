@@ -497,9 +497,11 @@ class LLMEngine:
             nb = blocks_for_tokens(p, cfg.block_size)
             reused = list(match.blocks) if match else []
             # pin the matched blocks (and the COW source) before any
-            # eviction the alloc below may trigger can free them
+            # eviction the alloc below may trigger can free them; the
+            # pin rides match.blocks into the sequence's table and is
+            # freed at retire/preempt through seq.blocks
             if reused:
-                self.pool.retain(reused)
+                self.pool.retain(reused)  # graftcheck: disable=GC030
             if match is not None and match.partial_block is not None:
                 self.pool.retain([match.partial_block])
             blocks = self._alloc_with_evict(nb - len(reused))

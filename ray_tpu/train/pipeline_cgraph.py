@@ -357,7 +357,9 @@ class _CGStage:
             if dp > 1:
                 from ..parallel import collective
 
-                collective.create_collective_group(
+                # the group lives for the engine run; released by
+                # _destroy_collective_groups at shutdown/recover/resize
+                collective.create_collective_group(  # graftcheck: disable=GC030
                     dp, dp_rank, group_name=group_name)
             if self._plane is not None:
                 # fsdp composes with dp through a host-collective grad

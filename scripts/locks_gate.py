@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Dynamic companion to the GC030-033 static lock-discipline rules: run
+the direct-dispatch suite under ``RAY_TPU_DEBUG_LOCKS=1`` (the
+instrumented-lock factory: per-thread acquisition stacks + role-level
+lock-order graph, docs/GRAFTCHECK.md) and assert ZERO lock-order
+inversions were reported anywhere in the run — driver and worker
+processes alike (their warnings reach the captured output through the
+driver log mirror).
+
+The static pass proves release-on-every-path per function; this gate
+proves the cross-thread ORDER discipline the CFG cannot see, on the
+suite with the densest lock interleaving (per-caller lanes, peer
+caches, sharded head loops).
+
+Exit status: 0 = suite green and zero inversions; 1 otherwise.
+"""
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MARKER = "lock-order inversion"
+
+
+def main() -> int:
+    env = dict(os.environ)
+    env["RAY_TPU_DEBUG_LOCKS"] = "1"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_dispatch_direct.py",
+         "-q", "-p", "no:cacheprovider", "-p", "no:randomly"],
+        cwd=ROOT, env=env, capture_output=True, text=True)
+    out = proc.stdout + proc.stderr
+    sys.stdout.write(out[-4000:])
+    inversions = [ln for ln in out.splitlines() if MARKER in ln]
+    if proc.returncode != 0:
+        print(f"locks_gate: FAIL — pytest exited {proc.returncode}")
+        return 1
+    if inversions:
+        print(f"locks_gate: FAIL — {len(inversions)} lock-order "
+              f"inversion(s) reported under RAY_TPU_DEBUG_LOCKS=1:")
+        for ln in inversions[:10]:
+            print("  " + ln.strip())
+        return 1
+    print("locks_gate: OK — suite green, zero lock-order inversions "
+          "under instrumented locks")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

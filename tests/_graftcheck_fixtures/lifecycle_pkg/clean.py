@@ -1,0 +1,98 @@
+"""Lifecycle shapes the GC030-033 rules must stay SILENT on."""
+import threading
+
+_lock = threading.Lock()
+
+
+def try_finally_release(pool, n, work):
+    """The canonical pairing: release guaranteed on every path,
+    including the exception edge out of work()."""
+    b = pool.alloc(n)
+    try:
+        work(b)
+    finally:
+        pool.free(b)
+
+
+def ownership_via_return(pool, n):
+    """Acquire-and-return transfers ownership to the caller."""
+    b = pool.alloc(n)
+    return b
+
+
+class Holder:
+    def ownership_via_self(self, pool, n):
+        """Storing on self transfers ownership to the object."""
+        b = pool.alloc(n)
+        self._blocks = b
+
+    def ownership_via_ctor(self, pool, n):
+        """A constructor takes ownership of its arguments."""
+        b = pool.alloc(n)
+        seq = _Sequence(b)
+        self._running.append(seq)
+
+
+class _Sequence:
+    def __init__(self, blocks):
+        self.blocks = blocks
+
+
+def with_statement(path):
+    """`with` IS the pairing: enter acquires, exit releases on every
+    path out — normal, return, and exception alike."""
+    with open(path) as fh:
+        return fh.read()
+
+
+def with_lock_guard(x):
+    with _lock:
+        if x:
+            return 1
+        return 2
+
+
+def alloc_failure_guard(pool, n):
+    """alloc() returning None acquired nothing: exiting on the
+    None-test branch is not a leak."""
+    b = pool.alloc(n)
+    if b is None:
+        return None
+    pool.free(b)
+    return n
+
+
+def refcounted_retain(pool, n):
+    """alloc + retain = refcount 2: two frees are the BALANCED
+    sequence, not a double release."""
+    b = pool.alloc(n)
+    pool.retain(b)
+    pool.free(b)
+    pool.free(b)
+
+
+def best_effort_close(path):
+    """A swallow around ONLY the release itself (best-effort close)
+    is not a skipped release."""
+    fh = open(path)
+    try:
+        fh.close()
+    except OSError:
+        pass
+
+
+def try_acquire_probe():
+    """The false branch of a try-acquire did not take the lock."""
+    if _lock.acquire(blocking=False):
+        _lock.release()
+        return False
+    return True
+
+
+def accumulator_loop(pool, k):
+    """Acquisitions accumulating into a container stay reachable and
+    are released through it — not a loop leak."""
+    blocks = []
+    for _ in range(k):
+        blocks.extend(pool.alloc(1))
+    pool.free(blocks)

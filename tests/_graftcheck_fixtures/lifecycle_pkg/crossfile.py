@@ -1,0 +1,31 @@
+"""Callers whose verdicts depend on helpers in ANOTHER file — the
+interprocedural ownership summaries resolved by the project pass."""
+from .helpers import Registry, measure, release_blocks
+
+
+def released_by_helper(pool, n):
+    """CLEAN: release_blocks() provably frees the blocks (cross-file
+    ownership summary)."""
+    b = pool.alloc(n)
+    release_blocks(pool, b)
+
+
+def adopted_by_helper(pool, n, reg: Registry):
+    """CLEAN: the registry takes ownership of the blocks."""
+    b = pool.alloc(n)
+    reg.adopt(b)
+
+
+def leaked_through_helper(pool, n):
+    """GC030 (pending -> confirmed): measure() provably neither
+    releases nor keeps the blocks, and nothing else does either."""
+    b = pool.alloc(n)
+    return measure(b)
+
+
+def double_free_through_helper(pool, n):
+    """GC031 (pending -> confirmed): the helper already freed the
+    blocks; the explicit free is a second release."""
+    b = pool.alloc(n)
+    release_blocks(pool, b)
+    pool.free(b)

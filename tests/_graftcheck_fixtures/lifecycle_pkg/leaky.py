@@ -1,0 +1,80 @@
+"""Lifecycle defects GC030-033 must each FLAG — including the two
+known-shape regressions this rule family was built to stop recurring:
+the PR-13 except-swallowed free (GC032) and the early-return-holding-
+lock (GC030)."""
+import threading
+
+_lock = threading.Lock()
+
+
+def swallowed_release(pool, n, work):
+    """GC032 — the PR-13 fixture shape, now path-proven: work() raising
+    lands in a handler that neither re-raises nor frees, and the path
+    rejoins the normal flow with the blocks still held."""
+    b = pool.alloc(n)
+    try:
+        work(b)
+        pool.free(b)
+    except Exception:
+        pass
+
+
+def loop_reacquire(pool, n, xs):
+    """GC030 — each iteration re-allocates over the previous
+    still-held allocation; every round but the last leaks."""
+    out = 0
+    for x in xs:
+        b = pool.alloc(n)
+        out += x
+    return out
+
+
+def double_free_diamond(pool, n, cond):
+    """GC031 — the conditional release followed by the unconditional
+    one: on the cond path the second free hits released blocks."""
+    b = pool.alloc(n)
+    if cond:
+        pool.free(b)
+    pool.free(b)
+
+
+def conditional_acquire(pool, n, cond):
+    """GC033 — the mismatched-branch shape behind the PR-10 peer-race:
+    acquire under a condition, release unconditionally."""
+    b = None
+    if cond:
+        b = pool.alloc(n)
+    pool.free(b)
+
+
+def early_return_holding_lock(busy):
+    """GC030 — the early return exits with the lock held and every
+    later acquirer wedges (the known-shape lock regression)."""
+    _lock.acquire()
+    if busy:
+        return None
+    _lock.release()
+    return 1
+
+
+def early_return_leak(pool, n, cond):
+    """GC030 — a plain early return past the release."""
+    b = pool.alloc(n)
+    if cond:
+        return None
+    pool.free(b)
+    return n
+
+
+def discarded_alloc(pool, n):
+    """GC030 — the allocation result is dropped on the floor."""
+    pool.alloc(n)
+
+
+def over_free(pool, n):
+    """GC031 — three frees against refcount 2."""
+    b = pool.alloc(n)
+    pool.retain(b)
+    pool.free(b)
+    pool.free(b)
+    pool.free(b)

@@ -817,7 +817,8 @@ def tree_result():
     res = check_project(
         [os.path.join(REPO, "ray_tpu"), os.path.join(REPO, "examples"),
          os.path.join(REPO, "tests")],
-        rules={"GC008", "GC010", "GC011", "GC020", "GC021", "GC022"},
+        rules={"GC008", "GC010", "GC011", "GC020", "GC021", "GC022",
+               "GC030", "GC031", "GC032", "GC033"},
         cache_path=None)
     assert res.errors == 0
     return res
@@ -889,3 +890,460 @@ class TestPrefixPkg:
             cache_path=None, root=os.path.join(REPO, "ray_tpu"))
         assert res.errors == 0
         assert [f.render() for f in res.findings] == []
+
+
+# ---------------------------------------------------------------------------
+# lifecycle rules GC030-033 (graftcheck v3: CFG + dataflow)
+
+
+LIFECYCLE = {"GC030", "GC031", "GC032", "GC033"}
+
+
+class TestLifecycleFixtures:
+    """The lifecycle_pkg fixture pack: every seeded positive fires on
+    its line, every clean shape stays silent, and the cross-file
+    ownership pendings resolve both ways."""
+
+    @pytest.fixture(scope="class")
+    def res(self):
+        return run_pkg("lifecycle_pkg", rules=LIFECYCLE)
+
+    def _at(self, res, fname, rule):
+        return [f for f in res.findings
+                if f.path.endswith(fname) and f.rule == rule]
+
+    def test_clean_shapes_are_silent(self, res):
+        """try/finally, with, ownership via return / self-store /
+        constructor, alloc-None guards, refcounted retain+2xfree,
+        best-effort close, try-acquire probes, accumulator loops."""
+        assert self._at(res, "clean.py", "GC030") == []
+        assert not any(f.path.endswith("clean.py") for f in res.findings)
+
+    def test_swallowed_release_is_gc032(self, res):
+        """The PR-13 known-shape regression, path-proven: an exception
+        before the free lands in a swallowing handler and rejoins the
+        normal flow holding the blocks."""
+        hits = self._at(res, "leaky.py", "GC032")
+        assert len(hits) == 1 and hits[0].line == 17
+        assert "swallows" in hits[0].message
+
+    def test_loop_reacquire_is_gc030(self, res):
+        hits = [f for f in self._at(res, "leaky.py", "GC030")
+                if f.line == 27]
+        assert hits and any("re-acquired" in f.message for f in hits)
+
+    def test_double_free_diamond_is_gc031(self, res):
+        hits = [f for f in self._at(res, "leaky.py", "GC031")
+                if f.line == 38]
+        assert len(hits) == 1
+        assert "double release" in hits[0].message
+
+    def test_conditional_acquire_is_gc033(self, res):
+        hits = self._at(res, "leaky.py", "GC033")
+        assert [f.line for f in hits] == [47]
+
+    def test_early_return_holding_lock_is_gc030(self, res):
+        """The second known-shape regression: a return path exits with
+        the lock held."""
+        hits = [f for f in self._at(res, "leaky.py", "GC030")
+                if f.line == 53]
+        assert hits and "lock" in hits[0].message
+
+    def test_early_return_leak_and_discarded_alloc(self, res):
+        lines = {f.line for f in self._at(res, "leaky.py", "GC030")}
+        assert 62 in lines     # early return past the release
+        assert 71 in lines     # discarded allocation result
+
+    def test_over_free_past_refcount_is_gc031(self, res):
+        hits = [f for f in self._at(res, "leaky.py", "GC031")
+                if f.line == 80]
+        assert len(hits) == 1
+
+    def test_crossfile_helper_release_is_clean(self, res):
+        """A helper in another file that releases (or adopts) its
+        parameter transfers ownership: no leak at the call site."""
+        bad = [f for f in res.findings if f.path.endswith("crossfile.py")
+               and f.line < 20]
+        assert bad == [], bad
+
+    def test_crossfile_leak_confirmed(self, res):
+        """measure() provably neither releases nor keeps the blocks —
+        the pending leak is CONFIRMED through the import graph."""
+        hits = [f for f in self._at(res, "crossfile.py", "GC030")]
+        assert [f.line for f in hits] == [22]
+        assert "measure" in hits[0].message
+
+    def test_crossfile_double_free_confirmed(self, res):
+        hits = [f for f in self._at(res, "crossfile.py", "GC031")]
+        assert [f.line for f in hits] == [31]
+        assert "release_blocks" in hits[0].message
+
+    def test_no_fixture_negatives(self, res):
+        """Zero findings outside the seeded positive lines."""
+        expect = {("leaky.py", 17), ("leaky.py", 27), ("leaky.py", 38),
+                  ("leaky.py", 47), ("leaky.py", 53), ("leaky.py", 62),
+                  ("leaky.py", 71), ("leaky.py", 80),
+                  ("crossfile.py", 22), ("crossfile.py", 31)}
+        got = {(os.path.basename(f.path), f.line) for f in res.findings}
+        assert got == expect, got.symmetric_difference(expect)
+
+
+class TestLifecycleCfgCorners:
+    """CFG-construction corners exercised through check_source."""
+
+    def _run(self, src):
+        return [f for f in graftcheck.check_source(src, "c.py",
+                                                   rules=LIFECYCLE)]
+
+    def test_for_else_return_transfers_ownership(self):
+        src = (
+            "def f(pool, n, xs):\n"
+            "    b = pool.alloc(n)\n"
+            "    for x in xs:\n"
+            "        if x:\n"
+            "            break\n"
+            "    else:\n"
+            "        return b\n"
+            "    pool.free(b)\n"
+        )
+        assert self._run(src) == []
+
+    def test_for_else_leak_on_break_path(self):
+        src = (
+            "def f(pool, n, xs):\n"
+            "    b = pool.alloc(n)\n"
+            "    for x in xs:\n"
+            "        if x:\n"
+            "            break\n"
+            "    else:\n"
+            "        pool.free(b)\n"
+            "        return None\n"
+            "    return 1\n"
+        )
+        hits = self._run(src)
+        assert [f.rule for f in hits] == ["GC030"]
+
+    def test_nested_finally_releases_on_every_path(self):
+        src = (
+            "def f(pool, n, work):\n"
+            "    b = pool.alloc(n)\n"
+            "    try:\n"
+            "        try:\n"
+            "            work(b)\n"
+            "        finally:\n"
+            "            pool.free(b)\n"
+            "    finally:\n"
+            "        work(None)\n"
+        )
+        assert self._run(src) == []
+
+    def test_raise_in_except_is_not_a_swallow(self):
+        src = (
+            "def f(pool, n, work):\n"
+            "    b = pool.alloc(n)\n"
+            "    try:\n"
+            "        work(b)\n"
+            "        pool.free(b)\n"
+            "    except Exception:\n"
+            "        raise RuntimeError('boom')\n"
+        )
+        assert self._run(src) == []
+
+    def test_release_in_handler_is_clean(self):
+        src = (
+            "def f(pool, n, work):\n"
+            "    b = pool.alloc(n)\n"
+            "    try:\n"
+            "        work(b)\n"
+            "        pool.free(b)\n"
+            "    except Exception:\n"
+            "        pool.free(b)\n"
+        )
+        assert self._run(src) == []
+
+    def test_while_else_and_continue(self):
+        src = (
+            "def f(pool, n, q):\n"
+            "    b = pool.alloc(n)\n"
+            "    while q.pending():\n"
+            "        if q.skip():\n"
+            "            continue\n"
+            "        q.step(n)\n"
+            "    else:\n"
+            "        pool.free(b)\n"
+            "    return 1\n"
+        )
+        # while-else runs on normal loop exit (no break): released
+        assert self._run(src) == []
+
+    def test_generator_functions_skipped_with_stat(self, tmp_path):
+        src = (
+            "def gen(pool, n):\n"
+            "    b = pool.alloc(n)\n"
+            "    yield b\n"
+            "def plain(pool, n):\n"
+            "    b = pool.alloc(n)\n"
+            "    pool.free(b)\n"
+        )
+        p = tmp_path / "g.py"
+        p.write_text(src)
+        res = check_project([str(p)], rules=LIFECYCLE, cache_path=None,
+                            root=str(tmp_path))
+        assert res.findings == []
+        assert res.lifecycle_stats.get("fns_generators_skipped") == 1
+        assert res.lifecycle_stats.get("fns_analyzed") == 1
+
+    def test_with_manual_release_is_gc031(self):
+        src = (
+            "import threading\n"
+            "_lk = threading.Lock()\n"
+            "def f(c):\n"
+            "    with _lk:\n"
+            "        if c:\n"
+            "            _lk.release()\n"
+            "        return 1\n"
+        )
+        hits = self._run(src)
+        assert [f.rule for f in hits] == ["GC031"]
+
+    def test_lifecycle_stats_aggregate(self, tmp_path):
+        p = tmp_path / "s.py"
+        p.write_text("def f(pool):\n    b = pool.alloc(1)\n"
+                     "    pool.free(b)\n")
+        res = check_project([str(p)], rules=LIFECYCLE, cache_path=None,
+                            root=str(tmp_path))
+        st = res.lifecycle_stats
+        assert st.get("cfg_nodes", 0) > 0
+        assert st.get("fixpoint_iterations", 0) > 0
+        assert st.get("resources") == 1
+
+    def test_cached_lifecycle_findings_identical_to_cold(self, tmp_path):
+        """Lifecycle findings + pendings ride the content-hash cache:
+        a warm run reports exactly the cold run's findings without
+        re-running the CFG pass."""
+        pkg = os.path.join(FIXTURES, "lifecycle_pkg")
+        cache = str(tmp_path / "cache.json")
+        cold = check_project([pkg], rules=LIFECYCLE, cache_path=cache,
+                             root=FIXTURES)
+        warm = check_project([pkg], rules=LIFECYCLE, cache_path=cache,
+                             root=FIXTURES)
+        assert warm.parsed == 0 and warm.cached == len(warm.files)
+        assert [f.render() for f in warm.findings] == \
+            [f.render() for f in cold.findings]
+        assert warm.findings  # the pack has positives
+
+
+def test_library_tree_is_lifecycle_clean(tree_result):
+    """The full-tree sweep satellite stays swept: zero un-annotated
+    GC030-033 findings across ray_tpu/, examples/ and tests/ (the
+    intentional long-held channel segments, actor-lifetime collective
+    groups and refcount stress tests carry line annotations with
+    rationale)."""
+    assert _tree_findings(tree_result, LIFECYCLE) == []
+
+
+# ---------------------------------------------------------------------------
+# baseline fingerprints: rule id + same-text occurrence disambiguation
+
+
+class TestBaselineFingerprintMasking:
+    def test_same_line_different_rules_do_not_mask(self, tmp_path):
+        """A GC030 and a GC032 anchored on the same line have distinct
+        fingerprints: baselining one must not hide the other."""
+        from ray_tpu.devtools.graftcheck import baseline
+        from ray_tpu.devtools.graftcheck.local import Finding
+
+        p = tmp_path / "x.py"
+        p.write_text("pool.free(b)\n")
+        f30 = Finding(str(p), 1, 1, "GC030", "leak")
+        f32 = Finding(str(p), 1, 1, "GC032", "swallowed")
+        bl = tmp_path / "bl.json"
+        baseline.write(str(bl), [f30])
+        kept = baseline.filter_findings([f30, f32], str(bl))
+        assert [f.rule for f in kept] == ["GC032"]
+
+    def test_duplicate_line_text_does_not_mask(self, tmp_path):
+        """Two findings of the SAME rule on identical duplicated lines
+        used to share a fingerprint — baselining one masked the other.
+        The occurrence index keeps them distinct."""
+        from ray_tpu.devtools.graftcheck import baseline
+        from ray_tpu.devtools.graftcheck.local import Finding
+
+        p = tmp_path / "x.py"
+        p.write_text("    pool.free(b)\n" * 3)
+        a = Finding(str(p), 1, 5, "GC031", "double")
+        b = Finding(str(p), 3, 5, "GC031", "double")
+        bl = tmp_path / "bl.json"
+        baseline.write(str(bl), [a])
+        kept = baseline.filter_findings([a, b], str(bl))
+        assert len(kept) == 1 and kept[0].line == 3
+
+    def test_single_occurrence_fingerprints_unchanged(self, tmp_path):
+        """Index 0 is omitted from the key: existing baselines for
+        non-duplicated lines keep filtering."""
+        from ray_tpu.devtools.graftcheck import baseline
+        from ray_tpu.devtools.graftcheck.local import Finding
+
+        p = tmp_path / "x.py"
+        p.write_text("lock.acquire()\n")
+        f = Finding(str(p), 1, 1, "GC030", "leak")
+        cache = {}
+        assert baseline.fingerprint(f, cache) == \
+            baseline.fingerprint(f, {}, 0)
+        bl = tmp_path / "bl.json"
+        baseline.write(str(bl), [f])
+        assert baseline.filter_findings([f], str(bl)) == []
+
+
+def test_sarif_includes_lifecycle_rule_metadata(tmp_path):
+    """The SARIF driver carries GC030-033 rule entries so code-scanning
+    renders the new family."""
+    from ray_tpu.devtools.graftcheck.sarif import to_sarif
+    from ray_tpu.devtools.graftcheck.local import Finding
+
+    doc = to_sarif([Finding("a.py", 3, 1, "GC032", "swallowed release")])
+    rules = {r["id"]
+             for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert {"GC030", "GC031", "GC032", "GC033"} <= rules
+    assert doc["runs"][0]["results"][0]["ruleId"] == "GC032"
+
+
+class TestLifecycleOwnershipEdges:
+    """Review-hardening regressions: ownership transfer through
+    keyword arguments, and delegation chains that leave the module."""
+
+    def test_kwarg_constructor_takes_ownership(self):
+        src = (
+            "def f(pool, q, n):\n"
+            "    b = pool.alloc(n)\n"
+            "    q.put(_Seq(blocks=b))\n"
+        )
+        assert graftcheck.check_source(src, "k.py",
+                                       rules=LIFECYCLE) == []
+
+    def test_local_helper_releases_kwarg_param(self):
+        src = (
+            "def fin(pool, blocks):\n"
+            "    pool.free(blocks)\n"
+            "def f(pool, n):\n"
+            "    b = pool.alloc(n)\n"
+            "    fin(pool, blocks=b)\n"
+        )
+        assert graftcheck.check_source(src, "k2.py",
+                                       rules=LIFECYCLE) == []
+
+    def test_cross_module_delegation_chain_stays_silent(self, tmp_path):
+        """A cross-module helper that hands the resource to a callee IT
+        cannot resolve is not 'provably non-owning': the pending leak
+        must be dismissed, not confirmed (one-hop-only summaries used
+        to confirm a false GC030 here)."""
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "deep.py").write_text(
+            "def real_free(pool, b):\n    pool.free(b)\n")
+        (pkg / "mid.py").write_text(
+            "from . import deep\n\n"
+            "def delegate_free(pool, b):\n"
+            "    deep.real_free(pool, b)\n")
+        (pkg / "caller.py").write_text(
+            "from .mid import delegate_free\n\n"
+            "def go(pool, n):\n"
+            "    b = pool.alloc(n)\n"
+            "    delegate_free(pool, b)\n")
+        res = check_project([str(pkg)], rules=LIFECYCLE,
+                            cache_path=None, root=str(tmp_path))
+        assert res.findings == [], [f.render() for f in res.findings]
+
+    def test_alternating_refcount_balance_is_clean(self):
+        """alloc;retain;free;retain;free;free is rc 1-2-1-2-1-0 —
+        balanced; the UAF check must not fire while any acquisition
+        bound to the name is still held."""
+        src = (
+            "def f(pool, n):\n"
+            "    b = pool.alloc(n)\n"
+            "    pool.retain(b)\n"
+            "    pool.free(b)\n"
+            "    pool.retain(b)\n"
+            "    pool.free(b)\n"
+            "    pool.free(b)\n"
+        )
+        assert graftcheck.check_source(src, "rc.py",
+                                       rules=LIFECYCLE) == []
+
+    def test_helper_routed_free_respects_refcount(self):
+        """A free routed through a local helper consumes ONE
+        acquisition like a direct free — rc-2 with one helper-free and
+        one direct free is balanced, not a double release."""
+        src = (
+            "def fin(pool, b):\n"
+            "    pool.free(b)\n"
+            "def f(pool, n):\n"
+            "    b = pool.alloc(n)\n"
+            "    pool.retain(b)\n"
+            "    fin(pool, b)\n"
+            "    pool.free(b)\n"
+        )
+        assert graftcheck.check_source(src, "rc2.py",
+                                       rules=LIFECYCLE) == []
+
+    def test_helper_free_plus_direct_free_is_double(self):
+        """Without the retain, the same shape IS a double release."""
+        src = (
+            "def fin(pool, b):\n"
+            "    pool.free(b)\n"
+            "def f(pool, n):\n"
+            "    b = pool.alloc(n)\n"
+            "    fin(pool, b)\n"
+            "    pool.free(b)\n"
+        )
+        hits = graftcheck.check_source(src, "rc3.py", rules=LIFECYCLE)
+        assert [f.rule for f in hits] == ["GC031"]
+
+    def test_elementwise_loop_release_credits_param(self, tmp_path):
+        """`for b in blocks: pool.free(b)` releases the PARAM — both
+        the same-module call site and a cross-module pending must stay
+        silent (the free_all cleanup-helper idiom)."""
+        src = (
+            "def free_all(pool, blocks):\n"
+            "    for b in blocks:\n"
+            "        pool.free(b)\n"
+            "def caller(pool, n):\n"
+            "    bs = pool.alloc(n)\n"
+            "    free_all(pool, bs)\n"
+        )
+        assert graftcheck.check_source(src, "ew.py",
+                                       rules=LIFECYCLE) == []
+        pkg = tmp_path / "p"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "h.py").write_text(
+            "def free_all(pool, blocks):\n"
+            "    for b in blocks:\n"
+            "        pool.free(b)\n")
+        (pkg / "c.py").write_text(
+            "from .h import free_all\n\n"
+            "def go(pool, n):\n"
+            "    bs = pool.alloc(n)\n"
+            "    free_all(pool, bs)\n")
+        res = check_project([str(pkg)], rules=LIFECYCLE,
+                            cache_path=None, root=str(tmp_path))
+        assert res.findings == [], [f.render() for f in res.findings]
+
+
+def test_baseline_new_duplicate_above_reports_the_new_one(tmp_path):
+    """A NEW identical-text finding appearing ABOVE a baselined one
+    must be the one reported: suppression prefers findings on the
+    lines the baseline recorded, so the new line surfaces instead of
+    silently absorbing the old entry's occurrence-0 fingerprint."""
+    from ray_tpu.devtools.graftcheck import baseline
+    from ray_tpu.devtools.graftcheck.local import Finding
+
+    p = tmp_path / "x.py"
+    p.write_text("    pool.free(b)\n" * 5)
+    old = Finding(str(p), 4, 5, "GC031", "double")
+    bl = tmp_path / "bl.json"
+    baseline.write(str(bl), [old])
+    new = Finding(str(p), 2, 5, "GC031", "double")
+    kept = baseline.filter_findings([new, old], str(bl))
+    assert [f.line for f in kept] == [2]

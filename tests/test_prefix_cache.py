@@ -72,12 +72,14 @@ class TestRefcountedPool:
         """The kv_blocks_used surface: N holders of one block still
         count it once — occupancy can't exceed pool capacity."""
         pool = BlockPool(4)
-        a = pool.alloc(4)
+        # rc stress: 5 retains balanced by 6 frees across loop
+        # iterations — beyond static counting, verified by check_leaks
+        a = pool.alloc(4)  # graftcheck: disable=GC030
         for _ in range(5):
-            pool.retain(a)
+            pool.retain(a)  # graftcheck: disable=GC030
         assert pool.used_count == 4 == pool.num_blocks
         for _ in range(6):
-            pool.free(a)
+            pool.free(a)  # graftcheck: disable=GC031
         assert pool.used_count == 0
         pool.check_leaks()
 
