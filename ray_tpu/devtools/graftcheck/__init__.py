@@ -32,6 +32,20 @@ Two layers (docs/GRAFTCHECK.md has the full rule tables):
   graph. The pass runs at parse time; its findings and pending facts
   ride the file cache.
 
+- **Shape-and-spec abstract interpretation** (v4): :mod:`.shapes`
+  defines a fact-set domain (concrete and *symbolic* array shapes,
+  quantized-payload and donated-buffer provenance) that
+  :mod:`.rules_shapes` runs over the same CFG/fixpoint engine, with
+  cross-file resolution of model-config constants, mesh axis sizes,
+  and logical-layout spec tables through the project index. On top:
+  GC040 (mesh-axis divisibility of shard_map inputs), GC041 (sharded
+  contraction dims in matmul/dot_general/einsum), GC042 (Pallas
+  BlockSpec consistency), GC043 (wire-codec encode/decode pairing),
+  GC044 (collective geometry), and a path-sensitive GC022 (donated
+  reads only fire on paths through the donating call). Shape facts
+  ride the file cache; ``--diff REF`` scopes reporting to changed
+  files plus their reverse-dependency closure.
+
 ``check_source`` / ``check_file`` compose both layers for a single
 blob (the whole-program passes then see exactly one module);
 ``check_project`` runs the full engine; ``main`` is the CLI
@@ -49,7 +63,7 @@ from .local import (LOCAL_RULES, RULES, Finding, _FileChecker,
 from .engine import (ProjectIndex, ProjectResult, build_call_graph,
                      check_project, to_dot)
 from .summary import extract
-from . import rules_lifecycle, rules_project, rules_spmd
+from . import rules_lifecycle, rules_project, rules_shapes, rules_spmd
 from .cli import main
 
 __all__ = [
@@ -74,11 +88,14 @@ def check_source(source: str, path: str = "<string>",
     findings.extend(f for f in extra if f.rule in enabled)
     findings.extend(f for f in rules_lifecycle.analyze_module(tree, summary)
                     if f.rule in enabled)
+    findings.extend(f for f in rules_shapes.analyze_module(tree, summary)
+                    if f.rule in enabled)
     index = ProjectIndex([summary])
     graph = build_call_graph(index)
     # GC008 already ran module-locally above; don't double-report
     findings.extend(rules_project.run(index, graph, enabled - {"GC008"}))
     findings.extend(rules_spmd.run(index, enabled))
+    findings.extend(rules_shapes.run(index, enabled))
     findings.extend(rules_lifecycle.resolve_pending(index, enabled))
     findings.sort(key=lambda f: (f.line, f.col, f.rule))
     return findings

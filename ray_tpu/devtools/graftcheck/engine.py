@@ -37,7 +37,7 @@ from .summary import SUMMARY_VERSION, extract, suppressed
 
 # Any change to local-rule or extraction logic must bump one of these:
 # the pair keys every cache entry.
-ENGINE_VERSION = 2  # v2: lifecycle findings + ownership facts in entries
+ENGINE_VERSION = 3  # v3: shape/spec findings + facts in entries
 CACHE_VERSION = f"{ENGINE_VERSION}.{SUMMARY_VERSION}"
 
 SHARD_MAP_FQS = {
@@ -45,6 +45,48 @@ SHARD_MAP_FQS = {
     "jax.shard_map",
     "jax.experimental.shard_map.shard_map",
 }
+
+# the repo's lowering wrappers, under every re-export path a consumer
+# can import them from (fixture trees don't scan the real package, so
+# resolution stops at the import target rather than the defining file)
+LOWER_SHARD_MAP_FQS = {
+    "ray_tpu.parallel.sharding.lower.lower_shard_map",
+    "ray_tpu.parallel.sharding.lower_shard_map",
+    "ray_tpu.parallel.lower_shard_map",
+}
+LOWER_JIT_FQS = {
+    "ray_tpu.parallel.sharding.lower.lower_jit",
+    "ray_tpu.parallel.sharding.lower_jit",
+    "ray_tpu.parallel.lower_jit",
+}
+
+
+def reverse_dependency_closure(index: "ProjectIndex",
+                               paths: Sequence[str]) -> Set[str]:
+    """`paths` plus every indexed file that transitively imports one of
+    them (absolute paths). Drives ``--diff``: a changed file re-lints
+    itself and everything whose cross-file facts could see the change —
+    re-export chains count, since the package ``__init__`` imports the
+    changed module and downstream files import the ``__init__``."""
+    abspaths = {os.path.abspath(p) for p in paths}
+    path_to_mod = {os.path.abspath(s["path"]): s["module"]
+                   for s in index.summaries}
+    rdeps: Dict[str, Set[str]] = {}
+    for s in index.summaries:
+        for fq in s["imports"].values():
+            mod, _rest = index._split_module(fq)
+            if mod is not None and mod != s["module"]:
+                rdeps.setdefault(mod, set()).add(s["module"])
+    seed = {path_to_mod[p] for p in abspaths if p in path_to_mod}
+    closed = set(seed)
+    work = list(seed)
+    while work:
+        m = work.pop()
+        for dep in rdeps.get(m, ()):
+            if dep not in closed:
+                closed.add(dep)
+                work.append(dep)
+    return {p for p, m in path_to_mod.items() if m in closed}
 
 
 def default_cache_path() -> str:
@@ -99,7 +141,9 @@ class ProjectIndex:
             if head in s["functions"] or head in s["classes"] \
                     or head in s["str_consts"] or head in s["tuple_consts"] \
                     or head in s["mesh_vars"] or head in s["module_unser"] \
-                    or head in s["handles"]:
+                    or head in s["handles"] or head in s["int_consts"] \
+                    or head in s["int_tuple_consts"] \
+                    or head in s.get("logical_tables", ()):
                 return fq
             if head in s["imports"]:
                 fq = s["imports"][head] + (("." + tail) if tail else "")
@@ -115,7 +159,9 @@ class ProjectIndex:
             return False
         s = self.modules[mod]
         return rest in s["str_consts"] or rest in s["tuple_consts"] \
-            or rest in s["mesh_vars"] or rest in s["handles"]
+            or rest in s["mesh_vars"] or rest in s["handles"] \
+            or rest in s["int_consts"] or rest in s["int_tuple_consts"] \
+            or rest in s.get("logical_tables", ())
 
     def resolve(self, summary: Dict[str, Any], name: str) -> str:
         """Dotted name as written in `summary`'s module -> canonical
@@ -164,6 +210,34 @@ class ProjectIndex:
         return s["mesh_vars"].get(rest) \
             or ([*s["tuple_consts"][rest]] if rest in s["tuple_consts"]
                 else None)
+
+    def lookup_mesh_sizes(self, summary: Dict[str, Any], name: str
+                          ) -> Optional[List[int]]:
+        """Per-axis device counts of a mesh variable, when its device
+        array shape was statically resolvable at the definition."""
+        fq = self.resolve(summary, name)
+        mod, rest = self._split_module(fq)
+        if mod is None or "." in rest or not rest:
+            return None
+        return self.modules[mod]["mesh_shapes"].get(rest)
+
+    def lookup_int_const(self, summary: Dict[str, Any], name: str
+                         ) -> Optional[int]:
+        fq = self.resolve(summary, name)
+        mod, rest = self._split_module(fq)
+        if mod is None or "." in rest or not rest:
+            return None
+        return self.modules[mod]["int_consts"].get(rest)
+
+    def lookup_logical_table(self, summary: Dict[str, Any], name: str
+                             ) -> Optional[Dict[str, Any]]:
+        """A module-level logical-axis table (``LOGICAL_TO_AXES``-style
+        dict or a ``logical_axes`` method's literal return), cross-file."""
+        fq = self.resolve(summary, name)
+        mod, rest = self._split_module(fq)
+        if mod is None or not rest:
+            return None
+        return self.modules[mod].get("logical_tables", {}).get(rest)
 
     # -- actor concurrency -------------------------------------------------
 
@@ -370,6 +444,7 @@ class ProjectResult:
     index: ProjectIndex
     graph: CallGraph
     lifecycle_stats: Dict[str, int] = field(default_factory=dict)
+    shape_stats: Dict[str, int] = field(default_factory=dict)
 
 
 def _module_name(path: str, root: str) -> str:
@@ -441,7 +516,7 @@ def check_project(paths: Sequence[str],
                   stderr=None) -> ProjectResult:
     """Run the full engine over `paths`: cached per-file rules + fact
     extraction, then the whole-program passes."""
-    from . import rules_lifecycle, rules_project, rules_spmd
+    from . import rules_lifecycle, rules_project, rules_shapes, rules_spmd
 
     stderr = stderr if stderr is not None else sys.stderr
     # None means "all rules"; an explicit empty set means none (the
@@ -490,10 +565,12 @@ def check_project(paths: Sequence[str],
             findings = checker.run()
             summary, extra = extract(path, source, tree, module)
             findings.extend(extra)
-            # the CFG/dataflow lifecycle pass (GC030-033) runs at parse
-            # time too: its confirmed findings and pending/ownership
-            # facts ride the same cache entry
+            # the CFG/dataflow lifecycle pass (GC030-033) and the
+            # shape/spec pass (GC022, GC042-043 + shape facts) run at
+            # parse time too: confirmed findings and pending facts ride
+            # the same cache entry
             findings.extend(rules_lifecycle.analyze_module(tree, summary))
+            findings.extend(rules_shapes.analyze_module(tree, summary))
         new_cache[apath] = {
             "sha": sha, "root": root,
             "local": [f.as_dict() for f in findings],
@@ -508,10 +585,13 @@ def check_project(paths: Sequence[str],
     findings.extend(rules_project.run(index, graph, enabled))
     findings.extend(rules_spmd.run(index, enabled))
     findings.extend(rules_lifecycle.resolve_pending(index, enabled))
+    findings.extend(rules_shapes.run(index, enabled))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     _save_cache(cache_path, cache, new_cache)
     return ProjectResult(findings=findings, errors=errors, files=files,
                          parsed=parsed, cached=cached, index=index,
                          graph=graph,
                          lifecycle_stats=rules_lifecycle.aggregate_stats(
+                             summaries),
+                         shape_stats=rules_shapes.aggregate_stats(
                              summaries))

@@ -7,9 +7,11 @@ hazards a generic linter cannot see because they depend on what
 one file alone (GC001-GC008); the whole-program rules (GC010/GC011,
 the GC020 SPMD series, and the call-graph-resolved GC008 upgrade) live
 in :mod:`.summary` / :mod:`.engine` / :mod:`.rules_project` /
-:mod:`.rules_spmd`, and the CFG-based path-sensitive lifecycle family
+:mod:`.rules_spmd`, the CFG-based path-sensitive lifecycle family
 (GC030-GC033) in :mod:`.cfg` / :mod:`.dataflow` /
-:mod:`.rules_lifecycle`; both run over the project index. The package
+:mod:`.rules_lifecycle`, and the shape-and-spec family (GC040-GC044
+plus the CFG'd GC022) in :mod:`.shapes` / :mod:`.rules_shapes`; all
+run over the project index. The package
 ``__init__`` composes all layers behind the same ``check_source`` /
 ``check_file`` API the single-file linter always had.
 
@@ -111,7 +113,8 @@ RULES: Dict[str, str] = {
     "GC021": "shard_map in_specs arity does not match the wrapped "
              "function's signature",
     "GC022": "buffer donated via donate_argnums is read after the jitted "
-             "call (its memory was reused by XLA)",
+             "call (its memory was reused by XLA); path-sensitive — only "
+             "paths through the donating call fire",
     # CFG-based path-sensitive lifecycle rules (engine-backed; see
     # cfg.py/dataflow.py/rules_lifecycle.py)
     "GC030": "resource leak: an acquired resource (pool alloc/retain, "
@@ -125,6 +128,27 @@ RULES: Dict[str, str] = {
     "GC033": "conditional acquire with unconditional release (or vice "
              "versa): the release runs on paths where the acquire never "
              "did",
+    # shape-and-spec abstract interpretation (v4; see shapes.py /
+    # rules_shapes.py — GC022 also lives there now, on the CFG)
+    "GC040": "mesh-axis divisibility: an in_specs entry shards a dim "
+             "whose statically-known size the bound mesh axis size does "
+             "not divide — GSPMD pads every shard silently",
+    "GC041": "sharded contraction dim: a dot_general/einsum/matmul "
+             "contraction dim of the shard_mapped function carries a "
+             "non-None spec entry (SpecLayout rule: contraction dims "
+             "never shard) — per-shard partial sums without a psum",
+    "GC042": "Pallas kernel consistency: index_map arity vs grid rank, "
+             "index_map return rank vs block_shape rank, kernel params "
+             "vs wired refs, block divisibility and constant/identity "
+             "out-of-bounds index maps, where every number resolves",
+    "GC043": "codec pairing on wire paths: a quantized payload reaching "
+             "a reduce before any dequantize (sums codewords, not "
+             "values), or sent point-to-point in a module with no "
+             "decode on any receive leg",
+    "GC044": "collective geometry: a psum_scatter/all_to_all inside a "
+             "shard_mapped body splits a per-shard dim the mesh axis "
+             "size does not divide, where shapes, specs and mesh all "
+             "resolve statically",
 }
 
 # GC007 targets library code only: user-facing surfaces where print IS

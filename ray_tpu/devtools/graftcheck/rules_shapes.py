@@ -1,0 +1,1079 @@
+"""Shape-and-spec abstract interpretation (graftcheck v4, GC040-044 +
+the path-sensitive GC022).
+
+Rides the v3 CFG/dataflow fixpoint (:mod:`.cfg`, :mod:`.dataflow`) and
+the v2 project index exactly like :mod:`.rules_lifecycle`: the
+module-local half runs at extraction time and its findings/facts ride
+the content-hash cache; the cross-file half is a dict-walk over cached
+facts at project time.
+
+Extraction time (``analyze_module``):
+
+GC022
+    Donated-buffer read, now on the CFG: a name passed at a
+    ``donate_argnums`` position of a jitted call and read on a path
+    *after* the donation. A read only on the untaken branch no longer
+    flags; a read reachable through an except edge now does (exception
+    edges carry the donated state into handlers).
+
+GC042
+    Pallas kernel consistency, structural per call site:
+    ``index_map`` arity vs grid rank, ``index_map`` return rank vs
+    ``block_shape`` rank, kernel parameter count vs wired refs,
+    block divisibility of the out shape, and constant/identity
+    out-of-bounds index maps — each checked only when every number
+    involved resolves statically. Sites using ``grid_spec=`` are
+    skipped (scalar-prefetch grids pass extra index args by design).
+
+GC043
+    Codec pairing on wire paths: a ``quantize``/``quantize_blocks``
+    payload reaching a reduce (``psum``/``psum_scatter``/``jnp.sum``/
+    ...) before any ``dequantize``/``astype`` — reducing packed
+    codewords sums bits, not values. A quantized payload handed to a
+    point-to-point send whose module never decodes anything fires the
+    module-pairing form at the send line. Keyed off
+    :func:`.shapes.classify_codec`, the same single-classifier
+    extension point the GC030 lifecycle vocabulary uses.
+
+Shape facts: array shapes from literal constructors propagate through
+the same fixpoint, and the first statically-visible invocation of each
+``shard_map``/``lower_shard_map``/``lower_jit`` site records its
+argument shapes onto the site (``site["call_shapes"]``) for the
+project pass.
+
+Project time (``run``):
+
+GC040
+    Mesh-axis divisibility: an ``in_specs`` entry shards a dim whose
+    statically-known size the bound mesh axis size does not divide —
+    GSPMD pads every shard silently.
+
+GC041
+    Sharded contraction dim: a ``dot_general``/einsum/matmul
+    contraction dim of the wrapped function carries a non-``None``
+    spec entry — the SpecLayout invariant from
+    ``parallel/sharding/layout.py`` ("contraction dims never shard"),
+    checked at every lowering site with ``spec_for_logical`` tables
+    resolved cross-file.
+
+GC044
+    Collective geometry: a ``psum_scatter``/``all_to_all`` inside the
+    wrapped body splits a per-shard dim the mesh axis size does not
+    divide, where shapes, specs, and mesh all resolve.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from . import dataflow, shapes
+from .cfg import (CFGTooLarge, ENTRY, EXCEPT_DISPATCH, EXCEPT_ENTRY, EXIT,
+                  FOR_BIND, RAISE_EXIT, TEST, WITH_ENTER, WITH_EXIT,
+                  build_cfg, is_generator)
+from .local import Finding, _assigned_names
+from .rules_lifecycle import (_own_scope_stmts, _params_of, _walk_expr,
+                              collect_functions)
+from .summary import _jit_donate_positions, suppressed
+
+__all__ = ["analyze_module", "run", "aggregate_stats"]
+
+
+# ---------------------------------------------------------------------------
+# small AST helpers
+
+
+def _dotted_last(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _expr_nodes(stmt: ast.AST):
+    """Expression nodes of one simple statement, nested scopes pruned."""
+    for child in ast.iter_child_nodes(stmt):
+        if not isinstance(child, (ast.stmt, ast.ExceptHandler)):
+            yield from _walk_expr(child)
+
+
+def _module_fn(tree: ast.Module) -> Optional[ast.AST]:
+    """Module-scope statements wrapped as a synthetic function so the
+    CFG builder can run over driver-level code too."""
+    body = [s for s in tree.body
+            if not isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Import,
+                                  ast.ImportFrom))]
+    if not body:
+        return None
+    tmpl = ast.parse("def _m():\n    pass").body[0]
+    tmpl.name = "<module>"
+    tmpl.body = body
+    ast.copy_location(tmpl, body[0])
+    return tmpl
+
+
+# ---------------------------------------------------------------------------
+# the CFG domain (GC022 + GC043 + shape facts)
+
+
+class _ShapeDomain:
+    """State: dict name -> frozenset of facts (see :mod:`.shapes`)."""
+
+    def __init__(self, analysis: "_FunctionAnalysis"):
+        self.a = analysis
+
+    def initial(self) -> Dict[str, Any]:
+        return {}
+
+    def join(self, x: Dict[str, Any], y: Dict[str, Any]) -> Dict[str, Any]:
+        return shapes.join_env(x, y)
+
+    def assume(self, state: Dict[str, Any], label) -> Dict[str, Any]:
+        return state
+
+    def transfer(self, node, state: Dict[str, Any]) -> Dict[str, Any]:
+        kind = node.kind
+        if kind in (ENTRY, EXIT, RAISE_EXIT, EXCEPT_DISPATCH,
+                    EXCEPT_ENTRY, WITH_EXIT) or node.ast is None:
+            return state
+        a = self.a
+        if kind == FOR_BIND:
+            new = dict(state)
+            for nm in _assigned_names(node.ast.target):
+                new.pop(nm, None)
+            return new
+        if kind == TEST:
+            a.check_exprs(_walk_expr(node.ast), state)
+            return state
+        if kind == WITH_ENTER:
+            item = node.ast
+            a.check_exprs(_walk_expr(item.context_expr), state)
+            if item.optional_vars is not None:
+                new = dict(state)
+                for nm in _assigned_names(item.optional_vars):
+                    new.pop(nm, None)
+                return new
+            return state
+        # STMT
+        stmt = node.ast
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return state
+        nodes = list(_expr_nodes(stmt))
+        if isinstance(stmt, ast.AugAssign) and isinstance(stmt.target,
+                                                          ast.Name):
+            a.check_name(stmt.target.id, stmt.target.lineno, state)
+        a.check_exprs(nodes, state)
+        new = dict(state)
+        # call effects: donation marks, before stores rebind
+        for n in nodes:
+            if isinstance(n, ast.Call):
+                a.call_effects(n, state, new)
+        # stores
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], (ast.Tuple, ast.List)) \
+                and isinstance(stmt.value, ast.Call) \
+                and shapes.classify_codec(stmt.value) == "encode":
+            # `payload, scales = quantize_blocks(x)`: every piece of the
+            # unpacked result carries the encoding until decoded
+            for nm in _assigned_names(stmt.targets[0]):
+                new[nm] = frozenset({("quant", stmt.value.lineno)})
+        elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            facts = a.value_facts(stmt.value, state)
+            nm = stmt.targets[0].id
+            if facts:
+                new[nm] = facts
+            else:
+                new.pop(nm, None)
+        elif isinstance(stmt, ast.AnnAssign) \
+                and isinstance(stmt.target, ast.Name):
+            facts = a.value_facts(stmt.value, state) if stmt.value else \
+                shapes.EMPTY
+            if facts:
+                new[stmt.target.id] = facts
+            else:
+                new.pop(stmt.target.id, None)
+        else:
+            for tgt in getattr(stmt, "targets", []) or \
+                    ([stmt.target] if isinstance(stmt, ast.AugAssign)
+                     else []):
+                for nm in _assigned_names(tgt):
+                    new.pop(nm, None)
+        return new
+
+
+class _FunctionAnalysis:
+    def __init__(self, fndef: ast.AST, qname: str, summary: Dict[str, Any],
+                 env: shapes.ConstEnv, sites_by_line: Dict[int, Dict],
+                 findings: List[Finding], events: Dict[str, Any]):
+        self.fndef = fndef
+        self.qname = qname
+        self.summary = summary
+        self.env = env
+        self.sites_by_line = sites_by_line
+        self.findings = findings
+        self.events = events
+        self.donated: Dict[str, Tuple[int, ...]] = {}
+        self.has_encode = False
+        self.has_site = False
+        self._reported: Set[Tuple] = set()
+
+    # -- reporting ---------------------------------------------------------
+
+    def report(self, rule: str, line: int, col: int, message: str) -> None:
+        key = (rule, line, message[:48])
+        if key in self._reported:
+            return
+        if suppressed(self.summary, line, rule):
+            return
+        self._reported.add(key)
+        self.findings.append(Finding(
+            path=self.summary["path"], line=line, col=col, rule=rule,
+            message=message))
+
+    # -- prescan -----------------------------------------------------------
+
+    def prescan(self) -> bool:
+        """Donated callables + interest check; False when the fixpoint
+        has nothing to track in this function."""
+        own = list(_own_scope_stmts(self.fndef))
+        for st in own:
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                    and isinstance(st.value, ast.Call):
+                pos = _jit_donate_positions(st.value)
+                if pos:
+                    tgt = st.targets[0]
+                    if isinstance(tgt, ast.Name):
+                        self.donated[tgt.id] = pos
+                    elif isinstance(tgt, ast.Attribute):
+                        d = _attr_dotted(tgt)
+                        if d:
+                            self.donated[d] = pos
+            for n in _expr_nodes(st) if not isinstance(
+                    st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)) else ():
+                if isinstance(n, ast.Call):
+                    if shapes.classify_codec(n) == "encode":
+                        self.has_encode = True
+                    if n.lineno in self.sites_by_line:
+                        self.has_site = True
+        # nested defs carrying @partial(jax.jit, donate_argnums=...)
+        for st in _child_defs_of(self.fndef):
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in st.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        pos = _jit_donate_positions(dec)
+                        if pos:
+                            self.donated[st.name] = pos
+        return bool(self.donated or self.has_encode or self.has_site)
+
+    # -- domain callbacks --------------------------------------------------
+
+    def check_name(self, name: str, lineno: int,
+                   state: Dict[str, Any]) -> None:
+        dl = shapes.donated_line(state.get(name, shapes.EMPTY))
+        if dl is not None:
+            self.report(
+                "GC022", lineno, 1,
+                f"'{name}' was donated to the jitted call at line {dl} "
+                f"(donate_argnums) and is read here afterwards; XLA may "
+                f"have reused its buffer — rebind the result to the same "
+                f"name or drop the donation")
+
+    def check_exprs(self, nodes, state: Dict[str, Any]) -> None:
+        for n in nodes:
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                self.check_name(n.id, n.lineno, state)
+
+    def call_effects(self, call: ast.Call, pre: Dict[str, Any],
+                     new: Dict[str, Any]) -> None:
+        # donation: `jitted(x)` / `jax.jit(f, donate_argnums=...)(x)`
+        positions: Optional[Tuple[int, ...]] = None
+        fd = _call_target_dotted(call.func)
+        if fd is not None and fd in self.donated:
+            positions = self.donated[fd]
+        elif isinstance(call.func, ast.Call):
+            positions = _jit_donate_positions(call.func)
+        if positions:
+            for p in positions:
+                if p < len(call.args) and isinstance(call.args[p],
+                                                     ast.Name):
+                    nm = call.args[p].id
+                    new[nm] = frozenset(
+                        {("donated", call.lineno)}
+                        | {f for f in new.get(nm, shapes.EMPTY)
+                           if f[0] != "donated"})
+        cls = shapes.classify_codec(call)
+        if cls == "reduce" and call.args \
+                and isinstance(call.args[0], ast.Name):
+            nm = call.args[0].id
+            ql = shapes.quant_line(pre.get(nm, shapes.EMPTY))
+            if ql is not None:
+                op = _dotted_last(call.func)
+                self.report(
+                    "GC043", call.lineno, call.col_offset + 1,
+                    f"{op}() reduces '{nm}', which still carries the "
+                    f"quantized wire encoding from line {ql}: reducing "
+                    f"packed payloads sums codewords, not values — "
+                    f"dequantize before the reduce")
+        elif cls == "send":
+            for arg in call.args:
+                if isinstance(arg, ast.Name):
+                    ql = shapes.quant_line(pre.get(arg.id, shapes.EMPTY))
+                    if ql is not None:
+                        self.events.setdefault("quant_sends", []).append(
+                            (call.lineno, call.col_offset + 1, arg.id))
+        # shard_map-site invocation: attach argument shapes
+        site = None
+        if isinstance(call.func, ast.Name):
+            ln = shapes.sm_site(pre.get(call.func.id, shapes.EMPTY))
+            if ln is not None:
+                site = self.sites_by_line.get(ln)
+        elif isinstance(call.func, ast.Call) \
+                and call.func.lineno in self.sites_by_line:
+            site = self.sites_by_line.get(call.func.lineno)
+        if site is not None and site.get("call_shapes") is None:
+            shps = []
+            for arg in call.args:
+                if isinstance(arg, ast.Name):
+                    shp = shapes.shape_of(pre.get(arg.id, shapes.EMPTY))
+                elif isinstance(arg, ast.Call):
+                    shp = shapes.shape_from_call(arg, self.env)
+                else:
+                    shp = shapes.eval_shape(arg, self.env) \
+                        if isinstance(arg, (ast.Tuple, ast.List)) else None
+                shps.append(list(shp) if shp is not None else None)
+            if any(s is not None for s in shps):
+                site["call_shapes"] = shps
+                self.events["sites_shaped"] = \
+                    self.events.get("sites_shaped", 0) + 1
+
+    def value_facts(self, value: Optional[ast.AST],
+                    state: Dict[str, Any]) -> Any:
+        if value is None:
+            return shapes.EMPTY
+        if isinstance(value, ast.Name):
+            return state.get(value.id, shapes.EMPTY)
+        if isinstance(value, ast.Call):
+            cls = shapes.classify_codec(value)
+            if cls == "encode":
+                return frozenset({("quant", value.lineno)})
+            if cls == "wire":
+                src = value.args[0] if value.args else None
+                if isinstance(src, ast.Name):
+                    ql = shapes.quant_line(state.get(src.id, shapes.EMPTY))
+                    if ql is not None:
+                        return frozenset({("quant", ql)})
+                return shapes.EMPTY
+            if cls in ("decode", "reduce"):
+                return shapes.EMPTY
+            if value.lineno in self.sites_by_line:
+                if isinstance(value.func, ast.Call):
+                    return shapes.EMPTY   # result of invoking the site
+                # the site call itself (`shard_map(f, ...)`, a lowering
+                # wrapper, or a partial-bound shard_map applied to its
+                # body fn) — the bound name carries the site
+                return frozenset({("sm", value.lineno)})
+            shp = shapes.shape_from_call(value, self.env)
+            if shp is not None:
+                return frozenset({("shape", shp)})
+            return shapes.EMPTY
+        return shapes.EMPTY
+
+    # -- run ---------------------------------------------------------------
+
+    def run(self, stats: Dict[str, int]) -> None:
+        if not self.prescan():
+            stats["fns_trivial"] = stats.get("fns_trivial", 0) + 1
+            return
+        try:
+            graph = build_cfg(self.fndef)
+        except CFGTooLarge:
+            stats["fns_too_large"] = stats.get("fns_too_large", 0) + 1
+            return
+        stats["fns_analyzed"] = stats.get("fns_analyzed", 0) + 1
+        stats["cfg_nodes"] = stats.get("cfg_nodes", 0) + len(graph.nodes)
+        result = dataflow.run(graph, _ShapeDomain(self))
+        stats["fixpoint_iterations"] = \
+            stats.get("fixpoint_iterations", 0) + result.iterations
+        if not result.converged:
+            stats["fns_nonconverged"] = \
+                stats.get("fns_nonconverged", 0) + 1
+
+
+def _attr_dotted(node: ast.Attribute) -> Optional[str]:
+    parts: List[str] = [node.attr]
+    cur = node.value
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _call_target_dotted(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return _attr_dotted(func)
+    return None
+
+
+def _child_defs_of(fndef: ast.AST) -> List[ast.stmt]:
+    out: List[ast.stmt] = []
+    stack: List[ast.stmt] = list(fndef.body)
+    while stack:
+        st = stack.pop(0)
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            out.append(st)
+            continue
+        for fld in ("body", "orelse", "finalbody"):
+            child = getattr(st, fld, None)
+            if isinstance(child, list):
+                stack.extend(c for c in child if isinstance(c, ast.stmt))
+        for handler in getattr(st, "handlers", ()):
+            stack.extend(handler.body)
+        for case in getattr(st, "cases", ()):
+            stack.extend(case.body)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GC042 — Pallas kernel consistency (structural, per call site)
+
+
+def _gc042_sites(fndef: ast.AST) -> List[ast.Call]:
+    out = []
+    for st in _own_scope_stmts(fndef):
+        for n in _expr_nodes(st):
+            if isinstance(n, ast.Call) \
+                    and _dotted_last(n.func) == "pallas_call":
+                out.append(n)
+    return out
+
+
+def _block_spec(call: ast.Call) -> Optional[Dict[str, Any]]:
+    """A ``pl.BlockSpec(block_shape, index_map)`` call -> its parsed
+    pieces; None for non-BlockSpec elements (``pl.ANY``, None, ...)."""
+    if not (isinstance(call, ast.Call)
+            and _dotted_last(call.func) == "BlockSpec"):
+        return None
+    kw = {k.arg: k.value for k in call.keywords if k.arg}
+    block = kw.get("block_shape") or (call.args[0] if call.args else None)
+    imap = kw.get("index_map") or (call.args[1] if len(call.args) > 1
+                                   else None)
+    rec: Dict[str, Any] = {"lineno": call.lineno,
+                           "col": call.col_offset + 1,
+                           "block": None, "arity": None, "ret": None}
+    if isinstance(block, (ast.Tuple, ast.List)):
+        rec["block"] = list(block.elts)
+    if isinstance(imap, ast.Lambda):
+        a = imap.args
+        rec["arity"] = len(a.posonlyargs) + len(a.args)
+        rec["params"] = [p.arg for p in list(a.posonlyargs) + list(a.args)]
+        body = imap.body
+        rec["ret"] = list(body.elts) if isinstance(body, ast.Tuple) \
+            else [body]
+    return rec
+
+
+def _out_shapes(expr: Optional[ast.AST], env: shapes.ConstEnv
+                ) -> Optional[List[Optional[Tuple]]]:
+    """out_shape= -> list of per-output shape tuples (None entries for
+    unresolvable shapes); None when the output count itself is unknown."""
+    if expr is None:
+        return None
+
+    def one(e: ast.AST) -> Optional[Tuple]:
+        if isinstance(e, ast.Call) \
+                and _dotted_last(e.func) == "ShapeDtypeStruct":
+            kw = {k.arg: k.value for k in e.keywords if k.arg}
+            shp = kw.get("shape") or (e.args[0] if e.args else None)
+            return shapes.eval_shape(shp, env)
+        return None
+
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return [one(e) for e in expr.elts]
+    if isinstance(expr, ast.Call) \
+            and _dotted_last(expr.func) == "ShapeDtypeStruct":
+        return [one(expr)]
+    return None
+
+
+def _analyze_pallas_site(call: ast.Call, qname: str,
+                         summary: Dict[str, Any], env: shapes.ConstEnv,
+                         report) -> None:
+    kw = {k.arg: k.value for k in call.keywords if k.arg}
+    if "grid_spec" in kw:
+        return   # PrefetchScalarGridSpec &co pass extra index args
+    # grid rank + dims
+    grid_rank: Optional[int] = None
+    grid_dims: Optional[List[Optional[int]]] = None
+    g = kw.get("grid")
+    if isinstance(g, (ast.Tuple, ast.List)):
+        grid_rank = len(g.elts)
+        grid_dims = [shapes.eval_int(e, env) for e in g.elts]
+    elif g is not None:
+        gs = shapes.eval_shape(g, env)
+        if gs is not None:
+            grid_rank = len(gs)
+            grid_dims = list(gs)
+    # in/out specs
+    in_specs_expr = kw.get("in_specs")
+    in_elts = list(in_specs_expr.elts) \
+        if isinstance(in_specs_expr, (ast.Tuple, ast.List)) else None
+    out_specs_expr = kw.get("out_specs")
+    if isinstance(out_specs_expr, (ast.Tuple, ast.List)):
+        out_elts: Optional[List[ast.AST]] = list(out_specs_expr.elts)
+    elif out_specs_expr is not None:
+        out_elts = [out_specs_expr]
+    else:
+        out_elts = None
+    out_shapes = _out_shapes(kw.get("out_shape"), env)
+
+    def check_spec(rec: Dict[str, Any],
+                   arr_shape: Optional[Tuple]) -> None:
+        if rec["arity"] is not None and grid_rank is not None \
+                and rec["arity"] != grid_rank:
+            report("GC042", rec["lineno"], rec["col"],
+                   f"BlockSpec index_map takes {rec['arity']} "
+                   f"argument(s) but the pallas_call grid has rank "
+                   f"{grid_rank}; each grid axis passes exactly one "
+                   f"block index — the kernel fails at trace time or "
+                   f"reads the wrong blocks")
+        if rec["ret"] is not None and rec["block"] is not None \
+                and len(rec["ret"]) != len(rec["block"]):
+            report("GC042", rec["lineno"], rec["col"],
+                   f"BlockSpec block_shape has rank {len(rec['block'])} "
+                   f"but its index_map returns {len(rec['ret'])} block "
+                   f"ind{'ex' if len(rec['ret']) == 1 else 'ices'}; the "
+                   f"ranks must match")
+        if rec["block"] is None or arr_shape is None:
+            return
+        if len(rec["block"]) != len(arr_shape):
+            report("GC042", rec["lineno"], rec["col"],
+                   f"BlockSpec block_shape has rank {len(rec['block'])} "
+                   f"but the array it buckets has rank {len(arr_shape)}")
+            return
+        for k, (bexpr, dim) in enumerate(zip(rec["block"], arr_shape)):
+            if isinstance(bexpr, ast.Constant) and bexpr.value is None:
+                continue
+            b = shapes.eval_int(bexpr, env)
+            if b is None or not isinstance(dim, int) or b <= 0:
+                continue
+            if dim % b != 0:
+                report("GC042", rec["lineno"], rec["col"],
+                       f"array dim {k} of size {dim} is not divisible "
+                       f"by block_shape[{k}] = {b}: the trailing "
+                       f"partial block reads out of bounds — pad the "
+                       f"array or pick a dividing block")
+                continue
+            ret = rec["ret"][k] if rec["ret"] is not None \
+                and len(rec["ret"]) == len(rec["block"]) else None
+            if isinstance(ret, ast.Constant) \
+                    and isinstance(ret.value, int):
+                if (ret.value + 1) * b > dim:
+                    report("GC042", rec["lineno"], rec["col"],
+                           f"index_map returns constant block index "
+                           f"{ret.value} along dim {k}: blocks of {b} "
+                           f"reach element {(ret.value + 1) * b} but "
+                           f"the array dim is {dim} — out of bounds")
+            elif isinstance(ret, ast.Name) and grid_dims is not None \
+                    and rec.get("params"):
+                try:
+                    p = rec["params"].index(ret.id)
+                except ValueError:
+                    continue
+                gp = grid_dims[p] if p < len(grid_dims) else None
+                if gp is not None and gp * b > dim:
+                    report("GC042", rec["lineno"], rec["col"],
+                           f"grid dim {p} of {gp} blocks times "
+                           f"block_shape[{k}] = {b} covers "
+                           f"{gp * b} elements but the array dim is "
+                           f"{dim} — the last blocks read out of "
+                           f"bounds")
+
+    n_in = len(in_elts) if in_elts is not None else None
+    for elt in in_elts or []:
+        rec = _block_spec(elt)
+        if rec is not None:
+            check_spec(rec, None)
+    if out_elts is not None:
+        for o, elt in enumerate(out_elts):
+            rec = _block_spec(elt)
+            if rec is None:
+                continue
+            arr = out_shapes[o] if out_shapes is not None \
+                and o < len(out_shapes) else None
+            check_spec(rec, arr)
+    # kernel arity vs wired refs
+    n_out = len(out_shapes) if out_shapes is not None else None
+    scratch = kw.get("scratch_shapes")
+    if scratch is None:
+        n_scratch: Optional[int] = 0
+    elif isinstance(scratch, (ast.Tuple, ast.List)):
+        n_scratch = len(scratch.elts)
+    else:
+        n_scratch = None
+    kernel = call.args[0] if call.args else None
+    n_params: Optional[int] = None
+    kname = ""
+    if isinstance(kernel, ast.Lambda):
+        if kernel.args.vararg is None:
+            n_params = len(kernel.args.posonlyargs) + len(kernel.args.args)
+        kname = "<lambda>"
+    elif isinstance(kernel, ast.Name):
+        kname = kernel.id
+        for cand in (f"{qname}.{kname}", kname):
+            fnrec = summary["functions"].get(cand)
+            if fnrec is not None and not fnrec["has_vararg"] \
+                    and not fnrec.get("cls"):
+                n_params = len(fnrec["params"])
+                break
+    if None not in (n_in, n_out, n_scratch, n_params) \
+            and n_in + n_out + n_scratch != n_params:
+        report("GC042", call.lineno, call.col_offset + 1,
+               f"pallas_call wires {n_in + n_out + n_scratch} ref(s) "
+               f"({n_in} in_specs + {n_out} output(s) + {n_scratch} "
+               f"scratch) but kernel {kname}() takes {n_params} "
+               f"parameter(s)")
+
+
+# ---------------------------------------------------------------------------
+# logical-axis table extraction (GC041 cross-file resolution)
+
+
+def _dict_table(node: ast.AST) -> Optional[Dict[str, Any]]:
+    """A literal ``{"name": None | "axis" | ("a", "b") | (...logical)}``
+    dict -> JSON-able table; None when any piece is non-literal."""
+    if not isinstance(node, ast.Dict):
+        return None
+    out: Dict[str, Any] = {}
+    for k, v in zip(node.keys, node.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            return None
+        if isinstance(v, ast.Constant) and (v.value is None
+                                            or isinstance(v.value, str)):
+            out[k.value] = v.value
+        elif isinstance(v, (ast.Tuple, ast.List)):
+            elems = []
+            for e in v.elts:
+                if isinstance(e, ast.Constant) \
+                        and (e.value is None or isinstance(e.value, str)):
+                    elems.append(e.value)
+                else:
+                    return None
+            out[k.value] = elems
+        else:
+            return None
+    return out
+
+
+def _collect_logical_tables(tree: ast.Module,
+                            summary: Dict[str, Any]) -> None:
+    tables: Dict[str, Any] = {}
+    for st in tree.body:
+        if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                and isinstance(st.targets[0], ast.Name):
+            t = _dict_table(st.value)
+            if t is not None:
+                tables[st.targets[0].id] = t
+    for fndef, qname, cls in collect_functions(tree):
+        if fndef.name != "logical_axes":
+            continue
+        for st in _own_scope_stmts(fndef):
+            if isinstance(st, ast.Return) and st.value is not None:
+                t = _dict_table(st.value)
+                if t is not None:
+                    tables[qname] = t
+    if tables:
+        summary["logical_tables"] = tables
+
+
+# ---------------------------------------------------------------------------
+# module entry point (runs at extraction time; results ride the cache)
+
+
+def analyze_module(tree: ast.Module, summary: Dict[str, Any]
+                   ) -> List[Finding]:
+    """GC022/GC042/GC043 plus shape-fact attachment over one module.
+    Mutates `summary`:
+
+    - ``summary["shapes"] = {"stats": {...}}`` (``--stats`` counters)
+    - ``summary["logical_tables"]`` — literal axis tables (GC041)
+    - ``site["call_shapes"]`` on shard_map sites whose invocation
+      shapes resolved
+    - ``summary["functions"][q]["shapes"]`` — contraction records
+    """
+    findings: List[Finding] = []
+    stats: Dict[str, int] = {}
+    events: Dict[str, Any] = {}
+    _collect_logical_tables(tree, summary)
+    sites_by_line = {site["lineno"]: site
+                     for site in summary.get("shardmap", ())}
+    menv = shapes.ConstEnv(summary)
+
+    def report(rule: str, line: int, col: int, message: str) -> None:
+        if suppressed(summary, line, rule):
+            return
+        findings.append(Finding(path=summary["path"], line=line, col=col,
+                                rule=rule, message=message))
+
+    units: List[Tuple[ast.AST, str, Optional[str]]] = \
+        list(collect_functions(tree))
+    mod_fn = _module_fn(tree)
+    if mod_fn is not None:
+        units.append((mod_fn, "<module>", None))
+
+    for fndef, qname, cls in units:
+        stats["fns_total"] = stats.get("fns_total", 0) + 1
+        env = shapes.ConstEnv(summary)
+        env.add_locals(_own_scope_stmts(fndef))
+        # GC042 (structural)
+        psites = _gc042_sites(fndef)
+        if psites:
+            stats["pallas_sites"] = \
+                stats.get("pallas_sites", 0) + len(psites)
+            for call in psites:
+                try:
+                    _analyze_pallas_site(call, qname, summary, env, report)
+                except Exception:
+                    stats["fns_errors"] = stats.get("fns_errors", 0) + 1
+        # GC041 facts: contraction records for project-time resolution
+        if qname != "<module>":
+            try:
+                recs = shapes.contraction_records(
+                    fndef, _params_of(fndef), _own_scope_walk)
+            except Exception:
+                recs = []
+            if recs:
+                stats["contraction_fns"] = \
+                    stats.get("contraction_fns", 0) + 1
+                fnrec = summary["functions"].get(qname)
+                if fnrec is not None:
+                    fnrec["shapes"] = {"contractions": recs}
+        # the CFG pass (GC022 + GC043 + shape facts)
+        if qname != "<module>" and is_generator(fndef):
+            stats["fns_generators_skipped"] = \
+                stats.get("fns_generators_skipped", 0) + 1
+            continue
+        fa = _FunctionAnalysis(fndef, qname, summary, env, sites_by_line,
+                               findings, events)
+        try:
+            fa.run(stats)
+        except Exception:    # never fail the lint on one function
+            stats["fns_errors"] = stats.get("fns_errors", 0) + 1
+
+    # module-level codec pairing: a quantized payload sent point-to-point
+    # with no decode anywhere on this module's receive legs
+    sends = events.get("quant_sends", ())
+    if sends and not _module_has_decode(tree):
+        for line, col, name in sends:
+            if suppressed(summary, line, "GC043"):
+                continue
+            findings.append(Finding(
+                path=summary["path"], line=line, col=col, rule="GC043",
+                message=f"quantized payload '{name}' is sent here but no "
+                        f"matching dequantize appears on any receive leg "
+                        f"in this module — the consumer reads packed "
+                        f"codewords; pair every encode with a decode"))
+    stats["sites_shaped"] = stats.get("sites_shaped", 0) \
+        + events.get("sites_shaped", 0)
+    summary["shapes"] = {"stats": stats}
+    return findings
+
+
+def _own_scope_walk(fndef: ast.AST):
+    for st in _own_scope_stmts(fndef):
+        yield from _expr_nodes(st)
+
+
+def _module_has_decode(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and shapes.classify_codec(node) == "decode":
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# project pass: GC040 / GC041 / GC044 over the index
+
+
+def run(index, enabled: Set[str]) -> List[Finding]:
+    if not ({"GC040", "GC041", "GC044"} & enabled):
+        return []
+    from . import rules_spmd
+
+    out: List[Finding] = []
+    for s in index.summaries:
+        for site in s["shardmap"]:
+            if not rules_spmd._is_real_shard_map(index, s, site):
+                continue
+            target = rules_spmd._resolve_wrapped(index, s, site)
+            recs = site.get("in_specs") or []
+            sizes = _mesh_axis_sizes(index, s, site)
+            if "GC040" in enabled and "GC040" not in site["suppress"]:
+                out.extend(_gc040(index, s, site, recs, sizes))
+            if "GC041" in enabled and "GC041" not in site["suppress"]:
+                out.extend(_gc041(index, s, site, recs, target))
+            if "GC044" in enabled and "GC044" not in site["suppress"]:
+                out.extend(_gc044(index, s, site, recs, sizes, target))
+    return out
+
+
+def _mesh_axis_sizes(index, s, site) -> Optional[Dict[str, int]]:
+    if not site.get("mesh"):
+        return None
+    axes = index.lookup_mesh_axes(s, site["mesh"])
+    sizes = index.lookup_mesh_sizes(s, site["mesh"])
+    if not axes or not sizes or len(axes) != len(sizes):
+        return None
+    return dict(zip(axes, sizes))
+
+
+def _resolved_entries(index, s, rec) -> Optional[List[Optional[List[str]]]]:
+    return shapes.resolve_p_entries(
+        rec, lambda sym: index.lookup_str_const(s, sym))
+
+
+def _gc040(index, s, site, recs, sizes) -> List[Finding]:
+    shapes_list = site.get("call_shapes")
+    if not shapes_list or not sizes:
+        return []
+    out: List[Finding] = []
+    for i, (rec, shp) in enumerate(zip(recs, shapes_list)):
+        if shp is None:
+            continue
+        entries = _resolved_entries(index, s, rec)
+        if entries is None:
+            continue
+        for j, axes in enumerate(entries):
+            if not axes or j >= len(shp):
+                continue
+            dim = shapes.dim_value(
+                shp[j], lambda n: index.lookup_int_const(s, n))
+            if dim is None:
+                continue
+            if not all(a in sizes for a in axes):
+                continue
+            total = 1
+            for a in axes:
+                total *= sizes[a]
+            if total > 0 and dim % total != 0:
+                out.append(Finding(
+                    path=s["path"], line=site["lineno"], col=1,
+                    rule="GC040",
+                    message=f"in_specs[{i}] shards dim {j} (size {dim}) "
+                            f"over mesh ax{'is' if len(axes) == 1 else 'es'}"
+                            f" {'+'.join(axes)} of total size {total}, "
+                            f"which does not divide it — GSPMD silently "
+                            f"pads every shard and collectives see the "
+                            f"padding; make the dim divisible or reshard"))
+    return out
+
+
+def _logical_axis_map(index, s, rec) -> Optional[Dict[str, Any]]:
+    """The LOGICAL_TO_AXES table governing a spec_for_logical record."""
+    fn = rec.get("fn")
+    if fn:
+        fq = index.resolve(s, fn)
+        mod, _rest = index._split_module(fq)
+        if mod is not None:
+            t = index.modules[mod].get("logical_tables", {}) \
+                .get("LOGICAL_TO_AXES")
+            if t is not None:
+                return t
+    for other in index.summaries:
+        t = other.get("logical_tables", {}).get("LOGICAL_TO_AXES")
+        if t is not None:
+            return t
+    return None
+
+
+def _spec_pos_for_param(site, param_idx: int) -> Optional[int]:
+    fnref = site["fn"]
+    if fnref["kind"] == "partial":
+        pos = param_idx - fnref["npos"]
+        return pos if pos >= 0 else None
+    return param_idx
+
+
+def _contraction_axes(index, s, site, recs, rec_pos: int, dim: int,
+                      rank_hint: Optional[int]
+                      ) -> Optional[Tuple[List[str], str]]:
+    """Mesh/logical axes sharding contraction position `dim` of spec
+    `rec_pos`, plus a description of how the spec said so; None when
+    replicated or unresolvable."""
+    if rec_pos >= len(recs):
+        return None
+    rec = recs[rec_pos]
+    kind = rec.get("kind")
+    if kind == "p":
+        entries = rec["entries"]
+        pos = dim
+        if pos < 0:
+            if rank_hint is None:
+                return None
+            pos = rank_hint + pos
+            if pos < 0:
+                return None
+        if pos >= len(entries):
+            return None   # implicit trailing None: replicated
+        resolved = _resolved_entries(index, s, rec)
+        axes = resolved[pos] if resolved else None
+        if axes:
+            return axes, f"P(..., {'+'.join(axes)!s}, ...)"
+        return None
+    if kind in ("logical", "logical_ref"):
+        if kind == "logical":
+            logical_tuple = rec.get("axes")
+        else:
+            table = index.lookup_logical_table(s, rec["table"])
+            logical_tuple = table.get(rec["key"]) if table else None
+        if not isinstance(logical_tuple, (list, tuple)):
+            return None
+        pos = dim if dim >= 0 else len(logical_tuple) + dim
+        if pos < 0 or pos >= len(logical_tuple):
+            return None
+        logical = logical_tuple[pos]
+        amap = _logical_axis_map(index, s, rec)
+        axes = shapes.logical_entry_axes(logical, amap)
+        if axes:
+            return axes, f"logical dim {logical!r}"
+        return None
+    return None
+
+
+def _gc041(index, s, site, recs, target) -> List[Finding]:
+    if target is None or not recs:
+        return []
+    ts, tfn = target
+    contractions = (tfn.get("shapes") or {}).get("contractions", ())
+    if not contractions:
+        return []
+    shapes_list = site.get("call_shapes") or []
+    out: List[Finding] = []
+    for con in contractions:
+        for opnd in con["operands"]:
+            rec_pos = _spec_pos_for_param(site, opnd["param"])
+            if rec_pos is None:
+                continue
+            rank_hint = None
+            if rec_pos < len(shapes_list) \
+                    and shapes_list[rec_pos] is not None:
+                rank_hint = len(shapes_list[rec_pos])
+            for dim in opnd["dims"]:
+                hit = _contraction_axes(index, s, site, recs, rec_pos,
+                                        dim, rank_hint)
+                if hit is None:
+                    continue
+                axes, how = hit
+                out.append(Finding(
+                    path=s["path"], line=site["lineno"], col=1,
+                    rule="GC041",
+                    message=f"in_specs[{rec_pos}] shards the contraction "
+                            f"dim (position {dim}) of {tfn['qname']}()'s "
+                            f"{con['kind']} at {ts['path']}:"
+                            f"{con['lineno']} on {'+'.join(axes)} "
+                            f"({how}): contracting a sharded dim "
+                            f"produces per-shard partial sums — "
+                            f"contraction dims never shard "
+                            f"(SpecLayout rule); replicate the dim or "
+                            f"psum the result"))
+    return out
+
+
+def _gc044(index, s, site, recs, sizes, target) -> List[Finding]:
+    if target is None or not sizes:
+        return []
+    shapes_list = site.get("call_shapes")
+    if not shapes_list:
+        return []
+    ts, tfn = target
+    params = list(tfn["params"])
+    tq = tfn["qname"]
+    # per-shard shapes of the wrapped function's parameters
+    pershard: Dict[str, List[Optional[int]]] = {}
+    for pi, pname in enumerate(params):
+        pos = _spec_pos_for_param(site, pi)
+        if pos is None or pos >= len(shapes_list) \
+                or shapes_list[pos] is None or pos >= len(recs):
+            continue
+        entries = _resolved_entries(index, s, recs[pos])
+        if entries is None:
+            continue
+        dims: List[Optional[int]] = []
+        for j, raw in enumerate(shapes_list[pos]):
+            dim = shapes.dim_value(
+                raw, lambda n: index.lookup_int_const(s, n))
+            if dim is None:
+                dims.append(None)
+                continue
+            axes = entries[j] if j < len(entries) else []
+            if axes is None:
+                dims.append(None)
+                continue
+            total = 1
+            ok = True
+            for a in axes:
+                if a not in sizes:
+                    ok = False
+                    break
+                total *= sizes[a]
+            if not ok or total <= 0 or dim % total != 0:
+                dims.append(None)   # GC040 territory
+            else:
+                dims.append(dim // total)
+        pershard[pname] = dims
+    if not pershard:
+        return []
+    out: List[Finding] = []
+    for coll in ts["collectives"]:
+        if coll["encl"] != tq and not coll["encl"].startswith(tq + "."):
+            continue
+        if coll["op"] not in ("psum_scatter", "all_to_all"):
+            continue
+        if "GC044" in coll["suppress"]:
+            continue
+        name = coll.get("arg0")
+        if name not in pershard:
+            continue
+        ax = coll.get("axis") or {}
+        lits = ax.get("lits") or []
+        if len(lits) != 1 or ax.get("syms") or not ax.get("clean"):
+            continue
+        size = sizes.get(lits[0])
+        if not size:
+            continue
+        if coll["op"] == "all_to_all":
+            k = coll.get("split_axis") or 0
+        else:
+            k = 0
+        dims = pershard[name]
+        if k >= len(dims) or dims[k] is None:
+            continue
+        if dims[k] % size != 0:
+            out.append(Finding(
+                path=ts["path"], line=coll["lineno"], col=coll["col"],
+                rule="GC044",
+                message=f"{coll['op']}() splits dim {k} of '{name}' "
+                        f"(per-shard size {dims[k]}) across axis "
+                        f"'{lits[0]}' of size {size}, which does not "
+                        f"divide it — the scatter misaligns shard "
+                        f"boundaries (lowering error or silent "
+                        f"padding); make the per-shard dim divisible"))
+    return out
+
+
+def aggregate_stats(summaries) -> Dict[str, int]:
+    total: Dict[str, int] = {}
+    for s in summaries:
+        for k, v in (s.get("shapes") or {}).get("stats", {}).items():
+            total[k] = total.get(k, 0) + int(v)
+    return total

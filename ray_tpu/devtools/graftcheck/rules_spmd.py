@@ -23,20 +23,28 @@ GC021
     neither side. Resolves local defs, imported project functions,
     ``functools.partial`` (bound positionals + keywords), and lambdas.
 
-GC022 (evaluated at extraction time, cached with the local findings)
-    A buffer passed at a ``donate_argnums`` position of a jitted call
-    and read afterwards — XLA may have reused its memory.
+Sites are collected from direct ``shard_map(...)`` calls, from the
+repo's ``lower_shard_map(...)``/``lower_jit(...)`` wrappers in
+``parallel/sharding/lower.py`` (specs are keyword-only there), and
+from ``functools.partial(shard_map, ...)`` bindings applied later —
+the summary extractor synthesizes a site from the merged arguments.
+``lower_jit`` sites carry no axis binding, so only GC021 applies.
+
+(GC022, the donated-buffer read, moved onto the CFG in v4 — see
+:mod:`.rules_shapes`.)
 
 Only calls that resolve to the real ``shard_map`` (``jax.shard_map``,
 ``jax.experimental.shard_map.shard_map``, or the repo's
-``ray_tpu.jax_compat.shard_map`` shim) are checked; Pallas
-``in_specs=[pl.BlockSpec...]`` grids never match.
+``ray_tpu.jax_compat.shard_map`` shim) or to the repo's lowering
+wrappers are checked; Pallas ``in_specs=[pl.BlockSpec...]`` grids
+never match.
 """
 from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from .engine import SHARD_MAP_FQS, ProjectIndex
+from .engine import (LOWER_JIT_FQS, LOWER_SHARD_MAP_FQS, SHARD_MAP_FQS,
+                     ProjectIndex)
 from .local import Finding
 
 
@@ -51,7 +59,8 @@ def run(index: ProjectIndex, enabled: Set[str]) -> List[Finding]:
             target = _resolve_wrapped(index, s, site)
             if "GC021" in enabled and "GC021" not in site["suppress"]:
                 out.extend(_gc021(s, site, target))
-            if "GC020" in enabled:
+            if "GC020" in enabled \
+                    and site.get("wrapper") != "lower_jit":
                 out.extend(_gc020(index, s, site, target))
     return out
 
@@ -59,6 +68,11 @@ def run(index: ProjectIndex, enabled: Set[str]) -> List[Finding]:
 def _is_real_shard_map(index: ProjectIndex, summary: Dict[str, Any],
                        site: Dict[str, Any]) -> bool:
     fq = index.resolve(summary, site["callee"])
+    wrapper = site.get("wrapper", "shard_map")
+    if wrapper == "lower_shard_map":
+        return fq in LOWER_SHARD_MAP_FQS
+    if wrapper == "lower_jit":
+        return fq in LOWER_JIT_FQS
     return fq in SHARD_MAP_FQS
 
 

@@ -1,0 +1,455 @@
+"""Shape-and-spec abstract domain for graftcheck v4.
+
+The vocabulary layer under :mod:`.rules_shapes`: abstract values, the
+constant-expression evaluators that resolve them, the codec-call
+classifier GC043 keys off, and the contraction-structure extractors
+GC041 consumes. Everything here is pure — no CFG, no project index —
+so the rules module stays a thin orchestration layer, the same split
+:mod:`.rules_lifecycle` uses over :mod:`.cfg`/:mod:`.dataflow`.
+
+Abstract values are per-name fact sets, each fact a hashable tuple:
+
+``("shape", dims)``
+    The name is an array of statically-known shape; every dim is an
+    ``int`` or ``None``. A *must* fact — joins intersect it away when
+    the branches disagree.
+
+``("sm", lineno)``
+    The name is the callable returned by the ``shard_map``/
+    ``lower_shard_map``/``lower_jit`` site at that line; a later call
+    through it attaches the invocation's argument shapes to the site.
+    Must fact.
+
+``("quant", lineno)``
+    The value still carries the packed quantized wire encoding
+    produced at that line (``quantize``/``quantize_blocks``), and no
+    decode has run on this path. A *may* fact — joins union it, since
+    a reduce over a possibly-still-quantized payload is the bug.
+
+``("donated", lineno)``
+    The buffer was passed at a ``donate_argnums`` position of a jitted
+    call at that line and not rebound since; any read is a
+    use-after-donation (GC022). May fact.
+
+Resolution is deliberately shallow and sound-when-it-fires: literal
+tuples, module int/tuple constants, single-assignment locals, and
+``+ - * // %`` over them. Anything else evaluates to unknown and the
+rules stay silent — the contract every GC0xx rule keeps.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# -- fact-set algebra --------------------------------------------------------
+
+MAY_TAGS = ("quant", "donated")
+
+Facts = frozenset
+EMPTY: Facts = frozenset()
+
+
+def join_facts(a: Facts, b: Facts) -> Facts:
+    """Union of may facts, intersection of must facts."""
+    out = set(a & b)
+    for f in (a | b) - (a & b):
+        if f[0] in MAY_TAGS:
+            out.add(f)
+    return frozenset(out)
+
+
+def join_env(a: Dict[str, Facts], b: Dict[str, Facts]) -> Dict[str, Facts]:
+    out: Dict[str, Facts] = {}
+    for name in set(a) | set(b):
+        f = join_facts(a.get(name, EMPTY), b.get(name, EMPTY))
+        if f:
+            out[name] = f
+    return out
+
+
+def shape_of(facts: Facts) -> Optional[Tuple[Optional[int], ...]]:
+    for f in facts:
+        if f[0] == "shape":
+            return f[1]
+    return None
+
+
+def quant_line(facts: Facts) -> Optional[int]:
+    for f in facts:
+        if f[0] == "quant":
+            return f[1]
+    return None
+
+
+def donated_line(facts: Facts) -> Optional[int]:
+    for f in facts:
+        if f[0] == "donated":
+            return f[1]
+    return None
+
+
+def sm_site(facts: Facts) -> Optional[int]:
+    for f in facts:
+        if f[0] == "sm":
+            return f[1]
+    return None
+
+
+# -- constant evaluation -----------------------------------------------------
+
+
+class ConstEnv:
+    """Int/tuple constants visible to one function: module-level consts
+    from the summary plus single-assignment locals (flow-insensitive —
+    a name assigned twice is dropped)."""
+
+    def __init__(self, summary: Dict[str, Any]):
+        self.ints: Dict[str, int] = dict(summary.get("int_consts", {}))
+        self.tuples: Dict[str, Tuple[Optional[int], ...]] = {
+            k: tuple(v)
+            for k, v in summary.get("int_tuple_consts", {}).items()}
+
+    def add_locals(self, stmts) -> None:
+        seen: Dict[str, int] = {}
+        pending: List[Tuple[str, ast.AST]] = []
+        for st in stmts:
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                    and isinstance(st.targets[0], ast.Name):
+                nm = st.targets[0].id
+                seen[nm] = seen.get(nm, 0) + 1
+                pending.append((nm, st.value))
+        for nm, value in pending:
+            if seen[nm] != 1:
+                self.ints.pop(nm, None)
+                self.tuples.pop(nm, None)
+                continue
+            v = eval_int(value, self)
+            if v is not None:
+                self.ints[nm] = v
+                continue
+            t = eval_shape(value, self)
+            if t is not None:
+                self.tuples[nm] = t
+
+
+def eval_int(expr: Optional[ast.AST], env: ConstEnv) -> Optional[int]:
+    if expr is None:
+        return None
+    if isinstance(expr, ast.Constant):
+        if isinstance(expr.value, bool) or not isinstance(expr.value, int):
+            return None
+        return expr.value
+    if isinstance(expr, ast.Name):
+        return env.ints.get(expr.id)
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.USub):
+        v = eval_int(expr.operand, env)
+        return -v if v is not None else None
+    if isinstance(expr, ast.BinOp):
+        left = eval_int(expr.left, env)
+        right = eval_int(expr.right, env)
+        if left is None or right is None:
+            return None
+        if isinstance(expr.op, ast.Add):
+            return left + right
+        if isinstance(expr.op, ast.Sub):
+            return left - right
+        if isinstance(expr.op, ast.Mult):
+            return left * right
+        if isinstance(expr.op, ast.FloorDiv) and right != 0:
+            return left // right
+        if isinstance(expr.op, ast.Mod) and right != 0:
+            return left % right
+    return None
+
+
+def eval_dim(expr: Optional[ast.AST], env: ConstEnv) -> Any:
+    """One shape dim: an int, a ``("sym", dotted)`` record for a name
+    this module can't resolve (the project pass resolves it through
+    ``lookup_int_const`` — model-config constants live cross-file), or
+    None."""
+    v = eval_int(expr, env)
+    if v is not None:
+        return v
+    if isinstance(expr, ast.Name):
+        return ("sym", expr.id)
+    if isinstance(expr, ast.Attribute):
+        parts: List[str] = [expr.attr]
+        cur = expr.value
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if isinstance(cur, ast.Name):
+            parts.append(cur.id)
+            return ("sym", ".".join(reversed(parts)))
+    return None
+
+
+def dim_value(d: Any, lookup_int) -> Optional[int]:
+    """A recorded shape dim -> concrete int: ints pass through,
+    ``("sym", name)`` records resolve through `lookup_int` (JSON
+    round-trips the tuple to a list); anything else is unknown."""
+    if isinstance(d, bool):
+        return None
+    if isinstance(d, int):
+        return d
+    if isinstance(d, (list, tuple)) and len(d) == 2 and d[0] == "sym":
+        return lookup_int(d[1])
+    return None
+
+
+def eval_shape(expr: Optional[ast.AST], env: ConstEnv
+               ) -> Optional[Tuple[Any, ...]]:
+    """A shape tuple with every dim an int, a ``("sym", name)`` record,
+    or None (unknown dim); None when the expression is not a shape at
+    all."""
+    if expr is None:
+        return None
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return tuple(eval_dim(e, env) for e in expr.elts)
+    if isinstance(expr, ast.Name):
+        return env.tuples.get(expr.id)
+    v = eval_int(expr, env)   # scalar: 1-tuple only when concrete
+    return (v,) if v is not None else None
+
+
+# -- array-producing calls ---------------------------------------------------
+
+_ARRAY_CTORS_SHAPE0 = {"zeros", "ones", "empty", "full"}
+_ARRAY_CTORS_SHAPE1 = {"normal", "uniform", "randint", "bernoulli",
+                       "broadcast_to"}
+
+
+def shape_from_call(call: ast.Call, env: ConstEnv
+                    ) -> Optional[Tuple[Optional[int], ...]]:
+    """``jnp.zeros((4, 8))``-family shapes, ``x.reshape(a, b)``,
+    ``jnp.arange(n)``; None for anything else."""
+    d = _dotted_last(call.func)
+    if d is None:
+        return None
+    shape_expr: Optional[ast.AST] = None
+    kw = {k.arg: k.value for k in call.keywords if k.arg}
+    if d in _ARRAY_CTORS_SHAPE0:
+        shape_expr = kw.get("shape") or (call.args[0] if call.args else None)
+    elif d in _ARRAY_CTORS_SHAPE1:
+        shape_expr = kw.get("shape") or (call.args[1] if len(call.args) > 1
+                                         else None)
+    elif d == "arange":
+        n = eval_int(call.args[0], env) if call.args else None
+        return (n,) if n is not None else None
+    elif d == "reshape" and isinstance(call.func, ast.Attribute):
+        if len(call.args) == 1:
+            return eval_shape(call.args[0], env)
+        return tuple(eval_dim(a, env) for a in call.args) or None
+    if shape_expr is None:
+        return None
+    return eval_shape(shape_expr, env)
+
+
+def _dotted_last(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+# -- codec classification (GC043) --------------------------------------------
+
+ENCODE_OPS = {"quantize", "quantize_blocks"}
+DECODE_OPS = {"dequantize", "dequantize_blocks"}
+# ops that move a payload without interpreting it: quantization survives
+WIRE_OPS = {"all_to_all", "ppermute", "all_gather", "pshuffle", "pcast"}
+# ops that arithmetically combine payloads: quantization must not survive
+REDUCE_OPS = {"psum", "pmean", "pmax", "pmin", "psum_scatter"}
+_NUMPY_REDUCE = {"sum", "mean", "add"}
+# host-plane point-to-point sends: the decode obligation moves to the
+# receive leg, checked module-wide
+SEND_OPS = {"send", "put", "push", "isend"}
+
+
+def classify_codec(call: ast.Call) -> Optional[str]:
+    """-> 'encode' | 'decode' | 'wire' | 'reduce' | 'send' | None.
+    The same single classifier extension point GC030's lifecycle
+    vocabulary uses — new codec families plug in here."""
+    d = _dotted_last(call.func)
+    if d is None:
+        return None
+    if d in ENCODE_OPS:
+        return "encode"
+    if d in DECODE_OPS:
+        return "decode"
+    if d == "astype":
+        # manual-decode idiom: widening back to a float dtype clears
+        # the packed-encoding flag
+        return "decode"
+    if d in WIRE_OPS:
+        return "wire"
+    if d in REDUCE_OPS:
+        return "reduce"
+    if d in _NUMPY_REDUCE and isinstance(call.func, ast.Attribute):
+        base = call.func.value
+        bd = _dotted_last(base) if isinstance(base, (ast.Name, ast.Attribute)) \
+            else None
+        if bd in ("jnp", "np", "numpy", "lax"):
+            return "reduce"
+    if d in SEND_OPS and isinstance(call.func, ast.Attribute):
+        return "send"
+    return None
+
+
+# -- contraction structure (GC041) -------------------------------------------
+
+
+def parse_einsum_subscripts(spec: str) -> Optional[List[List[int]]]:
+    """Per-operand contraction-dim positions of an explicit einsum
+    subscript string; None when it cannot be parsed soundly."""
+    spec = spec.replace(" ", "")
+    if "..." in spec or "->" not in spec:
+        return None
+    lhs, rhs = spec.split("->", 1)
+    operands = lhs.split(",")
+    contracted = {c for op in operands for c in op} - set(rhs)
+    return [[i for i, c in enumerate(op) if c in contracted]
+            for op in operands]
+
+
+def contraction_records(fndef: ast.AST, params: Sequence[str],
+                        walk_expr) -> List[Dict[str, Any]]:
+    """Contractions in the function's own scope whose operands are
+    direct parameters: ``[{"kind", "lineno", "operands":
+    [{"param": idx, "dims": [pos, ...]}, ...]}]``. ``dims`` entries may
+    be negative (counted from the end) for matmul-family ops."""
+    out: List[Dict[str, Any]] = []
+    pidx = {p: i for i, p in enumerate(params)}
+
+    def param_of(node: ast.AST) -> Optional[int]:
+        return pidx.get(node.id) if isinstance(node, ast.Name) else None
+
+    def add(kind: str, lineno: int, ops: List[Tuple[Optional[int],
+                                                    List[int]]]) -> None:
+        operands = [{"param": p, "dims": dims} for p, dims in ops
+                    if p is not None and dims]
+        if operands:
+            out.append({"kind": kind, "lineno": lineno,
+                        "operands": operands})
+
+    for node in walk_expr(fndef):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.MatMult):
+            add("matmul", node.lineno,
+                [(param_of(node.left), [-1]), (param_of(node.right), [-2])])
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted_last(node.func)
+        if d == "einsum" and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            per_op = parse_einsum_subscripts(node.args[0].value)
+            if per_op is None:
+                continue
+            ops = []
+            for k, dims in enumerate(per_op):
+                arg = node.args[1 + k] if 1 + k < len(node.args) else None
+                ops.append((param_of(arg) if arg is not None else None,
+                            dims))
+            add("einsum", node.lineno, ops)
+        elif d in ("matmul", "dot"):
+            if len(node.args) >= 2:
+                add(d, node.lineno, [(param_of(node.args[0]), [-1]),
+                                     (param_of(node.args[1]), [-2])])
+        elif d == "dot_general" and len(node.args) >= 3:
+            dn = node.args[2]
+            parsed = _parse_dimension_numbers(dn)
+            if parsed is not None:
+                (ca, cb) = parsed
+                add("dot_general", node.lineno,
+                    [(param_of(node.args[0]), ca),
+                     (param_of(node.args[1]), cb)])
+    return out
+
+
+def _parse_dimension_numbers(dn: ast.AST
+                             ) -> Optional[Tuple[List[int], List[int]]]:
+    """Literal ``((contract_a, contract_b), (batch_a, batch_b))`` ->
+    (contract_a, contract_b)."""
+    if not isinstance(dn, ast.Tuple) or not dn.elts:
+        return None
+    contract = dn.elts[0]
+    if not isinstance(contract, ast.Tuple) or len(contract.elts) != 2:
+        return None
+
+    def ints(node: ast.AST) -> Optional[List[int]]:
+        if not isinstance(node, (ast.Tuple, ast.List)):
+            return None
+        vals = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)):
+                return None
+            vals.append(e.value)
+        return vals
+
+    ca = ints(contract.elts[0])
+    cb = ints(contract.elts[1])
+    if ca is None or cb is None:
+        return None
+    return ca, cb
+
+
+# -- spec-record resolution (GC040/041/044) ----------------------------------
+
+
+def resolve_p_entries(record: Dict[str, Any], lookup_str
+                      ) -> Optional[List[Optional[List[str]]]]:
+    """A ``{"kind": "p"}`` spec record -> per-dim mesh-axis-name lists
+    (``[]`` = replicated, ``None`` = that dim is unresolvable).
+    `lookup_str` resolves a symbol to a module string constant."""
+    if record.get("kind") != "p":
+        return None
+    out: List[Optional[List[str]]] = []
+    for e in record["entries"]:
+        if e is None:
+            out.append([])
+        elif "lit" in e:
+            out.append([e["lit"]])
+        elif "sym" in e:
+            const = lookup_str(e["sym"])
+            out.append([const] if const is not None else None)
+        elif "tup" in e:
+            axes: Optional[List[str]] = []
+            for sub in e["tup"]:
+                if sub is not None and "lit" in sub:
+                    axes.append(sub["lit"])
+                elif sub is not None and "sym" in sub:
+                    const = lookup_str(sub["sym"])
+                    if const is None:
+                        axes = None
+                        break
+                    axes.append(const)
+                else:
+                    axes = None
+                    break
+            out.append(axes)
+        else:
+            out.append(None)
+    return out
+
+
+def logical_entry_axes(logical: Optional[str],
+                       table: Optional[Dict[str, Any]]
+                       ) -> Optional[List[str]]:
+    """A logical dim name -> the mesh-axis-role list its layout table
+    maps it to (``[]`` = replicated / contraction-safe); None unknown."""
+    if logical is None:
+        return []
+    if table is None or logical not in table:
+        return None
+    axes = table[logical]
+    if axes is None:
+        return []
+    if isinstance(axes, str):
+        return [axes]
+    if isinstance(axes, (list, tuple)) \
+            and all(isinstance(a, str) for a in axes):
+        return list(axes)
+    return None

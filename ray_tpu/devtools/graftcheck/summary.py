@@ -11,20 +11,23 @@ project-level rule passes need without ever touching the AST again:
 - class records: actor-ness, methods, ``self.x = <handle>`` bindings,
 - compiled-graph ``<recv>.<method>.bind(...)`` sites with receiver
   resolution (handle var / list-of-handles loop var / self attribute),
-- SPMD facts: ``shard_map`` call sites (wrapped fn, in_specs arity,
-  axis_names, mesh), collective call sites with their axis argument,
-  module-level mesh/str constants,
+- SPMD facts: ``shard_map`` call sites (wrapped fn, in_specs arity +
+  per-entry PartitionSpec records, axis_names, mesh) — including sites
+  reached through ``lower_jit``/``lower_shard_map`` wrappers and
+  through ``functools.partial(shard_map, ...)`` bindings — collective
+  call sites with their axis argument and operand name, module-level
+  mesh/str/int constants and statically-known mesh axis *sizes*,
 - the file's suppression map, so project findings honor the same
   ``# graftcheck: disable=`` comments as the local rules.
 
 Summaries are cached by content hash (see :mod:`.engine`); the project
-passes (:mod:`.rules_project`, :mod:`.rules_spmd`) run over summaries
-only, which is what makes warm runs cheap.
+passes (:mod:`.rules_project`, :mod:`.rules_spmd`,
+:mod:`.rules_shapes`) run over summaries only, which is what makes
+warm runs cheap.
 
-GC022 (donated-buffer read after a jitted call) is evaluated *here*,
-during extraction: it is purely local, needs statement ordering, and
-computing it alongside the other local rules keeps the warm path
-parse-free (its findings are cached with the local ones).
+GC022 (donated-buffer read after a jitted call) moved to the CFG in
+v4: :mod:`.rules_shapes` evaluates it path-sensitively at extraction
+time, so its findings still ride the cache with the local ones.
 """
 from __future__ import annotations
 
@@ -37,7 +40,11 @@ from .local import (Finding, _assigned_names, _ctor_kind, _dotted,
 
 # Folded into the cache key (engine.CACHE_VERSION): bump when the
 # summary schema or extraction logic changes.
-SUMMARY_VERSION = 3  # v3: lifecycle pending/ownership facts + stats
+SUMMARY_VERSION = 4  # v4: shape/spec facts, wrapper sites, mesh sizes
+
+#: the two sharding/lower.py wrappers that carry a program onto a mesh;
+#: sites through them are recorded alongside plain shard_map sites
+LOWER_WRAPPERS = ("lower_shard_map", "lower_jit")
 
 # collective -> positional index of its axis argument
 COLLECTIVE_AXIS_ARG: Dict[str, int] = {
@@ -113,6 +120,71 @@ def _int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
     return None
 
 
+def _spec_entry(node: ast.AST) -> Any:
+    """One PartitionSpec entry -> JSON-able record: None, {"lit": axis},
+    {"sym": name}, {"tup": [entries]}, or {"unk": True}."""
+    if isinstance(node, ast.Constant) and node.value is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {"lit": node.value}
+    if isinstance(node, ast.Name):
+        return {"sym": node.id}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return {"tup": [_spec_entry(e) for e in node.elts]}
+    return {"unk": True}
+
+
+def _logical_tuple(node: ast.AST) -> Optional[List[Optional[str]]]:
+    """A literal logical-axis tuple ("batch", None, "embed") -> list."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out: List[Optional[str]] = []
+    for e in node.elts:
+        if isinstance(e, ast.Constant) and (e.value is None
+                                            or isinstance(e.value, str)):
+            out.append(e.value)
+        else:
+            return None
+    return out
+
+
+def _spec_record(node: ast.AST) -> Dict[str, Any]:
+    """One in_specs element -> a spec record the shape rules can
+    resolve: a literal ``P(...)``, a ``<layout>.spec_for_logical(...)``
+    call (literal tuple, or a key into a ``logical_axes()`` table that
+    the project pass resolves cross-file), a symbol, or unknown."""
+    if isinstance(node, ast.Call):
+        d = _dotted(node.func)
+        if d is not None and d[-1] in ("P", "PartitionSpec"):
+            return {"kind": "p",
+                    "entries": [_spec_entry(a) for a in node.args]}
+        if d is not None and d[-1] == "spec_for_logical" and node.args:
+            fn = ".".join(d)
+            arg = node.args[0]
+            axes = _logical_tuple(arg)
+            if axes is not None:
+                return {"kind": "logical", "axes": axes, "fn": fn}
+            # <Model>.logical_axes()["name"] / TABLE["name"]
+            if isinstance(arg, ast.Subscript) \
+                    and isinstance(arg.slice, ast.Constant) \
+                    and isinstance(arg.slice.value, str):
+                base = arg.value
+                table = None
+                if isinstance(base, ast.Call):
+                    table = _dotted_str(base.func)
+                elif isinstance(base, (ast.Name, ast.Attribute)):
+                    table = _dotted_str(base)
+                if table:
+                    return {"kind": "logical_ref", "table": table,
+                            "key": arg.slice.value, "fn": fn}
+            return {"kind": "unk"}
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        d = _dotted_str(node)
+        if d:
+            return {"kind": "sym", "name": d}
+    return {"kind": "unk"}
+
+
 def _jit_donate_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
     """``jax.jit(f, donate_argnums=...)`` /
     ``functools.partial(jax.jit, donate_argnums=...)`` -> positions."""
@@ -130,6 +202,22 @@ def _jit_donate_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
         if kw.arg == "donate_argnums":
             return _int_tuple(kw.value)
     return None
+
+
+def _partial_shardmap(value: ast.AST) -> Optional[Dict[str, Any]]:
+    """``partial(shard_map, ...)`` / ``functools.partial(jax.shard_map,
+    mesh=..., in_specs=...)`` -> the bound arguments, so a later
+    ``fn(body)`` call can be synthesized into a shard_map site."""
+    if not isinstance(value, ast.Call):
+        return None
+    func_d = _dotted(value.func)
+    if func_d is None or func_d[-1] != "partial" or not value.args:
+        return None
+    inner = _dotted(value.args[0])
+    if inner is None or inner[-1] != "shard_map":
+        return None
+    return {"callee": inner, "pos": list(value.args[1:]),
+            "kw": list(value.keywords)}
 
 
 def _child_defs(stmts: Sequence[ast.stmt]) -> List[ast.stmt]:
@@ -178,7 +266,10 @@ class _Extractor:
             "module_unser": {},
             "str_consts": {},
             "tuple_consts": {},
+            "int_consts": {},       # module var -> int literal
+            "int_tuple_consts": {},  # module var -> [int, ...]
             "mesh_vars": {},
+            "mesh_shapes": {},      # mesh var -> [axis sizes] when known
             "handles": {},        # module var -> dotted class (as written)
             "handle_lists": {},   # module list-of-handles var -> class
             "functions": {},      # qname -> fn record
@@ -191,6 +282,7 @@ class _Extractor:
         self.extra_findings: List[Finding] = []
         self._bare_get_names: Set[str] = set()
         self._seen_submits: Set[int] = set()   # id(Call) dedup
+        self._devmesh: Dict[str, List[int]] = {}  # device-mesh var shapes
 
     # -- imports ----------------------------------------------------------
 
@@ -284,11 +376,20 @@ class _Extractor:
         if isinstance(value, ast.Constant) and isinstance(value.value, str):
             s["str_consts"][name] = value.value
             return
+        if isinstance(value, ast.Constant) and isinstance(value.value, int) \
+                and not isinstance(value.value, bool):
+            s["int_consts"][name] = value.value
+            return
         if isinstance(value, (ast.Tuple, ast.List)) and value.elts \
                 and all(isinstance(e, ast.Constant)
                         and isinstance(e.value, str) for e in value.elts):
             s["tuple_consts"][name] = [e.value for e in value.elts]
             return
+        if isinstance(value, (ast.Tuple, ast.List)) and value.elts:
+            it = _int_tuple(value)
+            if it is not None:
+                s["int_tuple_consts"][name] = list(it)
+                return
         if isinstance(value, ast.Call):
             cls, max_conc = _handle_class(value)
             if cls:
@@ -297,9 +398,15 @@ class _Extractor:
                     {"cls": cls, "max_concurrency": max_conc,
                      "lineno": value.lineno})
                 return
+            shape = self._device_shape(value)
+            if shape is not None:
+                self._devmesh[name] = shape
             axes = self._mesh_axes(value)
             if axes is not None:
                 s["mesh_vars"][name] = axes
+                sizes = self._mesh_sizes(value, len(axes))
+                if sizes is not None:
+                    s["mesh_shapes"][name] = sizes
                 return
         cls = self._handle_list_class(value)
         if cls:
@@ -326,6 +433,45 @@ class _Extractor:
             t = self.summary["tuple_consts"].get(v["syms"][0])
             if t is not None:
                 return list(t)
+        return None
+
+    def _device_shape(self, node: ast.AST) -> Optional[List[int]]:
+        """Statically-known shape of a device-array expression:
+        ``mesh_utils.create_device_mesh((4, 2))`` (literal or module
+        int-tuple const) or ``<...>.reshape(4, 2)``."""
+        if not isinstance(node, ast.Call):
+            return None
+        d = _dotted(node.func)
+        if d is not None and d[-1] == "create_device_mesh" and node.args:
+            arg = node.args[0]
+            it = _int_tuple(arg)
+            if it is not None:
+                return list(it)
+            if isinstance(arg, ast.Name):
+                t = self.summary["int_tuple_consts"].get(arg.id)
+                if t is not None:
+                    return list(t)
+            return None
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "reshape" and node.args:
+            shape = _int_tuple(node.args[0]) if len(node.args) == 1 \
+                else _int_tuple(ast.Tuple(elts=list(node.args)))
+            return list(shape) if shape is not None else None
+        return None
+
+    def _mesh_sizes(self, call: ast.Call,
+                    n_axes: int) -> Optional[List[int]]:
+        """Per-axis sizes of a ``Mesh(devs, axes)`` construction when
+        the device array's shape is statically known."""
+        d = _dotted(call.func)
+        if d is None or d[-1] != "Mesh" or not call.args:
+            return None
+        dev = call.args[0]
+        shape = self._device_shape(dev)
+        if shape is None and isinstance(dev, ast.Name):
+            shape = self._devmesh.get(dev.id)
+        if shape is not None and len(shape) == n_axes:
+            return shape
         return None
 
     def _handle_list_class(self, value: ast.AST) -> Optional[str]:
@@ -420,27 +566,14 @@ class _Extractor:
     def _scan_scope(self, stmts: Sequence[ast.stmt], fn: Dict[str, Any],
                     scope_handles: Dict[str, str],
                     scope_lists: Dict[str, str]) -> None:
-        donated: Dict[str, Tuple[int, ...]] = {}
-        donated_args: List[Tuple[str, int, int]] = []  # (var, line, end)
-        loads: Dict[str, List[int]] = {}
         stores: Dict[str, List[int]] = {}
         globals_declared: Set[str] = set()
         ctx = {"fn": fn, "handles": scope_handles, "lists": scope_lists,
-               "donated": donated, "donated_args": donated_args,
-               "loads": loads, "stores": stores}
+               "stores": stores, "sm_partials": {}}
 
         def walk_stmt(stmt: ast.stmt) -> None:
             if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.ClassDef)):
-                # nested scopes get their own record; a nested def carrying
-                # @partial(jax.jit, donate_argnums=...) registers as a
-                # donated callable of THIS scope
-                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    for dec in stmt.decorator_list:
-                        if isinstance(dec, ast.Call):
-                            p = _jit_donate_positions(dec)
-                            if p:
-                                donated[stmt.name] = p
                 return
             if isinstance(stmt, ast.Global):
                 globals_declared.update(stmt.names)
@@ -488,24 +621,6 @@ class _Extractor:
         fn["gets"] = [g for g in fn["gets"]
                       if not g.get("maybe") or g.get("matched")]
 
-        # GC022: donated buffers read after the jitted call
-        for var, call_line, call_end in donated_args:
-            later = [ln for ln in loads.get(var, ()) if ln > call_end]
-            if not later:
-                continue
-            first = min(later)
-            if any(call_line <= ln <= first for ln in stores.get(var, ())):
-                continue  # rebound (e.g. params, opt = update(params, opt))
-            if suppressed(self.summary, first, "GC022"):
-                continue
-            self.extra_findings.append(Finding(
-                path=self.path, line=first, col=1, rule="GC022",
-                message=f"'{var}' was donated to the jitted call at line "
-                        f"{call_line} (donate_argnums) and is read here "
-                        f"afterwards; XLA may have reused its buffer — "
-                        f"rebind the result to the same name or drop the "
-                        f"donation"))
-
     def _scan_assign(self, stmt: ast.Assign, ctx: Dict[str, Any]) -> None:
         fn = ctx["fn"]
         value = stmt.value
@@ -523,9 +638,9 @@ class _Extractor:
                     self.summary["actor_options"].append(
                         {"cls": cls, "max_concurrency": max_conc,
                          "lineno": value.lineno})
-                pos = _jit_donate_positions(value)
-                if pos:
-                    ctx["donated"][name] = pos
+                part = _partial_shardmap(value)
+                if part is not None:
+                    ctx["sm_partials"][name] = part
                 if not kind and not cls:
                     callee = _dotted_str(value.func)
                     if callee:
@@ -537,11 +652,6 @@ class _Extractor:
         if len(stmt.targets) == 1 and isinstance(stmt.targets[0],
                                                  ast.Attribute):
             tgt = stmt.targets[0]
-            dotted_tgt = _dotted_str(tgt)
-            if isinstance(value, ast.Call):
-                pos = _jit_donate_positions(value)
-                if pos and dotted_tgt:
-                    ctx["donated"][dotted_tgt] = pos
             # self.<attr> = <handle>: class-level attr handle table
             if isinstance(tgt.value, ast.Name) and tgt.value.id == "self" \
                     and fn["cls"]:
@@ -559,7 +669,7 @@ class _Extractor:
 
     def _scan_expr_tree(self, root: ast.AST, stmt: ast.stmt,
                         ctx: Dict[str, Any]) -> None:
-        loads, stores = ctx["loads"], ctx["stores"]
+        stores = ctx["stores"]
         stack: List[ast.AST] = [root]
         while stack:
             node = stack.pop()
@@ -567,9 +677,7 @@ class _Extractor:
                                  ast.ClassDef, ast.Lambda)):
                 continue
             if isinstance(node, ast.Name):
-                if isinstance(node.ctx, ast.Load):
-                    loads.setdefault(node.id, []).append(node.lineno)
-                else:
+                if not isinstance(node.ctx, ast.Load):
                     stores.setdefault(node.id, []).append(node.lineno)
             elif isinstance(node, ast.Call):
                 self._scan_call(node, stmt, ctx)
@@ -612,22 +720,21 @@ class _Extractor:
 
         if d is not None and d[-1] == "shard_map":
             self._shardmap_site(call, d, fn)
+        elif d is not None and d[-1] in LOWER_WRAPPERS:
+            self._shardmap_site(call, d, fn, wrapper=d[-1])
+        elif d is not None and len(d) == 1 and d[0] in ctx["sm_partials"]:
+            # ``fn = partial(shard_map, body, ...); fn(...)`` — synthesize
+            # a site from the bound arguments merged with the call's own
+            part = ctx["sm_partials"][d[0]]
+            merged = ast.Call(func=call.func,
+                              args=list(part["pos"]) + list(call.args),
+                              keywords=list(part["kw"]) + list(call.keywords))
+            ast.copy_location(merged, call)
+            self._shardmap_site(merged, part["callee"], fn)
 
         if d is not None and d[-1] in COLLECTIVE_AXIS_ARG \
                 and (len(d) == 1 or "lax" in d):
             self._collective_site(call, d, fn)
-
-        if d is not None:
-            positions = ctx["donated"].get(".".join(d))
-            if positions:
-                # the call's own argument loads span through end_lineno
-                # on wrapped calls — they are the donation, not a read
-                end = getattr(call, "end_lineno", None) or call.lineno
-                for p in positions:
-                    if p < len(call.args) and isinstance(call.args[p],
-                                                         ast.Name):
-                        ctx["donated_args"].append(
-                            (call.args[p].id, call.lineno, end))
 
         if d is not None and d[-1] not in ("remote", "bind", "options",
                                            "get"):
@@ -779,12 +886,14 @@ class _Extractor:
         self.summary["bind_sites"].append(site)
 
     def _shardmap_site(self, call: ast.Call, d: Tuple[str, ...],
-                       fn: Dict[str, Any]) -> None:
+                       fn: Dict[str, Any], wrapper: str = "shard_map",
+                       ) -> None:
         site: Dict[str, Any] = {
             "lineno": call.lineno, "callee": ".".join(d),
             "encl": fn["qname"], "fn": {"kind": "other"},
             "in_specs_arity": None, "axis_given": False,
-            "axis": None, "mesh": None,
+            "axis": None, "mesh": None, "wrapper": wrapper,
+            "in_specs": None, "out_specs": None,
             "suppress": self._line_suppressions(call.lineno),
         }
         pos = list(call.args)
@@ -806,13 +915,29 @@ class _Extractor:
                               "npos": len(fn_expr.args) - 1,
                               "kw": [k.arg for k in fn_expr.keywords
                                      if k.arg]}
-        mesh_expr = kw.get("mesh") or (pos[1] if len(pos) > 1 else None)
+        if wrapper == "shard_map":
+            mesh_expr = kw.get("mesh") or (pos[1] if len(pos) > 1 else None)
+            specs = kw.get("in_specs") if "in_specs" in kw \
+                else (pos[2] if len(pos) > 2 else None)
+        else:
+            # lower_shard_map(fn, owner, *, in_specs=..., out_specs=...)
+            # and lower_jit share the slot layout; specs are keyword-only.
+            mesh_expr = pos[1] if len(pos) > 1 else None
+            specs = kw.get("in_specs")
         site["mesh"] = _dotted_str(mesh_expr) if mesh_expr is not None \
             else None
-        specs = kw.get("in_specs") if "in_specs" in kw \
-            else (pos[2] if len(pos) > 2 else None)
         if isinstance(specs, (ast.Tuple, ast.List)):
             site["in_specs_arity"] = len(specs.elts)
+            site["in_specs"] = [_spec_record(e) for e in specs.elts]
+        elif specs is not None:
+            site["in_specs"] = [_spec_record(specs)]
+        out = kw.get("out_specs") if "out_specs" in kw \
+            else (pos[3] if wrapper == "shard_map" and len(pos) > 3
+                  else None)
+        if isinstance(out, (ast.Tuple, ast.List)):
+            site["out_specs"] = [_spec_record(e) for e in out.elts]
+        elif out is not None:
+            site["out_specs"] = [_spec_record(out)]
         ax = kw.get("axis_names")
         if ax is not None:
             site["axis_given"] = True
@@ -829,17 +954,31 @@ class _Extractor:
         for k in call.keywords:
             if k.arg in _AXIS_KWARGS:
                 ax_expr = k.value
+        arg0 = None
+        if call.args and isinstance(call.args[0], ast.Name):
+            arg0 = call.args[0].id
+        split_axis = None
+        for k in call.keywords:
+            if k.arg == "split_axis" and isinstance(k.value, ast.Constant) \
+                    and isinstance(k.value.value, int):
+                split_axis = k.value.value
+        if split_axis is None and op == "all_to_all" and len(call.args) > 2 \
+                and isinstance(call.args[2], ast.Constant) \
+                and isinstance(call.args[2].value, int):
+            split_axis = call.args[2].value
         self.summary["collectives"].append({
             "lineno": call.lineno, "col": call.col_offset + 1,
             "op": op, "dotted": ".".join(d),
             "axis": _axis_value(ax_expr) if ax_expr is not None else None,
-            "encl": fn["qname"],
+            "encl": fn["qname"], "arg0": arg0, "split_axis": split_axis,
             "suppress": self._line_suppressions(call.lineno)})
 
 
 def extract(path: str, source: str, tree: ast.Module,
             module: str) -> Tuple[Dict[str, Any], List[Finding]]:
     """Parse-once fact extraction: returns (summary, findings from
-    extraction-time local rules — currently GC022)."""
+    extraction-time local rules — none today; the CFG passes in
+    :mod:`.rules_lifecycle` and :mod:`.rules_shapes` contribute
+    theirs through ``analyze_module``)."""
     ex = _Extractor(path, source, tree, module)
     return ex.run()

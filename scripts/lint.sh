@@ -1,25 +1,43 @@
 #!/usr/bin/env bash
 # Static gate for the repo: the graftcheck whole-program engine (rules
-# GC001-GC033, see docs/GRAFTCHECK.md — incl. the v3 CFG-based
-# path-sensitive lifecycle pass) plus a bytecode-compile pass.
+# GC001-GC044, see docs/GRAFTCHECK.md — incl. the v3 CFG-based
+# path-sensitive lifecycle pass and the v4 shape-and-spec abstract
+# interpretation) plus a bytecode-compile pass.
 #
 # The engine keeps a content-hash file cache (.graftcheck-cache.json,
 # persisted across CI runs by actions/cache) so repeat runs only
-# re-parse changed files; the CFG/dataflow pass runs at parse time, so
-# warm runs skip it entirely. Two runs execute here: the first is cold
-# on a fresh checkout (or warm when CI restored the cache), the second
-# is always warm. Both are held to a timing budget so the engine's
-# cost stays visible in CI (measured with the CFG pass: cold ~5.6s,
-# warm ~0.7s on the CI box class — within the v2-era budgets, so they
-# stay unraised), and --stats prints the CFG/fixpoint counters so
-# analysis-cost regressions show up in CI logs:
-#   run 1  < GRAFTCHECK_BUDGET_COLD_S  (default 10s)
+# re-parse changed files; the CFG/dataflow passes run at parse time, so
+# warm runs skip them entirely. Two runs execute here: the first is
+# cold on a fresh checkout (or warm when CI restored the cache), the
+# second is always warm. Both are held to a timing budget so the
+# engine's cost stays visible in CI. Re-measured for v4 (shape pass
+# included): cold 8.2s, warm 0.8s on the dev box class — the v4 pass
+# added ~2.5s cold over v3's 5.6s, so the cold budget is raised from
+# the v2-era 10s to 15s to keep headroom on slower CI boxes; warm
+# stays within the 3s budget. --stats prints both passes' fixpoint
+# counters so analysis-cost regressions show up in CI logs:
+#   run 1  < GRAFTCHECK_BUDGET_COLD_S  (default 15s)
 #   run 2  < GRAFTCHECK_BUDGET_WARM_S  (default 3s, cache-served)
-# Usage: scripts/lint.sh [extra graftcheck paths...]
+#
+# Fast lane for local pre-push use:
+#   scripts/lint.sh --diff [REF]      (default REF: origin/main)
+# lints only files changed vs REF plus their reverse-dependency
+# closure — a one-file change checks in well under a second warm.
+# Usage: scripts/lint.sh [--diff [REF]] [extra graftcheck paths...]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 CACHE="${GRAFTCHECK_CACHE:-.graftcheck-cache.json}"
+
+if [[ "${1:-}" == "--diff" ]]; then
+    REF="${2:-origin/main}"
+    echo "== graftcheck --diff ${REF} (fast lane) =="
+    python -m ray_tpu.devtools.graftcheck \
+        --cache "$CACHE" --stats --diff "$REF" \
+        ray_tpu/ examples/ tests/
+    echo "lint OK (diff lane)"
+    exit 0
+fi
 
 echo "== graftcheck (whole-program engine) =="
 python - "$CACHE" "$@" <<'PY'
@@ -32,7 +50,7 @@ from ray_tpu.devtools.graftcheck import main
 cache, extra = sys.argv[1], sys.argv[2:]
 args = ["--cache", cache, "--stats",
         "ray_tpu/", "examples/", "tests/", *extra]
-budget_cold = float(os.environ.get("GRAFTCHECK_BUDGET_COLD_S", "10"))
+budget_cold = float(os.environ.get("GRAFTCHECK_BUDGET_COLD_S", "15"))
 budget_warm = float(os.environ.get("GRAFTCHECK_BUDGET_WARM_S", "3"))
 
 t0 = time.monotonic()

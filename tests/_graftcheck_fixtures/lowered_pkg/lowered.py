@@ -1,0 +1,29 @@
+"""GC020/GC021 through the repo's lowering wrappers (the satellite-2
+regression corpus): ``lower_shard_map(...)`` / ``lower_jit(...)``
+sites with keyword-only specs must resolve exactly like direct
+``shard_map`` calls. The bad site's in_specs arity disagrees with the
+wrapped body; the good sites below it stay clean."""
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.parallel.sharding import lower_jit, lower_shard_map
+
+
+def body2(x, y):
+    return x + y
+
+
+def bad_wrapper_arity(owner):
+    # one spec for a two-argument body, through the wrapper
+    return lower_shard_map(body2, owner, in_specs=(P("dp"),),
+                           out_specs=P("dp"))
+
+
+def good_wrapper(owner):
+    return lower_shard_map(body2, owner,
+                           in_specs=(P("dp"), P("dp")),
+                           out_specs=P("dp"))
+
+
+def good_lower_jit(owner):
+    # lower_jit sites carry no axis binding: GC021 only
+    return lower_jit(body2, owner, in_specs=(P("dp"), P("dp")))

@@ -1,0 +1,7 @@
+"""Mesh for the wrapper/partial shard_map site fixtures."""
+import jax
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+MESH_AXES = ("dp", "tp")
+MESH = Mesh(mesh_utils.create_device_mesh((4, 2)), MESH_AXES)

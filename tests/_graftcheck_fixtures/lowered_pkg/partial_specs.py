@@ -1,0 +1,41 @@
+"""GC020/GC021 through ``functools.partial(shard_map, ...)`` with
+keyword-only bound specs (satellite-2 regression): the summary
+extractor synthesizes a site from the merged arguments when the
+partial is applied. The bad application binds one spec for a
+two-argument body; the good one matches, and its collective axis
+resolves through the partial-bound mesh."""
+import functools
+
+import jax
+
+from jax.sharding import PartitionSpec as P
+
+from .meshdef import MESH
+
+
+def body2(x, y):
+    return x + y
+
+
+def reduce_body(x):
+    return jax.lax.psum(x, "tp")
+
+
+def bad_partial_arity():
+    wrap = functools.partial(jax.shard_map, mesh=MESH,
+                             in_specs=(P("dp"),), out_specs=P("dp"))
+    return wrap(body2)
+
+
+def good_partial():
+    wrap = functools.partial(jax.shard_map, mesh=MESH,
+                             in_specs=(P("dp"), P("dp")),
+                             out_specs=P("dp"))
+    return wrap(body2)
+
+
+def good_partial_collective():
+    wrap = functools.partial(jax.shard_map, mesh=MESH,
+                             in_specs=(P("dp", None),),
+                             out_specs=P("dp", None))
+    return wrap(reduce_body)

@@ -1,0 +1,93 @@
+"""Seeded shape/spec bugs: one positive per v4 rule (GC040, GC041 in
+both the literal-P and the cross-file logical-table forms, GC043 in
+both the reduce-on-quantized and the unpaired-send forms, GC044) plus
+the path-sensitive GC022 except-edge case. Exact lines are pinned by
+tests/test_graftcheck_engine.py."""
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.parallel.sharding.codec import quantize_blocks
+
+from .layoutdef import GPTLayout, spec_for_logical
+from .meshdef import BAD_ROWS, HIDDEN, MESH, SCATTER_ROWS, SEQ
+
+
+def scale(x):
+    return x * 2.0
+
+
+def matmul(x, w):
+    return x @ w
+
+
+def attn_scores(q, k):
+    return jnp.einsum("bhqd,bhkd->bhqk", q, k)
+
+
+def scatter_rows(x):
+    return jax.lax.psum_scatter(x, "tp")
+
+
+def gc040_indivisible_rows():
+    x = jnp.zeros((BAD_ROWS, HIDDEN))
+    f = jax.shard_map(scale, mesh=MESH, in_specs=(P("dp", None),),
+                      out_specs=P("dp", None))
+    return f(x)          # dp=4 does not divide 6 rows
+
+
+def gc041_sharded_contraction():
+    x = jnp.zeros((SEQ, HIDDEN))
+    w = jnp.zeros((HIDDEN, HIDDEN))
+    f = jax.shard_map(matmul, mesh=MESH,
+                      in_specs=(P("dp", None), P("tp", None)),
+                      out_specs=P("dp", None))
+    return f(x, w)       # w's contraction dim carries "tp"
+
+
+def gc041_logical_literal():
+    f = jax.shard_map(
+        attn_scores, mesh=MESH,
+        in_specs=(spec_for_logical(("batch", "heads", None, "heads")),
+                  spec_for_logical(("batch", "heads", None, None))),
+        out_specs=P(None))
+    return f             # einsum's d dim maps to "heads" -> tp
+
+
+def gc041_logical_table():
+    f = jax.shard_map(
+        matmul, mesh=MESH,
+        in_specs=(P(None, None),
+                  spec_for_logical(GPTLayout.logical_axes()["w_bad"])),
+        out_specs=P(None))
+    return f             # "w_bad" shards the contraction dim
+
+
+def gc044_indivisible_scatter():
+    x = jnp.zeros((SCATTER_ROWS, HIDDEN))
+    f = jax.shard_map(scatter_rows, mesh=MESH,
+                      in_specs=(P("dp", None),),
+                      out_specs=P("dp", None))
+    return f(x)          # per-shard 3 rows, tp=2 does not divide
+
+
+def gc043_reduce_quantized(grads):
+    payload, scales = quantize_blocks(grads)
+    total = jax.lax.psum(payload, "dp")
+    return total, scales
+
+
+def gc043_send_unpaired(chan, grads):
+    payload, scales = quantize_blocks(grads)
+    chan.send(payload)
+    return scales
+
+
+def gc022_except_edge(params, batch):
+    update = jax.jit(lambda p, b: p, donate_argnums=(0,))
+    try:
+        new = update(params, batch)
+        new.block_until_ready()
+    except ValueError:
+        return params    # donation already happened on this path
+    return new

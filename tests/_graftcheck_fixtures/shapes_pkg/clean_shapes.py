@@ -1,0 +1,86 @@
+"""Clean counterparts for every seeded bug in bad_shapes.py — the
+same idioms with the numbers/specs right, so the v4 rules' no-false-
+positive side is pinned alongside the positives."""
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.parallel.sharding.codec import (dequantize_blocks,
+                                             quantize_blocks)
+
+from .layoutdef import GPTLayout, spec_for_logical
+from .meshdef import HIDDEN, MESH, SEQ
+
+
+def scale(x):
+    return x * 2.0
+
+
+def matmul(x, w):
+    return x @ w
+
+
+def attn_scores(q, k):
+    return jnp.einsum("bhqd,bhkd->bhqk", q, k)
+
+
+def divisible_rows():
+    x = jnp.zeros((SEQ, HIDDEN))
+    f = jax.shard_map(scale, mesh=MESH, in_specs=(P("dp", None),),
+                      out_specs=P("dp", None))
+    return f(x)          # dp=4 divides 384
+
+
+def replicated_contraction():
+    x = jnp.zeros((SEQ, HIDDEN))
+    w = jnp.zeros((HIDDEN, HIDDEN))
+    f = jax.shard_map(matmul, mesh=MESH,
+                      in_specs=(P("dp", None), P(None, "tp")),
+                      out_specs=P("dp", "tp"))
+    return f(x, w)       # only batch and output dims are sharded
+
+
+def contraction_safe_logical():
+    f = jax.shard_map(
+        attn_scores, mesh=MESH,
+        in_specs=(spec_for_logical(("batch", "heads", None, "embed")),
+                  spec_for_logical(("batch", "heads", None, "embed"))),
+        out_specs=P(None))
+    return f             # "embed" maps to None: replicated
+
+
+def good_logical_table():
+    f = jax.shard_map(
+        matmul, mesh=MESH,
+        in_specs=(P(None, None),
+                  spec_for_logical(GPTLayout.logical_axes()["w_qkv"])),
+        out_specs=P(None))
+    return f             # "w_qkv" keeps its embed (contraction) dim
+
+
+def decode_before_reduce(grads):
+    payload, scales = quantize_blocks(grads)
+    wire_q = jax.lax.all_to_all(payload, "dp", 0, 0)
+    wire_s = jax.lax.all_to_all(scales, "dp", 0, 0)
+    full = dequantize_blocks(wire_q, wire_s)
+    return jax.lax.psum(full, "dp")
+
+
+def send_with_decode(chan, grads):
+    payload, scales = quantize_blocks(grads)
+    chan.send(payload)
+    raw = chan.recv()
+    return dequantize_blocks(raw, scales)
+
+
+def donation_rebound(params, batch):
+    update = jax.jit(lambda p, b: p, donate_argnums=(0,))
+    params = update(params, batch)
+    return params
+
+
+def read_before_donation(params, batch, debug):
+    update = jax.jit(lambda p, b: p, donate_argnums=(0,))
+    if debug:
+        return params    # only reachable BEFORE the donation
+    return update(params, batch)

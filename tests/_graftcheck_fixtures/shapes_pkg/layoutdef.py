@@ -1,0 +1,26 @@
+"""SpecLayout-style logical-axis tables (the GC041 cross-file corpus):
+``spec_for_logical`` consumers in other files resolve through this
+module's ``LOGICAL_TO_AXES`` and the ``logical_axes()`` family table."""
+from jax.sharding import PartitionSpec as P
+
+LOGICAL_TO_AXES = {
+    "batch": ("dp",),
+    "heads": ("tp",),
+    "mlp": ("tp",),
+    "embed": None,      # contraction dims never shard
+}
+
+
+def spec_for_logical(axes):
+    return P(*[LOGICAL_TO_AXES.get(a) for a in axes])
+
+
+class GPTLayout:
+    """Per-param logical tuples, keyed like the models' tables."""
+
+    def logical_axes(self):
+        return {
+            "w_in": (None, "mlp"),
+            "w_qkv": ("embed", "heads"),
+            "w_bad": ("mlp", "batch"),   # last dim (contraction) sharded
+        }

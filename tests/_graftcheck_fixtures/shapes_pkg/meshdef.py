@@ -1,0 +1,14 @@
+"""Mesh + model-config constants for the shape fixtures: the v4 rules
+resolve these cross-file (axis sizes from the device-mesh literal,
+dims from the int constants)."""
+import jax
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+MESH_AXES = ("dp", "tp")
+MESH = Mesh(mesh_utils.create_device_mesh((4, 2)), MESH_AXES)
+
+HIDDEN = 512
+SEQ = 384
+BAD_ROWS = 6          # dp=4 does not divide this
+SCATTER_ROWS = 12     # dp=4 divides; per-shard 3 rows, tp=2 does not

@@ -1,0 +1,69 @@
+"""Seeded GC042 Pallas positives: each bad kernel breaks exactly one
+of the structural consistency checks (index_map arity, index_map
+return rank, the deliberately mis-bucketed BlockSpec divisibility,
+constant/identity out-of-bounds index maps, kernel parameter count).
+Lines are pinned by tests/test_graftcheck_engine.py."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+ROWS = 512
+COLS = 512
+
+
+def copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def bad_index_map_arity(x):
+    return pl.pallas_call(
+        copy_kernel,
+        grid=(4, 4),
+        in_specs=[pl.BlockSpec((128, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((128, 128), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((ROWS, COLS), jnp.float32),
+    )(x)
+
+
+def bad_index_rank(x):
+    return pl.pallas_call(
+        copy_kernel,
+        grid=(4, 4),
+        in_specs=[pl.BlockSpec((128, 128), lambda i, j: (i, j, 0))],
+        out_specs=pl.BlockSpec((128, 128), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((ROWS, COLS), jnp.float32),
+    )(x)
+
+
+def mis_bucketed_block(x):
+    # 512 rows bucketed into blocks of 100: trailing partial block
+    return pl.pallas_call(
+        copy_kernel,
+        grid=(4, 4),
+        in_specs=[pl.BlockSpec((128, 128), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((100, 128), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((ROWS, COLS), jnp.float32),
+    )(x)
+
+
+def grid_overruns_array(x):
+    # 8 blocks of 128 along dim 0 cover 1024 > 512
+    return pl.pallas_call(
+        copy_kernel,
+        grid=(8, 4),
+        in_specs=[pl.BlockSpec((128, 128), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((128, 128), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((ROWS, COLS), jnp.float32),
+    )(x)
+
+
+def kernel_param_mismatch(x, y):
+    # 2 in_specs + 1 output wire 3 refs into a 2-param kernel
+    return pl.pallas_call(
+        copy_kernel,
+        grid=(4, 4),
+        in_specs=[pl.BlockSpec((128, 128), lambda i, j: (i, j)),
+                  pl.BlockSpec((128, 128), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((128, 128), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((ROWS, COLS), jnp.float32),
+    )(x, y)

@@ -1,0 +1,67 @@
+"""Clean Pallas corners for GC042: fully-resolved numbers that line
+up, symbolic blocks in the flash_attention style (value checks must
+skip, rank checks must pass), a scratch-shapes kernel, and a
+constant-0 index map that stays in bounds."""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+ROWS = 512
+COLS = 512
+
+
+def copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def acc_kernel(x_ref, o_ref, acc_ref):
+    acc_ref[...] = acc_ref[...] + x_ref[...]
+    o_ref[...] = acc_ref[...]
+
+
+def well_bucketed(x):
+    return pl.pallas_call(
+        copy_kernel,
+        grid=(4, 4),
+        in_specs=[pl.BlockSpec((128, 128), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((128, 128), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((ROWS, COLS), jnp.float32),
+    )(x)
+
+
+def broadcast_row(x):
+    # constant 0 block index along dim 0: in bounds (1 block of 128)
+    return pl.pallas_call(
+        copy_kernel,
+        grid=(4, 4),
+        in_specs=[pl.BlockSpec((128, 128), lambda i, j: (0, j))],
+        out_specs=pl.BlockSpec((128, 128), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((ROWS, COLS), jnp.float32),
+    )(x)
+
+
+def with_scratch(x):
+    return pl.pallas_call(
+        acc_kernel,
+        grid=(4, 4),
+        in_specs=[pl.BlockSpec((128, 128), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((128, 128), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((ROWS, COLS), jnp.float32),
+        scratch_shapes=[pl.ANY],
+    )(x)
+
+
+def symbolic_blocks(x, block_r, block_c):
+    # flash_attention style: blocks arrive as arguments; every value
+    # check must stay silent, the rank checks still apply
+    rows, cols = x.shape
+    grid = (rows // block_r, cols // block_c)
+    return pl.pallas_call(
+        copy_kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((block_r, block_c), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((block_r, block_c), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((rows, cols), x.dtype),
+    )(x)

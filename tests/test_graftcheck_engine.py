@@ -16,7 +16,9 @@ import pytest
 
 from ray_tpu.devtools import graftcheck
 from ray_tpu.devtools.graftcheck import check_source
-from ray_tpu.devtools.graftcheck.engine import check_project, to_dot
+from ray_tpu.devtools.graftcheck.engine import (check_project,
+                                                reverse_dependency_closure,
+                                                to_dot)
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "_graftcheck_fixtures")
 REPO = os.path.join(os.path.dirname(__file__), "..")
@@ -818,7 +820,8 @@ def tree_result():
         [os.path.join(REPO, "ray_tpu"), os.path.join(REPO, "examples"),
          os.path.join(REPO, "tests")],
         rules={"GC008", "GC010", "GC011", "GC020", "GC021", "GC022",
-               "GC030", "GC031", "GC032", "GC033"},
+               "GC030", "GC031", "GC032", "GC033",
+               "GC040", "GC041", "GC042", "GC043", "GC044"},
         cache_path=None)
     assert res.errors == 0
     return res
@@ -1347,3 +1350,203 @@ def test_baseline_new_duplicate_above_reports_the_new_one(tmp_path):
     new = Finding(str(p), 2, 5, "GC031", "double")
     kept = baseline.filter_findings([new, old], str(bl))
     assert [f.line for f in kept] == [2]
+
+
+# ---------------------------------------------------------------------------
+# v4 — shape-and-spec abstract interpretation (GC040-044, CFG'd GC022)
+
+SHAPES = frozenset({"GC022", "GC040", "GC041", "GC042", "GC043", "GC044"})
+
+
+class TestShapeFixtures:
+    """shapes_pkg seeds exactly one positive per v4 rule form; every
+    clean counterpart lives beside it. Line pins are exact."""
+
+    @pytest.fixture(scope="class")
+    def res(self):
+        return run_pkg("shapes_pkg", rules=SHAPES)
+
+    def _at(self, res, fname, rule):
+        return sorted(f.line for f in res.findings
+                      if f.rule == rule and f.path.endswith(fname))
+
+    def test_clean_files_are_silent(self, res):
+        noisy = [f.render() for f in res.findings
+                 if f.path.endswith(("clean_shapes.py", "pallas_clean.py",
+                                     "meshdef.py", "layoutdef.py"))]
+        assert noisy == []
+
+    def test_gc040_mesh_axis_divisibility(self, res):
+        # dp=4 does not divide the 6 rows imported from meshdef.py —
+        # the shape constant resolves cross-file
+        assert self._at(res, "bad_shapes.py", "GC040") == [34]
+
+    def test_gc041_sharded_contraction_all_three_forms(self, res):
+        # literal P on matmul (42), logical-name literal tuple through
+        # spec_for_logical on einsum (49), cross-file SpecLayout table
+        # entry (58)
+        assert self._at(res, "bad_shapes.py", "GC041") == [42, 49, 58]
+
+    def test_gc042_pallas_block_consistency(self, res):
+        # index-map arity (22), index rank (32), mis-bucketed block
+        # (44), grid overruns array (55), kernel param count (62)
+        assert self._at(res, "pallas_bad.py", "GC042") == \
+            [22, 32, 44, 55, 62]
+
+    def test_gc043_codec_pairing(self, res):
+        # psum on still-quantized payload (76), unpaired send (82) —
+        # both through the (payload, scales) tuple unpack
+        assert self._at(res, "bad_shapes.py", "GC043") == [76, 82]
+
+    def test_gc044_collective_geometry(self, res):
+        # fires at the psum_scatter line inside the target fn: the
+        # per-shard 3 rows are not divisible by tp=2
+        assert self._at(res, "bad_shapes.py", "GC044") == [29]
+
+    def test_gc022_is_path_sensitive(self, res):
+        # only the except-edge read after the donating call fires; the
+        # read-before-donation and rebind forms in clean_shapes.py stay
+        # silent (pre-CFG GC022 flagged any later mention)
+        assert self._at(res, "bad_shapes.py", "GC022") == [92]
+
+    def test_exactly_the_seeded_positives(self, res):
+        assert len(res.findings) == 13 and res.errors == 0
+
+    def test_shape_stats_surface_analysis_cost(self, res):
+        st = res.shape_stats
+        assert st.get("fns_analyzed", 0) > 0
+        assert st.get("pallas_sites", 0) >= 9
+        assert st.get("contraction_fns", 0) >= 4
+        assert st.get("sites_shaped", 0) >= 5
+        assert st.get("fns_nonconverged", 0) == 0
+
+
+class TestLoweredWrapperResolution:
+    """Satellite-2 regressions: GC020/021 must see through the
+    lower_shard_map wrapper and through functools.partial(shard_map)
+    with keyword-only bound specs."""
+
+    @pytest.fixture(scope="class")
+    def res(self):
+        return run_pkg("lowered_pkg", rules={"GC020", "GC021", "GC022"})
+
+    def test_wrapper_call_arity_mismatch(self, res):
+        hits = [(os.path.basename(f.path), f.line) for f in res.findings
+                if f.rule == "GC021"]
+        assert ("lowered.py", 17) in hits
+
+    def test_partial_kwonly_specs_resolve(self, res):
+        hits = [(os.path.basename(f.path), f.line) for f in res.findings
+                if f.rule == "GC021"]
+        assert ("partial_specs.py", 27) in hits
+
+    def test_good_forms_stay_silent(self, res):
+        # good_wrapper/good_lower_jit/good_partial(_collective) add no
+        # noise: exactly the two seeded arity bugs
+        assert len(res.findings) == 2
+
+
+def test_cached_shape_findings_identical_to_cold(tmp_path):
+    """Shape facts and GC040-044 findings ride the content-hash cache:
+    a warm run reproduces the cold findings and stats byte-for-byte
+    without re-running the abstract interpreter."""
+    pkg = os.path.join(FIXTURES, "shapes_pkg")
+    cache = str(tmp_path / "cache.json")
+    cold = check_project([pkg], rules=SHAPES, cache_path=cache,
+                         root=FIXTURES)
+    warm = check_project([pkg], rules=SHAPES, cache_path=cache,
+                         root=FIXTURES)
+    assert warm.parsed == 0 and warm.cached == len(warm.files)
+    assert [f.render() for f in warm.findings] == \
+        [f.render() for f in cold.findings]
+    assert warm.findings
+    assert warm.shape_stats == cold.shape_stats
+
+
+def test_sarif_includes_shape_rule_metadata():
+    """The v4 SARIF driver carries GC040-044 entries and the bumped
+    tool version so code-scanning renders the new family."""
+    from ray_tpu.devtools.graftcheck.sarif import to_sarif
+    from ray_tpu.devtools.graftcheck.local import Finding
+
+    doc = to_sarif([Finding("a.py", 3, 1, "GC040", "indivisible")])
+    driver = doc["runs"][0]["tool"]["driver"]
+    assert driver["version"] == "4.0.0"
+    assert {"GC040", "GC041", "GC042", "GC043", "GC044"} <= \
+        {r["id"] for r in driver["rules"]}
+    assert doc["runs"][0]["results"][0]["ruleId"] == "GC040"
+
+
+def test_baseline_round_trips_shape_findings(tmp_path):
+    """A baselined GC040 finding is suppressed on re-run and
+    resurrects only when its fingerprint changes."""
+    from ray_tpu.devtools.graftcheck import baseline
+
+    res = run_pkg("shapes_pkg", rules={"GC040"})
+    assert [f.rule for f in res.findings] == ["GC040"]
+    bl = str(tmp_path / "bl.json")
+    baseline.write(bl, res.findings)
+    assert baseline.filter_findings(res.findings, bl) == []
+
+
+def test_reverse_dependency_closure_follows_importers():
+    """--diff scoping: a change to meshdef.py must re-lint every file
+    whose cross-file shape facts can see it — but not the pallas
+    fixtures, which never import it."""
+    res = run_pkg("shapes_pkg", rules=SHAPES)
+    mesh = os.path.abspath(
+        os.path.join(FIXTURES, "shapes_pkg", "meshdef.py"))
+    scope = {os.path.basename(p)
+             for p in reverse_dependency_closure(res.index, [mesh])}
+    assert {"meshdef.py", "bad_shapes.py", "clean_shapes.py"} <= scope
+    assert "pallas_bad.py" not in scope and "pallas_clean.py" not in scope
+
+
+def test_diff_mode_scopes_cli_reporting(tmp_path, monkeypatch):
+    """`graftcheck --diff REF` reports only findings inside the changed
+    files' reverse-dependency closure: an unrelated edit passes even
+    though the tree still holds a finding elsewhere."""
+    import subprocess
+
+    def git(*args):
+        subprocess.run(["git", "-C", str(tmp_path), "-c",
+                        "user.email=t@t", "-c", "user.name=t", *args],
+                       check=True, capture_output=True)
+
+    bad_src = ("import ray_tpu\n"
+               "@ray_tpu.remote\n"
+               "def f(r):\n"
+               "    return ray_tpu.get(r)\n")
+    (tmp_path / "bad.py").write_text(bad_src)
+    (tmp_path / "other.py").write_text("Y = 1\n")
+    git("init", "-q")
+    git("add", ".")
+    git("commit", "-qm", "base")
+    monkeypatch.chdir(tmp_path)
+    assert graftcheck.main(["--no-cache", str(tmp_path)]) == 1
+    # edit only other.py: the diff closure excludes bad.py -> clean
+    (tmp_path / "other.py").write_text("Y = 2\n")
+    assert graftcheck.main(["--no-cache", "--diff", "HEAD",
+                            str(tmp_path)]) == 0
+    # touching bad.py itself brings its finding back into scope
+    (tmp_path / "bad.py").write_text(bad_src + "# touched\n")
+    assert graftcheck.main(["--no-cache", "--diff", "HEAD",
+                            str(tmp_path)]) == 1
+
+
+def test_library_tree_is_shape_clean(tree_result):
+    """Full-tree sweep for the v4 family: zero un-annotated GC040-044
+    findings across ray_tpu/ (ops/ pallas kernels, models/, parallel/
+    sharding/, serve/llm/), examples/ and tests/."""
+    assert _tree_findings(
+        tree_result, {"GC040", "GC041", "GC042", "GC043", "GC044"}) == []
+
+
+def test_flash_attention_pallas_sites_visited_and_clean():
+    """GC042's in-repo clean corpus: every pallas_call in ops/ (incl.
+    flash_attention's forward/backward kernels) is visited — not
+    skipped as unparseable — and produces no findings as-is."""
+    res = check_project([os.path.join(REPO, "ray_tpu", "ops")],
+                        rules={"GC042"}, cache_path=None)
+    assert res.findings == []
+    assert res.shape_stats.get("pallas_sites", 0) >= 7
