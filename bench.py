@@ -148,6 +148,7 @@ def main() -> None:
             **_bench_collectives(),
             **_bench_sharding(),
             **_bench_traffic(),
+            **_bench_perf(),
         },
     }))
 
@@ -336,6 +337,28 @@ def _bench_pipeline() -> dict:
         import traceback
 
         traceback.print_exc()  # a broken engine must not look like 0
+        return {}
+
+
+def _bench_perf() -> dict:
+    """Observability rows (ISSUE 17): flight-recorder overhead A/B on
+    the pipeline acceptance config (`profiler_overhead_pct`, bar <= 3%)
+    and the measured-vs-analytic 1F1B bubble fraction from
+    `CompiledPipelineEngine.profile()` (`pipeline_bubble_frac`) —
+    tracked per round in the BENCH json detail and BENCH_TRAJECTORY."""
+    try:
+        import ray_tpu
+        from bench_core import perf_overhead_bench
+
+        ray_tpu.init(num_cpus=max(4, os.cpu_count() or 4))
+        try:
+            return perf_overhead_bench()
+        finally:
+            ray_tpu.shutdown()
+    except Exception:
+        import traceback
+
+        traceback.print_exc()  # a broken profiler must not look like 0
         return {}
 
 

@@ -486,6 +486,92 @@ def pipeline_train_bench() -> dict:
     return out
 
 
+def perf_overhead_bench() -> dict:
+    """Observability rows (ISSUE 17). Assumes an initialized cluster.
+
+    - ``profiler_overhead_pct``: steady-state step-time delta with the
+      flight recorder on (the always-on default) vs off — toggled on the
+      driver AND every stage worker via ``set_flight_recording`` — at
+      the ISSUE 8 acceptance config (2 stages x 8 microbatches,
+      compute-light MLP so event cost is maximally visible). The
+      acceptance bar is <= 3% on a quiet box.
+    - ``pipeline_bubble_frac``: measured bubble fraction from
+      ``CompiledPipelineEngine.profile()``, next to the 1F1B analytic
+      value (P-1)/(M+P-1) for the same config.
+    """
+    import optax
+
+    from ray_tpu.perf import analytic_bubble_frac
+    from ray_tpu.train.pipeline_cgraph import CompiledPipelineEngine
+
+    warmup, timed = (1, 3) if SMOKE else (2, 8)
+    M = 4 if SMOKE else 8
+    fns, params, mbs, tgts = _pipeline_mlp(2, 32, M)
+    out: dict = {}
+    eng = CompiledPipelineEngine(fns, params, optax.sgd(1e-2),
+                                 num_microbatches=M, channel_bytes=1 << 18)
+    try:
+        on_s = _timed_steps(eng, mbs, tgts, warmup, timed)
+        eng.set_flight_recording(False)
+        try:
+            off_s = _timed_steps(eng, mbs, tgts, 1, timed)
+        finally:
+            eng.set_flight_recording(True)
+        out["pipeline_step_ms_recorder_on"] = round(on_s * 1e3, 2)
+        out["pipeline_step_ms_recorder_off"] = round(off_s * 1e3, 2)
+        out["profiler_overhead_pct"] = round((on_s - off_s) / off_s * 100, 2)
+        rep = eng.profile(steps=2 if SMOKE else 4)
+        out["pipeline_bubble_frac"] = round(rep.bubble_frac, 4)
+        out["pipeline_bubble_frac_analytic"] = round(
+            analytic_bubble_frac(2, M), 4)
+        out["profile_step_ms"] = round(rep.mean_step_ms, 2)
+        out["profile_phase_wall_ratio"] = round(rep.phase_wall_ratio(), 3)
+    finally:
+        eng.shutdown()
+
+    # -- llm tokens/s A/B (in-process engine, so set_enabled covers its
+    # whole event surface; driven inline like llm_serve_bench) ----------
+    try:
+        from ray_tpu.perf import set_enabled
+        from ray_tpu.serve.llm import EngineConfig, LLMEngine, build_model
+
+        m, params = build_model("gpt-tiny")
+        conc = 4 if SMOKE else 8
+        leng = LLMEngine(m, params, EngineConfig(
+            max_batch=conc, num_blocks=64, block_size=8,
+            max_blocks_per_seq=8, prefill_buckets=(8, 16),
+            max_prefill_tokens_per_step=64), name="bench-perf")
+        st = leng.add_request([1, 2, 3], max_tokens=2)
+        leng.run_until_idle(timeout=600)   # warmup: compile prefill+decode
+        st.tokens()
+        max_tokens = 8 if SMOKE else 16
+
+        def llm_rate() -> float:
+            prompts = [[1 + (i % 50), 5, 9, 2] for i in range(conc * 2)]
+            t0 = time.perf_counter()
+            streams = [leng.add_request(p, max_tokens=max_tokens)
+                       for p in prompts]
+            leng.run_until_idle(timeout=600)
+            total = sum(len(s.tokens(timeout=60)) for s in streams)
+            return total / (time.perf_counter() - t0)
+
+        on_r = llm_rate()
+        set_enabled(False)
+        try:
+            off_r = llm_rate()
+        finally:
+            set_enabled(True)
+        out["llm_tokens_per_s_recorder_on"] = round(on_r, 1)
+        out["llm_tokens_per_s_recorder_off"] = round(off_r, 1)
+        out["llm_profiler_overhead_pct"] = round(
+            (off_r - on_r) / off_r * 100, 2)
+    except Exception:
+        import traceback
+
+        traceback.print_exc()
+    return out
+
+
 class _CodecRank:
     """One rank of the codec bench's dp=2 host-collective group: runs
     the full ZeRO sync (reduce-scatter + shard update + all-gather)

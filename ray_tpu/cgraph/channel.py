@@ -49,6 +49,7 @@ from typing import Callable, Optional, Tuple
 
 from ..exceptions import (ChannelFullError, CompiledGraphClosedError,
                           GetTimeoutError)
+from ..perf.recorder import get_recorder
 from ..util import metrics as _metrics
 
 FLAG_ERROR = 1
@@ -63,6 +64,10 @@ CODEC_NAMES = {v: k for k, v in CODEC_IDS.items()}
 # fault-injection hook (ray_tpu.chaos): None until chaos.enable()
 # installs an engine; hot paths pay one global is-None test
 _CHAOS = None
+
+# flight recorder (perf/recorder.py): every send/recv stamps its seq
+# into the process ring when enabled; one attribute test when not
+_FLREC = get_recorder()
 
 # segment layout: header then the slot payload area
 _HDR = struct.Struct("<QQQQ")  # write_seq, read_seq, data_len, closed
@@ -198,11 +203,19 @@ class ShmChannel:
                 f"{self.capacity} (raise channel_bytes at compile time)")
         deadline = None if timeout is None else time.monotonic() + timeout
         bo = _Backoff()
+        blocked = False
         while True:
             self._check_alive()
             w, r, _, _ = self._hdr()
             if w - r < self._slots:  # a slot is vacant
                 break
+            if not blocked:
+                # a send stuck on a dead/stalled consumer leaves this
+                # begin dangling — the post-mortem in-flight marker
+                blocked = True
+                if _FLREC.enabled:
+                    _FLREC.record("chan.send.begin",
+                                  self.edge or self._name, {"seq": w})
             if deadline is not None and time.monotonic() > deadline:
                 raise GetTimeoutError(
                     f"channel {self.edge or self._name}: send timed out "
@@ -220,6 +233,12 @@ class ShmChannel:
             self._mv[off + 8:off + 8 + len(data)] = data
         struct.pack_into("<Q", self._mv, 0, w + 1)  # publish
         _count_send(self.edge or self._name, data)
+        if _FLREC.enabled:
+            if blocked:
+                _FLREC.record("chan.send.end", self.edge or self._name,
+                              {"seq": w})
+            _FLREC.record("chan.send", self.edge or self._name,
+                          {"seq": w})
 
     # -- reader ----------------------------------------------------------
 
@@ -249,6 +268,9 @@ class ShmChannel:
             n = struct.unpack_from("<Q", self._mv, off)[0]
             data = bytes(self._mv[off + 8:off + 8 + n])
         struct.pack_into("<Q", self._mv, 8, r + 1)  # release the slot
+        if _FLREC.enabled:
+            _FLREC.record("chan.recv", self.edge or self._name,
+                          {"seq": r})
         return data
 
     def close(self) -> None:
@@ -320,6 +342,9 @@ class QueueChannel:
             waited = time.perf_counter() - t0
             if waited > 1e-5:
                 _H_EDGE_WAIT.observe(waited, tags={"edge": self.edge})
+            if _FLREC.enabled:
+                _FLREC.record("chan.recv", self.edge or self.cid,
+                              {"seq": seq})
             return data
 
     def close(self) -> None:
@@ -347,6 +372,9 @@ class RpcSender:
         self._seq += 1
         self._send_fn(self.cid, seq, data)
         _count_send(self.edge or self.cid, data)
+        if _FLREC.enabled:
+            _FLREC.record("chan.send", self.edge or self.cid,
+                          {"seq": seq})
 
     def close(self) -> None:
         pass

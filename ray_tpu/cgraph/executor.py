@@ -23,6 +23,8 @@ from typing import Any, Dict, List, Optional
 
 from ..core import serialization
 from ..exceptions import CompiledGraphClosedError, TaskError
+from ..perf import oplog as _oplog
+from ..perf.recorder import get_recorder
 from ..util import metrics as _metrics
 from ..util.logs import get_logger
 from .channel import (FLAG_ERROR, QueueChannel, RpcSender, ShmChannel,
@@ -45,10 +47,14 @@ _H_BUBBLE_WAIT = _metrics.Histogram(
 
 _log = get_logger("ray_tpu.cgraph")
 
+# flight recorder (perf/recorder.py): one enabled-flag test per record,
+# chaos-style module handle so the A/B off leg costs one attribute load
+_FLREC = get_recorder()
+
 
 class _NodePlan:
     __slots__ = ("key", "method", "fn", "num_returns", "concurrency_group",
-                 "args", "kwargs", "outs", "codec")
+                 "args", "kwargs", "outs", "codec", "n_chan_args")
 
 
 class _GraphRun:
@@ -211,6 +217,9 @@ class CGraphExecutor:
             np.args = [self._load_argspec(a) for a in nspec["args"]]
             np.kwargs = {k: self._load_argspec(a)
                          for k, a in nspec["kwargs"].items()}
+            np.n_chan_args = sum(
+                1 for a in list(np.args) + list(np.kwargs.values())
+                if a[0] == "chan")
             np.outs = [self._make_writer(w, run) for w in nspec["outs"]]
             # wire codec negotiated at compile time for this node's
             # output envelopes (cgraph/codec.py); readers are stateless
@@ -239,11 +248,25 @@ class CGraphExecutor:
             # error instead of wedging on a silent half-dead pipeline
             _log.error("compiled-graph loop died:\n%s",
                        traceback.format_exc())
+            _FLREC.record("cgraph.loop.death",
+                          run.stage_tag or run.graph_id.hex()[:8],
+                          {"error": traceback.format_exc(limit=3)})
             for ch in list(run.readers.values()) + run.writers:
                 try:
                     ch.mark_closed()
                 except Exception:
                     pass
+            # worker-side half of the post-mortem: the driver's merged
+            # bundle RPC can only reach us while we're alive, so dump
+            # this process's ring locally too (throttled)
+            try:
+                from ..perf.postmortem import dump_bundle
+
+                dump_bundle("cgraph loop death",
+                            origin=f"worker:{run.stage_tag or 'dag'}",
+                            meta={"graph_id": run.graph_id.hex()})
+            except Exception:
+                pass
 
     def _iteration(self, run: _GraphRun) -> None:
         local: Dict[str, tuple] = {}  # node key -> ("val", v)|("err", bytes)
@@ -261,6 +284,7 @@ class CGraphExecutor:
         # applying an update over a broken accumulation.
         iter_err: Optional[bytes] = None
         last = run.nodes[-1] if run.nodes else None
+        tag = run.stage_tag or run.graph_id.hex()[:8]
         for np in run.nodes:
             err_bytes = None
             parent_trace = ""
@@ -302,15 +326,26 @@ class CGraphExecutor:
                     return None
                 return val
 
+            # recv begin/end bracket the whole arg-resolve phase: a stage
+            # blocked on a dead/stalled peer leaves a dangling begin the
+            # post-mortem renderer flags as in-flight at death
+            rec_on = _FLREC.enabled and np.n_chan_args
+            if rec_on:
+                _FLREC.record("cgraph.recv.begin", f"{tag}:{np.key}")
             for spec in np.args:
                 args.append(resolve(spec))
             for k, spec in np.kwargs.items():
                 kwargs[k] = resolve(spec)
+            if rec_on:
+                _FLREC.record("cgraph.recv.end", f"{tag}:{np.key}",
+                              {"waited_ms": round(t_waited * 1e3, 3)}
+                              if t_waited > 1e-4 else None)
             if run.iterative and n_chan:
                 # ops with no channel inputs (update, tied_grad) would
                 # pad the bubble histogram with guaranteed-zero samples
                 _H_BUBBLE_WAIT.observe(t_waited,
                                        tags={"stage": run.stage_tag})
+                _oplog.bubble_record(run.stage_tag, t_waited)
             if run.stop.is_set():
                 raise CompiledGraphClosedError("graph stopping")
 
@@ -319,12 +354,24 @@ class CGraphExecutor:
                 err_bytes = iter_err  # poison the report, skip the update
             trace_out = ""
             if err_bytes is None:
+                if _FLREC.enabled:
+                    _FLREC.record("cgraph.op.begin", f"{tag}:{np.key}",
+                                  {"method": np.method})
+                t_wall0 = time.time()
                 t_exec0 = time.perf_counter()
                 value, err_bytes, trace_out = self._exec_node(
                     np, args, kwargs, parent_trace)
+                dt = time.perf_counter() - t_exec0
+                if _FLREC.enabled:
+                    _FLREC.record("cgraph.op.end", f"{tag}:{np.key}",
+                                  {"error": True} if err_bytes else None)
                 if run.iterative:
-                    _H_STAGE_EXEC.observe(time.perf_counter() - t_exec0,
+                    _H_STAGE_EXEC.observe(dt,
                                           tags={"stage": run.stage_tag})
+                    _oplog.op_record(run.stage_tag, np.key, np.method,
+                                     t_wall0, t_wall0 + dt)
+            t_send0 = time.perf_counter() \
+                if run.iterative and np.outs else 0.0
             if err_bytes is not None:
                 if run.iterative:
                     iter_err = iter_err or err_bytes
@@ -347,6 +394,11 @@ class CGraphExecutor:
                 env = pack_envelope(cbits, trace_out, body)
             for w in np.outs:
                 w.send(env)
+            if t_send0:
+                # encode + channel writes, backpressure block included —
+                # the step profiler's third measured phase
+                _oplog.send_record(run.stage_tag,
+                                   time.perf_counter() - t_send0)
 
     def _exec_node(self, np: _NodePlan, args, kwargs, parent_trace: str):
         """-> (value, error_bytes, downstream_trace)."""

@@ -386,6 +386,115 @@ def _cmd_serve(args) -> int:
         return 1
 
 
+def _render_top(snap: dict, prev, interval: float) -> str:
+    """One refresh of the `ray_tpu top` table from a perf_snapshot."""
+    lines = []
+    t = time.strftime("%H:%M:%S", time.localtime(snap.get("time", 0)))
+    nodes = snap.get("nodes", [])
+    alive = sum(1 for n in nodes if n.get("alive"))
+    lines.append(f"ray_tpu top — {t} — {alive}/{len(nodes)} node(s) alive")
+    for n in nodes:
+        res = " ".join(f"{k}={v:g}" for k, v in
+                       sorted((n.get("resources") or {}).items()))
+        lines.append(f"  {n.get('node_id', '')[:12]:12s}  "
+                     f"{'ALIVE' if n.get('alive') else 'DEAD ':5s}  {res}")
+    scalars = snap.get("scalars") or {}
+    ok = scalars.get("ray_tpu_serve_slo_ok_total", {})
+    bad = scalars.get("ray_tpu_serve_slo_violated_total", {})
+    if ok or bad:
+        lines.append("")
+        lines.append("deployment SLO (ray_tpu_serve_slo_*_total):")
+        for tag in sorted(set(ok) | set(bad)):
+            o, v = ok.get(tag, 0.0), bad.get(tag, 0.0)
+            pct = 100.0 * o / (o + v) if o + v else 100.0
+            name = tag.split("=", 1)[1] if "=" in tag else (tag or "-")
+            lines.append(f"  {name:28s} ok={o:<10.0f} violated={v:<8.0f} "
+                         f"({pct:.1f}% within SLO)")
+    lines.append("")
+    lines.append(f"  {'series':44s} {'tags':26s} {'value':>12s} "
+                 f"{'rate/s':>9s}")
+    prev_scalars = (prev or {}).get("scalars") or {}
+    for fam in sorted(scalars):
+        for tag, val in sorted(scalars[fam].items()):
+            rate = ""
+            pv = prev_scalars.get(fam, {}).get(tag)
+            if pv is not None and interval > 0 and val >= pv:
+                rate = f"{(val - pv) / interval:.1f}"
+            lines.append(f"  {fam:44s} {tag or '-':26s} {val:>12g} "
+                         f"{rate:>9s}")
+    hist = snap.get("histograms") or {}
+    if hist:
+        def ms(x):
+            return "-" if x is None else f"{x * 1e3:.2f}"
+
+        lines.append("")
+        lines.append(f"  {'histogram':44s} {'count':>8s} {'mean_ms':>9s} "
+                     f"{'p50_ms':>9s} {'p95_ms':>9s} {'p99_ms':>9s}")
+        for name, s in sorted(hist.items()):
+            lines.append(f"  {name:44s} {s.get('count', 0):>8d} "
+                         f"{ms(s.get('mean')):>9s} {ms(s.get('p50')):>9s} "
+                         f"{ms(s.get('p95')):>9s} {ms(s.get('p99')):>9s}")
+    return "\n".join(lines)
+
+
+def _cmd_top(args) -> int:
+    """`ray_tpu top [--interval S] [--once]` — refreshing cluster table:
+    nodes, every ray_tpu_* scalar with its rate, latency summaries, and
+    per-deployment SLO counters. ONE head RPC per refresh."""
+    if args.address:
+        ch = _head_channel(args)
+        fetch = lambda: ch.call("perf_snapshot", {}, timeout=30)  # noqa: E731
+        closer = ch.close
+    else:
+        from .core import runtime as runtime_mod
+
+        rt = runtime_mod.maybe_runtime()
+        if rt is None:
+            return _no_runtime_help()
+        from .perf.snapshot import head_snapshot
+
+        fetch = lambda: head_snapshot(rt)  # noqa: E731
+        closer = lambda: None  # noqa: E731
+    prev = None
+    try:
+        while True:
+            snap = fetch()
+            text = _render_top(snap, prev, args.interval)
+            if args.once:
+                print(text)
+                return 0
+            sys.stdout.write("\x1b[2J\x1b[H" + text + "\n")
+            sys.stdout.flush()
+            prev = snap
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        closer()
+
+
+def _cmd_postmortem(args) -> int:
+    """`ray_tpu postmortem [bundle.json]` — render a flight-recorder
+    post-mortem bundle: metadata, in-flight (begin-without-end) ops per
+    process, and the merged event tail. With no path, renders the most
+    recent bundle in the dump directory."""
+    from .perf.postmortem import (bundle_dir, last_bundle_path,
+                                  load_bundle, render_bundle)
+
+    path = args.bundle
+    if not path:
+        path = last_bundle_path()
+        if path is None:
+            print(f"no post-mortem bundles in {bundle_dir()} "
+                  f"(set RAY_TPU_POSTMORTEM_DIR to look elsewhere)",
+                  file=sys.stderr)
+            return 1
+    bundle = load_bundle(path)
+    print(f"bundle: {path}")
+    print(render_bundle(bundle, tail=args.tail))
+    return 0
+
+
 def _cmd_up(args) -> int:
     from .autoscaler.launcher import cluster_up
 
@@ -562,6 +671,27 @@ def main(argv=None) -> int:
                     help="head HOST:PORT of a running cluster (required)")
     sv.add_argument("--authkey", default="")
     sv.set_defaults(fn=_cmd_serve)
+
+    tp = sub.add_parser(
+        "top", help="refreshing cluster perf table: nodes, ray_tpu_* "
+                    "series with rates, latency summaries, SLO counters")
+    tp.add_argument("--interval", type=float, default=2.0)
+    tp.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (no screen clear)")
+    tp.add_argument("--address", default="",
+                    help="head HOST:PORT (omit for the in-process head)")
+    tp.add_argument("--authkey", default="")
+    tp.set_defaults(fn=_cmd_top)
+
+    pm = sub.add_parser(
+        "postmortem", help="render a flight-recorder post-mortem bundle "
+                           "(most recent when no path is given)")
+    pm.add_argument("bundle", nargs="?", default="",
+                    help="bundle JSON path (default: newest in the dump "
+                         "directory)")
+    pm.add_argument("--tail", type=int, default=40,
+                    help="merged event lines to show")
+    pm.set_defaults(fn=_cmd_postmortem)
 
     args = p.parse_args(argv)
     return args.fn(args)

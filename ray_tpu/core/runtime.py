@@ -28,6 +28,7 @@ import cloudpickle
 
 from .. import exceptions as exc
 from ..devtools.locks import instrumented_lock
+from ..perf.recorder import get_recorder as _get_recorder
 from ..util import metrics as metrics_mod
 from ..util.retry import RetryPolicy
 from . import serialization
@@ -94,6 +95,18 @@ def dispatch_counts() -> Tuple[float, float]:
     """(direct, routed) submissions counted IN THIS PROCESS — the test
     hook for 'steady-state actor calls make zero head RPCs'."""
     return _C_DIRECT.total(), _C_ROUTED.total()
+
+
+# flight recorder (ray_tpu.perf): dispatch decisions land in the
+# per-process ring so a post-mortem bundle shows what was routed where
+# in the seconds before an abort
+_FLREC = _get_recorder()
+
+
+def _rec_dispatch(path: str, spec) -> None:
+    if _FLREC.enabled:
+        _FLREC.record(f"dispatch.{path}", spec.description,
+                      {"task": spec.task_id.hex()[:12]})
 
 
 class ShardedLoop:
@@ -431,6 +444,12 @@ class DriverRuntime:
                 return [{"node_id": n.node_id.hex(), "alive": n.alive,
                          "resources": dict(n.total_resources)}
                         for n in self.gcs.nodes()]
+            if method == "perf_snapshot":
+                # `ray_tpu top` plane: ONE RPC returns nodes + every
+                # ray_tpu_* scalar + latency summaries (perf/snapshot.py)
+                from ..perf.snapshot import head_snapshot
+
+                return head_snapshot(self)
             # debugging plane, served to unregistered channels too so
             # `ray_tpu logs/stack/profile --address H:P` work against a
             # running head (ref: `ray logs` / `ray stack` CLI)
@@ -1239,6 +1258,7 @@ class DriverRuntime:
                 return refs
         if _count:
             _C_ROUTED.inc()
+            _rec_dispatch("routed", spec)
         self.task_manager.register(spec)
         # SUBMITTED opens the lifecycle phase chain (-> SCHEDULED ->
         # RUNNING -> FINISHED); the GCS derives phase histograms from it
@@ -1317,6 +1337,11 @@ class DriverRuntime:
             "task_id": spec.task_id.hex(), "name": spec.description,
             "state": "SCHEDULED", "node_id": node.node_id.hex(),
             "time": time.time()})
+        if _FLREC.enabled:
+            _FLREC.record("sched.place", spec.description,
+                          {"task": spec.task_id.hex()[:12],
+                           "node": node.node_id.hex()[:12],
+                           "strategy": strat.kind})
         self.task_manager.mark_running(spec.task_id)
         fut = node.request_lease(spec)
 
@@ -1910,6 +1935,7 @@ class DriverRuntime:
         chan.notify("direct_submit", {"spec": spec, "gate": gate,
                                       "lane": era})
         _C_DIRECT.inc()
+        _rec_dispatch("direct", spec)
         if chan.closed:
             # raced the worker's death: the notify may be lost — recover
             # now (idempotent; results that did land are respected)
@@ -2007,6 +2033,7 @@ class DriverRuntime:
         spec.owner_id = None  # back to the head-routed lane
         spec.seq_no = 0
         _C_ROUTED.inc()
+        _rec_dispatch("routed", spec)
         self.task_manager.register(spec)
         self._submit_actor_spec(spec)
 
@@ -2859,6 +2886,7 @@ class _WorkerDirectState:
         chan.notify("direct_submit", {"spec": spec, "gate": gate,
                                       "lane": era})
         _C_DIRECT.inc()
+        _rec_dispatch("direct", spec)
         if chan.closed:
             # raced the peer's death: on_close may have swept before our
             # rows registered — run the fallback for this task explicitly
@@ -3443,6 +3471,7 @@ class WorkerRuntime:
             # the cached direct gate no longer covers it
             self._direct.note_routed(spec.actor_id)
         _C_ROUTED.inc()
+        _rec_dispatch("routed", spec)
         refs = [ObjectRef(oid) for oid in spec.return_ids()]
         self.channel.call("submit_task", spec)
         # the head counted this worker as holder of each return ref during

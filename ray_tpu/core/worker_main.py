@@ -461,6 +461,14 @@ class WorkerProcess:
             if self._cgraph is not None:
                 return self._cgraph.stop(payload["graph_id"])
             return True
+        if method == "flightrec_snapshot":
+            from ..perf.recorder import get_recorder
+            return get_recorder().snapshot(
+                clear=bool((payload or {}).get("clear")))
+        if method == "flightrec_set_enabled":
+            from ..perf.recorder import set_enabled
+            set_enabled(bool((payload or {}).get("on", True)))
+            return True
         if method == "kill_actor":
             os._exit(0)
         if method == "shutdown":

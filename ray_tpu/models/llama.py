@@ -119,6 +119,22 @@ class Llama:
         shapes = jax.eval_shape(self.init, jax.random.PRNGKey(0))
         return sum(int(math.prod(s.shape)) for s in jax.tree.leaves(shapes))
 
+    def flops_per_token(self, seq: Optional[int] = None) -> int:
+        """Forward+backward matmul FLOPs per token (6N rule + attention),
+        the same accounting as GPT.flops_per_token so MFU numbers are
+        comparable across models.
+
+        The attention score/value matmuls run at FULL head count even
+        under GQA (k/v broadcast to n_head before QK^T / PV), so the
+        attention term uses n_head * head_dim, not the smaller KV
+        projection width: 6 * L * S * (H * hd), already halved for
+        causal masking."""
+        c = self.config
+        s = c.max_seq if seq is None else seq
+        n = self.num_params()
+        attn = 6 * c.n_layer * c.n_head * c.head_dim * s
+        return 6 * n + attn
+
     def _block(self, x, lp, cos, sin, positions):
         c = self.config
         B, S, D = x.shape

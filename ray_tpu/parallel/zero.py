@@ -251,6 +251,11 @@ class ZeroUpdater:
         self._opt_state = None
         self._master = None   # fp32 shard master copy (codec path only)
         self._jit_update = None
+        # collective sync-exposed wall time (step profiler, ISSUE 17):
+        # the two wire legs of the last update() and the running total
+        self.last_rs_s = 0.0   # gradient reduce-scatter leg
+        self.last_ag_s = 0.0   # parameter all-gather leg
+        self.sync_s = 0.0      # cumulative rs+ag over this updater's life
 
     def init(self, params) -> "ZeroUpdater":
         import jax
@@ -327,8 +332,12 @@ class ZeroUpdater:
         # reducescatter SUMS then slices; divide for the dp mean
         # (codec: rows dequantize to fp32 BEFORE the sum, so gradient
         # accumulation precision is full — only the wire is narrow)
+        import time as _time
+
+        t0 = _time.perf_counter()
         g_shard = collective.reducescatter(
             np.asarray(flat_g), self.group_name, codec=codec) / self.world
+        self.last_rs_s = _time.perf_counter() - t0
         flat_p, _ = flatten_tree(params)
         lo, hi = shard_bounds(self._spec.size, self.world)[self.rank]
         if codec is not None and self._master is None:
@@ -340,8 +349,11 @@ class ZeroUpdater:
             self._opt_state, p_shard)
         if codec is not None:
             self._master = new_shard
+        t1 = _time.perf_counter()
         parts = collective.allgather(np.asarray(new_shard),
                                      self.group_name, codec=codec)
+        self.last_ag_s = _time.perf_counter() - t1
+        self.sync_s += self.last_rs_s + self.last_ag_s
         full = jnp.asarray(np.concatenate(parts), dtype=self._spec.dtype)
         return unflatten_tree(full, self._spec)
 

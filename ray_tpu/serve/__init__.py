@@ -82,8 +82,13 @@ def deployment(target: Any = None, *, name: Optional[str] = None,
                health_check_timeout_s: float = 10.0,
                user_config: Any = None,
                ray_actor_options: Optional[dict] = None,
-               autoscaling_config: Optional[AutoscalingConfig] = None):
-    """@serve.deployment — class or function (ref: serve/api.py:deployment)."""
+               autoscaling_config: Optional[AutoscalingConfig] = None,
+               slo_target_s: Optional[float] = None):
+    """@serve.deployment — class or function (ref: serve/api.py:deployment).
+
+    ``slo_target_s`` sets the deployment's end-to-end latency SLO:
+    routed requests count into
+    ``ray_tpu_serve_slo_{ok,violated}_total{deployment=...}``."""
 
     def wrap(t):
         cfg = DeploymentConfig(
@@ -94,6 +99,7 @@ def deployment(target: Any = None, *, name: Optional[str] = None,
             user_config=user_config,
             ray_actor_options=dict(ray_actor_options or {}),
             autoscaling=autoscaling_config,
+            slo_target_s=slo_target_s,
         )
         return Deployment(t, name or t.__name__, cfg)
 

@@ -27,6 +27,11 @@ class DeploymentConfig:
     user_config: Optional[Any] = None
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
     autoscaling: Optional[AutoscalingConfig] = None
+    # end-to-end latency SLO for this deployment (seconds, None = no
+    # SLO): every routed request lands in
+    # ray_tpu_serve_slo_{ok,violated}_total{deployment=...} depending on
+    # whether it finished inside the target
+    slo_target_s: Optional[float] = None
 
     def version_fields(self) -> tuple:
         """Changes to these require replacing replicas (rolling update);

@@ -179,6 +179,16 @@ class ServeController:
                 pass
         return len(marked)
 
+    def get_slo(self, name: str) -> Optional[float]:
+        """The deployment's latency SLO target in seconds (None = no
+        SLO). Handles fetch this once per version change and count every
+        routed request into ray_tpu_serve_slo_{ok,violated}_total."""
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None:
+                return None
+            return getattr(st.config, "slo_target_s", None)
+
     def replica_warmth(self, name: str) -> Dict[str, float]:
         """actor_id hex -> CURRENT resident prefix-block count for
         every routable replica (the health-ping `cache_stats` surface).

@@ -47,6 +47,19 @@ _C_SESSION_REROUTES = _metrics.Counter(
     "session-affinity reassignments to a different replica",
     tag_keys=("deployment",))
 
+# per-deployment SLO accounting (DeploymentConfig.slo_target_s): every
+# routed request falls into exactly one of these two, so
+# violated / (ok + violated) is the SLO miss rate `ray_tpu top` shows
+_C_SLO_OK = _metrics.Counter(
+    "ray_tpu_serve_slo_ok_total",
+    "requests that finished within the deployment's latency SLO",
+    tag_keys=("deployment",))
+_C_SLO_VIOLATED = _metrics.Counter(
+    "ray_tpu_serve_slo_violated_total",
+    "requests that exceeded the deployment's latency SLO (errors and "
+    "routing timeouts included)",
+    tag_keys=("deployment",))
+
 
 class DeploymentResponse:
     """Future-like result of handle.remote(). `ray_tpu.get` accepts it
@@ -212,7 +225,27 @@ class FailoverResponseGenerator:
                 self._gen = None
                 self._replica = None
                 self.failovers += 1
+                try:
+                    from ray_tpu.perf.recorder import get_recorder
+
+                    get_recorder().record(
+                        "serve.failover", self._handle._name,
+                        {"failovers": self.failovers,
+                         "yielded": len(self._yielded),
+                         "error": type(e).__name__})
+                except Exception:
+                    pass
                 if self.failovers > self._MAX_FAILOVERS:
+                    try:
+                        from ray_tpu.perf.postmortem import dump_bundle
+
+                        dump_bundle(
+                            f"serve failover exhausted: {e!r}",
+                            origin=f"serve:{self._handle._name}",
+                            meta={"deployment": self._handle._name,
+                                  "failovers": self.failovers})
+                    except Exception:
+                        pass
                     self._finish()
                     raise
                 cont = self._resume(self._args, self._kwargs,
@@ -289,6 +322,8 @@ class DeploymentHandle:
         # actor_id hex -> resident prefix blocks (refreshed with the
         # replica list; the p2c tie-break reads it without blocking)
         self._warmth: Dict[str, float] = {}
+        self._slo_target: Optional[float] = None
+        self._slo_version = -2          # config version the target is for
         self._lock = threading.Lock()
         self._router: Optional[ThreadPoolExecutor] = None
 
@@ -321,6 +356,18 @@ class DeploymentHandle:
                 live = {r._actor_id for r in replicas}
                 self._inflight = {a: c for a, c in self._inflight.items()
                                   if a in live}
+            fetch_slo = self._slo_version != version
+        if fetch_slo:
+            try:
+                target = ray_tpu.get(
+                    self._controller.get_slo.remote(self._name), timeout=5)
+            except Exception:
+                # flaky probe: keep the last-known target, retry next
+                # version-changed refresh
+                target = self._slo_target
+            with self._lock:
+                self._slo_target = target
+                self._slo_version = version
 
     def _drop(self, replica) -> None:
         with self._lock:
@@ -508,12 +555,23 @@ class DeploymentHandle:
             kwargs = {**kwargs, MUX_KWARG: mux_id}
         rt = runtime_mod.get_runtime()
         t_start = time.perf_counter()
+        ok = False
         try:
-            return self._route_with_retries(rt, method, args, kwargs,
-                                            deadline, mux_id, session_id)
+            out = self._route_with_retries(rt, method, args, kwargs,
+                                           deadline, mux_id, session_id)
+            ok = True
+            return out
         finally:
-            _H_SERVE_REQUEST.observe(time.perf_counter() - t_start,
-                                     tags={"deployment": self._name})
+            dt = time.perf_counter() - t_start
+            _H_SERVE_REQUEST.observe(dt, tags={"deployment": self._name})
+            slo = self._slo_target
+            if slo is not None:
+                # an errored request never met its SLO, whatever the clock
+                # says
+                if ok and dt <= slo:
+                    _C_SLO_OK.inc(tags={"deployment": self._name})
+                else:
+                    _C_SLO_VIOLATED.inc(tags={"deployment": self._name})
 
     # shared routing backoff (util/retry.py): saturated/empty replica
     # sets back off exponentially with full jitter so concurrent routers

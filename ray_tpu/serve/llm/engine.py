@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import collections
 import itertools
+import os
 import queue
 import threading
 import time
@@ -46,8 +47,11 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ...perf.recorder import get_recorder as _get_recorder
 from ...util import metrics as _metrics
 from .kv_cache import BlockPool, blocks_for_tokens
+
+_FLREC = _get_recorder()
 
 _G_QUEUE = _metrics.Gauge(
     "ray_tpu_llm_queue_depth",
@@ -277,6 +281,11 @@ class LLMEngine:
         self._stop = threading.Event()
         self._total_generated = 0
         self._total_preemptions = 0
+        # cumulative scheduler-phase seconds; profile() diffs across a
+        # window, so these only ever grow
+        self._phase_s = {"admit": 0.0, "prefill": 0.0, "decode": 0.0,
+                         "retire": 0.0}
+        self._prof: Optional[Dict[str, list]] = None
         self._peak_blocks = 0
         self._peak_per_chip: List[int] = [0] * self.tp
         self._tok_events: "collections.deque" = collections.deque()
@@ -453,8 +462,25 @@ class LLMEngine:
         """One scheduler iteration: retire/admit/decode. Returns True if
         any work was done (callers can sleep when False)."""
         with self._lock:
+            ph = self._phase_s
+            p0, r0 = ph["prefill"], ph["retire"]
+            t0 = time.perf_counter()
             admitted = self._admit()
+            t1 = time.perf_counter()
+            r1 = ph["retire"]
             decoded = self._decode_iteration()
+            t2 = time.perf_counter()
+            # admit = scheduling overhead net of the prefill compute and
+            # any retires it triggered (both self-accumulate); decode
+            # likewise nets out retires
+            ph["admit"] += max(0.0, (t1 - t0) - (ph["prefill"] - p0)
+                               - (r1 - r0))
+            ph["decode"] += max(0.0, (t2 - t1) - (ph["retire"] - r1))
+            if self._prof is not None and (admitted or decoded):
+                self._prof["occupancy"].append(float(len(self._running)))
+                self._prof["kv_pressure"].append(round(
+                    self.pool.used_count / self.pool.num_blocks, 4))
+                self._prof["step_ms"].append(round((t2 - t0) * 1e3, 4))
             self._update_gauges()
             return admitted or decoded
 
@@ -527,10 +553,12 @@ class LLMEngine:
             self._waiting.popleft()
             budget -= p - cached
             admitted = True
+            tp0 = time.perf_counter()
             if cached:
                 self._prefill_cached(req, match, blocks)
             else:
                 self._prefill_into(req, blocks)
+            self._phase_s["prefill"] += time.perf_counter() - tp0
         return admitted
 
     def _prefill_into(self, req: Request, blocks: List[int]) -> None:
@@ -594,6 +622,9 @@ class LLMEngine:
         slot = self._free_slots.pop()
         seq = _Sequence(req, slot, blocks, p, first)
         self._running.append(seq)
+        if _FLREC.enabled:
+            _FLREC.record("llm.admit", req.request_id,
+                          {"engine": self.name, "prompt": p, "slot": slot})
         if self.prefix_cache is not None:
             # index the prompt's full blocks NOW so concurrent requests
             # sharing the prefix hit before this sequence even retires
@@ -739,6 +770,7 @@ class LLMEngine:
 
     def _retire(self, seq: _Sequence, reason: str,
                 error: Optional[BaseException] = None) -> None:
+        t0 = time.perf_counter()
         self._running.remove(seq)
         if self.prefix_cache is not None and error is None:
             # leave the full-block KV of prompt+completion behind for
@@ -748,7 +780,12 @@ class LLMEngine:
             self.prefix_cache.insert(seq.tokens, seq.blocks)
         self.pool.free(seq.blocks)
         self._free_slots.append(seq.slot)
+        if _FLREC.enabled:
+            _FLREC.record("llm.retire", seq.req.request_id,
+                          {"engine": self.name, "reason": reason,
+                           "generated": len(seq.req.generated)})
         seq.req.stream._finish(reason, error)
+        self._phase_s["retire"] += time.perf_counter() - t0
 
     def _preempt(self, seq: _Sequence) -> None:
         """Free everything the sequence holds and requeue it at the front
@@ -769,6 +806,10 @@ class LLMEngine:
         req.preemptions += 1
         self._total_preemptions += 1
         _C_PREEMPT.inc(tags={"engine": self.name})
+        if _FLREC.enabled:
+            _FLREC.record("llm.preempt", req.request_id,
+                          {"engine": self.name,
+                           "context": len(req.prompt)})
         self._waiting.appendleft(req)
 
     # -- loop drivers ---------------------------------------------------------
@@ -794,6 +835,16 @@ class LLMEngine:
                 self._stop.wait(self.config.idle_sleep_s)
 
     def _fail_all(self, error: BaseException) -> None:
+        try:
+            from ...perf.postmortem import dump_bundle
+
+            dump_bundle(f"llm engine poisoned: {error!r}",
+                        origin=f"llm:{self.name}",
+                        meta={"engine": self.name,
+                              "waiting": len(self._waiting),
+                              "running": len(self._running)})
+        except Exception:
+            pass
         with self._lock:
             for seq in list(self._running):
                 self._retire(seq, "error", error)
@@ -825,6 +876,66 @@ class LLMEngine:
                 raise TimeoutError(f"{self.name}: not idle after {timeout}s")
 
     # -- introspection --------------------------------------------------------
+
+    def profile(self, steps: int = 8, flops_per_token: Optional[float] = None,
+                peak_flops: Optional[float] = None, timeout: float = 60.0):
+        """Profile ``steps`` scheduler iterations and return a
+        :class:`ray_tpu.perf.StepReport` (kind="llm") with the
+        admit/prefill/decode/retire phase split, batch-occupancy and
+        KV-pressure series, tokens/s and MFU.
+
+        With the background thread running (``start()``) this observes
+        passively until ``steps`` working iterations elapsed; otherwise
+        it drives ``step()`` inline over whatever is queued.
+        ``flops_per_token`` defaults to ``model.flops_per_token()`` when
+        the model has one; ``peak_flops`` to ``RAY_TPU_PEAK_FLOPS``."""
+        from ...perf.report import StepReport
+
+        if steps < 1:
+            raise ValueError(f"steps must be >= 1, got {steps}")
+        if peak_flops is None:
+            peak_flops = float(os.environ.get("RAY_TPU_PEAK_FLOPS", 0))
+        if flops_per_token is None:
+            fpt = getattr(self.model, "flops_per_token", None)
+            flops_per_token = float(fpt()) if callable(fpt) else 0.0
+        with self._lock:
+            self._prof = {"occupancy": [], "kv_pressure": [],
+                          "step_ms": []}
+            base = dict(self._phase_s)
+            gen0 = self._total_generated
+        t_start = time.time()
+        wall0 = time.perf_counter()
+        try:
+            if self.is_alive():
+                deadline = time.monotonic() + timeout
+                while time.monotonic() < deadline:
+                    with self._lock:
+                        if len(self._prof["step_ms"]) >= steps:
+                            break
+                    time.sleep(0.003)
+            else:
+                for _ in range(steps):
+                    self.step()
+        finally:
+            wall_s = time.perf_counter() - wall0
+            with self._lock:
+                prof, self._prof = self._prof, None
+                phases = {k: round((self._phase_s[k] - base[k]) * 1e3, 3)
+                          for k in base}
+                gen = self._total_generated - gen0
+        events = [ev for ev in _FLREC.snapshot(clear=False)
+                  if ev["ts"] >= t_start][-2000:]
+        return StepReport(
+            kind="llm", engine=self.name, steps=len(prof["step_ms"]),
+            wall_s=wall_s, step_ms=prof["step_ms"], phases=phases,
+            tokens=float(gen),
+            tokens_per_s=gen / wall_s if gen and wall_s > 0 else 0.0,
+            flops_per_token=flops_per_token, peak_flops=peak_flops,
+            occupancy=prof["occupancy"], kv_pressure=prof["kv_pressure"],
+            events=events,
+            extra={"max_batch": self.config.max_batch,
+                   "num_blocks": self.config.num_blocks,
+                   "preemptions": self._total_preemptions})
 
     def queue_depth(self) -> int:
         with self._lock:

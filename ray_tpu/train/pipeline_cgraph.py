@@ -51,8 +51,11 @@ from ray_tpu.core.placement_group import placement_group, remove_placement_group
 from ..exceptions import (CompiledGraphClosedError, CompiledGraphError,
                           GetTimeoutError)
 from ..parallel.pipeline import schedule_interleaved_1f1b
+from ..perf.recorder import get_recorder as _get_recorder
 from ..util import metrics as _metrics
 from ..util import tracing
+
+_FLREC = _get_recorder()
 
 _H_STEP = _metrics.Histogram(
     "ray_tpu_pipeline_step_seconds",
@@ -388,8 +391,13 @@ class _CGStage:
         key = str(v)
         cached = self._param_cache.get(key)
         if cached is None:
+            t0 = time.perf_counter()
             cached = self._param_cache[key] = self._plane.gather(
                 self._fsdp_state[key])
+            # sync-exposed fsdp gather time, drained into the step
+            # report by update() (step profiler, ISSUE 17)
+            self._gather_s = getattr(self, "_gather_s", 0.0) \
+                + (time.perf_counter() - t0)
         return cached
 
     # -- schedule ops (driven by the cgraph iterative loop) ---------------
@@ -458,6 +466,10 @@ class _CGStage:
                  for k, v in self._grad_acc.items()}
         from ..parallel.zero import tree_bytes
 
+        sync = {"rs_ms": 0.0, "ag_ms": 0.0, "allreduce_ms": 0.0,
+                "gather_ms": round(
+                    getattr(self, "_gather_s", 0.0) * 1e3, 3)}
+        self._gather_s = 0.0
         if self.tx is None:
             self._param_cache = {}  # evaluation engine: grads dropped
         elif self._plane is not None:
@@ -473,9 +485,12 @@ class _CGStage:
                 from ..parallel.zero import flatten_tree, unflatten_tree
 
                 flat_g, spec = flatten_tree(grads)
+                t_ar = time.perf_counter()
                 mean = collective.allreduce(
                     np.asarray(flat_g), self.group_name,
                     codec=self.grad_codec) / self.dp
+                sync["allreduce_ms"] = round(
+                    (time.perf_counter() - t_ar) * 1e3, 3)
                 grads = unflatten_tree(
                     jnp.asarray(mean, dtype=spec.dtype), spec)
             for v in range(self.virtual):
@@ -487,6 +502,8 @@ class _CGStage:
             self._param_cache = {}
         elif self._zero is not None:
             self.params = self._zero.update(self.params, grads)
+            sync["rs_ms"] = round(self._zero.last_rs_s * 1e3, 3)
+            sync["ag_ms"] = round(self._zero.last_ag_s * 1e3, 3)
         elif self.dp > 1:
             # replicated A/B path: allreduce-mean over the flat vector,
             # full-tree update on every replica (full opt state each)
@@ -498,9 +515,12 @@ class _CGStage:
             flat_g, spec = flatten_tree(grads)
             import numpy as np
 
+            t_ar = time.perf_counter()
             mean = collective.allreduce(
                 np.asarray(flat_g), self.group_name,
                 codec=self.grad_codec) / self.dp
+            sync["allreduce_ms"] = round(
+                (time.perf_counter() - t_ar) * 1e3, 3)
             grads = unflatten_tree(
                 jnp.asarray(mean, dtype=spec.dtype), spec)
             self.params, self._opt_state = self._upd(
@@ -514,7 +534,19 @@ class _CGStage:
             "update_ms": round((time.perf_counter() - t0) * 1e3, 3),
             "opt_state_bytes": self.opt_state_bytes(),
             "in_flight_residuals": len(self._residuals),
+            # collective sync-exposed ms: ZeRO reduce-scatter/all-gather
+            # legs, dp allreduce, fsdp gather — the ROADMAP overlap-
+            # scheduling arc's target series (step profiler, ISSUE 17)
+            "sync_ms": round(sum(sync.values()), 3),
+            "sync_breakdown": sync,
         }
+        # per-op wall spans + cumulative exec/bubble recorded by the
+        # cgraph executor in THIS process (perf/oplog.py); update() is
+        # the last op of the step schedule on the same thread, so the
+        # drain rides the existing report channel to the driver
+        from ..perf import oplog as _oplog
+
+        report["perf"] = _oplog.stage_perf(f"{self.dp_rank}.{self.idx}")
         if self._plane is not None:
             per_chip: Dict[int, int] = {}
             for v in range(self.virtual):
@@ -1163,6 +1195,10 @@ class CompiledPipelineEngine:
         deadline = time.monotonic() + timeout
         ctx = tracing.current_context()
         trace = f"{ctx[0]}:{ctx[1]}" if ctx else ""
+        self._last_step_inputs = (microbatches, targets)
+        if _FLREC.enabled:
+            _FLREC.record("pipeline.step.begin", self._gtag,
+                          {"step": self._step_count})
         t0 = time.perf_counter()
         try:
             for r in range(dp):
@@ -1208,12 +1244,15 @@ class CompiledPipelineEngine:
                     self._closed_error = CompiledGraphClosedError(
                         f"pipeline engine {self._gtag}: channel peer "
                         f"closed mid-step")
+            self._dump_postmortem(f"step closed mid-step: "
+                                  f"{self._closed_error}")
             raise self._closed_reason() from None
         except GetTimeoutError:
             self._poisoned = GetTimeoutError(
                 f"pipeline engine {self._gtag}: step timed out — "
                 f"in-flight state is indeterminate; shutdown() and "
                 f"rebuild")
+            self._dump_postmortem(f"step timeout: {self._poisoned}")
             raise
         except BaseException as e:
             # anything else raised mid-step (a serialization failure, a
@@ -1222,14 +1261,20 @@ class CompiledPipelineEngine:
             # so the next step would consume stale envelopes and pair
             # activations with the wrong targets. Not resumable.
             self._poisoned = e
+            self._dump_postmortem(f"step poisoned: {e!r}")
             raise
         self.last_step_s = time.perf_counter() - t0
         _H_STEP.observe(self.last_step_s, tags={"engine": self._gtag})
+        if _FLREC.enabled:
+            _FLREC.record("pipeline.step.end", self._gtag,
+                          {"step": self._step_count,
+                           "wall_ms": round(self.last_step_s * 1e3, 3)})
         if first_err is not None:
             # envelope error propagation kept every channel count
             # aligned, but residual/grad state on the stages is gone —
             # the engine is not safely resumable after a stage raise
             self._poisoned = first_err
+            self._dump_postmortem(f"stage raised: {first_err!r}")
             raise first_err
         self.last_reports = reports
         self._step_count += 1
@@ -1251,6 +1296,144 @@ class CompiledPipelineEngine:
             err = CompiledGraphClosedError(
                 f"pipeline engine {self._gtag} was shut down")
         return type(err)(str(err))
+
+    # -- performance introspection (ray_tpu.perf, ISSUE 17) ----------------
+
+    def _dump_postmortem(self, reason: str) -> Optional[str]:
+        """Merged driver+worker flight-recorder bundle: drains this
+        process's ring plus — best-effort, 5s per worker — every stage
+        worker still reachable. Throttled inside dump_bundle; never
+        raises (the abort being recorded takes precedence)."""
+        try:
+            from ..perf.postmortem import dump_bundle
+
+            fetchers = {}
+            for plan in self._actor_plans.values():
+                name = f"worker:{plan.replica}.{plan.stage}"
+                fetchers[name] = (
+                    lambda p=plan: p.node.worker_cgraph_call(
+                        p.worker, "flightrec_snapshot", {}, timeout=5.0))
+            return dump_bundle(
+                reason, origin="driver", ring_fetchers=fetchers,
+                meta={"engine": self._gtag, "dp": self.dp,
+                      "num_stages": self.num_stages,
+                      "num_microbatches": self.num_microbatches,
+                      "step": self._step_count, "reason": reason})
+        except Exception:
+            return None
+
+    def set_flight_recording(self, on: bool) -> None:
+        """Toggle the flight-recorder event stream on the driver and on
+        every stage worker (best-effort, 5s per worker). The per-op perf
+        counters that feed :meth:`profile` stay on either way — this
+        gates only the event ring, and exists mainly so the overhead
+        bench can A/B it."""
+        from ..perf.recorder import set_enabled
+
+        set_enabled(on)
+        for plan in self._actor_plans.values():
+            try:
+                plan.node.worker_cgraph_call(
+                    plan.worker, "flightrec_set_enabled", {"on": on},
+                    timeout=5.0)
+            except Exception:
+                pass
+
+    def profile(self, steps: int = 4, microbatches: Sequence[Any] = None,
+                targets: Sequence[Any] = None,
+                tokens_per_step: Optional[float] = None,
+                flops_per_token: Optional[float] = None,
+                peak_flops: Optional[float] = None,
+                timeout: float = 300.0):
+        """Run one warmup step plus ``steps`` profiled training steps
+        and return a :class:`ray_tpu.perf.StepReport` with the
+        per-stage exec/bubble/sync breakdown, per-op wall spans (chrome-
+        trace exportable), measured bubble fraction, tokens/s and MFU.
+
+        ``microbatches``/``targets`` default to replaying the last
+        ``step()``'s inputs — profiling trains on them, exactly as
+        ``step()`` would. ``tokens_per_step`` enables tokens/s;
+        ``flops_per_token`` + ``peak_flops`` (default
+        ``RAY_TPU_PEAK_FLOPS``) enable MFU."""
+        from ..perf.report import StepReport
+
+        if microbatches is None or targets is None:
+            last = getattr(self, "_last_step_inputs", None)
+            if last is None:
+                raise ValueError(
+                    "profile() without microbatches/targets needs at "
+                    "least one prior step() to replay")
+            microbatches, targets = last
+        if peak_flops is None:
+            peak_flops = float(os.environ.get("RAY_TPU_PEAK_FLOPS", 0))
+        if steps < 1:
+            raise ValueError(f"steps must be >= 1, got {steps}")
+        # warmup step doubles as the cumulative-counter baseline: the
+        # executor's exec/bubble sinks count from graph load, so the
+        # profiled window is (final - baseline)
+        self.step(microbatches, targets, timeout=timeout)
+        base = {f"{r['dp_rank']}.{r['stage']}": dict(r.get("perf") or {})
+                for r in self.last_reports}
+        t_start = time.time()
+        wall0 = time.perf_counter()
+        step_ms: List[float] = []
+        sync_acc: Dict[str, float] = {}
+        upd_acc: Dict[str, float] = {}
+        ops_acc: Dict[str, List[dict]] = {}
+        final: Dict[str, dict] = {}
+        for _ in range(steps):
+            self.step(microbatches, targets, timeout=timeout)
+            step_ms.append(self.last_step_s * 1e3)
+            for r in self.last_reports:
+                tag = f"{r['dp_rank']}.{r['stage']}"
+                sync_acc[tag] = sync_acc.get(tag, 0.0) \
+                    + float(r.get("sync_ms", 0.0))
+                upd_acc[tag] = upd_acc.get(tag, 0.0) \
+                    + float(r.get("update_ms", 0.0))
+                perf = r.get("perf") or {}
+                ops_acc.setdefault(tag, []).extend(perf.get("ops", ()))
+                final[tag] = perf
+        wall_s = time.perf_counter() - wall0
+        stages = []
+        for tag in sorted(final):
+            b = base.get(tag, {})
+            f = final[tag]
+            bubble_ms = (f.get("bubble_s", 0.0)
+                         - b.get("bubble_s", 0.0)) * 1e3
+            stages.append({
+                "stage": tag,
+                "exec_ms": round((f.get("exec_s", 0.0)
+                                  - b.get("exec_s", 0.0)) * 1e3, 3),
+                # in this engine the 1F1B bubble IS recv-blocked time —
+                # the executor times only the blocking channel read
+                "bubble_ms": round(bubble_ms, 3),
+                "recv_ms": round(bubble_ms, 3),
+                "send_ms": round((f.get("send_s", 0.0)
+                                  - b.get("send_s", 0.0)) * 1e3, 3),
+                "sync_ms": round(sync_acc.get(tag, 0.0), 3),
+                "update_ms": round(upd_acc.get(tag, 0.0), 3),
+                "ops": ops_acc.get(tag, []),
+            })
+        n_inst = max(1, len(stages))
+        phases = {
+            "compute": round(sum(s["exec_ms"] for s in stages) / n_inst,
+                             3),
+            "bubble": round(sum(s["bubble_ms"] for s in stages) / n_inst,
+                            3),
+            "send": round(sum(s["send_ms"] for s in stages) / n_inst, 3),
+        }
+        tokens = float(tokens_per_step or 0.0) * steps
+        events = [ev for ev in _FLREC.snapshot(clear=False)
+                  if ev["ts"] >= t_start][-2000:]
+        return StepReport(
+            kind="pipeline", engine=self._gtag, steps=steps,
+            wall_s=wall_s, step_ms=step_ms, stages=stages, phases=phases,
+            tokens=tokens,
+            tokens_per_s=tokens / wall_s if tokens and wall_s > 0 else 0.0,
+            flops_per_token=float(flops_per_token or 0.0),
+            peak_flops=peak_flops, num_stages=self.num_stages,
+            num_microbatches=self.num_microbatches, events=events,
+            extra={"dp": self.dp})
 
     def get_params(self) -> List[Any]:
         """Chunk params in GLOBAL chunk order (replica 0's copy)."""
@@ -1772,7 +1955,14 @@ class CompiledPipelineEngine:
         # pubsub callback, and blocking control-plane calls made from
         # that thread can't be serviced until the callback returns
         self._stop.set()
-        threading.Thread(target=self.teardown, daemon=True,
+
+        def _dump_and_teardown():
+            # ring fetch is a blocking control-plane call — it can only
+            # run here, never in the pubsub callback itself
+            self._dump_postmortem(f"abort: {err!r}")
+            self.teardown()
+
+        threading.Thread(target=_dump_and_teardown, daemon=True,
                          name=f"pipeline-abort-{self._gtag}").start()
 
     def teardown(self) -> None:

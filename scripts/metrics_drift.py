@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""metrics_drift: keep docs/OBSERVABILITY.md and the emitted metric
+families from drifting apart (they co-evolved by hand for 15 PRs).
+
+Two directions, both fatal:
+
+- code -> doc: every ``ray_tpu_*`` family constructed in ``ray_tpu/``
+  (AST scan for ``Counter``/``Gauge``/``Histogram`` calls with a string
+  first argument, plus the scrape-time ``fams.get(name, kind, help)``
+  families in util/metrics.py — NOT a text grep, which would
+  false-positive on strings like the ``ray_tpu_postmortem`` bundle-dir
+  name) must be named somewhere in docs/OBSERVABILITY.md.
+- doc -> code: every ``ray_tpu_*`` series the doc names must be
+  constructed somewhere in ``ray_tpu/``. PromQL spellings
+  (``_bucket``/``_sum``/``_count`` on a histogram) and the doc's
+  shorthand continuation cells (``ray_tpu_object_store_bytes_used`` /
+  ``_capacity_bytes`` / ``_objects``) are normalised first.
+
+Run: ``python scripts/metrics_drift.py`` (exit 1 on drift).
+"""
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC = os.path.join(REPO, "docs", "OBSERVABILITY.md")
+PKG = os.path.join(REPO, "ray_tpu")
+
+METRIC_CTORS = ("Counter", "Gauge", "Histogram")
+
+
+def code_series():
+    """{family_name: 'path:line'} for every metric constructed in
+    ray_tpu/ — AST only, so arbitrary ray_tpu_* strings don't count."""
+    out = {}
+    for root, _dirs, files in os.walk(PKG):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            with open(path, encoding="utf-8") as f:
+                try:
+                    tree = ast.parse(f.read(), filename=path)
+                except SyntaxError:
+                    continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                fname = (node.func.id if isinstance(node.func, ast.Name)
+                         else node.func.attr
+                         if isinstance(node.func, ast.Attribute) else "")
+                if not fname.endswith(METRIC_CTORS):
+                    # scrape-time families: fams.get(name, kind, help)
+                    # where kind is a literal gauge/counter/histogram
+                    if not (fname == "get" and len(node.args) >= 2
+                            and isinstance(node.args[1], ast.Constant)
+                            and node.args[1].value in
+                            ("gauge", "counter", "histogram")):
+                        continue
+                arg = node.args[0]
+                if (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)
+                        and arg.value.startswith("ray_tpu_")):
+                    rel = os.path.relpath(path, REPO)
+                    out.setdefault(arg.value, f"{rel}:{node.lineno}")
+    return out
+
+
+def doc_series(code):
+    """Set of normalised ray_tpu_* names the doc refers to."""
+    with open(DOC, encoding="utf-8") as f:
+        text = f.read()
+    names = set()
+    for line in text.splitlines():
+        # brace alternation: ray_tpu_serve_slo_{ok,violated}_total (the
+        # prefix ends with "_"); otherwise the braces are a tag list on
+        # a complete series name, e.g. ..._memory_bytes{device,kind}
+        for pre, alts, post in re.findall(
+                r"(ray_tpu_[a-z0-9_]*)\{([a-z0-9_,]+)\}([a-z0-9_]*)",
+                line):
+            if pre.endswith("_"):
+                names.update(f"{pre}{a}{post}" for a in alts.split(","))
+            else:
+                names.add(pre)
+        line = re.sub(r"ray_tpu_[a-z0-9_]*\{[a-z0-9_,]+\}[a-z0-9_]*",
+                      "", line)
+        full = re.findall(r"ray_tpu_[a-z0-9_]*[a-z0-9]", line)
+        names.update(full)
+        # shorthand continuation cells: `_capacity_bytes` on a line that
+        # already named a full series — resolve against every underscore
+        # prefix of the line's full names, keep matches that exist
+        for short in re.findall(r"`(_[a-z0-9_]*[a-z0-9])`", line):
+            for f_name in full:
+                parts = f_name.split("_")
+                for i in range(len(parts), 1, -1):
+                    cand = "_".join(parts[:i]) + short
+                    if cand in code:
+                        names.add(cand)
+                        break
+    # promql spellings of histogram families; family-prefix mentions
+    # (e.g. the `ray_tpu_postmortem` bundle dir, "the ray_tpu_llm
+    # family") are not series references and are dropped
+    norm = set()
+    for n in names:
+        if n not in code:
+            for suf in ("_bucket", "_sum", "_count"):
+                if n.endswith(suf) and n[:-len(suf)] in code:
+                    n = n[:-len(suf)]
+                    break
+        if n not in code and any(c.startswith(n + "_") for c in code):
+            continue
+        norm.add(n)
+    return norm
+
+
+def main() -> int:
+    code = code_series()
+    doc = doc_series(code)
+    undocumented = sorted(set(code) - doc)
+    unemitted = sorted(doc - set(code))
+    ok = True
+    if undocumented:
+        ok = False
+        print("metrics_drift: emitted but not in docs/OBSERVABILITY.md:")
+        for n in undocumented:
+            print(f"  {n}  ({code[n]})")
+    if unemitted:
+        ok = False
+        print("metrics_drift: named in docs/OBSERVABILITY.md but never "
+              "constructed in ray_tpu/:")
+        for n in unemitted:
+            print(f"  {n}")
+    if ok:
+        print(f"metrics_drift: OK — {len(code)} families, all documented, "
+              f"no stale doc rows")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

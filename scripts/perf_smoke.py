@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""CI smoke for the performance-introspection plane (ISSUE 17).
+
+Spins up an in-process head plus one REAL remote node agent, then:
+
+- profiles a 2-stage `CompiledPipelineEngine` split across the node
+  boundary: StepReport phases (compute/bubble/send) must sum to ~the
+  measured step wall (within 10%), the chrome-trace export must be
+  loadable JSON with schema-valid events from BOTH stage processes,
+  and `suggest()` must return strings
+- profiles a CONCURRENT llm stream: engine on its background thread,
+  streaming clients in flight, `profile()` observing passively — the
+  admit/prefill/decode/retire phase split must likewise sum to ~the
+  profiled steps' wall, with occupancy/kv-pressure series populated
+- fetches one `ray_tpu top` snapshot over the SAME head RPC the CLI
+  uses (`perf_snapshot`) and renders it: 2 alive nodes, the pipeline
+  step histogram present
+- A/B overhead gate: median step time with the flight recorder on vs
+  off (toggled driver+workers via `set_flight_recording`), interleaved
+  rounds so box drift cancels; the bar is load/CPU-aware like the
+  tier-1 envelope test
+
+Exit 0 = healthy; any assertion prints the evidence and exits 1.
+Run: python scripts/perf_smoke.py   (CI invokes it after traffic_smoke)
+"""
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _mlp(num_chunks: int, width: int, M: int, mb_size: int):
+    import jax
+    import jax.numpy as jnp
+
+    k = jax.random.PRNGKey(0)
+
+    def mk_mid():
+        def fn(p, x):
+            return jnp.tanh(x @ p["w"] + p["b"])
+        return fn
+
+    def mk_last():
+        def fn(p, x, targets):
+            return jnp.mean((x @ p["w"] + p["b"] - targets) ** 2)
+        return fn
+
+    fns = [mk_mid() for _ in range(num_chunks - 1)] + [mk_last()]
+    params = [
+        {"w": jax.random.normal(jax.random.fold_in(k, i),
+                                (width, width)) * 0.3,
+         "b": jnp.zeros((width,))}
+        for i in range(num_chunks)]
+    xs = jax.random.normal(jax.random.fold_in(k, 5), (M * mb_size, width))
+    ys = jax.random.normal(jax.random.fold_in(k, 6), (M * mb_size, width))
+    mbs = [xs[i * mb_size:(i + 1) * mb_size] for i in range(M)]
+    tgts = [ys[i * mb_size:(i + 1) * mb_size] for i in range(M)]
+    return fns, params, mbs, tgts
+
+
+def _check_chrome_trace(trace: dict) -> int:
+    # round-trip through JSON: perfetto loads the serialized form
+    trace = json.loads(json.dumps(trace))
+    assert isinstance(trace, dict) and "traceEvents" in trace, trace
+    events = trace["traceEvents"]
+    assert isinstance(events, list) and events, "empty chrome trace"
+    for ev in events:
+        assert isinstance(ev, dict), f"non-dict event: {ev!r}"
+        want = ("ph", "name", "pid", "tid") if ev.get("ph") == "M" \
+            else ("ph", "name", "pid", "tid", "ts")
+        missing = [k for k in want if k not in ev]
+        assert not missing, f"event missing {missing}: {ev}"
+    complete = [ev for ev in events if ev["ph"] == "X"]
+    assert complete, "no complete ('X') span events in trace"
+    for ev in complete:
+        assert isinstance(ev.get("dur"), (int, float)) and ev["dur"] > 0, \
+            f"X event without positive dur: {ev}"
+    op_tids = {ev["tid"] for ev in complete
+               if ev.get("cat") == "cgraph"
+               and (ev.get("args") or {}).get("method")
+               in ("forward", "backward")}
+    assert len(op_tids) >= 2, \
+        f"expected op spans from both stage lanes, tids={op_tids}"
+    return len(events)
+
+
+def main() -> int:
+    import optax
+
+    import ray_tpu  # noqa: F401 — Cluster below owns init
+    from ray_tpu import cli
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.core.rpc import connect
+    from ray_tpu.train import CompiledPipelineEngine
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    c = Cluster(head_resources={"CPU": 2.0})
+    try:
+        remote = c.add_remote_node(num_cpus=2.0)
+
+        # -- 1) pipeline profile across the node boundary ---------------
+        M, mb = 8, 4
+        fns, params, mbs, tgts = _mlp(2, 16, M=M, mb_size=mb)
+        eng = CompiledPipelineEngine(
+            fns, params, optax.sgd(0.05), num_microbatches=M,
+            channel_bytes=1 << 18,
+            scheduling_strategies=[
+                NodeAffinitySchedulingStrategy(node_id=c.runtime.head_node_id,
+                                               soft=False),
+                NodeAffinitySchedulingStrategy(node_id=remote.node_id,
+                                               soft=False)])
+        eng.step(mbs, tgts)   # compile + prime channels
+        rep = eng.profile(steps=4, tokens_per_step=M * mb,
+                          flops_per_token=1.0e6, peak_flops=1.0e12)
+        ratio = rep.phase_wall_ratio()
+        assert abs(ratio - 1.0) <= 0.10, \
+            (f"pipeline phases !~ step wall: ratio={ratio:.3f} "
+             f"phases={rep.phases} mean_step={rep.mean_step_ms:.2f}ms")
+        assert 0.0 < rep.bubble_frac < 1.0, f"bubble_frac={rep.bubble_frac}"
+        assert rep.tokens_per_s > 0 and rep.mfu > 0, \
+            f"tokens_per_s={rep.tokens_per_s} mfu={rep.mfu}"
+        assert {s["stage"] for s in rep.stages} == {"0.0", "0.1"}, \
+            f"stage rows: {[s['stage'] for s in rep.stages]}"
+        n_ev = _check_chrome_trace(rep.to_chrome_trace())
+        hints = rep.suggest()
+        assert hints and all(isinstance(h, str) for h in hints), hints
+        print(f"pipeline profile OK: ratio={ratio:.3f} "
+              f"bubble={rep.bubble_frac:.3f} mfu={rep.mfu:.2e} "
+              f"trace_events={n_ev} hints={len(hints)}")
+
+        # -- 2) overhead A/B, interleaved rounds, load-aware bar --------
+        def timed(n=3):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                eng.step(mbs, tgts)
+            return (time.perf_counter() - t0) / n
+
+        ratios = []
+        for _ in range(4):
+            on_s = timed()
+            eng.set_flight_recording(False)
+            try:
+                off_s = timed()
+            finally:
+                eng.set_flight_recording(True)
+            ratios.append(on_s / off_s)
+        overhead_pct = (statistics.median(ratios) - 1.0) * 100
+        ncpu = os.cpu_count() or 2
+        try:
+            load = os.getloadavg()[0] / ncpu
+        except OSError:
+            load = 0.0
+        bar = 10.0 if (ncpu >= 4 and load < 0.75) else 25.0
+        assert overhead_pct <= bar, \
+            (f"recorder overhead {overhead_pct:.1f}% > {bar}% bar "
+             f"(ncpu={ncpu} load={load:.2f} rounds={ratios})")
+        print(f"overhead A/B OK: {overhead_pct:+.1f}% "
+              f"(bar {bar}%, ncpu={ncpu}, load {load:.2f})")
+        eng.shutdown()
+
+        # -- 3) concurrent llm stream, passive profile ------------------
+        from ray_tpu.serve.llm import EngineConfig, LLMEngine, build_model
+
+        m, params2 = build_model("gpt-tiny")
+        leng = LLMEngine(m, params2, EngineConfig(
+            max_batch=4, num_blocks=64, block_size=8,
+            max_blocks_per_seq=8, prefill_buckets=(8, 16),
+            max_prefill_tokens_per_step=64), name="perf-smoke")
+        warm = leng.add_request([1, 2, 3], max_tokens=2)
+        leng.run_until_idle(timeout=600)
+        warm.tokens()
+        leng.start()
+        stop_feed = threading.Event()
+        fed = []
+
+        def feeder():
+            i = 0
+            while not stop_feed.is_set():
+                # keep a few streams in flight so every profiled step
+                # has admissions or decodes to account for
+                live = [s for s in fed if s.finish_reason is None]
+                if len(live) < 4:
+                    fed.append(leng.add_request(
+                        [1 + (i % 50), 5, 9, 2], max_tokens=24))
+                    i += 1
+                time.sleep(0.002)
+
+        th = threading.Thread(target=feeder, daemon=True)
+        th.start()
+        try:
+            lrep = leng.profile(steps=8, timeout=60.0)
+        finally:
+            stop_feed.set()
+            th.join(2.0)
+            for s in fed:
+                s.tokens(timeout=60)
+            leng.stop()
+        lratio = lrep.phase_wall_ratio()
+        assert abs(lratio - 1.0) <= 0.10, \
+            (f"llm phases !~ step wall: ratio={lratio:.3f} "
+             f"phases={lrep.phases} steps={lrep.steps}")
+        assert lrep.tokens_per_s > 0, f"tokens_per_s={lrep.tokens_per_s}"
+        assert lrep.occupancy and max(lrep.occupancy) <= 4, lrep.occupancy
+        assert lrep.kv_pressure and all(0 <= p <= 1
+                                        for p in lrep.kv_pressure), \
+            lrep.kv_pressure
+        print(f"llm profile OK: ratio={lratio:.3f} "
+              f"tokens/s={lrep.tokens_per_s:.0f} "
+              f"occ_max={max(lrep.occupancy)} "
+              f"phases={lrep.phases}")
+
+        # -- 4) `ray_tpu top` snapshot over the CLI's own head RPC ------
+        addr = c.runtime.enable_remote_nodes()
+        ch = connect(addr, name="perf-smoke-top")
+        snap = ch.call("perf_snapshot", {}, timeout=30)
+        alive = [n for n in snap["nodes"] if n["alive"]]
+        assert len(alive) >= 2, f"nodes: {snap['nodes']}"
+        assert "ray_tpu_pipeline_step_seconds" in snap["histograms"], \
+            f"histograms: {sorted(snap['histograms'])[:20]}"
+        rendered = cli._render_top(snap, None, 2.0)
+        assert "ray_tpu_pipeline_step_seconds" in rendered \
+            and "nodes" in rendered, rendered[:400]
+        print(f"top snapshot OK: {len(alive)} alive nodes, "
+              f"{len(snap['scalars'])} scalar families, "
+              f"{len(snap['histograms'])} histogram families")
+        print("perf smoke OK")
+        return 0
+    finally:
+        c.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
