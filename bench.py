@@ -247,16 +247,27 @@ def _bench_dispatch() -> dict:
 def _bench_llm_serve() -> dict:
     """LLM serving rows (ISSUE 7): continuous-batching vs sequential
     tokens/s, sustained requests/s, TTFT/TPOT p50/p99 — tracked per
-    round in the BENCH json detail. In-process engine; no cluster."""
+    round in the BENCH json detail. In-process engine; no cluster.
+    Plus the ISSUE 18 tracing A/B: median tokens/s overhead of
+    per-request lifecycle spans (acceptance <= 3%)."""
+    out: dict = {}
     try:
         from bench_core import llm_serve_bench
 
-        return llm_serve_bench(concurrency=4 if SMOKE else 8)
+        out.update(llm_serve_bench(concurrency=4 if SMOKE else 8))
     except Exception:
         import traceback
 
         traceback.print_exc()  # a broken engine must not look like 0
-        return {}
+    try:
+        from bench_core import llm_trace_overhead_bench
+
+        out.update(llm_trace_overhead_bench(concurrency=4 if SMOKE else 8))
+    except Exception:
+        import traceback
+
+        traceback.print_exc()  # a broken tracer must not look like 0
+    return out
 
 
 def _bench_traffic() -> dict:

@@ -257,6 +257,58 @@ def llm_serve_bench(n_requests: int = 0, concurrency: int = 8,
     }
 
 
+def llm_trace_overhead_bench(concurrency: int = 8,
+                             rounds: int = 3) -> dict:
+    """Distributed-tracing A/B on the continuous-batching loop (ISSUE
+    18 acceptance: per-request lifecycle spans — admit/prefill/decode
+    aggregates/retire, plus exemplar-tagged TTFT/TPOT observes — must
+    cost <= 3% tokens/s; requests WITHOUT a trace context must not pay
+    at all, since every span site is gated on ``req.trace_ctx``).
+    Interleaved traced/untraced rounds on one engine so compile state
+    and box drift cancel; reports the median overhead."""
+    import statistics
+
+    from ray_tpu.serve.llm import EngineConfig, LLMEngine, build_model
+    from ray_tpu.util import tracing
+
+    n_requests = concurrency * (2 if SMOKE else 3)
+    max_tokens = 16 if SMOKE else 32
+    m, params = build_model("gpt-tiny")
+    eng = LLMEngine(m, params, EngineConfig(
+        max_batch=concurrency, num_blocks=max(64, concurrency * 8),
+        block_size=8, max_blocks_per_seq=8, prefill_buckets=(8, 16),
+        max_prefill_tokens_per_step=64), name="bench-trace")
+    s = eng.add_request([1, 2, 3], max_tokens=2)
+    eng.run_until_idle(timeout=600)       # warmup compile
+    s.tokens()
+    prompts = [[1 + (i % 50), 5, 9, 2] for i in range(n_requests)]
+
+    def run(traced: bool) -> float:
+        t0 = time.perf_counter()
+        streams = [eng.add_request(
+            p, max_tokens=max_tokens,
+            trace_ctx=((tracing.new_trace_id(), tracing.new_span_id())
+                       if traced else None)) for p in prompts]
+        eng.run_until_idle(timeout=900)
+        wall = time.perf_counter() - t0
+        total = sum(len(st.tokens(timeout=60)) for st in streams)
+        return total / wall
+
+    run(True)                             # prime both paths
+    ratios = []
+    for _ in range(rounds):
+        on = run(True)
+        off = run(False)
+        ratios.append(off / on)
+    eng.pool.check_leaks()
+    overhead_pct = (statistics.median(ratios) - 1.0) * 100
+    rec = {"metric": "llm_trace_overhead_pct",
+           "value": round(overhead_pct, 2), "unit": "%"}
+    print(json.dumps(rec), flush=True)
+    return {"llm_trace_overhead_pct": round(overhead_pct, 2),
+            "llm_trace_overhead_rounds": [round(r, 4) for r in ratios]}
+
+
 def prefix_cache_bench(prefix_len: int = 0, suffix_len: int = 32,
                        concurrency: int = 8, max_tokens: int = 8) -> dict:
     """Radix-prefix-cache rows (ISSUE 14 acceptance): ``concurrency``

@@ -178,6 +178,155 @@ def _cmd_logs(args) -> int:
         closer()
 
 
+def _trace_backend(args):
+    """-> call(method, payload) against the in-process head or, with
+    --address, a running head over TCP (plus a closer)."""
+    if getattr(args, "address", ""):
+        ch = _head_channel(args)
+        return (lambda m, q: ch.call(m, q, timeout=None)), ch.close
+    from .core import runtime as runtime_mod
+
+    rt = runtime_mod.maybe_runtime()
+    if rt is None or not hasattr(rt, "gcs"):
+        return None, None
+
+    def call(method, payload):
+        if method == "traces_query":
+            return rt.gcs.traces.query(**(payload or {}))
+        if method == "trace_get":
+            return rt.gcs.traces.get(payload)
+        from .util.state import _span_trace_events
+
+        tr = rt.gcs.traces.get(payload)
+        return (_span_trace_events(list(tr.get("spans_detail", ())))
+                if tr else None)
+
+    return call, (lambda: None)
+
+
+# span attributes worth a column in the tree (everything else renders
+# only under --verbose); order = display order
+_TRACE_ATTRS = ("deployment", "replica", "engine", "method", "session",
+                "request_id", "status", "reason", "hop", "tokens",
+                "cached_tokens", "cache_hit_tokens", "cache_miss_tokens",
+                "prompt", "generated", "preemptions", "error")
+
+
+def _render_trace_tree(detail: dict, verbose: bool = False) -> str:
+    """Span tree with per-hop wall/gap breakdown: each line shows the
+    span's offset from trace start, its wall duration, and (when > 1 ms)
+    the GAP since its parent's start / previous sibling's end — where
+    the request sat in a queue or on the wire between hops."""
+    spans = list(detail.get("spans_detail", ()))
+    t0 = min((float(s.get("time") or 0.0) for s in spans),
+             default=float(detail.get("start") or 0.0))
+    ids = {s.get("span_id") for s in spans}
+    kids: dict = {}
+    roots = []
+    for s in spans:
+        p = s.get("parent_span_id")
+        if p and p in ids:
+            kids.setdefault(p, []).append(s)
+        else:
+            roots.append(s)
+    lines = [
+        f"trace {detail.get('trace_id', '')} — "
+        f"{float(detail.get('duration_s') or 0.0) * 1e3:.1f}ms — "
+        f"{len(spans)} span(s), {detail.get('procs', 1)} process(es)"
+        + (f" — kept={detail['keep_reason']}"
+           if detail.get("keep_reason") else "")
+        + ("" if detail.get("done") else " — OPEN")]
+
+    def fmt(s, depth, prev_end):
+        b = float(s.get("time") or 0.0)
+        e = float(s.get("end_time") or b)
+        attrs = dict(s.get("attributes") or {})
+        gap = b - (prev_end if prev_end is not None else b)
+        cols = [f"{'  ' * depth}{s.get('name', 'span'):<{28 - 2 * depth}s}",
+                f"+{(b - t0) * 1e3:8.1f}ms", f"{(e - b) * 1e3:9.1f}ms",
+                f"gap={gap * 1e3:.1f}ms" if gap > 1e-3 else " " * 9]
+        shown = [(k, attrs[k]) for k in _TRACE_ATTRS
+                 if attrs.get(k) not in (None, "", 0, False)]
+        if verbose:
+            shown += sorted((k, v) for k, v in attrs.items()
+                            if k not in _TRACE_ATTRS)
+        cols.append(" ".join(f"{k}={v}" for k, v in shown))
+        lines.append("  " + " ".join(cols).rstrip())
+        prev = None  # first child gaps against THIS span's start
+        for c in sorted(kids.get(s.get("span_id"), ()),
+                        key=lambda x: float(x.get("time") or 0.0)):
+            fmt(c, depth + 1, prev if prev is not None else b)
+            prev = float(c.get("end_time") or c.get("time") or 0.0)
+
+    for r in sorted(roots, key=lambda x: float(x.get("time") or 0.0)):
+        fmt(r, 0, None)
+    return "\n".join(lines)
+
+
+def _fmt_trace_summary(t: dict) -> str:
+    dur = float(t.get("duration_s") or 0.0)
+    return (f"{t.get('trace_id', ''):32s}  {dur * 1e3:9.1f}ms  "
+            f"spans={t.get('spans', 0):<4d} procs={t.get('procs', 1):<2d} "
+            f"kept={t.get('keep_reason') or '-':8s} "
+            f"{t.get('deployment') or '-':16s} "
+            f"req={t.get('request_id') or '-'}")
+
+
+def _cmd_trace(args) -> int:
+    """`ray_tpu trace <id> | --request R | --session S | --slowest N`
+    — render one stored trace's span tree (per-hop wall/gap breakdown)
+    or list tail-kept trace summaries; ids may be unique hex prefixes
+    (e.g. straight off a /metrics exemplar)."""
+    call, closer = _trace_backend(args)
+    if call is None:
+        return _no_runtime_help()
+    try:
+        if args.trace_id:
+            if args.chrome:
+                events = call("trace_chrome", args.trace_id)
+                if not events:
+                    print(f"no stored trace matches {args.trace_id!r}",
+                          file=sys.stderr)
+                    return 1
+                with open(args.chrome, "w") as f:
+                    json.dump(events, f)
+                print(f"wrote {len(events)} trace events to {args.chrome} "
+                      f"(open in chrome://tracing or "
+                      f"https://ui.perfetto.dev)")
+                return 0
+            detail = call("trace_get", args.trace_id)
+            if detail is None:
+                print(f"no stored trace matches {args.trace_id!r} (tail-"
+                      f"sampling keeps errors/failovers/preempts/slow "
+                      f"requests; see `trace_sample_rate`)",
+                      file=sys.stderr)
+                return 1
+            print(_render_trace_tree(detail, verbose=args.verbose))
+            return 0
+        q = {"request_id": args.request or None,
+             "session": args.session or None,
+             "deployment": args.deployment or None,
+             "slowest": args.slowest or None, "limit": args.limit}
+        res = call("traces_query", q)
+        for t in res.get("traces", ()):
+            print(_fmt_trace_summary(t))
+        if not args.follow:
+            if not res.get("traces"):
+                print("(no stored traces match)", file=sys.stderr)
+            return 0
+        cursor = res.get("cursor", 0)
+        while True:
+            res = call("traces_query",
+                       {**q, "since": cursor, "follow_timeout": 10.0})
+            cursor = res.get("cursor", cursor)
+            for t in res.get("traces", ()):
+                print(_fmt_trace_summary(t))
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        closer()
+
+
 def _cmd_stack(args) -> int:
     """`ray_tpu stack` — merged thread stacks of the driver and every
     live worker (ref: `ray stack`)."""
@@ -410,6 +559,18 @@ def _render_top(snap: dict, prev, interval: float) -> str:
             name = tag.split("=", 1)[1] if "=" in tag else (tag or "-")
             lines.append(f"  {name:28s} ok={o:<10.0f} violated={v:<8.0f} "
                          f"({pct:.1f}% within SLO)")
+    traces = snap.get("traces")
+    if traces:
+        lines.append("")
+        slow = traces.get("slowest_active")
+        lines.append(
+            f"tracing: kept={traces.get('traces', 0)} "
+            f"active={traces.get('active', 0)} "
+            f"dropped(sampled={traces.get('dropped_sampled', 0)} "
+            f"evicted={traces.get('dropped_evicted', 0)})"
+            + (f"  slowest-active={slow['trace_id']} "
+               f"({slow['name']} {slow['age_s']:.1f}s) — "
+               f"`ray_tpu trace {slow['trace_id'][:12]}`" if slow else ""))
     lines.append("")
     lines.append(f"  {'series':44s} {'tags':26s} {'value':>12s} "
                  f"{'rate/s':>9s}")
@@ -594,6 +755,33 @@ def main(argv=None) -> int:
                     help="head HOST:PORT (omit for the in-process head)")
     lg.add_argument("--authkey", default="")
     lg.set_defaults(fn=_cmd_logs)
+
+    tr = sub.add_parser(
+        "trace", help="render a stored request trace's span tree, or "
+                      "list tail-kept traces (--request/--session/"
+                      "--slowest); ids accept unique hex prefixes, e.g. "
+                      "off a /metrics exemplar")
+    tr.add_argument("trace_id", nargs="?", default="",
+                    help="trace id (hex prefix) to render as a span tree")
+    tr.add_argument("--request", default="", help="filter by request id")
+    tr.add_argument("--session", default="", help="filter by session id")
+    tr.add_argument("--deployment", default="",
+                    help="filter by deployment name")
+    tr.add_argument("--slowest", type=int, default=0,
+                    help="show the N slowest kept traces")
+    tr.add_argument("--limit", type=int, default=50)
+    tr.add_argument("--follow", "-f", action="store_true",
+                    help="keep streaming newly kept traces (long-poll)")
+    tr.add_argument("--chrome", default="",
+                    help="with a trace id: write chrome://tracing JSON "
+                         "here instead of rendering the tree")
+    tr.add_argument("--verbose", "-v", action="store_true",
+                    help="show every span attribute, not just the "
+                         "common columns")
+    tr.add_argument("--address", default="",
+                    help="head HOST:PORT (omit for the in-process head)")
+    tr.add_argument("--authkey", default="")
+    tr.set_defaults(fn=_cmd_trace)
 
     sk = sub.add_parser(
         "stack", help="dump merged thread stacks of the driver and every "

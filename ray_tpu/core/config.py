@@ -89,6 +89,16 @@ _define("capture_worker_logs", 1,
 _define("log_store_max_bytes", 16 * 1024 * 1024,
         "byte budget for the head's attributed log store; oldest records "
         "evict first (ref: dashboard log retention)")
+_define("trace_store_max_bytes", 8 * 1024 * 1024,
+        "byte budget for the head's request-trace store; oldest traces "
+        "evict first (counted in ray_tpu_traces_dropped_total)")
+_define("trace_sample_rate", 1.0,
+        "tail-sampling keep probability for ordinary completed traces; "
+        "errors, failovers, preemptions and slow requests are ALWAYS "
+        "kept regardless of this rate")
+_define("trace_slow_threshold_s", 1.0,
+        "completed traces slower than this are always tail-kept when the "
+        "root span carries no per-deployment slo_target attribute")
 _define("log_batch_lines", 200,
         "worker-side log forwarder flushes when this many lines are "
         "pending (or on the flush interval, whichever first)")

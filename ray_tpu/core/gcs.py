@@ -187,8 +187,13 @@ class Gcs:
         # byte-budgeted with long-poll follow — the `ray logs` analog
         # (ref: dashboard/modules/log/log_manager.py; gcs as the index)
         from .log_store import LogStore
+        from .trace_store import TraceStore
 
         self.logs = LogStore(max_bytes=int(config.log_store_max_bytes))
+        self.traces = TraceStore(
+            max_bytes=int(config.trace_store_max_bytes),
+            sample_rate=float(config.trace_sample_rate),
+            slow_threshold_s=float(config.trace_slow_threshold_s))
         self._storage_path = storage_path
         # set by the Runtime: asks the scheduler to (re)create an actor
         self.schedule_actor_cb: Optional[Callable[[ActorInfo], None]] = None
@@ -435,6 +440,10 @@ class Gcs:
 
     def add_task_event(self, event: dict) -> None:
         shard = self._event_shard(event)
+        if event.get("state") == "SPAN" and event.get("trace_id"):
+            # spans additionally feed the tail-sampled trace store (the
+            # shard ring keeps them too, for timeline() flow arrows)
+            self.traces.add_span(event)
         observe = None  # (histogram, seconds, name) — fired outside locks
         with shard.lock:
             shard.events.append(event)

@@ -455,6 +455,16 @@ class DriverRuntime:
             # running head (ref: `ray logs` / `ray stack` CLI)
             if method == "logs_query":
                 return self.query_logs(**(payload or {}))
+            if method == "traces_query":
+                return self.gcs.traces.query(**(payload or {}))
+            if method == "trace_get":
+                return self.gcs.traces.get(payload)
+            if method == "trace_chrome":
+                from ..util.state import _span_trace_events
+
+                tr = self.gcs.traces.get(payload)
+                return (_span_trace_events(list(tr.get("spans_detail", ())))
+                        if tr else None)
             if method == "stack_report":
                 return self.stack_report(
                     float((payload or {}).get("timeout", 5.0)))
@@ -2640,6 +2650,16 @@ class DriverRuntime:
             return None
         if method == "logs_query":
             return self.query_logs(**(payload or {}))
+        if method == "traces_query":
+            return self.gcs.traces.query(**(payload or {}))
+        if method == "trace_get":
+            return self.gcs.traces.get(payload)
+        if method == "trace_chrome":
+            from ..util.state import _span_trace_events
+
+            tr = self.gcs.traces.get(payload)
+            return (_span_trace_events(list(tr.get("spans_detail", ())))
+                    if tr else None)
         if method == "cgraph_send":
             # compiled-graph cross-node edge: producer -> head -> consumer
             return self._cgraph_route(payload)

@@ -60,14 +60,17 @@ _API = {
     "stacks": lambda: state_api.stack_report(timeout=3.0),
     "log_store": state_api.log_store_stats,
     "timeline": state_api.timeline,
+    "traces": lambda: state_api.traces(limit=100),
+    "trace_store": state_api.trace_store_stats,
 }
 
 # parameterized drill-downs: /api/actor/<id>, /api/task/<id>,
-# /api/logs/<worker_id_prefix>
+# /api/logs/<worker_id_prefix>, /api/trace/<trace_id_prefix>
 _API_ONE = {
     "actor": state_api.actor_detail,
     "task": state_api.task_detail,
     "logs": lambda wid: state_api.recent_logs(worker_id=wid, limit=400),
+    "trace": state_api.trace_detail,
 }
 
 _HISTORY_LEN = 120  # 2s cadence -> 4 minutes of sparkline
@@ -303,6 +306,29 @@ class Dashboard:
                             limit=int(q.get("limit", 400)))["records"]
                         self._send(200, json.dumps(
                             rows, default=str).encode(),
+                            "application/json")
+                    except Exception as e:  # noqa: BLE001
+                        self._send(500, json.dumps(
+                            {"error": repr(e)}).encode(),
+                            "application/json")
+                    return
+                if path == "api/traces" and "?" in self.path:
+                    # filtered trace queries: /api/traces?request=&
+                    # session=&deployment=&slowest=N&limit=N
+                    from urllib.parse import parse_qs, urlparse
+
+                    q = {k: v[0] for k, v in
+                         parse_qs(urlparse(self.path).query).items()}
+                    try:
+                        res = state_api.traces(
+                            request_id=q.get("request") or None,
+                            session=q.get("session") or None,
+                            deployment=q.get("deployment") or None,
+                            slowest=(int(q["slowest"])
+                                     if q.get("slowest") else None),
+                            limit=int(q.get("limit", 100)))
+                        self._send(200, json.dumps(
+                            res, default=str).encode(),
                             "application/json")
                     except Exception as e:  # noqa: BLE001
                         self._send(500, json.dumps(

@@ -40,7 +40,7 @@ def head_snapshot(runtime) -> dict:
         if fam.kind == "histogram":
             continue  # summarized below with percentiles
         series = scalars.setdefault(fam.name, {})
-        for suffix, tags, value in fam.samples:
+        for suffix, tags, value, _ex in fam.samples:
             if suffix:
                 continue
             key = _fmt_tags(tags)
@@ -56,5 +56,14 @@ def head_snapshot(runtime) -> dict:
             continue
         hists[name] = {k: summ.get(k) for k in
                        ("count", "mean", "p50", "p95", "p99")}
+    traces = None
+    try:
+        ts = runtime.gcs.traces
+        st = ts.stats()
+        if st.get("total_traces", 0):  # tracing actually on: show it
+            traces = dict(st)
+            traces["slowest_active"] = ts.slowest_active()
+    except Exception:
+        pass
     return {"time": time.time(), "nodes": nodes, "scalars": scalars,
-            "histograms": hists}
+            "histograms": hists, "traces": traces}

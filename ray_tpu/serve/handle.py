@@ -21,6 +21,7 @@ import ray_tpu
 from ray_tpu.exceptions import (ActorDiedError, ActorUnavailableError,
                                 ObjectLostError, WorkerCrashedError)
 from ray_tpu.util import metrics as _metrics
+from ray_tpu.util import tracing as _tracing
 from ray_tpu.util.retry import RetryPolicy
 
 from .controller import CONTROLLER_NAME
@@ -176,7 +177,7 @@ class FailoverResponseGenerator:
 
     def __init__(self, handle: "DeploymentHandle", method: str, args,
                  kwargs, mux_id: str, resume, deadline: float,
-                 session_id: str = ""):
+                 session_id: str = "", trace_ctx=None):
         self._handle = handle
         self._method = method
         self._args = args
@@ -185,6 +186,8 @@ class FailoverResponseGenerator:
         self._resume = resume
         self._deadline = deadline
         self._session_id = session_id
+        self._trace_ctx = trace_ctx
+        self._hop_started = 0.0
         self._gen: Optional[DeploymentResponseGenerator] = None
         self._replica = None
         self._yielded: list = []
@@ -200,9 +203,11 @@ class FailoverResponseGenerator:
     def _ensure_stream(self) -> None:
         if self._gen is not None:
             return
+        self._hop_started = time.time()
         self._gen, self._replica = self._handle._start_stream(
             self._method, self._args, self._kwargs, self._mux_id,
-            self._deadline, self._session_id)
+            self._deadline, self._session_id,
+            trace_ctx=self._trace_ctx)
         self._handle._assign_stream(self._key, self._replica._actor_id)
 
     def _finish(self) -> None:
@@ -225,6 +230,21 @@ class FailoverResponseGenerator:
                 self._gen = None
                 self._replica = None
                 self.failovers += 1
+                if self._trace_ctx is not None:
+                    # the failed hop lands as a child span on the SAME
+                    # trace (the trace store always tail-keeps failover
+                    # traces); the resumed hop's spans follow under the
+                    # same trace id via the re-routed TRACE_KWARG
+                    try:
+                        _tracing.record_span(
+                            "serve.failover", self._trace_ctx,
+                            self._hop_started,
+                            deployment=self._handle._name,
+                            hop=self.failovers,
+                            yielded=len(self._yielded),
+                            error=type(e).__name__)
+                    except Exception:
+                        pass
                 try:
                     from ray_tpu.perf.recorder import get_recorder
 
@@ -546,25 +566,47 @@ class DeploymentHandle:
     # -- the router worker ----------------------------------------------------
 
     def _route_blocking(self, method: str, args, kwargs, deadline: float,
-                        mux_id: str = "", session_id: str = ""):
+                        mux_id: str = "", session_id: str = "",
+                        trace_ctx=None):
         import ray_tpu.core.runtime as runtime_mod
 
         if mux_id:
             from .multiplex import MUX_KWARG
 
             kwargs = {**kwargs, MUX_KWARG: mux_id}
+        route_sid = None
+        if trace_ctx is not None:
+            # the route span's id crosses into the replica as a reserved
+            # kwarg (the MUX_KWARG pattern): replica and engine spans
+            # parent under it, stitching one trace across processes
+            route_sid = _tracing.new_span_id()
+            kwargs = {**kwargs,
+                      _tracing.TRACE_KWARG: (trace_ctx[0], route_sid)}
         rt = runtime_mod.get_runtime()
         t_start = time.perf_counter()
+        t_wall = time.time()
         ok = False
+        err = ""
         try:
             out = self._route_with_retries(rt, method, args, kwargs,
                                            deadline, mux_id, session_id)
             ok = True
             return out
+        except BaseException as e:  # noqa: BLE001 — re-raised
+            err = type(e).__name__
+            raise
         finally:
             dt = time.perf_counter() - t_start
-            _H_SERVE_REQUEST.observe(dt, tags={"deployment": self._name})
             slo = self._slo_target
+            _H_SERVE_REQUEST.observe(
+                dt, tags={"deployment": self._name},
+                exemplar=trace_ctx[0] if trace_ctx else None)
+            if trace_ctx is not None:
+                _tracing.record_span(
+                    "serve.route", trace_ctx, t_wall,
+                    span_id=route_sid, deployment=self._name,
+                    session=session_id, error=err,
+                    **({"slo_target": slo} if slo is not None else {}))
             if slo is not None:
                 # an errored request never met its SLO, whatever the clock
                 # says
@@ -618,8 +660,11 @@ class DeploymentHandle:
                     max_workers=16, thread_name_prefix=f"router-{self._name}")
             router = self._router
         deadline = time.monotonic() + 300.0
+        # the submitter's trace context must ride into the router thread
+        # as data — contextvars don't cross ThreadPoolExecutor hops
+        trace_ctx = _tracing.current_context()
         fut = router.submit(self._route_blocking, method, args, kwargs,
-                            deadline, mux_id, session_id)
+                            deadline, mux_id, session_id, trace_ctx)
         return DeploymentResponse(fut)
 
     def _pick_replica_blocking(self, mux_id: str, deadline: float,
@@ -648,9 +693,16 @@ class DeploymentHandle:
                 self._inflight[aid] = c
 
     def _start_stream(self, method: str, args, kwargs, mux_id: str,
-                      deadline: float, session_id: str = ""):
+                      deadline: float, session_id: str = "",
+                      trace_ctx=None):
         """-> (DeploymentResponseGenerator, replica). One routed
         streaming submission; the caller owns failover policy."""
+        route_sid = None
+        t_wall = time.time()
+        if trace_ctx is not None:
+            route_sid = _tracing.new_span_id()
+            kwargs = {**kwargs,
+                      _tracing.TRACE_KWARG: (trace_ctx[0], route_sid)}
         replica = self._pick_replica_blocking(mux_id, deadline, session_id)
         aid = replica._actor_id
         try:
@@ -658,6 +710,16 @@ class DeploymentHandle:
                 num_returns="streaming").remote(method, args, kwargs)
         finally:
             self._dec_inflight(aid)
+            if trace_ctx is not None:
+                # the route span covers replica pick + stream submission
+                # (chunk pulls are the consumer's own timeline); engine
+                # spans for this hop parent under route_sid
+                slo = self._slo_target
+                _tracing.record_span(
+                    "serve.route", trace_ctx, t_wall, span_id=route_sid,
+                    deployment=self._name, session=session_id,
+                    streaming=True,
+                    **({"slo_target": slo} if slo is not None else {}))
         return DeploymentResponseGenerator(ref_gen), replica
 
     def _submit_streaming(self, method: str, args, kwargs,
@@ -681,12 +743,18 @@ class DeploymentHandle:
 
             kwargs = {**kwargs, MUX_KWARG: mux_id}
         deadline = time.monotonic() + 300.0
+        # captured HERE (the submitting thread still holds the proxy's
+        # contextvar); it rides the generator as data because pulls may
+        # happen from any thread
+        trace_ctx = _tracing.current_context()
         if resume is not None:
             return FailoverResponseGenerator(self, method, args, kwargs,
                                              mux_id, resume, deadline,
-                                             session_id)
+                                             session_id,
+                                             trace_ctx=trace_ctx)
         gen, _replica = self._start_stream(method, args, kwargs, mux_id,
-                                           deadline, session_id)
+                                           deadline, session_id,
+                                           trace_ctx=trace_ctx)
         return gen
 
     def stream_assignments(self) -> Dict[int, Any]:

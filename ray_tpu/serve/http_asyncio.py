@@ -22,11 +22,13 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
 import ray_tpu
+from ray_tpu.util import tracing
 
 _MAX_BODY = 64 << 20  # 64 MiB request cap
 _MAX_HEADER = 64 << 10
@@ -162,11 +164,21 @@ class AsyncHTTPProxy:
 
         sess = extract_session(q, data)
         stream_mode = (q.get("stream") or ["0"])[0]
+        # trace ingress: continue the client's W3C traceparent or open
+        # a fresh root. Context travels as DATA from here (the handle
+        # call runs on the pool, where a loop-thread contextvar would
+        # not follow); the root span records once the reply is done.
+        parent = tracing.parse_traceparent(headers.get("traceparent"))
+        trace_id = parent[0] if parent else tracing.new_trace_id()
+        trace_ctx = (trace_id, tracing.new_span_id())
+        root_parent = (trace_id, parent[1] if parent else None)
+        t0 = time.time()
         if stream_mode in ("1", "true", "sse"):
             try:
                 ok = await self._stream_response(writer, name, data, mux,
                                                  sess,
-                                                 sse=stream_mode == "sse")
+                                                 sse=stream_mode == "sse",
+                                                 trace_ctx=trace_ctx)
             except Exception as e:  # noqa: BLE001 — pre-header failure
                 # nothing on the wire yet (submission/iterator setup
                 # failed): a normal 500 is still possible
@@ -174,7 +186,11 @@ class AsyncHTTPProxy:
                 self._write_json(writer, 500,
                                  {"error": f"{type(e).__name__}: {e}"},
                                  keep)
+                self._end_span(root_parent, trace_ctx, t0, name, sess,
+                               True, 500, f"{type(e).__name__}: {e}")
                 return keep
+            self._end_span(root_parent, trace_ctx, t0, name, sess, True,
+                           200 if ok else 0, "" if ok else "stream_failed")
             if not ok:
                 # mid-stream failure: headers were already sent and the
                 # connection was closed — a late 500 would corrupt the
@@ -184,29 +200,55 @@ class AsyncHTTPProxy:
             return keep
         try:
             result = await self._in_pool(self._call_blocking, name, data,
-                                         mux, sess)
-            self._write_json(writer, 200, _jsonable(result), keep)
+                                         mux, sess, trace_ctx)
+            self._write_json(writer, 200, _jsonable(result), keep,
+                             trace_ctx)
+            self._end_span(root_parent, trace_ctx, t0, name, sess, False,
+                           200, "")
         except Exception as e:  # noqa: BLE001
             self._errors += 1
             self._write_json(writer, 500,
-                             {"error": f"{type(e).__name__}: {e}"}, keep)
+                             {"error": f"{type(e).__name__}: {e}"}, keep,
+                             trace_ctx)
+            self._end_span(root_parent, trace_ctx, t0, name, sess, False,
+                           500, f"{type(e).__name__}: {e}")
         return keep
 
+    @staticmethod
+    def _end_span(root_parent, trace_ctx, t0, name, sess, stream,
+                  status, err) -> None:
+        tracing.record_span(
+            "http.request", root_parent, t0, span_id=trace_ctx[1],
+            ingress=True, deployment=name, session=sess, stream=stream,
+            status=status, error=err)
+
     async def _stream_response(self, writer, name, data, mux,
-                               sess: str = "", sse: bool = False) -> bool:
+                               sess: str = "", sse: bool = False,
+                               trace_ctx=None) -> bool:
         """Chunked streaming: generator items are pulled on the pool
         (each next() blocks on the replica) and written as they arrive —
         NDJSON lines by default, SSE `data:` frames with a terminal
         `event: done` under ?stream=sse. Exceptions BEFORE the headers
         go out propagate (caller sends a 500); a mid-stream failure
         closes the connection and returns False."""
-        gen = self._get_handle(name).options(
-            stream=True, multiplexed_model_id=mux,
-            session_id=sess).remote(data)
+        # activate around the synchronous submission only (no await in
+        # between, so no other handler can observe the contextvar): the
+        # handle captures the context into the stream generator, where
+        # it travels as data across pool-thread pulls
+        token = tracing.activate(trace_ctx)
+        try:
+            gen = self._get_handle(name).options(
+                stream=True, multiplexed_model_id=mux,
+                session_id=sess).remote(data)
+        finally:
+            tracing.deactivate(token)
         ctype = b"text/event-stream" if sse else b"application/x-ndjson"
+        tp = (b"traceparent: "
+              + tracing.format_traceparent(trace_ctx).encode()
+              + b"\r\n") if trace_ctx else b""
         writer.write(b"HTTP/1.1 200 OK\r\n"
-                     b"Content-Type: " + ctype + b"\r\n"
-                     b"Transfer-Encoding: chunked\r\n\r\n")
+                     b"Content-Type: " + ctype + b"\r\n" + tp
+                     + b"Transfer-Encoding: chunked\r\n\r\n")
         _SENTINEL = object()
 
         def pull():
@@ -251,11 +293,16 @@ class AsyncHTTPProxy:
     def _in_pool(self, fn, *args):
         return self._loop.run_in_executor(self._pool, fn, *args)
 
-    def _call_blocking(self, name: str, data, mux: str, sess: str = ""):
+    def _call_blocking(self, name: str, data, mux: str, sess: str = "",
+                       trace_ctx=None):
         h = self._get_handle(name)
         if mux or sess:
             h = h.options(multiplexed_model_id=mux, session_id=sess)
-        return ray_tpu.get(h.remote(data), timeout=60)
+        token = tracing.activate(trace_ctx)
+        try:
+            return ray_tpu.get(h.remote(data), timeout=60)
+        finally:
+            tracing.deactivate(token)
 
     def _get_handle(self, name: str):
         from .handle import DeploymentHandle
@@ -274,14 +321,18 @@ class AsyncHTTPProxy:
                             timeout=10)}
 
     @staticmethod
-    def _write_json(writer, code: int, payload, keep: bool) -> None:
+    def _write_json(writer, code: int, payload, keep: bool,
+                    trace_ctx=None) -> None:
         body = json.dumps(payload).encode()
         reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
                   500: "Internal Server Error"}.get(code, "")
         conn = "keep-alive" if keep else "close"
+        tp = ("traceparent: "
+              + tracing.format_traceparent(trace_ctx) + "\r\n") \
+            if trace_ctx else ""
         writer.write((f"HTTP/1.1 {code} {reason}\r\n"
                       f"Content-Type: application/json\r\n"
-                      f"Content-Length: {len(body)}\r\n"
+                      f"Content-Length: {len(body)}\r\n" + tp +
                       f"Connection: {conn}\r\n\r\n").encode())
         writer.write(body)
 

@@ -13,11 +13,13 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict
 from urllib.parse import parse_qs, urlparse
 
 import ray_tpu
+from ray_tpu.util import tracing
 
 
 class HTTPProxy:
@@ -41,6 +43,13 @@ class HTTPProxy:
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                ctx = getattr(self, "_trace_ctx", None)
+                if ctx is not None:
+                    # egress: clients correlate their request with the
+                    # stored trace (`ray_tpu trace <id>`)
+                    self.send_header("traceparent",
+                                     tracing.format_traceparent(ctx))
+                self._status = code
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -59,6 +68,10 @@ class HTTPProxy:
                 self.send_header("Transfer-Encoding", "chunked")
                 if sse:
                     self.send_header("Cache-Control", "no-cache")
+                ctx = getattr(self, "_trace_ctx", None)
+                if ctx is not None:
+                    self.send_header("traceparent",
+                                     tracing.format_traceparent(ctx))
                 self.end_headers()
 
                 def chunk(b: bytes) -> None:
@@ -99,6 +112,22 @@ class HTTPProxy:
                     return
                 from .handle import extract_session
 
+                # trace ingress: continue the client's W3C traceparent
+                # or open a fresh root. The dispatch runs on this
+                # handler thread, so activating the contextvar here
+                # lets handle._submit capture the context for its
+                # router thread; the root span records at the end of
+                # the reply (stream included) and completes the trace.
+                parent = tracing.parse_traceparent(
+                    self.headers.get("traceparent"))
+                trace_id = parent[0] if parent else tracing.new_trace_id()
+                self._trace_ctx = (trace_id, tracing.new_span_id())
+                self._status = 200
+                t0 = time.time()
+                err = ""
+                sess = ""
+                stream_mode = "0"
+                token = tracing.activate(self._trace_ctx)
                 try:
                     h = proxy._get_handle(name)
                     mux = (q.get("model_id") or [""])[0]
@@ -111,15 +140,25 @@ class HTTPProxy:
                                         multiplexed_model_id=mux,
                                         session_id=sess).remote(data)
                         self._stream_reply(gen, sse=stream_mode == "sse")
-                        return
-                    if mux or sess:
-                        h = h.options(multiplexed_model_id=mux,
-                                      session_id=sess)
-                    ref = h.remote(data)
-                    result = ray_tpu.get(ref, timeout=60)
-                    self._reply(200, proxy._jsonable(result))
+                    else:
+                        if mux or sess:
+                            h = h.options(multiplexed_model_id=mux,
+                                          session_id=sess)
+                        ref = h.remote(data)
+                        result = ray_tpu.get(ref, timeout=60)
+                        self._reply(200, proxy._jsonable(result))
                 except Exception as e:  # noqa: BLE001 — surfaced as 500
-                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    err = f"{type(e).__name__}: {e}"
+                    self._reply(500, {"error": err})
+                finally:
+                    tracing.deactivate(token)
+                    tracing.record_span(
+                        "http.request",
+                        (trace_id, parent[1] if parent else None), t0,
+                        span_id=self._trace_ctx[1], ingress=True,
+                        deployment=name, session=sess,
+                        stream=stream_mode not in ("0", ""),
+                        status=self._status, error=err)
 
             def do_POST(self):  # noqa: N802
                 n = int(self.headers.get("Content-Length") or 0)

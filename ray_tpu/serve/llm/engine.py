@@ -49,6 +49,7 @@ import numpy as np
 
 from ...perf.recorder import get_recorder as _get_recorder
 from ...util import metrics as _metrics
+from ...util import tracing as _tracing
 from .kv_cache import BlockPool, blocks_for_tokens
 
 _FLREC = _get_recorder()
@@ -199,13 +200,23 @@ class Request:
     generated: List[int] = field(default_factory=list)
     first_token_at: Optional[float] = None
     preemptions: int = 0
+    # distributed tracing: (trace_id, parent_span_id) captured at
+    # add_request — the scheduler thread emits lifecycle spans against
+    # it (contextvars can't cross the submit->scheduler thread hop).
+    # Wall-clock stamps ride along because Span times are time.time()
+    # while the engine's latency math stays on perf_counter.
+    trace_ctx: Optional[tuple] = None
+    submitted_wall: float = 0.0
+    queued_wall: float = 0.0           # last enqueue (submit or preempt)
+    cache_hit_tokens: int = 0
+    cache_miss_tokens: int = 0
 
 
 class _Sequence:
     """A running request's batch-slot state."""
 
     __slots__ = ("req", "slot", "blocks", "seq_len", "pending",
-                 "last_emit_at", "tokens")
+                 "last_emit_at", "tokens", "dec_count", "dec_wall0")
 
     def __init__(self, req: Request, slot: int, blocks: List[int],
                  seq_len: int, pending: int,
@@ -216,6 +227,8 @@ class _Sequence:
         self.seq_len = seq_len         # tokens whose KV is in cache
         self.pending = pending         # emitted token awaiting its KV write
         self.last_emit_at = time.perf_counter()
+        self.dec_count = 0             # decode steps since last span flush
+        self.dec_wall0 = 0.0
         # the token identity of the resident KV, position by position —
         # what the prefix cache indexes at retire/preempt time
         self.tokens: List[int] = list(tokens if tokens is not None
@@ -364,7 +377,8 @@ class LLMEngine:
 
     def add_request(self, prompt: Sequence[int], max_tokens: int = 16,
                     eos_id: Any = "__default__",
-                    request_id: Optional[str] = None) -> TokenStream:
+                    request_id: Optional[str] = None,
+                    trace_ctx: Optional[tuple] = None) -> TokenStream:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -376,10 +390,17 @@ class LLMEngine:
             raise ValueError(f"max_tokens must be >= 1, got {max_tokens}")
         rid = request_id or f"req-{next(self._ids)}"
         stream = TokenStream(rid)
+        if trace_ctx is None:
+            # the replica activates the request's context around the
+            # user-callable invocation, which reaches here synchronously
+            trace_ctx = _tracing.current_context()
+        now_wall = time.time()
         req = Request(rid, prompt, int(max_tokens),
                       self.config.eos_id if eos_id == "__default__"
                       else eos_id,
-                      stream, time.perf_counter())
+                      stream, time.perf_counter(),
+                      trace_ctx=tuple(trace_ctx) if trace_ctx else None,
+                      submitted_wall=now_wall, queued_wall=now_wall)
         with self._lock:
             self._waiting.append(req)
             self._update_gauges()
@@ -404,10 +425,14 @@ class LLMEngine:
                 f"(block_size {self.config.block_size})")
         rid = f"req-{next(self._ids)}"
         stream = TokenStream(rid)
+        trace_ctx = _tracing.current_context()
+        now_wall = time.time()
         req = Request(rid, prompt, int(max_tokens),
                       self.config.eos_id if eos_id == "__default__"
                       else eos_id,
-                      stream, time.perf_counter())
+                      stream, time.perf_counter(),
+                      trace_ctx=tuple(trace_ctx) if trace_ctx else None,
+                      submitted_wall=now_wall, queued_wall=now_wall)
         deadline = time.monotonic() + timeout
         while True:
             with self._lock:
@@ -446,7 +471,16 @@ class LLMEngine:
                     self._running.append(seq)
                     req.first_token_at = time.perf_counter()
                     _H_TTFT.observe(req.first_token_at - req.submitted_at,
-                                    tags={"engine": self.name})
+                                    tags={"engine": self.name},
+                                    exemplar=req.trace_ctx[0]
+                                    if req.trace_ctx else None)
+                    if req.trace_ctx is not None:
+                        # disagg intake: prefill happened remotely (on
+                        # the SAME trace via the shipped trace_ctx)
+                        _tracing.record_span(
+                            "llm.admit", req.trace_ctx, req.queued_wall,
+                            request_id=req.request_id, engine=self.name,
+                            prompt=len(prompt), disagg=True)
                     self._emit(seq, int(first_token), decode_step=False)
                     self._update_gauges()
                     return stream
@@ -554,11 +588,17 @@ class LLMEngine:
             budget -= p - cached
             admitted = True
             tp0 = time.perf_counter()
+            tw0 = time.time()
             if cached:
                 self._prefill_cached(req, match, blocks)
             else:
                 self._prefill_into(req, blocks)
             self._phase_s["prefill"] += time.perf_counter() - tp0
+            if req.trace_ctx is not None:
+                _tracing.record_span(
+                    "llm.prefill", req.trace_ctx, tw0,
+                    request_id=req.request_id, engine=self.name,
+                    tokens=p - cached, cached_tokens=cached)
         return admitted
 
     def _prefill_into(self, req: Request, blocks: List[int]) -> None:
@@ -577,6 +617,7 @@ class LLMEngine:
         self._cache = {"k": kc, "v": vc}
         first = int(np.asarray(logits).argmax())
         self._count_prefix(0, p)
+        req.cache_hit_tokens, req.cache_miss_tokens = 0, p
         self._start_sequence(req, blocks, p, first)
 
     def _prefill_cached(self, req: Request, match, blocks: List[int]) -> None:
@@ -615,6 +656,7 @@ class LLMEngine:
         self._cache = {"k": kc, "v": vc}
         first = int(np.asarray(logits).argmax())
         self._count_prefix(cached, s)
+        req.cache_hit_tokens, req.cache_miss_tokens = cached, s
         self._start_sequence(req, table, p, first)
 
     def _start_sequence(self, req: Request, blocks: List[int], p: int,
@@ -633,7 +675,18 @@ class LLMEngine:
         if req.first_token_at is None:
             req.first_token_at = now
             _H_TTFT.observe(now - req.submitted_at,
-                            tags={"engine": self.name})
+                            tags={"engine": self.name},
+                            exemplar=req.trace_ctx[0]
+                            if req.trace_ctx else None)
+        if req.trace_ctx is not None:
+            # queue wait + prefill, with the prefix-cache outcome as
+            # attributes (hit tokens reused KV; miss tokens paid compute)
+            _tracing.record_span(
+                "llm.admit", req.trace_ctx, req.queued_wall,
+                request_id=req.request_id, engine=self.name,
+                prompt=p, slot=seq.slot, preemptions=req.preemptions,
+                cache_hit_tokens=req.cache_hit_tokens,
+                cache_miss_tokens=req.cache_miss_tokens)
         self._emit(seq, first, decode_step=False)
 
     def _count_prefix(self, hit: int, miss: int) -> None:
@@ -742,12 +795,33 @@ class LLMEngine:
         self._total_generated += emitted
         return True
 
+    # decode spans aggregate: one span per this many steps, not one per
+    # token — span traffic stays O(tokens/32) while the trace still
+    # shows decode progress and inter-span gaps
+    _DECODE_SPAN_STEPS = 32
+
+    def _flush_decode_span(self, seq: "_Sequence") -> None:
+        if seq.dec_count and seq.req.trace_ctx is not None:
+            _tracing.record_span(
+                "llm.decode", seq.req.trace_ctx, seq.dec_wall0,
+                request_id=seq.req.request_id, engine=self.name,
+                tokens=seq.dec_count)
+        seq.dec_count = 0
+
     def _emit(self, seq: _Sequence, tok: int, decode_step: bool) -> None:
         req = seq.req
         now = time.perf_counter()
         if decode_step:
             _H_TPOT.observe(now - seq.last_emit_at,
-                            tags={"engine": self.name})
+                            tags={"engine": self.name},
+                            exemplar=req.trace_ctx[0]
+                            if req.trace_ctx else None)
+            if req.trace_ctx is not None:
+                if seq.dec_count == 0:
+                    seq.dec_wall0 = time.time()
+                seq.dec_count += 1
+                if seq.dec_count >= self._DECODE_SPAN_STEPS:
+                    self._flush_decode_span(seq)
         seq.last_emit_at = now
         req.generated.append(tok)
         req.stream._put(tok)
@@ -784,6 +858,17 @@ class LLMEngine:
             _FLREC.record("llm.retire", seq.req.request_id,
                           {"engine": self.name, "reason": reason,
                            "generated": len(seq.req.generated)})
+        req = seq.req
+        if req.trace_ctx is not None:
+            self._flush_decode_span(seq)
+            _tracing.record_span(
+                "llm.retire", req.trace_ctx, req.submitted_wall,
+                request_id=req.request_id, engine=self.name,
+                reason=reason, generated=len(req.generated),
+                preemptions=req.preemptions,
+                cache_hit_tokens=req.cache_hit_tokens,
+                cache_miss_tokens=req.cache_miss_tokens,
+                error=type(error).__name__ if error is not None else "")
         seq.req.stream._finish(reason, error)
         self._phase_s["retire"] += time.perf_counter() - t0
 
@@ -810,6 +895,15 @@ class LLMEngine:
             _FLREC.record("llm.preempt", req.request_id,
                           {"engine": self.name,
                            "context": len(req.prompt)})
+        if req.trace_ctx is not None:
+            self._flush_decode_span(seq)
+            now_w = time.time()
+            # the trace store always tail-keeps traces with this span
+            _tracing.record_span(
+                "llm.preempt", req.trace_ctx, now_w, end=now_w,
+                request_id=req.request_id, engine=self.name,
+                context=len(req.prompt), preemptions=req.preemptions)
+            req.queued_wall = now_w
         self._waiting.appendleft(req)
 
     # -- loop drivers ---------------------------------------------------------

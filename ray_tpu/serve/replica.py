@@ -15,6 +15,7 @@ from typing import Any, Dict, Optional
 import cloudpickle
 
 from ..util import metrics as _metrics
+from ..util import tracing as _tracing
 
 # replica-side execution latency; lives in the replica worker's registry
 # and ships to the head node/worker-tagged (util/metrics.py aggregation)
@@ -54,16 +55,37 @@ class Replica:
         from .multiplex import MUX_KWARG, _current_model_id
 
         mux_id = kwargs.pop(MUX_KWARG, "")
+        tctx = kwargs.pop(_tracing.TRACE_KWARG, None)
         with self._lock:
             self._ongoing += 1
             self._total += 1
         token = _current_model_id.set(mux_id)
+        ttoken = None
+        exec_sid = None
+        if tctx is not None:
+            # the route span's context (shipped as a reserved kwarg)
+            # re-activates here so the user callable's own remote calls
+            # and the LLM engine inherit it; the exec span parents them
+            tctx = tuple(tctx)
+            exec_sid = _tracing.new_span_id()
+            ttoken = _tracing.activate((tctx[0], exec_sid))
         t0 = time.perf_counter()
+        t_wall = time.time()
+        err = ""
         try:
             return self._resolve(method)(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001 — re-raised
+            err = type(e).__name__
+            raise
         finally:
             _H_REPLICA_EXEC.observe(time.perf_counter() - t0,
                                     tags={"deployment": self._deployment})
+            if ttoken is not None:
+                _tracing.deactivate(ttoken)
+                _tracing.record_span(
+                    "replica.exec", tctx, t_wall, span_id=exec_sid,
+                    deployment=self._deployment,
+                    replica=self._replica_tag, method=method, error=err)
             _current_model_id.reset(token)
             with self._lock:
                 self._ongoing -= 1
@@ -77,12 +99,37 @@ class Replica:
         from .multiplex import MUX_KWARG, _current_model_id
 
         mux_id = kwargs.pop(MUX_KWARG, "")
+        tctx = kwargs.pop(_tracing.TRACE_KWARG, None)
         with self._lock:
             self._ongoing += 1
             self._total += 1
         token = _current_model_id.set(mux_id)
         try:
-            result = self._resolve(method)(*args, **kwargs)
+            if tctx is not None:
+                # bracket ONLY the user-callable invocation (for the LLM
+                # server this synchronously calls engine.add_request,
+                # which captures the context onto the Request): a
+                # contextvar left set across `yield` would leak into
+                # whatever this worker thread runs between pulls
+                tctx = tuple(tctx)
+                exec_sid = _tracing.new_span_id()
+                t_wall = time.time()
+                ttoken = _tracing.activate((tctx[0], exec_sid))
+                err = ""
+                try:
+                    result = self._resolve(method)(*args, **kwargs)
+                except BaseException as e:  # noqa: BLE001 — re-raised
+                    err = type(e).__name__
+                    raise
+                finally:
+                    _tracing.deactivate(ttoken)
+                    _tracing.record_span(
+                        "replica.exec", tctx, t_wall, span_id=exec_sid,
+                        deployment=self._deployment,
+                        replica=self._replica_tag, method=method,
+                        streaming=True, error=err)
+            else:
+                result = self._resolve(method)(*args, **kwargs)
             yield from result
         finally:
             _current_model_id.reset(token)

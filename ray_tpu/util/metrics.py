@@ -26,6 +26,7 @@ agents piggybacked on the heartbeat — and the head merges them
 from __future__ import annotations
 
 import threading
+import time
 import warnings
 from bisect import bisect_left
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -171,9 +172,16 @@ class Histogram(Metric):
             # per-series NON-cumulative bucket counts: len(bounds)+1
             # (last = overflow); cumulated only at render time
             self._buckets: Dict[tuple, List[int]] = {}
+            # OpenMetrics exemplars: per series, per bucket index, the
+            # LATEST (trace_id, value, ts) observed with one — a p99
+            # bucket on the scrape links straight to a stored trace
+            self._exemplars: Dict[tuple, Dict[int, tuple]] = {}
 
     def observe(self, value: float,
-                tags: Optional[Dict[str, str]] = None) -> None:
+                tags: Optional[Dict[str, str]] = None,
+                exemplar: Optional[str] = None) -> None:
+        """``exemplar`` is a trace id to pin to the bucket this sample
+        lands in (rendered as `# {trace_id="..."} value ts`)."""
         k = self._key(tags)
         idx = bisect_left(self.boundaries, value)
         with self._lock:
@@ -183,6 +191,9 @@ class Histogram(Metric):
             if b is None:
                 b = self._buckets[k] = [0] * (len(self.boundaries) + 1)
             b[idx] += 1
+            if exemplar:
+                self._exemplars.setdefault(k, {})[idx] = (
+                    str(exemplar), float(value), time.time())
 
     def percentile(self, p: float,
                    tags: Optional[Dict[str, str]] = None) -> Optional[float]:
@@ -214,7 +225,16 @@ class Histogram(Metric):
                     ds, dc = s - ls, c - lc
                     db = [x - y for x, y in zip(b, lb)]
                 self._shipped[k] = (s, c, list(b))
-                series.append([list(k), [ds, dc, db]])
+                # exemplars ride as an OPTIONAL 4th element so heads
+                # that predate them still unpack the delta; pop = each
+                # exemplar ships once (the head keeps the latest seen).
+                # str keys survive JSON/msgpack map round-trips intact.
+                ex = self._exemplars.pop(k, None)
+                if ex:
+                    series.append([list(k), [ds, dc, db, {
+                        str(i): list(v) for i, v in ex.items()}]])
+                else:
+                    series.append([list(k), [ds, dc, db]])
         if not series:
             return None
         return {"name": self.name, "kind": "histogram",
@@ -321,13 +341,18 @@ def merge_remote(deltas: List[dict], node: str = "",
                     if kind == "gauge":
                         fam["series"][key] = float(val)
                     elif kind == "histogram":
-                        ds, dc, db = val
+                        ds, dc, db = val[0], val[1], val[2]
+                        ex = val[3] if len(val) > 3 else None
                         if cur is None:
-                            cur = [0.0, 0, [0] * len(db)]
+                            cur = [0.0, 0, [0] * len(db), {}]
+                        elif len(cur) == 3:  # pre-exemplar shape
+                            cur.append({})
                         cur[0] += ds
                         cur[1] += dc
                         if len(cur[2]) == len(db):
                             cur[2] = [x + y for x, y in zip(cur[2], db)]
+                        if ex:
+                            cur[3].update(ex)
                         fam["series"][key] = cur  # re-insert (recency)
                     else:  # counter
                         fam["series"][key] = (cur or 0.0) + float(val)
@@ -378,20 +403,28 @@ class _Family:
         self.name = name
         self.kind = kind
         self.help = help_
-        self.samples: List[Tuple[str, Dict[str, str], Any]] = []
+        # (suffix, tags, value, exemplar-or-None); exemplar is
+        # (trace_id, value, ts) attached only to histogram _bucket rows
+        self.samples: List[Tuple[str, Dict[str, str], Any, Any]] = []
 
-    def add(self, suffix: str, tags: Dict[str, str], value) -> None:
-        self.samples.append((suffix, tags, value))
+    def add(self, suffix: str, tags: Dict[str, str], value,
+            exemplar=None) -> None:
+        self.samples.append((suffix, tags, value, exemplar))
 
 
 def _hist_samples(fam: _Family, tags: Dict[str, str],
                   boundaries: Sequence[float], buckets: Sequence[int],
-                  total: float, count: int) -> None:
+                  total: float, count: int, exemplars=None) -> None:
+    def _ex(i):
+        if not exemplars:
+            return None
+        return exemplars.get(i) or exemplars.get(str(i))
+
     cum = 0
-    for b, c in zip(boundaries, buckets):
+    for i, (b, c) in enumerate(zip(boundaries, buckets)):
         cum += c
-        fam.add("_bucket", {**tags, "le": _fmt_val(float(b))}, cum)
-    fam.add("_bucket", {**tags, "le": "+Inf"}, count)
+        fam.add("_bucket", {**tags, "le": _fmt_val(float(b))}, cum, _ex(i))
+    fam.add("_bucket", {**tags, "le": "+Inf"}, count, _ex(len(boundaries)))
     fam.add("_sum", tags, total)
     fam.add("_count", tags, count)
 
@@ -538,11 +571,14 @@ def _collect_families() -> List[_Family]:
             counts = dict(getattr(m, "_counts", {}))
             buckets = {k: list(v)
                        for k, v in getattr(m, "_buckets", {}).items()}
+            exemplars = {k: dict(v)
+                         for k, v in getattr(m, "_exemplars", {}).items()}
         for k, value in items:
             tags = dict(zip(m.tag_keys, k))
             if isinstance(m, Histogram):
                 _hist_samples(fam, tags, m.boundaries,
-                              buckets.get(k, ()), value, counts.get(k, 0))
+                              buckets.get(k, ()), value, counts.get(k, 0),
+                              exemplars.get(k))
             else:
                 fam.add("", tags, value)
     with _remote_lock:
@@ -553,7 +589,8 @@ def _collect_families() -> List[_Family]:
                          "tag_keys": f["tag_keys"],
                          "boundaries": f["boundaries"],
                          "series": {
-                             k: ([v[0], v[1], list(v[2])]
+                             k: ([v[0], v[1], list(v[2]),
+                                  dict(v[3]) if len(v) > 3 else {}]
                                  if f["kind"] == "histogram" else v)
                              for k, v in f["series"].items()}}
                   for name, f in _remote_metrics.items()}
@@ -562,8 +599,9 @@ def _collect_families() -> List[_Family]:
         for key, val in f["series"].items():
             tags = dict(zip(f["tag_keys"], key))
             if f["kind"] == "histogram":
-                total, count, bks = val
-                _hist_samples(fam, tags, f["boundaries"], bks, total, count)
+                total, count, bks = val[0], val[1], val[2]
+                _hist_samples(fam, tags, f["boundaries"], bks, total, count,
+                              val[3] if len(val) > 3 else None)
             else:
                 fam.add("", tags, val)
     return fams.families()
@@ -575,9 +613,15 @@ def _render() -> str:
         if fam.help:
             lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
         lines.append(f"# TYPE {fam.name} {fam.kind}")
-        for suffix, tags, value in fam.samples:
-            lines.append(
-                f"{fam.name}{suffix}{_fmt_tags(tags)} {_fmt_val(value)}")
+        for suffix, tags, value, ex in fam.samples:
+            line = f"{fam.name}{suffix}{_fmt_tags(tags)} {_fmt_val(value)}"
+            if ex:
+                # OpenMetrics exemplar: `# {trace_id="..."} value ts` —
+                # the landing bucket links straight to the stored trace
+                tid, ev, ets = ex[0], ex[1], ex[2]
+                line += (f' # {{trace_id="{_escape_label_value(tid)}"}}'
+                         f" {_fmt_val(float(ev))} {ets:.3f}")
+            lines.append(line)
     return "\n".join(lines) + "\n"
 
 
@@ -615,9 +659,9 @@ def latency_summary() -> Dict[str, dict]:
         for name, f in _remote_metrics.items():
             if f["kind"] != "histogram":
                 continue
-            for key, (total, count, bks) in f["series"].items():
+            for key, val in f["series"].items():
                 fold(name, f["boundaries"], f["tag_keys"], key,
-                     total, count, list(bks))
+                     val[0], val[1], list(val[2]))
 
     out: Dict[str, dict] = {}
     for name, f in acc.items():

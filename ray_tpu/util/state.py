@@ -92,6 +92,50 @@ def log_store_stats() -> Dict[str, int]:
     return _rt().gcs.logs.stats()
 
 
+def traces(request_id: Optional[str] = None,
+           session: Optional[str] = None,
+           deployment: Optional[str] = None,
+           slowest: Optional[int] = None, since: Optional[int] = None,
+           limit: int = 50,
+           follow_timeout: Optional[float] = None) -> Dict[str, Any]:
+    """Completed request traces kept by the head's tail-sampler
+    (docs/OBSERVABILITY.md "Distributed tracing"). Returns {"traces":
+    [summaries], "cursor": n}; pass `since=cursor` (optionally with
+    `follow_timeout`) to stream newly kept traces, or `slowest=N` for
+    the N slowest retained."""
+    return _rt().gcs.traces.query(request_id=request_id, session=session,
+                                  deployment=deployment, slowest=slowest,
+                                  since=since, limit=limit,
+                                  follow_timeout=follow_timeout)
+
+
+def trace_detail(trace_id_prefix: str) -> Optional[Dict[str, Any]]:
+    """One trace's summary + full span list (`spans_detail`, time-
+    ordered); the id may be a unique hex prefix — e.g. straight off a
+    /metrics exemplar."""
+    return _rt().gcs.traces.get(trace_id_prefix)
+
+
+def trace_store_stats() -> Dict[str, Any]:
+    """Retention counters of the head's trace store (kept, dropped by
+    reason, bytes; the budget is config `trace_store_max_bytes`)."""
+    return _rt().gcs.traces.stats()
+
+
+def trace_chrome(trace_id_prefix: str,
+                 output_path: Optional[str] = None) -> List[dict]:
+    """One stored trace as chrome://tracing / Perfetto events — the
+    same span-slice + cross-process flow-arrow shape as timeline()."""
+    tr = _rt().gcs.traces.get(trace_id_prefix)
+    if tr is None:
+        return []
+    trace = _span_trace_events(list(tr.get("spans_detail", ())))
+    if output_path:
+        with open(output_path, "w") as f:
+            json.dump(trace, f)
+    return trace
+
+
 def actor_detail(actor_id_prefix: str) -> Optional[Dict[str, Any]]:
     """One actor's full picture: info + its recent task events + the
     log tail of its worker (dashboard drill-down)."""

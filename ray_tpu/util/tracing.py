@@ -39,6 +39,14 @@ SPANS_DROPPED = _metrics.Counter(
     "ray_tpu_spans_dropped_total",
     "trace spans dropped before reaching the task-event stream",
     tag_keys=("reason",))
+
+# head-side: whole traces dropped by the TraceStore — tail-sampled out
+# ("sampled"), evicted under the byte budget ("evicted"), or spans
+# arriving for an already-dropped trace ("late")
+TRACES_DROPPED = _metrics.Counter(
+    "ray_tpu_traces_dropped_total",
+    "whole traces dropped by the head trace store",
+    tag_keys=("reason",))
 _warned_reasons: set = set()
 
 
@@ -56,6 +64,69 @@ def _note_span_drop(reason: str, err: BaseException) -> None:
 
 def _new_id() -> str:
     return os.urandom(8).hex()
+
+
+def new_trace_id() -> str:
+    """Fresh W3C-width (16-byte) trace id for ingress root spans."""
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    return _new_id()
+
+
+# reserved kwarg carrying (trace_id, parent_span_id) across the
+# handle -> replica actor hop (popped in replica.handle_request*, the
+# MUX_KWARG pattern) — contextvars don't cross process boundaries
+TRACE_KWARG = "__rtpu_trace__"
+
+
+# ---- W3C trace-context wire format -----------------------------------------
+# traceparent: 00-<32 hex trace-id>-<16 hex parent-id>-<2 hex flags>
+# (https://www.w3.org/TR/trace-context/). Internal ids are 8-byte hex;
+# format_traceparent left-pads so an internally-rooted trace still
+# round-trips through a W3C-conformant proxy or client.
+
+def parse_traceparent(header: Optional[str]) -> Optional[tuple]:
+    """traceparent header -> (trace_id, span_id) context, or None when
+    absent/malformed (a bad header must not fail the request)."""
+    if not header:
+        return None
+    parts = header.strip().lower().split("-")
+    if len(parts) < 4 or parts[0] == "ff":
+        return None
+    trace_id, span_id = parts[1], parts[2]
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        if int(trace_id, 16) == 0 or int(span_id, 16) == 0:
+            return None
+    except ValueError:
+        return None
+    return (trace_id, span_id)
+
+
+def format_traceparent(ctx: tuple, sampled: bool = True) -> str:
+    """(trace_id, span_id) -> a version-00 traceparent header value."""
+    trace_id = str(ctx[0]).rjust(32, "0")
+    span_id = str(ctx[1]).rjust(16, "0")
+    return f"00-{trace_id}-{span_id}-{'01' if sampled else '00'}"
+
+
+def record_span(name: str, ctx: tuple, start: float,
+                end: Optional[float] = None,
+                span_id: Optional[str] = None, **attributes) -> str:
+    """Emit one finished span explicitly, without touching the
+    contextvar — for code that crosses threads (router pool, engine
+    scheduler loop) where the trace context travels as data, not
+    ambient state. ``ctx`` is the PARENT (trace_id, parent_span_id);
+    returns the new span's id so callers can parent further children."""
+    sid = span_id or _new_id()
+    span = Span(trace_id=ctx[0], span_id=sid, parent_span_id=ctx[1],
+                name=name, start=start, end=end if end is not None
+                else time.time(), attributes=dict(attributes))
+    _record(span)
+    return sid
 
 
 @dataclass

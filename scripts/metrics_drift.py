@@ -73,6 +73,10 @@ def doc_series(code):
         text = f.read()
     names = set()
     for line in text.splitlines():
+        # OpenMetrics exemplar recipes (`... # {trace_id="..."} value
+        # ts`) are sample syntax, not series references — strip them so
+        # nothing inside an exemplar can register as a doc-named series
+        line = re.sub(r"#\s*\{[^}]*\}[^`]*", "", line)
         # brace alternation: ray_tpu_serve_slo_{ok,violated}_total (the
         # prefix ends with "_"); otherwise the braces are a tag list on
         # a complete series name, e.g. ..._memory_bytes{device,kind}

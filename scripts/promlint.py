@@ -16,6 +16,10 @@ Checks
  - histograms: `_bucket` needs an `le` label with a parseable bound,
    cumulative counts must be non-decreasing in `le` order, the `+Inf`
    bucket must exist and equal `_count` for the same label set
+ - OpenMetrics exemplars (`... # {trace_id="..."} value ts`): allowed
+   only on counter and `_bucket` samples, labels must parse with the
+   same escaping rules, value/timestamp must parse, and a bucket
+   exemplar's value must not exceed its finite `le` bound
 
 Usage:
     promlint.py <file-or-url>     lint a saved body or live endpoint
@@ -144,6 +148,12 @@ def lint(body: str) -> List[str]:
                         f"its samples")
                 typed.setdefault(name, kind)
             continue
+        # an OpenMetrics exemplar rides after ` # ` on the sample line;
+        # split it off before the classic-format sample parse
+        exemplar = None
+        if " # " in line:
+            line, _, exraw = line.partition(" # ")
+            exemplar = exraw.strip()
         # sample line: name[{labels}] value [timestamp]
         m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
                      r"(?:\{(.*)\})?\s+(\S+)(?:\s+(-?\d+))?$", line)
@@ -175,6 +185,45 @@ def lint(body: str) -> List[str]:
             errors.append(
                 f"line {lineno}: {sname}: unparseable value {rawval!r}")
             continue
+        if exemplar is not None:
+            exm = re.match(r"^\{(.*)\}\s+(\S+)(?:\s+(\S+))?$", exemplar)
+            if exm is None:
+                errors.append(
+                    f"line {lineno}: {sname}: malformed exemplar "
+                    f"{exemplar!r}")
+            else:
+                if typed.get(fam) == "histogram" \
+                        and not sname.endswith("_bucket"):
+                    errors.append(
+                        f"line {lineno}: {sname}: exemplar on a "
+                        f"histogram sample that is not _bucket")
+                elif typed.get(fam) not in ("histogram", "counter"):
+                    errors.append(
+                        f"line {lineno}: {sname}: exemplar on a "
+                        f"{typed.get(fam) or 'untyped'} family")
+                if exm.group(1):
+                    expairs, exerr = _parse_labels(exm.group(1))
+                    if expairs is None:
+                        errors.append(
+                            f"line {lineno}: {sname}: exemplar: {exerr}")
+                exval = _parse_value(exm.group(2))
+                if exval is None:
+                    errors.append(
+                        f"line {lineno}: {sname}: unparseable exemplar "
+                        f"value {exm.group(2)!r}")
+                if exm.group(3) is not None \
+                        and _parse_value(exm.group(3)) is None:
+                    errors.append(
+                        f"line {lineno}: {sname}: unparseable exemplar "
+                        f"timestamp {exm.group(3)!r}")
+                if exval is not None and sname.endswith("_bucket"):
+                    le = dict(labels).get("le")
+                    bound = _parse_value(le) if le is not None else None
+                    if bound is not None and not math.isinf(bound) \
+                            and exval > bound:
+                        errors.append(
+                            f"line {lineno}: {sname}: exemplar value "
+                            f"{exval} exceeds its le={le} bound")
         if typed.get(fam) == "histogram":
             others = frozenset((k, v) for k, v in labels if k != "le")
             if sname.endswith("_bucket"):
@@ -243,6 +292,11 @@ def _live_scrape() -> str:
                     tag_keys=("k",)).inc(tags={"k": 'q"uote\\slash'})
             return x * 2
 
+        # exemplar-bearing histogram on the head: the scrape must carry
+        # a `# {trace_id="..."} value ts` suffix promlint can parse
+        metrics_mod.Histogram(
+            "promlint_probe_seconds", "live-lint exemplar probe",
+            boundaries=[0.1, 1.0]).observe(0.05, exemplar="ab" * 16)
         ref = ray_tpu.put(b"x" * 200_000)  # exercise the store path
         assert ray_tpu.get([work.remote(i) for i in range(8)],
                            timeout=120) == [2 * i for i in range(8)]
@@ -272,6 +326,10 @@ def main(argv=None) -> int:
         body = _live_scrape()
         if "promlint_worker_events_total" not in body:
             print("promlint --live: worker metric never reached the head "
+                  "scrape", file=sys.stderr)
+            return 1
+        if '# {trace_id="' not in body:
+            print("promlint --live: exemplar never appeared in the head "
                   "scrape", file=sys.stderr)
             return 1
     else:

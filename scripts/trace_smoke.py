@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""CI smoke for request-scoped distributed tracing (ISSUE 18 /
+docs/OBSERVABILITY.md "Distributed tracing").
+
+Live 2-node gate, run after perf_smoke: an in-process head plus one
+REAL remote node agent, two prefix-cached LLMServer replicas, the real
+HTTP proxy in front. Then:
+
+- replays a bursty session trace with ``--trace`` semantics (a
+  driver-rooted span per turn, forwarded as a W3C ``traceparent``
+  header) through the REAL HTTP proxy, and asserts the head TraceStore
+  holds >=1 tail-kept SLOW trace whose spans come from >=3 distinct
+  processes (client driver, proxy actor, replica worker)
+- kills one replica mid-replay while resilient streams are in flight
+  and asserts >=1 trace was tail-kept for ``failover`` with BOTH hops
+  stitched into one span tree: 2+ serve.route hops, a serve.failover
+  span, engine spans from two distinct replica processes
+- scrapes the REAL /metrics exposition, pulls a ``trace_id`` exemplar
+  off a latency-histogram bucket, and resolves it over the head RPC
+  the ``ray_tpu trace`` CLI uses (``trace_get``) back to the stored
+  span tree — the p99-to-trace workflow end to end
+
+Exit 0 = healthy; any assertion prints the evidence and exits 1.
+Run: python scripts/trace_smoke.py   (CI invokes it after perf_smoke)
+"""
+import os
+import re
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# a 50ms slow bar makes real streamed turns "slower than SLO" so the
+# tail sampler's always-keep path (not the probabilistic one) is what
+# this gate exercises; must be set before ray_tpu.core.config imports
+os.environ.setdefault("RTPU_TRACE_SLOW_THRESHOLD_S", "0.05")
+
+from traffic_harness import (ENGINE_CFG, deploy_llm_app,  # noqa: E402
+                             make_trace, replay, summarize,
+                             wait_for_scrape)
+
+N_SESSIONS = 10
+KILL_AT_S = 1.0
+
+
+def _kill_one_replica_after(delay_s: float, seed: int = 0):
+    """Kill a seeded-random live replica ``delay_s`` into the replay —
+    the traffic_harness --kill-replica-at move, as a thread."""
+    import random
+
+    import ray_tpu
+
+    def killer():
+        time.sleep(delay_s)
+        try:
+            controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+            _v, _q, reps = ray_tpu.get(
+                controller.get_replicas.remote("LLMServer"), timeout=10)
+            if reps:
+                victim = random.Random(seed).choice(reps)
+                print(f"trace_smoke: killing replica "
+                      f"{victim._actor_id.hex()[:8]} mid-replay")
+                ray_tpu.kill(victim)
+        except Exception as e:  # noqa: BLE001
+            print(f"trace_smoke: kill failed: {e}", file=sys.stderr)
+
+    th = threading.Thread(target=killer, daemon=True)
+    th.start()
+    return th
+
+
+def _span_names(detail):
+    return [s.get("name", "") for s in detail.get("spans_detail", ())]
+
+
+def main() -> int:
+    import ray_tpu  # noqa: F401 — Cluster below owns init
+    from ray_tpu import cli, serve
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.core.rpc import connect
+
+    c = Cluster(head_resources={"CPU": 4.0})
+    try:
+        c.add_remote_node(num_cpus=4.0)
+        handle = deploy_llm_app(2, ENGINE_CFG)
+        host, port = serve.start_http_proxy(port=0)
+        store = c.runtime.gcs.traces
+        print(f"trace_smoke: 2 nodes up, proxy at {host}:{port}")
+
+        # -- 1) traced replay through the real HTTP proxy ---------------
+        trace = make_trace(N_SESSIONS, seed=5, max_turns=2, max_tokens=8)
+        result = replay(trace, base_url=f"http://{host}:{port}",
+                        transport="http", tracing=True)
+        row = summarize(result)
+        assert row["traffic_failed"] == 0, \
+            [r for r in result["records"] if not r.get("ok")][:5]
+        want_tids = {r["trace_id"] for r in result["records"]
+                     if r.get("trace_id")}
+        print(f"trace_smoke: http replay done — {row['traffic_completed']} "
+              f"turns, {len(want_tids)} driver-rooted traces")
+
+        # worker spans ride channel notifies; let stragglers land
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            kept = store.query(limit=500)["traces"]
+            slow3 = [t for t in kept if t["keep_reason"] == "slow"
+                     and t["procs"] >= 3]
+            if slow3:
+                break
+            time.sleep(0.5)
+        assert slow3, (
+            f"no tail-kept slow trace with spans from >=3 processes; "
+            f"kept={[(t['trace_id'][:8], t['keep_reason'], t['procs']) for t in kept]}")
+        assert any(t["trace_id"] in want_tids for t in slow3), \
+            "slow traces stored, but none match a replayed turn's trace id"
+        pick = next(t for t in slow3 if t["trace_id"] in want_tids)
+        detail = store.get(pick["trace_id"])
+        names = _span_names(detail)
+        for need in ("traffic.turn", "http.request", "serve.route",
+                     "replica.exec", "llm.admit", "llm.retire"):
+            assert need in names, f"span {need!r} missing: {names}"
+        rendered = cli._render_trace_tree(detail, verbose=True)
+        assert "http.request" in rendered and "llm.retire" in rendered, \
+            rendered[:400]
+        print(f"trace_smoke: slow trace {pick['trace_id'][:12]} OK — "
+              f"{pick['spans']} spans / {pick['procs']} processes, "
+              f"full proxy->router->replica->engine lifecycle")
+
+        # -- 2) mid-stream replica kill => one trace, both hops ---------
+        trace2 = make_trace(8, seed=11, max_turns=2, max_tokens=24)
+        _kill_one_replica_after(KILL_AT_S)
+        result2 = replay(trace2, handle=handle, transport="resilient",
+                         tracing=True)
+        row2 = summarize(result2)
+        assert row2["traffic_failed"] == 0, \
+            [r for r in result2["records"] if not r.get("ok")][:5]
+        assert row2["traffic_failovers"] >= 1, row2
+        deadline = time.monotonic() + 20
+        fo_detail = None
+        while time.monotonic() < deadline:
+            fo = [t for t in store.query(limit=500)["traces"]
+                  if t["keep_reason"] == "failover"]
+            for t in fo:
+                d = store.get(t["trace_id"])
+                ns = _span_names(d)
+                routes = ns.count("serve.route")
+                # both hops' route spans + the failover marker record
+                # DRIVER-side, so they are deterministic evidence; the
+                # dead hop's replica/engine spans only arrive if the
+                # kill landed after they shipped, so a second replica
+                # pid is preferred, not required
+                hop_pids = {s.get("pid") for s in d["spans_detail"]
+                            if str(s.get("name", "")).startswith(
+                                ("replica.", "llm."))}
+                if routes >= 2 and "serve.failover" in ns:
+                    if fo_detail is None or len(hop_pids) >= 2:
+                        fo_detail = (t, routes, hop_pids)
+                    if len(hop_pids) >= 2:
+                        break
+            if fo_detail and (len(fo_detail[2]) >= 2
+                              or time.monotonic() > deadline - 10):
+                break
+            time.sleep(0.5)
+        assert fo_detail, \
+            ("no failover-kept trace stitching both hops; failover "
+             f"traces: {[t['trace_id'][:8] for t in fo]}")
+        t, routes, hop_pids = fo_detail
+        print(f"trace_smoke: failover trace {t['trace_id'][:12]} OK — "
+              f"{routes} route hops, serve.failover span present, "
+              f"replica pids {sorted(p for p in hop_pids if p)}")
+
+        # -- 3) /metrics exemplar resolves to a stored trace ------------
+        scrape = wait_for_scrape('# {trace_id="')
+        pat = (r'(ray_tpu_[a-z0-9_]+)_bucket\{[^}]*\}\s+\S+'
+               r'\s+#\s+\{trace_id="([0-9a-f]+)"\}')
+        hits = re.findall(pat, scrape)
+        assert hits, "no trace_id exemplar on any histogram bucket"
+        fams = {f for f, _ in hits}
+        assert "ray_tpu_llm_ttft_seconds" in fams, \
+            f"no TTFT exemplar crossed the worker->head delta path: {fams}"
+        resolved = 0
+        for fam, tid in hits:
+            det = store.get(tid)
+            if det and det.get("spans_detail"):
+                resolved += 1
+        assert resolved, f"no exemplar trace id resolves: {hits[:5]}"
+        print(f"trace_smoke: {len(hits)} bucket exemplars on "
+              f"{len(fams)} families, {resolved} resolve to stored traces")
+
+        # -- 4) the CLI's own head RPCs, over the wire ------------------
+        addr = c.runtime.enable_remote_nodes()
+        ch = connect(addr, name="trace-smoke")
+        q = ch.call("traces_query", {"slowest": 3}, timeout=30)
+        assert q["traces"], q
+        det = ch.call("trace_get", q["traces"][0]["trace_id"], timeout=30)
+        assert det and det.get("spans_detail"), det
+        snap = ch.call("perf_snapshot", {}, timeout=30)
+        assert snap.get("traces", {}).get("kept_traces", 0) >= 1, \
+            snap.get("traces")
+        top = cli._render_top(snap, None, 2.0)
+        assert "tracing:" in top, top[:400]
+        st = store.stats()
+        print(f"trace_smoke: head RPCs OK — store kept="
+              f"{st['kept_traces']}/{st['total_traces']} "
+              f"bytes={st['bytes']}")
+        serve.shutdown()
+    finally:
+        c.shutdown()
+    print("trace_smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

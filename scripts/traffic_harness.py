@@ -146,8 +146,16 @@ def _stream_http(base_url: str, deployment: str, sid: str,
     Returns (tokens, ttft_s, tpot_list_s)."""
     url = f"{base_url}/{deployment}?stream=1&session={sid}"
     body = json.dumps({**payload, "stream": True}).encode()
-    req = urllib.request.Request(
-        url, body, {"Content-Type": "application/json"})
+    headers = {"Content-Type": "application/json"}
+    try:  # propagate an active trace like a W3C-instrumented client
+        from ray_tpu.util import tracing as _trc
+
+        tctx = _trc.current_context()
+        if tctx:
+            headers["traceparent"] = _trc.format_traceparent(tctx)
+    except Exception:  # noqa: BLE001 — tracing must never fail traffic
+        pass
+    req = urllib.request.Request(url, body, headers)
     toks: List[int] = []
     tpots: List[float] = []
     t0 = time.perf_counter()
@@ -207,12 +215,18 @@ def _stream_handle(handle, sid: str, payload: dict, timeout: float,
 def replay(trace: Dict[str, Any], *, base_url: Optional[str] = None,
            handle=None, deployment: str = "LLMServer",
            transport: str = "http", timeout: float = 240.0,
-           time_scale: float = 1.0) -> Dict[str, Any]:
+           time_scale: float = 1.0, tracing: bool = False) -> Dict[str, Any]:
     """Replay the trace against a live deployment: one thread per
     session (spawned at its arrival time), turns sequential within a
     session, the full conversation re-sent each turn. Returns
     {"records": [...], "wall_s": float} — one record per request with
-    tokens/ttft/tpots/ok/failovers for summarize()."""
+    tokens/ttft/tpots/ok/failovers for summarize().
+
+    ``tracing`` opens a driver-rooted distributed-trace span around
+    every turn (W3C-width trace id): the http transport forwards it as
+    a ``traceparent`` header, the handle transports ride the routing
+    handle's context capture — so each turn becomes ONE stored trace
+    spanning client, proxy/router, replica, and engine."""
     records: List[dict] = []
     rec_lock = threading.Lock()
     t0 = time.perf_counter()
@@ -224,7 +238,8 @@ def replay(trace: Dict[str, Any], *, base_url: Optional[str] = None,
             payload = {"tokens": ctx, "max_tokens": s["max_tokens"]}
             rec = {"sid": s["sid"], "turn": turn, "shared": s["shared"],
                    "ok": False, "failovers": 0}
-            try:
+
+            def one_turn():
                 if transport == "http":
                     toks, ttft, tpots = _stream_http(
                         base_url, deployment, s["sid"], payload, timeout)
@@ -237,6 +252,26 @@ def replay(trace: Dict[str, Any], *, base_url: Optional[str] = None,
                     raise ValueError(f"unknown transport {transport!r}")
                 rec.update(ok=len(toks) > 0, tokens=toks, ttft_s=ttft,
                            tpots_s=tpots)
+                return toks
+
+            try:
+                if tracing:
+                    from ray_tpu.util import tracing as trc
+
+                    # pre-activate a W3C-width trace id so the root
+                    # span survives round-tripping through a conformant
+                    # proxy byte-identical (trace() alone would mint a
+                    # narrower internal id)
+                    tok = trc.activate((trc.new_trace_id(), None))
+                    try:
+                        with trc.trace("traffic.turn", session=s["sid"],
+                                       turn=turn) as span:
+                            rec["trace_id"] = span.trace_id
+                            toks = one_turn()
+                    finally:
+                        trc.deactivate(tok)
+                else:
+                    toks = one_turn()
                 ctx = ctx + toks
             except Exception as e:  # noqa: BLE001 — a failed stream is DATA
                 rec["error"] = f"{type(e).__name__}: {e}"
@@ -379,6 +414,11 @@ def main() -> int:
     ap.add_argument("--chaos", default="",
                     help="RAY_TPU_CHAOS spec (wire-level faults; pair "
                          "with --transport resilient)")
+    ap.add_argument("--trace", action="store_true",
+                    help="open a driver-rooted distributed-trace span "
+                         "around every turn (propagated as traceparent "
+                         "over http, via the handle context otherwise); "
+                         "inspect with `ray_tpu trace --slowest 5`")
     ap.add_argument("--kill-replica-at", type=float, default=0.0,
                     help="kill a live replica N seconds into the replay "
                          "(seeded pick; use --transport resilient so "
@@ -409,7 +449,7 @@ def main() -> int:
     try:
         handle = deploy_llm_app(args.replicas, cfg)
         kwargs: Dict[str, Any] = dict(transport=args.transport,
-                                      handle=handle)
+                                      handle=handle, tracing=args.trace)
         if args.transport == "http":
             host, port = serve.start_http_proxy(port=0)
             kwargs["base_url"] = f"http://{host}:{port}"

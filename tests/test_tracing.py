@@ -1,0 +1,361 @@
+"""Request-scoped distributed tracing (ISSUE 18): W3C traceparent
+propagation, head-side TraceStore tail sampling / eviction / paging,
+exemplar-linked latency histograms, failover-hop stitching.
+
+Unit tests drive the TraceStore and the exemplar wire path directly;
+the live tests run a real serve deployment so spans genuinely cross
+process boundaries (driver -> router -> replica worker). The full
+proxy + engine path is scripts/trace_smoke.py's job.
+"""
+import re
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.core.trace_store import TraceStore
+from ray_tpu.util import tracing
+
+
+def _span(tid, sid, parent=None, name="s", t0=0.0, t1=0.1, pid=1,
+          **attrs):
+    return {"trace_id": tid, "span_id": sid, "parent_span_id": parent,
+            "name": name, "state": "SPAN", "time": t0, "end_time": t1,
+            "attributes": dict(attrs), "pid": pid}
+
+
+def _store(**kw):
+    kw.setdefault("max_bytes", 1 << 20)
+    kw.setdefault("sample_rate", 1.0)
+    kw.setdefault("slow_threshold_s", 10.0)
+    kw.setdefault("seed", 0)
+    return TraceStore(**kw)
+
+
+# ---- W3C wire format -------------------------------------------------------
+
+
+def test_traceparent_parse_format_roundtrip():
+    ctx = (tracing.new_trace_id(), tracing.new_span_id())
+    hdr = tracing.format_traceparent(ctx)
+    assert re.fullmatch(r"00-[0-9a-f]{32}-[0-9a-f]{16}-01", hdr)
+    assert tracing.parse_traceparent(hdr) == ctx
+    # internal 8-byte ids left-pad to W3C width and still round-trip
+    assert tracing.parse_traceparent(
+        tracing.format_traceparent(("ab" * 8, "cd" * 8))) == \
+        (("ab" * 8).rjust(32, "0"), "cd" * 8)
+    for bad in (None, "", "nonsense", "00-zz-xx-01",
+                "00-" + "0" * 32 + "-" + "1" * 16 + "-01",   # zero trace
+                "00-" + "1" * 32 + "-" + "0" * 16 + "-01",   # zero span
+                "ff-" + "1" * 32 + "-" + "2" * 16 + "-01",   # bad version
+                "00-" + "1" * 31 + "-" + "2" * 16 + "-01"):  # short id
+        assert tracing.parse_traceparent(bad) is None, bad
+
+
+# ---- tail sampling ---------------------------------------------------------
+
+
+def test_tail_sampling_always_keep_reasons():
+    st = _store(sample_rate=0.0)
+    # ordinary fast trace: sampled out, tombstoned
+    st.add_span(_span("t1", "r", name="root", t1=0.5))
+    assert st.get("t1") is None
+    assert st.dropped_sampled == 1
+    st.add_span(_span("t1", "b", parent="r", name="late"))
+    assert st.get("t1") is None, "late span resurrected a dropped trace"
+    # an errored span anywhere in the tree => kept as "error"
+    st.add_span(_span("t2", "x", parent="r", name="replica.exec",
+                      error="Boom"))
+    st.add_span(_span("t2", "r", name="root", t1=0.5))
+    assert st.get("t2")["keep_reason"] == "error"
+    # a failover span's own error attr is the RECOVERED cause — the
+    # stream went on, so the trace keeps as "failover", not "error"
+    st.add_span(_span("t3", "x", parent="r", name="serve.failover",
+                      hop=1, error="WorkerCrashedError"))
+    st.add_span(_span("t3", "r", name="root", t1=0.5))
+    assert st.get("t3")["keep_reason"] == "failover"
+    st.add_span(_span("t4", "x", parent="r", name="llm.preempt"))
+    st.add_span(_span("t4", "r", name="root", t1=0.5))
+    assert st.get("t4")["keep_reason"] == "preempt"
+    # slower than the global bar
+    st.add_span(_span("t5", "r", name="root", t1=20.0))
+    assert st.get("t5")["keep_reason"] == "slow"
+    # a per-deployment slo_target on the route span beats the global bar
+    st.add_span(_span("t6", "x", parent="r", name="serve.route", t1=0.4,
+                      slo_target=0.25))
+    st.add_span(_span("t6", "r", name="root", t1=0.5))
+    assert st.get("t6")["keep_reason"] == "slow"
+    assert st.stats()["kept_traces"] == 5
+
+
+def test_tail_sampling_deterministic_under_seed():
+    def run(seed):
+        st = _store(sample_rate=0.5, seed=seed)
+        for i in range(64):
+            st.add_span(_span(f"t{i:02d}", "r", name="root", t1=0.5))
+        kept = {t["trace_id"] for t in st.query(limit=100)["traces"]}
+        return kept, st.dropped_sampled
+    k1, d1 = run(7)
+    k2, d2 = run(7)
+    assert k1 == k2 and d1 == d2
+    assert 0 < len(k1) < 64 and len(k1) + d1 == 64
+
+
+# ---- storage discipline ----------------------------------------------------
+
+
+def test_trace_store_eviction_budget_and_counter():
+    st = _store(max_bytes=4096)
+    for i in range(50):
+        st.add_span(_span(f"t{i:03d}", "r", name="root", t0=float(i),
+                          t1=float(i) + 0.1, note="x" * 100))
+    assert st.dropped_evicted > 0
+    assert st.stats()["bytes"] <= 4096
+    assert st.get("t000") is None, "oldest trace survived the budget"
+    assert st.get("t049") is not None, "newest trace was evicted"
+    # a late span for an evicted trace is tombstoned, not resurrected
+    st.add_span(_span("t000", "z", parent="r", name="late"))
+    assert st.get("t000") is None
+
+
+def test_trace_store_cursor_paging_and_follow():
+    st = _store()
+    for i in range(5):
+        st.add_span(_span(f"t{i}", "r", name="root", t0=float(i),
+                          t1=float(i) + 0.5))
+    seen, since = [], 0
+    while True:
+        out = st.query(since=since, limit=2)
+        if not out["traces"]:
+            break
+        seen += [t["trace_id"] for t in out["traces"]]
+        since = out["cursor"]
+    assert seen == [f"t{i}" for i in range(5)], seen
+    # long-poll follow wakes on the next completion
+    tail = st.query(limit=1)["cursor"]
+
+    def later():
+        time.sleep(0.2)
+        st.add_span(_span("t9", "r", name="root", t0=9.0, t1=9.5))
+
+    threading.Thread(target=later, daemon=True).start()
+    out = st.query(since=tail, follow_timeout=10.0)
+    assert [t["trace_id"] for t in out["traces"]] == ["t9"]
+
+
+def test_trace_store_filters_slowest_and_prefix_get():
+    st = _store()
+    for i in range(4):
+        st.add_span(_span(f"ab{i}cd", "r", name="http.request", t0=0.0,
+                          t1=0.1 * (i + 1), session=f"s{i % 2}",
+                          deployment="D", request_id=f"req{i}"))
+    assert {t["trace_id"] for t in st.query(session="s1")["traces"]} == \
+        {"ab1cd", "ab3cd"}
+    assert [t["trace_id"] for t in st.query(slowest=2)["traces"]] == \
+        ["ab3cd", "ab2cd"]
+    assert st.query(request_id="req2")["traces"][0]["trace_id"] == "ab2cd"
+    assert st.query(deployment="nope")["traces"] == []
+    got = st.get("ab1")
+    assert got["trace_id"] == "ab1cd" and got["spans_detail"]
+    assert st.get("ab") is None, "ambiguous prefix must not resolve"
+
+
+# ---- exemplar wire path ----------------------------------------------------
+
+
+def test_histogram_exemplar_ship_merge_render():
+    from ray_tpu.util import metrics as metrics_mod
+
+    h = metrics_mod.Histogram("test_trace_exemplar_seconds",
+                              "exemplar pipeline test",
+                              boundaries=[0.1, 1.0])
+    tid_lo, tid_inf = "ab" * 16, "cd" * 16
+    h.observe(0.05, exemplar=tid_lo)
+    h.observe(7.0, exemplar=tid_inf)
+    # local render: exemplars land on the matching bucket rows (+Inf too)
+    body = metrics_mod._render()
+    lines = [ln for ln in body.splitlines()
+             if ln.startswith("test_trace_exemplar_seconds_bucket")]
+    lo = next(ln for ln in lines if 'le="0.1"' in ln)
+    assert f'# {{trace_id="{tid_lo}"}} 0.05' in lo, lo
+    inf = next(ln for ln in lines if 'le="+Inf"' in ln)
+    assert tid_inf in inf, inf
+    # wire: the delta ships exemplars as an OPTIONAL 4th element with
+    # str bucket-index keys (msgpack/JSON-safe), and ships each ONCE
+    d = h._delta()
+    (_k, val), = d["series"]
+    assert len(val) == 4 and set(val[3]) == {"0", "2"}, val
+    metrics_mod.merge_remote([d], node="n1", worker="w1")
+    body2 = metrics_mod._render()
+    remote = [ln for ln in body2.splitlines()
+              if 'worker="w1"' in ln and "trace_id" in ln]
+    assert len(remote) == 2, body2[-1500:]
+    # a second delta with no fresh exemplars reverts to the legacy
+    # 3-element shape; a legacy 3-element delta still merges cleanly
+    h.observe(0.05)
+    d2 = h._delta()
+    (_k, val2), = d2["series"]
+    assert len(val2) == 3, val2
+    metrics_mod.merge_remote([{
+        "name": "test_trace_exemplar_seconds", "kind": "histogram",
+        "help": "exemplar pipeline test", "tag_keys": [],
+        "boundaries": [0.1, 1.0],
+        "series": [[[], [1.0, 1, [1, 0, 0]]]],
+    }], node="n2", worker="w2")
+    assert 'worker="w2"' in metrics_mod._render()
+
+
+# ---- live: spans really cross process boundaries ---------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    rt = ray_tpu.init(num_cpus=8)
+    yield rt
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _teardown_deployments(request):
+    yield
+    if "cluster" in request.fixturenames:
+        try:
+            for name in serve.status():
+                serve.delete(name)
+        except Exception:
+            pass
+
+
+def _wait_trace(store, tid, min_spans, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    detail = None
+    while time.monotonic() < deadline:
+        detail = store.get(tid)
+        if detail and len(detail.get("spans_detail", ())) >= min_spans:
+            return detail
+        time.sleep(0.2)
+    return detail
+
+
+def test_cross_process_trace_continuity(cluster):
+    """One driver-rooted trace: the route span records driver-side, the
+    replica.exec span records in the replica WORKER process, and the
+    parent chain stitches root -> serve.route -> replica.exec."""
+    @serve.deployment
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    h = serve.run(Echo.bind())
+    token = tracing.activate((tracing.new_trace_id(), None))
+    try:
+        with tracing.trace("client.call") as root:
+            assert ray_tpu.get(h.remote("hi"), timeout=30) == "hi"
+    finally:
+        tracing.deactivate(token)
+
+    detail = _wait_trace(cluster.gcs.traces, root.trace_id, 3)
+    assert detail, f"trace {root.trace_id} never completed in the store"
+    spans = {s["name"]: s for s in detail["spans_detail"]}
+    assert {"client.call", "serve.route", "replica.exec"} <= set(spans)
+    route, exec_ = spans["serve.route"], spans["replica.exec"]
+    assert route["parent_span_id"] == root.span_id
+    assert exec_["parent_span_id"] == route["span_id"]
+    assert exec_["pid"] != spans["client.call"]["pid"], \
+        "replica span did not come from a worker process"
+    assert detail["procs"] >= 2 and detail["done"]
+    # the state-API surfaces over the same store
+    from ray_tpu.util import state as state_api
+
+    rows = state_api.traces(limit=50)["traces"]
+    assert any(t["trace_id"] == root.trace_id for t in rows)
+    events = state_api.trace_chrome(root.trace_id)
+    assert events and any(e.get("ph") == "X" for e in events)
+
+
+def test_failover_hops_stitch_into_one_trace(cluster):
+    """Killing the serving replica mid-stream yields ONE kept trace
+    spanning both hops: two serve.route spans, a serve.failover span
+    carrying the recovered cause, keep_reason == failover."""
+    from ray_tpu.serve.llm import resilient_stream
+
+    @serve.deployment(num_replicas=2, health_check_period_s=0.5,
+                      health_check_timeout_s=2.0)
+    class DetLLM:
+        def __call__(self, payload):
+            toks = list(payload["tokens"])
+            n = int(payload.get("max_tokens", 16))
+
+            def gen(ctx=toks, n=n):
+                ctx = list(ctx)
+                for _ in range(n):
+                    t = (sum(ctx) * 31 + len(ctx)) % 97
+                    ctx.append(t)
+                    time.sleep(0.04)  # a kill lands mid-stream
+                    yield t
+
+            return gen()
+
+    h = serve.run(DetLLM.bind())
+    token = tracing.activate((tracing.new_trace_id(), None))
+    try:
+        with tracing.trace("client.stream") as root:
+            stream = resilient_stream(h, {"tokens": [3, 1, 4],
+                                          "max_tokens": 30})
+            got, killed = [], False
+            for tok in stream:
+                got.append(tok)
+                if len(got) == 6 and not killed:
+                    killed = True
+                    aid = stream.replica_actor_id
+                    controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+                    _, _, reps = ray_tpu.get(
+                        controller.get_replicas.remote("DetLLM"),
+                        timeout=30)
+                    victim = next(r for r in reps if r._actor_id == aid)
+                    ray_tpu.kill(victim)
+    finally:
+        tracing.deactivate(token)
+    assert len(got) == 30 and stream.failovers >= 1
+
+    detail = _wait_trace(cluster.gcs.traces, root.trace_id, 4)
+    assert detail and detail["keep_reason"] == "failover", detail
+    names = [s["name"] for s in detail["spans_detail"]]
+    assert names.count("serve.route") >= 2, names
+    fo = next(s for s in detail["spans_detail"]
+              if s["name"] == "serve.failover")
+    assert fo["attributes"]["hop"] == 1
+    assert fo["attributes"]["yielded"] == 6
+    assert fo["attributes"]["error"]
+    assert fo["trace_id"] == root.trace_id
+
+
+def test_serve_request_exemplar_resolves_to_stored_trace(cluster):
+    """The latency histogram's bucket exemplar on a scrape is a trace id
+    that resolves to the stored span tree — the p99-to-trace workflow."""
+    from ray_tpu.util import metrics as metrics_mod
+
+    @serve.deployment
+    class Pong:
+        def __call__(self, x):
+            return x
+
+    h = serve.run(Pong.bind())
+    token = tracing.activate((tracing.new_trace_id(), None))
+    try:
+        with tracing.trace("client.exemplar") as root:
+            ray_tpu.get(h.remote(1), timeout=30)
+    finally:
+        tracing.deactivate(token)
+    body = metrics_mod._render()
+    pat = (r'ray_tpu_serve_request_seconds_bucket\{[^}]*\}\s+\S+'
+           r'\s+#\s+\{trace_id="([0-9a-f]+)"\}')
+    tids = re.findall(pat, body)
+    assert root.trace_id in tids, \
+        f"no exemplar for {root.trace_id}; got {tids[:5]}"
+    detail = _wait_trace(cluster.gcs.traces, root.trace_id, 2)
+    assert detail and detail["spans_detail"], \
+        "exemplar trace id does not resolve to a stored trace"
