@@ -149,6 +149,7 @@ def main() -> None:
             **_bench_sharding(),
             **_bench_traffic(),
             **_bench_perf(),
+            **_bench_data(),
         },
     }))
 
@@ -348,6 +349,29 @@ def _bench_pipeline() -> dict:
         import traceback
 
         traceback.print_exc()  # a broken engine must not look like 0
+        return {}
+
+
+def _bench_data() -> dict:
+    """Streaming data-plane rows (ISSUE 19, docs/DATA.md):
+    `data_ingest_mb_s` through a byte-budgeted read->map plan,
+    `shuffle_epoch_ms` for one windowed_shuffle epoch, and
+    `feed_vs_handfed_tokens_ratio` (>= 0.95 acceptance bar, also
+    asserted live by scripts/data_smoke.py) — tracked per round in the
+    BENCH json detail."""
+    try:
+        import ray_tpu
+        from bench_core import data_plane_bench
+
+        ray_tpu.init(num_cpus=max(4, os.cpu_count() or 4))
+        try:
+            return data_plane_bench()
+        finally:
+            ray_tpu.shutdown()
+    except Exception:
+        import traceback
+
+        traceback.print_exc()  # a broken data plane must not look like 0
         return {}
 
 
@@ -572,4 +596,19 @@ def _bench_ppo_atari_host_steps() -> dict:
 
 
 if __name__ == "__main__":
+    if "--only" in sys.argv:
+        # single-suite entry (docs/DATA.md: `python bench.py --only data`)
+        # — skips the GPT headline and prints just that suite's rows
+        which = sys.argv[sys.argv.index("--only") + 1]
+        suites = {"data": _bench_data, "pipeline": _bench_pipeline,
+                  "perf": _bench_perf, "collectives": _bench_collectives,
+                  "sharding": _bench_sharding, "traffic": _bench_traffic,
+                  "llm": _bench_llm_serve, "dispatch": _bench_dispatch,
+                  "cgraph": _bench_cgraph_chain}
+        if which not in suites:
+            print(f"unknown suite {which!r}; one of {sorted(suites)}")
+            sys.exit(2)
+        print(json.dumps({"metric": f"bench_{which}",
+                          "value": suites[which]()}))
+        sys.exit(0)
     sys.exit(main())

@@ -538,6 +538,116 @@ def pipeline_train_bench() -> dict:
     return out
 
 
+def data_plane_bench() -> dict:
+    """Streaming data-plane rows (ISSUE 19, docs/DATA.md). Assumes an
+    initialized cluster.
+
+    - ``data_ingest_mb_s``: MB/s through a from_numpy->map_batches
+      streaming plan with the byte budget ON (~8 blocks worth), wall
+      clock over the block bytes drained at the consumer.
+    - ``shuffle_epoch_ms``: wall clock to drain one ``windowed_shuffle``
+      epoch end-to-end on the same block population — the streaming-
+      shuffle latency a training epoch pays.
+    - ``feed_vs_handfed_tokens_ratio``: steady-state step time of a
+      hand-fed ``CompiledPipelineEngine`` over the SAME engine config
+      fed the identical microbatches through ``attach_feed`` pump
+      actors. >= 0.95 is the acceptance bar (scripts/data_smoke.py
+      asserts it): the pump tier must keep the rings at least as
+      resident as the driver's synchronous sends.
+    """
+    import optax
+
+    import ray_tpu.data as rd
+    from ray_tpu.data import DataContext, DataFeed
+    from ray_tpu.train.pipeline_cgraph import CompiledPipelineEngine
+
+    out: dict = {}
+
+    # -- ingest MB/s, byte budget on --------------------------------------
+    rows, width, P = (4096, 64, 8) if SMOKE else (65536, 256, 32)
+    x = np.random.default_rng(0).standard_normal(
+        (rows, width)).astype(np.float32)
+    ctx = DataContext.get_current()
+    old_budget = ctx.target_max_bytes_inflight
+    ctx.target_max_bytes_inflight = 8 * (x.nbytes // P)
+    try:
+        t0 = time.perf_counter()
+        ds = rd.from_numpy({"x": x}, parallelism=P).map_batches(
+            lambda b: {"x": np.tanh(b["x"])})
+        total = 0
+        for b in ds.iter_batches(batch_size=None):
+            total += b["x"].nbytes
+        dt = time.perf_counter() - t0
+    finally:
+        ctx.target_max_bytes_inflight = old_budget
+    assert total == x.nbytes, f"drained {total} of {x.nbytes} bytes"
+    out["data_ingest_mb_s"] = round(total / dt / 1e6, 1)
+    out["data_ingest_blocks"] = P
+    out["data_ingest_peak_bytes_inflight"] = \
+        ds.stats().get("peak_bytes_inflight", 0)
+
+    # -- windowed-shuffle epoch drain -------------------------------------
+    t0 = time.perf_counter()
+    sds = rd.from_numpy({"x": x}, parallelism=P).windowed_shuffle(
+        window_blocks=4, seed=11)
+    n = 0
+    for b in sds.iter_batches(batch_size=None):
+        n += len(b["x"])
+    out["shuffle_epoch_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+    assert n == rows, f"shuffle epoch drained {n} of {rows} rows"
+
+    # -- feed-fed vs hand-fed engine throughput ---------------------------
+    # compute-meaningful microbatches (64 rows x 128 wide) so the row
+    # measures starvation, not channel-poll jitter; MEDIAN step time on
+    # both sides for the same reason (CI runs on oversubscribed cores)
+    M = 4
+    warmup, timed = (2, 6) if SMOKE else (3, 12)
+    fns, params, mbs, tgts = _pipeline_mlp(2, 128, M, mb_size=64)
+    tx = optax.sgd(1e-2)
+
+    def _median_steps(eng, step):
+        for _ in range(warmup):
+            step()
+        ts = []
+        for _ in range(timed):
+            t0 = time.perf_counter()
+            step()
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        return ts[len(ts) // 2]
+
+    eng = CompiledPipelineEngine(fns, params, tx, num_microbatches=M,
+                                 channel_bytes=1 << 20)
+    try:
+        hand_s = _median_steps(eng, lambda: eng.step(mbs, tgts))
+    finally:
+        eng.shutdown()
+
+    nmbs = [np.asarray(v) for v in mbs]
+    ntgts = [np.asarray(v) for v in tgts]
+    steps_total = warmup + timed + 4
+
+    def factory():
+        def it():
+            for _ in range(steps_total):
+                for xx, tt in zip(nmbs, ntgts):
+                    yield xx, tt
+        return it()
+
+    feng = CompiledPipelineEngine(fns, params, tx, num_microbatches=M,
+                                  channel_bytes=1 << 20)
+    try:
+        feng.attach_feed(DataFeed([factory]))
+        fed_s = _median_steps(feng, lambda: feng.step())
+    finally:
+        feng.shutdown()
+    tokens_per_step = M * nmbs[0].shape[0]
+    out["data_handfed_tokens_per_s"] = round(tokens_per_step / hand_s, 1)
+    out["data_fed_tokens_per_s"] = round(tokens_per_step / fed_s, 1)
+    out["feed_vs_handfed_tokens_ratio"] = round(hand_s / fed_s, 3)
+    return out
+
+
 def perf_overhead_bench() -> dict:
     """Observability rows (ISSUE 17). Assumes an initialized cluster.
 

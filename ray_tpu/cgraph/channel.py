@@ -284,6 +284,23 @@ class ShmChannel:
             except Exception:
                 pass
 
+    def detach(self) -> None:
+        """Release this endpoint's mapping WITHOUT poisoning the
+        ledger: the peer — and any successor endpoint attaching to the
+        same segment — keeps running. This is the writer-role handoff
+        primitive (the seq ledger is segment-resident, so a new writer
+        resumes exactly where this one left off); the data feed's
+        detach path uses it to hand the input rings back to the
+        driver."""
+        mv = self._mv
+        self._mv = None
+        if mv is not None:
+            del mv
+            try:
+                self._segreader.release(self._name)
+            except Exception:
+                pass
+
 
 class QueueChannel:
     """Consumer endpoint of a cross-node edge: a local queue fed by

@@ -850,6 +850,20 @@ class DriverRuntime:
         with sh.lock:
             sh.dir.setdefault(oid, set()).add(node_id)
 
+    def object_size_hint(self, oid: ObjectId) -> Optional[int]:
+        """Serialized size of a completed object, if the head knows it:
+        store-resident objects report the sealed segment size, inline
+        results their byte length. None for unknown/in-flight ids — the
+        data plane's byte-budget accounting (data/executor.py) treats
+        that as 'estimate instead'."""
+        sh = self._oshard(oid)
+        with sh.lock:
+            size = sh.sizes.get(oid)
+            if size is not None:
+                return int(size)
+            data = sh.mem.get(oid)
+            return len(data) if data is not None else None
+
     def object_table_snapshot(self) -> Tuple[Dict[ObjectId, Set[NodeId]],
                                              Set[ObjectId]]:
         """(directory, inline-object ids) merged over the shards — the

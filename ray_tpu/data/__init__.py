@@ -12,10 +12,12 @@ from .dataset import (ActorPoolStrategy, Dataset, GroupedDataset,
                       read_parquet, read_sql, read_tfrecords,
                       read_webdataset)
 from .pipeline import DatasetPipeline
-from .iterator import DataShard
+from .iterator import DataShard, Shardable
+from .feed import DataFeed
 
 __all__ = [
-    "ActorPoolStrategy", "Block", "DataContext", "DataShard", "Dataset",
+    "ActorPoolStrategy", "Block", "DataContext", "DataFeed", "DataShard",
+    "Dataset", "Shardable",
     "GroupedDataset", "from_arrow", "from_blocks", "from_items", "from_numpy", "range",
     "DatasetPipeline",
     "read_csv", "read_images", "read_json", "read_numpy",

@@ -10,6 +10,12 @@ class DataContext:
     # backpressure: max blocks in flight per streaming stage
     # (ref: streaming_executor_state.py resource limits)
     max_in_flight_blocks: int = 16
+    # byte-budget backpressure: per-segment admission stops once the
+    # tracked bytes of outstanding blocks (completed-but-unemitted at
+    # their store-reported size + in-flight tasks at the running average)
+    # reach this budget. 0 disables; the block-count window above always
+    # applies too (ref: ExecutionResources.object_store_memory)
+    target_max_bytes_inflight: int = 0
     # emit blocks in plan order rather than completion order (ref:
     # execution_options.preserve_order — the reference defaults False for
     # throughput; here determinism wins by default; buffered out-of-order

@@ -24,8 +24,9 @@ from .block import (Block, block_concat, block_from_batch, block_from_items,
                     block_to_rows)
 from .context import DataContext
 from .executor import StreamingExecutor
-from .iterator import DataShard, _iter_batches_from_blocks
-from .plan import AllToAllOp, MapOp, SourceOp, build_segments
+from .iterator import DataShard, Shardable, _iter_batches_from_blocks
+from .plan import (AllToAllOp, MapOp, SourceOp, WindowedShuffleOp,
+                   build_segments)
 
 
 def _block_rows(block: Block) -> int:
@@ -47,7 +48,7 @@ class ActorPoolStrategy:
     resources: Optional[Dict[str, float]] = None
 
 
-class Dataset:
+class Dataset(Shardable):
     def __init__(self, ops: List[Any], context: Optional[DataContext] = None):
         self._ops = ops
         self._ctx = context or DataContext.get_current()
@@ -171,6 +172,22 @@ class Dataset:
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
         return self._with(AllToAllOp("random_shuffle", seed))
+
+    def windowed_shuffle(self, *, window_blocks: int = 8,
+                         seed: Optional[int] = None) -> "Dataset":
+        """Streaming shuffle: buffer `window_blocks` upstream blocks,
+        emit their rows globally permuted by a seeded RNG, move to the
+        next window. Unlike random_shuffle this is NOT an all-to-all
+        barrier — the consumer starts after W blocks land and peak held
+        refs stay O(W) — which is the right trade for training input
+        pipelines (approximate global order, streaming memory).
+
+        The permutation is a pure function of (seed, epoch): replaying
+        the same epoch via iter_epochs() yields bit-identical order,
+        the next epoch reshuffles deterministically."""
+        if window_blocks < 1:
+            raise ValueError("window_blocks must be >= 1")
+        return self._with(WindowedShuffleOp(window_blocks, seed))
 
     def union(self, *others: "Dataset") -> "Dataset":
         """Lazy concatenation of datasets (ref: dataset.py union):
@@ -317,7 +334,7 @@ class Dataset:
         return build_segments(ops)
 
     def _execute_refs(self) -> List[Any]:
-        ex = StreamingExecutor(self._ctx)
+        ex = StreamingExecutor(self._ctx, epoch=getattr(self, "_epoch", 0))
         refs = list(ex.execute(self._segments()))
         self._last_stats = ex.stats.summary()
         limit = getattr(self, "_limit", None)
@@ -344,7 +361,7 @@ class Dataset:
         return refs
 
     def _stream_blocks(self) -> Iterator[Block]:
-        ex = StreamingExecutor(self._ctx)
+        ex = StreamingExecutor(self._ctx, epoch=getattr(self, "_epoch", 0))
         limit = getattr(self, "_limit", None)
         seen = 0
         for ref in ex.execute(self._segments()):
@@ -374,6 +391,25 @@ class Dataset:
         return _iter_batches_from_blocks(self._stream_blocks(), batch_size,
                                          batch_format, drop_last,
                                          local_shuffle_seed)
+
+    def iter_epochs(self, num_epochs: Optional[int] = None
+                    ) -> Iterator["Dataset"]:
+        """Epoch-aware re-execution for training loops: yields one
+        Dataset view per epoch, each re-running THIS plan with the
+        epoch index threaded into every windowed_shuffle stage — epoch
+        e replays bit-identically given the same seed, epoch e+1
+        reshuffles deterministically. num_epochs=None iterates forever
+        (ref: dataset_pipeline.py iter_epochs; here epochs re-execute
+        the lazy plan rather than replaying a pipeline log)."""
+        e = 0
+        while num_epochs is None or e < num_epochs:
+            ds = Dataset(self._ops, self._ctx)
+            lim = getattr(self, "_limit", None)
+            if lim is not None:
+                ds._limit = lim  # type: ignore[attr-defined]
+            ds._epoch = e  # type: ignore[attr-defined]
+            yield ds
+            e += 1
 
     def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
                            dtypes=None, device: str = "cpu",

@@ -23,9 +23,114 @@ import cloudpickle
 import numpy as np
 
 import ray_tpu
+from ray_tpu.core import runtime as _rt
+from ray_tpu.util import metrics as _metrics
 
 from .block import (Block, block_concat, block_num_rows, block_select,
                     block_slice)
+
+# byte-budget backpressure instruments (docs/DATA.md). Worker-process
+# executions register these in the worker's registry and their values
+# ship to the head on the standard metrics_push delta path.
+_G_BYTES_INFLIGHT = _metrics.Gauge(
+    "ray_tpu_data_bytes_inflight",
+    "bytes held by live streaming data segments in this process: "
+    "completed-but-unemitted blocks at store-reported size plus "
+    "in-flight tasks at the segment's running average")
+_C_BLOCKS_EMITTED = _metrics.Counter(
+    "ray_tpu_data_blocks_emitted_total",
+    "blocks emitted downstream by streaming data segments")
+
+# process-wide ledger behind the gauge: every live segment window posts
+# its outstanding-bytes delta here, so one scrape sees the sum over
+# concurrent executions without the windows sharing any other state
+_LEDGER_LOCK = threading.Lock()
+_LEDGER_BYTES = 0
+
+
+def _ledger_post(delta: int) -> None:
+    global _LEDGER_BYTES
+    if delta == 0:
+        return
+    with _LEDGER_LOCK:
+        _LEDGER_BYTES = max(0, _LEDGER_BYTES + delta)
+        _G_BYTES_INFLIGHT.set(float(_LEDGER_BYTES))
+
+
+def _ref_size_hint(ref) -> Optional[int]:
+    """Store-reported serialized size of a completed block ref, when the
+    process can see the object table (driver); None -> estimate."""
+    rt = _rt.maybe_runtime()
+    hint = getattr(rt, "object_size_hint", None)
+    if hint is None:
+        return None
+    try:
+        return hint(ref.id)
+    except Exception:
+        return None
+
+
+class _ByteWindow:
+    """Per-segment byte accounting for admit-against-budget
+    backpressure (DataContext.target_max_bytes_inflight; the way
+    serve/llm's BlockPool admits KV blocks — all-or-nothing against a
+    fixed budget, the admitter blocks rather than overshoots).
+
+    Completed-but-unemitted blocks count at their store-reported size —
+    including the ordered-mode head-of-line buffer, which the block
+    window already throttles but whose BYTES were previously invisible.
+    In-flight tasks count at the segment's running-average block size
+    (their real size is unknowable until the store seals them)."""
+
+    # in-flight estimate before the first completion is measured
+    _BOOTSTRAP_EST = 1 << 16
+
+    def __init__(self, stats: "ExecStats", budget: int):
+        self.budget = max(0, int(budget))
+        self.stats = stats
+        self._sizes: dict = {}     # emit index -> measured bytes
+        self._buffered = 0         # completed-but-unemitted bytes
+        self._avg = 0.0
+        self._seen = 0
+        self._posted = 0           # this window's share of the ledger
+
+    def outstanding(self, n_in_flight: int) -> int:
+        est = self._avg if self._seen else float(self._BOOTSTRAP_EST)
+        return self._buffered + int(est * n_in_flight)
+
+    def admit(self, n_in_flight: int) -> bool:
+        """May one more task be submitted? Always true with the budget
+        off; with everything drained (nothing in flight or buffered)
+        always true, so one oversized block can never wedge a stream."""
+        if self.budget <= 0:
+            return True
+        if n_in_flight == 0 and self._buffered == 0:
+            return True
+        return self.outstanding(n_in_flight) < self.budget
+
+    def on_complete(self, ref, idx: int) -> None:
+        size = _ref_size_hint(ref)
+        if size is None:
+            size = int(self._avg) if self._seen else self._BOOTSTRAP_EST
+        self._sizes[idx] = size
+        self._seen += 1
+        self._avg += (size - self._avg) / self._seen
+        self._buffered += size
+
+    def on_emit(self, idx: int) -> None:
+        self._buffered -= self._sizes.pop(idx, 0)
+        self.stats.on_emit()
+        _C_BLOCKS_EMITTED.inc()
+
+    def publish(self, n_in_flight: int) -> None:
+        now = self.outstanding(n_in_flight)
+        self.stats.on_bytes(now)
+        _ledger_post(now - self._posted)
+        self._posted = now
+
+    def close(self) -> None:
+        _ledger_post(-self._posted)
+        self._posted = 0
 
 # ---------------------------------------------------------------------------
 # remote helpers (module-level so the function blob is exported once)
@@ -221,24 +326,44 @@ class ExecStats:
         self.lock = threading.Lock()
         self.tasks_submitted = 0
         self.blocks_produced = 0
+        self.blocks_emitted = 0
         self.peak_in_flight = 0
+        self.bytes_inflight = 0
+        self.peak_bytes_inflight = 0
 
     def on_submit(self, in_flight: int) -> None:
         with self.lock:
             self.tasks_submitted += 1
             self.peak_in_flight = max(self.peak_in_flight, in_flight)
 
+    def on_emit(self) -> None:
+        with self.lock:
+            self.blocks_emitted += 1
+
+    def on_bytes(self, outstanding: int) -> None:
+        with self.lock:
+            self.bytes_inflight = outstanding
+            self.peak_bytes_inflight = max(self.peak_bytes_inflight,
+                                           outstanding)
+
     def summary(self) -> dict:
         return {"tasks_submitted": self.tasks_submitted,
                 "blocks_produced": self.blocks_produced,
-                "peak_in_flight": self.peak_in_flight}
+                "blocks_emitted": self.blocks_emitted,
+                "peak_in_flight": self.peak_in_flight,
+                "bytes_inflight": self.bytes_inflight,
+                "peak_bytes_inflight": self.peak_bytes_inflight}
 
 
 class StreamingExecutor:
     """Drives one dataset execution; yields output block refs."""
 
-    def __init__(self, context):
+    def __init__(self, context, epoch: int = 0):
         self.ctx = context
+        # epoch index threaded into windowed-shuffle seeds: Dataset
+        # .iter_epochs() re-executes the plan with epoch=e so every
+        # windowed_shuffle stage reshuffles deterministically per epoch
+        self.epoch = int(epoch)
         self.stats = ExecStats()
         self._apply_remote = ray_tpu.remote(_apply_chain)
         self._read_remote = ray_tpu.remote(_read_and_apply)
@@ -249,50 +374,70 @@ class StreamingExecutor:
                       reads: bool) -> Iterator[Any]:
         """Submit one task per input with a bounded in-flight window.
         With ctx.preserve_order (default), blocks emit in PLAN order —
-        completed-out-of-order refs buffer until their turn."""
+        completed-out-of-order refs buffer until their turn. Admission
+        is gated by the block-count window AND (when set) the byte
+        budget: ctx.target_max_bytes_inflight against this segment's
+        outstanding bytes."""
         cap = max(1, int(self.ctx.max_in_flight_blocks))
         ordered = bool(self.ctx.preserve_order)
+        bw = _ByteWindow(self.stats,
+                         getattr(self.ctx, "target_max_bytes_inflight", 0))
         in_flight: dict = {}   # ref -> submission index
         ready: dict = {}       # submission index -> ref (ordered mode)
         submitted = 0
         next_emit = 0
         inputs = iter(inputs)
         exhausted = False
-        while True:
-            # buffered-but-unemitted refs count against the window: one
-            # stalled head-of-line block must throttle submission, not let
-            # the whole dataset materialize behind it
-            while not exhausted and len(in_flight) + len(ready) < cap:
-                try:
-                    item = next(inputs)
-                except StopIteration:
-                    exhausted = True
-                    break
-                if reads:
-                    ref = self._read_remote.remote(item, chain_blob)
-                else:
-                    ref = self._apply_remote.remote(chain_blob, item)
-                in_flight[ref] = submitted
-                submitted += 1
-                self.stats.on_submit(len(in_flight))
-            if not in_flight:
-                if exhausted:
-                    for idx in sorted(ready):
-                        yield ready.pop(idx)
-                    return
-                continue
-            done, _ = ray_tpu.wait(list(in_flight), num_returns=1,
-                                   timeout=None, fetch_local=False)
-            for ref in done:
-                idx = in_flight.pop(ref)
-                self.stats.blocks_produced += 1
-                if not ordered:
-                    yield ref
+        try:
+            while True:
+                # buffered-but-unemitted refs count against the window: one
+                # stalled head-of-line block must throttle submission, not
+                # let the whole dataset materialize behind it
+                while not exhausted and len(in_flight) + len(ready) < cap \
+                        and bw.admit(len(in_flight)):
+                    try:
+                        item = next(inputs)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    if reads:
+                        ref = self._read_remote.remote(item, chain_blob)
+                    else:
+                        ref = self._apply_remote.remote(chain_blob, item)
+                    in_flight[ref] = submitted
+                    submitted += 1
+                    self.stats.on_submit(len(in_flight))
+                    bw.publish(len(in_flight))
+                if not in_flight:
+                    if exhausted:
+                        for idx in sorted(ready):
+                            ref = ready.pop(idx)
+                            bw.on_emit(idx)
+                            bw.publish(0)
+                            yield ref
+                        return
                     continue
-                ready[idx] = ref
-                while next_emit in ready:
-                    yield ready.pop(next_emit)
-                    next_emit += 1
+                done, _ = ray_tpu.wait(list(in_flight), num_returns=1,
+                                       timeout=None, fetch_local=False)
+                for ref in done:
+                    idx = in_flight.pop(ref)
+                    self.stats.blocks_produced += 1
+                    bw.on_complete(ref, idx)
+                    if not ordered:
+                        bw.on_emit(idx)
+                        bw.publish(len(in_flight))
+                        yield ref
+                        continue
+                    ready[idx] = ref
+                    while next_emit in ready:
+                        out = ready.pop(next_emit)
+                        bw.on_emit(next_emit)
+                        next_emit += 1
+                        bw.publish(len(in_flight))
+                        yield out
+                bw.publish(len(in_flight))
+        finally:
+            bw.close()
 
     def _stream_actor_pool(self, inputs: Iterator[Any], chain_blob: bytes,
                            pool_size: int,
@@ -306,6 +451,8 @@ class StreamingExecutor:
                 opts["resources"] = extra
         actors = [cls.options(**opts).remote(chain_blob) if opts
                   else cls.remote(chain_blob) for _ in range(pool_size)]
+        bw = _ByteWindow(self.stats,
+                         getattr(self.ctx, "target_max_bytes_inflight", 0))
         try:
             ray_tpu.get([a.ping.remote() for a in actors], timeout=60)
             per_actor_cap = max(
@@ -322,7 +469,7 @@ class StreamingExecutor:
                 while not exhausted:
                     i = min(load, key=lambda k: load[k])
                     if load[i] >= per_actor_cap or len(ready) >= len(actors) \
-                            * per_actor_cap:
+                            * per_actor_cap or not bw.admit(len(in_flight)):
                         break  # window full (incl. head-of-line buffer)
                     try:
                         item = next(inputs)
@@ -334,10 +481,14 @@ class StreamingExecutor:
                     submitted += 1
                     load[i] += 1
                     self.stats.on_submit(len(in_flight))
+                    bw.publish(len(in_flight))
                 if not in_flight:
                     if exhausted:
                         for idx in sorted(ready):
-                            yield ready.pop(idx)
+                            ref = ready.pop(idx)
+                            bw.on_emit(idx)
+                            bw.publish(0)
+                            yield ref
                         return
                     continue
                 done, _ = ray_tpu.wait(list(in_flight), num_returns=1,
@@ -346,14 +497,22 @@ class StreamingExecutor:
                     i, idx = in_flight.pop(ref)
                     load[i] -= 1
                     self.stats.blocks_produced += 1
+                    bw.on_complete(ref, idx)
                     if not ordered:
+                        bw.on_emit(idx)
+                        bw.publish(len(in_flight))
                         yield ref
                         continue
                     ready[idx] = ref
                     while next_emit in ready:
-                        yield ready.pop(next_emit)
+                        out = ready.pop(next_emit)
+                        bw.on_emit(next_emit)
                         next_emit += 1
+                        bw.publish(len(in_flight))
+                        yield out
+                bw.publish(len(in_flight))
         finally:
+            bw.close()
             for a in actors:
                 try:
                     ray_tpu.kill(a)
@@ -426,6 +585,54 @@ class StreamingExecutor:
                                      *merged_cols[j])
                 for j in range(n)]
 
+    # -- windowed shuffle (streaming, not a barrier) -------------------------
+
+    def _windowed_shuffle(self, stream: Iterator[Any], window: int,
+                          seed: Optional[int]) -> Iterator[Any]:
+        """Buffer up to `window` upstream block refs, emit their rows
+        globally permuted within the window, repeat. Replaces the
+        all-to-all random_shuffle barrier for training loops: the first
+        shuffled block is available after W upstream blocks land, and
+        peak held refs stay O(W) instead of O(dataset).
+
+        Every RNG in the stage is seeded by the tuple (base seed, epoch,
+        window index, task index) via np SeedSequence, so the emitted
+        row order is a pure function of (seed, epoch) — same seed+epoch
+        replays bit-identically, the next epoch reshuffles."""
+        window = max(1, int(window))
+        base = seed if seed is not None else 0x5EED
+        map_remote = ray_tpu.remote(_shuffle_map)
+        reduce_remote = ray_tpu.remote(_shuffle_reduce)
+
+        def shuffle_one(refs: List[Any], widx: int) -> List[Any]:
+            w = len(refs)
+            parts = [map_remote.options(num_returns=w).remote(
+                r, w, [base, self.epoch, widx, i])
+                for i, r in enumerate(refs)]
+            if w == 1:
+                cols = [[parts[0]]]
+            else:
+                cols = [[parts[i][j] for i in range(w)] for j in range(w)]
+            return [reduce_remote.remote([base, self.epoch, widx, w + j],
+                                         *col)
+                    for j, col in enumerate(cols)]
+
+        buf: List[Any] = []
+        widx = 0
+        for ref in stream:
+            buf.append(ref)
+            if len(buf) >= window:
+                # emit refs (futures) immediately: downstream pulls
+                # overlap this window's shuffle tasks and the upstream
+                # segment's production of the next window
+                for out in shuffle_one(buf, widx):
+                    yield out
+                buf = []
+                widx += 1
+        if buf:
+            for out in shuffle_one(buf, widx):
+                yield out
+
     def _sort(self, refs: List[Any], key: str, descending: bool) -> List[Any]:
         """Distributed sort: sample -> range partition -> per-partition
         sort (ref: planner/exchange/sort_task_spec.py SortTaskSpec)."""
@@ -491,6 +698,12 @@ class StreamingExecutor:
             elif kind == "chained":
                 assert stream is not None
                 inputs = stream
+            elif kind == "wshuffle":
+                # streaming stage: window-buffered shuffle over the
+                # previous segment's stream — no materialization
+                assert stream is not None
+                inputs = self._windowed_shuffle(stream, payload[0],
+                                                payload[1])
             elif kind == "barrier":
                 op, arg = payload
                 upstream = list(stream) if stream is not None else []

@@ -9,6 +9,7 @@ shard).
 """
 from __future__ import annotations
 
+import abc
 from typing import Any, Dict, Iterator, List, Optional
 
 import numpy as np
@@ -17,6 +18,21 @@ import ray_tpu
 
 from .block import (Block, block_concat, block_num_rows, block_select,
                     block_slice, block_to_batch, block_to_rows)
+
+
+class Shardable(abc.ABC):
+    """The sharding contract the Train layer consumes (`DataParallelTrainer`
+    ``datasets=``): ``split_shards(n)`` returns exactly ``n``
+    :class:`DataShard` handles whose rows are **disjoint** and
+    **exhaustive** — every row of the dataset lands in exactly one
+    shard. ``Dataset`` implements it; anything else that wants to feed
+    Train workers per-rank slices implements/registers this instead of
+    relying on a ``hasattr`` duck-type."""
+
+    @abc.abstractmethod
+    def split_shards(self, n: int, *, equal: bool = True,
+                     locality_hints=None) -> List["DataShard"]:
+        """Split into exactly ``n`` disjoint, exhaustive shards."""
 
 
 def _iter_batches_from_blocks(blocks: Iterator[Block], batch_size: Optional[int],

@@ -50,6 +50,20 @@ class AllToAllOp:
     name: str = "all_to_all"
 
 
+@dataclass
+class WindowedShuffleOp:
+    """Streaming windowed shuffle (Dataset.windowed_shuffle): buffers
+    `window` upstream blocks, emits their rows globally permuted by a
+    seeded RNG, then moves to the next window — NOT a barrier, so the
+    consumer starts pulling shuffled blocks after the first W blocks
+    land instead of after the whole dataset materializes. The executor
+    derives each window's RNG stream from (seed, epoch, window index),
+    so iter_epochs() reshuffles deterministically per epoch."""
+    window: int
+    seed: Optional[int] = None
+    name: str = "windowed_shuffle"
+
+
 def build_segments(ops: List[Any]) -> List[dict]:
     """Fuse the op list into executor segments (see StreamingExecutor.execute)."""
     if not ops or not isinstance(ops[0], SourceOp):
@@ -95,6 +109,11 @@ def build_segments(ops: List[Any]) -> List[dict]:
         elif isinstance(op, AllToAllOp):
             flush()
             pending_source = ("barrier", (op.kind, op.arg))
+        elif isinstance(op, WindowedShuffleOp):
+            # streaming stage: consumes the previous segment's stream
+            # window-by-window (no materialization barrier)
+            flush()
+            pending_source = ("wshuffle", (op.window, op.seed))
         else:
             raise TypeError(f"unknown op {op!r}")
     flush()

@@ -97,3 +97,10 @@ class CompiledGraphClosedError(CompiledGraphError):
 class ChannelFullError(CompiledGraphError):
     """A compiled-graph channel write could not complete: the payload
     exceeds the channel's pre-allocated slot capacity."""
+
+
+class DataFeedError(CompiledGraphError):
+    """A data-feed pump actor (ray_tpu.data.feed) attached to a
+    pipeline engine died or failed while the engine was live; the
+    engine aborts with this so ``recover()`` can respawn the stages
+    AND re-attach the feed."""

@@ -44,13 +44,26 @@ class DataParallelTrainer:
     def _dataset_shards(self) -> Optional[List[dict]]:
         if not self.datasets:
             return None
+        from ..data import DataShard, DatasetPipeline
+        from ..data.iterator import Shardable
+
         n = self.scaling.num_workers
         shards: List[dict] = [{} for _ in range(n)]
         for name, ds in self.datasets.items():
-            parts = None
-            if hasattr(ds, "split_shards"):          # ray_tpu.data.Dataset
+            if isinstance(ds, Shardable):
+                # the DataShard contract: exactly n shards, rows
+                # disjoint and exhaustive (enforced here so a broken
+                # implementer fails loudly, not with silently skewed
+                # or duplicated per-rank data)
                 parts = ds.split_shards(n)
-            elif hasattr(ds, "split"):
+                if len(parts) != n or not all(
+                        isinstance(p, DataShard) for p in parts):
+                    raise TypeError(
+                        f"dataset {name!r}: split_shards({n}) must "
+                        f"return exactly {n} DataShards (the Shardable "
+                        f"contract); got {len(parts)} x "
+                        f"{[type(p).__name__ for p in parts[:3]]}")
+            elif isinstance(ds, DatasetPipeline):
                 parts = ds.split(n)
             elif isinstance(ds, (list, tuple)):
                 parts = [list(ds[i::n]) for i in range(n)]

@@ -1,0 +1,65 @@
+"""Fixture: block-ref lifecycle in the feed's staging tier under
+GC030-033 — channel segments (allocate_channel/release_channel) and
+staging-pool blocks (pool.alloc/free), in the shapes the shipped
+data plane uses. The clean functions mirror executor.py/feed.py idiom
+(try/finally around the window, ownership transfer to the engine);
+the seeded positives are the leak shapes the rules exist to stop."""
+
+
+def pump_window_clean(pool, store, cid, size, batches):
+    """Shipped idiom: the pump's channel is released on EVERY path out
+    of the drain loop — including a batch raising mid-pack."""
+    name = store.allocate_channel(cid, size)
+    staged = pool.alloc(len(batches))
+    try:
+        for b in batches:
+            staged.append(b)
+        return name
+    finally:
+        pool.free(staged)
+        store.release_channel(cid)
+
+
+def handoff_clean(pool, n):
+    """Ownership transfer: the packed block is RETURNED to the engine
+    (the attach_feed handoff) — not a leak."""
+    block = pool.alloc(n)
+    return block
+
+
+def early_return_leak(pool, store, cid, size, empty):
+    """GC030: the empty-shard early return skips the release."""
+    store.allocate_channel(cid, size)
+    b = pool.alloc(4)
+    if empty:
+        return None
+    pool.free(b)
+    store.release_channel(cid)
+    return b
+
+
+def double_release(store, cid, size, drained):
+    """GC031: detach-then-teardown releasing the same channel twice."""
+    store.allocate_channel(cid, size)
+    if drained:
+        store.release_channel(cid)
+    store.release_channel(cid)
+
+
+def swallowed_release(pool, n, pack):
+    """GC032: pack() raising lands in a handler that neither re-raises
+    nor frees — the staged blocks leak into the next window."""
+    staged = pool.alloc(n)
+    try:
+        pack(staged)
+        pool.free(staged)
+    except Exception:
+        pass
+
+
+def conditional_acquire(pool, n, prefetch):
+    """GC033: acquire under a condition, release unconditionally."""
+    staged = None
+    if prefetch:
+        staged = pool.alloc(n)
+    pool.free(staged)

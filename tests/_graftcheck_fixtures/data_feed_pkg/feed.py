@@ -1,0 +1,96 @@
+"""Fixture: the streaming-feed shape (ISSUE 19). A FeedPump actor's
+methods are bound into the SAME cyclic compiled graph as the pipeline
+stages — pump -> stage0 -> stage1 -> stage0 (bwd) — so the bind graph
+is cyclic and the pump sits on it. The pump's bound methods are pure
+channel dataflow (pack a microbatch, push it on) and must stay
+GC008-clean, and the cycle is channel dataflow, not synchronous
+waiting, so GC010 must NOT call it an actor deadlock. DirtyPump is the
+GC008 positive control: same bound shape, dynamic submit inside."""
+import ray_tpu
+
+from .sink import BlockingSink
+
+
+@ray_tpu.remote
+def tokenize(x):
+    return x
+
+
+@ray_tpu.remote
+class FeedPump:
+    def setup(self, shard):
+        self.shard = shard
+        self.cursor = 0
+        return True
+
+    def pack(self, n):
+        batch = self.shard[self.cursor:self.cursor + n]
+        self.cursor += n
+        return batch                     # bound: pure compute, clean
+
+    def stats(self):
+        return {"cursor": self.cursor}
+
+
+@ray_tpu.remote
+class TrainStage:
+    def setup(self, params):
+        self.params = params
+        return True
+
+    def forward(self, batch):
+        return batch + self.params       # bound: pure compute, clean
+
+    def backward(self, grad):
+        return grad * 2                  # bound: pure compute, clean
+
+
+@ray_tpu.remote
+class DirtyPump:
+    def pack(self, n):
+        return tokenize.remote(n)        # GC008: dynamic submit in bound method
+
+
+class FedEngine:
+    """Driver: binds the pump INTO the stage cycle — the feed is an
+    engine input, not a side library. Engine-internal gets (setup
+    fan-out, stats) are driver-side and must not be attributed to the
+    bound methods."""
+
+    def __init__(self, shard, params):
+        self.pump = FeedPump.remote()
+        self.s0 = TrainStage.remote()
+        self.s1 = TrainStage.remote()
+        ray_tpu.get([self.pump.setup.remote(shard),
+                     self.s0.setup.remote(params),
+                     self.s1.setup.remote(params)])
+
+    def compile_step(self, n):
+        # pump -> s0 -> s1 -> s0: the pump feeds a cyclic dataflow
+        # graph (s0 appears on both the fwd and bwd arcs)
+        mb = self.pump.pack.bind(n)
+        h0 = self.s0.forward.bind(mb)
+        h1 = self.s1.forward.bind(h0)
+        g0 = self.s0.backward.bind(h1)
+        return g0
+
+    def feed_stats(self):
+        return ray_tpu.get(self.pump.stats.remote())
+
+
+def build_dirty(n):
+    d = DirtyPump.remote()
+    return d.pack.bind(n)
+
+
+@ray_tpu.remote
+class BlockingPump:
+    """GC010 positive control: a pump that synchronously WAITS on the
+    consumer which synchronously waits back — a real deadlock cycle,
+    unlike the channel-dataflow bind cycle above."""
+
+    def __init__(self, sink: BlockingSink):
+        self.sink = sink
+
+    def fill(self, x):
+        return ray_tpu.get(self.sink.take.remote(x))

@@ -1,0 +1,16 @@
+"""Fixture: the blocking consumer closing the GC010 positive-control
+cycle — BlockingPump.fill waits on BlockingSink.take, which waits
+right back on the pump. (Never imported at runtime — lint fixture
+only.)"""
+import ray_tpu
+
+from .feed import BlockingPump
+
+
+@ray_tpu.remote
+class BlockingSink:
+    def __init__(self, pump: "BlockingPump"):
+        self.pump = pump
+
+    def take(self, x):
+        return ray_tpu.get(self.pump.fill.remote(x + 1))

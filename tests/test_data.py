@@ -820,3 +820,191 @@ class TestRound5DatasetOps:
         assert out.count() == 6
         assert data.range(10).limit(3).map(
             lambda r: {"id": r["id"]}).count() == 3
+
+
+# ---------------------------------------------------------------------------
+# windowed epoch shuffle (ISSUE 19 tentpole b)
+# ---------------------------------------------------------------------------
+
+
+class TestWindowedShuffle:
+    def test_exactly_once_and_windowed(self, cluster):
+        """Every source row appears exactly once, and each window's
+        output rows come only from that window's input blocks (the
+        streaming property: W blocks buffer, shuffle, emit, repeat)."""
+        ds = rd.range(80, parallelism=8).windowed_shuffle(
+            window_blocks=4, seed=11)
+        vals = [r["id"] for r in ds.take_all()]
+        assert sorted(vals) == list(range(80))
+        # blocks 0-3 hold rows 0..39, blocks 4-7 hold rows 40..79:
+        # window locality means the first 40 emitted rows are exactly
+        # the first window's rows (permuted), never a row from window 2
+        assert sorted(vals[:40]) == list(range(40))
+        assert vals[:40] != list(range(40))  # actually shuffled
+
+    def test_same_seed_same_epoch_bit_identical(self, cluster):
+        def run():
+            return [r["id"] for r in rd.range(120, parallelism=6)
+                    .windowed_shuffle(window_blocks=3, seed=5).take_all()]
+
+        assert run() == run()
+
+    def test_epochs_reshuffle_deterministically(self, cluster):
+        """iter_epochs(): every epoch is a permutation of all rows,
+        different epochs differ, and replaying the epoch sequence
+        reproduces the same per-epoch orders bit-for-bit."""
+        ds = rd.range(60, parallelism=6).windowed_shuffle(
+            window_blocks=3, seed=9)
+
+        def epochs(n):
+            return [[r["id"] for r in e.take_all()]
+                    for e in ds.iter_epochs(n)]
+
+        a = epochs(3)
+        for order in a:
+            assert sorted(order) == list(range(60))
+        assert a[0] != a[1] and a[1] != a[2]
+        assert epochs(3) == a
+
+    def test_seed_changes_order(self, cluster):
+        base = rd.range(60, parallelism=6)
+        one = [r["id"] for r in
+               base.windowed_shuffle(window_blocks=3, seed=1).take_all()]
+        two = [r["id"] for r in
+               base.windowed_shuffle(window_blocks=3, seed=2).take_all()]
+        assert one != two and sorted(one) == sorted(two)
+
+    def test_window_one_and_tail_window(self, cluster):
+        # window_blocks=1 degenerates to per-block row shuffle; a
+        # 7-block source with window 4 leaves a 3-block tail window
+        vals = sorted(r["id"] for r in rd.range(70, parallelism=7)
+                      .windowed_shuffle(window_blocks=4, seed=3)
+                      .take_all())
+        assert vals == list(range(70))
+        vals1 = sorted(r["id"] for r in rd.range(30, parallelism=3)
+                       .windowed_shuffle(window_blocks=1, seed=3)
+                       .take_all())
+        assert vals1 == list(range(30))
+
+
+# ---------------------------------------------------------------------------
+# byte-budgeted backpressure (ISSUE 19 tentpole a) + Shardable contract
+# ---------------------------------------------------------------------------
+
+
+def test_byte_budget_caps_outstanding_bytes(cluster):
+    """target_max_bytes_inflight throttles admission: with a budget of
+    ~2 blocks, peak outstanding bytes stay bounded while the run still
+    completes; with the budget off the gauge path still counts."""
+    ctx = DataContext.get_current()
+    old = ctx.target_max_bytes_inflight
+    block_bytes = 8 * 2048  # int64 rows per block below
+    ctx.target_max_bytes_inflight = 2 * block_bytes
+    try:
+        ds = rd.range(16 * 2048, parallelism=16).map_batches(
+            lambda b: {"id": b["id"]})
+        assert ds.count() == 16 * 2048
+        stats = ds.stats()
+        assert stats["blocks_emitted"] == 16
+        # bounded: bootstrap-estimate slack on top of the 2-block budget,
+        # never the whole 16-block dataset in flight at once
+        assert 0 < stats["peak_bytes_inflight"] <= 6 * block_bytes
+    finally:
+        ctx.target_max_bytes_inflight = old
+
+
+def test_actor_pool_head_of_line_bytes_counted(cluster):
+    """Regression (ISSUE 19 satellite): the actor-pool path's
+    head-of-line buffer (completed-but-unemitted blocks in ordered
+    mode) must surface in the byte accounting, not just the block
+    window."""
+    ctx = DataContext.get_current()
+    old = ctx.target_max_bytes_inflight
+    ctx.target_max_bytes_inflight = 1 << 20
+    try:
+        ds = rd.range(8 * 1024, parallelism=8).map_batches(
+            lambda b: {"id": b["id"]},
+            compute=rd.ActorPoolStrategy(size=2))
+        assert ds.count() == 8 * 1024
+        stats = ds.stats()
+        # two segments emit: the read segment feeding the pool + the
+        # pool itself — 8 source blocks each
+        assert stats["blocks_emitted"] == 16
+        # with 8KiB blocks the peak must reflect real completed-block
+        # sizes (store-reported), not just the bootstrap estimate of
+        # in-flight tasks
+        assert stats["peak_bytes_inflight"] >= 8 * 1024
+    finally:
+        ctx.target_max_bytes_inflight = old
+
+
+def test_byte_window_buffers_head_of_line():
+    """_ByteWindow unit: completed-but-unemitted blocks count at
+    measured size, admission blocks once outstanding >= budget, and a
+    fully-drained window always admits (no oversized-block wedge)."""
+    from ray_tpu.data.executor import ExecStats, _ByteWindow
+
+    class _Ref:
+        class id:  # noqa: N801 — mimics ObjectId attribute shape
+            pass
+
+    bw = _ByteWindow(ExecStats(), budget=100)
+    assert bw.admit(0)          # drained -> always admit
+    bw.on_complete(_Ref(), 0)   # no store hint -> bootstrap estimate
+    assert bw._buffered >= bw._BOOTSTRAP_EST
+    assert not bw.admit(1)      # head-of-line bytes block admission
+    bw.on_emit(0)
+    assert bw._buffered == 0
+    assert bw.admit(0)
+    bw.close()
+
+
+def test_trainer_shard_contract_disjoint_exhaustive(cluster):
+    """A sharded Dataset feeds Trainer workers DISJOINT, EXHAUSTIVE row
+    sets (the Shardable contract satellite): the union of what the two
+    workers saw is exactly the source rows, with no overlap."""
+    from ray_tpu import train
+    from ray_tpu.train.trainer import DataParallelTrainer
+
+    ds = rd.range(100, parallelism=4)
+
+    def loop(config):
+        shard = train.get_dataset_shard("train")
+        ids = sorted(int(v) for b in shard.iter_batches(batch_size=16)
+                     for v in b["id"])
+        train.report({"n": len(ids), "lo": ids[0], "hi": ids[-1],
+                      "sum": sum(ids)})
+
+    res = DataParallelTrainer(
+        loop, datasets={"train": ds},
+        scaling_config=train.ScalingConfig(num_workers=2)).fit()
+    assert res.error is None
+    # rank-0 metrics ride the Result; disjoint+exhaustive shows as the
+    # two ranks' counts and sums totalling the source exactly — rank 0
+    # alone can't, so check via executor-reported history of rank 0 plus
+    # the contract-enforced equal split
+    assert res.metrics_history[-1]["n"] == 50
+    # re-split on the driver and check the actual contract directly
+    shards = ds.split_shards(2)
+    rows = [sorted(r["id"] for r in s.iter_rows()) for s in shards]
+    assert sorted(rows[0] + rows[1]) == list(range(100))
+    assert not (set(rows[0]) & set(rows[1]))
+
+
+def test_trainer_rejects_broken_shardable(cluster):
+    """An implementer that violates the Shardable contract (wrong shard
+    count / wrong type) fails loudly at sharding time, not with
+    silently skewed per-rank data."""
+    from ray_tpu import train
+    from ray_tpu.data.iterator import Shardable
+    from ray_tpu.train.trainer import DataParallelTrainer
+
+    class Broken(Shardable):
+        def split_shards(self, n, *, equal=True, locality_hints=None):
+            return ["not-a-shard"] * n
+
+    t = DataParallelTrainer(
+        lambda config: None, datasets={"train": Broken()},
+        scaling_config=train.ScalingConfig(num_workers=2))
+    with pytest.raises(TypeError, match="Shardable"):
+        t._dataset_shards()
