@@ -457,8 +457,9 @@ class Node:
         if env_hash is not None:
             handle.env_hash = env_hash  # container workers: dedicated
             # from birth (the env can't be applied to a host process)
-        self._workers[worker_id] = handle
-        self._starting_count += 1
+        with self._lock:  # reentrant: callers may already hold it
+            self._workers[worker_id] = handle
+            self._starting_count += 1
         # watchdog: a worker that dies before registering must not strand the
         # lease queue (ref: worker_pool.cc PopWorker failure callbacks)
         threading.Thread(target=self._reap_worker, args=(handle,), daemon=True,
@@ -559,8 +560,11 @@ class Node:
                     f"launcher / image) and worker logs"))
 
     def _terminate_worker(self, worker: WorkerHandle) -> None:
-        worker.state = "dead"
-        self._workers.pop(worker.worker_id, None)
+        # kill_worker/shutdown call in unlocked: the pop must not race a
+        # dispatch pass iterating _workers under the (reentrant) lock
+        with self._lock:
+            worker.state = "dead"
+            self._workers.pop(worker.worker_id, None)
         self.runtime.refcount.release_holder(worker.worker_id)
         if worker.channel is not None:
             worker.channel.notify("shutdown")

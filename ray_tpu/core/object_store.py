@@ -169,20 +169,25 @@ class PlasmaStore:
                        pin: bool = True) -> None:
         """Create+write+seal in one step (server-local fast path)."""
         t0 = time.perf_counter()
-        self.create(object_id, sobj.total_bytes)
-        e = self._entries[object_id]
-        sobj.write_into(memoryview(e.shm.buf))
-        e.pinned = pin
-        self.seal(object_id)
+        # hold the (reentrant) lock across create->write->seal: a
+        # concurrent create's eviction pass must not drop the entry
+        # mid-write (same discipline as the native store's put path)
+        with self._lock:
+            self.create(object_id, sobj.total_bytes)
+            e = self._entries[object_id]
+            sobj.write_into(memoryview(e.shm.buf))
+            e.pinned = pin
+            self.seal(object_id)
         _observe_op("put", t0, sobj.total_bytes)
 
     def put_bytes(self, object_id: ObjectId, data: bytes, pin: bool = True) -> None:
         t0 = time.perf_counter()
-        self.create(object_id, len(data))
-        e = self._entries[object_id]
-        e.shm.buf[: len(data)] = data
-        e.pinned = pin
-        self.seal(object_id)
+        with self._lock:  # see put_serialized: write under the lock
+            self.create(object_id, len(data))
+            e = self._entries[object_id]
+            e.shm.buf[: len(data)] = data
+            e.pinned = pin
+            self.seal(object_id)
         _observe_op("put", t0, len(data))
 
     def put_chunk(self, object_id: ObjectId, offset: int, total: int,

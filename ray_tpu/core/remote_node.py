@@ -147,8 +147,9 @@ class RemoteNode(Node):
                               started_at=time.monotonic())
         if env_hash is not None:
             handle.env_hash = env_hash  # container workers: dedicated
-        self._workers[worker_id] = handle
-        self._starting_count += 1
+        with self._lock:  # reentrant: callers may already hold it
+            self._workers[worker_id] = handle
+            self._starting_count += 1
         msg = {"worker_id": worker_id}
         if container is not None:
             # the agent launches inside the container on ITS host via
@@ -227,8 +228,9 @@ class RemoteNode(Node):
                                           "spec": spec})
 
     def _terminate_worker(self, worker: WorkerHandle) -> None:
-        worker.state = "dead"
-        self._workers.pop(worker.worker_id, None)
+        with self._lock:  # the pop must not race a dispatch pass
+            worker.state = "dead"
+            self._workers.pop(worker.worker_id, None)
         self.runtime.refcount.release_holder(worker.worker_id)
         try:
             self.channel.notify("kill_worker", {"worker_id": worker.worker_id,

@@ -1897,6 +1897,7 @@ class DriverRuntime:
         rec = self._actors.get(spec.actor_id)
         if rec is None:
             return None
+        new_chan = None
         with rec.lock:
             if rec.worker is None or rec.queued:
                 return None
@@ -1935,9 +1936,7 @@ class DriverRuntime:
                         rec.direct_fails += 1
                         return None
                     rec.direct_fails = 0
-                    chan.on_close(
-                        lambda aid=spec.actor_id, ch=chan:
-                        self._on_direct_peer_close(aid, ch))
+                    new_chan = chan
                     rec.direct_chan = chan
                     # new connection era: seq numbering restarts with it
                     # (frames lost in the old socket would otherwise leave
@@ -1953,6 +1952,16 @@ class DriverRuntime:
             gate = rec.seq
             era = rec.dlane
             rec.direct_inflight[spec.task_id] = spec
+        if new_chan is not None:
+            # registered only after rec.lock is dropped: on_close fires the
+            # callback synchronously when the channel already died, and
+            # _on_direct_peer_close re-takes this record's non-reentrant
+            # lock — registering under it would self-deadlock. A close in
+            # the unregistered window is caught by the chan.closed check
+            # below (recovery is idempotent).
+            new_chan.on_close(
+                lambda aid=spec.actor_id, ch=new_chan:
+                self._on_direct_peer_close(aid, ch))
         for oid in spec.return_ids():
             self.refcount.add_owned(oid)
         refs = [self.make_ref(oid) for oid in spec.return_ids()]
@@ -2164,6 +2173,7 @@ class DriverRuntime:
         submissions. Parked groups (no capacity) retry on cluster events
         and on a 500 ms tick (lease releases free capacity without an
         event)."""
+        # graftcheck: disable=GC050 — placer-thread-private fingerprint
         self._pg_last_fp = None
         while True:
             with self._pg_cv:
@@ -2807,6 +2817,9 @@ class DriverRuntime:
         # would return while teardown is still in progress — it must
         # block on the lock below instead
         if not self._shutdown_lock.acquire(blocking=False):
+            # a true compare only ever observes the reading thread's own
+            # earlier write, so reading the owner field unlocked is safe
+            # graftcheck: disable=GC050 — reentrancy probe
             if self._shutdown_owner == threading.get_ident():
                 return  # reentrant (signal handler / close callback)
             with self._shutdown_lock:  # concurrent: wait for completion

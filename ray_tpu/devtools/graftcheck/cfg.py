@@ -192,9 +192,12 @@ def _test_assumes(test: ast.expr) -> Tuple[Assume, Assume]:
     if isinstance(test, ast.Name):
         return ("some", test.id), ("none", test.id)
     if isinstance(test, ast.Call) and isinstance(test.func, ast.Attribute) \
-            and test.func.attr == "acquire":
+            and test.func.attr in ("acquire", "locked"):
         # `if lock.acquire(blocking=False):` — the false branch did NOT
-        # take the lock (try-acquire); dotted receiver keys the resource
+        # take the lock (try-acquire); dotted receiver keys the resource.
+        # `if lock.locked():` is the dual probe: code guards bodies with
+        # it to assert the caller-held invariant, so the true branch is
+        # treated as held (v5 concurrency domain; see rules_concurrency).
         parts: List[str] = []
         node = test.func.value
         while isinstance(node, ast.Attribute):

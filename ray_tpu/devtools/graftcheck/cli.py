@@ -8,6 +8,8 @@ subcommand.
         [--cache F | --no-cache] [--no-project] [--diff REF] \
         [--stats] paths...
     python -m ray_tpu.devtools.graftcheck graph [--out F] paths...
+    python -m ray_tpu.devtools.graftcheck locks [--dot | --json] \
+        [--out F] paths...
 
 ``--diff REF`` scopes reporting to files changed vs the git ref plus
 their reverse-dependency closure from the project index (everything
@@ -48,6 +50,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(argv) if argv is not None else sys.argv[1:]
     if argv and argv[0] == "graph":
         return _graph_main(argv[1:])
+    if argv and argv[0] == "locks":
+        return _locks_main(argv[1:])
     return _check_main(argv)
 
 
@@ -115,6 +119,7 @@ def _check_main(argv: List[str]) -> int:
 
     lifecycle_stats: dict = {}
     shape_stats: dict = {}
+    concurrency_stats: dict = {}
     diff_note = ""
     t0 = time.monotonic()
     if args.no_project:
@@ -145,6 +150,7 @@ def _check_main(argv: List[str]) -> int:
         parsed_n, cached_n = result.parsed, result.cached
         lifecycle_stats = result.lifecycle_stats
         shape_stats = result.shape_stats
+        concurrency_stats = result.concurrency_stats
         if args.diff:
             changed = _git_changed_files(args.diff)
             if changed is None:
@@ -221,6 +227,20 @@ def _check_main(argv: List[str]) -> int:
                   f"iterations, "
                   f"{ss.get('fns_nonconverged', 0)} non-converged",
                   file=sys.stderr)
+        if concurrency_stats:
+            cs = concurrency_stats
+            print("graftcheck concurrency: "
+                  f"{cs.get('fns_analyzed', 0)} fns analyzed "
+                  f"({cs.get('fns_total', 0)} seen, "
+                  f"{cs.get('fns_generators_skipped', 0)} generators, "
+                  f"{cs.get('fns_cfg_skipped', 0)} over-budget, "
+                  f"{cs.get('fns_errors', 0)} errors), "
+                  f"{cs.get('classes_with_locks', 0)} classes with "
+                  f"locks, {cs.get('locks_discovered', 0)} locks, "
+                  f"{cs.get('guards_inferred', 0)} guards inferred, "
+                  f"{cs.get('held_states', 0)} held-lock states, "
+                  f"{cs.get('helper_reruns', 0)} helper re-runs",
+                  file=sys.stderr)
     if errors:
         return 2
     return 1 if findings else 0
@@ -278,4 +298,70 @@ def _graph_main(argv: List[str]) -> int:
             fh.write(dot)
         print(f"graftcheck: wrote {len(result.graph.nodes)} nodes / "
               f"{len(result.graph.edges)} edges to {args.out}")
+    return 2 if result.errors else 0
+
+
+# ---------------------------------------------------------------------------
+# locks
+
+
+def _locks_main(argv: List[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.devtools.graftcheck locks",
+        description="dump the static role-level lock-order graph "
+                    "(nested held-lock states + transitive acquires); "
+                    "the dynamic RAY_TPU_DEBUG_LOCKS=1 order graph must "
+                    "be a subgraph of this (scripts/locks_gate.py)")
+    parser.add_argument("paths", nargs="+", help="files or directories")
+    parser.add_argument("--dot", action="store_true",
+                        help="emit GraphViz DOT instead of text")
+    parser.add_argument("--json", action="store_true",
+                        help="emit JSON ({roles: [...], edges: [{src, "
+                             "dst, path, line, via}]})")
+    parser.add_argument("--out", metavar="FILE", default="-",
+                        help="output path (default: stdout)")
+    parser.add_argument("--cache", metavar="FILE",
+                        default=engine_mod.default_cache_path())
+    parser.add_argument("--no-cache", action="store_true")
+    args = parser.parse_args(argv)
+
+    from . import rules_concurrency
+
+    try:
+        result = engine_mod.check_project(
+            args.paths, rules=set(),
+            cache_path=None if args.no_cache else args.cache)
+    except FileNotFoundError as e:
+        print(f"no such file or directory: {e}", file=sys.stderr)
+        return 2
+    edges = rules_concurrency.build_lock_order_graph(result.index)
+    items = sorted((src, dst, path, line, via)
+                   for (src, dst), (path, line, via) in edges.items())
+    if args.json:
+        out = json.dumps({
+            "roles": rules_concurrency.project_lock_roles(result.index),
+            "edges": [
+                {"src": s, "dst": d, "path": p, "line": ln, "via": v}
+                for s, d, p, ln, v in items]}, indent=2) + "\n"
+    elif args.dot:
+        lines = ["digraph lock_order {", "  rankdir=LR;"]
+        for s, d, p, ln, v in items:
+            note = f" {v}" if v else ""
+            lines.append(f'  "{s}" -> "{d}" '
+                         f'[label="{p}:{ln}{note}"];')
+        lines.append("}")
+        out = "\n".join(lines) + "\n"
+    else:
+        out = "".join(
+            f"{s} -> {d}  ({p}:{ln}{' ' + v if v else ''})\n"
+            for s, d, p, ln, v in items)
+        out += (f"graftcheck locks: {len(items)} order "
+                f"edge{'s' if len(items) != 1 else ''}\n")
+    if args.out == "-":
+        sys.stdout.write(out)
+    else:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(out)
+        print(f"graftcheck: wrote {len(items)} lock-order edges to "
+              f"{args.out}")
     return 2 if result.errors else 0

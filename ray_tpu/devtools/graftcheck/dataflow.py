@@ -65,6 +65,114 @@ class FixpointResult:
         self.converged = converged
 
 
+# ---------------------------------------------------------------------------
+# held-lock abstract state (graftcheck v5)
+#
+# The concurrency rules (GC050-054, :mod:`.rules_concurrency`) all run
+# the same MUST-analysis: "which locks does this thread provably hold
+# here?". The state is a held multiset (reentrant locks nest, so a bare
+# set would go empty one ``with`` too early) plus the bindings of
+# try-acquire results (``got = lock.acquire(blocking=False)`` — the
+# branch on ``got`` decides heldness, via the CFG's some/none assumes).
+# MUST semantics make the join an intersection: a lock only counts as
+# held after a merge point when every incoming path holds it — exactly
+# the conservative direction for "flag accesses with no lock held"
+# (under-claiming held locks can only create false positives on merge
+# diamonds, never false negatives, and the rules' exemptions absorb
+# the few real diamonds in the tree).
+
+
+class LockState:
+    """Immutable held-lock state: (token -> depth) + try-acquire binds.
+
+    Tokens are opaque strings chosen by the domain (the concurrency
+    rules use ``self._lock``-style dotted receivers, alias-resolved).
+    Depth is capped so pathological ``while True: lock.acquire()``
+    loops cannot grow the lattice unboundedly.
+    """
+
+    __slots__ = ("held", "binds")
+    _MAX_DEPTH = 3
+
+    def __init__(self, held: tuple = (), binds: frozenset = frozenset()):
+        self.held = held      # sorted ((token, depth), ...)
+        self.binds = binds    # {(name, token)}
+
+    # -- equality / hashing (the fixpoint compares states) ----------------
+
+    def __eq__(self, other):
+        return isinstance(other, LockState) and self.held == other.held \
+            and self.binds == other.binds
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+    def __hash__(self):
+        return hash((self.held, self.binds))
+
+    def __repr__(self):   # pragma: no cover - debugging aid
+        locks = ",".join(f"{t}x{d}" if d > 1 else t for t, d in self.held)
+        return f"<LockState [{locks}]>"
+
+    # -- queries ----------------------------------------------------------
+
+    def tokens(self) -> frozenset:
+        return frozenset(t for t, _ in self.held)
+
+    def has(self, token: str) -> bool:
+        return any(t == token for t, _ in self.held)
+
+    # -- transfers (all return new states) --------------------------------
+
+    def acquire(self, token: str) -> "LockState":
+        out = dict(self.held)
+        out[token] = min(out.get(token, 0) + 1, self._MAX_DEPTH)
+        return LockState(tuple(sorted(out.items())), self.binds)
+
+    def acquire_if_absent(self, token: str) -> "LockState":
+        """Establish heldness without nesting (``locked()`` assertions)."""
+        return self if self.has(token) else self.acquire(token)
+
+    def release(self, token: str) -> "LockState":
+        out = dict(self.held)
+        d = out.get(token, 0)
+        if d <= 1:
+            out.pop(token, None)
+        else:
+            out[token] = d - 1
+        return LockState(tuple(sorted(out.items())), self.binds)
+
+    def bind(self, name: str, token: str) -> "LockState":
+        return LockState(self.held, self.binds | {(name, token)})
+
+    def unbind(self, names) -> "LockState":
+        names = set(names)
+        if not any(n in names for n, _ in self.binds):
+            return self
+        return LockState(self.held, frozenset(
+            (n, t) for n, t in self.binds if n not in names))
+
+    def bound_token(self, name: str) -> Optional[str]:
+        for n, t in self.binds:
+            if n == name:
+                return t
+        return None
+
+    def join(self, other: "LockState") -> "LockState":
+        """MUST join: intersection, min depth."""
+        if self == other:
+            return self
+        mine = dict(self.held)
+        held = tuple(sorted((t, min(d, mine[t]))
+                            for t, d in other.held if t in mine))
+        return LockState(held, self.binds & other.binds)
+
+    @classmethod
+    def entry(cls, tokens) -> "LockState":
+        """State for a helper proven to be entered with locks held."""
+        return cls(tuple(sorted((t, 1) for t in set(tokens))))
+
+
 def run(cfg: CFG, domain) -> FixpointResult:
     in_states: Dict[int, Any] = {cfg.entry: domain.initial()}
     visits: Dict[int, int] = {}

@@ -37,7 +37,7 @@ from .summary import SUMMARY_VERSION, extract, suppressed
 
 # Any change to local-rule or extraction logic must bump one of these:
 # the pair keys every cache entry.
-ENGINE_VERSION = 3  # v3: shape/spec findings + facts in entries
+ENGINE_VERSION = 4  # v4: concurrency findings + lock facts in entries
 CACHE_VERSION = f"{ENGINE_VERSION}.{SUMMARY_VERSION}"
 
 SHARD_MAP_FQS = {
@@ -445,6 +445,7 @@ class ProjectResult:
     graph: CallGraph
     lifecycle_stats: Dict[str, int] = field(default_factory=dict)
     shape_stats: Dict[str, int] = field(default_factory=dict)
+    concurrency_stats: Dict[str, int] = field(default_factory=dict)
 
 
 def _module_name(path: str, root: str) -> str:
@@ -516,7 +517,8 @@ def check_project(paths: Sequence[str],
                   stderr=None) -> ProjectResult:
     """Run the full engine over `paths`: cached per-file rules + fact
     extraction, then the whole-program passes."""
-    from . import rules_lifecycle, rules_project, rules_shapes, rules_spmd
+    from . import rules_concurrency, rules_lifecycle, rules_project, \
+        rules_shapes, rules_spmd
 
     stderr = stderr if stderr is not None else sys.stderr
     # None means "all rules"; an explicit empty set means none (the
@@ -565,12 +567,14 @@ def check_project(paths: Sequence[str],
             findings = checker.run()
             summary, extra = extract(path, source, tree, module)
             findings.extend(extra)
-            # the CFG/dataflow lifecycle pass (GC030-033) and the
-            # shape/spec pass (GC022, GC042-043 + shape facts) run at
+            # the CFG/dataflow lifecycle pass (GC030-033), the
+            # shape/spec pass (GC022, GC042-043 + shape facts) and the
+            # concurrency pass (GC050/053/054 + lock facts) run at
             # parse time too: confirmed findings and pending facts ride
             # the same cache entry
             findings.extend(rules_lifecycle.analyze_module(tree, summary))
             findings.extend(rules_shapes.analyze_module(tree, summary))
+            findings.extend(rules_concurrency.analyze_module(tree, summary))
         new_cache[apath] = {
             "sha": sha, "root": root,
             "local": [f.as_dict() for f in findings],
@@ -586,6 +590,7 @@ def check_project(paths: Sequence[str],
     findings.extend(rules_spmd.run(index, enabled))
     findings.extend(rules_lifecycle.resolve_pending(index, enabled))
     findings.extend(rules_shapes.run(index, enabled))
+    findings.extend(rules_concurrency.run(index, enabled))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     _save_cache(cache_path, cache, new_cache)
     return ProjectResult(findings=findings, errors=errors, files=files,
@@ -594,4 +599,6 @@ def check_project(paths: Sequence[str],
                          lifecycle_stats=rules_lifecycle.aggregate_stats(
                              summaries),
                          shape_stats=rules_shapes.aggregate_stats(
+                             summaries),
+                         concurrency_stats=rules_concurrency.aggregate_stats(
                              summaries))

@@ -149,6 +149,28 @@ RULES: Dict[str, str] = {
              "shard_mapped body splits a per-shard dim the mesh axis "
              "size does not divide, where shapes, specs and mesh all "
              "resolve statically",
+    # thread-aware concurrency analysis (v5; see rules_concurrency.py —
+    # a held-lock MUST-state over the v3 CFG plus project-wide passes)
+    "GC050": "guarded-by violation: a class attribute whose accesses "
+             "majority-hold one specific lock is read or written on a "
+             "path where no lock is held at all (stale-read / "
+             "lost-update hazard)",
+    "GC051": "lock-reentry hazard: a stored callback invoked under a "
+             "held lock, a non-reentrant lock re-acquired while held, "
+             "or a call to a method that transitively re-acquires a "
+             "held non-reentrant lock (deadlock)",
+    "GC052": "lock-order cycle: the project-wide static acquisition-"
+             "order graph (nested held-lock states + transitive "
+             "acquires) contains a strongly-connected component — the "
+             "AB/BA deadlock precondition, every hop listed",
+    "GC053": "blocking call under lock: a get()/recv()/Event.wait() "
+             "with no timeout/Thread.join()/Queue.get() reached while "
+             "any lock is held — one slow peer wedges every thread "
+             "queued on the lock",
+    "GC054": "non-atomic check-then-act: an Event.is_set()/dict-"
+             "membership/attr-None test whose mutating counterpart "
+             "runs on a path where the guard lock was released in "
+             "between — two racing threads both pass the test",
 }
 
 # GC007 targets library code only: user-facing surfaces where print IS

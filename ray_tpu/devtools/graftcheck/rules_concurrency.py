@@ -1,0 +1,1677 @@
+"""GC050-GC054 — thread-aware static concurrency analysis (graftcheck v5).
+
+Every serious latent bug this tree has shipped was a thread-safety race
+in the dispatch/runtime layer, and each was caught only dynamically (the
+``RAY_TPU_DEBUG_LOCKS=1`` order graph, a live smoke). This pass encodes
+the same invariants statically: a held-lock MUST-state
+(:class:`.dataflow.LockState`) threaded through the v3 CFG, per-class
+guarded-by inference, and a project-wide lock-order graph riding the v2
+call machinery.
+
+====== =================================================================
+GC050  guarded-by violation — a class attribute whose accesses majority-
+       hold one specific lock is read/written on a path holding no lock
+       at all (the ``_entry_for`` stale-read class)
+GC051  lock-reentry hazard — a stored callback/handler invoked while a
+       lock is held (the peer-connect deadlock class), a non-reentrant
+       lock re-acquired while already held, or a call to a method that
+       transitively re-acquires a held non-reentrant lock
+GC052  lock-order cycle — the static role-level acquisition-order graph
+       (nested held states + transitive acquires through resolvable
+       calls) contains a strongly-connected component: the AB/BA
+       deadlock precondition, reported with every hop's site
+GC053  blocking call under lock — ``get()`` / ``.recv()`` /
+       ``Event.wait()`` with no timeout / ``Thread.join()`` /
+       ``Queue.get()`` reached while any lock is held (one slow peer
+       wedges every thread queued on the lock)
+GC054  non-atomic check-then-act — an ``Event.is_set()`` / dict-
+       membership / attr-``None`` test whose mutating counterpart runs
+       on a path where the guard lock was released in between (the
+       ``NodeAgent.shutdown`` claim class)
+====== =================================================================
+
+Condition sensitivity / exemptions (what keeps the shipped tree clean):
+
+- ``with lock:`` enters/exits track heldness exactly (finally-duplicated
+  CFG edges release on every continuation, exceptions included);
+- try-acquire probes: ``if lock.acquire(blocking=False):`` and the bound
+  form ``got = lock.acquire(False)`` refine heldness per branch via the
+  CFG's held/unheld + some/none assume labels;
+- ``lock.locked()`` tests/asserts establish the caller-held invariant on
+  the true path;
+- RLocks (``instrumented_lock(..., reentrant=True)`` / ``RLock()``)
+  nest: heldness is a depth-capped multiset and GC051 skips them;
+- ``Condition(lock)`` aliases to its underlying lock, and its ``wait()``
+  exempts that lock (wait releases it) in GC053;
+- constructor escape: dunder methods (``__init__`` before threads exist,
+  ``__repr__`` debug surfaces) neither count toward nor get flagged by
+  guarded-by inference;
+- attributes never written outside dunders, lock/event/queue attributes
+  themselves, and typed composition attributes (``self._gcs =
+  GCSClient()``) are not guard-inference candidates;
+- one level of intraclass helpers: a private method whose every
+  intraclass call site holds lock L is re-analyzed as entered-with-L.
+
+Facts exported into the cached file summaries (``summary["concurrency"]``
++ per-function ``concurrency`` records) feed the project pass: GC051's
+transitive-reacquire resolution and the GC052 order graph, which is also
+the static half of the ``scripts/locks_gate.py`` cross-check — the
+dynamic role-order graph observed under ``RAY_TPU_DEBUG_LOCKS=1`` must
+be a subgraph of :func:`build_lock_order_graph`'s output.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from . import dataflow
+from .cfg import (FOR_BIND, STMT, TEST, WITH_ENTER, WITH_EXIT, CFGTooLarge,
+                  build_cfg, is_generator)
+from .dataflow import LockState
+from .local import Finding, _assigned_names, _dotted, _is_lockish, \
+    _iter_own_exprs
+from .rules_lifecycle import _own_scope_stmts, _walk_expr, \
+    collect_functions
+from .summary import suppressed
+
+CONCURRENCY_RULES: Set[str] = {"GC050", "GC051", "GC052", "GC053", "GC054"}
+
+# -- lock / sync-object discovery -------------------------------------------
+
+# threading-module constructors (bare or dotted through threading/
+# multiprocessing; asyncio's cooperative locks are a different hazard
+# domain and are deliberately NOT tracked here)
+_LOCK_KINDS = {"Lock": ("lock", False), "RLock": ("rlock", True)}
+_SYNC_KINDS = {
+    "Event": "event", "Semaphore": "semaphore",
+    "BoundedSemaphore": "semaphore", "Barrier": "semaphore",
+    "Queue": "queue", "SimpleQueue": "queue", "LifoQueue": "queue",
+    "PriorityQueue": "queue", "deque": "deque", "local": "tls",
+    "Thread": "thread", "Timer": "thread", "Process": "thread",
+    "ThreadPoolExecutor": "pool", "ProcessPoolExecutor": "pool",
+}
+_SYNC_BASES = {"threading", "multiprocessing", "queue", "collections",
+               "concurrent", "futures", "mp"}
+
+_MUTATORS = {"append", "appendleft", "add", "pop", "popleft", "popitem",
+             "update", "setdefault", "clear", "remove", "discard",
+             "extend", "insert", "push"}
+
+_CB_ATTR_RE = re.compile(r"^_?on_[a-z0-9_]+$")
+_CB_SUFFIX_RE = re.compile(r".*(_cb|_callback|_hook|_handler)$")
+_CB_CONTAINER_RE = re.compile(
+    r".*(callback|handler|hook|listener|subscriber|watcher)s$")
+_THREADISH_RE = re.compile(r".*(thread|proc)")
+
+
+def _role_of(arg: ast.AST) -> Optional[str]:
+    """The role literal of an instrumented_lock() call; f-string roles
+    keep their constant parts with ``*`` for each formatted hole
+    (``f"refcounter.s{i}"`` -> ``refcounter.s*``)."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    if isinstance(arg, ast.JoinedStr):
+        parts = []
+        for v in arg.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                parts.append("*")
+        return "".join(parts)
+    return None
+
+
+def _lock_ctor(value: ast.AST) -> Optional[Dict[str, Any]]:
+    """Classify a lock-constructing RHS, or None.
+
+    Returns ``{"kind", "reentrant", "role", "cond_of"}`` where
+    ``cond_of`` is the dotted lock a ``Condition(lock)`` wraps.
+    """
+    if not isinstance(value, ast.Call):
+        return None
+    d = _dotted(value.func)
+    if d is None:
+        return None
+    if len(d) > 1 and d[0] == "asyncio":
+        return None
+    last = d[-1]
+    if last == "instrumented_lock":
+        role = _role_of(value.args[0]) if value.args else None
+        reentrant = any(kw.arg == "reentrant"
+                        and isinstance(kw.value, ast.Constant)
+                        and bool(kw.value.value)
+                        for kw in value.keywords)
+        return {"kind": "rlock" if reentrant else "lock",
+                "reentrant": reentrant, "role": role, "cond_of": None}
+    if last in _LOCK_KINDS and (len(d) == 1 or d[0] in _SYNC_BASES):
+        kind, reentrant = _LOCK_KINDS[last]
+        return {"kind": kind, "reentrant": reentrant, "role": None,
+                "cond_of": None}
+    if last == "Condition" and (len(d) == 1 or d[0] in _SYNC_BASES):
+        cond_of = None
+        if value.args:
+            cd = _dotted(value.args[0])
+            if cd is not None:
+                cond_of = ".".join(cd)
+        return {"kind": "condition", "reentrant": True, "role": None,
+                "cond_of": cond_of}
+    if last == "field":
+        # dataclass field(default_factory=lambda: instrumented_lock(...))
+        for kw in value.keywords:
+            if kw.arg == "default_factory" \
+                    and isinstance(kw.value, ast.Lambda):
+                return _lock_ctor(kw.value.body)
+    return None
+
+
+def _sync_ctor(value: ast.AST) -> Optional[str]:
+    if not isinstance(value, ast.Call):
+        return None
+    d = _dotted(value.func)
+    if d is None:
+        return None
+    if len(d) > 1 and d[0] == "asyncio":
+        return None
+    last = d[-1]
+    if last in _SYNC_KINDS and (len(d) == 1 or d[0] in _SYNC_BASES
+                                or "pool" in last.lower()):
+        return _SYNC_KINDS[last]
+    return None
+
+
+def _ctor_class(value: ast.AST) -> Optional[str]:
+    """Dotted class name of a plain-composition ctor RHS (CamelCase
+    final component), for the attr-type table."""
+    if not isinstance(value, ast.Call):
+        return None
+    d = _dotted(value.func)
+    if d is None:
+        return None
+    last = d[-1].lstrip("_")
+    if last[:1].isupper() and d[-1] not in _LOCK_KINDS \
+            and d[-1] not in _SYNC_KINDS:
+        return ".".join(d)
+    return None
+
+
+class _ModuleLocks:
+    """Every lock / sync object / typed composition attr of one module."""
+
+    def __init__(self) -> None:
+        # cls -> attr -> {"kind","reentrant","role","line","alias"}
+        self.classes: Dict[str, Dict[str, Dict[str, Any]]] = {}
+        # cls -> attr -> sync kind ("event"/"queue"/"thread"/...)
+        self.sync: Dict[str, Dict[str, str]] = {}
+        # cls -> attr -> dotted ctor class (composition typing)
+        self.attr_types: Dict[str, Dict[str, str]] = {}
+        self.globals_: Dict[str, Dict[str, Any]] = {}
+        self.global_sync: Dict[str, str] = {}
+        # cls -> attr -> ELEMENT class of a container attr (Dict value /
+        # List elem annotation, or comprehension-of-ctor RHS): types
+        # locals bound from lookups, so ``rec = self._actors.get(aid);
+        # with rec.lock:`` resolves to the record class's lock
+        self.attr_value_types: Dict[str, Dict[str, str]] = {}
+        # cls -> method -> returned class (from the return annotation)
+        self.method_returns: Dict[str, Dict[str, str]] = {}
+        # raw annotation ASTs, resolved once the whole module is known
+        self._raw_elem: Dict[str, Dict[str, ast.AST]] = {}
+        self._raw_elem_ctor: Dict[str, Dict[str, str]] = {}
+        self._raw_ret: Dict[str, Dict[str, ast.AST]] = {}
+
+    def class_locks(self, cls: Optional[str]) -> Dict[str, Dict[str, Any]]:
+        return self.classes.get(cls, {}) if cls else {}
+
+
+def _ann_class_name(ann: ast.AST) -> Optional[str]:
+    """Bare class name of a plain (or forward-string) annotation."""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            ann = ast.parse(ann.value.strip(), mode="eval").body
+        except SyntaxError:
+            return None
+    d = _dotted(ann)
+    return d[-1] if d else None
+
+
+_DICT_ANNS = ("Dict", "dict", "Mapping", "MutableMapping", "DefaultDict",
+              "defaultdict", "OrderedDict")
+_SEQ_ANNS = ("List", "list", "Set", "set", "FrozenSet", "frozenset",
+             "Sequence", "Iterable", "Deque", "deque", "Optional",
+             "Tuple", "tuple")
+
+
+def _ann_value_class(ann: ast.AST) -> Optional[str]:
+    """Element/value class of a container annotation: ``Dict[K, V]`` ->
+    V, ``List[X]``/``Optional[X]`` -> X, plain/forward ``X`` -> X."""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            ann = ast.parse(ann.value.strip(), mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(ann, ast.Subscript):
+        base = _dotted(ann.value)
+        if base is None:
+            return None
+        sl = ann.slice
+        if base[-1] in _DICT_ANNS:
+            if isinstance(sl, ast.Tuple) and len(sl.elts) == 2:
+                return _ann_class_name(sl.elts[1])
+            return None
+        if base[-1] in _SEQ_ANNS:
+            elts = sl.elts if isinstance(sl, ast.Tuple) else [sl]
+            return _ann_class_name(elts[0]) if elts else None
+        return None
+    return _ann_class_name(ann)
+
+
+def _discover(tree: ast.Module) -> _ModuleLocks:
+    ml = _ModuleLocks()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            name = stmt.targets[0].id
+            rec = _lock_ctor(stmt.value)
+            if rec is not None:
+                rec["line"] = stmt.lineno
+                ml.globals_[name] = rec
+                continue
+            sk = _sync_ctor(stmt.value)
+            if sk is not None:
+                ml.global_sync[name] = sk
+        if isinstance(stmt, ast.ClassDef):
+            _discover_class(stmt, ml)
+    _resolve_aliases(ml)
+    # element/return types resolve only against lock-bearing classes of
+    # THIS module (definition order doesn't matter: resolution is here,
+    # after every class is known)
+    for cls, anns in ml._raw_elem.items():
+        for attr, ann in anns.items():
+            v = _ann_value_class(ann)
+            if v and v in ml.classes:
+                ml.attr_value_types.setdefault(cls, {})[attr] = v
+    for cls, ctors in ml._raw_elem_ctor.items():
+        for attr, v in ctors.items():
+            if v in ml.classes:
+                ml.attr_value_types.setdefault(cls, {}).setdefault(attr, v)
+    for cls, rets in ml._raw_ret.items():
+        for meth, ann in rets.items():
+            v = _ann_value_class(ann)
+            if v and v in ml.classes:
+                ml.method_returns.setdefault(cls, {})[meth] = v
+    return ml
+
+
+def _discover_class(cdef: ast.ClassDef, ml: _ModuleLocks) -> None:
+    locks: Dict[str, Dict[str, Any]] = {}
+    sync: Dict[str, str] = {}
+    types: Dict[str, str] = {}
+
+    def note(attr: str, value: ast.AST, line: int) -> None:
+        rec = _lock_ctor(value)
+        if rec is not None:
+            rec["line"] = line
+            locks[attr] = rec
+            return
+        sk = _sync_ctor(value)
+        if sk is not None:
+            sync.setdefault(attr, sk)
+            return
+        cc = _ctor_class(value)
+        if cc is not None:
+            types.setdefault(attr, cc)
+
+    def note_elem(attr: str, value: ast.AST) -> None:
+        # comprehension-of-ctor RHS types the container's elements
+        # (``self._oshards = [_ObjShard(i) for i in range(16)]``)
+        if isinstance(value, (ast.ListComp, ast.SetComp)) \
+                and isinstance(value.elt, ast.Call):
+            cc = _ctor_class(value.elt)
+            if cc is not None:
+                ml._raw_elem_ctor.setdefault(cdef.name, {})[attr] = \
+                    cc.split(".")[-1]
+
+    for stmt in cdef.body:
+        # class-body defaults (incl. dataclass field(default_factory=..))
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            note(stmt.targets[0].id, stmt.value, stmt.lineno)
+            note_elem(stmt.targets[0].id, stmt.value)
+        if isinstance(stmt, ast.AnnAssign) \
+                and isinstance(stmt.target, ast.Name):
+            if stmt.value is not None:
+                note(stmt.target.id, stmt.value, stmt.lineno)
+            ml._raw_elem.setdefault(cdef.name, {})[stmt.target.id] = \
+                stmt.annotation
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if stmt.returns is not None:
+                ml._raw_ret.setdefault(cdef.name, {})[stmt.name] = \
+                    stmt.returns
+            for s in _own_scope_stmts(stmt):
+                if isinstance(s, ast.Assign) and len(s.targets) == 1 \
+                        and isinstance(s.targets[0], ast.Attribute) \
+                        and isinstance(s.targets[0].value, ast.Name) \
+                        and s.targets[0].value.id == "self":
+                    note(s.targets[0].attr, s.value, s.lineno)
+                    note_elem(s.targets[0].attr, s.value)
+                if isinstance(s, ast.AnnAssign) \
+                        and isinstance(s.target, ast.Attribute) \
+                        and isinstance(s.target.value, ast.Name) \
+                        and s.target.value.id == "self":
+                    if s.value is not None:
+                        note(s.target.attr, s.value, s.lineno)
+                    ml._raw_elem.setdefault(cdef.name, {})[
+                        s.target.attr] = s.annotation
+        if isinstance(stmt, ast.ClassDef):
+            _discover_class(stmt, ml)
+    if locks:
+        ml.classes[cdef.name] = locks
+    if sync:
+        ml.sync[cdef.name] = sync
+    if types:
+        ml.attr_types[cdef.name] = types
+
+
+def _resolve_aliases(ml: _ModuleLocks) -> None:
+    """``self._cv = Condition(self._lock)`` -> _cv aliases _lock: holding
+    the condition IS holding the lock, so both share one token."""
+    for locks in ml.classes.values():
+        for attr, rec in locks.items():
+            rec["alias"] = None
+            cond_of = rec.get("cond_of")
+            if rec["kind"] == "condition" and cond_of \
+                    and cond_of.startswith("self."):
+                tgt = cond_of[5:]
+                if tgt in locks and locks[tgt]["kind"] != "condition":
+                    rec["alias"] = tgt
+
+
+# -- tokens -----------------------------------------------------------------
+#
+# A token names one lock inside one function: "self.<attr>" for class
+# locks (alias-resolved: a Condition's token is its underlying lock's),
+# a bare name for module-global locks, or the dotted receiver text for
+# fallback lockish receivers (parameters named *lock* etc. — tracked
+# for "any lock held" rules, excluded from roles and guard inference).
+
+
+def _local_record_types(fndef: ast.AST, cls: Optional[str],
+                        ml: _ModuleLocks) -> Dict[str, str]:
+    """Local name -> lock-bearing record class, inferred from lookups on
+    typed container attrs (``rec = self._actors.get(aid)``, subscripts,
+    iteration — incl. through list()/sorted()), typed self-method calls
+    (``sh = self._oshard(oid)``) and direct ctor binds."""
+    out: Dict[str, str] = {}
+    if not cls:
+        return out
+    vt = ml.attr_value_types.get(cls, {})
+    mr = ml.method_returns.get(cls, {})
+    if not vt and not mr and not ml.classes:
+        return out
+
+    def self_attr(expr: ast.AST) -> Optional[str]:
+        d = _dotted(expr)
+        if d and len(d) == 2 and d[0] == "self":
+            return d[1]
+        return None
+
+    def src_class(value: ast.AST) -> Optional[str]:
+        if isinstance(value, ast.Subscript):
+            attr = self_attr(value.value)
+            return vt.get(attr) if attr else None
+        if isinstance(value, ast.Call):
+            d = _dotted(value.func)
+            if d and len(d) == 3 and d[0] == "self" and d[2] == "get":
+                return vt.get(d[1])
+            if d and len(d) == 2 and d[0] == "self":
+                return mr.get(d[1])
+            cc = _ctor_class(value)
+            if cc is not None and cc.split(".")[-1] in ml.classes:
+                return cc.split(".")[-1]
+        return None
+
+    def iter_class(it: ast.AST) -> Optional[str]:
+        if isinstance(it, ast.Call):
+            d = _dotted(it.func)
+            if d is not None and len(d) == 1 \
+                    and d[0] in ("list", "tuple", "sorted", "reversed") \
+                    and it.args:
+                return iter_class(it.args[0])
+            if d is not None and len(d) == 3 and d[0] == "self" \
+                    and d[2] == "values":
+                return vt.get(d[1])
+            return None
+        attr = self_attr(it)
+        return vt.get(attr) if attr else None
+
+    for st in _own_scope_stmts(fndef):
+        if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                and isinstance(st.targets[0], ast.Name):
+            v = src_class(st.value)
+            if v:
+                out[st.targets[0].id] = v
+        elif isinstance(st, (ast.For, ast.AsyncFor)) \
+                and isinstance(st.target, ast.Name):
+            v = iter_class(st.iter)
+            if v:
+                out[st.target.id] = v
+    return out
+
+
+class _FnCtx:
+    def __init__(self, fndef: ast.AST, qname: str, cls: Optional[str],
+                 summary: Dict[str, Any], ml: _ModuleLocks,
+                 known_locks: Set[str]):
+        self.fndef = fndef
+        self.qname = qname
+        self.cls = cls
+        self.summary = summary
+        self.ml = ml
+        self.known_locks = known_locks
+        self.class_locks = ml.class_locks(cls)
+        self.entry_tokens: Tuple[str, ...] = ()
+        self.local_types = _local_record_types(fndef, cls, ml)
+        # token -> (record class, lock attr) for locals typed above:
+        # "rec.lock" resolves to that class's lock table entry, so roles,
+        # reentrancy and the order graph see through local receivers
+        self.typed_tokens: Dict[str, Tuple[str, str]] = {}
+
+    def token_of_dotted(self, dotted: str) -> Optional[str]:
+        if dotted.startswith("self.") and dotted.count(".") == 1:
+            attr = dotted[5:]
+            rec = self.class_locks.get(attr)
+            if rec is not None:
+                alias = rec.get("alias")
+                return f"self.{alias}" if alias else dotted
+        elif "." not in dotted and dotted in self.ml.globals_:
+            return dotted
+        elif dotted.count(".") == 1:
+            base, attr = dotted.split(".")
+            vcls = self.local_types.get(base)
+            if vcls:
+                rec = self.ml.classes.get(vcls, {}).get(attr)
+                if rec is not None:
+                    alias = rec.get("alias")
+                    tok = f"{base}.{alias}" if alias else dotted
+                    self.typed_tokens[tok] = (vcls, alias or attr)
+                    return tok
+        return None
+
+    def token_of(self, expr: ast.AST) -> Optional[str]:
+        d = _dotted(expr)
+        if d is None:
+            return None
+        dotted = ".".join(d)
+        tok = self.token_of_dotted(dotted)
+        if tok is not None:
+            return tok
+        if _is_lockish(expr, self.known_locks):
+            return dotted
+        return None
+
+    def lock_rec(self, token: str) -> Optional[Dict[str, Any]]:
+        if token.startswith("self.") and token.count(".") == 1:
+            return self.class_locks.get(token[5:])
+        typed = self.typed_tokens.get(token)
+        if typed is not None:
+            return self.ml.classes.get(typed[0], {}).get(typed[1])
+        return self.ml.globals_.get(token)
+
+    def canonical(self, token: str) -> Optional[str]:
+        """Project-wide key for a known lock token, else None."""
+        mod = self.summary["module"]
+        if token.startswith("self.") and token.count(".") == 1:
+            if self.cls and token[5:] in self.class_locks:
+                return f"{mod}.{self.cls}.{token[5:]}"
+            return None
+        typed = self.typed_tokens.get(token)
+        if typed is not None:
+            return f"{mod}.{typed[0]}.{typed[1]}"
+        if token in self.ml.globals_:
+            return f"{mod}.{token}"
+        return None
+
+
+def _timeout_bounded(call: ast.Call, skip_first: bool = False) -> bool:
+    """True when a wait()/wait_for() call carries a real (non-None)
+    timeout; `skip_first` skips wait_for's predicate argument."""
+    args = call.args[1:] if skip_first else call.args
+    for a in args:
+        if not (isinstance(a, ast.Constant) and a.value is None):
+            return True
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return not (isinstance(kw.value, ast.Constant)
+                        and kw.value.value is None)
+    return False
+
+
+def _nonblocking_acquire(call: ast.Call) -> bool:
+    """``acquire(False)`` / ``acquire(blocking=False)`` / any timeout:
+    the result, not the call, decides heldness."""
+    if call.args:
+        a0 = call.args[0]
+        if isinstance(a0, ast.Constant) and a0.value is False:
+            return True
+        if len(call.args) > 1:
+            return True
+    for kw in call.keywords:
+        if kw.arg == "blocking" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+        if kw.arg == "timeout":
+            return True
+    return False
+
+
+class _Domain:
+    """The held-lock MUST-domain over :class:`.dataflow.LockState`."""
+
+    def __init__(self, ctx: _FnCtx):
+        self.ctx = ctx
+
+    def initial(self) -> LockState:
+        return LockState.entry(self.ctx.entry_tokens)
+
+    def join(self, a: LockState, b: LockState) -> LockState:
+        return a.join(b)
+
+    def assume(self, state: LockState, label) -> LockState:
+        sense, name = label
+        if sense in ("held", "unheld"):
+            tok = self.ctx.token_of_dotted(name)
+            if tok is None and "lock" in name.rsplit(".", 1)[-1].lower():
+                tok = name
+            if tok is None:
+                return state
+            return state.acquire_if_absent(tok) if sense == "held" \
+                else state.release(tok)
+        tok = state.bound_token(name)
+        if tok is None:
+            return state
+        return state.acquire_if_absent(tok) if sense == "some" \
+            else state.release(tok)
+
+    def transfer(self, node, state: LockState) -> LockState:
+        if node.kind == WITH_ENTER:
+            tok = self.ctx.token_of(node.ast.context_expr)
+            return state.acquire(tok) if tok else state
+        if node.kind == WITH_EXIT:
+            tok = self.ctx.token_of(node.ast.context_expr)
+            return state.release(tok) if tok else state
+        if node.kind == FOR_BIND:
+            return state.unbind(_assigned_names(node.ast.target))
+        if node.kind == STMT:
+            return self._stmt(node.ast, state)
+        return state
+
+    def _stmt(self, stmt: ast.stmt, state: LockState) -> LockState:
+        if isinstance(stmt, ast.Assert):
+            t = stmt.test
+            if isinstance(t, ast.Call) and isinstance(t.func, ast.Attribute) \
+                    and t.func.attr == "locked":
+                tok = self.ctx.token_of(t.func.value)
+                if tok:
+                    return state.acquire_if_absent(tok)
+            return state
+        for call in _iter_own_exprs(stmt):
+            if not isinstance(call, ast.Call) \
+                    or not isinstance(call.func, ast.Attribute):
+                continue
+            op = call.func.attr
+            if op not in ("acquire", "release"):
+                continue
+            tok = self.ctx.token_of(call.func.value)
+            if tok is None:
+                continue
+            if op == "release":
+                state = state.release(tok)
+            elif not _nonblocking_acquire(call):
+                state = state.acquire(tok)
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            names: List[str] = []
+            for t in targets:
+                names.extend(_assigned_names(t))
+            state = state.unbind(names)
+            if isinstance(stmt, ast.Assign) and len(names) == 1 \
+                    and isinstance(stmt.value, ast.Call) \
+                    and isinstance(stmt.value.func, ast.Attribute) \
+                    and stmt.value.func.attr == "acquire" \
+                    and _nonblocking_acquire(stmt.value):
+                tok = self.ctx.token_of(stmt.value.func.value)
+                if tok:
+                    state = state.bind(names[0], tok)
+        return state
+
+
+# -- per-function event collection ------------------------------------------
+
+
+class _FnEvents:
+    """Everything one function contributes, keyed off final in-states."""
+
+    def __init__(self) -> None:
+        # (attr, write, line, col, frozenset(held tokens))
+        self.attr_accesses: List[Tuple[str, bool, int, int, frozenset]] = []
+        # token -> first acquire line (known locks only)
+        self.acquires: Dict[str, int] = {}
+        # (held_token, acquired_token, line) — known locks only
+        self.edges: List[Tuple[str, str, int]] = []
+        # (frozenset held tokens, callee dotted, line)
+        self.calls_held: List[Tuple[frozenset, str, int]] = []
+        # (method_name, frozenset held tokens, line) for self.m() calls
+        self.intraclass_calls: List[Tuple[str, frozenset, int]] = []
+        # (desc, exempt_token, line, frozenset held)
+        self.blocking: List[Tuple[str, Optional[str], int, frozenset]] = []
+        # (desc, line, frozenset held)
+        self.cb_calls: List[Tuple[str, int, frozenset]] = []
+        # (kind, key, node_idx, line, frozenset held)
+        self.checks: List[Tuple[str, str, int, int, frozenset]] = []
+        self.acts: List[Tuple[str, str, int, int, frozenset]] = []
+        # (token, line) — non-reentrant lock acquired while already held
+        self.reentries: List[Tuple[str, int]] = []
+        self.states = 0
+
+
+class _FnAnalysis:
+    def __init__(self, ctx: _FnCtx):
+        self.ctx = ctx
+        self.events = _FnEvents()
+        self.cfg = None
+        self._if_tests: Set[int] = set()
+        self._cb_names: Set[str] = set()
+        self._get_lines: Set[int] = set()
+
+    # -- prescan ----------------------------------------------------------
+
+    def _prescan(self) -> None:
+        ctx = self.ctx
+        fnrec = ctx.summary["functions"].get(ctx.qname)
+        if fnrec:
+            self._get_lines = {g["lineno"] for g in fnrec.get("gets", ())}
+        for stmt in _own_scope_stmts(ctx.fndef):
+            if isinstance(stmt, ast.If):
+                self._if_tests.add(id(stmt.test))
+            if isinstance(stmt, ast.For):
+                d = _dotted(stmt.iter)
+                if d is not None and _CB_CONTAINER_RE.match(d[-1]):
+                    self._cb_names.update(_assigned_names(stmt.target))
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                d = _dotted(stmt.value)
+                if d is not None and (_CB_ATTR_RE.match(d[-1])
+                                      or _CB_SUFFIX_RE.match(d[-1])):
+                    self._cb_names.add(stmt.targets[0].id)
+
+    # -- run --------------------------------------------------------------
+
+    def run(self, stats: Dict[str, int]) -> bool:
+        self._prescan()
+        try:
+            self.cfg = build_cfg(self.ctx.fndef)
+        except CFGTooLarge:
+            stats["fns_cfg_skipped"] = stats.get("fns_cfg_skipped", 0) + 1
+            return False
+        result = dataflow.run(self.cfg, _Domain(self.ctx))
+        self.events = _FnEvents()
+        self.events.states = len(result.in_states)
+        for node in self.cfg.nodes:
+            state = result.in_states.get(node.idx)
+            if state is None:
+                continue
+            self._collect(node, state)
+        return True
+
+    # -- per-node event extraction ----------------------------------------
+
+    def _held(self, state: LockState) -> frozenset:
+        return state.tokens()
+
+    def _known_held(self, state: LockState) -> List[str]:
+        return [t for t in sorted(state.tokens())
+                if self.ctx.lock_rec(t) is not None]
+
+    def _note_acquire(self, tok: str, line: int, state: LockState) -> None:
+        ev = self.events
+        rec = self.ctx.lock_rec(tok)
+        if rec is not None:
+            ev.acquires.setdefault(tok, line)
+            if state.has(tok) and not rec["reentrant"]:
+                ev.reentries.append((tok, line))
+        for held in self._known_held(state):
+            if held != tok and rec is not None:
+                ev.edges.append((held, tok, line))
+
+    def _collect(self, node, state: LockState) -> None:
+        ev = self.events
+        ctx = self.ctx
+        if node.kind == WITH_ENTER:
+            tok = ctx.token_of(node.ast.context_expr)
+            if tok:
+                self._note_acquire(tok, node.lineno, state)
+            return
+        if node.kind == STMT:
+            exprs = list(_iter_own_exprs(node.ast))
+            write_ids = _write_attr_ids(node.ast)
+        elif node.kind == TEST:
+            exprs = list(_walk_expr(node.ast))
+            write_ids = set()
+        else:
+            return
+        held = self._held(state)
+        for sub in exprs:
+            if isinstance(sub, ast.Attribute) \
+                    and isinstance(sub.value, ast.Name) \
+                    and sub.value.id == "self":
+                write = id(sub) in write_ids \
+                    or not isinstance(sub.ctx, ast.Load)
+                ev.attr_accesses.append(
+                    (sub.attr, write, sub.lineno, sub.col_offset + 1, held))
+            if isinstance(sub, ast.Call):
+                self._call(sub, node, state, held)
+        if node.kind == TEST and id(node.ast) in self._if_tests:
+            self._check_site(node, state, held)
+        if node.kind == STMT:
+            self._act_sites(node, state, held)
+
+    def _call(self, call: ast.Call, node, state: LockState,
+              held: frozenset) -> None:
+        ev = self.events
+        ctx = self.ctx
+        func = call.func
+        d = _dotted(func)
+        # acquire sites (edges + reentry); heldness handled by the domain
+        if isinstance(func, ast.Attribute) and func.attr == "acquire":
+            tok = ctx.token_of(func.value)
+            if tok:
+                self._note_acquire(tok, call.lineno, state)
+            return
+        if isinstance(func, ast.Attribute) \
+                and func.attr in ("release", "locked", "__enter__",
+                                  "__exit__"):
+            return
+        # blocking calls
+        bk = self._blocking_kind(call)
+        if bk is not None:
+            desc, exempt = bk
+            eff = held - {exempt} if exempt else held
+            if eff:
+                ev.blocking.append((desc, exempt, call.lineno, eff))
+        # stored-callback invocations
+        cb = self._callback_desc(call)
+        if cb is not None and held:
+            ev.cb_calls.append((cb, call.lineno, held))
+        # interprocedural facts
+        if d is None:
+            return
+        name = ".".join(d)
+        if isinstance(func, ast.Attribute) and func.attr in (
+                "set", "clear", "is_set", "wait", "wait_for", "notify",
+                "notify_all", "append", "get", "put", "join", "recv",
+                "recv_bytes", "send"):
+            return
+        if held:
+            known = frozenset(self._known_held(state))
+            if known:
+                ev.calls_held.append((known, name, call.lineno))
+        if len(d) == 2 and d[0] == "self" and ctx.cls:
+            ev.intraclass_calls.append((d[1], held, call.lineno))
+
+    # -- blocking classification ------------------------------------------
+
+    def _blocking_kind(self, call: ast.Call
+                       ) -> Optional[Tuple[str, Optional[str]]]:
+        func = call.func
+        ctx = self.ctx
+        if call.lineno in self._get_lines:
+            return ("blocking get()", None)
+        if not isinstance(func, ast.Attribute):
+            return None
+        attr = func.attr
+        recv = func.value
+        d = _dotted(recv)
+        if attr in ("recv", "recv_bytes"):
+            return (f"{attr}()", None)
+        if attr == "join" and d is not None:
+            kind = self._sync_kind(d)
+            if kind == "thread" or (kind is None
+                                    and _THREADISH_RE.match(d[-1].lower())):
+                return ("join()", None)
+            return None
+        if attr in ("wait", "wait_for") and d is not None:
+            if _timeout_bounded(call, skip_first=(attr == "wait_for")):
+                return None
+            tok = ctx.token_of(recv)
+            if tok is not None:
+                rec = ctx.lock_rec(tok)
+                if rec is not None and rec["kind"] == "condition":
+                    # cond.wait releases its own lock while waiting
+                    return (f"{attr}() on condition", tok)
+            if self._sync_kind(d) == "event":
+                return ("wait() with no timeout", None)
+            return None
+        if attr == "get" and d is not None \
+                and self._sync_kind(d) == "queue":
+            if call.args or any(kw.arg == "timeout" for kw in call.keywords):
+                return None
+            return ("Queue.get() with no timeout", None)
+        return None
+
+    def _sync_kind(self, d: Tuple[str, ...]) -> Optional[str]:
+        ml = self.ctx.ml
+        if len(d) == 2 and d[0] == "self" and self.ctx.cls:
+            return ml.sync.get(self.ctx.cls, {}).get(d[1])
+        if len(d) == 1:
+            return ml.global_sync.get(d[0])
+        return None
+
+    # -- callback classification ------------------------------------------
+
+    def _callback_desc(self, call: ast.Call) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Name) and func.id in self._cb_names:
+            return f"'{func.id}'"
+        if isinstance(func, ast.Subscript):
+            d = _dotted(func.value)
+            if d is not None and _CB_CONTAINER_RE.match(d[-1]):
+                return f"'{'.'.join(d)}[...]'"
+        if isinstance(func, ast.Attribute) and isinstance(func.value,
+                                                          ast.Name) \
+                and func.value.id == "self" and self.ctx.cls:
+            attr = func.attr
+            if not (_CB_ATTR_RE.match(attr) or _CB_SUFFIX_RE.match(attr)):
+                return None
+            cls_rec = self.ctx.summary["classes"].get(self.ctx.cls, {})
+            if attr in cls_rec.get("methods", ()):
+                return None   # a real method: the transitive rule owns it
+            if attr in self.ctx.ml.attr_types.get(self.ctx.cls, {}):
+                return None   # typed composition object, resolvable
+            return f"'self.{attr}'"
+        return None
+
+    # -- GC054 sites -------------------------------------------------------
+
+    def _check_site(self, node, state: LockState, held: frozenset) -> None:
+        for atom in _test_atoms(node.ast):
+            kind_key = self._sync_atom(atom)
+            if kind_key is not None:
+                self.events.checks.append(
+                    (*kind_key, node.idx, node.lineno, held))
+
+    def _sync_atom(self, atom: ast.AST) -> Optional[Tuple[str, str]]:
+        ctx = self.ctx
+        if isinstance(atom, ast.Call) and isinstance(atom.func,
+                                                     ast.Attribute) \
+                and atom.func.attr == "is_set":
+            d = _dotted(atom.func.value)
+            if d is not None and self._sync_kind(d) == "event":
+                return ("event", ".".join(d))
+        if isinstance(atom, ast.Compare) and len(atom.ops) == 1:
+            op = atom.ops[0]
+            if isinstance(op, (ast.In, ast.NotIn)):
+                d = _dotted(atom.comparators[0])
+                if d is not None and len(d) == 2 and d[0] == "self" \
+                        and ctx.cls:
+                    return ("member", ".".join(d))
+            if isinstance(op, (ast.Is, ast.IsNot)) \
+                    and isinstance(atom.comparators[0], ast.Constant) \
+                    and atom.comparators[0].value is None:
+                d = _dotted(atom.left)
+                if d is not None and len(d) == 2 and d[0] == "self":
+                    return ("none", ".".join(d))
+        return None
+
+    def _act_sites(self, node, state: LockState, held: frozenset) -> None:
+        ev = self.events
+        stmt = node.ast
+        ctx = self.ctx
+        for call in _iter_own_exprs(stmt):
+            if isinstance(call, ast.Call) \
+                    and isinstance(call.func, ast.Attribute):
+                d = _dotted(call.func.value)
+                if d is None:
+                    continue
+                if call.func.attr in ("set", "clear") \
+                        and self._sync_kind(d) == "event":
+                    ev.acts.append(("event", ".".join(d), node.idx,
+                                    call.lineno, held))
+                if call.func.attr == "pop" and len(d) == 2 \
+                        and d[0] == "self":
+                    ev.acts.append(("member", ".".join(d), node.idx,
+                                    call.lineno, held))
+        targets: List[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.Delete):
+            targets = list(stmt.targets)
+        for t in targets:
+            if isinstance(t, ast.Subscript):
+                d = _dotted(t.value)
+                if d is not None and len(d) == 2 and d[0] == "self":
+                    ev.acts.append(("member", ".".join(d), node.idx,
+                                    t.value.lineno, held))
+            if isinstance(t, ast.Attribute) and isinstance(t.value,
+                                                           ast.Name) \
+                    and t.value.id == "self":
+                ev.acts.append(("none", f"self.{t.attr}", node.idx,
+                                t.lineno, held))
+
+    # -- reachability (GC054 pairing) --------------------------------------
+
+    def reachable_from(self, idx: int) -> Set[int]:
+        seen = {idx}
+        stack = [idx]
+        while stack:
+            cur = stack.pop()
+            for dst, _, _ in self.cfg.succ[cur]:
+                if dst not in seen:
+                    seen.add(dst)
+                    stack.append(dst)
+        return seen
+
+
+def _has_lock_syntax(fndef: ast.AST) -> bool:
+    """Cheap triviality gate: can this function possibly hold a lock on
+    its own (with-statement or manual acquire/release)? Functions that
+    cannot, in classes and modules with no locks or sync objects, skip
+    the CFG + fixpoint entirely."""
+    for node in ast.walk(fndef):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            return True
+        if isinstance(node, ast.Attribute) \
+                and node.attr in ("acquire", "release", "locked"):
+            return True
+    return False
+
+
+def _test_atoms(expr: ast.AST) -> List[ast.AST]:
+    """The comparable atoms of an if-test: the expr, its ``not``
+    operand, and each BoolOp conjunct (one level)."""
+    out: List[ast.AST] = []
+    worklist = [expr]
+    while worklist:
+        e = worklist.pop()
+        if isinstance(e, ast.UnaryOp) and isinstance(e.op, ast.Not):
+            worklist.append(e.operand)
+        elif isinstance(e, ast.BoolOp):
+            worklist.extend(e.values)
+        else:
+            out.append(e)
+    return out
+
+
+def _write_attr_ids(stmt: ast.stmt) -> Set[int]:
+    """ids of self-attr Attribute nodes written by this statement:
+    assignment/deletion targets, subscript-store receivers, and
+    receivers of known mutating container methods."""
+    out: Set[int] = set()
+
+    def note_target(t: ast.AST) -> None:
+        if isinstance(t, ast.Attribute):
+            out.add(id(t))
+        if isinstance(t, ast.Subscript) and isinstance(t.value,
+                                                       ast.Attribute):
+            out.add(id(t.value))
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                note_target(e)
+        if isinstance(t, ast.Starred):
+            note_target(t.value)
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            note_target(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        note_target(stmt.target)
+    elif isinstance(stmt, ast.Delete):
+        for t in stmt.targets:
+            note_target(t)
+    for sub in _iter_own_exprs(stmt):
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute) \
+                and sub.func.attr in _MUTATORS \
+                and isinstance(sub.func.value, ast.Attribute):
+            out.add(id(sub.func.value))
+    return out
+
+
+# -- module pass ------------------------------------------------------------
+
+
+def analyze_module(tree: ast.Module, summary: Dict[str, Any]
+                   ) -> List[Finding]:
+    """GC050/GC053/GC054 + the module-local GC051 forms over every
+    function; exports lock tables and held-call facts into `summary`
+    for the project pass (GC051 transitive, GC052 order graph)."""
+    findings: List[Finding] = []
+    stats: Dict[str, int] = {}
+    ml = _discover(tree)
+    known_locks = set(summary.get("module_unser", ()))
+    analyses: Dict[str, _FnAnalysis] = {}
+    by_class: Dict[str, List[str]] = {}
+
+    module_has_locks = bool(ml.globals_ or ml.global_sync)
+    for fndef, qname, cls in collect_functions(tree):
+        stats["fns_total"] = stats.get("fns_total", 0) + 1
+        if is_generator(fndef):
+            stats["fns_generators_skipped"] = \
+                stats.get("fns_generators_skipped", 0) + 1
+            continue
+        cls_relevant = cls is not None and (cls in ml.classes
+                                            or cls in ml.sync)
+        if not cls_relevant and not module_has_locks \
+                and not _has_lock_syntax(fndef):
+            stats["fns_trivial"] = stats.get("fns_trivial", 0) + 1
+            continue
+        ctx = _FnCtx(fndef, qname, cls, summary, ml, known_locks)
+        fa = _FnAnalysis(ctx)
+        try:
+            ok = fa.run(stats)
+        except Exception:        # never fail the lint on one function
+            stats["fns_errors"] = stats.get("fns_errors", 0) + 1
+            continue
+        if not ok:
+            continue
+        stats["fns_analyzed"] = stats.get("fns_analyzed", 0) + 1
+        stats["held_states"] = stats.get("held_states", 0) \
+            + fa.events.states
+        analyses[qname] = fa
+        if cls:
+            by_class.setdefault(cls, []).append(qname)
+
+    _helper_pass(analyses, by_class, ml, stats)
+
+    stats["locks_discovered"] = sum(len(v) for v in ml.classes.values()) \
+        + len(ml.globals_)
+    stats["classes_with_locks"] = len(ml.classes)
+
+    for qname, fa in analyses.items():
+        findings.extend(_function_findings(fa))
+    findings.extend(_guarded_by(summary, ml, analyses, by_class, stats))
+    _export(summary, ml, analyses, stats)
+    return findings
+
+
+def _helper_pass(analyses: Dict[str, _FnAnalysis],
+                 by_class: Dict[str, List[str]], ml: _ModuleLocks,
+                 stats: Dict[str, int]) -> None:
+    """Intraclass helper entry inference, iterated to a fixpoint: a
+    private method whose every intraclass call site holds L is
+    re-analyzed as entered-with-L. Iteration lets heldness cascade
+    through helper chains (create -> _ensure_space -> _evict ->
+    _release_entry); entries only grow, so this converges."""
+    for cls, qnames in by_class.items():
+        if cls not in ml.classes:
+            continue
+        for _round in range(5):
+            changed = False
+            sites: Dict[str, List[frozenset]] = {}
+            for q in qnames:
+                if q.count(".") >= 2:
+                    continue   # a closure's entry state is unknown: its
+                    # call sites are not evidence against heldness
+                for m, held, _line in analyses[q].events.intraclass_calls:
+                    sites.setdefault(m, []).append(held)
+            for m, helds in sites.items():
+                q = f"{cls}.{m}"
+                fa = analyses.get(q)
+                if fa is None or not m.startswith("_") \
+                        or m.startswith("__"):
+                    continue
+                entry = frozenset.intersection(*helds) if helds \
+                    else frozenset()
+                entry = tuple(sorted(
+                    t for t in entry if fa.ctx.lock_rec(t) is not None))
+                if not entry or entry == fa.ctx.entry_tokens:
+                    continue
+                fa.ctx.entry_tokens = entry
+                if fa.run(stats):
+                    changed = True
+                    stats["helper_reruns"] = \
+                        stats.get("helper_reruns", 0) + 1
+            if not changed:
+                break
+
+
+def _function_findings(fa: _FnAnalysis) -> List[Finding]:
+    out: List[Finding] = []
+    ctx = fa.ctx
+    ev = fa.events
+    path = ctx.summary["path"]
+
+    def role(tok: str) -> str:
+        rec = ctx.lock_rec(tok)
+        if rec and rec.get("role"):
+            return rec["role"]
+        return tok
+
+    def report(rule: str, line: int, message: str) -> None:
+        if not suppressed(ctx.summary, line, rule):
+            out.append(Finding(path=path, line=line, col=1, rule=rule,
+                               message=message))
+
+    for tok, line in ev.reentries:
+        report("GC051", line,
+               f"re-acquiring non-reentrant lock '{role(tok)}' already "
+               f"held on this path in {ctx.qname}: guaranteed "
+               f"self-deadlock (use reentrant=True or drop the lock "
+               f"first)")
+    seen_cb = set()
+    for desc, line, held in ev.cb_calls:
+        key = (desc, line)
+        if key in seen_cb:
+            continue
+        seen_cb.add(key)
+        roles = ", ".join(sorted(role(t) for t in held))
+        report("GC051", line,
+               f"stored callback {desc} invoked while holding "
+               f"[{roles}] in {ctx.qname}: a callback that re-enters "
+               f"this class deadlocks (the peer-connect class) — invoke "
+               f"it after releasing the lock")
+    seen_blk = set()
+    for desc, _exempt, line, held in ev.blocking:
+        if line in seen_blk:
+            continue
+        seen_blk.add(line)
+        roles = ", ".join(sorted(role(t) for t in held))
+        report("GC053", line,
+               f"{desc} reached while holding [{roles}] in "
+               f"{ctx.qname}: one slow peer wedges every thread queued "
+               f"on the lock — release before blocking")
+    # GC054: check-then-act pairing over CFG reachability
+    reach_memo: Dict[int, Set[int]] = {}
+    seen_cta = set()
+    for ckind, ckey, cidx, cline, cheld in ev.checks:
+        for akind, akey, aidx, aline, aheld in ev.acts:
+            if akind != ckind or akey != ckey or aidx == cidx:
+                continue
+            if ckind != "event" and not cheld:
+                continue   # unlocked check: nothing was dropped in between
+            if cheld & aheld:
+                continue   # a common lock spans both: atomic
+            if cidx not in reach_memo:
+                reach_memo[cidx] = fa.reachable_from(cidx)
+            if aidx not in reach_memo[cidx]:
+                continue
+            key = (ckey, cline, aline)
+            if key in seen_cta:
+                continue
+            seen_cta.add(key)
+            what = {"event": "Event tested with is_set()",
+                    "member": "membership tested",
+                    "none": "None-tested"}[ckind]
+            why = "but the guard lock was released in between" if cheld \
+                else "with no lock spanning test and mutation"
+            report("GC054", aline,
+                   f"non-atomic check-then-act on {ckey} in "
+                   f"{ctx.qname}: {what} at line {cline}, mutated here "
+                   f"{why} — two racing threads both pass the test")
+    return out
+
+
+def _init_only_methods(analyses: Dict[str, _FnAnalysis],
+                       qnames: List[str]) -> Set[str]:
+    """Private methods whose every intraclass call site sits in a
+    dunder (or another such method): the init path runs before any
+    worker thread exists, so the constructor escape extends to them."""
+    callers: Dict[str, Set[str]] = {}
+    for q in qnames:
+        caller = q.rsplit(".", 1)[-1]
+        for m, _held, _line in analyses[q].events.intraclass_calls:
+            callers.setdefault(m, set()).add(caller)
+
+    def is_dunder(m: str) -> bool:
+        return m.startswith("__") and m.endswith("__")
+
+    out: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for m, cs in callers.items():
+            if m in out or not m.startswith("_") or is_dunder(m):
+                continue
+            if cs and all(is_dunder(c) or c in out for c in cs):
+                out.add(m)
+                changed = True
+    return out
+
+
+def _guarded_by(summary: Dict[str, Any], ml: _ModuleLocks,
+                analyses: Dict[str, _FnAnalysis],
+                by_class: Dict[str, List[str]], stats: Dict[str, int]
+                ) -> List[Finding]:
+    """GC050: per class, infer each attribute's guard lock from the
+    majority of accesses, then flag accesses holding no lock at all."""
+    out: List[Finding] = []
+    for cls, qnames in by_class.items():
+        locks = ml.classes.get(cls)
+        if not locks:
+            continue
+        sync = ml.sync.get(cls, {})
+        types = ml.attr_types.get(cls, {})
+        init_only = _init_only_methods(analyses, qnames)
+        # attr -> [(guard-or-None, write, line, col, qname)]
+        acc: Dict[str, List[Tuple[Optional[frozenset], bool, int, int,
+                                  str]]] = {}
+        for q in qnames:
+            method = q.split(".", 1)[-1].split(".")[0] if "." in q else q
+            if method.startswith("__") and method.endswith("__"):
+                continue    # constructor escape + debug surfaces
+            if method in init_only:
+                continue    # init path: runs before any thread exists
+            # a nested closure's entry state is unknown (it may run
+            # under the enclosing with-block's lock, or escape): its
+            # accesses are neither guard evidence nor bare accesses
+            closure = q.count(".") >= 2
+            fa = analyses[q]
+            for attr, write, line, col, held in fa.events.attr_accesses:
+                if attr in locks or attr in sync or attr in types \
+                        or attr.startswith("__"):
+                    continue
+                known = frozenset(
+                    t for t in held if fa.ctx.lock_rec(t) is not None)
+                guards = known if known else (
+                    frozenset() if not held and not closure else None)
+                # `guards is None` => only unknown (fallback) locks held:
+                # neither evidence for a guard nor a bare access
+                acc.setdefault(attr, []).append(
+                    (guards, write, line, col, q))
+        for attr, accesses in sorted(acc.items()):
+            if not any(w for _, w, _, _, _ in accesses):
+                continue    # init-only / effectively immutable
+            counted = [a for a in accesses if a[0] is not None]
+            if len(counted) < 3:
+                continue
+            tally: Dict[str, int] = {}
+            for guards, _, _, _, _ in counted:
+                for g in guards:
+                    tally[g] = tally.get(g, 0) + 1
+            if not tally:
+                continue
+            guard = max(sorted(tally), key=lambda g: tally[g])
+            n = tally[guard]
+            if n < 2 or n * 4 < len(counted) * 3:
+                continue    # no majority (>= 75%) guard
+            stats["guards_inferred"] = stats.get("guards_inferred", 0) + 1
+            rec = ml.classes[cls].get(guard[5:]) if guard.startswith(
+                "self.") else ml.globals_.get(guard)
+            gname = rec["role"] if rec and rec.get("role") else guard
+            for guards, write, line, col, q in counted:
+                if guards:
+                    continue   # some known lock held: not the bare class
+                if suppressed(summary, line, "GC050"):
+                    continue
+                verb = "written" if write else "read"
+                out.append(Finding(
+                    path=summary["path"], line=line, col=col,
+                    rule="GC050",
+                    message=(f"self.{attr} is guarded by '{gname}' on "
+                             f"{n}/{len(counted)} accesses but {verb} "
+                             f"here ({q}) with no lock held — "
+                             f"stale-read/lost-update hazard")))
+    return out
+
+
+def _export(summary: Dict[str, Any], ml: _ModuleLocks,
+            analyses: Dict[str, _FnAnalysis], stats: Dict[str, int]
+            ) -> None:
+    mod = summary["module"]
+    locks: Dict[str, Dict[str, Any]] = {}
+    for cls, attrs in ml.classes.items():
+        for attr, rec in attrs.items():
+            locks[f"{mod}.{cls}.{attr}"] = {
+                "role": rec.get("role"), "reentrant": rec["reentrant"],
+                "kind": rec["kind"], "line": rec["line"],
+                "alias": rec.get("alias"), "scope": "attr"}
+    for name, rec in ml.globals_.items():
+        locks[f"{mod}.{name}"] = {
+            "role": rec.get("role"), "reentrant": rec["reentrant"],
+            "kind": rec["kind"], "line": rec["line"], "alias": None,
+            "scope": "global"}
+    conc: Dict[str, Any] = {"stats": stats}
+    if locks:
+        conc["locks"] = locks
+    if ml.attr_types:
+        conc["attr_types"] = {c: dict(t) for c, t in ml.attr_types.items()}
+    summary["concurrency"] = conc
+    for qname, fa in analyses.items():
+        ev = fa.events
+        acquires = {}
+        for tok, line in ev.acquires.items():
+            key = fa.ctx.canonical(tok)
+            if key:
+                acquires[key] = line
+        edges = []
+        for a, b, line in ev.edges:
+            ka, kb = fa.ctx.canonical(a), fa.ctx.canonical(b)
+            if ka and kb and ka != kb:
+                edges.append([ka, kb, line])
+        calls_held = []
+        for held, callee, line in ev.calls_held:
+            keys = sorted(k for k in (fa.ctx.canonical(t) for t in held)
+                          if k)
+            if keys:
+                calls_held.append([keys, callee, line])
+        if acquires or edges or calls_held:
+            fnrec = summary["functions"].get(qname)
+            if fnrec is not None:
+                fnrec["concurrency"] = {
+                    "acquires": acquires, "edges": edges,
+                    "calls_held": calls_held}
+
+
+# -- project pass -----------------------------------------------------------
+
+
+class _ProjectLocks:
+    """Cross-module lock table + transitive-acquire closures."""
+
+    _MAX_NODES = 4096
+
+    def __init__(self, index):
+        self.index = index
+        self.locks: Dict[str, Dict[str, Any]] = {}
+        for s in index.summaries:
+            self.locks.update((s.get("concurrency") or {}).get("locks", {}))
+        self._callees: Dict[str, List[Tuple[str, int, str]]] = {}
+        self._tacq: Dict[str, Dict[str, Tuple[Optional[str], int]]] = {}
+        self._tacq_self: Dict[str, Set[str]] = {}
+
+    def role(self, key: str) -> str:
+        rec = self.locks.get(key, {})
+        return rec.get("role") or key
+
+    def reentrant(self, key: str) -> bool:
+        return bool(self.locks.get(key, {}).get("reentrant"))
+
+    def resolve_callee(self, summary, fn, name: str) -> Optional[str]:
+        from .engine import resolve_call_target
+
+        parts = name.split(".")
+        if len(parts) == 3 and parts[0] == "self" and fn.get("cls"):
+            types = (summary.get("concurrency") or {}).get(
+                "attr_types", {}).get(fn["cls"], {})
+            ctor = types.get(parts[1])
+            if ctor:
+                cls_fq = self.index.resolve_class(summary, ctor)
+                if cls_fq:
+                    cand = f"{cls_fq}.{parts[2]}"
+                    if cand in self.index.functions:
+                        return cand
+            return None
+        return resolve_call_target(self.index, summary, fn, name)
+
+    def callees(self, fq: str) -> List[Tuple[str, int, str]]:
+        got = self._callees.get(fq)
+        if got is not None:
+            return got
+        summary, fn = self.index.functions[fq]
+        out: List[Tuple[str, int, str]] = []
+        for call in fn.get("calls", ()):
+            tgt = self.resolve_callee(summary, fn, call["name"])
+            if tgt is not None and tgt != fq:
+                out.append((tgt, call["lineno"], call["name"]))
+        self._callees[fq] = out
+        return out
+
+    def tacq(self, fq: str) -> Dict[str, Tuple[Optional[str], int]]:
+        """Transitive acquires of `fq` following every resolvable call:
+        lock key -> (via callee fq or None-if-direct, site line)."""
+        if fq in self._tacq:
+            return self._tacq[fq]
+        # collect the reachable subgraph, then iterate to a fixpoint
+        order: List[str] = []
+        seen = {fq}
+        stack = [fq]
+        while stack and len(seen) < self._MAX_NODES:
+            cur = stack.pop()
+            order.append(cur)
+            for tgt, _, _ in self.callees(cur):
+                if tgt not in seen:
+                    seen.add(tgt)
+                    stack.append(tgt)
+        acq: Dict[str, Dict[str, Tuple[Optional[str], int]]] = {}
+        for f in order:
+            _, fn = self.index.functions[f]
+            own = (fn.get("concurrency") or {}).get("acquires", {})
+            acq[f] = {k: (None, line) for k, line in own.items()}
+        changed = True
+        rounds = 0
+        while changed and rounds < 50:
+            changed = False
+            rounds += 1
+            for f in reversed(order):
+                mine = acq[f]
+                for tgt, line, _name in self.callees(f):
+                    for k in acq.get(tgt, ()):
+                        if k not in mine:
+                            mine[k] = (tgt, line)
+                            changed = True
+        for f, m in acq.items():
+            self._tacq.setdefault(f, m)
+        return self._tacq[fq]
+
+    def tacq_self(self, fq: str) -> Set[str]:
+        """Transitive acquires following only same-class ``self.m()``
+        calls — the same-instance discipline GC051 needs for class-attr
+        locks (another instance's ``self._lock`` is a different object)."""
+        if fq in self._tacq_self:
+            return self._tacq_self[fq]
+        cls_prefix = fq.rsplit(".", 1)[0]
+        seen = {fq}
+        stack = [fq]
+        out: Set[str] = set()
+        while stack:
+            cur = stack.pop()
+            summary, fn = self.index.functions[cur]
+            out.update((fn.get("concurrency") or {}).get("acquires", {}))
+            for call in fn.get("calls", ()):
+                parts = call["name"].split(".")
+                if len(parts) == 2 and parts[0] == "self":
+                    tgt = f"{cls_prefix}.{parts[1]}"
+                    if tgt in self.index.functions and tgt not in seen:
+                        seen.add(tgt)
+                        stack.append(tgt)
+        self._tacq_self[fq] = out
+        return out
+
+    def chain(self, fq: str, key: str, depth: int = 8) -> str:
+        """Human-readable acquire chain for a transitive key."""
+        hops = []
+        cur = fq
+        while depth > 0:
+            depth -= 1
+            via = self.tacq(cur).get(key)
+            if via is None:
+                break
+            nxt, line = via
+            if nxt is None:
+                hops.append(f"acquires '{self.role(key)}' at line {line}")
+                break
+            hops.append(f"{nxt.rsplit('.', 1)[-1]} (line {line})")
+            cur = nxt
+        return " -> ".join(hops) if hops else f"acquires '{self.role(key)}'"
+
+
+def build_lock_order_graph(index) -> Dict[Tuple[str, str],
+                                          Tuple[str, int, str]]:
+    """The static role-level lock-order graph, project-wide.
+
+    Edges come from (a) directly nested held states and (b) every call
+    made with locks held, crossed with the callee's transitive
+    acquires. Returns ``(role_held, role_acquired) -> (path, line,
+    via)`` with the lexically-first witness site per edge. The dynamic
+    order graph observed under ``RAY_TPU_DEBUG_LOCKS=1`` must be a
+    subgraph of this (``scripts/locks_gate.py``).
+    """
+    pl = _ProjectLocks(index)
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+    def note(ra: str, rb: str, site: Tuple[str, int, str]) -> None:
+        if ra == rb:
+            return
+        prev = edges.get((ra, rb))
+        if prev is None or (site[0], site[1]) < (prev[0], prev[1]):
+            edges[(ra, rb)] = site
+
+    for s in index.summaries:
+        for fn in s["functions"].values():
+            conc = fn.get("concurrency")
+            if not conc:
+                continue
+            for a, b, line in conc.get("edges", ()):
+                note(pl.role(a), pl.role(b), (s["path"], line, ""))
+            for held, callee, line in conc.get("calls_held", ()):
+                fq = pl.resolve_callee(s, fn, callee)
+                if fq is None:
+                    continue
+                for k in pl.tacq(fq):
+                    for h in held:
+                        note(pl.role(h), pl.role(k),
+                             (s["path"], line, f"via {callee}"))
+    return edges
+
+
+def project_lock_roles(index) -> List[str]:
+    """Every known lock role, project-wide: the instrumented role string
+    ('*'-wildcarded for f-string shard roles) or the canonical dotted
+    token for plain locks. ``scripts/locks_gate.py`` uses this to
+    recognize dynamic edges between two shards of one wildcard family,
+    which the static graph collapses to a single (self-)role and
+    therefore never lists as an edge."""
+    pl = _ProjectLocks(index)
+    return sorted({pl.role(k) for k in pl.locks})
+
+
+def _sccs(edges: Dict[Tuple[str, str], Any]) -> List[List[str]]:
+    """Tarjan SCCs (iterative) of the role graph; only components with
+    at least one internal cycle are returned."""
+    adj: Dict[str, List[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+        adj.setdefault(b, [])
+    idx: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    for root in sorted(adj):
+        if root in idx:
+            continue
+        work: List[Tuple[str, int]] = [(root, 0)]
+        while work:
+            v, pi = work[-1]
+            if pi == 0:
+                idx[v] = low[v] = counter[0]
+                counter[0] += 1
+                stack.append(v)
+                on.add(v)
+            advanced = False
+            nbrs = adj[v]
+            while pi < len(nbrs):
+                w = nbrs[pi]
+                pi += 1
+                work[-1] = (v, pi)
+                if w not in idx:
+                    work.append((w, 0))
+                    advanced = True
+                    break
+                if w in on:
+                    low[v] = min(low[v], idx[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                u = work[-1][0]
+                low[u] = min(low[u], low[v])
+            if low[v] == idx[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1:
+                    out.append(sorted(comp))
+    return out
+
+
+def run(index, enabled: Set[str]) -> List[Finding]:
+    """Project pass: GC052 order-graph cycles and GC051 transitive
+    re-acquires through the resolvable call graph."""
+    out: List[Finding] = []
+    if not ({"GC051", "GC052"} & enabled):
+        return out
+    pl = _ProjectLocks(index)
+
+    if "GC052" in enabled:
+        edges = build_lock_order_graph(index)
+        for comp in _sccs(edges):
+            members = set(comp)
+            hops = sorted((a, b) for a, b in edges
+                          if a in members and b in members)
+            sites = []
+            for a, b in hops:
+                path, line, via = edges[(a, b)]
+                note = f" {via}" if via else ""
+                sites.append(f"{a} -> {b} ({path}:{line}{note})")
+            path, line, _ = edges[hops[0]]
+            s = next((s for s in index.summaries if s["path"] == path),
+                     None)
+            if s is not None and suppressed(s, line, "GC052"):
+                continue
+            out.append(Finding(
+                path=path, line=line, col=1, rule="GC052",
+                message=("lock-order cycle between roles "
+                         f"[{', '.join(comp)}]: " + "; ".join(sites)
+                         + " — the AB/BA deadlock precondition; pick "
+                         "one global order")))
+
+    if "GC051" in enabled:
+        for s in index.summaries:
+            for fn in s["functions"].values():
+                conc = fn.get("concurrency")
+                if not conc:
+                    continue
+                for held, callee, line in conc.get("calls_held", ()):
+                    if suppressed(s, line, "GC051"):
+                        continue
+                    fq = pl.resolve_callee(s, fn, callee)
+                    if fq is None:
+                        continue
+                    for k in held:
+                        if pl.reentrant(k):
+                            continue
+                        if pl.locks.get(k, {}).get("scope") == "attr":
+                            # same-instance chains only: self.m() calls
+                            if not (callee.startswith("self.")
+                                    and callee.count(".") == 1):
+                                continue
+                            hit = k in pl.tacq_self(fq)
+                        else:
+                            hit = k in pl.tacq(fq)
+                        if not hit:
+                            continue
+                        out.append(Finding(
+                            path=s["path"], line=line, col=1,
+                            rule="GC051",
+                            message=(f"call to {callee} while holding "
+                                     f"'{pl.role(k)}': the callee "
+                                     f"transitively re-acquires it "
+                                     f"({pl.chain(fq, k)}) — "
+                                     f"non-reentrant self-deadlock")))
+    return out
+
+
+def aggregate_stats(summaries) -> Dict[str, int]:
+    total: Dict[str, int] = {}
+    for s in summaries:
+        for k, v in (s.get("concurrency") or {}).get("stats", {}).items():
+            total[k] = total.get(k, 0) + int(v)
+    return total

@@ -11,7 +11,7 @@ from .local import RULES, Finding
 SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
                 "master/Schemata/sarif-schema-2.1.0.json")
 SARIF_VERSION = "2.1.0"
-TOOL_VERSION = "4.0.0"
+TOOL_VERSION = "5.0.0"
 
 
 def _uri(path: str, base: str) -> str:

@@ -40,7 +40,7 @@ from .local import (Finding, _assigned_names, _ctor_kind, _dotted,
 
 # Folded into the cache key (engine.CACHE_VERSION): bump when the
 # summary schema or extraction logic changes.
-SUMMARY_VERSION = 4  # v4: shape/spec facts, wrapper sites, mesh sizes
+SUMMARY_VERSION = 5  # v5: concurrency lock tables + held-call facts
 
 #: the two sharding/lower.py wrappers that carry a program onto a mesh;
 #: sites through them are recorded alongside plain shard_map sites

@@ -30,6 +30,7 @@ timeline and as ``ray_tpu_task_events_total{state="LOCK_..."}`` in
 """
 from __future__ import annotations
 
+import atexit
 import collections
 import logging
 import os
@@ -217,6 +218,12 @@ class _Registry:
         with self._mu:
             return list(self.reports)
 
+    def order_edges(self) -> List[Tuple[str, str]]:
+        with self._mu:
+            return sorted((held, acq)
+                          for held, acqs in self._edges.items()
+                          for acq in acqs)
+
     def reset(self) -> None:
         with self._mu:
             self._edges.clear()
@@ -238,6 +245,45 @@ def get_lock_reports() -> List[LockReport]:
 def reset_lock_state() -> None:
     """Clear the order graph and report buffer (test isolation)."""
     _registry.reset()
+
+
+def get_order_edges() -> List[Tuple[str, str]]:
+    """The observed role-level order graph as (held, acquired) edges.
+
+    This is the dynamic twin of graftcheck's static lock-order graph
+    (``graftcheck locks``); ``scripts/locks_gate.py`` asserts every edge
+    observed here is predicted by the static graph.
+    """
+    return _registry.order_edges()
+
+
+def _dump_order_edges() -> None:
+    """atexit hook: append observed edges to RAY_TPU_LOCK_ORDER_DUMP.
+
+    Runs in every process (workers included — they import this module
+    when building their locks), so the gate sees the union of edges
+    across the whole process tree. O_APPEND keeps concurrent writers
+    from interleaving mid-line.
+    """
+    path = os.environ.get("RAY_TPU_LOCK_ORDER_DUMP", "")
+    if not path:
+        return
+    edges = _registry.order_edges()
+    if not edges:
+        return
+    payload = "".join(f"{held} -> {acq}\n" for held, acq in edges)
+    try:
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            os.write(fd, payload.encode())
+        finally:
+            os.close(fd)
+    except OSError:
+        pass
+
+
+if os.environ.get("RAY_TPU_LOCK_ORDER_DUMP"):
+    atexit.register(_dump_order_edges)
 
 
 def _capture_stack(skip: int = 2, limit: int = 8) -> str:

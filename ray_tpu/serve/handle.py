@@ -521,10 +521,13 @@ class DeploymentHandle:
             if hosts:
                 depths = self._probe_depths(hosts)
                 j = min(range(len(hosts)), key=lambda i: depths[i])
-                if depths[j] < self._max_q:
-                    with self._lock:
+                with self._lock:  # admission check + increment: atomic,
+                    # and _max_q may move under a router refresh
+                    admit = depths[j] < self._max_q
+                    if admit:
                         aid = hosts[j]._actor_id
                         self._inflight[aid] = self._inflight.get(aid, 0) + 1
+                if admit:
                     if session_id:
                         self._pin_session(session_id, hosts[j])
                     return hosts[j]

@@ -932,11 +932,16 @@ class LLMEngine:
         try:
             from ...perf.postmortem import dump_bundle
 
+            # advisory queue depths for the crash report: stale is fine
+            # graftcheck: disable=GC050
+            waiting = len(self._waiting)
+            # graftcheck: disable=GC050
+            running = len(self._running)
             dump_bundle(f"llm engine poisoned: {error!r}",
                         origin=f"llm:{self.name}",
                         meta={"engine": self.name,
-                              "waiting": len(self._waiting),
-                              "running": len(self._running)})
+                              "waiting": waiting,
+                              "running": running})
         except Exception:
             pass
         with self._lock:
@@ -1069,11 +1074,13 @@ class LLMEngine:
         0..tp-1 (same keying as the pool's shard accounting and the
         `{chip=}` gauge; raw jax device ids are global on multi-host
         TPUs and would not line up)."""
+        with self._lock:  # metrics thread: the step loop mutates _cache
+            cache = dict(self._cache)
         if self.owner is None:
             total = sum(int(np.asarray(v).nbytes)
-                        for v in self._cache.values())
+                        for v in cache.values())
             return {0: total}
-        by_dev = self.owner.per_device_bytes(self._cache)
+        by_dev = self.owner.per_device_bytes(cache)
         return {chip: by_dev.get(d.id, 0)
                 for chip, d in enumerate(self.owner.devices)}
 

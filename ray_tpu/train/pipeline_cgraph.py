@@ -2027,7 +2027,8 @@ class CompiledPipelineEngine:
         self._elastic = {"min": max(1, int(min_dp)),
                          "max": cap,
                          "grow": bool(grow_on_join)}
-        self._pending_dp: Optional[int] = None
+        with self._lock:  # the pubsub callback below reads it locked
+            self._pending_dp: Optional[int] = None
         self._elastic_unsub = self._rt.gcs.pubsub.subscribe(
             "node", self._on_elastic_node_event)
 

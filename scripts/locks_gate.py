@@ -1,30 +1,97 @@
 #!/usr/bin/env python
-"""Dynamic companion to the GC030-033 static lock-discipline rules: run
-the direct-dispatch suite under ``RAY_TPU_DEBUG_LOCKS=1`` (the
+"""Dynamic companion to the static lock rules (GC030-033, GC050-054):
+run the direct-dispatch suite under ``RAY_TPU_DEBUG_LOCKS=1`` (the
 instrumented-lock factory: per-thread acquisition stacks + role-level
-lock-order graph, docs/GRAFTCHECK.md) and assert ZERO lock-order
-inversions were reported anywhere in the run — driver and worker
-processes alike (their warnings reach the captured output through the
-driver log mirror).
+lock-order graph, docs/GRAFTCHECK.md) and assert
+
+  1. ZERO lock-order inversions were reported anywhere in the run —
+     driver and worker processes alike (their warnings reach the
+     captured output through the driver log mirror), and
+  2. every DYNAMICALLY OBSERVED held->acquired role edge is a subset of
+     the STATIC lock-order graph (``graftcheck locks --json``): the
+     graph GC052 proves acyclic must describe every ordering the
+     running system actually exercises, or the proof is about the
+     wrong graph.
+
+For (2) each process appends its observed ``held -> acq`` role pairs to
+``RAY_TPU_LOCK_ORDER_DUMP`` at exit (O_APPEND — workers and the driver
+share one file). A dynamic edge (h, a) is covered when a static edge
+(H, A) matches it role-pattern-wise (shard families carry fnmatch
+wildcards, e.g. ``gcs.events.s*``), or when h and a are two shards of
+ONE wildcard family — same-role edges are deliberately collapsed out of
+the static graph (a family's shards are ordered by index, not by the
+pairwise graph).
 
 The static pass proves release-on-every-path per function; this gate
 proves the cross-thread ORDER discipline the CFG cannot see, on the
 suite with the densest lock interleaving (per-caller lanes, peer
 caches, sharded head loops).
 
-Exit status: 0 = suite green and zero inversions; 1 otherwise.
+Exit status: 0 = suite green, zero inversions, dynamic graph covered;
+1 otherwise (uncovered edges are listed with the static hops closest
+to them).
 """
+import fnmatch
+import json
 import os
 import subprocess
 import sys
+import tempfile
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 MARKER = "lock-order inversion"
 
 
+def _read_dynamic_edges(path: str):
+    """Parse the dump file: one 'held -> acq' role pair per line."""
+    edges = set()
+    if not os.path.exists(path):
+        return edges
+    with open(path) as f:
+        for ln in f:
+            if " -> " not in ln:
+                continue
+            held, acq = ln.strip().split(" -> ", 1)
+            if held and acq:
+                edges.add((held, acq))
+    return edges
+
+
+def _static_graph():
+    """(edges, roles) from ``graftcheck locks --json`` over ray_tpu/."""
+    out = os.path.join(tempfile.gettempdir(), "locks_gate_static.json")
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.devtools.graftcheck", "locks",
+         "--json", "--out", out, "ray_tpu/"],
+        cwd=ROOT, capture_output=True, text=True)
+    if proc.returncode not in (0, 1):  # 1 = findings elsewhere; graph still valid
+        raise RuntimeError(f"graftcheck locks failed:\n{proc.stderr[-2000:]}")
+    with open(out) as f:
+        data = json.load(f)
+    return data["edges"], data.get("roles", [])
+
+
+def _covered(dyn, static_edges, roles) -> bool:
+    held, acq = dyn
+    for e in static_edges:
+        if fnmatch.fnmatch(held, e["src"]) and fnmatch.fnmatch(acq, e["dst"]):
+            return True
+    # two shards of one wildcard family: the static graph collapses
+    # same-role edges (intra-family order is by shard index)
+    for r in roles:
+        if "*" in r and fnmatch.fnmatch(held, r) and fnmatch.fnmatch(acq, r):
+            return True
+    return False
+
+
 def main() -> int:
+    dump = os.path.join(tempfile.gettempdir(),
+                        f"locks_gate_order_{os.getpid()}.txt")
+    if os.path.exists(dump):
+        os.unlink(dump)
     env = dict(os.environ)
     env["RAY_TPU_DEBUG_LOCKS"] = "1"
+    env["RAY_TPU_LOCK_ORDER_DUMP"] = dump
     env.setdefault("JAX_PLATFORMS", "cpu")
     proc = subprocess.run(
         [sys.executable, "-m", "pytest", "tests/test_dispatch_direct.py",
@@ -42,8 +109,38 @@ def main() -> int:
         for ln in inversions[:10]:
             print("  " + ln.strip())
         return 1
-    print("locks_gate: OK — suite green, zero lock-order inversions "
-          "under instrumented locks")
+
+    dyn_edges = _read_dynamic_edges(dump)
+    try:
+        static_edges, roles = _static_graph()
+    except RuntimeError as e:
+        print(f"locks_gate: FAIL — {e}")
+        return 1
+    uncovered = sorted(d for d in dyn_edges
+                       if not _covered(d, static_edges, roles))
+    if uncovered:
+        print(f"locks_gate: FAIL — {len(uncovered)} dynamically observed "
+              f"lock-order edge(s) missing from the static graph "
+              f"(GC052 proved the WRONG graph acyclic):")
+        for held, acq in uncovered:
+            print(f"  observed: {held} -> {acq}")
+            near = [e for e in static_edges
+                    if fnmatch.fnmatch(held, e["src"])
+                    or fnmatch.fnmatch(acq, e["dst"])]
+            for e in near[:4]:
+                print(f"    static hop: {e['src']} -> {e['dst']} "
+                      f"({e['path']}:{e['line']})")
+        print("  -> teach rules_concurrency.py the acquisition pattern "
+              "(receiver typing / container value types), or the order "
+              "proof does not bind the running system")
+        return 1
+    print(f"locks_gate: OK — suite green, zero lock-order inversions, "
+          f"{len(dyn_edges)} observed order edge(s) all inside the "
+          f"{len(static_edges)}-edge static graph")
+    try:
+        os.unlink(dump)
+    except OSError:
+        pass
     return 0
 
 

@@ -1,0 +1,37 @@
+"""Fixture: GC053 seeded positives — unbounded blocking calls reached
+with a lock held, next to their timeout-bounded or unlocked (clean)
+twins. Lines pinned by tests/test_graftcheck_engine.py. (Never
+imported at runtime.)"""
+import queue
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inbox = queue.Queue()
+        self._done = threading.Event()
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._drained = 0
+
+    def _run(self):
+        pass
+
+    def drain_one_bad(self):
+        with self._lock:
+            item = self._inbox.get()    # GC053: unbounded get under lock
+            self._drained += 1
+            return item
+
+    def stop_bad(self):
+        with self._lock:
+            self._worker.join()         # GC053: join under lock
+
+    def drain_one_ok(self):
+        with self._lock:
+            item = self._inbox.get(timeout=0.5)
+            self._drained += 1
+            return item
+
+    def await_done_ok(self):
+        self._done.wait()               # no lock held: fine

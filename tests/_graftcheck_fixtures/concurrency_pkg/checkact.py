@@ -1,0 +1,34 @@
+"""Fixture: GC054 seeded positives — check-then-act races on dict
+membership (guard lock dropped between test and pop) and on an Event
+(is_set/clear with no lock at all), next to the lock-spanning atomic
+forms. Lines pinned by tests/test_graftcheck_engine.py. (Never
+imported at runtime.)"""
+import threading
+
+
+class JobTable:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ready = threading.Event()
+        self._jobs = {}
+
+    def cancel_bad(self, key):
+        with self._lock:
+            if key not in self._jobs:
+                return None
+        return self._jobs.pop(key)   # GC054: lock dropped since the test
+
+    def cancel_ok(self, key):
+        with self._lock:
+            if key not in self._jobs:
+                return None
+            return self._jobs.pop(key)
+
+    def restart_bad(self):
+        if self._ready.is_set():
+            self._ready.clear()      # GC054: is_set/clear not atomic
+
+    def restart_ok(self):
+        with self._lock:
+            if self._ready.is_set():
+                self._ready.clear()

@@ -1,0 +1,115 @@
+"""Fixture: every shipped concurrency idiom in one place — all of it
+must stay SILENT under GC050-054. (Never imported at runtime — lint
+fixture only.)
+
+Shapes covered: with-lock discipline, constructor-escape writes,
+RLock re-entry through a helper, try-acquire probes with bound
+results, locked()-assert idiom, Condition waiting on its own lock,
+timeout-bounded blocking calls.
+"""
+import queue
+import threading
+
+
+class Ledger:
+    """All _entries accesses under self._lock; the constructor writes
+    are pre-publication and exempt from guard inference."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self._entries["boot"] = 0   # constructor escape: no lock needed
+
+    def put(self, k, v):
+        with self._lock:
+            self._entries[k] = v
+
+    def get(self, k):
+        with self._lock:
+            return self._entries.get(k)
+
+    def drop(self, k):
+        with self._lock:
+            self._entries.pop(k, None)
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._entries)
+
+
+class Reentrant:
+    """RLock: nested acquisition through a private helper is legal and
+    the helper inherits the caller's held set."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._bump_locked()
+
+    def _bump_locked(self):
+        with self._lock:      # re-entry on an RLock: fine
+            self._n += 1
+
+    def read(self):
+        with self._lock:
+            return self._n
+
+
+class Prober:
+    """try-acquire probes: the bound result gates the held state, so
+    the guarded body counts as locked and the bail-out path as not."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = "idle"
+
+    def try_update(self):
+        if self._lock.acquire(blocking=False):
+            try:
+                self._state = "busy"
+            finally:
+                self._lock.release()
+            return True
+        return False
+
+    def update(self):
+        with self._lock:
+            self._state = "busy"
+
+    def read(self):
+        with self._lock:
+            return self._state
+
+    def _render_locked(self):
+        assert self._lock.locked()
+        return self._state
+
+
+class BoundedWaits:
+    """Blocking under a lock is exempt when the wait releases that very
+    lock (Condition) or is timeout-bounded."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._inbox = queue.Queue()
+        self._items = []
+
+    def pop_wait(self):
+        with self._cv:
+            while not self._items:
+                self._cv.wait(1.0)   # releases its own lock: exempt
+            return self._items.pop()
+
+    def push(self, x):
+        with self._cv:
+            self._items.append(x)
+            self._cv.notify()
+
+    def drain(self):
+        with self._cv:
+            got = self._inbox.get(timeout=0.5)   # bounded: exempt
+            self._items.append(got)

@@ -1,0 +1,26 @@
+"""Fixture: GC050 seeded positive. _table is lock-disciplined on
+three of four accesses, so the guard is inferred — the one unlocked
+write in evict_fast must fire on its line (pinned by
+tests/test_graftcheck_engine.py). (Never imported at runtime.)"""
+import threading
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = {}
+
+    def put(self, k, v):
+        with self._lock:
+            self._table[k] = v
+
+    def get(self, k):
+        with self._lock:
+            return self._table.get(k)
+
+    def size(self):
+        with self._lock:
+            return len(self._table)
+
+    def evict_fast(self, k):
+        self._table.pop(k, None)    # GC050: write with no lock held

@@ -1,0 +1,43 @@
+"""Fixture: GC052 seeded positive — a three-class lock-order cycle.
+Alpha.step acquires Alpha's lock then calls Beta.advance, which
+acquires Beta's lock then calls Gamma.finish, which acquires Gamma's
+lock and calls back into Alpha.step: the static order graph holds the
+A -> B -> C -> A strongly connected component and GC052 must report
+every hop with its file:line. No single call path self-deadlocks (each
+hop runs on a different instance's lock), so GC051 stays silent here.
+(Never imported at runtime — the ctor wiring is for composition typing
+only.)"""
+import threading
+
+
+class Alpha:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.beta = Beta()
+
+    def step(self):
+        with self._lock:
+            self.beta.advance()
+
+
+class Beta:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.gamma = Gamma()
+
+    def advance(self):
+        with self._lock:
+            self.gamma.finish()
+
+
+class Gamma:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.alpha = None
+
+    def wire(self):
+        self.alpha = Alpha()
+
+    def finish(self):
+        with self._lock:
+            self.alpha.step()

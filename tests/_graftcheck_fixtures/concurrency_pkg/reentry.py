@@ -1,0 +1,51 @@
+"""Fixture: GC051 seeded positives — a non-reentrant lock re-acquired
+through one private-helper hop, and a stored callback invoked while
+the lock is held (also one helper hop down, so the held set reaches
+the callback through the helper pass). The RLock twin below is the
+clean control. Lines pinned by tests/test_graftcheck_engine.py.
+(Never imported at runtime.)"""
+import threading
+
+
+class Dispatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subscribers = []
+        self._pending = []
+
+    def register(self, cb):
+        with self._lock:
+            self._subscribers.append(cb)
+
+    def publish(self, msg):
+        with self._lock:
+            self._pending.append(msg)
+            self._emit(msg)
+
+    def _emit(self, msg):
+        for cb in self._subscribers:
+            cb(msg)          # GC051: callback invoked under self._lock
+
+    def kick(self):
+        with self._lock:
+            self._drain()    # GC051 (transitive): _drain re-acquires
+
+    def _drain(self):
+        with self._lock:     # GC051: re-acquire of a non-reentrant lock
+            del self._pending[:]
+
+
+class ReentrantDispatcher:
+    """Identical shape on an RLock: silent."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._pending = []
+
+    def kick(self):
+        with self._lock:
+            self._drain()
+
+    def _drain(self):
+        with self._lock:
+            del self._pending[:]

@@ -821,7 +821,8 @@ def tree_result():
          os.path.join(REPO, "tests")],
         rules={"GC008", "GC010", "GC011", "GC020", "GC021", "GC022",
                "GC030", "GC031", "GC032", "GC033",
-               "GC040", "GC041", "GC042", "GC043", "GC044"},
+               "GC040", "GC041", "GC042", "GC043", "GC044",
+               "GC050", "GC051", "GC052", "GC053", "GC054"},
         cache_path=None)
     assert res.errors == 0
     return res
@@ -1464,14 +1465,13 @@ def test_cached_shape_findings_identical_to_cold(tmp_path):
 
 
 def test_sarif_includes_shape_rule_metadata():
-    """The v4 SARIF driver carries GC040-044 entries and the bumped
-    tool version so code-scanning renders the new family."""
+    """The SARIF driver carries GC040-044 entries so code-scanning
+    renders the shape family."""
     from ray_tpu.devtools.graftcheck.sarif import to_sarif
     from ray_tpu.devtools.graftcheck.local import Finding
 
     doc = to_sarif([Finding("a.py", 3, 1, "GC040", "indivisible")])
     driver = doc["runs"][0]["tool"]["driver"]
-    assert driver["version"] == "4.0.0"
     assert {"GC040", "GC041", "GC042", "GC043", "GC044"} <= \
         {r["id"] for r in driver["rules"]}
     assert doc["runs"][0]["results"][0]["ruleId"] == "GC040"
@@ -1614,3 +1614,250 @@ def test_shipped_data_tree_is_clean():
         cache_path=None, root=os.path.join(REPO, "ray_tpu"))
     assert res.errors == 0
     assert [f.render() for f in res.findings] == []
+
+
+# ---------------------------------------------------------------------------
+# concurrency rules GC050-054 (graftcheck v5): guarded-by inference,
+# reentrancy/callback deadlocks, lock-order cycles, blocking-under-lock,
+# check-then-act
+
+
+CONCURRENCY = {"GC050", "GC051", "GC052", "GC053", "GC054"}
+
+
+class TestConcurrencyFixtures:
+    """The concurrency_pkg fixture pack: every seeded positive fires on
+    its line, every shipped idiom (with-locks, RLock re-entry through a
+    helper, try-acquire probes, Condition-on-own-lock waits, bounded
+    gets, constructor escapes) stays silent."""
+
+    @pytest.fixture(scope="class")
+    def res(self):
+        return run_pkg("concurrency_pkg", rules=CONCURRENCY)
+
+    def _at(self, res, fname, rule):
+        return [f for f in res.findings
+                if f.path.endswith(fname) and f.rule == rule]
+
+    def test_clean_idioms_are_silent(self, res):
+        assert not any(f.path.endswith("clean.py") for f in res.findings)
+
+    def test_unlocked_write_to_guarded_attr_is_gc050(self, res):
+        hits = self._at(res, "guarded.py", "GC050")
+        assert [f.line for f in hits] == [26]
+        msg = hits[0].message
+        assert "_table" in msg and "self._lock" in msg
+        assert "3/4" in msg     # inference ratio surfaces in the report
+
+    def test_direct_reacquire_through_helper_is_gc051(self, res):
+        """kick() -> _drain() re-acquires the non-reentrant lock: the
+        helper pass pushes kick's held set into _drain, which reports
+        the re-acquire on its with-line; the transitive project rule
+        additionally names the call site."""
+        hits = self._at(res, "reentry.py", "GC051")
+        direct = [f for f in hits if f.line == 34]
+        assert direct and "re-acquiring non-reentrant" in direct[0].message
+        trans = [f for f in hits if f.line == 31]
+        assert trans and "transitively" in trans[0].message
+
+    def test_callback_under_lock_via_helper_hop_is_gc051(self, res):
+        """publish() holds the lock and calls _emit(), which invokes the
+        stored subscriber callbacks: the held set crosses the helper hop
+        and the invocation line fires."""
+        cb = [f for f in self._at(res, "reentry.py", "GC051")
+              if f.line == 27]
+        assert len(cb) == 1 and "callback" in cb[0].message
+        assert "self._lock" in cb[0].message
+
+    def test_rlock_twin_stays_silent(self, res):
+        # ReentrantDispatcher (line 37 on) mirrors kick/_drain on an
+        # RLock: zero findings there
+        assert all(f.line < 37 for f in self._at(res, "reentry.py",
+                                                 "GC051"))
+
+    def test_three_class_order_cycle_is_gc052(self, res):
+        hits = self._at(res, "ordering.py", "GC052")
+        assert len(hits) == 1
+        msg = hits[0].message
+        for cls in ("Alpha._lock", "Beta._lock", "Gamma._lock"):
+            assert cls in msg
+        # every hop carries its file:line witness
+        for line in (20, 30, 43):
+            assert f"ordering.py:{line}" in msg, (line, msg)
+
+    def test_order_cycle_is_not_a_gc051_self_deadlock(self, res):
+        # each hop re-enters a DIFFERENT instance's lock: order hazard,
+        # not a self-deadlock — GC051 must stay quiet in ordering.py
+        assert self._at(res, "ordering.py", "GC051") == []
+
+    def test_blocking_under_lock_is_gc053(self, res):
+        hits = self._at(res, "blocking.py", "GC053")
+        assert [f.line for f in hits] == [22, 28]
+        assert "Queue.get() with no timeout" in hits[0].message
+        assert "join()" in hits[1].message
+
+    def test_check_then_act_is_gc054(self, res):
+        hits = self._at(res, "checkact.py", "GC054")
+        assert [f.line for f in hits] == [19, 29]
+        member = hits[0].message
+        assert "membership tested at line 17" in member
+        assert "released in between" in member
+        event = hits[1].message
+        assert "is_set()" in event and "line 28" in event
+
+    def test_exactly_the_seeded_positives(self, res):
+        expect = {("blocking.py", 22, "GC053"),
+                  ("blocking.py", 28, "GC053"),
+                  ("checkact.py", 19, "GC054"),
+                  # the dropped-lock pop is ALSO an unguarded write to a
+                  # majority-guarded attr: both rules own that line
+                  ("checkact.py", 19, "GC050"),
+                  ("checkact.py", 29, "GC054"),
+                  ("guarded.py", 26, "GC050"),
+                  ("ordering.py", 20, "GC052"),
+                  ("reentry.py", 27, "GC051"),
+                  ("reentry.py", 31, "GC051"),
+                  ("reentry.py", 34, "GC051")}
+        got = {(os.path.basename(f.path), f.line, f.rule)
+               for f in res.findings}
+        assert got == expect, got.symmetric_difference(expect)
+        assert res.errors == 0
+
+    def test_concurrency_stats_surface_analysis_cost(self, res):
+        st = res.concurrency_stats
+        assert st.get("fns_analyzed", 0) > 0
+        assert st.get("classes_with_locks", 0) >= 10
+        assert st.get("guards_inferred", 0) >= 3
+        assert st.get("helper_reruns", 0) >= 1
+        assert st.get("fns_errors", 0) == 0
+
+
+def test_cached_concurrency_findings_identical_to_cold(tmp_path):
+    """Lock tables, held-call facts and GC050-054 findings ride the
+    content-hash cache: a warm run reproduces the cold findings and
+    stats byte-for-byte without re-running the lock-domain fixpoint."""
+    pkg = os.path.join(FIXTURES, "concurrency_pkg")
+    cache = str(tmp_path / "cache.json")
+    cold = check_project([pkg], rules=CONCURRENCY, cache_path=cache,
+                         root=FIXTURES)
+    warm = check_project([pkg], rules=CONCURRENCY, cache_path=cache,
+                         root=FIXTURES)
+    assert warm.parsed == 0 and warm.cached == len(warm.files)
+    assert [f.render() for f in warm.findings] == \
+        [f.render() for f in cold.findings]
+    assert warm.findings
+    assert warm.concurrency_stats == cold.concurrency_stats
+
+
+def test_sarif_includes_concurrency_rule_metadata():
+    """The v5 SARIF driver carries GC050-054 entries and the bumped
+    tool version so code-scanning renders the new family."""
+    from ray_tpu.devtools.graftcheck.sarif import to_sarif
+    from ray_tpu.devtools.graftcheck.local import Finding
+
+    doc = to_sarif([Finding("a.py", 3, 1, "GC050", "unguarded")])
+    driver = doc["runs"][0]["tool"]["driver"]
+    assert driver["version"] == "5.0.0"
+    assert {"GC050", "GC051", "GC052", "GC053", "GC054"} <= \
+        {r["id"] for r in driver["rules"]}
+    assert doc["runs"][0]["results"][0]["ruleId"] == "GC050"
+
+
+def test_baseline_round_trips_concurrency_findings(tmp_path):
+    """A baselined GC050 finding is suppressed on re-run."""
+    from ray_tpu.devtools.graftcheck import baseline
+
+    res = run_pkg("concurrency_pkg", rules={"GC050"})
+    assert {f.rule for f in res.findings} == {"GC050"}
+    bl = str(tmp_path / "bl.json")
+    baseline.write(bl, res.findings)
+    assert baseline.filter_findings(res.findings, bl) == []
+
+
+def test_diff_mode_scopes_concurrency_reporting(tmp_path, monkeypatch):
+    """GC050 rides --diff scoping: an edit away from the offending
+    class passes, touching the class brings its finding into scope."""
+    import subprocess
+
+    def git(*args):
+        subprocess.run(["git", "-C", str(tmp_path), "-c",
+                        "user.email=t@t", "-c", "user.name=t", *args],
+                       check=True, capture_output=True)
+
+    bad_src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._d = {}\n"
+        "    def a(self):\n"
+        "        with self._lock:\n"
+        "            self._d['k'] = 1\n"
+        "    def b(self):\n"
+        "        with self._lock:\n"
+        "            return self._d.get('k')\n"
+        "    def c(self):\n"
+        "        with self._lock:\n"
+        "            return len(self._d)\n"
+        "    def d(self):\n"
+        "        self._d.pop('k', None)\n")
+    (tmp_path / "bad.py").write_text(bad_src)
+    (tmp_path / "other.py").write_text("Y = 1\n")
+    git("init", "-q")
+    git("add", ".")
+    git("commit", "-qm", "base")
+    monkeypatch.chdir(tmp_path)
+    assert graftcheck.main(["--no-cache", "--rules", "GC050",
+                            str(tmp_path)]) == 1
+    (tmp_path / "other.py").write_text("Y = 2\n")
+    assert graftcheck.main(["--no-cache", "--rules", "GC050", "--diff",
+                            "HEAD", str(tmp_path)]) == 0
+    (tmp_path / "bad.py").write_text(bad_src + "# touched\n")
+    assert graftcheck.main(["--no-cache", "--rules", "GC050", "--diff",
+                            "HEAD", str(tmp_path)]) == 1
+
+
+def test_locks_cli_dot_json_and_text(tmp_path, capsys):
+    """`graftcheck locks` renders the static lock-order graph: DOT with
+    labeled witness edges, JSON with src/dst/path/line/via records, and
+    the default text listing."""
+    pkg = os.path.join(FIXTURES, "concurrency_pkg")
+    out = tmp_path / "locks.dot"
+    rc = graftcheck.main(["locks", "--no-cache", "--dot", "--out",
+                          str(out), pkg])
+    assert rc == 0
+    dot = out.read_text()
+    assert dot.startswith("digraph lock_order")
+    assert "Alpha._lock" in dot and "Beta._lock" in dot
+    assert "ordering.py:" in dot      # witness file:line on the edge label
+
+    jout = tmp_path / "locks.json"
+    rc = graftcheck.main(["locks", "--no-cache", "--json", "--out",
+                          str(jout), pkg])
+    assert rc == 0
+    doc = json.loads(jout.read_text())
+    assert doc["edges"], "expected order edges"
+    for e in doc["edges"]:
+        assert {"src", "dst", "path", "line", "via"} <= set(e)
+    srcs = {e["src"] for e in doc["edges"]}
+    assert any("Alpha._lock" in s for s in srcs)
+
+    rc = graftcheck.main(["locks", "--no-cache", pkg])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "->" in text and "order edges" in text
+
+
+def test_library_tree_is_concurrency_clean(tree_result):
+    """Full-tree sweep for the v5 family: zero un-annotated GC050-054
+    findings across ray_tpu/, examples/ and tests/ — and the analyzer
+    ran everywhere it should (silent per-function failures would make
+    the sweep vacuously clean)."""
+    assert _tree_findings(
+        tree_result,
+        {"GC050", "GC051", "GC052", "GC053", "GC054"}) == []
+    st = tree_result.concurrency_stats
+    assert st.get("fns_analyzed", 0) > 500
+    assert st.get("classes_with_locks", 0) >= 40
+    assert st.get("guards_inferred", 0) >= 50
+    assert st.get("fns_errors", 0) == 0
